@@ -1,0 +1,40 @@
+"""tpu-a5: TPU-native substitution-attack candidate engine.
+
+A brand-new, TPU-first framework with the capabilities of the reference
+``A113L/hashcat_a5_table_generator`` (a Go CLI reimplementing hashcat-legacy's
+``-a 5`` table-lookup attack as a standalone candidate generator): substitution
+tables (``key=value`` lines, ``$HEX[]`` notation), four generation engines
+(default / reverse / substitute-all / substitute-all-reverse), candidate
+streaming — plus, beyond the reference, on-device Cartesian expansion, batched
+MD5/SHA1/NTLM hashing and digest-set membership as fused JAX/XLA kernels with
+the wordlist sharded across a TPU mesh.
+
+Layer map (cf. SURVEY.md §1):
+  tables/    — L0+L2: table parsing, merging, $HEX codec, layout emitters,
+               compilation to dense device arrays
+  oracle/    — L3 (CPU): byte-exact reference engines (the parity anchor)
+  ops/       — L3 (TPU): expansion / hash / membership kernels
+  models/    — fused end-to-end attack pipelines (expand→hash→membership)
+  parallel/  — L5: mesh construction, shard_map pipelines, collectives
+  runtime/   — sweep scheduler, cursors, checkpoint/resume, progress, sinks
+  utils/     — shared helpers
+  native/    — C++ host-side hot paths (wordlist packing) + ctypes bindings
+"""
+
+__version__ = "0.1.0"
+
+from .tables.parser import (  # noqa: F401
+    HexDecodeError,
+    decode_hex_notation,
+    merge_substitution_tables,
+    parse_substitution_table,
+    read_substitution_table,
+)
+from .oracle.engines import (  # noqa: F401
+    ReferencePanic,
+    iter_candidates,
+    process_word,
+    process_word_reverse,
+    process_word_substitute_all,
+    process_word_substitute_all_reverse,
+)

@@ -1,0 +1,15 @@
+"""Byte-exact CPU reference engines — the parity anchor for the TPU backend."""
+
+from .engines import (  # noqa: F401
+    ReferencePanic,
+    iter_candidates,
+    process_word,
+    process_word_reverse,
+    process_word_substitute_all,
+    process_word_substitute_all_reverse,
+)
+from .keyspace import (  # noqa: F401
+    count_candidates,
+    find_spans,
+    unique_patterns,
+)

@@ -1,0 +1,43 @@
+"""Test configuration: force JAX onto CPU with 8 virtual devices so the
+sharding / collective paths (pjit, shard_map, all_gather over a Mesh) are
+exercised without TPU hardware (SURVEY.md §4.3). Must run before jax imports."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pathlib
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+UPSTREAM_REFERENCE = pathlib.Path("/root/reference")
+
+
+@pytest.fixture(scope="session")
+def reference_tables(tmp_path_factory) -> pathlib.Path:
+    """Directory of parity-fixture ``.table`` files, regenerated from the
+    built-in layout maps by the emitter (golden-tested byte-identical to the
+    upstream artifacts in tests/test_layouts.py)."""
+    from hashcat_a5_table_generator_tpu.tables.layouts import (
+        BUILTIN_LAYOUTS,
+        emit_table,
+    )
+
+    tables_dir = tmp_path_factory.mktemp("tables")
+    for name, layout in BUILTIN_LAYOUTS.items():
+        emit_table(layout, str(tables_dir / f"{name}.table"))
+    return tables_dir
+
+
+@pytest.fixture(scope="session")
+def upstream_reference() -> pathlib.Path:
+    """The read-only upstream snapshot, when present (for golden byte checks)."""
+    if not UPSTREAM_REFERENCE.is_dir():
+        pytest.skip("upstream reference snapshot not available")
+    return UPSTREAM_REFERENCE

@@ -1,0 +1,67 @@
+"""Layout emitter golden tests: the built-in layout maps must regenerate the
+upstream ``.table`` artifacts byte-identically (BASELINE.json configs[0] —
+"emit qwerty-azerty.table from built-in layout maps")."""
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.tables.layouts import (
+    BUILTIN_LAYOUTS,
+    DERIVED_LAYOUTS,
+    get_layout,
+)
+from hashcat_a5_table_generator_tpu.tables.parser import parse_substitution_table
+
+
+@pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))
+def test_emitter_byte_identical_to_upstream(name, upstream_reference):
+    artifact = upstream_reference / f"{name}.table"
+    assert artifact.exists(), f"upstream artifact {name}.table missing"
+    assert BUILTIN_LAYOUTS[name].to_table_bytes() == artifact.read_bytes()
+
+
+@pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))
+def test_emitted_tables_parse_to_same_map(name):
+    layout = BUILTIN_LAYOUTS[name]
+    parsed = parse_substitution_table(layout.to_table_bytes())
+    assert parsed == layout.to_substitution_map()
+
+
+def test_azerty_qwerty_derivable():
+    # README.MD:112,147,154 reference azerty-qwerty.table but never check it
+    # in; inversion derives it.
+    inv = get_layout("azerty-qwerty")
+    fwd = get_layout("qwerty-azerty")
+    assert inv.pairs == tuple((v, k) for k, v in fwd.pairs)
+    # round-trips through the parser
+    parsed = parse_substitution_table(inv.to_table_bytes())
+    # 'q=a' and the case pair 'Q=a' both invert to key 'a', in pair order
+    assert parsed[b"a"] == [b"q", b"Q"]
+
+
+def test_inversion_involution():
+    layout = get_layout("qwerty-greek")
+    assert layout.inverted().inverted().pairs == layout.pairs
+
+
+def test_cyrillic_multi_option_preserved_in_order():
+    m = get_layout("qwerty-cyrillic").to_substitution_map()
+    assert m[b";"] == ["ж".encode(), "Ж".encode()]
+
+
+def test_unknown_layout_raises():
+    with pytest.raises(KeyError):
+        get_layout("dvorak-martian")
+
+
+def test_derived_registry_names():
+    assert set(DERIVED_LAYOUTS) == {
+        "cyrillic-qwerty", "greek-qwerty", "hebrew-greek", "azerty-qwerty",
+    }
+
+
+def test_hex_escaping_round_trip():
+    from hashcat_a5_table_generator_tpu.tables.layouts import Layout
+
+    layout = Layout("weird", pairs=(("=", " x "), ("#c", "ok"), ("a", "b")))
+    parsed = parse_substitution_table(layout.to_table_bytes())
+    assert parsed == {b"=": [b" x "], b"#c": [b"ok"], b"a": [b"b"]}

@@ -1,0 +1,254 @@
+"""CPU-oracle engine tests: the verified behavioral contract of SURVEY.md §2.4.
+
+Every Q-vector listed there (empirically confirmed against a faithful
+transcription of the reference) is asserted here; these are the anchors the
+TPU backend is later tested against.
+"""
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.oracle.engines import (
+    ReferencePanic,
+    iter_candidates,
+    process_word,
+    process_word_reverse,
+    process_word_substitute_all,
+    process_word_substitute_all_reverse,
+)
+
+
+def run(word, table, lo=0, hi=15, **kw):
+    return list(iter_candidates(word, table, lo, hi, **kw))
+
+
+HELLO_TABLE = {b"h": [b"H"], b"e": [b"E"], b"l": [b"L"], b"o": [b"O"]}
+PASSWORD_TABLE = {
+    b"p": [b"P"], b"a": [b"A"], b"s": [b"S"], b"w": [b"W"],
+    b"o": [b"O"], b"r": [b"R"], b"d": [b"D"],
+}
+
+
+class TestDefaultMode:
+    def test_keyspace_hello_31(self):
+        # Q10: k substitutable single-option positions => 2^k - 1 variants
+        assert len(run(b"hello", HELLO_TABLE)) == 31
+
+    def test_keyspace_password_255(self):
+        assert len(run(b"password", PASSWORD_TABLE)) == 255
+
+    def test_q1_original_never_emitted(self):
+        # min==0 silently bumped to 1 (main.go:169-171)
+        out = run(b"ab", {b"a": [b"X"]}, lo=0)
+        assert b"ab" not in out
+        assert out == [b"Xb"]
+
+    def test_q5_longest_key_first_ordering(self):
+        # verified vector: "ss" with {s=Z, ss=ß} => ß, Zs, ZZ, sZ
+        out = run(b"ss", {b"s": [b"Z"], b"ss": ["ß".encode()]})
+        assert out == ["ß".encode(), b"Zs", b"ZZ", b"sZ"]
+
+    def test_q6_no_rematch_of_replacement(self):
+        # verified: ab with a=b,b=c => bb, bc, ac (no cc)
+        out = run(b"ab", {b"a": [b"b"], b"b": [b"c"]})
+        assert out == [b"bb", b"bc", b"ac"]
+
+    def test_q7_duplicate_options_duplicate_candidates(self):
+        out = run(b"a", {b"a": [b"X", b"X"]})
+        assert out == [b"X", b"X"]
+
+    def test_q7_convergent_paths_duplicate(self):
+        out = run(b"ab", {b"a": [b"X"], b"ab": [b"Xb"]})
+        assert sorted(out) == [b"Xb", b"Xb"]
+
+    def test_min_max_window(self):
+        out = run(b"hello", HELLO_TABLE, lo=2, hi=2)
+        # C(5,2) = 10 pairs of substitutable positions ('l' appears twice)
+        assert len(out) == 10
+        assert all(sum(b < 0x61 for b in w) == 2 for w in out)
+
+    def test_max_zero_emits_nothing(self):
+        assert run(b"hello", HELLO_TABLE, lo=0, hi=0) == []
+
+    def test_multioption_key(self):
+        out = run(b"a", {b"a": [b"1", b"2"]})
+        assert out == [b"1", b"2"]
+
+    def test_no_match_emits_nothing(self):
+        assert run(b"zzz", HELLO_TABLE) == []
+
+    def test_length_changing_sub(self):
+        out = run(b"ab", {b"a": [b"XY"]})
+        assert out == [b"XYb"]
+
+    def test_empty_key_inert(self):
+        # match length >= 1 in default mode: empty key never looked up
+        assert run(b"ab", {b"": [b"X"]}) == []
+
+    def test_dfs_order_deterministic(self):
+        out1 = run(b"hello", HELLO_TABLE)
+        out2 = run(b"hello", HELLO_TABLE)
+        assert out1 == out2
+        # first emission substitutes the first substitutable position
+        assert out1[0] == b"Hello"
+
+
+class TestReverseMode:
+    def test_q1_original_emitted_at_min_zero(self):
+        out = run(b"ab", {b"a": [b"X"]}, reverse=True)
+        assert b"ab" in out
+        assert out == [b"Xb", b"ab"]  # max->min order: 1 sub first, then 0
+
+    def test_q2_first_option_only(self):
+        out = run(b"a", {b"a": [b"1", b"2"]}, lo=1, reverse=True)
+        assert out == [b"1"]
+
+    def test_q3_offset_bug_reproduced(self):
+        # verified vector: "ab" with a=XX, b=YY at exactly 2 subs emits aXXY
+        out = run(b"ab", {b"a": [b"XX"], b"b": [b"YY"]}, lo=2, hi=2, reverse=True)
+        assert out == [b"aXXY"]
+
+    def test_q3_bug_fixed_mode(self):
+        out = run(
+            b"ab", {b"a": [b"XX"], b"b": [b"YY"]}, lo=2, hi=2,
+            reverse=True, bug_compat=False,
+        )
+        assert out == [b"XXYY"]
+
+    def test_q3_panic_vector(self):
+        # "abab" with ab=X: descending combo [ab@2, ab@0] drives the buggy
+        # offset negative => the Go binary panics with slice out of range
+        with pytest.raises(ReferencePanic):
+            run(b"abab", {b"ab": [b"X"]}, lo=2, hi=2, reverse=True)
+
+    def test_q3_panic_vector_fixed_mode_ok(self):
+        out = run(b"abab", {b"ab": [b"X"]}, lo=2, hi=2, reverse=True,
+                  bug_compat=False)
+        assert out == [b"XX"]
+
+    def test_overlap_filter(self):
+        # "ss" spans s@0, ss@0, s@1: subsets of size 2 = {s@0,s@1} only
+        out = run(b"ss", {b"s": [b"Z"], b"ss": ["ß".encode()]},
+                  lo=2, hi=2, reverse=True)
+        assert out == [b"ZZ"]
+
+    def test_early_return_when_too_few_positions(self):
+        assert run(b"a", {b"a": [b"X"]}, lo=5, reverse=True) == []
+
+    def test_descending_count_order(self):
+        out = run(b"ab", {b"a": [b"A"], b"b": [b"B"]}, reverse=True)
+        # combos enumerate by DESCENDING index (main.go:273): among the k=1
+        # combos, position 1 ('b') substitutes before position 0 ('a')
+        assert out == [b"AB", b"aB", b"Ab", b"ab"]
+
+
+class TestSubstituteAllMode:
+    def test_q1_original_emitted_at_min_zero(self):
+        out = run(b"aa", {b"a": [b"X"]}, substitute_all=True)
+        assert out == [b"XX", b"aa"]
+
+    def test_all_occurrences_replaced_together(self):
+        out = run(b"abab", {b"a": [b"X"]}, lo=1, substitute_all=True)
+        assert out == [b"XbXb"]
+
+    def test_count_is_distinct_patterns_not_occurrences(self):
+        # "aa" has ONE unique pattern; min=2 can never be met
+        assert run(b"aa", {b"a": [b"X"]}, lo=2, substitute_all=True) == []
+
+    def test_product_keyspace(self):
+        # Q10: prod(options_i + 1) over unique patterns present
+        out = run(b"ab", {b"a": [b"1", b"2"], b"b": [b"3"]}, substitute_all=True)
+        assert len(out) == (2 + 1) * (1 + 1)
+
+    def test_enumeration_order(self):
+        # first pattern's options first, then skip branch (main.go:349-360)
+        out = run(b"ab", {b"a": [b"1"], b"b": [b"2"]}, substitute_all=True)
+        assert out == [b"12", b"1b", b"a2", b"ab"]
+
+    def test_q4_canonical_cascade_order(self):
+        # a=b then b=c chosen together: sorted order applies a's ReplaceAll
+        # first, so its output 'b' is re-replaced by the later b=c pass => cc
+        out = run(b"ab", {b"a": [b"b"], b"b": [b"c"]}, lo=2, substitute_all=True)
+        assert out == [b"cc"]
+
+    def test_transliteration_full_word(self):
+        table = {b"q": ["й".encode()], b"w": ["ц".encode()]}
+        out = run(b"qw", table, lo=2, substitute_all=True)
+        assert out == ["йц".encode()]
+
+    def test_multichar_pattern(self):
+        out = run(b"ssa", {b"ss": ["ß".encode()]}, lo=1, substitute_all=True)
+        assert out == ["ßa".encode()]
+
+    def test_empty_key_live_in_substitute_all(self):
+        # empty pattern matches every non-empty word; Python bytes.replace
+        # inserts per byte (documented divergence for multi-byte runes)
+        out = run(b"ab", {b"": [b"-"]}, lo=1, substitute_all=True)
+        assert out == [b"-a-b-"]
+
+
+class TestSubstituteAllReverseMode:
+    def test_q1_original_at_min_zero_and_subset_lattice(self):
+        out = run(b"ab", {b"a": [b"1"], b"b": [b"2"]},
+                  substitute_all=True, reverse=True)
+        # full set, then remove-one subsets in index order, down to empty
+        assert out == [b"12", b"a2", b"ab", b"1b"]
+
+    def test_q2_first_option_only(self):
+        out = run(b"a", {b"a": [b"1", b"2"]}, lo=1,
+                  substitute_all=True, reverse=True)
+        assert out == [b"1"]
+
+    def test_subset_count(self):
+        table = {b"a": [b"1"], b"b": [b"2"], b"c": [b"3"]}
+        out = run(b"abc", table, substitute_all=True, reverse=True)
+        assert len(out) == 8  # all subsets of 3 patterns
+
+    def test_early_return_too_few_patterns(self):
+        assert run(b"a", {b"a": [b"1"]}, lo=3,
+                   substitute_all=True, reverse=True) == []
+
+    def test_min_truncates_lattice(self):
+        table = {b"a": [b"1"], b"b": [b"2"], b"c": [b"3"]}
+        out = run(b"abc", table, lo=2, substitute_all=True, reverse=True)
+        assert len(out) == 4  # C(3,3) + C(3,2)
+
+    def test_max_filters_but_descends(self):
+        table = {b"a": [b"1"], b"b": [b"2"], b"c": [b"3"]}
+        out = run(b"abc", table, lo=0, hi=1, substitute_all=True, reverse=True)
+        assert sorted(out) == sorted([b"1bc", b"a2c", b"ab3", b"abc"])
+
+
+class TestAgainstFixtureTables:
+    def test_german_default_mode(self, reference_tables):
+        from hashcat_a5_table_generator_tpu.tables.parser import (
+            read_substitution_table,
+        )
+
+        table = read_substitution_table(str(reference_tables / "german.table"))
+        out = list(process_word(b"strasse", table, 0, 15))
+        assert "straße".encode() in out
+        # span model: substitutable spans are a@3, s@4, s@5, ss@4, e@6 is not
+        # in the table; non-overlapping subsets (weighted, all 1 option):
+        # positions {a,s,s,ss} -> count = 2^2 * 3 ... verified via keyspace
+        from hashcat_a5_table_generator_tpu.oracle.keyspace import (
+            count_candidates,
+        )
+
+        assert len(out) == count_candidates(b"strasse", table, 0, 15)
+        # the ß variants arise via BOTH the multi-char 'ss' key and Z is
+        # absent here, so straße appears exactly once
+        assert out.count("straße".encode()) == 1
+
+    def test_cyrillic_substitute_all(self, reference_tables):
+        from hashcat_a5_table_generator_tpu.tables.parser import (
+            read_substitution_table,
+        )
+
+        table = read_substitution_table(
+            str(reference_tables / "qwerty-cyrillic.table")
+        )
+        out = list(process_word_substitute_all(b"password", table, 8, 15))
+        # p,a,s,w,o,r,d = 7 unique patterns; lo=8 unreachable
+        assert out == []
+        out = list(process_word_substitute_all(b"password", table, 7, 15))
+        assert out == ["зфыыцщкв".encode()]

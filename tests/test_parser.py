@@ -1,0 +1,144 @@
+"""Table parser tests — the L2 parity contract (SURVEY.md §2.1, main.go:108-162)."""
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.tables.parser import (
+    HexDecodeError,
+    TableLineError,
+    decode_hex_notation,
+    merge_substitution_tables,
+    parse_substitution_table,
+)
+
+
+class TestHexNotation:
+    def test_passthrough_plain_value(self):
+        assert decode_hex_notation(b"abc") == b"abc"
+
+    def test_decodes_basic(self):
+        assert decode_hex_notation(b"$HEX[414243]") == b"ABC"
+
+    def test_case_insensitive(self):
+        assert decode_hex_notation(b"$HEX[aBcD]") == b"\xab\xcd"
+
+    def test_spaces_stripped(self):
+        # space-delimited hex is accepted (README.MD:172-176)
+        assert decode_hex_notation(b"$HEX[41 42 43]") == b"ABC"
+
+    def test_too_short_is_passthrough(self):
+        # "$HEX[]" is 6 bytes < 7 => returned verbatim (main.go:149)
+        assert decode_hex_notation(b"$HEX[]") == b"$HEX[]"
+
+    def test_odd_length_raises(self):
+        with pytest.raises(HexDecodeError):
+            decode_hex_notation(b"$HEX[abc]")
+
+    def test_nonhex_raises(self):
+        with pytest.raises(HexDecodeError):
+            decode_hex_notation(b"$HEX[zz]")
+
+    def test_unwrapped_prefix_passthrough(self):
+        assert decode_hex_notation(b"$HEX[41") == b"$HEX[41"
+
+
+class TestParse:
+    def test_basic_lines(self):
+        table = parse_substitution_table(b"a=b\nc=d\n")
+        assert table == {b"a": [b"b"], b"c": [b"d"]}
+
+    def test_comments_and_blanks_skipped(self):
+        table = parse_substitution_table(b"# comment\n\n  \na=b\n")
+        assert table == {b"a": [b"b"]}
+
+    def test_no_equals_silently_skipped(self):
+        # main.go:124-126
+        table = parse_substitution_table(b"noequals\na=b\n")
+        assert table == {b"a": [b"b"]}
+
+    def test_split_at_first_equals_value_may_contain_equals(self):
+        table = parse_substitution_table(b"a=b=c\n")
+        assert table == {b"a": [b"b=c"]}
+
+    def test_empty_key_line(self):
+        # "=x" and "==x" both yield an empty-key entry (SURVEY.md §2.1)
+        table = parse_substitution_table(b"=x\n==y\n")
+        assert table == {b"": [b"x", b"=y"]}
+
+    def test_repeated_key_appends_in_order(self):
+        table = parse_substitution_table(b"a=1\na=2\n")
+        assert table == {b"a": [b"1", b"2"]}
+
+    def test_duplicate_lines_kept(self):
+        # Q7: no dedupe — duplicate lines => duplicate candidates downstream
+        table = parse_substitution_table(b"a=X\na=X\n")
+        assert table == {b"a": [b"X", b"X"]}
+
+    def test_hex_on_both_sides(self):
+        table = parse_substitution_table(b"$HEX[3d]=$HEX[2020]\n")
+        assert table == {b"=": [b"  "]}
+
+    def test_bad_hex_skips_line_and_reports(self):
+        messages = []
+        table = parse_substitution_table(
+            b"$HEX[zz]=x\na=b\nc=$HEX[123]\n", on_skip=messages.append
+        )
+        assert table == {b"a": [b"b"]}
+        assert len(messages) == 2
+        assert "key" in messages[0] and "value" in messages[1]
+
+    def test_crlf_lines(self):
+        # qwerty-azerty.table is CRLF-terminated
+        table = parse_substitution_table(b"a=b\r\nc=d\r\n")
+        assert table == {b"a": [b"b"], b"c": [b"d"]}
+
+    def test_whitespace_trimmed(self):
+        table = parse_substitution_table(b"  a=b\t\n")
+        assert table == {b"a": [b"b"]}
+
+    def test_multichar_and_multibyte_keys(self):
+        # byte-string keys: "ss=ß" (german.table:7), UTF-8 both sides
+        table = parse_substitution_table("ss=ß\nε=ר\n".encode())
+        assert table == {b"ss": ["ß".encode()], "ε".encode(): ["ר".encode()]}
+
+    def test_oversized_line_raises(self):
+        # Go's bufio.Scanner would abort the file here (Q8 analog for tables)
+        with pytest.raises(TableLineError):
+            parse_substitution_table(b"a=" + b"x" * 70000 + b"\n")
+
+    def test_value_with_dollar_not_hex(self):
+        table = parse_substitution_table(b"*=$\n")
+        assert table == {b"*": [b"$"]}
+
+
+class TestMerge:
+    def test_later_tables_append_alternatives(self):
+        # main.go:40-50: values append per key across files, in file order
+        merged = merge_substitution_tables(
+            [{b"a": [b"1"]}, {b"a": [b"2"], b"b": [b"3"]}]
+        )
+        assert merged == {b"a": [b"1", b"2"], b"b": [b"3"]}
+
+    def test_same_mapping_twice_duplicates(self):
+        merged = merge_substitution_tables([{b"a": [b"X"]}, {b"a": [b"X"]}])
+        assert merged == {b"a": [b"X", b"X"]}
+
+
+class TestReferenceArtifacts:
+    def test_parse_all_builtin_tables(self, reference_tables):
+        for path in sorted(reference_tables.glob("*.table")):
+            table = parse_substitution_table(path.read_bytes(), source=str(path))
+            assert table, path
+
+    def test_qwerty_cyrillic_multi_option_keys(self, reference_tables):
+        table = parse_substitution_table(
+            (reference_tables / "qwerty-cyrillic.table").read_bytes()
+        )
+        assert table[b";"] == ["ж".encode(), "Ж".encode()]
+        assert table[b"q"] == ["й".encode()]
+
+    def test_german_multichar_key(self, reference_tables):
+        table = parse_substitution_table(
+            (reference_tables / "german.table").read_bytes()
+        )
+        assert table[b"ss"] == ["ß".encode()]
+        assert table[b"Z"] == ["ß".encode()]
