@@ -1,0 +1,8 @@
+"""TPU compute kernels (layer L3, device side): word packing, variant
+expansion, hash primitives (MD5/SHA1/NTLM) and digest membership.
+
+All kernels operate on fixed-shape padded byte tensors (``uint8[B, L]`` plus
+length vectors) so XLA sees static shapes end to end (SURVEY.md §5
+"long-context": variable-length words become padded buffers with masks, and a
+word's variant space is split by exact integer index ranges, never by dynamic
+shapes)."""

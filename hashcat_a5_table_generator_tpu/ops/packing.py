@@ -1,0 +1,154 @@
+"""Wordlist packing: variable-length byte strings -> padded device tensors.
+
+The reference streams the dictionary line by line through ``bufio.Scanner``
+(``main.go:72-94``) and hands each word to a goroutine. The TPU path instead
+packs words into fixed-shape batches ``uint8[B, width]`` + ``int32[B]``
+lengths up front; length bucketing (16/32/64...) keeps padding waste low
+across rockyou-class dictionaries.
+
+This module is the numpy implementation; ``native/`` provides a C++ packer
+with the same output contract for the file-to-arrays hot path (the analog of
+the reference's scanner loop), and transparently falls back to this code.
+
+Faithfulness notes (Q8): the reference's scanner silently ends input on a line
+longer than 64 KiB and never checks ``scanner.Err()``. We do NOT copy that
+hole: oversized lines raise unless ``max_word_bytes`` is explicitly lifted,
+and I/O errors propagate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import numpy as np
+
+#: Go bufio.Scanner default token limit (reference main.go Q8).
+DEFAULT_MAX_WORD_BYTES = 64 * 1024
+
+#: Default length-bucket boundaries (words longer than the last bucket get a
+#: bucket of exactly their padded power-of-two width).
+DEFAULT_BUCKETS = (16, 32, 64)
+
+
+@dataclass(frozen=True)
+class PackedWords:
+    """A batch of words as device-ready padded arrays.
+
+    ``tokens[i, :lengths[i]]`` are the word's bytes; the rest is zero padding.
+    ``index[i]`` is the word's ordinal in the source wordlist — packing may
+    bucket/reorder, and every downstream hit is reported against this index so
+    results are always expressed in dictionary order.
+    """
+
+    tokens: np.ndarray  # uint8 [B, width]
+    lengths: np.ndarray  # int32 [B]
+    index: np.ndarray  # int64 [B] — position in the original wordlist
+
+    @property
+    def batch(self) -> int:
+        return int(self.tokens.shape[0])
+
+    @property
+    def width(self) -> int:
+        return int(self.tokens.shape[1])
+
+    def word(self, i: int) -> bytes:
+        return bytes(self.tokens[i, : self.lengths[i]])
+
+    def words(self) -> List[bytes]:
+        return [self.word(i) for i in range(self.batch)]
+
+
+def pack_words(
+    words: Sequence[bytes],
+    *,
+    width: int | None = None,
+    start_index: int = 0,
+) -> PackedWords:
+    """Pack ``words`` into one padded batch of a single width.
+
+    ``width`` defaults to the smallest multiple of 4 covering the longest word
+    (keeping uint32 lane alignment for the hash kernels); zero-length batches
+    get width 4.
+    """
+    if width is None:
+        longest = max((len(w) for w in words), default=0)
+        width = max(4, -(-longest // 4) * 4)
+    tokens = np.zeros((len(words), width), dtype=np.uint8)
+    lengths = np.zeros((len(words),), dtype=np.int32)
+    for i, w in enumerate(words):
+        if len(w) > width:
+            raise ValueError(f"word {i} is {len(w)} bytes > width {width}")
+        tokens[i, : len(w)] = np.frombuffer(w, dtype=np.uint8)
+        lengths[i] = len(w)
+    index = np.arange(start_index, start_index + len(words), dtype=np.int64)
+    return PackedWords(tokens=tokens, lengths=lengths, index=index)
+
+
+def bucket_words(
+    words: Sequence[bytes],
+    *,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    max_word_bytes: int = DEFAULT_MAX_WORD_BYTES,
+    start_index: int = 0,
+) -> Dict[int, PackedWords]:
+    """Split ``words`` into length buckets, each packed at its bucket width.
+
+    Returns ``{width: PackedWords}``; original wordlist positions are carried
+    in each batch's ``index``. Words longer than the last bucket boundary get
+    a power-of-two width of their own; words over ``max_word_bytes`` raise
+    (the anti-Q8 guarantee).
+    """
+    by_width: Dict[int, List[int]] = {}
+    for i, w in enumerate(words):
+        if len(w) > max_word_bytes:
+            raise ValueError(
+                f"word {start_index + i} is {len(w)} bytes > limit "
+                f"{max_word_bytes} (Go would silently truncate here — Q8)"
+            )
+        width = next((b for b in buckets if len(w) <= b), None)
+        if width is None:
+            width = 4
+            while width < len(w):
+                width *= 2
+        by_width.setdefault(width, []).append(i)
+
+    out: Dict[int, PackedWords] = {}
+    for width, idxs in sorted(by_width.items()):
+        packed = pack_words([words[i] for i in idxs], width=width)
+        out[width] = PackedWords(
+            tokens=packed.tokens,
+            lengths=packed.lengths,
+            index=np.asarray([start_index + i for i in idxs], dtype=np.int64),
+        )
+    return out
+
+
+def read_wordlist(
+    path: str,
+    *,
+    max_word_bytes: int = DEFAULT_MAX_WORD_BYTES,
+) -> List[bytes]:
+    """Read a dictionary file into a list of words (one per line).
+
+    Mirrors ``bufio.ScanLines``: splits on ``\\n``, drops one trailing ``\\r``
+    per line, and a final line without a newline still counts. Unlike the
+    reference, an oversized line is an error, not a silent end of input (Q8).
+    """
+    with open(path, "rb") as fh:
+        data = fh.read()
+    words: List[bytes] = []
+    if not data:
+        return words
+    for line in data.split(b"\n"):
+        if line.endswith(b"\r"):
+            line = line[:-1]
+        if len(line) > max_word_bytes:
+            raise ValueError(
+                f"{path}: line {len(words)} exceeds {max_word_bytes} bytes (Q8)"
+            )
+        words.append(line)
+    if data.endswith(b"\n"):
+        words.pop()  # split() produced a trailing empty element, not a word
+    return words

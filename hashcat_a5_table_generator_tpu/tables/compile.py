@@ -1,0 +1,167 @@
+"""Table compiler: substitution map -> dense, fixed-shape device arrays.
+
+The reference keeps its merged table as a Go ``map[string][]string`` and probes
+it per byte position inside the generation recursion (``main.go:182-185``). A
+TPU enumerates variants by index arithmetic over fixed-shape tensors, so the
+map is compiled once, host-side, into:
+
+* a **key matrix** ``key_bytes[K, key_width] / key_len[K]`` with keys in
+  canonical sorted-bytes order (the same order the oracle's substitute-all
+  engines use for pattern enumeration — Q4 canonicalization), and a CSR-style
+  value table ``val_bytes[V, val_width] / val_len[V]`` with per-key slices
+  ``val_start[K] / val_count[K]`` preserving merge/append order and duplicate
+  multiplicity (Q7);
+* a **single-byte LUT** ``byte_to_key[256]`` (-1 = no single-byte key) for the
+  dominant transliteration-table case;
+* fast-path predicates: ``cascade_hazard[K, K]`` — ``hazard[p, q]`` is True
+  when pattern ``q`` sorts AFTER ``p`` and occurs inside one of ``p``'s
+  values, so the canonical sorted-order ReplaceAll cascade (oracle Q4
+  semantics) would re-match text inserted by ``p`` — and ``has_empty_key``
+  (a ``=x`` table line; live only in substitute-all modes). A value inserted
+  by ``p`` can only ever be re-matched by patterns applied after it, i.e.
+  patterns sorting strictly after ``p``; earlier-sorted patterns have already
+  run. ``cascade_free`` (no hazard at all) holds for monodirectional
+  transliteration tables (qwerty-cyrillic, greek-hebrew, czech, german,
+  qwerty-greek); bidirectional tables like qwerty-azerty have hazards and
+  route hazard-affected words through the exact oracle path.
+
+Everything here is host-side numpy; the arrays are uploaded to device once per
+sweep and shared by every batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Mapping, Sequence
+
+import numpy as np
+
+SubstitutionMap = Mapping[bytes, Sequence[bytes]]
+
+
+@dataclass(frozen=True)
+class CompiledTable:
+    """A substitution map in dense device-ready form.
+
+    Keys are sorted bytewise (canonical pattern order); values keep their
+    merged append order and multiplicity. All arrays are numpy (host); callers
+    move them to device with ``jnp.asarray`` / ``jax.device_put``.
+    """
+
+    keys: tuple  # tuple[bytes] in sorted order (host-side convenience)
+    key_bytes: np.ndarray  # uint8 [K, key_width]
+    key_len: np.ndarray  # int32 [K]
+    val_start: np.ndarray  # int32 [K] — CSR offset into value table
+    val_count: np.ndarray  # int32 [K]
+    val_bytes: np.ndarray  # uint8 [V, val_width]
+    val_len: np.ndarray  # int32 [V]
+    byte_to_key: np.ndarray  # int32 [256] — key index of single-byte key, or -1
+    max_key_len: int
+    max_val_len: int
+    cascade_hazard: np.ndarray  # bool [K, K] — see module docstring
+    has_empty_key: bool  # a b"" key exists (inert outside substitute-all)
+
+    @property
+    def cascade_free(self) -> bool:
+        """True when NO sorted-order ReplaceAll cascade can re-match inserted
+        text, so the all-or-none span-splice fast path is exact for every
+        word and every chosen-pattern subset."""
+        return not bool(self.cascade_hazard.any())
+
+    @property
+    def num_keys(self) -> int:
+        return int(self.key_bytes.shape[0])
+
+    @property
+    def num_values(self) -> int:
+        return int(self.val_bytes.shape[0])
+
+    @property
+    def all_keys_single_byte(self) -> bool:
+        return self.max_key_len <= 1 and not self.has_empty_key
+
+    def key_index(self, key: bytes) -> int:
+        """Index of ``key`` in canonical order (host-side; -1 if absent)."""
+        try:
+            return self.keys.index(key)
+        except ValueError:
+            return -1
+
+    def values_of(self, key_idx: int) -> List[bytes]:
+        """Host-side value list of a key, in merged order (for oracles/tests)."""
+        s = int(self.val_start[key_idx])
+        c = int(self.val_count[key_idx])
+        return [
+            bytes(self.val_bytes[i, : self.val_len[i]]) for i in range(s, s + c)
+        ]
+
+
+def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
+    """Compile a parsed/merged substitution map into dense arrays.
+
+    Zero-key and zero-value-count edge cases produce shape-(0, 1) matrices so
+    downstream jnp code never sees a zero-width axis.
+    """
+    keys = sorted(sub_map.keys())
+    k = len(keys)
+    max_key_len = max((len(key) for key in keys), default=0)
+    key_width = max(max_key_len, 1)
+
+    key_bytes = np.zeros((k, key_width), dtype=np.uint8)
+    key_len = np.zeros((k,), dtype=np.int32)
+    val_start = np.zeros((k,), dtype=np.int32)
+    val_count = np.zeros((k,), dtype=np.int32)
+
+    flat_values: List[bytes] = []
+    for i, key in enumerate(keys):
+        key_bytes[i, : len(key)] = np.frombuffer(key, dtype=np.uint8)
+        key_len[i] = len(key)
+        vals = list(sub_map[key])
+        val_start[i] = len(flat_values)
+        val_count[i] = len(vals)
+        flat_values.extend(bytes(v) for v in vals)
+
+    v = len(flat_values)
+    max_val_len = max((len(x) for x in flat_values), default=0)
+    val_width = max(max_val_len, 1)
+    val_bytes = np.zeros((v, val_width), dtype=np.uint8)
+    val_len = np.zeros((v,), dtype=np.int32)
+    for i, value in enumerate(flat_values):
+        val_bytes[i, : len(value)] = np.frombuffer(value, dtype=np.uint8)
+        val_len[i] = len(value)
+
+    byte_to_key = np.full((256,), -1, dtype=np.int32)
+    for i, key in enumerate(keys):
+        if len(key) == 1:
+            byte_to_key[key[0]] = i
+
+    cascade_hazard = np.zeros((k, k), dtype=bool)
+    for p, key_p in enumerate(keys):
+        for q in range(p + 1, k):  # only later-sorted patterns can re-match
+            key_q = keys[q]
+            if not key_q:
+                # An empty pattern "matches" everywhere; treat any non-empty
+                # inserted value as re-matchable by it.
+                cascade_hazard[p, q] = any(
+                    flat_values[val_start[p] + j] for j in range(val_count[p])
+                )
+                continue
+            cascade_hazard[p, q] = any(
+                key_q in flat_values[val_start[p] + j]
+                for j in range(val_count[p])
+            )
+
+    return CompiledTable(
+        keys=tuple(keys),
+        key_bytes=key_bytes,
+        key_len=key_len,
+        val_start=val_start,
+        val_count=val_count,
+        val_bytes=val_bytes,
+        val_len=val_len,
+        byte_to_key=byte_to_key,
+        max_key_len=max_key_len,
+        max_val_len=max_val_len,
+        cascade_hazard=cascade_hazard,
+        has_empty_key=b"" in sub_map,
+    )

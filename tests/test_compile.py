@@ -1,0 +1,117 @@
+"""Tests for the table compiler (tables/compile.py) and word packing
+(ops/packing.py)."""
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    bucket_words,
+    pack_words,
+    read_wordlist,
+)
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import BUILTIN_LAYOUTS
+from hashcat_a5_table_generator_tpu.tables.parser import read_substitution_table
+
+
+def test_compile_roundtrip_simple():
+    sub_map = {b"a": [b"X", b"YY"], b"ss": [b"\xc3\x9f"], b"b": [b"Z"]}
+    ct = compile_table(sub_map)
+    assert ct.keys == (b"a", b"b", b"ss")
+    assert ct.num_keys == 3
+    assert ct.num_values == 4
+    assert ct.max_key_len == 2
+    assert ct.max_val_len == 2
+    # Values preserve per-key order and multiplicity.
+    assert ct.values_of(ct.key_index(b"a")) == [b"X", b"YY"]
+    assert ct.values_of(ct.key_index(b"ss")) == [b"\xc3\x9f"]
+    # Single-byte LUT hits 'a' and 'b', not 'ss'.
+    assert ct.byte_to_key[ord("a")] == ct.key_index(b"a")
+    assert ct.byte_to_key[ord("b")] == ct.key_index(b"b")
+    assert ct.byte_to_key[ord("s")] == -1
+    assert not ct.all_keys_single_byte
+
+
+def test_compile_duplicate_values_kept():
+    ct = compile_table({b"a": [b"X", b"X"]})  # Q7: multiplicity is parity
+    assert ct.values_of(0) == [b"X", b"X"]
+
+
+def test_compile_cascade_hazard_detection():
+    # 'b' sorts after 'a' and appears in a's value -> the sorted ReplaceAll
+    # cascade would re-substitute the inserted 'b'.
+    ct = compile_table({b"a": [b"b"], b"b": [b"c"]})
+    assert not ct.cascade_free
+    assert ct.cascade_hazard[ct.key_index(b"a"), ct.key_index(b"b")]
+    # The reverse direction is safe: 'a' is applied before 'b' inserts it.
+    assert compile_table({b"b": [b"a"], b"a": [b"x"]}).cascade_free
+    assert compile_table({b"a": [b"\xd0\x90"]}).cascade_free
+    # Self-insertion is safe too (a pattern never re-matches its own pass).
+    assert compile_table({b"a": [b"aa"]}).cascade_free
+    # Empty later-sorted pattern matches inside any non-empty inserted value.
+    assert not compile_table({b"": [b"z"], b"a": [b"xy"]}).cascade_free
+
+
+def test_compile_empty_key_and_empty_map():
+    ct = compile_table({b"": [b"x"]})
+    assert ct.has_empty_key and not ct.all_keys_single_byte
+    empty = compile_table({})
+    assert empty.num_keys == 0 and empty.key_bytes.shape == (0, 1)
+
+
+@pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))
+def test_compile_builtin_layouts(name):
+    sub_map = BUILTIN_LAYOUTS[name].to_substitution_map()
+    ct = compile_table(sub_map)
+    assert ct.num_keys == len(sub_map)
+    for key, vals in sub_map.items():
+        assert ct.values_of(ct.key_index(key)) == list(vals)
+    # Every monodirectional transliteration table is cascade-free; the
+    # bidirectional qwerty-azerty merges both directions, so a later-sorted
+    # key can occur in an earlier key's value (e.g. '!' -> '8' with '8' a key).
+    assert ct.cascade_free == (name != "qwerty-azerty")
+
+
+def test_compile_upstream_tables_hazards(upstream_reference):
+    for table in sorted(upstream_reference.glob("*.table")):
+        ct = compile_table(read_substitution_table(str(table)))
+        assert ct.cascade_free == (table.name != "qwerty-azerty"), table.name
+
+
+def test_pack_words_basic():
+    pw = pack_words([b"abc", b"", b"0123456789"])
+    assert pw.width == 12  # multiple of 4 covering the longest
+    assert pw.words() == [b"abc", b"", b"0123456789"]
+    assert list(pw.lengths) == [3, 0, 10]
+    assert list(pw.index) == [0, 1, 2]
+    assert pw.tokens.dtype == np.uint8
+
+
+def test_pack_words_width_overflow():
+    with pytest.raises(ValueError):
+        pack_words([b"abcdef"], width=4)
+
+
+def test_bucket_words():
+    words = [b"a" * n for n in (3, 17, 70, 5, 200)]
+    buckets = bucket_words(words)
+    assert sorted(buckets) == [16, 32, 128, 256]
+    assert buckets[16].words() == [b"aaa", b"aaaaa"]
+    assert list(buckets[16].index) == [0, 3]
+    assert list(buckets[128].index) == [2]
+    assert list(buckets[256].index) == [4]
+
+
+def test_bucket_words_q8_guard():
+    with pytest.raises(ValueError, match="Q8"):
+        bucket_words([b"x" * (64 * 1024 + 1)])
+
+
+def test_read_wordlist(tmp_path):
+    p = tmp_path / "dict.txt"
+    p.write_bytes(b"alpha\r\nbeta\n\ngamma")
+    assert read_wordlist(str(p)) == [b"alpha", b"beta", b"", b"gamma"]
+    p.write_bytes(b"one\ntwo\n")
+    assert read_wordlist(str(p)) == [b"one", b"two"]
+    p.write_bytes(b"")
+    assert read_wordlist(str(p)) == []
