@@ -138,14 +138,10 @@ def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
     cascade_hazard = np.zeros((k, k), dtype=bool)
     for p, key_p in enumerate(keys):
         for q in range(p + 1, k):  # only later-sorted patterns can re-match
+            # keys[q] is never empty here: b"" sorts first, so it cannot be a
+            # later-sorted pattern (tables with an empty key are excluded from
+            # the fast path via has_empty_key regardless).
             key_q = keys[q]
-            if not key_q:
-                # An empty pattern "matches" everywhere; treat any non-empty
-                # inserted value as re-matchable by it.
-                cascade_hazard[p, q] = any(
-                    flat_values[val_start[p] + j] for j in range(val_count[p])
-                )
-                continue
             cascade_hazard[p, q] = any(
                 key_q in flat_values[val_start[p] + j]
                 for j in range(val_count[p])
