@@ -1,0 +1,288 @@
+"""Batched hash primitives: MD5, SHA-1, MD4 and NTLM over padded byte tensors.
+
+The reference generates candidates only and pipes them into hashcat for
+hashing (reference ``README.MD:69``); this framework hashes **on device** so
+candidates never leave VMEM and only digest-set hits cross the host boundary
+(SURVEY.md §7 step 4). Everything is formulated as uint32 lane arithmetic over
+a batch axis — adds mod 2^32, rotates as shift-or — which XLA vectorizes onto
+the TPU VPU; the per-round structure is unrolled at trace time (static Python
+loops) so the compiler sees one straight-line dataflow per block.
+
+Contract: inputs are ``msg: uint32-aligned uint8[B, W]`` padded with zeros and
+``length: int32[B]``; outputs are the raw state words ``uint32[B, 4|5]`` (the
+natural form for digest-set membership). ``digest_bytes`` converts to the
+canonical byte serialization (little-endian words for MD4/MD5, big-endian for
+SHA-1) for interop and tests.
+
+Message schedule: a message of ``length`` bytes occupies
+``ceil((length + 9) / 64)`` 64-byte blocks; the kernel always runs the static
+``ceil((W + 9) / 64)`` blocks that the padded width admits and masks state
+updates for blocks past each message's end, so one compiled program serves
+every length in the bucket.
+
+NTLM is MD4 over the UTF-16LE encoding of the password. ``utf16le_expand``
+implements the byte->code-unit expansion exactly like hashcat's NTLM kernel
+does by default: each candidate BYTE becomes the code unit ``byte | 0x0000``
+(naive interleave, no UTF-8 decoding). For pure-ASCII candidates this is
+identical to true UTF-16LE; for multi-byte UTF-8 candidates it matches
+hashcat's default behavior (hashcat only transcodes under ``--encoding-from``,
+which is a separate, host-side concern).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_U32 = jnp.uint32
+
+
+def _rotl(x: jnp.ndarray, s: int) -> jnp.ndarray:
+    return (x << np.uint32(s)) | (x >> np.uint32(32 - s))
+
+
+def _blocks_for_width(width: int) -> int:
+    """Static number of 64-byte blocks the padded layout needs."""
+    return -(-(width + 9) // 64)
+
+
+def pad_message(
+    msg: jnp.ndarray, length: jnp.ndarray, *, big_endian_length: bool
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Lay out Merkle–Damgård padding for a whole batch in one shot.
+
+    Returns ``(words, n_blocks)`` where ``words`` is ``uint32[B, NB*16]``
+    (little-endian byte order within each word — SHA-1 byte-swaps later) and
+    ``n_blocks`` is ``int32[B]``, the number of blocks each message actually
+    uses. The 0x80 terminator lands at byte index ``length`` and the 64-bit
+    bit-length at the end of each message's own last block, all computed with
+    masks so the whole thing is one fused elementwise pass.
+    """
+    batch, width = msg.shape
+    nb = _blocks_for_width(width)
+    total = nb * 64
+    length = length.astype(jnp.int32)
+
+    buf = jnp.zeros((batch, total), dtype=jnp.uint8)
+    buf = buf.at[:, :width].set(msg)
+    pos = jnp.arange(total, dtype=jnp.int32)[None, :]
+    len_col = length[:, None]
+    # Zero out padding bytes that may carry garbage, add the 0x80 terminator.
+    buf = jnp.where(pos < len_col, buf, jnp.uint8(0))
+    buf = jnp.where(pos == len_col, jnp.uint8(0x80), buf)
+
+    n_blocks = (length + 9 + 63) // 64
+    msg_end = n_blocks[:, None] * 64  # end of each message's own last block
+    # 64-bit bit length as two uint32 halves (no uint64 needed: length is
+    # int32, so bits = length*8 < 2^34; the high half is bits >> 32).
+    bits_lo = (length.astype(_U32) * _U32(8))[:, None]
+    bits_hi = (length.astype(_U32) >> _U32(29))[:, None]
+    # Byte i of the 8-byte length field sits at msg_end - 8 + i.
+    tail_off = pos - (msg_end - 8)
+    in_tail = (tail_off >= 0) & (tail_off < 8)
+    idx = jnp.where(big_endian_length, 7 - tail_off, tail_off)  # LE byte index
+    half = jnp.where(idx < 4, bits_lo, bits_hi)
+    shift = ((idx & 3).astype(_U32)) * _U32(8)
+    len_byte = ((half >> shift) & _U32(0xFF)).astype(jnp.uint8)
+    buf = jnp.where(in_tail, len_byte, buf)
+
+    b = buf.astype(_U32).reshape(batch, total // 4, 4)
+    words = b[..., 0] | (b[..., 1] << 8) | (b[..., 2] << 16) | (b[..., 3] << 24)
+    return words, n_blocks
+
+
+def _byteswap32(x: jnp.ndarray) -> jnp.ndarray:
+    return (
+        ((x & _U32(0xFF)) << 24)
+        | ((x & _U32(0xFF00)) << 8)
+        | ((x >> 8) & _U32(0xFF00))
+        | (x >> 24)
+    )
+
+
+# ---------------------------------------------------------------------------
+# MD5 (RFC 1321)
+# ---------------------------------------------------------------------------
+
+_MD5_S = (
+    [7, 12, 17, 22] * 4 + [5, 9, 14, 20] * 4 + [4, 11, 16, 23] * 4 + [6, 10, 15, 21] * 4
+)
+_MD5_K = [int(abs(np.sin(i + 1)) * 2**32) & 0xFFFFFFFF for i in range(64)]
+_MD5_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+
+
+def _md5_block(state: Tuple[jnp.ndarray, ...], m: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """One MD5 compression over ``m: uint32[B, 16]`` (already little-endian)."""
+    a, b, c, d = state
+    a0, b0, c0, d0 = a, b, c, d
+    for i in range(64):
+        if i < 16:
+            f = (b & c) | (~b & d)
+            g = i
+        elif i < 32:
+            f = (d & b) | (~d & c)
+            g = (5 * i + 1) % 16
+        elif i < 48:
+            f = b ^ c ^ d
+            g = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            g = (7 * i) % 16
+        tmp = d
+        d = c
+        c = b
+        rot = a + f + _U32(_MD5_K[i]) + m[:, g]
+        b = b + _rotl(rot, _MD5_S[i])
+        a = tmp
+    return a0 + a, b0 + b, c0 + c, d0 + d
+
+
+# ---------------------------------------------------------------------------
+# MD4 (RFC 1320) — the NTLM core
+# ---------------------------------------------------------------------------
+
+_MD4_INIT = _MD5_INIT
+_MD4_G = [0, 4, 8, 12, 1, 5, 9, 13, 2, 6, 10, 14, 3, 7, 11, 15]
+_MD4_H = [0, 8, 4, 12, 2, 10, 6, 14, 1, 9, 5, 13, 3, 11, 7, 15]
+
+
+def _md4_block(state: Tuple[jnp.ndarray, ...], m: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    a, b, c, d = state
+    a0, b0, c0, d0 = a, b, c, d
+
+    def round1(a, b, c, d, k, s):
+        return _rotl(a + ((b & c) | (~b & d)) + m[:, k], s)
+
+    def round2(a, b, c, d, k, s):
+        return _rotl(a + ((b & c) | (b & d) | (c & d)) + m[:, k] + _U32(0x5A827999), s)
+
+    def round3(a, b, c, d, k, s):
+        return _rotl(a + (b ^ c ^ d) + m[:, k] + _U32(0x6ED9EBA1), s)
+
+    for r, (rf, shifts, order) in enumerate(
+        (
+            (round1, (3, 7, 11, 19), list(range(16))),
+            (round2, (3, 5, 9, 13), _MD4_G),
+            (round3, (3, 9, 11, 15), _MD4_H),
+        )
+    ):
+        for j, k in enumerate(order):
+            s = shifts[j % 4]
+            a = rf(a, b, c, d, k, s)
+            a, b, c, d = d, a, b, c
+    return a0 + a, b0 + b, c0 + c, d0 + d
+
+
+# ---------------------------------------------------------------------------
+# SHA-1 (RFC 3174)
+# ---------------------------------------------------------------------------
+
+_SHA1_INIT = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0)
+_SHA1_K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+
+
+def _sha1_block(state: Tuple[jnp.ndarray, ...], m_le: jnp.ndarray) -> Tuple[jnp.ndarray, ...]:
+    """One SHA-1 compression; ``m_le`` is the shared little-endian word layout,
+    byte-swapped here to SHA-1's big-endian schedule."""
+    a, b, c, d, e = state
+    w = [_byteswap32(m_le[:, t]) for t in range(16)]
+    for t in range(16, 80):
+        w.append(_rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
+    a0, b0, c0, d0, e0 = a, b, c, d, e
+    for t in range(80):
+        if t < 20:
+            f = (b & c) | (~b & d)
+        elif t < 40:
+            f = b ^ c ^ d
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        tmp = _rotl(a, 5) + f + e + _U32(_SHA1_K[t // 20]) + w[t]
+        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+    return a0 + a, b0 + b, c0 + c, d0 + d, e0 + e
+
+
+# ---------------------------------------------------------------------------
+# Multi-block drivers
+# ---------------------------------------------------------------------------
+
+
+def _run_blocks(block_fn, init, words, n_blocks):
+    """Run ``block_fn`` over every static block, masking updates for blocks a
+    given message does not use. Unrolled at trace time (bucket widths keep the
+    static block count tiny — width 64 is 2 blocks)."""
+    batch = words.shape[0]
+    nb = words.shape[1] // 16
+    state = tuple(jnp.full((batch,), _U32(x)) for x in init)
+    for blk in range(nb):
+        m = words[:, blk * 16 : (blk + 1) * 16]
+        new_state = block_fn(state, m)
+        active = blk < n_blocks
+        state = tuple(
+            jnp.where(active, ns, s) for ns, s in zip(new_state, state)
+        )
+    return jnp.stack(state, axis=-1)
+
+
+def md5(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
+    """MD5 of each row: ``uint8[B, W], int32[B] -> uint32[B, 4]`` state words."""
+    words, n_blocks = pad_message(msg, length, big_endian_length=False)
+    return _run_blocks(_md5_block, _MD5_INIT, words, n_blocks)
+
+
+def md4(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
+    """MD4 of each row: ``uint8[B, W], int32[B] -> uint32[B, 4]`` state words."""
+    words, n_blocks = pad_message(msg, length, big_endian_length=False)
+    return _run_blocks(_md4_block, _MD4_INIT, words, n_blocks)
+
+
+def sha1(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
+    """SHA-1 of each row: ``uint8[B, W], int32[B] -> uint32[B, 5]`` state words."""
+    words, n_blocks = pad_message(msg, length, big_endian_length=True)
+    return _run_blocks(_sha1_block, _SHA1_INIT, words, n_blocks)
+
+
+def utf16le_expand(msg: jnp.ndarray, length: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Expand bytes to UTF-16LE code units the way hashcat's NTLM kernel does:
+    ``uint8[B, W] -> uint8[B, 2W]`` with a zero byte after every input byte."""
+    batch, width = msg.shape
+    out = jnp.zeros((batch, 2 * width), dtype=jnp.uint8)
+    out = out.at[:, 0::2].set(msg)
+    return out, length.astype(jnp.int32) * 2
+
+
+def ntlm(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
+    """NTLM: MD4 over the UTF-16LE expansion. ``uint32[B, 4]`` state words."""
+    wide, wide_len = utf16le_expand(msg, length)
+    return md4(wide, wide_len)
+
+
+HASH_FNS = {"md5": md5, "sha1": sha1, "md4": md4, "ntlm": ntlm}
+DIGEST_WORDS = {"md5": 4, "sha1": 5, "md4": 4, "ntlm": 4}
+#: Canonical byte serialization: MD4/MD5 little-endian words, SHA-1 big-endian.
+BIG_ENDIAN_DIGEST = {"md5": False, "sha1": True, "md4": False, "ntlm": False}
+
+
+def digest_bytes(state: np.ndarray, algo: str) -> list:
+    """Convert ``uint32[B, K]`` state words to canonical digest bytes."""
+    state = np.asarray(state)
+    order = ">u4" if BIG_ENDIAN_DIGEST[algo] else "<u4"
+    return [row.astype(order).tobytes() for row in state]
+
+
+def digest_to_words(digest: bytes, algo: str) -> np.ndarray:
+    """Parse a canonical digest (raw bytes or hex str) back to uint32 words."""
+    if isinstance(digest, str):
+        digest = bytes.fromhex(digest)
+    order = ">u4" if BIG_ENDIAN_DIGEST[algo] else "<u4"
+    return np.frombuffer(digest, dtype=order).astype(np.uint32)
+
+
+jit_md5 = jax.jit(md5)
+jit_sha1 = jax.jit(sha1)
+jit_md4 = jax.jit(md4)
+jit_ntlm = jax.jit(ntlm)

@@ -4,16 +4,33 @@ exercised without TPU hardware (SURVEY.md §4.3). Must run before jax imports.""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the driver environment pre-sets JAX_PLATFORMS=axon
+# (the remote-TPU tunnel), and every dispatch over the tunnel costs a network
+# round trip — the suite must run on the local CPU backend regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The jaxtyping pytest plugin imports jax BEFORE this conftest runs, and
+# jax_platforms is snapshotted from the env at import time — so the env vars
+# above came too late and the suite would silently run over the TPU tunnel.
+# jax.config.update overrides the snapshot (the backend itself has not been
+# initialized yet at conftest time, so the switch is still safe).
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
 import pathlib
 
 import pytest
+
+# Persistent XLA compilation cache: the unrolled hash kernels take tens of
+# seconds to compile cold; cached, the suite runs in seconds.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 UPSTREAM_REFERENCE = pathlib.Path("/root/reference")

@@ -1,0 +1,134 @@
+"""Hash-kernel tests: batched jnp MD5/SHA-1/MD4/NTLM vs hashlib ground truth."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.ops import hashes
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+
+
+def _ref_md4(data: bytes) -> bytes:
+    """Pure-python MD4 (hashlib's md4 is an OpenSSL legacy algo, often absent)."""
+    try:
+        return hashlib.new("md4", data).digest()
+    except ValueError:
+        pass
+    # Minimal reference MD4 used only when OpenSSL lacks the legacy provider.
+    import struct
+
+    msg = bytearray(data) + b"\x80"
+    while len(msg) % 64 != 56:
+        msg += b"\x00"
+    msg += struct.pack("<Q", len(data) * 8)
+    a, b, c, d = 0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476
+
+    def lrot(x, s):
+        x &= 0xFFFFFFFF
+        return ((x << s) | (x >> (32 - s))) & 0xFFFFFFFF
+
+    for off in range(0, len(msg), 64):
+        x = struct.unpack("<16I", msg[off : off + 64])
+        aa, bb, cc, dd = a, b, c, d
+        for i in range(16):
+            s = (3, 7, 11, 19)[i % 4]
+            a = lrot(a + ((b & c) | (~b & d)) + x[i], s)
+            a, b, c, d = d, a, b, c
+        for i, k in enumerate((0, 4, 8, 12, 1, 5, 9, 13, 2, 6, 10, 14, 3, 7, 11, 15)):
+            s = (3, 5, 9, 13)[i % 4]
+            a = lrot(a + ((b & c) | (b & d) | (c & d)) + x[k] + 0x5A827999, s)
+            a, b, c, d = d, a, b, c
+        for i, k in enumerate((0, 8, 4, 12, 2, 10, 6, 14, 1, 9, 5, 13, 3, 11, 7, 15)):
+            s = (3, 9, 11, 15)[i % 4]
+            a = lrot(a + (b ^ c ^ d) + x[k] + 0x6ED9EBA1, s)
+            a, b, c, d = d, a, b, c
+        a = (a + aa) & 0xFFFFFFFF
+        b = (b + bb) & 0xFFFFFFFF
+        c = (c + cc) & 0xFFFFFFFF
+        d = (d + dd) & 0xFFFFFFFF
+    return struct.pack("<4I", a, b, c, d)
+
+
+WORDS = [
+    b"",
+    b"a",
+    b"abc",
+    b"password",
+    b"hello world",
+    bytes(range(33, 88)),  # 55 bytes — largest single-block payload
+    bytes(range(0, 56)),  # 56 bytes — forces a second block
+    bytes(range(0, 64)),  # exactly one block of data
+    b"x" * 119,  # 2-block payload
+    b"x" * 120,  # forces a third block
+    "пароль".encode("utf-8"),
+    "ΠΑΣΣΩΟΡΔ".encode("utf-8"),
+]
+
+
+@pytest.mark.parametrize("algo,ref", [("md5", lambda d: hashlib.md5(d).digest()),
+                                      ("sha1", lambda d: hashlib.sha1(d).digest())])
+def test_hash_vs_hashlib(algo, ref):
+    packed = pack_words(WORDS)
+    state = np.asarray(hashes.HASH_FNS[algo](packed.tokens, packed.lengths))
+    got = hashes.digest_bytes(state, algo)
+    for w, g in zip(WORDS, got):
+        assert g == ref(w), (algo, w)
+
+
+def test_md4_vs_reference():
+    packed = pack_words(WORDS)
+    got = hashes.digest_bytes(np.asarray(hashes.md4(packed.tokens, packed.lengths)), "md4")
+    for w, g in zip(WORDS, got):
+        assert g == _ref_md4(w), w
+
+
+def test_ntlm_known_vectors():
+    # Classic NTLM test vectors (MD4 of UTF-16LE password).
+    vectors = {
+        b"": "31d6cfe0d16ae931b73c59d7e0c089c0",
+        b"password": "8846f7eaee8fb117ad06bdd830b7586c",
+        b"admin": "209c6174da490caeb422f3fa5a7ae634",
+    }
+    words = list(vectors)
+    packed = pack_words(words)
+    got = hashes.digest_bytes(np.asarray(hashes.ntlm(packed.tokens, packed.lengths)), "ntlm")
+    for w, g in zip(words, got):
+        assert g.hex() == vectors[w], w
+
+
+def test_ntlm_matches_naive_interleave_for_nonascii():
+    # Documented semantics: byte interleave (hashcat default), not UTF-8
+    # transcoding — so the reference value is MD4 over bytes+zero bytes.
+    w = "пароль".encode("utf-8")
+    packed = pack_words([w])
+    got = hashes.digest_bytes(np.asarray(hashes.ntlm(packed.tokens, packed.lengths)), "ntlm")[0]
+    interleaved = bytes(b for byte in w for b in (byte, 0))
+    assert got == _ref_md4(interleaved)
+
+
+def test_padding_garbage_immunity():
+    # Bytes past `length` must not affect the digest.
+    base = pack_words([b"secret"], width=64)
+    dirty = base.tokens.copy()
+    dirty[:, 6:] = 0xAA
+    a = hashes.digest_bytes(np.asarray(hashes.md5(base.tokens, base.lengths)), "md5")[0]
+    b = hashes.digest_bytes(np.asarray(hashes.md5(dirty, base.lengths)), "md5")[0]
+    assert a == b == hashlib.md5(b"secret").digest()
+
+
+def test_digest_word_roundtrip():
+    for algo, ref in (("md5", hashlib.md5), ("sha1", hashlib.sha1)):
+        d = ref(b"roundtrip").digest()
+        words = hashes.digest_to_words(d, algo)
+        assert hashes.digest_bytes(words[None, :], algo)[0] == d
+        assert (hashes.digest_to_words(d.hex(), algo) == words).all()
+
+
+def test_mixed_lengths_one_batch():
+    # One compiled program must serve every length in a bucket (static shapes).
+    words = [b"a" * n for n in range(0, 56, 7)]
+    packed = pack_words(words, width=56)
+    state = np.asarray(hashes.jit_md5(packed.tokens, packed.lengths))
+    for w, g in zip(words, hashes.digest_bytes(state, "md5")):
+        assert g == hashlib.md5(w).digest()
