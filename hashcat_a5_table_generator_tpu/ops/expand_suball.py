@@ -1,0 +1,367 @@
+"""Substitute-all (``-s``) expansion as index arithmetic — the flagship kernel.
+
+The reference's transliteration engine (``processWordSubstituteAll``,
+``main.go:308-365``) recursively assigns each unique pattern present in a word
+one of its options *or skip*, then applies a ReplaceAll cascade at every leaf.
+That keyspace is a product space: with patterns ``p_1..p_P`` present and
+``r_i = options(p_i) + 1`` (the +1 is "skip"), every candidate is one digit
+vector of the mixed-radix number ``Π r_i`` (SURVEY.md Q10). So instead of
+recursion, the TPU enumerates **variant ids** and decodes them:
+
+    variant id --mixed-radix decode--> digit vector
+              --digit per pattern--> chosen option (0 = skip)
+              --segment gather--> candidate bytes
+
+The word is pre-split (host side, :func:`build_suball_plan`) into SEGMENTS —
+alternating unclaimed gaps and pattern-occurrence spans. A variant's candidate
+is the concatenation of each segment's bytes: the original slice for gaps and
+un-chosen spans, the chosen option's value for chosen spans. Output offsets
+are one prefix sum; bytes are two gathers. No recursion, no dynamic shapes.
+
+Exactness ("fast path") conditions, checked per word at plan time:
+
+* the table has no cascade hazard among the word's present patterns
+  (``CompiledTable.cascade_hazard``) — otherwise the sorted-order ReplaceAll
+  cascade could re-match inserted text;
+* greedy leftmost occurrences of different patterns don't overlap — otherwise
+  WHICH occurrences get replaced depends on the chosen subset, not the word;
+* the table has no empty key (a ``=x`` line makes ReplaceAll insert between
+  every character — oracle-only semantics).
+
+Words failing these checks get ``fallback=True`` and are routed through the
+byte-exact CPU oracle by the runtime; all six reference tables except the
+bidirectional qwerty-azerty are fast-path for every word.
+
+Work unit: a **block** ``(word, base_digits, count)`` covering a contiguous
+range of the word's variant space. Blocks are how huge single-word spaces are
+split across chips (SURVEY.md §5 "long-context") and how sweep cursors resume:
+the host cuts arbitrary [cursor, cursor+n) ranges with bigint divmods, and the
+device adds the in-block rank to ``base_digits`` with mixed-radix carries —
+everything on device stays uint32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..tables.compile import CompiledTable
+from .packing import PackedWords
+
+#: Per-block variant-count cap: in-block ranks must fit int32.
+MAX_BLOCK = 1 << 30
+
+
+@dataclass(frozen=True)
+class SubAllPlan:
+    """Device-ready per-word expansion plan for substitute-all mode.
+
+    Axes: B words, P pattern slots (slot order = sorted-pattern order, slot 0
+    is the least-significant mixed-radix digit), G segments (in word order).
+    """
+
+    tokens: np.ndarray  # uint8 [B, L]
+    lengths: np.ndarray  # int32 [B]
+    index: np.ndarray  # int64 [B] — wordlist ordinals (from PackedWords)
+    pat_radix: np.ndarray  # int32 [B, P] — options+1, 1 on inactive slots
+    pat_val_start: np.ndarray  # int32 [B, P] — CSR into table val rows
+    seg_orig_start: np.ndarray  # int32 [B, G]
+    seg_orig_len: np.ndarray  # int32 [B, G] — 0 on inactive segments
+    seg_pat: np.ndarray  # int32 [B, G] — pattern slot, -1 for gaps
+    n_variants: Tuple[int, ...]  # python bigints — Π radix per word
+    fallback: np.ndarray  # bool [B] — word needs the CPU oracle
+    out_width: int  # static candidate-buffer width (uint32-aligned)
+
+    @property
+    def batch(self) -> int:
+        return int(self.tokens.shape[0])
+
+    @property
+    def num_slots(self) -> int:
+        return int(self.pat_radix.shape[1])
+
+    @property
+    def num_segments(self) -> int:
+        return int(self.seg_orig_start.shape[1])
+
+
+def build_suball_plan(
+    ct: CompiledTable, packed: PackedWords, *, out_width: int | None = None
+) -> SubAllPlan:
+    """Host-side plan construction (numpy + bytes.find; the C++ packer will
+    take this over for the file-to-plan hot path)."""
+    b, width = packed.tokens.shape
+    hazard = ct.cascade_hazard
+
+    per_word: List[dict] = []
+    max_p = 1
+    max_s = 1
+    for i in range(b):
+        word = packed.word(i)
+        slots: List[int] = []  # key indices, ascending = sorted patterns
+        spans: List[Tuple[int, int, int]] = []  # (start, klen, slot)
+        claimed = np.zeros(len(word), dtype=bool)
+        fallback = ct.has_empty_key
+        for ki, key in enumerate(ct.keys):
+            if not key or fallback:
+                continue
+            pos = word.find(key)
+            if pos < 0:
+                continue
+            slot = len(slots)
+            slots.append(ki)
+            while pos >= 0:
+                end = pos + len(key)
+                if claimed[pos:end].any():
+                    fallback = True  # cross-pattern overlap: subset-dependent
+                    break
+                claimed[pos:end] = True
+                spans.append((pos, len(key), slot))
+                pos = word.find(key, end)
+        if not fallback and len(slots) > 1:
+            ks = np.asarray(slots)
+            fallback = bool(hazard[np.ix_(ks, ks)].any())
+        spans.sort()
+        per_word.append({"slots": slots, "spans": spans, "fallback": fallback})
+        max_p = max(max_p, len(slots))
+        max_s = max(max_s, len(spans))
+
+    num_p, num_g = max_p, 2 * max_s + 1
+    pat_radix = np.ones((b, num_p), dtype=np.int32)
+    pat_val_start = np.zeros((b, num_p), dtype=np.int32)
+    seg_orig_start = np.zeros((b, num_g), dtype=np.int32)
+    seg_orig_len = np.zeros((b, num_g), dtype=np.int32)
+    seg_pat = np.full((b, num_g), -1, dtype=np.int32)
+    n_variants: List[int] = []
+    fallback_mask = np.zeros((b,), dtype=bool)
+    max_delta = 0
+
+    for i, info in enumerate(per_word):
+        fallback_mask[i] = info["fallback"]
+        total = 1
+        for slot, ki in enumerate(info["slots"]):
+            pat_radix[i, slot] = ct.val_count[ki] + 1
+            pat_val_start[i, slot] = ct.val_start[ki]
+            total *= int(ct.val_count[ki]) + 1
+        n_variants.append(total if not info["fallback"] else 0)
+
+        # Segments: gap before each span, the span, and a final gap to len.
+        g = 0
+        cursor = 0
+        delta = 0
+        for start, klen, slot in info["spans"]:
+            if start > cursor:
+                seg_orig_start[i, g] = cursor
+                seg_orig_len[i, g] = start - cursor
+                g += 1
+            seg_orig_start[i, g] = start
+            seg_orig_len[i, g] = klen
+            seg_pat[i, g] = slot
+            g += 1
+            cursor = start + klen
+            ki = info["slots"][slot]
+            vs, vc = int(ct.val_start[ki]), int(ct.val_count[ki])
+            widest = max(
+                (int(ct.val_len[vs + o]) for o in range(vc)), default=klen
+            )
+            delta += max(0, widest - klen)
+        word_len = int(packed.lengths[i])
+        if cursor < word_len:
+            seg_orig_start[i, g] = cursor
+            seg_orig_len[i, g] = word_len - cursor
+            g += 1
+        max_delta = max(max_delta, delta)
+
+    if out_width is None:
+        out_width = max(4, -(-(width + max_delta) // 4) * 4)
+
+    return SubAllPlan(
+        tokens=packed.tokens,
+        lengths=packed.lengths,
+        index=packed.index,
+        pat_radix=pat_radix,
+        pat_val_start=pat_val_start,
+        seg_orig_start=seg_orig_start,
+        seg_orig_len=seg_orig_len,
+        seg_pat=seg_pat,
+        n_variants=tuple(n_variants),
+        fallback=fallback_mask,
+        out_width=out_width,
+    )
+
+
+@dataclass(frozen=True)
+class BlockBatch:
+    """A device launch's worth of work blocks (see module docstring)."""
+
+    word: np.ndarray  # int32 [NB] — row into the plan's word batch
+    base_digits: np.ndarray  # int32 [NB, P] — mixed-radix start digits
+    count: np.ndarray  # int32 [NB] — variants in this block (< 2^31)
+    offset: np.ndarray  # int32 [NB] — exclusive prefix sum of count
+
+    @property
+    def total(self) -> int:
+        return int(self.offset[-1] + self.count[-1]) if len(self.count) else 0
+
+
+def digits_of(rank: int, radices: Sequence[int]) -> List[int]:
+    """Mixed-radix digits of ``rank`` (slot 0 least significant), host bigint."""
+    out = []
+    for r in radices:
+        out.append(rank % r)
+        rank //= r
+    return out
+
+
+def make_blocks(
+    plan: SubAllPlan,
+    *,
+    start_word: int = 0,
+    start_rank: int = 0,
+    max_variants: int,
+    max_block: int = MAX_BLOCK,
+) -> Tuple[BlockBatch, int, int]:
+    """Cut up to ``max_variants`` of the plan's variant space into blocks,
+    starting at (start_word, start_rank). Returns (batch, next_word,
+    next_rank) — the resume cursor. Fallback words are skipped (the runtime
+    routes them through the oracle)."""
+    words: List[int] = []
+    bases: List[List[int]] = []
+    counts: List[int] = []
+    p = plan.num_slots
+    budget = max_variants
+    w, rank = start_word, start_rank
+    while w < plan.batch and budget > 0:
+        total = plan.n_variants[w]
+        if plan.fallback[w] or rank >= total:
+            w, rank = w + 1, 0
+            continue
+        take = min(budget, total - rank, max_block)
+        radices = [int(plan.pat_radix[w, s]) for s in range(p)]
+        words.append(w)
+        bases.append(digits_of(rank, radices))
+        counts.append(take)
+        budget -= take
+        rank += take
+        if rank >= total:
+            w, rank = w + 1, 0
+    counts_arr = np.asarray(counts, dtype=np.int32)
+    batch = BlockBatch(
+        word=np.asarray(words, dtype=np.int32),
+        base_digits=np.asarray(bases, dtype=np.int32).reshape(len(words), p),
+        count=counts_arr,
+        offset=np.concatenate([[0], np.cumsum(counts_arr[:-1])]).astype(np.int32)
+        if len(counts)
+        else np.zeros((0,), dtype=np.int32),
+    )
+    return batch, w, rank
+
+
+def expand_suball(
+    tokens: jnp.ndarray,  # uint8 [B, L]
+    lengths: jnp.ndarray,  # int32 [B]
+    pat_radix: jnp.ndarray,  # int32 [B, P]
+    pat_val_start: jnp.ndarray,  # int32 [B, P]
+    seg_orig_start: jnp.ndarray,  # int32 [B, G]
+    seg_orig_len: jnp.ndarray,  # int32 [B, G]
+    seg_pat: jnp.ndarray,  # int32 [B, G]
+    val_bytes: jnp.ndarray,  # uint8 [V, val_width] — compiled table values
+    val_len: jnp.ndarray,  # int32 [V]
+    blk_word: jnp.ndarray,  # int32 [NB]
+    blk_base: jnp.ndarray,  # int32 [NB, P]
+    blk_count: jnp.ndarray,  # int32 [NB]
+    blk_offset: jnp.ndarray,  # int32 [NB]
+    *,
+    num_lanes: int,
+    out_width: int,
+    min_substitute: int,
+    max_substitute: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Decode + materialize ``num_lanes`` variants.
+
+    Returns ``(cand uint8[N, out_width], cand_len int32[N], word_row int32[N],
+    emit bool[N])`` — ``emit`` folds together lane validity (rank in range)
+    and the min/max chosen-pattern-count window.
+    """
+    n = num_lanes
+    p = pat_radix.shape[1]
+    g = seg_orig_start.shape[1]
+
+    v = jnp.arange(n, dtype=jnp.int32)
+    blk = jnp.clip(
+        jnp.searchsorted(blk_offset, v, side="right").astype(jnp.int32) - 1,
+        0,
+        max(blk_offset.shape[0] - 1, 0),
+    )
+    rank = v - blk_offset[blk]
+    lane_ok = rank < blk_count[blk]
+    w = blk_word[blk]  # int32 [N]
+
+    radix = pat_radix[w]  # [N, P]
+    base = blk_base[blk]  # [N, P]
+
+    # digits = base + mixed-radix(rank), slot 0 least significant, with carry.
+    digits = []
+    carry = jnp.zeros_like(rank)
+    r = rank
+    for s in range(p):
+        rs = radix[:, s]
+        t = base[:, s] + (r % rs) + carry
+        digits.append(t % rs)
+        carry = t // rs
+        r = r // rs
+    digits = jnp.stack(digits, axis=1)  # [N, P]
+
+    active = radix > 1
+    chosen_count = jnp.sum((digits > 0) & active, axis=1)
+
+    # Per-segment output lengths and value rows for this variant.
+    spat = seg_pat[w]  # [N, G]
+    is_span = spat >= 0
+    seg_digit = jnp.take_along_axis(
+        digits, jnp.where(is_span, spat, 0), axis=1
+    )
+    seg_digit = jnp.where(is_span, seg_digit, 0)
+    chosen = seg_digit > 0
+    vstart = jnp.take_along_axis(
+        pat_val_start[w], jnp.where(is_span, spat, 0), axis=1
+    )
+    opt_row = jnp.where(chosen, vstart + seg_digit - 1, 0)
+    o_len = seg_orig_len[w]
+    seg_len = jnp.where(chosen, val_len[opt_row], o_len)  # [N, G]
+
+    seg_end = jnp.cumsum(seg_len, axis=1)  # inclusive ends [N, G]
+    out_len = seg_end[:, -1]
+    seg_start_out = seg_end - seg_len
+
+    # Gather output bytes: for each out position j, locate its segment.
+    j = jnp.arange(out_width, dtype=jnp.int32)[None, :]  # [1, W]
+    seg_of_j = jnp.sum(
+        (j[:, :, None] >= seg_end[:, None, :]).astype(jnp.int32), axis=2
+    )  # [N, W] — first segment whose inclusive end exceeds j
+    seg_of_j = jnp.clip(seg_of_j, 0, g - 1)
+
+    take = lambda a: jnp.take_along_axis(a, seg_of_j, axis=1)  # noqa: E731
+    rel = j - take(seg_start_out)
+    rep = take(chosen.astype(jnp.int32)) > 0
+    src_val_row = take(opt_row)
+    src_orig = take(seg_orig_start[w]) + rel
+
+    vw = val_bytes.shape[1]
+    from_val = val_bytes[src_val_row, jnp.clip(rel, 0, vw - 1)]
+    lw = tokens.shape[1]
+    from_word = tokens[
+        w[:, None], jnp.clip(src_orig, 0, lw - 1)
+    ]
+    out = jnp.where(rep, from_val, from_word)
+    out = jnp.where(j < out_len[:, None], out, jnp.uint8(0))
+
+    emit = (
+        lane_ok
+        & (chosen_count >= min_substitute)
+        & (chosen_count <= max_substitute)
+    )
+    return out, out_len.astype(jnp.int32), w, emit

@@ -1,0 +1,165 @@
+"""CPU<->TPU parity for the substitute-all expansion kernel.
+
+Every fast-path word's device-enumerated candidate multiset must equal the
+oracle's (``process_word_substitute_all``); fallback flags must fire exactly
+when the fast path would be inexact."""
+
+from collections import Counter
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.oracle.engines import (
+    process_word_substitute_all,
+)
+from hashcat_a5_table_generator_tpu.ops.expand_suball import (
+    build_suball_plan,
+    expand_suball,
+    make_blocks,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import BUILTIN_LAYOUTS
+from hashcat_a5_table_generator_tpu.tables.parser import parse_substitution_table
+
+
+def run_device_suball(sub_map, words, min_sub, max_sub, lanes=4096):
+    """Enumerate the whole substitute-all space on the device path; returns
+    ({word_index: Counter(candidates)}, fallback word indices)."""
+    ct = compile_table(sub_map)
+    packed = pack_words(words)
+    plan = build_suball_plan(ct, packed)
+    results = {i: Counter() for i in range(len(words))}
+    w, rank = 0, 0
+    while True:
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank, max_variants=lanes
+        )
+        if batch.total == 0:
+            break
+        cand, cand_len, word_row, emit = expand_suball(
+            jnp.asarray(plan.tokens),
+            jnp.asarray(plan.lengths),
+            jnp.asarray(plan.pat_radix),
+            jnp.asarray(plan.pat_val_start),
+            jnp.asarray(plan.seg_orig_start),
+            jnp.asarray(plan.seg_orig_len),
+            jnp.asarray(plan.seg_pat),
+            jnp.asarray(ct.val_bytes),
+            jnp.asarray(ct.val_len),
+            jnp.asarray(batch.word),
+            jnp.asarray(batch.base_digits),
+            jnp.asarray(batch.count),
+            jnp.asarray(batch.offset),
+            num_lanes=lanes,
+            out_width=plan.out_width,
+            min_substitute=min_sub,
+            max_substitute=max_sub,
+        )
+        cand = np.asarray(cand)
+        cand_len = np.asarray(cand_len)
+        word_row = np.asarray(word_row)
+        emit = np.asarray(emit)
+        for i in np.nonzero(emit)[0]:
+            results[int(word_row[i])][bytes(cand[i, : cand_len[i]])] += 1
+    return results, set(np.nonzero(plan.fallback)[0])
+
+
+def assert_parity(sub_map, words, min_sub=0, max_sub=15):
+    got, fallbacks = run_device_suball(sub_map, words, min_sub, max_sub)
+    for i, word in enumerate(words):
+        if i in fallbacks:
+            continue
+        want = Counter(
+            process_word_substitute_all(word, sub_map, min_sub, max_sub)
+        )
+        assert got[i] == want, (word, min_sub, max_sub)
+    return fallbacks
+
+
+def test_single_byte_table_parity():
+    sub_map = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"]}
+    fb = assert_parity(sub_map, [b"password", b"gas", b"", b"zzz", b"aosaos"])
+    assert not fb
+
+
+def test_min_max_windows():
+    sub_map = {b"a": [b"4"], b"o": [b"0"], b"s": [b"$"], b"e": [b"3"]}
+    words = [b"aoese", b"sea", b"x"]
+    for mn, mx in [(0, 15), (1, 2), (2, 2), (3, 3), (0, 0), (2, 1), (4, 9)]:
+        assert_parity(sub_map, words, mn, mx)
+
+
+def test_multibyte_values_length_change():
+    sub_map = {b"s": [b"\xc3\x9f", b""], b"e": [b"\xd0\xad"]}  # grow and shrink
+    fb = assert_parity(sub_map, [b"sees", b"s", b"esse"])
+    assert not fb
+
+
+def test_multibyte_keys():
+    sub_map = {b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
+    fb = assert_parity(sub_map, [b"passsword", b"ssass", b"ssss"])
+    assert not fb
+
+
+def test_overlapping_patterns_fall_back():
+    # "ab" and "b" overlap in "ab": chosen-subset-dependent spans -> fallback.
+    sub_map = {b"ab": [b"X"], b"b": [b"Y"]}
+    got, fallbacks = run_device_suball(sub_map, [b"ab", b"aab", b"cd"], 0, 15)
+    assert 0 in fallbacks and 1 in fallbacks and 2 not in fallbacks
+
+
+def test_cascade_hazard_falls_back():
+    # 'b' sorts after 'a' and is inserted by it: hazard when both present.
+    sub_map = {b"a": [b"b"], b"b": [b"c"]}
+    _, fallbacks = run_device_suball(sub_map, [b"ab", b"a", b"b"], 0, 15)
+    assert fallbacks == {0}
+    # Words containing only one side of the hazard stay on the fast path.
+    assert_parity(sub_map, [b"a", b"b", b"xa", b"bx"])
+
+
+def test_duplicate_options_multiplicity():
+    # Q7: duplicate table options must yield duplicate candidates.
+    sub_map = {b"a": [b"X", b"X"]}
+    got, _ = run_device_suball(sub_map, [b"za"], 0, 15)
+    assert got[0] == Counter({b"za": 1, b"zX": 2})
+
+
+def test_empty_key_table_all_fallback():
+    _, fallbacks = run_device_suball({b"": [b"-"], b"a": [b"4"]}, [b"ab"], 0, 15)
+    assert fallbacks == {0}
+
+
+@pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))
+def test_builtin_table_parity(name):
+    sub_map = BUILTIN_LAYOUTS[name].to_substitution_map()
+    words = [
+        b"password",
+        b"hello",
+        b"",
+        b"a",
+        b"zzzyyy",
+        "καλημέρα".encode("utf-8"),
+        b"Pa,ss",
+    ]
+    fallbacks = assert_parity(sub_map, words, 0, 15)
+    if name != "qwerty-azerty":
+        assert not fallbacks
+
+
+def test_block_splitting_matches_whole_run():
+    # Tiny lane budget forces many blocks with nonzero base digits; the union
+    # must equal a single big run.
+    sub_map = {b"a": [b"1", b"2", b"3"], b"b": [b"x", b"y"], b"c": [b"q"]}
+    words = [b"abcabc", b"cab"]
+    small, _ = run_device_suball(sub_map, words, 0, 15, lanes=7)
+    big, _ = run_device_suball(sub_map, words, 0, 15, lanes=4096)
+    assert small == big
+
+
+def test_hex_table_roundtrip_parity():
+    data = b"a=$HEX[c3 9f]\n$HEX[62]=B\n"
+    sub_map = parse_substitution_table(data)
+    fb = assert_parity(sub_map, [b"abba"])
+    assert not fb
