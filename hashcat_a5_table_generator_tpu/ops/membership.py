@@ -1,0 +1,146 @@
+"""On-device digest-set membership: bitmap prefilter + lexicographic search.
+
+The reference never hashes — it streams candidates to stdout and lets hashcat
+do lookup (reference ``README.MD:69``, which tunes hashcat's ``--bitmap-max``).
+This module is the TPU-side analog of hashcat's matching stage (SURVEY.md §7
+step 5): the target digest list lives on device as a **row-sorted uint32
+matrix**, candidates' digests are tested in bulk, and only hits ever reach the
+host.
+
+Two stages, both branch-free and batch-vectorized:
+
+1. **Bitmap prefilter** (hashcat-style): a bit array of size ``2^bitmap_bits``
+   indexed by the digest's low bits. One gather + mask per candidate rejects
+   the overwhelming majority of misses before any search. The bitmap is
+   ``uint32[2^bitmap_bits / 32]``.
+2. **Lexicographic binary search** over the sorted digest rows, comparing all
+   K state words (no truncation, no false positives). The loop is a fixed
+   ``ceil(log2 D)``-step ``lax.fori_loop`` — compiled once per digest-set
+   size, all candidates advance in lockstep.
+
+Digests are compared as tuples of uint32 *state words* (the natural output of
+``ops.hashes``) — sort order is an internal detail, consistent between
+:func:`build_digest_set` and the device search.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashes import digest_to_words
+
+_U32 = jnp.uint32
+
+#: Default bitmap size: 2^24 bits = 2 MiB — comfortably VMEM/HBM-cheap and
+#: <0.1% false-positive density for digest lists up to ~1e6 entries.
+DEFAULT_BITMAP_BITS = 24
+
+
+@dataclass(frozen=True)
+class DigestSet:
+    """A target digest list in device-ready, sorted, prefiltered form."""
+
+    rows: np.ndarray  # uint32 [D, K] — row-sorted lexicographically
+    bitmap: np.ndarray  # uint32 [2^bits / 32]
+    bitmap_bits: int
+    algo: str
+
+    @property
+    def size(self) -> int:
+        return int(self.rows.shape[0])
+
+
+def build_digest_set(
+    digests: Iterable,
+    algo: str,
+    *,
+    bitmap_bits: int = DEFAULT_BITMAP_BITS,
+) -> DigestSet:
+    """Compile raw/hex digests into a :class:`DigestSet`.
+
+    Accepts raw ``bytes`` or hex strings (hashcat left-list lines). Duplicate
+    digests are collapsed — membership is a set question, multiplicity lives
+    on the candidate side (Q7).
+    """
+    if bitmap_bits < 5:
+        raise ValueError("bitmap_bits must be >= 5 (one uint32 word)")
+    parsed = [digest_to_words(d, algo) for d in digests]
+    k = {"md5": 4, "md4": 4, "ntlm": 4, "sha1": 5}[algo]
+    if not parsed:
+        rows = np.zeros((0, k), dtype=np.uint32)
+    else:
+        # np.unique(axis=0) returns rows in lexicographic order, first column
+        # most significant — exactly the device search's comparison order.
+        rows = np.unique(np.stack(parsed).astype(np.uint32), axis=0)
+
+    bitmap = np.zeros((max(1, (1 << bitmap_bits) // 32),), dtype=np.uint32)
+    if rows.shape[0]:
+        idx = rows[:, 0] & np.uint32((1 << bitmap_bits) - 1)
+        np.bitwise_or.at(bitmap, idx >> 5, np.uint32(1) << (idx & 31))
+    return DigestSet(rows=rows, bitmap=bitmap, bitmap_bits=bitmap_bits, algo=algo)
+
+
+def _row_cmp_le(probe: jnp.ndarray, row: jnp.ndarray) -> jnp.ndarray:
+    """``row <= probe`` lexicographically; both ``uint32[..., K]``."""
+    k = probe.shape[-1]
+    lt = jnp.zeros(probe.shape[:-1], dtype=bool)
+    eq = jnp.ones(probe.shape[:-1], dtype=bool)
+    for i in range(k):
+        lt = lt | (eq & (row[..., i] < probe[..., i]))
+        eq = eq & (row[..., i] == probe[..., i])
+    return lt | eq
+
+
+def bitmap_probe(digest: jnp.ndarray, bitmap: jnp.ndarray) -> jnp.ndarray:
+    """Stage-1 test: ``uint32[N, K] -> bool[N]`` (may have false positives).
+
+    The bitmap's bit count is its (static) length × 32, so the index mask is
+    derived from the array itself — callers can't mismatch it.
+    """
+    bitmap_bits = int(np.log2(bitmap.shape[0])) + 5
+    idx = digest[:, 0] & _U32((1 << bitmap_bits) - 1)
+    word = bitmap[idx >> _U32(5)]
+    return (word >> (idx & _U32(31))) & _U32(1) != 0
+
+
+def digest_member(
+    digest: jnp.ndarray,  # uint32 [N, K]
+    rows: jnp.ndarray,  # uint32 [D, K] row-sorted
+    bitmap: jnp.ndarray,  # uint32 [2^bits/32]
+) -> jnp.ndarray:
+    """Exact membership of each candidate digest: ``bool[N]``.
+
+    All candidates run the bitmap probe; survivors' binary searches execute
+    unconditionally (branch-free SIMD — the prefilter prunes *memory traffic*
+    expectations, not instructions) and the final verdict ANDs both stages.
+    """
+    n, k = digest.shape
+    d = rows.shape[0]
+    if d == 0:
+        return jnp.zeros((n,), dtype=bool)
+
+    pre = bitmap_probe(digest, bitmap)
+
+    steps = int(np.ceil(np.log2(max(d, 2)))) + 1
+    # Invariant: rows[lo-1] <= probe < rows[hi] (virtual rows at -1/D); when
+    # lo == hi the search has converged and further steps must not move it.
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) >> 1
+        le = _row_cmp_le(digest, rows[mid]) & (lo < hi)
+        return jnp.where(le, mid + 1, lo), jnp.where(le, hi, mid)
+
+    lo0 = jnp.zeros((n,), dtype=jnp.int32)
+    hi0 = jnp.full((n,), d, dtype=jnp.int32)
+    lo, _ = jax.lax.fori_loop(0, steps, body, (lo0, hi0))
+    found = jnp.clip(lo - 1, 0, d - 1)
+    exact = jnp.all(rows[found] == digest, axis=-1) & (lo > 0)
+    return pre & exact
+
+
+jit_digest_member = jax.jit(digest_member)

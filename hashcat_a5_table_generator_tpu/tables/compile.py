@@ -14,13 +14,21 @@ map is compiled once, host-side, into:
 * a **single-byte LUT** ``byte_to_key[256]`` (-1 = no single-byte key) for the
   dominant transliteration-table case;
 * fast-path predicates: ``cascade_hazard[K, K]`` — ``hazard[p, q]`` is True
-  when pattern ``q`` sorts AFTER ``p`` and occurs inside one of ``p``'s
-  values, so the canonical sorted-order ReplaceAll cascade (oracle Q4
-  semantics) would re-match text inserted by ``p`` — and ``has_empty_key``
+  when pattern ``q`` sorts AFTER ``p`` and the canonical sorted-order
+  ReplaceAll cascade (oracle Q4 semantics) could match ``q`` against text
+  *touching* a value ``v`` inserted by ``p`` — and ``has_empty_key``
   (a ``=x`` table line; live only in substitute-all modes). A value inserted
   by ``p`` can only ever be re-matched by patterns applied after it, i.e.
   patterns sorting strictly after ``p``; earlier-sorted patterns have already
-  run. ``cascade_free`` (no hazard at all) holds for monodirectional
+  run. A ``q`` match touching ``v`` either (a) lies inside ``v``, (b) crosses
+  ``v``'s left boundary (so ``q`` ends with a nonempty prefix of ``v``),
+  (c) crosses its right boundary (``q`` starts with a nonempty suffix of
+  ``v``), or (d) spans all of ``v`` plus context on both sides (``v`` a
+  proper substring of ``q`` — including ``v == b""``, where the splice joins
+  previously separated context). These conditions are word-independent and
+  conservative: they flag every word where the span-splice fast path could
+  diverge from the ReplaceAll cascade, at the cost of some exact-but-flagged
+  words. ``cascade_free`` (no hazard at all) holds for monodirectional
   transliteration tables (qwerty-cyrillic, greek-hebrew, czech, german,
   qwerty-greek); bidirectional tables like qwerty-azerty have hazards and
   route hazard-affected words through the exact oracle path.
@@ -96,6 +104,24 @@ class CompiledTable:
         ]
 
 
+def _touching_match_possible(v: bytes, q: bytes) -> bool:
+    """Could a ReplaceAll of pattern ``q`` match text touching an inserted
+    value ``v``? Word-independent over-approximation — see the module
+    docstring's (a)-(d). Every real cascade divergence satisfies one of
+    these: a match intersecting ``v`` covers a prefix, suffix, or all of
+    ``v``, with any overhang coming from surrounding context."""
+    if q in v:  # (a) contained in the inserted text
+        return True
+    if len(v) < len(q) and v in q:  # (d) spans v plus context on both sides
+        return True
+    for n in range(1, min(len(q), len(v) + 1)):
+        if q[-n:] == v[:n]:  # (b) crosses v's left boundary
+            return True
+        if q[:n] == v[-n:]:  # (c) crosses v's right boundary
+            return True
+    return False
+
+
 def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
     """Compile a parsed/merged substitution map into dense arrays.
 
@@ -136,14 +162,16 @@ def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
             byte_to_key[key[0]] = i
 
     cascade_hazard = np.zeros((k, k), dtype=bool)
-    for p, key_p in enumerate(keys):
+    for p in range(k):
         for q in range(p + 1, k):  # only later-sorted patterns can re-match
             # keys[q] is never empty here: b"" sorts first, so it cannot be a
             # later-sorted pattern (tables with an empty key are excluded from
             # the fast path via has_empty_key regardless).
             key_q = keys[q]
             cascade_hazard[p, q] = any(
-                key_q in flat_values[val_start[p] + j]
+                _touching_match_possible(
+                    flat_values[val_start[p] + j], key_q
+                )
                 for j in range(val_count[p])
             )
 

@@ -119,6 +119,25 @@ def test_cascade_hazard_falls_back():
     assert_parity(sub_map, [b"a", b"b", b"xa", b"bx"])
 
 
+def test_cascade_boundary_crossing_falls_back():
+    # 'cb' matches across the boundary of the value 'c' inserted by 'a' and
+    # the adjacent original 'b' — no containment, but the ReplaceAll cascade
+    # diverges from span splicing, so the word must fall back.
+    sub_map = {b"a": [b"c"], b"cb": [b"Z"]}
+    _, fallbacks = run_device_suball(sub_map, [b"abcb", b"acb", b"xcb"], 0, 15)
+    assert 0 in fallbacks and 1 in fallbacks
+    assert 2 not in fallbacks  # only 'cb' present: no inserter, no hazard
+    assert_parity(sub_map, [b"xcb", b"aa", b"a"])
+
+
+def test_cascade_shrink_merge_falls_back():
+    # An empty value for 'a' merges its neighbors; 'bc' then matches across
+    # the splice point ('bacbc' -> 'bcbc' -> ReplaceAll hits both).
+    sub_map = {b"a": [b""], b"bc": [b"Z"]}
+    _, fallbacks = run_device_suball(sub_map, [b"bacbc"], 0, 15)
+    assert fallbacks == {0}
+
+
 def test_duplicate_options_multiplicity():
     # Q7: duplicate table options must yield duplicate candidates.
     sub_map = {b"a": [b"X", b"X"]}

@@ -1,0 +1,162 @@
+"""Digest-set membership: build, bitmap prefilter, exact search vs hashlib."""
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.ops.hashes import (
+    digest_bytes,
+    jit_md5,
+    jit_ntlm,
+    jit_sha1,
+)
+from hashcat_a5_table_generator_tpu.ops.membership import (
+    DigestSet,
+    bitmap_probe,
+    build_digest_set,
+    digest_member,
+    jit_digest_member,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+
+
+def _member(ds: DigestSet, probes: np.ndarray) -> np.ndarray:
+    return np.asarray(
+        jit_digest_member(
+            jnp.asarray(probes, dtype=jnp.uint32),
+            jnp.asarray(ds.rows),
+            jnp.asarray(ds.bitmap),
+        )
+    )
+
+
+def _md5_words(data: bytes) -> np.ndarray:
+    return np.frombuffer(hashlib.md5(data).digest(), dtype="<u4").astype(np.uint32)
+
+
+class TestBuildDigestSet:
+    def test_sorted_and_deduped(self):
+        digs = [hashlib.md5(bytes([i])).hexdigest() for i in range(50)]
+        ds = build_digest_set(digs + digs[:10], "md5")
+        assert ds.size == 50
+        rows = ds.rows
+        for i in range(1, ds.size):
+            assert tuple(rows[i - 1]) < tuple(rows[i])
+
+    def test_accepts_raw_and_hex(self):
+        raw = hashlib.sha1(b"x").digest()
+        ds = build_digest_set([raw, raw.hex()], "sha1")
+        assert ds.size == 1
+        assert ds.rows.shape == (1, 5)
+
+    def test_empty(self):
+        ds = build_digest_set([], "md5")
+        assert ds.size == 0
+        probes = np.stack([_md5_words(b"a")])
+        assert not _member(ds, probes).any()
+
+
+class TestBitmap:
+    def test_members_always_pass_prefilter(self):
+        digs = [hashlib.md5(b"w%d" % i).digest() for i in range(200)]
+        ds = build_digest_set(digs, "md5", bitmap_bits=12)
+        probes = np.stack([_md5_words(b"w%d" % i) for i in range(200)])
+        pre = np.asarray(bitmap_probe(jnp.asarray(probes), jnp.asarray(ds.bitmap)))
+        assert pre.all()
+
+    def test_nondefault_bitmap_bits_membership(self):
+        # Regression: probe derives the mask from the bitmap's own size, so a
+        # DigestSet built with non-default bits still finds every member.
+        digs = [hashlib.md5(b"nb%d" % i).digest() for i in range(64)]
+        ds = build_digest_set(digs, "md5", bitmap_bits=12)
+        probes = np.stack([_md5_words(b"nb%d" % i) for i in range(64)])
+        assert _member(ds, probes).all()
+
+    def test_prefilter_rejects_most_misses(self):
+        ds = build_digest_set([hashlib.md5(b"only").digest()], "md5")
+        probes = np.stack([_md5_words(b"m%d" % i) for i in range(512)])
+        pre = np.asarray(bitmap_probe(jnp.asarray(probes), jnp.asarray(ds.bitmap)))
+        # One digest in a 2^24 bitmap: essentially every miss is pruned.
+        assert pre.sum() <= 1
+
+
+class TestExactMembership:
+    @pytest.mark.parametrize("set_size", [1, 2, 3, 7, 100, 1000])
+    def test_hits_and_misses(self, set_size):
+        members = [hashlib.md5(b"in%d" % i).digest() for i in range(set_size)]
+        ds = build_digest_set(members, "md5")
+        hit_probes = np.stack([_md5_words(b"in%d" % i) for i in range(set_size)])
+        miss_probes = np.stack([_md5_words(b"out%d" % i) for i in range(64)])
+        assert _member(ds, hit_probes).all()
+        assert not _member(ds, miss_probes).any()
+
+    def test_first_word_collision_not_false_positive(self):
+        # Same leading word, different tail: full-row compare must reject.
+        base = _md5_words(b"target")
+        twisted = base.copy()
+        twisted[3] ^= np.uint32(1)
+        rows = np.stack([base])
+        ds = build_digest_set([hashlib.md5(b"target").digest()], "md5")
+        assert _member(ds, np.stack([base]))[0]
+        assert not _member(ds, np.stack([twisted]))[0]
+
+    def test_boundary_probes(self):
+        # Probes below the smallest and above the largest row.
+        ds = build_digest_set(
+            [hashlib.md5(b"mid%d" % i).digest() for i in range(32)], "md5"
+        )
+        lo = np.zeros((1, 4), dtype=np.uint32)
+        hi = np.full((1, 4), 0xFFFFFFFF, dtype=np.uint32)
+        assert not _member(ds, lo)[0]
+        assert not _member(ds, hi)[0]
+
+    def test_sha1_five_words(self):
+        members = [hashlib.sha1(b"s%d" % i).digest() for i in range(33)]
+        ds = build_digest_set(members, "sha1")
+        probes = np.stack(
+            [
+                np.frombuffer(hashlib.sha1(b"s%d" % i).digest(), dtype=">u4")
+                .astype(np.uint32)
+                for i in range(33)
+            ]
+        )
+        assert _member(ds, probes).all()
+        probes[:, 4] ^= 1
+        assert not _member(ds, probes).any()
+
+
+class TestEndToEndHashMembership:
+    """Device-hash → device-membership round trips against hashlib."""
+
+    def test_md5_pipeline(self):
+        words = [b"password", b"hello", b"p@ssw0rd", b"zzz"]
+        targets = [hashlib.md5(w).digest() for w in words[:2]]
+        ds = build_digest_set(targets, "md5")
+        packed = pack_words(words)
+        state = jit_md5(jnp.asarray(packed.tokens), jnp.asarray(packed.lengths))
+        got = _member(ds, np.asarray(state))
+        assert got.tolist() == [True, True, False, False]
+
+    def test_ntlm_pipeline(self):
+        from tests.test_hashes import _ref_md4
+
+        words = [b"admin", b"letmein", b"root"]
+        targets = [
+            _ref_md4(w.decode().encode("utf-16-le")) for w in words[1:]
+        ]
+        ds = build_digest_set(targets, "ntlm")
+        packed = pack_words(words)
+        state = jit_ntlm(jnp.asarray(packed.tokens), jnp.asarray(packed.lengths))
+        got = _member(ds, np.asarray(state))
+        assert got.tolist() == [False, True, True]
+
+    def test_sha1_pipeline_digest_bytes_roundtrip(self):
+        words = [b"alpha", b"beta"]
+        packed = pack_words(words)
+        state = np.asarray(
+            jit_sha1(jnp.asarray(packed.tokens), jnp.asarray(packed.lengths))
+        )
+        ds = build_digest_set(digest_bytes(state, "sha1"), "sha1")
+        assert _member(ds, state).all()
