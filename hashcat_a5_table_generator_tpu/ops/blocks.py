@@ -1,0 +1,92 @@
+"""Variant-space block scheduler, shared by every expansion kernel.
+
+A *block* is ``(word, base_digits, count)``: a contiguous rank range of one
+word's mixed-radix variant space. Blocks are the unit of device work, of
+cross-chip splitting for huge single-word spaces (SURVEY.md §5
+"long-context"), and of sweep checkpoint/resume — the host cuts arbitrary
+``[cursor, cursor + n)`` ranges with Python-bigint divmods, and the device
+adds the in-block rank to ``base_digits`` with mixed-radix carries, so
+everything on device stays int32.
+
+Any expansion plan can be scheduled here as long as it exposes ``batch``,
+``num_slots``, ``n_variants`` (per-word Python ints — these can exceed 2^63),
+``fallback`` (words the runtime routes through the CPU oracle instead), and
+``pat_radix[B, P]`` (per-slot radices, 1 on inactive slots).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+#: Per-block variant-count cap: in-block ranks must fit int32.
+MAX_BLOCK = 1 << 30
+
+
+@dataclass(frozen=True)
+class BlockBatch:
+    """A device launch's worth of work blocks."""
+
+    word: np.ndarray  # int32 [NB] — row into the plan's word batch
+    base_digits: np.ndarray  # int32 [NB, P] — mixed-radix start digits
+    count: np.ndarray  # int32 [NB] — variants in this block (< 2^31)
+    offset: np.ndarray  # int32 [NB] — exclusive prefix sum of count
+
+    @property
+    def total(self) -> int:
+        return int(self.offset[-1] + self.count[-1]) if len(self.count) else 0
+
+
+def digits_of(rank: int, radices: Sequence[int]) -> List[int]:
+    """Mixed-radix digits of ``rank`` (slot 0 least significant), host bigint."""
+    out = []
+    for r in radices:
+        out.append(rank % r)
+        rank //= r
+    return out
+
+
+def make_blocks(
+    plan,
+    *,
+    start_word: int = 0,
+    start_rank: int = 0,
+    max_variants: int,
+    max_block: int = MAX_BLOCK,
+) -> Tuple[BlockBatch, int, int]:
+    """Cut up to ``max_variants`` of the plan's variant space into blocks,
+    starting at (start_word, start_rank). Returns (batch, next_word,
+    next_rank) — the resume cursor. Fallback words are skipped (the runtime
+    routes them through the oracle)."""
+    words: List[int] = []
+    bases: List[List[int]] = []
+    counts: List[int] = []
+    p = plan.num_slots
+    budget = max_variants
+    w, rank = start_word, start_rank
+    while w < plan.batch and budget > 0:
+        total = plan.n_variants[w]
+        if plan.fallback[w] or rank >= total:
+            w, rank = w + 1, 0
+            continue
+        take = min(budget, total - rank, max_block)
+        radices = [int(plan.pat_radix[w, s]) for s in range(p)]
+        words.append(w)
+        bases.append(digits_of(rank, radices))
+        counts.append(take)
+        budget -= take
+        rank += take
+        if rank >= total:
+            w, rank = w + 1, 0
+    counts_arr = np.asarray(counts, dtype=np.int32)
+    batch = BlockBatch(
+        word=np.asarray(words, dtype=np.int32),
+        base_digits=np.asarray(bases, dtype=np.int32).reshape(len(words), p),
+        count=counts_arr,
+        offset=np.concatenate([[0], np.cumsum(counts_arr[:-1])]).astype(np.int32)
+        if len(counts)
+        else np.zeros((0,), dtype=np.int32),
+    )
+    return batch, w, rank

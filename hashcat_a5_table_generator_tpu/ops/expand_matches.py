@@ -1,0 +1,289 @@
+"""Default- and reverse-mode expansion as index arithmetic over match sets.
+
+The reference's default engine (``processWord``, ``main.go:168-205``) is a
+recursive DFS: at each byte position it probes keys longest-first, splices a
+replacement, and resumes *after* the inserted text (Q5/Q6). Its reverse engine
+(``processWordReverse``, ``main.go:208-261``) materializes C(n, k) position
+combos, filters overlaps, and applies first options only (Q2). Both enumerate
+the same underlying object: **subsets of pairwise non-overlapping matches** of
+the table's keys against the original word —
+
+* default mode: every option per match is available, and the DFS's
+  "resume after the replacement" rule means a candidate is exactly a set of
+  non-overlapping ``(position, key)`` matches with one option chosen each,
+  emitted once per distinct choice set (Q6/Q7; adjacency is allowed);
+* reverse mode: the overlap filter (``main.go:283-305``) admits exactly the
+  same non-overlapping sets, with only ``subs[0]`` applied (Q2).
+
+So one kernel serves both: enumerate mixed-radix digit vectors over the
+word's match list (digit 0 = skip; reverse mode just clamps every radix to
+2), mask out vectors whose chosen matches overlap, window on the chosen
+count (default mode bumps ``min 0 -> 1`` — Q1 — so the all-skip vector is
+never emitted there, while reverse mode emits the original word at
+``min == 0``), and splice chosen values by position. Parity is per-word
+multiset equality (Q9); enumeration order is rank order, not DFS order.
+
+Reverse-mode outputs follow the *corrected* offset arithmetic (ascending
+application) — the reference's Q3 bug is reproduced only by the CPU oracle
+under ``bug_compat=True``; an engine proper must not corrupt candidates.
+Length-preserving tables (all transliteration fixtures) are unaffected.
+
+Unlike substitute-all there is NO ReplaceAll cascade here, hence no fallback
+path: splicing is exact for every word and every table (empty keys can never
+match — the reference probes key lengths >= 1 only).
+
+Cost note: the enumeration space is ``Π (options_i + 1)`` over all matches
+even when ``max_substitute`` prunes deep counts; lanes outside the count
+window are masked, not skipped. With the default ``--table-max 15`` and
+dictionary-scale words the window covers most of the space, so waste is
+small; the reference pays the analogous cost by materializing C(n, k).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tables.compile import CompiledTable
+from .packing import PackedWords
+
+
+@dataclass(frozen=True)
+class MatchPlan:
+    """Device-ready per-word match list for default/reverse expansion.
+
+    Axes: B words, M match slots in reference scan order (position ascending,
+    key length descending — ``main.go:177``); slot 0 is the least-significant
+    mixed-radix digit. Inactive slots have radix 1.
+    """
+
+    tokens: np.ndarray  # uint8 [B, L]
+    lengths: np.ndarray  # int32 [B]
+    index: np.ndarray  # int64 [B] — wordlist ordinals (from PackedWords)
+    match_pos: np.ndarray  # int32 [B, M]
+    match_len: np.ndarray  # int32 [B, M] — key length, 0 on inactive slots
+    match_radix: np.ndarray  # int32 [B, M] — options+1 (default) / 2 (reverse)
+    match_val_start: np.ndarray  # int32 [B, M] — CSR row of the key's options
+    n_variants: Tuple[int, ...]  # python bigints — Π radix per word
+    fallback: np.ndarray  # bool [B] — always False; kept for the shared
+    # block scheduler's plan interface
+    out_width: int  # static candidate-buffer width (uint32-aligned)
+
+    # Shared-scheduler interface (ops.blocks.make_blocks) --------------------
+    @property
+    def batch(self) -> int:
+        return int(self.tokens.shape[0])
+
+    @property
+    def num_slots(self) -> int:
+        return int(self.match_pos.shape[1])
+
+    @property
+    def pat_radix(self) -> np.ndarray:
+        return self.match_radix
+
+
+def find_matches(word: bytes, ct: CompiledTable) -> List[Tuple[int, int, int]]:
+    """All ``(pos, key_len, key_index)`` matches in reference scan order:
+    position ascending, key length descending (``main.go:175-177``)."""
+    out: List[Tuple[int, int, int]] = []
+    kmax = ct.max_key_len
+    for i in range(len(word)):
+        for klen in range(min(len(word) - i, kmax), 0, -1):
+            ki = ct.key_index(word[i : i + klen])
+            if ki >= 0:
+                out.append((i, klen, ki))
+    return out
+
+
+def build_match_plan(
+    ct: CompiledTable,
+    packed: PackedWords,
+    *,
+    first_option_only: bool = False,
+    out_width: int | None = None,
+) -> MatchPlan:
+    """Host-side plan construction for default (``first_option_only=False``)
+    or reverse (``True``) mode."""
+    b, width = packed.tokens.shape
+    per_word = [find_matches(packed.word(i), ct) for i in range(b)]
+    m = max(1, max((len(x) for x in per_word), default=0))
+
+    match_pos = np.zeros((b, m), dtype=np.int32)
+    match_len = np.zeros((b, m), dtype=np.int32)
+    match_radix = np.ones((b, m), dtype=np.int32)
+    match_val_start = np.zeros((b, m), dtype=np.int32)
+    n_variants: List[int] = []
+    max_delta = 0
+
+    for i, matches in enumerate(per_word):
+        total = 1
+        delta = 0
+        for s, (pos, klen, ki) in enumerate(matches):
+            vc = int(ct.val_count[ki])
+            radix = 2 if first_option_only else vc + 1
+            if vc == 0:
+                radix = 1  # a key with no options can never be chosen
+            match_pos[i, s] = pos
+            match_len[i, s] = klen
+            match_radix[i, s] = radix
+            match_val_start[i, s] = ct.val_start[ki]
+            total *= radix
+            opts = 1 if first_option_only else vc
+            widest = max(
+                (int(ct.val_len[ct.val_start[ki] + o]) for o in range(opts)),
+                default=klen,
+            )
+            delta += max(0, widest - klen)
+        n_variants.append(total)
+        max_delta = max(max_delta, delta)
+
+    if out_width is None:
+        out_width = max(4, -(-(width + max_delta) // 4) * 4)
+
+    return MatchPlan(
+        tokens=packed.tokens,
+        lengths=packed.lengths,
+        index=packed.index,
+        match_pos=match_pos,
+        match_len=match_len,
+        match_radix=match_radix,
+        match_val_start=match_val_start,
+        n_variants=tuple(n_variants),
+        fallback=np.zeros((b,), dtype=bool),
+        out_width=out_width,
+    )
+
+
+def expand_matches(
+    tokens: jnp.ndarray,  # uint8 [B, L]
+    lengths: jnp.ndarray,  # int32 [B]
+    match_pos: jnp.ndarray,  # int32 [B, M]
+    match_len: jnp.ndarray,  # int32 [B, M]
+    match_radix: jnp.ndarray,  # int32 [B, M]
+    match_val_start: jnp.ndarray,  # int32 [B, M]
+    val_bytes: jnp.ndarray,  # uint8 [V, val_width] — compiled table values
+    val_len: jnp.ndarray,  # int32 [V]
+    blk_word: jnp.ndarray,  # int32 [NB]
+    blk_base: jnp.ndarray,  # int32 [NB, M]
+    blk_count: jnp.ndarray,  # int32 [NB]
+    blk_offset: jnp.ndarray,  # int32 [NB]
+    *,
+    num_lanes: int,
+    out_width: int,
+    min_substitute: int,
+    max_substitute: int,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Decode + materialize ``num_lanes`` variants.
+
+    Returns ``(cand uint8[N, out_width], cand_len int32[N], word_row int32[N],
+    emit bool[N])`` — ``emit`` folds lane validity (rank in range), the
+    non-overlap constraint, and the chosen-count window. Callers pass the
+    *effective* window: default mode's Q1 bump (``min 0 -> 1``) happens in the
+    caller, reverse mode passes ``min`` through.
+    """
+    n = num_lanes
+    m = match_pos.shape[1]
+    length_axis = tokens.shape[1]
+
+    v = jnp.arange(n, dtype=jnp.int32)
+    blk = jnp.clip(
+        jnp.searchsorted(blk_offset, v, side="right").astype(jnp.int32) - 1,
+        0,
+        max(blk_offset.shape[0] - 1, 0),
+    )
+    rank = v - blk_offset[blk]
+    lane_ok = rank < blk_count[blk]
+    w = blk_word[blk]  # int32 [N]
+
+    radix = match_radix[w]  # [N, M]
+    base = blk_base[blk]  # [N, M]
+
+    # digits = base + mixed-radix(rank), slot 0 least significant, with carry.
+    digits = []
+    carry = jnp.zeros_like(rank)
+    r = rank
+    for s in range(m):
+        rs = radix[:, s]
+        t = base[:, s] + (r % rs) + carry
+        digits.append(t % rs)
+        carry = t // rs
+        r = r // rs
+    digits = jnp.stack(digits, axis=1)  # [N, M]
+
+    chosen = digits > 0  # [N, M]
+    chosen_count = jnp.sum(chosen, axis=1)
+
+    # Per-match selected value rows/lengths.
+    opt_row = match_val_start[w] + digits - 1  # valid where chosen
+    opt_row = jnp.where(chosen, opt_row, 0)
+    vlen = jnp.where(chosen, val_len[opt_row], 0)  # [N, M]
+
+    # Output units per original byte position j: a chosen match starting at j
+    # contributes its value's bytes; an uncovered j contributes tokens[w, j].
+    pos_w = match_pos[w]  # [N, M]
+    len_w = match_len[w]
+    end_w = pos_w + len_w
+    lane_idx = jnp.broadcast_to(v[:, None], (n, m))
+    cov_delta = jnp.zeros((n, length_axis + 1), dtype=jnp.int32)
+    cov_delta = cov_delta.at[lane_idx, pos_w].add(chosen.astype(jnp.int32))
+    cov_delta = cov_delta.at[lane_idx, end_w].add(-chosen.astype(jnp.int32))
+    cover_count = jnp.cumsum(cov_delta[:, :length_axis], axis=1)  # [N, L]
+    covered = cover_count > 0
+    # Non-overlap constraint: chosen matches are pairwise disjoint iff no byte
+    # is covered twice (adjacency is allowed — touching intervals never share
+    # a byte). This replaces any explicit [M, M] interval-pair test.
+    clash = jnp.any(cover_count > 1, axis=1)
+
+    started = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    started = started.at[lane_idx, jnp.minimum(pos_w, length_axis - 1)].add(
+        chosen.astype(jnp.int32)
+    )
+    start_vlen = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    start_vlen = start_vlen.at[lane_idx, jnp.minimum(pos_w, length_axis - 1)].add(
+        vlen
+    )
+    start_vrow = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    start_vrow = start_vrow.at[lane_idx, jnp.minimum(pos_w, length_axis - 1)].add(
+        jnp.where(chosen, opt_row, 0)
+    )
+
+    j = jnp.arange(length_axis, dtype=jnp.int32)[None, :]
+    in_word = j < lengths[w][:, None]
+    # unit_len: a chosen match's start contributes its value's length (the
+    # position itself is covered, so no original byte); covered non-start
+    # bytes contribute 0; uncovered bytes pass through as 1 original byte.
+    unit_len = jnp.where(
+        in_word,
+        jnp.where(started > 0, start_vlen, jnp.where(covered, 0, 1)),
+        0,
+    )
+    cum = jnp.cumsum(unit_len, axis=1)  # inclusive ends [N, L]
+    out_len = cum[:, -1]
+
+    # For each output column o, locate its source unit j.
+    o = jnp.arange(out_width, dtype=jnp.int32)
+    j_of_o = jax.vmap(lambda c: jnp.searchsorted(c, o, side="right"))(cum)
+    j_of_o = jnp.clip(j_of_o, 0, length_axis - 1).astype(jnp.int32)
+
+    take = lambda a: jnp.take_along_axis(a, j_of_o, axis=1)  # noqa: E731
+    rel = o[None, :] - (take(cum) - take(unit_len))
+    is_start = take(started) > 0
+    vrow = take(start_vrow)
+    vw = val_bytes.shape[1]
+    from_val = val_bytes[vrow, jnp.clip(rel, 0, vw - 1)]
+    from_word = tokens[w[:, None], j_of_o]
+    out = jnp.where(is_start, from_val, from_word)
+    out = jnp.where(o[None, :] < out_len[:, None], out, jnp.uint8(0))
+
+    emit = (
+        lane_ok
+        & ~clash
+        & (chosen_count >= min_substitute)
+        & (chosen_count <= max_substitute)
+    )
+    return out, out_len.astype(jnp.int32), w, emit

@@ -1,0 +1,221 @@
+"""CPU<->TPU parity for default- and reverse-mode expansion.
+
+Per-word candidate multisets from the device kernel must equal the oracle's
+(``process_word`` / ``process_word_reverse(bug_compat=False)``) for every
+word — these modes have no fallback path (SURVEY.md Q1/Q2/Q5/Q6/Q7 vectors
+are all exercised below)."""
+
+from collections import Counter
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.oracle.engines import (
+    process_word,
+    process_word_reverse,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.expand_matches import (
+    build_match_plan,
+    expand_matches,
+    find_matches,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import BUILTIN_LAYOUTS
+
+
+def run_device(sub_map, words, min_sub, max_sub, *, reverse=False, lanes=4096):
+    """Enumerate the full variant space on the device path; returns
+    {word_index: Counter(candidates)}."""
+    ct = compile_table(sub_map)
+    packed = pack_words(words)
+    plan = build_match_plan(ct, packed, first_option_only=reverse)
+    eff_min = min_sub if reverse else max(1, min_sub)
+    results = {i: Counter() for i in range(len(words))}
+    w, rank = 0, 0
+    while True:
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank, max_variants=lanes
+        )
+        if batch.total == 0:
+            break
+        cand, cand_len, word_row, emit = expand_matches(
+            jnp.asarray(plan.tokens),
+            jnp.asarray(plan.lengths),
+            jnp.asarray(plan.match_pos),
+            jnp.asarray(plan.match_len),
+            jnp.asarray(plan.match_radix),
+            jnp.asarray(plan.match_val_start),
+            jnp.asarray(ct.val_bytes),
+            jnp.asarray(ct.val_len),
+            jnp.asarray(batch.word),
+            jnp.asarray(batch.base_digits),
+            jnp.asarray(batch.count),
+            jnp.asarray(batch.offset),
+            num_lanes=lanes,
+            out_width=plan.out_width,
+            min_substitute=eff_min,
+            max_substitute=max_sub,
+        )
+        cand = np.asarray(cand)
+        cand_len = np.asarray(cand_len)
+        word_row = np.asarray(word_row)
+        emit = np.asarray(emit)
+        for i in np.nonzero(emit)[0]:
+            results[int(word_row[i])][bytes(cand[i, : cand_len[i]])] += 1
+    return results
+
+
+def assert_parity(sub_map, words, min_sub=0, max_sub=15, *, reverse=False):
+    got = run_device(sub_map, words, min_sub, max_sub, reverse=reverse)
+    for i, word in enumerate(words):
+        if reverse:
+            want = Counter(
+                process_word_reverse(
+                    word, sub_map, min_sub, max_sub, bug_compat=False
+                )
+            )
+        else:
+            want = Counter(process_word(word, sub_map, min_sub, max_sub))
+        assert got[i] == want, (word, min_sub, max_sub, reverse)
+
+
+# --------------------------------------------------------------------------
+# Default mode
+# --------------------------------------------------------------------------
+
+
+class TestDefaultMode:
+    def test_q10_keyspace_shape(self):
+        # 'password': all 8 byte positions substitutable, one option each ->
+        # 2^8 - 1 = 255; 'hello' -> 31 (SURVEY.md Q10 verified vectors).
+        sub_map = {bytes([c]): [bytes([c]).upper()] for c in b"pasword"}
+        got = run_device(sub_map, [b"password"], 0, 15)
+        assert sum(got[0].values()) == 255
+        sub_map2 = {c: [c.upper()] for c in [b"h", b"e", b"l", b"o"]}
+        got2 = run_device(sub_map2, [b"hello"], 0, 15)
+        assert sum(got2[0].values()) == 31
+
+    def test_q1_original_never_emitted(self):
+        sub_map = {b"a": [b"4"]}
+        got = run_device(sub_map, [b"aa"], 0, 15)
+        assert b"aa" not in got[0]
+        assert_parity(sub_map, [b"aa", b"b", b""], 0, 15)
+
+    def test_q5_longest_first_multiset(self):
+        # 'ss' with {s=Z, ss=ß}: oracle multiset {ß, Zs, ZZ, sZ}.
+        sub_map = {b"s": [b"Z"], b"ss": [b"\xc3\x9f"]}
+        got = run_device(sub_map, [b"ss"], 0, 15)
+        assert got[0] == Counter([b"\xc3\x9f", b"Zs", b"ZZ", b"sZ"])
+        assert_parity(sub_map, [b"ss", b"sss", b"ssss", b"s", b"xsx"])
+
+    def test_q6_no_rematch_of_replacement(self):
+        # 'ab' with a=b, b=c: {bb, bc, ac} and never 'cc'.
+        sub_map = {b"a": [b"b"], b"b": [b"c"]}
+        got = run_device(sub_map, [b"ab"], 0, 15)
+        assert got[0] == Counter([b"bb", b"bc", b"ac"])
+
+    def test_q7_convergent_paths_duplicate(self):
+        # 'ab' with a=X, ab=Xb -> Xb twice.
+        sub_map = {b"a": [b"X"], b"ab": [b"Xb"]}
+        got = run_device(sub_map, [b"ab"], 0, 15)
+        assert got[0] == Counter({b"Xb": 2})
+        assert_parity(sub_map, [b"ab", b"abab"])
+
+    def test_q7_duplicate_options(self):
+        sub_map = {b"a": [b"X", b"X"]}
+        got = run_device(sub_map, [b"za"], 0, 15)
+        assert got[0] == Counter({b"zX": 2})
+
+    def test_multi_option_parity(self):
+        sub_map = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5", b"z"]}
+        assert_parity(sub_map, [b"aos", b"ssaa", b"xyz", b""])
+
+    def test_min_max_windows(self):
+        sub_map = {b"a": [b"4"], b"o": [b"0"], b"s": [b"$"], b"e": [b"3"]}
+        words = [b"aoese", b"sea", b"x"]
+        for mn, mx in [(0, 15), (1, 2), (2, 2), (3, 3), (0, 0), (2, 1), (4, 9)]:
+            assert_parity(sub_map, words, mn, mx)
+
+    def test_length_changing_values(self):
+        sub_map = {b"s": [b"\xc3\x9f", b""], b"e": [b"\xd0\xad"]}
+        assert_parity(sub_map, [b"sees", b"s", b"esse"])
+
+    def test_overlapping_multichar_keys(self):
+        # 's', 'ss', 'sss' all present: heavy interval overlap, no fallback.
+        sub_map = {b"s": [b"1"], b"ss": [b"22"], b"sss": [b"333", b"x"]}
+        assert_parity(sub_map, [b"sssss", b"ss", b"s"])
+
+    def test_empty_key_inert(self):
+        # A '=x' table line: match length >= 1 means it can never fire.
+        sub_map = {b"": [b"!"], b"a": [b"4"]}
+        assert_parity(sub_map, [b"ab", b""])
+
+    @pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))
+    def test_builtin_table_parity(self, name):
+        sub_map = BUILTIN_LAYOUTS[name].to_substitution_map()
+        words = [b"pass", b"hi", b"", b"a", "λόγος".encode(), b"Pa,s"]
+        assert_parity(sub_map, words, 0, 15)
+
+    def test_block_splitting_matches_whole_run(self):
+        sub_map = {b"a": [b"1", b"2", b"3"], b"b": [b"x", b"y"], b"c": [b"q"]}
+        words = [b"abcabc", b"cab"]
+        small = run_device(sub_map, words, 0, 15, lanes=7)
+        big = run_device(sub_map, words, 0, 15, lanes=4096)
+        assert small == big
+
+
+# --------------------------------------------------------------------------
+# Reverse mode
+# --------------------------------------------------------------------------
+
+
+class TestReverseMode:
+    def test_q1_original_emitted_at_min_zero(self):
+        sub_map = {b"a": [b"4"]}
+        got = run_device(sub_map, [b"aa", b"zz"], 0, 15, reverse=True)
+        assert got[0][b"aa"] == 1
+        assert got[1] == Counter({b"zz": 1})
+
+    def test_q2_first_option_only(self):
+        sub_map = {b"a": [b"4", b"@"], b"b": [b"8", b"6", b"&"]}
+        got = run_device(sub_map, [b"ab"], 1, 15, reverse=True)
+        assert got[0] == Counter([b"4b", b"a8", b"48"])
+        assert_parity(sub_map, [b"ab", b"aabb"], 0, 15, reverse=True)
+
+    def test_q3_corrected_offsets_length_changing(self):
+        # 'ab' with a=XX, b=YY at exactly 2 subs: the buggy Go binary emits
+        # 'aXXY'; the engine proper (== oracle bug_compat=False) emits 'XXYY'.
+        sub_map = {b"a": [b"XX"], b"b": [b"YY"]}
+        got = run_device(sub_map, [b"ab"], 2, 2, reverse=True)
+        assert got[0] == Counter([b"XXYY"])
+        assert_parity(sub_map, [b"ab", b"ba", b"abab"], 0, 15, reverse=True)
+
+    def test_overlap_filter(self):
+        # 'ab' and 'b' overlap in 'ab': combos containing both are rejected.
+        sub_map = {b"ab": [b"X"], b"b": [b"Y"]}
+        assert_parity(sub_map, [b"ab", b"aab", b"abb"], 0, 15, reverse=True)
+
+    def test_min_max_windows(self):
+        sub_map = {b"a": [b"4"], b"o": [b"0"], b"s": [b"$"]}
+        words = [b"aos", b"sa", b"q"]
+        for mn, mx in [(0, 15), (1, 1), (2, 2), (0, 0), (3, 3), (2, 1)]:
+            assert_parity(sub_map, words, mn, mx, reverse=True)
+
+    @pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))
+    def test_builtin_table_parity(self, name):
+        sub_map = BUILTIN_LAYOUTS[name].to_substitution_map()
+        words = [b"pass", b"hi", b"", b"Pa,s"]
+        assert_parity(sub_map, words, 0, 15, reverse=True)
+
+
+def test_find_matches_scan_order():
+    ct = compile_table({b"s": [b"1"], b"ss": [b"2"]})
+    # position ascending, key length descending at each position.
+    assert [(p, l) for p, l, _ in find_matches(b"ss", ct)] == [
+        (0, 2),
+        (0, 1),
+        (1, 1),
+    ]
