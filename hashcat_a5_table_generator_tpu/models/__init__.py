@@ -1,0 +1,12 @@
+"""Fused end-to-end attack pipelines (expand -> hash -> membership)."""
+
+from .attack import (  # noqa: F401
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    digest_arrays,
+    make_candidates_step,
+    make_crack_step,
+    plan_arrays,
+    table_arrays,
+)

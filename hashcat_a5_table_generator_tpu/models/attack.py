@@ -1,0 +1,278 @@
+"""The flagship pipeline: fused expand -> hash -> digest-membership steps.
+
+The reference's whole runtime is "generate candidates, write to stdout, let
+hashcat hash and match" (``main.go:58-99`` + ``README.MD:69``). On TPU the
+three stages run as ONE jitted program per block batch, so candidate bytes
+never leave the device: mixed-radix decode + splice (``ops.expand_matches`` /
+``ops.expand_suball``), uint32-lane MD5/SHA1/MD4/NTLM (``ops.hashes``), and
+bitmap + binary-search membership (``ops.membership``). Only two scalars and
+two small masks come back per launch — XLA fuses the elementwise chain, and
+the minor arrays (tables, plans, digest rows) ride along as device residents.
+
+Two step flavors:
+
+* :func:`make_crack_step` — expand, hash, match; returns per-lane hit/emit
+  masks plus counts. Hits are *rare*, so the host re-derives hit candidate
+  bytes from (block, rank) cursors via :func:`decode_variant` instead of
+  shipping the full candidate buffer back.
+* :func:`make_candidates_step` — expand only; returns the candidate buffer
+  for the stdout sink (the reference-compatible mode; device->host copy is
+  the price of emitting every candidate, exactly like the reference's
+  channel->stdout funnel at ``main.go:58-68``).
+
+All step builders return **jitted functions of device-array pytrees**; the
+``*_arrays`` helpers convert host plan/table/digest objects into those
+pytrees once per sweep.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.blocks import BlockBatch
+from ..ops.expand_matches import MatchPlan, build_match_plan, expand_matches
+from ..ops.expand_suball import SubAllPlan, build_suball_plan, expand_suball
+from ..ops.hashes import HASH_FNS
+from ..ops.membership import DigestSet, digest_member
+from ..ops.packing import PackedWords
+from ..tables.compile import CompiledTable
+
+#: The four reference generation modes (``main.go:80-92``).
+MODES = ("default", "reverse", "suball", "suball-reverse")
+
+
+@dataclass(frozen=True)
+class AttackSpec:
+    """Static attack configuration — everything that shapes the compiled
+    program (mode/algo pick the kernel graph; the window is baked in)."""
+
+    mode: str = "default"
+    algo: str = "md5"
+    min_substitute: int = 0
+    max_substitute: int = 15
+
+    def __post_init__(self):
+        if self.mode not in MODES:
+            raise ValueError(f"unknown mode {self.mode!r}; one of {MODES}")
+        if self.algo not in HASH_FNS:
+            raise ValueError(
+                f"unknown algo {self.algo!r}; one of {tuple(HASH_FNS)}"
+            )
+
+    @property
+    def effective_min(self) -> int:
+        """Default mode silently bumps ``min 0 -> 1`` (Q1, main.go:169-171);
+        every other mode emits the original word at ``min == 0``."""
+        if self.mode == "default":
+            return max(1, self.min_substitute)
+        return self.min_substitute
+
+
+def build_plan(
+    spec: AttackSpec, ct: CompiledTable, packed: PackedWords, **kwargs
+):
+    """Mode-dispatched host plan construction."""
+    if spec.mode in ("default", "reverse"):
+        return build_match_plan(
+            ct, packed, first_option_only=spec.mode == "reverse", **kwargs
+        )
+    return build_suball_plan(
+        ct, packed, first_option_only=spec.mode == "suball-reverse", **kwargs
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host object -> device pytree converters
+# ---------------------------------------------------------------------------
+
+
+def table_arrays(ct: CompiledTable) -> Dict[str, jnp.ndarray]:
+    return {
+        "val_bytes": jnp.asarray(ct.val_bytes),
+        "val_len": jnp.asarray(ct.val_len),
+    }
+
+
+def plan_arrays(plan) -> Dict[str, jnp.ndarray]:
+    if isinstance(plan, MatchPlan):
+        keys = ("tokens", "lengths", "match_pos", "match_len", "match_radix",
+                "match_val_start")
+    elif isinstance(plan, SubAllPlan):
+        keys = ("tokens", "lengths", "pat_radix", "pat_val_start",
+                "seg_orig_start", "seg_orig_len", "seg_pat")
+    else:
+        raise TypeError(f"unknown plan type {type(plan)!r}")
+    return {k: jnp.asarray(getattr(plan, k)) for k in keys}
+
+
+def block_arrays(batch: BlockBatch) -> Dict[str, jnp.ndarray]:
+    return {
+        "word": jnp.asarray(batch.word),
+        "base": jnp.asarray(batch.base_digits),
+        "count": jnp.asarray(batch.count),
+        "offset": jnp.asarray(batch.offset),
+    }
+
+
+def digest_arrays(ds: DigestSet) -> Dict[str, jnp.ndarray]:
+    return {"rows": jnp.asarray(ds.rows), "bitmap": jnp.asarray(ds.bitmap)}
+
+
+def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width):
+    """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit)."""
+    common = dict(
+        num_lanes=num_lanes,
+        out_width=out_width,
+        min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute,
+    )
+    if spec.mode in ("default", "reverse"):
+        return expand_matches(
+            plan["tokens"], plan["lengths"], plan["match_pos"],
+            plan["match_len"], plan["match_radix"], plan["match_val_start"],
+            table["val_bytes"], table["val_len"],
+            blocks["word"], blocks["base"], blocks["count"], blocks["offset"],
+            **common,
+        )
+    return expand_suball(
+        plan["tokens"], plan["lengths"], plan["pat_radix"],
+        plan["pat_val_start"], plan["seg_orig_start"], plan["seg_orig_len"],
+        plan["seg_pat"], table["val_bytes"], table["val_len"],
+        blocks["word"], blocks["base"], blocks["count"], blocks["offset"],
+        **common,
+    )
+
+
+def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
+    """Build the fused expand->hash->match step.
+
+    Returns ``step(plan, table, blocks, digests) -> dict`` with per-lane
+    ``hit``/``emit`` masks, per-lane ``word_row``, and scalar counts.
+    """
+    hash_fn = HASH_FNS[spec.algo]
+
+    def step(plan, table, blocks, digests):
+        cand, cand_len, word_row, emit = _expand(
+            spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
+        )
+        state = hash_fn(cand, cand_len)
+        member = digest_member(state, digests["rows"], digests["bitmap"])
+        hit = member & emit
+        return {
+            "hit": hit,
+            "emit": emit,
+            "word_row": word_row,
+            "n_emitted": jnp.sum(emit.astype(jnp.int32)),
+            "n_hits": jnp.sum(hit.astype(jnp.int32)),
+        }
+
+    return jax.jit(step)
+
+
+def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
+    """Build the expand-only step for the stdout-candidates sink.
+
+    Returns ``step(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
+    """
+
+    def step(plan, table, blocks):
+        return _expand(
+            spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
+        )
+
+    return jax.jit(step)
+
+
+# ---------------------------------------------------------------------------
+# Host-side variant decode (hit reporting)
+# ---------------------------------------------------------------------------
+
+
+def decode_variant(
+    plan, ct: CompiledTable, spec: AttackSpec, word_idx: int, rank: int
+) -> bytes:
+    """Reconstruct the candidate bytes of one variant on the host.
+
+    Hits come back as device lanes -> (word, variant rank) via
+    :func:`lane_cursor`; this rebuilds the candidate exactly as the device
+    kernels splice it. Raises ``ValueError`` for ranks the device would not
+    emit (overlap clashes or count-window misses) — callers only pass ranks
+    the device flagged.
+    """
+    radices = [int(r) for r in plan.pat_radix[word_idx]]
+    digits = []
+    r = rank
+    for radix in radices:
+        digits.append(r % radix)
+        r //= radix
+    if r:
+        raise ValueError(f"rank {rank} out of range for word {word_idx}")
+    word = bytes(plan.tokens[word_idx, : plan.lengths[word_idx]])
+
+    def val(vrow: int) -> bytes:
+        return bytes(ct.val_bytes[vrow, : ct.val_len[vrow]])
+
+    if isinstance(plan, MatchPlan):
+        chosen = [
+            (int(plan.match_pos[word_idx, s]), int(plan.match_len[word_idx, s]),
+             int(plan.match_val_start[word_idx, s]) + d - 1)
+            for s, d in enumerate(digits)
+            if d > 0
+        ]
+        count = len(chosen)
+        if not (spec.effective_min <= count <= spec.max_substitute):
+            raise ValueError("variant outside the count window")
+        out = []
+        cursor = 0
+        for pos, klen, vrow in sorted(chosen):
+            if pos < cursor:
+                raise ValueError("variant has overlapping matches")
+            out.append(word[cursor:pos])
+            out.append(val(vrow))
+            cursor = pos + klen
+        out.append(word[cursor:])
+        return b"".join(out)
+
+    # Substitute-all plans: walk the static segment list.
+    count = sum(1 for s, d in enumerate(digits) if d > 0 and radices[s] > 1)
+    if not (spec.effective_min <= count <= spec.max_substitute):
+        raise ValueError("variant outside the count window")
+    out = []
+    for g in range(plan.num_segments):
+        slot = int(plan.seg_pat[word_idx, g])
+        start = int(plan.seg_orig_start[word_idx, g])
+        length = int(plan.seg_orig_len[word_idx, g])
+        if slot < 0 or digits[slot] == 0:
+            out.append(word[start : start + length])
+        else:
+            vrow = int(plan.pat_val_start[word_idx, slot]) + digits[slot] - 1
+            out.append(val(vrow))
+    return b"".join(out)
+
+
+def lane_cursor(
+    plan, batch: BlockBatch, lanes: Sequence[int]
+) -> List[Tuple[int, int]]:
+    """Map device lane indices back to (word_row, global variant rank).
+
+    The block's ``base_digits`` encode its starting rank in the word's
+    mixed-radix space; the global rank is that base plus the in-block rank.
+    """
+    offsets = batch.offset
+    out = []
+    for lane in lanes:
+        blk = int(np.searchsorted(offsets, lane, side="right")) - 1
+        rank_in_block = int(lane) - int(offsets[blk])
+        w = int(batch.word[blk])
+        base_rank = 0
+        scale = 1
+        for s in range(plan.num_slots):
+            base_rank += int(batch.base_digits[blk, s]) * scale
+            scale *= int(plan.pat_radix[w, s])
+        out.append((w, base_rank + rank_in_block))
+    return out

@@ -92,10 +92,20 @@ class SubAllPlan:
 
 
 def build_suball_plan(
-    ct: CompiledTable, packed: PackedWords, *, out_width: int | None = None
+    ct: CompiledTable,
+    packed: PackedWords,
+    *,
+    first_option_only: bool = False,
+    out_width: int | None = None,
 ) -> SubAllPlan:
     """Host-side plan construction (numpy + bytes.find; the C++ packer will
-    take this over for the file-to-plan hot path)."""
+    take this over for the file-to-plan hot path).
+
+    ``first_option_only=True`` builds the ``-s -r`` (substitute-all reverse)
+    space: the reference enumerates every subset of present patterns with
+    only ``subs[0]`` applied (Q2, ``main.go:393-398``), which is exactly this
+    plan with every radix clamped to 2. Its per-word multiset equals the
+    oracle's subset lattice (each subset emitted once, size windowed)."""
     b, width = packed.tokens.shape
     hazard = ct.cascade_hazard
 
@@ -146,9 +156,10 @@ def build_suball_plan(
         fallback_mask[i] = info["fallback"]
         total = 1
         for slot, ki in enumerate(info["slots"]):
-            pat_radix[i, slot] = ct.val_count[ki] + 1
+            options = min(1, int(ct.val_count[ki])) if first_option_only else int(ct.val_count[ki])
+            pat_radix[i, slot] = options + 1
             pat_val_start[i, slot] = ct.val_start[ki]
-            total *= int(ct.val_count[ki]) + 1
+            total *= options + 1
         n_variants.append(total if not info["fallback"] else 0)
 
         # Segments: gap before each span, the span, and a final gap to len.

@@ -135,8 +135,11 @@ def digest_member(
         le = _row_cmp_le(digest, rows[mid]) & (lo < hi)
         return jnp.where(le, mid + 1, lo), jnp.where(le, hi, mid)
 
-    lo0 = jnp.zeros((n,), dtype=jnp.int32)
-    hi0 = jnp.full((n,), d, dtype=jnp.int32)
+    # Derive the carry init from the probe array (not fresh constants) so its
+    # device-variance matches inside shard_map'd callers — fori_loop requires
+    # carry input/output types to agree, including the varying-axes tag.
+    lo0 = (digest[:, 0] & _U32(0)).astype(jnp.int32)
+    hi0 = lo0 + d
     lo, _ = jax.lax.fori_loop(0, steps, body, (lo0, hi0))
     found = jnp.clip(lo - 1, 0, d - 1)
     exact = jnp.all(rows[found] == digest, axis=-1) & (lo > 0)

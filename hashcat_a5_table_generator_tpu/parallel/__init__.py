@@ -1,0 +1,8 @@
+"""L5: mesh construction, shard_map pipelines, collectives."""
+
+from .mesh import (  # noqa: F401
+    make_device_blocks,
+    make_mesh,
+    make_sharded_crack_step,
+    stack_blocks,
+)

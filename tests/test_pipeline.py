@@ -1,0 +1,227 @@
+"""Fused attack pipeline: crack step, candidates step, host hit decode, and
+the shard_map'd step on the 8-virtual-device CPU mesh."""
+
+import hashlib
+
+import jax
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    decode_variant,
+    digest_arrays,
+    lane_cursor,
+    make_candidates_step,
+    make_crack_step,
+    plan_arrays,
+    table_arrays,
+)
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.parallel.mesh import (
+    make_device_blocks,
+    make_mesh,
+    make_sharded_crack_step,
+    stack_blocks,
+)
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+
+LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a"]
+
+
+def _oracle_candidates(spec: AttackSpec, word: bytes, sub_map):
+    return list(
+        iter_candidates(
+            word,
+            sub_map,
+            spec.min_substitute,
+            spec.max_substitute,
+            substitute_all=spec.mode.startswith("suball"),
+            reverse=spec.mode in ("reverse", "suball-reverse"),
+            bug_compat=False,
+        )
+    )
+
+
+def _run_crack(spec, sub_map, words, targets, lanes=2048):
+    ct = compile_table(sub_map)
+    packed = pack_words(words)
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set(targets, spec.algo)
+    step = make_crack_step(spec, num_lanes=lanes, out_width=plan.out_width)
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+
+    hits = []
+    total_emitted = 0
+    w, rank = 0, 0
+    while True:
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank, max_variants=lanes
+        )
+        if batch.total == 0:
+            break
+        out = step(p, t, block_arrays(batch), d)
+        total_emitted += int(out["n_emitted"])
+        lanes_hit = np.nonzero(np.asarray(out["hit"]))[0]
+        for word_row, vrank in lane_cursor(plan, batch, lanes_hit):
+            hits.append(decode_variant(plan, ct, spec, word_row, vrank))
+        assert int(out["n_hits"]) == len(lanes_hit)
+    return hits, total_emitted, plan
+
+
+class TestCrackStep:
+    @pytest.mark.parametrize(
+        "mode", ["default", "reverse", "suball", "suball-reverse"]
+    )
+    def test_planted_hits_found(self, mode):
+        spec = AttackSpec(mode=mode, algo="md5")
+        # Plant digests of two oracle candidates + decoys.
+        oracle = _oracle_candidates(spec, b"password", LEET)
+        planted = sorted({oracle[0], oracle[-1]})
+        targets = [hashlib.md5(c).digest() for c in planted]
+        targets += [hashlib.md5(b"decoy%d" % i).digest() for i in range(100)]
+        hits, emitted, _ = _run_crack(spec, LEET, WORDS, targets)
+        assert sorted(set(hits)) == planted
+        # Emitted count == total oracle candidates over all words.
+        want_total = sum(
+            len(_oracle_candidates(spec, w, LEET)) for w in WORDS
+        )
+        assert emitted == want_total
+
+    def test_no_targets_no_hits(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        hits, emitted, _ = _run_crack(spec, LEET, WORDS, [])
+        assert hits == []
+        assert emitted > 0
+
+    def test_sha1_and_ntlm(self):
+        for algo in ("sha1", "ntlm"):
+            spec = AttackSpec(mode="suball", algo=algo)
+            cand = _oracle_candidates(spec, b"sesame", LEET)[1]
+            if algo == "sha1":
+                target = hashlib.sha1(cand).digest()
+            else:
+                from tests.test_hashes import _ref_md4
+
+                target = _ref_md4(
+                    bytes(sum(([b, 0] for b in cand), []))
+                )
+            hits, _, _ = _run_crack(spec, LEET, WORDS, [target])
+            assert cand in hits
+
+    def test_min_window_respected(self):
+        spec = AttackSpec(mode="default", algo="md5", min_substitute=2)
+        oracle = [
+            c
+            for w in WORDS
+            for c in _oracle_candidates(spec, w, LEET)
+        ]
+        _, emitted, _ = _run_crack(spec, LEET, WORDS, [])
+        assert emitted == len(oracle)
+
+
+class TestCandidatesStep:
+    def test_multiset_matches_oracle(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(LEET)
+        packed = pack_words(WORDS)
+        plan = build_plan(spec, ct, packed)
+        step = make_candidates_step(
+            spec, num_lanes=2048, out_width=plan.out_width
+        )
+        p, t = plan_arrays(plan), table_arrays(ct)
+        from collections import Counter
+
+        got = Counter()
+        w, rank = 0, 0
+        while True:
+            batch, w, rank = make_blocks(
+                plan, start_word=w, start_rank=rank, max_variants=2048
+            )
+            if batch.total == 0:
+                break
+            cand, clen, wrow, emit = step(p, t, block_arrays(batch))
+            cand, clen, emit = map(np.asarray, (cand, clen, emit))
+            for i in np.nonzero(emit)[0]:
+                got[bytes(cand[i, : clen[i]])] += 1
+        from collections import Counter as C
+
+        want = C()
+        for w_ in WORDS:
+            want.update(_oracle_candidates(spec, w_, LEET))
+        assert got == want
+
+
+class TestShardedStep:
+    def test_eight_device_mesh_matches_single(self):
+        assert len(jax.devices()) >= 8, "conftest must provide 8 CPU devices"
+        spec = AttackSpec(mode="suball", algo="md5")
+        ct = compile_table(LEET)
+        packed = pack_words(WORDS)
+        plan = build_plan(spec, ct, packed)
+        oracle = _oracle_candidates(spec, b"octopus", LEET)
+        targets = [hashlib.md5(oracle[0]).digest()]
+        ds = build_digest_set(targets, "md5")
+
+        mesh = make_mesh(8)
+        lanes = 64  # small budget -> multiple launches, uneven tails
+        step = make_sharded_crack_step(
+            spec, mesh, lanes_per_device=lanes, out_width=plan.out_width
+        )
+        p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+
+        hits = []
+        emitted = 0
+        w, rank = 0, 0
+        while True:
+            batches, w, rank = make_device_blocks(
+                plan, n_devices=8, lanes_per_device=lanes,
+                start_word=w, start_rank=rank,
+            )
+            if sum(b.total for b in batches) == 0:
+                break
+            blocks = stack_blocks(batches)
+            out = step(p, t, d, blocks)
+            emitted += int(out["n_emitted"])
+            hit = np.asarray(out["hit"])
+            for dev in range(8):
+                dev_lanes = np.nonzero(hit[dev * lanes : (dev + 1) * lanes])[0]
+                for word_row, vrank in lane_cursor(
+                    plan, batches[dev], dev_lanes
+                ):
+                    hits.append(
+                        decode_variant(plan, ct, spec, word_row, vrank)
+                    )
+
+        want_total = sum(len(_oracle_candidates(spec, w_, LEET)) for w_ in WORDS)
+        assert emitted == want_total
+        assert hits == [oracle[0]]
+
+    def test_stack_blocks_padding(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(LEET)
+        packed = pack_words([b"a"])  # tiny space: later devices get nothing
+        plan = build_plan(spec, ct, packed)
+        batches, _, _ = make_device_blocks(
+            plan, n_devices=4, lanes_per_device=8
+        )
+        blocks = stack_blocks(batches)
+        nb = len(blocks["count"]) // 4
+        assert all(
+            blocks["count"][i * nb :].sum() == 0 for i in range(1, 4)
+        )
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        AttackSpec(mode="bogus")
+    with pytest.raises(ValueError):
+        AttackSpec(algo="crc32")
+    assert AttackSpec(mode="default", min_substitute=0).effective_min == 1
+    assert AttackSpec(mode="reverse", min_substitute=0).effective_min == 0
