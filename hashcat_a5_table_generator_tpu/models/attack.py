@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.blocks import BlockBatch
+from ..ops.blocks import BlockBatch, pad_batch
 from ..ops.expand_matches import MatchPlan, build_match_plan, expand_matches
 from ..ops.expand_suball import SubAllPlan, build_suball_plan, expand_suball
 from ..ops.hashes import HASH_FNS
@@ -110,7 +110,14 @@ def plan_arrays(plan) -> Dict[str, jnp.ndarray]:
     return {k: jnp.asarray(getattr(plan, k)) for k in keys}
 
 
-def block_arrays(batch: BlockBatch) -> Dict[str, jnp.ndarray]:
+def block_arrays(
+    batch: BlockBatch, *, num_blocks: int | None = None
+) -> Dict[str, jnp.ndarray]:
+    """Device pytree of a block batch; ``num_blocks`` pads to a static block
+    count so repeated launches keep one compiled program (pass the same value
+    as ``make_blocks(..., max_blocks=...)``)."""
+    if num_blocks is not None:
+        batch = pad_batch(batch, num_blocks)
     return {
         "word": jnp.asarray(batch.word),
         "base": jnp.asarray(batch.base_digits),
@@ -148,15 +155,16 @@ def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width):
     )
 
 
-def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
-    """Build the fused expand->hash->match step.
+def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int):
+    """The un-jitted fused expand->hash->match body, shared by the
+    single-device step and the shard_map'd step (which psums the counts).
 
-    Returns ``step(plan, table, blocks, digests) -> dict`` with per-lane
-    ``hit``/``emit`` masks, per-lane ``word_row``, and scalar counts.
+    ``body(plan, table, digests, blocks) -> dict`` with per-lane ``hit`` /
+    ``emit`` masks, per-lane ``word_row``, and *local* scalar counts.
     """
     hash_fn = HASH_FNS[spec.algo]
 
-    def step(plan, table, blocks, digests):
+    def body(plan, table, digests, blocks):
         cand, cand_len, word_row, emit = _expand(
             spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
         )
@@ -170,6 +178,20 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
             "n_emitted": jnp.sum(emit.astype(jnp.int32)),
             "n_hits": jnp.sum(hit.astype(jnp.int32)),
         }
+
+    return body
+
+
+def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
+    """Build the fused expand->hash->match step (single device).
+
+    Returns ``step(plan, table, blocks, digests) -> dict`` with per-lane
+    ``hit``/``emit`` masks, per-lane ``word_row``, and scalar counts.
+    """
+    body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width)
+
+    def step(plan, table, blocks, digests):
+        return body(plan, table, digests, blocks)
 
     return jax.jit(step)
 

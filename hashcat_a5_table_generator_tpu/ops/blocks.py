@@ -55,11 +55,14 @@ def make_blocks(
     start_rank: int = 0,
     max_variants: int,
     max_block: int = MAX_BLOCK,
+    max_blocks: int | None = None,
 ) -> Tuple[BlockBatch, int, int]:
     """Cut up to ``max_variants`` of the plan's variant space into blocks,
     starting at (start_word, start_rank). Returns (batch, next_word,
     next_rank) — the resume cursor. Fallback words are skipped (the runtime
-    routes them through the oracle)."""
+    routes them through the oracle). ``max_blocks`` caps the number of blocks
+    cut (the budget may go unfilled) so callers can pad to a static block
+    count and keep jit shapes stable across launches."""
     words: List[int] = []
     bases: List[List[int]] = []
     counts: List[int] = []
@@ -67,6 +70,8 @@ def make_blocks(
     budget = max_variants
     w, rank = start_word, start_rank
     while w < plan.batch and budget > 0:
+        if max_blocks is not None and len(words) >= max_blocks:
+            break
         total = plan.n_variants[w]
         if plan.fallback[w] or rank >= total:
             w, rank = w + 1, 0
@@ -90,3 +95,30 @@ def make_blocks(
         else np.zeros((0,), dtype=np.int32),
     )
     return batch, w, rank
+
+
+def pad_batch(batch: BlockBatch, num_blocks: int) -> BlockBatch:
+    """Pad a batch to exactly ``num_blocks`` blocks with zero-count blocks.
+
+    Padding blocks carry ``offset == total`` so their lanes fail the
+    ``rank < count`` test and are masked; a static block count keeps the
+    jitted step's input shapes stable across launches (no retraces).
+    """
+    k = len(batch.count)
+    if k > num_blocks:
+        raise ValueError(f"batch has {k} blocks > num_blocks {num_blocks}")
+    if k == num_blocks:
+        return batch
+    pad = num_blocks - k
+    total = batch.total
+    p = batch.base_digits.shape[1] if k else 1
+    return BlockBatch(
+        word=np.pad(batch.word, (0, pad)).astype(np.int32),
+        base_digits=np.pad(batch.base_digits, ((0, pad), (0, 0))).astype(np.int32)
+        if k
+        else np.zeros((num_blocks, p), dtype=np.int32),
+        count=np.pad(batch.count, (0, pad)).astype(np.int32),
+        offset=np.concatenate(
+            [batch.offset, np.full(pad, total, dtype=np.int32)]
+        ).astype(np.int32),
+    )

@@ -4,5 +4,7 @@ from .mesh import (  # noqa: F401
     make_device_blocks,
     make_mesh,
     make_sharded_crack_step,
+    replicate,
+    shard_leading,
     stack_blocks,
 )

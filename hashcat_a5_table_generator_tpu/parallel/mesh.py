@@ -28,14 +28,11 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.attack import AttackSpec, _expand
+from ..models.attack import AttackSpec, make_fused_body
 from ..ops.blocks import BlockBatch, make_blocks
-from ..ops.hashes import HASH_FNS
-from ..ops.membership import digest_member
 
 
 def make_mesh(n_devices: int | None = None, *, axis_name: str = "data") -> Mesh:
@@ -81,8 +78,11 @@ def stack_blocks(batches: List[BlockBatch]) -> Dict[str, np.ndarray]:
     Batches are padded to a common block count with zero-count blocks whose
     ``offset`` continues past the end — their lanes fail ``rank < count`` and
     are masked. Returns arrays with leading axis ``n_devices * nb``.
+    ``batches`` must be non-empty (one entry per mesh device).
     """
-    n_slots = max(b.base_digits.shape[1] for b in batches) if batches else 1
+    if not batches:
+        raise ValueError("batches must have one entry per mesh device")
+    n_slots = max(b.base_digits.shape[1] for b in batches)
     nb = max(1, max(len(b.count) for b in batches))
     words, bases, counts, offsets = [], [], [], []
     for b in batches:
@@ -122,25 +122,17 @@ def make_sharded_crack_step(
     ``hit``/``emit``/``word_row`` sharded over the mesh plus globally-psum'd
     scalar counts (replicated).
     """
-    hash_fn = HASH_FNS[spec.algo]
+    body = make_fused_body(
+        spec, num_lanes=lanes_per_device, out_width=out_width
+    )
 
     def local_step(plan, table, digests, blocks):
-        cand, cand_len, word_row, emit = _expand(
-            spec, plan, table, blocks,
-            num_lanes=lanes_per_device, out_width=out_width,
-        )
-        state = hash_fn(cand, cand_len)
-        member = digest_member(state, digests["rows"], digests["bitmap"])
-        hit = member & emit
-        n_emitted = jax.lax.psum(jnp.sum(emit.astype(jnp.int32)), axis_name)
-        n_hits = jax.lax.psum(jnp.sum(hit.astype(jnp.int32)), axis_name)
-        return {
-            "hit": hit,
-            "emit": emit,
-            "word_row": word_row,
-            "n_emitted": n_emitted,
-            "n_hits": n_hits,
-        }
+        out = body(plan, table, digests, blocks)
+        # The fused body's counts are device-local; reduce them over ICI so
+        # every host sees global totals without touching the per-lane masks.
+        out["n_emitted"] = jax.lax.psum(out["n_emitted"], axis_name)
+        out["n_hits"] = jax.lax.psum(out["n_hits"], axis_name)
+        return out
 
     rep = P()
     shard = P(axis_name)

@@ -27,6 +27,8 @@ from hashcat_a5_table_generator_tpu.parallel.mesh import (
     make_device_blocks,
     make_mesh,
     make_sharded_crack_step,
+    replicate,
+    shard_leading,
     stack_blocks,
 )
 from hashcat_a5_table_generator_tpu.tables.compile import compile_table
@@ -174,7 +176,9 @@ class TestShardedStep:
         step = make_sharded_crack_step(
             spec, mesh, lanes_per_device=lanes, out_width=plan.out_width
         )
-        p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+        p, t, d = replicate(
+            mesh, (plan_arrays(plan), table_arrays(ct), digest_arrays(ds))
+        )
 
         hits = []
         emitted = 0
@@ -186,7 +190,7 @@ class TestShardedStep:
             )
             if sum(b.total for b in batches) == 0:
                 break
-            blocks = stack_blocks(batches)
+            blocks = shard_leading(mesh, stack_blocks(batches))
             out = step(p, t, d, blocks)
             emitted += int(out["n_emitted"])
             hit = np.asarray(out["hit"])
@@ -216,6 +220,31 @@ class TestShardedStep:
         assert all(
             blocks["count"][i * nb :].sum() == 0 for i in range(1, 4)
         )
+
+
+def test_static_block_padding_avoids_retraces():
+    # With max_blocks + num_blocks padding, every launch presents identical
+    # input shapes, so the jitted step compiles exactly once.
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(LEET)
+    packed = pack_words(WORDS)
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set([], "md5")
+    nb, lanes = 8, 64
+    step = make_crack_step(spec, num_lanes=lanes, out_width=plan.out_width)
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    w, rank, launches = 0, 0, 0
+    while True:
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank,
+            max_variants=lanes, max_blocks=nb,
+        )
+        if batch.total == 0:
+            break
+        step(p, t, block_arrays(batch, num_blocks=nb), d)
+        launches += 1
+    assert launches > 1
+    assert step._cache_size() == 1
 
 
 def test_spec_validation():
