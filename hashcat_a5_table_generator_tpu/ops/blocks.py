@@ -111,12 +111,11 @@ def pad_batch(batch: BlockBatch, num_blocks: int) -> BlockBatch:
         return batch
     pad = num_blocks - k
     total = batch.total
-    p = batch.base_digits.shape[1] if k else 1
     return BlockBatch(
         word=np.pad(batch.word, (0, pad)).astype(np.int32),
-        base_digits=np.pad(batch.base_digits, ((0, pad), (0, 0))).astype(np.int32)
-        if k
-        else np.zeros((num_blocks, p), dtype=np.int32),
+        # make_blocks always shapes base_digits (k, P) — even at k == 0 — so
+        # padding preserves the plan's slot width unconditionally.
+        base_digits=np.pad(batch.base_digits, ((0, pad), (0, 0))).astype(np.int32),
         count=np.pad(batch.count, (0, pad)).astype(np.int32),
         offset=np.concatenate(
             [batch.offset, np.full(pad, total, dtype=np.int32)]

@@ -32,7 +32,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.attack import AttackSpec, make_fused_body
-from ..ops.blocks import BlockBatch, make_blocks
+from ..ops.blocks import BlockBatch, make_blocks, pad_batch
 
 
 def make_mesh(n_devices: int | None = None, *, axis_name: str = "data") -> Mesh:
@@ -84,26 +84,22 @@ def stack_blocks(batches: List[BlockBatch]) -> Dict[str, np.ndarray]:
         raise ValueError("batches must have one entry per mesh device")
     n_slots = max(b.base_digits.shape[1] for b in batches)
     nb = max(1, max(len(b.count) for b in batches))
-    words, bases, counts, offsets = [], [], [], []
+    padded = []
     for b in batches:
-        k = len(b.count)
-        pad = nb - k
-        total = b.total
-        words.append(np.pad(b.word, (0, pad)))
-        bases.append(
-            np.pad(b.base_digits, ((0, pad), (0, n_slots - b.base_digits.shape[1])))
+        b = BlockBatch(
+            word=b.word,
+            base_digits=np.pad(
+                b.base_digits, ((0, 0), (0, n_slots - b.base_digits.shape[1]))
+            ),
+            count=b.count,
+            offset=b.offset,
         )
-        counts.append(np.pad(b.count, (0, pad)))
-        offsets.append(
-            np.concatenate([b.offset, np.full(pad, total, dtype=np.int32)])
-            if k
-            else np.zeros(nb, dtype=np.int32)
-        )
+        padded.append(pad_batch(b, nb))
     return {
-        "word": np.concatenate(words).astype(np.int32),
-        "base": np.concatenate(bases).astype(np.int32),
-        "count": np.concatenate(counts).astype(np.int32),
-        "offset": np.concatenate(offsets).astype(np.int32),
+        "word": np.concatenate([b.word for b in padded]).astype(np.int32),
+        "base": np.concatenate([b.base_digits for b in padded]).astype(np.int32),
+        "count": np.concatenate([b.count for b in padded]).astype(np.int32),
+        "offset": np.concatenate([b.offset for b in padded]).astype(np.int32),
     }
 
 

@@ -247,6 +247,24 @@ def test_static_block_padding_avoids_retraces():
     assert step._cache_size() == 1
 
 
+def test_pad_batch_empty_preserves_slot_width():
+    # Regression: an empty batch (sweep exhausted / all-fallback tail) must
+    # pad to the plan's slot width, not collapse to width 1 — otherwise the
+    # jitted step retraces and the expand kernel's slot indexing breaks.
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(LEET)
+    plan = build_plan(spec, ct, pack_words(WORDS))
+    empty, w, rank = make_blocks(
+        plan, start_word=plan.batch, start_rank=0, max_variants=64
+    )
+    assert empty.total == 0
+    from hashcat_a5_table_generator_tpu.ops.blocks import pad_batch
+
+    padded = pad_batch(empty, 4)
+    assert padded.base_digits.shape == (4, plan.num_slots)
+    assert padded.count.sum() == 0
+
+
 def test_spec_validation():
     with pytest.raises(ValueError):
         AttackSpec(mode="bogus")
