@@ -1,0 +1,191 @@
+"""Benchmark: fused expand→MD5→membership throughput on one chip.
+
+The headline config from ``BASELINE.json`` configs[2]: a rockyou-class
+wordlist × qwerty-cyrillic, default mode, MD5 — candidates expanded, hashed
+and membership-tested entirely on device. The reference publishes no numbers
+(``BASELINE.md``); the target is the north star ≥1e10 candidate-hashes/sec
+per chip, so ``vs_baseline`` is value / 1e10.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "hashes/sec", "vs_baseline": N}
+
+Steady-state methodology: pre-cut real variant blocks for the sweep's head,
+warm up (compile), then cycle the pre-cut batches for a fixed wall-clock
+window, counting device-reported emitted candidates (each emitted candidate
+is exactly one MD5). Host block-cutting is excluded from the timed loop —
+in the sweep runtime it overlaps device execution (double-buffered feeds).
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+
+def synth_wordlist(n: int, seed: int = 0):
+    """Deterministic rockyou-like wordlist: lowercase stems + digit tails."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    stems = rng.integers(ord("a"), ord("z") + 1, size=(n, 10), dtype=np.uint8)
+    lens = rng.integers(6, 11, size=n)
+    digits = rng.integers(0, 3, size=n)  # 0-2 trailing digits
+    words = []
+    for i in range(n):
+        w = bytes(stems[i, : lens[i]])
+        if digits[i]:
+            w = w[: -digits[i]] + b"123"[: digits[i]]
+        words.append(w)
+    return words
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--lanes", type=int, default=1 << 19,
+                    help="variant lanes per launch")
+    ap.add_argument("--blocks", type=int, default=4096,
+                    help="static block count per launch")
+    ap.add_argument("--words", type=int, default=20000,
+                    help="synthetic wordlist size")
+    ap.add_argument("--seconds", type=float, default=10.0,
+                    help="timed-window length")
+    ap.add_argument("--batches", type=int, default=8,
+                    help="distinct pre-cut batches to cycle")
+    ap.add_argument("--algo", default="md5", help="hash algorithm")
+    ap.add_argument("--mode", default="default", help="attack mode")
+    ap.add_argument("--init-timeout", type=float, default=180.0,
+                    help="seconds to wait for accelerator init before "
+                         "falling back to CPU")
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) before init")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    # The axon TPU tunnel can wedge (backend init blocks forever in
+    # make_c_api_client). Probe device init on a daemon thread; if it does
+    # not come up in time, fall back to the local CPU backend so the bench
+    # always reports a number.
+    import threading
+
+    init_ok = threading.Event()
+
+    def _probe():
+        try:
+            jax.devices()
+            init_ok.set()
+        except Exception as e:  # pragma: no cover - backend-dependent
+            print(f"# accelerator init failed: {e}", file=sys.stderr)
+
+    probe = threading.Thread(target=_probe, daemon=True)
+    probe.start()
+    probe.join(args.init_timeout)
+    if not init_ok.is_set():
+        print(
+            f"# accelerator init did not complete in {args.init_timeout}s; "
+            "this process cannot recover the wedged backend — exiting",
+            file=sys.stderr,
+        )
+        print(json.dumps({
+            "metric": "md5_candidate_hashes_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "hashes/sec",
+            "vs_baseline": 0.0,
+            "error": "accelerator init timeout",
+        }))
+        os._exit(2)
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        AttackSpec,
+        block_arrays,
+        build_plan,
+        digest_arrays,
+        make_crack_step,
+        plan_arrays,
+        table_arrays,
+    )
+    from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+    from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+    from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
+    ct = compile_table(sub_map)
+    words = synth_wordlist(args.words)
+    packed = pack_words(words)
+    plan = build_plan(spec, ct, packed)
+    targets = [hashlib.md5(b"bench-decoy-%d" % i).digest() for i in range(1024)]
+    ds = build_digest_set(targets, spec.algo)
+
+    step = make_crack_step(spec, num_lanes=args.lanes, out_width=plan.out_width)
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+
+    # Pre-cut real blocks from the sweep's head (host cost excluded: the
+    # sweep runtime overlaps cutting with device execution).
+    batches = []
+    w, rank = 0, 0
+    for _ in range(args.batches):
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank,
+            max_variants=args.lanes, max_blocks=args.blocks,
+        )
+        if batch.total == 0:
+            break
+        batches.append(block_arrays(batch, num_blocks=args.blocks))
+    if not batches:
+        raise SystemExit("wordlist produced no variant blocks")
+
+    # Warmup: compile + one pass over every distinct batch, collecting each
+    # batch's device-reported emitted count. Block descriptors enumerate the
+    # full Π-radix rank space, but `emit` excludes min-window misses (e.g.
+    # default mode's rank-0 no-substitution variant) and overlap-clash
+    # lanes — only emitted lanes are hashed candidates, so only they count.
+    t0 = time.perf_counter()
+    per_batch = []
+    for b in batches:
+        out = step(p, t, b, d)
+        per_batch.append(int(out["n_emitted"]))
+    print(f"# warmup (incl. compile): {time.perf_counter()-t0:.1f}s",
+          file=sys.stderr)
+    hashed = 0
+    launches = 0
+    start = time.perf_counter()
+    deadline = start + args.seconds
+    out = None
+    while time.perf_counter() < deadline:
+        b = batches[launches % len(batches)]
+        out = step(p, t, b, d)
+        hashed += per_batch[launches % len(batches)]
+        launches += 1
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - start
+
+    value = hashed / elapsed
+    baseline = 1e10  # north-star target, BASELINE.json / BASELINE.md
+    print(f"# {launches} launches, {hashed:.3e} hashes, {elapsed:.2f}s",
+          file=sys.stderr)
+    print(json.dumps({
+        "metric": "md5_candidate_hashes_per_sec_per_chip",
+        "value": value,
+        "unit": "hashes/sec",
+        "vs_baseline": value / baseline,
+    }))
+
+
+if __name__ == "__main__":
+    main()
