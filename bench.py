@@ -19,7 +19,6 @@ in the sweep runtime it overlaps device execution (double-buffered feeds).
 from __future__ import annotations
 
 import argparse
-import hashlib
 import json
 import os
 import sys
@@ -62,7 +61,7 @@ def main() -> None:
     ap.add_argument("--mode", default="default", help="attack mode")
     ap.add_argument("--init-timeout", type=float, default=180.0,
                     help="seconds to wait for accelerator init before "
-                         "falling back to CPU")
+                         "aborting with an error record (exit 2)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) before init")
     args = ap.parse_args()
@@ -74,8 +73,8 @@ def main() -> None:
 
     # The axon TPU tunnel can wedge (backend init blocks forever in
     # make_c_api_client). Probe device init on a daemon thread; if it does
-    # not come up in time, fall back to the local CPU backend so the bench
-    # always reports a number.
+    # not come up in time, abort with an error record — the hung init holds
+    # backend locks, so an in-process CPU retry would deadlock.
     import threading
 
     init_ok = threading.Event()
@@ -90,6 +89,7 @@ def main() -> None:
     probe = threading.Thread(target=_probe, daemon=True)
     probe.start()
     probe.join(args.init_timeout)
+    metric = f"{args.algo}_candidate_hashes_per_sec_per_chip"
     if not init_ok.is_set():
         print(
             f"# accelerator init did not complete in {args.init_timeout}s; "
@@ -97,7 +97,7 @@ def main() -> None:
             file=sys.stderr,
         )
         print(json.dumps({
-            "metric": "md5_candidate_hashes_per_sec_per_chip",
+            "metric": metric,
             "value": 0.0,
             "unit": "hashes/sec",
             "vs_baseline": 0.0,
@@ -123,13 +123,16 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
+    from hashcat_a5_table_generator_tpu.runtime.sweep import HOST_DIGEST
+
     spec = AttackSpec(mode=args.mode, algo=args.algo)
     sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
     ct = compile_table(sub_map)
     words = synth_wordlist(args.words)
     packed = pack_words(words)
     plan = build_plan(spec, ct, packed)
-    targets = [hashlib.md5(b"bench-decoy-%d" % i).digest() for i in range(1024)]
+    host_digest = HOST_DIGEST[spec.algo]
+    targets = [host_digest(b"bench-decoy-%d" % i) for i in range(1024)]
     ds = build_digest_set(targets, spec.algo)
 
     step = make_crack_step(spec, num_lanes=args.lanes, out_width=plan.out_width)
@@ -180,7 +183,7 @@ def main() -> None:
     print(f"# {launches} launches, {hashed:.3e} hashes, {elapsed:.2f}s",
           file=sys.stderr)
     print(json.dumps({
-        "metric": "md5_candidate_hashes_per_sec_per_chip",
+        "metric": metric,
         "value": value,
         "unit": "hashes/sec",
         "vs_baseline": value / baseline,

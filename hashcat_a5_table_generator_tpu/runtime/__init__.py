@@ -1,0 +1,16 @@
+"""Sweep runtime: cursors, checkpoint/resume, progress, sinks, and the
+launch loop driving the fused device steps (the reference has NONE of this —
+its runtime is goroutines + one channel + ``log.Fatal``, SURVEY.md §5; here
+recovery is replay-from-cursor because generation is pure and the variant
+space is indexable, Q10)."""
+
+from .checkpoint import (  # noqa: F401
+    CheckpointState,
+    SweepCursor,
+    load_checkpoint,
+    save_checkpoint,
+    sweep_fingerprint,
+)
+from .progress import ProgressReporter  # noqa: F401
+from .sinks import CandidateWriter, HitRecord, HitRecorder  # noqa: F401
+from .sweep import Sweep, SweepConfig, SweepResult  # noqa: F401

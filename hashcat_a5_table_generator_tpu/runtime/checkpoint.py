@@ -1,0 +1,125 @@
+"""Sweep cursors and crash-safe checkpoint/resume.
+
+The reference is stateless streaming — a killed run restarts from zero
+(SURVEY.md §5 "Checkpoint/resume: ABSENT"). Here a sweep's position is one
+tiny cursor, ``(word index, variant rank)``, because the variant space is
+indexable (Q10: variant id ↔ choice vector bijection); recovery is exact
+replay from the cursor. The checkpoint also carries a fingerprint of every
+semantic input (mode, window, table, wordlist, digest set) so a stale file
+can never silently resume the wrong sweep — note the fingerprint is
+deliberately independent of *launch geometry* (lanes/blocks), so a resumed
+run may retune those freely.
+
+Writes are atomic (tmp + rename) so a crash mid-checkpoint leaves the
+previous checkpoint intact.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from dataclasses import asdict, dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+FORMAT_VERSION = 1
+
+
+@dataclass(frozen=True)
+class SweepCursor:
+    """Position in the sweep: next word row, next variant rank within it.
+
+    ``rank`` is a Python int (variant spaces can exceed 2^63; blocks cut
+    int32-sized pieces of it, ``ops.blocks.MAX_BLOCK``)."""
+
+    word: int = 0
+    rank: int = 0
+
+
+@dataclass
+class CheckpointState:
+    """Everything needed to resume a sweep exactly where it stopped."""
+
+    fingerprint: str
+    cursor: SweepCursor = field(default_factory=SweepCursor)
+    n_emitted: int = 0  # candidates emitted (device + oracle fallback)
+    n_hits: int = 0
+    hits: List[Tuple[int, int]] = field(default_factory=list)  # (word, rank)
+    fallback_done: int = 0  # fallback words fully re-expanded so far
+    wall_s: float = 0.0
+    version: int = FORMAT_VERSION
+
+
+def sweep_fingerprint(
+    mode: str,
+    algo: str,
+    min_substitute: int,
+    max_substitute: int,
+    sub_map: Dict[bytes, List[bytes]],
+    words: Sequence[bytes],
+    digests: Sequence[bytes] = (),
+) -> str:
+    """SHA-256 over a canonical serialization of the sweep's semantic inputs.
+
+    Table entries hash in key order with value-list order preserved (order
+    and multiplicity are semantic — Q2 first-option, Q7 duplicates)."""
+    h = hashlib.sha256()
+    h.update(f"{mode}|{algo}|{min_substitute}|{max_substitute}|".encode())
+    for key in sorted(sub_map):
+        h.update(b"K%d:" % len(key) + key)
+        for val in sub_map[key]:
+            h.update(b"V%d:" % len(val) + val)
+    h.update(b"|W%d|" % len(words))
+    for w in words:
+        h.update(b"%d:" % len(w) + w)
+    h.update(b"|D%d|" % len(digests))
+    for d in sorted(digests):
+        h.update(d)
+    return h.hexdigest()
+
+
+def save_checkpoint(path: str, state: CheckpointState) -> None:
+    """Atomically write ``state`` as JSON (tmp file + rename)."""
+    doc = asdict(state)
+    doc["cursor"] = {"word": state.cursor.word, "rank": str(state.cursor.rank)}
+    doc["hits"] = [[w, str(r)] for w, r in state.hits]
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
+    """Load and validate a checkpoint; None when absent.
+
+    Raises ``ValueError`` on version or fingerprint mismatch (a checkpoint
+    for a *different* sweep is an operator error worth surfacing, not a
+    silent fresh start)."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint {path!r} has version {doc.get('version')}, "
+            f"expected {FORMAT_VERSION}"
+        )
+    if doc.get("fingerprint") != fingerprint:
+        raise ValueError(
+            f"checkpoint {path!r} was written by a different sweep "
+            "(mode/window/table/wordlist/digests changed); delete it to "
+            "start over"
+        )
+    return CheckpointState(
+        fingerprint=doc["fingerprint"],
+        cursor=SweepCursor(
+            word=int(doc["cursor"]["word"]), rank=int(doc["cursor"]["rank"])
+        ),
+        n_emitted=int(doc["n_emitted"]),
+        n_hits=int(doc["n_hits"]),
+        hits=[(int(w), int(r)) for w, r in doc["hits"]],
+        fallback_done=int(doc.get("fallback_done", 0)),
+        wall_s=float(doc["wall_s"]),
+    )

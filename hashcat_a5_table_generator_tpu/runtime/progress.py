@@ -1,0 +1,66 @@
+"""Rate-limited structured progress to stderr.
+
+The reference reports nothing (stdlib ``log`` for errors only, SURVEY.md §5);
+candidates own stdout, so progress/metrics keep to stderr — the same clean
+split the reference uses for its error logs.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Optional, TextIO
+
+
+class ProgressReporter:
+    """Emits one JSON progress line to ``stream`` at most every
+    ``every_s`` seconds (and unconditionally on ``final()``)."""
+
+    def __init__(
+        self,
+        total_words: int,
+        *,
+        every_s: float = 5.0,
+        stream: Optional[TextIO] = None,
+        clock=time.monotonic,
+    ) -> None:
+        self.total_words = total_words
+        self.every_s = every_s
+        self.stream = stream if stream is not None else sys.stderr
+        self._clock = clock
+        self._t0 = clock()
+        self._last = float("-inf")
+        self._last_emitted = 0
+        self._last_t = self._t0
+
+    def update(
+        self, *, words_done: int, emitted: int, hits: int, force: bool = False
+    ) -> None:
+        now = self._clock()
+        if not force and now - self._last < self.every_s:
+            return
+        window = max(now - self._last_t, 1e-9)
+        rate = (emitted - self._last_emitted) / window
+        self._last, self._last_t = now, now
+        self._last_emitted = emitted
+        print(
+            json.dumps(
+                {
+                    "progress": {
+                        "words": [words_done, self.total_words],
+                        "candidates": emitted,
+                        "cand_per_sec": round(rate, 1),
+                        "hits": hits,
+                        "elapsed_s": round(now - self._t0, 2),
+                    }
+                }
+            ),
+            file=self.stream,
+            flush=True,
+        )
+
+    def final(self, *, words_done: int, emitted: int, hits: int) -> None:
+        self.update(
+            words_done=words_done, emitted=emitted, hits=hits, force=True
+        )

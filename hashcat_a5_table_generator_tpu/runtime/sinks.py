@@ -1,0 +1,116 @@
+"""Output sinks — the L4 layer (reference: one writer goroutine draining a
+channel into buffered stdout, ``main.go:58-68``).
+
+Here the device already filters (crack mode) or batches (candidates mode),
+so sinks are plain synchronous writers: ``CandidateWriter`` streams raw
+candidate bytes + ``\\n`` through one buffered binary stream exactly like
+the reference's ``bufio.Writer``; ``HitRecorder`` collects crack-mode hits
+as structured records. No thread is needed — the "single writer" discipline
+the reference gets from its goroutine is the default in a sequential launch
+loop, and device→host copies already overlap compute via JAX's async
+dispatch.
+"""
+
+from __future__ import annotations
+
+import io
+import sys
+from dataclasses import dataclass
+from typing import BinaryIO, List, Optional
+
+from ..utils.hexenc import hex_notation_encode, needs_hex_notation
+
+
+class CandidateWriter:
+    """Buffered line writer for candidate bytes (reference-compatible raw
+    emission; optional ``$HEX[]`` wrapping for line-corrupting bytes)."""
+
+    def __init__(
+        self,
+        stream: Optional[BinaryIO] = None,
+        *,
+        hex_unsafe: bool = False,
+        buffer_size: int = 1 << 20,
+    ) -> None:
+        raw = stream if stream is not None else sys.stdout.buffer
+        # Wrap in our own buffer only when the target is unbuffered-ish;
+        # BufferedWriter on BufferedWriter is harmless but wasteful.
+        self._stream = (
+            raw
+            if isinstance(raw, io.BufferedWriter)
+            else io.BufferedWriter(_NonClosingRaw(raw), buffer_size=buffer_size)
+            if isinstance(raw, io.RawIOBase)
+            else raw
+        )
+        self._own = self._stream is not raw
+        self.hex_unsafe = hex_unsafe
+        self.n_written = 0
+
+    def emit(self, candidate: bytes) -> None:
+        if self.hex_unsafe and needs_hex_notation(candidate):
+            candidate = hex_notation_encode(candidate)
+        self._stream.write(candidate)
+        self._stream.write(b"\n")
+        self.n_written += 1
+
+    def write_block(self, data: bytes, n_candidates: int) -> None:
+        """Bulk path: ``data`` is ``n_candidates`` pre-assembled
+        newline-terminated candidate lines (the sweep runner's vectorized
+        ragged flatten)."""
+        self._stream.write(data)
+        self.n_written += n_candidates
+
+    def flush(self) -> None:
+        self._stream.flush()
+
+    def close(self) -> None:
+        self._stream.flush()
+        if self._own:
+            self._stream.close()
+
+    def __enter__(self) -> "CandidateWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _NonClosingRaw(io.RawIOBase):
+    """Raw wrapper that flushes through but never closes the underlying
+    stream (closing sys.stdout.buffer would kill the process's stdout)."""
+
+    def __init__(self, raw: BinaryIO) -> None:
+        self._raw = raw
+
+    def writable(self) -> bool:
+        return True
+
+    def write(self, b) -> int:
+        return self._raw.write(b)
+
+
+@dataclass(frozen=True)
+class HitRecord:
+    """One cracked digest: where it came from and what it was."""
+
+    word_index: int  # wordlist ordinal
+    variant_rank: int  # rank in the word's variant space
+    candidate: bytes
+    digest_hex: str
+
+
+class HitRecorder:
+    """Collects crack-mode hits; optionally tees ``hex_digest:candidate``
+    lines (hashcat potfile style) to a binary stream as they arrive."""
+
+    def __init__(self, stream: Optional[BinaryIO] = None) -> None:
+        self.hits: List[HitRecord] = []
+        self._stream = stream
+
+    def emit(self, record: HitRecord) -> None:
+        self.hits.append(record)
+        if self._stream is not None:
+            self._stream.write(
+                record.digest_hex.encode("ascii") + b":" + record.candidate + b"\n"
+            )
+            self._stream.flush()

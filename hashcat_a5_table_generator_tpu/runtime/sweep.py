@@ -1,0 +1,459 @@
+"""The sweep runner: the launch loop driving the fused device steps.
+
+This is the reference's L5 scheduler re-thought for an accelerator
+(``main.go:70-99``: one goroutine per word behind a counting semaphore, all
+candidates funneled through one channel). Here the unit of work is a
+*variant block* — a contiguous rank range of one word's mixed-radix space —
+so per-word skew disappears and the whole sweep is a single linear cursor
+``(word, rank)`` (SURVEY.md §5): checkpointable, resumable by pure replay,
+and splittable across devices.
+
+Two modes, mirroring the two halves of the reference's pipeline:
+
+* **candidates** (:meth:`Sweep.run_candidates`) — the reference-compatible
+  surface: every candidate streamed to a sink as raw bytes, per-word
+  multiset-identical to the CPU oracle (global order is word order; in-word
+  order is rank order, a documented divergence from DFS order — Q9 defines
+  parity per word, not globally).
+* **crack** (:meth:`Sweep.run_crack`) — what the reference pipes into
+  hashcat for (``README.MD:69``): expand + hash + digest-membership fused
+  on device; only hits cross back to the host, where the candidate is
+  re-derived from its (word, rank) cursor and its digest re-verified with a
+  host hash — every reported hit is double-checked by construction.
+
+Words the device plans cannot handle exactly (substitute-all cascade
+hazards, ``ops.expand_suball``) are routed through the byte-exact CPU
+oracle *in word order*, interleaved at the word's position so candidates
+mode preserves global word ordering.
+
+Device launches are double-buffered: launch N+1 is dispatched before launch
+N's outputs are fetched, so host block-cutting and device compute overlap
+(JAX async dispatch does the rest).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..models.attack import (
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    decode_variant,
+    digest_arrays,
+    lane_cursor,
+    make_candidates_step,
+    make_crack_step,
+    plan_arrays,
+    table_arrays,
+)
+from ..oracle.engines import iter_candidates
+from ..ops.blocks import BlockBatch, make_blocks
+from ..ops.membership import build_digest_set
+from ..ops.packing import pack_words
+from ..tables.compile import compile_table
+from ..utils.md4 import md4, ntlm
+from .checkpoint import (
+    CheckpointState,
+    SweepCursor,
+    load_checkpoint,
+    save_checkpoint,
+    sweep_fingerprint,
+)
+from .progress import ProgressReporter
+from .sinks import CandidateWriter, HitRecord, HitRecorder
+
+#: Host-side digest functions (for oracle-fallback words and hit
+#: re-verification); must agree with the device kernels in ``ops.hashes``.
+HOST_DIGEST: Dict[str, Callable[[bytes], bytes]] = {
+    "md5": lambda b: hashlib.md5(b).digest(),
+    "sha1": lambda b: hashlib.sha1(b).digest(),
+    "md4": md4,
+    "ntlm": ntlm,
+}
+
+
+@dataclass
+class SweepConfig:
+    """Launch geometry + runtime knobs (none of these affect WHAT is
+    emitted — the checkpoint fingerprint deliberately excludes them)."""
+
+    lanes: int = 1 << 17  # variant lanes per device launch
+    num_blocks: int = 1024  # static block count (jit shape stability)
+    max_in_flight: int = 2  # double-buffered launches
+    checkpoint_path: Optional[str] = None
+    checkpoint_every_s: float = 30.0
+    progress: Optional[ProgressReporter] = None
+
+
+@dataclass
+class SweepResult:
+    n_emitted: int = 0
+    n_hits: int = 0
+    hits: List[HitRecord] = field(default_factory=list)
+    words_done: int = 0
+    resumed: bool = False
+    wall_s: float = 0.0
+
+
+class Sweep:
+    """One wordlist × one merged table × one attack spec."""
+
+    def __init__(
+        self,
+        spec: AttackSpec,
+        sub_map: Dict[bytes, List[bytes]],
+        words: Sequence[bytes],
+        digests: Sequence[bytes] = (),
+        config: Optional[SweepConfig] = None,
+    ) -> None:
+        self.spec = spec
+        self.sub_map = sub_map
+        self.words = list(words)
+        self.digests = list(digests)
+        self.config = config or SweepConfig()
+        self.ct = compile_table(sub_map)
+        self.packed = pack_words(self.words)
+        self.plan = build_plan(spec, self.ct, self.packed)
+        self.fingerprint = sweep_fingerprint(
+            spec.mode,
+            spec.algo,
+            spec.min_substitute,
+            spec.max_substitute,
+            sub_map,
+            self.words,
+            self.digests,
+        )
+        self._host_digest = HOST_DIGEST[spec.algo]
+        #: fallback word rows in word order (oracle-routed, SURVEY.md §2.4)
+        self.fallback_rows: List[int] = [
+            int(i) for i in np.nonzero(self.plan.fallback)[0]
+        ]
+
+    # ------------------------------------------------------------------
+    # Shared machinery
+    # ------------------------------------------------------------------
+
+    def _oracle_candidates(self, row: int) -> Iterator[bytes]:
+        word = self.packed.word(row)
+        return iter_candidates(
+            word,
+            self.sub_map,
+            self.spec.min_substitute,
+            self.spec.max_substitute,
+            substitute_all=self.spec.mode.startswith("suball"),
+            reverse=self.spec.mode in ("reverse", "suball-reverse"),
+        )
+
+    def _load_state(self, resume: bool) -> Tuple[CheckpointState, bool]:
+        cfg = self.config
+        if resume and cfg.checkpoint_path:
+            state = load_checkpoint(cfg.checkpoint_path, self.fingerprint)
+            if state is not None:
+                return state, True
+        return CheckpointState(fingerprint=self.fingerprint), False
+
+    def _launches(
+        self, cursor: SweepCursor, step_args: tuple, step
+    ) -> Iterator[Tuple[BlockBatch, object, SweepCursor]]:
+        """Double-buffered launch stream: yields (batch, device out, cursor
+        AFTER this launch). Dispatch runs ``max_in_flight`` ahead of fetch."""
+        cfg = self.config
+        pending: deque = deque()
+        w, rank = cursor.word, cursor.rank
+        while True:
+            batch, w2, rank2 = make_blocks(
+                self.plan,
+                start_word=w,
+                start_rank=rank,
+                max_variants=cfg.lanes,
+                max_blocks=cfg.num_blocks,
+            )
+            if batch.total == 0:
+                break
+            blocks = block_arrays(batch, num_blocks=cfg.num_blocks)
+            out = step(*step_args, blocks)
+            pending.append((batch, out, SweepCursor(w2, rank2)))
+            w, rank = w2, rank2
+            if len(pending) >= cfg.max_in_flight:
+                yield pending.popleft()
+        while pending:
+            yield pending.popleft()
+
+    def _maybe_checkpoint(self, state: CheckpointState, last: List[float],
+                          *, force: bool = False,
+                          before_save: Optional[Callable[[], None]] = None
+                          ) -> None:
+        cfg = self.config
+        if cfg.checkpoint_path is None:
+            return
+        now = time.monotonic()
+        if force or now - last[0] >= cfg.checkpoint_every_s:
+            if before_save is not None:
+                # Durably land everything the cursor claims was emitted
+                # BEFORE the checkpoint asserts it (else a crash between
+                # the save and the flush loses output resume cannot replay).
+                before_save()
+            save_checkpoint(cfg.checkpoint_path, state)
+            last[0] = now
+
+    def _flush_fallback_until(
+        self,
+        word_row: int,
+        state: CheckpointState,
+        on_candidate: Callable[[int, int, bytes], None],
+    ) -> None:
+        """Run the oracle for every unprocessed fallback word < ``word_row``
+        (pass ``len(words)`` to flush all). Candidate callback gets
+        (word_row, dfs_index, candidate)."""
+        while (
+            state.fallback_done < len(self.fallback_rows)
+            and self.fallback_rows[state.fallback_done] < word_row
+        ):
+            row = self.fallback_rows[state.fallback_done]
+            for i, cand in enumerate(self._oracle_candidates(row)):
+                on_candidate(row, i, cand)
+                state.n_emitted += 1
+            state.fallback_done += 1
+
+    # ------------------------------------------------------------------
+    # Crack mode
+    # ------------------------------------------------------------------
+
+    def run_crack(
+        self,
+        recorder: Optional[HitRecorder] = None,
+        *,
+        resume: bool = True,
+    ) -> SweepResult:
+        """Fused expand→hash→membership; only hits return to the host."""
+        spec, cfg, plan = self.spec, self.config, self.plan
+        recorder = recorder if recorder is not None else HitRecorder()
+        state, resumed = self._load_state(resume)
+        digest_set = set(self.digests)
+
+        step = make_crack_step(
+            spec, num_lanes=cfg.lanes, out_width=plan.out_width
+        )
+        args = (
+            plan_arrays(plan),
+            table_arrays(self.ct),
+        )
+        darrs = digest_arrays(build_digest_set(self.digests, spec.algo))
+
+        def crack_step(p, t, blocks):
+            return step(p, t, blocks, darrs)
+
+        # Replay checkpointed hits into the recorder (resume produces the
+        # same final hit list a never-interrupted run would). Fallback-word
+        # hits carry a DFS index, not a variant rank — re-derive via oracle.
+        fallback_set = set(self.fallback_rows)
+        for w_row, rank in state.hits:
+            if w_row in fallback_set:
+                cand = next(
+                    c
+                    for i, c in enumerate(self._oracle_candidates(w_row))
+                    if i == rank
+                )
+            else:
+                cand = decode_variant(plan, self.ct, spec, w_row, rank)
+            recorder.emit(
+                HitRecord(
+                    word_index=int(self.packed.index[w_row]),
+                    variant_rank=rank,
+                    candidate=cand,
+                    digest_hex=self._host_digest(cand).hex(),
+                )
+            )
+
+        def fallback_candidate(row: int, i: int, cand: bytes) -> None:
+            dig = self._host_digest(cand)
+            if dig in digest_set:
+                state.n_hits += 1
+                state.hits.append((row, i))
+                recorder.emit(
+                    HitRecord(
+                        word_index=int(self.packed.index[row]),
+                        variant_rank=i,
+                        candidate=cand,
+                        digest_hex=dig.hex(),
+                    )
+                )
+
+        t0 = time.monotonic()
+        last_ckpt = [t0]
+        cursor = state.cursor
+        for batch, out, cursor in self._launches(cursor, args, crack_step):
+            hit = np.asarray(out["hit"])
+            lanes = np.nonzero(hit)[0]
+            for w_row, rank in lane_cursor(plan, batch, lanes):
+                # Flush oracle words that sit before this hit's word so the
+                # hit list stays word-ordered.
+                self._flush_fallback_until(w_row, state, fallback_candidate)
+                cand = decode_variant(plan, self.ct, spec, w_row, rank)
+                dig = self._host_digest(cand)
+                # Host re-verification: the device flagged this lane; its
+                # digest must really be in the target set.
+                if dig not in digest_set:
+                    raise RuntimeError(
+                        f"device hit failed host re-verification: word "
+                        f"{w_row} rank {rank} candidate {cand!r}"
+                    )
+                state.n_hits += 1
+                state.hits.append((w_row, rank))
+                recorder.emit(
+                    HitRecord(
+                        word_index=int(self.packed.index[w_row]),
+                        variant_rank=rank,
+                        candidate=cand,
+                        digest_hex=dig.hex(),
+                    )
+                )
+            # Fallback words wholly before the cursor are due now.
+            self._flush_fallback_until(cursor.word, state, fallback_candidate)
+            state.n_emitted += int(out["n_emitted"])
+            state.cursor = cursor
+            self._maybe_checkpoint(state, last_ckpt)
+            if cfg.progress:
+                cfg.progress.update(
+                    words_done=cursor.word,
+                    emitted=state.n_emitted,
+                    hits=state.n_hits,
+                )
+        # Tail: any fallback words at/after the last device word.
+        self._flush_fallback_until(len(self.words), state, fallback_candidate)
+        state.cursor = SweepCursor(word=len(self.words), rank=0)
+        state.wall_s += time.monotonic() - t0
+        self._maybe_checkpoint(state, last_ckpt, force=True)
+        if cfg.progress:
+            cfg.progress.final(
+                words_done=len(self.words),
+                emitted=state.n_emitted,
+                hits=state.n_hits,
+            )
+        return SweepResult(
+            n_emitted=state.n_emitted,
+            n_hits=state.n_hits,
+            hits=recorder.hits,
+            words_done=len(self.words),
+            resumed=resumed,
+            wall_s=state.wall_s,
+        )
+
+    # ------------------------------------------------------------------
+    # Candidates mode (reference-compatible stdout surface)
+    # ------------------------------------------------------------------
+
+    def run_candidates(
+        self,
+        writer: CandidateWriter,
+        *,
+        resume: bool = True,
+    ) -> SweepResult:
+        """Stream every candidate to ``writer`` in word order (in-word order
+        is variant-rank order; per-word multiset parity with the oracle).
+
+        Resume is at-least-once: candidates written between the last
+        checkpoint and a crash are re-emitted on resume (tune the window
+        with ``checkpoint_every_s``); crack mode has no such duplication —
+        hits are keyed by (word, rank) in the checkpoint itself."""
+        spec, cfg, plan = self.spec, self.config, self.plan
+        state, resumed = self._load_state(resume)
+
+        step = make_candidates_step(
+            spec, num_lanes=cfg.lanes, out_width=plan.out_width
+        )
+        args = (plan_arrays(plan), table_arrays(self.ct))
+
+        def fallback_candidate(row: int, i: int, cand: bytes) -> None:
+            writer.emit(cand)
+
+        t0 = time.monotonic()
+        last_ckpt = [t0]
+        cursor = state.cursor
+        for batch, out, cursor in self._launches(cursor, args, step):
+            cand, clen, _, emit = out
+            cand = np.asarray(cand)
+            clen = np.asarray(clen).astype(np.int32)
+            emit = np.asarray(emit)
+            # Walk blocks in order; fallback words interleave at their word
+            # position. Within a fallback-free run of blocks, the write is
+            # one vectorized ragged flatten (newline planted at clen).
+            nb = len(batch.count)
+            b0 = 0
+            while b0 < nb:
+                w0 = int(batch.word[b0])
+                self._flush_fallback_until(w0, state, fallback_candidate)
+                b1 = b0
+                next_fb = (
+                    self.fallback_rows[state.fallback_done]
+                    if state.fallback_done < len(self.fallback_rows)
+                    else len(self.words)
+                )
+                while b1 < nb and int(batch.word[b1]) <= next_fb:
+                    b1 += 1
+                lo = int(batch.offset[b0])
+                hi = int(batch.offset[b1 - 1] + batch.count[b1 - 1])
+                n = self._write_lane_range(writer, cand, clen, emit, lo, hi)
+                state.n_emitted += n
+                b0 = b1
+            state.cursor = cursor
+            self._maybe_checkpoint(state, last_ckpt, before_save=writer.flush)
+            if cfg.progress:
+                cfg.progress.update(
+                    words_done=cursor.word,
+                    emitted=state.n_emitted,
+                    hits=0,
+                )
+        self._flush_fallback_until(len(self.words), state, fallback_candidate)
+        state.cursor = SweepCursor(word=len(self.words), rank=0)
+        state.wall_s += time.monotonic() - t0
+        self._maybe_checkpoint(state, last_ckpt, force=True,
+                               before_save=writer.flush)
+        if cfg.progress:
+            cfg.progress.final(
+                words_done=len(self.words), emitted=state.n_emitted, hits=0
+            )
+        return SweepResult(
+            n_emitted=state.n_emitted,
+            n_hits=0,
+            hits=[],
+            words_done=len(self.words),
+            resumed=resumed,
+            wall_s=state.wall_s,
+        )
+
+    @staticmethod
+    def _write_lane_range(
+        writer: CandidateWriter,
+        cand: np.ndarray,
+        clen: np.ndarray,
+        emit: np.ndarray,
+        lo: int,
+        hi: int,
+    ) -> int:
+        """Write emitted lanes in [lo, hi) as candidate+\\n lines with one
+        vectorized ragged flatten; returns the number of lines written."""
+        sel = emit[lo:hi]
+        if not sel.any():
+            return 0
+        rows = cand[lo:hi][sel]
+        lens = clen[lo:hi][sel]
+        n, w = rows.shape
+        if writer.hex_unsafe:
+            # Rare path: per-candidate inspection needed; emit row by row.
+            for i in range(n):
+                writer.emit(bytes(rows[i, : lens[i]]))
+            return n
+        buf = np.empty((n, w + 1), dtype=np.uint8)
+        buf[:, :w] = rows
+        buf[np.arange(n), lens] = 0x0A  # newline at each row's length
+        mask = np.arange(w + 1)[None, :] <= lens[:, None]
+        writer.write_block(buf[mask].tobytes(), n)
+        return n
