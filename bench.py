@@ -103,6 +103,8 @@ def main() -> None:
             "vs_baseline": 0.0,
             "error": "accelerator init timeout",
         }))
+        sys.stdout.flush()
+        sys.stderr.flush()
         os._exit(2)
 
     from hashcat_a5_table_generator_tpu.models.attack import (
@@ -123,7 +125,7 @@ def main() -> None:
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
-    from hashcat_a5_table_generator_tpu.runtime.sweep import HOST_DIGEST
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
     spec = AttackSpec(mode=args.mode, algo=args.algo)
     sub_map = get_layout("qwerty-cyrillic").to_substitution_map()

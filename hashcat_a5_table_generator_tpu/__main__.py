@@ -1,0 +1,7 @@
+"""``python -m hashcat_a5_table_generator_tpu`` — the a5gen CLI."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

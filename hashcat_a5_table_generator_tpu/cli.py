@@ -1,0 +1,263 @@
+"""The command-line surface: reference-compatible flags plus the TPU engine.
+
+The reference CLI (kong struct, ``main.go:18-26``) is one positional arg and
+six flags; those are reproduced verbatim so existing invocations keep
+working (``README.MD``'s documented names are outdated — kong's actual
+surface is the contract, Q11):
+
+  a5gen DICT_FILE -t TABLE [-t TABLE ...] [-m MIN] [-x MAX]
+        [--threads N] [-s] [-r]
+
+New surface (the engine lift, ``BASELINE.json`` north star):
+
+* ``--backend {oracle,device}`` — ``oracle`` streams the byte-exact CPU
+  engines in reference ``--threads 1`` order (file order, DFS order —
+  SURVEY.md Q9); ``device`` runs the JAX sweep runtime (TPU when available,
+  multiset-per-word parity, rank order within words).
+* ``--algo``, ``--digests FILE`` — crack mode: hash on device, match a
+  digest list, print ``digest:plain`` hits instead of candidates.
+* ``--checkpoint FILE`` / ``--checkpoint-every S`` — resumable sweeps.
+* ``--emit-table NAME`` / ``--list-layouts`` — the layout-map → ``.table``
+  emitter (regenerates the reference's checked-in artifacts byte-exactly).
+* ``--progress``, ``--lanes``, ``--blocks``, ``--hex-unsafe``,
+  ``--bug-compat`` (reproduce the reference's Q3 reverse-offset bug in the
+  oracle), ``--max-word-bytes`` (the anti-Q8 guard, default 64 KiB).
+
+``--threads`` is accepted for compatibility and ignored: the reference uses
+it to bound goroutines (``main.go:70-94``); here the device batches its own
+parallelism and the oracle is deterministic single-stream.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional, Sequence
+
+from .oracle.engines import iter_candidates
+from .utils.digests import HOST_DIGEST
+from .tables.layouts import BUILTIN_LAYOUTS, DERIVED_LAYOUTS, get_layout, emit_table
+from .tables.parser import load_tables
+
+PROG = "a5gen"
+DIGEST_BYTES = {"md5": 16, "md4": 16, "ntlm": 16, "sha1": 20}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog=PROG,
+        description=(
+            "TPU-native table-lookup candidate engine (hashcat -a 5 style): "
+            "apply substitution tables to a dictionary and stream variants, "
+            "or hash them on-device against a digest list."
+        ),
+    )
+    # --- reference-compatible surface (main.go:18-26) ---------------------
+    ap.add_argument("dict_file", nargs="?",
+                    help="dictionary file, one word per line")
+    ap.add_argument("-t", "--table-files", action="append", default=[],
+                    metavar="FILE",
+                    help="substitution table (repeatable; later tables "
+                         "append alternative substitutions per key)")
+    ap.add_argument("-m", "--table-min", type=int, default=0,
+                    help="minimum substitutions per candidate (default 0)")
+    ap.add_argument("-x", "--table-max", type=int, default=15,
+                    help="maximum substitutions per candidate (default 15)")
+    ap.add_argument("--threads", type=int, default=-1,
+                    help="accepted for reference compatibility; ignored")
+    ap.add_argument("-s", "--substitute-all", action="store_true",
+                    help="substitution-cipher mode: choose per unique "
+                         "pattern, not per occurrence")
+    ap.add_argument("-r", "--reverse-sub", action="store_true",
+                    help="reverse mode: start from most-substituted, "
+                         "first option per key only")
+    # --- engine surface ---------------------------------------------------
+    ap.add_argument("--backend", choices=("oracle", "device"),
+                    default="oracle",
+                    help="oracle: byte-exact CPU reference engines in "
+                         "deterministic DFS order; device: JAX sweep "
+                         "(TPU when available; per-word multiset parity)")
+    ap.add_argument("--algo", choices=sorted(DIGEST_BYTES), default="md5",
+                    help="hash algorithm for --digests mode")
+    ap.add_argument("--digests", metavar="FILE",
+                    help="hex digest list (one per line); switches to crack "
+                         "mode: print digest:plain hits instead of "
+                         "candidates")
+    ap.add_argument("--checkpoint", metavar="FILE",
+                    help="checkpoint path for resumable sweeps "
+                         "(device backend)")
+    ap.add_argument("--checkpoint-every", type=float, default=30.0,
+                    metavar="SECONDS", help="checkpoint interval")
+    ap.add_argument("--no-resume", action="store_true",
+                    help="ignore an existing checkpoint and start over")
+    ap.add_argument("--progress", action="store_true",
+                    help="periodic JSON progress lines on stderr")
+    ap.add_argument("--lanes", type=int, default=1 << 17,
+                    help="device variant lanes per launch")
+    ap.add_argument("--blocks", type=int, default=1024,
+                    help="device block slots per launch")
+    ap.add_argument("--hex-unsafe", action="store_true",
+                    help="wrap line-corrupting candidates in $HEX[...]")
+    ap.add_argument("--bug-compat", action="store_true",
+                    help="reproduce the reference's reverse-mode offset bug "
+                         "(Q3) in the oracle backend")
+    ap.add_argument("--max-word-bytes", type=int, default=64 * 1024,
+                    help="reject dictionary lines longer than this instead "
+                         "of silently truncating input (reference Q8)")
+    # --- layout emitter ---------------------------------------------------
+    ap.add_argument("--emit-table", metavar="LAYOUT",
+                    help="write a built-in layout as a .table file to stdout "
+                         "(or --output) and exit")
+    ap.add_argument("--output", metavar="FILE",
+                    help="output path for --emit-table")
+    ap.add_argument("--list-layouts", action="store_true",
+                    help="list built-in and derived layouts and exit")
+    return ap
+
+
+def _mode(args) -> str:
+    if args.substitute_all:
+        return "suball-reverse" if args.reverse_sub else "suball"
+    return "reverse" if args.reverse_sub else "default"
+
+
+def _read_digests(path: str, algo: str) -> List[bytes]:
+    want = DIGEST_BYTES[algo]
+    out: List[bytes] = []
+    with open(path, "rb") as fh:
+        for ln, raw in enumerate(fh, 1):
+            line = raw.strip()
+            if not line or line.startswith(b"#"):
+                continue
+            # hashcat-style lines may carry :salt/:plain suffixes; the
+            # digest is the first field.
+            field = line.split(b":", 1)[0]
+            try:
+                dig = bytes.fromhex(field.decode("ascii"))
+            except (UnicodeDecodeError, ValueError) as e:
+                raise SystemExit(
+                    f"{path}:{ln}: not a hex digest: {field[:40]!r} ({e})"
+                )
+            if len(dig) != want:
+                raise SystemExit(
+                    f"{path}:{ln}: {len(dig)}-byte digest, {algo} needs {want}"
+                )
+            out.append(dig)
+    return out
+
+
+def _run_emit_table(args) -> int:
+    layout = get_layout(args.emit_table)
+    if args.output:
+        emit_table(layout, args.output)
+    else:
+        sys.stdout.buffer.write(layout.to_table_bytes())
+    return 0
+
+
+def _run_list_layouts() -> int:
+    for name in sorted(BUILTIN_LAYOUTS):
+        print(f"{name}\t(built-in)\t{BUILTIN_LAYOUTS[name].description}")
+    for name in sorted(DERIVED_LAYOUTS):
+        print(f"{name}\t(derived)\t{DERIVED_LAYOUTS[name].description}")
+    return 0
+
+
+def _run_oracle(args, sub_map, words) -> int:
+    """Reference semantics, reference order (--threads 1): word order,
+    DFS order within each word (Q9)."""
+    from .runtime.sinks import CandidateWriter, potfile_line
+
+    mode = _mode(args)
+    crack = args.digests is not None
+    digest_set = (
+        set(_read_digests(args.digests, args.algo)) if crack else set()
+    )
+    host_digest = HOST_DIGEST[args.algo]
+    n_hits = 0
+    with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
+        for word in words:
+            for cand in iter_candidates(
+                word,
+                sub_map,
+                args.table_min,
+                args.table_max,
+                substitute_all=mode.startswith("suball"),
+                reverse=mode in ("reverse", "suball-reverse"),
+                bug_compat=args.bug_compat,
+            ):
+                if crack:
+                    dig = host_digest(cand)
+                    if dig in digest_set:
+                        n_hits += 1
+                        writer.write_block(
+                            potfile_line(dig.hex(), cand), 1
+                        )
+                else:
+                    writer.emit(cand)
+    if crack:
+        print(f"{n_hits} hits", file=sys.stderr)
+    return 0
+
+
+def _run_device(args, sub_map, words) -> int:
+    from .models.attack import AttackSpec
+    from .runtime.progress import ProgressReporter
+    from .runtime.sinks import CandidateWriter, HitRecorder
+    from .runtime.sweep import Sweep, SweepConfig
+
+    spec = AttackSpec(
+        mode=_mode(args),
+        algo=args.algo,
+        min_substitute=args.table_min,
+        max_substitute=args.table_max,
+    )
+    progress = (
+        ProgressReporter(len(words)) if args.progress else None
+    )
+    cfg = SweepConfig(
+        lanes=args.lanes,
+        num_blocks=args.blocks,
+        checkpoint_path=args.checkpoint,
+        checkpoint_every_s=args.checkpoint_every,
+        progress=progress,
+    )
+    if args.digests is not None:
+        digests = _read_digests(args.digests, args.algo)
+        sweep = Sweep(spec, sub_map, words, digests, config=cfg)
+        recorder = HitRecorder(sys.stdout.buffer)
+        res = sweep.run_crack(recorder, resume=not args.no_resume)
+        print(f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
+              file=sys.stderr)
+        return 0
+    sweep = Sweep(spec, sub_map, words, config=cfg)
+    with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
+        sweep.run_candidates(writer, resume=not args.no_resume)
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_layouts:
+        return _run_list_layouts()
+    if args.emit_table:
+        return _run_emit_table(args)
+    if not args.dict_file:
+        build_parser().error("dict_file is required (or use --emit-table)")
+    if not args.table_files:
+        build_parser().error("at least one -t/--table-files is required")
+    if args.table_min > args.table_max:
+        build_parser().error(
+            f"--table-min {args.table_min} > --table-max {args.table_max}"
+        )
+    from .ops.packing import read_wordlist  # numpy-only module
+
+    sub_map = load_tables(args.table_files)
+    words = read_wordlist(args.dict_file, max_word_bytes=args.max_word_bytes)
+    if args.backend == "oracle":
+        return _run_oracle(args, sub_map, words)
+    return _run_device(args, sub_map, words)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

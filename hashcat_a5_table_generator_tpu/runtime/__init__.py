@@ -2,7 +2,12 @@
 launch loop driving the fused device steps (the reference has NONE of this —
 its runtime is goroutines + one channel + ``log.Fatal``, SURVEY.md §5; here
 recovery is replay-from-cursor because generation is pure and the variant
-space is indexable, Q10)."""
+space is indexable, Q10).
+
+``Sweep``/``SweepConfig``/``SweepResult`` are loaded lazily (PEP 562): they
+pull in jax, and jax-free consumers (the oracle CLI backend) must be able to
+import the checkpoint/progress/sink layers without it.
+"""
 
 from .checkpoint import (  # noqa: F401
     CheckpointState,
@@ -13,4 +18,17 @@ from .checkpoint import (  # noqa: F401
 )
 from .progress import ProgressReporter  # noqa: F401
 from .sinks import CandidateWriter, HitRecord, HitRecorder  # noqa: F401
-from .sweep import Sweep, SweepConfig, SweepResult  # noqa: F401
+
+_LAZY = ("Sweep", "SweepConfig", "SweepResult")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        from . import sweep
+
+        return getattr(sweep, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

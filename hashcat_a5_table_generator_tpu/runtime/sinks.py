@@ -99,9 +99,18 @@ class HitRecord:
     digest_hex: str
 
 
+def potfile_line(digest_hex: str, candidate: bytes) -> bytes:
+    """One ``digest:plain`` potfile line; a line-corrupting plain (embedded
+    newline via ``$HEX[]`` table values) is ``$HEX[]``-wrapped — only the
+    plain, never the digest prefix, matching hashcat's potfile convention."""
+    if needs_hex_notation(candidate):
+        candidate = hex_notation_encode(candidate)
+    return digest_hex.encode("ascii") + b":" + candidate + b"\n"
+
+
 class HitRecorder:
-    """Collects crack-mode hits; optionally tees ``hex_digest:candidate``
-    lines (hashcat potfile style) to a binary stream as they arrive."""
+    """Collects crack-mode hits; optionally tees potfile lines to a binary
+    stream as they arrive."""
 
     def __init__(self, stream: Optional[BinaryIO] = None) -> None:
         self.hits: List[HitRecord] = []
@@ -111,6 +120,6 @@ class HitRecorder:
         self.hits.append(record)
         if self._stream is not None:
             self._stream.write(
-                record.digest_hex.encode("ascii") + b":" + record.candidate + b"\n"
+                potfile_line(record.digest_hex, record.candidate)
             )
             self._stream.flush()

@@ -33,7 +33,6 @@ N's outputs are fetched, so host block-cutting and device compute overlap
 
 from __future__ import annotations
 
-import hashlib
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -58,7 +57,7 @@ from ..ops.blocks import BlockBatch, make_blocks
 from ..ops.membership import build_digest_set
 from ..ops.packing import pack_words
 from ..tables.compile import compile_table
-from ..utils.md4 import md4, ntlm
+from ..utils.digests import HOST_DIGEST
 from .checkpoint import (
     CheckpointState,
     SweepCursor,
@@ -68,15 +67,6 @@ from .checkpoint import (
 )
 from .progress import ProgressReporter
 from .sinks import CandidateWriter, HitRecord, HitRecorder
-
-#: Host-side digest functions (for oracle-fallback words and hit
-#: re-verification); must agree with the device kernels in ``ops.hashes``.
-HOST_DIGEST: Dict[str, Callable[[bytes], bytes]] = {
-    "md5": lambda b: hashlib.md5(b).digest(),
-    "sha1": lambda b: hashlib.sha1(b).digest(),
-    "md4": md4,
-    "ntlm": ntlm,
-}
 
 
 @dataclass

@@ -1,0 +1,219 @@
+"""End-to-end CLI tests: reference-compatible flag surface, oracle and
+device backends, crack mode, emit-table, error paths (SURVEY.md §4.5)."""
+
+import hashlib
+import json
+import subprocess
+import sys
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.tables.parser import load_tables
+
+#: In-process devices are forced onto CPU by conftest; subprocesses need the
+#: same (the axon plugin ignores JAX_PLATFORMS env, so use jax.config).
+DRIVER = (
+    "import jax, sys; jax.config.update('jax_platforms', 'cpu'); "
+    "from hashcat_a5_table_generator_tpu.cli import main; "
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def run_cli(*argv, check=True):
+    r = subprocess.run(
+        [sys.executable, "-c", DRIVER, *argv], capture_output=True
+    )
+    if check and r.returncode != 0:
+        raise AssertionError(
+            f"CLI failed ({r.returncode}):\n{r.stderr.decode()[-2000:]}"
+        )
+    return r
+
+
+@pytest.fixture(scope="module")
+def workdir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("cli")
+    (d / "dict.txt").write_bytes(b"password\nsesame\nzzz\n")
+    (d / "leet.table").write_bytes(b"a=4\na=@\no=0\ns=$\ns=5\ne=3\n")
+    return d
+
+
+def oracle_all(sub_map, words, mn=0, mx=15, suball=False, reverse=False):
+    out = []
+    for w in words:
+        out.extend(
+            iter_candidates(w, sub_map, mn, mx,
+                            substitute_all=suball, reverse=reverse)
+        )
+    return out
+
+
+class TestReferenceSurface:
+    def test_default_mode_matches_oracle_in_order(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"))
+        sub = load_tables([str(workdir / "leet.table")])
+        want = oracle_all(sub, [b"password", b"sesame", b"zzz"])
+        assert r.stdout.splitlines() == want  # exact --threads 1 order
+
+    def test_all_four_modes(self, workdir):
+        sub = load_tables([str(workdir / "leet.table")])
+        for flags, kw in [
+            ((), {}),
+            (("-r",), dict(reverse=True)),
+            (("-s",), dict(suball=True)),
+            (("-s", "-r"), dict(suball=True, reverse=True)),
+        ]:
+            r = run_cli(str(workdir / "dict.txt"),
+                        "-t", str(workdir / "leet.table"), *flags)
+            want = oracle_all(sub, [b"password", b"sesame", b"zzz"], **kw)
+            assert r.stdout.splitlines() == want, flags
+
+    def test_min_max_window(self, workdir):
+        sub = load_tables([str(workdir / "leet.table")])
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "-m", "2", "-x", "3")
+        want = oracle_all(sub, [b"password", b"sesame", b"zzz"], mn=2, mx=3)
+        assert r.stdout.splitlines() == want
+
+    def test_merged_tables_append_options(self, workdir, tmp_path):
+        extra = tmp_path / "extra.table"
+        extra.write_bytes(b"a=AAA\n")
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "-t", str(extra))
+        sub = load_tables([str(workdir / "leet.table"), str(extra)])
+        assert sub[b"a"] == [b"4", b"@", b"AAA"]
+        want = oracle_all(sub, [b"password", b"sesame", b"zzz"])
+        assert r.stdout.splitlines() == want
+
+    def test_threads_flag_accepted(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "--threads", "8")
+        assert r.returncode == 0
+
+
+class TestErrors:
+    def test_missing_table_flag(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), check=False)
+        assert r.returncode == 2
+        assert b"table-files" in r.stderr
+
+    def test_min_above_max(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "-m", "5", "-x", "2", check=False)
+        assert r.returncode == 2
+
+    def test_oversized_line_rejected_not_truncated(self, workdir, tmp_path):
+        # Anti-Q8: the reference silently ends input here with exit 0.
+        big = tmp_path / "big.txt"
+        big.write_bytes(b"x" * 100 + b"\n")
+        r = run_cli(str(big), "-t", str(workdir / "leet.table"),
+                    "--max-word-bytes", "50", check=False)
+        assert r.returncode != 0
+
+    def test_bad_digest_file(self, workdir, tmp_path):
+        bad = tmp_path / "bad.hashes"
+        bad.write_bytes(b"zznothex\n")
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "--digests", str(bad), check=False)
+        assert r.returncode != 0
+        assert b"not a hex digest" in r.stderr
+
+
+class TestEmitTable:
+    def test_emit_stdout_round_trips(self):
+        r = run_cli("--emit-table", "german")
+        assert r.stdout == (
+            b"A=\xc3\xa4\nO=\xc3\xb6\nU=\xc3\xbc\na=\xc3\xa4\no=\xc3\xb6\n"
+            b"u=\xc3\xbc\nss=\xc3\x9f\nZ=\xc3\x9f\n"
+        )
+
+    def test_emit_matches_upstream_artifact(self, upstream_reference):
+        got = run_cli("--emit-table", "qwerty-cyrillic").stdout
+        want = (upstream_reference / "qwerty-cyrillic.table").read_bytes()
+        assert got == want
+
+    def test_list_layouts(self):
+        r = run_cli("--list-layouts")
+        names = [l.split(b"\t")[0] for l in r.stdout.splitlines()]
+        assert b"qwerty-cyrillic" in names
+        assert b"azerty-qwerty" in names  # derived, not checked in upstream
+
+    def test_unknown_layout(self):
+        r = run_cli("--emit-table", "dvorak-klingon", check=False)
+        assert r.returncode != 0
+
+
+class TestDeviceBackend:
+    def test_candidates_multiset_parity(self, workdir):
+        sub = load_tables([str(workdir / "leet.table")])
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--lanes", "256", "--blocks", "16")
+        from collections import Counter
+
+        want = Counter(oracle_all(sub, [b"password", b"sesame", b"zzz"]))
+        assert Counter(r.stdout.splitlines()) == want
+
+    def test_crack_mode_finds_planted(self, workdir, tmp_path):
+        sub = load_tables([str(workdir / "leet.table")])
+        plant = oracle_all(sub, [b"sesame"])[5]
+        hashes = tmp_path / "t.hashes"
+        hashes.write_bytes(
+            hashlib.md5(plant).hexdigest().encode() + b"\n"
+            + hashlib.md5(b"decoy").hexdigest().encode() + b"\n"
+        )
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--digests", str(hashes),
+                    "--lanes", "256", "--blocks", "16")
+        lines = r.stdout.splitlines()
+        assert lines == [hashlib.md5(plant).hexdigest().encode() + b":" + plant]
+        assert b"1 hits" in r.stderr
+
+    def test_progress_lines(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--progress",
+                    "--lanes", "256", "--blocks", "16")
+        prog = [json.loads(l) for l in r.stderr.decode().splitlines()
+                if '"progress"' in l]
+        assert prog and prog[-1]["progress"]["words"] == [3, 3]
+
+    def test_checkpoint_written_and_resume_skips(self, workdir, tmp_path):
+        ck = tmp_path / "ck.json"
+        args = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                "--backend", "device", "--checkpoint", str(ck),
+                "--lanes", "256", "--blocks", "16")
+        r1 = run_cli(*args)
+        assert ck.exists()
+        assert r1.stdout  # full candidate stream
+        r2 = run_cli(*args)  # complete checkpoint -> nothing re-emitted
+        assert r2.stdout == b""
+        r3 = run_cli(*args, "--no-resume")
+        assert r3.stdout == r1.stdout or sorted(r3.stdout.splitlines()) == sorted(
+            r1.stdout.splitlines()
+        )
+
+
+class TestOracleCrack:
+    def test_oracle_backend_crack(self, workdir, tmp_path):
+        sub = load_tables([str(workdir / "leet.table")])
+        plant = oracle_all(sub, [b"password"])[0]
+        hashes = tmp_path / "t.hashes"
+        hashes.write_bytes(hashlib.md5(plant).hexdigest().encode() + b"\n")
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "--digests", str(hashes))
+        assert r.stdout.splitlines() == [
+            hashlib.md5(plant).hexdigest().encode() + b":" + plant
+        ]
+
+    def test_ntlm_crack(self, workdir, tmp_path):
+        from hashcat_a5_table_generator_tpu.utils.md4 import ntlm
+
+        sub = load_tables([str(workdir / "leet.table")])
+        plant = oracle_all(sub, [b"zzz"], suball=True)[0]  # original word
+        hashes = tmp_path / "t.hashes"
+        hashes.write_bytes(ntlm(plant).hex().encode() + b"\n")
+        r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                    "-s", "--algo", "ntlm", "--digests", str(hashes))
+        assert r.stdout.splitlines() == [
+            ntlm(plant).hex().encode() + b":" + plant
+        ]
