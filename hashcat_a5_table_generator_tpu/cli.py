@@ -193,6 +193,9 @@ def _run_oracle(args, sub_map, words) -> int:
                         writer.write_block(
                             potfile_line(dig.hex(), cand), 1
                         )
+                        # Hits are rare and precious: land each one
+                        # immediately (matches HitRecorder's per-hit flush).
+                        writer.flush()
                 else:
                     writer.emit(cand)
     if crack:
@@ -237,23 +240,46 @@ def _run_device(args, sub_map, words) -> int:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    args = build_parser().parse_args(argv)
+    ap = build_parser()
+    args = ap.parse_args(argv)
     if args.list_layouts:
         return _run_list_layouts()
     if args.emit_table:
-        return _run_emit_table(args)
+        try:
+            return _run_emit_table(args)
+        except KeyError as e:
+            ap.error(str(e.args[0]) if e.args else str(e))
     if not args.dict_file:
-        build_parser().error("dict_file is required (or use --emit-table)")
+        ap.error("dict_file is required (or use --emit-table)")
     if not args.table_files:
-        build_parser().error("at least one -t/--table-files is required")
+        ap.error("at least one -t/--table-files is required")
     if args.table_min > args.table_max:
-        build_parser().error(
+        ap.error(
             f"--table-min {args.table_min} > --table-max {args.table_max}"
         )
+    if args.backend == "oracle":
+        for flag, name in (
+            (args.checkpoint, "--checkpoint"),
+            (args.no_resume, "--no-resume"),
+            (args.progress, "--progress"),
+        ):
+            if flag:
+                print(
+                    f"{PROG}: warning: {name} has no effect with "
+                    "--backend oracle (the oracle streams statelessly)",
+                    file=sys.stderr,
+                )
     from .ops.packing import read_wordlist  # numpy-only module
 
     sub_map = load_tables(args.table_files)
-    words = read_wordlist(args.dict_file, max_word_bytes=args.max_word_bytes)
+    try:
+        words = read_wordlist(
+            args.dict_file, max_word_bytes=args.max_word_bytes
+        )
+    except ValueError as e:
+        raise SystemExit(f"{PROG}: {e}")
+    except OSError as e:
+        raise SystemExit(f"{PROG}: cannot read {args.dict_file}: {e}")
     if args.backend == "oracle":
         return _run_oracle(args, sub_map, words)
     return _run_device(args, sub_map, words)

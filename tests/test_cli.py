@@ -14,8 +14,13 @@ from hashcat_a5_table_generator_tpu.tables.parser import load_tables
 #: In-process devices are forced onto CPU by conftest; subprocesses need the
 #: same (the axon plugin ignores JAX_PLATFORMS env, so use jax.config).
 DRIVER = (
-    "import jax, sys; jax.config.update('jax_platforms', 'cpu'); "
-    "from hashcat_a5_table_generator_tpu.cli import main; "
+    "import sys\n"
+    "try:\n"
+    "    import jax\n"
+    "    jax.config.update('jax_platforms', 'cpu')\n"
+    "except ImportError:\n"
+    "    pass  # oracle-path tests must run in a jax-less environment\n"
+    "from hashcat_a5_table_generator_tpu.cli import main\n"
     "sys.exit(main(sys.argv[1:]))"
 )
 

@@ -1,0 +1,189 @@
+"""Hypothesis property tests (SURVEY.md §4.4): random tables × words vs the
+oracle — keyspace counts, mode quirks (Q1/Q2), parser/emitter round-trips,
+and the central enumeration theorem: the device plans' mixed-radix
+index-decode (``decode_variant`` over every rank) reproduces the recursive
+DFS engines' multiset exactly, for every mode, without touching a device.
+"""
+
+from collections import Counter
+
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    build_plan,
+    decode_variant,
+)
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.oracle.keyspace import count_candidates
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import Layout
+from hashcat_a5_table_generator_tpu.tables.parser import parse_substitution_table
+from hashcat_a5_table_generator_tpu.utils.hexenc import hex_notation_encode
+from hashcat_a5_table_generator_tpu.tables.parser import decode_hex_notation
+
+# Small alphabet so keys overlap and multi-char keys collide with
+# single-char ones (the hard enumeration cases).
+ALPHA = b"abc"
+
+def _bytes_from(alphabet: bytes, min_size: int, max_size: int):
+    return st.lists(
+        st.sampled_from(list(alphabet)), min_size=min_size, max_size=max_size
+    ).map(bytes)
+
+
+keys = _bytes_from(ALPHA, 1, 2)
+# Values may lengthen, shorten (empty allowed: "a=" is a legal table line)
+# or contain other keys (cascade-hazard food for suball fallback analysis).
+values = _bytes_from(ALPHA + b"XY", 0, 3)
+tables = st.dictionaries(
+    keys, st.lists(values, min_size=1, max_size=2), min_size=1, max_size=4
+)
+words = _bytes_from(ALPHA, 0, 6)
+windows = st.tuples(st.integers(0, 3), st.integers(0, 6)).filter(
+    lambda t: t[0] <= t[1]
+)
+
+MODES = [
+    dict(substitute_all=False, reverse=False),
+    dict(substitute_all=False, reverse=True),
+    dict(substitute_all=True, reverse=False),
+    dict(substitute_all=True, reverse=True),
+]
+MODE_NAME = ["default", "reverse", "suball", "suball-reverse"]
+
+
+def oracle(word, table, mn, mx, **mode):
+    return list(
+        iter_candidates(word, table, mn, mx, bug_compat=False, **mode)
+    )
+
+
+@settings(max_examples=120, deadline=None)
+@given(word=words, table=tables, window=windows)
+@pytest.mark.parametrize("mode_i", range(4))
+def test_keyspace_count_exact(mode_i, word, table, window):
+    mn, mx = window
+    mode = MODES[mode_i]
+    assert count_candidates(word, table, mn, mx, **mode) == len(
+        oracle(word, table, mn, mx, **mode)
+    )
+
+
+@settings(max_examples=100, deadline=None)
+@given(word=words, table=tables)
+def test_q1_original_emission(word, table):
+    # Q1: at min=0, default mode never emits the unmodified word (min is
+    # silently bumped to 1); the other three always emit it (k=0 combo /
+    # empty choice / empty subset). For the default-mode half, restrict to
+    # length-preserving non-identity tables: with length CHANGES a pair of
+    # substitutions can reconstruct the original (hypothesis found
+    # word=b'aa', {a: ['', 'aa']} -> '' + 'aa' == original).
+    if all(
+        v != k and len(v) == len(k) for k, vs in table.items() for v in vs
+    ):
+        d = oracle(word, table, 0, 15, substitute_all=False, reverse=False)
+        assert word not in d
+    for mode in MODES[1:]:
+        out = oracle(word, table, 0, 15, **mode)
+        assert out.count(word) >= 1
+
+
+@settings(max_examples=100, deadline=None)
+@given(word=words, table=tables, window=windows)
+def test_q2_reverse_uses_first_option_only(word, table, window):
+    mn, mx = window
+    first_only = {k: v[:1] for k, v in table.items()}
+    got = oracle(word, table, mn, mx, substitute_all=False, reverse=True)
+    want = oracle(word, first_only, mn, mx, substitute_all=False, reverse=True)
+    assert got == want
+
+
+@settings(max_examples=60, deadline=None)
+@given(word=words, table=tables, window=windows)
+@pytest.mark.parametrize("mode_i", range(4))
+def test_index_decode_equals_dfs_multiset(mode_i, word, table, window):
+    """The enumeration theorem (SURVEY.md §7 hard part b): decoding EVERY
+    rank of the device plan's mixed-radix space — dropping count-window
+    misses and overlap clashes — yields exactly the DFS engines' multiset."""
+    mn, mx = window
+    mode = MODES[mode_i]
+    spec = AttackSpec(
+        mode=MODE_NAME[mode_i], min_substitute=mn, max_substitute=mx
+    )
+    ct = compile_table(table)
+    plan = build_plan(spec, ct, pack_words([word]))
+    if plan.fallback[0]:
+        return  # oracle-routed by design (cascade hazard)
+    total = plan.n_variants[0]
+    if total > 4096:
+        return  # keep the exhaustive decode bounded
+    got = Counter()
+    for rank in range(total):
+        try:
+            got[decode_variant(plan, ct, spec, 0, rank)] += 1
+        except ValueError:
+            pass  # masked lane: window miss or overlap clash
+    want = Counter(oracle(word, table, mn, mx, **mode))
+    assert got == want
+
+
+@settings(max_examples=100, deadline=None)
+@given(
+    pairs=st.lists(
+        st.tuples(
+            st.text(min_size=0, max_size=3),
+            st.text(min_size=0, max_size=3),
+        ),
+        min_size=0,
+        max_size=6,
+    )
+)
+def test_layout_emit_parse_round_trip(pairs):
+    # Emitter escaping must survive a re-parse for ANY printable pairs —
+    # including '=', '#', whitespace and empty strings (empty keys emit as
+    # '=v' and parse back to the inert empty key, matching the reference).
+    layout = Layout("prop", tuple(pairs))
+    text = layout.to_table_bytes()
+    reparsed = parse_substitution_table(text)
+    want = {}
+    for k, v in pairs:
+        kb, vb = k.encode(), v.encode()
+        # The parser's TrimSpace drops lines whose whole content trims away;
+        # the emitter hex-escapes those, so nothing is ever lost — except
+        # pure-comment keys which are escaped too. Model the contract:
+        want.setdefault(kb, []).append(vb)
+    assert reparsed == {k: v for k, v in want.items()}
+
+
+@settings(max_examples=200, deadline=None)
+@given(data=st.binary(min_size=1, max_size=32))
+def test_hex_notation_round_trip(data):
+    # Non-empty only: "$HEX[]" is 6 bytes and the reference's decoder
+    # passes anything under 7 bytes through verbatim (len<7 rule), so the
+    # empty payload cannot round-trip — and is never emitted (an empty
+    # candidate never needs_hex_notation).
+    assert decode_hex_notation(hex_notation_encode(data)) == data
+
+
+@settings(max_examples=60, deadline=None)
+@given(word=words, table=tables)
+def test_multiplicity_q7_duplicate_values_double(word, table):
+    # Duplicating every option list doubles the multiplicity of every
+    # substituted candidate (no dedupe anywhere — Q7).
+    doubled = {k: v + v for k, v in table.items()}
+    base = Counter(oracle(word, table, 1, 15, substitute_all=False,
+                          reverse=False))
+    got = Counter(oracle(word, doubled, 1, 15, substitute_all=False,
+                         reverse=False))
+    # Each k-substitution variant contributes 2^k >= 2 copies after
+    # doubling; a candidate STRING may aggregate variants of different k
+    # (hypothesis: word=b'aa', {a: [a]} gives 3 -> 8, not a multiple), so
+    # the per-candidate law is support equality + at-least-doubling.
+    assert set(got) == set(base)
+    for cand, n in base.items():
+        assert got[cand] >= 2 * n
