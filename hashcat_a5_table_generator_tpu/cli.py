@@ -203,7 +203,9 @@ def _run_oracle(args, sub_map, words) -> int:
     return 0
 
 
-def _run_device(args, sub_map, words) -> int:
+def _run_device(args, sub_map, packed) -> int:
+    """``packed`` is a PackedWords batch (native fast path) — the device
+    backend never materializes a Python word list."""
     from .models.attack import AttackSpec
     from .runtime.progress import ProgressReporter
     from .runtime.sinks import CandidateWriter, HitRecorder
@@ -216,7 +218,7 @@ def _run_device(args, sub_map, words) -> int:
         max_substitute=args.table_max,
     )
     progress = (
-        ProgressReporter(len(words)) if args.progress else None
+        ProgressReporter(packed.batch) if args.progress else None
     )
     cfg = SweepConfig(
         lanes=args.lanes,
@@ -227,13 +229,13 @@ def _run_device(args, sub_map, words) -> int:
     )
     if args.digests is not None:
         digests = _read_digests(args.digests, args.algo)
-        sweep = Sweep(spec, sub_map, words, digests, config=cfg)
+        sweep = Sweep(spec, sub_map, packed, digests, config=cfg)
         recorder = HitRecorder(sys.stdout.buffer)
         res = sweep.run_crack(recorder, resume=not args.no_resume)
         print(f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
               file=sys.stderr)
         return 0
-    sweep = Sweep(spec, sub_map, words, config=cfg)
+    sweep = Sweep(spec, sub_map, packed, config=cfg)
     with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
         sweep.run_candidates(writer, resume=not args.no_resume)
     return 0
@@ -269,20 +271,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "--backend oracle (the oracle streams statelessly)",
                     file=sys.stderr,
                 )
-    from .ops.packing import read_wordlist  # numpy-only module
-
-    sub_map = load_tables(args.table_files)
     try:
-        words = read_wordlist(
+        sub_map = load_tables(args.table_files)
+    except OSError as e:
+        raise SystemExit(f"{PROG}: cannot read table: {e}")
+    try:
+        if args.backend == "oracle":
+            from .ops.packing import read_wordlist  # numpy-only module
+
+            words = read_wordlist(
+                args.dict_file, max_word_bytes=args.max_word_bytes
+            )
+            return _run_oracle(args, sub_map, words)
+        # Device backend: the native scanner/packer is the wordlist hot
+        # path (numpy fallback engages transparently when unavailable).
+        from . import native
+
+        packed = native.read_packed(
             args.dict_file, max_word_bytes=args.max_word_bytes
         )
+        return _run_device(args, sub_map, packed)
     except ValueError as e:
         raise SystemExit(f"{PROG}: {e}")
     except OSError as e:
         raise SystemExit(f"{PROG}: cannot read {args.dict_file}: {e}")
-    if args.backend == "oracle":
-        return _run_oracle(args, sub_map, words)
-    return _run_device(args, sub_map, words)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,203 @@
+"""ctypes bindings for the native wordlist scanner/packer.
+
+Build-on-first-use: ``g++ -O3 -shared`` into a per-source-hash cache under
+``~/.cache/a5native`` (no pip, no pybind11 — the C ABI + ctypes per the
+environment's binding guidance). Every entry point degrades to the numpy
+reference implementation in ``ops.packing`` when the toolchain or build is
+unavailable, and ``A5_NATIVE=0`` forces the fallback.
+
+The contract — byte-identical outputs to ``ops.packing`` — is enforced by
+tests/test_native.py across CRLF, unterminated tails, empty lines and the
+anti-Q8 oversized-line error.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import sys
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..ops.packing import DEFAULT_MAX_WORD_BYTES, PackedWords, aligned_width
+
+_SRC = pathlib.Path(__file__).with_name("packer.cpp")
+_ABI = 1
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _cache_dir() -> pathlib.Path:
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return pathlib.Path(root) / "a5native"
+
+
+def _build() -> Optional[pathlib.Path]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"liba5native-{tag}.so"
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".tmp{os.getpid()}.so")
+    # No -march=native: the cache key is source-hash only, and the scan/pack
+    # passes are memory-bound — a portable -O3 binary avoids SIGILL when the
+    # cache directory is shared across heterogeneous machines.
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC",
+        "-o", str(tmp), str(_SRC),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+    except (OSError, subprocess.SubprocessError) as e:
+        print(
+            f"a5native: build failed ({e}); using numpy fallback",
+            file=sys.stderr,
+        )
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None => use fallback."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("A5_NATIVE", "1") == "0":
+        return None
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError as e:
+        print(f"a5native: load failed ({e}); using numpy fallback",
+              file=sys.stderr)
+        return None
+    if lib.a5_native_abi() != _ABI:
+        print("a5native: ABI mismatch; using numpy fallback", file=sys.stderr)
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.a5_count_lines.argtypes = [u8p, ctypes.c_int64]
+    lib.a5_count_lines.restype = ctypes.c_int64
+    lib.a5_scan_lines.argtypes = [u8p, ctypes.c_int64, ctypes.c_int64,
+                                  i64p, i32p, i64p]
+    lib.a5_scan_lines.restype = ctypes.c_int32
+    lib.a5_pack.argtypes = [u8p, i64p, i32p, i64p, ctypes.c_int64,
+                            ctypes.c_int32, u8p, i32p]
+    lib.a5_pack.restype = ctypes.c_int32
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _u8(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def _i64(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def scan_wordlist_bytes(
+    data: bytes, *, max_word_bytes: int = DEFAULT_MAX_WORD_BYTES
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Line structure of a wordlist buffer: (buffer, offsets, lengths).
+
+    Matches ``ops.packing.read_wordlist`` semantics exactly (ScanLines +
+    anti-Q8 error). Raises ValueError on an oversized line."""
+    lib = load()
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if lib is None:
+        # numpy fallback mirroring the native pass
+        from ..ops.packing import read_wordlist_lines
+
+        return read_wordlist_lines(data, max_word_bytes=max_word_bytes)
+    n = np.int64(len(data))
+    count = lib.a5_count_lines(_u8(buf), n) if len(data) else 0
+    offsets = np.zeros(max(1, count), dtype=np.int64)
+    lengths = np.zeros(max(1, count), dtype=np.int32)
+    bad = np.zeros(1, dtype=np.int64)
+    rc = lib.a5_scan_lines(
+        _u8(buf), n, np.int64(max_word_bytes), _i64(offsets), _i32(lengths),
+        _i64(bad),
+    )
+    if rc == -2:
+        raise ValueError(
+            f"line {int(bad[0])} exceeds {max_word_bytes} bytes (Q8)"
+        )
+    return buf, offsets[:count], lengths[:count]
+
+
+def pack_rows(
+    buf: np.ndarray,
+    offsets: np.ndarray,
+    lengths: np.ndarray,
+    sel: Optional[np.ndarray],
+    width: int,
+    *,
+    index: Optional[np.ndarray] = None,
+) -> PackedWords:
+    """Pack selected rows into a PackedWords batch of ``width``."""
+    lib = load()
+    m = len(sel) if sel is not None else len(offsets)
+    tokens = np.zeros((m, width), dtype=np.uint8)
+    out_len = np.zeros(m, dtype=np.int32)
+    if index is None:
+        index = (
+            sel.astype(np.int64) if sel is not None
+            else np.arange(m, dtype=np.int64)
+        )
+    if lib is None:
+        rows = sel if sel is not None else np.arange(m)
+        for i, r in enumerate(rows):
+            ln = int(lengths[r])
+            tokens[i, :ln] = buf[offsets[r] : offsets[r] + ln]
+            out_len[i] = ln
+        return PackedWords(tokens=tokens, lengths=out_len, index=index)
+    sel64 = None if sel is None else np.ascontiguousarray(sel, dtype=np.int64)
+    rc = lib.a5_pack(
+        _u8(buf), _i64(offsets), _i32(lengths),
+        _i64(sel64) if sel64 is not None else None,
+        np.int64(m), np.int32(width), _u8(tokens), _i32(out_len),
+    )
+    if rc != 0:
+        raise ValueError(f"a5_pack failed with {rc} (row longer than width?)")
+    return PackedWords(tokens=tokens, lengths=out_len, index=index)
+
+
+def read_packed(
+    path: str,
+    *,
+    width: Optional[int] = None,
+    max_word_bytes: int = DEFAULT_MAX_WORD_BYTES,
+) -> PackedWords:
+    """File → one PackedWords batch (the native fast path for the sweep
+    runtime; equivalent to ``pack_words(read_wordlist(path))``)."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    buf, offsets, lengths = scan_wordlist_bytes(
+        data, max_word_bytes=max_word_bytes
+    )
+    if width is None:
+        width = aligned_width(int(lengths.max()) if len(lengths) else 0)
+    return pack_rows(buf, offsets, lengths, None, width)

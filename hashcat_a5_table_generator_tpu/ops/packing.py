@@ -60,6 +60,13 @@ class PackedWords:
         return [self.word(i) for i in range(self.batch)]
 
 
+def aligned_width(longest: int) -> int:
+    """The packing width for a longest-word length: smallest multiple of 4
+    covering it (uint32 lane alignment for the hash kernels), minimum 4.
+    Single source of truth for Python and native packers."""
+    return max(4, -(-longest // 4) * 4)
+
+
 def pack_words(
     words: Sequence[bytes],
     *,
@@ -68,13 +75,10 @@ def pack_words(
 ) -> PackedWords:
     """Pack ``words`` into one padded batch of a single width.
 
-    ``width`` defaults to the smallest multiple of 4 covering the longest word
-    (keeping uint32 lane alignment for the hash kernels); zero-length batches
-    get width 4.
+    ``width`` defaults to :func:`aligned_width` of the longest word.
     """
     if width is None:
-        longest = max((len(w) for w in words), default=0)
-        width = max(4, -(-longest // 4) * 4)
+        width = aligned_width(max((len(w) for w in words), default=0))
     tokens = np.zeros((len(words), width), dtype=np.uint8)
     lengths = np.zeros((len(words),), dtype=np.int32)
     for i, w in enumerate(words):
@@ -123,6 +127,34 @@ def bucket_words(
             index=np.asarray([start_index + i for i in idxs], dtype=np.int64),
         )
     return out
+
+
+def read_wordlist_lines(
+    data: bytes,
+    *,
+    max_word_bytes: int = DEFAULT_MAX_WORD_BYTES,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Line structure of a wordlist buffer: (buffer, offsets, lengths),
+    ScanLines semantics (see :func:`read_wordlist`). This is the numpy
+    reference for the native scanner (``native.scan_wordlist_bytes``)."""
+    buf = np.frombuffer(data, dtype=np.uint8)
+    if len(data) == 0:
+        empty64 = np.zeros(0, dtype=np.int64)
+        return buf, empty64, np.zeros(0, dtype=np.int32)
+    nl = np.nonzero(buf == 0x0A)[0]
+    starts = np.concatenate([[0], nl + 1])
+    ends = np.concatenate([nl, [len(data)]])
+    if starts[-1] >= len(data) and data.endswith(b"\n"):
+        starts, ends = starts[:-1], ends[:-1]
+    lengths = ends - starts
+    # Drop one trailing '\r' per line.
+    has_cr = lengths > 0
+    cr_pos = np.where(has_cr, starts + lengths - 1, 0)
+    lengths = lengths - (has_cr & (buf[cr_pos] == 0x0D))
+    if len(lengths) and int(lengths.max()) > max_word_bytes:
+        bad = int(np.argmax(lengths > max_word_bytes))
+        raise ValueError(f"line {bad} exceeds {max_word_bytes} bytes (Q8)")
+    return buf, starts.astype(np.int64), lengths.astype(np.int32)
 
 
 def read_wordlist(

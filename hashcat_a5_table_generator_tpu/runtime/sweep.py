@@ -55,7 +55,7 @@ from ..models.attack import (
 from ..oracle.engines import iter_candidates
 from ..ops.blocks import BlockBatch, make_blocks
 from ..ops.membership import build_digest_set
-from ..ops.packing import pack_words
+from ..ops.packing import PackedWords, pack_words
 from ..tables.compile import compile_table
 from ..utils.digests import HOST_DIGEST
 from .checkpoint import (
@@ -92,6 +92,21 @@ class SweepResult:
     wall_s: float = 0.0
 
 
+class _PackedView:
+    """Lazy ``Sequence[bytes]`` view over a PackedWords batch, so
+    fingerprinting never materializes a word list."""
+
+    def __init__(self, packed: PackedWords) -> None:
+        self._p = packed
+
+    def __len__(self) -> int:
+        return self._p.batch
+
+    def __iter__(self):
+        for i in range(self._p.batch):
+            yield self._p.word(i)
+
+
 class Sweep:
     """One wordlist × one merged table × one attack spec."""
 
@@ -99,17 +114,22 @@ class Sweep:
         self,
         spec: AttackSpec,
         sub_map: Dict[bytes, List[bytes]],
-        words: Sequence[bytes],
+        words: "Sequence[bytes] | PackedWords",
         digests: Sequence[bytes] = (),
         config: Optional[SweepConfig] = None,
     ) -> None:
         self.spec = spec
         self.sub_map = sub_map
-        self.words = list(words)
         self.digests = list(digests)
         self.config = config or SweepConfig()
         self.ct = compile_table(sub_map)
-        self.packed = pack_words(self.words)
+        # A pre-packed batch (e.g. the native scanner's read_packed) is
+        # accepted directly — the rockyou-scale path never materializes a
+        # Python list of words.
+        self.packed = (
+            words if isinstance(words, PackedWords) else pack_words(list(words))
+        )
+        self.n_words = self.packed.batch
         self.plan = build_plan(spec, self.ct, self.packed)
         self.fingerprint = sweep_fingerprint(
             spec.mode,
@@ -117,7 +137,7 @@ class Sweep:
             spec.min_substitute,
             spec.max_substitute,
             sub_map,
-            self.words,
+            _PackedView(self.packed),
             self.digests,
         )
         self._host_digest = HOST_DIGEST[spec.algo]
@@ -317,13 +337,13 @@ class Sweep:
                     hits=state.n_hits,
                 )
         # Tail: any fallback words at/after the last device word.
-        self._flush_fallback_until(len(self.words), state, fallback_candidate)
-        state.cursor = SweepCursor(word=len(self.words), rank=0)
+        self._flush_fallback_until(self.n_words, state, fallback_candidate)
+        state.cursor = SweepCursor(word=self.n_words, rank=0)
         state.wall_s += time.monotonic() - t0
         self._maybe_checkpoint(state, last_ckpt, force=True)
         if cfg.progress:
             cfg.progress.final(
-                words_done=len(self.words),
+                words_done=self.n_words,
                 emitted=state.n_emitted,
                 hits=state.n_hits,
             )
@@ -331,7 +351,7 @@ class Sweep:
             n_emitted=state.n_emitted,
             n_hits=state.n_hits,
             hits=recorder.hits,
-            words_done=len(self.words),
+            words_done=self.n_words,
             resumed=resumed,
             wall_s=state.wall_s,
         )
@@ -384,7 +404,7 @@ class Sweep:
                 next_fb = (
                     self.fallback_rows[state.fallback_done]
                     if state.fallback_done < len(self.fallback_rows)
-                    else len(self.words)
+                    else self.n_words
                 )
                 while b1 < nb and int(batch.word[b1]) <= next_fb:
                     b1 += 1
@@ -401,20 +421,20 @@ class Sweep:
                     emitted=state.n_emitted,
                     hits=0,
                 )
-        self._flush_fallback_until(len(self.words), state, fallback_candidate)
-        state.cursor = SweepCursor(word=len(self.words), rank=0)
+        self._flush_fallback_until(self.n_words, state, fallback_candidate)
+        state.cursor = SweepCursor(word=self.n_words, rank=0)
         state.wall_s += time.monotonic() - t0
         self._maybe_checkpoint(state, last_ckpt, force=True,
                                before_save=writer.flush)
         if cfg.progress:
             cfg.progress.final(
-                words_done=len(self.words), emitted=state.n_emitted, hits=0
+                words_done=self.n_words, emitted=state.n_emitted, hits=0
             )
         return SweepResult(
             n_emitted=state.n_emitted,
             n_hits=0,
             hits=[],
-            words_done=len(self.words),
+            words_done=self.n_words,
             resumed=resumed,
             wall_s=state.wall_s,
         )

@@ -1,0 +1,118 @@
+"""Native C++ scanner/packer vs the numpy reference: bit-identical outputs
+on every line-structure edge, the anti-Q8 error, and the fallback path."""
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu import native
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    pack_words,
+    read_wordlist,
+    read_wordlist_lines,
+)
+
+CASES = [
+    b"",
+    b"\n",
+    b"abc\n",
+    b"abc",  # unterminated tail
+    b"abc\r\n",  # CRLF
+    b"abc\rx\n",  # interior CR preserved
+    b"one\ntwo\nthree\n",
+    b"\n\nmid\n\n",  # empty lines
+    b"word\r\nmixed\nendings\r\n",
+    bytes(range(1, 10)) + b"\n" + b"\xf0\x9f\x94\x91\n",  # binary + UTF-8
+    b"a" * 100 + b"\n" + b"b\n",
+]
+
+
+def _native_or_skip():
+    if not native.available():
+        pytest.skip("native toolchain unavailable")
+
+
+class TestScanParity:
+    @pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+    def test_scan_matches_numpy(self, data):
+        _native_or_skip()
+        buf_n, off_n, len_n = native.scan_wordlist_bytes(data)
+        buf_p, off_p, len_p = read_wordlist_lines(data)
+        np.testing.assert_array_equal(off_n, off_p)
+        np.testing.assert_array_equal(len_n, len_p)
+
+    @pytest.mark.parametrize("data", CASES, ids=range(len(CASES)))
+    def test_scan_matches_read_wordlist(self, data, tmp_path):
+        # The line-structure view must reconstruct exactly the word list
+        # the list-of-bytes reader produces.
+        p = tmp_path / "w.txt"
+        p.write_bytes(data)
+        words = read_wordlist(str(p))
+        buf, off, lens = read_wordlist_lines(data)
+        got = [bytes(buf[o : o + l]) for o, l in zip(off, lens)]
+        assert got == words
+
+    def test_oversized_line_raises_both_paths(self):
+        data = b"x" * 64 + b"\nok\n"
+        with pytest.raises(ValueError, match="Q8"):
+            read_wordlist_lines(data, max_word_bytes=10)
+        _native_or_skip()
+        with pytest.raises(ValueError, match="Q8"):
+            native.scan_wordlist_bytes(data, max_word_bytes=10)
+
+
+class TestPackParity:
+    def test_read_packed_matches_pack_words(self, tmp_path):
+        _native_or_skip()
+        words = [b"password", b"", b"x" * 31, b"\xd0\xb9ob", b"tail"]
+        p = tmp_path / "w.txt"
+        p.write_bytes(b"\n".join(words) + b"\n")
+        got = native.read_packed(str(p))
+        want = pack_words(words)
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        np.testing.assert_array_equal(got.lengths, want.lengths)
+        np.testing.assert_array_equal(got.index, want.index)
+
+    def test_selection_pack(self, tmp_path):
+        _native_or_skip()
+        data = b"aa\nbbbb\ncc\ndddddd\n"
+        buf, off, lens = native.scan_wordlist_bytes(data)
+        sel = np.asarray([1, 3], dtype=np.int64)
+        got = native.pack_rows(buf, off, lens, sel, 8)
+        want = pack_words([b"bbbb", b"dddddd"], width=8)
+        np.testing.assert_array_equal(got.tokens, want.tokens)
+        np.testing.assert_array_equal(got.lengths, want.lengths)
+        np.testing.assert_array_equal(got.index, sel)
+
+    def test_width_overflow_errors(self):
+        _native_or_skip()
+        buf, off, lens = native.scan_wordlist_bytes(b"toolong\n")
+        with pytest.raises(ValueError):
+            native.pack_rows(buf, off, lens, None, 4)
+
+
+class TestFallback:
+    def test_forced_fallback_matches(self, tmp_path, monkeypatch):
+        # A5_NATIVE=0 must produce identical results through the same API.
+        p = tmp_path / "w.txt"
+        p.write_bytes(b"alpha\nbeta\r\ngamma")
+        import importlib
+
+        import hashcat_a5_table_generator_tpu.native as nat
+
+        monkeypatch.setenv("A5_NATIVE", "0")
+        importlib.reload(nat)
+        try:
+            got = nat.read_packed(str(p))
+            want = pack_words([b"alpha", b"beta", b"gamma"])
+            np.testing.assert_array_equal(got.tokens, want.tokens)
+            np.testing.assert_array_equal(got.lengths, want.lengths)
+            assert nat.available() is False
+        finally:
+            monkeypatch.delenv("A5_NATIVE")
+            importlib.reload(nat)
+
+
+def test_native_builds_here():
+    # This environment ships g++ (per the build brief); the native path must
+    # actually engage in CI here, not silently fall back.
+    assert native.available()
