@@ -9,6 +9,22 @@ per chip, so ``vs_baseline`` is value / 1e10.
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "hashes/sec", "vs_baseline": N}
 
+Two-level structure (the accelerator backend in this environment — the axon
+TPU tunnel — can wedge *forever* inside backend init, and a wedged init
+thread cannot be killed in-process):
+
+- **Orchestrator** (default entry): runs the measurement as a *subprocess*
+  per platform attempt — default resolution (the axon tunnel), then the
+  explicit ``tpu`` plugin, then a CPU fallback sized for host execution —
+  each under a hard kill-timeout, all under one total wall-clock budget.
+  Emits exactly one JSON line: the first successful attempt's record,
+  augmented with the platform used and the stderr tails of failed attempts
+  (so a wedge is diagnosable, not a bare timeout).  Exits 2 if every
+  attempt failed (the error record is still printed).
+- **Worker** (``--worker``): the actual timed loop.  Probes device init on a
+  daemon thread with its own timeout and aborts with rc=2 if init never
+  completes (``os._exit`` — the wedged thread holds backend locks).
+
 Steady-state methodology: pre-cut real variant blocks for the sweep's head,
 warm up (compile), then cycle the pre-cut batches for a fixed wall-clock
 window, counting device-reported emitted candidates (each emitted candidate
@@ -21,11 +37,30 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+NORTH_STAR = 1e10  # hashes/sec/chip target, BASELINE.json / BASELINE.md
+
+
+def metric_name(algo: str) -> str:
+    return f"{algo}_candidate_hashes_per_sec_per_chip"
+
+
+def error_record(algo: str, error: str, **extra) -> dict:
+    rec = {
+        "metric": metric_name(algo),
+        "value": 0.0,
+        "unit": "hashes/sec",
+        "vs_baseline": 0.0,
+        "error": error,
+    }
+    rec.update(extra)
+    return rec
 
 
 def synth_wordlist(n: int, seed: int = 0):
@@ -45,7 +80,7 @@ def synth_wordlist(n: int, seed: int = 0):
     return words
 
 
-def main() -> None:
+def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--lanes", type=int, default=1 << 19,
                     help="variant lanes per launch")
@@ -59,22 +94,29 @@ def main() -> None:
                     help="distinct pre-cut batches to cycle")
     ap.add_argument("--algo", default="md5", help="hash algorithm")
     ap.add_argument("--mode", default="default", help="attack mode")
-    ap.add_argument("--init-timeout", type=float, default=180.0,
-                    help="seconds to wait for accelerator init before "
-                         "aborting with an error record (exit 2)")
+    ap.add_argument("--init-timeout", type=float, default=150.0,
+                    help="seconds the worker waits for accelerator init")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) before init")
-    args = ap.parse_args()
+    ap.add_argument("--profile-dir", default=None,
+                    help="write a jax.profiler trace of the timed window here")
+    ap.add_argument("--worker", action="store_true",
+                    help="run the measurement in this process (internal)")
+    return ap
 
+
+# ----------------------------------------------------------------- worker --
+
+
+def run_worker(args: argparse.Namespace) -> None:
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
-    # The axon TPU tunnel can wedge (backend init blocks forever in
-    # make_c_api_client). Probe device init on a daemon thread; if it does
-    # not come up in time, abort with an error record — the hung init holds
-    # backend locks, so an in-process CPU retry would deadlock.
+    # Probe device init on a daemon thread; if it does not come up in time,
+    # abort — the hung init holds backend locks, so an in-process retry on
+    # another platform would deadlock.  The orchestrator handles retries.
     import threading
 
     init_ok = threading.Event()
@@ -89,21 +131,18 @@ def main() -> None:
     probe = threading.Thread(target=_probe, daemon=True)
     probe.start()
     probe.join(args.init_timeout)
-    metric = f"{args.algo}_candidate_hashes_per_sec_per_chip"
     if not init_ok.is_set():
         print(
-            f"# accelerator init did not complete in {args.init_timeout}s; "
-            "this process cannot recover the wedged backend — exiting",
+            f"# accelerator init did not complete in {args.init_timeout}s",
             file=sys.stderr,
         )
-        print(json.dumps({
-            "metric": metric,
-            "value": 0.0,
-            "unit": "hashes/sec",
-            "vs_baseline": 0.0,
-            "error": "accelerator init timeout",
-        }))
-        sys.stdout.flush()
+        if not args.worker:
+            # Direct (--platform) invocation: no orchestrator above us to
+            # emit the record, so keep the one-JSON-line contract here.
+            print(json.dumps(
+                error_record(args.algo, "accelerator init timeout")
+            ))
+            sys.stdout.flush()
         sys.stderr.flush()
         os._exit(2)
 
@@ -121,11 +160,10 @@ def main() -> None:
     from hashcat_a5_table_generator_tpu.ops.packing import pack_words
     from hashcat_a5_table_generator_tpu.tables.compile import compile_table
     from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
-
-    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
     spec = AttackSpec(mode=args.mode, algo=args.algo)
     sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
@@ -167,29 +205,210 @@ def main() -> None:
         per_batch.append(int(out["n_emitted"]))
     print(f"# warmup (incl. compile): {time.perf_counter()-t0:.1f}s",
           file=sys.stderr)
+
+    from contextlib import nullcontext
+
+    trace_ctx = nullcontext()
+    if args.profile_dir:
+        import jax.profiler
+
+        trace_ctx = jax.profiler.trace(args.profile_dir)
+
     hashed = 0
     launches = 0
-    start = time.perf_counter()
-    deadline = start + args.seconds
-    out = None
-    while time.perf_counter() < deadline:
-        b = batches[launches % len(batches)]
-        out = step(p, t, b, d)
-        hashed += per_batch[launches % len(batches)]
-        launches += 1
-    jax.block_until_ready(out)
-    elapsed = time.perf_counter() - start
+    with trace_ctx:
+        start = time.perf_counter()
+        deadline = start + args.seconds
+        out = None
+        while time.perf_counter() < deadline:
+            b = batches[launches % len(batches)]
+            out = step(p, t, b, d)
+            hashed += per_batch[launches % len(batches)]
+            launches += 1
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - start
 
     value = hashed / elapsed
-    baseline = 1e10  # north-star target, BASELINE.json / BASELINE.md
     print(f"# {launches} launches, {hashed:.3e} hashes, {elapsed:.2f}s",
           file=sys.stderr)
     print(json.dumps({
-        "metric": metric,
+        "metric": metric_name(args.algo),
         "value": value,
         "unit": "hashes/sec",
-        "vs_baseline": value / baseline,
+        "vs_baseline": value / NORTH_STAR,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": args.lanes,
+        "blocks": args.blocks,
+        "launches": launches,
     }))
+    sys.stdout.flush()
+
+
+# ----------------------------------------------------------- orchestrator --
+
+
+def _attempt(argv: list[str], env: dict, init_grace: float, run_grace: float,
+             max_total: float):
+    """Run one worker subprocess under a dynamic deadline.
+
+    The worker prints ``# device:`` to stderr once backend init succeeds;
+    until then the deadline is ``init_grace`` (a wedged init is killed
+    fast), after which it extends by ``run_grace`` (compile + timed window
+    deserve their time) — capped at ``max_total`` from attempt start, the
+    attempt's share of the orchestrator's overall budget.
+    Returns (record|None, stderr_tail, rc).
+    """
+    import tempfile
+
+    # The child gets its own file objects; the parent polls via separate
+    # opens of the same paths — a dup'd descriptor would share one file
+    # offset with the child, and seeking it mid-write corrupts the stream.
+    with tempfile.TemporaryDirectory() as td:
+        out_path = os.path.join(td, "out")
+        err_path = os.path.join(td, "err")
+        with open(out_path, "w") as out_f, open(err_path, "w") as err_f:
+            proc = subprocess.Popen(argv, env=env, stdout=out_f, stderr=err_f)
+            t0 = time.monotonic()
+            deadline = t0 + init_grace
+            extended = False
+            killed = ""
+            rc = None
+            while True:
+                rc = proc.poll()
+                if rc is not None:
+                    break
+                if not extended:
+                    with open(err_path) as f:
+                        if "# device:" in f.read():
+                            deadline = min(
+                                time.monotonic() + run_grace,
+                                t0 + max_total,
+                            )
+                            extended = True
+                if time.monotonic() > deadline:
+                    proc.kill()
+                    proc.wait()
+                    rc = -9
+                    killed = (
+                        f"\n# orchestrator: hard kill after "
+                        f"{time.monotonic() - t0:.0f}s "
+                        f"({'run' if extended else 'init'} deadline)"
+                    )
+                    break
+                time.sleep(1.0)
+        with open(out_path) as f:
+            stdout = f.read()
+        with open(err_path) as f:
+            stderr = f.read() + killed
+    tail = stderr[-2000:]
+    if tail:
+        print(tail, file=sys.stderr)
+    record = None
+    if rc == 0:
+        for line in reversed(stdout.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except (ValueError, TypeError):
+                continue
+            if isinstance(cand, dict) and "value" in cand:
+                record = cand
+                break
+    return record, tail, rc
+
+
+def run_orchestrator(args: argparse.Namespace) -> None:
+    me = os.path.abspath(__file__)
+
+    def worker_args(init_timeout: float, platform: str | None = None,
+                    **overrides):
+        vals = {
+            "lanes": args.lanes, "blocks": args.blocks, "words": args.words,
+            "seconds": args.seconds, "batches": args.batches,
+        }
+        vals.update(overrides)
+        out = [
+            "--lanes", str(vals["lanes"]), "--blocks", str(vals["blocks"]),
+            "--words", str(vals["words"]),
+            "--seconds", str(vals["seconds"]),
+            "--batches", str(vals["batches"]), "--algo", args.algo,
+            "--mode", args.mode, "--init-timeout", str(init_timeout),
+        ]
+        if platform:
+            out += ["--platform", platform]
+        if args.profile_dir:
+            out += ["--profile-dir", args.profile_dir]
+        return out
+
+    # CPU fallback gets host-sized shapes: the full accelerator geometry
+    # (2^19 lanes × 4096 blocks) takes minutes per launch on a host core.
+    cpu_args = worker_args(
+        60, platform="cpu",
+        lanes=min(args.lanes, 1 << 15),
+        blocks=min(args.blocks, 512),
+        words=min(args.words, 4000),
+        seconds=min(args.seconds, 8.0),
+        batches=min(args.batches, 4),
+    )
+
+    # Budget: the whole orchestration must land a number well inside the
+    # driver's patience (~10 min).  Per attempt, init_grace is the time the
+    # backend gets to come up; only once init *succeeds* (the worker prints
+    # '# device:') does the deadline extend for compile + the timed window.
+    # One shared wall-clock budget bounds the sum of attempts, always
+    # reserving enough tail for the CPU fallback to complete.
+    run_grace = 240.0 + args.seconds  # first TPU compile can take minutes
+    cpu_need = 90 + 60 + 30  # cpu init grace + compile/run + slack
+    total_deadline = time.monotonic() + 540.0
+    attempts = [
+        # Default platform resolution (the axon TPU tunnel, when present).
+        ("accelerator", worker_args(args.init_timeout),
+         args.init_timeout + 30, True),
+        # Explicit tpu plugin: if axon is wedged but a local libtpu chip
+        # exists this comes up fast; if neither exists it errors fast.
+        ("tpu", worker_args(45, platform="tpu"), 45 + 30, True),
+        ("cpu-fallback", cpu_args, 90, False),
+    ]
+
+    failures = []
+    for name, extra, init_grace, reserve_cpu in attempts:
+        remaining = total_deadline - time.monotonic()
+        spendable = remaining - (cpu_need if reserve_cpu else 0)
+        if spendable < init_grace:
+            failures.append({
+                "attempt": name, "rc": None,
+                "stderr_tail": "# orchestrator: skipped (budget exhausted)",
+            })
+            continue
+        env = dict(os.environ)
+        argv = [sys.executable, me, "--worker"] + extra
+        print(f"# attempt[{name}]: {' '.join(argv[2:])}", file=sys.stderr)
+        record, tail, rc = _attempt(
+            argv, env, init_grace, run_grace, max_total=spendable
+        )
+        if record is not None:
+            record["attempt"] = name
+            if failures:
+                record["failed_attempts"] = failures
+            print(json.dumps(record))
+            return
+        failures.append({"attempt": name, "rc": rc, "stderr_tail": tail})
+
+    print(json.dumps(error_record(
+        args.algo, "all platform attempts failed", failed_attempts=failures,
+    )))
+    sys.exit(2)
+
+
+def main() -> None:
+    args = build_parser().parse_args()
+    if args.worker or args.platform:
+        # --worker: orchestrator subprocess.  --platform: the user pinned a
+        # backend — run in-process at the requested geometry with no kill
+        # deadline (the init-timeout abort still guards a wedged init).
+        run_worker(args)
+    else:
+        run_orchestrator(args)
 
 
 if __name__ == "__main__":

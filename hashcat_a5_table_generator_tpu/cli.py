@@ -93,9 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--progress", action="store_true",
                     help="periodic JSON progress lines on stderr")
     ap.add_argument("--lanes", type=int, default=1 << 17,
-                    help="device variant lanes per launch")
+                    help="variant lanes per device per launch")
     ap.add_argument("--blocks", type=int, default=1024,
                     help="device block slots per launch")
+    ap.add_argument("--devices", type=_devices_arg, default=1, metavar="N",
+                    help="shard the sweep over N local devices via a 1-D "
+                         "mesh ('auto' = all local devices; default 1)")
     ap.add_argument("--hex-unsafe", action="store_true",
                     help="wrap line-corrupting candidates in $HEX[...]")
     ap.add_argument("--bug-compat", action="store_true",
@@ -113,6 +116,21 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--list-layouts", action="store_true",
                     help="list built-in and derived layouts and exit")
     return ap
+
+
+def _devices_arg(value: str):
+    """--devices: positive int, or 'auto' (None) = all local devices."""
+    if value == "auto":
+        return None
+    try:
+        n = int(value)
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"must be a positive integer or 'auto', got {value!r}"
+        )
+    return n
 
 
 def _mode(args) -> str:
@@ -223,6 +241,7 @@ def _run_device(args, sub_map, packed) -> int:
     cfg = SweepConfig(
         lanes=args.lanes,
         num_blocks=args.blocks,
+        devices=args.devices,
         checkpoint_path=args.checkpoint,
         checkpoint_every_s=args.checkpoint_every,
         progress=progress,
@@ -264,6 +283,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             (args.checkpoint, "--checkpoint"),
             (args.no_resume, "--no-resume"),
             (args.progress, "--progress"),
+            (args.devices != 1, "--devices"),
         ):
             if flag:
                 print(

@@ -196,18 +196,29 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
     return jax.jit(step)
 
 
+def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int):
+    """The un-jitted expand-only body, shared by the single-device
+    candidates step and the shard_map'd candidates step.
+
+    ``body(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
+    """
+
+    def body(plan, table, blocks):
+        return _expand(
+            spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
+        )
+
+    return body
+
+
 def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
     """Build the expand-only step for the stdout-candidates sink.
 
     Returns ``step(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
     """
-
-    def step(plan, table, blocks):
-        return _expand(
-            spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
-        )
-
-    return jax.jit(step)
+    return jax.jit(
+        make_candidates_body(spec, num_lanes=num_lanes, out_width=out_width)
+    )
 
 
 # ---------------------------------------------------------------------------

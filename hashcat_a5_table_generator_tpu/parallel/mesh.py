@@ -31,7 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.attack import AttackSpec, make_fused_body
+from ..models.attack import AttackSpec, make_candidates_body, make_fused_body
 from ..ops.blocks import BlockBatch, make_blocks, pad_batch
 
 
@@ -54,36 +54,47 @@ def make_device_blocks(
     lanes_per_device: int,
     start_word: int = 0,
     start_rank: int = 0,
+    max_blocks: int | None = None,
 ) -> Tuple[List[BlockBatch], int, int]:
     """Cut one launch's work: ``n_devices`` equal-budget block batches.
 
     Returns (batches, next_word, next_rank) — the cursor after the LAST
     device's range, so consecutive launches sweep the space contiguously.
     Devices later in the list may receive empty batches near the end of the
-    sweep; those lanes are masked out by ``emit``.
+    sweep; those lanes are masked out by ``emit``. ``max_blocks`` caps each
+    device's block count (pair with ``stack_blocks(..., num_blocks=...)`` for
+    launch-to-launch jit shape stability).
     """
     batches = []
     w, rank = start_word, start_rank
     for _ in range(n_devices):
         batch, w, rank = make_blocks(
-            plan, start_word=w, start_rank=rank, max_variants=lanes_per_device
+            plan,
+            start_word=w,
+            start_rank=rank,
+            max_variants=lanes_per_device,
+            max_blocks=max_blocks,
         )
         batches.append(batch)
     return batches, w, rank
 
 
-def stack_blocks(batches: List[BlockBatch]) -> Dict[str, np.ndarray]:
+def stack_blocks(
+    batches: List[BlockBatch], *, num_blocks: int | None = None
+) -> Dict[str, np.ndarray]:
     """Stack per-device block batches into shard_map-ready arrays.
 
     Batches are padded to a common block count with zero-count blocks whose
     ``offset`` continues past the end — their lanes fail ``rank < count`` and
     are masked. Returns arrays with leading axis ``n_devices * nb``.
     ``batches`` must be non-empty (one entry per mesh device).
+    ``num_blocks`` forces the per-device block count (static jit shapes
+    across launches); by default the largest batch sets it.
     """
     if not batches:
         raise ValueError("batches must have one entry per mesh device")
     n_slots = max(b.base_digits.shape[1] for b in batches)
-    nb = max(1, max(len(b.count) for b in batches))
+    nb = num_blocks or max(1, max(len(b.count) for b in batches))
     padded = []
     for b in batches:
         b = BlockBatch(
@@ -143,6 +154,40 @@ def make_sharded_crack_step(
             "n_emitted": rep,
             "n_hits": rep,
         },
+    )
+    return jax.jit(mapped)
+
+
+def make_sharded_candidates_step(
+    spec: AttackSpec,
+    mesh: Mesh,
+    *,
+    lanes_per_device: int,
+    out_width: int,
+    axis_name: str = "data",
+):
+    """The expand-only step, shard_map'd over a 1-D mesh.
+
+    For the reference-compatible stdout surface at mesh scale: each device
+    expands its own block shard; the host fetches the (sharded) candidate
+    buffer and streams it in device order — device d's lanes occupy rows
+    ``[d * lanes_per_device, (d+1) * lanes_per_device)``, which is cursor
+    order because :func:`make_device_blocks` cuts device ranges contiguously.
+
+    Returns ``step(plan, table, blocks) -> (cand, cand_len, word_row, emit)``
+    with every output sharded on its leading axis.
+    """
+    local_step = make_candidates_body(
+        spec, num_lanes=lanes_per_device, out_width=out_width
+    )
+
+    rep = P()
+    shard = P(axis_name)
+    mapped = jax.shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(rep, rep, shard),
+        out_specs=(shard, shard, shard, shard),
     )
     return jax.jit(mapped)
 

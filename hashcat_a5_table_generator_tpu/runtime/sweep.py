@@ -72,11 +72,14 @@ from .sinks import CandidateWriter, HitRecord, HitRecorder
 @dataclass
 class SweepConfig:
     """Launch geometry + runtime knobs (none of these affect WHAT is
-    emitted — the checkpoint fingerprint deliberately excludes them)."""
+    emitted — the checkpoint fingerprint deliberately excludes them, so a
+    checkpoint taken at one geometry/device count resumes at any other)."""
 
-    lanes: int = 1 << 17  # variant lanes per device launch
-    num_blocks: int = 1024  # static block count (jit shape stability)
+    lanes: int = 1 << 17  # variant lanes per device per launch
+    num_blocks: int = 1024  # static per-device block count (jit stability)
     max_in_flight: int = 2  # double-buffered launches
+    devices: Optional[int] = 1  # 1 = single-device; N = shard over first N
+    #                             local devices; None = all local devices
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
@@ -169,27 +172,122 @@ class Sweep:
                 return state, True
         return CheckpointState(fingerprint=self.fingerprint), False
 
+    def _resolve_devices(self) -> int:
+        """Device count for this run: config.devices, or all local devices
+        when None (the mesh constructor validates availability)."""
+        n = self.config.devices
+        if n is None:
+            import jax
+
+            n = len(jax.devices())
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"SweepConfig.devices must be >= 1, got {n}")
+        return n
+
+    def _make_launch(self, kind: str):
+        """Build this run's launch callable: ``kind`` is 'crack' or
+        'candidates'.  Single-device builds the plain jitted step; multi-
+        device builds the shard_map'd step over a 1-D mesh with plan/table
+        (and digests, for crack) replicated.  Returns
+        (launch(blocks) -> out, n_devices, mesh)."""
+        spec, cfg, plan = self.spec, self.config, self.plan
+        n_devices = self._resolve_devices()
+        if n_devices == 1:
+            p, t = plan_arrays(plan), table_arrays(self.ct)
+            if kind == "crack":
+                step = make_crack_step(
+                    spec, num_lanes=cfg.lanes, out_width=plan.out_width
+                )
+                darrs = digest_arrays(
+                    build_digest_set(self.digests, spec.algo)
+                )
+                return (lambda blocks: step(p, t, blocks, darrs)), 1, None
+            step = make_candidates_step(
+                spec, num_lanes=cfg.lanes, out_width=plan.out_width
+            )
+            return (lambda blocks: step(p, t, blocks)), 1, None
+
+        from ..parallel.mesh import (
+            make_mesh,
+            make_sharded_candidates_step,
+            make_sharded_crack_step,
+            replicate,
+        )
+
+        mesh = make_mesh(n_devices)
+        if kind == "crack":
+            step = make_sharded_crack_step(
+                spec, mesh, lanes_per_device=cfg.lanes,
+                out_width=plan.out_width,
+            )
+            p, t, darrs = replicate(
+                mesh,
+                (
+                    plan_arrays(plan),
+                    table_arrays(self.ct),
+                    digest_arrays(build_digest_set(self.digests, spec.algo)),
+                ),
+            )
+            return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
+        step = make_sharded_candidates_step(
+            spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width
+        )
+        p, t = replicate(mesh, (plan_arrays(plan), table_arrays(self.ct)))
+        return (lambda blocks: step(p, t, blocks)), n_devices, mesh
+
     def _launches(
-        self, cursor: SweepCursor, step_args: tuple, step
-    ) -> Iterator[Tuple[BlockBatch, object, SweepCursor]]:
-        """Double-buffered launch stream: yields (batch, device out, cursor
-        AFTER this launch). Dispatch runs ``max_in_flight`` ahead of fetch."""
+        self, cursor: SweepCursor, launch: Callable, *, n_devices: int = 1,
+        mesh=None,
+    ) -> Iterator[Tuple[list, object, SweepCursor]]:
+        """Double-buffered launch stream: yields (segments, device out,
+        cursor AFTER this launch); ``segments`` is a cursor-ordered list of
+        ``(batch, lane_lo, lane_hi)`` — one entry per device, slicing the
+        launch's flat lane axis. Dispatch runs ``max_in_flight`` ahead of
+        fetch, so host block-cutting overlaps device execution."""
         cfg = self.config
         pending: deque = deque()
         w, rank = cursor.word, cursor.rank
+        lanes = cfg.lanes
         while True:
-            batch, w2, rank2 = make_blocks(
-                self.plan,
-                start_word=w,
-                start_rank=rank,
-                max_variants=cfg.lanes,
-                max_blocks=cfg.num_blocks,
-            )
-            if batch.total == 0:
-                break
-            blocks = block_arrays(batch, num_blocks=cfg.num_blocks)
-            out = step(*step_args, blocks)
-            pending.append((batch, out, SweepCursor(w2, rank2)))
+            if n_devices == 1:
+                batch, w2, rank2 = make_blocks(
+                    self.plan,
+                    start_word=w,
+                    start_rank=rank,
+                    max_variants=lanes,
+                    max_blocks=cfg.num_blocks,
+                )
+                if batch.total == 0:
+                    break
+                blocks = block_arrays(batch, num_blocks=cfg.num_blocks)
+                segments = [(batch, 0, lanes)]
+            else:
+                from ..parallel.mesh import (
+                    make_device_blocks,
+                    shard_leading,
+                    stack_blocks,
+                )
+
+                batches, w2, rank2 = make_device_blocks(
+                    self.plan,
+                    n_devices=n_devices,
+                    lanes_per_device=lanes,
+                    start_word=w,
+                    start_rank=rank,
+                    max_blocks=cfg.num_blocks,
+                )
+                if sum(b.total for b in batches) == 0:
+                    break
+                blocks = shard_leading(
+                    mesh, stack_blocks(batches, num_blocks=cfg.num_blocks)
+                )
+                segments = [
+                    (batches[d], d * lanes, (d + 1) * lanes)
+                    for d in range(n_devices)
+                ]
+            out = launch(blocks)
+            pending.append((segments, out, SweepCursor(w2, rank2)))
             w, rank = w2, rank2
             if len(pending) >= cfg.max_in_flight:
                 yield pending.popleft()
@@ -248,17 +346,7 @@ class Sweep:
         state, resumed = self._load_state(resume)
         digest_set = set(self.digests)
 
-        step = make_crack_step(
-            spec, num_lanes=cfg.lanes, out_width=plan.out_width
-        )
-        args = (
-            plan_arrays(plan),
-            table_arrays(self.ct),
-        )
-        darrs = digest_arrays(build_digest_set(self.digests, spec.algo))
-
-        def crack_step(p, t, blocks):
-            return step(p, t, blocks, darrs)
+        launch, n_devices, mesh = self._make_launch("crack")
 
         # Replay checkpointed hits into the recorder (resume produces the
         # same final hit list a never-interrupted run would). Fallback-word
@@ -299,32 +387,39 @@ class Sweep:
         t0 = time.monotonic()
         last_ckpt = [t0]
         cursor = state.cursor
-        for batch, out, cursor in self._launches(cursor, args, crack_step):
+        for segments, out, cursor in self._launches(
+            cursor, launch, n_devices=n_devices, mesh=mesh
+        ):
             hit = np.asarray(out["hit"])
-            lanes = np.nonzero(hit)[0]
-            for w_row, rank in lane_cursor(plan, batch, lanes):
-                # Flush oracle words that sit before this hit's word so the
-                # hit list stays word-ordered.
-                self._flush_fallback_until(w_row, state, fallback_candidate)
-                cand = decode_variant(plan, self.ct, spec, w_row, rank)
-                dig = self._host_digest(cand)
-                # Host re-verification: the device flagged this lane; its
-                # digest must really be in the target set.
-                if dig not in digest_set:
-                    raise RuntimeError(
-                        f"device hit failed host re-verification: word "
-                        f"{w_row} rank {rank} candidate {cand!r}"
+            # Segments are cursor-ordered (device d's lane slice precedes
+            # device d+1's), so walking them in order keeps hits word-ordered.
+            for batch, lo, hi in segments:
+                lanes = np.nonzero(hit[lo:hi])[0]
+                for w_row, rank in lane_cursor(plan, batch, lanes):
+                    # Flush oracle words that sit before this hit's word so
+                    # the hit list stays word-ordered.
+                    self._flush_fallback_until(
+                        w_row, state, fallback_candidate
                     )
-                state.n_hits += 1
-                state.hits.append((w_row, rank))
-                recorder.emit(
-                    HitRecord(
-                        word_index=int(self.packed.index[w_row]),
-                        variant_rank=rank,
-                        candidate=cand,
-                        digest_hex=dig.hex(),
+                    cand = decode_variant(plan, self.ct, spec, w_row, rank)
+                    dig = self._host_digest(cand)
+                    # Host re-verification: the device flagged this lane;
+                    # its digest must really be in the target set.
+                    if dig not in digest_set:
+                        raise RuntimeError(
+                            f"device hit failed host re-verification: word "
+                            f"{w_row} rank {rank} candidate {cand!r}"
+                        )
+                    state.n_hits += 1
+                    state.hits.append((w_row, rank))
+                    recorder.emit(
+                        HitRecord(
+                            word_index=int(self.packed.index[w_row]),
+                            variant_rank=rank,
+                            candidate=cand,
+                            digest_hex=dig.hex(),
+                        )
                     )
-                )
             # Fallback words wholly before the cursor are due now.
             self._flush_fallback_until(cursor.word, state, fallback_candidate)
             state.n_emitted += int(out["n_emitted"])
@@ -376,10 +471,7 @@ class Sweep:
         spec, cfg, plan = self.spec, self.config, self.plan
         state, resumed = self._load_state(resume)
 
-        step = make_candidates_step(
-            spec, num_lanes=cfg.lanes, out_width=plan.out_width
-        )
-        args = (plan_arrays(plan), table_arrays(self.ct))
+        launch, n_devices, mesh = self._make_launch("candidates")
 
         def fallback_candidate(row: int, i: int, cand: bytes) -> None:
             writer.emit(cand)
@@ -387,32 +479,40 @@ class Sweep:
         t0 = time.monotonic()
         last_ckpt = [t0]
         cursor = state.cursor
-        for batch, out, cursor in self._launches(cursor, args, step):
+        for segments, out, cursor in self._launches(
+            cursor, launch, n_devices=n_devices, mesh=mesh
+        ):
             cand, clen, _, emit = out
             cand = np.asarray(cand)
             clen = np.asarray(clen).astype(np.int32)
             emit = np.asarray(emit)
-            # Walk blocks in order; fallback words interleave at their word
+            # Segments in cursor order; within each device's lane slice,
+            # walk blocks in order — fallback words interleave at their word
             # position. Within a fallback-free run of blocks, the write is
             # one vectorized ragged flatten (newline planted at clen).
-            nb = len(batch.count)
-            b0 = 0
-            while b0 < nb:
-                w0 = int(batch.word[b0])
-                self._flush_fallback_until(w0, state, fallback_candidate)
-                b1 = b0
-                next_fb = (
-                    self.fallback_rows[state.fallback_done]
-                    if state.fallback_done < len(self.fallback_rows)
-                    else self.n_words
-                )
-                while b1 < nb and int(batch.word[b1]) <= next_fb:
-                    b1 += 1
-                lo = int(batch.offset[b0])
-                hi = int(batch.offset[b1 - 1] + batch.count[b1 - 1])
-                n = self._write_lane_range(writer, cand, clen, emit, lo, hi)
-                state.n_emitted += n
-                b0 = b1
+            for batch, seg_lo, _seg_hi in segments:
+                nb = len(batch.count)
+                b0 = 0
+                while b0 < nb:
+                    w0 = int(batch.word[b0])
+                    self._flush_fallback_until(w0, state, fallback_candidate)
+                    b1 = b0
+                    next_fb = (
+                        self.fallback_rows[state.fallback_done]
+                        if state.fallback_done < len(self.fallback_rows)
+                        else self.n_words
+                    )
+                    while b1 < nb and int(batch.word[b1]) <= next_fb:
+                        b1 += 1
+                    lo = seg_lo + int(batch.offset[b0])
+                    hi = seg_lo + int(
+                        batch.offset[b1 - 1] + batch.count[b1 - 1]
+                    )
+                    n = self._write_lane_range(
+                        writer, cand, clen, emit, lo, hi
+                    )
+                    state.n_emitted += n
+                    b0 = b1
             state.cursor = cursor
             self._maybe_checkpoint(state, last_ckpt, before_save=writer.flush)
             if cfg.progress:

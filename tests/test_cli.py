@@ -174,6 +174,23 @@ class TestDeviceBackend:
         assert lines == [hashlib.md5(plant).hexdigest().encode() + b":" + plant]
         assert b"1 hits" in r.stderr
 
+    def test_devices_sharded_stream_identical(self, workdir):
+        base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                "--backend", "device", "--lanes", "64", "--blocks", "16")
+        single = run_cli(*base, "--devices", "1")
+        multi = run_cli(*base, "--devices", "8")
+        auto = run_cli(*base, "--devices", "auto")
+        assert multi.stdout == single.stdout
+        assert auto.stdout == single.stdout
+        assert single.stdout  # non-empty stream
+
+    def test_devices_rejects_garbage(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t",
+                    str(workdir / "leet.table"), "--backend", "device",
+                    "--devices", "lots", check=False)
+        assert r.returncode != 0
+        assert b"--devices" in r.stderr
+
     def test_progress_lines(self, workdir):
         r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                     "--backend", "device", "--progress",
