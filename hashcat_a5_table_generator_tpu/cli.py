@@ -99,6 +99,17 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--devices", type=_devices_arg, default=1, metavar="N",
                     help="shard the sweep over N local devices via a 1-D "
                          "mesh ('auto' = all local devices; default 1)")
+    ap.add_argument("--buckets", type=_buckets_arg, default=(16, 32, 64),
+                    metavar="W1,W2,...",
+                    help="length-bucket boundaries for the device backend: "
+                         "one compiled program per bucket width, so one "
+                         "long line does not inflate every lane (default "
+                         "16,32,64; 'none' = single global width, strict "
+                         "dictionary-order candidate stream)")
+    ap.add_argument("--profile", metavar="DIR",
+                    help="write a jax.profiler trace of the device sweep to "
+                         "DIR (inspect with TensorBoard / Perfetto); host "
+                         "stages are annotated (block cutting, output fetch)")
     ap.add_argument("--hex-unsafe", action="store_true",
                     help="wrap line-corrupting candidates in $HEX[...]")
     ap.add_argument("--bug-compat", action="store_true",
@@ -116,6 +127,24 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--list-layouts", action="store_true",
                     help="list built-in and derived layouts and exit")
     return ap
+
+
+def _buckets_arg(value: str):
+    """--buckets: comma-separated ascending positive widths, or 'none'."""
+    if value == "none":
+        return None
+    try:
+        widths = tuple(int(v) for v in value.split(","))
+        if not widths or any(w < 4 for w in widths) or any(
+            a >= b for a, b in zip(widths, widths[1:])
+        ):
+            raise ValueError
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"must be ascending widths >= 4 (e.g. 16,32,64) or 'none', "
+            f"got {value!r}"
+        )
+    return widths
 
 
 def _devices_arg(value: str):
@@ -222,9 +251,11 @@ def _run_oracle(args, sub_map, words) -> int:
 
 
 def _run_device(args, sub_map, packed) -> int:
-    """``packed`` is a PackedWords batch (native fast path) — the device
-    backend never materializes a Python word list."""
+    """``packed`` is a PackedWords batch or a ``{width: PackedWords}``
+    bucket dict (native fast path) — the device backend never materializes
+    a Python word list."""
     from .models.attack import AttackSpec
+    from .runtime.bucketed import BucketedSweep
     from .runtime.progress import ProgressReporter
     from .runtime.sinks import CandidateWriter, HitRecorder
     from .runtime.sweep import Sweep, SweepConfig
@@ -235,9 +266,11 @@ def _run_device(args, sub_map, packed) -> int:
         min_substitute=args.table_min,
         max_substitute=args.table_max,
     )
-    progress = (
-        ProgressReporter(packed.batch) if args.progress else None
+    bucketed = isinstance(packed, dict)
+    n_words = (
+        sum(p.batch for p in packed.values()) if bucketed else packed.batch
     )
+    progress = ProgressReporter(n_words) if args.progress else None
     cfg = SweepConfig(
         lanes=args.lanes,
         num_blocks=args.blocks,
@@ -246,17 +279,33 @@ def _run_device(args, sub_map, packed) -> int:
         checkpoint_every_s=args.checkpoint_every,
         progress=progress,
     )
-    if args.digests is not None:
-        digests = _read_digests(args.digests, args.algo)
-        sweep = Sweep(spec, sub_map, packed, digests, config=cfg)
-        recorder = HitRecorder(sys.stdout.buffer)
-        res = sweep.run_crack(recorder, resume=not args.no_resume)
-        print(f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
-              file=sys.stderr)
-        return 0
-    sweep = Sweep(spec, sub_map, packed, config=cfg)
-    with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
-        sweep.run_candidates(writer, resume=not args.no_resume)
+
+    def make_sweep(digests=()):
+        if bucketed:
+            return BucketedSweep(spec, sub_map, packed, digests, config=cfg)
+        return Sweep(spec, sub_map, packed, digests, config=cfg)
+
+    from contextlib import nullcontext
+
+    if args.profile:
+        import jax.profiler
+
+        trace_ctx = jax.profiler.trace(args.profile)
+    else:
+        trace_ctx = nullcontext()
+
+    with trace_ctx:
+        if args.digests is not None:
+            digests = _read_digests(args.digests, args.algo)
+            recorder = HitRecorder(sys.stdout.buffer)
+            res = make_sweep(digests).run_crack(
+                recorder, resume=not args.no_resume
+            )
+            print(f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
+                  file=sys.stderr)
+            return 0
+        with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
+            make_sweep().run_candidates(writer, resume=not args.no_resume)
     return 0
 
 
@@ -278,12 +327,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         ap.error(
             f"--table-min {args.table_min} > --table-max {args.table_max}"
         )
+    if args.backend == "device" and args.bug_compat:
+        # The Q3 reverse-offset bug (main.go:249-257) is reproduced only by
+        # the oracle engines; the device plans emit corrected bytes. Honor
+        # the flag rather than silently diverging.
+        if args.reverse_sub and not args.substitute_all:
+            print(
+                f"{PROG}: warning: --bug-compat requires the oracle "
+                "reverse engine (the device plan emits corrected offsets); "
+                "routing this sweep through --backend oracle",
+                file=sys.stderr,
+            )
+            args.backend = "oracle"
+        else:
+            print(
+                f"{PROG}: warning: --bug-compat only affects reverse mode "
+                "(-r without -s); it has no effect on this sweep",
+                file=sys.stderr,
+            )
     if args.backend == "oracle":
         for flag, name in (
             (args.checkpoint, "--checkpoint"),
             (args.no_resume, "--no-resume"),
             (args.progress, "--progress"),
             (args.devices != 1, "--devices"),
+            (args.profile, "--profile"),
         ):
             if flag:
                 print(
@@ -307,9 +375,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # path (numpy fallback engages transparently when unavailable).
         from . import native
 
-        packed = native.read_packed(
-            args.dict_file, max_word_bytes=args.max_word_bytes
-        )
+        if args.buckets is not None:
+            packed = native.read_packed_buckets(
+                args.dict_file,
+                buckets=args.buckets,
+                max_word_bytes=args.max_word_bytes,
+            )
+        else:
+            packed = native.read_packed(
+                args.dict_file, max_word_bytes=args.max_word_bytes
+            )
         return _run_device(args, sub_map, packed)
     except ValueError as e:
         raise SystemExit(f"{PROG}: {e}")

@@ -211,20 +211,43 @@ def _sha1_block(state: Tuple[jnp.ndarray, ...], m_le: jnp.ndarray) -> Tuple[jnp.
 # ---------------------------------------------------------------------------
 
 
+#: Block counts up to this unroll at trace time (straight-line dataflow for
+#: the common short buckets); wider buckets roll into one lax.scan so trace
+#: and compile cost stay O(1) in message width.
+_UNROLL_BLOCKS = 4
+
+
 def _run_blocks(block_fn, init, words, n_blocks):
     """Run ``block_fn`` over every static block, masking updates for blocks a
-    given message does not use. Unrolled at trace time (bucket widths keep the
-    static block count tiny — width 64 is 2 blocks)."""
+    given message does not use.
+
+    Short layouts (<= ``_UNROLL_BLOCKS`` blocks — width 64 is 2) unroll;
+    longer ones run as ``lax.scan`` over the block axis, which compiles the
+    compression once regardless of width (a 512-byte bucket would otherwise
+    trace 9 copies of the 64-step round structure)."""
     batch = words.shape[0]
     nb = words.shape[1] // 16
     state = tuple(jnp.full((batch,), _U32(x)) for x in init)
-    for blk in range(nb):
-        m = words[:, blk * 16 : (blk + 1) * 16]
-        new_state = block_fn(state, m)
-        active = blk < n_blocks
-        state = tuple(
-            jnp.where(active, ns, s) for ns, s in zip(new_state, state)
-        )
+    if nb <= _UNROLL_BLOCKS:
+        for blk in range(nb):
+            m = words[:, blk * 16 : (blk + 1) * 16]
+            new_state = block_fn(state, m)
+            active = blk < n_blocks
+            state = tuple(
+                jnp.where(active, ns, s) for ns, s in zip(new_state, state)
+            )
+        return jnp.stack(state, axis=-1)
+
+    m_seq = jnp.moveaxis(words.reshape(batch, nb, 16), 1, 0)  # [nb, B, 16]
+
+    def step(carry, m):
+        blk, st = carry
+        new_st = block_fn(st, m)
+        active = blk < n_blocks  # [B]
+        st = tuple(jnp.where(active, ns, s) for ns, s in zip(new_st, st))
+        return (blk + 1, st), None
+
+    (_, state), _ = jax.lax.scan(step, (jnp.int32(0), state), m_seq)
     return jnp.stack(state, axis=-1)
 
 

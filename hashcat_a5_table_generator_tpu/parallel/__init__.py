@@ -1,10 +1,20 @@
-"""L5: mesh construction, shard_map pipelines, collectives."""
+"""L5: mesh construction, shard_map pipelines, collectives, multi-host."""
 
 from .mesh import (  # noqa: F401
     make_device_blocks,
     make_mesh,
+    make_sharded_candidates_step,
     make_sharded_crack_step,
     replicate,
     shard_leading,
     stack_blocks,
+)
+from .multihost import (  # noqa: F401
+    allgather_sum,
+    gather_hits,
+    host_stripe,
+    initialize,
+    run_candidates_multihost,
+    run_crack_multihost,
+    stripe_packed,
 )

@@ -1,0 +1,242 @@
+"""Multi-host runtime: ``jax.distributed`` + per-host wordlist stripes.
+
+The reference's only "communication backend" is in-process Go channels
+(``main.go:58-98``); its scheduler cannot leave one machine.  The TPU-native
+equivalent (SURVEY.md §2.3/§5) is two-level:
+
+* **within a host**: the sharded sweep over the local 1-D device mesh
+  (``parallel.mesh`` via ``SweepConfig.devices``) — candidate traffic and
+  hit reductions ride ICI;
+* **across hosts**: the dictionary is cut into contiguous *stripes*, one per
+  process; each host sweeps only its stripe with its local devices, and only
+  tiny serialized **hit records** cross the host network (DCN) at the end —
+  candidates never do.
+
+This maps the problem's structure onto the hardware: candidate generation is
+embarrassingly parallel over words (the reference itself parallelizes
+per-word, ``main.go:70-94``), so host-level data parallelism with a final
+hit gather is the whole story — no parameter synchronization, no pipeline.
+
+Hit collection uses ``jax.experimental.multihost_utils.process_allgather``
+over the distributed backend: JSON-serialized hit records padded to the
+max per-host payload (hits are rare; the payload is bytes, not candidates).
+Every process returns the same combined result; process 0 is the
+conventional reporter.
+
+Works as an N-process CPU job for CI (see tests/test_multihost.py: two
+processes, one virtual CPU device each, coordinator on localhost).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.packing import PackedWords
+
+__all__ = [
+    "initialize",
+    "host_stripe",
+    "stripe_packed",
+    "gather_hits",
+    "allgather_sum",
+    "run_crack_multihost",
+    "run_candidates_multihost",
+]
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Tuple[int, int]:
+    """Bring up (or join) the JAX distributed runtime.
+
+    Explicit arguments for manual topologies (CI, bare clusters); all-None
+    lets JAX auto-detect cloud TPU pod environments.  Safe to call when the
+    runtime is already up (returns the live topology).  Returns
+    ``(process_id, num_processes)``.
+    """
+    import jax
+
+    if jax.process_count() == 1 and (
+        coordinator_address or (num_processes or 0) > 1
+    ):
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    return jax.process_index(), jax.process_count()
+
+
+def host_stripe(n_words: int, num_processes: int, process_id: int
+                ) -> Tuple[int, int]:
+    """Contiguous balanced stripe ``[lo, hi)`` of ``n_words`` for one host.
+
+    The first ``n_words % num_processes`` hosts get one extra word; stripes
+    are contiguous so each host's sweep keeps the linear (word, rank)
+    cursor and dictionary-order semantics within its slice.
+    """
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"process_id {process_id} out of range for {num_processes}"
+        )
+    base, rem = divmod(n_words, num_processes)
+    lo = process_id * base + min(process_id, rem)
+    hi = lo + base + (1 if process_id < rem else 0)
+    return lo, hi
+
+
+def stripe_packed(packed: PackedWords, lo: int, hi: int) -> PackedWords:
+    """One host's slice of a packed batch; global dictionary positions are
+    preserved in ``index`` so hits report against the full wordlist."""
+    return PackedWords(
+        tokens=packed.tokens[lo:hi],
+        lengths=packed.lengths[lo:hi],
+        index=packed.index[lo:hi],
+    )
+
+
+def _allgather(x: np.ndarray) -> np.ndarray:
+    """Process-allgather with a leading process axis."""
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x))
+
+
+def allgather_sum(value: int) -> int:
+    """Sum a host-local Python int across processes (DCN scalar reduce)."""
+    return int(_allgather(np.asarray([value], dtype=np.int64)).sum())
+
+
+def gather_hits(hits: Sequence) -> List:
+    """All-gather host-local hit records; returns the combined list sorted
+    by (word_index, variant_rank), identical on every process.
+
+    Records are JSON on the wire (variant ranks are host bigints — they can
+    exceed int64 for huge variant spaces, so no fixed-width array encoding).
+    Payloads are padded to the max per-host length; hits are rare, so the
+    padding waste is noise.
+    """
+    from ..runtime.sinks import HitRecord
+
+    payload = json.dumps([
+        {
+            "w": int(h.word_index),
+            "r": int(h.variant_rank),
+            "c": h.candidate.hex(),
+            "d": h.digest_hex,
+        }
+        for h in hits
+    ]).encode()
+    n = len(payload)
+    lens = _allgather(np.asarray([n], dtype=np.int64))[:, 0]
+    width = max(1, int(lens.max()))
+    buf = np.zeros(width, dtype=np.uint8)
+    buf[:n] = np.frombuffer(payload, dtype=np.uint8)
+    bufs = _allgather(buf)
+    combined = []
+    for p in range(bufs.shape[0]):
+        raw = bytes(bufs[p, : int(lens[p])])
+        for rec in json.loads(raw) if raw else []:
+            combined.append(
+                HitRecord(
+                    word_index=rec["w"],
+                    variant_rank=rec["r"],
+                    candidate=bytes.fromhex(rec["c"]),
+                    digest_hex=rec["d"],
+                )
+            )
+    combined.sort(key=lambda h: (h.word_index, h.variant_rank))
+    return combined
+
+
+def _host_config(config, process_id: int):
+    """Per-host copy of a SweepConfig: checkpoint paths get a process
+    suffix (each host checkpoints its own stripe cursor independently)."""
+    if config is None or config.checkpoint_path is None:
+        return config
+    return replace(
+        config, checkpoint_path=f"{config.checkpoint_path}.p{process_id}"
+    )
+
+
+def run_crack_multihost(
+    spec,
+    sub_map: Dict[bytes, List[bytes]],
+    packed: PackedWords,
+    digests: Sequence[bytes],
+    config=None,
+    *,
+    recorder=None,
+    resume: bool = True,
+):
+    """The fused crack sweep at pod scale.
+
+    Every process calls this with the SAME full wordlist (cheap: packed
+    arrays), sweeps its own stripe on its local devices, then all processes
+    exchange hit records and return the same combined SweepResult.  The
+    recorder (process-local; typically only given on process 0) receives
+    the combined, globally-sorted hit stream.
+    """
+    import jax
+
+    from ..runtime.sweep import Sweep, SweepResult
+
+    pid, nprocs = jax.process_index(), jax.process_count()
+    lo, hi = host_stripe(packed.batch, nprocs, pid)
+    local = stripe_packed(packed, lo, hi)
+    sweep = Sweep(
+        spec, sub_map, local, digests, config=_host_config(config, pid)
+    )
+    res = sweep.run_crack(resume=resume)
+    all_hits = gather_hits(res.hits)
+    if recorder is not None:
+        for h in all_hits:
+            recorder.emit(h)
+    return SweepResult(
+        n_emitted=allgather_sum(res.n_emitted),
+        n_hits=len(all_hits),
+        hits=all_hits,
+        words_done=allgather_sum(res.words_done),
+        resumed=res.resumed,
+        wall_s=res.wall_s,
+    )
+
+
+def run_candidates_multihost(
+    spec,
+    sub_map: Dict[bytes, List[bytes]],
+    packed: PackedWords,
+    writer,
+    config=None,
+    *,
+    resume: bool = True,
+):
+    """Candidates mode at pod scale: each host streams ITS OWN stripe to its
+    local writer (stripe-local dictionary order).  Candidate streams never
+    cross DCN — concatenating the per-host outputs in process order yields
+    the single-host stream.  Returns this host's SweepResult with
+    global emitted/words counts.
+    """
+    import jax
+
+    from ..runtime.sweep import Sweep, SweepResult
+
+    pid, nprocs = jax.process_index(), jax.process_count()
+    lo, hi = host_stripe(packed.batch, nprocs, pid)
+    local = stripe_packed(packed, lo, hi)
+    sweep = Sweep(spec, sub_map, local, config=_host_config(config, pid))
+    res = sweep.run_candidates(writer, resume=resume)
+    return SweepResult(
+        n_emitted=allgather_sum(res.n_emitted),
+        n_hits=0,
+        hits=[],
+        words_done=allgather_sum(res.words_done),
+        resumed=res.resumed,
+        wall_s=res.wall_s,
+    )

@@ -19,10 +19,14 @@ from .checkpoint import (  # noqa: F401
 from .progress import ProgressReporter  # noqa: F401
 from .sinks import CandidateWriter, HitRecord, HitRecorder  # noqa: F401
 
-_LAZY = ("Sweep", "SweepConfig", "SweepResult")
+_LAZY = ("Sweep", "SweepConfig", "SweepResult", "BucketedSweep")
 
 
 def __getattr__(name: str):
+    if name == "BucketedSweep":
+        from .bucketed import BucketedSweep
+
+        return BucketedSweep
     if name in _LAZY:
         from . import sweep
 

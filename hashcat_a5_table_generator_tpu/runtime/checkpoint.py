@@ -22,7 +22,11 @@ import os
 from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-FORMAT_VERSION = 1
+import numpy as np
+
+#: v2: canonical word encoding is (int64 length vector, concatenated
+#: content) so packed batches hash buffer-at-a-time instead of per-word.
+FORMAT_VERSION = 2
 
 
 @dataclass(frozen=True)
@@ -62,16 +66,37 @@ def sweep_fingerprint(
     """SHA-256 over a canonical serialization of the sweep's semantic inputs.
 
     Table entries hash in key order with value-list order preserved (order
-    and multiplicity are semantic — Q2 first-option, Q7 duplicates)."""
+    and multiplicity are semantic — Q2 first-option, Q7 duplicates).
+
+    ``words`` may be a ``PackedWords`` batch — hashed buffer-at-a-time
+    (little-endian int64 length vector, then the concatenated unpadded
+    content bytes), identical to the per-word path for the same word
+    sequence but without a Python loop over a rockyou-scale dictionary.
+    The fingerprint stays independent of packing width and launch geometry.
+    """
     h = hashlib.sha256()
     h.update(f"{mode}|{algo}|{min_substitute}|{max_substitute}|".encode())
     for key in sorted(sub_map):
         h.update(b"K%d:" % len(key) + key)
         for val in sub_map[key]:
             h.update(b"V%d:" % len(val) + val)
-    h.update(b"|W%d|" % len(words))
-    for w in words:
-        h.update(b"%d:" % len(w) + w)
+    if hasattr(words, "tokens"):  # PackedWords fast path
+        lengths = np.ascontiguousarray(words.lengths, dtype="<i8")
+        h.update(b"|W%d|" % len(lengths))
+        h.update(lengths.tobytes())
+        tokens = np.asarray(words.tokens)
+        mask = (
+            np.arange(tokens.shape[1])[None, :]
+            < np.asarray(words.lengths)[:, None]
+        )
+        h.update(np.ascontiguousarray(tokens[mask]).tobytes())
+    else:
+        h.update(b"|W%d|" % len(words))
+        h.update(
+            np.asarray([len(w) for w in words], dtype="<i8").tobytes()
+        )
+        for w in words:
+            h.update(w)
     h.update(b"|D%d|" % len(digests))
     for d in sorted(digests):
         h.update(d)

@@ -34,6 +34,12 @@ class ProgressReporter:
         self._last_emitted = 0
         self._last_t = self._t0
 
+    def seed_emitted(self, emitted: int) -> None:
+        """Base the first rate window on a resumed sweep's prior count, so
+        candidates emitted by an earlier process are not attributed to this
+        one's first few seconds."""
+        self._last_emitted = emitted
+
     def update(
         self, *, words_done: int, emitted: int, hits: int, force: bool = False
     ) -> None:

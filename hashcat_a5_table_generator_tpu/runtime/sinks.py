@@ -100,10 +100,11 @@ class HitRecord:
 
 
 def potfile_line(digest_hex: str, candidate: bytes) -> bytes:
-    """One ``digest:plain`` potfile line; a line-corrupting plain (embedded
-    newline via ``$HEX[]`` table values) is ``$HEX[]``-wrapped — only the
-    plain, never the digest prefix, matching hashcat's potfile convention."""
-    if needs_hex_notation(candidate):
+    """One ``digest:plain`` potfile line; a plain that would corrupt the
+    line format — embedded newline, or a ``:`` that colon-splitting potfile
+    consumers would mis-parse — is ``$HEX[]``-wrapped.  Only the plain,
+    never the digest prefix, matching hashcat's potfile convention."""
+    if needs_hex_notation(candidate) or b":" in candidate:
         candidate = hex_notation_encode(candidate)
     return digest_hex.encode("ascii") + b":" + candidate + b"\n"
 

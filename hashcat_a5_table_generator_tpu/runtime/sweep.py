@@ -95,21 +95,6 @@ class SweepResult:
     wall_s: float = 0.0
 
 
-class _PackedView:
-    """Lazy ``Sequence[bytes]`` view over a PackedWords batch, so
-    fingerprinting never materializes a word list."""
-
-    def __init__(self, packed: PackedWords) -> None:
-        self._p = packed
-
-    def __len__(self) -> int:
-        return self._p.batch
-
-    def __iter__(self):
-        for i in range(self._p.batch):
-            yield self._p.word(i)
-
-
 class Sweep:
     """One wordlist × one merged table × one attack spec."""
 
@@ -140,7 +125,7 @@ class Sweep:
             spec.min_substitute,
             spec.max_substitute,
             sub_map,
-            _PackedView(self.packed),
+            self.packed,  # buffer-level hash, no per-word Python loop
             self.digests,
         )
         self._host_digest = HOST_DIGEST[spec.algo]
@@ -245,47 +230,52 @@ class Sweep:
         ``(batch, lane_lo, lane_hi)`` — one entry per device, slicing the
         launch's flat lane axis. Dispatch runs ``max_in_flight`` ahead of
         fetch, so host block-cutting overlaps device execution."""
+        import jax.profiler
+
         cfg = self.config
         pending: deque = deque()
         w, rank = cursor.word, cursor.rank
         lanes = cfg.lanes
         while True:
-            if n_devices == 1:
-                batch, w2, rank2 = make_blocks(
-                    self.plan,
-                    start_word=w,
-                    start_rank=rank,
-                    max_variants=lanes,
-                    max_blocks=cfg.num_blocks,
-                )
-                if batch.total == 0:
-                    break
-                blocks = block_arrays(batch, num_blocks=cfg.num_blocks)
-                segments = [(batch, 0, lanes)]
-            else:
-                from ..parallel.mesh import (
-                    make_device_blocks,
-                    shard_leading,
-                    stack_blocks,
-                )
+            # Annotated so a --profile trace shows how much wall-clock the
+            # host-side scheduler costs vs the overlapped device launches.
+            with jax.profiler.TraceAnnotation("a5.host_cut_blocks"):
+                if n_devices == 1:
+                    batch, w2, rank2 = make_blocks(
+                        self.plan,
+                        start_word=w,
+                        start_rank=rank,
+                        max_variants=lanes,
+                        max_blocks=cfg.num_blocks,
+                    )
+                    if batch.total == 0:
+                        break
+                    blocks = block_arrays(batch, num_blocks=cfg.num_blocks)
+                    segments = [(batch, 0, lanes)]
+                else:
+                    from ..parallel.mesh import (
+                        make_device_blocks,
+                        shard_leading,
+                        stack_blocks,
+                    )
 
-                batches, w2, rank2 = make_device_blocks(
-                    self.plan,
-                    n_devices=n_devices,
-                    lanes_per_device=lanes,
-                    start_word=w,
-                    start_rank=rank,
-                    max_blocks=cfg.num_blocks,
-                )
-                if sum(b.total for b in batches) == 0:
-                    break
-                blocks = shard_leading(
-                    mesh, stack_blocks(batches, num_blocks=cfg.num_blocks)
-                )
-                segments = [
-                    (batches[d], d * lanes, (d + 1) * lanes)
-                    for d in range(n_devices)
-                ]
+                    batches, w2, rank2 = make_device_blocks(
+                        self.plan,
+                        n_devices=n_devices,
+                        lanes_per_device=lanes,
+                        start_word=w,
+                        start_rank=rank,
+                        max_blocks=cfg.num_blocks,
+                    )
+                    if sum(b.total for b in batches) == 0:
+                        break
+                    blocks = shard_leading(
+                        mesh, stack_blocks(batches, num_blocks=cfg.num_blocks)
+                    )
+                    segments = [
+                        (batches[d], d * lanes, (d + 1) * lanes)
+                        for d in range(n_devices)
+                    ]
             out = launch(blocks)
             pending.append((segments, out, SweepCursor(w2, rank2)))
             w, rank = w2, rank2
@@ -345,6 +335,8 @@ class Sweep:
         recorder = recorder if recorder is not None else HitRecorder()
         state, resumed = self._load_state(resume)
         digest_set = set(self.digests)
+        if cfg.progress is not None:
+            cfg.progress.seed_emitted(state.n_emitted)
 
         launch, n_devices, mesh = self._make_launch("crack")
 
@@ -470,6 +462,8 @@ class Sweep:
         hits are keyed by (word, rank) in the checkpoint itself."""
         spec, cfg, plan = self.spec, self.config, self.plan
         state, resumed = self._load_state(resume)
+        if cfg.progress is not None:
+            cfg.progress.seed_emitted(state.n_emitted)
 
         launch, n_devices, mesh = self._make_launch("candidates")
 

@@ -174,6 +174,33 @@ class TestDeviceBackend:
         assert lines == [hashlib.md5(plant).hexdigest().encode() + b":" + plant]
         assert b"1 hits" in r.stderr
 
+    def test_bug_compat_reverse_routes_to_oracle(self, workdir, tmp_path):
+        # Length-changing table (1 byte -> 2 bytes) exposes the Q3 offset
+        # bug; --backend device --bug-compat -r must yield the ORACLE's
+        # bug-exact bytes, with a loud warning.
+        t = tmp_path / "grow.table"
+        t.write_bytes(b"a=XX\nb=YY\n")
+        d = tmp_path / "d.txt"
+        d.write_bytes(b"ab\n")
+        dev = run_cli(str(d), "-t", str(t), "-r", "--bug-compat",
+                      "--backend", "device")
+        orc = run_cli(str(d), "-t", str(t), "-r", "--bug-compat",
+                      "--backend", "oracle")
+        assert dev.stdout == orc.stdout
+        assert b"routing" in dev.stderr and b"oracle" in dev.stderr
+        # The Q3 vector itself: exactly-2-subs on "ab" emits the corrupted
+        # aXXY, not the corrected XXYY (SURVEY.md Q3).
+        exact = run_cli(str(d), "-t", str(t), "-r", "--bug-compat",
+                        "-m", "2", "-x", "2", "--backend", "device")
+        assert exact.stdout == b"aXXY\n"
+
+    def test_bug_compat_non_reverse_warns_no_effect(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t",
+                    str(workdir / "leet.table"), "--backend", "device",
+                    "--bug-compat", "--lanes", "256", "--blocks", "16")
+        assert b"no effect" in r.stderr
+        assert r.stdout  # sweep still ran
+
     def test_devices_sharded_stream_identical(self, workdir):
         base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                 "--backend", "device", "--lanes", "64", "--blocks", "16")
@@ -190,6 +217,36 @@ class TestDeviceBackend:
                     "--devices", "lots", check=False)
         assert r.returncode != 0
         assert b"--devices" in r.stderr
+
+    def test_buckets_mixed_length_dictionary(self, workdir, tmp_path):
+        # Default bucketing: an over-the-last-boundary line must not break
+        # the sweep (it gets its own bucket width) and parity holds per word.
+        d = tmp_path / "mixed.txt"
+        long_word = b"q" * 68 + b"as"
+        d.write_bytes(b"password\n" + long_word + b"\nzzz\n")
+        sub = load_tables([str(workdir / "leet.table")])
+        r = run_cli(str(d), "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--lanes", "256", "--blocks", "16")
+        from collections import Counter
+
+        want = Counter(oracle_all(sub, [b"password", long_word, b"zzz"]))
+        assert Counter(r.stdout.splitlines()) == want
+
+    def test_buckets_none_single_width(self, workdir):
+        base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                "--backend", "device", "--lanes", "256", "--blocks", "16")
+        bucketed = run_cli(*base)
+        single = run_cli(*base, "--buckets", "none")
+        assert sorted(bucketed.stdout.splitlines()) == sorted(
+            single.stdout.splitlines()
+        )
+
+    def test_buckets_rejects_garbage(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t",
+                    str(workdir / "leet.table"), "--backend", "device",
+                    "--buckets", "64,16", check=False)
+        assert r.returncode != 0
+        assert b"--buckets" in r.stderr
 
     def test_progress_lines(self, workdir):
         r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),

@@ -103,6 +103,18 @@ class TestCheckpoint:
         reordered = dict(reversed(list(LEET.items())))
         assert base == sweep_fingerprint("default", "md5", 0, 15, reordered, WORDS, [])
 
+    def test_fingerprint_packed_equals_word_list(self):
+        # The buffer-level PackedWords path must produce the SAME
+        # fingerprint as the per-word list path, at ANY packing width.
+        from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+
+        base = sweep_fingerprint("default", "md5", 0, 15, LEET, WORDS, [])
+        for width in (None, 64, 128):
+            packed = pack_words(WORDS, width=width)
+            assert sweep_fingerprint(
+                "default", "md5", 0, 15, LEET, packed, []
+            ) == base
+
 
 class TestSinks:
     def test_candidate_writer_lines(self):
@@ -428,3 +440,29 @@ class TestMultiDeviceSweep:
         sweep = Sweep(spec, LEET, WORDS, config=cfg)
         with pytest.raises(ValueError, match="devices"):
             sweep.run_candidates(CandidateWriter(io.BytesIO()))
+
+
+def test_potfile_line_wraps_colon_plains():
+    from hashcat_a5_table_generator_tpu.runtime.sinks import potfile_line
+
+    assert potfile_line("ab" * 16, b"pa:ss") == (
+        b"ab" * 16 + b":$HEX[" + b"pa:ss".hex().encode() + b"]\n"
+    )
+    assert potfile_line("ab" * 16, b"plain") == b"ab" * 16 + b":plain\n"
+    assert potfile_line("ab" * 16, b"nl\nin") == (
+        b"ab" * 16 + b":$HEX[" + b"nl\nin".hex().encode() + b"]\n"
+    )
+
+
+def test_progress_seed_emitted_resumed_rate():
+    # A resumed sweep's first progress line must not attribute prior-run
+    # output to this process's first window (ADVICE r1).
+    t = [0.0]
+
+    out = io.StringIO()
+    rep = ProgressReporter(10, every_s=1.0, stream=out, clock=lambda: t[0])
+    rep.seed_emitted(1_000_000)  # checkpointed n_emitted from a prior run
+    t[0] = 2.0
+    rep.update(words_done=5, emitted=1_000_100, hits=0)
+    line = json.loads(out.getvalue().splitlines()[-1])
+    assert line["progress"]["cand_per_sec"] == pytest.approx(50.0)
