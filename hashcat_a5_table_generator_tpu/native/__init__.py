@@ -28,6 +28,7 @@ from ..ops.packing import (
     DEFAULT_MAX_WORD_BYTES,
     PackedWords,
     aligned_width,
+    validate_buckets,
 )
 
 _SRC = pathlib.Path(__file__).with_name("packer.cpp")
@@ -215,10 +216,14 @@ def bucket_widths(
     ``ops.packing.bucket_words``: the smallest bucket boundary covering the
     word, else the word's own power-of-two width (min 4)."""
     lengths = np.asarray(lengths, dtype=np.int64)
-    b = np.asarray(sorted(buckets), dtype=np.int64)
+    b = np.asarray(validate_buckets(buckets), dtype=np.int64)
     idx = np.searchsorted(b, lengths, side="left")
     over = idx >= len(b)
-    widths = np.where(over, 0, b[np.minimum(idx, len(b) - 1)])
+    widths = (
+        np.where(over, 0, b[np.minimum(idx, len(b) - 1)])
+        if len(b)
+        else np.zeros(len(lengths), dtype=np.int64)
+    )
     if over.any():
         pow2 = np.maximum(
             4, 2 ** np.ceil(np.log2(np.maximum(lengths, 1))).astype(np.int64)

@@ -90,6 +90,22 @@ def pack_words(
     return PackedWords(tokens=tokens, lengths=lengths, index=index)
 
 
+def validate_buckets(buckets: Sequence[int]) -> Tuple[int, ...]:
+    """Require strictly-ascending positive bucket boundaries.
+
+    Shared by the Python (`bucket_words`, first-match in caller order) and
+    native (`native.bucket_widths`, searchsorted) assignment paths so an
+    unsorted tuple cannot make them assign different widths (advisor r2).
+    An empty tuple is allowed: every word gets its own power-of-two width.
+    """
+    if list(buckets) != sorted(set(buckets)) or any(b < 1 for b in buckets):
+        raise ValueError(
+            f"buckets must be strictly ascending positive widths, got "
+            f"{tuple(buckets)}"
+        )
+    return tuple(buckets)
+
+
 def bucket_words(
     words: Sequence[bytes],
     *,
@@ -104,6 +120,7 @@ def bucket_words(
     a power-of-two width of their own; words over ``max_word_bytes`` raise
     (the anti-Q8 guarantee).
     """
+    validate_buckets(buckets)
     by_width: Dict[int, List[int]] = {}
     for i, w in enumerate(words):
         if len(w) > max_word_bytes:

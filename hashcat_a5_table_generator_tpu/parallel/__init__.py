@@ -10,6 +10,7 @@ from .mesh import (  # noqa: F401
     stack_blocks,
 )
 from .multihost import (  # noqa: F401
+    allgather_max,
     allgather_sum,
     gather_hits,
     host_stripe,

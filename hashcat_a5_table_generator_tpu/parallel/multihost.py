@@ -43,9 +43,32 @@ __all__ = [
     "stripe_packed",
     "gather_hits",
     "allgather_sum",
+    "allgather_max",
     "run_crack_multihost",
     "run_candidates_multihost",
 ]
+
+
+def _runtime_already_up() -> bool:
+    """Whether ``jax.distributed`` is already initialized in this process.
+
+    Probed via ``jax.distributed.is_initialized()`` (falling back to the
+    internal global state on older JAX), NOT via ``jax.process_count()`` —
+    the latter spins up the XLA backend as a side effect, after which
+    ``jax.distributed.initialize`` can never succeed (advisor r2, medium).
+    """
+    import jax
+
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:
+        pass
+    try:
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+    except Exception:
+        return False
 
 
 def initialize(
@@ -56,20 +79,47 @@ def initialize(
     """Bring up (or join) the JAX distributed runtime.
 
     Explicit arguments for manual topologies (CI, bare clusters); all-None
-    lets JAX auto-detect cloud TPU pod environments.  Safe to call when the
-    runtime is already up (returns the live topology).  Returns
-    ``(process_id, num_processes)``.
+    attempts JAX's cluster auto-detection (cloud TPU pods, SLURM...) and
+    falls back to single-process when no cluster environment is found.
+    Safe to call when the runtime is already up (returns the live
+    topology).  Must run before any other JAX call that would initialize
+    the XLA backend.  Returns ``(process_id, num_processes)``.
     """
     import jax
 
-    if jax.process_count() == 1 and (
-        coordinator_address or (num_processes or 0) > 1
-    ):
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
+    explicit = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+    )
+    if explicit and coordinator_address is None and (num_processes or 1) <= 1:
+        # Explicit single-process topology (e.g. --num-processes 1 with no
+        # coordinator): nothing to bring up.
+        return 0, 1
+    if not _runtime_already_up():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        except RuntimeError as e:
+            # A racing/duplicate init is fine (JAX 0.9: "distributed.
+            # initialize should only be called once."); anything else —
+            # including "must be called before any JAX computations"
+            # (backend up) and coordinator bind failures like "address
+            # already in use" — is a real operator error, re-raised.
+            msg = str(e).lower()
+            if "called once" not in msg and "already initialized" not in msg:
+                raise
+        except ValueError:
+            if explicit:
+                raise
+            # All-None auto-detection found no cluster environment:
+            # single-process run.
+            return 0, 1
+    # Only query the topology AFTER distributed init (these calls create
+    # the backend and cache its view of the world).
     return jax.process_index(), jax.process_count()
 
 
@@ -111,6 +161,11 @@ def _allgather(x: np.ndarray) -> np.ndarray:
 def allgather_sum(value: int) -> int:
     """Sum a host-local Python int across processes (DCN scalar reduce)."""
     return int(_allgather(np.asarray([value], dtype=np.int64)).sum())
+
+
+def allgather_max(value: float) -> float:
+    """Max of a host-local float across processes (DCN scalar reduce)."""
+    return float(_allgather(np.asarray([value], dtype=np.float64)).max())
 
 
 def gather_hits(hits: Sequence) -> List:
@@ -198,13 +253,15 @@ def run_crack_multihost(
     if recorder is not None:
         for h in all_hits:
             recorder.emit(h)
+    # resumed/wall_s are globally reduced too (any/max), so every process
+    # really does return the same combined SweepResult (advisor r2).
     return SweepResult(
         n_emitted=allgather_sum(res.n_emitted),
         n_hits=len(all_hits),
         hits=all_hits,
         words_done=allgather_sum(res.words_done),
-        resumed=res.resumed,
-        wall_s=res.wall_s,
+        resumed=allgather_sum(int(res.resumed)) > 0,
+        wall_s=allgather_max(res.wall_s),
     )
 
 
@@ -237,6 +294,6 @@ def run_candidates_multihost(
         n_hits=0,
         hits=[],
         words_done=allgather_sum(res.words_done),
-        resumed=res.resumed,
-        wall_s=res.wall_s,
+        resumed=allgather_sum(int(res.resumed)) > 0,
+        wall_s=allgather_max(res.wall_s),
     )

@@ -18,8 +18,12 @@ Semantics vs a single-width sweep:
   bucket streams ITS words in dictionary order.  A single-bucket wordlist
   (the common case) is byte-identical to the unbucketed stream.  The oracle
   backend remains the strict-global-order surface.
-* **checkpoints**: per-bucket files (``{path}.w{width}``), each with its own
-  stripe fingerprint; buckets resume independently.
+* **checkpoints**: the user's ``--checkpoint FILE`` path holds a top-level
+  *manifest* (bucket widths → per-bucket checkpoint files + fingerprints,
+  :func:`~.checkpoint.save_bucket_manifest`); each bucket's cursor state
+  lives in ``{path}.w{width}`` and resumes independently.  A legacy
+  single-file checkpoint at FILE, or a manifest written under different
+  ``--buckets``, fails loudly instead of silently restarting.
 """
 
 from __future__ import annotations
@@ -28,6 +32,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..ops.packing import PackedWords
+from .checkpoint import check_bucket_manifest, save_bucket_manifest
 from .sweep import Sweep, SweepConfig, SweepResult
 
 
@@ -127,6 +132,18 @@ class BucketedSweep:
     def n_words(self) -> int:
         return sum(s.n_words for s in self.sweeps.values())
 
+    def _sync_manifest(self, resume: bool) -> None:
+        """Validate (when resuming) and write the top-level manifest at the
+        user's checkpoint path, before any bucket runs — so FILE exists
+        even if the run dies inside the first bucket."""
+        path = self.config.checkpoint_path
+        if not path:
+            return
+        fps = {w: s.fingerprint for w, s in self.sweeps.items()}
+        if resume:
+            check_bucket_manifest(path, fps)
+        save_bucket_manifest(path, fps)
+
     def _merge(self, results: List[SweepResult], t0: float) -> SweepResult:
         hits = [h for r in results for h in r.hits]
         hits.sort(key=lambda h: (h.word_index, h.variant_rank))
@@ -144,6 +161,7 @@ class BucketedSweep:
         found (bucket-major order); the returned result's ``hits`` list is
         sorted by global (word_index, rank)."""
         t0 = time.monotonic()
+        self._sync_manifest(resume)
         results = []
         for width, sweep in self.sweeps.items():
             res = sweep.run_crack(_ForwardRecorder(recorder), resume=resume)
@@ -164,6 +182,7 @@ class BucketedSweep:
         """Stream every bucket's candidates (ascending width, dictionary
         order within each bucket)."""
         t0 = time.monotonic()
+        self._sync_manifest(resume)
         results = []
         for width, sweep in self.sweeps.items():
             res = sweep.run_candidates(writer, resume=resume)

@@ -28,6 +28,11 @@ import numpy as np
 #: content) so packed batches hash buffer-at-a-time instead of per-word.
 FORMAT_VERSION = 2
 
+#: ``kind`` marker distinguishing a bucketed sweep's top-level manifest
+#: from a single sweep's cursor checkpoint (both live at the user's
+#: ``--checkpoint FILE`` path depending on ``--buckets``).
+MANIFEST_KIND = "bucket-manifest"
+
 
 @dataclass(frozen=True)
 class SweepCursor:
@@ -126,6 +131,12 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
         return None
     with open(path) as fh:
         doc = json.load(fh)
+    if doc.get("kind") == MANIFEST_KIND:
+        raise ValueError(
+            f"checkpoint {path!r} is a bucket manifest written by a "
+            "bucketed sweep; resume with the same --buckets, or delete it "
+            "to start over"
+        )
     if doc.get("version") != FORMAT_VERSION:
         raise ValueError(
             f"checkpoint {path!r} has version {doc.get('version')}, "
@@ -148,3 +159,69 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
         fallback_done=int(doc.get("fallback_done", 0)),
         wall_s=float(doc["wall_s"]),
     )
+
+
+def save_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> None:
+    """Atomically write the bucketed sweep's top-level checkpoint at the
+    user's ``--checkpoint FILE`` path: a manifest mapping each bucket width
+    to its per-bucket checkpoint file (``{path}.w{width}``) and that
+    bucket's semantic fingerprint.  FILE therefore always exists for a
+    bucketed run, and a resume under different ``--buckets`` (or a legacy
+    single-file checkpoint) fails loudly instead of silently restarting."""
+    doc = {
+        "version": FORMAT_VERSION,
+        "kind": MANIFEST_KIND,
+        "buckets": {
+            str(width): {
+                "file": os.path.basename(f"{path}.w{width}"),
+                "fingerprint": fp,
+            }
+            for width, fp in sorted(fingerprints.items())
+        },
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def check_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> bool:
+    """Validate an existing manifest at ``path`` against this run's bucket
+    fingerprints; returns False when absent.
+
+    Raises ``ValueError`` when the file is a legacy single-sweep checkpoint
+    (the pre-manifest layout — resuming it under bucketing would silently
+    restart from zero) or when the bucket set / any fingerprint differs
+    (``--buckets`` or sweep inputs changed)."""
+    if not os.path.exists(path):
+        return False
+    with open(path) as fh:
+        doc = json.load(fh)
+    if doc.get("kind") != MANIFEST_KIND:
+        raise ValueError(
+            f"checkpoint {path!r} is a single-sweep checkpoint, not a "
+            "bucket manifest; it would be ignored by a bucketed sweep — "
+            "rerun with --buckets none to resume it, or delete it to "
+            "start over"
+        )
+    if doc.get("version") != FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint manifest {path!r} has version "
+            f"{doc.get('version')}, expected {FORMAT_VERSION}"
+        )
+    want = {
+        str(width): fp for width, fp in fingerprints.items()
+    }
+    got = {
+        w: entry.get("fingerprint")
+        for w, entry in doc.get("buckets", {}).items()
+    }
+    if got != want:
+        raise ValueError(
+            f"checkpoint manifest {path!r} was written with different "
+            "buckets or sweep inputs (--buckets/mode/window/table/wordlist/"
+            "digests changed); delete it and its .w* files to start over"
+        )
+    return True

@@ -55,6 +55,29 @@ class TestStripes:
             host_stripe(10, 2, 2)
 
 
+def test_initialize_no_cluster_falls_back_single_process(tmp_path):
+    """All-None initialize() on a plain host (no TPU pod / SLURM / MPI env)
+    reports single-process instead of raising."""
+    script = tmp_path / "solo.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "for v in ('SLURM_JOB_ID', 'OMPI_COMM_WORLD_SIZE'):\n"
+        "    os.environ.pop(v, None)\n"
+        "from hashcat_a5_table_generator_tpu.parallel import multihost\n"
+        "assert multihost.initialize() == (0, 1)\n"
+        "print('OK')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, str(script)], env=env, capture_output=True,
+        timeout=120,
+    )
+    assert r.returncode == 0, r.stderr.decode()[-3000:]
+    assert b"OK" in r.stdout
+
+
 _CHILD = r"""
 import json, os, sys
 
@@ -68,9 +91,16 @@ os.environ.pop("XLA_FLAGS", None)  # one local device per process
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(
-    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
-)
+
+# Exercise multihost.initialize() itself (advisor r2, medium: it used to
+# probe jax.process_count() first, which spun up the XLA backend and made
+# jax.distributed.initialize unconditionally fail).
+from hashcat_a5_table_generator_tpu.parallel import multihost
+
+topo = multihost.initialize(f"127.0.0.1:{port}", 2, pid)
+assert topo == (pid, 2), topo
+# Idempotent: a second call reports the live topology.
+assert multihost.initialize() == (pid, 2)
 assert jax.process_count() == 2
 
 import hashlib
@@ -95,6 +125,8 @@ with open(os.path.join(outdir, f"out{pid}.json"), "w") as fh:
     json.dump({
         "n_emitted": res.n_emitted,
         "n_hits": res.n_hits,
+        "resumed": res.resumed,
+        "wall_s": res.wall_s,
         "hits": [
             [h.word_index, h.variant_rank, h.candidate.hex(), h.digest_hex]
             for h in res.hits
@@ -150,8 +182,20 @@ def test_two_process_crack_matches_single(tmp_path):
     results = [
         json.load(open(tmp_path / f"out{p}.json")) for p in range(2)
     ]
-    # Both processes hold the SAME combined result (hit gather is symmetric).
+    # Both processes hold the SAME combined result — resumed/wall_s are
+    # globally reduced (any/max), not host-local (advisor r2).
     assert results[0] == results[1]
+    assert results[0]["resumed"] is False
     assert results[0]["hits"] == want_hits
     assert results[0]["n_emitted"] == want.n_emitted == len(oracle)
     assert {bytes.fromhex(h[2]) for h in results[0]["hits"]} == set(planted)
+
+
+def test_initialize_explicit_single_process_is_noop():
+    """initialize(num_processes=1) with no coordinator short-circuits to
+    (0, 1) without touching jax.distributed (regression: the r3 rework
+    briefly made this raise ValueError)."""
+    from hashcat_a5_table_generator_tpu.parallel import multihost
+
+    assert multihost.initialize(num_processes=1) == (0, 1)
+    assert multihost.initialize(process_id=0) == (0, 1)
