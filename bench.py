@@ -175,7 +175,17 @@ def run_worker(args: argparse.Namespace) -> None:
     targets = [host_digest(b"bench-decoy-%d" % i) for i in range(1024)]
     ds = build_digest_set(targets, spec.algo)
 
-    step = make_crack_step(spec, num_lanes=args.lanes, out_width=plan.out_width)
+    # Fixed-stride blocks whenever lanes divide evenly over the block slots
+    # (the TPU fast path: arithmetic lane->block map, no per-lane binary
+    # search — PERF.md). One rule, owned by the sweep runtime: the bench
+    # must measure the same layout the real sweep executes.
+    from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
+
+    stride = SweepConfig(
+        lanes=args.lanes, num_blocks=args.blocks
+    ).block_stride
+    step = make_crack_step(spec, num_lanes=args.lanes,
+                           out_width=plan.out_width, block_stride=stride)
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
 
     # Pre-cut real blocks from the sweep's head (host cost excluded: the
@@ -186,6 +196,7 @@ def run_worker(args: argparse.Namespace) -> None:
         batch, w, rank = make_blocks(
             plan, start_word=w, start_rank=rank,
             max_variants=args.lanes, max_blocks=args.blocks,
+            fixed_stride=stride,
         )
         if batch.total == 0:
             break
@@ -193,19 +204,32 @@ def run_worker(args: argparse.Namespace) -> None:
     if not batches:
         raise SystemExit("wordlist produced no variant blocks")
 
-    # Warmup: compile + one pass over every distinct batch, collecting each
-    # batch's device-reported emitted count. Block descriptors enumerate the
-    # full Π-radix rank space, but `emit` excludes min-window misses (e.g.
-    # default mode's rank-0 no-substitution variant) and overlap-clash
-    # lanes — only emitted lanes are hashed candidates, so only they count.
+    # Every sync below is a device->host SCALAR fetch (``int(...)`` on the
+    # emitted count): on the axon TPU tunnel ``jax.block_until_ready`` can
+    # return before the computation retires, which is how r3's timed loop
+    # dispatched unboundedly and blew the orchestrator deadline (VERDICT r3
+    # weak #2). A scalar fetch is an honest completion barrier everywhere.
+    #
+    # `n_emitted` excludes min-window misses (e.g. default mode's rank-0
+    # no-substitution variant) and overlap-clash lanes — only emitted lanes
+    # are hashed candidates, so only they count.
     t0 = time.perf_counter()
-    per_batch = []
-    for b in batches:
-        out = step(p, t, b, d)
-        per_batch.append(int(out["n_emitted"]))
+    int(step(p, t, batches[0], d)["n_emitted"])
     print(f"# warmup (incl. compile): {time.perf_counter()-t0:.1f}s",
           file=sys.stderr)
 
+    # Size the window from evidence: one steady-state launch, then run the
+    # number of launches the requested window can retire — never dispatch
+    # more than the budget can drain (each launch is fetched before two
+    # more are dispatched, so in-flight work is bounded at 2).
+    t0 = time.perf_counter()
+    int(step(p, t, batches[1 % len(batches)], d)["n_emitted"])
+    per_launch = time.perf_counter() - t0
+    target = max(2, min(5000, int(args.seconds / max(per_launch, 1e-4))))
+    print(f"# sized window: {per_launch:.3f}s/launch -> {target} launches",
+          file=sys.stderr)
+
+    from collections import deque
     from contextlib import nullcontext
 
     trace_ctx = nullcontext()
@@ -218,20 +242,27 @@ def run_worker(args: argparse.Namespace) -> None:
     launches = 0
     with trace_ctx:
         start = time.perf_counter()
-        deadline = start + args.seconds
-        out = None
-        while time.perf_counter() < deadline:
-            b = batches[launches % len(batches)]
-            out = step(p, t, b, d)
-            hashed += per_batch[launches % len(batches)]
+        # Hard guard: if launches run slower than the sizing launch
+        # suggested, stop early and report a partial window rather than
+        # dying on the orchestrator's knife (r3's failure mode).
+        guard = start + max(3 * args.seconds, args.seconds + 30.0)
+        pending: deque = deque()
+        for i in range(target):
+            pending.append(step(p, t, batches[i % len(batches)], d))
+            while len(pending) >= 2:
+                hashed += int(pending.popleft()["n_emitted"])
+                launches += 1
+            if time.perf_counter() > guard:
+                break
+        while pending:
+            hashed += int(pending.popleft()["n_emitted"])
             launches += 1
-        jax.block_until_ready(out)
         elapsed = time.perf_counter() - start
 
     value = hashed / elapsed
     print(f"# {launches} launches, {hashed:.3e} hashes, {elapsed:.2f}s",
           file=sys.stderr)
-    print(json.dumps({
+    record = {
         "metric": metric_name(args.algo),
         "value": value,
         "unit": "hashes/sec",
@@ -241,7 +272,11 @@ def run_worker(args: argparse.Namespace) -> None:
         "lanes": args.lanes,
         "blocks": args.blocks,
         "launches": launches,
-    }))
+        "per_launch_s": round(elapsed / max(launches, 1), 4),
+    }
+    if launches < target:
+        record["partial"] = True
+    print(json.dumps(record))
     sys.stdout.flush()
 
 

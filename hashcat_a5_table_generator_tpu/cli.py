@@ -19,6 +19,19 @@ New surface (the engine lift, ``BASELINE.json`` north star):
 * ``--checkpoint FILE`` / ``--checkpoint-every S`` — resumable sweeps.
 * ``--emit-table NAME`` / ``--list-layouts`` — the layout-map → ``.table``
   emitter (regenerates the reference's checked-in artifacts byte-exactly).
+* ``--coordinator HOST:PORT --num-processes N --process-id I`` — the pod
+  story (SURVEY.md §2.3/§5): every host runs the same command with its own
+  rank; each sweeps a contiguous dictionary stripe on its local devices
+  (``parallel.multihost``), hit records all-gather over DCN, and process 0
+  reports the combined result.  A 2-host crack launch looks like::
+
+      host0$ a5gen rockyou.txt -t qwerty-cyrillic.table --backend device \
+                 --digests left.txt --coordinator host0:8476 \
+                 --num-processes 2 --process-id 0
+      host1$ a5gen rockyou.txt -t qwerty-cyrillic.table --backend device \
+                 --digests left.txt --coordinator host0:8476 \
+                 --num-processes 2 --process-id 1
+
 * ``--progress``, ``--lanes``, ``--blocks``, ``--hex-unsafe``,
   ``--bug-compat`` (reproduce the reference's Q3 reverse-offset bug in the
   oracle), ``--max-word-bytes`` (the anti-Q8 guard, default 64 KiB).
@@ -96,16 +109,37 @@ def build_parser() -> argparse.ArgumentParser:
                     help="variant lanes per device per launch")
     ap.add_argument("--blocks", type=int, default=1024,
                     help="device block slots per launch")
+    ap.add_argument("--packed-blocks", action="store_true",
+                    help="use the tightly-packed variable-offset block "
+                         "layout instead of fixed-stride blocks (stride = "
+                         "lanes/blocks). Packed wastes no lanes on word "
+                         "tails but maps lane->block with a per-lane binary "
+                         "search the TPU serializes; prefer it only for "
+                         "tables whose words have very few variants each")
     ap.add_argument("--devices", type=_devices_arg, default=1, metavar="N",
                     help="shard the sweep over N local devices via a 1-D "
                          "mesh ('auto' = all local devices; default 1)")
-    ap.add_argument("--buckets", type=_buckets_arg, default=(16, 32, 64),
+    ap.add_argument("--buckets", type=_buckets_arg, default="auto",
                     metavar="W1,W2,...",
                     help="length-bucket boundaries for the device backend: "
                          "one compiled program per bucket width, so one "
-                         "long line does not inflate every lane (default "
-                         "16,32,64; 'none' = single global width, strict "
-                         "dictionary-order candidate stream)")
+                         "long line does not inflate every lane. 'none' = "
+                         "single global width, strict dictionary-order "
+                         "candidate stream. Default: 16,32,64 in crack mode "
+                         "(--digests); none in candidates mode, so the "
+                         "stream diffs against the reference without a "
+                         "bucket-major permutation")
+    ap.add_argument("--coordinator", metavar="HOST:PORT",
+                    help="multi-host sweep: jax.distributed coordinator "
+                         "address (run the same command on every host with "
+                         "its own --process-id); each host sweeps a "
+                         "contiguous stripe of the dictionary on its local "
+                         "devices, and hit records are all-gathered over "
+                         "the host network")
+    ap.add_argument("--num-processes", type=int, default=None, metavar="N",
+                    help="multi-host sweep: total participating processes")
+    ap.add_argument("--process-id", type=int, default=None, metavar="I",
+                    help="multi-host sweep: this process's rank in [0, N)")
     ap.add_argument("--profile", metavar="DIR",
                     help="write a jax.profiler trace of the device sweep to "
                          "DIR (inspect with TensorBoard / Perfetto); host "
@@ -130,7 +164,10 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def _buckets_arg(value: str):
-    """--buckets: comma-separated ascending positive widths, or 'none'."""
+    """--buckets: comma-separated ascending widths, 'none', or 'auto'
+    (mode-dependent default: 16,32,64 in crack mode, none in candidates)."""
+    if value == "auto":
+        return "auto"
     if value == "none":
         return None
     try:
@@ -266,15 +303,37 @@ def _run_device(args, sub_map, packed) -> int:
         min_substitute=args.table_min,
         max_substitute=args.table_max,
     )
+    # Multi-host topology comes up FIRST: jax.distributed.initialize must
+    # run before anything initializes the XLA backend (parallel.multihost).
+    pid, nprocs = 0, 1
+    if (
+        args.coordinator is not None
+        or args.num_processes is not None
+        or args.process_id is not None
+    ):
+        from .parallel import multihost
+
+        pid, nprocs = multihost.initialize(
+            args.coordinator, args.num_processes, args.process_id
+        )
+        print(f"{PROG}: distributed process {pid}/{nprocs}", file=sys.stderr)
     bucketed = isinstance(packed, dict)
-    n_words = (
-        sum(p.batch for p in packed.values()) if bucketed else packed.batch
-    )
+    if nprocs > 1:
+        # Each process sweeps (and reports progress over) only its own
+        # dictionary stripe.
+        from .parallel.multihost import stripe_n_words
+
+        n_words = stripe_n_words(packed, nprocs, pid)
+    else:
+        n_words = (
+            sum(p.batch for p in packed.values()) if bucketed else packed.batch
+        )
     progress = ProgressReporter(n_words) if args.progress else None
     cfg = SweepConfig(
         lanes=args.lanes,
         num_blocks=args.blocks,
         devices=args.devices,
+        packed_blocks=args.packed_blocks,
         checkpoint_path=args.checkpoint,
         checkpoint_every_s=args.checkpoint_every,
         progress=progress,
@@ -297,15 +356,46 @@ def _run_device(args, sub_map, packed) -> int:
     with trace_ctx:
         if args.digests is not None:
             digests = _read_digests(args.digests, args.algo)
-            recorder = HitRecorder(sys.stdout.buffer)
-            res = make_sweep(digests).run_crack(
-                recorder, resume=not args.no_resume
-            )
-            print(f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
-                  file=sys.stderr)
+            if nprocs > 1:
+                from .parallel.multihost import run_crack_multihost
+
+                # The combined hit stream is identical on every process;
+                # process 0 is the conventional reporter.
+                recorder = (
+                    HitRecorder(sys.stdout.buffer) if pid == 0 else None
+                )
+                res = run_crack_multihost(
+                    spec, sub_map, packed, digests, cfg,
+                    recorder=recorder, resume=not args.no_resume,
+                )
+            else:
+                recorder = HitRecorder(sys.stdout.buffer)
+                res = make_sweep(digests).run_crack(
+                    recorder, resume=not args.no_resume
+                )
+            if pid == 0:
+                print(
+                    f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
+                    file=sys.stderr,
+                )
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
-            make_sweep().run_candidates(writer, resume=not args.no_resume)
+            if nprocs > 1:
+                from .parallel.multihost import run_candidates_multihost
+
+                # Each process streams ITS stripe to its own stdout;
+                # concatenating the per-host outputs in process order
+                # yields the single-host stream for unbucketed input (the
+                # candidates-mode default). With explicit --buckets each
+                # host's stream is bucket-major over its own stripe, so
+                # the concatenation is a per-word-preserving permutation
+                # of the single-host bucket-major stream.
+                run_candidates_multihost(
+                    spec, sub_map, packed, writer, cfg,
+                    resume=not args.no_resume,
+                )
+            else:
+                make_sweep().run_candidates(writer, resume=not args.no_resume)
     return 0
 
 
@@ -352,6 +442,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             (args.progress, "--progress"),
             (args.devices != 1, "--devices"),
             (args.profile, "--profile"),
+            (args.coordinator is not None, "--coordinator"),
+            (args.num_processes is not None, "--num-processes"),
+            (args.process_id is not None, "--process-id"),
         ):
             if flag:
                 print(
@@ -375,12 +468,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # path (numpy fallback engages transparently when unavailable).
         from . import native
 
+        if args.buckets == "auto":
+            # Crack mode gets the perf default (per-width compiled programs);
+            # candidates mode defaults to one global width so the stream
+            # keeps strict dictionary order — diffable against the
+            # reference without a bucket-major permutation.
+            args.buckets = (16, 32, 64) if args.digests is not None else None
         if args.buckets is not None:
             packed = native.read_packed_buckets(
                 args.dict_file,
                 buckets=args.buckets,
                 max_word_bytes=args.max_word_bytes,
             )
+            if args.digests is None and sum(
+                1 for p in packed.values() if p.batch
+            ) > 1:
+                print(
+                    f"{PROG}: notice: --buckets reorders a mixed-length "
+                    "candidate stream bucket-major (per-word multisets "
+                    "unchanged); pass --buckets none for strict "
+                    "dictionary order",
+                    file=sys.stderr,
+                )
         else:
             packed = native.read_packed(
                 args.dict_file, max_word_bytes=args.max_word_bytes

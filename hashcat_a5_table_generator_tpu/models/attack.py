@@ -130,13 +130,15 @@ def digest_arrays(ds: DigestSet) -> Dict[str, jnp.ndarray]:
     return {"rows": jnp.asarray(ds.rows), "bitmap": jnp.asarray(ds.bitmap)}
 
 
-def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width):
+def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width,
+            block_stride=None):
     """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit)."""
     common = dict(
         num_lanes=num_lanes,
         out_width=out_width,
         min_substitute=spec.effective_min,
         max_substitute=spec.max_substitute,
+        block_stride=block_stride,
     )
     if spec.mode in ("default", "reverse"):
         return expand_matches(
@@ -155,18 +157,24 @@ def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width):
     )
 
 
-def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int):
+def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
+                    block_stride: int | None = None):
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
     ``body(plan, table, digests, blocks) -> dict`` with per-lane ``hit`` /
     ``emit`` masks, per-lane ``word_row``, and *local* scalar counts.
+
+    ``block_stride``: static lanes-per-block for fixed-stride batches
+    (``make_blocks(fixed_stride=...)``) — the TPU fast path; ``None`` keeps
+    the variable-offset layout.
     """
     hash_fn = HASH_FNS[spec.algo]
 
     def body(plan, table, digests, blocks):
         cand, cand_len, word_row, emit = _expand(
-            spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
+            spec, plan, table, blocks, num_lanes=num_lanes,
+            out_width=out_width, block_stride=block_stride,
         )
         state = hash_fn(cand, cand_len)
         member = digest_member(state, digests["rows"], digests["bitmap"])
@@ -182,13 +190,15 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int):
     return body
 
 
-def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
+def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
+                    block_stride: int | None = None):
     """Build the fused expand->hash->match step (single device).
 
     Returns ``step(plan, table, blocks, digests) -> dict`` with per-lane
     ``hit``/``emit`` masks, per-lane ``word_row``, and scalar counts.
     """
-    body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width)
+    body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width,
+                           block_stride=block_stride)
 
     def step(plan, table, blocks, digests):
         return body(plan, table, digests, blocks)
@@ -196,7 +206,8 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
     return jax.jit(step)
 
 
-def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int):
+def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
+                         block_stride: int | None = None):
     """The un-jitted expand-only body, shared by the single-device
     candidates step and the shard_map'd candidates step.
 
@@ -205,19 +216,22 @@ def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int):
 
     def body(plan, table, blocks):
         return _expand(
-            spec, plan, table, blocks, num_lanes=num_lanes, out_width=out_width
+            spec, plan, table, blocks, num_lanes=num_lanes,
+            out_width=out_width, block_stride=block_stride,
         )
 
     return body
 
 
-def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int):
+def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
+                         block_stride: int | None = None):
     """Build the expand-only step for the stdout-candidates sink.
 
     Returns ``step(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
     """
     return jax.jit(
-        make_candidates_body(spec, num_lanes=num_lanes, out_width=out_width)
+        make_candidates_body(spec, num_lanes=num_lanes, out_width=out_width,
+                             block_stride=block_stride)
     )
 
 

@@ -56,13 +56,26 @@ def make_blocks(
     max_variants: int,
     max_block: int = MAX_BLOCK,
     max_blocks: int | None = None,
+    fixed_stride: int | None = None,
 ) -> Tuple[BlockBatch, int, int]:
     """Cut up to ``max_variants`` of the plan's variant space into blocks,
     starting at (start_word, start_rank). Returns (batch, next_word,
     next_rank) — the resume cursor. Fallback words are skipped (the runtime
     routes them through the oracle). ``max_blocks`` caps the number of blocks
     cut (the budget may go unfilled) so callers can pad to a static block
-    count and keep jit shapes stable across launches."""
+    count and keep jit shapes stable across launches.
+
+    ``fixed_stride``: the TPU-fast layout — every block owns exactly
+    ``stride`` consecutive LANES (``offset[b] == b * stride``) and at most
+    ``stride`` variants, so the device maps lane -> block with one constant
+    divide instead of a per-lane binary search, and block fields broadcast
+    per block instead of gathering per lane (``expand_matches.block_stride``;
+    see PERF.md). A word's final partial block leaves its tail lanes masked
+    — that is the price, bounded by ``stride/2`` lanes per word on average.
+    ``max_variants`` then budgets lane SPAN (``stride`` per block), matching
+    the launch's lane count, and ``max_block`` is ignored (``stride`` caps
+    every block).
+    """
     words: List[int] = []
     bases: List[List[int]] = []
     counts: List[int] = []
@@ -72,27 +85,42 @@ def make_blocks(
     while w < plan.batch and budget > 0:
         if max_blocks is not None and len(words) >= max_blocks:
             break
+        if fixed_stride is not None and budget < fixed_stride:
+            break
         total = plan.n_variants[w]
         if plan.fallback[w] or rank >= total:
             w, rank = w + 1, 0
             continue
-        take = min(budget, total - rank, max_block)
+        if fixed_stride is not None:
+            take = min(fixed_stride, total - rank)
+            spent = fixed_stride
+        else:
+            take = min(budget, total - rank, max_block)
+            spent = take
         radices = [int(plan.pat_radix[w, s]) for s in range(p)]
         words.append(w)
         bases.append(digits_of(rank, radices))
         counts.append(take)
-        budget -= take
+        budget -= spent
         rank += take
         if rank >= total:
             w, rank = w + 1, 0
     counts_arr = np.asarray(counts, dtype=np.int32)
+    if fixed_stride is not None:
+        offset = (
+            np.arange(len(counts), dtype=np.int32) * np.int32(fixed_stride)
+        )
+    elif len(counts):
+        offset = np.concatenate([[0], np.cumsum(counts_arr[:-1])]).astype(
+            np.int32
+        )
+    else:
+        offset = np.zeros((0,), dtype=np.int32)
     batch = BlockBatch(
         word=np.asarray(words, dtype=np.int32),
         base_digits=np.asarray(bases, dtype=np.int32).reshape(len(words), p),
         count=counts_arr,
-        offset=np.concatenate([[0], np.cumsum(counts_arr[:-1])]).astype(np.int32)
-        if len(counts)
-        else np.zeros((0,), dtype=np.int32),
+        offset=offset,
     )
     return batch, w, rank
 

@@ -159,6 +159,69 @@ def build_match_plan(
     )
 
 
+def per_lane_broadcast(num_blocks: int, stride: int):
+    """Block-field -> lane-field expander for the fixed-stride layout:
+    ``x[NB, ...] -> x[NB * stride, ...]`` by tiling each block's row over its
+    ``stride`` lanes — a broadcast XLA fuses into consumers, replacing the
+    per-lane gathers (``field[blk]``) the variable-offset layout needs."""
+
+    def per_lane(x: jnp.ndarray) -> jnp.ndarray:
+        tiled = jnp.broadcast_to(
+            x[:, None], (num_blocks, stride) + x.shape[1:]
+        )
+        return tiled.reshape((num_blocks * stride,) + x.shape[1:])
+
+    return per_lane
+
+
+def lane_fields(
+    blk_word, blk_base, blk_count, blk_offset, *, num_lanes, block_stride
+):
+    """Lane -> block resolution shared by both expansion kernels.
+
+    Returns ``(rank, lane_ok, w, base, field)``: per-lane in-block rank,
+    validity mask, word row, mixed-radix base digits, and ``field(x)``
+    expanding a per-word array ``x[B, ...]`` to per-lane ``[N, ...]``.
+
+    ``block_stride`` set (fixed-stride batches, ``make_blocks(fixed_stride)``)
+    is the TPU-critical path: lane -> block is one constant divide (XLA
+    strength-reduces it) and block fields broadcast over the stride. The
+    variable-offset path (``None``) binary-searches ``blk_offset`` per lane —
+    on TPU that ``searchsorted`` lowers to a sequential ``while`` loop that
+    alone cost 57% of the fused step at 2^19 lanes (PERF.md).
+    """
+    n = num_lanes
+    v = jnp.arange(n, dtype=jnp.int32)
+    if block_stride is not None:
+        nb = n // block_stride
+        if nb * block_stride != n or blk_offset.shape[0] != nb:
+            raise ValueError(
+                f"block_stride {block_stride} needs num_lanes divisible and "
+                f"exactly {n} // stride = {nb} blocks, got "
+                f"{blk_offset.shape[0]}"
+            )
+        per_lane = per_lane_broadcast(nb, block_stride)
+        blk = v // np.int32(block_stride)
+        rank = v - blk * np.int32(block_stride)
+        lane_ok = rank < per_lane(blk_count)
+        w = per_lane(blk_word)
+        base = per_lane(blk_base)
+        field = lambda x: per_lane(x[blk_word])  # noqa: E731
+    else:
+        blk = jnp.clip(
+            jnp.searchsorted(blk_offset, v, side="right").astype(jnp.int32)
+            - 1,
+            0,
+            max(blk_offset.shape[0] - 1, 0),
+        )
+        rank = v - blk_offset[blk]
+        lane_ok = rank < blk_count[blk]
+        w = blk_word[blk]
+        base = blk_base[blk]
+        field = lambda x: x[w]  # noqa: E731
+    return rank, lane_ok, w, base, field
+
+
 def expand_matches(
     tokens: jnp.ndarray,  # uint8 [B, L]
     lengths: jnp.ndarray,  # int32 [B]
@@ -177,6 +240,7 @@ def expand_matches(
     out_width: int,
     min_substitute: int,
     max_substitute: int,
+    block_stride: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -185,23 +249,30 @@ def expand_matches(
     non-overlap constraint, and the chosen-count window. Callers pass the
     *effective* window: default mode's Q1 bump (``min 0 -> 1``) happens in the
     caller, reverse mode passes ``min`` through.
+
+    ``block_stride``: static lanes-per-block of a fixed-stride batch
+    (``make_blocks(fixed_stride=...)``). The TPU-critical path: lane ->
+    block becomes one constant divide (XLA strength-reduces it) and every
+    block field broadcasts over its stride instead of gathering per lane.
+    The variable-offset path (``None``) keeps the per-lane ``searchsorted``
+    + gathers — on TPU that binary search lowers to a sequential ``while``
+    loop that alone cost 57% of the fused step at 2^19 lanes (PERF.md).
     """
     n = num_lanes
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
 
     v = jnp.arange(n, dtype=jnp.int32)
-    blk = jnp.clip(
-        jnp.searchsorted(blk_offset, v, side="right").astype(jnp.int32) - 1,
-        0,
-        max(blk_offset.shape[0] - 1, 0),
+    rank, lane_ok, w, base, field = lane_fields(
+        blk_word, blk_base, blk_count, blk_offset,
+        num_lanes=n, block_stride=block_stride,
     )
-    rank = v - blk_offset[blk]
-    lane_ok = rank < blk_count[blk]
-    w = blk_word[blk]  # int32 [N]
-
-    radix = match_radix[w]  # [N, M]
-    base = blk_base[blk]  # [N, M]
+    radix = field(match_radix)  # [N, M]
+    pos_w = field(match_pos)  # [N, M]
+    len_w = field(match_len)
+    mvs_w = field(match_val_start)
+    tokens_w = field(tokens)  # [N, L]
+    lengths_w = field(lengths)  # [N]
 
     # digits = base + mixed-radix(rank), slot 0 least significant, with carry.
     digits = []
@@ -219,41 +290,44 @@ def expand_matches(
     chosen_count = jnp.sum(chosen, axis=1)
 
     # Per-match selected value rows/lengths.
-    opt_row = match_val_start[w] + digits - 1  # valid where chosen
+    opt_row = mvs_w + digits - 1  # valid where chosen
     opt_row = jnp.where(chosen, opt_row, 0)
     vlen = jnp.where(chosen, val_len[opt_row], 0)  # [N, M]
 
     # Output units per original byte position j: a chosen match starting at j
     # contributes its value's bytes; an uncovered j contributes tokens[w, j].
-    pos_w = match_pos[w]  # [N, M]
-    len_w = match_len[w]
+    #
+    # TPU-critical formulation: everything below is unrolled compare-and-
+    # accumulate over the STATIC slot axis M and length axis L — never
+    # ``.at[].add`` scatters and never per-lane ``searchsorted``. XLA lowers
+    # scatters with duplicate indices to serialized updates on TPU (measured
+    # ~5 µs/lane at 2^19 lanes — the whole kernel's cost, see PERF.md); the
+    # compare loops fuse into a handful of vectorized [N, L] passes.
     end_w = pos_w + len_w
-    lane_idx = jnp.broadcast_to(v[:, None], (n, m))
-    cov_delta = jnp.zeros((n, length_axis + 1), dtype=jnp.int32)
-    cov_delta = cov_delta.at[lane_idx, pos_w].add(chosen.astype(jnp.int32))
-    cov_delta = cov_delta.at[lane_idx, end_w].add(-chosen.astype(jnp.int32))
-    cover_count = jnp.cumsum(cov_delta[:, :length_axis], axis=1)  # [N, L]
+    j = jnp.arange(length_axis, dtype=jnp.int32)[None, :]  # [1, L]
+
+    cover_count = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    started = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    start_vlen = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    start_vrow = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    for s in range(m):
+        c_s = chosen[:, s : s + 1]  # [N, 1] bool
+        p_s = pos_w[:, s : s + 1]
+        inside = (c_s & (j >= p_s) & (j < end_w[:, s : s + 1])).astype(
+            jnp.int32
+        )
+        cover_count = cover_count + inside
+        at_start = (c_s & (j == p_s)).astype(jnp.int32)
+        started = started + at_start
+        start_vlen = start_vlen + at_start * vlen[:, s : s + 1]
+        start_vrow = start_vrow + at_start * opt_row[:, s : s + 1]
     covered = cover_count > 0
     # Non-overlap constraint: chosen matches are pairwise disjoint iff no byte
     # is covered twice (adjacency is allowed — touching intervals never share
     # a byte). This replaces any explicit [M, M] interval-pair test.
     clash = jnp.any(cover_count > 1, axis=1)
 
-    started = jnp.zeros((n, length_axis), dtype=jnp.int32)
-    started = started.at[lane_idx, jnp.minimum(pos_w, length_axis - 1)].add(
-        chosen.astype(jnp.int32)
-    )
-    start_vlen = jnp.zeros((n, length_axis), dtype=jnp.int32)
-    start_vlen = start_vlen.at[lane_idx, jnp.minimum(pos_w, length_axis - 1)].add(
-        vlen
-    )
-    start_vrow = jnp.zeros((n, length_axis), dtype=jnp.int32)
-    start_vrow = start_vrow.at[lane_idx, jnp.minimum(pos_w, length_axis - 1)].add(
-        jnp.where(chosen, opt_row, 0)
-    )
-
-    j = jnp.arange(length_axis, dtype=jnp.int32)[None, :]
-    in_word = j < lengths[w][:, None]
+    in_word = j < lengths_w[:, None]
     # unit_len: a chosen match's start contributes its value's length (the
     # position itself is covered, so no original byte); covered non-start
     # bytes contribute 0; uncovered bytes pass through as 1 original byte.
@@ -265,20 +339,25 @@ def expand_matches(
     cum = jnp.cumsum(unit_len, axis=1)  # inclusive ends [N, L]
     out_len = cum[:, -1]
 
-    # For each output column o, locate its source unit j.
-    o = jnp.arange(out_width, dtype=jnp.int32)
-    j_of_o = jax.vmap(lambda c: jnp.searchsorted(c, o, side="right"))(cum)
-    j_of_o = jnp.clip(j_of_o, 0, length_axis - 1).astype(jnp.int32)
-
-    take = lambda a: jnp.take_along_axis(a, j_of_o, axis=1)  # noqa: E731
-    rel = o[None, :] - (take(cum) - take(unit_len))
-    is_start = take(started) > 0
-    vrow = take(start_vrow)
+    # For each output column o, locate its source unit j and gather that
+    # unit's fields — one unrolled pass over L replaces the vmap'd
+    # searchsorted AND the four take_along_axis row gathers.
+    o = jnp.arange(out_width, dtype=jnp.int32)[None, :]  # [1, W]
+    unit_start = cum - unit_len  # output offset where unit j begins
+    src_rel = jnp.zeros((n, out_width), dtype=jnp.int32)
+    src_is_start = jnp.zeros((n, out_width), dtype=jnp.bool_)
+    src_vrow = jnp.zeros((n, out_width), dtype=jnp.int32)
+    src_byte = jnp.zeros((n, out_width), dtype=jnp.uint8)
+    for jj in range(length_axis):
+        sel = (unit_start[:, jj : jj + 1] <= o) & (o < cum[:, jj : jj + 1])
+        src_rel = jnp.where(sel, o - unit_start[:, jj : jj + 1], src_rel)
+        src_is_start = src_is_start | (sel & (started[:, jj : jj + 1] > 0))
+        src_vrow = jnp.where(sel, start_vrow[:, jj : jj + 1], src_vrow)
+        src_byte = jnp.where(sel, tokens_w[:, jj : jj + 1], src_byte)
     vw = val_bytes.shape[1]
-    from_val = val_bytes[vrow, jnp.clip(rel, 0, vw - 1)]
-    from_word = tokens[w[:, None], j_of_o]
-    out = jnp.where(is_start, from_val, from_word)
-    out = jnp.where(o[None, :] < out_len[:, None], out, jnp.uint8(0))
+    from_val = val_bytes[src_vrow, jnp.clip(src_rel, 0, vw - 1)]
+    out = jnp.where(src_is_start, from_val, src_byte)
+    out = jnp.where(o < out_len[:, None], out, jnp.uint8(0))
 
     emit = (
         lane_ok

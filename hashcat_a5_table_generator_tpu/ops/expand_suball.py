@@ -55,6 +55,7 @@ from .blocks import (  # noqa: F401  — re-exported: this module defined them f
     digits_of,
     make_blocks,
 )
+from .expand_matches import lane_fields
 from .packing import PackedWords
 
 
@@ -226,29 +227,32 @@ def expand_suball(
     out_width: int,
     min_substitute: int,
     max_substitute: int,
+    block_stride: int | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
     Returns ``(cand uint8[N, out_width], cand_len int32[N], word_row int32[N],
     emit bool[N])`` — ``emit`` folds together lane validity (rank in range)
     and the min/max chosen-pattern-count window.
+
+    ``block_stride``: fixed-stride batch layout — constant-divide lane ->
+    block plus per-block broadcasts instead of per-lane searchsorted +
+    gathers (see ``expand_matches.expand_matches``).
     """
     n = num_lanes
     p = pat_radix.shape[1]
     g = seg_orig_start.shape[1]
 
-    v = jnp.arange(n, dtype=jnp.int32)
-    blk = jnp.clip(
-        jnp.searchsorted(blk_offset, v, side="right").astype(jnp.int32) - 1,
-        0,
-        max(blk_offset.shape[0] - 1, 0),
+    rank, lane_ok, w, base, field = lane_fields(
+        blk_word, blk_base, blk_count, blk_offset,
+        num_lanes=n, block_stride=block_stride,
     )
-    rank = v - blk_offset[blk]
-    lane_ok = rank < blk_count[blk]
-    w = blk_word[blk]  # int32 [N]
-
-    radix = pat_radix[w]  # [N, P]
-    base = blk_base[blk]  # [N, P]
+    radix = field(pat_radix)  # [N, P]
+    spat_w = field(seg_pat)  # [N, G]
+    pvs_w = field(pat_val_start)  # [N, P]
+    olen_w = field(seg_orig_len)  # [N, G]
+    ostart_w = field(seg_orig_start)  # [N, G]
+    tokens_w = field(tokens)  # [N, L]
 
     # digits = base + mixed-radix(rank), slot 0 least significant, with carry.
     digits = []
@@ -266,19 +270,17 @@ def expand_suball(
     chosen_count = jnp.sum((digits > 0) & active, axis=1)
 
     # Per-segment output lengths and value rows for this variant.
-    spat = seg_pat[w]  # [N, G]
-    is_span = spat >= 0
+    is_span = spat_w >= 0
     seg_digit = jnp.take_along_axis(
-        digits, jnp.where(is_span, spat, 0), axis=1
+        digits, jnp.where(is_span, spat_w, 0), axis=1
     )
     seg_digit = jnp.where(is_span, seg_digit, 0)
     chosen = seg_digit > 0
     vstart = jnp.take_along_axis(
-        pat_val_start[w], jnp.where(is_span, spat, 0), axis=1
+        pvs_w, jnp.where(is_span, spat_w, 0), axis=1
     )
     opt_row = jnp.where(chosen, vstart + seg_digit - 1, 0)
-    o_len = seg_orig_len[w]
-    seg_len = jnp.where(chosen, val_len[opt_row], o_len)  # [N, G]
+    seg_len = jnp.where(chosen, val_len[opt_row], olen_w)  # [N, G]
 
     seg_end = jnp.cumsum(seg_len, axis=1)  # inclusive ends [N, G]
     out_len = seg_end[:, -1]
@@ -295,14 +297,14 @@ def expand_suball(
     rel = j - take(seg_start_out)
     rep = take(chosen.astype(jnp.int32)) > 0
     src_val_row = take(opt_row)
-    src_orig = take(seg_orig_start[w]) + rel
+    src_orig = take(ostart_w) + rel
 
     vw = val_bytes.shape[1]
     from_val = val_bytes[src_val_row, jnp.clip(rel, 0, vw - 1)]
     lw = tokens.shape[1]
-    from_word = tokens[
-        w[:, None], jnp.clip(src_orig, 0, lw - 1)
-    ]
+    from_word = jnp.take_along_axis(
+        tokens_w, jnp.clip(src_orig, 0, lw - 1), axis=1
+    )
     out = jnp.where(rep, from_val, from_word)
     out = jnp.where(j < out_len[:, None], out, jnp.uint8(0))
 

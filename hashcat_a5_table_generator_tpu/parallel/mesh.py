@@ -55,6 +55,7 @@ def make_device_blocks(
     start_word: int = 0,
     start_rank: int = 0,
     max_blocks: int | None = None,
+    fixed_stride: int | None = None,
 ) -> Tuple[List[BlockBatch], int, int]:
     """Cut one launch's work: ``n_devices`` equal-budget block batches.
 
@@ -63,7 +64,8 @@ def make_device_blocks(
     Devices later in the list may receive empty batches near the end of the
     sweep; those lanes are masked out by ``emit``. ``max_blocks`` caps each
     device's block count (pair with ``stack_blocks(..., num_blocks=...)`` for
-    launch-to-launch jit shape stability).
+    launch-to-launch jit shape stability). ``fixed_stride`` selects the
+    fixed-lanes-per-block layout (``ops.blocks.make_blocks``).
     """
     batches = []
     w, rank = start_word, start_rank
@@ -74,6 +76,7 @@ def make_device_blocks(
             start_rank=rank,
             max_variants=lanes_per_device,
             max_blocks=max_blocks,
+            fixed_stride=fixed_stride,
         )
         batches.append(batch)
     return batches, w, rank
@@ -121,6 +124,7 @@ def make_sharded_crack_step(
     lanes_per_device: int,
     out_width: int,
     axis_name: str = "data",
+    block_stride: int | None = None,
 ):
     """The fused crack step, shard_map'd over a 1-D mesh.
 
@@ -130,7 +134,8 @@ def make_sharded_crack_step(
     scalar counts (replicated).
     """
     body = make_fused_body(
-        spec, num_lanes=lanes_per_device, out_width=out_width
+        spec, num_lanes=lanes_per_device, out_width=out_width,
+        block_stride=block_stride,
     )
 
     def local_step(plan, table, digests, blocks):
@@ -165,6 +170,7 @@ def make_sharded_candidates_step(
     lanes_per_device: int,
     out_width: int,
     axis_name: str = "data",
+    block_stride: int | None = None,
 ):
     """The expand-only step, shard_map'd over a 1-D mesh.
 
@@ -178,7 +184,8 @@ def make_sharded_candidates_step(
     with every output sharded on its leading axis.
     """
     local_step = make_candidates_body(
-        spec, num_lanes=lanes_per_device, out_width=out_width
+        spec, num_lanes=lanes_per_device, out_width=out_width,
+        block_stride=block_stride,
     )
 
     rep = P()

@@ -220,6 +220,43 @@ def _host_config(config, process_id: int):
     )
 
 
+def stripe_n_words(packed, num_processes: int, process_id: int) -> int:
+    """Word count of one host's stripe — the same striping policy
+    :func:`_local_sweep` executes (per-bucket independent stripes for
+    bucketed input), so progress totals always match the words actually
+    swept."""
+    if isinstance(packed, dict):
+        return sum(
+            stripe_n_words(p, num_processes, process_id)
+            for p in packed.values()
+        )
+    lo, hi = host_stripe(packed.batch, num_processes, process_id)
+    return hi - lo
+
+
+def _local_sweep(spec, sub_map, packed, digests, config, pid: int,
+                 nprocs: int):
+    """This host's sweep over its stripe.  ``packed`` is a flat
+    :class:`PackedWords` batch or a ``{width: PackedWords}`` bucket dict
+    (the CLI's native fast path) — bucketed input stripes each bucket
+    independently, which balances per-bucket work across hosts and keeps
+    every stripe's linear (word, rank) cursor."""
+    cfg = _host_config(config, pid)
+    if isinstance(packed, dict):
+        from ..runtime.bucketed import BucketedSweep
+
+        local = {
+            width: stripe_packed(p, *host_stripe(p.batch, nprocs, pid))
+            for width, p in packed.items()
+        }
+        return BucketedSweep(spec, sub_map, local, digests, config=cfg)
+    from ..runtime.sweep import Sweep
+
+    lo, hi = host_stripe(packed.batch, nprocs, pid)
+    return Sweep(spec, sub_map, stripe_packed(packed, lo, hi), digests,
+                 config=cfg)
+
+
 def run_crack_multihost(
     spec,
     sub_map: Dict[bytes, List[bytes]],
@@ -232,22 +269,19 @@ def run_crack_multihost(
 ):
     """The fused crack sweep at pod scale.
 
-    Every process calls this with the SAME full wordlist (cheap: packed
-    arrays), sweeps its own stripe on its local devices, then all processes
+    Every process calls this with the SAME full wordlist — a flat
+    :class:`PackedWords` batch or a ``{width: PackedWords}`` bucket dict —
+    sweeps its own stripe on its local devices, then all processes
     exchange hit records and return the same combined SweepResult.  The
     recorder (process-local; typically only given on process 0) receives
     the combined, globally-sorted hit stream.
     """
     import jax
 
-    from ..runtime.sweep import Sweep, SweepResult
+    from ..runtime.sweep import SweepResult
 
     pid, nprocs = jax.process_index(), jax.process_count()
-    lo, hi = host_stripe(packed.batch, nprocs, pid)
-    local = stripe_packed(packed, lo, hi)
-    sweep = Sweep(
-        spec, sub_map, local, digests, config=_host_config(config, pid)
-    )
+    sweep = _local_sweep(spec, sub_map, packed, digests, config, pid, nprocs)
     res = sweep.run_crack(resume=resume)
     all_hits = gather_hits(res.hits)
     if recorder is not None:
@@ -276,18 +310,20 @@ def run_candidates_multihost(
 ):
     """Candidates mode at pod scale: each host streams ITS OWN stripe to its
     local writer (stripe-local dictionary order).  Candidate streams never
-    cross DCN — concatenating the per-host outputs in process order yields
-    the single-host stream.  Returns this host's SweepResult with
-    global emitted/words counts.
+    cross DCN — for flat (unbucketed) input, concatenating the per-host
+    outputs in process order yields exactly the single-host stream.  For
+    bucketed input each host's stream is bucket-major over its own stripe,
+    so the concatenation is a per-word-multiset-preserving permutation of
+    the single-host bucket-major stream (word order holds within each
+    host×bucket run).  Returns this host's SweepResult with global
+    emitted/words counts.
     """
     import jax
 
-    from ..runtime.sweep import Sweep, SweepResult
+    from ..runtime.sweep import SweepResult
 
     pid, nprocs = jax.process_index(), jax.process_count()
-    lo, hi = host_stripe(packed.batch, nprocs, pid)
-    local = stripe_packed(packed, lo, hi)
-    sweep = Sweep(spec, sub_map, local, config=_host_config(config, pid))
+    sweep = _local_sweep(spec, sub_map, packed, (), config, pid, nprocs)
     res = sweep.run_candidates(writer, resume=resume)
     return SweepResult(
         n_emitted=allgather_sum(res.n_emitted),

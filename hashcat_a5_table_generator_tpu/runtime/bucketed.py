@@ -29,6 +29,7 @@ Semantics vs a single-width sweep:
 from __future__ import annotations
 
 import time
+from dataclasses import replace
 from typing import Dict, List, Optional, Sequence
 
 from ..ops.packing import PackedWords
@@ -111,17 +112,13 @@ class BucketedSweep:
             if packed.batch == 0:
                 continue
             cfg = self.config
-            bucket_cfg = SweepConfig(
-                lanes=cfg.lanes,
-                num_blocks=cfg.num_blocks,
-                max_in_flight=cfg.max_in_flight,
-                devices=cfg.devices,
+            bucket_cfg = replace(
+                cfg,
                 checkpoint_path=(
                     f"{cfg.checkpoint_path}.w{width}"
                     if cfg.checkpoint_path
                     else None
                 ),
-                checkpoint_every_s=cfg.checkpoint_every_s,
                 progress=self.progress,
             )
             self.sweeps[width] = Sweep(

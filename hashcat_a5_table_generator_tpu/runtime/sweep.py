@@ -80,9 +80,22 @@ class SweepConfig:
     max_in_flight: int = 2  # double-buffered launches
     devices: Optional[int] = 1  # 1 = single-device; N = shard over first N
     #                             local devices; None = all local devices
+    packed_blocks: bool = False  # True = variable-offset (tightly packed)
+    #   block layout; False = fixed-stride blocks (stride = lanes //
+    #   num_blocks) whenever lanes divides evenly — the TPU fast path: the
+    #   kernels map lane -> block arithmetically instead of binary-searching
+    #   per lane (PERF.md). Tail lanes of each word's last block are masked,
+    #   so packed may win for tables whose words have very few variants.
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
+
+    @property
+    def block_stride(self) -> Optional[int]:
+        """Lanes-per-block of the fixed-stride layout; None = packed."""
+        if self.packed_blocks or self.lanes % self.num_blocks:
+            return None
+        return self.lanes // self.num_blocks
 
 
 @dataclass
@@ -182,14 +195,16 @@ class Sweep:
             p, t = plan_arrays(plan), table_arrays(self.ct)
             if kind == "crack":
                 step = make_crack_step(
-                    spec, num_lanes=cfg.lanes, out_width=plan.out_width
+                    spec, num_lanes=cfg.lanes, out_width=plan.out_width,
+                    block_stride=cfg.block_stride,
                 )
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
                 )
                 return (lambda blocks: step(p, t, blocks, darrs)), 1, None
             step = make_candidates_step(
-                spec, num_lanes=cfg.lanes, out_width=plan.out_width
+                spec, num_lanes=cfg.lanes, out_width=plan.out_width,
+                block_stride=cfg.block_stride,
             )
             return (lambda blocks: step(p, t, blocks)), 1, None
 
@@ -204,7 +219,7 @@ class Sweep:
         if kind == "crack":
             step = make_sharded_crack_step(
                 spec, mesh, lanes_per_device=cfg.lanes,
-                out_width=plan.out_width,
+                out_width=plan.out_width, block_stride=cfg.block_stride,
             )
             p, t, darrs = replicate(
                 mesh,
@@ -216,7 +231,8 @@ class Sweep:
             )
             return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
         step = make_sharded_candidates_step(
-            spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width
+            spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width,
+            block_stride=cfg.block_stride,
         )
         p, t = replicate(mesh, (plan_arrays(plan), table_arrays(self.ct)))
         return (lambda blocks: step(p, t, blocks)), n_devices, mesh
@@ -247,6 +263,7 @@ class Sweep:
                         start_rank=rank,
                         max_variants=lanes,
                         max_blocks=cfg.num_blocks,
+                        fixed_stride=cfg.block_stride,
                     )
                     if batch.total == 0:
                         break
@@ -266,6 +283,7 @@ class Sweep:
                         start_word=w,
                         start_rank=rank,
                         max_blocks=cfg.num_blocks,
+                        fixed_stride=cfg.block_stride,
                     )
                     if sum(b.total for b in batches) == 0:
                         break

@@ -1,0 +1,106 @@
+"""Stage decomposition on the live device: time expand-only, hash-only,
+membership-only, and the full fused step at one geometry.  Evidence for
+PERF.md; not part of the package."""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays,
+    make_candidates_step, make_crack_step, plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.hashes import HASH_FNS
+from hashcat_a5_table_generator_tpu.ops.membership import (
+    build_digest_set, digest_member,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+LANES = 1 << 19
+BLOCKS = 4096
+
+
+def timeit(fn, *args):
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return compile_s, min(times)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    packed = pack_words(synth_wordlist(20000))
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set(
+        [HOST_DIGEST["md5"](b"bench-decoy-%d" % i) for i in range(1024)], "md5"
+    )
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    batch, _, _ = make_blocks(plan, start_word=0, start_rank=0,
+                              max_variants=LANES, max_blocks=BLOCKS)
+    b = block_arrays(batch, num_blocks=BLOCKS)
+    w = plan.out_width
+
+    # Full fused step
+    step = make_crack_step(spec, num_lanes=LANES, out_width=w)
+    c, r = timeit(step, p, t, b, d)
+    print(json.dumps({"stage": "fused", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4)}))
+    sys.stdout.flush()
+
+    # Expand only
+    cstep = make_candidates_step(spec, num_lanes=LANES, out_width=w)
+    c, r = timeit(cstep, p, t, b)
+    print(json.dumps({"stage": "expand", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4)}))
+    sys.stdout.flush()
+
+    # Hash only (fixed candidate buffer)
+    cand = jnp.asarray(
+        np.random.default_rng(0).integers(97, 123, size=(LANES, w),
+                                          dtype=np.uint8))
+    clen = jnp.full((LANES,), w - 2, dtype=jnp.int32)
+    hash_fn = jax.jit(HASH_FNS["md5"])
+    c, r = timeit(hash_fn, cand, clen)
+    print(json.dumps({"stage": "hash", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4)}))
+    sys.stdout.flush()
+
+    # Membership only (fixed state)
+    state = jnp.asarray(
+        np.random.default_rng(1).integers(0, 2**32, size=(LANES, 4),
+                                          dtype=np.uint64).astype(np.uint32))
+    mem_fn = jax.jit(lambda s, rows, bm: digest_member(s, rows, bm))
+    c, r = timeit(mem_fn, state, d["rows"], d["bitmap"])
+    print(json.dumps({"stage": "membership", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4)}))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Stage decomposition, round 2: on the axon tunnel ``block_until_ready`` can
+return immediately, so every measurement here forces a device->host SCALAR
+fetch per launch and cycles distinct batches to defeat any result caching.
+Evidence for PERF.md; not part of the package."""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays, make_fused_body,
+    make_candidates_body, plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.hashes import HASH_FNS
+from hashcat_a5_table_generator_tpu.ops.membership import (
+    build_digest_set, digest_member,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+LANES = 1 << 19
+BLOCKS = 4096
+STRIDE = LANES // BLOCKS
+REPS = 6
+
+
+def bench_scalar(fn, argsets):
+    """fn returns a SCALAR device array; fetch it per launch (true sync)."""
+    # warmup/compile
+    t0 = time.perf_counter()
+    _ = float(fn(*argsets[0]))
+    compile_s = time.perf_counter() - t0
+    times = []
+    for i in range(REPS):
+        args = argsets[i % len(argsets)]
+        t0 = time.perf_counter()
+        _ = float(fn(*args))
+        times.append(time.perf_counter() - t0)
+    return compile_s, min(times), times
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    packed = pack_words(synth_wordlist(20000))
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set(
+        [HOST_DIGEST["md5"](b"bench-decoy-%d" % i) for i in range(1024)], "md5"
+    )
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+
+    batches = []
+    w = rank = 0
+    for _ in range(3):
+        batch, w, rank = make_blocks(plan, start_word=w, start_rank=rank,
+                                     max_variants=LANES, max_blocks=BLOCKS,
+                                     fixed_stride=STRIDE)
+        batches.append(block_arrays(batch, num_blocks=BLOCKS))
+    ow = plan.out_width
+
+    fused = make_fused_body(spec, num_lanes=LANES, out_width=ow,
+                            block_stride=STRIDE)
+    fused_scalar = jax.jit(
+        lambda p_, t_, d_, b_: fused(p_, t_, d_, b_)["n_emitted"]
+    )
+    c, r, ts = bench_scalar(fused_scalar, [(p, t, d, b) for b in batches])
+    print(json.dumps({"stage": "fused", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4),
+                      "all": [round(x, 3) for x in ts]}))
+    sys.stdout.flush()
+
+    expand = make_candidates_body(spec, num_lanes=LANES, out_width=ow,
+                                  block_stride=STRIDE)
+    expand_scalar = jax.jit(
+        lambda p_, t_, b_: expand(p_, t_, b_)[0].astype(jnp.uint32).sum()
+        + expand(p_, t_, b_)[1].sum().astype(jnp.uint32)
+    )
+    c, r, ts = bench_scalar(expand_scalar, [(p, t, b) for b in batches])
+    print(json.dumps({"stage": "expand", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4),
+                      "all": [round(x, 3) for x in ts]}))
+    sys.stdout.flush()
+
+    rng = np.random.default_rng(0)
+    cands = [jnp.asarray(rng.integers(97, 123, size=(LANES, ow),
+                                      dtype=np.uint8)) for _ in range(3)]
+    clen = jnp.full((LANES,), ow - 2, dtype=jnp.int32)
+    hash_fn = HASH_FNS["md5"]
+    hash_scalar = jax.jit(lambda c_, l_: hash_fn(c_, l_).sum())
+    c, r, ts = bench_scalar(hash_scalar, [(cand, clen) for cand in cands])
+    print(json.dumps({"stage": "hash", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4),
+                      "all": [round(x, 3) for x in ts]}))
+    sys.stdout.flush()
+
+    states = [jnp.asarray(rng.integers(0, 2**32, size=(LANES, 4),
+                                       dtype=np.uint64).astype(np.uint32))
+              for _ in range(3)]
+    mem_scalar = jax.jit(
+        lambda s_, rows_, bm_: digest_member(s_, rows_, bm_).sum()
+    )
+    c, r, ts = bench_scalar(mem_scalar,
+                            [(s, d["rows"], d["bitmap"]) for s in states])
+    print(json.dumps({"stage": "membership", "compile_s": round(c, 1),
+                      "launch_s": round(r, 4),
+                      "all": [round(x, 3) for x in ts]}))
+    sys.stdout.flush()
+
+    # Pipelined fused throughput: dispatch 2 ahead, fetch behind.
+    from collections import deque
+
+    q = deque()
+    t0 = time.perf_counter()
+    n = 12
+    for i in range(n):
+        q.append(fused_scalar(p, t, d, batches[i % 3]))
+        if len(q) >= 2:
+            float(q.popleft())
+    while q:
+        float(q.popleft())
+    el = time.perf_counter() - t0
+    print(json.dumps({"stage": "fused_pipelined", "launches": n,
+                      "total_s": round(el, 2),
+                      "per_launch_s": round(el / n, 4),
+                      "lanes_per_s": round(n * LANES / el, 1)}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""One-off probe: per-launch wall time of the fused crack step on the live
+device at several lanes x blocks geometries.  Writes one JSON line per
+geometry to stdout.  Not part of the package; evidence-gathering for PERF.md.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays, make_crack_step,
+    plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    words = synth_wordlist(20000)
+    packed = pack_words(words)
+    plan = build_plan(spec, ct, packed)
+    host_digest = HOST_DIGEST[spec.algo]
+    ds = build_digest_set([host_digest(b"bench-decoy-%d" % i) for i in range(1024)],
+                          spec.algo)
+    t, d = table_arrays(ct), digest_arrays(ds)
+    p = plan_arrays(plan)
+
+    geoms = [(1 << 16, 512), (1 << 19, 4096), (1 << 21, 16384), (1 << 22, 32768)]
+    for lanes, blocks in geoms:
+        step = make_crack_step(spec, num_lanes=lanes, out_width=plan.out_width)
+        batch, w, rank = make_blocks(plan, start_word=0, start_rank=0,
+                                     max_variants=lanes, max_blocks=blocks)
+        b = block_arrays(batch, num_blocks=blocks)
+        t0 = time.perf_counter()
+        out = step(p, t, b, d)
+        n_emitted = int(out["n_emitted"])
+        compile_s = time.perf_counter() - t0
+        # steady state: 3 timed launches, blocking each
+        times = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = step(p, t, b, d)
+            jax.block_until_ready(out)
+            times.append(time.perf_counter() - t0)
+        per = min(times)
+        print(json.dumps({
+            "lanes": lanes, "blocks": blocks, "out_width": plan.out_width,
+            "compile_s": round(compile_s, 2), "launch_s": round(per, 4),
+            "n_emitted": n_emitted,
+            "hashes_per_sec": round(n_emitted / per, 1),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

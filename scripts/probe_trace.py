@@ -1,0 +1,95 @@
+"""Capture a jax.profiler trace of the fused crack step on the live device
+and print the top XLA ops by device self-time (parsed from the xplane.pb —
+the tensorboard_plugin_profile conversion path is broken in this image, so
+we aggregate the raw planes ourselves).  Evidence for PERF.md."""
+
+import glob
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays, make_fused_body,
+    plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+LANES = 1 << 19
+BLOCKS = 4096
+TRACE_DIR = sys.argv[1] if len(sys.argv) > 1 else "/tmp/a5_trace"
+
+
+def analyze(trace_dir):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(trace_dir + "/**/*.xplane.pb", recursive=True)
+    if not paths:
+        print(json.dumps({"error": "no xplane.pb found"}))
+        return
+    xspace = xplane_pb2.XSpace()
+    with open(max(paths, key=os.path.getmtime), "rb") as fh:
+        xspace.ParseFromString(fh.read())
+    for plane in xspace.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        ev_names = dict(plane.event_metadata.items())
+        totals = defaultdict(lambda: [0.0, 0])
+        for line in plane.lines:
+            for ev in line.events:
+                meta = ev_names.get(ev.metadata_id)
+                name = meta.name if meta else str(ev.metadata_id)
+                totals[name][0] += ev.duration_ps / 1e12
+                totals[name][1] += 1
+        top = sorted(totals.items(), key=lambda kv: -kv[1][0])[:25]
+        print(f"## plane: {plane.name}")
+        for name, (sec, cnt) in top:
+            print(f"{sec:9.4f}s  x{cnt:<5d} {name[:110]}")
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    packed = pack_words(synth_wordlist(20000))
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set(
+        [HOST_DIGEST["md5"](b"bench-decoy-%d" % i) for i in range(1024)], "md5"
+    )
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    batches = []
+    w = rank = 0
+    for _ in range(3):
+        batch, w, rank = make_blocks(plan, start_word=w, start_rank=rank,
+                                     max_variants=LANES, max_blocks=BLOCKS)
+        batches.append(block_arrays(batch, num_blocks=BLOCKS))
+
+    fused = make_fused_body(spec, num_lanes=LANES, out_width=plan.out_width)
+    step = jax.jit(lambda p_, t_, d_, b_: fused(p_, t_, d_, b_)["n_emitted"])
+    int(step(p, t, d, batches[0]))  # compile
+
+    with jax.profiler.trace(TRACE_DIR):
+        for i in range(8):
+            int(step(p, t, d, batches[i % 3]))
+    print("# trace captured", file=sys.stderr)
+    analyze(TRACE_DIR)
+
+
+if __name__ == "__main__":
+    main()

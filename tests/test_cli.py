@@ -219,27 +219,76 @@ class TestDeviceBackend:
         assert b"--devices" in r.stderr
 
     def test_buckets_mixed_length_dictionary(self, workdir, tmp_path):
-        # Default bucketing: an over-the-last-boundary line must not break
-        # the sweep (it gets its own bucket width) and parity holds per word.
+        # Explicit bucketing: an over-the-last-boundary line must not break
+        # the sweep (it gets its own bucket width), parity holds per word,
+        # and the reorder notice appears (mixed-length stream, candidates
+        # mode).
         d = tmp_path / "mixed.txt"
         long_word = b"q" * 68 + b"as"
         d.write_bytes(b"password\n" + long_word + b"\nzzz\n")
         sub = load_tables([str(workdir / "leet.table")])
         r = run_cli(str(d), "-t", str(workdir / "leet.table"),
-                    "--backend", "device", "--lanes", "256", "--blocks", "16")
+                    "--backend", "device", "--buckets", "16,32,64",
+                    "--lanes", "256", "--blocks", "16")
         from collections import Counter
 
         want = Counter(oracle_all(sub, [b"password", long_word, b"zzz"]))
         assert Counter(r.stdout.splitlines()) == want
+        assert b"reorders" in r.stderr
+
+    def test_candidates_default_strict_order(self, workdir, tmp_path):
+        # Candidates mode defaults to --buckets none: a mixed-length
+        # dictionary streams in strict word order (no bucket-major
+        # permutation), diffable against the oracle, with no notice.
+        d = tmp_path / "mixed_order.txt"
+        words = [b"password", b"q" * 20 + b"as", b"zzz"]
+        d.write_bytes(b"\n".join(words) + b"\n")
+        sub = load_tables([str(workdir / "leet.table")])
+        r = run_cli(str(d), "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--lanes", "256", "--blocks", "16")
+        got = r.stdout.splitlines()
+        want = oracle_all(sub, words)
+        # Per-word multiset parity AND global word order: candidates from
+        # word i all precede candidates from word j>i.
+        assert sorted(got) == sorted(want)
+        from collections import Counter
+
+        pos = 0
+        for w in words:
+            per_word = Counter(oracle_all(sub, [w]))
+            n = sum(per_word.values())
+            assert Counter(got[pos:pos + n]) == per_word
+            pos += n
+        assert b"reorders" not in r.stderr
 
     def test_buckets_none_single_width(self, workdir):
         base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                 "--backend", "device", "--lanes", "256", "--blocks", "16")
-        bucketed = run_cli(*base)
+        bucketed = run_cli(*base, "--buckets", "16,32,64")
         single = run_cli(*base, "--buckets", "none")
+        auto = run_cli(*base, "--buckets", "auto")
         assert sorted(bucketed.stdout.splitlines()) == sorted(
             single.stdout.splitlines()
         )
+        # 'auto' in candidates mode = none: byte-identical strict order.
+        assert auto.stdout == single.stdout
+
+    def test_crack_default_still_bucketed(self, workdir, tmp_path):
+        # Crack mode keeps the bucketed default: the checkpoint FILE is a
+        # bucket manifest, not a legacy single-file cursor.
+        d = tmp_path / "mixed_crack.txt"
+        d.write_bytes(b"password\n" + b"q" * 20 + b"as\nzzz\n")
+        target = hashlib.md5(b"p4ssword").hexdigest()
+        dig = tmp_path / "digs.txt"
+        dig.write_text(target + "\n")
+        ck = tmp_path / "crack_ck.json"
+        r = run_cli(str(d), "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--digests", str(dig),
+                    "--checkpoint", str(ck),
+                    "--lanes", "256", "--blocks", "16")
+        assert b"p4ssword" in r.stdout
+        manifest = json.loads(ck.read_text())
+        assert "buckets" in manifest  # top-level manifest => bucketed run
 
     def test_buckets_rejects_garbage(self, workdir):
         r = run_cli(str(workdir / "dict.txt"), "-t",
@@ -247,6 +296,27 @@ class TestDeviceBackend:
                     "--buckets", "64,16", check=False)
         assert r.returncode != 0
         assert b"--buckets" in r.stderr
+
+    def test_packed_blocks_stream_identical(self, workdir):
+        base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+                "--backend", "device", "--lanes", "64", "--blocks", "16")
+        strided = run_cli(*base)
+        packed = run_cli(*base, "--packed-blocks")
+        assert packed.stdout == strided.stdout
+        assert strided.stdout
+
+    def test_profile_writes_trace(self, workdir, tmp_path):
+        # --profile DIR: a device sweep leaves a jax.profiler trace on disk
+        # (plugins/profile/<ts>/*.trace.json.gz or *.xplane.pb, backend-
+        # dependent) — the one observability flag must actually observe.
+        trace_dir = tmp_path / "trace"
+        r = run_cli(str(workdir / "dict.txt"),
+                    "-t", str(workdir / "leet.table"),
+                    "--backend", "device", "--profile", str(trace_dir),
+                    "--lanes", "256", "--blocks", "16")
+        assert r.stdout  # sweep still streamed candidates
+        files = [p for p in trace_dir.rglob("*") if p.is_file()]
+        assert files, "profile dir exists but holds no trace artifacts"
 
     def test_progress_lines(self, workdir):
         r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),

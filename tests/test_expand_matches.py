@@ -211,6 +211,100 @@ class TestReverseMode:
         assert_parity(sub_map, words, 0, 15, reverse=True)
 
 
+class TestFixedStride:
+    """The TPU-fast fixed-stride block layout (arithmetic lane -> block,
+    per-block broadcasts) must emit exactly the multiset the packed
+    variable-offset layout emits."""
+
+    LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$"], b"e": [b"3"]}
+    WORDS = [b"password", b"sesame", b"a", b"zzz", b"assesses", b"oboe"]
+
+    def test_block_layout_invariants(self):
+        ct = compile_table(self.LEET)
+        plan = build_match_plan(ct, pack_words(self.WORDS))
+        batch, w, rank = make_blocks(
+            plan, max_variants=256, max_blocks=32, fixed_stride=8
+        )
+        assert list(batch.offset) == [8 * i for i in range(len(batch.count))]
+        assert all(c <= 8 for c in batch.count)
+        # Lane budget, not variant budget: at most 256/8 = 32 blocks.
+        assert len(batch.count) <= 32
+
+    def test_stride_multiset_matches_oracle(self):
+        lanes, stride = 512, 16
+        ct = compile_table(self.LEET)
+        packed = pack_words(self.WORDS)
+        plan = build_match_plan(ct, packed)
+        results = {i: Counter() for i in range(len(self.WORDS))}
+        w = rank = 0
+        while True:
+            batch, w, rank = make_blocks(
+                plan, start_word=w, start_rank=rank, max_variants=lanes,
+                max_blocks=lanes // stride, fixed_stride=stride,
+            )
+            if batch.total == 0:
+                break
+            from hashcat_a5_table_generator_tpu.ops.blocks import pad_batch
+
+            batch = pad_batch(batch, lanes // stride)
+            cand, cand_len, word_row, emit = expand_matches(
+                jnp.asarray(plan.tokens),
+                jnp.asarray(plan.lengths),
+                jnp.asarray(plan.match_pos),
+                jnp.asarray(plan.match_len),
+                jnp.asarray(plan.match_radix),
+                jnp.asarray(plan.match_val_start),
+                jnp.asarray(ct.val_bytes),
+                jnp.asarray(ct.val_len),
+                jnp.asarray(batch.word),
+                jnp.asarray(batch.base_digits),
+                jnp.asarray(batch.count),
+                jnp.asarray(batch.offset),
+                num_lanes=lanes,
+                out_width=plan.out_width,
+                min_substitute=1,
+                max_substitute=15,
+                block_stride=stride,
+            )
+            cand, cand_len = np.asarray(cand), np.asarray(cand_len)
+            word_row, emit = np.asarray(word_row), np.asarray(emit)
+            for i in np.nonzero(emit)[0]:
+                results[int(word_row[i])][
+                    bytes(cand[i, : cand_len[i]])
+                ] += 1
+        for i, word in enumerate(self.WORDS):
+            want = Counter(process_word(word, self.LEET, 1, 15))
+            assert results[i] == want, word
+
+    def test_stride_sweep_stream_identical_to_packed(self):
+        # Full runtime equality: same candidate BYTES in the same order.
+        import io
+
+        from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+        from hashcat_a5_table_generator_tpu.runtime.sinks import (
+            CandidateWriter,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.sweep import (
+            Sweep,
+            SweepConfig,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5")
+        outs = []
+        for packed_blocks in (False, True):
+            buf = io.BytesIO()
+            cfg = SweepConfig(lanes=64, num_blocks=16,
+                              packed_blocks=packed_blocks)
+            assert (cfg.block_stride is None) == packed_blocks
+            with CandidateWriter(stream=buf) as writer:
+                Sweep(spec, self.LEET, self.WORDS, config=cfg).run_candidates(
+                    writer, resume=False
+                )
+            outs.append(buf.getvalue())
+        assert outs[0] == outs[1]
+        assert outs[0]  # non-empty
+
+
 def test_find_matches_scan_order():
     ct = compile_table({b"s": [b"1"], b"ss": [b"2"]})
     # position ascending, key length descending at each position.
