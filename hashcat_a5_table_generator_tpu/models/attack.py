@@ -76,10 +76,17 @@ class AttackSpec:
 def build_plan(
     spec: AttackSpec, ct: CompiledTable, packed: PackedWords, **kwargs
 ):
-    """Mode-dispatched host plan construction."""
+    """Mode-dispatched host plan construction.
+
+    Match plans get the spec's EFFECTIVE window so a tight ``-m/-x`` can
+    switch to count-windowed enumeration (``expand_matches.build_match_plan``)
+    instead of masking the full mixed-radix space.
+    """
     if spec.mode in ("default", "reverse"):
         return build_match_plan(
-            ct, packed, first_option_only=spec.mode == "reverse", **kwargs
+            ct, packed, first_option_only=spec.mode == "reverse",
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute, **kwargs
         )
     return build_suball_plan(
         ct, packed, first_option_only=spec.mode == "suball-reverse", **kwargs
@@ -102,6 +109,8 @@ def plan_arrays(plan) -> Dict[str, jnp.ndarray]:
     if isinstance(plan, MatchPlan):
         keys = ("tokens", "lengths", "match_pos", "match_len", "match_radix",
                 "match_val_start")
+        if plan.windowed:
+            keys = keys + ("win_v",)
     elif isinstance(plan, SubAllPlan):
         keys = ("tokens", "lengths", "pat_radix", "pat_val_start",
                 "seg_orig_start", "seg_orig_len", "seg_pat")
@@ -146,6 +155,7 @@ def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width,
             plan["match_len"], plan["match_radix"], plan["match_val_start"],
             table["val_bytes"], table["val_len"],
             blocks["word"], blocks["base"], blocks["count"], blocks["offset"],
+            win_v=plan.get("win_v"),
             **common,
         )
     return expand_suball(
@@ -169,7 +179,12 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     (``make_blocks(fixed_stride=...)``) — the TPU fast path; ``None`` keeps
     the variable-offset layout.
     """
-    hash_fn = HASH_FNS[spec.algo]
+    from ..ops.pallas_md5 import maybe_pallas_hash_fn
+
+    # A5GEN_PALLAS=1 on a TPU backend swaps in the VMEM-resident Pallas MD5
+    # compression (ops.pallas_md5; falls back per-geometry) — selected at
+    # trace-build time, so the flag picks the compiled program.
+    hash_fn = maybe_pallas_hash_fn(spec.algo, HASH_FNS[spec.algo])
 
     def body(plan, table, digests, blocks):
         cand, cand_len, word_row, emit = _expand(
@@ -252,13 +267,18 @@ def decode_variant(
     the device flagged.
     """
     radices = [int(r) for r in plan.pat_radix[word_idx]]
-    digits = []
-    r = rank
-    for radix in radices:
-        digits.append(r % radix)
-        r //= radix
-    if r:
-        raise ValueError(f"rank {rank} out of range for word {word_idx}")
+    if isinstance(plan, MatchPlan) and plan.windowed:
+        from ..ops.expand_matches import unrank_windowed
+
+        digits = unrank_windowed(plan.win_v[word_idx], radices, rank)
+    else:
+        digits = []
+        r = rank
+        for radix in radices:
+            digits.append(r % radix)
+            r //= radix
+        if r:
+            raise ValueError(f"rank {rank} out of range for word {word_idx}")
     word = bytes(plan.tokens[word_idx, : plan.lengths[word_idx]])
 
     def val(vrow: int) -> bytes:
@@ -311,15 +331,20 @@ def lane_cursor(
     mixed-radix space; the global rank is that base plus the in-block rank.
     """
     offsets = batch.offset
+    windowed = getattr(plan, "windowed", False)
     out = []
     for lane in lanes:
         blk = int(np.searchsorted(offsets, lane, side="right")) - 1
         rank_in_block = int(lane) - int(offsets[blk])
         w = int(batch.word[blk])
-        base_rank = 0
-        scale = 1
-        for s in range(plan.num_slots):
-            base_rank += int(batch.base_digits[blk, s]) * scale
-            scale *= int(plan.pat_radix[w, s])
+        if windowed:
+            # Windowed blocks cursor by scalar rank in slot 0.
+            base_rank = int(batch.base_digits[blk, 0])
+        else:
+            base_rank = 0
+            scale = 1
+            for s in range(plan.num_slots):
+                base_rank += int(batch.base_digits[blk, s]) * scale
+                scale *= int(plan.pat_radix[w, s])
         out.append((w, base_rank + rank_in_block))
     return out

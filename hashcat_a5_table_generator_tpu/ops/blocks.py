@@ -97,9 +97,14 @@ def make_blocks(
         else:
             take = min(budget, total - rank, max_block)
             spent = take
-        radices = [int(plan.pat_radix[w, s]) for s in range(p)]
         words.append(w)
-        bases.append(digits_of(rank, radices))
+        if getattr(plan, "windowed", False):
+            # Windowed plans cursor by scalar rank (int32 by eligibility);
+            # the device unranks through the plan's win_v DP table.
+            bases.append([rank] + [0] * (p - 1))
+        else:
+            radices = [int(plan.pat_radix[w, s]) for s in range(p)]
+            bases.append(digits_of(rank, radices))
         counts.append(take)
         budget -= spent
         rank += take

@@ -42,7 +42,7 @@ small; the reference pays the analogous cost by materializing C(n, k).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,13 @@ class MatchPlan:
     Axes: B words, M match slots in reference scan order (position ascending,
     key length descending — ``main.go:177``); slot 0 is the least-significant
     mixed-radix digit. Inactive slots have radix 1.
+
+    ``windowed`` plans enumerate ONLY digit vectors whose chosen count lies
+    in the substitution window, via the suffix-count DP table ``win_v``
+    (VERDICT r3 #4: a tight ``-m 1 -x 1`` window over a 20-match word must
+    not burn 2^20 lanes for 20 candidates). ``n_variants`` is then the
+    windowed total and block base cursors are scalar ranks, not digit
+    vectors.
     """
 
     tokens: np.ndarray  # uint8 [B, L]
@@ -68,10 +75,15 @@ class MatchPlan:
     match_len: np.ndarray  # int32 [B, M] — key length, 0 on inactive slots
     match_radix: np.ndarray  # int32 [B, M] — options+1 (default) / 2 (reverse)
     match_val_start: np.ndarray  # int32 [B, M] — CSR row of the key's options
-    n_variants: Tuple[int, ...]  # python bigints — Π radix per word
+    n_variants: Tuple[int, ...]  # python bigints — Π radix per word, or the
+    #                              windowed totals when ``windowed``
     fallback: np.ndarray  # bool [B] — always False; kept for the shared
     # block scheduler's plan interface
     out_width: int  # static candidate-buffer width (uint32-aligned)
+    windowed: bool = False  # count-windowed enumeration active
+    win_v: "np.ndarray | None" = None  # int32 [B, M+1, K+2] suffix counts:
+    #   win_v[b, s, j] = number of digit assignments for slots s.. given j
+    #   already chosen, with the final count inside the window
 
     # Shared-scheduler interface (ops.blocks.make_blocks) --------------------
     @property
@@ -100,15 +112,86 @@ def find_matches(word: bytes, ct: CompiledTable) -> List[Tuple[int, int, int]]:
     return out
 
 
+#: Windowed-enumeration eligibility bounds: per-word windowed totals must
+#: fit comfortably in int32 (block base cursors become scalar ranks) and the
+#: window ceiling must keep the DP table narrow.
+WINDOWED_MAX_TOTAL = 1 << 30
+WINDOWED_MAX_SUBST = 8
+
+
+def _windowed_tables(
+    match_radix: np.ndarray,
+    min_substitute: int,
+    max_substitute: int,
+) -> "Tuple[np.ndarray, List[int]] | Tuple[None, None]":
+    """Suffix-count DP for count-windowed enumeration (numpy over words).
+
+    ``v[b, s, j]`` = number of digit assignments for slots ``s..m-1`` given
+    ``j`` slots already chosen, such that the final chosen count lands in
+    ``[min_substitute, max_substitute]`` (overlap clashes are NOT modeled —
+    they stay a device-side mask, exactly as in full enumeration; inactive
+    slots have 0 options and contribute nothing).
+    Returns ``(v, totals)`` or ``(None, None)`` when any word's windowed
+    total overflows the int32 cursor budget.
+    """
+    mx = max_substitute
+    b, m = match_radix.shape
+    opts = (match_radix.astype(np.int64) - 1).clip(min=0)  # [B, M]
+    v = np.zeros((b, m + 1, mx + 2), dtype=np.int64)
+    v[:, m, min_substitute : mx + 1] = 1
+    for s in range(m - 1, -1, -1):
+        v[:, s, : mx + 1] = (
+            v[:, s + 1, : mx + 1] + opts[:, s : s + 1] * v[:, s + 1, 1 : mx + 2]
+        )
+        if v[:, s].max() > WINDOWED_MAX_TOTAL:
+            return None, None
+    return v.astype(np.int32), [int(t) for t in v[:, 0, 0]]
+
+
+def unrank_windowed(
+    v_row: np.ndarray, radices: Sequence[int], rank: int
+) -> List[int]:
+    """Host mirror of the device's windowed unranking: digit vector of
+    ``rank`` in word's windowed enumeration. ``v_row`` is ``win_v[word]``
+    (``[M+1, K+2]``). Raises ``ValueError`` for ranks past the windowed
+    total (mirrors the full-mode decode contract in ``decode_variant``)."""
+    digits: List[int] = []
+    j = 0
+    r = int(rank)
+    if r >= int(v_row[0, 0]):
+        raise ValueError(f"windowed rank {rank} out of range")
+    for s, radix in enumerate(radices):
+        vn0 = int(v_row[s + 1, j])
+        if r < vn0:
+            digits.append(0)
+        else:
+            r -= vn0
+            vn1 = int(v_row[s + 1, j + 1])
+            digits.append(r // vn1 + 1)
+            r %= vn1
+            j += 1
+    return digits
+
+
 def build_match_plan(
     ct: CompiledTable,
     packed: PackedWords,
     *,
     first_option_only: bool = False,
     out_width: int | None = None,
+    min_substitute: int | None = None,
+    max_substitute: int | None = None,
 ) -> MatchPlan:
     """Host-side plan construction for default (``first_option_only=False``)
-    or reverse (``True``) mode."""
+    or reverse (``True``) mode.
+
+    When the EFFECTIVE substitution window ``[min_substitute,
+    max_substitute]`` is given and tight (``max_substitute <=
+    WINDOWED_MAX_SUBST``, windowed totals < 2^30, and at least a 2x lane
+    saving over full enumeration), the plan switches to count-windowed
+    enumeration: ranks walk only in-window digit vectors via the ``win_v``
+    DP instead of masking the full mixed-radix space.
+    """
     b, width = packed.tokens.shape
     per_word = [find_matches(packed.word(i), ct) for i in range(b)]
     m = max(1, max((len(x) for x in per_word), default=0))
@@ -145,6 +228,24 @@ def build_match_plan(
     if out_width is None:
         out_width = max(4, -(-(width + max_delta) // 4) * 4)
 
+    windowed = False
+    win_v = None
+    if (
+        min_substitute is not None
+        and max_substitute is not None
+        and 0 <= min_substitute <= max_substitute <= WINDOWED_MAX_SUBST
+        and b > 0
+    ):
+        v, totals = _windowed_tables(
+            match_radix, min_substitute, max_substitute
+        )
+        if v is not None:
+            full = sum(min(t, 1 << 62) for t in n_variants)
+            if sum(totals) * 2 <= full:
+                windowed = True
+                win_v = v
+                n_variants = totals
+
     return MatchPlan(
         tokens=packed.tokens,
         lengths=packed.lengths,
@@ -156,6 +257,8 @@ def build_match_plan(
         n_variants=tuple(n_variants),
         fallback=np.zeros((b,), dtype=bool),
         out_width=out_width,
+        windowed=windowed,
+        win_v=win_v,
     )
 
 
@@ -241,6 +344,7 @@ def expand_matches(
     min_substitute: int,
     max_substitute: int,
     block_stride: int | None = None,
+    win_v: jnp.ndarray | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -257,12 +361,17 @@ def expand_matches(
     The variable-offset path (``None``) keeps the per-lane ``searchsorted``
     + gathers — on TPU that binary search lowers to a sequential ``while``
     loop that alone cost 57% of the fused step at 2^19 lanes (PERF.md).
+
+    ``win_v``: the windowed plan's suffix-count DP table (``MatchPlan.win_v``
+    as a device array). When given, ranks unrank through the DP — visiting
+    ONLY digit vectors whose chosen count is in the window — and block base
+    cursors are scalar ranks in slot 0 (``make_blocks`` encodes them so for
+    windowed plans).
     """
     n = num_lanes
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
 
-    v = jnp.arange(n, dtype=jnp.int32)
     rank, lane_ok, w, base, field = lane_fields(
         blk_word, blk_base, blk_count, blk_offset,
         num_lanes=n, block_stride=block_stride,
@@ -274,17 +383,49 @@ def expand_matches(
     tokens_w = field(tokens)  # [N, L]
     lengths_w = field(lengths)  # [N]
 
-    # digits = base + mixed-radix(rank), slot 0 least significant, with carry.
-    digits = []
-    carry = jnp.zeros_like(rank)
-    r = rank
-    for s in range(m):
-        rs = radix[:, s]
-        t = base[:, s] + (r % rs) + carry
-        digits.append(t % rs)
-        carry = t // rs
-        r = r // rs
-    digits = jnp.stack(digits, axis=1)  # [N, M]
+    if win_v is not None:
+        # Count-windowed unranking: R walks only in-window digit vectors.
+        # Per slot, "skip" covers v[s+1][j] completions; "choose option d"
+        # covers v[s+1][j+1] completions each. Column selection is an
+        # unrolled compare-sum (K+2 columns), never a per-lane gather.
+        k2 = int(win_v.shape[2])
+
+        def sel(row, jcol):
+            acc = jnp.zeros_like(rank)
+            for c in range(k2):
+                acc = acc + jnp.where(jcol == c, row[:, c], 0)
+            return acc
+
+        big_r = base[:, 0] + rank  # scalar windowed rank (host-bounded int32)
+        jcnt = jnp.zeros_like(rank)
+        digits = []
+        for s in range(m):
+            row = field(win_v[:, s + 1])  # [N, K+2]
+            vn0 = sel(row, jcnt)
+            not_chosen = big_r < vn0
+            r2 = big_r - vn0
+            safe = jnp.maximum(sel(row, jcnt + 1), 1)
+            d = jnp.where(not_chosen, 0, 1 + r2 // safe)
+            big_r = jnp.where(not_chosen, big_r, r2 % safe)
+            # Invalid lanes (rank past the block's count) decode garbage;
+            # clamp so downstream value-row lookups stay in range — emit
+            # masks them regardless.
+            digits.append(jnp.clip(d, 0, radix[:, s] - 1))
+            jcnt = jcnt + jnp.where(not_chosen, 0, 1)
+        digits = jnp.stack(digits, axis=1)  # [N, M]
+    else:
+        # digits = base + mixed-radix(rank), slot 0 least significant, with
+        # carry.
+        digits = []
+        carry = jnp.zeros_like(rank)
+        r = rank
+        for s in range(m):
+            rs = radix[:, s]
+            t = base[:, s] + (r % rs) + carry
+            digits.append(t % rs)
+            carry = t // rs
+            r = r // rs
+        digits = jnp.stack(digits, axis=1)  # [N, M]
 
     chosen = digits > 0  # [N, M]
     chosen_count = jnp.sum(chosen, axis=1)

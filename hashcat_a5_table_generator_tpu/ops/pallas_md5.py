@@ -1,0 +1,132 @@
+"""Pallas TPU kernel for single-block MD5 (SURVEY.md §7 step 4's "drop to
+Pallas where XLA fusion is insufficient").
+
+PERF.md §3: post-expansion the fused step retires ~1000 int32 ops/lane at
+~8 GOP/s — two orders below the VPU roofline — because XLA materializes
+large intermediates between the unrolled round chain's fusion groups. This
+kernel keeps the whole 64-round compression in VMEM registers:
+
+* the message is pre-padded OUTSIDE the kernel by the shared
+  :func:`..ops.hashes.pad_message` layout (tested against hashlib), then
+  laid out as ``uint32[N/128, 16, 128]`` so every message word is a
+  perfect ``(sublane, lane)`` int32 tile and every round operates on
+  ``(rows, 128)`` vectors — the VPU's native shape;
+* the 64 rounds are unrolled straight-line inside the kernel (statically
+  indexed message words, rotate = shift|shift), state lives in VMEM tiles;
+* output is ``uint32[N/128, 4, 128]``, transposed back to the ``[N, 4]``
+  state-word layout the membership stage consumes.
+
+Scope: messages that fit ONE 64-byte MD5 block (padded width <= 55 bytes —
+every shipped bucket width up to 52 qualifies; the reference's own hot path
+is short candidates, ``main.go:175-201``). The public wrapper falls back to
+the XLA path for anything else, so callers can use it unconditionally.
+
+Wired behind ``A5GEN_PALLAS=1`` (``models.attack.make_fused_body``) until
+on-chip A/B timing confirms the win; interpret-mode CPU tests pin
+word-exactness against ``ops.hashes.md5`` and hashlib
+(tests/test_pallas_md5.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashes import _MD5_INIT, _MD5_K, _MD5_S, _blocks_for_width, pad_message
+
+_U32 = jnp.uint32
+
+#: Lane rows per grid step: (ROWS, 16, 128) uint32 inputs = ROWS * 8 KiB in
+#: VMEM — 64 rows keeps the working set ~0.5 MiB, far under the ~16 MiB VMEM.
+_ROWS_PER_TILE = 64
+
+
+def _md5_kernel(w_ref, out_ref):
+    """One grid step: ``w_ref`` is ``uint32[R, 16, 128]`` (message words),
+    ``out_ref`` is ``uint32[R, 4, 128]`` (digest state words)."""
+    m = [w_ref[:, j, :] for j in range(16)]
+    a = jnp.full_like(m[0], _U32(_MD5_INIT[0]))
+    b = jnp.full_like(m[0], _U32(_MD5_INIT[1]))
+    c = jnp.full_like(m[0], _U32(_MD5_INIT[2]))
+    d = jnp.full_like(m[0], _U32(_MD5_INIT[3]))
+    for i in range(64):
+        if i < 16:
+            f = (b & c) | (~b & d)
+            g = i
+        elif i < 32:
+            f = (d & b) | (~d & c)
+            g = (5 * i + 1) % 16
+        elif i < 48:
+            f = b ^ c ^ d
+            g = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            g = (7 * i) % 16
+        rot = a + f + _U32(_MD5_K[i]) + m[g]
+        s = _MD5_S[i]
+        rotated = (rot << _U32(s)) | (rot >> _U32(32 - s))
+        a, d, c, b = d, c, b, b + rotated
+    out_ref[:, 0, :] = a + _U32(_MD5_INIT[0])
+    out_ref[:, 1, :] = b + _U32(_MD5_INIT[1])
+    out_ref[:, 2, :] = c + _U32(_MD5_INIT[2])
+    out_ref[:, 3, :] = d + _U32(_MD5_INIT[3])
+
+
+def pallas_supported(num_lanes: int, width: int) -> bool:
+    """Static eligibility: one MD5 block and a whole number of lane tiles."""
+    return (
+        _blocks_for_width(width) == 1
+        and num_lanes % (128 * _ROWS_PER_TILE) == 0
+    )
+
+
+def md5_pallas(
+    msg: jnp.ndarray, length: jnp.ndarray, *, interpret: bool = False
+) -> jnp.ndarray:
+    """MD5 state words via the Pallas kernel; same contract as
+    ``ops.hashes.md5`` (``uint8[N, W]``, ``int32[N]`` -> ``uint32[N, 4]``).
+    Falls back to the XLA path when the geometry is ineligible."""
+    from jax.experimental import pallas as pl
+
+    n, width = msg.shape
+    if not pallas_supported(n, width):
+        from .hashes import md5
+
+        return md5(msg, length)
+
+    words, _ = pad_message(msg, length, big_endian_length=False)  # [N, 16]
+    rows = n // 128
+    x = words.reshape(rows, 128, 16).transpose(0, 2, 1)  # [rows, 16, 128]
+    grid = (rows // _ROWS_PER_TILE,)
+    out = pl.pallas_call(
+        _md5_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows, 4, 128), jnp.uint32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (_ROWS_PER_TILE, 16, 128), lambda i: (i, 0, 0)
+            )
+        ],
+        out_specs=pl.BlockSpec(
+            (_ROWS_PER_TILE, 4, 128), lambda i: (i, 0, 0)
+        ),
+        interpret=interpret,
+    )(x)
+    return out.transpose(0, 2, 1).reshape(n, 4)
+
+
+def maybe_pallas_hash_fn(algo: str, hash_fn):
+    """The ``A5GEN_PALLAS=1`` hook: returns the Pallas-backed hash for MD5
+    on a TPU backend, the given XLA ``hash_fn`` otherwise. Checked at
+    trace-build time (the flag selects the compiled program, not a runtime
+    branch)."""
+    import os
+
+    if (
+        algo == "md5"
+        and os.environ.get("A5GEN_PALLAS") == "1"
+        and jax.default_backend() == "tpu"
+    ):
+        return md5_pallas
+    return hash_fn

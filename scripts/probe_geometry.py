@@ -1,0 +1,88 @@
+"""Geometry sweep: pipelined fused throughput across lanes x stride on the
+live device. Evidence for PERF.md; not part of the package."""
+
+import json
+import os
+import sys
+import time
+from collections import deque
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays, make_fused_body,
+    plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    packed = pack_words(synth_wordlist(50000))
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set(
+        [HOST_DIGEST["md5"](b"bench-decoy-%d" % i) for i in range(1024)], "md5"
+    )
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+
+    geoms = [
+        (1 << 19, 128), (1 << 20, 128), (1 << 21, 128),
+        (1 << 20, 256), (1 << 21, 256), (1 << 22, 256),
+        (1 << 21, 512),
+    ]
+    for lanes, stride in geoms:
+        blocks = lanes // stride
+        fused = make_fused_body(spec, num_lanes=lanes,
+                                out_width=plan.out_width, block_stride=stride)
+        step = jax.jit(
+            lambda p_, t_, d_, b_: fused(p_, t_, d_, b_)["n_emitted"]
+        )
+        batches = []
+        w = rank = 0
+        for _ in range(3):
+            batch, w, rank = make_blocks(
+                plan, start_word=w, start_rank=rank, max_variants=lanes,
+                max_blocks=blocks, fixed_stride=stride,
+            )
+            batches.append(block_arrays(batch, num_blocks=blocks))
+        t0 = time.perf_counter()
+        emitted = [int(step(p, t, d, b)) for b in batches]
+        compile_s = time.perf_counter() - t0
+        n = 10
+        q = deque()
+        hashed = 0
+        t0 = time.perf_counter()
+        for i in range(n):
+            q.append(step(p, t, d, batches[i % 3]))
+            if len(q) >= 2:
+                hashed += int(q.popleft())
+        while q:
+            hashed += int(q.popleft())
+        el = time.perf_counter() - t0
+        print(json.dumps({
+            "lanes": lanes, "stride": stride, "blocks": blocks,
+            "compile_s": round(compile_s, 1),
+            "per_launch_s": round(el / n, 4),
+            "hashes_per_sec": round(hashed / el, 1),
+            "fill": round(sum(emitted) / (3 * lanes), 3),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

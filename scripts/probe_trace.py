@@ -31,6 +31,7 @@ from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
 LANES = 1 << 19
 BLOCKS = 4096
+STRIDE = LANES // BLOCKS
 TRACE_DIR = sys.argv[1] if len(sys.argv) > 1 else "/tmp/a5_trace"
 
 
@@ -77,10 +78,12 @@ def main():
     w = rank = 0
     for _ in range(3):
         batch, w, rank = make_blocks(plan, start_word=w, start_rank=rank,
-                                     max_variants=LANES, max_blocks=BLOCKS)
+                                     max_variants=LANES, max_blocks=BLOCKS,
+                                     fixed_stride=STRIDE)
         batches.append(block_arrays(batch, num_blocks=BLOCKS))
 
-    fused = make_fused_body(spec, num_lanes=LANES, out_width=plan.out_width)
+    fused = make_fused_body(spec, num_lanes=LANES, out_width=plan.out_width,
+                            block_stride=STRIDE)
     step = jax.jit(lambda p_, t_, d_, b_: fused(p_, t_, d_, b_)["n_emitted"])
     int(step(p, t, d, batches[0]))  # compile
 

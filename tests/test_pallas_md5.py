@@ -1,0 +1,62 @@
+"""Pallas MD5 kernel: interpret-mode CPU parity against the XLA path and
+hashlib (SURVEY.md §7 step 4; PERF.md §3). The kernel itself targets TPU;
+``interpret=True`` runs the same program through the Pallas interpreter so
+word-exactness is pinned without hardware."""
+
+import hashlib
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.ops.hashes import digest_bytes, md5
+from hashcat_a5_table_generator_tpu.ops.pallas_md5 import (
+    _ROWS_PER_TILE,
+    md5_pallas,
+    pallas_supported,
+)
+
+N = 128 * _ROWS_PER_TILE  # one grid tile
+
+
+def _random_batch(width, seed=0):
+    rng = np.random.default_rng(seed)
+    msg = rng.integers(0, 256, size=(N, width), dtype=np.uint8)
+    length = rng.integers(0, width + 1, size=(N,)).astype(np.int32)
+    # Zero the padding region like the expansion kernels do.
+    msg = np.where(np.arange(width)[None, :] < length[:, None], msg, 0)
+    return jnp.asarray(msg), jnp.asarray(length)
+
+
+@pytest.mark.parametrize("width", [4, 24, 52])
+def test_interpret_matches_xla_path(width):
+    msg, length = _random_batch(width, seed=width)
+    got = np.asarray(md5_pallas(msg, length, interpret=True))
+    want = np.asarray(md5(msg, length))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_interpret_matches_hashlib():
+    msg, length = _random_batch(24, seed=7)
+    got = np.asarray(
+        digest_bytes(md5_pallas(msg, length, interpret=True), "md5")
+    )
+    msg_np, len_np = np.asarray(msg), np.asarray(length)
+    for i in range(0, N, 997):  # sample lanes
+        want = hashlib.md5(bytes(msg_np[i, : len_np[i]])).digest()
+        assert bytes(got[i]) == want, i
+
+
+def test_ineligible_geometry_falls_back():
+    # Width needing two MD5 blocks and a non-tile-multiple lane count both
+    # route through the XLA path transparently.
+    for n, width in [(N, 64), (100, 24)]:
+        rng = np.random.default_rng(1)
+        msg = jnp.asarray(
+            rng.integers(97, 123, size=(n, width), dtype=np.uint8)
+        )
+        length = jnp.full((n,), min(width, 30), dtype=jnp.int32)
+        assert not pallas_supported(n, width)
+        got = np.asarray(md5_pallas(msg, length, interpret=True))
+        want = np.asarray(md5(msg, length))
+        np.testing.assert_array_equal(got, want)
