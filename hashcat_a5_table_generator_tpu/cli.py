@@ -103,6 +103,16 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SECONDS", help="checkpoint interval")
     ap.add_argument("--no-resume", action="store_true",
                     help="ignore an existing checkpoint and start over")
+    ap.add_argument("--retries", type=int, default=0, metavar="N",
+                    help="re-run a failed device sweep up to N times, "
+                         "resuming from the last checkpoint (chip loss / "
+                         "backend errors — SURVEY.md §5). Crack mode is "
+                         "exactly-once: hits dedupe across attempts. "
+                         "Candidates mode requires --checkpoint and is "
+                         "at-least-once: candidates emitted since the last "
+                         "checkpoint repeat after a retry (bound the window "
+                         "with --checkpoint-every; a notice marks each "
+                         "retry on stderr)")
     ap.add_argument("--progress", action="store_true",
                     help="periodic JSON progress lines on stderr")
     ap.add_argument("--lanes", type=int, default=1 << 17,
@@ -287,6 +297,58 @@ def _run_oracle(args, sub_map, words) -> int:
     return 0
 
 
+class _DedupRecorder:
+    """Hit recorder wrapper that drops (word, rank) duplicates.
+
+    Used by the --retries loop: after an attempt dies mid-sweep, the next
+    attempt's resume replays every checkpointed hit into its recorder —
+    correct for a fresh process, duplicate output within one retrying
+    process. The wrapper spans attempts, so each hit prints once per
+    process while a genuinely fresh resume still prints the full list."""
+
+    def __init__(self, inner) -> None:
+        self.inner = inner
+        self._seen = set()
+
+    def emit(self, record) -> None:
+        key = (record.word_index, record.variant_rank)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.inner.emit(record)
+
+
+def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
+                      label: str, retry_notice: str = ""):
+    """Elastic recovery (SURVEY.md §5): candidate generation is pure and
+    cursors are durable, so a chip/backend loss is survived by rebuilding
+    the sweep (fresh compiled steps, fresh device buffers) and resuming
+    from the last checkpoint. ``make_attempt(resume: bool)`` runs one
+    attempt; the first honors ``default_resume`` (--no-resume), later ones
+    always resume."""
+    import time as _time
+
+    attempt = 0
+    resume = default_resume
+    while True:
+        try:
+            return make_attempt(resume)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:  # noqa: BLE001 — backend loss is not typed
+            attempt += 1
+            if attempt > retries:
+                raise
+            print(
+                f"{PROG}: {label} attempt failed "
+                f"({type(e).__name__}: {e}); retry {attempt}/{retries} "
+                f"from last checkpoint{retry_notice}",
+                file=sys.stderr,
+            )
+            resume = True  # later attempts always resume
+            _time.sleep(min(2.0 * attempt, 10.0))
+
+
 def _run_device(args, sub_map, packed) -> int:
     """``packed`` is a PackedWords batch or a ``{width: PackedWords}``
     bucket dict (native fast path) — the device backend never materializes
@@ -317,6 +379,16 @@ def _run_device(args, sub_map, packed) -> int:
             args.coordinator, args.num_processes, args.process_id
         )
         print(f"{PROG}: distributed process {pid}/{nprocs}", file=sys.stderr)
+        if nprocs > 1 and args.retries:
+            # A lone retrying process would desync the pod's collectives;
+            # pod-level recovery is relaunching the job (each host resumes
+            # its own stripe checkpoint).
+            print(
+                f"{PROG}: warning: --retries is single-process only; "
+                "ignored under --coordinator (relaunch the pod to resume)",
+                file=sys.stderr,
+            )
+            args.retries = 0
     bucketed = isinstance(packed, dict)
     if nprocs > 1:
         # Each process sweeps (and reports progress over) only its own
@@ -369,9 +441,14 @@ def _run_device(args, sub_map, packed) -> int:
                     recorder=recorder, resume=not args.no_resume,
                 )
             else:
-                recorder = HitRecorder(sys.stdout.buffer)
-                res = make_sweep(digests).run_crack(
-                    recorder, resume=not args.no_resume
+                recorder = _DedupRecorder(HitRecorder(sys.stdout.buffer))
+                res = _run_with_retries(
+                    lambda resume: make_sweep(digests).run_crack(
+                        recorder, resume=resume
+                    ),
+                    args.retries,
+                    default_resume=not args.no_resume,
+                    label="crack sweep",
                 )
             if pid == 0:
                 print(
@@ -395,7 +472,18 @@ def _run_device(args, sub_map, packed) -> int:
                     resume=not args.no_resume,
                 )
             else:
-                make_sweep().run_candidates(writer, resume=not args.no_resume)
+                _run_with_retries(
+                    lambda resume: make_sweep().run_candidates(
+                        writer, resume=resume
+                    ),
+                    args.retries,
+                    default_resume=not args.no_resume,
+                    label="candidates sweep",
+                    retry_notice=(
+                        "; candidates since that checkpoint repeat "
+                        "(at-least-once stream)"
+                    ),
+                )
     return 0
 
 
@@ -416,6 +504,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.table_min > args.table_max:
         ap.error(
             f"--table-min {args.table_min} > --table-max {args.table_max}"
+        )
+    if (
+        args.retries
+        and args.backend == "device"
+        and args.digests is None
+        and not args.checkpoint
+    ):
+        ap.error(
+            "--retries in candidates mode requires --checkpoint (a retry "
+            "without one would re-emit the whole candidate stream)"
         )
     if args.backend == "device" and args.bug_compat:
         # The Q3 reverse-offset bug (main.go:249-257) is reproduced only by
@@ -445,6 +543,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             (args.coordinator is not None, "--coordinator"),
             (args.num_processes is not None, "--num-processes"),
             (args.process_id is not None, "--process-id"),
+            (args.retries, "--retries"),
         ):
             if flag:
                 print(

@@ -89,7 +89,9 @@ def build_plan(
             max_substitute=spec.max_substitute, **kwargs
         )
     return build_suball_plan(
-        ct, packed, first_option_only=spec.mode == "suball-reverse", **kwargs
+        ct, packed, first_option_only=spec.mode == "suball-reverse",
+        min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute, **kwargs
     )
 
 
@@ -114,6 +116,8 @@ def plan_arrays(plan) -> Dict[str, jnp.ndarray]:
     elif isinstance(plan, SubAllPlan):
         keys = ("tokens", "lengths", "pat_radix", "pat_val_start",
                 "seg_orig_start", "seg_orig_len", "seg_pat")
+        if plan.windowed:
+            keys = keys + ("win_v",)
     else:
         raise TypeError(f"unknown plan type {type(plan)!r}")
     return {k: jnp.asarray(getattr(plan, k)) for k in keys}
@@ -163,6 +167,7 @@ def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width,
         plan["pat_val_start"], plan["seg_orig_start"], plan["seg_orig_len"],
         plan["seg_pat"], table["val_bytes"], table["val_len"],
         blocks["word"], blocks["base"], blocks["count"], blocks["offset"],
+        win_v=plan.get("win_v"),
         **common,
     )
 
@@ -267,7 +272,7 @@ def decode_variant(
     the device flagged.
     """
     radices = [int(r) for r in plan.pat_radix[word_idx]]
-    if isinstance(plan, MatchPlan) and plan.windowed:
+    if getattr(plan, "windowed", False):
         from ..ops.expand_matches import unrank_windowed
 
         digits = unrank_windowed(plan.win_v[word_idx], radices, rank)

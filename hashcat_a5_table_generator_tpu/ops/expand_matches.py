@@ -148,6 +148,55 @@ def _windowed_tables(
     return v.astype(np.int32), [int(t) for t in v[:, 0, 0]]
 
 
+def decode_digits(rank, base, radix, field, win_v, m):
+    """Per-lane digit-vector decode shared by both expansion kernels.
+
+    Full enumeration (``win_v is None``): digits = base + mixed-radix(rank),
+    slot 0 least significant, with carry. Windowed enumeration: the scalar
+    rank ``base[:, 0] + rank`` walks only in-window digit vectors through
+    the suffix-count DP — per slot, "skip" covers ``v[s+1][j]`` completions
+    and "choose option d" covers ``v[s+1][j+1]`` each; column selection is
+    an unrolled compare-sum (K+2 columns), never a per-lane gather.
+    Returns ``digits int32[N, M]``.
+    """
+    if win_v is not None:
+        k2 = int(win_v.shape[2])
+
+        def sel(row, jcol):
+            acc = jnp.zeros_like(rank)
+            for c in range(k2):
+                acc = acc + jnp.where(jcol == c, row[:, c], 0)
+            return acc
+
+        big_r = base[:, 0] + rank  # scalar windowed rank (host-bounded int32)
+        jcnt = jnp.zeros_like(rank)
+        digits = []
+        for s in range(m):
+            row = field(win_v[:, s + 1])  # [N, K+2]
+            vn0 = sel(row, jcnt)
+            not_chosen = big_r < vn0
+            r2 = big_r - vn0
+            safe = jnp.maximum(sel(row, jcnt + 1), 1)
+            d = jnp.where(not_chosen, 0, 1 + r2 // safe)
+            big_r = jnp.where(not_chosen, big_r, r2 % safe)
+            # Invalid lanes (rank past the block's count) decode garbage;
+            # clamp so downstream value-row lookups stay in range — emit
+            # masks them regardless.
+            digits.append(jnp.clip(d, 0, radix[:, s] - 1))
+            jcnt = jcnt + jnp.where(not_chosen, 0, 1)
+        return jnp.stack(digits, axis=1)  # [N, M]
+    digits = []
+    carry = jnp.zeros_like(rank)
+    r = rank
+    for s in range(m):
+        rs = radix[:, s]
+        t = base[:, s] + (r % rs) + carry
+        digits.append(t % rs)
+        carry = t // rs
+        r = r // rs
+    return jnp.stack(digits, axis=1)  # [N, M]
+
+
 def unrank_windowed(
     v_row: np.ndarray, radices: Sequence[int], rank: int
 ) -> List[int]:
@@ -171,6 +220,38 @@ def unrank_windowed(
             r %= vn1
             j += 1
     return digits
+
+
+def windowed_plan_fields(
+    radix_matrix: np.ndarray,
+    n_variants: List[int],
+    min_substitute: "int | None",
+    max_substitute: "int | None",
+    zero_mask: "np.ndarray | None" = None,
+) -> "Tuple[bool, np.ndarray | None, List[int]]":
+    """Shared windowed-enumeration eligibility + table construction for both
+    plan builders: bounds check, suffix-count DP, 2x lane-saving gate.
+
+    ``zero_mask`` marks words whose totals are forced to 0 (suball's
+    oracle-routed hazard words). Returns ``(windowed, win_v, n_variants)``
+    — unchanged inputs when ineligible.
+    """
+    if (
+        min_substitute is None
+        or max_substitute is None
+        or not 0 <= min_substitute <= max_substitute <= WINDOWED_MAX_SUBST
+        or radix_matrix.shape[0] == 0
+    ):
+        return False, None, n_variants
+    v, totals = _windowed_tables(radix_matrix, min_substitute, max_substitute)
+    if v is None:
+        return False, None, n_variants
+    if zero_mask is not None:
+        totals = [0 if zero_mask[i] else t for i, t in enumerate(totals)]
+    full = sum(min(t, 1 << 62) for t in n_variants)
+    if sum(totals) * 2 > full:
+        return False, None, n_variants
+    return True, v, totals
 
 
 def build_match_plan(
@@ -228,23 +309,9 @@ def build_match_plan(
     if out_width is None:
         out_width = max(4, -(-(width + max_delta) // 4) * 4)
 
-    windowed = False
-    win_v = None
-    if (
-        min_substitute is not None
-        and max_substitute is not None
-        and 0 <= min_substitute <= max_substitute <= WINDOWED_MAX_SUBST
-        and b > 0
-    ):
-        v, totals = _windowed_tables(
-            match_radix, min_substitute, max_substitute
-        )
-        if v is not None:
-            full = sum(min(t, 1 << 62) for t in n_variants)
-            if sum(totals) * 2 <= full:
-                windowed = True
-                win_v = v
-                n_variants = totals
+    windowed, win_v, n_variants = windowed_plan_fields(
+        match_radix, n_variants, min_substitute, max_substitute
+    )
 
     return MatchPlan(
         tokens=packed.tokens,
@@ -383,49 +450,7 @@ def expand_matches(
     tokens_w = field(tokens)  # [N, L]
     lengths_w = field(lengths)  # [N]
 
-    if win_v is not None:
-        # Count-windowed unranking: R walks only in-window digit vectors.
-        # Per slot, "skip" covers v[s+1][j] completions; "choose option d"
-        # covers v[s+1][j+1] completions each. Column selection is an
-        # unrolled compare-sum (K+2 columns), never a per-lane gather.
-        k2 = int(win_v.shape[2])
-
-        def sel(row, jcol):
-            acc = jnp.zeros_like(rank)
-            for c in range(k2):
-                acc = acc + jnp.where(jcol == c, row[:, c], 0)
-            return acc
-
-        big_r = base[:, 0] + rank  # scalar windowed rank (host-bounded int32)
-        jcnt = jnp.zeros_like(rank)
-        digits = []
-        for s in range(m):
-            row = field(win_v[:, s + 1])  # [N, K+2]
-            vn0 = sel(row, jcnt)
-            not_chosen = big_r < vn0
-            r2 = big_r - vn0
-            safe = jnp.maximum(sel(row, jcnt + 1), 1)
-            d = jnp.where(not_chosen, 0, 1 + r2 // safe)
-            big_r = jnp.where(not_chosen, big_r, r2 % safe)
-            # Invalid lanes (rank past the block's count) decode garbage;
-            # clamp so downstream value-row lookups stay in range — emit
-            # masks them regardless.
-            digits.append(jnp.clip(d, 0, radix[:, s] - 1))
-            jcnt = jcnt + jnp.where(not_chosen, 0, 1)
-        digits = jnp.stack(digits, axis=1)  # [N, M]
-    else:
-        # digits = base + mixed-radix(rank), slot 0 least significant, with
-        # carry.
-        digits = []
-        carry = jnp.zeros_like(rank)
-        r = rank
-        for s in range(m):
-            rs = radix[:, s]
-            t = base[:, s] + (r % rs) + carry
-            digits.append(t % rs)
-            carry = t // rs
-            r = r // rs
-        digits = jnp.stack(digits, axis=1)  # [N, M]
+    digits = decode_digits(rank, base, radix, field, win_v, m)
 
     chosen = digits > 0  # [N, M]
     chosen_count = jnp.sum(chosen, axis=1)

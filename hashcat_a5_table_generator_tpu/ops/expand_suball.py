@@ -55,7 +55,11 @@ from .blocks import (  # noqa: F401  — re-exported: this module defined them f
     digits_of,
     make_blocks,
 )
-from .expand_matches import lane_fields
+from .expand_matches import (
+    decode_digits,
+    lane_fields,
+    windowed_plan_fields,
+)
 from .packing import PackedWords
 
 
@@ -75,9 +79,14 @@ class SubAllPlan:
     seg_orig_start: np.ndarray  # int32 [B, G]
     seg_orig_len: np.ndarray  # int32 [B, G] — 0 on inactive segments
     seg_pat: np.ndarray  # int32 [B, G] — pattern slot, -1 for gaps
-    n_variants: Tuple[int, ...]  # python bigints — Π radix per word
+    n_variants: Tuple[int, ...]  # python bigints — Π radix per word, or the
+    #                              windowed totals when ``windowed``
     fallback: np.ndarray  # bool [B] — word needs the CPU oracle
     out_width: int  # static candidate-buffer width (uint32-aligned)
+    windowed: bool = False  # count-windowed enumeration active
+    win_v: "np.ndarray | None" = None  # int32 [B, P+1, K+2] suffix counts
+    #   (see expand_matches.MatchPlan.win_v — identical scheme over
+    #   pattern slots)
 
     @property
     def batch(self) -> int:
@@ -98,6 +107,8 @@ def build_suball_plan(
     *,
     first_option_only: bool = False,
     out_width: int | None = None,
+    min_substitute: int | None = None,
+    max_substitute: int | None = None,
 ) -> SubAllPlan:
     """Host-side plan construction (numpy + bytes.find; the C++ packer will
     take this over for the file-to-plan hot path).
@@ -193,6 +204,16 @@ def build_suball_plan(
     if out_width is None:
         out_width = max(4, -(-(width + max_delta) // 4) * 4)
 
+    # Count-windowed enumeration for tight -m/-x windows (same DP scheme
+    # as match plans — the suball count is "distinct patterns chosen",
+    # which is exactly "digits > 0 over slots with options"). Fallback
+    # words keep the oracle route: totals forced to 0, matching the
+    # full-enumeration convention above.
+    windowed, win_v, n_variants = windowed_plan_fields(
+        pat_radix, n_variants, min_substitute, max_substitute,
+        zero_mask=fallback_mask,
+    )
+
     return SubAllPlan(
         tokens=packed.tokens,
         lengths=packed.lengths,
@@ -205,6 +226,8 @@ def build_suball_plan(
         n_variants=tuple(n_variants),
         fallback=fallback_mask,
         out_width=out_width,
+        windowed=windowed,
+        win_v=win_v,
     )
 
 
@@ -228,6 +251,7 @@ def expand_suball(
     min_substitute: int,
     max_substitute: int,
     block_stride: int | None = None,
+    win_v: jnp.ndarray | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -237,7 +261,9 @@ def expand_suball(
 
     ``block_stride``: fixed-stride batch layout — constant-divide lane ->
     block plus per-block broadcasts instead of per-lane searchsorted +
-    gathers (see ``expand_matches.expand_matches``).
+    gathers (see ``expand_matches.expand_matches``). ``win_v``: windowed
+    plans unrank only in-window digit vectors (``expand_matches.
+    decode_digits``; block bases are scalar ranks).
     """
     n = num_lanes
     p = pat_radix.shape[1]
@@ -254,17 +280,7 @@ def expand_suball(
     ostart_w = field(seg_orig_start)  # [N, G]
     tokens_w = field(tokens)  # [N, L]
 
-    # digits = base + mixed-radix(rank), slot 0 least significant, with carry.
-    digits = []
-    carry = jnp.zeros_like(rank)
-    r = rank
-    for s in range(p):
-        rs = radix[:, s]
-        t = base[:, s] + (r % rs) + carry
-        digits.append(t % rs)
-        carry = t // rs
-        r = r // rs
-    digits = jnp.stack(digits, axis=1)  # [N, P]
+    digits = decode_digits(rank, base, radix, field, win_v, p)  # [N, P]
 
     active = radix > 1
     chosen_count = jnp.sum((digits > 0) & active, axis=1)

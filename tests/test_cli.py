@@ -297,6 +297,60 @@ class TestDeviceBackend:
         assert r.returncode != 0
         assert b"--buckets" in r.stderr
 
+    def test_retries_candidates_requires_checkpoint(self, workdir):
+        r = run_cli(str(workdir / "dict.txt"), "-t",
+                    str(workdir / "leet.table"), "--backend", "device",
+                    "--retries", "2", check=False)
+        assert r.returncode != 0
+        assert b"--checkpoint" in r.stderr
+
+    def test_retry_machinery_resumes_and_dedupes(self):
+        # Library-level: _run_with_retries re-invokes with resume=True after
+        # a failure; _DedupRecorder suppresses cross-attempt hit replays.
+        from hashcat_a5_table_generator_tpu.cli import (
+            _DedupRecorder,
+            _run_with_retries,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.sinks import HitRecord
+
+        calls = []
+
+        def attempt(resume):
+            calls.append(resume)
+            if len(calls) < 3:
+                raise RuntimeError("chip fell over")
+            return "done"
+
+        assert _run_with_retries(
+            attempt, 5, default_resume=False, label="t"
+        ) == "done"
+        # First attempt honors the caller default (--no-resume); retries
+        # force resume=True regardless.
+        assert calls == [False, True, True]
+
+        with pytest.raises(RuntimeError):
+            _run_with_retries(
+                lambda _: (_ for _ in ()).throw(RuntimeError("x")),
+                1, default_resume=True, label="t",
+            )
+
+        class Sink:
+            def __init__(self):
+                self.got = []
+
+            def emit(self, rec):
+                self.got.append(rec)
+
+        sink = Sink()
+        rec = _DedupRecorder(sink)
+        h = HitRecord(word_index=3, variant_rank=7, candidate=b"x",
+                      digest_hex="00")
+        rec.emit(h)
+        rec.emit(h)  # the retry's resume replay
+        rec.emit(HitRecord(word_index=3, variant_rank=8, candidate=b"y",
+                           digest_hex="01"))
+        assert len(sink.got) == 2
+
     def test_packed_blocks_stream_identical(self, workdir):
         base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                 "--backend", "device", "--lanes", "64", "--blocks", "16")

@@ -416,6 +416,50 @@ class TestWindowedEnumeration:
         assert not plan.windowed
         assert plan.n_variants == (2 ** 20,)
 
+    def test_windowed_suball_modes(self):
+        # Eight single-option patterns per word: full space 2^8 = 256 per
+        # word vs ~37 windowed ranks — comfortably past the 2x gain gate.
+        from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+
+        leet = {k.encode(): [k.upper().encode()]
+                for k in "asetonir"}
+        words = [b"administrations", b"penetrations", b"xyz", b"oooo"]
+        for mode, rev in [("suball", False), ("suball-reverse", True)]:
+            spec = AttackSpec(mode=mode, algo="md5",
+                              min_substitute=1, max_substitute=2)
+            sweep, got = self._sweep_counter(spec, leet, words)
+            assert sweep.plan.windowed, mode
+            want = Counter()
+            for w in words:
+                want.update(
+                    iter_candidates(w, leet, 1, 2, substitute_all=True,
+                                    reverse=rev)
+                )
+            assert got == want, mode
+
+    def test_windowed_suball_fallback_words_keep_oracle_route(self):
+        # Cascade-hazard words must stay oracle-routed under windowed
+        # enumeration (total 0 -> device never cuts blocks for them). The
+        # fixture mixes hazard words with 8-pattern words so the windowed
+        # gain gate genuinely engages.
+        from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+
+        sub = {k.encode(): [k.upper().encode()] for k in "setonird"}
+        sub[b"a"] = [b"bb"]  # replacement re-contains pattern 'b'...
+        sub[b"b"] = [b"c"]  # ...so words holding both a and b are hazards
+        words = [b"ab", b"considerations", b"ba", b"introductions"]
+        spec = AttackSpec(mode="suball", algo="md5",
+                          min_substitute=0, max_substitute=2)
+        sweep, got = self._sweep_counter(spec, sub, words)
+        assert sweep.plan.windowed  # the gate engaged — no dead assertions
+        assert sweep.fallback_rows  # and hazard words exist alongside
+        for row in sweep.fallback_rows:
+            assert sweep.plan.n_variants[row] == 0
+        want = Counter()
+        for w in words:
+            want.update(iter_candidates(w, sub, 0, 2, substitute_all=True))
+        assert got == want
+
     def test_windowed_checkpoint_fingerprint_distinct(self, tmp_path):
         # Same inputs, different enumeration schemes (via the eligibility
         # rule) must never share a fingerprint token — guard the cursor
