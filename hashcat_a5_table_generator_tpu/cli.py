@@ -317,6 +317,12 @@ class _DedupRecorder:
         self._seen.add(key)
         self.inner.emit(record)
 
+    @property
+    def hits(self):
+        """The deduplicated hit list (Sweep.run_crack returns
+        ``recorder.hits`` — the wrapper must keep the recorder contract)."""
+        return self.inner.hits
+
 
 def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
                       label: str, retry_notice: str = ""):

@@ -412,6 +412,7 @@ def expand_matches(
     max_substitute: int,
     block_stride: int | None = None,
     win_v: jnp.ndarray | None = None,
+    splice_impl: str | None = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -434,6 +435,11 @@ def expand_matches(
     ONLY digit vectors whose chosen count is in the window — and block base
     cursors are scalar ranks in slot 0 (``make_blocks`` encodes them so for
     windowed plans).
+
+    ``splice_impl``: ``"compare"`` (TPU formulation) or ``"scatter"`` (CPU
+    formulation); ``None`` picks by the trace-time backend. Both are
+    semantically identical — see :func:`_splice_compare` /
+    :func:`_splice_scatter`.
     """
     n = num_lanes
     m = match_pos.shape[1]
@@ -460,15 +466,42 @@ def expand_matches(
     opt_row = jnp.where(chosen, opt_row, 0)
     vlen = jnp.where(chosen, val_len[opt_row], 0)  # [N, M]
 
-    # Output units per original byte position j: a chosen match starting at j
-    # contributes its value's bytes; an uncovered j contributes tokens[w, j].
-    #
-    # TPU-critical formulation: everything below is unrolled compare-and-
-    # accumulate over the STATIC slot axis M and length axis L — never
-    # ``.at[].add`` scatters and never per-lane ``searchsorted``. XLA lowers
-    # scatters with duplicate indices to serialized updates on TPU (measured
-    # ~5 µs/lane at 2^19 lanes — the whole kernel's cost, see PERF.md); the
-    # compare loops fuse into a handful of vectorized [N, L] passes.
+    if splice_impl is None:
+        # Gathers and small scatters are cheap on CPU and pathological on
+        # TPU (PERF.md §1-2); pick per backend at trace time.
+        splice_impl = (
+            "scatter" if jax.default_backend() == "cpu" else "compare"
+        )
+    splice = _splice_scatter if splice_impl == "scatter" else _splice_compare
+    out, out_len, clash = splice(
+        chosen, vlen, opt_row, pos_w, len_w, tokens_w, lengths_w, val_bytes,
+        n=n, m=m, length_axis=length_axis, out_width=out_width,
+    )
+
+    emit = (
+        lane_ok
+        & ~clash
+        & (chosen_count >= min_substitute)
+        & (chosen_count <= max_substitute)
+    )
+    return out, out_len.astype(jnp.int32), w, emit
+
+
+def _splice_compare(
+    chosen, vlen, opt_row, pos_w, len_w, tokens_w, lengths_w, val_bytes,
+    *, n, m, length_axis, out_width,
+):
+    """Candidate materialization as unrolled compare-and-accumulate over the
+    STATIC slot axis M and length axis L — never ``.at[].add`` scatters and
+    never per-lane ``searchsorted``. The TPU formulation: XLA lowers
+    scatters with duplicate indices to serialized updates there (measured
+    ~5 µs/lane at 2^19 lanes — the whole kernel's cost, PERF.md), while
+    these compare loops fuse into a handful of vectorized [N, L] passes.
+
+    Output units per original byte position j: a chosen match starting at j
+    contributes its value's bytes; an uncovered j contributes the original
+    byte. Returns ``(out uint8[N, W], out_len int32[N], clash bool[N])``.
+    """
     end_w = pos_w + len_w
     j = jnp.arange(length_axis, dtype=jnp.int32)[None, :]  # [1, L]
 
@@ -524,11 +557,60 @@ def expand_matches(
     from_val = val_bytes[src_vrow, jnp.clip(src_rel, 0, vw - 1)]
     out = jnp.where(src_is_start, from_val, src_byte)
     out = jnp.where(o < out_len[:, None], out, jnp.uint8(0))
+    return out, out_len, clash
 
-    emit = (
-        lane_ok
-        & ~clash
-        & (chosen_count >= min_substitute)
-        & (chosen_count <= max_substitute)
+
+def _splice_scatter(
+    chosen, vlen, opt_row, pos_w, len_w, tokens_w, lengths_w, val_bytes,
+    *, n, m, length_axis, out_width,
+):
+    """The CPU formulation of the same materialization: per-unit fields via
+    ``.at[].add`` scatters, source units via a vmap'd ``searchsorted``, and
+    ``take_along_axis`` gathers — all cheap on the CPU backend (XLA-CPU
+    executes them as plain indexed loops; measured ~2.5x faster there than
+    the compare loops, which do strictly more scalar work — PERF.md §2).
+    Semantically identical to :func:`_splice_compare` (the parity suite and
+    a direct equality test cover both)."""
+    lane_idx = jnp.broadcast_to(
+        jnp.arange(n, dtype=jnp.int32)[:, None], (n, m)
     )
-    return out, out_len.astype(jnp.int32), w, emit
+    end_w = pos_w + len_w
+    cov_delta = jnp.zeros((n, length_axis + 1), dtype=jnp.int32)
+    cov_delta = cov_delta.at[lane_idx, pos_w].add(chosen.astype(jnp.int32))
+    cov_delta = cov_delta.at[lane_idx, end_w].add(-chosen.astype(jnp.int32))
+    cover_count = jnp.cumsum(cov_delta[:, :length_axis], axis=1)  # [N, L]
+    covered = cover_count > 0
+    clash = jnp.any(cover_count > 1, axis=1)
+
+    start_col = jnp.minimum(pos_w, length_axis - 1)
+    started = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    started = started.at[lane_idx, start_col].add(chosen.astype(jnp.int32))
+    start_vlen = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    start_vlen = start_vlen.at[lane_idx, start_col].add(vlen)
+    start_vrow = jnp.zeros((n, length_axis), dtype=jnp.int32)
+    start_vrow = start_vrow.at[lane_idx, start_col].add(opt_row)
+
+    j = jnp.arange(length_axis, dtype=jnp.int32)[None, :]
+    in_word = j < lengths_w[:, None]
+    unit_len = jnp.where(
+        in_word,
+        jnp.where(started > 0, start_vlen, jnp.where(covered, 0, 1)),
+        0,
+    )
+    cum = jnp.cumsum(unit_len, axis=1)  # inclusive ends [N, L]
+    out_len = cum[:, -1]
+
+    o = jnp.arange(out_width, dtype=jnp.int32)
+    j_of_o = jax.vmap(lambda c: jnp.searchsorted(c, o, side="right"))(cum)
+    j_of_o = jnp.clip(j_of_o, 0, length_axis - 1).astype(jnp.int32)
+
+    take = lambda a: jnp.take_along_axis(a, j_of_o, axis=1)  # noqa: E731
+    rel = o[None, :] - (take(cum) - take(unit_len))
+    is_start = take(started) > 0
+    vrow = take(start_vrow)
+    vw = val_bytes.shape[1]
+    from_val = val_bytes[vrow, jnp.clip(rel, 0, vw - 1)]
+    from_word = take(tokens_w)
+    out = jnp.where(is_start, from_val, from_word)
+    out = jnp.where(o[None, :] < out_len[:, None], out, jnp.uint8(0))
+    return out, out_len, clash

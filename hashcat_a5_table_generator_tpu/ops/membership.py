@@ -59,14 +59,29 @@ def build_digest_set(
     digests: Iterable,
     algo: str,
     *,
-    bitmap_bits: int = DEFAULT_BITMAP_BITS,
+    bitmap_bits: int | None = None,
 ) -> DigestSet:
     """Compile raw/hex digests into a :class:`DigestSet`.
 
     Accepts raw ``bytes`` or hex strings (hashcat left-list lines). Duplicate
     digests are collapsed — membership is a set question, multiplicity lives
     on the candidate side (Q7).
+
+    ``bitmap_bits=None`` sizes the prefilter to the digest count:
+    ``ceil(log2 D) + 10`` bits (≈0.1% false-positive density), clamped to
+    [16, DEFAULT_BITMAP_BITS]. Small digest lists — the common crack-mode
+    case — then get a bitmap that fits on-chip vector memory (2^16 bits =
+    8 KiB, 2^20 = 128 KiB) instead of the fixed 2 MiB HBM-resident table,
+    so every lane's stage-1 probe stops paying an HBM random-gather.
     """
+    digests = list(digests)
+    if bitmap_bits is None:
+        import math
+
+        bitmap_bits = min(
+            DEFAULT_BITMAP_BITS,
+            max(16, math.ceil(math.log2(max(len(digests), 2))) + 10),
+        )
     if bitmap_bits < 5:
         raise ValueError("bitmap_bits must be >= 5 (one uint32 word)")
     parsed = [digest_to_words(d, algo) for d in digests]

@@ -351,6 +351,20 @@ class TestDeviceBackend:
                            digest_hex="01"))
         assert len(sink.got) == 2
 
+    def test_crack_unbucketed_single_sweep(self, workdir, tmp_path):
+        # --digests with --buckets none reaches plain Sweep.run_crack with
+        # the CLI's dedup recorder directly (no bucketed _ForwardRecorder
+        # shield) — regression: the wrapper must expose .hits.
+        target = hashlib.md5(b"p4ssword").hexdigest()
+        dig = tmp_path / "digs_nb.txt"
+        dig.write_text(target + "\n")
+        r = run_cli(str(workdir / "dict.txt"), "-t",
+                    str(workdir / "leet.table"), "--backend", "device",
+                    "--digests", str(dig), "--buckets", "none",
+                    "--lanes", "256", "--blocks", "16")
+        assert b"p4ssword" in r.stdout
+        assert b"1 hits" in r.stderr
+
     def test_packed_blocks_stream_identical(self, workdir):
         base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                 "--backend", "device", "--lanes", "64", "--blocks", "16")

@@ -132,6 +132,43 @@ def test_index_decode_equals_dfs_multiset(mode_i, word, table, window):
     assert got == want
 
 
+@settings(max_examples=40, deadline=None)
+@given(word=words, table=tables,
+       window=st.tuples(st.integers(1, 2), st.integers(1, 4)).filter(
+           lambda t: t[0] <= t[1]))
+def test_windowed_unrank_equals_masked_full(word, table, window):
+    """Count-windowed enumeration theorem: unranking the windowed plan's
+    [0, T) visits exactly the in-window, non-clashing variants the full
+    mixed-radix plan yields after masking — same multiset, fewer ranks."""
+    from hashcat_a5_table_generator_tpu.ops.expand_matches import (
+        build_match_plan,
+    )
+
+    mn, mx = window
+    spec = AttackSpec(mode="default", min_substitute=mn, max_substitute=mx)
+    ct = compile_table(table)
+    packed = pack_words([word])
+    full = build_match_plan(ct, packed)
+    win = build_match_plan(
+        ct, packed, min_substitute=spec.effective_min, max_substitute=mx
+    )
+    if full.n_variants[0] > 4096:
+        return  # keep the exhaustive decode bounded
+    if win.windowed:
+        assert win.n_variants[0] <= full.n_variants[0]
+
+    def multiset(plan):
+        got = Counter()
+        for rank in range(plan.n_variants[0]):
+            try:
+                got[decode_variant(plan, ct, spec, 0, rank)] += 1
+            except ValueError:
+                pass  # masked: window miss or overlap clash
+        return got
+
+    assert multiset(win) == multiset(full)
+
+
 @settings(max_examples=100, deadline=None)
 @given(
     pairs=st.lists(
