@@ -175,15 +175,15 @@ def run_worker(args: argparse.Namespace) -> None:
     targets = [host_digest(b"bench-decoy-%d" % i) for i in range(1024)]
     ds = build_digest_set(targets, spec.algo)
 
-    # Fixed-stride blocks whenever lanes divide evenly over the block slots
-    # (the TPU fast path: arithmetic lane->block map, no per-lane binary
-    # search — PERF.md). One rule, owned by the sweep runtime: the bench
-    # must measure the same layout the real sweep executes.
+    # Block layout by backend, one rule owned by the sweep runtime (the
+    # bench must measure the same layout the real sweep executes):
+    # fixed-stride on accelerators (arithmetic lane->block map, no per-lane
+    # binary search), packed on CPU (perfect fill, cheap search) — PERF.md.
     from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
 
     stride = SweepConfig(
         lanes=args.lanes, num_blocks=args.blocks
-    ).block_stride
+    ).resolve_block_stride()
     step = make_crack_step(spec, num_lanes=args.lanes,
                            out_width=plan.out_width, block_stride=stride)
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)

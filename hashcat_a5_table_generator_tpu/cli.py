@@ -119,13 +119,16 @@ def build_parser() -> argparse.ArgumentParser:
                     help="variant lanes per device per launch")
     ap.add_argument("--blocks", type=int, default=1024,
                     help="device block slots per launch")
-    ap.add_argument("--packed-blocks", action="store_true",
-                    help="use the tightly-packed variable-offset block "
-                         "layout instead of fixed-stride blocks (stride = "
-                         "lanes/blocks). Packed wastes no lanes on word "
-                         "tails but maps lane->block with a per-lane binary "
-                         "search the TPU serializes; prefer it only for "
-                         "tables whose words have very few variants each")
+    ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
+                    default="auto",
+                    help="variant-block layout: 'packed' = tightly-packed "
+                         "variable offsets (no lanes wasted on word tails; "
+                         "lane->block is a per-lane binary search the TPU "
+                         "serializes), 'stride' = fixed lanes-per-block "
+                         "(stride = lanes/blocks; arithmetic lane->block "
+                         "map — the accelerator fast path). Default 'auto' "
+                         "picks packed on CPU, stride elsewhere; the "
+                         "layouts are stream-identical (PERF.md §2)")
     ap.add_argument("--devices", type=_devices_arg, default=1, metavar="N",
                     help="shard the sweep over N local devices via a 1-D "
                          "mesh ('auto' = all local devices; default 1)")
@@ -411,7 +414,9 @@ def _run_device(args, sub_map, packed) -> int:
         lanes=args.lanes,
         num_blocks=args.blocks,
         devices=args.devices,
-        packed_blocks=args.packed_blocks,
+        packed_blocks={"auto": None, "packed": True, "stride": False}[
+            args.block_layout
+        ],
         checkpoint_path=args.checkpoint,
         checkpoint_every_s=args.checkpoint_every,
         progress=progress,

@@ -80,20 +80,27 @@ class SweepConfig:
     max_in_flight: int = 2  # double-buffered launches
     devices: Optional[int] = 1  # 1 = single-device; N = shard over first N
     #                             local devices; None = all local devices
-    packed_blocks: bool = False  # True = variable-offset (tightly packed)
-    #   block layout; False = fixed-stride blocks (stride = lanes //
+    packed_blocks: Optional[bool] = None  # True = variable-offset (tightly
+    #   packed) block layout; False = fixed-stride blocks (stride = lanes //
     #   num_blocks) whenever lanes divides evenly — the TPU fast path: the
     #   kernels map lane -> block arithmetically instead of binary-searching
-    #   per lane (PERF.md). Tail lanes of each word's last block are masked,
-    #   so packed may win for tables whose words have very few variants.
+    #   per lane (PERF.md). None = auto by backend: packed on CPU (perfect
+    #   lane fill, cheap per-lane search) and fixed-stride elsewhere. The
+    #   layouts are stream-identical; only throughput differs.
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
 
-    @property
-    def block_stride(self) -> Optional[int]:
-        """Lanes-per-block of the fixed-stride layout; None = packed."""
-        if self.packed_blocks or self.lanes % self.num_blocks:
+    def resolve_block_stride(self) -> Optional[int]:
+        """Lanes-per-block of the fixed-stride layout; None = packed.
+        Resolves the ``packed_blocks=None`` auto mode against the live
+        backend, so call only where JAX is already in play."""
+        packed = self.packed_blocks
+        if packed is None:
+            import jax
+
+            packed = jax.default_backend() == "cpu"
+        if packed or self.lanes % self.num_blocks:
             return None
         return self.lanes // self.num_blocks
 
@@ -271,12 +278,13 @@ class Sweep:
         (launch(blocks) -> out, n_devices, mesh)."""
         spec, cfg, plan = self.spec, self.config, self.plan
         n_devices = self._resolve_devices()
+        stride = cfg.resolve_block_stride()
         if n_devices == 1:
             p, t = plan_arrays(plan), table_arrays(self.ct)
             if kind == "crack":
                 step = make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
-                    block_stride=cfg.block_stride,
+                    block_stride=stride,
                 )
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
@@ -284,7 +292,7 @@ class Sweep:
                 return (lambda blocks: step(p, t, blocks, darrs)), 1, None
             step = make_candidates_step(
                 spec, num_lanes=cfg.lanes, out_width=plan.out_width,
-                block_stride=cfg.block_stride,
+                block_stride=stride,
             )
             return (lambda blocks: step(p, t, blocks)), 1, None
 
@@ -299,7 +307,7 @@ class Sweep:
         if kind == "crack":
             step = make_sharded_crack_step(
                 spec, mesh, lanes_per_device=cfg.lanes,
-                out_width=plan.out_width, block_stride=cfg.block_stride,
+                out_width=plan.out_width, block_stride=stride,
             )
             p, t, darrs = replicate(
                 mesh,
@@ -312,7 +320,7 @@ class Sweep:
             return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
         step = make_sharded_candidates_step(
             spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width,
-            block_stride=cfg.block_stride,
+            block_stride=stride,
         )
         p, t = replicate(mesh, (plan_arrays(plan), table_arrays(self.ct)))
         return (lambda blocks: step(p, t, blocks)), n_devices, mesh
@@ -329,6 +337,7 @@ class Sweep:
         import jax.profiler
 
         cfg = self.config
+        stride = cfg.resolve_block_stride()
         pending: deque = deque()
         w, rank = cursor.word, cursor.rank
         lanes = cfg.lanes
@@ -343,7 +352,7 @@ class Sweep:
                         start_rank=rank,
                         max_variants=lanes,
                         max_blocks=cfg.num_blocks,
-                        fixed_stride=cfg.block_stride,
+                        fixed_stride=stride,
                     )
                     if batch.total == 0:
                         break
@@ -363,7 +372,7 @@ class Sweep:
                         start_word=w,
                         start_rank=rank,
                         max_blocks=cfg.num_blocks,
-                        fixed_stride=cfg.block_stride,
+                        fixed_stride=stride,
                     )
                     if sum(b.total for b in batches) == 0:
                         break

@@ -365,12 +365,16 @@ class TestDeviceBackend:
         assert b"p4ssword" in r.stdout
         assert b"1 hits" in r.stderr
 
-    def test_packed_blocks_stream_identical(self, workdir):
+    def test_block_layouts_stream_identical(self, workdir):
+        # Force BOTH layouts explicitly (auto resolves to packed on the CPU
+        # test backend, so flagless-vs-packed would compare packed to
+        # itself): stride and packed must produce byte-identical streams.
         base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                 "--backend", "device", "--lanes", "64", "--blocks", "16")
-        strided = run_cli(*base)
-        packed = run_cli(*base, "--packed-blocks")
-        assert packed.stdout == strided.stdout
+        strided = run_cli(*base, "--block-layout", "stride")
+        packed = run_cli(*base, "--block-layout", "packed")
+        auto = run_cli(*base)
+        assert packed.stdout == strided.stdout == auto.stdout
         assert strided.stdout
 
     def test_profile_writes_trace(self, workdir, tmp_path):

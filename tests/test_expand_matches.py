@@ -296,7 +296,7 @@ class TestFixedStride:
             buf = io.BytesIO()
             cfg = SweepConfig(lanes=64, num_blocks=16,
                               packed_blocks=packed_blocks)
-            assert (cfg.block_stride is None) == packed_blocks
+            assert (cfg.resolve_block_stride() is None) == packed_blocks
             with CandidateWriter(stream=buf) as writer:
                 Sweep(spec, self.LEET, self.WORDS, config=cfg).run_candidates(
                     writer, resume=False
