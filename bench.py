@@ -93,6 +93,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--batches", type=int, default=8,
                     help="distinct pre-cut batches to cycle")
     ap.add_argument("--algo", default="md5", help="hash algorithm")
+    ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
+                    default="auto",
+                    help="variant-block layout (same semantics as the CLI; "
+                         "auto = packed on CPU, stride on accelerators)")
     ap.add_argument("--mode", default="default", help="attack mode")
     ap.add_argument("--init-timeout", type=float, default=150.0,
                     help="seconds the worker waits for accelerator init")
@@ -182,8 +186,14 @@ def run_worker(args: argparse.Namespace) -> None:
     from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
 
     stride = SweepConfig(
-        lanes=args.lanes, num_blocks=args.blocks
+        lanes=args.lanes,
+        num_blocks=args.blocks,
+        packed_blocks={"auto": None, "packed": True, "stride": False}[
+            args.block_layout
+        ],
     ).resolve_block_stride()
+    print(f"# block layout: {'packed' if stride is None else f'stride {stride}'}",
+          file=sys.stderr)
     step = make_crack_step(spec, num_lanes=args.lanes,
                            out_width=plan.out_width, block_stride=stride)
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
@@ -368,6 +378,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
             "--seconds", str(vals["seconds"]),
             "--batches", str(vals["batches"]), "--algo", args.algo,
             "--mode", args.mode, "--init-timeout", str(init_timeout),
+            "--block-layout", args.block_layout,
         ]
         if platform:
             out += ["--platform", platform]

@@ -94,14 +94,28 @@ class SweepConfig:
     def resolve_block_stride(self) -> Optional[int]:
         """Lanes-per-block of the fixed-stride layout; None = packed.
         Resolves the ``packed_blocks=None`` auto mode against the live
-        backend, so call only where JAX is already in play."""
+        backend, so call only where JAX is already in play.
+
+        An EXPLICIT stride request (``packed_blocks=False``) with a
+        non-divisible geometry raises instead of silently degrading to
+        packed; auto mode quietly falls back (the layouts are
+        stream-identical, only throughput differs)."""
         packed = self.packed_blocks
         if packed is None:
             import jax
 
-            packed = jax.default_backend() == "cpu"
-        if packed or self.lanes % self.num_blocks:
+            packed = (
+                jax.default_backend() == "cpu"
+                or self.lanes % self.num_blocks != 0
+            )
+        if packed:
             return None
+        if self.lanes % self.num_blocks:
+            raise ValueError(
+                f"fixed-stride layout needs lanes ({self.lanes}) divisible "
+                f"by blocks ({self.num_blocks}); adjust the geometry or use "
+                "the packed layout"
+            )
         return self.lanes // self.num_blocks
 
 

@@ -103,11 +103,16 @@ class TestBucketedSweep:
             assert sweep.plan.out_width < global_width or width == 128
         assert bs.sweeps[16].plan.out_width <= 32  # 16 + expansion margin
 
-    def test_candidates_multiset_matches_oracle(self):
+    # layout=False forces the fixed-stride (accelerator) layout — auto
+    # resolves to packed on the CPU test backend, and bucketed sweeps must
+    # keep stride coverage.
+    @pytest.mark.parametrize("layout", [None, False], ids=["auto", "stride"])
+    def test_candidates_multiset_matches_oracle(self, layout):
         spec = AttackSpec(mode="default", algo="md5")
         bs = BucketedSweep(
             spec, LEET, bucket_words(WORDS),
-            config=SweepConfig(lanes=256, num_blocks=32),
+            config=SweepConfig(lanes=256, num_blocks=32,
+                               packed_blocks=layout),
         )
         buf = io.BytesIO()
         with CandidateWriter(buf) as w:

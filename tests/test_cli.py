@@ -207,8 +207,13 @@ class TestDeviceBackend:
         single = run_cli(*base, "--devices", "1")
         multi = run_cli(*base, "--devices", "8")
         auto = run_cli(*base, "--devices", "auto")
+        # Sharded + forced fixed-stride layout: the accelerator production
+        # combination (auto resolves to packed on the CPU test backend).
+        strided = run_cli(*base, "--devices", "8",
+                          "--block-layout", "stride")
         assert multi.stdout == single.stdout
         assert auto.stdout == single.stdout
+        assert strided.stdout == single.stdout
         assert single.stdout  # non-empty stream
 
     def test_devices_rejects_garbage(self, workdir):

@@ -1,0 +1,115 @@
+"""Pod-scale checkpoint/resume: a 2-process BUCKETED crack sweep runs to
+completion, then the SAME pod relaunches with the same per-host checkpoint
+paths — every process must report resumed=True, replay its checkpointed
+hits, and the combined hit set must equal the fresh run's (SURVEY.md §5
+failure detection/recovery at the multihost level: pod recovery =
+relaunch, each host resumes its own stripe manifest)."""
+
+import hashlib
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+_CHILD = r"""
+import json, os, sys
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+outdir = sys.argv[3]
+ck = sys.argv[4]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from hashcat_a5_table_generator_tpu.parallel import multihost
+
+multihost.initialize(f"127.0.0.1:{port}", 2, pid)
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.ops.packing import bucket_words
+from hashcat_a5_table_generator_tpu.parallel.multihost import (
+    run_crack_multihost,
+)
+from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
+
+LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a", b"assess",
+         b"oboe", b"extraordinarily", b"sass"]
+digests = [bytes.fromhex(h) for h in json.loads(sys.argv[5])]
+
+spec = AttackSpec(mode="default", algo="md5")
+res = run_crack_multihost(
+    spec, LEET, bucket_words(WORDS, buckets=(8, 16)), digests,
+    # packed_blocks=False forces the fixed-stride (accelerator) layout so
+    # the pod-resume path keeps stride coverage on the CPU test backend.
+    config=SweepConfig(lanes=64, num_blocks=16, checkpoint_path=ck,
+                       packed_blocks=False),
+)
+with open(os.path.join(outdir, f"res{pid}.json"), "w") as fh:
+    json.dump({
+        "resumed": res.resumed,
+        "n_hits": res.n_hits,
+        "hits": [[h.word_index, h.variant_rank, h.candidate.hex()]
+                 for h in res.hits],
+    }, fh)
+"""
+
+
+def _launch_pod(tmp_path, ck, digest_arg, tag):
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "child_resume.py"
+    script.write_text(_CHILD)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    outdir = tmp_path / tag
+    outdir.mkdir()
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(p), str(port), str(outdir),
+             str(ck), digest_arg],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for p in range(2)
+    ]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err.decode()[-3000:]
+    return [json.load(open(outdir / f"res{p}.json")) for p in range(2)]
+
+
+def test_pod_relaunch_resumes_bucketed_checkpoints(tmp_path):
+    from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+
+    leet = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+    words = [b"password", b"sesame", b"octopus", b"zzz", b"a", b"assess",
+             b"oboe", b"extraordinarily", b"sass"]
+    oracle = []
+    for w in words:
+        oracle.extend(iter_candidates(w, leet, 0, 15))
+    planted = sorted({oracle[0], oracle[len(oracle) // 2], oracle[-1]})
+    digest_arg = json.dumps([hashlib.md5(c).digest().hex() for c in planted])
+
+    ck = tmp_path / "pod.ck"
+    first = _launch_pod(tmp_path, ck, digest_arg, "first")
+    assert first[0] == first[1]
+    assert first[0]["resumed"] is False
+    assert first[0]["n_hits"] == len(planted)
+    # Per-host bucket manifests exist (FILE.pN + per-bucket .wW cursors).
+    assert (tmp_path / "pod.ck.p0").exists()
+    assert (tmp_path / "pod.ck.p1").exists()
+
+    second = _launch_pod(tmp_path, ck, digest_arg, "second")
+    assert second[0] == second[1]
+    assert second[0]["resumed"] is True
+    assert second[0]["hits"] == first[0]["hits"]

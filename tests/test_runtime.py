@@ -383,12 +383,17 @@ class TestMultiDeviceSweep:
     shard_map loop): SweepConfig(devices=N) must produce exactly the
     single-device results on the 8-virtual-CPU-device mesh."""
 
+    # layout=False forces the fixed-stride (accelerator) layout on the CPU
+    # test backend — auto would resolve to packed here, and the sharded
+    # production path must keep stride coverage.
+    @pytest.mark.parametrize("layout", [None, False], ids=["auto", "stride"])
     @pytest.mark.parametrize("mode", ["default", "suball"])
-    def test_candidates_equal_single_device(self, mode):
+    def test_candidates_equal_single_device(self, mode, layout):
         spec = AttackSpec(mode=mode, algo="md5")
 
         def run(devices):
-            cfg = SweepConfig(lanes=64, num_blocks=16, devices=devices)
+            cfg = SweepConfig(lanes=64, num_blocks=16, devices=devices,
+                              packed_blocks=layout)
             sweep = Sweep(spec, LEET, WORDS, config=cfg)
             buf = io.BytesIO()
             with CandidateWriter(buf) as w:
@@ -402,7 +407,8 @@ class TestMultiDeviceSweep:
         assert out8 == out1
         assert n8 == n1 == len(oracle_lines(spec, LEET, WORDS))
 
-    def test_crack_hits_equal_single_device(self):
+    @pytest.mark.parametrize("layout", [None, False], ids=["auto", "stride"])
+    def test_crack_hits_equal_single_device(self, layout):
         spec = AttackSpec(mode="default", algo="md5")
         oracle = oracle_lines(spec, LEET, WORDS)
         planted = sorted({oracle[0], oracle[len(oracle) // 3], oracle[-1]})
@@ -410,7 +416,8 @@ class TestMultiDeviceSweep:
         digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(40)]
 
         def run(devices):
-            cfg = SweepConfig(lanes=64, num_blocks=16, devices=devices)
+            cfg = SweepConfig(lanes=64, num_blocks=16, devices=devices,
+                              packed_blocks=layout)
             sweep = Sweep(spec, LEET, WORDS, digests, config=cfg)
             res = sweep.run_crack()
             return res.n_emitted, [
