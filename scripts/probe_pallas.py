@@ -1,0 +1,106 @@
+"""A/B the Pallas MD5 kernel against the XLA hash path inside the fused
+crack step on the live device. Evidence for PERF.md §3; not part of the
+package. Run twice-in-one: both programs built in-process (the A5GEN_PALLAS
+env hook is trace-time, so we call maybe_pallas_hash_fn's target directly).
+"""
+
+import json
+import os
+import sys
+import time
+from collections import deque
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays, plan_arrays,
+    table_arrays, _expand,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.hashes import HASH_FNS
+from hashcat_a5_table_generator_tpu.ops.membership import (
+    build_digest_set, digest_member,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.ops.pallas_md5 import md5_pallas
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+LANES = 1 << 19
+BLOCKS = 4096
+STRIDE = LANES // BLOCKS
+
+
+def fused_with(hash_fn, spec, ow):
+    def body(p, t, d, b):
+        cand, cand_len, word_row, emit = _expand(
+            spec, p, t, b, num_lanes=LANES, out_width=ow,
+            block_stride=STRIDE,
+        )
+        state = hash_fn(cand, cand_len)
+        member = digest_member(state, d["rows"], d["bitmap"])
+        hit = member & emit
+        return {
+            "n_emitted": jnp.sum(emit.astype(jnp.int32)),
+            "n_hits": jnp.sum(hit.astype(jnp.int32)),
+        }
+
+    return jax.jit(body)
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    packed = pack_words(synth_wordlist(20000))
+    plan = build_plan(spec, ct, packed)
+    ds = build_digest_set(
+        [HOST_DIGEST["md5"](b"bench-decoy-%d" % i) for i in range(1024)], "md5"
+    )
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    batches = []
+    w = rank = 0
+    for _ in range(3):
+        batch, w, rank = make_blocks(plan, start_word=w, start_rank=rank,
+                                     max_variants=LANES, max_blocks=BLOCKS,
+                                     fixed_stride=STRIDE)
+        batches.append(block_arrays(batch, num_blocks=BLOCKS))
+
+    for name, hash_fn in (("xla_md5", HASH_FNS["md5"]),
+                          ("pallas_md5", md5_pallas)):
+        step = fused_with(hash_fn, spec, plan.out_width)
+        t0 = time.perf_counter()
+        e0 = int(step(p, t, d, batches[0])["n_emitted"])
+        compile_s = time.perf_counter() - t0
+        n = 10
+        q = deque()
+        hashed = 0
+        t0 = time.perf_counter()
+        for i in range(n):
+            q.append(step(p, t, d, batches[i % 3]))
+            if len(q) >= 2:
+                hashed += int(q.popleft()["n_emitted"])
+        while q:
+            hashed += int(q.popleft()["n_emitted"])
+        el = time.perf_counter() - t0
+        print(json.dumps({
+            "variant": name, "compile_s": round(compile_s, 1),
+            "per_launch_s": round(el / n, 4),
+            "hashes_per_sec": round(hashed / el, 1),
+            "hits_consistent": int(step(p, t, d, batches[0])["n_hits"]),
+        }))
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()
