@@ -123,10 +123,23 @@ def maybe_pallas_hash_fn(algo: str, hash_fn):
     branch)."""
     import os
 
-    if (
-        algo == "md5"
-        and os.environ.get("A5GEN_PALLAS") == "1"
-        and jax.default_backend() == "tpu"
-    ):
-        return md5_pallas
+    if algo == "md5" and os.environ.get("A5GEN_PALLAS") == "1":
+        # Check the DEVICE platform, not the backend name: the remote
+        # tunnel registers a backend whose name differs from its device
+        # platform ("tpu" devices behind an "axon" backend).
+        try:
+            on_tpu = jax.devices()[0].platform == "tpu"
+        except Exception as e:  # pragma: no cover - backend-dependent
+            import sys
+
+            # The user explicitly asked for Pallas; a swallowed device-
+            # enumeration error must not silently route to the slow path.
+            print(
+                f"a5gen: warning: A5GEN_PALLAS=1 but device enumeration "
+                f"failed ({type(e).__name__}: {e}); using the XLA hash path",
+                file=sys.stderr,
+            )
+            on_tpu = False
+        if on_tpu:
+            return md5_pallas
     return hash_fn

@@ -1,7 +1,18 @@
-"""A/B the Pallas MD5 kernel against the XLA hash path inside the fused
-crack step on the live device. Evidence for PERF.md §3; not part of the
-package. Run twice-in-one: both programs built in-process (the A5GEN_PALLAS
-env hook is trace-time, so we call maybe_pallas_hash_fn's target directly).
+"""A/B the Pallas MD5 kernel against the XLA hash path inside the REAL
+fused crack step on the live device (PERF.md §3 evidence; not part of the
+package).
+
+Fidelity notes (review-driven):
+* Both variants build the production program via ``make_crack_step`` — the
+  ``A5GEN_PALLAS`` hook is read at trace-build time inside
+  ``make_fused_body``, so toggling the env var between the two builds
+  yields two full-fidelity programs in one process.
+* Eligibility is asserted up front — ``md5_pallas`` silently falls back to
+  XLA for ineligible geometries, which would turn the A/B into a
+  self-comparison.
+* The digest set plants REAL candidate hashes, so ``n_hits`` equality
+  between variants is a live correctness signal for the Pallas kernel,
+  not a vacuous 0 == 0.
 """
 
 import json
@@ -14,22 +25,19 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 import jax
-import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from bench import synth_wordlist
 from hashcat_a5_table_generator_tpu.models.attack import (
-    AttackSpec, block_arrays, build_plan, digest_arrays, plan_arrays,
-    table_arrays, _expand,
+    AttackSpec, block_arrays, build_plan, digest_arrays, make_crack_step,
+    plan_arrays, table_arrays,
 )
 from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
-from hashcat_a5_table_generator_tpu.ops.hashes import HASH_FNS
-from hashcat_a5_table_generator_tpu.ops.membership import (
-    build_digest_set, digest_member,
-)
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
 from hashcat_a5_table_generator_tpu.ops.packing import pack_words
-from hashcat_a5_table_generator_tpu.ops.pallas_md5 import md5_pallas
+from hashcat_a5_table_generator_tpu.ops.pallas_md5 import pallas_supported
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
 from hashcat_a5_table_generator_tpu.tables.compile import compile_table
 from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
 from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
@@ -39,34 +47,40 @@ BLOCKS = 4096
 STRIDE = LANES // BLOCKS
 
 
-def fused_with(hash_fn, spec, ow):
-    def body(p, t, d, b):
-        cand, cand_len, word_row, emit = _expand(
-            spec, p, t, b, num_lanes=LANES, out_width=ow,
-            block_stride=STRIDE,
-        )
-        state = hash_fn(cand, cand_len)
-        member = digest_member(state, d["rows"], d["bitmap"])
-        hit = member & emit
-        return {
-            "n_emitted": jnp.sum(emit.astype(jnp.int32)),
-            "n_hits": jnp.sum(hit.astype(jnp.int32)),
-        }
-
-    return jax.jit(body)
-
-
 def main():
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
     spec = AttackSpec(mode="default", algo="md5")
-    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
-    packed = pack_words(synth_wordlist(20000))
+    sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
+    ct = compile_table(sub_map)
+    words = synth_wordlist(20000)
+    packed = pack_words(words)
     plan = build_plan(spec, ct, packed)
-    ds = build_digest_set(
-        [HOST_DIGEST["md5"](b"bench-decoy-%d" % i) for i in range(1024)], "md5"
+    assert pallas_supported(LANES, plan.out_width), (
+        f"geometry ineligible for Pallas (lanes={LANES}, "
+        f"out_width={plan.out_width}) — the A/B would self-compare"
     )
+    # The hook must actually select the Pallas kernel on this platform —
+    # otherwise both variants compile the identical XLA program and the
+    # planted-hit check passes vacuously.
+    from hashcat_a5_table_generator_tpu.ops.pallas_md5 import (
+        maybe_pallas_hash_fn, md5_pallas,
+    )
+
+    os.environ["A5GEN_PALLAS"] = "1"
+    assert maybe_pallas_hash_fn("md5", None) is md5_pallas, (
+        f"Pallas hook not engaged on platform {dev.platform!r} — "
+        "the A/B would self-compare"
+    )
+
+    # Plant real hits inside the first launch's lane span so n_hits is a
+    # live cross-variant correctness signal.
+    host_digest = HOST_DIGEST[spec.algo]
+    planted = list(iter_candidates(words[0], sub_map, 0, 15))[:3]
+    targets = [host_digest(c) for c in planted]
+    targets += [host_digest(b"bench-decoy-%d" % i) for i in range(1021)]
+    ds = build_digest_set(targets, spec.algo)
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
     batches = []
     w = rank = 0
@@ -76,18 +90,21 @@ def main():
                                      fixed_stride=STRIDE)
         batches.append(block_arrays(batch, num_blocks=BLOCKS))
 
-    for name, hash_fn in (("xla_md5", HASH_FNS["md5"]),
-                          ("pallas_md5", md5_pallas)):
-        step = fused_with(hash_fn, spec, plan.out_width)
+    hits_by_variant = {}
+    for name, env in (("xla_md5", "0"), ("pallas_md5", "1")):
+        os.environ["A5GEN_PALLAS"] = env  # read at trace-build time
+        step = make_crack_step(spec, num_lanes=LANES,
+                               out_width=plan.out_width, block_stride=STRIDE)
         t0 = time.perf_counter()
-        e0 = int(step(p, t, d, batches[0])["n_emitted"])
+        first = step(p, t, batches[0], d)
+        hits_by_variant[name] = int(first["n_hits"])
         compile_s = time.perf_counter() - t0
         n = 10
         q = deque()
         hashed = 0
         t0 = time.perf_counter()
         for i in range(n):
-            q.append(step(p, t, d, batches[i % 3]))
+            q.append(step(p, t, batches[i % 3], d))
             if len(q) >= 2:
                 hashed += int(q.popleft()["n_emitted"])
         while q:
@@ -97,9 +114,14 @@ def main():
             "variant": name, "compile_s": round(compile_s, 1),
             "per_launch_s": round(el / n, 4),
             "hashes_per_sec": round(hashed / el, 1),
-            "hits_consistent": int(step(p, t, d, batches[0])["n_hits"]),
+            "n_hits_first_launch": hits_by_variant[name],
         }))
         sys.stdout.flush()
+
+    assert hits_by_variant["pallas_md5"] == hits_by_variant["xla_md5"] >= 1, (
+        f"planted-hit mismatch: {hits_by_variant} — Pallas digests diverge"
+    )
+    print("# planted hits consistent across variants", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,46 @@
+"""The driver-facing bench contract (README "Maintain bench.py"): one JSON
+line on stdout with metric/value/unit/vs_baseline, whatever the platform.
+Runs the worker directly on the CPU backend at a tiny geometry — the
+orchestrator's kill-timeout machinery is exercised implicitly every round
+by the driver; what must never regress silently is the record shape and
+the worker's ability to produce a number."""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_worker_emits_one_json_record():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--platform", "cpu",
+         "--lanes", "4096", "--blocks", "64", "--words", "400",
+         "--seconds", "1", "--batches", "2"],
+        capture_output=True, timeout=240, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    assert rec["metric"] == "md5_candidate_hashes_per_sec_per_chip"
+    assert rec["unit"] == "hashes/sec"
+    assert rec["value"] > 0
+    assert rec["platform"] == "cpu"
+    assert rec["launches"] >= 2  # bounded-in-flight loop actually ran
+
+
+def test_worker_respects_block_layout_flag():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--platform", "cpu",
+         "--lanes", "4096", "--blocks", "64", "--words", "400",
+         "--seconds", "1", "--batches", "2", "--block-layout", "stride"],
+        capture_output=True, timeout=240, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    assert b"# block layout: stride 64" in r.stderr
+    rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert rec["value"] > 0
