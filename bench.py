@@ -82,11 +82,11 @@ def synth_wordlist(n: int, seed: int = 0):
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--lanes", type=int, default=1 << 19,
+    ap.add_argument("--lanes", type=int, default=1 << 22,
                     help="variant lanes per launch")
-    ap.add_argument("--blocks", type=int, default=4096,
+    ap.add_argument("--blocks", type=int, default=32768,
                     help="static block count per launch")
-    ap.add_argument("--words", type=int, default=20000,
+    ap.add_argument("--words", type=int, default=50000,
                     help="synthetic wordlist size")
     ap.add_argument("--seconds", type=float, default=10.0,
                     help="timed-window length")
@@ -155,7 +155,7 @@ def run_worker(args: argparse.Namespace) -> None:
         block_arrays,
         build_plan,
         digest_arrays,
-        make_crack_step,
+        make_fused_body,
         plan_arrays,
         table_arrays,
     )
@@ -194,8 +194,6 @@ def run_worker(args: argparse.Namespace) -> None:
     ).resolve_block_stride()
     print(f"# block layout: {'packed' if stride is None else f'stride {stride}'}",
           file=sys.stderr)
-    step = make_crack_step(spec, num_lanes=args.lanes,
-                           out_width=plan.out_width, block_stride=stride)
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
 
     # Pre-cut real blocks from the sweep's head (host cost excluded: the
@@ -223,23 +221,39 @@ def run_worker(args: argparse.Namespace) -> None:
     # `n_emitted` excludes min-window misses (e.g. default mode's rank-0
     # no-substitution variant) and overlap-clash lanes — only emitted lanes
     # are hashed candidates, so only they count.
+    #
+    # The fetch itself costs a full tunnel round trip (~65 ms measured —
+    # ~5x the device time of a 2^19-lane launch), so the timed loop chains
+    # per-launch emitted counts into a DEVICE-side int32 accumulator and
+    # fetches it once per chunk: in-flight work is bounded by the chunk
+    # length (the chunk fetch is a completion barrier over its whole
+    # chain), while the round trip amortizes across the chunk.
+    import jax.numpy as jnp
+
+    body = make_fused_body(spec, num_lanes=args.lanes,
+                           out_width=plan.out_width, block_stride=stride)
+    acc_step = jax.jit(
+        lambda p_, t_, b_, d_, tot: tot + body(p_, t_, d_, b_)["n_emitted"]
+    )
+    zero = jnp.zeros((), jnp.int32)
+
     t0 = time.perf_counter()
-    int(step(p, t, batches[0], d)["n_emitted"])
+    int(acc_step(p, t, batches[0], d, zero))
     print(f"# warmup (incl. compile): {time.perf_counter()-t0:.1f}s",
           file=sys.stderr)
 
-    # Size the window from evidence: one steady-state launch, then run the
-    # number of launches the requested window can retire — never dispatch
-    # more than the budget can drain (each launch is fetched before two
-    # more are dispatched, so in-flight work is bounded at 2).
+    # One steady-state launch (fetch included) sizes the chunk so each
+    # chunk retires in ~2 s of wall clock; per-launch time inside a chunk
+    # is lower than this estimate (no per-launch round trip), so chunks
+    # only ever finish faster than sized. int32 safety: 256 launches of
+    # 2^22 lanes stays under 2^31 counts.
     t0 = time.perf_counter()
-    int(step(p, t, batches[1 % len(batches)], d)["n_emitted"])
+    int(acc_step(p, t, batches[1 % len(batches)], d, zero))
     per_launch = time.perf_counter() - t0
-    target = max(2, min(5000, int(args.seconds / max(per_launch, 1e-4))))
-    print(f"# sized window: {per_launch:.3f}s/launch -> {target} launches",
+    chunk = max(2, min(256, int(2.0 / max(per_launch, 1e-4))))
+    print(f"# sized chunks: {per_launch:.3f}s/launch -> {chunk}/chunk",
           file=sys.stderr)
 
-    from collections import deque
     from contextlib import nullcontext
 
     trace_ctx = nullcontext()
@@ -252,21 +266,24 @@ def run_worker(args: argparse.Namespace) -> None:
     launches = 0
     with trace_ctx:
         start = time.perf_counter()
-        # Hard guard: if launches run slower than the sizing launch
-        # suggested, stop early and report a partial window rather than
-        # dying on the orchestrator's knife (r3's failure mode).
+        # Hard guard: if chunks run slower than the sizing launch
+        # suggested, stop at a chunk boundary and report a partial window
+        # rather than dying on the orchestrator's knife (r3's failure
+        # mode). Only fetched chunks are counted.
         guard = start + max(3 * args.seconds, args.seconds + 30.0)
-        pending: deque = deque()
-        for i in range(target):
-            pending.append(step(p, t, batches[i % len(batches)], d))
-            while len(pending) >= 2:
-                hashed += int(pending.popleft()["n_emitted"])
-                launches += 1
-            if time.perf_counter() > guard:
+        i = 0
+        guard_tripped = False
+        while True:
+            total = zero
+            for _ in range(chunk):
+                total = acc_step(p, t, batches[i % len(batches)], d, total)
+                i += 1
+            hashed += int(total)  # completion barrier for the whole chain
+            launches += chunk
+            now = time.perf_counter()
+            guard_tripped = now > guard
+            if now - start >= args.seconds or guard_tripped:
                 break
-        while pending:
-            hashed += int(pending.popleft()["n_emitted"])
-            launches += 1
         elapsed = time.perf_counter() - start
 
     value = hashed / elapsed
@@ -284,8 +301,8 @@ def run_worker(args: argparse.Namespace) -> None:
         "launches": launches,
         "per_launch_s": round(elapsed / max(launches, 1), 4),
     }
-    if launches < target:
-        record["partial"] = True
+    if guard_tripped:
+        record["partial"] = True  # chunks ran far slower than sized
     print(json.dumps(record))
     sys.stdout.flush()
 
@@ -387,7 +404,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         return out
 
     # CPU fallback gets host-sized shapes: the full accelerator geometry
-    # (2^19 lanes × 4096 blocks) takes minutes per launch on a host core.
+    # (2^22 lanes × 32768 blocks) takes minutes per launch on a host core.
     cpu_args = worker_args(
         60, platform="cpu",
         lanes=min(args.lanes, 1 << 15),

@@ -148,7 +148,28 @@ def _windowed_tables(
     return v.astype(np.int32), [int(t) for t in v[:, 0, 0]]
 
 
-def decode_digits(rank, base, radix, field, win_v, m):
+def _exact_div(r, rs):
+    """Exact ``r // rs`` (floor) for ``|r| < 2**24`` via f32 division + a
+    ±1 fixup — the TPU VPU has no native s32 divide, so XLA lowers ``//``
+    to a long instruction sequence that dominated the whole fused step
+    (three decode fusions = 94% of device self-time at 2^19 lanes; PERF.md
+    §3 trace). f32 division is correctly rounded, so after flooring the
+    quotient is within ±1; both fixup products stay exact in int32."""
+    q = jnp.floor(
+        r.astype(jnp.float32) / rs.astype(jnp.float32)
+    ).astype(jnp.int32)
+    q = q - (q * rs > r).astype(jnp.int32)
+    q = q + ((q + 1) * rs <= r).astype(jnp.int32)
+    return q
+
+
+#: Largest per-lane rank for which the f32 decode path is exact (f32
+#: represents every integer below 2**24; quotients/products stay exact).
+_F32_DECODE_MAX_RANK = 1 << 24
+
+
+def decode_digits(rank, base, radix, field, win_v, m, *,
+                  max_rank: "int | None" = None):
     """Per-lane digit-vector decode shared by both expansion kernels.
 
     Full enumeration (``win_v is None``): digits = base + mixed-radix(rank),
@@ -158,6 +179,12 @@ def decode_digits(rank, base, radix, field, win_v, m):
     and "choose option d" covers ``v[s+1][j+1]`` each; column selection is
     an unrolled compare-sum (K+2 columns), never a per-lane gather.
     Returns ``digits int32[N, M]``.
+
+    ``max_rank`` (static): exclusive bound on in-block ranks. When it fits
+    f32's exact-integer range the full-enumeration divides run as f32 + ±1
+    fixup (:func:`_exact_div`) and the carry chain is compare/subtract —
+    the s32 ``//``/``%`` lowering those replace was 94% of the fused step's
+    device time (PERF.md §3).
     """
     if win_v is not None:
         k2 = int(win_v.shape[2])
@@ -188,12 +215,17 @@ def decode_digits(rank, base, radix, field, win_v, m):
     digits = []
     carry = jnp.zeros_like(rank)
     r = rank
+    fast = max_rank is not None and max_rank <= _F32_DECODE_MAX_RANK
     for s in range(m):
         rs = radix[:, s]
-        t = base[:, s] + (r % rs) + carry
-        digits.append(t % rs)
-        carry = t // rs
-        r = r // rs
+        q = _exact_div(r, rs) if fast else r // rs
+        # base and (r mod rs) are both proper digits (< rs) and carry is
+        # 0/1, so t < 2*rs: the carry chain reduces to compare/subtract.
+        t = base[:, s] + (r - q * rs) + carry
+        ge = (t >= rs).astype(jnp.int32)
+        digits.append(t - ge * rs)
+        carry = ge
+        r = q
     return jnp.stack(digits, axis=1)  # [N, M]
 
 
@@ -456,7 +488,11 @@ def expand_matches(
     tokens_w = field(tokens)  # [N, L]
     lengths_w = field(lengths)  # [N]
 
-    digits = decode_digits(rank, base, radix, field, win_v, m)
+    # In-block ranks are bounded by the stride when fixed (rank = lane mod
+    # stride), by the lane count otherwise (rank = lane - offset); the
+    # static bound turns the decode divides into f32 + fixup.
+    digits = decode_digits(rank, base, radix, field, win_v, m,
+                           max_rank=block_stride or n)
 
     chosen = digits > 0  # [N, M]
     chosen_count = jnp.sum(chosen, axis=1)

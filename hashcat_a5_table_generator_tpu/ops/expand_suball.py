@@ -280,7 +280,9 @@ def expand_suball(
     ostart_w = field(seg_orig_start)  # [N, G]
     tokens_w = field(tokens)  # [N, L]
 
-    digits = decode_digits(rank, base, radix, field, win_v, p)  # [N, P]
+    digits = decode_digits(
+        rank, base, radix, field, win_v, p, max_rank=block_stride or n
+    )  # [N, P]
 
     active = radix > 1
     chosen_count = jnp.sum((digits > 0) & active, axis=1)

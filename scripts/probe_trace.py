@@ -29,10 +29,10 @@ from hashcat_a5_table_generator_tpu.tables.compile import compile_table
 from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
 from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
-LANES = 1 << 19
-BLOCKS = 4096
-STRIDE = LANES // BLOCKS
 TRACE_DIR = sys.argv[1] if len(sys.argv) > 1 else "/tmp/a5_trace"
+LANES = int(sys.argv[2]) if len(sys.argv) > 2 else 1 << 19
+STRIDE = int(sys.argv[3]) if len(sys.argv) > 3 else 128
+BLOCKS = LANES // STRIDE
 
 
 def analyze(trace_dir):
