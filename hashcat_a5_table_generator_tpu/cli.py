@@ -115,10 +115,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "retry on stderr)")
     ap.add_argument("--progress", action="store_true",
                     help="periodic JSON progress lines on stderr")
-    ap.add_argument("--lanes", type=int, default=1 << 17,
-                    help="variant lanes per device per launch")
-    ap.add_argument("--blocks", type=int, default=1024,
-                    help="device block slots per launch")
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="variant lanes per device per launch (default: "
+                         "2^22 on accelerators — big launches amortize "
+                         "dispatch, PERF.md §4 — and 2^17 on CPU)")
+    ap.add_argument("--blocks", type=int, default=None,
+                    help="device block slots per launch (default: lanes/128 "
+                         "on accelerators — stride 128; 1024 on CPU)")
     ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
                     default="auto",
                     help="variant-block layout: 'packed' = tightly-packed "
@@ -410,6 +413,17 @@ def _run_device(args, sub_map, packed) -> int:
             sum(p.batch for p in packed.values()) if bucketed else packed.batch
         )
     progress = ProgressReporter(n_words) if args.progress else None
+    if args.lanes is None or args.blocks is None:
+        # Backend-sized launch geometry: accelerators want big launches
+        # (dispatch/fetch amortization, PERF.md §4) at stride 128; the CPU
+        # backend peaks far smaller (PERF.md §2).
+        import jax
+
+        on_cpu = jax.default_backend() == "cpu"
+        if args.lanes is None:
+            args.lanes = (1 << 17) if on_cpu else (1 << 22)
+        if args.blocks is None:
+            args.blocks = 1024 if on_cpu else max(1, args.lanes // 128)
     cfg = SweepConfig(
         lanes=args.lanes,
         num_blocks=args.blocks,

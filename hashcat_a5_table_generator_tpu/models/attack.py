@@ -11,10 +11,11 @@ the minor arrays (tables, plans, digest rows) ride along as device residents.
 
 Two step flavors:
 
-* :func:`make_crack_step` — expand, hash, match; returns per-lane hit/emit
-  masks plus counts. Hits are *rare*, so the host re-derives hit candidate
-  bytes from (block, rank) cursors via :func:`decode_variant` instead of
-  shipping the full candidate buffer back.
+* :func:`make_crack_step` — expand, hash, match; returns a packed per-lane
+  hit bitmask plus counts. Hits are *rare*, so the host re-derives hit
+  candidate bytes from (block, rank) cursors via :func:`decode_variant`
+  instead of shipping the full candidate buffer back — and per-lane
+  word/emit arrays never leave the device at all.
 * :func:`make_candidates_step` — expand only; returns the candidate buffer
   for the stdout sink (the reference-compatible mode; device->host copy is
   the price of emitting every candidate, exactly like the reference's
@@ -27,6 +28,7 @@ pytrees once per sweep.
 
 from __future__ import annotations
 
+import sys
 from dataclasses import dataclass
 from typing import Dict, List, Sequence, Tuple
 
@@ -172,13 +174,43 @@ def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width,
     )
 
 
+def pack_bits(mask: jnp.ndarray) -> jnp.ndarray:
+    """Pack a bool ``[N]`` lane mask into ``uint32[ceil(N/32)]`` (lane
+    ``i*32+j`` -> bit ``j`` of word ``i``). The crack step returns hits in
+    this form: a launch's per-lane outputs are its dominant device->host
+    payload (~12 MB of masks at 2^21 lanes), and over the remote-device
+    tunnel that transfer costs more than the launch's compute — 32x smaller
+    outputs keep the launch loop device-bound. Decode with
+    :func:`unpack_bits`."""
+    n = mask.shape[0]
+    nw = -(-n // 32)
+    padded = jnp.pad(mask.astype(jnp.uint32), (0, nw * 32 - n))
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, :]
+    return jnp.sum(padded.reshape(nw, 32) << shifts, axis=1,
+                   dtype=jnp.uint32)
+
+
+def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
+    """Host inverse of :func:`pack_bits`: ``uint32[ceil(N/32)] -> bool[N]``."""
+    raw = np.ascontiguousarray(np.asarray(bits))
+    if raw.dtype != np.uint32:
+        raise TypeError(f"expected uint32 bit words, got {raw.dtype}")
+    bytes_ = raw.view(np.uint8)
+    if sys.byteorder != "little":  # pragma: no cover - TPU hosts are LE
+        bytes_ = raw.byteswap().view(np.uint8)
+    return np.unpackbits(bytes_, bitorder="little")[:num_lanes].astype(bool)
+
+
 def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None):
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
-    ``body(plan, table, digests, blocks) -> dict`` with per-lane ``hit`` /
-    ``emit`` masks, per-lane ``word_row``, and *local* scalar counts.
+    ``body(plan, table, digests, blocks) -> dict`` with the packed per-lane
+    hit mask ``hit_bits`` (``uint32[ceil(lanes/32)]``, see
+    :func:`pack_bits`) and *local* scalar counts ``n_emitted``/``n_hits``.
+    Hit word/rank cursors are host-derived from lane indices
+    (:func:`lane_cursor`), so lanes are the only per-hit payload.
 
     ``block_stride``: static lanes-per-block for fixed-stride batches
     (``make_blocks(fixed_stride=...)``) — the TPU fast path; ``None`` keeps
@@ -196,13 +228,12 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
             spec, plan, table, blocks, num_lanes=num_lanes,
             out_width=out_width, block_stride=block_stride,
         )
+        del word_row  # hit cursors are host-derived from lane indices
         state = hash_fn(cand, cand_len)
         member = digest_member(state, digests["rows"], digests["bitmap"])
         hit = member & emit
         return {
-            "hit": hit,
-            "emit": emit,
-            "word_row": word_row,
+            "hit_bits": pack_bits(hit),
             "n_emitted": jnp.sum(emit.astype(jnp.int32)),
             "n_hits": jnp.sum(hit.astype(jnp.int32)),
         }
@@ -214,8 +245,8 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None):
     """Build the fused expand->hash->match step (single device).
 
-    Returns ``step(plan, table, blocks, digests) -> dict`` with per-lane
-    ``hit``/``emit`` masks, per-lane ``word_row``, and scalar counts.
+    Returns ``step(plan, table, blocks, digests) -> dict`` with the packed
+    hit bitmask ``hit_bits`` (:func:`pack_bits`) and scalar counts.
     """
     body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width,
                            block_stride=block_stride)

@@ -24,6 +24,105 @@ import numpy as np
 #: Per-block variant-count cap: in-block ranks must fit int32.
 MAX_BLOCK = 1 << 30
 
+#: Words whose variant total reaches this are cut by the scalar path only:
+#: the vectorized cutter works in int64 block/rank arithmetic, which a
+#: ~2^60-variant word would overflow. (No shipped table comes anywhere
+#: close; the cap exists for correctness, not tuning.)
+_HUGE_WORD = 1 << 60
+
+
+def _stride_index(plan, stride: int):
+    """Per-(plan, stride) cumulative block index for the vectorized cutter.
+
+    ``cum[w]`` = global index of word ``w``'s first block when every
+    non-fallback word is cut into ``ceil(total / stride)`` fixed-stride
+    blocks; fallback and huge words occupy zero / capped width (huge words
+    force the scalar path — ``huge`` marks them). Cached on the plan object
+    (plans are frozen; ``object.__setattr__`` is the sanctioned backdoor) so
+    the O(batch) pass runs once per sweep, not once per launch.
+    """
+    cache = getattr(plan, "_stride_index_cache", None)
+    if cache is None:
+        cache = {}
+        object.__setattr__(plan, "_stride_index_cache", cache)
+    entry = cache.get(stride)
+    if entry is None:
+        b = plan.batch
+        widths = np.zeros(b + 1, dtype=np.int64)
+        totals = np.zeros(b, dtype=np.int64)
+        huge = np.zeros(b, dtype=bool)
+        fallback = plan.fallback
+        for i, t in enumerate(plan.n_variants):
+            if fallback[i]:
+                continue
+            if t >= _HUGE_WORD:
+                huge[i] = True
+                totals[i] = _HUGE_WORD
+                widths[i + 1] = -(-_HUGE_WORD // stride)
+            else:
+                totals[i] = t
+                widths[i + 1] = -(-t // stride)
+        entry = (np.cumsum(widths), totals, huge)
+        cache[stride] = entry
+    return entry
+
+
+def _make_blocks_stride_fast(
+    plan, cum, totals, huge, start_word: int, start_rank: int,
+    nb_cap: int, stride: int,
+) -> "Tuple[BlockBatch, int, int] | None":
+    """Vectorized fixed-stride cutter: the whole launch is one searchsorted
+    over the cumulative block index plus a vectorized mixed-radix decompose
+    — replacing the per-block Python loop (~4.4 µs/block; at 16k+ blocks
+    per launch the scalar cutter cost more than the launch's device time).
+    Returns None when the window touches a huge word (scalar path handles
+    those exactly)."""
+    p = plan.num_slots
+    b0 = int(cum[start_word]) + start_rank // stride
+    b1 = min(b0 + nb_cap, int(cum[-1]))
+    nb = b1 - b0
+    if nb <= 0:
+        return (
+            BlockBatch(
+                word=np.zeros(0, np.int32),
+                base_digits=np.zeros((0, p), np.int32),
+                count=np.zeros(0, np.int32),
+                offset=np.zeros(0, np.int32),
+            ),
+            plan.batch,
+            0,
+        )
+    blocks = np.arange(b0, b1, dtype=np.int64)
+    w = (np.searchsorted(cum, blocks, side="right") - 1).astype(np.int64)
+    if huge[w].any():
+        return None
+    rank0 = (blocks - cum[w]) * stride  # int64 [nb]
+    count = np.minimum(stride, totals[w] - rank0).astype(np.int32)
+    if getattr(plan, "windowed", False):
+        bases = np.zeros((nb, p), dtype=np.int32)
+        bases[:, 0] = rank0.astype(np.int32)  # int32 by plan eligibility
+    else:
+        radices = plan.pat_radix[w].astype(np.int64)  # [nb, p]
+        bases = np.empty((nb, p), dtype=np.int64)
+        t = rank0.copy()
+        for s in range(p):
+            r = radices[:, s]
+            bases[:, s] = t % r
+            t //= r
+        bases = bases.astype(np.int32)
+    if b1 == int(cum[-1]):
+        w_next, rank_next = plan.batch, 0
+    else:
+        w_next = int(np.searchsorted(cum, b1, side="right") - 1)
+        rank_next = int(b1 - cum[w_next]) * stride
+    batch = BlockBatch(
+        word=w.astype(np.int32),
+        base_digits=bases,
+        count=count,
+        offset=np.arange(nb, dtype=np.int32) * np.int32(stride),
+    )
+    return batch, w_next, rank_next
+
 
 @dataclass(frozen=True)
 class BlockBatch:
@@ -76,12 +175,31 @@ def make_blocks(
     the launch's lane count, and ``max_block`` is ignored (``stride`` caps
     every block).
     """
-    words: List[int] = []
-    bases: List[List[int]] = []
-    counts: List[int] = []
     p = plan.num_slots
     budget = max_variants
     w, rank = start_word, start_rank
+    if fixed_stride is not None:
+        # Mirror the scalar loop's cursor normalization (it lazily advances
+        # past finished and fallback words), then try the vectorized cutter.
+        while w < plan.batch and (
+            plan.fallback[w] or rank >= plan.n_variants[w]
+        ):
+            w, rank = w + 1, 0
+        if rank % fixed_stride == 0:
+            # Misaligned ranks (cross-geometry checkpoint resume) keep the
+            # scalar path; they re-align at the next word boundary.
+            cum, totals, huge = _stride_index(plan, fixed_stride)
+            nb_cap = budget // fixed_stride
+            if max_blocks is not None:
+                nb_cap = min(nb_cap, max_blocks)
+            fast = _make_blocks_stride_fast(
+                plan, cum, totals, huge, w, rank, nb_cap, fixed_stride
+            )
+            if fast is not None:
+                return fast
+    words: List[int] = []
+    bases: List[List[int]] = []
+    counts: List[int] = []
     while w < plan.batch and budget > 0:
         if max_blocks is not None and len(words) >= max_blocks:
             break

@@ -13,8 +13,8 @@ TPU design instead shards **variant blocks** over a 1-D device mesh:
   read-only); block descriptors and lane outputs are **sharded** on the
   leading axis;
 * the only cross-device traffic is the hit/emit reduction — a `psum` over
-  ICI inside ``shard_map``; per-lane hit masks stay device-local and are
-  fetched lazily (hits are rare);
+  ICI inside ``shard_map``; hits travel as a packed per-lane bitmask
+  (``models.attack.pack_bits``) fetched lazily (hits are rare);
 * multi-host runs initialize ``jax.distributed`` and give each host its own
   wordlist shard (DCN never carries candidate traffic — SURVEY.md §5).
 
@@ -129,10 +129,18 @@ def make_sharded_crack_step(
     """The fused crack step, shard_map'd over a 1-D mesh.
 
     Input pytrees: ``plan``/``table``/``digests`` replicated, ``blocks``
-    sharded on the leading axis (from :func:`stack_blocks`). Returns per-lane
-    ``hit``/``emit``/``word_row`` sharded over the mesh plus globally-psum'd
-    scalar counts (replicated).
+    sharded on the leading axis (from :func:`stack_blocks`). Returns the
+    packed per-lane hit bitmask ``hit_bits`` sharded over the mesh (device
+    ``d``'s lanes occupy bit-words ``[d*lanes/32, (d+1)*lanes/32)``) plus
+    globally-psum'd scalar counts (replicated).
     """
+    if lanes_per_device % 32:
+        # Each device packs its own lanes into whole uint32 bit-words; a
+        # non-multiple would misalign the concatenated global bitmask.
+        raise ValueError(
+            f"lanes_per_device must be a multiple of 32 (packed hit "
+            f"bitmask words), got {lanes_per_device}"
+        )
     body = make_fused_body(
         spec, num_lanes=lanes_per_device, out_width=out_width,
         block_stride=block_stride,
@@ -153,9 +161,7 @@ def make_sharded_crack_step(
         mesh=mesh,
         in_specs=(rep, rep, rep, shard),
         out_specs={
-            "hit": shard,
-            "emit": shard,
-            "word_row": shard,
+            "hit_bits": shard,
             "n_emitted": rep,
             "n_hits": rep,
         },

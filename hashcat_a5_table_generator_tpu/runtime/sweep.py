@@ -51,6 +51,7 @@ from ..models.attack import (
     make_crack_step,
     plan_arrays,
     table_arrays,
+    unpack_bits,
 )
 from ..oracle.engines import iter_candidates
 from ..ops.blocks import BlockBatch, make_blocks
@@ -78,6 +79,17 @@ class SweepConfig:
     lanes: int = 1 << 17  # variant lanes per device per launch
     num_blocks: int = 1024  # static per-device block count (jit stability)
     max_in_flight: int = 2  # double-buffered launches
+    fetch_chunk: int = 16  # crack mode: max launches whose counts accumulate
+    #   ON DEVICE between host fetches. A device->host fetch costs a full
+    #   round trip (~65 ms over the remote-device tunnel — several times a
+    #   launch's device time; PERF.md §4), so the crack loop chains per-
+    #   launch (n_emitted, n_hits) into a device accumulator and fetches
+    #   once per chunk; per-launch hit masks are fetched only for chunks
+    #   whose hit count is nonzero (hits are rare). The chunk fetch is a
+    #   completion barrier over its whole chain, so in-flight device work
+    #   stays bounded at fetch_chunk + max_in_flight launches. Chunks grow
+    #   adaptively 1 -> fetch_chunk while drains stay under ~1 s, so small
+    #   sweeps and fast backends keep per-launch checkpoint granularity.
     devices: Optional[int] = 1  # 1 = single-device; N = shard over first N
     #                             local devices; None = all local devices
     packed_blocks: Optional[bool] = None  # True = variable-offset (tightly
@@ -512,58 +524,110 @@ class Sweep:
                     )
                 )
 
+        import jax
+        import jax.numpy as jnp
+
+        # Per-launch counts chain into a device-side accumulator; the host
+        # fetches it once per chunk (see SweepConfig.fetch_chunk). The fetch
+        # is the completion barrier for the chunk's whole launch chain.
+        accum = jax.jit(lambda acc, ne, nh: acc + jnp.stack([ne, nh]))
+        acc_zero = jnp.zeros((2,), jnp.int32)
+
+        def process_launch_hits(segments, out) -> None:
+            hit = unpack_bits(out["hit_bits"], cfg.lanes * n_devices)
+            # Segments are cursor-ordered (device d's lane slice precedes
+            # device d+1's), so walking them in order keeps hits
+            # word-ordered.
+            for batch, lo, hi in segments:
+                lanes = np.nonzero(hit[lo:hi])[0]
+                for w_row, rank in lane_cursor(plan, batch, lanes):
+                    # Flush oracle words that sit before this hit's word
+                    # so the hit list stays word-ordered.
+                    self._flush_fallback_until(
+                        w_row, state, fallback_candidate, prefetch
+                    )
+                    cand = decode_variant(plan, self.ct, spec, w_row, rank)
+                    dig = self._host_digest(cand)
+                    # Host re-verification: the device flagged this lane;
+                    # its digest must really be in the target set.
+                    if dig not in digest_set:
+                        raise RuntimeError(
+                            f"device hit failed host re-verification: "
+                            f"word {w_row} rank {rank} candidate {cand!r}"
+                        )
+                    state.n_hits += 1
+                    state.hits.append((w_row, rank))
+                    recorder.emit(
+                        HitRecord(
+                            word_index=int(self.packed.index[w_row]),
+                            variant_rank=rank,
+                            candidate=cand,
+                            digest_hex=dig.hex(),
+                        )
+                    )
+
         t0 = time.monotonic()
         last_ckpt = [t0]
         cursor = state.cursor
         prefetch = self._make_prefetcher(state)
+        chunk: List[tuple] = []
+        # The device accumulator is int32: cap the chunk so a worst case of
+        # every lane emitting cannot reach 2^31 counts per chunk.
+        chunk_cap = max(1, min(
+            int(cfg.fetch_chunk),
+            ((1 << 31) - 1) // max(1, cfg.lanes * n_devices),
+        ))
+        chunk_len = 1  # grows adaptively toward chunk_cap
+        acc = acc_zero
+        last_drain = [time.monotonic()]
+
+        def drain_chunk() -> None:
+            nonlocal chunk, acc, chunk_len
+            if not chunk:
+                return
+            ne_delta, nh_delta = (int(x) for x in np.asarray(acc))
+            if nh_delta:
+                # Rare path: find the hit-bearing launches (scalar probe
+                # each) and fetch only their masks.
+                for segments_i, out_i, _cur in chunk:
+                    if int(out_i["n_hits"]):
+                        process_launch_hits(segments_i, out_i)
+            end_cursor = chunk[-1][2]
+            # Fallback words wholly before the cursor are due now.
+            self._flush_fallback_until(
+                end_cursor.word, state, fallback_candidate, prefetch
+            )
+            state.n_emitted += ne_delta
+            state.cursor = end_cursor
+            chunk = []
+            acc = acc_zero
+            self._maybe_checkpoint(state, last_ckpt)
+            if cfg.progress:
+                cfg.progress.update(
+                    words_done=end_cursor.word,
+                    emitted=state.n_emitted,
+                    hits=state.n_hits,
+                )
+            # Adapt: grow while full chunk cycles run fast (amortize the
+            # fetch round trip), shrink when they crawl (keep checkpoint
+            # and progress granularity).
+            cycle = time.monotonic() - last_drain[0]
+            if cycle < 1.0:
+                chunk_len = min(chunk_len * 2, chunk_cap)
+            elif cycle > 4.0:
+                chunk_len = max(1, chunk_len // 2)
+            last_drain[0] = time.monotonic()
+
         try:
-            for segments, out, cursor in self._launches(
+            for item in self._launches(
                 cursor, launch, n_devices=n_devices, mesh=mesh
             ):
-                hit = np.asarray(out["hit"])
-                # Segments are cursor-ordered (device d's lane slice precedes
-                # device d+1's), so walking them in order keeps hits
-                # word-ordered.
-                for batch, lo, hi in segments:
-                    lanes = np.nonzero(hit[lo:hi])[0]
-                    for w_row, rank in lane_cursor(plan, batch, lanes):
-                        # Flush oracle words that sit before this hit's word
-                        # so the hit list stays word-ordered.
-                        self._flush_fallback_until(
-                            w_row, state, fallback_candidate, prefetch
-                        )
-                        cand = decode_variant(plan, self.ct, spec, w_row, rank)
-                        dig = self._host_digest(cand)
-                        # Host re-verification: the device flagged this lane;
-                        # its digest must really be in the target set.
-                        if dig not in digest_set:
-                            raise RuntimeError(
-                                f"device hit failed host re-verification: "
-                                f"word {w_row} rank {rank} candidate {cand!r}"
-                            )
-                        state.n_hits += 1
-                        state.hits.append((w_row, rank))
-                        recorder.emit(
-                            HitRecord(
-                                word_index=int(self.packed.index[w_row]),
-                                variant_rank=rank,
-                                candidate=cand,
-                                digest_hex=dig.hex(),
-                            )
-                        )
-                # Fallback words wholly before the cursor are due now.
-                self._flush_fallback_until(
-                    cursor.word, state, fallback_candidate, prefetch
-                )
-                state.n_emitted += int(out["n_emitted"])
-                state.cursor = cursor
-                self._maybe_checkpoint(state, last_ckpt)
-                if cfg.progress:
-                    cfg.progress.update(
-                        words_done=cursor.word,
-                        emitted=state.n_emitted,
-                        hits=state.n_hits,
-                    )
+                out = item[1]
+                acc = accum(acc, out["n_emitted"], out["n_hits"])
+                chunk.append(item)
+                if len(chunk) >= chunk_len:
+                    drain_chunk()
+            drain_chunk()
             # Tail: any fallback words at/after the last device word.
             self._flush_fallback_until(
                 self.n_words, state, fallback_candidate, prefetch

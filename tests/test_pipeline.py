@@ -18,6 +18,7 @@ from hashcat_a5_table_generator_tpu.models.attack import (
     make_crack_step,
     plan_arrays,
     table_arrays,
+    unpack_bits,
 )
 from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
 from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
@@ -70,7 +71,7 @@ def _run_crack(spec, sub_map, words, targets, lanes=2048):
             break
         out = step(p, t, block_arrays(batch), d)
         total_emitted += int(out["n_emitted"])
-        lanes_hit = np.nonzero(np.asarray(out["hit"]))[0]
+        lanes_hit = np.nonzero(unpack_bits(out["hit_bits"], lanes))[0]
         for word_row, vrank in lane_cursor(plan, batch, lanes_hit):
             hits.append(decode_variant(plan, ct, spec, word_row, vrank))
         assert int(out["n_hits"]) == len(lanes_hit)
@@ -193,7 +194,7 @@ class TestShardedStep:
             blocks = shard_leading(mesh, stack_blocks(batches))
             out = step(p, t, d, blocks)
             emitted += int(out["n_emitted"])
-            hit = np.asarray(out["hit"])
+            hit = unpack_bits(out["hit_bits"], 8 * lanes)
             for dev in range(8):
                 dev_lanes = np.nonzero(hit[dev * lanes : (dev + 1) * lanes])[0]
                 for word_row, vrank in lane_cursor(
