@@ -230,8 +230,15 @@ def run_worker(args: argparse.Namespace) -> None:
     # chain), while the round trip amortizes across the chunk.
     import jax.numpy as jnp
 
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import opts_for
+
+    fused_opts = opts_for(spec, plan, ct, block_stride=stride,
+                          num_blocks=args.blocks)
+    if fused_opts is not None:
+        print("# fused Pallas expand+MD5 kernel enabled", file=sys.stderr)
     body = make_fused_body(spec, num_lanes=args.lanes,
-                           out_width=plan.out_width, block_stride=stride)
+                           out_width=plan.out_width, block_stride=stride,
+                           fused_expand_opts=fused_opts)
     acc_step = jax.jit(
         lambda p_, t_, b_, d_, tot: tot + body(p_, t_, d_, b_)["n_emitted"]
     )

@@ -202,7 +202,8 @@ def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
 
 
 def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                    block_stride: int | None = None):
+                    block_stride: int | None = None,
+                    fused_expand_opts: int | None = None):
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
@@ -215,6 +216,11 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     ``block_stride``: static lanes-per-block for fixed-stride batches
     (``make_blocks(fixed_stride=...)``) — the TPU fast path; ``None`` keeps
     the variable-offset layout.
+
+    ``fused_expand_opts``: static per-key option count K enabling the fused
+    Pallas decode+splice+MD5 kernel (``ops.pallas_expand``) in place of the
+    XLA expand+hash pair. Callers gate via ``pallas_expand.opts_for`` —
+    eligibility is a plan/table property this builder cannot see.
     """
     from ..ops.pallas_md5 import maybe_pallas_hash_fn
 
@@ -223,13 +229,30 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     # trace-build time, so the flag picks the compiled program.
     hash_fn = maybe_pallas_hash_fn(spec.algo, HASH_FNS[spec.algo])
 
-    def body(plan, table, digests, blocks):
+    def expand_and_hash(plan, table, blocks):
+        if fused_expand_opts is not None:
+            from ..ops.pallas_expand import fused_expand_md5
+
+            return fused_expand_md5(
+                plan["tokens"], plan["lengths"], plan["match_pos"],
+                plan["match_len"], plan["match_radix"],
+                plan["match_val_start"],
+                table["val_bytes"], table["val_len"],
+                blocks["word"], blocks["base"], blocks["count"],
+                num_lanes=num_lanes, out_width=out_width,
+                min_substitute=spec.effective_min,
+                max_substitute=spec.max_substitute,
+                block_stride=block_stride, k_opts=fused_expand_opts,
+            )
         cand, cand_len, word_row, emit = _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
             out_width=out_width, block_stride=block_stride,
         )
         del word_row  # hit cursors are host-derived from lane indices
-        state = hash_fn(cand, cand_len)
+        return hash_fn(cand, cand_len), emit
+
+    def body(plan, table, digests, blocks):
+        state, emit = expand_and_hash(plan, table, blocks)
         member = digest_member(state, digests["rows"], digests["bitmap"])
         hit = member & emit
         return {
@@ -242,14 +265,16 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
 
 
 def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                    block_stride: int | None = None):
+                    block_stride: int | None = None,
+                    fused_expand_opts: int | None = None):
     """Build the fused expand->hash->match step (single device).
 
     Returns ``step(plan, table, blocks, digests) -> dict`` with the packed
     hit bitmask ``hit_bits`` (:func:`pack_bits`) and scalar counts.
     """
     body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width,
-                           block_stride=block_stride)
+                           block_stride=block_stride,
+                           fused_expand_opts=fused_expand_opts)
 
     def step(plan, table, blocks, digests):
         return body(plan, table, digests, blocks)

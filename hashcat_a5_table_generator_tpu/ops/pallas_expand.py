@@ -1,0 +1,419 @@
+"""Pallas TPU kernel fusing mixed-radix decode + splice + MD5 per block.
+
+Why (PERF.md §3/§4): with the f32 decode and chunked fetches landed, the
+fused XLA step still spends its device time on `[N, 1]`-shaped decode/splice
+fusions tiled ``T(1, 128)`` — one of eight VPU sublanes busy — plus ~5 ms of
+materialized block-field broadcasts per 2^22-lane launch. This kernel walks
+the same math on ``(G, S)`` tiles (G = 8 blocks per grid step, S = lanes
+per block), with every block field loaded once into VMEM per step and the
+MD5 message built directly in 16 uint32 words — candidate bytes never exist
+in HBM at all.
+
+Scope (``eligible``): match plans (default/reverse mode — ``main.go:168-261``
+semantics via ``ops.expand_matches``'s non-overlapping-match formulation),
+MD5, fixed-stride layout with stride a multiple of 128, non-windowed plans,
+single-MD5-block candidates (out_width <= 55), table values <= 4 bytes
+(packed into one u32 per option). Everything else keeps the XLA path; the
+wrapper never silently changes semantics — ineligible configurations must
+not call it (``models.attack.make_fused_body`` gates on ``eligible``).
+
+Parity contract: for every EMITTED lane the digest equals the XLA
+``expand_matches`` + ``ops.hashes.md5`` path bit-for-bit, and the emit mask
+itself is identical (interpret-mode suite: tests/test_pallas_expand.py).
+Non-emitted lanes may hold garbage state — overlap-clash lanes build a
+nonsense message by construction in both paths, and both mask them.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashes import _MD5_INIT, _MD5_K, _MD5_S
+
+_U32 = jnp.uint32
+_I32 = jnp.int32
+
+#: Blocks per grid step: (G, S) tiles fill all 8 VPU sublanes at S >= 128.
+_G = 8
+
+#: Soft caps keeping the fully-unrolled kernel's compile time bounded.
+_MAX_SLOTS = 24
+_MAX_TOKENS = 32
+_MAX_OPTIONS = 8
+
+
+def eligible(
+    *,
+    mode: str,
+    algo: str,
+    windowed: bool,
+    block_stride: "int | None",
+    num_blocks: int,
+    out_width: int,
+    num_slots: int,
+    token_width: int,
+    max_val_len: int,
+    max_options: int,
+) -> bool:
+    """Static eligibility for the fused expand+MD5 kernel (see module doc).
+
+    Callers own plan/table knowledge (``runtime.sweep``, ``bench.py``): all
+    arguments are host-static facts about the launch configuration.
+    """
+    return (
+        mode in ("default", "reverse")
+        and algo == "md5"
+        and not windowed
+        and block_stride is not None
+        and block_stride % 128 == 0
+        # In-kernel ranks run up to the stride; the f32 divide in
+        # _exact_div is only exact below 2^24 (expand_matches mirrors
+        # this bound as _F32_DECODE_MAX_RANK).
+        and block_stride <= (1 << 24)
+        and num_blocks % _G == 0
+        and num_blocks > 0
+        and 0 < out_width <= 55
+        and 1 <= num_slots <= _MAX_SLOTS
+        and 1 <= token_width <= _MAX_TOKENS
+        and 1 <= max_val_len <= 4
+        and 1 <= max_options <= _MAX_OPTIONS
+    )
+
+
+def k_opts_for(plan) -> int:
+    """Static per-key option count K for a match plan — the kernel's
+    K-way value select width. Single source shared by production gating
+    (:func:`opts_for`), the parity tests, and the A/B probe, so they can
+    never drift apart."""
+    return max(1, int(plan.match_radix.max()) - 1)
+
+
+def enabled_by_env() -> bool:
+    """``A5GEN_PALLAS=expand`` opts the fused expansion kernel in (kept
+    behind a flag until the on-chip A/B lands, like the hash-only kernel's
+    ``A5GEN_PALLAS=1``)."""
+    import os
+
+    return os.environ.get("A5GEN_PALLAS") == "expand"
+
+
+def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
+    """One-stop gate for callers that own the plan and table: returns the
+    static option count K to pass as ``make_fused_body(fused_expand_opts=)``
+    when the env flag is set and the configuration is eligible, else None.
+    ``spec``/``plan``/``ct`` are the attack spec, host plan (must be a match
+    plan — substitute-all plans have a different device layout), and
+    compiled table."""
+    if not enabled_by_env():
+        return None
+    # Device platform, not backend name: the remote tunnel fronts "tpu"
+    # devices behind a differently-named backend (see ops.pallas_md5).
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except Exception:  # pragma: no cover - backend-dependent
+        on_tpu = False
+    if not on_tpu:
+        import sys
+
+        print(
+            "a5gen: warning: A5GEN_PALLAS=expand but no TPU device; "
+            "using the XLA expand+hash path",
+            file=sys.stderr,
+        )
+        return None
+    if not hasattr(plan, "match_radix"):  # suball plans: not supported
+        return None
+    max_options = k_opts_for(plan)
+    ok = eligible(
+        mode=spec.mode,
+        algo=spec.algo,
+        windowed=bool(getattr(plan, "windowed", False)),
+        block_stride=block_stride,
+        num_blocks=int(num_blocks),
+        out_width=int(plan.out_width),
+        num_slots=int(plan.num_slots),
+        token_width=int(plan.tokens.shape[1]),
+        max_val_len=int(ct.max_val_len),
+        max_options=max_options,
+    )
+    return max_options if ok else None
+
+
+def _exact_div(r, rs):
+    """Floor ``r // rs`` via f32 divide + ±1 fixup (exact for |r| < 2^24;
+    in-kernel ranks are < the block stride). Mirrors
+    ``expand_matches._exact_div`` — the VPU has no native s32 divide."""
+    q = jnp.floor(
+        r.astype(jnp.float32) / rs.astype(jnp.float32)
+    ).astype(_I32)
+    q = q - (q * rs > r).astype(_I32)
+    q = q + ((q + 1) * rs <= r).astype(_I32)
+    return q
+
+
+def _make_kernel(
+    *, g: int, s: int, m: int, length_axis: int, k_opts: int,
+    out_width: int, min_substitute: int, max_substitute: int,
+):
+    """Build the per-step kernel body (fully unrolled straight-line trace).
+
+    Ref shapes per grid step (all VMEM):
+      tok[G, L] i32, wlen[G, 1] i32, pos[G, M] i32, mlen[G, M] i32,
+      radix[G, M] i32, base[G, M] i32, count[G, 1] i32,
+      vopt[G, M, K] u32 (value bytes little-endian-packed), vlen[G, M, K] i32
+    Outputs: state[G, 4, S] u32 (MD5 state words), emit[G, S] i32.
+    """
+    # One-MD5-block scope: every emitted candidate (out_len <= out_width)
+    # plus its 0x80 terminator must fit below the length words.
+    assert 0 < out_width <= 55, out_width
+    n_words = 14  # message words a <=55-byte candidate (plus 0x80) can touch
+
+    def kernel(tok, wlen, pos, mlen, radix, base, count, vopt, vlen,
+               state_ref, emit_ref):
+        rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
+        lane_ok = rank < count[:, 0][:, None]
+
+        # --- mixed-radix digit decode (base digits + in-block rank) ------
+        digits = []
+        r = rank
+        carry = jnp.zeros((g, s), _I32)
+        for sl in range(m):
+            rs = radix[:, sl][:, None]
+            q = _exact_div(r, rs)
+            t = base[:, sl][:, None] + (r - q * rs) + carry
+            ge = (t >= rs).astype(_I32)
+            digits.append(t - ge * rs)
+            carry = ge
+            r = q
+
+        chosen = [d > 0 for d in digits]
+        chosen_count = jnp.zeros((g, s), _I32)
+        for c in chosen:
+            chosen_count = chosen_count + c.astype(_I32)
+
+        # --- per-slot selected value word/length (K-way compare select) --
+        val_w = []
+        val_l = []
+        for sl in range(m):
+            vw = jnp.zeros((g, s), _U32)
+            vl = jnp.zeros((g, s), _I32)
+            for k in range(k_opts):
+                sel = digits[sl] == (k + 1)
+                vw = jnp.where(sel, vopt[:, sl, k][:, None], vw)
+                vl = jnp.where(sel, vlen[:, sl, k][:, None], vl)
+            val_w.append(vw)
+            val_l.append(vl)
+
+        # --- unit scheme over original byte positions (splice-compare) ---
+        clash = jnp.zeros((g, s), jnp.bool_)
+        cum = jnp.zeros((g, s), _I32)
+        unit_start = []
+        unit_len = []
+        unit_word = []  # u32 source: value word when started, else token byte
+        for j in range(length_axis):
+            cover = jnp.zeros((g, s), _I32)
+            started = jnp.zeros((g, s), _I32)
+            svw = jnp.zeros((g, s), _U32)
+            svl = jnp.zeros((g, s), _I32)
+            for sl in range(m):
+                p_s = pos[:, sl][:, None]
+                e_s = p_s + mlen[:, sl][:, None]
+                inside = chosen[sl] & (j >= p_s) & (j < e_s)
+                cover = cover + inside.astype(_I32)
+                at_start = chosen[sl] & (j == p_s)
+                started = started + at_start.astype(_I32)
+                svw = jnp.where(at_start, val_w[sl], svw)
+                svl = jnp.where(at_start, val_l[sl], svl)
+            clash = clash | (cover > 1)
+            in_word = j < wlen[:, 0][:, None]
+            is_start = started > 0
+            ul = jnp.where(
+                in_word,
+                jnp.where(is_start, svl,
+                          jnp.where(cover > 0, 0, 1)),
+                0,
+            )
+            tok_j = tok[:, j][:, None].astype(_U32)
+            unit_start.append(cum)
+            unit_len.append(ul)
+            unit_word.append(jnp.where(is_start, svw, tok_j))
+            cum = cum + ul
+        out_len = cum
+
+        # --- build the padded MD5 message directly in u32 words ----------
+        msg = [jnp.zeros((g, s), _U32) for _ in range(16)]
+        for j in range(length_axis):
+            us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
+            for k in range(4):
+                active = k < ul
+                o = us + k
+                byte = (uw >> _U32(8 * k)) & _U32(0xFF)
+                contrib = jnp.where(
+                    active, byte << (_U32(8) * (o & 3).astype(_U32)),
+                    _U32(0),
+                )
+                widx = o >> 2
+                # A unit at original position j starts at output offset
+                # <= 4*j (every prior position contributes <= 4 bytes), so
+                # its bytes land in words [0, j+1].
+                for w_i in range(min(n_words, j + 2)):
+                    msg[w_i] = msg[w_i] | jnp.where(
+                        widx == w_i, contrib, _U32(0)
+                    )
+        # 0x80 terminator at out_len (out_len <= 55 for emitted lanes;
+        # clash lanes may exceed — their words are garbage and masked).
+        mark = _U32(0x80) << (_U32(8) * (out_len & 3).astype(_U32))
+        widx = out_len >> 2
+        for w_i in range(n_words):
+            msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
+        msg[14] = (out_len * 8).astype(_U32)  # bit length, low word
+        # msg[15] stays 0: single-block messages only (eligibility).
+
+        # --- MD5 compression (same unrolled chain as ops.pallas_md5) -----
+        a = jnp.full((g, s), _U32(_MD5_INIT[0]))
+        b = jnp.full((g, s), _U32(_MD5_INIT[1]))
+        c = jnp.full((g, s), _U32(_MD5_INIT[2]))
+        d = jnp.full((g, s), _U32(_MD5_INIT[3]))
+        for i in range(64):
+            if i < 16:
+                f = (b & c) | (~b & d)
+                gidx = i
+            elif i < 32:
+                f = (d & b) | (~d & c)
+                gidx = (5 * i + 1) % 16
+            elif i < 48:
+                f = b ^ c ^ d
+                gidx = (3 * i + 5) % 16
+            else:
+                f = c ^ (b | ~d)
+                gidx = (7 * i) % 16
+            rot = a + f + _U32(_MD5_K[i]) + msg[gidx]
+            sh = _MD5_S[i]
+            rotated = (rot << _U32(sh)) | (rot >> _U32(32 - sh))
+            a, d, c, b = d, c, b, b + rotated
+        state_ref[:, 0, :] = a + _U32(_MD5_INIT[0])
+        state_ref[:, 1, :] = b + _U32(_MD5_INIT[1])
+        state_ref[:, 2, :] = c + _U32(_MD5_INIT[2])
+        state_ref[:, 3, :] = d + _U32(_MD5_INIT[3])
+
+        emit = (
+            lane_ok
+            & ~clash
+            & (chosen_count >= min_substitute)
+            & (chosen_count <= max_substitute)
+        )
+        emit_ref[:, :] = emit.astype(_I32)
+
+    return kernel
+
+
+def fused_expand_md5(
+    tokens: jnp.ndarray,  # uint8 [B, L] — plan token matrix
+    lengths: jnp.ndarray,  # int32 [B]
+    match_pos: jnp.ndarray,  # int32 [B, M]
+    match_len: jnp.ndarray,  # int32 [B, M]
+    match_radix: jnp.ndarray,  # int32 [B, M]
+    match_val_start: jnp.ndarray,  # int32 [B, M]
+    val_bytes: jnp.ndarray,  # uint8 [V, VW<=4]
+    val_len: jnp.ndarray,  # int32 [V]
+    blk_word: jnp.ndarray,  # int32 [NB]
+    blk_base: jnp.ndarray,  # int32 [NB, M]
+    blk_count: jnp.ndarray,  # int32 [NB]
+    *,
+    num_lanes: int,
+    out_width: int,
+    min_substitute: int,
+    max_substitute: int,
+    block_stride: int,
+    k_opts: int,
+    interpret: bool = False,
+):
+    """Fused decode+splice+MD5 for a fixed-stride launch.
+
+    Returns ``(state uint32[N, 4], emit bool[N])`` — the same contract as
+    ``expand_matches`` + ``ops.hashes.md5`` restricted to what the crack
+    step consumes. Callers must have checked :func:`eligible`.
+    """
+    from jax.experimental import pallas as pl
+
+    nb = blk_word.shape[0]
+    stride = block_stride
+    if nb * stride != num_lanes:
+        raise ValueError(
+            f"fused kernel needs num_lanes == blocks * stride, got "
+            f"{num_lanes} != {nb} * {stride}"
+        )
+    if nb % _G:
+        # grid = nb // _G would silently skip the trailing blocks, leaving
+        # their state/emit rows uninitialized output memory.
+        raise ValueError(
+            f"fused kernel needs the block count divisible by {_G} "
+            f"(blocks per grid step), got {nb}"
+        )
+    m = match_pos.shape[1]
+    length_axis = tokens.shape[1]
+    vw = val_bytes.shape[1]
+
+    # Block-level gathers (NB rows — the cheap granularity): per-block word
+    # fields and per-(block, slot, option) packed value words.
+    tok_b = tokens[blk_word].astype(_I32)  # [NB, L]
+    wlen_b = lengths[blk_word][:, None]  # [NB, 1]
+    pos_b = match_pos[blk_word]  # [NB, M]
+    mlen_b = match_len[blk_word]
+    radix_b = match_radix[blk_word]
+    mvs_b = match_val_start[blk_word]
+    count_b = blk_count[:, None]  # [NB, 1]
+
+    val_word = jnp.zeros((val_bytes.shape[0],), _U32)
+    for k in range(vw):
+        val_word = val_word | (
+            val_bytes[:, k].astype(_U32) << _U32(8 * k)
+        )
+    k_idx = jnp.arange(k_opts, dtype=_I32)[None, None, :]
+    opt_rows = jnp.clip(
+        mvs_b[:, :, None] + k_idx, 0, val_bytes.shape[0] - 1
+    )
+    vopt_b = val_word[opt_rows]  # [NB, M, K]
+    vlen_b = val_len[opt_rows]  # [NB, M, K]
+
+    kernel = _make_kernel(
+        g=_G, s=stride, m=m, length_axis=length_axis, k_opts=k_opts,
+        out_width=out_width, min_substitute=min_substitute,
+        max_substitute=max_substitute,
+    )
+    grid = (nb // _G,)
+
+    def row_spec(*trail):
+        return pl.BlockSpec((_G,) + trail, lambda i: (i,) + (0,) * len(trail))
+
+    state, emit = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            row_spec(length_axis),  # tok
+            row_spec(1),  # wlen
+            row_spec(m),  # pos
+            row_spec(m),  # mlen
+            row_spec(m),  # radix
+            row_spec(m),  # base
+            row_spec(1),  # count
+            row_spec(m, k_opts),  # vopt
+            row_spec(m, k_opts),  # vlen
+        ],
+        out_specs=[
+            row_spec(4, stride),  # state
+            row_spec(stride),  # emit
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, 4, stride), jnp.uint32),
+            jax.ShapeDtypeStruct((nb, stride), jnp.int32),
+        ],
+        interpret=interpret,
+    )(tok_b, wlen_b, pos_b, mlen_b, radix_b, blk_base, count_b,
+      vopt_b, vlen_b)
+
+    state = state.transpose(0, 2, 1).reshape(num_lanes, 4)
+    emit = emit.reshape(num_lanes) > 0
+    return state, emit

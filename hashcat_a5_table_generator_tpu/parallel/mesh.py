@@ -125,6 +125,7 @@ def make_sharded_crack_step(
     out_width: int,
     axis_name: str = "data",
     block_stride: int | None = None,
+    fused_expand_opts: int | None = None,
 ):
     """The fused crack step, shard_map'd over a 1-D mesh.
 
@@ -143,7 +144,7 @@ def make_sharded_crack_step(
         )
     body = make_fused_body(
         spec, num_lanes=lanes_per_device, out_width=out_width,
-        block_stride=block_stride,
+        block_stride=block_stride, fused_expand_opts=fused_expand_opts,
     )
 
     def local_step(plan, table, digests, blocks):

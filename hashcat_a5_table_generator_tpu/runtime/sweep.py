@@ -305,12 +305,20 @@ class Sweep:
         spec, cfg, plan = self.spec, self.config, self.plan
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()
+        from ..ops.pallas_expand import opts_for
+
+        # A5GEN_PALLAS=expand + an eligible config swaps the crack step's
+        # expand+hash pair for the fused Pallas kernel (ops.pallas_expand).
+        fused_opts = opts_for(
+            spec, plan, self.ct, block_stride=stride,
+            num_blocks=cfg.num_blocks,
+        )
         if n_devices == 1:
             p, t = plan_arrays(plan), table_arrays(self.ct)
             if kind == "crack":
                 step = make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
-                    block_stride=stride,
+                    block_stride=stride, fused_expand_opts=fused_opts,
                 )
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
@@ -334,6 +342,7 @@ class Sweep:
             step = make_sharded_crack_step(
                 spec, mesh, lanes_per_device=cfg.lanes,
                 out_width=plan.out_width, block_stride=stride,
+                fused_expand_opts=fused_opts,
             )
             p, t, darrs = replicate(
                 mesh,

@@ -1,0 +1,109 @@
+"""A/B the fused Pallas expand+MD5 kernel against the XLA expand+hash pair
+inside the production fused body on the live device (evidence for PERF.md;
+not part of the package). Planted candidate digests make cross-variant
+n_hits equality a live correctness check, exactly like probe_pallas.py."""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, digest_arrays, make_fused_body,
+    plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+    eligible, k_opts_for,
+)
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+LANES = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 22
+STRIDE = 128
+BLOCKS = LANES // STRIDE
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    spec = AttackSpec(mode="default", algo="md5")
+    sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
+    ct = compile_table(sub_map)
+    words = synth_wordlist(50000)
+    packed = pack_words(words)
+    plan = build_plan(spec, ct, packed)
+    k_opts = k_opts_for(plan)
+    assert eligible(
+        mode=spec.mode, algo=spec.algo, windowed=plan.windowed,
+        block_stride=STRIDE, num_blocks=BLOCKS, out_width=plan.out_width,
+        num_slots=plan.num_slots, token_width=plan.tokens.shape[1],
+        max_val_len=ct.max_val_len, max_options=k_opts,
+    ), "config not eligible for the fused kernel — A/B would self-compare"
+
+    host_digest = HOST_DIGEST[spec.algo]
+    planted = list(iter_candidates(words[0], sub_map, 0, 15))[:3]
+    targets = [host_digest(c) for c in planted]
+    targets += [host_digest(b"bench-decoy-%d" % i) for i in range(1021)]
+    ds = build_digest_set(targets, spec.algo)
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    batches = []
+    w = rank = 0
+    for _ in range(3):
+        batch, w, rank = make_blocks(plan, start_word=w, start_rank=rank,
+                                     max_variants=LANES, max_blocks=BLOCKS,
+                                     fixed_stride=STRIDE)
+        batches.append(block_arrays(batch, num_blocks=BLOCKS))
+
+    results = {}
+    for name, fused in (("xla", None), ("pallas_fused", k_opts)):
+        body = make_fused_body(spec, num_lanes=LANES,
+                               out_width=plan.out_width, block_stride=STRIDE,
+                               fused_expand_opts=fused)
+        acc = jax.jit(
+            lambda p_, t_, b_, d_, tot: tot + body(p_, t_, d_, b_)["n_emitted"]
+        )
+        step = jax.jit(lambda p_, t_, b_, d_: body(p_, t_, d_, b_)["n_hits"])
+        zero = jnp.zeros((), jnp.int32)
+        t0 = time.perf_counter()
+        nh = int(step(p, t, batches[0], d))
+        results[name] = nh
+        compile_s = time.perf_counter() - t0
+        int(acc(p, t, batches[0], d, zero))  # compile the acc variant too
+        n = 30
+        t0 = time.perf_counter()
+        tot = zero
+        for i in range(n):
+            tot = acc(p, t, batches[i % 3], d, tot)
+        hashed = int(tot)
+        el = time.perf_counter() - t0
+        print(json.dumps({
+            "variant": name, "compile_s": round(compile_s, 1),
+            "per_launch_s": round(el / n, 4),
+            "hashes_per_sec": round(hashed / el, 1),
+            "n_hits_first_launch": nh,
+        }))
+        sys.stdout.flush()
+
+    assert results["pallas_fused"] == results["xla"] >= 1, (
+        f"planted-hit mismatch: {results} — fused kernel diverges on-chip"
+    )
+    print("# planted hits consistent across variants", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,177 @@
+"""Interpret-mode parity for the fused Pallas expand+MD5 kernel
+(``ops.pallas_expand``): for every EMITTED lane the MD5 state must match
+the XLA ``expand_matches`` + ``ops.hashes.md5`` pair bit-for-bit, and the
+emit mask itself must be identical — the kernel replaces both stages in the
+production crack step, so any divergence is silent candidate loss."""
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec, build_plan
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
+from hashcat_a5_table_generator_tpu.ops.expand_matches import expand_matches
+from hashcat_a5_table_generator_tpu.ops.hashes import md5
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+    eligible,
+    fused_expand_md5,
+    k_opts_for,
+    opts_for,
+)
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+
+LEET = {
+    b"a": [b"4", b"@"],
+    b"e": [b"3"],
+    b"l": [b"1", b"|"],
+    b"o": [b"0"],
+    b"s": [b"5", b"$"],
+    b"ss": [b"\xc3\x9f"],
+}
+WORDS = [
+    b"glass", b"password", b"x", b"", b"hello", b"assassin",
+    b"lessons", b"aeolus", b"misses", b"sassafras",
+]
+
+STRIDE = 128
+
+
+def _arrays(spec, words=WORDS, sub=LEET):
+    ct = compile_table(sub)
+    packed = pack_words(words)
+    plan = build_plan(spec, ct, packed)
+    return ct, plan
+
+
+def _run_both(spec, plan, ct, *, num_blocks=16):
+    """Run one full-space sweep through both paths; returns per-launch
+    (emit_xla, emit_pal, state_xla, state_pal) stacked."""
+    import jax.numpy as jnp
+
+    lanes = num_blocks * STRIDE
+    k_opts = k_opts_for(plan)
+    w = rank = 0
+    outs = []
+    while True:
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank, max_variants=lanes,
+            max_blocks=num_blocks, fixed_stride=STRIDE,
+        )
+        if batch.total == 0:
+            break
+        batch = pad_batch(batch, num_blocks)
+        args = (
+            jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
+            jnp.asarray(plan.match_pos), jnp.asarray(plan.match_len),
+            jnp.asarray(plan.match_radix), jnp.asarray(plan.match_val_start),
+            jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
+        )
+        blocks = (
+            jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
+            jnp.asarray(batch.count), jnp.asarray(batch.offset),
+        )
+        cand, clen, _, emit_x = expand_matches(
+            *args, *blocks,
+            num_lanes=lanes, out_width=plan.out_width,
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute,
+            block_stride=STRIDE,
+        )
+        state_x = md5(cand, clen)
+        state_p, emit_p = fused_expand_md5(
+            *args, blocks[0], blocks[1], blocks[2],
+            num_lanes=lanes, out_width=plan.out_width,
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute,
+            block_stride=STRIDE, k_opts=k_opts, interpret=True,
+        )
+        outs.append((
+            np.asarray(emit_x), np.asarray(emit_p),
+            np.asarray(state_x), np.asarray(state_p),
+        ))
+    assert outs, "no launches cut"
+    return outs
+
+
+@pytest.mark.parametrize("mode", ["default", "reverse"])
+def test_state_and_emit_match_xla(mode):
+    spec = AttackSpec(mode=mode, algo="md5")
+    ct, plan = _arrays(spec)
+    for emit_x, emit_p, state_x, state_p in _run_both(spec, plan, ct):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        assert emit_x.any()  # the comparison must not be vacuous
+
+
+def test_count_window_respected():
+    # max_substitute > WINDOWED_MAX_SUBST keeps the plan on full
+    # enumeration (windowed plans are ineligible for the fused kernel by
+    # design), while min_substitute still prunes low-count lanes — the
+    # kernel's in-tile window mask must agree exactly.
+    spec = AttackSpec(mode="default", algo="md5", min_substitute=2,
+                      max_substitute=9)
+    ct, plan = _arrays(spec)
+    assert not plan.windowed
+    saw_emit = False
+    for emit_x, emit_p, state_x, state_p in _run_both(spec, plan, ct):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        saw_emit = saw_emit or emit_x.any()
+    assert saw_emit
+
+
+def test_multibyte_values_and_multichar_keys():
+    # german-style: multi-char key (ss) and 2-byte UTF-8 values.
+    sub = {b"a": [b"\xc3\xa4"], b"o": [b"\xc3\xb6"], b"u": [b"\xc3\xbc"],
+           b"ss": [b"\xc3\x9f"], b"s": [b"z", b"Z"]}
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table(sub)
+    packed = pack_words([b"strasse", b"gauss", b"umlaut", b"sos"])
+    plan = build_plan(spec, ct, packed)
+    for emit_x, emit_p, state_x, state_p in _run_both(spec, plan, ct):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        assert emit_x.any()
+
+
+def test_opts_for_gates(monkeypatch):
+    import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct, plan = _arrays(spec)
+    monkeypatch.delenv("A5GEN_PALLAS", raising=False)
+    assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) is None
+    monkeypatch.setenv("A5GEN_PALLAS", "expand")
+    # CPU CI: the platform gate must keep the kernel off...
+    assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) is None
+    # ...and with a (faked) TPU device the full gate opens.
+    class _Dev:
+        platform = "tpu"
+
+    monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
+    assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) == 2
+    # Ineligible shapes stay off.
+    assert opts_for(spec, plan, ct, block_stride=64, num_blocks=16) is None
+    assert opts_for(spec, plan, ct, block_stride=None, num_blocks=16) is None
+    suball = build_plan(
+        AttackSpec(mode="suball", algo="md5"), ct,
+        pack_words([b"glass"]),
+    )
+    assert (
+        opts_for(AttackSpec(mode="suball", algo="md5"), suball, ct,
+                 block_stride=128, num_blocks=16)
+        is None
+    )
+
+
+def test_eligible_bounds():
+    base = dict(mode="default", algo="md5", windowed=False, block_stride=128,
+                num_blocks=16, out_width=40, num_slots=8, token_width=16,
+                max_val_len=2, max_options=2)
+    assert eligible(**base)
+    for bad in (
+        dict(mode="suball"), dict(algo="sha1"), dict(windowed=True),
+        dict(block_stride=96), dict(num_blocks=12), dict(out_width=56),
+        dict(max_val_len=5), dict(max_options=9), dict(token_width=64),
+    ):
+        assert not eligible(**{**base, **bad}), bad
