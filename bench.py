@@ -96,7 +96,7 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
                     default="auto",
                     help="variant-block layout (same semantics as the CLI; "
-                         "auto = packed on CPU, stride on accelerators)")
+                         "auto = stride whenever blocks divides lanes evenly)")
     ap.add_argument("--mode", default="default", help="attack mode")
     ap.add_argument("--init-timeout", type=float, default=150.0,
                     help="seconds the worker waits for accelerator init")
@@ -179,10 +179,10 @@ def run_worker(args: argparse.Namespace) -> None:
     targets = [host_digest(b"bench-decoy-%d" % i) for i in range(1024)]
     ds = build_digest_set(targets, spec.algo)
 
-    # Block layout by backend, one rule owned by the sweep runtime (the
-    # bench must measure the same layout the real sweep executes):
-    # fixed-stride on accelerators (arithmetic lane->block map, no per-lane
-    # binary search), packed on CPU (perfect fill, cheap search) — PERF.md.
+    # Block layout: one rule owned by the sweep runtime (the bench must
+    # measure the same layout the real sweep executes): fixed-stride
+    # whenever the block count divides lanes evenly (arithmetic
+    # lane->block map; faster on every backend — PERF.md §4c), else packed.
     from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
 
     stride = SweepConfig(
@@ -414,8 +414,8 @@ def run_orchestrator(args: argparse.Namespace) -> None:
     # (2^22 lanes × 32768 blocks) takes minutes per launch on a host core.
     cpu_args = worker_args(
         60, platform="cpu",
-        lanes=min(args.lanes, 1 << 15),
-        blocks=min(args.blocks, 512),
+        lanes=min(args.lanes, 2048),
+        blocks=min(args.blocks, 32),
         words=min(args.words, 4000),
         seconds=min(args.seconds, 8.0),
         batches=min(args.batches, 4),

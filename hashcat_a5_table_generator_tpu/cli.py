@@ -126,12 +126,13 @@ def build_parser() -> argparse.ArgumentParser:
                     default="auto",
                     help="variant-block layout: 'packed' = tightly-packed "
                          "variable offsets (no lanes wasted on word tails; "
-                         "lane->block is a per-lane binary search the TPU "
-                         "serializes), 'stride' = fixed lanes-per-block "
-                         "(stride = lanes/blocks; arithmetic lane->block "
-                         "map — the accelerator fast path). Default 'auto' "
-                         "picks packed on CPU, stride elsewhere; the "
-                         "layouts are stream-identical (PERF.md §2)")
+                         "lane->block is a per-lane binary search), "
+                         "'stride' = fixed lanes-per-block (stride = "
+                         "lanes/blocks; arithmetic lane->block map). "
+                         "Default 'auto' picks stride whenever the "
+                         "block count divides lanes evenly — it measures "
+                         "faster on every backend (PERF.md §4c); the "
+                         "layouts are stream-identical")
     ap.add_argument("--devices", type=_devices_arg, default=1, metavar="N",
                     help="shard the sweep over N local devices via a 1-D "
                          "mesh ('auto' = all local devices; default 1)")

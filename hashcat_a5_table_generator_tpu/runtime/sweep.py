@@ -94,19 +94,18 @@ class SweepConfig:
     #                             local devices; None = all local devices
     packed_blocks: Optional[bool] = None  # True = variable-offset (tightly
     #   packed) block layout; False = fixed-stride blocks (stride = lanes //
-    #   num_blocks) whenever lanes divides evenly — the TPU fast path: the
-    #   kernels map lane -> block arithmetically instead of binary-searching
-    #   per lane (PERF.md). None = auto by backend: packed on CPU (perfect
-    #   lane fill, cheap per-lane search) and fixed-stride elsewhere. The
-    #   layouts are stream-identical; only throughput differs.
+    #   num_blocks) — the kernels map lane -> block arithmetically instead
+    #   of binary-searching per lane (PERF.md). None = auto: fixed-stride
+    #   whenever num_blocks divides lanes evenly (it wins on every backend
+    #   since the f32 decode + vectorized cutter landed — PERF.md §4c),
+    #   packed otherwise. The layouts are stream-identical; only throughput
+    #   differs.
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
 
     def resolve_block_stride(self) -> Optional[int]:
         """Lanes-per-block of the fixed-stride layout; None = packed.
-        Resolves the ``packed_blocks=None`` auto mode against the live
-        backend, so call only where JAX is already in play.
 
         An EXPLICIT stride request (``packed_blocks=False``) with a
         non-divisible geometry raises instead of silently degrading to
@@ -114,12 +113,7 @@ class SweepConfig:
         stream-identical, only throughput differs)."""
         packed = self.packed_blocks
         if packed is None:
-            import jax
-
-            packed = (
-                jax.default_backend() == "cpu"
-                or self.lanes % self.num_blocks != 0
-            )
+            packed = self.lanes % self.num_blocks != 0
         if packed:
             return None
         if self.lanes % self.num_blocks:

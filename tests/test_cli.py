@@ -207,8 +207,8 @@ class TestDeviceBackend:
         single = run_cli(*base, "--devices", "1")
         multi = run_cli(*base, "--devices", "8")
         auto = run_cli(*base, "--devices", "auto")
-        # Sharded + forced fixed-stride layout: the accelerator production
-        # combination (auto resolves to packed on the CPU test backend).
+        # Sharded + each explicit layout (auto resolves to stride for
+        # this divisible geometry).
         strided = run_cli(*base, "--devices", "8",
                           "--block-layout", "stride")
         assert multi.stdout == single.stdout
@@ -371,8 +371,8 @@ class TestDeviceBackend:
         assert b"1 hits" in r.stderr
 
     def test_block_layouts_stream_identical(self, workdir):
-        # Force BOTH layouts explicitly (auto resolves to packed on the CPU
-        # test backend, so flagless-vs-packed would compare packed to
+        # Force BOTH layouts explicitly (auto resolves to stride for this
+        # divisible geometry, so flagless-vs-stride would compare stride to
         # itself): stride and packed must produce byte-identical streams.
         base = (str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
                 "--backend", "device", "--lanes", "64", "--blocks", "16")

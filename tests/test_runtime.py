@@ -383,10 +383,10 @@ class TestMultiDeviceSweep:
     shard_map loop): SweepConfig(devices=N) must produce exactly the
     single-device results on the 8-virtual-CPU-device mesh."""
 
-    # layout=False forces the fixed-stride (accelerator) layout on the CPU
-    # test backend — auto would resolve to packed here, and the sharded
-    # production path must keep stride coverage.
-    @pytest.mark.parametrize("layout", [None, False], ids=["auto", "stride"])
+    # Auto resolves to stride for these divisible geometries (the
+    # backend-independent rule, PERF.md §4c); layout=True keeps the packed
+    # layout covered under sharding.
+    @pytest.mark.parametrize("layout", [None, True], ids=["auto", "packed"])
     @pytest.mark.parametrize("mode", ["default", "suball"])
     def test_candidates_equal_single_device(self, mode, layout):
         spec = AttackSpec(mode=mode, algo="md5")
@@ -407,7 +407,7 @@ class TestMultiDeviceSweep:
         assert out8 == out1
         assert n8 == n1 == len(oracle_lines(spec, LEET, WORDS))
 
-    @pytest.mark.parametrize("layout", [None, False], ids=["auto", "stride"])
+    @pytest.mark.parametrize("layout", [None, True], ids=["auto", "packed"])
     def test_crack_hits_equal_single_device(self, layout):
         spec = AttackSpec(mode="default", algo="md5")
         oracle = oracle_lines(spec, LEET, WORDS)
