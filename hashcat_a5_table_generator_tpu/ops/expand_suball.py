@@ -57,7 +57,10 @@ from .blocks import (  # noqa: F401  — re-exported: this module defined them f
 )
 from .expand_matches import (
     decode_digits,
+    key_deltas,
     lane_fields,
+    rounded_out_width,
+    variant_totals,
     windowed_plan_fields,
 )
 from .packing import PackedWords
@@ -101,6 +104,130 @@ class SubAllPlan:
         return int(self.seg_orig_start.shape[1])
 
 
+def _build_suball_plan_fast(
+    ct: CompiledTable,
+    packed: PackedWords,
+    *,
+    first_option_only: bool,
+    out_width: "int | None",
+    min_substitute: "int | None",
+    max_substitute: "int | None",
+) -> "SubAllPlan | None":
+    """Vectorized plan construction for the dominant table shape: all keys
+    single-byte, no empty key, cascade-free (qwerty-cyrillic, czech,
+    qwerty-greek, ...). Single-byte patterns cannot overlap and the
+    cascade-free predicate rules out every fallback, so the whole scan is
+    a byte-LUT lookup and segments are maximal unmatched runs interleaved
+    with one-byte spans — all expressible as cumsum/scatter over the token
+    matrix. The per-word Python loop this replaces took ~30 s for a
+    300k-word dictionary (longer than the entire device sweep). Returns
+    None for table shapes it does not cover — the scalar path below is the
+    semantic reference (``tests/test_expand_suball.py`` pins equality).
+    """
+    if not (
+        ct.all_keys_single_byte
+        and not ct.has_empty_key
+        and ct.cascade_free
+        and ct.num_keys > 0
+    ):
+        return None
+    tokens, lengths = packed.tokens, packed.lengths
+    b, width = tokens.shape
+    if b == 0 or width == 0:
+        return None  # degenerate shapes: keep the scalar reference path
+    j = np.arange(width)
+    in_word = j[None, :] < lengths[:, None]
+    ki_mat = np.where(in_word, ct.byte_to_key[tokens], -1)  # [B, L]
+    matched = ki_mat >= 0
+
+    k = ct.num_keys
+    present = np.zeros((b, k), dtype=bool)
+    mrows, mcols = np.nonzero(matched)
+    mki = ki_mat[mrows, mcols]
+    present[mrows, mki] = True
+    counts_p = present.sum(axis=1)
+    num_p = max(1, int(counts_p.max()))
+    # Slot of key ki in word i = its rank among the word's present keys
+    # (ascending ki — the scalar loop walks ct.keys in sorted order).
+    krank = np.cumsum(present, axis=1) - 1  # [B, K]
+
+    vc = ct.val_count.astype(np.int64)
+    options = np.minimum(1, vc) if first_option_only else vc
+    key_radix = (options + 1).astype(np.int32)
+
+    pat_radix = np.ones((b, num_p), dtype=np.int32)
+    pat_val_start = np.zeros((b, num_p), dtype=np.int32)
+    pw, pk = np.nonzero(present)
+    slot_of = krank[pw, pk]
+    pat_radix[pw, slot_of] = key_radix[pk]
+    pat_val_start[pw, slot_of] = ct.val_start[pk]
+
+    # Segments: every matched byte is a 1-byte span segment; unmatched
+    # runs collapse to one gap segment each. A position starts a segment
+    # iff it is matched, follows a matched byte, or opens the word.
+    prev_matched = np.zeros_like(matched)
+    prev_matched[:, 1:] = matched[:, :-1]
+    seg_start_mask = in_word & (matched | prev_matched | (j[None, :] == 0))
+    max_spans = int(matched.sum(axis=1).max())
+    num_g = 2 * max(1, max_spans) + 1  # scalar formula: gaps interleave
+    seg_rank = np.cumsum(seg_start_mask, axis=1) - 1
+    srows, scols = np.nonzero(seg_start_mask)
+    gidx = seg_rank[srows, scols]
+    if len(gidx) and int(gidx.max()) >= num_g:
+        num_g = int(gidx.max()) + 1  # safety: never truncate segments
+    # Segment end = next segment's start in the same row, else word end.
+    nxt = np.empty_like(scols)
+    if len(scols):
+        nxt[:-1] = scols[1:]
+        nxt[-1] = 0
+    same_row = np.zeros(len(srows), dtype=bool)
+    if len(srows):
+        same_row[:-1] = srows[1:] == srows[:-1]
+    seg_end = np.where(same_row, nxt, lengths[srows])
+    seg_orig_start = np.zeros((b, num_g), dtype=np.int32)
+    seg_orig_len = np.zeros((b, num_g), dtype=np.int32)
+    seg_pat = np.full((b, num_g), -1, dtype=np.int32)
+    seg_orig_start[srows, gidx] = scols
+    seg_orig_len[srows, gidx] = (seg_end - scols).astype(np.int32)
+    s_ki = ki_mat[srows, scols]
+    seg_pat[srows, gidx] = np.where(
+        matched[srows, scols], krank[srows, np.clip(s_ki, 0, k - 1)], -1
+    ).astype(np.int32)
+
+    # Output growth: per OCCURRENCE, the widest option beyond the key's
+    # single byte (the scalar span loop considers every option even in
+    # reverse mode — the width bound only needs to be safe, not tight).
+    delta_per_key = key_deltas(ct, limit_first_option=False)
+    word_delta = np.zeros(b, dtype=np.int64)
+    np.add.at(word_delta, mrows, delta_per_key[mki])
+    max_delta = int(word_delta.max()) if b else 0
+    if out_width is None:
+        out_width = rounded_out_width(width, max_delta)
+
+    n_variants = variant_totals(pat_radix)
+
+    fallback_mask = np.zeros((b,), dtype=bool)
+    windowed, win_v, n_variants = windowed_plan_fields(
+        pat_radix, n_variants, min_substitute, max_substitute,
+        zero_mask=fallback_mask,
+    )
+    return SubAllPlan(
+        tokens=packed.tokens,
+        lengths=packed.lengths,
+        index=packed.index,
+        pat_radix=pat_radix,
+        pat_val_start=pat_val_start,
+        seg_orig_start=seg_orig_start,
+        seg_orig_len=seg_orig_len,
+        seg_pat=seg_pat,
+        n_variants=tuple(n_variants),
+        fallback=fallback_mask,
+        out_width=out_width,
+        windowed=windowed,
+        win_v=win_v,
+    )
+
+
 def build_suball_plan(
     ct: CompiledTable,
     packed: PackedWords,
@@ -118,6 +245,13 @@ def build_suball_plan(
     only ``subs[0]`` applied (Q2, ``main.go:393-398``), which is exactly this
     plan with every radix clamped to 2. Its per-word multiset equals the
     oracle's subset lattice (each subset emitted once, size windowed)."""
+    fast = _build_suball_plan_fast(
+        ct, packed, first_option_only=first_option_only,
+        out_width=out_width, min_substitute=min_substitute,
+        max_substitute=max_substitute,
+    )
+    if fast is not None:
+        return fast
     b, width = packed.tokens.shape
     hazard = ct.cascade_hazard
 

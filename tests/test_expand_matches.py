@@ -528,3 +528,67 @@ def test_find_matches_scan_order():
         (0, 1),
         (1, 1),
     ]
+
+
+class TestBatchMatchScan:
+    """The vectorized batch scan inside build_match_plan must reproduce the
+    per-word find_matches construction exactly — every plan field, variant
+    total (including bigint rows), and the derived out_width."""
+
+    TABLES = [
+        {b"s": [b"1"], b"ss": [b"2"]},  # overlapping multi-char key
+        {b"a": [b"\xc3\xa4"], b"ss": [b"\xc3\x9f"], b"u": []},  # 0-option key
+        {bytes([c]): [b"x", b"yy", b"z"] for c in b"abcdefgh"},  # 3 options
+    ]
+    WORDS = [b"", b"s", b"ss", b"sss", b"glass", b"strasse", b"aaaa",
+             b"abcdefgh" * 4, b"zzz", b"au", b"x" * 30]
+
+    @pytest.mark.parametrize("first_option_only", [False, True])
+    @pytest.mark.parametrize("table_idx", range(len(TABLES)))
+    def test_matches_scalar_reference(self, table_idx, first_option_only):
+        ct = compile_table(self.TABLES[table_idx])
+        packed = pack_words(self.WORDS)
+        plan = build_match_plan(
+            ct, packed, first_option_only=first_option_only
+        )
+        # Scalar reference reconstruction (the pre-vectorization loop).
+        b = packed.batch
+        per_word = [find_matches(packed.word(i), ct) for i in range(b)]
+        m = max(1, max((len(x) for x in per_word), default=0))
+        assert plan.num_slots == m
+        for i, matches in enumerate(per_word):
+            total = 1
+            for s, (pos, klen, ki) in enumerate(matches):
+                vc = int(ct.val_count[ki])
+                radix = 2 if first_option_only else vc + 1
+                if vc == 0:
+                    radix = 1
+                assert plan.match_pos[i, s] == pos
+                assert plan.match_len[i, s] == klen
+                assert plan.match_radix[i, s] == radix
+                assert plan.match_val_start[i, s] == ct.val_start[ki]
+                total *= radix
+            for s in range(len(matches), m):
+                assert plan.match_radix[i, s] == 1
+                assert plan.match_len[i, s] == 0
+            assert plan.n_variants[i] == total
+
+    def test_bigint_variant_totals(self):
+        # 40 positions x radix 4 = 4^40 > 2^63: the exact-recompute path.
+        ct = compile_table({b"a": [b"x", b"y", b"z"]})
+        packed = pack_words([b"a" * 40, b"aa"])
+        plan = build_match_plan(ct, packed)
+        assert plan.n_variants[0] == 4 ** 40
+        assert plan.n_variants[1] == 16
+
+    def test_key_longer_than_packed_width(self):
+        # A key longer than the widest dictionary word can never match;
+        # the batch scan must return the empty-match plan, not crash
+        # (regression: negative shifted-compare slices).
+        ct = compile_table({b"abcdefgh": [b"X"], b"a": [b"4"]})
+        packed = pack_words([b"ab", b"a"])
+        plan = build_match_plan(ct, packed)
+        ref = [find_matches(packed.word(i), ct) for i in range(2)]
+        assert [len(r) for r in ref] == [1, 1]  # only the 1-byte key
+        assert plan.n_variants == (2, 2)
+        assert (plan.match_len[:, 0] == 1).all()

@@ -182,3 +182,59 @@ def test_hex_table_roundtrip_parity():
     sub_map = parse_substitution_table(data)
     fb = assert_parity(sub_map, [b"abba"])
     assert not fb
+
+
+class TestFastPlanPath:
+    """The vectorized single-byte plan builder must produce a plan
+    field-identical to the scalar reference path (every array, variant
+    totals, out_width, windowed state) — it replaces it silently for the
+    dominant table shape, so any divergence is invisible stream corruption."""
+
+    TABLES = [
+        {b"a": [b"1", b"2"], b"b": [b"x"], b"c": []},  # multi-option + empty
+        {bytes([c]): [bytes([c - 32])] for c in b"abcdefghij"},  # toggle-ish
+        {b"s": [b"\xc3\x9f", b"$"], b"e": [b"3"]},  # 2-byte values
+    ]
+    WORDS = [b"", b"a", b"abc", b"aabbcc", b"zzz", b"cabbage",
+             b"mississippi", b"abcabcabc", b"q" * 20, b"sesames"]
+
+    @pytest.mark.parametrize("first_option_only", [False, True])
+    @pytest.mark.parametrize("window", [(None, None), (1, 2)])
+    @pytest.mark.parametrize("ti", range(len(TABLES)))
+    def test_fast_equals_scalar(self, ti, first_option_only, window,
+                                monkeypatch):
+        import hashcat_a5_table_generator_tpu.ops.expand_suball as es
+
+        ct = compile_table(self.TABLES[ti])
+        assert ct.all_keys_single_byte and ct.cascade_free
+        packed = pack_words(self.WORDS)
+        mn, mx = window
+        kw = dict(first_option_only=first_option_only,
+                  min_substitute=mn, max_substitute=mx)
+        fast = build_suball_plan(ct, packed, **kw)
+        with monkeypatch.context() as m:
+            m.setattr(es, "_build_suball_plan_fast", lambda *a, **k: None)
+            slow = build_suball_plan(ct, packed, **kw)
+        assert fast.n_variants == slow.n_variants
+        assert fast.out_width == slow.out_width
+        assert fast.windowed == slow.windowed
+        for f in ("pat_radix", "pat_val_start", "seg_orig_start",
+                  "seg_orig_len", "seg_pat", "fallback"):
+            np.testing.assert_array_equal(
+                getattr(fast, f), getattr(slow, f), err_msg=f
+            )
+        if fast.windowed:
+            np.testing.assert_array_equal(fast.win_v, slow.win_v)
+
+    def test_scalar_path_keeps_multibyte_and_hazard_tables(self):
+        # german-style multi-char key: fast path must decline.
+        ct = compile_table({b"ss": [b"\xc3\x9f"], b"a": [b"4"]})
+        assert not ct.all_keys_single_byte
+        from hashcat_a5_table_generator_tpu.ops.expand_suball import (
+            _build_suball_plan_fast,
+        )
+
+        assert _build_suball_plan_fast(
+            ct, pack_words([b"strasse"]), first_option_only=False,
+            out_width=None, min_substitute=None, max_substitute=None,
+        ) is None
