@@ -231,18 +231,33 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
 
     def expand_and_hash(plan, table, blocks):
         if fused_expand_opts is not None:
-            from ..ops.pallas_expand import fused_expand_md5
+            from ..ops.pallas_expand import (
+                fused_expand_md5,
+                fused_expand_suball_md5,
+            )
 
-            return fused_expand_md5(
-                plan["tokens"], plan["lengths"], plan["match_pos"],
-                plan["match_len"], plan["match_radix"],
-                plan["match_val_start"],
-                table["val_bytes"], table["val_len"],
-                blocks["word"], blocks["base"], blocks["count"],
+            common = dict(
                 num_lanes=num_lanes, out_width=out_width,
                 min_substitute=spec.effective_min,
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
+            )
+            if spec.mode in ("default", "reverse"):
+                return fused_expand_md5(
+                    plan["tokens"], plan["lengths"], plan["match_pos"],
+                    plan["match_len"], plan["match_radix"],
+                    plan["match_val_start"],
+                    table["val_bytes"], table["val_len"],
+                    blocks["word"], blocks["base"], blocks["count"],
+                    **common,
+                )
+            return fused_expand_suball_md5(
+                plan["tokens"], plan["lengths"], plan["pat_radix"],
+                plan["pat_val_start"], plan["seg_orig_start"],
+                plan["seg_orig_len"], plan["seg_pat"],
+                table["val_bytes"], table["val_len"],
+                blocks["word"], blocks["base"], blocks["count"],
+                **common,
             )
         cand, cand_len, word_row, emit = _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,

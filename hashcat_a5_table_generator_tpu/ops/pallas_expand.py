@@ -9,19 +9,22 @@ per block), with every block field loaded once into VMEM per step and the
 MD5 message built directly in 16 uint32 words — candidate bytes never exist
 in HBM at all.
 
-Scope (``eligible``): match plans (default/reverse mode — ``main.go:168-261``
-semantics via ``ops.expand_matches``'s non-overlapping-match formulation),
-MD5, fixed-stride layout with stride a multiple of 128, non-windowed plans,
-single-MD5-block candidates (out_width <= 55), table values <= 4 bytes
-(packed into one u32 per option). Everything else keeps the XLA path; the
-wrapper never silently changes semantics — ineligible configurations must
-not call it (``models.attack.make_fused_body`` gates on ``eligible``).
+Scope (``eligible``): all four generation modes — match plans
+(default/reverse, ``main.go:168-261`` semantics via ``ops.expand_matches``'s
+non-overlapping-match formulation) and substitute-all plans (``-s``/
+``-s -r``, ``main.go:308-440`` via ``ops.expand_suball``'s segment
+formulation) — MD5, fixed-stride layout with stride a multiple of 128,
+non-windowed plans, single-MD5-block candidates (out_width <= 55), table
+values <= 4 bytes (packed into one u32 per option). Everything else keeps
+the XLA path; the wrapper never silently changes semantics — ineligible
+configurations must not call it (``models.attack.make_fused_body`` gates
+on ``eligible``).
 
 Parity contract: for every EMITTED lane the digest equals the XLA
-``expand_matches`` + ``ops.hashes.md5`` path bit-for-bit, and the emit mask
-itself is identical (interpret-mode suite: tests/test_pallas_expand.py).
-Non-emitted lanes may hold garbage state — overlap-clash lanes build a
-nonsense message by construction in both paths, and both mask them.
+expand + ``ops.hashes.md5`` path bit-for-bit, and the emit mask itself is
+identical (interpret-mode suite: tests/test_pallas_expand.py). Non-emitted
+lanes may hold garbage state — overlap-clash lanes build a nonsense
+message by construction in both paths, and both mask them.
 """
 
 from __future__ import annotations
@@ -42,6 +45,7 @@ _G = 8
 _MAX_SLOTS = 24
 _MAX_TOKENS = 32
 _MAX_OPTIONS = 8
+_MAX_SEGMENTS = 64  # suball kernel only (match kernels pass 0)
 
 
 def eligible(
@@ -56,6 +60,7 @@ def eligible(
     token_width: int,
     max_val_len: int,
     max_options: int,
+    num_segments: int = 0,
 ) -> bool:
     """Static eligibility for the fused expand+MD5 kernel (see module doc).
 
@@ -63,7 +68,7 @@ def eligible(
     arguments are host-static facts about the launch configuration.
     """
     return (
-        mode in ("default", "reverse")
+        mode in ("default", "reverse", "suball", "suball-reverse")
         and algo == "md5"
         and not windowed
         and block_stride is not None
@@ -79,15 +84,17 @@ def eligible(
         and 1 <= token_width <= _MAX_TOKENS
         and 1 <= max_val_len <= 4
         and 1 <= max_options <= _MAX_OPTIONS
+        and num_segments <= _MAX_SEGMENTS
     )
 
 
 def k_opts_for(plan) -> int:
-    """Static per-key option count K for a match plan — the kernel's
-    K-way value select width. Single source shared by production gating
-    (:func:`opts_for`), the parity tests, and the A/B probe, so they can
-    never drift apart."""
-    return max(1, int(plan.match_radix.max()) - 1)
+    """Static per-key option count K — the kernel's K-way value select
+    width. Works for match AND substitute-all plans (both expose the
+    ``pat_radix`` slot-radix matrix). Single source shared by production
+    gating (:func:`opts_for`), the parity tests, and the A/B probe, so
+    they can never drift apart."""
+    return max(1, int(plan.pat_radix.max()) - 1)
 
 
 def enabled_by_env() -> bool:
@@ -103,9 +110,8 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
     """One-stop gate for callers that own the plan and table: returns the
     static option count K to pass as ``make_fused_body(fused_expand_opts=)``
     when the env flag is set and the configuration is eligible, else None.
-    ``spec``/``plan``/``ct`` are the attack spec, host plan (must be a match
-    plan — substitute-all plans have a different device layout), and
-    compiled table."""
+    ``spec``/``plan``/``ct`` are the attack spec, host plan (match or
+    substitute-all — the body routes by mode), and compiled table."""
     if not enabled_by_env():
         return None
     # Device platform, not backend name: the remote tunnel fronts "tpu"
@@ -123,8 +129,6 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
             file=sys.stderr,
         )
         return None
-    if not hasattr(plan, "match_radix"):  # suball plans: not supported
-        return None
     max_options = k_opts_for(plan)
     ok = eligible(
         mode=spec.mode,
@@ -137,6 +141,7 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
         token_width=int(plan.tokens.shape[1]),
         max_val_len=int(ct.max_val_len),
         max_options=max_options,
+        num_segments=int(getattr(plan, "num_segments", 0)),
     )
     return max_options if ok else None
 
@@ -151,6 +156,92 @@ def _exact_div(r, rs):
     q = q - (q * rs > r).astype(_I32)
     q = q + ((q + 1) * rs <= r).astype(_I32)
     return q
+
+
+def _decode_tile(rank, base, radix, m, g, s):
+    """Mixed-radix digit decode on a (G, S) tile: base digits + in-block
+    rank with carries (f32 divides — ranks are < the block stride).
+    Returns the per-slot digit list."""
+    digits = []
+    r = rank
+    carry = jnp.zeros((g, s), _I32)
+    for sl in range(m):
+        rs = radix[:, sl][:, None]
+        q = _exact_div(r, rs)
+        t = base[:, sl][:, None] + (r - q * rs) + carry
+        ge = (t >= rs).astype(_I32)
+        digits.append(t - ge * rs)
+        carry = ge
+        r = q
+    return digits
+
+
+#: Message words a <=55-byte candidate (plus its 0x80 terminator) can touch.
+_N_MSG_WORDS = 14
+
+
+def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s):
+    """Assemble the padded single-block MD5 message (16 u32 words on (G, S)
+    tiles) from per-unit output spans: unit j contributes bytes
+    ``unit_word[j]`` (little-endian) at offsets ``unit_start[j] ..
+    +unit_len[j]``; 0x80 terminator at ``out_len``; bit length in word 14.
+    A unit at index j starts at output offset <= 4*j (every prior unit
+    contributes <= 4 bytes), bounding its word span."""
+    msg = [jnp.zeros((g, s), _U32) for _ in range(16)]
+    for j in range(len(unit_start)):
+        us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
+        for k in range(4):
+            active = k < ul
+            o = us + k
+            byte = (uw >> _U32(8 * k)) & _U32(0xFF)
+            contrib = jnp.where(
+                active, byte << (_U32(8) * (o & 3).astype(_U32)),
+                _U32(0),
+            )
+            widx = o >> 2
+            for w_i in range(min(_N_MSG_WORDS, j + 2)):
+                msg[w_i] = msg[w_i] | jnp.where(
+                    widx == w_i, contrib, _U32(0)
+                )
+    mark = _U32(0x80) << (_U32(8) * (out_len & 3).astype(_U32))
+    widx = out_len >> 2
+    for w_i in range(_N_MSG_WORDS):
+        msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
+    msg[14] = (out_len * 8).astype(_U32)  # bit length, low word
+    # msg[15] stays 0: single-block messages only (eligibility).
+    return msg
+
+
+def _md5_rounds(msg, g, s):
+    """The unrolled 64-round MD5 compression on (G, S) u32 tiles (same
+    chain as ops.pallas_md5). Returns the four output state words."""
+    a = jnp.full((g, s), _U32(_MD5_INIT[0]))
+    b = jnp.full((g, s), _U32(_MD5_INIT[1]))
+    c = jnp.full((g, s), _U32(_MD5_INIT[2]))
+    d = jnp.full((g, s), _U32(_MD5_INIT[3]))
+    for i in range(64):
+        if i < 16:
+            f = (b & c) | (~b & d)
+            gidx = i
+        elif i < 32:
+            f = (d & b) | (~d & c)
+            gidx = (5 * i + 1) % 16
+        elif i < 48:
+            f = b ^ c ^ d
+            gidx = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            gidx = (7 * i) % 16
+        rot = a + f + _U32(_MD5_K[i]) + msg[gidx]
+        sh = _MD5_S[i]
+        rotated = (rot << _U32(sh)) | (rot >> _U32(32 - sh))
+        a, d, c, b = d, c, b, b + rotated
+    return (
+        a + _U32(_MD5_INIT[0]),
+        b + _U32(_MD5_INIT[1]),
+        c + _U32(_MD5_INIT[2]),
+        d + _U32(_MD5_INIT[3]),
+    )
 
 
 def _make_kernel(
@@ -168,26 +259,13 @@ def _make_kernel(
     # One-MD5-block scope: every emitted candidate (out_len <= out_width)
     # plus its 0x80 terminator must fit below the length words.
     assert 0 < out_width <= 55, out_width
-    n_words = 14  # message words a <=55-byte candidate (plus 0x80) can touch
 
     def kernel(tok, wlen, pos, mlen, radix, base, count, vopt, vlen,
                state_ref, emit_ref):
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
 
-        # --- mixed-radix digit decode (base digits + in-block rank) ------
-        digits = []
-        r = rank
-        carry = jnp.zeros((g, s), _I32)
-        for sl in range(m):
-            rs = radix[:, sl][:, None]
-            q = _exact_div(r, rs)
-            t = base[:, sl][:, None] + (r - q * rs) + carry
-            ge = (t >= rs).astype(_I32)
-            digits.append(t - ge * rs)
-            carry = ge
-            r = q
-
+        digits = _decode_tile(rank, base, radix, m, g, s)
         chosen = [d > 0 for d in digits]
         chosen_count = jnp.zeros((g, s), _I32)
         for c in chosen:
@@ -242,61 +320,16 @@ def _make_kernel(
             cum = cum + ul
         out_len = cum
 
-        # --- build the padded MD5 message directly in u32 words ----------
-        msg = [jnp.zeros((g, s), _U32) for _ in range(16)]
-        for j in range(length_axis):
-            us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
-            for k in range(4):
-                active = k < ul
-                o = us + k
-                byte = (uw >> _U32(8 * k)) & _U32(0xFF)
-                contrib = jnp.where(
-                    active, byte << (_U32(8) * (o & 3).astype(_U32)),
-                    _U32(0),
-                )
-                widx = o >> 2
-                # A unit at original position j starts at output offset
-                # <= 4*j (every prior position contributes <= 4 bytes), so
-                # its bytes land in words [0, j+1].
-                for w_i in range(min(n_words, j + 2)):
-                    msg[w_i] = msg[w_i] | jnp.where(
-                        widx == w_i, contrib, _U32(0)
-                    )
-        # 0x80 terminator at out_len (out_len <= 55 for emitted lanes;
-        # clash lanes may exceed — their words are garbage and masked).
-        mark = _U32(0x80) << (_U32(8) * (out_len & 3).astype(_U32))
-        widx = out_len >> 2
-        for w_i in range(n_words):
-            msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
-        msg[14] = (out_len * 8).astype(_U32)  # bit length, low word
-        # msg[15] stays 0: single-block messages only (eligibility).
-
-        # --- MD5 compression (same unrolled chain as ops.pallas_md5) -----
-        a = jnp.full((g, s), _U32(_MD5_INIT[0]))
-        b = jnp.full((g, s), _U32(_MD5_INIT[1]))
-        c = jnp.full((g, s), _U32(_MD5_INIT[2]))
-        d = jnp.full((g, s), _U32(_MD5_INIT[3]))
-        for i in range(64):
-            if i < 16:
-                f = (b & c) | (~b & d)
-                gidx = i
-            elif i < 32:
-                f = (d & b) | (~d & c)
-                gidx = (5 * i + 1) % 16
-            elif i < 48:
-                f = b ^ c ^ d
-                gidx = (3 * i + 5) % 16
-            else:
-                f = c ^ (b | ~d)
-                gidx = (7 * i) % 16
-            rot = a + f + _U32(_MD5_K[i]) + msg[gidx]
-            sh = _MD5_S[i]
-            rotated = (rot << _U32(sh)) | (rot >> _U32(32 - sh))
-            a, d, c, b = d, c, b, b + rotated
-        state_ref[:, 0, :] = a + _U32(_MD5_INIT[0])
-        state_ref[:, 1, :] = b + _U32(_MD5_INIT[1])
-        state_ref[:, 2, :] = c + _U32(_MD5_INIT[2])
-        state_ref[:, 3, :] = d + _U32(_MD5_INIT[3])
+        # --- message build + compression (shared helpers) ---------------
+        # 0x80 terminator lands at out_len (<= 55 for emitted lanes; clash
+        # lanes may exceed — their words are garbage and masked).
+        msg = _message_from_units(unit_start, unit_len, unit_word,
+                                  out_len, g, s)
+        a, b, c, d = _md5_rounds(msg, g, s)
+        state_ref[:, 0, :] = a
+        state_ref[:, 1, :] = b
+        state_ref[:, 2, :] = c
+        state_ref[:, 3, :] = d
 
         emit = (
             lane_ok
@@ -307,6 +340,68 @@ def _make_kernel(
         emit_ref[:, :] = emit.astype(_I32)
 
     return kernel
+
+
+def _validate_geometry(blk_word, block_stride: int, num_lanes: int) -> int:
+    """Shared launch-shape checks for both fused wrappers; returns NB."""
+    nb = blk_word.shape[0]
+    if nb * block_stride != num_lanes:
+        raise ValueError(
+            f"fused kernel needs num_lanes == blocks * stride, got "
+            f"{num_lanes} != {nb} * {block_stride}"
+        )
+    if nb % _G:
+        # grid = nb // _G would silently skip the trailing blocks, leaving
+        # their state/emit rows uninitialized output memory.
+        raise ValueError(
+            f"fused kernel needs the block count divisible by {_G} "
+            f"(blocks per grid step), got {nb}"
+        )
+    return nb
+
+
+def _pack_val_options(val_bytes, val_len, vstart_b, k_opts: int):
+    """Per-(block, slot, option) value words/lengths: each <=4-byte table
+    value packs little-endian into one u32; option k of a slot lives at CSR
+    row ``vstart + k`` (clipped — digits never select past the radix)."""
+    vw = val_bytes.shape[1]
+    val_word = jnp.zeros((val_bytes.shape[0],), _U32)
+    for k in range(vw):
+        val_word = val_word | (
+            val_bytes[:, k].astype(_U32) << _U32(8 * k)
+        )
+    k_idx = jnp.arange(k_opts, dtype=_I32)[None, None, :]
+    opt_rows = jnp.clip(
+        vstart_b[:, :, None] + k_idx, 0, val_bytes.shape[0] - 1
+    )
+    return val_word[opt_rows], val_len[opt_rows]
+
+
+def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, interpret):
+    """Shared pallas_call epilogue for both fused wrappers: G-row block
+    specs derived from each input's trailing shape, (state, emit) outputs
+    reshaped to the flat lane contract."""
+    from jax.experimental import pallas as pl
+
+    def row_spec(trail):
+        return pl.BlockSpec(
+            (_G,) + tuple(trail), lambda i: (i,) + (0,) * len(trail)
+        )
+
+    state, emit = pl.pallas_call(
+        kernel,
+        grid=(nb // _G,),
+        in_specs=[row_spec(x.shape[1:]) for x in inputs],
+        out_specs=[row_spec((4, stride)), row_spec((stride,))],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, 4, stride), jnp.uint32),
+            jax.ShapeDtypeStruct((nb, stride), jnp.int32),
+        ],
+        interpret=interpret,
+    )(*inputs)
+    state = state.transpose(0, 2, 1).reshape(num_lanes, 4)
+    emit = emit.reshape(num_lanes) > 0
+    return state, emit
 
 
 def fused_expand_md5(
@@ -336,25 +431,9 @@ def fused_expand_md5(
     ``expand_matches`` + ``ops.hashes.md5`` restricted to what the crack
     step consumes. Callers must have checked :func:`eligible`.
     """
-    from jax.experimental import pallas as pl
-
-    nb = blk_word.shape[0]
-    stride = block_stride
-    if nb * stride != num_lanes:
-        raise ValueError(
-            f"fused kernel needs num_lanes == blocks * stride, got "
-            f"{num_lanes} != {nb} * {stride}"
-        )
-    if nb % _G:
-        # grid = nb // _G would silently skip the trailing blocks, leaving
-        # their state/emit rows uninitialized output memory.
-        raise ValueError(
-            f"fused kernel needs the block count divisible by {_G} "
-            f"(blocks per grid step), got {nb}"
-        )
+    nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
-    vw = val_bytes.shape[1]
 
     # Block-level gathers (NB rows — the cheap granularity): per-block word
     # fields and per-(block, slot, option) packed value words.
@@ -363,57 +442,185 @@ def fused_expand_md5(
     pos_b = match_pos[blk_word]  # [NB, M]
     mlen_b = match_len[blk_word]
     radix_b = match_radix[blk_word]
-    mvs_b = match_val_start[blk_word]
     count_b = blk_count[:, None]  # [NB, 1]
-
-    val_word = jnp.zeros((val_bytes.shape[0],), _U32)
-    for k in range(vw):
-        val_word = val_word | (
-            val_bytes[:, k].astype(_U32) << _U32(8 * k)
-        )
-    k_idx = jnp.arange(k_opts, dtype=_I32)[None, None, :]
-    opt_rows = jnp.clip(
-        mvs_b[:, :, None] + k_idx, 0, val_bytes.shape[0] - 1
+    vopt_b, vlen_b = _pack_val_options(
+        val_bytes, val_len, match_val_start[blk_word], k_opts
     )
-    vopt_b = val_word[opt_rows]  # [NB, M, K]
-    vlen_b = val_len[opt_rows]  # [NB, M, K]
 
     kernel = _make_kernel(
-        g=_G, s=stride, m=m, length_axis=length_axis, k_opts=k_opts,
+        g=_G, s=block_stride, m=m, length_axis=length_axis, k_opts=k_opts,
         out_width=out_width, min_substitute=min_substitute,
         max_substitute=max_substitute,
     )
-    grid = (nb // _G,)
-
-    def row_spec(*trail):
-        return pl.BlockSpec((_G,) + trail, lambda i: (i,) + (0,) * len(trail))
-
-    state, emit = pl.pallas_call(
+    return _launch_fused(
         kernel,
-        grid=grid,
-        in_specs=[
-            row_spec(length_axis),  # tok
-            row_spec(1),  # wlen
-            row_spec(m),  # pos
-            row_spec(m),  # mlen
-            row_spec(m),  # radix
-            row_spec(m),  # base
-            row_spec(1),  # count
-            row_spec(m, k_opts),  # vopt
-            row_spec(m, k_opts),  # vlen
-        ],
-        out_specs=[
-            row_spec(4, stride),  # state
-            row_spec(stride),  # emit
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((nb, 4, stride), jnp.uint32),
-            jax.ShapeDtypeStruct((nb, stride), jnp.int32),
-        ],
+        (tok_b, wlen_b, pos_b, mlen_b, radix_b, blk_base, count_b,
+         vopt_b, vlen_b),
+        nb=nb, stride=block_stride, num_lanes=num_lanes,
         interpret=interpret,
-    )(tok_b, wlen_b, pos_b, mlen_b, radix_b, blk_base, count_b,
-      vopt_b, vlen_b)
+    )
 
-    state = state.transpose(0, 2, 1).reshape(num_lanes, 4)
-    emit = emit.reshape(num_lanes) > 0
-    return state, emit
+
+def _make_suball_kernel(
+    *, g: int, s: int, p: int, num_segments: int, length_axis: int,
+    k_opts: int, out_width: int, min_substitute: int, max_substitute: int,
+):
+    """Per-step kernel body for substitute-all plans (``-s`` / ``-s -r``).
+
+    Segment geometry is per-BLOCK data ((G, 1) tiles — cheap), only the
+    chosen/skip digit of a segment's pattern slot is per-lane. Per original
+    byte position: the first byte of a CHOSEN pattern segment emits the
+    selected value's bytes, its other bytes emit nothing, and every other
+    in-word byte passes through — exactly ``ops.expand_suball``'s segment
+    cumsum, re-expressed per position so the shared unit/message helpers
+    apply. No overlap/clash concept exists here (plans pre-resolve spans;
+    hazard words never reach the device).
+
+    Ref shapes per grid step: tok[G, L] i32, wlen[G, 1] i32,
+    pradix[G, P] i32, base[G, P] i32, count[G, 1] i32, sstart[G, GS] i32,
+    slen[G, GS] i32, spat[G, GS] i32, vopt[G, P, K] u32, vlen[G, P, K] i32.
+    Outputs: state[G, 4, S] u32, emit[G, S] i32.
+    """
+    assert 0 < out_width <= 55, out_width
+
+    def kernel(tok, wlen, pradix, base, count, sstart, slen, spat,
+               vopt, vlen, state_ref, emit_ref):
+        rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
+        lane_ok = rank < count[:, 0][:, None]
+
+        digits = _decode_tile(rank, base, pradix, p, g, s)
+        chosen_count = jnp.zeros((g, s), _I32)
+        for sl in range(p):
+            active = pradix[:, sl][:, None] > 1
+            chosen_count = chosen_count + (
+                active & (digits[sl] > 0)
+            ).astype(_I32)
+
+        # Per-slot selected value word/length (K-way compare select).
+        val_w = []
+        val_l = []
+        for sl in range(p):
+            vw = jnp.zeros((g, s), _U32)
+            vl = jnp.zeros((g, s), _I32)
+            for k in range(k_opts):
+                sel = digits[sl] == (k + 1)
+                vw = jnp.where(sel, vopt[:, sl, k][:, None], vw)
+                vl = jnp.where(sel, vlen[:, sl, k][:, None], vl)
+            val_w.append(vw)
+            val_l.append(vl)
+
+        # Per-position segment resolution — block-level (G, 1) selects.
+        unit_start = []
+        unit_len = []
+        unit_word = []
+        cum = jnp.zeros((g, s), _I32)
+        for j in range(length_axis):
+            slot_at_j = jnp.full((g, 1), -1, _I32)
+            start_at_j = jnp.zeros((g, 1), _I32)
+            for gi in range(num_segments):
+                st = sstart[:, gi][:, None]
+                ln = slen[:, gi][:, None]
+                inside = (ln > 0) & (j >= st) & (j < st + ln)
+                slot_at_j = jnp.where(
+                    inside, spat[:, gi][:, None], slot_at_j
+                )
+                start_at_j = jnp.where(inside, st, start_at_j)
+            # Lane-level: the digit / value of the slot owning position j.
+            digit_at_j = jnp.zeros((g, s), _I32)
+            vw_at_j = jnp.zeros((g, s), _U32)
+            vl_at_j = jnp.zeros((g, s), _I32)
+            for sl in range(p):
+                here = slot_at_j == sl
+                digit_at_j = jnp.where(here, digits[sl], digit_at_j)
+                vw_at_j = jnp.where(here, val_w[sl], vw_at_j)
+                vl_at_j = jnp.where(here, val_l[sl], vl_at_j)
+            chosen_here = (slot_at_j >= 0) & (digit_at_j > 0)
+            is_start = chosen_here & (j == start_at_j)
+            in_word = j < wlen[:, 0][:, None]
+            ul = jnp.where(
+                in_word,
+                jnp.where(is_start, vl_at_j,
+                          jnp.where(chosen_here, 0, 1)),
+                0,
+            )
+            tok_j = tok[:, j][:, None].astype(_U32)
+            unit_start.append(cum)
+            unit_len.append(ul)
+            unit_word.append(jnp.where(is_start, vw_at_j, tok_j))
+            cum = cum + ul
+        out_len = cum
+
+        msg = _message_from_units(unit_start, unit_len, unit_word,
+                                  out_len, g, s)
+        a, b, c, d = _md5_rounds(msg, g, s)
+        state_ref[:, 0, :] = a
+        state_ref[:, 1, :] = b
+        state_ref[:, 2, :] = c
+        state_ref[:, 3, :] = d
+
+        emit = (
+            lane_ok
+            & (chosen_count >= min_substitute)
+            & (chosen_count <= max_substitute)
+        )
+        emit_ref[:, :] = emit.astype(_I32)
+
+    return kernel
+
+
+def fused_expand_suball_md5(
+    tokens: jnp.ndarray,  # uint8 [B, L] — plan token matrix
+    lengths: jnp.ndarray,  # int32 [B]
+    pat_radix: jnp.ndarray,  # int32 [B, P]
+    pat_val_start: jnp.ndarray,  # int32 [B, P]
+    seg_orig_start: jnp.ndarray,  # int32 [B, GS]
+    seg_orig_len: jnp.ndarray,  # int32 [B, GS]
+    seg_pat: jnp.ndarray,  # int32 [B, GS]
+    val_bytes: jnp.ndarray,  # uint8 [V, VW<=4]
+    val_len: jnp.ndarray,  # int32 [V]
+    blk_word: jnp.ndarray,  # int32 [NB]
+    blk_base: jnp.ndarray,  # int32 [NB, P]
+    blk_count: jnp.ndarray,  # int32 [NB]
+    *,
+    num_lanes: int,
+    out_width: int,
+    min_substitute: int,
+    max_substitute: int,
+    block_stride: int,
+    k_opts: int,
+    interpret: bool = False,
+):
+    """Fused decode+splice+MD5 for substitute-all fixed-stride launches.
+
+    Same contract as :func:`fused_expand_md5` (``(state uint32[N, 4],
+    emit bool[N])``); callers must have checked :func:`eligible` with the
+    plan's ``num_segments``.
+    """
+    nb = _validate_geometry(blk_word, block_stride, num_lanes)
+    p = pat_radix.shape[1]
+    gs = seg_pat.shape[1]
+    length_axis = tokens.shape[1]
+
+    tok_b = tokens[blk_word].astype(_I32)
+    wlen_b = lengths[blk_word][:, None]
+    pradix_b = pat_radix[blk_word]
+    sstart_b = seg_orig_start[blk_word]
+    slen_b = seg_orig_len[blk_word]
+    spat_b = seg_pat[blk_word]
+    count_b = blk_count[:, None]
+    vopt_b, vlen_b = _pack_val_options(
+        val_bytes, val_len, pat_val_start[blk_word], k_opts
+    )
+
+    kernel = _make_suball_kernel(
+        g=_G, s=block_stride, p=p, num_segments=gs,
+        length_axis=length_axis, k_opts=k_opts, out_width=out_width,
+        min_substitute=min_substitute, max_substitute=max_substitute,
+    )
+    return _launch_fused(
+        kernel,
+        (tok_b, wlen_b, pradix_b, blk_base, count_b, sstart_b, slen_b,
+         spat_b, vopt_b, vlen_b),
+        nb=nb, stride=block_stride, num_lanes=num_lanes,
+        interpret=interpret,
+    )

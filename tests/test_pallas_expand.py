@@ -43,9 +43,13 @@ def _arrays(spec, words=WORDS, sub=LEET):
     return ct, plan
 
 
-def _run_both(spec, plan, ct, *, num_blocks=16):
-    """Run one full-space sweep through both paths; returns per-launch
-    (emit_xla, emit_pal, state_xla, state_pal) stacked."""
+def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
+                num_blocks=16):
+    """Shared full-space sweep harness: run every launch through the XLA
+    expand+md5 pair AND the fused kernel; returns per-launch
+    (emit_xla, emit_pal, state_xla, state_pal). ``plan_fields`` names the
+    plan attributes forming the mode's arg tuple (candidate/table arrays
+    appended)."""
     import jax.numpy as jnp
 
     lanes = num_blocks * STRIDE
@@ -60,30 +64,24 @@ def _run_both(spec, plan, ct, *, num_blocks=16):
         if batch.total == 0:
             break
         batch = pad_batch(batch, num_blocks)
-        args = (
-            jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
-            jnp.asarray(plan.match_pos), jnp.asarray(plan.match_len),
-            jnp.asarray(plan.match_radix), jnp.asarray(plan.match_val_start),
-            jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
-        )
+        args = tuple(
+            jnp.asarray(getattr(plan, f)) for f in plan_fields
+        ) + (jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len))
         blocks = (
             jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
             jnp.asarray(batch.count), jnp.asarray(batch.offset),
         )
-        cand, clen, _, emit_x = expand_matches(
-            *args, *blocks,
+        common = dict(
             num_lanes=lanes, out_width=plan.out_width,
             min_substitute=spec.effective_min,
             max_substitute=spec.max_substitute,
             block_stride=STRIDE,
         )
+        cand, clen, _, emit_x = xla_fn(*args, *blocks, **common)
         state_x = md5(cand, clen)
-        state_p, emit_p = fused_expand_md5(
+        state_p, emit_p = fused_fn(
             *args, blocks[0], blocks[1], blocks[2],
-            num_lanes=lanes, out_width=plan.out_width,
-            min_substitute=spec.effective_min,
-            max_substitute=spec.max_substitute,
-            block_stride=STRIDE, k_opts=k_opts, interpret=True,
+            k_opts=k_opts, interpret=True, **common,
         )
         outs.append((
             np.asarray(emit_x), np.asarray(emit_p),
@@ -91,6 +89,15 @@ def _run_both(spec, plan, ct, *, num_blocks=16):
         ))
     assert outs, "no launches cut"
     return outs
+
+
+def _run_both(spec, plan, ct, *, num_blocks=16):
+    return _sweep_both(
+        spec, plan, ct,
+        ("tokens", "lengths", "match_pos", "match_len", "match_radix",
+         "match_val_start"),
+        expand_matches, fused_expand_md5, num_blocks=num_blocks,
+    )
 
 
 @pytest.mark.parametrize("mode", ["default", "reverse"])
@@ -153,15 +160,6 @@ def test_opts_for_gates(monkeypatch):
     # Ineligible shapes stay off.
     assert opts_for(spec, plan, ct, block_stride=64, num_blocks=16) is None
     assert opts_for(spec, plan, ct, block_stride=None, num_blocks=16) is None
-    suball = build_plan(
-        AttackSpec(mode="suball", algo="md5"), ct,
-        pack_words([b"glass"]),
-    )
-    assert (
-        opts_for(AttackSpec(mode="suball", algo="md5"), suball, ct,
-                 block_stride=128, num_blocks=16)
-        is None
-    )
 
 
 def test_eligible_bounds():
@@ -169,9 +167,83 @@ def test_eligible_bounds():
                 num_blocks=16, out_width=40, num_slots=8, token_width=16,
                 max_val_len=2, max_options=2)
     assert eligible(**base)
+    assert eligible(**{**base, "mode": "suball", "num_segments": 33})
     for bad in (
-        dict(mode="suball"), dict(algo="sha1"), dict(windowed=True),
+        dict(mode="plain"), dict(algo="sha1"), dict(windowed=True),
         dict(block_stride=96), dict(num_blocks=12), dict(out_width=56),
         dict(max_val_len=5), dict(max_options=9), dict(token_width=64),
+        dict(num_segments=65),
     ):
         assert not eligible(**{**base, **bad}), bad
+
+
+def _run_both_suball(spec, plan, ct, *, num_blocks=16):
+    from hashcat_a5_table_generator_tpu.ops.expand_suball import expand_suball
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        fused_expand_suball_md5,
+    )
+
+    return _sweep_both(
+        spec, plan, ct,
+        ("tokens", "lengths", "pat_radix", "pat_val_start",
+         "seg_orig_start", "seg_orig_len", "seg_pat"),
+        expand_suball, fused_expand_suball_md5, num_blocks=num_blocks,
+    )
+
+
+#: Suball tests need a table with no overlapping keys: LEET's s/ss pair
+#: claims overlapping spans, routing those words through the oracle, and
+#: fallback words never reach any device kernel.
+SUBALL_TABLE = {
+    b"a": [b"4", b"@"],
+    b"e": [b"3"],
+    b"l": [b"1", b"|"],
+    b"o": [b"0"],
+    b"s": [b"5", b"$"],
+}
+
+
+@pytest.mark.parametrize("mode", ["suball", "suball-reverse"])
+def test_suball_state_and_emit_match_xla(mode):
+    spec = AttackSpec(mode=mode, algo="md5")
+    ct, plan = _arrays(spec, sub=SUBALL_TABLE)
+    assert not plan.fallback.any()
+    saw = False
+    for emit_x, emit_p, state_x, state_p in _run_both_suball(spec, plan, ct):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        saw = saw or emit_x.any()
+    assert saw
+
+
+def test_suball_multichar_key_segments():
+    # Multi-char patterns produce multi-byte spans: non-start bytes of a
+    # chosen segment must contribute nothing, unchosen ones pass through.
+    sub = {b"ss": [b"\xc3\x9f"], b"a": [b"4", b"@"], b"e": [b"3"]}
+    spec = AttackSpec(mode="suball", algo="md5")
+    ct = compile_table(sub)
+    packed = pack_words([b"strasse", b"assess", b"sea"])
+    plan = build_plan(spec, ct, packed)
+    if plan.fallback.any():
+        pytest.skip("table routed words to the oracle; kernel never sees them")
+    saw = False
+    for emit_x, emit_p, state_x, state_p in _run_both_suball(spec, plan, ct):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        saw = saw or emit_x.any()
+    assert saw
+
+
+def test_opts_for_covers_suball(monkeypatch):
+    import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+
+    spec = AttackSpec(mode="suball", algo="md5")
+    ct = compile_table(LEET)
+    plan = build_plan(spec, ct, pack_words(WORDS))
+    monkeypatch.setenv("A5GEN_PALLAS", "expand")
+
+    class _Dev:
+        platform = "tpu"
+
+    monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
+    assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) == 2
