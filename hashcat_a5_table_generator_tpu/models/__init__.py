@@ -7,6 +7,8 @@ from .attack import (  # noqa: F401
     digest_arrays,
     make_candidates_step,
     make_crack_step,
+    pack_bits,
     plan_arrays,
     table_arrays,
+    unpack_bits,
 )

@@ -241,6 +241,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                 min_substitute=spec.effective_min,
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
+                algo=spec.algo,
             )
             if spec.mode in ("default", "reverse"):
                 return fused_expand_md5(

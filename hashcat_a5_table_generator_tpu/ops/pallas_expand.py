@@ -1,4 +1,4 @@
-"""Pallas TPU kernel fusing mixed-radix decode + splice + MD5 per block.
+"""Pallas TPU kernels fusing mixed-radix decode + splice + hash per block.
 
 Why (PERF.md §3/§4): with the f32 decode and chunked fetches landed, the
 fused XLA step still spends its device time on `[N, 1]`-shaped decode/splice
@@ -13,18 +13,19 @@ Scope (``eligible``): all four generation modes — match plans
 (default/reverse, ``main.go:168-261`` semantics via ``ops.expand_matches``'s
 non-overlapping-match formulation) and substitute-all plans (``-s``/
 ``-s -r``, ``main.go:308-440`` via ``ops.expand_suball``'s segment
-formulation) — MD5, fixed-stride layout with stride a multiple of 128,
-non-windowed plans, single-MD5-block candidates (out_width <= 55), table
-values <= 4 bytes (packed into one u32 per option). Everything else keeps
-the XLA path; the wrapper never silently changes semantics — ineligible
-configurations must not call it (``models.attack.make_fused_body`` gates
-on ``eligible``).
+formulation) — every shipped hash (MD5/MD4/SHA-1/NTLM, single hash block:
+out_width <= 55, or <= 27 for NTLM whose UTF-16LE expansion doubles bytes),
+fixed-stride layout with stride a multiple of 128, non-windowed plans,
+table values <= 4 bytes (packed into one u32 per option). Everything else
+keeps the XLA path; the wrapper never silently changes semantics —
+ineligible configurations must not call it
+(``models.attack.make_fused_body`` gates on ``eligible``).
 
 Parity contract: for every EMITTED lane the digest equals the XLA
-expand + ``ops.hashes.md5`` path bit-for-bit, and the emit mask itself is
-identical (interpret-mode suite: tests/test_pallas_expand.py). Non-emitted
-lanes may hold garbage state — overlap-clash lanes build a nonsense
-message by construction in both paths, and both mask them.
+expand + ``ops.hashes.HASH_FNS[algo]`` path bit-for-bit, and the emit mask
+itself is identical (interpret-mode suite: tests/test_pallas_expand.py).
+Non-emitted lanes may hold garbage state — overlap-clash lanes build a
+nonsense message by construction in both paths, and both mask them.
 """
 
 from __future__ import annotations
@@ -33,7 +34,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hashes import _MD5_INIT, _MD5_K, _MD5_S
+from .hashes import (
+    _MD4_G,
+    _MD4_H,
+    _MD4_INIT,
+    _MD5_INIT,
+    _MD5_K,
+    _MD5_S,
+    _SHA1_INIT,
+    _SHA1_K,
+    DIGEST_WORDS,
+)
 
 _U32 = jnp.uint32
 _I32 = jnp.int32
@@ -69,7 +80,7 @@ def eligible(
     """
     return (
         mode in ("default", "reverse", "suball", "suball-reverse")
-        and algo == "md5"
+        and algo in ("md5", "md4", "sha1", "ntlm")
         and not windowed
         and block_stride is not None
         and block_stride % 128 == 0
@@ -79,7 +90,9 @@ def eligible(
         and block_stride <= (1 << 24)
         and num_blocks % _G == 0
         and num_blocks > 0
-        and 0 < out_width <= 55
+        # Single hash block: <=55 candidate bytes incl. terminator; NTLM's
+        # UTF-16LE expansion doubles every byte.
+        and 0 < out_width <= (27 if algo == "ntlm" else 55)
         and 1 <= num_slots <= _MAX_SLOTS
         and 1 <= token_width <= _MAX_TOKENS
         and 1 <= max_val_len <= 4
@@ -180,35 +193,55 @@ def _decode_tile(rank, base, radix, m, g, s):
 _N_MSG_WORDS = 14
 
 
-def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s):
-    """Assemble the padded single-block MD5 message (16 u32 words on (G, S)
-    tiles) from per-unit output spans: unit j contributes bytes
-    ``unit_word[j]`` (little-endian) at offsets ``unit_start[j] ..
-    +unit_len[j]``; 0x80 terminator at ``out_len``; bit length in word 14.
-    A unit at index j starts at output offset <= 4*j (every prior unit
+def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
+                        *, big_endian_length=False, utf16=False):
+    """Assemble the padded single-block message (16 u32 words on (G, S)
+    tiles, little-endian byte order — SHA-1 byte-swaps in its schedule)
+    from per-unit output spans: unit j contributes bytes ``unit_word[j]``
+    at offsets ``unit_start[j] .. +unit_len[j]``; 0x80 terminator after
+    the data; bit length in word 14 (LE) or byte-swapped word 15 (BE).
+
+    ``utf16``: NTLM's hashcat-style expansion — every candidate byte
+    becomes the code unit ``byte | 0x0000``, i.e. byte offsets double and
+    odd bytes stay zero (matching ``ops.hashes.utf16le_expand``).
+
+    A unit at index j starts at candidate offset <= 4*j (every prior unit
     contributes <= 4 bytes), bounding its word span."""
+    scale = 2 if utf16 else 1
     msg = [jnp.zeros((g, s), _U32) for _ in range(16)]
     for j in range(len(unit_start)):
         us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
         for k in range(4):
             active = k < ul
-            o = us + k
+            o = (us + k) * scale
             byte = (uw >> _U32(8 * k)) & _U32(0xFF)
             contrib = jnp.where(
                 active, byte << (_U32(8) * (o & 3).astype(_U32)),
                 _U32(0),
             )
             widx = o >> 2
-            for w_i in range(min(_N_MSG_WORDS, j + 2)):
+            for w_i in range(min(_N_MSG_WORDS, scale * (j + 1) + 1)):
                 msg[w_i] = msg[w_i] | jnp.where(
                     widx == w_i, contrib, _U32(0)
                 )
-    mark = _U32(0x80) << (_U32(8) * (out_len & 3).astype(_U32))
-    widx = out_len >> 2
+    end = out_len * scale
+    mark = _U32(0x80) << (_U32(8) * (end & 3).astype(_U32))
+    widx = end >> 2
     for w_i in range(_N_MSG_WORDS):
         msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
-    msg[14] = (out_len * 8).astype(_U32)  # bit length, low word
-    # msg[15] stays 0: single-block messages only (eligibility).
+    bits = (end * 8).astype(_U32)
+    if big_endian_length:
+        # SHA-1: the 64-bit BE bit length occupies bytes 56..63; its low
+        # 32 bits are bytes 60..63 = LE word 15 byte-swapped. msg[14]
+        # (bytes 56..59, the BE high half) stays 0 for <2^29-bit messages.
+        msg[15] = (
+            ((bits & _U32(0xFF)) << 24)
+            | ((bits & _U32(0xFF00)) << 8)
+            | ((bits >> 8) & _U32(0xFF00))
+            | (bits >> 24)
+        )
+    else:
+        msg[14] = bits  # bit length, low word; msg[15] stays 0
     return msg
 
 
@@ -244,9 +277,99 @@ def _md5_rounds(msg, g, s):
     )
 
 
+def _rotl_tile(x, sh: int):
+    return (x << _U32(sh)) | (x >> _U32(32 - sh))
+
+
+def _md4_rounds(msg, g, s):
+    """Unrolled MD4 (RFC 1320 — the NTLM core) on (G, S) u32 tiles,
+    mirroring ``ops.hashes._md4_block``."""
+    a = jnp.full((g, s), _U32(_MD4_INIT[0]))
+    b = jnp.full((g, s), _U32(_MD4_INIT[1]))
+    c = jnp.full((g, s), _U32(_MD4_INIT[2]))
+    d = jnp.full((g, s), _U32(_MD4_INIT[3]))
+    for j, k in enumerate(range(16)):
+        a2 = _rotl_tile(a + ((b & c) | (~b & d)) + msg[k], (3, 7, 11, 19)[j % 4])
+        a, b, c, d = d, a2, b, c
+    for j, k in enumerate(_MD4_G):
+        a2 = _rotl_tile(
+            a + ((b & c) | (b & d) | (c & d)) + msg[k] + _U32(0x5A827999),
+            (3, 5, 9, 13)[j % 4],
+        )
+        a, b, c, d = d, a2, b, c
+    for j, k in enumerate(_MD4_H):
+        a2 = _rotl_tile(
+            a + (b ^ c ^ d) + msg[k] + _U32(0x6ED9EBA1), (3, 9, 11, 15)[j % 4]
+        )
+        a, b, c, d = d, a2, b, c
+    return (
+        a + _U32(_MD4_INIT[0]),
+        b + _U32(_MD4_INIT[1]),
+        c + _U32(_MD4_INIT[2]),
+        d + _U32(_MD4_INIT[3]),
+    )
+
+
+def _sha1_rounds(msg, g, s):
+    """Unrolled 80-round SHA-1 on (G, S) u32 tiles: byte-swaps the shared
+    little-endian message layout into the big-endian schedule, rolling
+    16-word window for the expansion (mirrors ``ops.hashes._sha1_block``)."""
+    def bswap(x):
+        return (
+            ((x & _U32(0xFF)) << 24)
+            | ((x & _U32(0xFF00)) << 8)
+            | ((x >> 8) & _U32(0xFF00))
+            | (x >> 24)
+        )
+
+    w = [bswap(m) for m in msg]
+    for t in range(16, 80):
+        w.append(_rotl_tile(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
+    a = jnp.full((g, s), _U32(_SHA1_INIT[0]))
+    b = jnp.full((g, s), _U32(_SHA1_INIT[1]))
+    c = jnp.full((g, s), _U32(_SHA1_INIT[2]))
+    d = jnp.full((g, s), _U32(_SHA1_INIT[3]))
+    e = jnp.full((g, s), _U32(_SHA1_INIT[4]))
+    for t in range(80):
+        if t < 20:
+            f = (b & c) | (~b & d)
+        elif t < 40:
+            f = b ^ c ^ d
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        tmp = _rotl_tile(a, 5) + f + e + _U32(_SHA1_K[t // 20]) + w[t]
+        e, d, c, b, a = d, c, _rotl_tile(b, 30), a, tmp
+    return (
+        a + _U32(_SHA1_INIT[0]),
+        b + _U32(_SHA1_INIT[1]),
+        c + _U32(_SHA1_INIT[2]),
+        d + _U32(_SHA1_INIT[3]),
+        e + _U32(_SHA1_INIT[4]),
+    )
+
+
+def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s):
+    """Message assembly + compression for one algo; returns the state-word
+    tuple (4 for MD5/MD4/NTLM, 5 for SHA-1)."""
+    if algo == "ntlm":
+        msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
+                                  g, s, utf16=True)
+        return _md4_rounds(msg, g, s)
+    msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
+                              g, s, big_endian_length=algo == "sha1")
+    if algo == "md5":
+        return _md5_rounds(msg, g, s)
+    if algo == "md4":
+        return _md4_rounds(msg, g, s)
+    return _sha1_rounds(msg, g, s)
+
+
 def _make_kernel(
     *, g: int, s: int, m: int, length_axis: int, k_opts: int,
     out_width: int, min_substitute: int, max_substitute: int,
+    algo: str = "md5",
 ):
     """Build the per-step kernel body (fully unrolled straight-line trace).
 
@@ -254,11 +377,13 @@ def _make_kernel(
       tok[G, L] i32, wlen[G, 1] i32, pos[G, M] i32, mlen[G, M] i32,
       radix[G, M] i32, base[G, M] i32, count[G, 1] i32,
       vopt[G, M, K] u32 (value bytes little-endian-packed), vlen[G, M, K] i32
-    Outputs: state[G, 4, S] u32 (MD5 state words), emit[G, S] i32.
+    Outputs: state[G, KS, S] u32 (hash state words, KS = DIGEST_WORDS[algo]),
+    emit[G, S] i32.
     """
-    # One-MD5-block scope: every emitted candidate (out_len <= out_width)
-    # plus its 0x80 terminator must fit below the length words.
-    assert 0 < out_width <= 55, out_width
+    # Single-hash-block scope: every emitted candidate (out_len <=
+    # out_width, doubled for NTLM) plus its terminator must fit below the
+    # length words.
+    assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
 
     def kernel(tok, wlen, pos, mlen, radix, base, count, vopt, vlen,
                state_ref, emit_ref):
@@ -321,15 +446,12 @@ def _make_kernel(
         out_len = cum
 
         # --- message build + compression (shared helpers) ---------------
-        # 0x80 terminator lands at out_len (<= 55 for emitted lanes; clash
-        # lanes may exceed — their words are garbage and masked).
-        msg = _message_from_units(unit_start, unit_len, unit_word,
-                                  out_len, g, s)
-        a, b, c, d = _md5_rounds(msg, g, s)
-        state_ref[:, 0, :] = a
-        state_ref[:, 1, :] = b
-        state_ref[:, 2, :] = c
-        state_ref[:, 3, :] = d
+        # The terminator lands after the data (within bounds for emitted
+        # lanes; clash lanes may exceed — garbage words, masked).
+        state = _hash_units(algo, unit_start, unit_len, unit_word,
+                            out_len, g, s)
+        for w_i, sw in enumerate(state):
+            state_ref[:, w_i, :] = sw
 
         emit = (
             lane_ok
@@ -377,10 +499,12 @@ def _pack_val_options(val_bytes, val_len, vstart_b, k_opts: int):
     return val_word[opt_rows], val_len[opt_rows]
 
 
-def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, interpret):
+def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
+                  interpret):
     """Shared pallas_call epilogue for both fused wrappers: G-row block
     specs derived from each input's trailing shape, (state, emit) outputs
-    reshaped to the flat lane contract."""
+    reshaped to the flat lane contract. ``n_state`` = hash state words
+    (4 for MD5/MD4/NTLM, 5 for SHA-1)."""
     from jax.experimental import pallas as pl
 
     def row_spec(trail):
@@ -392,14 +516,14 @@ def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, interpret):
         kernel,
         grid=(nb // _G,),
         in_specs=[row_spec(x.shape[1:]) for x in inputs],
-        out_specs=[row_spec((4, stride)), row_spec((stride,))],
+        out_specs=[row_spec((n_state, stride)), row_spec((stride,))],
         out_shape=[
-            jax.ShapeDtypeStruct((nb, 4, stride), jnp.uint32),
+            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32),
             jax.ShapeDtypeStruct((nb, stride), jnp.int32),
         ],
         interpret=interpret,
     )(*inputs)
-    state = state.transpose(0, 2, 1).reshape(num_lanes, 4)
+    state = state.transpose(0, 2, 1).reshape(num_lanes, n_state)
     emit = emit.reshape(num_lanes) > 0
     return state, emit
 
@@ -423,13 +547,15 @@ def fused_expand_md5(
     max_substitute: int,
     block_stride: int,
     k_opts: int,
+    algo: str = "md5",
     interpret: bool = False,
 ):
-    """Fused decode+splice+MD5 for a fixed-stride launch.
+    """Fused decode+splice+hash for a fixed-stride launch.
 
-    Returns ``(state uint32[N, 4], emit bool[N])`` — the same contract as
-    ``expand_matches`` + ``ops.hashes.md5`` restricted to what the crack
-    step consumes. Callers must have checked :func:`eligible`.
+    Returns ``(state uint32[N, K], emit bool[N])`` (K =
+    ``DIGEST_WORDS[algo]``) — the same contract as ``expand_matches`` +
+    ``ops.hashes.HASH_FNS[algo]`` restricted to what the crack step
+    consumes. Callers must have checked :func:`eligible`.
     """
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
@@ -450,20 +576,21 @@ def fused_expand_md5(
     kernel = _make_kernel(
         g=_G, s=block_stride, m=m, length_axis=length_axis, k_opts=k_opts,
         out_width=out_width, min_substitute=min_substitute,
-        max_substitute=max_substitute,
+        max_substitute=max_substitute, algo=algo,
     )
     return _launch_fused(
         kernel,
         (tok_b, wlen_b, pos_b, mlen_b, radix_b, blk_base, count_b,
          vopt_b, vlen_b),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
-        interpret=interpret,
+        n_state=DIGEST_WORDS[algo], interpret=interpret,
     )
 
 
 def _make_suball_kernel(
     *, g: int, s: int, p: int, num_segments: int, length_axis: int,
     k_opts: int, out_width: int, min_substitute: int, max_substitute: int,
+    algo: str = "md5",
 ):
     """Per-step kernel body for substitute-all plans (``-s`` / ``-s -r``).
 
@@ -479,9 +606,9 @@ def _make_suball_kernel(
     Ref shapes per grid step: tok[G, L] i32, wlen[G, 1] i32,
     pradix[G, P] i32, base[G, P] i32, count[G, 1] i32, sstart[G, GS] i32,
     slen[G, GS] i32, spat[G, GS] i32, vopt[G, P, K] u32, vlen[G, P, K] i32.
-    Outputs: state[G, 4, S] u32, emit[G, S] i32.
+    Outputs: state[G, KS, S] u32 (KS = DIGEST_WORDS[algo]), emit[G, S] i32.
     """
-    assert 0 < out_width <= 55, out_width
+    assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
 
     def kernel(tok, wlen, pradix, base, count, sstart, slen, spat,
                vopt, vlen, state_ref, emit_ref):
@@ -550,13 +677,10 @@ def _make_suball_kernel(
             cum = cum + ul
         out_len = cum
 
-        msg = _message_from_units(unit_start, unit_len, unit_word,
-                                  out_len, g, s)
-        a, b, c, d = _md5_rounds(msg, g, s)
-        state_ref[:, 0, :] = a
-        state_ref[:, 1, :] = b
-        state_ref[:, 2, :] = c
-        state_ref[:, 3, :] = d
+        state = _hash_units(algo, unit_start, unit_len, unit_word,
+                            out_len, g, s)
+        for w_i, sw in enumerate(state):
+            state_ref[:, w_i, :] = sw
 
         emit = (
             lane_ok
@@ -588,13 +712,13 @@ def fused_expand_suball_md5(
     max_substitute: int,
     block_stride: int,
     k_opts: int,
+    algo: str = "md5",
     interpret: bool = False,
 ):
-    """Fused decode+splice+MD5 for substitute-all fixed-stride launches.
+    """Fused decode+splice+hash for substitute-all fixed-stride launches.
 
-    Same contract as :func:`fused_expand_md5` (``(state uint32[N, 4],
-    emit bool[N])``); callers must have checked :func:`eligible` with the
-    plan's ``num_segments``.
+    Same contract as :func:`fused_expand_md5`; callers must have checked
+    :func:`eligible` with the plan's ``num_segments``.
     """
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     p = pat_radix.shape[1]
@@ -616,11 +740,12 @@ def fused_expand_suball_md5(
         g=_G, s=block_stride, p=p, num_segments=gs,
         length_axis=length_axis, k_opts=k_opts, out_width=out_width,
         min_substitute=min_substitute, max_substitute=max_substitute,
+        algo=algo,
     )
     return _launch_fused(
         kernel,
         (tok_b, wlen_b, pradix_b, blk_base, count_b, sstart_b, slen_b,
          spat_b, vopt_b, vlen_b),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
-        interpret=interpret,
+        n_state=DIGEST_WORDS[algo], interpret=interpret,
     )

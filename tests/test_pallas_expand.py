@@ -10,7 +10,7 @@ import pytest
 from hashcat_a5_table_generator_tpu.models.attack import AttackSpec, build_plan
 from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
 from hashcat_a5_table_generator_tpu.ops.expand_matches import expand_matches
-from hashcat_a5_table_generator_tpu.ops.hashes import md5
+from hashcat_a5_table_generator_tpu.ops.hashes import HASH_FNS
 from hashcat_a5_table_generator_tpu.ops.packing import pack_words
 from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
     eligible,
@@ -44,7 +44,7 @@ def _arrays(spec, words=WORDS, sub=LEET):
 
 
 def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
-                num_blocks=16):
+                num_blocks=16, algo="md5"):
     """Shared full-space sweep harness: run every launch through the XLA
     expand+md5 pair AND the fused kernel; returns per-launch
     (emit_xla, emit_pal, state_xla, state_pal). ``plan_fields`` names the
@@ -78,10 +78,10 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
             block_stride=STRIDE,
         )
         cand, clen, _, emit_x = xla_fn(*args, *blocks, **common)
-        state_x = md5(cand, clen)
+        state_x = HASH_FNS[algo](cand, clen)
         state_p, emit_p = fused_fn(
             *args, blocks[0], blocks[1], blocks[2],
-            k_opts=k_opts, interpret=True, **common,
+            k_opts=k_opts, algo=algo, interpret=True, **common,
         )
         outs.append((
             np.asarray(emit_x), np.asarray(emit_p),
@@ -91,12 +91,12 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
     return outs
 
 
-def _run_both(spec, plan, ct, *, num_blocks=16):
+def _run_both(spec, plan, ct, *, num_blocks=16, algo="md5"):
     return _sweep_both(
         spec, plan, ct,
         ("tokens", "lengths", "match_pos", "match_len", "match_radix",
          "match_val_start"),
-        expand_matches, fused_expand_md5, num_blocks=num_blocks,
+        expand_matches, fused_expand_md5, num_blocks=num_blocks, algo=algo,
     )
 
 
@@ -169,7 +169,7 @@ def test_eligible_bounds():
     assert eligible(**base)
     assert eligible(**{**base, "mode": "suball", "num_segments": 33})
     for bad in (
-        dict(mode="plain"), dict(algo="sha1"), dict(windowed=True),
+        dict(mode="plain"), dict(algo="sha256"), dict(windowed=True),
         dict(block_stride=96), dict(num_blocks=12), dict(out_width=56),
         dict(max_val_len=5), dict(max_options=9), dict(token_width=64),
         dict(num_segments=65),
@@ -177,7 +177,7 @@ def test_eligible_bounds():
         assert not eligible(**{**base, **bad}), bad
 
 
-def _run_both_suball(spec, plan, ct, *, num_blocks=16):
+def _run_both_suball(spec, plan, ct, *, num_blocks=16, algo="md5"):
     from hashcat_a5_table_generator_tpu.ops.expand_suball import expand_suball
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
         fused_expand_suball_md5,
@@ -188,6 +188,7 @@ def _run_both_suball(spec, plan, ct, *, num_blocks=16):
         ("tokens", "lengths", "pat_radix", "pat_val_start",
          "seg_orig_start", "seg_orig_len", "seg_pat"),
         expand_suball, fused_expand_suball_md5, num_blocks=num_blocks,
+        algo=algo,
     )
 
 
@@ -247,3 +248,47 @@ def test_opts_for_covers_suball(monkeypatch):
 
     monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
     assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) == 2
+
+
+@pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
+def test_other_algos_match_xla(algo):
+    """SHA-1 (BE schedule + 5 state words), NTLM (UTF-16LE expansion +
+    MD4), and raw MD4 through the fused kernel vs the XLA pair."""
+    spec = AttackSpec(mode="default", algo=algo)
+    ct, plan = _arrays(spec)
+    saw = False
+    for emit_x, emit_p, state_x, state_p in _run_both(
+        spec, plan, ct, algo=algo
+    ):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        saw = saw or emit_x.any()
+    assert saw
+
+
+def test_eligible_algo_bounds():
+    base = dict(mode="default", algo="md5", windowed=False, block_stride=128,
+                num_blocks=16, out_width=40, num_slots=8, token_width=16,
+                max_val_len=2, max_options=2)
+    for algo in ("md4", "sha1"):
+        assert eligible(**{**base, "algo": algo})
+    # NTLM halves the single-block candidate budget (UTF-16LE doubling).
+    assert not eligible(**{**base, "algo": "ntlm"})
+    assert eligible(**{**base, "algo": "ntlm", "out_width": 27})
+
+
+@pytest.mark.parametrize("algo", ["sha1", "ntlm"])
+def test_suball_other_algos_match_xla(algo):
+    """The suball kernel's non-MD5 paths: SHA-1's 5-word state and NTLM's
+    doubled-offset message through the segment formulation."""
+    spec = AttackSpec(mode="suball", algo=algo)
+    ct, plan = _arrays(spec, sub=SUBALL_TABLE)
+    assert not plan.fallback.any()
+    saw = False
+    for emit_x, emit_p, state_x, state_p in _run_both_suball(
+        spec, plan, ct, algo=algo
+    ):
+        np.testing.assert_array_equal(emit_x, emit_p)
+        np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+        saw = saw or emit_x.any()
+    assert saw

@@ -224,3 +224,66 @@ def test_multiplicity_q7_duplicate_values_double(word, table):
     assert set(got) == set(base)
     for cand, n in base.items():
         assert got[cand] >= 2 * n
+
+
+word_lists = st.lists(words, min_size=1, max_size=6)
+
+
+@settings(max_examples=100, deadline=None)
+@given(table=tables, wl=word_lists, first=st.booleans())
+def test_vectorized_match_builder_equals_scalar(table, wl, first):
+    """build_match_plan's batch scan vs the per-word find_matches loop:
+    every slot field and variant total identical (the vectorized path
+    replaced the loop silently, so any divergence is stream corruption)."""
+    from hashcat_a5_table_generator_tpu.ops.expand_matches import (
+        build_match_plan, find_matches,
+    )
+
+    ct = compile_table(table)
+    packed = pack_words(wl)
+    plan = build_match_plan(ct, packed, first_option_only=first)
+    for i in range(packed.batch):
+        matches = find_matches(packed.word(i), ct)
+        total = 1
+        for s, (pos, klen, ki) in enumerate(matches):
+            vc = int(ct.val_count[ki])
+            radix = 1 if vc == 0 else (2 if first else vc + 1)
+            assert plan.match_pos[i, s] == pos
+            assert plan.match_len[i, s] == klen
+            assert plan.match_radix[i, s] == radix
+            assert plan.match_val_start[i, s] == ct.val_start[ki]
+            total *= radix
+        assert all(
+            plan.match_radix[i, s] == 1
+            for s in range(len(matches), plan.num_slots)
+        )
+        assert plan.n_variants[i] == total
+
+
+@settings(max_examples=100, deadline=None)
+@given(table=tables, wl=word_lists, first=st.booleans())
+def test_vectorized_suball_builder_equals_scalar(table, wl, first,):
+    """The single-byte suball fast path vs the scalar segment builder,
+    field for field (random tables include multi-char keys and hazards —
+    those must route to the scalar path and still agree trivially)."""
+    import numpy as np
+
+    import hashcat_a5_table_generator_tpu.ops.expand_suball as es
+
+    ct = compile_table(table)
+    packed = pack_words(wl)
+    fast = es.build_suball_plan(ct, packed, first_option_only=first)
+    orig = es._build_suball_plan_fast
+    try:
+        es._build_suball_plan_fast = lambda *a, **k: None
+        slow = es.build_suball_plan(ct, packed, first_option_only=first)
+    finally:
+        es._build_suball_plan_fast = orig
+    assert fast.n_variants == slow.n_variants
+    assert fast.out_width == slow.out_width
+    assert fast.windowed == slow.windowed
+    for f in ("pat_radix", "pat_val_start", "seg_orig_start",
+              "seg_orig_len", "seg_pat", "fallback"):
+        np.testing.assert_array_equal(
+            getattr(fast, f), getattr(slow, f), err_msg=f
+        )
