@@ -28,10 +28,12 @@ LEET = {
     b"s": [b"5", b"$"],
     b"ss": [b"\xc3\x9f"],
 }
-WORDS = [
-    b"glass", b"password", b"x", b"", b"hello", b"assassin",
-    b"lessons", b"aeolus", b"misses", b"sassafras",
-]
+#: Deliberately small: interpret-mode kernel cost scales with total
+#: variants. Coverage kept: empty/1-char words, multi-match words, the
+#: multi-char-key path, and (via assassin's ~3k variants at 1024-lane
+#: launches) multi-block words with nonzero base digits AND multi-launch
+#: sweeps.
+WORDS = [b"glass", b"x", b"", b"hello", b"assassin", b"misses"]
 
 STRIDE = 128
 
@@ -44,7 +46,7 @@ def _arrays(spec, words=WORDS, sub=LEET):
 
 
 def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
-                num_blocks=16, algo="md5"):
+                num_blocks=8, algo="md5"):
     """Shared full-space sweep harness: run every launch through the XLA
     expand+md5 pair AND the fused kernel; returns per-launch
     (emit_xla, emit_pal, state_xla, state_pal). ``plan_fields`` names the
@@ -91,7 +93,7 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
     return outs
 
 
-def _run_both(spec, plan, ct, *, num_blocks=16, algo="md5"):
+def _run_both(spec, plan, ct, *, num_blocks=8, algo="md5"):
     return _sweep_both(
         spec, plan, ct,
         ("tokens", "lengths", "match_pos", "match_len", "match_radix",
@@ -177,7 +179,7 @@ def test_eligible_bounds():
         assert not eligible(**{**base, **bad}), bad
 
 
-def _run_both_suball(spec, plan, ct, *, num_blocks=16, algo="md5"):
+def _run_both_suball(spec, plan, ct, *, num_blocks=8, algo="md5"):
     from hashcat_a5_table_generator_tpu.ops.expand_suball import expand_suball
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
         fused_expand_suball_md5,
