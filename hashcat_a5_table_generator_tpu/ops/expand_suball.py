@@ -113,23 +113,32 @@ def _build_suball_plan_fast(
     min_substitute: "int | None",
     max_substitute: "int | None",
 ) -> "SubAllPlan | None":
-    """Vectorized plan construction for the dominant table shape: all keys
-    single-byte, no empty key, cascade-free (qwerty-cyrillic, czech,
-    qwerty-greek, ...). Single-byte patterns cannot overlap and the
-    cascade-free predicate rules out every fallback, so the whole scan is
-    a byte-LUT lookup and segments are maximal unmatched runs interleaved
-    with one-byte spans — all expressible as cumsum/scatter over the token
-    matrix. The per-word Python loop this replaces took ~30 s for a
-    300k-word dictionary (longer than the entire device sweep). Returns
-    None for table shapes it does not cover — the scalar path below is the
-    semantic reference (``tests/test_expand_suball.py`` pins equality).
+    """Vectorized plan construction for every table WITHOUT an empty key
+    (the ``=x`` line routes all words to the oracle — rare and cheap, so
+    it keeps the scalar path).
+
+    The scan vectorizes per key: single-byte keys are one byte-LUT lookup;
+    multi-byte keys use shifted compares plus an O(L) greedy pass that
+    reproduces ``bytes.find``'s non-overlapping occurrence walk. The
+    scalar path's word-level fallback flag is equivalent to "some pair of
+    occurrences overlaps": if no claim conflict fires, every key's
+    occurrence loop completes, so claimed spans ARE the independent
+    occurrence sets and are disjoint; conversely any overlap between
+    independent occurrences is detected when the later-sorted key claims.
+    Cross-pattern cascade hazards reduce to a presence×hazard matmul.
+
+    For fallback words the scalar path records the PARTIAL spans claimed
+    before the conflict; those segment fields are dead (the block cutter
+    skips fallback words, the oracle re-derives their candidates), so this
+    path stores the independent spans instead and only guarantees segment
+    equality on non-fallback rows; pattern-slot fields ARE equal
+    everywhere because both paths neutralize fallback rows to radix 1
+    before the windowed decision (tests pin exactly this contract; width
+    sizing also considers only non-fallback rows). The per-word Python
+    loop this replaces took ~30 s for a 300k-word dictionary — longer
+    than the whole device sweep.
     """
-    if not (
-        ct.all_keys_single_byte
-        and not ct.has_empty_key
-        and ct.cascade_free
-        and ct.num_keys > 0
-    ):
+    if ct.has_empty_key or ct.num_keys == 0:
         return None
     tokens, lengths = packed.tokens, packed.lengths
     b, width = tokens.shape
@@ -137,45 +146,92 @@ def _build_suball_plan_fast(
         return None  # degenerate shapes: keep the scalar reference path
     j = np.arange(width)
     in_word = j[None, :] < lengths[:, None]
-    ki_mat = np.where(in_word, ct.byte_to_key[tokens], -1)  # [B, L]
-    matched = ki_mat >= 0
-
     k = ct.num_keys
-    present = np.zeros((b, k), dtype=bool)
-    mrows, mcols = np.nonzero(matched)
-    mki = ki_mat[mrows, mcols]
-    present[mrows, mki] = True
-    counts_p = present.sum(axis=1)
-    num_p = max(1, int(counts_p.max()))
-    # Slot of key ki in word i = its rank among the word's present keys
-    # (ascending ki — the scalar loop walks ct.keys in sorted order).
-    krank = np.cumsum(present, axis=1) - 1  # [B, K]
 
+    # Occurrence scan: per-position key index / span length, coverage
+    # deltas for the overlap test, presence and span counts per word.
+    occ_key = np.full((b, width), -1, dtype=np.int32)
+    occ_len = np.zeros((b, width), dtype=np.int32)
+    cover_delta = np.zeros((b, width + 1), dtype=np.int32)
+    present = np.zeros((b, k), dtype=bool)
+    span_count = np.zeros(b, dtype=np.int64)
+
+    if ct.max_key_len >= 1:
+        ki1 = np.where(in_word, ct.byte_to_key[tokens], -1)  # [B, L]
+        m1 = ki1 >= 0
+        occ_key = np.where(m1, ki1, occ_key)
+        occ_len = np.where(m1, 1, occ_len)
+        cover_delta[:, :width] += m1
+        cover_delta[:, 1:] -= m1
+        r1, c1 = np.nonzero(m1)
+        present[r1, ki1[r1, c1]] = True
+        span_count += m1.sum(axis=1)
+
+    for kidx in np.nonzero((ct.key_len >= 2) & (ct.key_len <= width))[0]:
+        klen = int(ct.key_len[kidx])
+        key = ct.key_bytes[kidx]
+        match = (j[None, :] + klen) <= lengths[:, None]
+        for t in range(klen):
+            match[:, : width - t] &= tokens[:, t:] == key[t]
+            if t:
+                match[:, width - t:] = False
+        # Greedy non-overlapping same-key occurrences (bytes.find walk).
+        sel = np.zeros((b, width), dtype=bool)
+        next_free = np.zeros(b, dtype=np.int32)
+        for jj in range(width - klen + 1):
+            take = match[:, jj] & (jj >= next_free)
+            sel[:, jj] = take
+            next_free = np.where(take, jj + klen, next_free)
+        occ_key = np.where(sel, np.int32(kidx), occ_key)
+        occ_len = np.where(sel, np.int32(klen), occ_len)
+        cover_delta[:, :width] += sel
+        cover_delta[:, klen:] -= sel[:, : width + 1 - klen]
+        present[:, kidx] |= sel.any(axis=1)
+        span_count += sel.sum(axis=1)
+
+    coverage = np.cumsum(cover_delta[:, :width], axis=1)  # [B, L]
+    fallback_mask = (coverage > 1).any(axis=1)
+    if ct.cascade_hazard.any():
+        hz = ct.cascade_hazard.astype(np.int32)
+        m = present.astype(np.int32) @ hz  # hazardous-predecessor counts
+        fallback_mask |= ((m > 0) & present).any(axis=1)
+
+    # Slots: the word's present keys in ascending order. Fallback rows
+    # are neutralized below (radix 1) in BOTH paths, so dead rows never
+    # influence the windowed-enumeration decision and pat_* fields agree
+    # everywhere.
+    num_p = max(1, int(present.sum(axis=1).max()))
+    krank = np.cumsum(present, axis=1) - 1  # [B, K]
     vc = ct.val_count.astype(np.int64)
     options = np.minimum(1, vc) if first_option_only else vc
     key_radix = (options + 1).astype(np.int32)
-
     pat_radix = np.ones((b, num_p), dtype=np.int32)
     pat_val_start = np.zeros((b, num_p), dtype=np.int32)
     pw, pk = np.nonzero(present)
     slot_of = krank[pw, pk]
     pat_radix[pw, slot_of] = key_radix[pk]
     pat_val_start[pw, slot_of] = ct.val_start[pk]
+    pat_radix[fallback_mask] = 1
+    pat_val_start[fallback_mask] = 0
 
-    # Segments: every matched byte is a 1-byte span segment; unmatched
-    # runs collapse to one gap segment each. A position starts a segment
-    # iff it is matched, follows a matched byte, or opens the word.
-    prev_matched = np.zeros_like(matched)
-    prev_matched[:, 1:] = matched[:, :-1]
-    seg_start_mask = in_word & (matched | prev_matched | (j[None, :] == 0))
-    max_spans = int(matched.sum(axis=1).max())
-    num_g = 2 * max(1, max_spans) + 1  # scalar formula: gaps interleave
+    # Segments: spans start where an occurrence starts; gaps start at
+    # word-open or right after covered text. (Fallback rows may hold
+    # overlapping spans — their fields are dead, see docstring.)
+    covered = coverage > 0
+    prev_covered = np.zeros_like(covered)
+    prev_covered[:, 1:] = covered[:, :-1]
+    span_start = occ_len > 0
+    seg_start_mask = in_word & (
+        span_start | (~covered & ((j[None, :] == 0) | prev_covered))
+    )
+    num_g = 2 * max(1, int(span_count.max())) + 1
     seg_rank = np.cumsum(seg_start_mask, axis=1) - 1
     srows, scols = np.nonzero(seg_start_mask)
     gidx = seg_rank[srows, scols]
     if len(gidx) and int(gidx.max()) >= num_g:
         num_g = int(gidx.max()) + 1  # safety: never truncate segments
-    # Segment end = next segment's start in the same row, else word end.
+    # Segment end = next segment's start in the same row, else word end
+    # (for spans that equals start + key length on non-fallback rows).
     nxt = np.empty_like(scols)
     if len(scols):
         nxt[:-1] = scols[1:]
@@ -188,25 +244,30 @@ def _build_suball_plan_fast(
     seg_orig_len = np.zeros((b, num_g), dtype=np.int32)
     seg_pat = np.full((b, num_g), -1, dtype=np.int32)
     seg_orig_start[srows, gidx] = scols
-    seg_orig_len[srows, gidx] = (seg_end - scols).astype(np.int32)
-    s_ki = ki_mat[srows, scols]
+    is_span = span_start[srows, scols]
+    seg_orig_len[srows, gidx] = np.where(
+        is_span, occ_len[srows, scols], (seg_end - scols).astype(np.int32)
+    )
+    s_ki = np.clip(occ_key[srows, scols], 0, k - 1)
     seg_pat[srows, gidx] = np.where(
-        matched[srows, scols], krank[srows, np.clip(s_ki, 0, k - 1)], -1
+        is_span, krank[srows, s_ki], -1
     ).astype(np.int32)
 
-    # Output growth: per OCCURRENCE, the widest option beyond the key's
-    # single byte (the scalar span loop considers every option even in
-    # reverse mode — the width bound only needs to be safe, not tight).
+    # Output growth per occurrence (non-fallback rows size the buffer —
+    # fallback words never reach the device).
     delta_per_key = key_deltas(ct, limit_first_option=False)
+    orows, ocols = np.nonzero(occ_len > 0)
     word_delta = np.zeros(b, dtype=np.int64)
-    np.add.at(word_delta, mrows, delta_per_key[mki])
-    max_delta = int(word_delta.max()) if b else 0
+    np.add.at(word_delta, orows, delta_per_key[occ_key[orows, ocols]])
+    word_delta[fallback_mask] = 0
+    max_delta = int(word_delta.max())
     if out_width is None:
         out_width = rounded_out_width(width, max_delta)
 
     n_variants = variant_totals(pat_radix)
+    for i in np.nonzero(fallback_mask)[0]:
+        n_variants[int(i)] = 0
 
-    fallback_mask = np.zeros((b,), dtype=bool)
     windowed, win_v, n_variants = windowed_plan_fields(
         pat_radix, n_variants, min_substitute, max_substitute,
         zero_mask=fallback_mask,
@@ -337,6 +398,12 @@ def build_suball_plan(
 
     if out_width is None:
         out_width = max(4, -(-(width + max_delta) // 4) * 4)
+
+    # Neutralize fallback rows (mirrored in the fast path): their slots
+    # are dead — the oracle re-derives those words — and must not sway
+    # the global windowed-enumeration decision below.
+    pat_radix[fallback_mask] = 1
+    pat_val_start[fallback_mask] = 0
 
     # Count-windowed enumeration for tight -m/-x windows (same DP scheme
     # as match plans — the suball count is "distinct patterns chosen",
