@@ -122,6 +122,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--blocks", type=int, default=None,
                     help="device block slots per launch (default: lanes/128 "
                          "on accelerators — stride 128; 1024 on CPU)")
+    ap.add_argument("--fetch-chunk", type=_positive_int, default=None,
+                    metavar="N",
+                    help="crack mode: max launches whose counts accumulate "
+                         "on device between host fetches (a fetch costs a "
+                         "full round trip over remote-device links; chunks "
+                         "grow adaptively 1..N; default: the sweep "
+                         "runtime's tuned value — PERF.md §4b)")
     ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
                     default="auto",
                     help="variant-block layout: 'packed' = tightly-packed "
@@ -199,6 +206,18 @@ def _buckets_arg(value: str):
             f"got {value!r}"
         )
     return widths
+
+
+def _positive_int(value: str):
+    try:
+        n = int(value)
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"must be a positive integer, got {value!r}"
+        )
+    return n
 
 
 def _devices_arg(value: str):
@@ -425,10 +444,14 @@ def _run_device(args, sub_map, packed) -> int:
             args.lanes = (1 << 17) if on_cpu else (1 << 22)
         if args.blocks is None:
             args.blocks = 1024 if on_cpu else max(1, args.lanes // 128)
+    cfg_kw = {}
+    if args.fetch_chunk is not None:
+        cfg_kw["fetch_chunk"] = args.fetch_chunk
     cfg = SweepConfig(
         lanes=args.lanes,
         num_blocks=args.blocks,
         devices=args.devices,
+        **cfg_kw,
         packed_blocks={"auto": None, "packed": True, "stride": False}[
             args.block_layout
         ],

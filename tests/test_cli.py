@@ -443,3 +443,29 @@ class TestOracleCrack:
         assert r.stdout.splitlines() == [
             ntlm(plant).hex().encode() + b":" + plant
         ]
+
+
+def test_fetch_chunk_flag(workdir, tmp_path):
+    # --fetch-chunk reaches the sweep config; a chunk of 1 must still find
+    # every planted hit (per-launch fetching, the pre-chunking behavior).
+    sub = load_tables([str(workdir / "leet.table")])
+    cand = next(iter_candidates(b"password", sub, 0, 15))
+    digests = tmp_path / "d.txt"
+    digests.write_text(hashlib.md5(cand).hexdigest() + "\n")
+    for chunk in ("1", "64"):
+        r = run_cli(
+            str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+            "--backend", "device", "--digests", str(digests),
+            "--algo", "md5", "--fetch-chunk", chunk,
+            "--lanes", "64", "--blocks", "16",
+        )
+        assert hashlib.md5(cand).hexdigest().encode() in r.stdout, chunk
+
+
+def test_fetch_chunk_rejects_nonpositive(workdir):
+    r = run_cli(
+        str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),
+        "--backend", "device", "--fetch-chunk", "0", check=False,
+    )
+    assert r.returncode != 0
+    assert b"positive integer" in r.stderr
