@@ -21,7 +21,7 @@ Layer map (cf. SURVEY.md §1):
   native/    — C++ host-side hot paths (wordlist packing) + ctypes bindings
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
 
 from .tables.parser import (  # noqa: F401
     HexDecodeError,
