@@ -14,16 +14,21 @@ TPU tunnel — can wedge *forever* inside backend init, and a wedged init
 thread cannot be killed in-process):
 
 - **Orchestrator** (default entry): runs the measurement as a *subprocess*
-  per platform attempt — default resolution (the axon tunnel), then the
-  explicit ``tpu`` plugin, then a CPU fallback sized for host execution —
-  each under a hard kill-timeout, all under one total wall-clock budget.
+  per platform attempt — default resolution (the axon tunnel) RETRIED with
+  backoff for as long as ``--wall-budget`` allows (the tunnel wedge is a
+  known transient; one try is not a diagnosis), the explicit ``tpu``
+  plugin once, and a CPU fallback sized for host execution only when the
+  accelerator budget is exhausted — each under a hard kill-timeout.
   Emits exactly one JSON line: the first successful attempt's record,
-  augmented with the platform used and the stderr tails of failed attempts
-  (so a wedge is diagnosable, not a bare timeout).  Exits 2 if every
-  attempt failed (the error record is still printed).
+  augmented with the platform used and the stderr tails of ALL failed
+  attempts (so a wedge is diagnosable, not a bare timeout).  Exits 2 if
+  every attempt failed (the error record is still printed).
 - **Worker** (``--worker``): the actual timed loop.  Probes device init on a
   daemon thread with its own timeout and aborts with rc=2 if init never
-  completes (``os._exit`` — the wedged thread holds backend locks).
+  completes (``os._exit`` — the wedged thread holds backend locks).  On a
+  kernel-eligible config it times BOTH expand+hash arms — the XLA pair and
+  the fused Pallas kernel — and records the winner (``"arm"``), with both
+  sub-records under ``"arms"``.
 
 Steady-state methodology: pre-cut real variant blocks for the sweep's head,
 warm up (compile), then cycle the pre-cut batches for a fixed wall-clock
@@ -98,6 +103,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="variant-block layout (same semantics as the CLI; "
                          "auto = stride whenever blocks divides lanes evenly)")
     ap.add_argument("--mode", default="default", help="attack mode")
+    ap.add_argument("--arm", choices=("auto", "xla", "pallas"),
+                    default="auto",
+                    help="which expand+hash arm to time: the XLA pair, the "
+                         "fused Pallas kernel, or (auto) both when the "
+                         "config is kernel-eligible — recording the winner")
+    ap.add_argument("--wall-budget", type=float, default=540.0,
+                    help="orchestrator total wall-clock budget (seconds); "
+                         "accelerator attempts retry with backoff until "
+                         "only the CPU-fallback reserve remains")
     ap.add_argument("--init-timeout", type=float, default=150.0,
                     help="seconds the worker waits for accelerator init")
     ap.add_argument("--platform", default=None,
@@ -230,86 +244,156 @@ def run_worker(args: argparse.Namespace) -> None:
     # chain), while the round trip amortizes across the chunk.
     import jax.numpy as jnp
 
-    from hashcat_a5_table_generator_tpu.ops.pallas_expand import opts_for
-
-    fused_opts = opts_for(spec, plan, ct, block_stride=stride,
-                          num_blocks=args.blocks)
-    if fused_opts is not None:
-        print("# fused Pallas expand+MD5 kernel enabled", file=sys.stderr)
-    body = make_fused_body(spec, num_lanes=args.lanes,
-                           out_width=plan.out_width, block_stride=stride,
-                           fused_expand_opts=fused_opts)
-    acc_step = jax.jit(
-        lambda p_, t_, b_, d_, tot: tot + body(p_, t_, d_, b_)["n_emitted"]
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        opts_for_config,
     )
+
     zero = jnp.zeros((), jnp.int32)
 
-    t0 = time.perf_counter()
-    int(acc_step(p, t, batches[0], d, zero))
-    print(f"# warmup (incl. compile): {time.perf_counter()-t0:.1f}s",
-          file=sys.stderr)
+    def time_arm(arm_name: str, fused_opts) -> dict:
+        """Warm up, size chunks, and run the timed window for one arm
+        (fused_opts=None -> XLA expand+hash pair; K -> Pallas kernel)."""
+        body = make_fused_body(spec, num_lanes=args.lanes,
+                               out_width=plan.out_width, block_stride=stride,
+                               fused_expand_opts=fused_opts)
+        acc_step = jax.jit(
+            lambda p_, t_, b_, d_, tot:
+                tot + body(p_, t_, d_, b_)["n_emitted"]
+        )
 
-    # One steady-state launch (fetch included) sizes the chunk so each
-    # chunk retires in ~2 s of wall clock; per-launch time inside a chunk
-    # is lower than this estimate (no per-launch round trip), so chunks
-    # only ever finish faster than sized. int32 safety: 256 launches of
-    # 2^22 lanes stays under 2^31 counts.
-    t0 = time.perf_counter()
-    int(acc_step(p, t, batches[1 % len(batches)], d, zero))
-    per_launch = time.perf_counter() - t0
-    chunk = max(2, min(256, int(2.0 / max(per_launch, 1e-4))))
-    print(f"# sized chunks: {per_launch:.3f}s/launch -> {chunk}/chunk",
-          file=sys.stderr)
+        t0 = time.perf_counter()
+        int(acc_step(p, t, batches[0], d, zero))
+        print(f"# [{arm_name}] warmup (incl. compile): "
+              f"{time.perf_counter()-t0:.1f}s", file=sys.stderr)
 
-    from contextlib import nullcontext
+        # One steady-state launch (fetch included) sizes the chunk so each
+        # chunk retires in ~2 s of wall clock; per-launch time inside a
+        # chunk is lower than this estimate (no per-launch round trip), so
+        # chunks only ever finish faster than sized. int32 safety: 256
+        # launches of 2^22 lanes stays under 2^31 counts.
+        t0 = time.perf_counter()
+        int(acc_step(p, t, batches[1 % len(batches)], d, zero))
+        per_launch = time.perf_counter() - t0
+        chunk = max(2, min(256, int(2.0 / max(per_launch, 1e-4))))
+        print(f"# [{arm_name}] sized chunks: {per_launch:.3f}s/launch -> "
+              f"{chunk}/chunk", file=sys.stderr)
 
-    trace_ctx = nullcontext()
-    if args.profile_dir:
-        import jax.profiler
+        from contextlib import nullcontext
 
-        trace_ctx = jax.profiler.trace(args.profile_dir)
+        trace_ctx = nullcontext()
+        if args.profile_dir:
+            from jax import profiler as _profiler
 
-    hashed = 0
-    launches = 0
-    with trace_ctx:
-        start = time.perf_counter()
-        # Hard guard: if chunks run slower than the sizing launch
-        # suggested, stop at a chunk boundary and report a partial window
-        # rather than dying on the orchestrator's knife (r3's failure
-        # mode). Only fetched chunks are counted.
-        guard = start + max(3 * args.seconds, args.seconds + 30.0)
-        i = 0
-        guard_tripped = False
-        while True:
-            total = zero
-            for _ in range(chunk):
-                total = acc_step(p, t, batches[i % len(batches)], d, total)
-                i += 1
-            hashed += int(total)  # completion barrier for the whole chain
-            launches += chunk
-            now = time.perf_counter()
-            guard_tripped = now > guard
-            if now - start >= args.seconds or guard_tripped:
-                break
-        elapsed = time.perf_counter() - start
+            trace_ctx = _profiler.trace(
+                os.path.join(args.profile_dir, arm_name)
+            )
 
-    value = hashed / elapsed
-    print(f"# {launches} launches, {hashed:.3e} hashes, {elapsed:.2f}s",
-          file=sys.stderr)
-    record = {
-        "metric": metric_name(args.algo),
-        "value": value,
-        "unit": "hashes/sec",
-        "vs_baseline": value / NORTH_STAR,
-        "platform": dev.platform,
-        "device_kind": dev.device_kind,
-        "lanes": args.lanes,
-        "blocks": args.blocks,
-        "launches": launches,
-        "per_launch_s": round(elapsed / max(launches, 1), 4),
-    }
-    if guard_tripped:
-        record["partial"] = True  # chunks ran far slower than sized
+        hashed = 0
+        launches = 0
+        with trace_ctx:
+            start = time.perf_counter()
+            # Hard guard: if chunks run slower than the sizing launch
+            # suggested, stop at a chunk boundary and report a partial
+            # window rather than dying on the orchestrator's knife (r3's
+            # failure mode). Only fetched chunks are counted.
+            guard = start + max(3 * args.seconds, args.seconds + 30.0)
+            i = 0
+            guard_tripped = False
+            while True:
+                total = zero
+                for _ in range(chunk):
+                    total = acc_step(
+                        p, t, batches[i % len(batches)], d, total
+                    )
+                    i += 1
+                hashed += int(total)  # completion barrier for the chain
+                launches += chunk
+                now = time.perf_counter()
+                guard_tripped = now > guard
+                if now - start >= args.seconds or guard_tripped:
+                    break
+            elapsed = time.perf_counter() - start
+
+        value = hashed / elapsed
+        print(f"# [{arm_name}] {launches} launches, {hashed:.3e} hashes, "
+              f"{elapsed:.2f}s -> {value:.3e} hashes/s", file=sys.stderr)
+        sub = {
+            "value": value,
+            "launches": launches,
+            "per_launch_s": round(elapsed / max(launches, 1), 4),
+        }
+        if guard_tripped:
+            sub["partial"] = True  # chunks ran far slower than sized
+        return sub
+
+    # Arm selection: time both the XLA pair and the fused Pallas kernel
+    # when the config is kernel-eligible on this device (VERDICT r4 #2 —
+    # the bench must measure the kernel built to beat the XLA path, not
+    # just the path the env default selects), and record the winner.
+    cfg_opts = opts_for_config(spec, plan, ct, block_stride=stride,
+                               num_blocks=args.blocks)
+    if args.arm == "xla":
+        arm_plan = [("xla", None)]
+    elif args.arm == "pallas":
+        if cfg_opts is None:
+            raise SystemExit(
+                "--arm pallas: config is not kernel-eligible on this device"
+            )
+        arm_plan = [("pallas", cfg_opts)]
+    elif cfg_opts is None:
+        arm_plan = [("xla", None)]
+    else:
+        arm_plan = [("xla", None), ("pallas", cfg_opts)]
+
+    def winner_record(results: dict, partial_arms: bool) -> "dict | None":
+        ok = {k: v for k, v in results.items() if "error" not in v}
+        if not ok:
+            return None
+        winner = max(ok, key=lambda k: ok[k]["value"])
+        record = {
+            "metric": metric_name(args.algo),
+            "value": results[winner]["value"],
+            "unit": "hashes/sec",
+            "vs_baseline": results[winner]["value"] / NORTH_STAR,
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "lanes": args.lanes,
+            "blocks": args.blocks,
+            "launches": results[winner].get("launches", 0),
+            "per_launch_s": results[winner].get("per_launch_s", 0.0),
+            "arm": winner,
+        }
+        if results[winner].get("partial"):
+            record["partial"] = True
+        if len(results) > 1 or partial_arms:
+            record["arms"] = results
+        if partial_arms:
+            record["partial_arms"] = True  # not every planned arm ran
+        return record
+
+    results: dict[str, dict] = {}
+    for i, (arm_name, fused_opts) in enumerate(arm_plan):
+        try:
+            results[arm_name] = time_arm(arm_name, fused_opts)
+        except Exception as e:  # pragma: no cover - backend-dependent
+            # A losing arm must not sink the bench: record the failure and
+            # let the other arm carry the number (the Pallas kernel's
+            # first hardware runs happen *here*).
+            print(f"# [{arm_name}] FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            results[arm_name] = {"value": 0.0, "error": f"{e}"[:500]}
+        if i + 1 < len(arm_plan):
+            # Checkpoint the winner-so-far: if the orchestrator kills us
+            # mid-next-arm, this line still lands a number (it parses the
+            # LAST record on stdout).
+            interim = winner_record(results, partial_arms=True)
+            if interim is not None:
+                print(json.dumps(interim))
+                sys.stdout.flush()
+
+    record = winner_record(results, partial_arms=False)
+    if record is None:
+        raise SystemExit("all arms failed")
     print(json.dumps(record))
     sys.stdout.flush()
 
@@ -324,9 +408,11 @@ def _attempt(argv: list[str], env: dict, init_grace: float, run_grace: float,
     The worker prints ``# device:`` to stderr once backend init succeeds;
     until then the deadline is ``init_grace`` (a wedged init is killed
     fast), after which it extends by ``run_grace`` (compile + timed window
-    deserve their time) — capped at ``max_total`` from attempt start, the
-    attempt's share of the orchestrator's overall budget.
-    Returns (record|None, stderr_tail, rc).
+    deserve their time) — capped at ``max_total`` from attempt start.
+    Returns (record|None, stderr_tail, rc).  A killed/failed worker can
+    still yield a record: the worker prints a full record line after EACH
+    completed arm, so the last non-error record on stdout survives a kill
+    during a later arm (it carries ``partial_arms: true``).
     """
     import tempfile
 
@@ -373,16 +459,22 @@ def _attempt(argv: list[str], env: dict, init_grace: float, run_grace: float,
     tail = stderr[-2000:]
     if tail:
         print(tail, file=sys.stderr)
+    # Take the LAST parseable non-error record — even when the worker was
+    # killed or failed: the worker prints a full record after each
+    # completed arm, so a kill during arm 2 must not discard arm 1's
+    # finished measurement.
     record = None
-    if rc == 0:
-        for line in reversed(stdout.strip().splitlines()):
-            try:
-                cand = json.loads(line)
-            except (ValueError, TypeError):
-                continue
-            if isinstance(cand, dict) and "value" in cand:
-                record = cand
-                break
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(cand, dict) and "value" in cand \
+                and "error" not in cand:
+            record = cand
+            break
+    if record is not None and rc != 0:
+        record["worker_rc"] = rc
     return record, tail, rc
 
 
@@ -390,7 +482,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
     me = os.path.abspath(__file__)
 
     def worker_args(init_timeout: float, platform: str | None = None,
-                    **overrides):
+                    arm: str | None = None, **overrides):
         vals = {
             "lanes": args.lanes, "blocks": args.blocks, "words": args.words,
             "seconds": args.seconds, "batches": args.batches,
@@ -402,7 +494,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
             "--seconds", str(vals["seconds"]),
             "--batches", str(vals["batches"]), "--algo", args.algo,
             "--mode", args.mode, "--init-timeout", str(init_timeout),
-            "--block-layout", args.block_layout,
+            "--block-layout", args.block_layout, "--arm", arm or args.arm,
         ]
         if platform:
             out += ["--platform", platform]
@@ -421,48 +513,139 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         batches=min(args.batches, 4),
     )
 
-    # Budget: the whole orchestration must land a number well inside the
-    # driver's patience (~10 min).  Per attempt, init_grace is the time the
-    # backend gets to come up; only once init *succeeds* (the worker prints
-    # '# device:') does the deadline extend for compile + the timed window.
-    # One shared wall-clock budget bounds the sum of attempts, always
-    # reserving enough tail for the CPU fallback to complete.
-    run_grace = 240.0 + args.seconds  # first TPU compile can take minutes
+    # Budget: the whole orchestration must land a number inside the
+    # driver's patience (--wall-budget, default 540s).  Per attempt,
+    # init_grace is the time the backend gets to come up; only once init
+    # *succeeds* (the worker prints '# device:') does the deadline extend
+    # for compile + the timed window — and a successful init may spend the
+    # CPU reserve too (the fallback is moot once a device is up).
+    #
+    # The axon tunnel is a known *transient* wedge (it ate the r3 window
+    # and the r4 snapshot): one try is not a diagnosis.  So accelerator
+    # attempts RETRY with backoff — fresh subprocess each time — for as
+    # long as the budget allows, reserving only the tail the CPU fallback
+    # needs; every attempt's stderr tail is recorded (VERDICT r4 #1).
+    # Two compiles + two warmups + two timed windows when both arms run.
+    run_grace = 420.0 + 2 * args.seconds
     cpu_need = 90 + 60 + 30  # cpu init grace + compile/run + slack
-    total_deadline = time.monotonic() + 540.0
-    attempts = [
-        # Default platform resolution (the axon TPU tunnel, when present).
-        ("accelerator", worker_args(args.init_timeout),
-         args.init_timeout + 30, True),
-        # Explicit tpu plugin: if axon is wedged but a local libtpu chip
-        # exists this comes up fast; if neither exists it errors fast.
-        ("tpu", worker_args(45, platform="tpu"), 45 + 30, True),
-        ("cpu-fallback", cpu_args, 90, False),
-    ]
+    # A post-init accelerator attempt may run long — but never into the
+    # CPU fallback's guaranteed tail (a failing post-init run must still
+    # leave enough budget to land SOME number).
+    cpu_tail = float(cpu_need)
+    total_deadline = time.monotonic() + args.wall_budget
 
-    failures = []
-    for name, extra, init_grace, reserve_cpu in attempts:
-        remaining = total_deadline - time.monotonic()
-        spendable = remaining - (cpu_need if reserve_cpu else 0)
-        if spendable < init_grace:
-            failures.append({
-                "attempt": name, "rc": None,
-                "stderr_tail": "# orchestrator: skipped (budget exhausted)",
-            })
-            continue
+    def try_one(name, extra, init_grace, max_total):
+        """One capped attempt; returns the record (NOT printed — the
+        caller may still merge in a completion attempt) or logs the
+        failure and returns None."""
         env = dict(os.environ)
         argv = [sys.executable, me, "--worker"] + extra
         print(f"# attempt[{name}]: {' '.join(argv[2:])}", file=sys.stderr)
         record, tail, rc = _attempt(
-            argv, env, init_grace, run_grace, max_total=spendable
+            argv, env, init_grace, run_grace, max_total=max_total,
         )
         if record is not None:
             record["attempt"] = name
-            if failures:
-                record["failed_attempts"] = failures
-            print(json.dumps(record))
+            return record
+        failures.append({"attempt": name, "rc": rc,
+                         "stderr_tail": tail[-600:]})
+        return None
+
+    def arm_entry(rec):
+        """One record's winner as an `arms`-style sub-record."""
+        return {
+            "value": rec["value"],
+            "launches": rec.get("launches", 0),
+            "per_launch_s": rec.get("per_launch_s", 0.0),
+        }
+
+    def emit(record):
+        if failures:
+            record["failed_attempts"] = failures
+        print(json.dumps(record))
+
+    def complete_arms(record):
+        """A kill mid-pallas-arm leaves a partial_arms record (xla only).
+        When budget remains, run a pallas-ONLY attempt — the persistent
+        compilation cache makes the retry's compile cheap — and merge, so
+        the fused kernel still gets measured (VERDICT r4 #2)."""
+        if not record.get("partial_arms") or args.arm != "auto":
+            return record
+        remaining = total_deadline - time.monotonic()
+        if remaining - cpu_tail < 120:
+            return record
+        print("# orchestrator: completing unmeasured pallas arm",
+              file=sys.stderr)
+        rec2 = try_one(
+            "accelerator-pallas",
+            worker_args(args.init_timeout, arm="pallas"),
+            min(args.init_timeout + 30, remaining - cpu_tail),
+            total_deadline - time.monotonic() - 60,
+        )
+        if rec2 is None:
+            return record
+        arms = dict(record.get("arms") or {record["arm"]: arm_entry(record)})
+        arms.update(rec2.get("arms")
+                    or {rec2["arm"]: arm_entry(rec2)})
+        ok = {k: v for k, v in arms.items() if "error" not in v}
+        winner = max(ok, key=lambda k: ok[k]["value"])
+        merged = dict(record)
+        merged.update({
+            "value": arms[winner]["value"],
+            "vs_baseline": arms[winner]["value"] / NORTH_STAR,
+            "launches": arms[winner].get("launches", 0),
+            "per_launch_s": arms[winner].get("per_launch_s", 0.0),
+            "arm": winner,
+            "arms": arms,
+        })
+        merged.pop("partial_arms", None)
+        merged["arms_completed_by_retry"] = True
+        return merged
+
+    failures = []
+    tried_tpu_plugin = False
+    backoff = 10.0
+    while True:
+        remaining = total_deadline - time.monotonic()
+        spendable = remaining - cpu_need
+        if spendable < 75:
+            break
+        # Default platform resolution (the axon TPU tunnel, when present).
+        # A wedged init is killed at init_grace; a successful init may run
+        # up to the CPU fallback's guaranteed tail.
+        init_grace = min(args.init_timeout + 30, spendable)
+        rec = try_one("accelerator",
+                      worker_args(min(args.init_timeout, init_grace - 15)),
+                      init_grace,
+                      total_deadline - time.monotonic() - cpu_tail)
+        if rec is not None:
+            emit(complete_arms(rec))
             return
-        failures.append({"attempt": name, "rc": rc, "stderr_tail": tail})
+        # Explicit tpu plugin: if axon is wedged but a local libtpu chip
+        # exists this comes up fast; if neither exists it errors fast —
+        # so one try settles it for the whole run.
+        if not tried_tpu_plugin:
+            tried_tpu_plugin = True
+            if total_deadline - time.monotonic() - cpu_need >= 75:
+                rec = try_one("tpu", worker_args(45, platform="tpu"), 75,
+                              total_deadline - time.monotonic() - cpu_tail)
+                if rec is not None:
+                    emit(complete_arms(rec))
+                    return
+        # Tunnel down: back off briefly, then retry a fresh subprocess.
+        sleep_s = min(backoff,
+                      max(0.0, total_deadline - time.monotonic() - cpu_need))
+        if sleep_s > 0:
+            print(f"# orchestrator: accelerator down, retrying in "
+                  f"{sleep_s:.0f}s", file=sys.stderr)
+            time.sleep(sleep_s)
+        backoff = min(backoff * 2, 60.0)
+
+    rec = try_one("cpu-fallback", cpu_args, 90,
+                  max(60.0, total_deadline - time.monotonic() - 5))
+    if rec is not None:
+        emit(rec)
+        return
 
     print(json.dumps(error_record(
         args.algo, "all platform attempts failed", failed_attempts=failures,

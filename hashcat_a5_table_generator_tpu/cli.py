@@ -381,6 +381,28 @@ def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
             _time.sleep(min(2.0 * attempt, 10.0))
 
 
+def _die_peer_loss(e) -> None:
+    """Loud multihost abort: a peer died and the collective timed out.
+
+    The survivor's checkpoint is already on disk (each host checkpoints
+    its own stripe cursor), so the printed instructions make relaunching
+    the pod a correct resume.  ``os._exit`` — the timed-out all-gather
+    thread holds the distributed client and cannot be joined.
+    """
+    import os
+
+    print(f"{PROG}: FATAL: {e}", file=sys.stderr)
+    print(
+        f"{PROG}: recovery: relaunch the pod (same command on every host); "
+        "each host resumes its own stripe from --checkpoint and "
+        "already-reported hits are deduped",
+        file=sys.stderr,
+    )
+    sys.stderr.flush()
+    sys.stdout.flush()
+    os._exit(3)
+
+
 def _run_device(args, sub_map, packed) -> int:
     """``packed`` is a PackedWords batch or a ``{width: PackedWords}``
     bucket dict (native fast path) — the device backend never materializes
@@ -478,17 +500,23 @@ def _run_device(args, sub_map, packed) -> int:
         if args.digests is not None:
             digests = _read_digests(args.digests, args.algo)
             if nprocs > 1:
-                from .parallel.multihost import run_crack_multihost
+                from .parallel.multihost import (
+                    PeerLossError,
+                    run_crack_multihost,
+                )
 
                 # The combined hit stream is identical on every process;
                 # process 0 is the conventional reporter.
                 recorder = (
                     HitRecorder(sys.stdout.buffer) if pid == 0 else None
                 )
-                res = run_crack_multihost(
-                    spec, sub_map, packed, digests, cfg,
-                    recorder=recorder, resume=not args.no_resume,
-                )
+                try:
+                    res = run_crack_multihost(
+                        spec, sub_map, packed, digests, cfg,
+                        recorder=recorder, resume=not args.no_resume,
+                    )
+                except PeerLossError as e:
+                    _die_peer_loss(e)
             else:
                 recorder = _DedupRecorder(HitRecorder(sys.stdout.buffer))
                 res = _run_with_retries(
@@ -507,7 +535,10 @@ def _run_device(args, sub_map, packed) -> int:
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
             if nprocs > 1:
-                from .parallel.multihost import run_candidates_multihost
+                from .parallel.multihost import (
+                    PeerLossError,
+                    run_candidates_multihost,
+                )
 
                 # Each process streams ITS stripe to its own stdout;
                 # concatenating the per-host outputs in process order
@@ -516,10 +547,13 @@ def _run_device(args, sub_map, packed) -> int:
                 # host's stream is bucket-major over its own stripe, so
                 # the concatenation is a per-word-preserving permutation
                 # of the single-host bucket-major stream.
-                run_candidates_multihost(
-                    spec, sub_map, packed, writer, cfg,
-                    resume=not args.no_resume,
-                )
+                try:
+                    run_candidates_multihost(
+                        spec, sub_map, packed, writer, cfg,
+                        resume=not args.no_resume,
+                    )
+                except PeerLossError as e:
+                    _die_peer_loss(e)
             else:
                 _run_with_retries(
                     lambda resume: make_sweep().run_candidates(

@@ -45,25 +45,40 @@ def _stride_index(plan, stride: int):
     if cache is None:
         cache = {}
         object.__setattr__(plan, "_stride_index_cache", cache)
-    entry = cache.get(stride)
-    if entry is None:
-        b = plan.batch
-        widths = np.zeros(b + 1, dtype=np.int64)
-        totals = np.zeros(b, dtype=np.int64)
-        huge = np.zeros(b, dtype=bool)
-        fallback = plan.fallback
-        for i, t in enumerate(plan.n_variants):
-            if fallback[i]:
-                continue
-            if t >= _HUGE_WORD:
-                huge[i] = True
-                totals[i] = _HUGE_WORD
-                widths[i + 1] = -(-_HUGE_WORD // stride)
-            else:
-                totals[i] = t
-                widths[i + 1] = -(-t // stride)
-        entry = (np.cumsum(widths), totals, huge)
-        cache[stride] = entry
+    if stride in cache:
+        return cache[stride]
+    b = plan.batch
+    widths = np.zeros(b + 1, dtype=np.int64)
+    totals = np.zeros(b, dtype=np.int64)
+    huge = np.zeros(b, dtype=bool)
+    fallback = plan.fallback
+    total_width = 0  # Python int: overflow-proof running sum of widths
+    for i, t in enumerate(plan.n_variants):
+        if fallback[i]:
+            continue
+        if t >= _HUGE_WORD:
+            # Width 1, not ceil(t/stride): any window whose searchsorted
+            # lands on a huge word bails to the scalar path (huge[w].any()
+            # in the fast cutter), so the fast path never decodes a huge
+            # word's ranks — a single slot keeps the cumsum small instead
+            # of adding ~2^53 per huge word (advisor r4: ~1024 such words
+            # silently overflowed the int64 cumsum).
+            huge[i] = True
+            totals[i] = _HUGE_WORD
+            widths[i + 1] = 1
+            total_width += 1
+        else:
+            totals[i] = t
+            w_i = -(-t // stride)
+            widths[i + 1] = w_i
+            total_width += w_i
+    if total_width >= (1 << 62):
+        # Cumulative block index would overflow int64 (needs ~2^55 words
+        # just below the huge cap): scalar path only for this stride.
+        cache[stride] = None
+        return None
+    entry = (np.cumsum(widths), totals, huge)
+    cache[stride] = entry
     return entry
 
 
@@ -82,6 +97,10 @@ def _make_blocks_stride_fast(
     b1 = min(b0 + nb_cap, int(cum[-1]))
     nb = b1 - b0
     if nb <= 0:
+        # Distinguish 'sweep complete' from 'no block budget' (advisor r4:
+        # nb_cap == 0 with unfinished words must not report completion —
+        # a silent-keyspace-loss hazard for future make_blocks callers).
+        done = b0 >= int(cum[-1])
         return (
             BlockBatch(
                 word=np.zeros(0, np.int32),
@@ -89,8 +108,8 @@ def _make_blocks_stride_fast(
                 count=np.zeros(0, np.int32),
                 offset=np.zeros(0, np.int32),
             ),
-            plan.batch,
-            0,
+            plan.batch if done else start_word,
+            0 if done else start_rank,
         )
     blocks = np.arange(b0, b1, dtype=np.int64)
     w = (np.searchsorted(cum, blocks, side="right") - 1).astype(np.int64)
@@ -185,18 +204,25 @@ def make_blocks(
             plan.fallback[w] or rank >= plan.n_variants[w]
         ):
             w, rank = w + 1, 0
-        if rank % fixed_stride == 0:
+        if rank % fixed_stride == 0 and (
+            w >= plan.batch or plan.n_variants[w] < _HUGE_WORD
+        ):
             # Misaligned ranks (cross-geometry checkpoint resume) keep the
-            # scalar path; they re-align at the next word boundary.
-            cum, totals, huge = _stride_index(plan, fixed_stride)
-            nb_cap = budget // fixed_stride
-            if max_blocks is not None:
-                nb_cap = min(nb_cap, max_blocks)
-            fast = _make_blocks_stride_fast(
-                plan, cum, totals, huge, w, rank, nb_cap, fixed_stride
-            )
-            if fast is not None:
-                return fast
+            # scalar path; they re-align at the next word boundary.  A huge
+            # START word also keeps it: huge words occupy one slot in the
+            # cumulative index, so ``cum[w] + rank // stride`` would land
+            # inside later words' block ranges.
+            entry = _stride_index(plan, fixed_stride)
+            if entry is not None:
+                cum, totals, huge = entry
+                nb_cap = budget // fixed_stride
+                if max_blocks is not None:
+                    nb_cap = min(nb_cap, max_blocks)
+                fast = _make_blocks_stride_fast(
+                    plan, cum, totals, huge, w, rank, nb_cap, fixed_stride
+                )
+                if fast is not None:
+                    return fast
     words: List[int] = []
     bases: List[List[int]] = []
     counts: List[int] = []

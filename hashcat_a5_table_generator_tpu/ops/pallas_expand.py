@@ -111,36 +111,50 @@ def k_opts_for(plan) -> int:
 
 
 def enabled_by_env() -> bool:
-    """``A5GEN_PALLAS=expand`` opts the fused expansion kernel in (kept
-    behind a flag until the on-chip A/B lands, like the hash-only kernel's
-    ``A5GEN_PALLAS=1``)."""
+    """The fused expansion kernel is ON by default on TPU; ``A5GEN_PALLAS``
+    set to ``off``/``0``/``xla``/``none`` opts out (``expand`` still force-
+    opts in, for symmetry with the hash-only kernel's ``A5GEN_PALLAS=1`` —
+    which selects *that* kernel and therefore also opts this one out).
+    Unrecognized values warn and keep the default — a typo must not
+    silently disable the fast path."""
     import os
 
-    return os.environ.get("A5GEN_PALLAS") == "expand"
+    val = os.environ.get("A5GEN_PALLAS")
+    if val is None or val == "":
+        return True
+    if val == "expand":
+        return True
+    if val in ("off", "0", "xla", "none", "1"):
+        return False
+    import sys
+
+    print(
+        f"a5gen: warning: unrecognized A5GEN_PALLAS={val!r} "
+        "(want expand|off|0|xla|none|1); keeping the default "
+        "(fused kernel on for eligible TPU configs)",
+        file=sys.stderr,
+    )
+    return True
 
 
-def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
-    """One-stop gate for callers that own the plan and table: returns the
-    static option count K to pass as ``make_fused_body(fused_expand_opts=)``
-    when the env flag is set and the configuration is eligible, else None.
-    ``spec``/``plan``/``ct`` are the attack spec, host plan (match or
-    substitute-all — the body routes by mode), and compiled table."""
-    if not enabled_by_env():
-        return None
-    # Device platform, not backend name: the remote tunnel fronts "tpu"
-    # devices behind a differently-named backend (see ops.pallas_md5).
+def _on_tpu() -> bool:
+    """Device platform, not backend name: the remote tunnel fronts "tpu"
+    devices behind a differently-named backend (see ops.pallas_md5)."""
     try:
-        on_tpu = jax.devices()[0].platform == "tpu"
+        return jax.devices()[0].platform == "tpu"
     except Exception:  # pragma: no cover - backend-dependent
-        on_tpu = False
-    if not on_tpu:
-        import sys
+        return False
 
-        print(
-            "a5gen: warning: A5GEN_PALLAS=expand but no TPU device; "
-            "using the XLA expand+hash path",
-            file=sys.stderr,
-        )
+
+def opts_for_config(spec, plan, ct, *, block_stride, num_blocks,
+                    require_tpu: bool = True) -> "int | None":
+    """Pure eligibility gate (no env check): returns the static option
+    count K for ``make_fused_body(fused_expand_opts=)`` when the launch
+    configuration is eligible, else None.  ``spec``/``plan``/``ct`` are the
+    attack spec, host plan (match or substitute-all — the body routes by
+    mode), and compiled table.  ``require_tpu=False`` skips the device
+    probe (interpret-mode tests, A/B probes that pin the platform)."""
+    if require_tpu and not _on_tpu():
         return None
     max_options = k_opts_for(plan)
     ok = eligible(
@@ -157,6 +171,30 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
         num_segments=int(getattr(plan, "num_segments", 0)),
     )
     return max_options if ok else None
+
+
+def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
+    """Production gate: :func:`opts_for_config` under the env opt-out
+    (:func:`enabled_by_env`).  Default-on on TPU devices; the XLA
+    expand+hash pair remains for ineligible configs and non-TPU backends."""
+    import os
+
+    if not enabled_by_env():
+        return None
+    if os.environ.get("A5GEN_PALLAS") == "expand" and not _on_tpu():
+        # An EXPLICIT opt-in deserves a diagnostic when it can't be
+        # honored; the default-on (env unset) case falls back silently.
+        import sys
+
+        print(
+            "a5gen: warning: A5GEN_PALLAS=expand but no TPU device; "
+            "using the XLA expand+hash path",
+            file=sys.stderr,
+        )
+        return None
+    return opts_for_config(
+        spec, plan, ct, block_stride=block_stride, num_blocks=num_blocks
+    )
 
 
 def _exact_div(r, rs):
@@ -206,24 +244,59 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
     odd bytes stay zero (matching ``ops.hashes.utf16le_expand``).
 
     A unit at index j starts at candidate offset <= 4*j (every prior unit
-    contributes <= 4 bytes), bounding its word span."""
+    contributes <= 4 bytes), bounding its word span.
+
+    Placement is whole-unit, not per-byte (PERF.md §7's top lever): the
+    unit's <=4 masked bytes shift as one u32 into a (lo, hi) word pair
+    straddling the dynamic byte offset, and each pair scatters into the
+    message with one select chain per touched word — ~2x fewer VPU ops
+    than placing each byte separately.  For utf16 the unit first expands
+    into two 2-code-unit pieces (even byte offsets, same machinery)."""
     scale = 2 if utf16 else 1
     msg = [jnp.zeros((g, s), _U32) for _ in range(16)]
+
+    def place(off, blen, word, j_span):
+        """OR ``word``'s low ``blen`` bytes into msg at byte offset
+        ``off`` (all (G, S) tiles; blen in 0..4).  ``j_span``: static cap
+        on the highest word index the piece can reach."""
+        sh8 = (blen * 8) & 31
+        mask = (_U32(1) << sh8.astype(_U32)) - _U32(1)
+        mask = jnp.where(blen >= 4, _U32(0xFFFFFFFF), mask)
+        wm = word & mask
+        sh = (_U32(8) * (off & 3).astype(_U32))
+        lo = wm << sh
+        # Shift-by-32 is undefined: mask the amount and select instead.
+        hi = jnp.where(sh > 0, wm >> ((_U32(32) - sh) & _U32(31)), _U32(0))
+        widx = off >> 2
+        sel_prev = None
+        for w_i in range(min(_N_MSG_WORDS, j_span + 1)):
+            sel = widx == w_i
+            contrib = jnp.where(sel, lo, _U32(0))
+            if sel_prev is not None:
+                contrib = contrib | jnp.where(sel_prev, hi, _U32(0))
+            msg[w_i] = msg[w_i] | contrib
+            sel_prev = sel
+        # hi spill past the last lo word (within the message bound).
+        w_last = min(_N_MSG_WORDS, j_span + 1)
+        if w_last < _N_MSG_WORDS:
+            msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
+
     for j in range(len(unit_start)):
         us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
-        for k in range(4):
-            active = k < ul
-            o = (us + k) * scale
-            byte = (uw >> _U32(8 * k)) & _U32(0xFF)
-            contrib = jnp.where(
-                active, byte << (_U32(8) * (o & 3).astype(_U32)),
-                _U32(0),
+        if not utf16:
+            place(us, ul, uw, scale * (j + 1))
+        else:
+            # Bytes b0..b3 -> code units (b0|b1<<16) at 2*us and
+            # (b2|b3<<16) at 2*us+4.
+            lo16 = (uw & _U32(0xFF)) | ((uw & _U32(0xFF00)) << 8)
+            hi16 = ((uw >> 16) & _U32(0xFF)) | (
+                ((uw >> 24) & _U32(0xFF)) << 16
             )
-            widx = o >> 2
-            for w_i in range(min(_N_MSG_WORDS, scale * (j + 1) + 1)):
-                msg[w_i] = msg[w_i] | jnp.where(
-                    widx == w_i, contrib, _U32(0)
-                )
+            off = us * 2
+            blen_lo = jnp.minimum(ul, 2) * 2
+            blen_hi = jnp.maximum(ul - 2, 0) * 2
+            place(off, blen_lo, lo16, scale * (j + 1))
+            place(off + 4, blen_hi, hi16, scale * (j + 1) + 1)
     end = out_len * scale
     mark = _U32(0x80) << (_U32(8) * (end & 3).astype(_U32))
     widx = end >> 2

@@ -30,6 +30,9 @@ processes, one virtual CPU device each, coordinator on localhost).
 from __future__ import annotations
 
 import json
+import os
+import threading
+import time
 from dataclasses import replace
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -38,6 +41,7 @@ import numpy as np
 from ..ops.packing import PackedWords
 
 __all__ = [
+    "PeerLossError",
     "initialize",
     "host_stripe",
     "stripe_packed",
@@ -47,6 +51,111 @@ __all__ = [
     "run_crack_multihost",
     "run_candidates_multihost",
 ]
+
+#: Seconds without any sign of life from a peer before a survivor blocked
+#: in a collective gives up (``A5GEN_DCN_TIMEOUT`` overrides; ``0``
+#: disables).  With the coordination-service heartbeat (the normal case)
+#: "sign of life" is a heartbeat update, so a STRAGGLER still sweeping its
+#: stripe never trips it — only a process that stopped beating does, and
+#: this value is pure detection latency.  Without a KV client (fallback)
+#: it degrades to a plain collective timeout, where the default must also
+#: cover straggler skew.
+_DEFAULT_DCN_TIMEOUT = 600.0
+
+#: Seconds between heartbeat publications (see :func:`_start_heartbeat`).
+_HB_INTERVAL = 5.0
+
+_HB_PREFIX = "a5gen/hb/"
+
+_hb_thread: Optional[threading.Thread] = None
+
+
+class PeerLossError(RuntimeError):
+    """A peer process died or stalled while this one waited in a collective.
+
+    ``jax.distributed`` collectives have no liveness detection — a host
+    that dies mid-sweep leaves the survivors blocked in the final
+    hit all-gather forever (VERDICT r4 weak #6).  Detection is a
+    heartbeat: every process publishes a counter to the pod's
+    coordination KV store every ``_HB_INTERVAL`` seconds for its whole
+    lifetime (daemon thread, started by :func:`initialize`), and a
+    survivor blocked in a collective polls its peers' counters — a
+    counter frozen longer than ``A5GEN_DCN_TIMEOUT`` means the peer is
+    gone, and the survivor aborts loudly instead of hanging.  Change
+    detection (not timestamps) keeps it clock-skew-free, and a peer
+    still *sweeping* keeps beating, so slow stripes never false-abort.
+    Recovery is a relaunch: each host checkpoints its own stripe cursor
+    independently, so rerunning the same command on every host resumes
+    every stripe and dedupes already-reported hits
+    (``runtime.checkpoint``, ``cli --retries``).
+    """
+
+
+def _kv_client():
+    """The distributed coordination KV client, or None (no distributed
+    runtime / internal API moved)."""
+    try:
+        from jax._src import distributed
+
+        return distributed.global_state.client
+    except Exception:  # pragma: no cover - jax-internal API
+        return None
+
+
+def _start_heartbeat() -> None:
+    """Publish this process's liveness counter forever (daemon thread).
+
+    The thread dies with the process — which is exactly the signal: a
+    frozen counter IS a dead process.  Idempotent; no-op when the
+    distributed runtime (and hence the KV store) is absent."""
+    global _hb_thread
+    if _hb_thread is not None and _hb_thread.is_alive():
+        return
+    client = _kv_client()
+    if client is None:
+        return
+    import jax
+
+    key = f"{_HB_PREFIX}{jax.process_index()}"
+
+    def _beat():
+        n = 0
+        while True:
+            try:
+                client.key_value_set(key, str(n), allow_overwrite=True)
+            except Exception:
+                return  # client torn down: process is exiting
+            n += 1
+            time.sleep(_HB_INTERVAL)
+
+    _hb_thread = threading.Thread(
+        target=_beat, daemon=True, name="a5gen-heartbeat"
+    )
+    _hb_thread.start()
+
+
+def _stale_peer(client, seen: dict, nprocs: int, self_pid: int,
+                threshold: float) -> Optional[int]:
+    """Return a peer id whose heartbeat has not CHANGED in ``threshold``
+    seconds (None if all alive).  ``seen`` carries (value, last-change
+    monotonic time) across polls; comparing values instead of clocks
+    makes cross-host skew irrelevant.  A peer whose key never appears is
+    stale from the first poll — a process that died before its first
+    beat is exactly as dead."""
+    now = time.monotonic()
+    for p in range(nprocs):
+        if p == self_pid:
+            continue
+        try:
+            v = client.key_value_try_get(f"{_HB_PREFIX}{p}")
+        except Exception:
+            v = None
+        rec = seen.get(p)
+        if rec is None or rec[0] != v:
+            seen[p] = (v, now)
+        elif now - rec[1] > threshold:
+            return p
+    return None
 
 
 def _runtime_already_up() -> bool:
@@ -87,6 +196,7 @@ def initialize(
     """
     import jax
 
+    _dcn_timeout()  # validate the env knob at startup, not at first gather
     explicit = (
         coordinator_address is not None
         or num_processes is not None
@@ -120,7 +230,13 @@ def initialize(
             return 0, 1
     # Only query the topology AFTER distributed init (these calls create
     # the backend and cache its view of the world).
-    return jax.process_index(), jax.process_count()
+    pid, nprocs = jax.process_index(), jax.process_count()
+    if nprocs > 1:
+        # Liveness heartbeat for the pod failure detector (PeerLossError):
+        # beats for the process's whole lifetime, including the sweep, so
+        # a slow stripe is distinguishable from a dead host.
+        _start_heartbeat()
+    return pid, nprocs
 
 
 def host_stripe(n_words: int, num_processes: int, process_id: int
@@ -151,11 +267,96 @@ def stripe_packed(packed: PackedWords, lo: int, hi: int) -> PackedWords:
     )
 
 
-def _allgather(x: np.ndarray) -> np.ndarray:
-    """Process-allgather with a leading process axis."""
+def _dcn_timeout() -> float:
+    """``A5GEN_DCN_TIMEOUT`` as seconds, defaulting (with a stderr
+    warning) on malformed values — a typo must not crash the pod at the
+    END of a sweep, which is when the first collective runs.
+    :func:`initialize` calls this too, so the warning fires at startup."""
+    raw = os.environ.get("A5GEN_DCN_TIMEOUT")
+    if raw is None or raw == "":
+        return _DEFAULT_DCN_TIMEOUT
+    try:
+        return float(raw)
+    except ValueError:
+        import sys
+
+        print(
+            f"a5gen: warning: invalid A5GEN_DCN_TIMEOUT={raw!r} "
+            f"(want seconds); using {_DEFAULT_DCN_TIMEOUT:.0f}",
+            file=sys.stderr,
+        )
+        return _DEFAULT_DCN_TIMEOUT
+
+
+def _allgather(x: np.ndarray, timeout: Optional[float] = None) -> np.ndarray:
+    """Process-allgather with a leading process axis, under a liveness
+    timeout.
+
+    The gather runs on a daemon thread so the caller stays in control of
+    the wait.  While blocked, the caller polls its peers' heartbeats
+    (:func:`_stale_peer`): a peer whose counter froze for longer than
+    ``timeout`` seconds (``A5GEN_DCN_TIMEOUT``, default
+    ``_DEFAULT_DCN_TIMEOUT``; ``<=0`` disables the whole guard) raises
+    :class:`PeerLossError` with resume instructions, while live-but-slow
+    peers keep the wait open indefinitely.  Without a KV client the guard
+    degrades to a plain collective timeout.  The stuck gather thread
+    cannot be cancelled — callers that intend to exit must use
+    ``os._exit`` after reporting (the CLI does)."""
     from jax.experimental import multihost_utils
 
-    return np.asarray(multihost_utils.process_allgather(x))
+    if timeout is None:
+        timeout = _dcn_timeout()
+    if timeout <= 0:
+        return np.asarray(multihost_utils.process_allgather(x))
+
+    result: list = []
+    error: list = []
+
+    def _run():
+        try:
+            result.append(np.asarray(multihost_utils.process_allgather(x)))
+        except Exception as e:  # pragma: no cover - backend-dependent
+            error.append(e)
+
+    th = threading.Thread(target=_run, daemon=True, name="a5gen-allgather")
+    th.start()
+
+    import jax
+
+    client = _kv_client()
+    nprocs, self_pid = jax.process_count(), jax.process_index()
+    seen: dict = {}
+    start = time.monotonic()
+    recovery = (
+        "This host's stripe cursor is checkpointed independently "
+        "(--checkpoint PATH.p<id>); relaunch the pod with the same flags "
+        "to resume all stripes from their last checkpoints — "
+        "already-reported hits are deduped on resume. A5GEN_DCN_TIMEOUT "
+        "adjusts the detection threshold (0 disables)."
+    )
+    while True:
+        th.join(min(_HB_INTERVAL, timeout))
+        if not th.is_alive():
+            break
+        if client is not None:
+            dead = _stale_peer(client, seen, nprocs, self_pid, timeout)
+            if dead is not None:
+                raise PeerLossError(
+                    f"peer process {dead} has not heartbeat for "
+                    f"{timeout:.0f}s while process {self_pid} of {nprocs} "
+                    f"waits in a cross-host all-gather: the peer has died "
+                    f"or stalled mid-sweep. " + recovery
+                )
+        elif time.monotonic() - start > timeout:
+            raise PeerLossError(
+                f"cross-host all-gather did not complete within "
+                f"{timeout:.0f}s (process {self_pid} of {nprocs}, no "
+                f"coordination KV store for heartbeats): a peer process "
+                f"has likely died or stalled mid-sweep. " + recovery
+            )
+    if error:
+        raise error[0]
+    return result[0]
 
 
 def allgather_sum(value: int) -> int:

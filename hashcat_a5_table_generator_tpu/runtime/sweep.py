@@ -301,8 +301,9 @@ class Sweep:
         stride = cfg.resolve_block_stride()
         from ..ops.pallas_expand import opts_for
 
-        # A5GEN_PALLAS=expand + an eligible config swaps the crack step's
-        # expand+hash pair for the fused Pallas kernel (ops.pallas_expand).
+        # On TPU an eligible config swaps the crack step's expand+hash
+        # pair for the fused Pallas kernel by default (ops.pallas_expand;
+        # A5GEN_PALLAS=off opts out).
         fused_opts = opts_for(
             spec, plan, self.ct, block_stride=stride,
             num_blocks=cfg.num_blocks,

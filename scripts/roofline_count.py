@@ -1,0 +1,155 @@
+"""Roofline input: count the fused kernel's per-candidate VPU op budget.
+
+The fused Pallas kernel (`ops/pallas_expand.py`) is straight-line
+elementwise code on (G, S) = (8, 128k) tiles — every traced op is a VPU
+vector instruction processing one op for each lane it covers.  Counting
+the kernel jaxpr's equations, weighted by how many (8, 128) native
+vregs each op's shape spans, therefore gives ops-per-candidate directly:
+
+    ops/candidate = sum(eqn_vregs) / (G * S / 1024 vregs) / lanes-per-vreg
+                  = weighted_eqns * 1024 / (G * S)
+
+(S = block stride; at the headline geometry stride=128, so G*S = one
+vreg and ops/candidate = plain weighted eqn count.)
+
+That number divided into the VPU's per-chip op rate brackets the
+hashes/s ceiling — see PERF.md §7 for the analysis this feeds.
+
+Usage: python scripts/roofline_count.py [--mode default] [--algo md5]
+Runs on CPU (no device needed): only traces, never executes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def count_kernel_ops(jaxpr, g, s):
+    """Weighted eqn count of the pallas kernel jaxpr: each eqn costs
+    ceil(elements / 1024) native (8,128) vregs; ops/candidate normalizes
+    by the tile's own vreg span so sub-tile ops (e.g. (G,1) scalars that
+    still burn a whole vreg) are charged fairly."""
+    tile_vregs = max(1, (g * s) // 1024)
+    total = 0.0
+    by_prim = Counter()
+
+    def walk(jx):
+        nonlocal total
+        for eqn in jx.eqns:
+            # Recurse through call-like wrappers (jnp.where etc. trace as
+            # nested jit eqns) — only leaf primitives are instructions.
+            sub = eqn.params.get("jaxpr")
+            if sub is not None and hasattr(sub, "eqns"):
+                walk(sub)
+                continue
+            if sub is not None and hasattr(getattr(sub, "jaxpr", None),
+                                           "eqns"):
+                walk(sub.jaxpr)
+                continue
+            outs = eqn.outvars
+            elems = max(
+                int(np.prod(v.aval.shape)) if v.aval.shape else 1
+                for v in outs
+            )
+            vregs = max(1, -(-elems // 1024))
+            w = vregs / tile_vregs
+            total += w
+            by_prim[eqn.primitive.name] += w
+
+    walk(jaxpr)
+    return total, by_prim
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mode", default="default")
+    ap.add_argument("--algo", default="md5")
+    ap.add_argument("--stride", type=int, default=128)
+    ap.add_argument("--words", type=int, default=256)
+    args = ap.parse_args()
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        AttackSpec,
+        build_plan,
+    )
+    from hashcat_a5_table_generator_tpu.ops import pallas_expand as pe
+    from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
+    from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    from bench import synth_wordlist
+
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    packed = pack_words(synth_wordlist(args.words))
+    plan = build_plan(spec, ct, packed)
+    k = pe.k_opts_for(plan)
+    nb = 16
+    stride = args.stride
+    batch, _, _ = make_blocks(
+        plan, start_word=0, start_rank=0, max_variants=nb * stride,
+        max_blocks=nb, fixed_stride=stride,
+    )
+    batch = pad_batch(batch, nb)
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        block_arrays,
+        plan_arrays,
+        table_arrays,
+    )
+
+    p, t, b = plan_arrays(plan), table_arrays(ct), block_arrays(batch, num_blocks=nb)
+
+    if args.mode in ("default", "reverse"):
+        fn = lambda: pe.fused_expand_md5(  # noqa: E731
+            p["tokens"], p["lengths"], p["match_pos"], p["match_len"],
+            p["match_radix"], p["match_val_start"],
+            t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"],
+            num_lanes=nb * stride, out_width=int(plan.out_width),
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute,
+            block_stride=stride, k_opts=k, algo=args.algo, interpret=True,
+        )
+    else:
+        raise SystemExit("suball counting not wired; use --mode default")
+
+    jpr = jax.make_jaxpr(fn)()
+    # Find the pallas_call eqn and pull its inner kernel jaxpr.
+    inner = None
+    for eqn in jpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            inner = eqn.params["jaxpr"]
+            break
+    assert inner is not None, "no pallas_call in trace"
+    g = pe._G
+    ops, by_prim = count_kernel_ops(inner, g, stride)
+    print(f"mode={args.mode} algo={args.algo} stride={stride} "
+          f"slots={plan.num_slots} tokens={plan.tokens.shape[1]} K={k}")
+    print(f"kernel vector ops per candidate: {ops:.0f}")
+    for name, w in by_prim.most_common(12):
+        print(f"  {name:>22}: {w:8.1f}")
+    for rate, label in ((1.0e12, "1 op/ALU/cycle (conservative)"),
+                        (2.0e12, "2-issue"), (4.0e12, "4-issue VLIW")):
+        print(f"ceiling @ VPU {rate:.0e} ops/s ({label}): "
+              f"{rate / ops:.2e} hashes/s")
+
+
+if __name__ == "__main__":
+    main()

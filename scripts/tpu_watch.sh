@@ -1,0 +1,21 @@
+#!/bin/bash
+# Poll for TPU availability; write status to /tmp/tpu_status when it comes up.
+while true; do
+  timeout 90 python - <<'PY' > /tmp/tpu_probe.out 2>&1
+import jax
+ds = jax.devices()
+print("OK", jax.default_backend(), [str(d) for d in ds])
+PY
+  if grep -q '^OK' /tmp/tpu_probe.out 2>/dev/null; then
+    if grep -q 'cpu' /tmp/tpu_probe.out && ! grep -qiE 'tpu|axon' /tmp/tpu_probe.out; then
+      echo "$(date -u +%H:%M:%S) cpu-only: $(cat /tmp/tpu_probe.out)" >> /tmp/tpu_watch.log
+    else
+      cp /tmp/tpu_probe.out /tmp/tpu_status
+      echo "$(date -u +%H:%M:%S) UP: $(cat /tmp/tpu_probe.out)" >> /tmp/tpu_watch.log
+      exit 0
+    fi
+  else
+    echo "$(date -u +%H:%M:%S) down: $(tail -1 /tmp/tpu_probe.out 2>/dev/null)" >> /tmp/tpu_watch.log
+  fi
+  sleep 60
+done

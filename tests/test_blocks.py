@@ -142,3 +142,71 @@ def test_huge_word_routes_to_scalar_path():
     assert int(batch.count.sum()) == 256
     assert (batch.word == 0).all()
     assert rank == 256
+
+
+def test_zero_block_budget_preserves_cursor():
+    """Advisor r4: a zero block budget (max_variants < stride, or
+    max_blocks == 0) with unfinished words must return the incoming
+    cursor, not 'sweep complete' — on both cutter paths."""
+    for plan in _plans():
+        for kwargs in (
+            dict(max_variants=0, max_blocks=4),       # budget < stride
+            dict(max_variants=256, max_blocks=0),     # no blocks allowed
+        ):
+            batch, w, rank = make_blocks(
+                plan, start_word=0, start_rank=0, fixed_stride=4, **kwargs
+            )
+            assert batch.total == 0
+            assert (w, rank) != (plan.batch, 0)
+            # The cursor may lazily normalize past fallback/empty words but
+            # must still point at unswept keyspace.
+            assert w < plan.batch
+            assert rank < plan.n_variants[w]
+
+        # Mid-sweep: advance one window, then hit a zero budget.
+        _, w1, r1 = make_blocks(
+            plan, start_word=0, start_rank=0, max_variants=8,
+            max_blocks=2, fixed_stride=4,
+        )
+        if w1 >= plan.batch:
+            continue
+        batch, w2, r2 = make_blocks(
+            plan, start_word=w1, start_rank=r1, max_variants=0,
+            max_blocks=2, fixed_stride=4,
+        )
+        assert batch.total == 0 and (w2, r2) == (w1, r1)
+
+
+def test_huge_word_mid_list_fast_scalar_agree(monkeypatch):
+    """A huge word BETWEEN normal words: windows that touch it must fall
+    back to the scalar cutter and stay block-for-block identical to a
+    forced-scalar sweep (huge words get width 1 in the cumulative index)."""
+
+    class MixedPlan:
+        batch = 3
+        num_slots = 64
+        n_variants = (96, 1 << 64, 40)
+        fallback = np.zeros(3, dtype=bool)
+        pat_radix = np.full((3, 64), 2, dtype=np.int32)
+        windowed = False
+
+    def cut(force_scalar, n_calls=4):
+        if force_scalar:
+            monkeypatch.setattr(
+                blocks_mod, "_make_blocks_stride_fast",
+                lambda *a, **k: None,
+            )
+        out, w, rank = [], 0, 0
+        for _ in range(n_calls):
+            batch, w, rank = make_blocks(
+                MixedPlan(), start_word=w, start_rank=rank,
+                max_variants=128, max_blocks=4, fixed_stride=32,
+            )
+            out.append((
+                batch.word.tolist(), batch.base_digits.tolist(),
+                batch.count.tolist(), batch.offset.tolist(), w, rank,
+            ))
+        monkeypatch.undo()
+        return out
+
+    assert cut(False) == cut(True)

@@ -149,19 +149,31 @@ def test_opts_for_gates(monkeypatch):
     spec = AttackSpec(mode="default", algo="md5")
     ct, plan = _arrays(spec)
     monkeypatch.delenv("A5GEN_PALLAS", raising=False)
+    # CPU CI: the platform gate must keep the kernel off even though the
+    # kernel is default-on (env unset)...
     assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) is None
-    monkeypatch.setenv("A5GEN_PALLAS", "expand")
-    # CPU CI: the platform gate must keep the kernel off...
-    assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) is None
-    # ...and with a (faked) TPU device the full gate opens.
+
+    # ...and with a (faked) TPU device the full gate opens by default.
     class _Dev:
         platform = "tpu"
 
     monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
     assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) == 2
+    # The env var is an opt-OUT now ("expand" still force-opts in; "1"
+    # selects the hash-only kernel, which also opts this one out).
+    for off in ("off", "0", "xla", "none", "1"):
+        monkeypatch.setenv("A5GEN_PALLAS", off)
+        assert opts_for(spec, plan, ct,
+                        block_stride=128, num_blocks=16) is None
+    monkeypatch.setenv("A5GEN_PALLAS", "expand")
+    assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) == 2
     # Ineligible shapes stay off.
     assert opts_for(spec, plan, ct, block_stride=64, num_blocks=16) is None
     assert opts_for(spec, plan, ct, block_stride=None, num_blocks=16) is None
+    # The pure-config gate ignores the env entirely.
+    monkeypatch.setenv("A5GEN_PALLAS", "off")
+    assert pe.opts_for_config(spec, plan, ct, block_stride=128,
+                              num_blocks=16, require_tpu=False) == 2
 
 
 def test_eligible_bounds():
