@@ -97,6 +97,9 @@ def eligible(
         and 1 <= token_width <= _MAX_TOKENS
         and 1 <= max_val_len <= 4
         and 1 <= max_options <= _MAX_OPTIONS
+        # Since the per-position segment resolution moved to an XLA
+        # precompute, the kernel's cost no longer scales with segment
+        # count; the cap now only bounds the [NB, GS, L] precompute.
         and num_segments <= _MAX_SEGMENTS
     )
 
@@ -207,6 +210,27 @@ def _exact_div(r, rs):
     q = q - (q * rs > r).astype(_I32)
     q = q + ((q + 1) * rs <= r).astype(_I32)
     return q
+
+
+def _decode_tile_radix2(rank, base, radix, m, g, s):
+    """Mixed-radix decode specialized to radices <= 2 (K=1 tables — every
+    shipped 1:1 layout map): active slots' digits are successive BITS of
+    the rank, so the f32 divide chain collapses to shift/mask + a binary
+    carry (PERF.md §7 lever 2).  Exactly equivalent to
+    :func:`_decode_tile` for radix-1/2 slots (radix-1 slots emit digit 0
+    and pass the carry through, matching the general ge-fixup)."""
+    digits = []
+    carry = jnp.zeros((g, s), _I32)
+    nbits = jnp.zeros((g, 1), _I32)
+    for sl in range(m):
+        active_b = radix[:, sl][:, None] > 1
+        active = active_b.astype(_I32)
+        bit = (rank >> nbits) & 1
+        t = base[:, sl][:, None] + bit * active + carry
+        digits.append(jnp.where(active_b, t & 1, 0))
+        carry = jnp.where(active_b, t >> 1, carry)
+        nbits = nbits + active
+    return digits
 
 
 def _decode_tile(rank, base, radix, m, g, s):
@@ -447,8 +471,9 @@ def _make_kernel(
     """Build the per-step kernel body (fully unrolled straight-line trace).
 
     Ref shapes per grid step (all VMEM):
-      tok[G, L] i32, wlen[G, 1] i32, pos[G, M] i32, mlen[G, M] i32,
-      radix[G, M] i32, base[G, M] i32, count[G, 1] i32,
+      tok[G, L] i32, wlen[G, 1] i32, radix[G, M] i32, base[G, M] i32,
+      count[G, 1] i32, inside[G, M, L] i32 0/1 (byte j inside slot sl's
+      match span), start[G, M, L] i32 0/1 (byte j starts it),
       vopt[G, M, K] u32 (value bytes little-endian-packed), vlen[G, M, K] i32
     Outputs: state[G, KS, S] u32 (hash state words, KS = DIGEST_WORDS[algo]),
     emit[G, S] i32.
@@ -458,16 +483,18 @@ def _make_kernel(
     # length words.
     assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
 
-    def kernel(tok, wlen, pos, mlen, radix, base, count, vopt, vlen,
-               state_ref, emit_ref):
+    def kernel(tok, wlen, radix, base, count, inside, start,
+               vopt, vlen, state_ref, emit_ref):
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
 
-        digits = _decode_tile(rank, base, radix, m, g, s)
+        decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
+        digits = decode(rank, base, radix, m, g, s)
         chosen = [d > 0 for d in digits]
+        chosen_i = [c.astype(_I32) for c in chosen]
         chosen_count = jnp.zeros((g, s), _I32)
-        for c in chosen:
-            chosen_count = chosen_count + c.astype(_I32)
+        for c in chosen_i:
+            chosen_count = chosen_count + c
 
         # --- per-slot selected value word/length (K-way compare select) --
         val_w = []
@@ -476,13 +503,19 @@ def _make_kernel(
             vw = jnp.zeros((g, s), _U32)
             vl = jnp.zeros((g, s), _I32)
             for k in range(k_opts):
-                sel = digits[sl] == (k + 1)
+                # K=1: digit 1 is the only option — `chosen` IS the select.
+                sel = chosen[sl] if k_opts == 1 else digits[sl] == (k + 1)
                 vw = jnp.where(sel, vopt[:, sl, k][:, None], vw)
                 vl = jnp.where(sel, vlen[:, sl, k][:, None], vl)
             val_w.append(vw)
             val_l.append(vl)
 
         # --- unit scheme over original byte positions (splice-compare) ---
+        # Match GEOMETRY is block-uniform: whether byte j is inside /
+        # starts slot sl's span depends only on the block's (pos, mlen),
+        # so the span compares are precomputed in XLA (`inside`/`start`
+        # refs, [G, M, L] 0/1) and the per-lane work here is just
+        # chosen-AND + accumulate (PERF.md §7 lever 1).
         clash = jnp.zeros((g, s), jnp.bool_)
         cum = jnp.zeros((g, s), _I32)
         unit_start = []
@@ -494,14 +527,13 @@ def _make_kernel(
             svw = jnp.zeros((g, s), _U32)
             svl = jnp.zeros((g, s), _I32)
             for sl in range(m):
-                p_s = pos[:, sl][:, None]
-                e_s = p_s + mlen[:, sl][:, None]
-                inside = chosen[sl] & (j >= p_s) & (j < e_s)
-                cover = cover + inside.astype(_I32)
-                at_start = chosen[sl] & (j == p_s)
-                started = started + at_start.astype(_I32)
-                svw = jnp.where(at_start, val_w[sl], svw)
-                svl = jnp.where(at_start, val_l[sl], svl)
+                ins = inside[:, sl, j][:, None]
+                cover = cover + chosen_i[sl] * ins
+                at_start = chosen_i[sl] * start[:, sl, j][:, None]
+                started = started | at_start
+                at_b = at_start > 0
+                svw = jnp.where(at_b, val_w[sl], svw)
+                svl = jnp.where(at_b, val_l[sl], svl)
             clash = clash | (cover > 1)
             in_word = j < wlen[:, 0][:, None]
             is_start = started > 0
@@ -645,6 +677,13 @@ def fused_expand_md5(
     vopt_b, vlen_b = _pack_val_options(
         val_bytes, val_len, match_val_start[blk_word], k_opts
     )
+    # Block-uniform span masks ([NB, M, L] 0/1): byte j inside / starting
+    # slot sl's match span — hoists the kernel's per-(byte, slot) span
+    # compares out to XLA (PERF.md §7 lever 1).
+    jj = jnp.arange(length_axis, dtype=jnp.int32)[None, None, :]
+    ps = pos_b[:, :, None]
+    inside_b = ((jj >= ps) & (jj < ps + mlen_b[:, :, None])).astype(_I32)
+    start_b = (jj == ps).astype(_I32)
 
     kernel = _make_kernel(
         g=_G, s=block_stride, m=m, length_axis=length_axis, k_opts=k_opts,
@@ -653,15 +692,15 @@ def fused_expand_md5(
     )
     return _launch_fused(
         kernel,
-        (tok_b, wlen_b, pos_b, mlen_b, radix_b, blk_base, count_b,
-         vopt_b, vlen_b),
+        (tok_b, wlen_b, radix_b, blk_base, count_b,
+         inside_b, start_b, vopt_b, vlen_b),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
         n_state=DIGEST_WORDS[algo], interpret=interpret,
     )
 
 
 def _make_suball_kernel(
-    *, g: int, s: int, p: int, num_segments: int, length_axis: int,
+    *, g: int, s: int, p: int, length_axis: int,
     k_opts: int, out_width: int, min_substitute: int, max_substitute: int,
     algo: str = "md5",
 ):
@@ -677,18 +716,20 @@ def _make_suball_kernel(
     hazard words never reach the device).
 
     Ref shapes per grid step: tok[G, L] i32, wlen[G, 1] i32,
-    pradix[G, P] i32, base[G, P] i32, count[G, 1] i32, sstart[G, GS] i32,
-    slen[G, GS] i32, spat[G, GS] i32, vopt[G, P, K] u32, vlen[G, P, K] i32.
+    pradix[G, P] i32, base[G, P] i32, count[G, 1] i32, slotat[G, L] i32
+    (pattern slot owning byte j, -1 free), startat[G, L] i32 (its span
+    start), vopt[G, P, K] u32, vlen[G, P, K] i32.
     Outputs: state[G, KS, S] u32 (KS = DIGEST_WORDS[algo]), emit[G, S] i32.
     """
     assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
 
-    def kernel(tok, wlen, pradix, base, count, sstart, slen, spat,
+    def kernel(tok, wlen, pradix, base, count, slotat, startat,
                vopt, vlen, state_ref, emit_ref):
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
 
-        digits = _decode_tile(rank, base, pradix, p, g, s)
+        decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
+        digits = decode(rank, base, pradix, p, g, s)
         chosen_count = jnp.zeros((g, s), _I32)
         for sl in range(p):
             active = pradix[:, sl][:, None] > 1
@@ -703,28 +744,26 @@ def _make_suball_kernel(
             vw = jnp.zeros((g, s), _U32)
             vl = jnp.zeros((g, s), _I32)
             for k in range(k_opts):
-                sel = digits[sl] == (k + 1)
+                # K=1: digit 1 is the only option (radix-1 slots always
+                # decode 0, so `> 0` is safe for padded slots too).
+                sel = (digits[sl] > 0 if k_opts == 1
+                       else digits[sl] == (k + 1))
                 vw = jnp.where(sel, vopt[:, sl, k][:, None], vw)
                 vl = jnp.where(sel, vlen[:, sl, k][:, None], vl)
             val_w.append(vw)
             val_l.append(vl)
 
-        # Per-position segment resolution — block-level (G, 1) selects.
+        # Per-position segment resolution: block-uniform, so the whole
+        # (position, segment) scan is precomputed in XLA — ``slotat`` /
+        # ``startat`` [G, L] give the pattern slot owning byte j (-1 free)
+        # and its span start (PERF.md §7 lever 1).
         unit_start = []
         unit_len = []
         unit_word = []
         cum = jnp.zeros((g, s), _I32)
         for j in range(length_axis):
-            slot_at_j = jnp.full((g, 1), -1, _I32)
-            start_at_j = jnp.zeros((g, 1), _I32)
-            for gi in range(num_segments):
-                st = sstart[:, gi][:, None]
-                ln = slen[:, gi][:, None]
-                inside = (ln > 0) & (j >= st) & (j < st + ln)
-                slot_at_j = jnp.where(
-                    inside, spat[:, gi][:, None], slot_at_j
-                )
-                start_at_j = jnp.where(inside, st, start_at_j)
+            slot_at_j = slotat[:, j][:, None]
+            start_at_j = startat[:, j][:, None]
             # Lane-level: the digit / value of the slot owning position j.
             digit_at_j = jnp.zeros((g, s), _I32)
             vw_at_j = jnp.zeros((g, s), _U32)
@@ -808,17 +847,32 @@ def fused_expand_suball_md5(
     vopt_b, vlen_b = _pack_val_options(
         val_bytes, val_len, pat_val_start[blk_word], k_opts
     )
+    # Precompute the per-position segment resolution in XLA (segments are
+    # disjoint, block-uniform): slotat[NB, L] = pattern slot owning byte
+    # j (-1 free), startat[NB, L] = that segment's span start.
+    if gs:
+        jj = jnp.arange(length_axis, dtype=jnp.int32)[None, None, :]
+        st3 = sstart_b[:, :, None]
+        covered = (
+            (slen_b[:, :, None] > 0) & (jj >= st3)
+            & (jj < st3 + slen_b[:, :, None])
+        )  # [NB, GS, L]
+        slotat_b = jnp.where(covered, spat_b[:, :, None], -1).max(axis=1)
+        startat_b = jnp.where(covered, st3, 0).max(axis=1)
+    else:  # no segments: every byte passes through
+        slotat_b = jnp.full((nb, length_axis), -1, jnp.int32)
+        startat_b = jnp.zeros((nb, length_axis), jnp.int32)
 
     kernel = _make_suball_kernel(
-        g=_G, s=block_stride, p=p, num_segments=gs,
+        g=_G, s=block_stride, p=p,
         length_axis=length_axis, k_opts=k_opts, out_width=out_width,
         min_substitute=min_substitute, max_substitute=max_substitute,
         algo=algo,
     )
     return _launch_fused(
         kernel,
-        (tok_b, wlen_b, pradix_b, blk_base, count_b, sstart_b, slen_b,
-         spat_b, vopt_b, vlen_b),
+        (tok_b, wlen_b, pradix_b, blk_base, count_b, slotat_b, startat_b,
+         vopt_b, vlen_b),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
         n_state=DIGEST_WORDS[algo], interpret=interpret,
     )

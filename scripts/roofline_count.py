@@ -116,19 +116,26 @@ def main():
 
     p, t, b = plan_arrays(plan), table_arrays(ct), block_arrays(batch, num_blocks=nb)
 
+    common = dict(
+        num_lanes=nb * stride, out_width=int(plan.out_width),
+        min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute,
+        block_stride=stride, k_opts=k, algo=args.algo, interpret=True,
+    )
     if args.mode in ("default", "reverse"):
         fn = lambda: pe.fused_expand_md5(  # noqa: E731
             p["tokens"], p["lengths"], p["match_pos"], p["match_len"],
             p["match_radix"], p["match_val_start"],
             t["val_bytes"], t["val_len"],
-            b["word"], b["base"], b["count"],
-            num_lanes=nb * stride, out_width=int(plan.out_width),
-            min_substitute=spec.effective_min,
-            max_substitute=spec.max_substitute,
-            block_stride=stride, k_opts=k, algo=args.algo, interpret=True,
+            b["word"], b["base"], b["count"], **common,
         )
     else:
-        raise SystemExit("suball counting not wired; use --mode default")
+        fn = lambda: pe.fused_expand_suball_md5(  # noqa: E731
+            p["tokens"], p["lengths"], p["pat_radix"], p["pat_val_start"],
+            p["seg_orig_start"], p["seg_orig_len"], p["seg_pat"],
+            t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"], **common,
+        )
 
     jpr = jax.make_jaxpr(fn)()
     # Find the pallas_call eqn and pull its inner kernel jaxpr.

@@ -1,0 +1,61 @@
+#!/bin/bash
+# Round-5 TPU measurement session (run the moment the tunnel recovers).
+# Produces, under $OUT:
+#   bench_headline.json  - bench.py default MD5, both arms (xla vs pallas)
+#   bench_suball.json    - bench.py -s substitute-all, both arms
+#   bench_sha1.json      - bench.py sha1, both arms
+#   probe_fused.txt      - production-body A/B with planted-hit cross-check
+#   sweep_cli.txt        - sustained production CLI crack sweep throughput
+# Each step is individually time-capped; a re-wedged tunnel fails the step,
+# not the session.
+set -u
+OUT=${OUT:-/tmp/tpu_session_r5}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date -u +%H:%M:%S)) ===" | tee -a "$OUT/log"
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  echo "rc=$? $name" | tee -a "$OUT/log"
+  tail -3 "$OUT/$name.err" >> "$OUT/log" 2>/dev/null
+}
+
+# 1. Production-body A/B with planted-hit correctness cross-check (2^22).
+run probe_fused 900 python scripts/probe_fused.py
+
+# 2. Headline bench, both arms, long window.
+run bench_headline 700 python bench.py --wall-budget 600 --seconds 10
+
+# 3. Substitute-all flagship (BASELINE configs[3] analog).
+run bench_suball 700 python bench.py --wall-budget 600 --seconds 10 --mode suball
+
+# 4. Second algo (BASELINE configs[4] analog).
+run bench_sha1 700 python bench.py --wall-budget 600 --seconds 10 --algo sha1
+
+# 5. Sustained production CLI crack sweep (VERDICT r4 #4): synthetic
+#    rockyou-class dictionary, qwerty-cyrillic, MD5 digests, device backend.
+OUT="$OUT" python - <<'EOF'
+import hashlib, os, sys
+sys.path.insert(0, ".")
+from bench import synth_wordlist
+out = os.environ["OUT"]
+words = synth_wordlist(200000)
+os.makedirs(out, exist_ok=True)
+with open(os.path.join(out, "dict.txt"), "wb") as f:
+    f.write(b"\n".join(words) + b"\n")
+with open(os.path.join(out, "digests.txt"), "w") as f:
+    for i in (0, 1000, 100000):
+        f.write(hashlib.md5(words[i]).hexdigest() + "\n")
+EOF
+run emit_table 120 python -m hashcat_a5_table_generator_tpu \
+    --emit-table qwerty-cyrillic --output "$OUT/qc.table" /dev/null
+run sweep_cli 900 python -m hashcat_a5_table_generator_tpu \
+    "$OUT/dict.txt" -t "$OUT/qc.table" --backend device \
+    --digests "$OUT/digests.txt" --progress
+
+echo "=== session done ($(date -u +%H:%M:%S)) ===" | tee -a "$OUT/log"
+for f in probe_fused bench_headline bench_suball bench_sha1; do
+  echo "--- $f"; tail -2 "$OUT/$f.out" 2>/dev/null
+done
+grep -E "hits|candidates hashed" "$OUT/sweep_cli.err" 2>/dev/null | tail -2
