@@ -241,28 +241,110 @@ def _mode(args) -> str:
     return "reverse" if args.reverse_sub else "default"
 
 
-def _read_digests(path: str, algo: str) -> List[bytes]:
+_HEX_LUT = None
+
+
+def _parse_digest_blob(data: bytes, want: int, path: str) -> "list | None":
+    """Vectorized left-list parse: the whole file as one numpy pass.
+
+    Hashmob-scale left lists run to tens of millions of lines; the
+    per-line ``fromhex`` loop costs minutes there, this path seconds.
+    Returns None — caller falls back to the exact per-line loop — on
+    inputs the vector path doesn't model (leading whitespace) AND on any
+    malformed line, so error messages always come from the loop and
+    match it exactly."""
+    import numpy as np
+
+    global _HEX_LUT
+    if _HEX_LUT is None:
+        lut = np.full(256, 255, dtype=np.uint8)
+        for i in range(10):
+            lut[ord("0") + i] = i
+        for i in range(6):
+            lut[ord("a") + i] = 10 + i
+            lut[ord("A") + i] = 10 + i
+        _HEX_LUT = lut
+
+    if not data:
+        return []
+    if not data.endswith(b"\n"):
+        data += b"\n"  # one whole-blob copy, only for newline-less tails
+    arr = np.frombuffer(data, dtype=np.uint8)
+    nl = np.flatnonzero(arr == 10)
+    starts = np.concatenate(([0], nl[:-1] + 1)).astype(np.int64)
+    ends = nl
+    # Strip one trailing \r (CRLF files).
+    lens = ends - starts
+    has_cr = (lens > 0) & (arr[np.maximum(ends - 1, 0)] == 13)
+    lens = lens - has_cr
+    first = arr[np.minimum(starts, arr.shape[0] - 1)]
+    nonblank = lens > 0
+    if bool((nonblank & ((first == 32) | (first == 9))).any()):
+        return None  # leading whitespace: slow path owns full strip()
+    keep = nonblank & (first != ord("#"))
+    ks, kl = starts[keep], lens[keep]
+    if ks.shape[0] == 0:
+        return []
+    # The digest is the first field: exactly 2*want hex chars, then end
+    # of line or ':'.
+    sep_pos = np.minimum(ks + 2 * want, arr.shape[0] - 1)
+    bad = (kl < 2 * want) | ((kl > 2 * want) & (arr[sep_pos] != ord(":")))
+    if int(ks[-1]) + 2 * want > arr.shape[0]:
+        return None  # short final line: the loop reports it exactly
+    # No per-element clamp: the scalar bound check above covers the only
+    # line that could overrun (ks is ascending); int32 offsets while the
+    # file fits (hashmob-scale lists can exceed 2 GiB — then int64).
+    off_t = np.int32 if arr.shape[0] < (1 << 31) else np.int64
+    if bool(bad.any()):
+        return None  # malformed line somewhere: loop raises the exact error
+    # Decode in bounded chunks: the [C, 2*want] gather/index intermediates
+    # cost ~70x the digest width per row, which at hashmob scale (50M+
+    # lines) would otherwise peak several GiB above the output matrix.
+    n = ks.shape[0]
+    mat = np.empty((n, want), dtype=np.uint8)
+    chunk = 1 << 20
+    rng = np.arange(2 * want, dtype=off_t)
+    for lo in range(0, n, chunk):
+        sub = ks[lo:lo + chunk].astype(off_t)[:, None] + rng
+        nib = _HEX_LUT[arr[sub]]
+        if bool((nib == 255).any()):
+            return None  # bad hex: loop raises the exact error
+        mat[lo:lo + chunk] = (nib[:, 0::2] << 4) | nib[:, 1::2]
+    return mat
+
+
+def _read_digests(path: str, algo: str):
+    """Load a digest left-list: returns an ``[N, digest_bytes] uint8``
+    matrix (vectorized fast path) or a ``List[bytes]`` (fallback) — both
+    accepted by the sweep and :func:`ops.membership.build_digest_set`."""
     want = DIGEST_BYTES[algo]
-    out: List[bytes] = []
     with open(path, "rb") as fh:
-        for ln, raw in enumerate(fh, 1):
-            line = raw.strip()
-            if not line or line.startswith(b"#"):
-                continue
-            # hashcat-style lines may carry :salt/:plain suffixes; the
-            # digest is the first field.
-            field = line.split(b":", 1)[0]
-            try:
-                dig = bytes.fromhex(field.decode("ascii"))
-            except (UnicodeDecodeError, ValueError) as e:
-                raise SystemExit(
-                    f"{path}:{ln}: not a hex digest: {field[:40]!r} ({e})"
-                )
-            if len(dig) != want:
-                raise SystemExit(
-                    f"{path}:{ln}: {len(dig)}-byte digest, {algo} needs {want}"
-                )
-            out.append(dig)
+        data = fh.read()
+    fast = _parse_digest_blob(data, want, path)
+    if fast is not None:
+        return fast
+    out: List[bytes] = []
+    # split(b"\n"), not splitlines(): file iteration splits on \n only (a
+    # lone \r is line CONTENT — e.g. a CR-separated file is one long bad
+    # line), and the vector path above models the same rule.
+    for ln, raw in enumerate(data.split(b"\n"), 1):
+        line = raw.strip()
+        if not line or line.startswith(b"#"):
+            continue
+        # hashcat-style lines may carry :salt/:plain suffixes; the
+        # digest is the first field.
+        field = line.split(b":", 1)[0]
+        try:
+            dig = bytes.fromhex(field.decode("ascii"))
+        except (UnicodeDecodeError, ValueError) as e:
+            raise SystemExit(
+                f"{path}:{ln}: not a hex digest: {field[:40]!r} ({e})"
+            )
+        if len(dig) != want:
+            raise SystemExit(
+                f"{path}:{ln}: {len(dig)}-byte digest, {algo} needs {want}"
+            )
+        out.append(dig)
     return out
 
 
@@ -288,10 +370,12 @@ def _run_oracle(args, sub_map, words) -> int:
     DFS order within each word (Q9)."""
     from .runtime.sinks import CandidateWriter, potfile_line
 
+    from .ops.membership import HostDigestLookup
+
     mode = _mode(args)
     crack = args.digests is not None
-    digest_set = (
-        set(_read_digests(args.digests, args.algo)) if crack else set()
+    digest_set = HostDigestLookup(
+        _read_digests(args.digests, args.algo) if crack else ()
     )
     host_digest = HOST_DIGEST[args.algo]
     n_hits = 0

@@ -32,7 +32,34 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hashes import digest_to_words
+from .hashes import BIG_ENDIAN_DIGEST, DIGEST_WORDS, digest_to_words
+
+
+def _bulk_rows(digests, algo: str, k: int) -> "np.ndarray | None":
+    """Vectorized digest->uint32-row conversion for the common case — a
+    uniform list of raw ``bytes`` (or an ``[N, 4k] uint8`` matrix from the
+    CLI's vectorized left-list parser).  Hashmob-scale lists (tens of
+    millions of digests) make the per-item ``digest_to_words`` loop a
+    minutes-long startup cost; one join + frombuffer is ~50x faster.
+    Returns None when the input needs the per-item path."""
+    order = ">u4" if BIG_ENDIAN_DIGEST[algo] else "<u4"
+    if isinstance(digests, np.ndarray):
+        if digests.ndim != 2 or digests.dtype != np.uint8 \
+                or digests.shape[1] != 4 * k:
+            return None
+        return (
+            np.ascontiguousarray(digests).reshape(-1).view(order)
+            .astype(np.uint32).reshape(-1, k)
+        )
+    if not digests:
+        return np.zeros((0, k), dtype=np.uint32)
+    width = 4 * k
+    if not all(type(d) is bytes and len(d) == width for d in digests):
+        return None
+    blob = b"".join(digests)
+    return (
+        np.frombuffer(blob, dtype=order).astype(np.uint32).reshape(-1, k)
+    )
 
 _U32 = jnp.uint32
 
@@ -63,9 +90,10 @@ def build_digest_set(
 ) -> DigestSet:
     """Compile raw/hex digests into a :class:`DigestSet`.
 
-    Accepts raw ``bytes`` or hex strings (hashcat left-list lines). Duplicate
-    digests are collapsed — membership is a set question, multiplicity lives
-    on the candidate side (Q7).
+    Accepts raw ``bytes``, hex strings (hashcat left-list lines), or an
+    ``[N, digest_bytes] uint8`` matrix (the CLI's vectorized parser).
+    Duplicate digests are collapsed — membership is a set question,
+    multiplicity lives on the candidate side (Q7).
 
     ``bitmap_bits=None`` sizes the prefilter to the digest count:
     ``ceil(log2 D) + 10`` bits (≈0.1% false-positive density), clamped to
@@ -74,7 +102,8 @@ def build_digest_set(
     8 KiB, 2^20 = 128 KiB) instead of the fixed 2 MiB HBM-resident table,
     so every lane's stage-1 probe stops paying an HBM random-gather.
     """
-    digests = list(digests)
+    if not isinstance(digests, np.ndarray):
+        digests = list(digests)
     if bitmap_bits is None:
         import math
 
@@ -84,20 +113,77 @@ def build_digest_set(
         )
     if bitmap_bits < 5:
         raise ValueError("bitmap_bits must be >= 5 (one uint32 word)")
-    parsed = [digest_to_words(d, algo) for d in digests]
-    k = {"md5": 4, "md4": 4, "ntlm": 4, "sha1": 5}[algo]
-    if not parsed:
-        rows = np.zeros((0, k), dtype=np.uint32)
-    else:
-        # np.unique(axis=0) returns rows in lexicographic order, first column
-        # most significant — exactly the device search's comparison order.
-        rows = np.unique(np.stack(parsed).astype(np.uint32), axis=0)
+    k = DIGEST_WORDS[algo]
+    rows = _bulk_rows(digests, algo, k)
+    if rows is None:
+        # Per-item path: hex strings, mixed representations, odd widths.
+        parsed = [digest_to_words(d, algo) for d in digests]
+        if not parsed:
+            rows = np.zeros((0, k), dtype=np.uint32)
+        else:
+            rows = np.stack(parsed).astype(np.uint32)
+    # np.unique(axis=0) returns rows in lexicographic order, first column
+    # most significant — exactly the device search's comparison order.
+    if rows.shape[0]:
+        rows = np.unique(rows, axis=0)
 
     bitmap = np.zeros((max(1, (1 << bitmap_bits) // 32),), dtype=np.uint32)
     if rows.shape[0]:
         idx = rows[:, 0] & np.uint32((1 << bitmap_bits) - 1)
         np.bitwise_or.at(bitmap, idx >> 5, np.uint32(1) << (idx & 31))
     return DigestSet(rows=rows, bitmap=bitmap, bitmap_bits=bitmap_bits, algo=algo)
+
+
+class HostDigestLookup:
+    """Host-side digest membership + the canonical sorted byte blob, over
+    EITHER digest form — a list of raw ``bytes`` or an ``[N, W] uint8``
+    matrix (the CLI's vectorized left-list parser).
+
+    One object, one sort: the sweep fingerprint (``sorted_blob`` — the
+    concatenation of the digests in ascending byte order, identical for
+    both forms) and per-hit host membership (``in``) share it, so the
+    matrix/list duality lives HERE and nowhere else.  Matrix form keeps a
+    sorted void view (binary search, no Python set of tens of millions of
+    bytes objects); list form keeps the plain set.
+    """
+
+    def __init__(self, digests):
+        if isinstance(digests, np.ndarray) and digests.ndim == 2:
+            a = np.ascontiguousarray(digests)
+            self._width = int(a.shape[1])
+            self._rows = np.sort(a.view(f"V{self._width}")[:, 0])
+            self._set = None
+            self._sorted_list = None
+        else:
+            lst = list(digests)
+            self._rows = None
+            self._set = set(lst)
+            self._sorted_list = sorted(lst)
+            self._width = len(lst[0]) if lst else 0
+
+    def __len__(self) -> int:
+        return (
+            int(self._rows.shape[0]) if self._rows is not None
+            else len(self._sorted_list)
+        )
+
+    def __contains__(self, dig: bytes) -> bool:
+        if self._set is not None:
+            return dig in self._set
+        rows = self._rows
+        if not rows.shape[0] or len(dig) != self._width:
+            return False
+        probe = np.frombuffer(dig, dtype=rows.dtype)[0]
+        i = int(np.searchsorted(rows, probe))
+        return i < rows.shape[0] and bool(rows[i] == probe)
+
+    def sorted_blob(self) -> bytes:
+        """Digests concatenated in ascending byte order — the fingerprint
+        stream; void-row sort == ``sorted(list_of_bytes)``, so both forms
+        of the same set produce identical bytes."""
+        if self._rows is not None:
+            return self._rows.tobytes()
+        return b"".join(self._sorted_list)
 
 
 def _row_cmp_le(probe: jnp.ndarray, row: jnp.ndarray) -> jnp.ndarray:

@@ -67,6 +67,8 @@ def sweep_fingerprint(
     sub_map: Dict[bytes, List[bytes]],
     words: Sequence[bytes],
     digests: Sequence[bytes] = (),
+    *,
+    digest_lookup=None,
 ) -> str:
     """SHA-256 over a canonical serialization of the sweep's semantic inputs.
 
@@ -102,9 +104,16 @@ def sweep_fingerprint(
         )
         for w in words:
             h.update(w)
-    h.update(b"|D%d|" % len(digests))
-    for d in sorted(digests):
-        h.update(d)
+    # The lookup's sorted_blob is the digests in ascending byte order —
+    # identical for matrix and list forms of the same set, so checkpoints
+    # stay portable across parser paths (and a Sweep-provided lookup
+    # reuses its one sort instead of re-sorting here).
+    if digest_lookup is None:
+        from ..ops.membership import HostDigestLookup
+
+        digest_lookup = HostDigestLookup(digests)
+    h.update(b"|D%d|" % len(digest_lookup))
+    h.update(digest_lookup.sorted_blob())
     return h.hexdigest()
 
 

@@ -55,7 +55,7 @@ from ..models.attack import (
 )
 from ..oracle.engines import iter_candidates
 from ..ops.blocks import BlockBatch, make_blocks
-from ..ops.membership import build_digest_set
+from ..ops.membership import HostDigestLookup, build_digest_set
 from ..ops.packing import PackedWords, pack_words
 from ..tables.compile import compile_table
 from ..utils.digests import HOST_DIGEST
@@ -220,7 +220,16 @@ class Sweep:
     ) -> None:
         self.spec = spec
         self.sub_map = sub_map
-        self.digests = list(digests)
+        # A [N, digest_bytes] uint8 matrix (the CLI's vectorized left-list
+        # parser) stays a matrix — hashmob-scale lists must not explode
+        # into tens of millions of Python bytes objects.
+        self.digests = (
+            digests if isinstance(digests, np.ndarray) else list(digests)
+        )
+        # One sort serves both the fingerprint's canonical blob and
+        # per-hit host membership (matrix/list duality lives in the
+        # lookup, ops.membership.HostDigestLookup).
+        self._digest_lookup = HostDigestLookup(self.digests)
         self.config = config or SweepConfig()
         self.ct = compile_table(sub_map)
         # A pre-packed batch (e.g. the native scanner's read_packed) is
@@ -247,12 +256,18 @@ class Sweep:
             sub_map,
             self.packed,  # buffer-level hash, no per-word Python loop
             self.digests,
+            digest_lookup=self._digest_lookup,  # reuse its one sort
         )
         self._host_digest = HOST_DIGEST[spec.algo]
         #: fallback word rows in word order (oracle-routed, SURVEY.md §2.4)
         self.fallback_rows: List[int] = [
             int(i) for i in np.nonzero(self.plan.fallback)[0]
         ]
+
+    def _digest_contains(self, dig: bytes) -> bool:
+        """Host-side membership in the target digest list (fallback-word
+        hits and device-hit re-verification)."""
+        return dig in self._digest_lookup
 
     # ------------------------------------------------------------------
     # Shared machinery
@@ -486,7 +501,6 @@ class Sweep:
         spec, cfg, plan = self.spec, self.config, self.plan
         recorder = recorder if recorder is not None else HitRecorder()
         state, resumed = self._load_state(resume)
-        digest_set = set(self.digests)
         if cfg.progress is not None:
             cfg.progress.seed_emitted(state.n_emitted)
 
@@ -516,7 +530,7 @@ class Sweep:
 
         def fallback_candidate(row: int, i: int, cand: bytes) -> None:
             dig = self._host_digest(cand)
-            if dig in digest_set:
+            if self._digest_contains(dig):
                 state.n_hits += 1
                 state.hits.append((row, i))
                 recorder.emit(
@@ -554,7 +568,7 @@ class Sweep:
                     dig = self._host_digest(cand)
                     # Host re-verification: the device flagged this lane;
                     # its digest must really be in the target set.
-                    if dig not in digest_set:
+                    if not self._digest_contains(dig):
                         raise RuntimeError(
                             f"device hit failed host re-verification: "
                             f"word {w_row} rank {rank} candidate {cand!r}"

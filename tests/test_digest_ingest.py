@@ -1,0 +1,173 @@
+"""Vectorized digest-list ingest: the numpy left-list parser, the bulk
+DigestSet build, and the matrix-form digest plumbing through the sweep
+(hashmob-scale lists must not pay per-line/per-digest Python loops, and
+the fast paths must be observationally identical to the loops)."""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.cli import _read_digests
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+
+DIGS = [hashlib.md5(b"word%d" % i).digest() for i in range(500)]
+
+
+def _write(tmp_path, body: bytes):
+    p = tmp_path / "left.txt"
+    p.write_bytes(body)
+    return str(p)
+
+
+class TestVectorParser:
+    def test_plain_lines_give_matrix(self, tmp_path):
+        p = _write(tmp_path, b"".join(d.hex().encode() + b"\n" for d in DIGS))
+        out = _read_digests(p, "md5")
+        assert isinstance(out, np.ndarray) and out.shape == (500, 16)
+        assert out.tobytes() == b"".join(DIGS)
+
+    def test_suffixes_comments_blanks_crlf_upper(self, tmp_path):
+        body = (
+            b"# comment\n\n"
+            + DIGS[0].hex().encode() + b":plain text\n"
+            + DIGS[1].hex().upper().encode() + b"\r\n"
+            + DIGS[2].hex().encode() + b":\n"
+            + b"#" + DIGS[3].hex().encode() + b"\n"
+            + DIGS[4].hex().encode()  # no trailing newline
+        )
+        out = _read_digests(_write(tmp_path, body), "md5")
+        assert isinstance(out, np.ndarray)
+        assert out.tobytes() == DIGS[0] + DIGS[1] + DIGS[2] + DIGS[4]
+
+    def test_leading_whitespace_falls_back_to_loop(self, tmp_path):
+        body = b"  " + DIGS[0].hex().encode() + b"\n"
+        out = _read_digests(_write(tmp_path, body), "md5")
+        assert isinstance(out, list) and out == [DIGS[0]]
+
+    def test_bad_hex_raises_loop_message(self, tmp_path):
+        body = DIGS[0].hex().encode() + b"\nzz" + DIGS[1].hex().encode()[2:] + b"\n"
+        with pytest.raises(SystemExit, match=r"left.txt:2: not a hex digest"):
+            _read_digests(_write(tmp_path, body), "md5")
+
+    def test_wrong_length_raises_loop_message(self, tmp_path):
+        body = DIGS[0].hex().encode() + b"\nabcdef\n"
+        with pytest.raises(SystemExit, match=r"left.txt:2: 3-byte digest"):
+            _read_digests(_write(tmp_path, body), "md5")
+
+    def test_sha1_width(self, tmp_path):
+        digs = [hashlib.sha1(b"w%d" % i).digest() for i in range(20)]
+        p = _write(tmp_path, b"".join(d.hex().encode() + b"\n" for d in digs))
+        out = _read_digests(p, "sha1")
+        assert isinstance(out, np.ndarray) and out.shape == (20, 20)
+        assert out.tobytes() == b"".join(digs)
+
+    def test_empty_file(self, tmp_path):
+        assert len(_read_digests(_write(tmp_path, b""), "md5")) == 0
+        assert len(_read_digests(_write(tmp_path, b"\n# c\n"), "md5")) == 0
+
+
+class TestBulkDigestSet:
+    @pytest.mark.parametrize("algo,mk", [
+        ("md5", lambda b: hashlib.md5(b).digest()),
+        ("sha1", lambda b: hashlib.sha1(b).digest()),
+    ])
+    def test_matrix_list_hex_forms_identical(self, algo, mk):
+        digs = [mk(b"x%d" % i) for i in range(300)] + [mk(b"x0")]  # dup
+        mat = np.frombuffer(b"".join(digs), np.uint8).reshape(
+            len(digs), -1
+        )
+        s_list = build_digest_set(digs, algo)
+        s_mat = build_digest_set(mat, algo)
+        s_hex = build_digest_set([d.hex() for d in digs], algo)
+        assert (s_list.rows == s_mat.rows).all()
+        assert (s_list.rows == s_hex.rows).all()
+        assert (s_list.bitmap == s_mat.bitmap).all()
+        assert s_list.size == 300  # dup collapsed
+
+    def test_empty_matrix(self):
+        s = build_digest_set(np.zeros((0, 16), np.uint8), "md5")
+        assert s.size == 0
+
+
+class TestMatrixDigestsThroughSweep:
+    def test_crack_with_matrix_digests_matches_list(self, tmp_path):
+        from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            iter_candidates,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.sweep import (
+            Sweep,
+            SweepConfig,
+        )
+
+        # german-style table gives cascade-hazard fallback words, so the
+        # matrix path's host-side _digest_contains (fallback hits + device
+        # re-verification) executes on both the device and oracle routes.
+        sub = {b"a": [b"\xc3\xa4"], b"s": [b"$"], b"ss": [b"\xc3\x9f"]}
+        words = [b"glass", b"pass", b"mass", b"lass"]
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = []
+        for w in words:
+            oracle.extend(iter_candidates(w, sub, 0, 15))
+        planted = sorted({oracle[1], oracle[-1]})
+        digs = [hashlib.md5(c).digest() for c in planted]
+        digs += [hashlib.md5(b"decoy%d" % i).digest() for i in range(50)]
+        mat = np.frombuffer(b"".join(digs), np.uint8).reshape(-1, 16)
+
+        cfg = SweepConfig(lanes=64, num_blocks=16)
+        res_list = Sweep(spec, sub, words, digs, config=cfg).run_crack()
+        res_mat = Sweep(spec, sub, words, mat, config=cfg).run_crack()
+        key = lambda h: (h.word_index, h.variant_rank)  # noqa: E731
+        assert sorted(map(key, res_mat.hits)) == sorted(
+            map(key, res_list.hits)
+        )
+        assert {h.candidate for h in res_mat.hits} == set(planted)
+        assert res_mat.n_emitted == res_list.n_emitted
+
+    def test_fingerprint_matches_across_forms(self):
+        from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+        from hashcat_a5_table_generator_tpu.runtime.sweep import (
+            Sweep,
+            SweepConfig,
+        )
+
+        sub = {b"a": [b"4"]}
+        words = [b"banana"]
+        digs = sorted(DIGS[:37], reverse=True)  # unsorted on purpose
+        mat = np.frombuffer(b"".join(digs), np.uint8).reshape(-1, 16)
+        spec = AttackSpec(mode="default", algo="md5")
+        cfg = SweepConfig(lanes=32, num_blocks=8)
+        s1 = Sweep(spec, sub, words, digs, config=cfg)
+        s2 = Sweep(spec, sub, words, mat, config=cfg)
+        assert s1.fingerprint == s2.fingerprint
+
+
+def test_cr_separated_file_errors_like_old_reader(tmp_path):
+    """A CR-separated (classic Mac) file is ONE long line to the \n-split
+    reader — it must error, not silently parse (review regression)."""
+    body = DIGS[0].hex().encode() + b"\r" + DIGS[1].hex().encode() + b"\r"
+    p = tmp_path / "left.txt"
+    p.write_bytes(body)
+    with pytest.raises(SystemExit):
+        _read_digests(str(p), "md5")
+
+
+def test_host_digest_lookup_forms():
+    from hashcat_a5_table_generator_tpu.ops.membership import (
+        HostDigestLookup,
+    )
+
+    digs = DIGS[:50]
+    mat = np.frombuffer(b"".join(digs), np.uint8).reshape(-1, 16)
+    for lk in (HostDigestLookup(digs), HostDigestLookup(mat)):
+        assert len(lk) == 50
+        assert digs[7] in lk
+        assert hashlib.md5(b"nope").digest() not in lk
+        assert b"short" not in lk
+    assert (HostDigestLookup(digs).sorted_blob()
+            == HostDigestLookup(mat).sorted_blob()
+            == b"".join(sorted(digs)))
+    empty = HostDigestLookup(np.zeros((0, 16), np.uint8))
+    assert len(empty) == 0 and DIGS[0] not in empty
+    assert empty.sorted_blob() == b""
