@@ -242,6 +242,9 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
                 algo=spec.algo,
+                # Count-windowed plans carry win_v; the kernel walks the
+                # suffix-count DP in place of the mixed-radix decode.
+                win_v=plan.get("win_v"),
             )
             if spec.mode in ("default", "reverse"):
                 return fused_expand_md5(

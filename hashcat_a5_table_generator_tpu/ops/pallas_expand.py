@@ -15,7 +15,9 @@ non-overlapping-match formulation) and substitute-all plans (``-s``/
 ``-s -r``, ``main.go:308-440`` via ``ops.expand_suball``'s segment
 formulation) — every shipped hash (MD5/MD4/SHA-1/NTLM, single hash block:
 out_width <= 55, or <= 27 for NTLM whose UTF-16LE expansion doubles bytes),
-fixed-stride layout with stride a multiple of 128, non-windowed plans,
+fixed-stride layout with stride a multiple of 128, full-enumeration AND
+count-windowed plans (the in-kernel suffix-count DP walk,
+``_decode_tile_windowed``),
 table values <= 4 bytes (packed into one u32 per option). Everything else
 keeps the XLA path; the wrapper never silently changes semantics —
 ineligible configurations must not call it
@@ -57,6 +59,9 @@ _MAX_SLOTS = 24
 _MAX_TOKENS = 32
 _MAX_OPTIONS = 8
 _MAX_SEGMENTS = 64  # suball kernel only (match kernels pass 0)
+#: Windowed plans: suffix-count DP column bound (window <= 8 per the
+#: plan-side eligibility, +2 DP columns).
+_MAX_WIN_K2 = 10
 
 
 def eligible(
@@ -72,16 +77,20 @@ def eligible(
     max_val_len: int,
     max_options: int,
     num_segments: int = 0,
+    win_k2: int = 0,
 ) -> bool:
     """Static eligibility for the fused expand+MD5 kernel (see module doc).
 
     Callers own plan/table knowledge (``runtime.sweep``, ``bench.py``): all
     arguments are host-static facts about the launch configuration.
+    ``win_k2``: the windowed plan's DP column count (``win_v.shape[2]``,
+    0 when not windowed) — the in-kernel suffix-count walk handles
+    count-windowed plans directly.
     """
     return (
         mode in ("default", "reverse", "suball", "suball-reverse")
         and algo in ("md5", "md4", "sha1", "ntlm")
-        and not windowed
+        and (not windowed or 2 <= win_k2 <= _MAX_WIN_K2)
         and block_stride is not None
         and block_stride % 128 == 0
         # In-kernel ranks run up to the stride; the f32 divide in
@@ -172,6 +181,8 @@ def opts_for_config(spec, plan, ct, *, block_stride, num_blocks,
         max_val_len=int(ct.max_val_len),
         max_options=max_options,
         num_segments=int(getattr(plan, "num_segments", 0)),
+        win_k2=(int(plan.win_v.shape[2])
+                if getattr(plan, "win_v", None) is not None else 0),
     )
     return max_options if ok else None
 
@@ -210,6 +221,53 @@ def _exact_div(r, rs):
     q = q - (q * rs > r).astype(_I32)
     q = q + ((q + 1) * rs <= r).astype(_I32)
     return q
+
+
+def _decode_tile_windowed(rank, base, winv, radix, m, g, s, k_opts):
+    """Count-windowed digit decode on a (G, S) tile: the scalar windowed
+    rank ``base[:, 0] + rank`` walks only in-window digit vectors through
+    the suffix-count DP rows ``winv[G, M+1, K2]`` (mirrors
+    ``expand_matches.decode_digits``'s windowed branch bit-for-bit).
+
+    Division-free: the per-slot quotient ``d - 1 = r2 // safe`` is at most
+    ``radix - 2 <= K - 1`` for every in-window lane, so a K-1-step
+    subtractive chain computes quotient and remainder exactly — and never
+    overflows i32, unlike ``i * safe`` compare ladders (windowed totals
+    run to 2^30).  Out-of-range lanes decode garbage and are clipped;
+    emit masks them (same contract as the XLA path)."""
+    k2 = int(winv.shape[2])
+    big_r = base[:, 0][:, None] + rank
+    jcnt = jnp.zeros((g, s), _I32)
+    digits = []
+    for sl in range(m):
+        # jcnt increments at most once per slot, so at slot sl only
+        # columns 0..sl are reachable — bounding the unrolled selects
+        # there drops the statically dead compare+where pairs.
+        kc = min(sl + 1, k2)
+        rows = [winv[:, sl + 1, c][:, None] for c in range(min(kc + 1, k2))]
+        masks = [jcnt == c for c in range(kc)]
+        vn0 = jnp.zeros((g, s), _I32)
+        vn1 = jnp.zeros((g, s), _I32)
+        for c in range(kc):
+            vn0 = vn0 + jnp.where(masks[c], rows[c], 0)
+            if c + 1 < k2:
+                vn1 = vn1 + jnp.where(masks[c], rows[c + 1], 0)
+        not_chosen = big_r < vn0
+        r2 = big_r - vn0
+        safe = jnp.maximum(vn1, 1)
+        # Chosen digits run 1..radix-1 <= k_opts, so the quotient needs at
+        # most k_opts-1 subtractive steps (zero for K=1 tables: d is 1).
+        q = jnp.zeros((g, s), _I32)
+        rr = r2
+        for _ in range(max(0, k_opts - 1)):
+            ge = (rr >= safe).astype(_I32)
+            rr = rr - ge * safe
+            q = q + ge
+        d = jnp.where(not_chosen, 0, 1 + q)
+        big_r = jnp.where(not_chosen, big_r, rr)
+        digits.append(jnp.clip(d, 0, radix[:, sl][:, None] - 1))
+        jcnt = jcnt + jnp.where(not_chosen, 0, 1)
+    return digits
 
 
 def _decode_tile_radix2(rank, base, radix, m, g, s):
@@ -466,7 +524,7 @@ def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s):
 def _make_kernel(
     *, g: int, s: int, m: int, length_axis: int, k_opts: int,
     out_width: int, min_substitute: int, max_substitute: int,
-    algo: str = "md5",
+    algo: str = "md5", win_k2: "int | None" = None,
 ):
     """Build the per-step kernel body (fully unrolled straight-line trace).
 
@@ -474,9 +532,14 @@ def _make_kernel(
       tok[G, L] i32, wlen[G, 1] i32, radix[G, M] i32, base[G, M] i32,
       count[G, 1] i32, inside[G, M, L] i32 0/1 (byte j inside slot sl's
       match span), start[G, M, L] i32 0/1 (byte j starts it),
+      [winv[G, M+1, K2] i32 — windowed plans only],
       vopt[G, M, K] u32 (value bytes little-endian-packed), vlen[G, M, K] i32
     Outputs: state[G, KS, S] u32 (hash state words, KS = DIGEST_WORDS[algo]),
     emit[G, S] i32.
+
+    ``win_k2``: the suffix-count DP's column count for count-windowed
+    plans (None = full enumeration); selects the windowed decode and the
+    extra ``winv`` input.
     """
     # Single-hash-block scope: every emitted candidate (out_len <=
     # out_width, doubled for NTLM) plus its terminator must fit below the
@@ -484,12 +547,22 @@ def _make_kernel(
     assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
 
     def kernel(tok, wlen, radix, base, count, inside, start,
-               vopt, vlen, state_ref, emit_ref):
+               *rest):
+        if win_k2 is not None:
+            winv, vopt, vlen, state_ref, emit_ref = rest
+        else:
+            winv = None
+            vopt, vlen, state_ref, emit_ref = rest
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
 
-        decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
-        digits = decode(rank, base, radix, m, g, s)
+        if winv is not None:
+            digits = _decode_tile_windowed(
+                rank, base, winv, radix, m, g, s, k_opts
+            )
+        else:
+            decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
+            digits = decode(rank, base, radix, m, g, s)
         chosen = [d > 0 for d in digits]
         chosen_i = [c.astype(_I32) for c in chosen]
         chosen_count = jnp.zeros((g, s), _I32)
@@ -653,6 +726,7 @@ def fused_expand_md5(
     block_stride: int,
     k_opts: int,
     algo: str = "md5",
+    win_v: "jnp.ndarray | None" = None,  # int32 [B, M+1, K2] (windowed)
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for a fixed-stride launch.
@@ -660,7 +734,9 @@ def fused_expand_md5(
     Returns ``(state uint32[N, K], emit bool[N])`` (K =
     ``DIGEST_WORDS[algo]``) — the same contract as ``expand_matches`` +
     ``ops.hashes.HASH_FNS[algo]`` restricted to what the crack step
-    consumes. Callers must have checked :func:`eligible`.
+    consumes. Callers must have checked :func:`eligible`.  ``win_v``
+    (count-windowed plans) switches the in-kernel decode to the
+    suffix-count DP walk; block base cursors are then scalar ranks.
     """
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
@@ -689,11 +765,16 @@ def fused_expand_md5(
         g=_G, s=block_stride, m=m, length_axis=length_axis, k_opts=k_opts,
         out_width=out_width, min_substitute=min_substitute,
         max_substitute=max_substitute, algo=algo,
+        win_k2=None if win_v is None else int(win_v.shape[2]),
     )
+    inputs = [tok_b, wlen_b, radix_b, blk_base, count_b,
+              inside_b, start_b]
+    if win_v is not None:
+        inputs.append(win_v[blk_word])
+    inputs += [vopt_b, vlen_b]
     return _launch_fused(
         kernel,
-        (tok_b, wlen_b, radix_b, blk_base, count_b,
-         inside_b, start_b, vopt_b, vlen_b),
+        tuple(inputs),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
         n_state=DIGEST_WORDS[algo], interpret=interpret,
     )
@@ -702,7 +783,7 @@ def fused_expand_md5(
 def _make_suball_kernel(
     *, g: int, s: int, p: int, length_axis: int,
     k_opts: int, out_width: int, min_substitute: int, max_substitute: int,
-    algo: str = "md5",
+    algo: str = "md5", win_k2: "int | None" = None,
 ):
     """Per-step kernel body for substitute-all plans (``-s`` / ``-s -r``).
 
@@ -724,12 +805,22 @@ def _make_suball_kernel(
     assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
 
     def kernel(tok, wlen, pradix, base, count, slotat, startat,
-               vopt, vlen, state_ref, emit_ref):
+               *rest):
+        if win_k2 is not None:
+            winv, vopt, vlen, state_ref, emit_ref = rest
+        else:
+            winv = None
+            vopt, vlen, state_ref, emit_ref = rest
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
 
-        decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
-        digits = decode(rank, base, pradix, p, g, s)
+        if winv is not None:
+            digits = _decode_tile_windowed(
+                rank, base, winv, pradix, p, g, s, k_opts
+            )
+        else:
+            decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
+            digits = decode(rank, base, pradix, p, g, s)
         chosen_count = jnp.zeros((g, s), _I32)
         for sl in range(p):
             active = pradix[:, sl][:, None] > 1
@@ -825,12 +916,14 @@ def fused_expand_suball_md5(
     block_stride: int,
     k_opts: int,
     algo: str = "md5",
+    win_v: "jnp.ndarray | None" = None,  # int32 [B, P+1, K2] (windowed)
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for substitute-all fixed-stride launches.
 
-    Same contract as :func:`fused_expand_md5`; callers must have checked
-    :func:`eligible` with the plan's ``num_segments``.
+    Same contract as :func:`fused_expand_md5` (including the ``win_v``
+    count-windowed decode); callers must have checked :func:`eligible`
+    with the plan's ``num_segments``.
     """
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     p = pat_radix.shape[1]
@@ -868,10 +961,15 @@ def fused_expand_suball_md5(
         length_axis=length_axis, k_opts=k_opts, out_width=out_width,
         min_substitute=min_substitute, max_substitute=max_substitute,
         algo=algo,
+        win_k2=None if win_v is None else int(win_v.shape[2]),
     )
+    inputs = [tok_b, wlen_b, pradix_b, blk_base, count_b, slotat_b,
+              startat_b]
+    if win_v is not None:
+        inputs.append(win_v[blk_word])
     return _launch_fused(
         kernel,
-        (tok_b, wlen_b, pradix_b, blk_base, count_b, slotat_b, startat_b,
+        tuple(inputs) + (
          vopt_b, vlen_b),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
         n_state=DIGEST_WORDS[algo], interpret=interpret,

@@ -33,6 +33,11 @@ run bench_suball 700 python bench.py --wall-budget 600 --seconds 10 --mode subal
 # 4. Second algo (BASELINE configs[4] analog).
 run bench_sha1 700 python bench.py --wall-budget 600 --seconds 10 --algo sha1
 
+# 4b. Geometry probe: stride 256 (fewer ops/candidate per PERF.md §7 —
+#     3254 vs 3597 — but bigger tiles; the A/B settles which wins on chip).
+run bench_stride256 700 python bench.py --wall-budget 600 --seconds 10 \
+    --blocks 16384
+
 # 5. Sustained production CLI crack sweep (VERDICT r4 #4): synthetic
 #    rockyou-class dictionary, qwerty-cyrillic, MD5 digests, device backend.
 OUT="$OUT" python - <<'EOF'

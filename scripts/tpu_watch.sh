@@ -1,5 +1,6 @@
 #!/bin/bash
-# Poll for TPU availability; write status to /tmp/tpu_status when it comes up.
+# Poll for TPU availability; when it comes up, write /tmp/tpu_status and
+# immediately kick off the round-5 measurement session (scripts/tpu_session.sh).
 while true; do
   timeout 90 python - <<'PY' > /tmp/tpu_probe.out 2>&1
 import jax
@@ -7,13 +8,15 @@ ds = jax.devices()
 print("OK", jax.default_backend(), [str(d) for d in ds])
 PY
   if grep -q '^OK' /tmp/tpu_probe.out 2>/dev/null; then
-    if grep -q 'cpu' /tmp/tpu_probe.out && ! grep -qiE 'tpu|axon' /tmp/tpu_probe.out; then
-      echo "$(date -u +%H:%M:%S) cpu-only: $(cat /tmp/tpu_probe.out)" >> /tmp/tpu_watch.log
-    else
+    if grep -qiE 'tpu|axon' /tmp/tpu_probe.out; then
       cp /tmp/tpu_probe.out /tmp/tpu_status
       echo "$(date -u +%H:%M:%S) UP: $(cat /tmp/tpu_probe.out)" >> /tmp/tpu_watch.log
+      OUT=/tmp/tpu_session_r5 bash /root/repo/scripts/tpu_session.sh \
+        >> /tmp/tpu_watch.log 2>&1
+      echo "$(date -u +%H:%M:%S) session done" >> /tmp/tpu_watch.log
       exit 0
     fi
+    echo "$(date -u +%H:%M:%S) non-tpu: $(cat /tmp/tpu_probe.out)" >> /tmp/tpu_watch.log
   else
     echo "$(date -u +%H:%M:%S) down: $(tail -1 /tmp/tpu_probe.out 2>/dev/null)" >> /tmp/tpu_watch.log
   fi

@@ -79,6 +79,9 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
             max_substitute=spec.max_substitute,
             block_stride=STRIDE,
         )
+        if getattr(plan, "windowed", False):
+            # Both paths take the same suffix-count DP table.
+            common["win_v"] = jnp.asarray(plan.win_v)
         cand, clen, _, emit_x = xla_fn(*args, *blocks, **common)
         state_x = HASH_FNS[algo](cand, clen)
         state_p, emit_p = fused_fn(
@@ -113,10 +116,10 @@ def test_state_and_emit_match_xla(mode):
 
 
 def test_count_window_respected():
-    # max_substitute > WINDOWED_MAX_SUBST keeps the plan on full
-    # enumeration (windowed plans are ineligible for the fused kernel by
-    # design), while min_substitute still prunes low-count lanes — the
-    # kernel's in-tile window mask must agree exactly.
+    # max_substitute > WINDOWED_MAX_SUBST keeps the plan on FULL
+    # enumeration (the windowed decode has its own parity tests below),
+    # while min_substitute still prunes low-count lanes — the kernel's
+    # in-tile window mask must agree exactly.
     spec = AttackSpec(mode="default", algo="md5", min_substitute=2,
                       max_substitute=9)
     ct, plan = _arrays(spec)
@@ -182,8 +185,12 @@ def test_eligible_bounds():
                 max_val_len=2, max_options=2)
     assert eligible(**base)
     assert eligible(**{**base, "mode": "suball", "num_segments": 33})
+    # Windowed plans are eligible WITH their DP table's column count.
+    assert eligible(**{**base, "windowed": True, "win_k2": 3})
     for bad in (
-        dict(mode="plain"), dict(algo="sha256"), dict(windowed=True),
+        dict(mode="plain"), dict(algo="sha256"),
+        dict(windowed=True),  # windowed without win_k2: no DP table
+        dict(windowed=True, win_k2=11),
         dict(block_stride=96), dict(num_blocks=12), dict(out_width=56),
         dict(max_val_len=5), dict(max_options=9), dict(token_width=64),
         dict(num_segments=65),
@@ -306,3 +313,69 @@ def test_suball_other_algos_match_xla(algo):
         np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
         saw = saw or emit_x.any()
     assert saw
+
+
+class TestWindowedKernel:
+    """Count-windowed plans through the fused kernels: the in-kernel
+    suffix-count DP walk must agree with the XLA windowed decode on emit
+    mask and per-emitted-lane state, for match AND suball plans."""
+
+    def _windowed_spec(self, mode, lo=1, hi=1):
+        return AttackSpec(mode=mode, algo="md5", min_substitute=lo,
+                          max_substitute=hi)
+
+    @pytest.mark.parametrize("mode", ["default", "reverse"])
+    def test_match_windowed_parity(self, mode):
+        spec = self._windowed_spec(mode)
+        ct, plan = _arrays(spec)
+        assert plan.windowed and plan.win_v is not None
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both(spec, plan, ct):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    def test_match_windowed_wider_window(self):
+        # K=2 options exercise the subtractive quotient chain (digits > 1).
+        spec = self._windowed_spec("default", lo=2, hi=3)
+        ct, plan = _arrays(spec)
+        assert plan.windowed
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both(spec, plan, ct):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    def test_suball_windowed_parity(self):
+        # Needs >= 2x lane saving to trigger windowed plans: words with
+        # many unique keys and a tight window. K=2 on 's' exercises the
+        # subtractive quotient chain; no value is itself a key (hazard-free
+        # so no word routes to the oracle).
+        sub = {b"a": [b"4"], b"e": [b"3"], b"l": [b"1"], b"o": [b"0"],
+               b"s": [b"5", b"$"], b"u": [b"v"]}
+        words = [b"aeolus", b"louse", b"sale", b"aeiou"]
+        spec = self._windowed_spec("suball", lo=1, hi=1)
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words(words))
+        assert plan.windowed and not plan.fallback.any()
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both_suball(
+            spec, plan, ct
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    def test_opts_for_config_accepts_windowed(self):
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            opts_for_config,
+        )
+
+        spec = self._windowed_spec("default")
+        ct, plan = _arrays(spec)
+        assert plan.windowed
+        assert opts_for_config(spec, plan, ct, block_stride=128,
+                               num_blocks=16, require_tpu=False) == 2
