@@ -164,6 +164,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="multi-host sweep: total participating processes")
     ap.add_argument("--process-id", type=int, default=None, metavar="I",
                     help="multi-host sweep: this process's rank in [0, N)")
+    ap.add_argument("--pod-hits", choices=("gathered", "local"),
+                    default="gathered",
+                    help="multi-host hit reporting: 'gathered' (default) "
+                         "all-gathers hit records and process 0 prints the "
+                         "combined stream; 'local' prints each host's own "
+                         "stripe's hits on its own stdout with NO "
+                         "cross-host collectives — fully elastic (a dead "
+                         "peer cannot block survivors; relaunch only its "
+                         "stripe)")
     ap.add_argument("--profile", metavar="DIR",
                     help="write a jax.profiler trace of the device sweep to "
                          "DIR (inspect with TensorBoard / Perfetto); host "
@@ -465,6 +474,20 @@ def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
             _time.sleep(min(2.0 * attempt, 10.0))
 
 
+def _maybe_exit_pod_local(args, nprocs: int) -> None:
+    """Elastic-mode exit: ``--pod-hits local`` promises a dead peer can
+    never block a survivor, so the cooperative shutdown barrier must not
+    run — ``parallel.multihost.pod_local_done_exit`` implements the
+    done/dead wait (process 0 lingers as coordination host) and leaves
+    via ``os._exit``.  (``--profile`` keeps the normal exit so the trace
+    finalizes; a degraded pod may then report a coordination error at
+    shutdown.)"""
+    if nprocs > 1 and args.pod_hits == "local" and not args.profile:
+        from .parallel.multihost import pod_local_done_exit
+
+        pod_local_done_exit()
+
+
 def _die_peer_loss(e) -> None:
     """Loud multihost abort: a peer died and the collective timed out.
 
@@ -589,15 +612,19 @@ def _run_device(args, sub_map, packed) -> int:
                     run_crack_multihost,
                 )
 
-                # The combined hit stream is identical on every process;
-                # process 0 is the conventional reporter.
+                # Gathered: the combined hit stream is identical on every
+                # process; process 0 is the conventional reporter.  Local
+                # (elastic): every host streams its own stripe's hits.
+                gather = args.pod_hits == "gathered"
                 recorder = (
-                    HitRecorder(sys.stdout.buffer) if pid == 0 else None
+                    HitRecorder(sys.stdout.buffer)
+                    if (pid == 0 or not gather) else None
                 )
                 try:
                     res = run_crack_multihost(
                         spec, sub_map, packed, digests, cfg,
                         recorder=recorder, resume=not args.no_resume,
+                        gather=gather,
                     )
                 except PeerLossError as e:
                     _die_peer_loss(e)
@@ -611,11 +638,18 @@ def _run_device(args, sub_map, packed) -> int:
                     default_resume=not args.no_resume,
                     label="crack sweep",
                 )
-            if pid == 0:
+            if nprocs > 1 and args.pod_hits == "local":
+                print(
+                    f"{PROG}: process {pid}/{nprocs} stripe: "
+                    f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
+                    file=sys.stderr,
+                )
+            elif pid == 0:
                 print(
                     f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
                     file=sys.stderr,
                 )
+            _maybe_exit_pod_local(args, nprocs)
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
             if nprocs > 1:
@@ -635,6 +669,7 @@ def _run_device(args, sub_map, packed) -> int:
                     run_candidates_multihost(
                         spec, sub_map, packed, writer, cfg,
                         resume=not args.no_resume,
+                        gather=args.pod_hits == "gathered",
                     )
                 except PeerLossError as e:
                     _die_peer_loss(e)
@@ -651,6 +686,7 @@ def _run_device(args, sub_map, packed) -> int:
                         "(at-least-once stream)"
                     ),
                 )
+    _maybe_exit_pod_local(args, nprocs)
     return 0
 
 

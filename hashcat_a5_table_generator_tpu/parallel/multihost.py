@@ -42,6 +42,7 @@ from ..ops.packing import PackedWords
 
 __all__ = [
     "PeerLossError",
+    "pod_local_done_exit",
     "initialize",
     "host_stripe",
     "stripe_packed",
@@ -134,16 +135,94 @@ def _start_heartbeat() -> None:
     _hb_thread.start()
 
 
+def pod_local_done_exit() -> None:
+    """Elastic-mode (``--pod-hits local``) exit protocol.
+
+    ``jax.distributed``'s atexit hook runs a cooperative Shutdown barrier
+    — which blocks (or errors) exactly when a peer died, breaking local
+    mode's promise that a dead peer never blocks a survivor.  But process
+    0 also HOSTS the coordination service: if it just ``os._exit``-ed on
+    finishing its stripe, still-working peers would be killed by "leader
+    died" errors.  So: every process marks itself done in the KV store
+    (a write, not a barrier — works with dead peers), non-coordinator
+    processes exit immediately, and process 0 lingers as service host
+    until every peer is done or dead (stale heartbeat), then exits.
+    All exits are ``os._exit(0)`` — the shutdown barrier never runs.
+    """
+    import sys
+
+    import jax
+
+    pid, nprocs = jax.process_index(), jax.process_count()
+    client = _kv_client()
+    if nprocs > 1 and client is None:
+        # No KV store (internal API moved): an early os._exit cannot be
+        # coordinated safely — keep the normal exit path (cooperative
+        # shutdown barrier) rather than risk killing working peers.
+        return
+    if client is not None:
+        try:
+            client.key_value_set(f"a5gen/done/{pid}", "1",
+                                 allow_overwrite=True)
+        except Exception:  # pragma: no cover - service already torn down
+            pass
+    if pid == 0 and nprocs > 1:
+        # A5GEN_DCN_TIMEOUT=0 disables DEATH detection only: the
+        # coordinator still waits on done-marks (plain KV reads), it just
+        # never declares a silent peer dead.
+        threshold = _dcn_timeout()
+        seen: dict = {}
+        pending = set(range(1, nprocs))
+        notified = False
+        while pending:
+            for p in list(pending):
+                try:
+                    done = client.key_value_try_get(f"a5gen/done/{p}")
+                except Exception:
+                    done = None
+                if done is not None:
+                    pending.discard(p)
+            if not pending:
+                break
+            if threshold > 0:
+                dead = _stale_peer(client, seen, nprocs, pid, threshold,
+                                   only=pending)
+                if dead is not None:
+                    pending.discard(dead)
+                    print(
+                        f"a5gen: process 0: peer {dead} died mid-sweep; "
+                        "its stripe needs a relaunch (resumes from its "
+                        "own --checkpoint)",
+                        file=sys.stderr,
+                    )
+                    continue
+            if not notified:
+                notified = True
+                print(
+                    f"a5gen: process 0: stripe done; staying up as "
+                    f"coordination host for {len(pending)} working "
+                    "peer(s)",
+                    file=sys.stderr,
+                )
+            time.sleep(1.0)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
+
+
 def _stale_peer(client, seen: dict, nprocs: int, self_pid: int,
-                threshold: float) -> Optional[int]:
+                threshold: float,
+                only: "Optional[set]" = None) -> Optional[int]:
     """Return a peer id whose heartbeat has not CHANGED in ``threshold``
     seconds (None if all alive).  ``seen`` carries (value, last-change
     monotonic time) across polls; comparing values instead of clocks
     makes cross-host skew irrelevant.  A peer whose key never appears is
     stale from the first poll — a process that died before its first
-    beat is exactly as dead."""
+    beat is exactly as dead.  ``only`` restricts the scan (the local-mode
+    linger loop passes its pending set: peers that finished and exited
+    have frozen heartbeats but are not dead)."""
     now = time.monotonic()
-    for p in range(nprocs):
+    for p in (sorted(only) if only is not None else range(nprocs)):
         if p == self_pid:
             continue
         try:
@@ -467,15 +546,26 @@ def run_crack_multihost(
     *,
     recorder=None,
     resume: bool = True,
+    gather: bool = True,
 ):
     """The fused crack sweep at pod scale.
 
     Every process calls this with the SAME full wordlist — a flat
     :class:`PackedWords` batch or a ``{width: PackedWords}`` bucket dict —
-    sweeps its own stripe on its local devices, then all processes
-    exchange hit records and return the same combined SweepResult.  The
-    recorder (process-local; typically only given on process 0) receives
-    the combined, globally-sorted hit stream.
+    and sweeps its own stripe on its local devices.
+
+    ``gather=True`` (default): all processes then exchange hit records
+    and return the same combined SweepResult; the recorder
+    (process-local; typically only given on process 0) receives the
+    combined, globally-sorted hit stream.
+
+    ``gather=False`` (elastic mode, CLI ``--pod-hits local``): each
+    process streams ITS OWN stripe's hits to its recorder as they are
+    found and returns its host-local result — **no collective runs at
+    all**, so a dead peer cannot block survivors (they finish their
+    stripes and exit cleanly; only the dead host's stripe needs a
+    relaunch, which resumes from its own checkpoint).  The union of the
+    per-host hit streams equals gathered mode's combined stream.
     """
     import jax
 
@@ -483,6 +573,8 @@ def run_crack_multihost(
 
     pid, nprocs = jax.process_index(), jax.process_count()
     sweep = _local_sweep(spec, sub_map, packed, digests, config, pid, nprocs)
+    if not gather:
+        return sweep.run_crack(recorder, resume=resume)
     res = sweep.run_crack(resume=resume)
     all_hits = gather_hits(res.hits)
     if recorder is not None:
@@ -508,6 +600,7 @@ def run_candidates_multihost(
     config=None,
     *,
     resume: bool = True,
+    gather: bool = True,
 ):
     """Candidates mode at pod scale: each host streams ITS OWN stripe to its
     local writer (stripe-local dictionary order).  Candidate streams never
@@ -526,6 +619,10 @@ def run_candidates_multihost(
     pid, nprocs = jax.process_index(), jax.process_count()
     sweep = _local_sweep(spec, sub_map, packed, (), config, pid, nprocs)
     res = sweep.run_candidates(writer, resume=resume)
+    if not gather:
+        # Elastic mode: host-local counts, no collectives (see
+        # :func:`run_crack_multihost`).
+        return res
     return SweepResult(
         n_emitted=allgather_sum(res.n_emitted),
         n_hits=0,

@@ -465,3 +465,107 @@ def test_slow_peer_does_not_trip_failure_detector(tmp_path):
         line.split(b":", 1)[1] for line in hit_lines(outs[0][0])
     )
     assert got_plains == planted
+
+
+def test_pod_hits_local_is_elastic_and_union_complete(tmp_path):
+    """--pod-hits local: (a) two healthy hosts each report exactly their
+    own stripe's hits and the union equals the single-host hit set;
+    (b) a peer dying mid-run cannot block the survivor — it completes
+    its stripe and exits 0 (no collectives exist to hang in)."""
+    import hashlib
+
+    from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+
+    table = tmp_path / "leet.table"
+    table.write_bytes(b"a=4\na=@\no=0\ns=$\ns=5\ne=3\n")
+    dict_file = tmp_path / "dict.txt"
+    dict_file.write_bytes(b"\n".join(WORDS) + b"\n")
+    sub = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+    oracle = []
+    for w in WORDS:
+        oracle.extend(iter_candidates(w, sub, 0, 15))
+    planted = sorted({oracle[0], oracle[len(oracle) // 2], oracle[-1]})
+    digests_file = tmp_path / "digests.txt"
+    digests_file.write_bytes(
+        b"".join(hashlib.md5(c).digest().hex().encode() + b"\n"
+                 for c in planted)
+    )
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["A5GEN_DCN_TIMEOUT"] = "30"  # must never fire: no collectives
+
+    driver = (
+        "import sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from hashcat_a5_table_generator_tpu.cli import main\n"
+        "sys.exit(main(sys.argv[1:]))"
+    )
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def cli_args(port, pid):
+        return [
+            str(dict_file), "-t", str(table),
+            "--backend", "device", "--digests", str(digests_file),
+            "--lanes", "64", "--blocks", "16", "--pod-hits", "local",
+            "--coordinator", f"127.0.0.1:{port}", "--num-processes", "2",
+            "--process-id", str(pid),
+        ]
+
+    def hit_lines(out):
+        return [
+            line for line in out.splitlines()
+            if len(line.split(b":", 1)[0]) == 32
+            and not line.startswith(b"[Gloo]")
+        ]
+
+    # (a) healthy pod: per-host streams, union == single-host hit set.
+    port = free_port()
+    procs = [
+        subprocess.Popen([sys.executable, "-c", driver] + cli_args(port, p),
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE)
+        for p in range(2)
+    ]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err.decode()[-3000:]
+    per_host = [sorted(line.split(b":", 1)[1] for line in hit_lines(o))
+                for o, _ in outs]
+    assert sorted(per_host[0] + per_host[1]) == planted
+    assert per_host[0] and per_host[1]  # hits planted on both stripes
+    assert b"stripe:" in outs[0][1]
+
+    # (b) peer dies after joining: the survivor still completes cleanly.
+    port = free_port()
+    dying = (
+        "import os, sys\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from hashcat_a5_table_generator_tpu.parallel import multihost\n"
+        "multihost.initialize(sys.argv[1], 2, 1)\n"
+        "jax.devices()\n"
+        "import time; time.sleep(2)\n"
+        "os._exit(0)\n"
+    )
+    survivor = subprocess.Popen(
+        [sys.executable, "-c", driver] + cli_args(port, 0),
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    peer = subprocess.Popen(
+        [sys.executable, "-c", dying, f"127.0.0.1:{port}"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    peer.communicate(timeout=120)
+    out0, err0 = survivor.communicate(timeout=180)
+    assert survivor.returncode == 0, (survivor.returncode,
+                                      err0.decode()[-3000:])
+    got = sorted(line.split(b":", 1)[1] for line in hit_lines(out0))
+    assert got == per_host[0]  # its whole stripe, nothing blocked
