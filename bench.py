@@ -245,9 +245,12 @@ def run_worker(args: argparse.Namespace) -> None:
     import jax.numpy as jnp
 
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        k_opts_for,
         opts_for_config,
     )
 
+    # K=1 tables: the XLA arm's decode collapses to bit extraction.
+    radix2 = k_opts_for(plan) == 1
     zero = jnp.zeros((), jnp.int32)
 
     def time_arm(arm_name: str, fused_opts) -> dict:
@@ -255,7 +258,7 @@ def run_worker(args: argparse.Namespace) -> None:
         (fused_opts=None -> XLA expand+hash pair; K -> Pallas kernel)."""
         body = make_fused_body(spec, num_lanes=args.lanes,
                                out_width=plan.out_width, block_stride=stride,
-                               fused_expand_opts=fused_opts)
+                               fused_expand_opts=fused_opts, radix2=radix2)
         acc_step = jax.jit(
             lambda p_, t_, b_, d_, tot:
                 tot + body(p_, t_, d_, b_)["n_emitted"]

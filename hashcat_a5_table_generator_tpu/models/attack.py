@@ -146,14 +146,19 @@ def digest_arrays(ds: DigestSet) -> Dict[str, jnp.ndarray]:
 
 
 def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width,
-            block_stride=None):
-    """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit)."""
+            block_stride=None, radix2=False):
+    """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit).
+
+    ``radix2`` (static): all plan radices <= 2 (``k_opts == 1``) — the
+    decode collapses to bit extraction (``expand_matches.decode_digits``).
+    """
     common = dict(
         num_lanes=num_lanes,
         out_width=out_width,
         min_substitute=spec.effective_min,
         max_substitute=spec.max_substitute,
         block_stride=block_stride,
+        radix2=radix2,
     )
     if spec.mode in ("default", "reverse"):
         return expand_matches(
@@ -203,7 +208,8 @@ def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
 
 def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
-                    fused_expand_opts: int | None = None):
+                    fused_expand_opts: int | None = None,
+                    radix2: bool = False):
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
@@ -265,7 +271,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
             )
         cand, cand_len, word_row, emit = _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
-            out_width=out_width, block_stride=block_stride,
+            out_width=out_width, block_stride=block_stride, radix2=radix2,
         )
         del word_row  # hit cursors are host-derived from lane indices
         return hash_fn(cand, cand_len), emit
@@ -285,7 +291,8 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
 
 def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
-                    fused_expand_opts: int | None = None):
+                    fused_expand_opts: int | None = None,
+                    radix2: bool = False):
     """Build the fused expand->hash->match step (single device).
 
     Returns ``step(plan, table, blocks, digests) -> dict`` with the packed
@@ -293,7 +300,8 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
     """
     body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width,
                            block_stride=block_stride,
-                           fused_expand_opts=fused_expand_opts)
+                           fused_expand_opts=fused_expand_opts,
+                           radix2=radix2)
 
     def step(plan, table, blocks, digests):
         return body(plan, table, digests, blocks)
@@ -302,7 +310,8 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
 
 
 def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                         block_stride: int | None = None):
+                         block_stride: int | None = None,
+                         radix2: bool = False):
     """The un-jitted expand-only body, shared by the single-device
     candidates step and the shard_map'd candidates step.
 
@@ -312,21 +321,22 @@ def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     def body(plan, table, blocks):
         return _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
-            out_width=out_width, block_stride=block_stride,
+            out_width=out_width, block_stride=block_stride, radix2=radix2,
         )
 
     return body
 
 
 def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                         block_stride: int | None = None):
+                         block_stride: int | None = None,
+                         radix2: bool = False):
     """Build the expand-only step for the stdout-candidates sink.
 
     Returns ``step(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
     """
     return jax.jit(
         make_candidates_body(spec, num_lanes=num_lanes, out_width=out_width,
-                             block_stride=block_stride)
+                             block_stride=block_stride, radix2=radix2)
     )
 
 

@@ -209,7 +209,7 @@ _F32_DECODE_MAX_RANK = 1 << 24
 
 
 def decode_digits(rank, base, radix, field, win_v, m, *,
-                  max_rank: "int | None" = None):
+                  max_rank: "int | None" = None, radix2: bool = False):
     """Per-lane digit-vector decode shared by both expansion kernels.
 
     Full enumeration (``win_v is None``): digits = base + mixed-radix(rank),
@@ -251,6 +251,28 @@ def decode_digits(rank, base, radix, field, win_v, m, *,
             # masks them regardless.
             digits.append(jnp.clip(d, 0, radix[:, s] - 1))
             jcnt = jcnt + jnp.where(not_chosen, 0, 1)
+        return jnp.stack(digits, axis=1)  # [N, M]
+    # Shift amounts >= 32 are implementation-defined in XLA; > 31 active
+    # slots can push the bit cursor there, so wide plans keep the general
+    # decode (static fact — m is the padded slot count). The Pallas twin
+    # is capped harder by its own eligibility (_MAX_SLOTS = 24).
+    radix2 = radix2 and m <= 31
+    if radix2:
+        # K=1 tables (every shipped 1:1 layout map): all radices <= 2, so
+        # active slots' digits are successive BITS of the rank — shift/
+        # mask + a binary carry replaces even the f32 divide chain
+        # (mirrors pallas_expand._decode_tile_radix2; the caller asserts
+        # the static fact via k_opts == 1).
+        digits = []
+        carry = jnp.zeros_like(rank)
+        nbits = jnp.zeros_like(rank)
+        for s in range(m):
+            active = radix[:, s] > 1
+            bit = (rank >> nbits) & 1
+            t = base[:, s] + jnp.where(active, bit, 0) + carry
+            digits.append(jnp.where(active, t & 1, 0))
+            carry = jnp.where(active, t >> 1, carry)
+            nbits = nbits + active.astype(jnp.int32)
         return jnp.stack(digits, axis=1)  # [N, M]
     digits = []
     carry = jnp.zeros_like(rank)
@@ -532,6 +554,7 @@ def expand_matches(
     block_stride: int | None = None,
     win_v: jnp.ndarray | None = None,
     splice_impl: str | None = None,
+    radix2: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -579,7 +602,7 @@ def expand_matches(
     # stride), by the lane count otherwise (rank = lane - offset); the
     # static bound turns the decode divides into f32 + fixup.
     digits = decode_digits(rank, base, radix, field, win_v, m,
-                           max_rank=block_stride or n)
+                           max_rank=block_stride or n, radix2=radix2)
 
     chosen = digits > 0  # [N, M]
     chosen_count = jnp.sum(chosen, axis=1)

@@ -453,6 +453,7 @@ def expand_suball(
     max_substitute: int,
     block_stride: int | None = None,
     win_v: jnp.ndarray | None = None,
+    radix2: bool = False,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -482,7 +483,8 @@ def expand_suball(
     tokens_w = field(tokens)  # [N, L]
 
     digits = decode_digits(
-        rank, base, radix, field, win_v, p, max_rank=block_stride or n
+        rank, base, radix, field, win_v, p, max_rank=block_stride or n,
+        radix2=radix2,
     )  # [N, P]
 
     active = radix > 1

@@ -126,6 +126,7 @@ def make_sharded_crack_step(
     axis_name: str = "data",
     block_stride: int | None = None,
     fused_expand_opts: int | None = None,
+    radix2: bool = False,
 ):
     """The fused crack step, shard_map'd over a 1-D mesh.
 
@@ -145,6 +146,7 @@ def make_sharded_crack_step(
     body = make_fused_body(
         spec, num_lanes=lanes_per_device, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
+        radix2=radix2,
     )
 
     def local_step(plan, table, digests, blocks):
@@ -178,6 +180,7 @@ def make_sharded_candidates_step(
     out_width: int,
     axis_name: str = "data",
     block_stride: int | None = None,
+    radix2: bool = False,
 ):
     """The expand-only step, shard_map'd over a 1-D mesh.
 
@@ -192,7 +195,7 @@ def make_sharded_candidates_step(
     """
     local_step = make_candidates_body(
         spec, num_lanes=lanes_per_device, out_width=out_width,
-        block_stride=block_stride,
+        block_stride=block_stride, radix2=radix2,
     )
 
     rep = P()

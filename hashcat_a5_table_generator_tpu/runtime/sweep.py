@@ -314,7 +314,7 @@ class Sweep:
         spec, cfg, plan = self.spec, self.config, self.plan
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()
-        from ..ops.pallas_expand import opts_for
+        from ..ops.pallas_expand import k_opts_for, opts_for
 
         # On TPU an eligible config swaps the crack step's expand+hash
         # pair for the fused Pallas kernel by default (ops.pallas_expand;
@@ -323,12 +323,16 @@ class Sweep:
             spec, plan, self.ct, block_stride=stride,
             num_blocks=cfg.num_blocks,
         )
+        # K=1 tables (all radices <= 2): the XLA decode collapses to bit
+        # extraction (expand_matches.decode_digits radix2 path).
+        radix2 = k_opts_for(plan) == 1
         if n_devices == 1:
             p, t = plan_arrays(plan), table_arrays(self.ct)
             if kind == "crack":
                 step = make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
                     block_stride=stride, fused_expand_opts=fused_opts,
+                    radix2=radix2,
                 )
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
@@ -336,7 +340,7 @@ class Sweep:
                 return (lambda blocks: step(p, t, blocks, darrs)), 1, None
             step = make_candidates_step(
                 spec, num_lanes=cfg.lanes, out_width=plan.out_width,
-                block_stride=stride,
+                block_stride=stride, radix2=radix2,
             )
             return (lambda blocks: step(p, t, blocks)), 1, None
 
@@ -352,7 +356,7 @@ class Sweep:
             step = make_sharded_crack_step(
                 spec, mesh, lanes_per_device=cfg.lanes,
                 out_width=plan.out_width, block_stride=stride,
-                fused_expand_opts=fused_opts,
+                fused_expand_opts=fused_opts, radix2=radix2,
             )
             p, t, darrs = replicate(
                 mesh,
@@ -365,7 +369,7 @@ class Sweep:
             return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
         step = make_sharded_candidates_step(
             spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width,
-            block_stride=stride,
+            block_stride=stride, radix2=radix2,
         )
         p, t = replicate(mesh, (plan_arrays(plan), table_arrays(self.ct)))
         return (lambda blocks: step(p, t, blocks)), n_devices, mesh

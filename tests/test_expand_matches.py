@@ -592,3 +592,100 @@ class TestBatchMatchScan:
         assert [len(r) for r in ref] == [1, 1]  # only the 1-byte key
         assert plan.n_variants == (2, 2)
         assert (plan.match_len[:, 0] == 1).all()
+
+
+class TestRadix2Decode:
+    """The K=1 bit-extraction decode (``decode_digits(radix2=True)``) must
+    be lane-for-lane identical to the general decode on radix-<=2 plans —
+    match and suball, fixed-stride and packed layouts."""
+
+    SUB = {b"a": [b"4"], b"e": [b"3"], b"s": [b"$"], b"o": [b"0"],
+           b"ss": [b"\xc3\x9f"]}
+    WORDS = [b"glasses", b"x", b"", b"assess", b"aeoaeo", b"mississippi"]
+
+    def _match_args(self, stride):
+        ct = compile_table(self.SUB)
+        packed = pack_words(self.WORDS)
+        plan = build_match_plan(ct, packed)
+        lanes = 512
+        outs = []
+        w = rank = 0
+        while True:
+            batch, w, rank = make_blocks(
+                plan, start_word=w, start_rank=rank, max_variants=lanes,
+                max_blocks=lanes // (stride or 64),
+                fixed_stride=stride,
+            )
+            if batch.total == 0:
+                break
+            if stride is not None:
+                from hashcat_a5_table_generator_tpu.ops.blocks import (
+                    pad_batch,
+                )
+
+                batch = pad_batch(batch, lanes // stride)
+            outs.append((plan, ct, batch))
+        assert outs
+        return lanes, outs
+
+    @pytest.mark.parametrize("stride", [64, None])
+    def test_match_radix2_identical(self, stride):
+        lanes, launches = self._match_args(stride)
+        for plan, ct, batch in launches:
+            args = (
+                jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.match_pos), jnp.asarray(plan.match_len),
+                jnp.asarray(plan.match_radix),
+                jnp.asarray(plan.match_val_start),
+                jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
+                jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
+                jnp.asarray(batch.count), jnp.asarray(batch.offset),
+            )
+            kw = dict(num_lanes=lanes, out_width=plan.out_width,
+                      min_substitute=1, max_substitute=15,
+                      block_stride=stride)
+            a = expand_matches(*args, radix2=False, **kw)
+            b = expand_matches(*args, radix2=True, **kw)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    def test_suball_radix2_identical(self):
+        from hashcat_a5_table_generator_tpu.ops.expand_suball import (
+            build_suball_plan,
+            expand_suball,
+        )
+
+        ct = compile_table(self.SUB)
+        packed = pack_words(self.WORDS)
+        plan = build_suball_plan(ct, packed)
+        lanes = 256
+        w = rank = 0
+        saw = False
+        while True:
+            batch, w, rank = make_blocks(
+                plan, start_word=w, start_rank=rank, max_variants=lanes,
+                max_blocks=4, fixed_stride=64,
+            )
+            if batch.total == 0:
+                break
+            saw = True
+            from hashcat_a5_table_generator_tpu.ops.blocks import pad_batch
+
+            batch = pad_batch(batch, 4)
+            args = (
+                jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.pat_radix),
+                jnp.asarray(plan.pat_val_start),
+                jnp.asarray(plan.seg_orig_start),
+                jnp.asarray(plan.seg_orig_len), jnp.asarray(plan.seg_pat),
+                jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
+                jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
+                jnp.asarray(batch.count), jnp.asarray(batch.offset),
+            )
+            kw = dict(num_lanes=lanes, out_width=plan.out_width,
+                      min_substitute=1, max_substitute=15, block_stride=64)
+            a = expand_suball(*args, radix2=False, **kw)
+            b = expand_suball(*args, radix2=True, **kw)
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        assert saw
