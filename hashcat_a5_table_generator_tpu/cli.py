@@ -36,9 +36,12 @@ New surface (the engine lift, ``BASELINE.json`` north star):
   ``--bug-compat`` (reproduce the reference's Q3 reverse-offset bug in the
   oracle), ``--max-word-bytes`` (the anti-Q8 guard, default 64 KiB).
 
-``--threads`` is accepted for compatibility and ignored: the reference uses
-it to bound goroutines (``main.go:70-94``); here the device batches its own
-parallelism and the oracle is deterministic single-stream.
+``--threads N`` parallelizes the ORACLE backend across N worker processes
+with an in-order merge, so the stream stays byte-identical to
+``--threads 1`` at any N (``oracle.parallel``; stronger than the
+reference, whose goroutines interleave output nondeterministically,
+``main.go:70-94``). The device backend batches its own parallelism and
+ignores the flag.
 """
 
 from __future__ import annotations
@@ -77,7 +80,13 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("-x", "--table-max", type=int, default=15,
                     help="maximum substitutions per candidate (default 15)")
     ap.add_argument("--threads", type=int, default=-1,
-                    help="accepted for reference compatibility; ignored")
+                    help="oracle backend: expand words across N worker "
+                         "processes; the stream STAYS byte-identical to "
+                         "--threads 1 (in-order merge — stronger than the "
+                         "reference, whose threads interleave output "
+                         "nondeterministically). <=1 or unset = "
+                         "sequential. The device backend batches its own "
+                         "parallelism and ignores this")
     ap.add_argument("-s", "--substitute-all", action="store_true",
                     help="substitution-cipher mode: choose per unique "
                          "pattern, not per occurrence")
@@ -383,6 +392,40 @@ def _run_oracle(args, sub_map, words) -> int:
 
     mode = _mode(args)
     crack = args.digests is not None
+    iter_kw = dict(
+        min_substitute=args.table_min,
+        max_substitute=args.table_max,
+        substitute_all=mode.startswith("suball"),
+        reverse=mode in ("reverse", "suball-reverse"),
+        bug_compat=args.bug_compat,
+    )
+    if args.threads and args.threads > 1:
+        # Multi-process oracle (oracle.parallel): same byte stream, N
+        # cores — the in-order merge keeps --threads 1 order at any N.
+        from .oracle.parallel import (
+            run_candidates_parallel,
+            run_crack_parallel,
+        )
+
+        with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
+            if crack:
+                def on_hit(dig_hex: str, cand: bytes) -> None:
+                    writer.write_block(potfile_line(dig_hex, cand), 1)
+                    writer.flush()
+
+                n_hits = run_crack_parallel(
+                    words, sub_map,
+                    _read_digests(args.digests, args.algo), args.algo,
+                    on_hit, n_workers=args.threads, **iter_kw,
+                )
+            else:
+                run_candidates_parallel(
+                    words, sub_map, writer, n_workers=args.threads,
+                    hex_unsafe=args.hex_unsafe, **iter_kw,
+                )
+        if crack:
+            print(f"{n_hits} hits", file=sys.stderr)
+        return 0
     digest_set = HostDigestLookup(
         _read_digests(args.digests, args.algo) if crack else ()
     )
@@ -390,15 +433,7 @@ def _run_oracle(args, sub_map, words) -> int:
     n_hits = 0
     with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
         for word in words:
-            for cand in iter_candidates(
-                word,
-                sub_map,
-                args.table_min,
-                args.table_max,
-                substitute_all=mode.startswith("suball"),
-                reverse=mode in ("reverse", "suball-reverse"),
-                bug_compat=args.bug_compat,
-            ):
+            for cand in iter_candidates(word, sub_map, **iter_kw):
                 if crack:
                     dig = host_digest(cand)
                     if dig in digest_set:
