@@ -32,6 +32,8 @@ nonsense message by construction in both paths, and both mask them.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -52,7 +54,35 @@ _U32 = jnp.uint32
 _I32 = jnp.int32
 
 #: Blocks per grid step: (G, S) tiles fill all 8 VPU sublanes at S >= 128.
-_G = 8
+#: ``A5GEN_PALLAS_G`` overrides (e.g. 16/32) for on-chip geometry probes —
+#: larger G amortizes per-step block-field loads over more lanes at the
+#: cost of VMEM; read once at import, consulted at kernel-build time.
+#: Malformed or non-positive values warn and keep the default (same
+#: convention as ``enabled_by_env``: a typo must not break — or silently
+#: reshape — the fast path).
+
+
+def _grid_height_from_env() -> int:
+    raw = os.environ.get("A5GEN_PALLAS_G")
+    if raw is None or raw == "":
+        return 8
+    try:
+        g = int(raw)
+        if g <= 0:
+            raise ValueError("must be positive")
+    except ValueError:
+        import sys
+
+        print(
+            f"a5gen: warning: invalid A5GEN_PALLAS_G={raw!r} "
+            "(want a positive integer); using 8",
+            file=sys.stderr,
+        )
+        return 8
+    return g
+
+
+_G = _grid_height_from_env()
 
 #: Soft caps keeping the fully-unrolled kernel's compile time bounded.
 _MAX_SLOTS = 24
@@ -129,8 +159,6 @@ def enabled_by_env() -> bool:
     which selects *that* kernel and therefore also opts this one out).
     Unrecognized values warn and keep the default — a typo must not
     silently disable the fast path."""
-    import os
-
     val = os.environ.get("A5GEN_PALLAS")
     if val is None or val == "":
         return True
@@ -191,8 +219,6 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
     """Production gate: :func:`opts_for_config` under the env opt-out
     (:func:`enabled_by_env`).  Default-on on TPU devices; the XLA
     expand+hash pair remains for ineligible configs and non-TPU backends."""
-    import os
-
     if not enabled_by_env():
         return None
     if os.environ.get("A5GEN_PALLAS") == "expand" and not _on_tpu():

@@ -38,6 +38,11 @@ run bench_sha1 700 python bench.py --wall-budget 600 --seconds 10 --algo sha1
 run bench_stride256 700 python bench.py --wall-budget 600 --seconds 10 \
     --blocks 16384
 
+# 4c. Grid-height probe: 16 blocks per Pallas grid step (amortizes
+#     per-step block-field loads; parity-pinned in the interpret suite).
+A5GEN_PALLAS_G=16 run bench_g16 700 python bench.py --wall-budget 600 \
+    --seconds 10 --arm pallas
+
 # 5. Sustained production CLI crack sweep (VERDICT r4 #4): synthetic
 #    rockyou-class dictionary, qwerty-cyrillic, MD5 digests, device backend.
 OUT="$OUT" python - <<'EOF'

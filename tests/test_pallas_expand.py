@@ -379,3 +379,23 @@ class TestWindowedKernel:
         assert plan.windowed
         assert opts_for_config(spec, plan, ct, block_stride=128,
                                num_blocks=16, require_tpu=False) == 2
+
+
+def test_grid_height_override_parity(monkeypatch):
+    """_G (blocks per grid step) is probe-tunable (A5GEN_PALLAS_G):
+    G=16 must produce the identical emit/state stream as the default
+    G=8 — geometry must never change semantics."""
+    import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+
+    spec = AttackSpec(mode="default", algo="md5")
+    ct, plan = _arrays(spec)
+    monkeypatch.setattr(pe, "_G", 8)  # pin: env may have set another G
+    base = _run_both(spec, plan, ct, num_blocks=16)
+    monkeypatch.setattr(pe, "_G", 16)
+    wide = _run_both(spec, plan, ct, num_blocks=16)
+    saw = False
+    for (ex, ep, sx, sp), (ex2, ep2, sx2, sp2) in zip(base, wide):
+        np.testing.assert_array_equal(ep, ep2)
+        np.testing.assert_array_equal(sp[ep], sp2[ep2])
+        saw = saw or ep.any()
+    assert saw  # the comparison must not be vacuous
