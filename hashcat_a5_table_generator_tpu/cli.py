@@ -383,6 +383,43 @@ def _run_list_layouts() -> int:
     return 0
 
 
+def native_default_eligible(sub_map, mode: str, crack: bool,
+                            hex_unsafe: bool,
+                            max_substitute: int = 15) -> bool:
+    """Whether the C++ default-engine oracle can serve this run (thin
+    shim over the ONE shared predicate,
+    ``native.oracle_engine.default_engine_eligible`` — the --threads
+    workers use the same one, so the two paths can never drift)."""
+    from .native.oracle_engine import default_engine_eligible
+
+    return default_engine_eligible(
+        sub_map,
+        substitute_all=mode.startswith("suball"),
+        reverse=mode in ("reverse", "suball-reverse"),
+        crack=crack,
+        hex_unsafe=hex_unsafe,
+        max_substitute=max_substitute,
+    )
+
+
+def _native_default_engine(args, sub_map, mode: str, crack: bool):
+    """A ready NativeDefaultOracle, or None (ineligible / no toolchain /
+    A5_NATIVE=0 — the Python engines remain the behavior)."""
+    if not native_default_eligible(sub_map, mode, crack, args.hex_unsafe,
+                                   args.table_max):
+        return None
+    try:
+        from .native.oracle_engine import NativeDefaultOracle, available
+
+        if not available():
+            return None
+        return NativeDefaultOracle(sub_map)
+    except Exception as e:  # pragma: no cover - toolchain-dependent
+        print(f"{PROG}: native oracle unavailable ({e}); Python engine",
+              file=sys.stderr)
+        return None
+
+
 def _run_oracle(args, sub_map, words) -> int:
     """Reference semantics, reference order (--threads 1): word order,
     DFS order within each word (Q9)."""
@@ -425,6 +462,18 @@ def _run_oracle(args, sub_map, words) -> int:
                 )
         if crack:
             print(f"{n_hits} hits", file=sys.stderr)
+        return 0
+    native_eng = _native_default_engine(args, sub_map, mode, crack)
+    if native_eng is not None:
+        # Engine A (default mode) streams from the C++ oracle — the same
+        # byte stream ~17x faster (native/oracle.cpp; parity pinned by
+        # tests/test_native.py).
+        with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
+            for word in words:
+                native_eng.stream_word(
+                    word, args.table_min, args.table_max,
+                    lambda b: writer.write_block(b, b.count(b"\n")),
+                )
         return 0
     digest_set = HostDigestLookup(
         _read_digests(args.digests, args.algo) if crack else ()

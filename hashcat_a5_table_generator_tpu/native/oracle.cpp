@@ -1,0 +1,151 @@
+// Native default-mode oracle engine (engine A): byte-exact, stream-order-
+// exact reimplementation of oracle/engines.py::process_word — the
+// reference's primary path (recursive DFS, longest-key-first probes,
+// scan resumes past replacement text, min==0 bumped to 1 by the CALLER'S
+// contract being preserved here too).  The Python oracle remains the
+// parity anchor; tests/test_native.py pins this engine byte-for-byte
+// against it (including duplicate multiplicity, Q7).
+//
+// C ABI + ctypes (no pybind11 in this environment); output streams
+// through a chunk callback so candidate floods never materialize in one
+// allocation.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct SvHash {
+  using is_transparent = void;
+  size_t operator()(std::string_view sv) const noexcept {
+    return std::hash<std::string_view>{}(sv);
+  }
+  size_t operator()(const std::string& s) const noexcept {
+    return std::hash<std::string_view>{}(std::string_view(s));
+  }
+};
+
+struct Table {
+  std::unordered_map<std::string, std::vector<std::string>, SvHash,
+                     std::equal_to<>>
+      map;
+  size_t kmax = 0;
+};
+
+// Returns 0 to continue, nonzero to abort the enumeration (the Python
+// side uses this to surface sink exceptions — ctypes callbacks cannot
+// raise through the C frame, so a swallowed BrokenPipeError would
+// otherwise run the whole candidate space and report success).
+typedef int32_t (*a5_sink_fn)(const uint8_t* data, int64_t len, void* ctx);
+
+struct Emit {
+  std::string out;
+  size_t chunk;
+  a5_sink_fn sink;
+  void* uctx;
+  int64_t count = 0;
+  bool aborted = false;
+
+  void ship() {
+    if (sink(reinterpret_cast<const uint8_t*>(out.data()),
+             static_cast<int64_t>(out.size()), uctx) != 0)
+      aborted = true;
+    out.clear();
+  }
+  void line(const std::string& cand) {
+    out.append(cand);
+    out.push_back('\n');
+    ++count;
+    if (out.size() >= chunk) ship();
+  }
+  void flush() {
+    if (!out.empty() && !aborted) ship();
+  }
+};
+
+// Mirrors engines.process_word's inner generate(): for each position from
+// `start`, probe key lengths longest-first; on a match splice each option,
+// emit when the count is in [min, max], and recurse past the replacement.
+void generate(const Table& t, Emit& e, const std::string& current, int count,
+              size_t start, int min_sub, int max_sub) {
+  if (e.aborted) return;
+  const size_t n = current.size();
+  for (size_t i = start; i < n; ++i) {
+    size_t maxkl = n - i < t.kmax ? n - i : t.kmax;
+    for (size_t kl = maxkl; kl >= 1; --kl) {
+      auto it = t.map.find(std::string_view(current).substr(i, kl));
+      if (it == t.map.end()) continue;
+      for (const std::string& sub : it->second) {
+        int nc = count + 1;
+        if (nc > max_sub) continue;
+        std::string nw;
+        nw.reserve(n - kl + sub.size());
+        nw.append(current, 0, i);
+        nw.append(sub);
+        nw.append(current, i + kl, n - i - kl);
+        if (nc >= min_sub) e.line(nw);
+        generate(t, e, nw, nc, i + sub.size(), min_sub, max_sub);
+        if (e.aborted) return;
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t a5_oracle_abi() { return 2; }
+
+// Flattened table: nk keys (keys_blob + key_lens), each key's options are
+// value rows [val_start[k], val_start[k+1]) into (vals_blob + val_lens).
+void* a5_oracle_table_new(const uint8_t* keys_blob, const int32_t* key_lens,
+                          int32_t nk, const uint8_t* vals_blob,
+                          const int32_t* val_lens,
+                          const int32_t* val_start) {
+  Table* t = new Table();
+  std::vector<int64_t> voff(1, 0);
+  int32_t nv = val_start[nk];
+  for (int32_t v = 0; v < nv; ++v) voff.push_back(voff.back() + val_lens[v]);
+  int64_t koff = 0;
+  for (int32_t k = 0; k < nk; ++k) {
+    std::string key(reinterpret_cast<const char*>(keys_blob) + koff,
+                    static_cast<size_t>(key_lens[k]));
+    koff += key_lens[k];
+    std::vector<std::string> vals;
+    for (int32_t v = val_start[k]; v < val_start[k + 1]; ++v) {
+      vals.emplace_back(reinterpret_cast<const char*>(vals_blob) + voff[v],
+                        static_cast<size_t>(val_lens[v]));
+    }
+    if (key.size() > t->kmax) t->kmax = key.size();
+    t->map.emplace(std::move(key), std::move(vals));
+  }
+  return t;
+}
+
+void a5_oracle_table_free(void* table) { delete static_cast<Table*>(table); }
+
+// Default engine over one word; candidates stream through `sink` as
+// newline-terminated chunks (<= chunk_bytes + one candidate each).
+// Returns the candidate count.  min==0 is bumped to 1 (Q1), matching
+// engines.process_word.
+int64_t a5_oracle_process_word(void* table, const uint8_t* word, int32_t wlen,
+                               int32_t min_sub, int32_t max_sub,
+                               int64_t chunk_bytes, a5_sink_fn sink,
+                               void* ctx) {
+  const Table& t = *static_cast<Table*>(table);
+  if (min_sub == 0) min_sub = 1;
+  Emit e{std::string(), static_cast<size_t>(chunk_bytes), sink, ctx};
+  e.out.reserve(static_cast<size_t>(chunk_bytes) + 256);
+  std::string w(reinterpret_cast<const char*>(word),
+                static_cast<size_t>(wlen));
+  if (t.kmax > 0) generate(t, e, w, 0, 0, min_sub, max_sub);
+  e.flush();
+  return e.count;
+}
+
+}  // extern "C"

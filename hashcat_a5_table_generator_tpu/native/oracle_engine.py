@@ -1,0 +1,232 @@
+"""ctypes binding for the native default-mode oracle engine (oracle.cpp).
+
+The reference's primary path (engine A, the recursive DFS) is the hot
+loop of ``--backend oracle`` candidates mode; the Python generators are
+the parity ANCHOR but cost ~4e5 candidates/s/core.  This binding streams
+the identical byte stream from C++ at an order of magnitude more — and
+falls back to the Python engine whenever the toolchain, the build, or
+the mode doesn't fit (``A5_NATIVE=0`` forces the fallback, same knob as
+the packer).
+
+Scope: default mode only (no ``bug_compat`` concerns — Q3 is a
+reverse-mode bug), raw byte output (``$HEX[]`` wrapping keeps the Python
+path).  tests/test_native.py pins the stream byte-for-byte against
+``oracle.engines.process_word`` across the quirk suite.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import pathlib
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional, Sequence
+
+_SRC = pathlib.Path(__file__).with_name("oracle.cpp")
+_ABI = 2
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+_SINK_FN = ctypes.CFUNCTYPE(
+    ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8), ctypes.c_int64,
+    ctypes.c_void_p
+)
+
+#: Chunk granularity for the candidate stream callback.
+_CHUNK_BYTES = 1 << 18
+
+
+def _cache_dir() -> pathlib.Path:
+    root = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    return pathlib.Path(root) / "a5native"
+
+
+def _build() -> Optional[pathlib.Path]:
+    src = _SRC.read_bytes()
+    tag = hashlib.sha256(src).hexdigest()[:16]
+    out = _cache_dir() / f"liba5oracle-{tag}.so"
+    if out.exists():
+        return out
+    out.parent.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(f".tmp{os.getpid()}.so")
+    cmd = [
+        # c++20: heterogeneous unordered_map lookup (string_view probes
+        # without a per-probe std::string allocation).
+        "g++", "-O3", "-std=c++20", "-shared", "-fPIC",
+        "-o", str(tmp), str(_SRC),
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        print(
+            f"a5native: oracle build failed ({e}); using the Python engine",
+            file=sys.stderr,
+        )
+        return None
+    os.replace(tmp, out)
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """The native oracle library, building on first use; None => Python."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("A5_NATIVE", "1") == "0":
+        return None
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(path))
+    except OSError as e:
+        print(f"a5native: oracle load failed ({e}); using the Python engine",
+              file=sys.stderr)
+        return None
+    if lib.a5_oracle_abi() != _ABI:
+        print("a5native: oracle ABI mismatch; using the Python engine",
+              file=sys.stderr)
+        return None
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.a5_oracle_table_new.argtypes = [
+        u8p, i32p, ctypes.c_int32, u8p, i32p, i32p,
+    ]
+    lib.a5_oracle_table_new.restype = ctypes.c_void_p
+    lib.a5_oracle_table_free.argtypes = [ctypes.c_void_p]
+    lib.a5_oracle_table_free.restype = None
+    lib.a5_oracle_process_word.argtypes = [
+        ctypes.c_void_p, u8p, ctypes.c_int32, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int64, _SINK_FN, ctypes.c_void_p,
+    ]
+    lib.a5_oracle_process_word.restype = ctypes.c_int64
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+#: Recursion in the C++ engine is one frame per substitution; cap the
+#: window so a pathological --table-max cannot blow the native stack
+#: (the Python engine handles larger windows, failing with a clean
+#: RecursionError where applicable).
+MAX_NATIVE_SUBST = 512
+
+
+def default_engine_eligible(
+    sub_map: Dict[bytes, Sequence[bytes]],
+    *,
+    substitute_all: bool,
+    reverse: bool,
+    crack: bool,
+    hex_unsafe: bool,
+    max_substitute: int,
+) -> bool:
+    """The ONE eligibility predicate for the native engine-A stream,
+    shared by the CLI and the --threads workers (they must never drift:
+    both paths must pick the same engine for the same input).  Default
+    mode, candidates output, no $HEX[] wrapping (per-candidate inspection
+    stays Python), bounded window (native stack), and no table value
+    embedding line terminators (the stream counts candidates by
+    newline)."""
+    return (
+        not crack
+        and not hex_unsafe
+        and not substitute_all
+        and not reverse
+        and 0 <= max_substitute <= MAX_NATIVE_SUBST
+        and all(
+            b"\n" not in v and b"\r" not in v
+            for vals in sub_map.values() for v in vals
+        )
+    )
+
+
+class NativeDefaultOracle:
+    """One compiled table, reusable across words (default engine only).
+
+    ``stream_word(word, min_sub, max_sub, sink)`` calls ``sink(chunk)``
+    with newline-terminated candidate chunks in exact engine-A order and
+    returns the candidate count.
+    """
+
+    def __init__(self, sub_map: Dict[bytes, Sequence[bytes]]) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native oracle unavailable")
+        self._lib = lib
+        keys = list(sub_map.keys())
+        keys_blob = b"".join(keys)
+        key_lens = (ctypes.c_int32 * len(keys))(*[len(k) for k in keys])
+        vals: List[bytes] = []
+        val_start = [0]
+        for k in keys:
+            vals.extend(sub_map[k])
+            val_start.append(len(vals))
+        vals_blob = b"".join(vals)
+        val_lens = (ctypes.c_int32 * max(1, len(vals)))(
+            *([len(v) for v in vals] or [0])
+        )
+        starts = (ctypes.c_int32 * (len(keys) + 1))(*val_start)
+        kb = (ctypes.c_uint8 * max(1, len(keys_blob))).from_buffer_copy(
+            keys_blob or b"\0"
+        )
+        vb = (ctypes.c_uint8 * max(1, len(vals_blob))).from_buffer_copy(
+            vals_blob or b"\0"
+        )
+        self._table = lib.a5_oracle_table_new(
+            kb, key_lens, len(keys), vb, val_lens, starts
+        )
+        if not self._table:
+            raise RuntimeError("native oracle table construction failed")
+
+    def stream_word(
+        self,
+        word: bytes,
+        min_sub: int,
+        max_sub: int,
+        sink: Callable[[bytes], None],
+    ) -> int:
+        # ctypes callbacks cannot raise through the C frame: capture the
+        # sink's exception, tell the C++ loop to ABORT (nonzero return),
+        # and re-raise here — a BrokenPipeError/ENOSPC/interrupt must not
+        # silently truncate the stream while reporting success.
+        err: list = []
+
+        def _cb(data, length, _ctx):
+            try:
+                sink(ctypes.string_at(data, length))
+                return 0
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err.append(e)
+                return 1
+
+        cb = _SINK_FN(_cb)  # keep alive for the call's duration
+        wb = (ctypes.c_uint8 * max(1, len(word))).from_buffer_copy(
+            word or b"\0"
+        )
+        n = int(self._lib.a5_oracle_process_word(
+            self._table, wb, len(word), min_sub, max_sub,
+            _CHUNK_BYTES, cb, None,
+        ))
+        if err:
+            raise err[0]
+        return n
+
+    def close(self) -> None:
+        if getattr(self, "_table", None):
+            self._lib.a5_oracle_table_free(self._table)
+            self._table = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,6 +1,9 @@
 """Native C++ scanner/packer vs the numpy reference: bit-identical outputs
 on every line-structure edge, the anti-Q8 error, and the fallback path."""
 
+import os
+import pathlib
+
 import numpy as np
 import pytest
 
@@ -116,3 +119,103 @@ def test_native_builds_here():
     # This environment ships g++ (per the build brief); the native path must
     # actually engage in CI here, not silently fall back.
     assert native.available()
+
+
+class TestNativeDefaultOracle:
+    """The C++ engine-A oracle must be byte-for-byte identical to
+    oracle.engines.process_word — stream order, duplicates (Q7),
+    longest-first probing (Q5), no-rematch-of-replacement (Q6), window
+    edges, binary bytes, length-changing values."""
+
+    TABLES = [
+        {b"a": [b"4", b"@"], b"s": [b"$", b"5"], b"e": [b"3"]},
+        {b"ss": [b"\xc3\x9f"], b"s": [b"z"], b"a": [b"\xc3\xa4"]},
+        {b"a": [b"4", b"4"]},                      # duplicate options (Q7)
+        {b"ab": [b"X"], b"b": [b"Y"], b"a": [b"Z"]},  # overlap, longest-first
+        {b"a": [b""], b"b": [b"bb"]},              # shrink + grow values
+        {b"\x00": [b"\xff"], b"\xff\xfe": [b"\x00\x01"]},  # raw bytes
+        {b"a": [b"ba"]},                           # value contains a key
+    ]
+    WORDS = [b"", b"x", b"glass", b"assassin", b"abab", b"aaaa",
+             b"\x00\xff\xfe\x00", b"banana"]
+
+    def _engine(self, sub):
+        from hashcat_a5_table_generator_tpu.native.oracle_engine import (
+            NativeDefaultOracle,
+            available,
+        )
+
+        if not available():
+            pytest.skip("no native toolchain")
+        return NativeDefaultOracle(sub)
+
+    @pytest.mark.parametrize("ti", range(7))
+    def test_stream_parity(self, ti):
+        import io
+
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            process_word,
+        )
+
+        sub = self.TABLES[ti]
+        eng = self._engine(sub)
+        for word in self.WORDS:
+            for lo, hi in [(0, 15), (1, 1), (2, 3), (0, 0), (3, 2)]:
+                want = b"".join(
+                    c + b"\n" for c in process_word(word, sub, lo, hi)
+                )
+                got = io.BytesIO()
+                n = eng.stream_word(word, lo, hi, got.write)
+                assert got.getvalue() == want, (ti, word, lo, hi)
+                assert n == want.count(b"\n")
+
+    def test_cli_fast_path_matches_python(self, tmp_path, monkeypatch):
+        """The CLI's native fast path and the Python loop emit identical
+        bytes (A5_NATIVE toggles the engine, never the stream)."""
+        import subprocess
+        import sys as _sys
+
+        table = tmp_path / "t.table"
+        table.write_bytes(b"a=4\na=@\ns=$\nss=\xc3\x9f\n")
+        dict_file = tmp_path / "d.txt"
+        dict_file.write_bytes(b"glass\nassassin\nsassy\n")
+        driver = (
+            "import sys\n"
+            "from hashcat_a5_table_generator_tpu.cli import main\n"
+            "sys.exit(main(sys.argv[1:]))"
+        )
+        outs = {}
+        for nat in ("1", "0"):
+            env = dict(os.environ)
+            env["A5_NATIVE"] = nat
+            env["PYTHONPATH"] = (
+                str(pathlib.Path(__file__).resolve().parent.parent)
+                + os.pathsep + env.get("PYTHONPATH", "")
+            )
+            r = subprocess.run(
+                [_sys.executable, "-c", driver, str(dict_file),
+                 "-t", str(table), "--backend", "oracle"],
+                env=env, capture_output=True, timeout=120,
+            )
+            assert r.returncode == 0, r.stderr[-800:]
+            outs[nat] = r.stdout
+        assert outs["1"] == outs["0"]
+        assert outs["1"].count(b"\n") > 10
+
+    def test_eligibility_gate(self):
+        from hashcat_a5_table_generator_tpu.cli import (
+            native_default_eligible,
+        )
+
+        sub = {b"a": [b"4"]}
+        assert native_default_eligible(sub, "default", False, False)
+        assert not native_default_eligible(sub, "default", True, False)
+        assert not native_default_eligible(sub, "default", False, True)
+        assert not native_default_eligible(sub, "suball", False, False)
+        assert not native_default_eligible(
+            {b"a": [b"\n"]}, "default", False, False
+        )
+        # Pathological windows keep the Python engine (native stack cap).
+        assert not native_default_eligible(
+            sub, "default", False, False, 100000
+        )
