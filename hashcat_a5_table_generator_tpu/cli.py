@@ -465,12 +465,14 @@ def _run_oracle(args, sub_map, words) -> int:
         return 0
     native_eng = _native_default_engine(args, sub_map, mode, crack)
     if native_eng is not None:
-        # Engine A (default mode) streams from the C++ oracle — the same
-        # byte stream ~17x faster (native/oracle.cpp; parity pinned by
-        # tests/test_native.py).
+        # Engines A and C (default / substitute-all) stream from the C++
+        # oracle — the same byte stream ~17x faster (native/oracle.cpp;
+        # parity pinned by tests/test_native.py).
+        stream = (native_eng.stream_word_suball if mode == "suball"
+                  else native_eng.stream_word)
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
             for word in words:
-                native_eng.stream_word(
+                stream(
                     word, args.table_min, args.table_max,
                     lambda b: writer.write_block(b, b.count(b"\n")),
                 )

@@ -10,6 +10,7 @@
 // through a chunk callback so candidate floods never materialize in one
 // allocation.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <string>
@@ -33,6 +34,10 @@ struct Table {
   std::unordered_map<std::string, std::vector<std::string>, SvHash,
                      std::equal_to<>>
       map;
+  // Keys in ascending byte order (== Python sorted(bytes)) — the
+  // substitute-all engines enumerate and cascade in this order (Q4
+  // canonicalization, mirroring engines.unique_patterns_in_word).
+  std::vector<std::string> sorted_keys;
   size_t kmax = 0;
 };
 
@@ -95,11 +100,80 @@ void generate(const Table& t, Emit& e, const std::string& current, int count,
   }
 }
 
+// Python bytes.replace semantics, including the empty-pattern case
+// (b"abc".replace(b"", b"X") == b"XaXbXcX") — the oracle engines' spec is
+// the PYTHON anchor, which canonicalizes the reference's Go behavior.
+std::string replace_all(const std::string& s, const std::string& pat,
+                        const std::string& rep) {
+  std::string out;
+  if (pat.empty()) {
+    out.reserve(s.size() + (s.size() + 1) * rep.size());
+    out.append(rep);
+    for (char c : s) {
+      out.push_back(c);
+      out.append(rep);
+    }
+    return out;
+  }
+  out.reserve(s.size());
+  size_t pos = 0;
+  while (true) {
+    size_t hit = s.find(pat, pos);
+    if (hit == std::string::npos) {
+      out.append(s, pos, s.size() - pos);
+      return out;
+    }
+    out.append(s, pos, hit - pos);
+    out.append(rep);
+    pos = hit + pat.size();
+  }
+}
+
+struct SuballCtx {
+  const std::string* word;
+  const std::vector<const std::string*>* patterns;  // sorted, present
+  const std::vector<const std::vector<std::string>*>* options;
+  std::vector<const std::string*> chosen;  // per pattern, null = skip
+  int min_sub, max_sub;
+  Emit* e;
+};
+
+// Mirrors engines.process_word_substitute_all's generate(): options
+// first (in table order), then skip; leaf emits the sorted-order
+// ReplaceAll cascade when the chosen count is in [min, max].
+void gen_suball(SuballCtx& c, size_t pos, int count) {
+  if (c.e->aborted) return;
+  if (pos >= c.patterns->size()) {
+    if (count >= c.min_sub && count <= c.max_sub) {
+      std::string result = *c.word;
+      for (size_t p = 0; p < c.patterns->size(); ++p) {
+        if (c.chosen[p] != nullptr)
+          result = replace_all(result, *(*c.patterns)[p], *c.chosen[p]);
+      }
+      c.e->line(result);
+    }
+    return;
+  }
+  // Prune option branches that already exceed the window: count never
+  // decreases along a path, so such subtrees cannot emit (identical
+  // output to the unpruned Python anchor, exponentially less dead work
+  // for tight windows over many patterns).
+  if (count + 1 <= c.max_sub) {
+    for (const std::string& sub : *(*c.options)[pos]) {
+      c.chosen[pos] = &sub;
+      gen_suball(c, pos + 1, count + 1);
+      if (c.e->aborted) return;
+    }
+  }
+  c.chosen[pos] = nullptr;
+  gen_suball(c, pos + 1, count);
+}
+
 }  // namespace
 
 extern "C" {
 
-int32_t a5_oracle_abi() { return 2; }
+int32_t a5_oracle_abi() { return 3; }
 
 // Flattened table: nk keys (keys_blob + key_lens), each key's options are
 // value rows [val_start[k], val_start[k+1]) into (vals_blob + val_lens).
@@ -122,8 +196,10 @@ void* a5_oracle_table_new(const uint8_t* keys_blob, const int32_t* key_lens,
                         static_cast<size_t>(val_lens[v]));
     }
     if (key.size() > t->kmax) t->kmax = key.size();
+    t->sorted_keys.push_back(key);
     t->map.emplace(std::move(key), std::move(vals));
   }
+  std::sort(t->sorted_keys.begin(), t->sorted_keys.end());
   return t;
 }
 
@@ -144,6 +220,38 @@ int64_t a5_oracle_process_word(void* table, const uint8_t* word, int32_t wlen,
   std::string w(reinterpret_cast<const char*>(word),
                 static_cast<size_t>(wlen));
   if (t.kmax > 0) generate(t, e, w, 0, 0, min_sub, max_sub);
+  e.flush();
+  return e.count;
+}
+
+// Substitute-all engine over one word (engine C,
+// engines.process_word_substitute_all): per unique PRESENT pattern
+// (ascending byte order), choose one option or skip; leaves in-window
+// emit the sorted-order ReplaceAll cascade.  No Q1 bump here — suball
+// emits the original word at min == 0.
+int64_t a5_oracle_suball_word(void* table, const uint8_t* word, int32_t wlen,
+                              int32_t min_sub, int32_t max_sub,
+                              int64_t chunk_bytes, a5_sink_fn sink,
+                              void* ctx) {
+  const Table& t = *static_cast<Table*>(table);
+  Emit e{std::string(), static_cast<size_t>(chunk_bytes), sink, ctx};
+  e.out.reserve(static_cast<size_t>(chunk_bytes) + 256);
+  std::string w(reinterpret_cast<const char*>(word),
+                static_cast<size_t>(wlen));
+  // Present patterns, sorted (mirrors unique_patterns_in_word: an empty
+  // key matches any non-empty word).
+  std::vector<const std::string*> patterns;
+  std::vector<const std::vector<std::string>*> options;
+  for (const std::string& k : t.sorted_keys) {
+    bool present = k.empty() ? !w.empty() : w.find(k) != std::string::npos;
+    if (!present) continue;
+    patterns.push_back(&k);
+    options.push_back(&t.map.find(std::string_view(k))->second);
+  }
+  SuballCtx c{&w, &patterns, &options,
+              std::vector<const std::string*>(patterns.size(), nullptr),
+              min_sub, max_sub, &e};
+  gen_suball(c, 0, 0);
   e.flush();
   return e.count;
 }

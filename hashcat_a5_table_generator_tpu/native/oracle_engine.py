@@ -25,7 +25,7 @@ import sys
 from typing import Callable, Dict, List, Optional, Sequence
 
 _SRC = pathlib.Path(__file__).with_name("oracle.cpp")
-_ABI = 2
+_ABI = 3
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
@@ -105,6 +105,8 @@ def load() -> Optional[ctypes.CDLL]:
         ctypes.c_int32, ctypes.c_int64, _SINK_FN, ctypes.c_void_p,
     ]
     lib.a5_oracle_process_word.restype = ctypes.c_int64
+    lib.a5_oracle_suball_word.argtypes = lib.a5_oracle_process_word.argtypes
+    lib.a5_oracle_suball_word.restype = ctypes.c_int64
     _lib = lib
     return _lib
 
@@ -113,11 +115,15 @@ def available() -> bool:
     return load() is not None
 
 
-#: Recursion in the C++ engine is one frame per substitution; cap the
-#: window so a pathological --table-max cannot blow the native stack
-#: (the Python engine handles larger windows, failing with a clean
+#: Recursion in the C++ default engine is one frame per substitution;
+#: cap the window so a pathological --table-max cannot blow the native
+#: stack (the Python engine handles larger windows, failing with a clean
 #: RecursionError where applicable).
 MAX_NATIVE_SUBST = 512
+
+#: The suball engine recurses once per PRESENT pattern — bound the table
+#: size so pathological key counts keep the Python engine.
+MAX_NATIVE_SUBALL_PATTERNS = 4096
 
 
 def default_engine_eligible(
@@ -129,19 +135,22 @@ def default_engine_eligible(
     hex_unsafe: bool,
     max_substitute: int,
 ) -> bool:
-    """The ONE eligibility predicate for the native engine-A stream,
+    """The ONE eligibility predicate for the native candidate stream,
     shared by the CLI and the --threads workers (they must never drift:
-    both paths must pick the same engine for the same input).  Default
-    mode, candidates output, no $HEX[] wrapping (per-candidate inspection
-    stays Python), bounded window (native stack), and no table value
-    embedding line terminators (the stream counts candidates by
-    newline)."""
+    both paths must pick the same engine for the same input).  Default or
+    substitute-all mode (the reverse engines keep Python: Q2/Q3 bug
+    modeling and panic semantics), candidates output, no $HEX[] wrapping
+    (per-candidate inspection stays Python), bounded window (native
+    stack: per-substitution frames in engine A, per-present-pattern
+    frames in engine C), and no table value embedding line terminators
+    (the stream counts candidates by newline)."""
     return (
         not crack
         and not hex_unsafe
-        and not substitute_all
         and not reverse
         and 0 <= max_substitute <= MAX_NATIVE_SUBST
+        and (not substitute_all
+             or len(sub_map) <= MAX_NATIVE_SUBALL_PATTERNS)
         and all(
             b"\n" not in v and b"\r" not in v
             for vals in sub_map.values() for v in vals
@@ -187,17 +196,14 @@ class NativeDefaultOracle:
         if not self._table:
             raise RuntimeError("native oracle table construction failed")
 
-    def stream_word(
-        self,
-        word: bytes,
-        min_sub: int,
-        max_sub: int,
-        sink: Callable[[bytes], None],
-    ) -> int:
-        # ctypes callbacks cannot raise through the C frame: capture the
-        # sink's exception, tell the C++ loop to ABORT (nonzero return),
-        # and re-raise here — a BrokenPipeError/ENOSPC/interrupt must not
-        # silently truncate the stream while reporting success.
+    def _stream(self, c_fn, word: bytes, min_sub: int, max_sub: int,
+                sink: Callable[[bytes], None]) -> int:
+        """Shared ctypes plumbing for both engines.
+
+        ctypes callbacks cannot raise through the C frame: capture the
+        sink's exception, tell the C++ loop to ABORT (nonzero return),
+        and re-raise here — a BrokenPipeError/ENOSPC/interrupt must not
+        silently truncate the stream while reporting success."""
         err: list = []
 
         def _cb(data, length, _ctx):
@@ -212,13 +218,109 @@ class NativeDefaultOracle:
         wb = (ctypes.c_uint8 * max(1, len(word))).from_buffer_copy(
             word or b"\0"
         )
-        n = int(self._lib.a5_oracle_process_word(
+        n = int(c_fn(
             self._table, wb, len(word), min_sub, max_sub,
             _CHUNK_BYTES, cb, None,
         ))
         if err:
             raise err[0]
         return n
+
+    def stream_word(
+        self,
+        word: bytes,
+        min_sub: int,
+        max_sub: int,
+        sink: Callable[[bytes], None],
+    ) -> int:
+        return self._stream(self._lib.a5_oracle_process_word, word,
+                            min_sub, max_sub, sink)
+
+    def stream_word_suball(
+        self,
+        word: bytes,
+        min_sub: int,
+        max_sub: int,
+        sink: Callable[[bytes], None],
+    ) -> int:
+        """Engine C (substitute-all) stream — same contract as
+        :meth:`stream_word`, mirroring
+        ``engines.process_word_substitute_all`` byte-for-byte."""
+        return self._stream(self._lib.a5_oracle_suball_word, word,
+                            min_sub, max_sub, sink)
+
+    def iter_word(self, word: bytes, min_sub: int, max_sub: int,
+                  *, substitute_all: bool = False):
+        """LAZY per-candidate iterator over the native stream (the
+        sweep's oracle-fallback path consumes candidates one by one).
+
+        The C++ enumeration runs on a producer thread pushing chunks into
+        a small bounded queue (ctypes releases the GIL during the C call,
+        so producer and consumer genuinely overlap); closing the
+        generator aborts the enumeration through the sink protocol — a
+        huge hazard word neither buffers unboundedly nor outlives its
+        consumer."""
+        import queue as queue_mod
+        import threading
+
+        q: "queue_mod.Queue" = queue_mod.Queue(maxsize=4)
+        stop = threading.Event()
+        DONE = object()
+
+        class _Abort(BaseException):
+            pass
+
+        def sink(blob: bytes) -> None:
+            while True:
+                if stop.is_set():
+                    raise _Abort()
+                try:
+                    q.put(blob, timeout=0.1)
+                    return
+                except queue_mod.Full:
+                    continue
+
+        def produce() -> None:
+            try:
+                if substitute_all:
+                    self.stream_word_suball(word, min_sub, max_sub, sink)
+                else:
+                    self.stream_word(word, min_sub, max_sub, sink)
+            except _Abort:
+                pass
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                try:
+                    q.put(e, timeout=5.0)
+                except queue_mod.Full:
+                    pass
+            while True:  # DONE must land even against a full queue
+                if stop.is_set():
+                    return
+                try:
+                    q.put(DONE, timeout=0.1)
+                    return
+                except queue_mod.Full:
+                    continue
+
+        th = threading.Thread(target=produce, daemon=True,
+                              name="a5-native-oracle")
+        th.start()
+        try:
+            while True:
+                item = q.get()
+                if item is DONE:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield from item.split(b"\n")[:-1]
+        finally:
+            stop.set()
+            while th.is_alive():  # drain so the producer can exit
+                try:
+                    q.get_nowait()
+                except queue_mod.Empty:
+                    pass
+                th.join(timeout=0.05)
 
     def close(self) -> None:
         if getattr(self, "_table", None):

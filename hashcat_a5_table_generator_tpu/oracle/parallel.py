@@ -51,9 +51,10 @@ def _worker_candidates(
     """Expand words ``wid, wid+N, ...``; emit per-word encoded chunks
     ``(word_idx, (blob, n_candidates), last)`` in word order.
 
-    Default-mode, non-``$HEX[]`` runs use the native C++ engine when the
-    toolchain provides it — same byte stream, ~17x faster (the parent's
-    eligibility mirrors :func:`cli.native_default_eligible`)."""
+    Default and substitute-all non-``$HEX[]`` runs use the native C++
+    engines when the toolchain provides them — same byte stream, ~17x
+    faster (the ONE shared predicate:
+    ``native.oracle_engine.default_engine_eligible``)."""
     from ..runtime.sinks import CandidateWriter
     from .engines import iter_candidates
 
@@ -82,7 +83,10 @@ def _worker_candidates(
             if native is not None:
                 # Stream chunks straight to the queue (bounded memory for
                 # huge words); an empty final marker closes the word.
-                native.stream_word(
+                stream = (native.stream_word_suball
+                          if kw.get("substitute_all")
+                          else native.stream_word)
+                stream(
                     words[i], kw.get("min_substitute", 0),
                     kw.get("max_substitute", 15),
                     lambda blob: out_q.put(

@@ -211,7 +211,11 @@ class TestNativeDefaultOracle:
         assert native_default_eligible(sub, "default", False, False)
         assert not native_default_eligible(sub, "default", True, False)
         assert not native_default_eligible(sub, "default", False, True)
-        assert not native_default_eligible(sub, "suball", False, False)
+        assert native_default_eligible(sub, "suball", False, False)
+        assert not native_default_eligible(sub, "reverse", False, False)
+        assert not native_default_eligible(
+            sub, "suball-reverse", False, False
+        )
         assert not native_default_eligible(
             {b"a": [b"\n"]}, "default", False, False
         )
@@ -219,3 +223,93 @@ class TestNativeDefaultOracle:
         assert not native_default_eligible(
             sub, "default", False, False, 100000
         )
+
+
+class TestNativeSuballOracle:
+    """Engine C (substitute-all) native parity: byte-for-byte against
+    process_word_substitute_all across cascade interactions, empty
+    keys/values, window edges, and the per-candidate iterator the
+    sweep's hazard-fallback path consumes."""
+
+    TABLES = [
+        {b"a": [b"4", b"@"], b"s": [b"$"], b"e": [b"3"]},
+        {b"ss": [b"\xc3\x9f"], b"s": [b"z"]},
+        {b"a": [b""], b"": [b"Q"]},
+        {b"a": [b"ba"], b"b": [b"ab"]},   # cascade interactions (Q4 order)
+        {b"x": [b"y", b"y"]},             # duplicate options (Q7)
+    ]
+    WORDS = [b"", b"x", b"glass", b"assassin", b"abab", b"banana"]
+
+    @pytest.mark.parametrize("ti", range(5))
+    def test_stream_and_iter_parity(self, ti):
+        import io
+
+        from hashcat_a5_table_generator_tpu.native.oracle_engine import (
+            NativeDefaultOracle,
+            available,
+        )
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            process_word_substitute_all,
+        )
+
+        if not available():
+            pytest.skip("no native toolchain")
+        sub = self.TABLES[ti]
+        eng = NativeDefaultOracle(sub)
+        for word in self.WORDS:
+            for lo, hi in [(0, 15), (0, 0), (1, 2), (2, 2), (3, 1)]:
+                want = list(process_word_substitute_all(word, sub, lo, hi))
+                got = io.BytesIO()
+                n = eng.stream_word_suball(word, lo, hi, got.write)
+                assert got.getvalue() == b"".join(
+                    c + b"\n" for c in want
+                ), (ti, word, lo, hi)
+                assert n == len(want)
+                assert list(eng.iter_word(word, lo, hi,
+                                          substitute_all=True)) == want
+
+    def test_sweep_fallback_uses_native_and_matches(self):
+        """A hazard table routes words through the oracle fallback; the
+        sweep's candidate stream (native iterator) must equal the pure
+        Python sweep's (A5_NATIVE path toggled via monkeypatched cache)."""
+        import io
+
+        from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+        from hashcat_a5_table_generator_tpu.runtime.sinks import (
+            CandidateWriter,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.sweep import (
+            Sweep,
+            SweepConfig,
+        )
+
+        from hashcat_a5_table_generator_tpu.native.oracle_engine import (
+            available,
+        )
+
+        if not available():
+            pytest.skip("no native toolchain")
+        # german-style hazard: ss -> ß while s -> z cascades.
+        sub = {b"ss": [b"\xc3\x9f"], b"s": [b"z"], b"a": [b"4"]}
+        words = [b"glass", b"assess", b"sassy"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        cfg = SweepConfig(lanes=64, num_blocks=16)
+
+        native_engaged = []
+
+        def run(native: bool):
+            sweep = Sweep(spec, sub, words, (), config=cfg)
+            if not native:
+                sweep._native_oracle_cache = None  # force Python engines
+            assert sweep.fallback_rows  # the hazard actually routes
+            buf = io.BytesIO()
+            w = CandidateWriter(buf)
+            sweep.run_candidates(w, resume=False)
+            w.flush()
+            if native:
+                native_engaged.append(sweep._native_oracle_cache)
+            return buf.getvalue()
+
+        assert run(True) == run(False)
+        # The native path must have actually engaged, not fallen back.
+        assert native_engaged and native_engaged[0] is not None
