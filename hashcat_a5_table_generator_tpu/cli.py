@@ -402,10 +402,14 @@ def native_default_eligible(sub_map, mode: str, crack: bool,
     )
 
 
-def _native_default_engine(args, sub_map, mode: str, crack: bool):
+def _native_default_engine(args, sub_map, mode: str, crack: bool,
+                           hex_unsafe: "bool | None" = None):
     """A ready NativeDefaultOracle, or None (ineligible / no toolchain /
-    A5_NATIVE=0 — the Python engines remain the behavior)."""
-    if not native_default_eligible(sub_map, mode, crack, args.hex_unsafe,
+    A5_NATIVE=0 — the Python engines remain the behavior).
+    ``hex_unsafe`` overrides the flag for callers whose output never
+    wraps (crack's potfile lines)."""
+    hu = args.hex_unsafe if hex_unsafe is None else hex_unsafe
+    if not native_default_eligible(sub_map, mode, crack, hu,
                                    args.table_max):
         return None
     try:
@@ -481,10 +485,27 @@ def _run_oracle(args, sub_map, words) -> int:
         _read_digests(args.digests, args.algo) if crack else ()
     )
     host_digest = HOST_DIGEST[args.algo]
+    # Crack mode iterates candidates (hash + membership per candidate);
+    # generation dominates that loop, so the native engines feed it too
+    # when the mode fits (output identical; only the iterator changes).
+    crack_native = (
+        _native_default_engine(args, sub_map, mode, crack=False,
+                               hex_unsafe=False)
+        if crack and mode in ("default", "suball") else None
+    )
+
+    def word_iter(word):
+        if crack_native is not None:
+            return crack_native.iter_word(
+                word, args.table_min, args.table_max,
+                substitute_all=mode == "suball",
+            )
+        return iter_candidates(word, sub_map, **iter_kw)
+
     n_hits = 0
     with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
         for word in words:
-            for cand in iter_candidates(word, sub_map, **iter_kw):
+            for cand in word_iter(word):
                 if crack:
                     dig = host_digest(cand)
                     if dig in digest_set:

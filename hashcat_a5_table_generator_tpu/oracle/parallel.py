@@ -39,6 +39,33 @@ _QUEUE_DEPTH = 8
 _ERROR = -1  # sentinel word index carrying a worker traceback
 
 
+def _maybe_native(sub_map, kw: dict, *, hex_unsafe: bool):
+    """A NativeDefaultOracle when the ONE shared predicate admits this
+    mode/config, else None — the single engine-selection point for both
+    worker kinds (candidates pass their writer's hex_unsafe; crack passes
+    False, since potfile hit lines never $HEX[]-wrap candidates)."""
+    try:
+        from ..native.oracle_engine import (
+            NativeDefaultOracle,
+            available,
+            default_engine_eligible,
+        )
+
+        if default_engine_eligible(
+            sub_map,
+            substitute_all=bool(kw.get("substitute_all")),
+            reverse=bool(kw.get("reverse")),
+            crack=False,
+            hex_unsafe=hex_unsafe,
+            max_substitute=int(kw.get("max_substitute", 15)),
+        ) and available():
+            return NativeDefaultOracle(sub_map)
+    except Exception:  # pragma: no cover - toolchain-dependent
+        pass
+    return None
+
+
+
 def _worker_candidates(
     wid: int,
     n_workers: int,
@@ -58,25 +85,7 @@ def _worker_candidates(
     from ..runtime.sinks import CandidateWriter
     from .engines import iter_candidates
 
-    native = None
-    try:
-        from ..native.oracle_engine import (
-            NativeDefaultOracle,
-            available,
-            default_engine_eligible,
-        )
-
-        if default_engine_eligible(
-            sub_map,
-            substitute_all=bool(kw.get("substitute_all")),
-            reverse=bool(kw.get("reverse")),
-            crack=False,
-            hex_unsafe=hex_unsafe,
-            max_substitute=int(kw.get("max_substitute", 15)),
-        ) and available():
-            native = NativeDefaultOracle(sub_map)
-    except Exception:  # pragma: no cover - toolchain-dependent
-        native = None
+    native = _maybe_native(sub_map, kw, hex_unsafe=hex_unsafe)
 
     try:
         for i in range(wid, len(words), n_workers):
@@ -124,16 +133,29 @@ def _worker_crack(
     out_q: "mp.Queue",
 ) -> None:
     """Hash every candidate of this worker's words; emit per-word hit
-    lists ``(word_idx, [(digest_hex, cand)], True)``."""
+    lists ``(word_idx, [(digest_hex, cand)], True)``.  Generation feeds
+    from the native engines when the mode fits (hashing stays Python —
+    hashlib's C MD5 — but generation dominated the loop)."""
     from ..utils.digests import HOST_DIGEST
     from .engines import iter_candidates
+
+    native = _maybe_native(sub_map, kw, hex_unsafe=False)
+
+    def word_iter(word):
+        if native is not None:
+            return native.iter_word(
+                word, kw.get("min_substitute", 0),
+                kw.get("max_substitute", 15),
+                substitute_all=bool(kw.get("substitute_all")),
+            )
+        return iter_candidates(word, sub_map, **kw)
 
     try:
         lookup = digests  # a HostDigestLookup, built once pre-fork (COW)
         host_digest = HOST_DIGEST[algo]
         for i in range(wid, len(words), n_workers):
             hits: List[Tuple[str, bytes]] = []
-            for cand in iter_candidates(words[i], sub_map, **kw):
+            for cand in word_iter(words[i]):
                 dig = host_digest(cand)
                 if dig in lookup:
                     hits.append((dig.hex(), cand))
@@ -258,6 +280,14 @@ def run_crack_parallel(
     words = list(words)
     n_workers = max(1, min(n_workers, len(words) or 1))
     ctx = _fork_ctx()
+    # Warm the native oracle build/load ONCE pre-fork (see
+    # run_candidates_parallel): crack workers use the engine too.
+    try:
+        from ..native.oracle_engine import available as _native_available
+
+        _native_available()
+    except Exception:  # pragma: no cover - toolchain-dependent
+        pass
     # Build the sorted lookup ONCE pre-fork: workers inherit it by
     # copy-on-write instead of each re-sorting a hashmob-scale matrix.
     lookup = (digests if isinstance(digests, HostDigestLookup)
