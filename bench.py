@@ -180,6 +180,11 @@ def run_worker(args: argparse.Namespace) -> None:
     from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
     from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
+    if args.lanes > (1 << 30):
+        # Two launches must fit the device-side int32 count accumulator.
+        raise SystemExit("--lanes above 2^30 would overflow the int32 "
+                         "emitted-count accumulator")
+
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
@@ -272,12 +277,20 @@ def run_worker(args: argparse.Namespace) -> None:
         # One steady-state launch (fetch included) sizes the chunk so each
         # chunk retires in ~2 s of wall clock; per-launch time inside a
         # chunk is lower than this estimate (no per-launch round trip), so
-        # chunks only ever finish faster than sized. int32 safety: 256
-        # launches of 2^22 lanes stays under 2^31 counts.
+        # chunks only ever finish faster than sized. int32 safety: the
+        # device accumulator counts <= lanes per launch, so the cap scales
+        # with the geometry (256 at 2^22 lanes; far higher for the small
+        # CPU-fallback launches, whose fetch overhead otherwise dominates).
         t0 = time.perf_counter()
         int(acc_step(p, t, batches[1 % len(batches)], d, zero))
         per_launch = time.perf_counter() - t0
-        chunk = max(2, min(256, int(2.0 / max(per_launch, 1e-4))))
+        # 1024 absolute ceiling: the hard guard below only fires at chunk
+        # boundaries, so a chunk mis-sized by a fast sizing launch must
+        # stay within the guard's patience even at a ~100x steady-state
+        # slowdown (the r3 failure mode).
+        int32_cap = ((1 << 31) - 1) // max(args.lanes, 1)
+        chunk = max(2, min(int32_cap, 1024,
+                           int(2.0 / max(per_launch, 1e-4))))
         print(f"# [{arm_name}] sized chunks: {per_launch:.3f}s/launch -> "
               f"{chunk}/chunk", file=sys.stderr)
 
