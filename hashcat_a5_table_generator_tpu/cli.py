@@ -469,11 +469,13 @@ def _run_oracle(args, sub_map, words) -> int:
         return 0
     native_eng = _native_default_engine(args, sub_map, mode, crack)
     if native_eng is not None:
-        # Engines A and C (default / substitute-all) stream from the C++
-        # oracle — the same byte stream ~17x faster (native/oracle.cpp;
-        # parity pinned by tests/test_native.py).
-        stream = (native_eng.stream_word_suball if mode == "suball"
-                  else native_eng.stream_word)
+        # Engines A, C and D (default / substitute-all / suball-reverse)
+        # stream from the C++ oracle — the same byte stream ~17x faster
+        # (native/oracle.cpp; parity pinned by tests/test_native.py).
+        stream = {
+            "suball": native_eng.stream_word_suball,
+            "suball-reverse": native_eng.stream_word_suball_reverse,
+        }.get(mode, native_eng.stream_word)
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
             for word in words:
                 stream(
@@ -491,14 +493,16 @@ def _run_oracle(args, sub_map, words) -> int:
     crack_native = (
         _native_default_engine(args, sub_map, mode, crack=False,
                                hex_unsafe=False)
-        if crack and mode in ("default", "suball") else None
+        if crack and mode in ("default", "suball", "suball-reverse")
+        else None
     )
 
     def word_iter(word):
         if crack_native is not None:
             return crack_native.iter_word(
                 word, args.table_min, args.table_max,
-                substitute_all=mode == "suball",
+                substitute_all=mode.startswith("suball"),
+                reverse=mode == "suball-reverse",
             )
         return iter_candidates(word, sub_map, **iter_kw)
 

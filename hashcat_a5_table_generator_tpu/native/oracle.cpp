@@ -169,11 +169,45 @@ void gen_suball(SuballCtx& c, size_t pos, int count) {
   gen_suball(c, pos + 1, count);
 }
 
+// Mirrors engines.process_word_substitute_all_reverse's
+// generate_subsets(): emit the current subset when in-window, then
+// remove each still-chosen pattern from `pos` upward and recurse —
+// every subset visited exactly once, full set first.
+struct SuballRevCtx {
+  const std::string* word;
+  const std::vector<const std::string*>* patterns;  // sorted, present
+  const std::vector<const std::string*>* first_opt;  // per pattern or null
+  std::vector<char> chosen;
+  int min_sub, max_sub;
+  Emit* e;
+};
+
+void gen_suball_rev(SuballRevCtx& c, size_t pos, int count) {
+  if (c.e->aborted) return;
+  if (count < c.min_sub) return;
+  if (count <= c.max_sub) {
+    std::string result = *c.word;
+    for (size_t p = 0; p < c.patterns->size(); ++p) {
+      if (c.chosen[p])
+        result = replace_all(result, *(*c.patterns)[p], *(*c.first_opt)[p]);
+    }
+    c.e->line(result);
+  }
+  if (count <= c.min_sub) return;
+  for (size_t i = pos; i < c.patterns->size(); ++i) {
+    if (!c.chosen[i]) continue;
+    c.chosen[i] = 0;
+    gen_suball_rev(c, i + 1, count - 1);
+    c.chosen[i] = 1;
+    if (c.e->aborted) return;
+  }
+}
+
 }  // namespace
 
 extern "C" {
 
-int32_t a5_oracle_abi() { return 3; }
+int32_t a5_oracle_abi() { return 4; }
 
 // Flattened table: nk keys (keys_blob + key_lens), each key's options are
 // value rows [val_start[k], val_start[k+1]) into (vals_blob + val_lens).
@@ -252,6 +286,47 @@ int64_t a5_oracle_suball_word(void* table, const uint8_t* word, int32_t wlen,
               std::vector<const std::string*>(patterns.size(), nullptr),
               min_sub, max_sub, &e};
   gen_suball(c, 0, 0);
+  e.flush();
+  return e.count;
+}
+
+// Substitute-all REVERSE engine (engine D,
+// engines.process_word_substitute_all_reverse): start from every present
+// pattern substituted with its FIRST option (Q2) and enumerate subsets
+// down to the window floor.
+int64_t a5_oracle_suball_reverse_word(void* table, const uint8_t* word,
+                                      int32_t wlen, int32_t min_sub,
+                                      int32_t max_sub, int64_t chunk_bytes,
+                                      a5_sink_fn sink, void* ctx) {
+  const Table& t = *static_cast<Table*>(table);
+  Emit e{std::string(), static_cast<size_t>(chunk_bytes), sink, ctx};
+  e.out.reserve(static_cast<size_t>(chunk_bytes) + 256);
+  std::string w(reinterpret_cast<const char*>(word),
+                static_cast<size_t>(wlen));
+  std::vector<const std::string*> patterns;
+  std::vector<const std::string*> first_opt;
+  for (const std::string& k : t.sorted_keys) {
+    bool present = k.empty() ? !w.empty() : w.find(k) != std::string::npos;
+    if (!present) continue;
+    patterns.push_back(&k);
+    const auto& opts = t.map.find(std::string_view(k))->second;
+    first_opt.push_back(opts.empty() ? nullptr : &opts[0]);
+  }
+  // Mirrors the Python early-return: fewer PRESENT patterns than the
+  // window floor emits nothing (optionless patterns still count here).
+  if (static_cast<int>(patterns.size()) >= min_sub) {
+    int count0 = 0;
+    std::vector<char> chosen(patterns.size(), 0);
+    for (size_t p = 0; p < patterns.size(); ++p) {
+      if (first_opt[p] != nullptr) {
+        chosen[p] = 1;
+        ++count0;
+      }
+    }
+    SuballRevCtx c{&w, &patterns, &first_opt, std::move(chosen),
+                   min_sub, max_sub, &e};
+    gen_suball_rev(c, 0, count0);
+  }
   e.flush();
   return e.count;
 }

@@ -25,7 +25,7 @@ import sys
 from typing import Callable, Dict, List, Optional, Sequence
 
 _SRC = pathlib.Path(__file__).with_name("oracle.cpp")
-_ABI = 3
+_ABI = 4
 _lib: Optional[ctypes.CDLL] = None
 _lib_tried = False
 
@@ -107,6 +107,10 @@ def load() -> Optional[ctypes.CDLL]:
     lib.a5_oracle_process_word.restype = ctypes.c_int64
     lib.a5_oracle_suball_word.argtypes = lib.a5_oracle_process_word.argtypes
     lib.a5_oracle_suball_word.restype = ctypes.c_int64
+    lib.a5_oracle_suball_reverse_word.argtypes = (
+        lib.a5_oracle_process_word.argtypes
+    )
+    lib.a5_oracle_suball_reverse_word.restype = ctypes.c_int64
     _lib = lib
     return _lib
 
@@ -137,19 +141,23 @@ def default_engine_eligible(
 ) -> bool:
     """The ONE eligibility predicate for the native candidate stream,
     shared by the CLI and the --threads workers (they must never drift:
-    both paths must pick the same engine for the same input).  Default or
-    substitute-all mode (the reverse engines keep Python: Q2/Q3 bug
-    modeling and panic semantics), candidates output, no $HEX[] wrapping
+    both paths must pick the same engine for the same input).  Default,
+    substitute-all, or substitute-all-reverse mode (plain reverse —
+    engine B — keeps Python: Q3 offset-bug modeling and panic
+    semantics), candidates output, no $HEX[] wrapping
     (per-candidate inspection stays Python), bounded window (native
     stack: per-substitution frames in engine A, per-present-pattern
-    frames in engine C), and no table value embedding line terminators
-    (the stream counts candidates by newline)."""
+    frames in engines C/D), and no table value embedding line terminators
+    (the stream counts candidates by newline).  Plain reverse (engine B)
+    stays Python — it models the reference's Q3 offset bug and panic
+    semantics, which belong in the anchor; suball-reverse (engine D) has
+    no such bugs and is native."""
     return (
         not crack
         and not hex_unsafe
-        and not reverse
+        and (not reverse or substitute_all)
         and 0 <= max_substitute <= MAX_NATIVE_SUBST
-        and (not substitute_all
+        and (not (substitute_all or reverse)
              or len(sub_map) <= MAX_NATIVE_SUBALL_PATTERNS)
         and all(
             b"\n" not in v and b"\r" not in v
@@ -249,8 +257,21 @@ class NativeDefaultOracle:
         return self._stream(self._lib.a5_oracle_suball_word, word,
                             min_sub, max_sub, sink)
 
+    def stream_word_suball_reverse(
+        self,
+        word: bytes,
+        min_sub: int,
+        max_sub: int,
+        sink: Callable[[bytes], None],
+    ) -> int:
+        """Engine D (substitute-all reverse) stream, mirroring
+        ``engines.process_word_substitute_all_reverse`` byte-for-byte
+        (first option per pattern — Q2; subsets from the full set down)."""
+        return self._stream(self._lib.a5_oracle_suball_reverse_word, word,
+                            min_sub, max_sub, sink)
+
     def iter_word(self, word: bytes, min_sub: int, max_sub: int,
-                  *, substitute_all: bool = False):
+                  *, substitute_all: bool = False, reverse: bool = False):
         """LAZY per-candidate iterator over the native stream (the
         sweep's oracle-fallback path consumes candidates one by one).
 
@@ -280,12 +301,18 @@ class NativeDefaultOracle:
                 except queue_mod.Full:
                     continue
 
+        if substitute_all and reverse:
+            stream = self.stream_word_suball_reverse
+        elif substitute_all:
+            stream = self.stream_word_suball
+        elif reverse:
+            raise ValueError("plain reverse has no native engine")
+        else:
+            stream = self.stream_word
+
         def produce() -> None:
             try:
-                if substitute_all:
-                    self.stream_word_suball(word, min_sub, max_sub, sink)
-                else:
-                    self.stream_word(word, min_sub, max_sub, sink)
+                stream(word, min_sub, max_sub, sink)
             except _Abort:
                 pass
             except BaseException as e:  # noqa: BLE001 — re-raised below

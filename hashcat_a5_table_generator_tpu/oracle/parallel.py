@@ -92,9 +92,12 @@ def _worker_candidates(
             if native is not None:
                 # Stream chunks straight to the queue (bounded memory for
                 # huge words); an empty final marker closes the word.
-                stream = (native.stream_word_suball
-                          if kw.get("substitute_all")
-                          else native.stream_word)
+                if kw.get("substitute_all") and kw.get("reverse"):
+                    stream = native.stream_word_suball_reverse
+                elif kw.get("substitute_all"):
+                    stream = native.stream_word_suball
+                else:
+                    stream = native.stream_word
                 stream(
                     words[i], kw.get("min_substitute", 0),
                     kw.get("max_substitute", 15),
@@ -147,6 +150,7 @@ def _worker_crack(
                 word, kw.get("min_substitute", 0),
                 kw.get("max_substitute", 15),
                 substitute_all=bool(kw.get("substitute_all")),
+                reverse=bool(kw.get("reverse")),
             )
         return iter_candidates(word, sub_map, **kw)
 

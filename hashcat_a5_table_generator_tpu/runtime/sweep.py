@@ -286,7 +286,7 @@ class Sweep:
         if eng is not None:
             return eng.iter_word(
                 word, self.spec.min_substitute, self.spec.max_substitute,
-                substitute_all=substitute_all,
+                substitute_all=substitute_all, reverse=reverse,
             )
         return iter_candidates(
             word,

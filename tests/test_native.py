@@ -213,9 +213,8 @@ class TestNativeDefaultOracle:
         assert not native_default_eligible(sub, "default", False, True)
         assert native_default_eligible(sub, "suball", False, False)
         assert not native_default_eligible(sub, "reverse", False, False)
-        assert not native_default_eligible(
-            sub, "suball-reverse", False, False
-        )
+        # suball-reverse has no Q3 bug to model: native-eligible.
+        assert native_default_eligible(sub, "suball-reverse", False, False)
         assert not native_default_eligible(
             {b"a": [b"\n"]}, "default", False, False
         )
@@ -368,8 +367,8 @@ def test_oracle_crack_native_matches_python(tmp_path):
 
 def test_native_engines_fuzz_parity():
     """Randomized tables/words (binary bytes, multichar keys, empty and
-    multibyte values, duplicate options): both native engines must match
-    the Python anchor byte-for-byte on every sample."""
+    multibyte values, duplicate options): all three native engines must
+    match the Python anchor byte-for-byte on every sample."""
     import io
     import random
 
@@ -380,6 +379,7 @@ def test_native_engines_fuzz_parity():
     from hashcat_a5_table_generator_tpu.oracle.engines import (
         process_word,
         process_word_substitute_all,
+        process_word_substitute_all_reverse,
     )
 
     if not available():
@@ -414,3 +414,58 @@ def test_native_engines_fuzz_parity():
             got = io.BytesIO()
             eng.stream_word_suball(word, lo, hi, got.write)
             assert got.getvalue() == want_c, (trial, sub, word, lo, hi)
+            want_d = b"".join(
+                c + b"\n"
+                for c in process_word_substitute_all_reverse(
+                    word, sub, lo, hi
+                )
+            )
+            got = io.BytesIO()
+            eng.stream_word_suball_reverse(word, lo, hi, got.write)
+            assert got.getvalue() == want_d, (trial, sub, word, lo, hi)
+
+
+class TestNativeSuballReverse:
+    """Engine D (substitute-all reverse) native parity: byte-for-byte
+    against process_word_substitute_all_reverse — subset order, Q2
+    first-option, optionless patterns counting toward the floor."""
+
+    TABLES = [
+        {b"a": [b"4", b"@"], b"s": [b"$"], b"e": [b"3"]},
+        {b"ss": [b"\xc3\x9f"], b"s": [b"z"]},
+        {b"a": [b""], b"": [b"Q"]},
+        {b"a": [b"ba"], b"b": [b"ab"]},
+    ]
+    WORDS = [b"", b"x", b"glass", b"assassin", b"abab", b"banana"]
+
+    @pytest.mark.parametrize("ti", range(4))
+    def test_stream_parity(self, ti):
+        import io
+
+        from hashcat_a5_table_generator_tpu.native.oracle_engine import (
+            NativeDefaultOracle,
+            available,
+        )
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            process_word_substitute_all_reverse,
+        )
+
+        if not available():
+            pytest.skip("no native toolchain")
+        sub = self.TABLES[ti]
+        eng = NativeDefaultOracle(sub)
+        for word in self.WORDS:
+            for lo, hi in [(0, 15), (0, 0), (1, 2), (2, 2), (3, 1)]:
+                want = b"".join(
+                    c + b"\n"
+                    for c in process_word_substitute_all_reverse(
+                        word, sub, lo, hi
+                    )
+                )
+                got = io.BytesIO()
+                n = eng.stream_word_suball_reverse(word, lo, hi, got.write)
+                assert got.getvalue() == want, (ti, word, lo, hi)
+                assert n == want.count(b"\n")
+                assert list(eng.iter_word(
+                    word, lo, hi, substitute_all=True, reverse=True
+                )) == want.split(b"\n")[:-1]
