@@ -89,8 +89,10 @@ def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--lanes", type=int, default=1 << 22,
                     help="variant lanes per launch")
-    ap.add_argument("--blocks", type=int, default=32768,
-                    help="static block count per launch")
+    ap.add_argument("--blocks", type=int, default=None,
+                    help="static block count per launch (default: each arm's "
+                         "measured best geometry — xla lanes/128; pallas "
+                         "lanes/512, or lanes/256 for suball — PERF.md §9b)")
     ap.add_argument("--words", type=int, default=50000,
                     help="synthetic wordlist size")
     ap.add_argument("--seconds", type=float, default=10.0,
@@ -202,34 +204,60 @@ def run_worker(args: argparse.Namespace) -> None:
     # measure the same layout the real sweep executes): fixed-stride
     # whenever the block count divides lanes evenly (arithmetic
     # lane->block map; faster on every backend — PERF.md §4c), else packed.
+    # With --blocks unset, each arm gets its own measured-best geometry
+    # (PERF.md §9b: the XLA arm peaks at stride 128, the fused kernel at
+    # stride 512 — 256 for suball — so a shared geometry would handicap
+    # one arm and misreport the winner).
     from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
 
-    stride = SweepConfig(
-        lanes=args.lanes,
-        num_blocks=args.blocks,
-        packed_blocks={"auto": None, "packed": True, "stride": False}[
-            args.block_layout
-        ],
-    ).resolve_block_stride()
-    print(f"# block layout: {'packed' if stride is None else f'stride {stride}'}",
-          file=sys.stderr)
+    def arm_geometry(arm_name: str) -> "tuple[int, int | None]":
+        """(num_blocks, stride | None=packed) for one arm."""
+        if args.blocks is not None:
+            nb = args.blocks
+        elif args.block_layout == "packed":
+            nb = max(1, args.lanes // 128)
+        elif arm_name == "pallas":
+            pref = 256 if args.mode.startswith("suball") else 512
+            if args.lanes % pref == 0:
+                nb = args.lanes // pref
+            else:
+                nb = max(1, args.lanes // 128)
+        else:
+            nb = max(1, args.lanes // 128) if args.lanes % 128 == 0 else 1024
+        stride = SweepConfig(
+            lanes=args.lanes,
+            num_blocks=nb,
+            packed_blocks={"auto": None, "packed": True, "stride": False}[
+                args.block_layout
+            ],
+        ).resolve_block_stride()
+        return nb, stride
+
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
 
     # Pre-cut real blocks from the sweep's head (host cost excluded: the
-    # sweep runtime overlaps cutting with device execution).
-    batches = []
-    w, rank = 0, 0
-    for _ in range(args.batches):
-        batch, w, rank = make_blocks(
-            plan, start_word=w, start_rank=rank,
-            max_variants=args.lanes, max_blocks=args.blocks,
-            fixed_stride=stride,
-        )
-        if batch.total == 0:
-            break
-        batches.append(block_arrays(batch, num_blocks=args.blocks))
-    if not batches:
-        raise SystemExit("wordlist produced no variant blocks")
+    # sweep runtime overlaps cutting with device execution), cached per
+    # geometry — both arms share a cut when their geometries agree.
+    _batch_cache: dict = {}
+
+    def batches_for(nb: int, stride: "int | None") -> list:
+        key = (nb, stride)
+        if key not in _batch_cache:
+            batches = []
+            w, rank = 0, 0
+            for _ in range(args.batches):
+                batch, w, rank = make_blocks(
+                    plan, start_word=w, start_rank=rank,
+                    max_variants=args.lanes, max_blocks=nb,
+                    fixed_stride=stride,
+                )
+                if batch.total == 0:
+                    break
+                batches.append(block_arrays(batch, num_blocks=nb))
+            if not batches:
+                raise SystemExit("wordlist produced no variant blocks")
+            _batch_cache[key] = batches
+        return _batch_cache[key]
 
     # Every sync below is a device->host SCALAR fetch (``int(...)`` on the
     # emitted count): on the axon TPU tunnel ``jax.block_until_ready`` can
@@ -258,9 +286,14 @@ def run_worker(args: argparse.Namespace) -> None:
     radix2 = k_opts_for(plan) == 1
     zero = jnp.zeros((), jnp.int32)
 
-    def time_arm(arm_name: str, fused_opts) -> dict:
+    def time_arm(arm_name: str, fused_opts, nb: int,
+                 stride: "int | None") -> dict:
         """Warm up, size chunks, and run the timed window for one arm
         (fused_opts=None -> XLA expand+hash pair; K -> Pallas kernel)."""
+        print(f"# [{arm_name}] geometry: {args.lanes} lanes x {nb} blocks "
+              f"({'packed' if stride is None else f'stride {stride}'})",
+              file=sys.stderr)
+        batches = batches_for(nb, stride)
         body = make_fused_body(spec, num_lanes=args.lanes,
                                out_width=plan.out_width, block_stride=stride,
                                fused_expand_opts=fused_opts, radix2=radix2)
@@ -337,6 +370,7 @@ def run_worker(args: argparse.Namespace) -> None:
             "value": value,
             "launches": launches,
             "per_launch_s": round(elapsed / max(launches, 1), 4),
+            "blocks": nb,
         }
         if guard_tripped:
             sub["partial"] = True  # chunks ran far slower than sized
@@ -345,21 +379,42 @@ def run_worker(args: argparse.Namespace) -> None:
     # Arm selection: time both the XLA pair and the fused Pallas kernel
     # when the config is kernel-eligible on this device (VERDICT r4 #2 —
     # the bench must measure the kernel built to beat the XLA path, not
-    # just the path the env default selects), and record the winner.
-    cfg_opts = opts_for_config(spec, plan, ct, block_stride=stride,
-                               num_blocks=args.blocks)
+    # just the path the env default selects), and record the winner —
+    # each arm at its own geometry (arm_geometry).
+    def pallas_entry():
+        """('pallas', opts, nb, stride) at the arm's preferred geometry.
+        Only AUTO geometry may fall back to stride 128 when the preferred
+        stride is ineligible — an explicit --blocks/--block-layout request
+        is timed as pinned or not at all (the arms must not silently run
+        at geometries the user did not ask for)."""
+        nb, stride = arm_geometry("pallas")
+        geoms = [(nb, stride)]
+        if args.blocks is None and args.block_layout != "packed":
+            geoms.append((max(1, args.lanes // 128), 128))
+        for nb_try, stride_try in geoms:
+            if stride_try is None or args.lanes % max(stride_try, 1):
+                continue
+            opts = opts_for_config(spec, plan, ct, block_stride=stride_try,
+                                   num_blocks=nb_try)
+            if opts is not None:
+                return ("pallas", opts, nb_try, stride_try)
+        return None
+
+    xla_nb, xla_stride = arm_geometry("xla")
+    xla_entry = ("xla", None, xla_nb, xla_stride)
+    pallas = pallas_entry()
     if args.arm == "xla":
-        arm_plan = [("xla", None)]
+        arm_plan = [xla_entry]
     elif args.arm == "pallas":
-        if cfg_opts is None:
+        if pallas is None:
             raise SystemExit(
                 "--arm pallas: config is not kernel-eligible on this device"
             )
-        arm_plan = [("pallas", cfg_opts)]
-    elif cfg_opts is None:
-        arm_plan = [("xla", None)]
+        arm_plan = [pallas]
+    elif pallas is None:
+        arm_plan = [xla_entry]
     else:
-        arm_plan = [("xla", None), ("pallas", cfg_opts)]
+        arm_plan = [xla_entry, pallas]
 
     def winner_record(results: dict, partial_arms: bool) -> "dict | None":
         ok = {k: v for k, v in results.items() if "error" not in v}
@@ -374,7 +429,7 @@ def run_worker(args: argparse.Namespace) -> None:
             "platform": dev.platform,
             "device_kind": dev.device_kind,
             "lanes": args.lanes,
-            "blocks": args.blocks,
+            "blocks": results[winner].get("blocks", args.blocks),
             "launches": results[winner].get("launches", 0),
             "per_launch_s": results[winner].get("per_launch_s", 0.0),
             "arm": winner,
@@ -388,9 +443,9 @@ def run_worker(args: argparse.Namespace) -> None:
         return record
 
     results: dict[str, dict] = {}
-    for i, (arm_name, fused_opts) in enumerate(arm_plan):
+    for i, (arm_name, fused_opts, nb, arm_stride) in enumerate(arm_plan):
         try:
-            results[arm_name] = time_arm(arm_name, fused_opts)
+            results[arm_name] = time_arm(arm_name, fused_opts, nb, arm_stride)
         except Exception as e:  # pragma: no cover - backend-dependent
             # A losing arm must not sink the bench: record the failure and
             # let the other arm carry the number (the Pallas kernel's
@@ -505,13 +560,15 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         }
         vals.update(overrides)
         out = [
-            "--lanes", str(vals["lanes"]), "--blocks", str(vals["blocks"]),
+            "--lanes", str(vals["lanes"]),
             "--words", str(vals["words"]),
             "--seconds", str(vals["seconds"]),
             "--batches", str(vals["batches"]), "--algo", args.algo,
             "--mode", args.mode, "--init-timeout", str(init_timeout),
             "--block-layout", args.block_layout, "--arm", arm or args.arm,
         ]
+        if vals["blocks"] is not None:  # None = per-arm auto geometry
+            out += ["--blocks", str(vals["blocks"])]
         if platform:
             out += ["--platform", platform]
         if args.profile_dir:
@@ -523,7 +580,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
     cpu_args = worker_args(
         60, platform="cpu",
         lanes=min(args.lanes, 2048),
-        blocks=min(args.blocks, 32),
+        blocks=32 if args.blocks is None else min(args.blocks, 32),
         words=min(args.words, 4000),
         seconds=min(args.seconds, 8.0),
         batches=min(args.batches, 4),

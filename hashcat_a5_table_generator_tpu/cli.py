@@ -129,8 +129,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "2^22 on accelerators — big launches amortize "
                          "dispatch, PERF.md §4 — and 2^17 on CPU)")
     ap.add_argument("--blocks", type=int, default=None,
-                    help="device block slots per launch (default: lanes/128 "
-                         "on accelerators — stride 128; 1024 on CPU)")
+                    help="device block slots per launch (default: auto — "
+                         "on accelerators the sweep picks the measured best "
+                         "stride for the engaged kernel, 512/256 fused vs "
+                         "128 XLA; 1024 on CPU)")
     ap.add_argument("--fetch-chunk", type=_positive_int, default=None,
                     metavar="N",
                     help="crack mode: max launches whose counts accumulate "
@@ -675,15 +677,18 @@ def _run_device(args, sub_map, packed) -> int:
     progress = ProgressReporter(n_words) if args.progress else None
     if args.lanes is None or args.blocks is None:
         # Backend-sized launch geometry: accelerators want big launches
-        # (dispatch/fetch amortization, PERF.md §4) at stride 128; the CPU
-        # backend peaks far smaller (PERF.md §2).
+        # (dispatch/fetch amortization, PERF.md §4); the CPU backend peaks
+        # far smaller (PERF.md §2).  Accelerator block count stays None =
+        # auto: the Sweep resolves it per plan once fused-kernel
+        # eligibility is known (stride 512 / 256 when the kernel takes the
+        # launch, else 128 — the measured per-arm bests, PERF.md §9b).
         import jax
 
         on_cpu = jax.default_backend() == "cpu"
         if args.lanes is None:
             args.lanes = (1 << 17) if on_cpu else (1 << 22)
-        if args.blocks is None:
-            args.blocks = 1024 if on_cpu else max(1, args.lanes // 128)
+        if args.blocks is None and on_cpu:
+            args.blocks = 1024
     cfg_kw = {}
     if args.fetch_chunk is not None:
         cfg_kw["fetch_chunk"] = args.fetch_chunk

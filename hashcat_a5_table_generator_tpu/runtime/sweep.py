@@ -77,7 +77,11 @@ class SweepConfig:
     checkpoint taken at one geometry/device count resumes at any other)."""
 
     lanes: int = 1 << 17  # variant lanes per device per launch
-    num_blocks: int = 1024  # static per-device block count (jit stability)
+    num_blocks: Optional[int] = 1024  # static per-device block count (jit
+    #   stability). None = auto: resolved by the Sweep once plan/table
+    #   eligibility is known — lanes/512 (lanes/256 for suball) when the
+    #   fused kernel will take the launch, else lanes/128: the measured
+    #   per-arm best geometries (PERF.md §9b).
     max_in_flight: int = 2  # double-buffered launches
     fetch_chunk: int = 16  # crack mode: max launches whose counts accumulate
     #   ON DEVICE between host fetches. A device->host fetch costs a full
@@ -111,6 +115,12 @@ class SweepConfig:
         non-divisible geometry raises instead of silently degrading to
         packed; auto mode quietly falls back (the layouts are
         stream-identical, only throughput differs)."""
+        if self.num_blocks is None:
+            raise ValueError(
+                "num_blocks=None (auto) is resolved by the Sweep once plan "
+                "eligibility is known; resolve_block_stride needs a "
+                "concrete block count"
+            )
         packed = self.packed_blocks
         if packed is None:
             packed = self.lanes % self.num_blocks != 0
@@ -263,6 +273,27 @@ class Sweep:
         self.fallback_rows: List[int] = [
             int(i) for i in np.nonzero(self.plan.fallback)[0]
         ]
+    def _auto_num_blocks(self, kind: str) -> int:
+        """Resolve ``num_blocks=None``: the measured per-arm best geometry
+        (PERF.md §9b) — when the fused Pallas kernel will take the launch,
+        stride 512 wins (256 for suball: its Π(options+1) variant space
+        fills larger strides poorly); the XLA path peaks at stride 128.
+        Candidates mode never engages the fused kernel
+        (``make_candidates_step`` has no fused path), so it always gets
+        the XLA-best stride."""
+        from ..ops.pallas_expand import opts_for
+
+        lanes = self.config.lanes
+        if kind == "crack":
+            pref = 256 if self.spec.mode.startswith("suball") else 512
+            if lanes % pref == 0:
+                nb = lanes // pref
+                if opts_for(self.spec, self.plan, self.ct,
+                            block_stride=pref, num_blocks=nb) is not None:
+                    return nb
+        if lanes % 128 == 0:
+            return lanes // 128
+        return 1024
 
     def _digest_contains(self, dig: bytes) -> bool:
         """Host-side membership in the target digest list (fallback-word
@@ -352,6 +383,12 @@ class Sweep:
         device builds the shard_map'd step over a 1-D mesh with plan/table
         (and digests, for crack) replicated.  Returns
         (launch(blocks) -> out, n_devices, mesh)."""
+        if self.config.num_blocks is None:
+            from dataclasses import replace
+
+            self.config = replace(
+                self.config, num_blocks=self._auto_num_blocks(kind)
+            )
         spec, cfg, plan = self.spec, self.config, self.plan
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()

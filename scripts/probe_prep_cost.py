@@ -1,0 +1,135 @@
+"""Isolate the fused launch's per-block cost: time the bare pallas_call
+(inputs pre-gathered once, reused) against the full fused_expand_md5 wrapper
+(per-launch gathers + mask build) at the same geometry.  Evidence for the
+bucketed-launch design: if the bare kernel's wall is ~lane-term only, the
+~575 ns/block cost lives in the wrapper's XLA prep, not the kernel."""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_wordlist
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec, block_arrays, build_plan, plan_arrays, table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops import pallas_expand as pe
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+
+LANES = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 22
+STRIDE = int(sys.argv[2]) if len(sys.argv) > 2 else 128
+BLOCKS = LANES // STRIDE
+N = 30
+
+
+def main():
+    dev = jax.devices()[0]
+    print(f"# device: {dev.platform} ({dev.device_kind}) "
+          f"lanes=2^{LANES.bit_length()-1} stride={STRIDE}", file=sys.stderr)
+    spec = AttackSpec(mode="default", algo="md5")
+    sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
+    ct = compile_table(sub_map)
+    words = synth_wordlist(50000)
+    plan = build_plan(spec, ct, pack_words(words))
+    k_opts = pe.k_opts_for(plan)
+    p, t = plan_arrays(plan), table_arrays(ct)
+    batch, _, _ = make_blocks(plan, start_word=0, start_rank=0,
+                              max_variants=LANES, max_blocks=BLOCKS,
+                              fixed_stride=STRIDE)
+    b = block_arrays(batch, num_blocks=BLOCKS)
+
+    kw = dict(num_lanes=LANES, out_width=plan.out_width,
+              min_substitute=spec.effective_min,
+              max_substitute=spec.max_substitute,
+              block_stride=STRIDE, k_opts=k_opts)
+
+    # --- arm 1: full wrapper (per-launch gathers + mask build) -----------
+    @jax.jit
+    def full(p_, t_, b_):
+        state, emit = pe.fused_expand_md5(
+            p_["tokens"], p_["lengths"], p_["match_pos"], p_["match_len"],
+            p_["match_radix"], p_["match_val_start"],
+            t_["val_bytes"], t_["val_len"],
+            b_["word"], b_["base"], b_["count"], **kw)
+        return state[:, 0].sum() + emit.sum().astype(jnp.uint32)
+
+    # --- arm 2: bare kernel (inputs pre-gathered ONCE outside the timer) -
+    m = int(p["match_pos"].shape[1])
+    length_axis = int(p["tokens"].shape[1])
+    blk_word = b["word"]
+    tok_b = p["tokens"][blk_word].astype(jnp.int32)
+    wlen_b = p["lengths"][blk_word][:, None]
+    pos_b = p["match_pos"][blk_word]
+    mlen_b = p["match_len"][blk_word]
+    radix_b = p["match_radix"][blk_word]
+    count_b = b["count"][:, None]
+    vopt_b, vlen_b = pe._pack_val_options(
+        t["val_bytes"], t["val_len"], p["match_val_start"][blk_word], k_opts)
+    jj = jnp.arange(length_axis, dtype=jnp.int32)[None, None, :]
+    ps = pos_b[:, :, None]
+    inside_b = ((jj >= ps) & (jj < ps + mlen_b[:, :, None])).astype(jnp.int32)
+    start_b = (jj == ps).astype(jnp.int32)
+    inputs = tuple(jax.device_put(x) for x in (
+        tok_b, wlen_b, radix_b, b["base"], count_b,
+        inside_b, start_b, vopt_b, vlen_b))
+    kernel = pe._make_kernel(
+        g=pe._G, s=STRIDE, m=m, length_axis=length_axis, k_opts=k_opts,
+        out_width=plan.out_width, min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute, algo="md5")
+
+    @jax.jit
+    def bare(*ins):
+        state, emit = pe._launch_fused(
+            kernel, ins, nb=BLOCKS, stride=STRIDE, num_lanes=LANES,
+            n_state=4, interpret=False)
+        return state[:, 0].sum() + emit.sum().astype(jnp.uint32)
+
+    # --- arm 3: prep only (gathers + mask build, no kernel) --------------
+    @jax.jit
+    def prep(p_, t_, b_):
+        bw = b_["word"]
+        tok = p_["tokens"][bw].astype(jnp.int32)
+        wl = p_["lengths"][bw][:, None]
+        pos = p_["match_pos"][bw]
+        ml = p_["match_len"][bw]
+        rx = p_["match_radix"][bw]
+        vo, vl = pe._pack_val_options(
+            t_["val_bytes"], t_["val_len"], p_["match_val_start"][bw], k_opts)
+        jj_ = jnp.arange(length_axis, dtype=jnp.int32)[None, None, :]
+        ps_ = pos[:, :, None]
+        ins_ = ((jj_ >= ps_) & (jj_ < ps_ + ml[:, :, None])).astype(jnp.int32)
+        st_ = (jj_ == ps_).astype(jnp.int32)
+        return (tok.sum().astype(jnp.uint32) + wl.sum().astype(jnp.uint32)
+                + rx.sum().astype(jnp.uint32) + vo.sum()
+                + vl.sum().astype(jnp.uint32) + ins_.sum().astype(jnp.uint32)
+                + st_.sum().astype(jnp.uint32))
+
+    for name, fn, args in (("full", full, (p, t, b)),
+                           ("bare_kernel", bare, inputs),
+                           ("prep_only", prep, (p, t, b))):
+        r = fn(*args)
+        r.block_until_ready()
+        acc = jnp.zeros((), jnp.uint32)
+        t0 = time.perf_counter()
+        for _ in range(N):
+            acc = acc + fn(*args)
+        _ = int(acc)  # honest completion barrier over the whole chain
+        el = (time.perf_counter() - t0) / N
+        print(f"{name:12s} {el*1e3:8.3f} ms/launch   "
+              f"({el/LANES*1e9:.3f} ns/lane, {el/BLOCKS*1e9:.0f} ns/block)")
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

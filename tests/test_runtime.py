@@ -518,3 +518,36 @@ def test_progress_seed_emitted_resumed_rate():
     rep.update(words_done=5, emitted=1_000_100, hits=0)
     line = json.loads(out.getvalue().splitlines()[-1])
     assert line["progress"]["cand_per_sec"] == pytest.approx(50.0)
+
+
+class TestAutoNumBlocks:
+    """num_blocks=None resolves once the run kind is known (PERF.md §9b):
+    the fused-kernel strides only apply to crack launches on TPU; on the
+    CPU backend (this suite) every kind resolves to the XLA-best
+    lanes/128."""
+
+    def test_auto_resolves_on_candidates_run(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        sweep = Sweep(spec, LEET, WORDS,
+                      config=SweepConfig(lanes=256, num_blocks=None))
+        assert sweep.config.num_blocks is None  # deferred until the run
+        buf = io.BytesIO()
+        with CandidateWriter(buf) as w:
+            sweep.run_candidates(w)
+        assert sweep.config.num_blocks == 2  # 256 // 128
+        expected = oracle_lines(spec, LEET, WORDS)
+        assert sorted(buf.getvalue().splitlines()) == sorted(expected)
+
+    def test_auto_resolves_on_crack_run(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        target = next(iter_candidates(b"password", LEET, 1, 15))
+        digests = [hashlib.md5(target).digest()]
+        sweep = Sweep(spec, LEET, WORDS, digests,
+                      config=SweepConfig(lanes=256, num_blocks=None))
+        res = sweep.run_crack()
+        assert sweep.config.num_blocks == 2
+        assert any(h.candidate == target for h in res.hits)
+
+    def test_resolve_block_stride_rejects_unresolved_auto(self):
+        with pytest.raises(ValueError, match="auto"):
+            SweepConfig(lanes=256, num_blocks=None).resolve_block_stride()
