@@ -280,10 +280,14 @@ def run_worker(args: argparse.Namespace) -> None:
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
         k_opts_for,
         opts_for_config,
+        scalar_units_for,
     )
 
     # K=1 tables: the XLA arm's decode collapses to bit extraction.
     radix2 = k_opts_for(plan) == 1
+    # ...and the pallas arm's kernel takes the scalar-units fast path
+    # (PERF.md §11) exactly as the production sweep would.
+    scalar_units = scalar_units_for(plan)
     zero = jnp.zeros((), jnp.int32)
 
     def time_arm(arm_name: str, fused_opts, nb: int,
@@ -296,7 +300,9 @@ def run_worker(args: argparse.Namespace) -> None:
         batches = batches_for(nb, stride)
         body = make_fused_body(spec, num_lanes=args.lanes,
                                out_width=plan.out_width, block_stride=stride,
-                               fused_expand_opts=fused_opts, radix2=radix2)
+                               fused_expand_opts=fused_opts,
+                               fused_scalar_units=scalar_units,
+                               radix2=radix2)
         acc_step = jax.jit(
             lambda p_, t_, b_, d_, tot:
                 tot + body(p_, t_, d_, b_)["n_emitted"]

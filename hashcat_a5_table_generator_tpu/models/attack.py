@@ -209,6 +209,7 @@ def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
 def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
+                    fused_scalar_units: bool = False,
                     radix2: bool = False):
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
@@ -227,6 +228,11 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     Pallas decode+splice+MD5 kernel (``ops.pallas_expand``) in place of the
     XLA expand+hash pair. Callers gate via ``pallas_expand.opts_for`` —
     eligibility is a plan/table property this builder cannot see.
+
+    ``fused_scalar_units``: selects the fused kernel's K=1 scalar-units
+    fast path (PERF.md §11). Callers gate via
+    ``pallas_expand.scalar_units_for`` — the unique-start property lives
+    on the host plan.
     """
     from ..ops.pallas_md5 import maybe_pallas_hash_fn
 
@@ -247,6 +253,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                 min_substitute=spec.effective_min,
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
+                scalar_units=fused_scalar_units,
                 algo=spec.algo,
                 # Count-windowed plans carry win_v; the kernel walks the
                 # suffix-count DP in place of the mixed-radix decode.
@@ -292,6 +299,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
 def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
+                    fused_scalar_units: bool = False,
                     radix2: bool = False):
     """Build the fused expand->hash->match step (single device).
 
@@ -301,6 +309,7 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
     body = make_fused_body(spec, num_lanes=num_lanes, out_width=out_width,
                            block_stride=block_stride,
                            fused_expand_opts=fused_expand_opts,
+                           fused_scalar_units=fused_scalar_units,
                            radix2=radix2)
 
     def step(plan, table, blocks, digests):

@@ -317,6 +317,153 @@ def _decode_tile_radix2(rank, base, radix, m, g, s):
     return digits
 
 
+def scalar_units_for(plan) -> bool:
+    """Host gate for the K=1 *scalar-units* fast path (PERF.md §11).
+
+    K=1 plans (every shipped 1:1 layout map) have all radices <= 2, so a
+    lane's chosen-slot vector is exactly the binary digits of
+    ``packed_base + rank`` — and the per-byte unit resolution becomes bit
+    tests against block-uniform precomputes.  Match plans additionally
+    need at most one match START per byte position (mixed key lengths can
+    collide there — ``find_matches`` appends one match per matching
+    length); the packed start encode holds a single slot per position.
+    Substitute-all plans qualify unconditionally: segments are disjoint
+    by construction.  Windowed plans keep the DP decode (the digit
+    stream is not the rank's binary form)."""
+    if k_opts_for(plan) != 1 or getattr(plan, "windowed", False):
+        return False
+    mp = getattr(plan, "match_pos", None)
+    if mp is None:
+        return True
+    mp = np.asarray(mp)
+    act = np.asarray(plan.match_radix) > 1
+    m = mp.shape[1]
+    # Inactive (padding) slots sit at distinct negative positions so they
+    # can never collide with real starts or each other.
+    pos = np.where(act, mp, -1 - np.arange(m, dtype=mp.dtype)[None, :])
+    srt = np.sort(pos, axis=1)
+    return not bool((srt[:, 1:] == srt[:, :-1]).any())
+
+
+def _popcount_tile(cb):
+    """SWAR popcount of a nonnegative i32 tile (values < 2^26 here:
+    packed chosen-slot vectors over <= 24 active slots plus block carry)."""
+    u = cb.astype(_U32)
+    u = u - ((u >> 1) & _U32(0x55555555))
+    u = (u & _U32(0x33333333)) + ((u >> 2) & _U32(0x33333333))
+    u = (u + (u >> 4)) & _U32(0x0F0F0F0F)
+    u = u + (u >> 8)
+    u = (u + (u >> 16)) & _U32(0x3F)
+    return u.astype(_I32)
+
+
+def _make_scalar_kernel(
+    *, g: int, s: int, kind: str, length_axis: int, out_width: int,
+    min_substitute: int, max_substitute: int, algo: str = "md5",
+):
+    """K=1 scalar-units kernel body (PERF.md §11), shared by match and
+    substitute-all plans.
+
+    The chosen-slot vector IS ``pbase + rank`` (one add — no mixed-radix
+    decode loop), the substitution count is its popcount, and the per-byte
+    unit loop runs on block-uniform precomputes: per (block, byte j)
+    ``a_j``/``b_j`` resolve coverage and starts (match: ``ins_bits`` with
+    one bit per active slot + the starting slot's bit position, sentinel
+    31; suball: the owning pattern slot's bit position, sentinel 31, + a
+    span-start 0/1), and ``svl``/``svw`` carry the (single) value's
+    length/packed word.  Sentinel 31 is safe: chosen vectors stay below
+    2^26 (<= 24 active slots + the in-block rank carry), so bit 31 is 0.
+
+    Ref shapes per grid step (all VMEM):
+      tok[G, L] i32, wlen[G, 1] i32, count[G, 1] i32, pbase[G, 1] i32,
+      a_j[G, L] i32, b_j[G, L] i32, svl[G, L] i32, svw[G, L] u32.
+    Outputs: state[G, KS, S] u32, emit[G, S] i32 — identical contract to
+    :func:`_make_kernel`.
+    """
+    assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
+    assert kind in ("match", "suball"), kind
+
+    def kernel(tok, wlen, count, pbase, a_j, b_j, svl, svw,
+               state_ref, emit_ref):
+        rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
+        lane_ok = rank < count[:, 0][:, None]
+        cb = pbase[:, 0][:, None] + rank
+        chosen_count = _popcount_tile(cb)
+
+        clash = jnp.zeros((g, s), jnp.bool_)
+        cum = jnp.zeros((g, s), _I32)
+        unit_start = []
+        unit_len = []
+        unit_word = []
+        for j in range(length_axis):
+            if kind == "match":
+                ab = cb & a_j[:, j][:, None]
+                cov = (ab != 0).astype(_I32)
+                clash = clash | ((ab & (ab - 1)) != 0)
+                started = ((cb >> b_j[:, j][:, None]) & 1) == 1
+            else:
+                ch = ((cb >> a_j[:, j][:, None]) & 1) == 1
+                cov = ch.astype(_I32)
+                started = ch & (b_j[:, j][:, None] > 0)
+            in_word = j < wlen[:, 0][:, None]
+            ul = jnp.where(
+                in_word,
+                jnp.where(started, svl[:, j][:, None], 1 - cov),
+                0,
+            )
+            tok_j = tok[:, j][:, None].astype(_U32)
+            unit_start.append(cum)
+            unit_len.append(ul)
+            unit_word.append(jnp.where(started, svw[:, j][:, None], tok_j))
+            cum = cum + ul
+        out_len = cum
+
+        state = _hash_units(algo, unit_start, unit_len, unit_word,
+                            out_len, g, s)
+        for w_i, sw in enumerate(state):
+            state_ref[:, w_i, :] = sw
+
+        emit = (
+            lane_ok
+            & (chosen_count >= min_substitute)
+            & (chosen_count <= max_substitute)
+        )
+        if kind == "match":
+            emit = emit & ~clash
+        emit_ref[:, :] = emit.astype(_I32)
+
+    return kernel
+
+
+def _scalar_units_prelude(radix_b, blk_base):
+    """Shared packing for both scalar-units fast paths: active mask,
+    active-rank bit positions, per-slot bit weights (``1 << bitpos`` for
+    active slots, 0 for padding), and the block base digit vector packed
+    to one plain integer per block."""
+    act = (radix_b > 1).astype(_I32)
+    bitpos = jnp.cumsum(act, axis=1) - act
+    weight = act << bitpos
+    pbase = jnp.sum(blk_base * weight, axis=1)[:, None]  # [NB, 1]
+    return act, bitpos, weight, pbase
+
+
+def _launch_scalar_units(
+    kind, inputs, *, block_stride, length_axis, out_width,
+    min_substitute, max_substitute, algo, nb, num_lanes, interpret,
+):
+    """Shared kernel-build + launch tail for both scalar-units fast paths
+    (``inputs`` = the 8-ref tuple of :func:`_make_scalar_kernel`)."""
+    kernel = _make_scalar_kernel(
+        g=_G, s=block_stride, kind=kind, length_axis=length_axis,
+        out_width=out_width, min_substitute=min_substitute,
+        max_substitute=max_substitute, algo=algo,
+    )
+    return _launch_fused(
+        kernel, inputs, nb=nb, stride=block_stride, num_lanes=num_lanes,
+        n_state=DIGEST_WORDS[algo], interpret=interpret,
+    )
+
+
 def _decode_tile(rank, base, radix, m, g, s):
     """Mixed-radix digit decode on a (G, S) tile: base digits + in-block
     rank with carries (f32 divides — ranks are < the block stride).
@@ -753,6 +900,7 @@ def fused_expand_md5(
     k_opts: int,
     algo: str = "md5",
     win_v: "jnp.ndarray | None" = None,  # int32 [B, M+1, K2] (windowed)
+    scalar_units: bool = False,
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for a fixed-stride launch.
@@ -763,6 +911,8 @@ def fused_expand_md5(
     consumes. Callers must have checked :func:`eligible`.  ``win_v``
     (count-windowed plans) switches the in-kernel decode to the
     suffix-count DP walk; block base cursors are then scalar ranks.
+    ``scalar_units`` (host-gated via :func:`scalar_units_for`) selects the
+    K=1 fast kernel (PERF.md §11) for full-enumeration launches.
     """
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
@@ -786,6 +936,32 @@ def fused_expand_md5(
     ps = pos_b[:, :, None]
     inside_b = ((jj >= ps) & (jj < ps + mlen_b[:, :, None])).astype(_I32)
     start_b = (jj == ps).astype(_I32)
+
+    if scalar_units and win_v is None and k_opts == 1:
+        # K=1 scalar-units fast path (PERF.md §11): pack each active
+        # slot's chosen bit at its active-rank position; per-byte
+        # coverage / start / value fields become block-uniform [NB, L]
+        # arrays (the host gate guarantees at most one start per
+        # position).
+        act, bitpos, weight, pbase = _scalar_units_prelude(
+            radix_b, blk_base
+        )
+        ins_bits = jnp.sum(inside_b * weight[:, :, None], axis=1)
+        stt = start_b * act[:, :, None]  # [NB, M, L], <=1 slot set per j
+        startp = jnp.sum(stt * (bitpos + 1)[:, :, None], axis=1)
+        startp = jnp.where(startp == 0, 31, startp - 1)
+        svl_j = jnp.sum(stt * vlen_b[:, :, 0][:, :, None], axis=1)
+        svw_j = jnp.sum(stt.astype(_U32) * vopt_b[:, :, 0][:, :, None],
+                        axis=1)
+        return _launch_scalar_units(
+            "match",
+            (tok_b, wlen_b, count_b, pbase, ins_bits, startp, svl_j,
+             svw_j),
+            block_stride=block_stride, length_axis=length_axis,
+            out_width=out_width, min_substitute=min_substitute,
+            max_substitute=max_substitute, algo=algo, nb=nb,
+            num_lanes=num_lanes, interpret=interpret,
+        )
 
     kernel = _make_kernel(
         g=_G, s=block_stride, m=m, length_axis=length_axis, k_opts=k_opts,
@@ -943,13 +1119,16 @@ def fused_expand_suball_md5(
     k_opts: int,
     algo: str = "md5",
     win_v: "jnp.ndarray | None" = None,  # int32 [B, P+1, K2] (windowed)
+    scalar_units: bool = False,
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for substitute-all fixed-stride launches.
 
     Same contract as :func:`fused_expand_md5` (including the ``win_v``
-    count-windowed decode); callers must have checked :func:`eligible`
-    with the plan's ``num_segments``.
+    count-windowed decode and the K=1 ``scalar_units`` fast path —
+    substitute-all plans qualify unconditionally, segments are disjoint);
+    callers must have checked :func:`eligible` with the plan's
+    ``num_segments``.
     """
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     p = pat_radix.shape[1]
@@ -981,6 +1160,38 @@ def fused_expand_suball_md5(
     else:  # no segments: every byte passes through
         slotat_b = jnp.full((nb, length_axis), -1, jnp.int32)
         startat_b = jnp.zeros((nb, length_axis), jnp.int32)
+
+    if scalar_units and win_v is None and k_opts == 1:
+        # K=1 scalar-units fast path (PERF.md §11): the owning pattern
+        # slot's chosen bit sits at its active-rank position; per-byte
+        # fields resolve to block-uniform [NB, L] arrays via the
+        # already-computed segment ownership (``slotat_b``/``startat_b``).
+        act, bitpos, _, pbase = _scalar_units_prelude(pradix_b, blk_base)
+        sl_clip = jnp.clip(slotat_b, 0, p - 1)
+        owned = slotat_b >= 0
+        own_act = jnp.take_along_axis(act, sl_clip, axis=1) > 0
+        ownbit = jnp.where(
+            owned & own_act, jnp.take_along_axis(bitpos, sl_clip, axis=1),
+            31,
+        )
+        jj2 = jnp.arange(length_axis, dtype=jnp.int32)[None, :]
+        isstart = (owned & (startat_b == jj2)).astype(_I32)
+        svl_j = jnp.where(
+            owned, jnp.take_along_axis(vlen_b[:, :, 0], sl_clip, axis=1), 0
+        )
+        svw_j = jnp.where(
+            owned, jnp.take_along_axis(vopt_b[:, :, 0], sl_clip, axis=1),
+            _U32(0),
+        )
+        return _launch_scalar_units(
+            "suball",
+            (tok_b, wlen_b, count_b, pbase, ownbit, isstart, svl_j,
+             svw_j),
+            block_stride=block_stride, length_axis=length_axis,
+            out_width=out_width, min_substitute=min_substitute,
+            max_substitute=max_substitute, algo=algo, nb=nb,
+            num_lanes=num_lanes, interpret=interpret,
+        )
 
     kernel = _make_suball_kernel(
         g=_G, s=block_stride, p=p,

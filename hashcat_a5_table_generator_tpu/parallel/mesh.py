@@ -126,6 +126,7 @@ def make_sharded_crack_step(
     axis_name: str = "data",
     block_stride: int | None = None,
     fused_expand_opts: int | None = None,
+    fused_scalar_units: bool = False,
     radix2: bool = False,
 ):
     """The fused crack step, shard_map'd over a 1-D mesh.
@@ -146,7 +147,7 @@ def make_sharded_crack_step(
     body = make_fused_body(
         spec, num_lanes=lanes_per_device, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
-        radix2=radix2,
+        fused_scalar_units=fused_scalar_units, radix2=radix2,
     )
 
     def local_step(plan, table, digests, blocks):

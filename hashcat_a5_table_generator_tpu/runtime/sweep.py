@@ -392,7 +392,11 @@ class Sweep:
         spec, cfg, plan = self.spec, self.config, self.plan
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()
-        from ..ops.pallas_expand import k_opts_for, opts_for
+        from ..ops.pallas_expand import (
+            k_opts_for,
+            opts_for,
+            scalar_units_for,
+        )
 
         # On TPU an eligible config swaps the crack step's expand+hash
         # pair for the fused Pallas kernel by default (ops.pallas_expand;
@@ -401,6 +405,7 @@ class Sweep:
             spec, plan, self.ct, block_stride=stride,
             num_blocks=cfg.num_blocks,
         )
+        scalar_units = scalar_units_for(plan)
         # K=1 tables (all radices <= 2): the XLA decode collapses to bit
         # extraction (expand_matches.decode_digits radix2 path).
         radix2 = k_opts_for(plan) == 1
@@ -410,7 +415,7 @@ class Sweep:
                 step = make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
                     block_stride=stride, fused_expand_opts=fused_opts,
-                    radix2=radix2,
+                    fused_scalar_units=scalar_units, radix2=radix2,
                 )
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
@@ -434,7 +439,8 @@ class Sweep:
             step = make_sharded_crack_step(
                 spec, mesh, lanes_per_device=cfg.lanes,
                 out_width=plan.out_width, block_stride=stride,
-                fused_expand_opts=fused_opts, radix2=radix2,
+                fused_expand_opts=fused_opts,
+                fused_scalar_units=scalar_units, radix2=radix2,
             )
             p, t, darrs = replicate(
                 mesh,

@@ -82,7 +82,9 @@ class CompiledTable:
 
     @property
     def num_values(self) -> int:
-        return int(self.val_bytes.shape[0])
+        # Not val_bytes.shape[0]: a zero-pair table pads one value row so
+        # device gathers stay in-bounds, but it holds zero actual values.
+        return int(self.val_count.sum())
 
     @property
     def all_keys_single_byte(self) -> bool:
@@ -125,8 +127,10 @@ def _touching_match_possible(v: bytes, q: bytes) -> bool:
 def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
     """Compile a parsed/merged substitution map into dense arrays.
 
-    Zero-key and zero-value-count edge cases produce shape-(0, 1) matrices so
-    downstream jnp code never sees a zero-width axis.
+    Zero-key edge cases produce shape-(0, 1) key matrices so downstream
+    jnp code never sees a zero-width axis; the VALUE arrays additionally
+    keep at least one (zero) row because device kernels gather value rows
+    by index (``num_values`` still reports the true count).
     """
     keys = sorted(sub_map.keys())
     k = len(keys)
@@ -150,8 +154,12 @@ def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
     v = len(flat_values)
     max_val_len = max((len(x) for x in flat_values), default=0)
     val_width = max(max_val_len, 1)
-    val_bytes = np.zeros((v, val_width), dtype=np.uint8)
-    val_len = np.zeros((v,), dtype=np.int32)
+    # A zero-PAIR table (every input line skipped) keeps one zero row: the
+    # device kernels gather value rows by clamped index, and a 0-row axis
+    # makes even the never-selected gather out of bounds (val_count is all
+    # zero, so no lane ever chooses the padding row).
+    val_bytes = np.zeros((max(v, 1), val_width), dtype=np.uint8)
+    val_len = np.zeros((max(v, 1),), dtype=np.int32)
     for i, value in enumerate(flat_values):
         val_bytes[i, : len(value)] = np.frombuffer(value, dtype=np.uint8)
         val_len[i] = len(value)

@@ -25,7 +25,7 @@ from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
 from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
 from hashcat_a5_table_generator_tpu.ops.packing import pack_words
 from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
-    eligible, k_opts_for,
+    eligible, k_opts_for, scalar_units_for,
 )
 from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
 from hashcat_a5_table_generator_tpu.tables.compile import compile_table
@@ -33,7 +33,7 @@ from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
 from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
 
 LANES = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 22
-STRIDE = 128
+STRIDE = int(sys.argv[2]) if len(sys.argv) > 2 else 128
 BLOCKS = LANES // STRIDE
 
 
@@ -70,10 +70,14 @@ def main():
         batches.append(block_arrays(batch, num_blocks=BLOCKS))
 
     results = {}
-    for name, fused in (("xla", None), ("pallas_fused", k_opts)):
+    arms = [("xla", None, False), ("pallas_fused", k_opts, False)]
+    if scalar_units_for(plan):
+        arms.append(("pallas_scalar", k_opts, True))
+    for name, fused, scalar in arms:
         body = make_fused_body(spec, num_lanes=LANES,
                                out_width=plan.out_width, block_stride=STRIDE,
-                               fused_expand_opts=fused)
+                               fused_expand_opts=fused,
+                               fused_scalar_units=scalar)
         acc = jax.jit(
             lambda p_, t_, b_, d_, tot: tot + body(p_, t_, d_, b_)["n_emitted"]
         )
@@ -99,9 +103,9 @@ def main():
         }))
         sys.stdout.flush()
 
-    assert results["pallas_fused"] == results["xla"] >= 1, (
-        f"planted-hit mismatch: {results} — fused kernel diverges on-chip"
-    )
+    assert all(v == results["xla"] for v in results.values()) and (
+        results["xla"] >= 1
+    ), f"planted-hit mismatch: {results} — fused kernel diverges on-chip"
     print("# planted hits consistent across variants", file=sys.stderr)
 
 

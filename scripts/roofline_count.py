@@ -78,6 +78,9 @@ def main():
     ap.add_argument("--algo", default="md5")
     ap.add_argument("--stride", type=int, default=128)
     ap.add_argument("--words", type=int, default=256)
+    ap.add_argument("--no-scalar-units", action="store_true",
+                    help="force the general kernel even when the plan "
+                         "qualifies for the K=1 scalar-units path")
     args = ap.parse_args()
 
     from hashcat_a5_table_generator_tpu.models.attack import (
@@ -121,6 +124,8 @@ def main():
         min_substitute=spec.effective_min,
         max_substitute=spec.max_substitute,
         block_stride=stride, k_opts=k, algo=args.algo, interpret=True,
+        scalar_units=(not args.no_scalar_units
+                      and pe.scalar_units_for(plan)),
     )
     if args.mode in ("default", "reverse"):
         fn = lambda: pe.fused_expand_md5(  # noqa: E731

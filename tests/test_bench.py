@@ -41,6 +41,6 @@ def test_worker_respects_block_layout_flag():
         capture_output=True, timeout=240, cwd=str(REPO),
     )
     assert r.returncode == 0, r.stderr.decode()[-2000:]
-    assert b"# block layout: stride 64" in r.stderr
+    assert b"(stride 64)" in r.stderr
     rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
     assert rec["value"] > 0

@@ -150,6 +150,19 @@ class TestEmitTable:
 
 
 class TestDeviceBackend:
+    def test_zero_pair_table(self, workdir, tmp_path):
+        # A table whose every line is skipped (comments / no '=') compiles
+        # to zero value rows; the device sweep must agree with the oracle
+        # (no candidates under the Q1 min bump), not crash in a gather.
+        empty = tmp_path / "empty.table"
+        empty.write_bytes(b"# nothing here\nnot a pair\n")
+        outs = [
+            run_cli(str(workdir / "dict.txt"), "-t", str(empty),
+                    "--backend", be, "--lanes", "256", "--blocks", "16")
+            for be in ("device", "oracle")
+        ]
+        assert outs[0].stdout == outs[1].stdout == b""
+
     def test_candidates_multiset_parity(self, workdir):
         sub = load_tables([str(workdir / "leet.table")])
         r = run_cli(str(workdir / "dict.txt"), "-t", str(workdir / "leet.table"),

@@ -59,6 +59,10 @@ def test_compile_empty_key_and_empty_map():
     assert ct.has_empty_key and not ct.all_keys_single_byte
     empty = compile_table({})
     assert empty.num_keys == 0 and empty.key_bytes.shape == (0, 1)
+    # Value arrays keep one zero row (device kernels gather value rows by
+    # index; a 0-row axis makes even a never-selected gather go OOB).
+    assert empty.val_bytes.shape == (1, 1) and empty.val_len.shape == (1,)
+    assert empty.val_count.sum() == 0
 
 
 @pytest.mark.parametrize("name", sorted(BUILTIN_LAYOUTS))

@@ -46,7 +46,7 @@ def _arrays(spec, words=WORDS, sub=LEET):
 
 
 def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
-                num_blocks=8, algo="md5"):
+                num_blocks=8, algo="md5", **fused_kw):
     """Shared full-space sweep harness: run every launch through the XLA
     expand+md5 pair AND the fused kernel; returns per-launch
     (emit_xla, emit_pal, state_xla, state_pal). ``plan_fields`` names the
@@ -86,7 +86,7 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
         state_x = HASH_FNS[algo](cand, clen)
         state_p, emit_p = fused_fn(
             *args, blocks[0], blocks[1], blocks[2],
-            k_opts=k_opts, algo=algo, interpret=True, **common,
+            k_opts=k_opts, algo=algo, interpret=True, **common, **fused_kw,
         )
         outs.append((
             np.asarray(emit_x), np.asarray(emit_p),
@@ -96,12 +96,13 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
     return outs
 
 
-def _run_both(spec, plan, ct, *, num_blocks=8, algo="md5"):
+def _run_both(spec, plan, ct, *, num_blocks=8, algo="md5", **fused_kw):
     return _sweep_both(
         spec, plan, ct,
         ("tokens", "lengths", "match_pos", "match_len", "match_radix",
          "match_val_start"),
         expand_matches, fused_expand_md5, num_blocks=num_blocks, algo=algo,
+        **fused_kw,
     )
 
 
@@ -198,7 +199,8 @@ def test_eligible_bounds():
         assert not eligible(**{**base, **bad}), bad
 
 
-def _run_both_suball(spec, plan, ct, *, num_blocks=8, algo="md5"):
+def _run_both_suball(spec, plan, ct, *, num_blocks=8, algo="md5",
+                     **fused_kw):
     from hashcat_a5_table_generator_tpu.ops.expand_suball import expand_suball
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
         fused_expand_suball_md5,
@@ -209,7 +211,7 @@ def _run_both_suball(spec, plan, ct, *, num_blocks=8, algo="md5"):
         ("tokens", "lengths", "pat_radix", "pat_val_start",
          "seg_orig_start", "seg_orig_len", "seg_pat"),
         expand_suball, fused_expand_suball_md5, num_blocks=num_blocks,
-        algo=algo,
+        algo=algo, **fused_kw,
     )
 
 
@@ -269,6 +271,106 @@ def test_opts_for_covers_suball(monkeypatch):
 
     monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
     assert opts_for(spec, plan, ct, block_stride=128, num_blocks=16) == 2
+
+
+#: K=1 scalar-units fast path (PERF.md §11): a 1:1 layout-style map (one
+#: option per key) with a 2-byte value, exactly the shipped-table shape.
+K1_MAP = {b"a": [b"\xd0\xb0"], b"s": [b"5"], b"o": [b"0"], b"l": [b"1"],
+          b"e": [b"3"]}
+
+
+class TestScalarUnits:
+    """The K=1 scalar-units kernel (``scalar_units=True``) against the
+    XLA pair — the path every shipped 1:1 layout takes in production."""
+
+    @pytest.mark.parametrize("mode,algo,window", [
+        ("default", "md5", None), ("reverse", "md5", None),
+        ("default", "md5", (2, 9)), ("default", "sha1", None),
+        ("default", "ntlm", None),
+    ])
+    def test_match_parity(self, mode, algo, window):
+        kw = dict(mode=mode, algo=algo)
+        if window is not None:
+            # max > WINDOWED_MAX_SUBST keeps full enumeration; the
+            # popcount-based count window must still prune exactly.
+            kw.update(min_substitute=window[0], max_substitute=window[1])
+        spec = AttackSpec(**kw)
+        ct, plan = _arrays(spec, sub=K1_MAP)
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        assert scalar_units_for(plan)
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both(
+            spec, plan, ct, algo=algo, scalar_units=True
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    @pytest.mark.parametrize("mode", ["suball", "suball-reverse"])
+    def test_suball_parity(self, mode):
+        spec = AttackSpec(mode=mode, algo="md5")
+        ct, plan = _arrays(spec, sub=K1_MAP)
+        assert not plan.fallback.any()
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both_suball(
+            spec, plan, ct, scalar_units=True
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    def test_gate(self):
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        # K=2 tables never qualify.
+        spec = AttackSpec(mode="default", algo="md5")
+        _, plan = _arrays(spec)
+        assert not scalar_units_for(plan)
+        # K=1 with colliding match starts (s and ss both match at the
+        # same position in "assassin"/"misses") must fall back: the
+        # packed start encode holds one slot per position.
+        k1_collide = {b"s": [b"5"], b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
+        ct = compile_table(k1_collide)
+        plan = build_plan(spec, ct, pack_words([b"misses", b"sass"]))
+        assert k_opts_for(plan) == 1
+        assert not scalar_units_for(plan)
+        # ...while K=1 multi-char keys WITHOUT collisions qualify.
+        plan = build_plan(spec, ct, pack_words([b"banana"]))
+        assert scalar_units_for(plan)
+        # Suball plans qualify unconditionally (segments are disjoint).
+        sspec = AttackSpec(mode="suball", algo="md5")
+        ct1 = compile_table(K1_MAP)
+        splan = build_plan(sspec, ct1, pack_words([b"glass"]))
+        assert scalar_units_for(splan)
+        # Windowed plans keep the DP decode.
+        wspec = AttackSpec(mode="default", algo="md5", min_substitute=1,
+                           max_substitute=1)
+        wplan = build_plan(wspec, ct1, pack_words([b"oleander"]))
+        if wplan.windowed:
+            assert not scalar_units_for(wplan)
+
+    def test_collision_table_parity_on_general_path(self):
+        # The exact config the gate rejects must still be correct via the
+        # general kernel (production passes scalar_units=True but the
+        # wrapper only engages it when the caller's gate said so — this
+        # pins the fallback pairing end-to-end).
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"s": [b"5"], b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words([b"misses", b"sass"]))
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both(spec, plan, ct):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
 
 
 @pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
