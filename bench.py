@@ -205,9 +205,15 @@ def run_worker(args: argparse.Namespace) -> None:
     # whenever the block count divides lanes evenly (arithmetic
     # lane->block map; faster on every backend — PERF.md §4c), else packed.
     # With --blocks unset, each arm gets its own measured-best geometry
-    # (PERF.md §9b: the XLA arm peaks at stride 128, the fused kernel at
-    # stride 512 — 256 for suball — so a shared geometry would handicap
-    # one arm and misreport the winner).
+    # (PERF.md §9b/§11: the XLA arm peaks at stride 128; the fused
+    # kernel's general path at stride 512 — 256 for suball — while the
+    # K=1 scalar-units path peaks back at stride 128, where fill is
+    # highest, because §11 removed most of the per-block cost that made
+    # big strides pay).  A shared geometry would handicap one arm and
+    # misreport the winner.
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        scalar_units_for,
+    )
     from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
 
     def arm_geometry(arm_name: str) -> "tuple[int, int | None]":
@@ -217,7 +223,10 @@ def run_worker(args: argparse.Namespace) -> None:
         elif args.block_layout == "packed":
             nb = max(1, args.lanes // 128)
         elif arm_name == "pallas":
-            pref = 256 if args.mode.startswith("suball") else 512
+            if scalar_units_for(plan):
+                pref = 128
+            else:
+                pref = 256 if args.mode.startswith("suball") else 512
             if args.lanes % pref == 0:
                 nb = args.lanes // pref
             else:
@@ -280,7 +289,6 @@ def run_worker(args: argparse.Namespace) -> None:
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
         k_opts_for,
         opts_for_config,
-        scalar_units_for,
     )
 
     # K=1 tables: the XLA arm's decode collapses to bit extraction.

@@ -317,7 +317,7 @@ def _decode_tile_radix2(rank, base, radix, m, g, s):
     return digits
 
 
-def scalar_units_for(plan) -> bool:
+def scalar_units_for(plan) -> "bool | str":
     """Host gate for the K=1 *scalar-units* fast path (PERF.md §11).
 
     K=1 plans (every shipped 1:1 layout map) have all radices <= 2, so a
@@ -329,7 +329,12 @@ def scalar_units_for(plan) -> bool:
     length); the packed start encode holds a single slot per position.
     Substitute-all plans qualify unconditionally: segments are disjoint
     by construction.  Windowed plans keep the DP decode (the digit
-    stream is not the rank's binary form)."""
+    stream is not the rank's binary form).
+
+    Returns ``"single"`` when additionally every active match span is one
+    byte (all shipped 1:1 layout maps): overlaps are then impossible and
+    the kernel drops its coverage bitmask entirely.  Both truthy values
+    thread through ``fused_scalar_units`` unchanged."""
     if k_opts_for(plan) != 1 or getattr(plan, "windowed", False):
         return False
     mp = getattr(plan, "match_pos", None)
@@ -337,6 +342,10 @@ def scalar_units_for(plan) -> bool:
         return True
     mp = np.asarray(mp)
     act = np.asarray(plan.match_radix) > 1
+    if not np.where(act, np.asarray(plan.match_len) > 1, False).any():
+        # Single-byte spans: at most one key can match at a position, so
+        # start uniqueness is automatic.
+        return "single"
     m = mp.shape[1]
     # Inactive (padding) slots sit at distinct negative positions so they
     # can never collide with real starts or each other.
@@ -360,6 +369,7 @@ def _popcount_tile(cb):
 def _make_scalar_kernel(
     *, g: int, s: int, kind: str, length_axis: int, out_width: int,
     min_substitute: int, max_substitute: int, algo: str = "md5",
+    max_val_len: int = 4, single_span: bool = False,
 ):
     """K=1 scalar-units kernel body (PERF.md §11), shared by match and
     substitute-all plans.
@@ -379,12 +389,22 @@ def _make_scalar_kernel(
       a_j[G, L] i32, b_j[G, L] i32, svl[G, L] i32, svw[G, L] u32.
     Outputs: state[G, KS, S] u32, emit[G, S] i32 — identical contract to
     :func:`_make_kernel`.
+
+    ``single_span`` (match only, host-gated: every active match span is
+    one byte — all shipped layout maps): coverage equals start, overlaps
+    are impossible, so the ``a_j`` coverage-bitmask ref is DROPPED (the
+    kernel takes 7 refs) and the clash test vanishes.
     """
     assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
     assert kind in ("match", "suball"), kind
+    assert not (single_span and kind != "match")
 
-    def kernel(tok, wlen, count, pbase, a_j, b_j, svl, svw,
-               state_ref, emit_ref):
+    def kernel(tok, wlen, count, pbase, *rest):
+        if single_span:
+            b_j, svl, svw, state_ref, emit_ref = rest
+            a_j = None
+        else:
+            a_j, b_j, svl, svw, state_ref, emit_ref = rest
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
         cb = pbase[:, 0][:, None] + rank
@@ -396,7 +416,10 @@ def _make_scalar_kernel(
         unit_len = []
         unit_word = []
         for j in range(length_axis):
-            if kind == "match":
+            if kind == "match" and single_span:
+                started = ((cb >> b_j[:, j][:, None]) & 1) == 1
+                cov = started.astype(_I32)
+            elif kind == "match":
                 ab = cb & a_j[:, j][:, None]
                 cov = (ab != 0).astype(_I32)
                 clash = clash | ((ab & (ab - 1)) != 0)
@@ -419,7 +442,8 @@ def _make_scalar_kernel(
         out_len = cum
 
         state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s)
+                            out_len, g, s, max_unit_len=max_val_len,
+                            out_width=out_width)
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
 
@@ -428,7 +452,7 @@ def _make_scalar_kernel(
             & (chosen_count >= min_substitute)
             & (chosen_count <= max_substitute)
         )
-        if kind == "match":
+        if kind == "match" and not single_span:
             emit = emit & ~clash
         emit_ref[:, :] = emit.astype(_I32)
 
@@ -450,13 +474,16 @@ def _scalar_units_prelude(radix_b, blk_base):
 def _launch_scalar_units(
     kind, inputs, *, block_stride, length_axis, out_width,
     min_substitute, max_substitute, algo, nb, num_lanes, interpret,
+    max_val_len=4, single_span=False,
 ):
     """Shared kernel-build + launch tail for both scalar-units fast paths
-    (``inputs`` = the 8-ref tuple of :func:`_make_scalar_kernel`)."""
+    (``inputs`` = the 8-ref tuple of :func:`_make_scalar_kernel`, 7 when
+    ``single_span`` drops the coverage bitmask)."""
     kernel = _make_scalar_kernel(
         g=_G, s=block_stride, kind=kind, length_axis=length_axis,
         out_width=out_width, min_substitute=min_substitute,
         max_substitute=max_substitute, algo=algo,
+        max_val_len=max_val_len, single_span=single_span,
     )
     return _launch_fused(
         kernel, inputs, nb=nb, stride=block_stride, num_lanes=num_lanes,
@@ -487,7 +514,8 @@ _N_MSG_WORDS = 14
 
 
 def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
-                        *, big_endian_length=False, utf16=False):
+                        *, big_endian_length=False, utf16=False,
+                        max_unit_len=4, out_width=None):
     """Assemble the padded single-block message (16 u32 words on (G, S)
     tiles, little-endian byte order — SHA-1 byte-swaps in its schedule)
     from per-unit output spans: unit j contributes bytes ``unit_word[j]``
@@ -498,8 +526,13 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
     becomes the code unit ``byte | 0x0000``, i.e. byte offsets double and
     odd bytes stay zero (matching ``ops.hashes.utf16le_expand``).
 
-    A unit at index j starts at candidate offset <= 4*j (every prior unit
-    contributes <= 4 bytes), bounding its word span.
+    A unit at index j starts at candidate offset <= ``max_unit_len * j``
+    (every prior unit contributes at most ``max_unit_len`` bytes — the
+    table's value width, 1..4), bounding its word span: for the shipped
+    2-byte-value layouts the per-unit select chains halve versus the
+    generic <=4-bytes bound.  ``out_width`` (when given) likewise bounds
+    the terminator scan — emitted candidates never exceed it, and
+    overlong lanes are masked garbage by contract.
 
     Placement is whole-unit, not per-byte (PERF.md §7's top lever): the
     unit's <=4 masked bytes shift as one u32 into a (lo, hi) word pair
@@ -536,10 +569,14 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
         if w_last < _N_MSG_WORDS:
             msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
 
+    mul = max(1, int(max_unit_len))
     for j in range(len(unit_start)):
         us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
+        # Highest word index unit j's LO part can reach: its start offset
+        # is at most mul*j (hi spills one word further inside place()).
+        span = (scale * mul * j) // 4
         if not utf16:
-            place(us, ul, uw, scale * (j + 1))
+            place(us, ul, uw, span)
         else:
             # Bytes b0..b3 -> code units (b0|b1<<16) at 2*us and
             # (b2|b3<<16) at 2*us+4.
@@ -550,12 +587,17 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
             off = us * 2
             blen_lo = jnp.minimum(ul, 2) * 2
             blen_hi = jnp.maximum(ul - 2, 0) * 2
-            place(off, blen_lo, lo16, scale * (j + 1))
-            place(off + 4, blen_hi, hi16, scale * (j + 1) + 1)
+            place(off, blen_lo, lo16, span)
+            place(off + 4, blen_hi, hi16, span + 1)
     end = out_len * scale
     mark = _U32(0x80) << (_U32(8) * (end & 3).astype(_U32))
     widx = end >> 2
-    for w_i in range(_N_MSG_WORDS):
+    # Emitted candidates end at <= out_width bytes, so the terminator can
+    # only land in the first (out_width*scale)//4 + 1 words; overlong
+    # lanes are masked garbage either way.
+    n_term = (_N_MSG_WORDS if out_width is None
+              else min(_N_MSG_WORDS, (int(out_width) * scale) // 4 + 1))
+    for w_i in range(n_term):
         msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
     bits = (end * 8).astype(_U32)
     if big_endian_length:
@@ -678,15 +720,20 @@ def _sha1_rounds(msg, g, s):
     )
 
 
-def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s):
+def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s,
+                max_unit_len=4, out_width=None):
     """Message assembly + compression for one algo; returns the state-word
     tuple (4 for MD5/MD4/NTLM, 5 for SHA-1)."""
     if algo == "ntlm":
         msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
-                                  g, s, utf16=True)
+                                  g, s, utf16=True,
+                                  max_unit_len=max_unit_len,
+                                  out_width=out_width)
         return _md4_rounds(msg, g, s)
     msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
-                              g, s, big_endian_length=algo == "sha1")
+                              g, s, big_endian_length=algo == "sha1",
+                              max_unit_len=max_unit_len,
+                              out_width=out_width)
     if algo == "md5":
         return _md5_rounds(msg, g, s)
     if algo == "md4":
@@ -698,6 +745,7 @@ def _make_kernel(
     *, g: int, s: int, m: int, length_axis: int, k_opts: int,
     out_width: int, min_substitute: int, max_substitute: int,
     algo: str = "md5", win_k2: "int | None" = None,
+    max_val_len: int = 4,
 ):
     """Build the per-step kernel body (fully unrolled straight-line trace).
 
@@ -800,7 +848,8 @@ def _make_kernel(
         # The terminator lands after the data (within bounds for emitted
         # lanes; clash lanes may exceed — garbage words, masked).
         state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s)
+                            out_len, g, s, max_unit_len=max_val_len,
+                            out_width=out_width)
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
 
@@ -946,21 +995,26 @@ def fused_expand_md5(
         act, bitpos, weight, pbase = _scalar_units_prelude(
             radix_b, blk_base
         )
-        ins_bits = jnp.sum(inside_b * weight[:, :, None], axis=1)
         stt = start_b * act[:, :, None]  # [NB, M, L], <=1 slot set per j
         startp = jnp.sum(stt * (bitpos + 1)[:, :, None], axis=1)
         startp = jnp.where(startp == 0, 31, startp - 1)
         svl_j = jnp.sum(stt * vlen_b[:, :, 0][:, :, None], axis=1)
         svw_j = jnp.sum(stt.astype(_U32) * vopt_b[:, :, 0][:, :, None],
                         axis=1)
+        single = scalar_units == "single"
+        if single:  # one-byte spans: coverage == start, no clash ref
+            inputs = (tok_b, wlen_b, count_b, pbase, startp, svl_j, svw_j)
+        else:
+            ins_bits = jnp.sum(inside_b * weight[:, :, None], axis=1)
+            inputs = (tok_b, wlen_b, count_b, pbase, ins_bits, startp,
+                      svl_j, svw_j)
         return _launch_scalar_units(
-            "match",
-            (tok_b, wlen_b, count_b, pbase, ins_bits, startp, svl_j,
-             svw_j),
+            "match", inputs,
             block_stride=block_stride, length_axis=length_axis,
             out_width=out_width, min_substitute=min_substitute,
             max_substitute=max_substitute, algo=algo, nb=nb,
             num_lanes=num_lanes, interpret=interpret,
+            max_val_len=int(val_bytes.shape[1]), single_span=single,
         )
 
     kernel = _make_kernel(
@@ -968,6 +1022,7 @@ def fused_expand_md5(
         out_width=out_width, min_substitute=min_substitute,
         max_substitute=max_substitute, algo=algo,
         win_k2=None if win_v is None else int(win_v.shape[2]),
+        max_val_len=int(val_bytes.shape[1]),
     )
     inputs = [tok_b, wlen_b, radix_b, blk_base, count_b,
               inside_b, start_b]
@@ -986,6 +1041,7 @@ def _make_suball_kernel(
     *, g: int, s: int, p: int, length_axis: int,
     k_opts: int, out_width: int, min_substitute: int, max_substitute: int,
     algo: str = "md5", win_k2: "int | None" = None,
+    max_val_len: int = 4,
 ):
     """Per-step kernel body for substitute-all plans (``-s`` / ``-s -r``).
 
@@ -1083,7 +1139,8 @@ def _make_suball_kernel(
         out_len = cum
 
         state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s)
+                            out_len, g, s, max_unit_len=max_val_len,
+                            out_width=out_width)
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
 
@@ -1191,6 +1248,7 @@ def fused_expand_suball_md5(
             out_width=out_width, min_substitute=min_substitute,
             max_substitute=max_substitute, algo=algo, nb=nb,
             num_lanes=num_lanes, interpret=interpret,
+            max_val_len=int(val_bytes.shape[1]),
         )
 
     kernel = _make_suball_kernel(
@@ -1199,6 +1257,7 @@ def fused_expand_suball_md5(
         min_substitute=min_substitute, max_substitute=max_substitute,
         algo=algo,
         win_k2=None if win_v is None else int(win_v.shape[2]),
+        max_val_len=int(val_bytes.shape[1]),
     )
     inputs = [tok_b, wlen_b, pradix_b, blk_base, count_b, slotat_b,
               startat_b]

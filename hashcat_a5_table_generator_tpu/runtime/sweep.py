@@ -275,17 +275,22 @@ class Sweep:
         ]
     def _auto_num_blocks(self, kind: str) -> int:
         """Resolve ``num_blocks=None``: the measured per-arm best geometry
-        (PERF.md §9b) — when the fused Pallas kernel will take the launch,
-        stride 512 wins (256 for suball: its Π(options+1) variant space
-        fills larger strides poorly); the XLA path peaks at stride 128.
-        Candidates mode never engages the fused kernel
+        (PERF.md §9b/§11) — when the fused Pallas kernel will take the
+        launch, the K=1 scalar-units path peaks at stride 128 (best
+        fill; §11 removed most of the per-block cost), the general
+        kernel at stride 512 (256 for suball: its Π(options+1) variant
+        space fills larger strides poorly); the XLA path peaks at
+        stride 128.  Candidates mode never engages the fused kernel
         (``make_candidates_step`` has no fused path), so it always gets
         the XLA-best stride."""
-        from ..ops.pallas_expand import opts_for
+        from ..ops.pallas_expand import opts_for, scalar_units_for
 
         lanes = self.config.lanes
         if kind == "crack":
-            pref = 256 if self.spec.mode.startswith("suball") else 512
+            if scalar_units_for(self.plan):
+                pref = 128
+            else:
+                pref = 256 if self.spec.mode.startswith("suball") else 512
             if lanes % pref == 0:
                 nb = lanes // pref
                 if opts_for(self.spec, self.plan, self.ct,

@@ -115,9 +115,26 @@ def main():
                 + vl.sum().astype(jnp.uint32) + ins_.sum().astype(jnp.uint32)
                 + st_.sum().astype(jnp.uint32))
 
-    for name, fn, args in (("full", full, (p, t, b)),
-                           ("bare_kernel", bare, inputs),
-                           ("prep_only", prep, (p, t, b))):
+    # --- arm 4: full wrapper on the scalar-units path (PERF.md §11) ------
+    tier = pe.scalar_units_for(plan)
+    arms = [("full", full, (p, t, b)),
+            ("bare_kernel", bare, inputs),
+            ("prep_only", prep, (p, t, b))]
+    if tier:
+        skw = dict(kw, scalar_units=tier)
+
+        @jax.jit
+        def full_scalar(p_, t_, b_):
+            state, emit = pe.fused_expand_md5(
+                p_["tokens"], p_["lengths"], p_["match_pos"],
+                p_["match_len"], p_["match_radix"], p_["match_val_start"],
+                t_["val_bytes"], t_["val_len"],
+                b_["word"], b_["base"], b_["count"], **skw)
+            return state[:, 0].sum() + emit.sum().astype(jnp.uint32)
+
+        arms.append(("full_scalar", full_scalar, (p, t, b)))
+
+    for name, fn, args in arms:
         r = fn(*args)
         r.block_until_ready()
         acc = jnp.zeros((), jnp.uint32)

@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-5b TPU measurement session: the scalar-units kernel geometry
+# matrix (PERF.md §11). Run the moment the tunnel recovers; each step is
+# individually time-capped so a re-wedged tunnel fails the step, not the
+# session. Produces, under $OUT:
+#   probe_s{128,256,512}.txt       - 3-arm A/B/C at 2^22 lanes (probe_fused)
+#   probe_s{128,256}_g16.txt       - grid-height 16 variants
+#   bench_headline.json            - bench.py default MD5, both arms
+#   bench_suball.json              - bench.py -s substitute-all, both arms
+#   bench_sha1.json                - bench.py sha1, both arms
+#   sweep_cli.txt                  - sustained production CLI crack sweep
+set -u
+OUT=${OUT:-/tmp/tpu_session_r5b}
+mkdir -p "$OUT"
+cd "$(dirname "$0")/.."
+
+run() { # name timeout cmd...
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date -u +%H:%M:%S)) ===" | tee -a "$OUT/log"
+  timeout "$tmo" "$@" >"$OUT/$name.out" 2>"$OUT/$name.err"
+  echo "rc=$? $name" | tee -a "$OUT/log"
+  tail -3 "$OUT/$name.err" >> "$OUT/log" 2>/dev/null
+}
+
+# 1. Scalar-units geometry matrix: 3-arm probe at strides 128/256/512,
+#    then G=16 at the two best candidates.
+run probe_s128 900 python scripts/probe_fused.py 4194304 128
+run probe_s256 900 python scripts/probe_fused.py 4194304 256
+run probe_s512 900 python scripts/probe_fused.py 4194304 512
+# (env prefix on a bash FUNCTION call can leak into later steps; scope
+# the grid-height override to the child process instead.)
+run probe_s128_g16 900 env A5GEN_PALLAS_G=16 python scripts/probe_fused.py 4194304 128
+run probe_s256_g16 900 env A5GEN_PALLAS_G=16 python scripts/probe_fused.py 4194304 256
+
+# 1b. Per-block/per-lane cost split for the scalar kernel (two strides
+#     fit t = nb*C1 + lanes*C2).
+run prep_s128 900 python scripts/probe_prep_cost.py 4194304 128
+run prep_s512 900 python scripts/probe_prep_cost.py 4194304 512
+
+# 2. Official-bench configs, both arms (per-arm auto geometry).
+run bench_headline 700 python bench.py --wall-budget 600 --seconds 10
+run bench_suball 700 python bench.py --wall-budget 600 --seconds 10 --mode suball
+run bench_sha1 700 python bench.py --wall-budget 600 --seconds 10 --algo sha1
+
+# 3. Sustained production CLI crack sweep at the headline config.
+OUT="$OUT" python - <<'EOF'
+import hashlib, os, sys
+sys.path.insert(0, ".")
+from bench import synth_wordlist
+out = os.environ["OUT"]
+words = synth_wordlist(200000)
+os.makedirs(out, exist_ok=True)
+with open(os.path.join(out, "dict.txt"), "wb") as f:
+    f.write(b"\n".join(words) + b"\n")
+with open(os.path.join(out, "digests.txt"), "w") as f:
+    for i in (0, 1000, 100000):
+        f.write(hashlib.md5(words[i]).hexdigest() + "\n")
+EOF
+run emit_table 120 python -m hashcat_a5_table_generator_tpu \
+    --emit-table qwerty-cyrillic --output "$OUT/qc.table" /dev/null
+run sweep_cli 900 python -m hashcat_a5_table_generator_tpu \
+    "$OUT/dict.txt" -t "$OUT/qc.table" --backend device \
+    --digests "$OUT/digests.txt" --progress
+
+echo "=== session done ($(date -u +%H:%M:%S)) ===" | tee -a "$OUT/log"
+for f in probe_s128 probe_s256 probe_s512 probe_s128_g16 probe_s256_g16; do
+  echo "--- $f"; grep -h hashes_per_sec "$OUT/$f.out" 2>/dev/null
+done
+for f in bench_headline bench_suball bench_sha1; do
+  echo "--- $f"; tail -1 "$OUT/$f.out" 2>/dev/null
+done
+grep -E "hits|candidates hashed" "$OUT/sweep_cli.err" 2>/dev/null | tail -2

@@ -11,7 +11,7 @@ PY
     if grep -qiE 'tpu|axon' /tmp/tpu_probe.out; then
       cp /tmp/tpu_probe.out /tmp/tpu_status
       echo "$(date -u +%H:%M:%S) UP: $(cat /tmp/tpu_probe.out)" >> /tmp/tpu_watch.log
-      OUT=/tmp/tpu_session_r5 bash /root/repo/scripts/tpu_session.sh \
+      OUT=/tmp/tpu_session_r5b bash /root/repo/scripts/tpu_session2.sh \
         >> /tmp/tpu_watch.log 2>&1
       echo "$(date -u +%H:%M:%S) session done" >> /tmp/tpu_watch.log
       exit 0

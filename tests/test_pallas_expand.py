@@ -300,10 +300,13 @@ class TestScalarUnits:
             scalar_units_for,
         )
 
-        assert scalar_units_for(plan)
+        # Production threads the gate value itself ("single" here —
+        # one-byte spans drop the coverage bitmask).
+        tier = scalar_units_for(plan)
+        assert tier == "single"
         saw = False
         for emit_x, emit_p, state_x, state_p in _run_both(
-            spec, plan, ct, algo=algo, scalar_units=True
+            spec, plan, ct, algo=algo, scalar_units=tier
         ):
             np.testing.assert_array_equal(emit_x, emit_p)
             np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
@@ -341,9 +344,14 @@ class TestScalarUnits:
         plan = build_plan(spec, ct, pack_words([b"misses", b"sass"]))
         assert k_opts_for(plan) == 1
         assert not scalar_units_for(plan)
-        # ...while K=1 multi-char keys WITHOUT collisions qualify.
+        # Only single-byte spans active -> the "single" tier (no
+        # coverage bitmask in the kernel).
         plan = build_plan(spec, ct, pack_words([b"banana"]))
-        assert scalar_units_for(plan)
+        assert scalar_units_for(plan) == "single"
+        # Multi-byte spans without collisions -> the bitmask tier.
+        ct2 = compile_table({b"ab": [b"X"], b"c": [b"Y"]})
+        plan = build_plan(spec, ct2, pack_words([b"cabby"]))
+        assert scalar_units_for(plan) is True
         # Suball plans qualify unconditionally (segments are disjoint).
         sspec = AttackSpec(mode="suball", algo="md5")
         ct1 = compile_table(K1_MAP)
@@ -356,11 +364,37 @@ class TestScalarUnits:
         if wplan.windowed:
             assert not scalar_units_for(wplan)
 
+    def test_multichar_key_parity_bitmask_tier(self):
+        # K=1 multi-char keys without start collisions take the scalar
+        # path WITH the coverage bitmask (scalar_units_for -> True, not
+        # "single"): overlap clash masking must match the XLA pair.
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"ab": [b"X"], b"c": [b"YZ"]}
+        ct = compile_table(sub)
+        plan = build_plan(
+            spec, ct, pack_words([b"cabby", b"abcab", b"ccc", b"ab"])
+        )
+        assert scalar_units_for(plan) is True
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both(
+            spec, plan, ct, scalar_units=True
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
     def test_collision_table_parity_on_general_path(self):
         # The exact config the gate rejects must still be correct via the
-        # general kernel (production passes scalar_units=True but the
-        # wrapper only engages it when the caller's gate said so — this
-        # pins the fallback pairing end-to-end).
+        # general kernel. NOTE: the wrapper does NOT re-check collisions —
+        # it trusts the caller to pass scalar_units_for(plan)'s verdict
+        # (production does); passing True for a colliding plan would
+        # corrupt the packed start encode. This pins the general-kernel
+        # pairing the gate falls back to.
         spec = AttackSpec(mode="default", algo="md5")
         sub = {b"s": [b"5"], b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
         ct = compile_table(sub)
