@@ -386,6 +386,14 @@ def run_worker(args: argparse.Namespace) -> None:
             "per_launch_s": round(elapsed / max(launches, 1), 4),
             "blocks": nb,
         }
+        if fused_opts is not None:
+            # Which kernel tier actually ran (PERF.md §11): the scalar
+            # fast path engages only for K=1 full-enumeration plans.
+            sub["kernel"] = (
+                "scalar-single" if scalar_units == "single"
+                else "scalar-bitmask" if scalar_units
+                else "general"
+            )
         if guard_tripped:
             sub["partial"] = True  # chunks ran far slower than sized
         return sub

@@ -388,6 +388,49 @@ class TestScalarUnits:
             saw = saw or emit_x.any()
         assert saw
 
+    def test_fuzz_parity(self):
+        # Randomized K=1 tables (multichar keys, empty/multibyte values,
+        # binary bytes) through whichever tier the gate picks — the bit
+        # encodings (packed base, sentinel-31 starts, span bounds) must
+        # match the XLA pair on every sample. Few trials: interpret-mode
+        # kernel cost dominates.
+        import random
+
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        rng = random.Random(99)
+        alpha = b"abcx\x00\xff"
+
+        def rand_bytes(lo, hi):
+            return bytes(rng.choice(alpha) for _ in range(rng.randint(lo, hi)))
+
+        trials = 0
+        tiers = set()
+        while trials < 3:
+            sub = {}
+            for _ in range(rng.randint(1, 4)):
+                sub[rand_bytes(1, 2)] = [rand_bytes(0, 4)]
+            words = [rand_bytes(0, 8) for _ in range(5)]
+            spec = AttackSpec(mode="default", algo="md5",
+                              min_substitute=rng.choice([0, 1]),
+                              max_substitute=15)
+            ct = compile_table(sub)
+            plan = build_plan(spec, ct, pack_words(words))
+            tier = scalar_units_for(plan)
+            if not tier or ct.max_val_len < 1:
+                continue  # collisions / all-empty values: other tests
+            trials += 1
+            tiers.add(tier)
+            for emit_x, emit_p, state_x, state_p in _run_both(
+                spec, plan, ct, scalar_units=tier
+            ):
+                np.testing.assert_array_equal(emit_x, emit_p)
+                np.testing.assert_array_equal(
+                    state_x[emit_x], state_p[emit_p]
+                )
+
     def test_collision_table_parity_on_general_path(self):
         # The exact config the gate rejects must still be correct via the
         # general kernel. NOTE: the wrapper does NOT re-check collisions —
