@@ -388,7 +388,8 @@ def run_worker(args: argparse.Namespace) -> None:
         }
         if fused_opts is not None:
             # Which kernel tier actually ran (PERF.md §11): the scalar
-            # fast path engages only for K=1 full-enumeration plans.
+            # fast path engages for K=1 plans, full-enumeration and
+            # count-windowed alike.
             sub["kernel"] = (
                 "scalar-single" if scalar_units == "single"
                 else "scalar-bitmask" if scalar_units

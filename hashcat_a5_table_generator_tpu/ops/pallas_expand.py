@@ -328,14 +328,15 @@ def scalar_units_for(plan) -> "bool | str":
     collide there — ``find_matches`` appends one match per matching
     length); the packed start encode holds a single slot per position.
     Substitute-all plans qualify unconditionally: segments are disjoint
-    by construction.  Windowed plans keep the DP decode (the digit
-    stream is not the rank's binary form).
+    by construction.  Count-windowed plans qualify too: the decode stays
+    the suffix-count DP walk, but its chosen bits pack into the same
+    vector and the bitmask unit scheme applies unchanged.
 
     Returns ``"single"`` when additionally every active match span is one
     byte (all shipped 1:1 layout maps): overlaps are then impossible and
     the kernel drops its coverage bitmask entirely.  Both truthy values
     thread through ``fused_scalar_units`` unchanged."""
-    if k_opts_for(plan) != 1 or getattr(plan, "windowed", False):
+    if k_opts_for(plan) != 1:
         return False
     mp = getattr(plan, "match_pos", None)
     if mp is None:
@@ -370,6 +371,7 @@ def _make_scalar_kernel(
     *, g: int, s: int, kind: str, length_axis: int, out_width: int,
     min_substitute: int, max_substitute: int, algo: str = "md5",
     max_val_len: int = 4, single_span: bool = False,
+    windowed: bool = False, num_slots: "int | None" = None,
 ):
     """K=1 scalar-units kernel body (PERF.md §11), shared by match and
     substitute-all plans.
@@ -394,12 +396,24 @@ def _make_scalar_kernel(
     one byte — all shipped layout maps): coverage equals start, overlaps
     are impossible, so the ``a_j`` coverage-bitmask ref is DROPPED (the
     kernel takes 7 refs) and the clash test vanishes.
+
+    ``windowed`` (count-windowed plans): the decode stays the in-kernel
+    suffix-count DP walk (``_decode_tile_windowed``, K=1 quotient path),
+    but the chosen bits it yields pack into the same ``cb`` vector (one
+    shift-OR per slot via a ``bitpos[G, M]`` ref), so the whole bitmask
+    unit scheme above applies unchanged. The ``pbase`` ref is then the
+    raw base tile (scalar windowed ranks in slot 0) and three refs are
+    added: ``winv[G, M+1, K2]``, ``radix[G, M]``, ``bitpos[G, M]``
+    (``num_slots`` sizes the DP walk).
     """
     assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
     assert kind in ("match", "suball"), kind
     assert not (single_span and kind != "match")
+    assert not windowed or num_slots is not None
 
     def kernel(tok, wlen, count, pbase, *rest):
+        if windowed:
+            winv, radix, bitpos, rest = rest[0], rest[1], rest[2], rest[3:]
         if single_span:
             b_j, svl, svw, state_ref, emit_ref = rest
             a_j = None
@@ -407,7 +421,17 @@ def _make_scalar_kernel(
             a_j, b_j, svl, svw, state_ref, emit_ref = rest
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
-        cb = pbase[:, 0][:, None] + rank
+        if not windowed:
+            cb = pbase[:, 0][:, None] + rank
+        else:
+            digits = _decode_tile_windowed(
+                rank, pbase, winv, radix, num_slots, g, s, 1
+            )
+            cb = jnp.zeros((g, s), _I32)
+            for sl in range(num_slots):
+                cb = cb | (
+                    (digits[sl] > 0).astype(_I32) << bitpos[:, sl][:, None]
+                )
         chosen_count = _popcount_tile(cb)
 
         clash = jnp.zeros((g, s), jnp.bool_)
@@ -474,16 +498,18 @@ def _scalar_units_prelude(radix_b, blk_base):
 def _launch_scalar_units(
     kind, inputs, *, block_stride, length_axis, out_width,
     min_substitute, max_substitute, algo, nb, num_lanes, interpret,
-    max_val_len=4, single_span=False,
+    max_val_len=4, single_span=False, windowed=False, num_slots=None,
 ):
     """Shared kernel-build + launch tail for both scalar-units fast paths
     (``inputs`` = the 8-ref tuple of :func:`_make_scalar_kernel`, 7 when
-    ``single_span`` drops the coverage bitmask)."""
+    ``single_span`` drops the coverage bitmask, +3 when ``windowed``
+    selects the DP decode)."""
     kernel = _make_scalar_kernel(
         g=_G, s=block_stride, kind=kind, length_axis=length_axis,
         out_width=out_width, min_substitute=min_substitute,
         max_substitute=max_substitute, algo=algo,
         max_val_len=max_val_len, single_span=single_span,
+        windowed=windowed, num_slots=num_slots,
     )
     return _launch_fused(
         kernel, inputs, nb=nb, stride=block_stride, num_lanes=num_lanes,
@@ -986,7 +1012,7 @@ def fused_expand_md5(
     inside_b = ((jj >= ps) & (jj < ps + mlen_b[:, :, None])).astype(_I32)
     start_b = (jj == ps).astype(_I32)
 
-    if scalar_units and win_v is None and k_opts == 1:
+    if scalar_units and k_opts == 1:
         # K=1 scalar-units fast path (PERF.md §11): pack each active
         # slot's chosen bit at its active-rank position; per-byte
         # coverage / start / value fields become block-uniform [NB, L]
@@ -1001,13 +1027,17 @@ def fused_expand_md5(
         svl_j = jnp.sum(stt * vlen_b[:, :, 0][:, :, None], axis=1)
         svw_j = jnp.sum(stt.astype(_U32) * vopt_b[:, :, 0][:, :, None],
                         axis=1)
+        if win_v is None:  # full enumeration: cb = packed base + rank
+            head = (tok_b, wlen_b, count_b, pbase)
+        else:  # windowed: DP decode in-kernel, bits packed via bitpos
+            head = (tok_b, wlen_b, count_b, blk_base, win_v[blk_word],
+                    radix_b, bitpos)
         single = scalar_units == "single"
         if single:  # one-byte spans: coverage == start, no clash ref
-            inputs = (tok_b, wlen_b, count_b, pbase, startp, svl_j, svw_j)
+            inputs = head + (startp, svl_j, svw_j)
         else:
             ins_bits = jnp.sum(inside_b * weight[:, :, None], axis=1)
-            inputs = (tok_b, wlen_b, count_b, pbase, ins_bits, startp,
-                      svl_j, svw_j)
+            inputs = head + (ins_bits, startp, svl_j, svw_j)
         return _launch_scalar_units(
             "match", inputs,
             block_stride=block_stride, length_axis=length_axis,
@@ -1015,6 +1045,8 @@ def fused_expand_md5(
             max_substitute=max_substitute, algo=algo, nb=nb,
             num_lanes=num_lanes, interpret=interpret,
             max_val_len=int(val_bytes.shape[1]), single_span=single,
+            windowed=win_v is not None,
+            num_slots=None if win_v is None else m,
         )
 
     kernel = _make_kernel(
@@ -1218,7 +1250,7 @@ def fused_expand_suball_md5(
         slotat_b = jnp.full((nb, length_axis), -1, jnp.int32)
         startat_b = jnp.zeros((nb, length_axis), jnp.int32)
 
-    if scalar_units and win_v is None and k_opts == 1:
+    if scalar_units and k_opts == 1:
         # K=1 scalar-units fast path (PERF.md §11): the owning pattern
         # slot's chosen bit sits at its active-rank position; per-byte
         # fields resolve to block-uniform [NB, L] arrays via the
@@ -1240,15 +1272,21 @@ def fused_expand_suball_md5(
             owned, jnp.take_along_axis(vopt_b[:, :, 0], sl_clip, axis=1),
             _U32(0),
         )
+        if win_v is None:
+            head = (tok_b, wlen_b, count_b, pbase)
+        else:
+            head = (tok_b, wlen_b, count_b, blk_base, win_v[blk_word],
+                    pradix_b, bitpos)
         return _launch_scalar_units(
             "suball",
-            (tok_b, wlen_b, count_b, pbase, ownbit, isstart, svl_j,
-             svw_j),
+            head + (ownbit, isstart, svl_j, svw_j),
             block_stride=block_stride, length_axis=length_axis,
             out_width=out_width, min_substitute=min_substitute,
             max_substitute=max_substitute, algo=algo, nb=nb,
             num_lanes=num_lanes, interpret=interpret,
             max_val_len=int(val_bytes.shape[1]),
+            windowed=win_v is not None,
+            num_slots=None if win_v is None else p,
         )
 
     kernel = _make_suball_kernel(

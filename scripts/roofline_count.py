@@ -81,6 +81,11 @@ def main():
     ap.add_argument("--no-scalar-units", action="store_true",
                     help="force the general kernel even when the plan "
                          "qualifies for the K=1 scalar-units path")
+    ap.add_argument("--min-substitute", type=int, default=0,
+                    help="count-window floor (tight windows produce "
+                         "windowed plans — the DP-decode kernel)")
+    ap.add_argument("--max-substitute", type=int, default=15,
+                    help="count-window ceiling")
     args = ap.parse_args()
 
     from hashcat_a5_table_generator_tpu.models.attack import (
@@ -98,7 +103,9 @@ def main():
     sys.path.insert(0, "/root/repo")
     from bench import synth_wordlist
 
-    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    spec = AttackSpec(mode=args.mode, algo=args.algo,
+                      min_substitute=args.min_substitute,
+                      max_substitute=args.max_substitute)
     ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
     packed = pack_words(synth_wordlist(args.words))
     plan = build_plan(spec, ct, packed)

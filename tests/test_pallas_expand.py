@@ -357,12 +357,11 @@ class TestScalarUnits:
         ct1 = compile_table(K1_MAP)
         splan = build_plan(sspec, ct1, pack_words([b"glass"]))
         assert scalar_units_for(splan)
-        # Windowed plans keep the DP decode.
+        # Windowed plans qualify (the DP decode's bits pack into cb).
         wspec = AttackSpec(mode="default", algo="md5", min_substitute=1,
                            max_substitute=1)
         wplan = build_plan(wspec, ct1, pack_words([b"oleander"]))
-        if wplan.windowed:
-            assert not scalar_units_for(wplan)
+        assert wplan.windowed and scalar_units_for(wplan) == "single"
 
     def test_multichar_key_parity_bitmask_tier(self):
         # K=1 multi-char keys without start collisions take the scalar
@@ -382,6 +381,30 @@ class TestScalarUnits:
         saw = False
         for emit_x, emit_p, state_x, state_p in _run_both(
             spec, plan, ct, scalar_units=True
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    @pytest.mark.parametrize("mode", ["default", "suball"])
+    def test_windowed_parity(self, mode):
+        # Count-windowed plans on the scalar path: the DP decode's chosen
+        # bits pack into the same vector, the bitmask unit scheme follows.
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        spec = AttackSpec(mode=mode, algo="md5", min_substitute=1,
+                          max_substitute=1)
+        ct, plan = _arrays(spec, sub=K1_MAP)
+        assert plan.windowed
+        tier = scalar_units_for(plan)
+        assert tier
+        runner = _run_both if mode == "default" else _run_both_suball
+        saw = False
+        for emit_x, emit_p, state_x, state_p in runner(
+            spec, plan, ct, scalar_units=tier
         ):
             np.testing.assert_array_equal(emit_x, emit_p)
             np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
