@@ -465,8 +465,34 @@ def _make_scalar_kernel(
             cum = cum + ul
         out_len = cum
 
+        # Unit grouping: with values <= 2 bytes, 4//mul adjacent units
+        # always fit one u32 — merging them halves (2-byte values) or
+        # quarters (1-byte) the per-unit placement select chains. Unit
+        # words hold exactly their length's bytes (packed values zero-pad,
+        # tokens are one byte), so only zero-length units need masking,
+        # and the intra-group shift stays <= 8*(4 - mul) < 32.  The span
+        # bound is unchanged: merged unit k starts at <= mul*gsz*k =
+        # eff_mul*k bytes.
+        mu = max(1, max_val_len)
+        gsz = max(1, 4 // mu)
+        if gsz > 1:
+            g_start, g_len, g_word = [], [], []
+            for k in range(0, length_axis, gsz):
+                acc_w = jnp.zeros((g, s), _U32)
+                acc_l = jnp.zeros((g, s), _I32)
+                for t in range(k, min(k + gsz, length_axis)):
+                    w_m = jnp.where(unit_len[t] > 0, unit_word[t],
+                                    _U32(0))
+                    acc_w = acc_w | (
+                        w_m << (acc_l.astype(_U32) * _U32(8))
+                    )
+                    acc_l = acc_l + unit_len[t]
+                g_start.append(unit_start[k])
+                g_len.append(acc_l)
+                g_word.append(acc_w)
+            unit_start, unit_len, unit_word = g_start, g_len, g_word
         state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s, max_unit_len=max_val_len,
+                            out_len, g, s, max_unit_len=mu * gsz,
                             out_width=out_width)
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
