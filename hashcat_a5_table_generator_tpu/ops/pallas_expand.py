@@ -433,6 +433,7 @@ def _make_scalar_kernel(
                     (digits[sl] > 0).astype(_I32) << bitpos[:, sl][:, None]
                 )
         chosen_count = _popcount_tile(cb)
+        wl = wlen[:, 0][:, None]  # loop-invariant: hoisted once
 
         clash = jnp.zeros((g, s), jnp.bool_)
         cum = jnp.zeros((g, s), _I32)
@@ -452,7 +453,7 @@ def _make_scalar_kernel(
                 ch = ((cb >> a_j[:, j][:, None]) & 1) == 1
                 cov = ch.astype(_I32)
                 started = ch & (b_j[:, j][:, None] > 0)
-            in_word = j < wlen[:, 0][:, None]
+            in_word = j < wl
             ul = jnp.where(
                 in_word,
                 jnp.where(started, svl[:, j][:, None], 1 - cov),
@@ -465,35 +466,10 @@ def _make_scalar_kernel(
             cum = cum + ul
         out_len = cum
 
-        # Unit grouping: with values <= 2 bytes, 4//mul adjacent units
-        # always fit one u32 — merging them halves (2-byte values) or
-        # quarters (1-byte) the per-unit placement select chains. Unit
-        # words hold exactly their length's bytes (packed values zero-pad,
-        # tokens are one byte), so only zero-length units need masking,
-        # and the intra-group shift stays <= 8*(4 - mul) < 32.  The span
-        # bound is unchanged: merged unit k starts at <= mul*gsz*k =
-        # eff_mul*k bytes.
-        mu = max(1, max_val_len)
-        gsz = max(1, 4 // mu)
-        if gsz > 1:
-            g_start, g_len, g_word = [], [], []
-            for k in range(0, length_axis, gsz):
-                acc_w = jnp.zeros((g, s), _U32)
-                acc_l = jnp.zeros((g, s), _I32)
-                for t in range(k, min(k + gsz, length_axis)):
-                    w_m = jnp.where(unit_len[t] > 0, unit_word[t],
-                                    _U32(0))
-                    acc_w = acc_w | (
-                        w_m << (acc_l.astype(_U32) * _U32(8))
-                    )
-                    acc_l = acc_l + unit_len[t]
-                g_start.append(unit_start[k])
-                g_len.append(acc_l)
-                g_word.append(acc_w)
-            unit_start, unit_len, unit_word = g_start, g_len, g_word
-        state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s, max_unit_len=mu * gsz,
-                            out_width=out_width)
+        state = _grouped_hash_units(
+            algo, unit_start, unit_len, unit_word, out_len, g, s,
+            max_val_len=max_val_len, out_width=out_width,
+        )
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
 
@@ -793,6 +769,38 @@ def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s,
     return _sha1_rounds(msg, g, s)
 
 
+def _grouped_hash_units(algo, unit_start, unit_len, unit_word, out_len,
+                        g, s, *, max_val_len, out_width):
+    """:func:`_hash_units` behind unit grouping, shared by every kernel.
+
+    With values <= 2 bytes, ``4 // mul`` adjacent units always fit one
+    u32 — merging them halves (2-byte values) or quarters (1-byte) the
+    per-unit placement select chains. Unit words hold exactly their
+    length's bytes (packed values zero-pad, tokens are one byte), so
+    only zero-length units need masking, and the intra-group shift stays
+    <= 8*(4 - mul) < 32.  The span bound is unchanged: merged unit k
+    starts at most ``mul*gsz*k = eff_mul*k`` bytes in.
+    """
+    mu = max(1, max_val_len)
+    gsz = max(1, 4 // mu)
+    length_axis = len(unit_start)
+    if gsz > 1:
+        g_start, g_len, g_word = [], [], []
+        for k in range(0, length_axis, gsz):
+            acc_w = jnp.zeros((g, s), _U32)
+            acc_l = jnp.zeros((g, s), _I32)
+            for t in range(k, min(k + gsz, length_axis)):
+                w_m = jnp.where(unit_len[t] > 0, unit_word[t], _U32(0))
+                acc_w = acc_w | (w_m << (acc_l.astype(_U32) * _U32(8)))
+                acc_l = acc_l + unit_len[t]
+            g_start.append(unit_start[k])
+            g_len.append(acc_l)
+            g_word.append(acc_w)
+        unit_start, unit_len, unit_word = g_start, g_len, g_word
+    return _hash_units(algo, unit_start, unit_len, unit_word, out_len,
+                       g, s, max_unit_len=mu * gsz, out_width=out_width)
+
+
 def _make_kernel(
     *, g: int, s: int, m: int, length_axis: int, k_opts: int,
     out_width: int, min_substitute: int, max_substitute: int,
@@ -862,6 +870,7 @@ def _make_kernel(
         # so the span compares are precomputed in XLA (`inside`/`start`
         # refs, [G, M, L] 0/1) and the per-lane work here is just
         # chosen-AND + accumulate (PERF.md §7 lever 1).
+        wl = wlen[:, 0][:, None]  # loop-invariant: hoisted once
         clash = jnp.zeros((g, s), jnp.bool_)
         cum = jnp.zeros((g, s), _I32)
         unit_start = []
@@ -881,7 +890,7 @@ def _make_kernel(
                 svw = jnp.where(at_b, val_w[sl], svw)
                 svl = jnp.where(at_b, val_l[sl], svl)
             clash = clash | (cover > 1)
-            in_word = j < wlen[:, 0][:, None]
+            in_word = j < wl
             is_start = started > 0
             ul = jnp.where(
                 in_word,
@@ -899,9 +908,10 @@ def _make_kernel(
         # --- message build + compression (shared helpers) ---------------
         # The terminator lands after the data (within bounds for emitted
         # lanes; clash lanes may exceed — garbage words, masked).
-        state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s, max_unit_len=max_val_len,
-                            out_width=out_width)
+        state = _grouped_hash_units(
+            algo, unit_start, unit_len, unit_word, out_len, g, s,
+            max_val_len=max_val_len, out_width=out_width,
+        )
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
 
@@ -1164,6 +1174,7 @@ def _make_suball_kernel(
         # (position, segment) scan is precomputed in XLA — ``slotat`` /
         # ``startat`` [G, L] give the pattern slot owning byte j (-1 free)
         # and its span start (PERF.md §7 lever 1).
+        wl = wlen[:, 0][:, None]  # loop-invariant: hoisted once
         unit_start = []
         unit_len = []
         unit_word = []
@@ -1182,7 +1193,7 @@ def _make_suball_kernel(
                 vl_at_j = jnp.where(here, val_l[sl], vl_at_j)
             chosen_here = (slot_at_j >= 0) & (digit_at_j > 0)
             is_start = chosen_here & (j == start_at_j)
-            in_word = j < wlen[:, 0][:, None]
+            in_word = j < wl
             ul = jnp.where(
                 in_word,
                 jnp.where(is_start, vl_at_j,
@@ -1196,9 +1207,10 @@ def _make_suball_kernel(
             cum = cum + ul
         out_len = cum
 
-        state = _hash_units(algo, unit_start, unit_len, unit_word,
-                            out_len, g, s, max_unit_len=max_val_len,
-                            out_width=out_width)
+        state = _grouped_hash_units(
+            algo, unit_start, unit_len, unit_word, out_len, g, s,
+            max_val_len=max_val_len, out_width=out_width,
+        )
         for w_i, sw in enumerate(state):
             state_ref[:, w_i, :] = sw
 
