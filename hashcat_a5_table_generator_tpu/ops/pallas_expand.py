@@ -177,6 +177,16 @@ def enabled_by_env() -> bool:
     return True
 
 
+def _interpret_by_env() -> bool:
+    """``A5GEN_PALLAS_INTERPRET=1`` forces interpret-mode pallas_call in
+    the production wrappers.  Test/debug hook: it lets the full sweep
+    runtime drive the REAL kernel path (gates, precomputes, launch
+    plumbing) on the CPU backend, where compiled pallas is unavailable —
+    the e2e wiring test uses it so a threading bug cannot hide until a
+    TPU run."""
+    return os.environ.get("A5GEN_PALLAS_INTERPRET") == "1"
+
+
 def _on_tpu() -> bool:
     """Device platform, not backend name: the remote tunnel fronts "tpu"
     devices behind a differently-named backend (see ops.pallas_md5)."""
@@ -1025,6 +1035,7 @@ def fused_expand_md5(
     ``scalar_units`` (host-gated via :func:`scalar_units_for`) selects the
     K=1 fast kernel (PERF.md §11) for full-enumeration launches.
     """
+    interpret = interpret or _interpret_by_env()
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
@@ -1257,6 +1268,7 @@ def fused_expand_suball_md5(
     callers must have checked :func:`eligible` with the plan's
     ``num_segments``.
     """
+    interpret = interpret or _interpret_by_env()
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     p = pat_radix.shape[1]
     gs = seg_pat.shape[1]

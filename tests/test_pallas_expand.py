@@ -473,6 +473,69 @@ class TestScalarUnits:
         assert saw
 
 
+class TestProductionWiring:
+    """The full sweep runtime driving the REAL fused-kernel path: fake a
+    TPU device so the gates open, force interpret-mode pallas (the
+    ``A5GEN_PALLAS_INTERPRET`` hook), and run a production crack sweep on
+    CPU. A threading bug in sweep -> make_crack_step ->
+    fused_expand_md5(scalar_units=...) would otherwise only surface on
+    real hardware."""
+
+    def test_crack_sweep_through_scalar_kernel(self, monkeypatch):
+        import hashlib
+
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            iter_candidates,
+        )
+        from hashcat_a5_table_generator_tpu.runtime import (
+            HitRecorder,
+            Sweep,
+            SweepConfig,
+        )
+
+        class _Dev:
+            platform = "tpu"
+
+        monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
+        monkeypatch.delenv("A5GEN_PALLAS", raising=False)
+        monkeypatch.setenv("A5GEN_PALLAS_INTERPRET", "1")
+        # Spy on the wrapper: if the gate silently fell back to the XLA
+        # pair, this test would pass without testing anything.
+        calls = []
+        real = pe.fused_expand_md5
+
+        def spy(*a, **kw):
+            calls.append(kw.get("scalar_units"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pe, "fused_expand_md5", spy)
+
+        words = [b"glass", b"hello", b"oleander"]
+        planted = [
+            list(iter_candidates(words[0], K1_MAP, 0, 15))[1],
+            list(iter_candidates(words[2], K1_MAP, 0, 15))[-1],
+        ]
+        digests = [hashlib.md5(c).digest() for c in planted]
+        spec = AttackSpec(mode="default", algo="md5")
+        sweep = Sweep(
+            spec, K1_MAP, words, digests,
+            # num_blocks=None: the production auto-geometry must itself
+            # pick a kernel-eligible stride (PERF.md §11 -> 128).
+            config=SweepConfig(lanes=1024, num_blocks=None),
+        )
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        assert scalar_units_for(sweep.plan) == "single"
+        rec = HitRecorder()
+        res = sweep.run_crack(rec)
+        assert calls and all(t == "single" for t in calls)
+        assert res.n_hits == len(planted)
+        assert sorted(h.candidate for h in res.hits) == sorted(planted)
+
+
 @pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
 def test_other_algos_match_xla(algo):
     """SHA-1 (BE schedule + 5 state words), NTLM (UTF-16LE expansion +
