@@ -535,6 +535,64 @@ class TestProductionWiring:
         assert res.n_hits == len(planted)
         assert sorted(h.candidate for h in res.hits) == sorted(planted)
 
+    @pytest.mark.parametrize("mode,window", [
+        ("suball", None),
+        ("default", (1, 1)),  # windowed plan -> windowed scalar kernel
+    ])
+    def test_other_modes_through_kernel(self, monkeypatch, mode, window):
+        import hashlib
+
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            iter_candidates,
+        )
+        from hashcat_a5_table_generator_tpu.runtime import (
+            HitRecorder,
+            Sweep,
+            SweepConfig,
+        )
+
+        class _Dev:
+            platform = "tpu"
+
+        monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
+        monkeypatch.delenv("A5GEN_PALLAS", raising=False)
+        monkeypatch.setenv("A5GEN_PALLAS_INTERPRET", "1")
+        calls = []
+        wrapper = ("fused_expand_suball_md5" if mode == "suball"
+                   else "fused_expand_md5")
+        real = getattr(pe, wrapper)
+
+        def spy(*a, **kw):
+            calls.append(kw.get("scalar_units"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pe, wrapper, spy)
+
+        kw = dict(mode=mode, algo="md5")
+        lo, hi = window or (0, 15)
+        if window:
+            kw.update(min_substitute=lo, max_substitute=hi)
+        spec = AttackSpec(**kw)
+        words = [b"glass", b"hello", b"oleander"]
+        cands = [c for w in words for c in iter_candidates(
+            w, K1_MAP, lo if window else 0, hi,
+            substitute_all=(mode == "suball"))]
+        planted = [cands[0], cands[-1]]
+        digests = [hashlib.md5(c).digest() for c in planted]
+        sweep = Sweep(spec, K1_MAP, words, digests,
+                      config=SweepConfig(lanes=1024, num_blocks=None))
+        if window:
+            assert sweep.plan.windowed
+        # The "single" tier is match-only; suball plans ride the plain
+        # scalar tier (segments are disjoint, no start encode needed).
+        want_tier = True if mode == "suball" else "single"
+        rec = HitRecorder()
+        res = sweep.run_crack(rec)
+        assert calls and all(t == want_tier for t in calls)
+        assert {h.candidate for h in res.hits} == set(planted)
+        assert res.n_hits >= len(set(planted))
+
 
 @pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
 def test_other_algos_match_xla(algo):
