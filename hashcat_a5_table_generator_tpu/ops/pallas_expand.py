@@ -984,14 +984,23 @@ def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
             (_G,) + tuple(trail), lambda i: (i,) + (0,) * len(trail)
         )
 
+    # Inside shard_map the outputs vary over whatever mesh axes the
+    # inputs vary over (the per-device block batches) — shard_map's
+    # check_vma rejects a bare ShapeDtypeStruct there, so propagate the
+    # union of the inputs' varying axes explicitly.
+    vma = frozenset()
+    for x in inputs:
+        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+
     state, emit = pl.pallas_call(
         kernel,
         grid=(nb // _G,),
         in_specs=[row_spec(x.shape[1:]) for x in inputs],
         out_specs=[row_spec((n_state, stride)), row_spec((stride,))],
         out_shape=[
-            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32),
-            jax.ShapeDtypeStruct((nb, stride), jnp.int32),
+            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32,
+                                 vma=vma),
+            jax.ShapeDtypeStruct((nb, stride), jnp.int32, vma=vma),
         ],
         interpret=interpret,
     )(*inputs)

@@ -169,6 +169,11 @@ def make_sharded_crack_step(
             "n_emitted": rep,
             "n_hits": rep,
         },
+        # Out specs are explicit, so the static vma checker adds nothing
+        # here — and it rejects pallas_call bodies whose block specs mix
+        # replicated plan/table refs with sharded block refs (JAX's own
+        # error message recommends exactly this switch).
+        check_vma=False,
     )
     return jax.jit(mapped)
 
@@ -206,6 +211,7 @@ def make_sharded_candidates_step(
         mesh=mesh,
         in_specs=(rep, rep, shard),
         out_specs=(shard, shard, shard, shard),
+        check_vma=False,  # see make_sharded_crack_step
     )
     return jax.jit(mapped)
 

@@ -494,10 +494,9 @@ class TestProductionWiring:
             SweepConfig,
         )
 
-        class _Dev:
-            platform = "tpu"
-
-        monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
+        # Patch the gate itself, not jax.devices: the module-level jax
+        # is shared, and the sharded path needs the REAL device list.
+        monkeypatch.setattr(pe, "_on_tpu", lambda: True)
         monkeypatch.delenv("A5GEN_PALLAS", raising=False)
         monkeypatch.setenv("A5GEN_PALLAS_INTERPRET", "1")
         # Spy on the wrapper: if the gate silently fell back to the XLA
@@ -552,10 +551,9 @@ class TestProductionWiring:
             SweepConfig,
         )
 
-        class _Dev:
-            platform = "tpu"
-
-        monkeypatch.setattr(pe.jax, "devices", lambda: [_Dev()])
+        # Patch the gate itself, not jax.devices: the module-level jax
+        # is shared, and the sharded path needs the REAL device list.
+        monkeypatch.setattr(pe, "_on_tpu", lambda: True)
         monkeypatch.delenv("A5GEN_PALLAS", raising=False)
         monkeypatch.setenv("A5GEN_PALLAS_INTERPRET", "1")
         calls = []
@@ -592,6 +590,53 @@ class TestProductionWiring:
         assert calls and all(t == want_tier for t in calls)
         assert {h.candidate for h in res.hits} == set(planted)
         assert res.n_hits >= len(set(planted))
+
+    def test_sharded_sweep_through_kernel(self, monkeypatch):
+        # The shard_map'd crack step must thread the kernel flags too
+        # (parallel.mesh -> make_fused_body); 2 virtual CPU devices,
+        # interpret-mode pallas inside shard_map.
+        import hashlib
+
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 virtual devices")
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+        from hashcat_a5_table_generator_tpu.oracle.engines import (
+            iter_candidates,
+        )
+        from hashcat_a5_table_generator_tpu.runtime import (
+            HitRecorder,
+            Sweep,
+            SweepConfig,
+        )
+
+        # Patch the gate itself, not jax.devices: the module-level jax
+        # is shared, and the sharded path needs the REAL device list.
+        monkeypatch.setattr(pe, "_on_tpu", lambda: True)
+        monkeypatch.delenv("A5GEN_PALLAS", raising=False)
+        monkeypatch.setenv("A5GEN_PALLAS_INTERPRET", "1")
+        calls = []
+        real = pe.fused_expand_md5
+
+        def spy(*a, **kw):
+            calls.append(kw.get("scalar_units"))
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pe, "fused_expand_md5", spy)
+
+        words = [b"glass", b"hello", b"oleander", b"misses"]
+        planted = [list(iter_candidates(words[0], K1_MAP, 0, 15))[1]]
+        spec = AttackSpec(mode="default", algo="md5")
+        sweep = Sweep(
+            spec, K1_MAP, words,
+            [hashlib.md5(planted[0]).digest()],
+            config=SweepConfig(lanes=1024, num_blocks=None, devices=2),
+        )
+        rec = HitRecorder()
+        res = sweep.run_crack(rec)
+        assert calls and all(t == "single" for t in calls)
+        assert {h.candidate for h in res.hits} == set(planted)
 
 
 @pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
