@@ -105,6 +105,9 @@ def build_parser() -> argparse.ArgumentParser:
                     help="variant-block layout (same semantics as the CLI; "
                          "auto = stride whenever blocks divides lanes evenly)")
     ap.add_argument("--mode", default="default", help="attack mode")
+    ap.add_argument("--table", default="qwerty-cyrillic",
+                    help="built-in layout table (BASELINE.json configs "
+                         "3-4 use czech / greek-hebrew)")
     ap.add_argument("--arm", choices=("auto", "xla", "pallas"),
                     default="auto",
                     help="which expand+hash arm to time: the XLA pair, the "
@@ -191,7 +194,7 @@ def run_worker(args: argparse.Namespace) -> None:
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
 
     spec = AttackSpec(mode=args.mode, algo=args.algo)
-    sub_map = get_layout("qwerty-cyrillic").to_substitution_map()
+    sub_map = get_layout(args.table).to_substitution_map()
     ct = compile_table(sub_map)
     words = synth_wordlist(args.words)
     packed = pack_words(words)
@@ -457,6 +460,11 @@ def run_worker(args: argparse.Namespace) -> None:
             "per_launch_s": results[winner].get("per_launch_s", 0.0),
             "arm": winner,
         }
+        if results[winner].get("kernel"):
+            record["kernel"] = results[winner]["kernel"]
+        if args.mode != "default" or args.table != "qwerty-cyrillic":
+            record["mode"] = args.mode
+            record["table"] = args.table
         if results[winner].get("partial"):
             record["partial"] = True
         if len(results) > 1 or partial_arms:
@@ -587,7 +595,8 @@ def run_orchestrator(args: argparse.Namespace) -> None:
             "--words", str(vals["words"]),
             "--seconds", str(vals["seconds"]),
             "--batches", str(vals["batches"]), "--algo", args.algo,
-            "--mode", args.mode, "--init-timeout", str(init_timeout),
+            "--mode", args.mode, "--table", args.table,
+            "--init-timeout", str(init_timeout),
             "--block-layout", args.block_layout, "--arm", arm or args.arm,
         ]
         if vals["blocks"] is not None:  # None = per-arm auto geometry

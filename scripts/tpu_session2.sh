@@ -42,6 +42,12 @@ run bench_headline 700 python bench.py --wall-budget 600 --seconds 10
 run bench_suball 700 python bench.py --wall-budget 600 --seconds 10 --mode suball
 run bench_sha1 700 python bench.py --wall-budget 600 --seconds 10 --algo sha1
 
+# 2b. BASELINE.json configs[3]/[4] faithful tables.
+run bench_czech_ntlm 700 python bench.py --wall-budget 600 --seconds 10 \
+    --table czech --algo ntlm
+run bench_greek_sha1 700 python bench.py --wall-budget 600 --seconds 10 \
+    --table greek-hebrew --algo sha1
+
 # 3. Sustained production CLI crack sweep at the headline config.
 OUT="$OUT" python - <<'EOF'
 import hashlib, os, sys
@@ -66,7 +72,8 @@ echo "=== session done ($(date -u +%H:%M:%S)) ===" | tee -a "$OUT/log"
 for f in probe_s128 probe_s256 probe_s512 probe_s128_g16 probe_s256_g16; do
   echo "--- $f"; grep -h hashes_per_sec "$OUT/$f.out" 2>/dev/null
 done
-for f in bench_headline bench_suball bench_sha1; do
+for f in bench_headline bench_suball bench_sha1 bench_czech_ntlm \
+         bench_greek_sha1; do
   echo "--- $f"; tail -1 "$OUT/$f.out" 2>/dev/null
 done
 grep -E "hits|candidates hashed" "$OUT/sweep_cli.err" 2>/dev/null | tail -2
