@@ -246,6 +246,17 @@ def run_worker(args: argparse.Namespace) -> None:
         return nb, stride
 
     p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    # The pallas arm's kernel takes the scalar-units fast path (PERF.md
+    # §11) exactly as the production sweep would.
+    scalar_units = scalar_units_for(plan)
+    if scalar_units:
+        # Word-level scalar-unit fields, precomputed once (as the sweep
+        # does): the pallas arm's per-launch prep becomes row gathers.
+        from hashcat_a5_table_generator_tpu.models.attack import (
+            scalar_units_arrays,
+        )
+
+        p.update(scalar_units_arrays(plan, ct))
 
     # Pre-cut real blocks from the sweep's head (host cost excluded: the
     # sweep runtime overlaps cutting with device execution), cached per
@@ -296,9 +307,6 @@ def run_worker(args: argparse.Namespace) -> None:
 
     # K=1 tables: the XLA arm's decode collapses to bit extraction.
     radix2 = k_opts_for(plan) == 1
-    # ...and the pallas arm's kernel takes the scalar-units fast path
-    # (PERF.md §11) exactly as the production sweep would.
-    scalar_units = scalar_units_for(plan)
     zero = jnp.zeros((), jnp.int32)
 
     def time_arm(arm_name: str, fused_opts, nb: int,

@@ -206,6 +206,21 @@ def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
     return np.unpackbits(bytes_, bitorder="little")[:num_lanes].astype(bool)
 
 
+def scalar_units_arrays(plan, ct) -> Dict[str, jnp.ndarray]:
+    """Device copies of ``pallas_expand.scalar_units_fields``, namespaced
+    for the plan dict (``su_*``).  Callers merge them into
+    :func:`plan_arrays`' output when the fused kernel may take launches:
+    the wrappers then replace their per-launch [NB, M, L] precompute with
+    word-row gathers (PERF.md §12).  Empty when the plan doesn't qualify
+    — the plan dict's pytree structure stays stable per sweep."""
+    from ..ops.pallas_expand import scalar_units_fields
+
+    fields = scalar_units_fields(plan, ct)
+    if not fields:
+        return {}
+    return {f"su_{k}": jnp.asarray(v) for k, v in fields.items()}
+
+
 def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
@@ -254,6 +269,10 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
                 scalar_units=fused_scalar_units,
+                # su_* entries (scalar_units_arrays): word-level fields
+                # precomputed per sweep; the wrapper preps by gathering.
+                pre={k[3:]: v for k, v in plan.items()
+                     if k.startswith("su_")} or None,
                 algo=spec.algo,
                 # Count-windowed plans carry win_v; the kernel walks the
                 # suffix-count DP in place of the mixed-radix decode.

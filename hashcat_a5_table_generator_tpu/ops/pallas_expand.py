@@ -365,6 +365,99 @@ def scalar_units_for(plan) -> "bool | str":
     return not bool((srt[:, 1:] == srt[:, :-1]).any())
 
 
+def scalar_units_fields(plan, ct) -> "dict | None":
+    """Word-level numpy precomputes for the scalar-units fast path.
+
+    The per-byte coverage / start / value fields the wrappers need are
+    WORD-level facts (geometry and K=1 values don't depend on the block),
+    yet the in-XLA precompute rebuilt them from block-gathered arrays on
+    every launch — [NB, M, L] reductions costing a measurable slice of
+    launch wall (PERF.md §12).  Computing them here once per sweep turns
+    the per-launch prep into pure row gathers.
+
+    Returns ``{"weight", "bitpos" [B, M|P], "startp"|"ownbit",
+    "svl", "svw" [B, L], +"ins_bits" [B, L] (match bitmask tier),
+    +"isstart" [B, L] (suball)}`` as numpy arrays, or None when the plan
+    doesn't qualify.  Cached on the plan object (plans are frozen;
+    keyed by the table identity)."""
+    tier = scalar_units_for(plan)
+    if not tier:
+        return None
+    cache = getattr(plan, "_scalar_fields_cache", None)
+    if cache is not None and cache[0] is ct:
+        return cache[1]
+    radix = np.asarray(plan.pat_radix)
+    act = (radix > 1).astype(np.int32)
+    bitpos = np.cumsum(act, axis=1) - act
+    weight = (act << bitpos).astype(np.int32)
+    tokens = np.asarray(plan.tokens)
+    b, length_axis = tokens.shape
+    val_bytes = np.asarray(ct.val_bytes)
+    val_len = np.asarray(ct.val_len)
+    vw_packed = np.zeros(val_bytes.shape[0], np.uint32)
+    for k in range(val_bytes.shape[1]):
+        vw_packed |= val_bytes[:, k].astype(np.uint32) << np.uint32(8 * k)
+    jj = np.arange(length_axis, dtype=np.int32)[None, None, :]
+    if getattr(plan, "match_pos", None) is not None:
+        vs = np.asarray(plan.match_val_start)
+        rows = np.clip(vs, 0, val_bytes.shape[0] - 1)
+        vw_slot = vw_packed[rows]  # [B, M] (K=1: option 0)
+        vl_slot = val_len[rows].astype(np.int32)
+        stt = ((jj == np.asarray(plan.match_pos)[:, :, None])
+               & (act[:, :, None] > 0))  # [B, M, L], <=1 slot per j
+        startp = (stt * (bitpos + 1)[:, :, None]).sum(1)
+        out = {
+            "weight": weight,
+            "bitpos": bitpos,
+            "startp": np.where(startp == 0, 31, startp - 1).astype(
+                np.int32),
+            "svl": (stt * vl_slot[:, :, None]).sum(1).astype(np.int32),
+            "svw": (stt.astype(np.uint32)
+                    * vw_slot[:, :, None]).sum(1, dtype=np.uint32),
+        }
+        if tier != "single":
+            mlen = np.asarray(plan.match_len)
+            ps = np.asarray(plan.match_pos)[:, :, None]
+            inside = (jj >= ps) & (jj < ps + mlen[:, :, None])
+            out["ins_bits"] = (inside * weight[:, :, None]).sum(1).astype(
+                np.int32)
+    else:
+        st = np.asarray(plan.seg_orig_start)
+        sl = np.asarray(plan.seg_orig_len)
+        sp = np.asarray(plan.seg_pat)
+        if sp.shape[1]:
+            st3 = st[:, :, None]
+            covered = (sl[:, :, None] > 0) & (jj >= st3) & (
+                jj < st3 + sl[:, :, None])  # [B, GS, L]
+            slotat = np.where(covered, sp[:, :, None], -1).max(axis=1)
+            startat = np.where(covered, st3, 0).max(axis=1)
+        else:
+            slotat = np.full((b, length_axis), -1, np.int32)
+            startat = np.zeros((b, length_axis), np.int32)
+        owned = slotat >= 0
+        sl_clip = np.clip(slotat, 0, radix.shape[1] - 1)
+        rows_i = np.arange(b)[:, None]
+        own_act = act[rows_i, sl_clip] > 0
+        vs = np.asarray(plan.pat_val_start)
+        rows = np.clip(vs, 0, val_bytes.shape[0] - 1)
+        vw_slot = vw_packed[rows]
+        vl_slot = val_len[rows].astype(np.int32)
+        out = {
+            "weight": weight,
+            "bitpos": bitpos,
+            "ownbit": np.where(owned & own_act, bitpos[rows_i, sl_clip],
+                               31).astype(np.int32),
+            "isstart": (owned & (startat == np.arange(
+                length_axis)[None, :])).astype(np.int32),
+            "svl": np.where(owned, vl_slot[rows_i, sl_clip], 0).astype(
+                np.int32),
+            "svw": np.where(owned, vw_slot[rows_i, sl_clip],
+                            np.uint32(0)).astype(np.uint32),
+        }
+    object.__setattr__(plan, "_scalar_fields_cache", (ct, out))
+    return out
+
+
 def _popcount_tile(cb):
     """SWAR popcount of a nonnegative i32 tile (values < 2^26 here:
     packed chosen-slot vectors over <= 24 active slots plus block carry)."""
@@ -1031,6 +1124,7 @@ def fused_expand_md5(
     algo: str = "md5",
     win_v: "jnp.ndarray | None" = None,  # int32 [B, M+1, K2] (windowed)
     scalar_units: bool = False,
+    pre: "dict | None" = None,  # scalar_units_fields device arrays
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for a fixed-stride launch.
@@ -1042,7 +1136,9 @@ def fused_expand_md5(
     (count-windowed plans) switches the in-kernel decode to the
     suffix-count DP walk; block base cursors are then scalar ranks.
     ``scalar_units`` (host-gated via :func:`scalar_units_for`) selects the
-    K=1 fast kernel (PERF.md §11) for full-enumeration launches.
+    K=1 fast kernel (PERF.md §11) for full-enumeration launches;
+    ``pre`` (the device copy of :func:`scalar_units_fields`) replaces the
+    in-trace [NB, M, L] precompute with word-row gathers (PERF.md §12).
     """
     interpret = interpret or _interpret_by_env()
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
@@ -1074,25 +1170,40 @@ def fused_expand_md5(
         # coverage / start / value fields become block-uniform [NB, L]
         # arrays (the host gate guarantees at most one start per
         # position).
-        act, bitpos, weight, pbase = _scalar_units_prelude(
-            radix_b, blk_base
-        )
-        stt = start_b * act[:, :, None]  # [NB, M, L], <=1 slot set per j
-        startp = jnp.sum(stt * (bitpos + 1)[:, :, None], axis=1)
-        startp = jnp.where(startp == 0, 31, startp - 1)
-        svl_j = jnp.sum(stt * vlen_b[:, :, 0][:, :, None], axis=1)
-        svw_j = jnp.sum(stt.astype(_U32) * vopt_b[:, :, 0][:, :, None],
-                        axis=1)
+        single = scalar_units == "single"
+        if pre is not None:
+            # Word-level fields precomputed once per sweep
+            # (scalar_units_fields): the launch prep is row gathers.
+            bitpos = pre["bitpos"][blk_word]
+            pbase = jnp.sum(
+                blk_base * pre["weight"][blk_word], axis=1
+            )[:, None]
+            startp = pre["startp"][blk_word]
+            svl_j = pre["svl"][blk_word]
+            svw_j = pre["svw"][blk_word]
+            ins_bits = None if single else pre["ins_bits"][blk_word]
+        else:
+            act, bitpos, weight, pbase = _scalar_units_prelude(
+                radix_b, blk_base
+            )
+            stt = start_b * act[:, :, None]  # [NB, M, L], <=1 slot per j
+            startp = jnp.sum(stt * (bitpos + 1)[:, :, None], axis=1)
+            startp = jnp.where(startp == 0, 31, startp - 1)
+            svl_j = jnp.sum(stt * vlen_b[:, :, 0][:, :, None], axis=1)
+            svw_j = jnp.sum(
+                stt.astype(_U32) * vopt_b[:, :, 0][:, :, None], axis=1
+            )
+            ins_bits = None if single else jnp.sum(
+                inside_b * weight[:, :, None], axis=1
+            )
         if win_v is None:  # full enumeration: cb = packed base + rank
             head = (tok_b, wlen_b, count_b, pbase)
         else:  # windowed: DP decode in-kernel, bits packed via bitpos
             head = (tok_b, wlen_b, count_b, blk_base, win_v[blk_word],
                     radix_b, bitpos)
-        single = scalar_units == "single"
         if single:  # one-byte spans: coverage == start, no clash ref
             inputs = head + (startp, svl_j, svw_j)
         else:
-            ins_bits = jnp.sum(inside_b * weight[:, :, None], axis=1)
             inputs = head + (ins_bits, startp, svl_j, svw_j)
         return _launch_scalar_units(
             "match", inputs,
@@ -1267,6 +1378,7 @@ def fused_expand_suball_md5(
     algo: str = "md5",
     win_v: "jnp.ndarray | None" = None,  # int32 [B, P+1, K2] (windowed)
     scalar_units: bool = False,
+    pre: "dict | None" = None,  # scalar_units_fields device arrays
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for substitute-all fixed-stride launches.
@@ -1314,23 +1426,38 @@ def fused_expand_suball_md5(
         # slot's chosen bit sits at its active-rank position; per-byte
         # fields resolve to block-uniform [NB, L] arrays via the
         # already-computed segment ownership (``slotat_b``/``startat_b``).
-        act, bitpos, _, pbase = _scalar_units_prelude(pradix_b, blk_base)
-        sl_clip = jnp.clip(slotat_b, 0, p - 1)
-        owned = slotat_b >= 0
-        own_act = jnp.take_along_axis(act, sl_clip, axis=1) > 0
-        ownbit = jnp.where(
-            owned & own_act, jnp.take_along_axis(bitpos, sl_clip, axis=1),
-            31,
-        )
-        jj2 = jnp.arange(length_axis, dtype=jnp.int32)[None, :]
-        isstart = (owned & (startat_b == jj2)).astype(_I32)
-        svl_j = jnp.where(
-            owned, jnp.take_along_axis(vlen_b[:, :, 0], sl_clip, axis=1), 0
-        )
-        svw_j = jnp.where(
-            owned, jnp.take_along_axis(vopt_b[:, :, 0], sl_clip, axis=1),
-            _U32(0),
-        )
+        if pre is not None:  # word-level fields: launch prep is gathers
+            bitpos = pre["bitpos"][blk_word]
+            pbase = jnp.sum(
+                blk_base * pre["weight"][blk_word], axis=1
+            )[:, None]
+            ownbit = pre["ownbit"][blk_word]
+            isstart = pre["isstart"][blk_word]
+            svl_j = pre["svl"][blk_word]
+            svw_j = pre["svw"][blk_word]
+        else:
+            act, bitpos, _, pbase = _scalar_units_prelude(
+                pradix_b, blk_base
+            )
+            sl_clip = jnp.clip(slotat_b, 0, p - 1)
+            owned = slotat_b >= 0
+            own_act = jnp.take_along_axis(act, sl_clip, axis=1) > 0
+            ownbit = jnp.where(
+                owned & own_act,
+                jnp.take_along_axis(bitpos, sl_clip, axis=1),
+                31,
+            )
+            jj2 = jnp.arange(length_axis, dtype=jnp.int32)[None, :]
+            isstart = (owned & (startat_b == jj2)).astype(_I32)
+            svl_j = jnp.where(
+                owned,
+                jnp.take_along_axis(vlen_b[:, :, 0], sl_clip, axis=1), 0
+            )
+            svw_j = jnp.where(
+                owned,
+                jnp.take_along_axis(vopt_b[:, :, 0], sl_clip, axis=1),
+                _U32(0),
+            )
         if win_v is None:
             head = (tok_b, wlen_b, count_b, pbase)
         else:

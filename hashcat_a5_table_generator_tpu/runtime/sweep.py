@@ -50,6 +50,7 @@ from ..models.attack import (
     make_candidates_step,
     make_crack_step,
     plan_arrays,
+    scalar_units_arrays,
     table_arrays,
     unpack_bits,
 )
@@ -416,6 +417,10 @@ class Sweep:
         radix2 = k_opts_for(plan) == 1
         if n_devices == 1:
             p, t = plan_arrays(plan), table_arrays(self.ct)
+            if fused_opts is not None and scalar_units:
+                # Word-level scalar-unit fields precomputed once per
+                # sweep; the kernel wrapper preps by gathering.
+                p.update(scalar_units_arrays(plan, self.ct))
             if kind == "crack":
                 step = make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
@@ -447,10 +452,13 @@ class Sweep:
                 fused_expand_opts=fused_opts,
                 fused_scalar_units=scalar_units, radix2=radix2,
             )
+            parr = plan_arrays(plan)
+            if fused_opts is not None and scalar_units:
+                parr.update(scalar_units_arrays(plan, self.ct))
             p, t, darrs = replicate(
                 mesh,
                 (
-                    plan_arrays(plan),
+                    parr,
                     table_arrays(self.ct),
                     digest_arrays(build_digest_set(self.digests, spec.algo)),
                 ),

@@ -19,7 +19,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from bench import synth_wordlist
 from hashcat_a5_table_generator_tpu.models.attack import (
     AttackSpec, block_arrays, build_plan, digest_arrays, make_fused_body,
-    plan_arrays, table_arrays,
+    plan_arrays, scalar_units_arrays, table_arrays,
 )
 from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
 from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
@@ -70,10 +70,16 @@ def main():
         batches.append(block_arrays(batch, num_blocks=BLOCKS))
 
     results = {}
-    arms = [("xla", None, False), ("pallas_fused", k_opts, False)]
-    if scalar_units_for(plan):
-        arms.append(("pallas_scalar", k_opts, True))
-    for name, fused, scalar in arms:
+    arms = [("xla", None, False, p), ("pallas_fused", k_opts, False, p)]
+    tier = scalar_units_for(plan)
+    if tier:
+        # Two scalar arms: in-trace prep vs the per-sweep word-level
+        # precompute (PERF.md §12) — the A/B of the prep change itself.
+        p_aug = dict(p, **scalar_units_arrays(plan, ct))
+        arms += [("pallas_scalar", k_opts, tier, p),
+                 ("pallas_scalar_pre", k_opts, tier, p_aug)]
+    for name, fused, scalar, p_arm in arms:
+        p = p_arm
         body = make_fused_body(spec, num_lanes=LANES,
                                out_width=plan.out_width, block_stride=STRIDE,
                                fused_expand_opts=fused,

@@ -411,6 +411,52 @@ class TestScalarUnits:
             saw = saw or emit_x.any()
         assert saw
 
+    @pytest.mark.parametrize("mode", ["default", "suball"])
+    def test_pre_fields_match_in_trace_prep(self, mode):
+        # scalar_units_fields' numpy precompute (PERF.md §12) must yield
+        # bit-identical kernel outputs to the in-trace prep.
+        import jax.numpy as jnp
+
+        from hashcat_a5_table_generator_tpu.models.attack import (
+            scalar_units_arrays,
+        )
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            fused_expand_suball_md5,
+            scalar_units_for,
+        )
+
+        spec = AttackSpec(mode=mode, algo="md5")
+        ct, plan = _arrays(spec, sub=K1_MAP)
+        tier = scalar_units_for(plan)
+        assert tier
+        pre = {k[3:]: v for k, v in scalar_units_arrays(plan, ct).items()}
+        suball = mode == "suball"
+        fields = (("tokens", "lengths", "pat_radix", "pat_val_start",
+                   "seg_orig_start", "seg_orig_len", "seg_pat") if suball
+                  else ("tokens", "lengths", "match_pos", "match_len",
+                        "match_radix", "match_val_start"))
+        fn = fused_expand_suball_md5 if suball else fused_expand_md5
+        nb = 8
+        batch, _, _ = make_blocks(plan, max_variants=nb * STRIDE,
+                                  max_blocks=nb, fixed_stride=STRIDE)
+        batch = pad_batch(batch, nb)
+        args = tuple(jnp.asarray(getattr(plan, f)) for f in fields) + (
+            jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
+            jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
+            jnp.asarray(batch.count),
+        )
+        kw = dict(num_lanes=nb * STRIDE, out_width=plan.out_width,
+                  min_substitute=spec.effective_min,
+                  max_substitute=spec.max_substitute, block_stride=STRIDE,
+                  k_opts=1, scalar_units=tier, interpret=True)
+        state_a, emit_a = fn(*args, **kw)
+        state_b, emit_b = fn(*args, pre=pre, **kw)
+        np.testing.assert_array_equal(np.asarray(emit_a),
+                                      np.asarray(emit_b))
+        np.testing.assert_array_equal(np.asarray(state_a),
+                                      np.asarray(state_b))
+        assert np.asarray(emit_a).any()
+
     def test_fuzz_parity(self):
         # Randomized K=1 tables (multichar keys, empty/multibyte values,
         # binary bytes) through whichever tier the gate picks — the bit
