@@ -92,7 +92,8 @@ def build_parser() -> argparse.ArgumentParser:
     ap.add_argument("--blocks", type=int, default=None,
                     help="static block count per launch (default: each arm's "
                          "measured best geometry — xla lanes/128; pallas "
-                         "lanes/512, or lanes/256 for suball — PERF.md §9b)")
+                         "lanes/128 on the K=1 scalar path, else lanes/512 "
+                         "or lanes/256 for suball — PERF.md §9b/§11)")
     ap.add_argument("--words", type=int, default=50000,
                     help="synthetic wordlist size")
     ap.add_argument("--seconds", type=float, default=10.0,
