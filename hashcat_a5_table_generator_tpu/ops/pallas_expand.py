@@ -365,7 +365,7 @@ def scalar_units_for(plan) -> "bool | str":
     return not bool((srt[:, 1:] == srt[:, :-1]).any())
 
 
-def scalar_units_fields(plan, ct) -> "dict | None":
+def scalar_units_fields(plan, ct, *, _row_chunk=None) -> "dict | None":
     """Word-level numpy precomputes for the scalar-units fast path.
 
     The per-byte coverage / start / value fields the wrappers need are
@@ -375,16 +375,20 @@ def scalar_units_fields(plan, ct) -> "dict | None":
     launch wall (PERF.md §12).  Computing them here once per sweep turns
     the per-launch prep into pure row gathers.
 
-    Returns ``{"weight", "bitpos" [B, M|P], "startp"|"ownbit",
-    "svl", "svw" [B, L], +"ins_bits" [B, L] (match bitmask tier),
-    +"isstart" [B, L] (suball)}`` as numpy arrays, or None when the plan
-    doesn't qualify.  Cached on the plan object (plans are frozen;
-    keyed by the table identity)."""
+    Returns ``{"weight", "bitpos" [B, M|P] i32, "startp"|"ownbit",
+    "svl" [B, L] u8, "svw" [B, L] u32, +"ins_bits" [B, L] i32 (match
+    bitmask tier), +"isstart" [B, L] u8 (suball)}`` as numpy arrays, or
+    None when the plan doesn't qualify.  Per-byte fields are u8 where
+    they fit (hashmob-scale dictionaries: millions of words x L bytes),
+    the wrappers widen after the block gather; the [chunk, M|GS, L]
+    intermediates are computed in bounded row chunks for the same
+    reason.  Cached on the plan object (plans are frozen; keyed by the
+    table identity)."""
     tier = scalar_units_for(plan)
     if not tier:
         return None
     cache = getattr(plan, "_scalar_fields_cache", None)
-    if cache is not None and cache[0] is ct:
+    if cache is not None and cache[0] is ct and _row_chunk is None:
         return cache[1]
     radix = np.asarray(plan.pat_radix)
     act = (radix > 1).astype(np.int32)
@@ -398,63 +402,75 @@ def scalar_units_fields(plan, ct) -> "dict | None":
     for k in range(val_bytes.shape[1]):
         vw_packed |= val_bytes[:, k].astype(np.uint32) << np.uint32(8 * k)
     jj = np.arange(length_axis, dtype=np.int32)[None, None, :]
-    if getattr(plan, "match_pos", None) is not None:
+    is_match = getattr(plan, "match_pos", None) is not None
+    out = {"weight": weight, "bitpos": bitpos}
+    bl = (b, length_axis)
+    if is_match:
+        out["startp"] = np.empty(bl, np.uint8)
+        out["svl"] = np.empty(bl, np.uint8)
+        out["svw"] = np.empty(bl, np.uint32)
+        if tier != "single":
+            out["ins_bits"] = np.empty(bl, np.int32)
         vs = np.asarray(plan.match_val_start)
         rows = np.clip(vs, 0, val_bytes.shape[0] - 1)
         vw_slot = vw_packed[rows]  # [B, M] (K=1: option 0)
         vl_slot = val_len[rows].astype(np.int32)
-        stt = ((jj == np.asarray(plan.match_pos)[:, :, None])
-               & (act[:, :, None] > 0))  # [B, M, L], <=1 slot per j
-        startp = (stt * (bitpos + 1)[:, :, None]).sum(1)
-        out = {
-            "weight": weight,
-            "bitpos": bitpos,
-            "startp": np.where(startp == 0, 31, startp - 1).astype(
-                np.int32),
-            "svl": (stt * vl_slot[:, :, None]).sum(1).astype(np.int32),
-            "svw": (stt.astype(np.uint32)
-                    * vw_slot[:, :, None]).sum(1, dtype=np.uint32),
-        }
-        if tier != "single":
-            mlen = np.asarray(plan.match_len)
-            ps = np.asarray(plan.match_pos)[:, :, None]
-            inside = (jj >= ps) & (jj < ps + mlen[:, :, None])
-            out["ins_bits"] = (inside * weight[:, :, None]).sum(1).astype(
-                np.int32)
+        mpos = np.asarray(plan.match_pos)
+        mlen = np.asarray(plan.match_len)
+        chunk = _row_chunk or max(
+            1, (64 << 20) // max(1, mpos.shape[1] * length_axis))
     else:
+        out["ownbit"] = np.empty(bl, np.uint8)
+        out["isstart"] = np.empty(bl, np.uint8)
+        out["svl"] = np.empty(bl, np.uint8)
+        out["svw"] = np.empty(bl, np.uint32)
         st = np.asarray(plan.seg_orig_start)
         sl = np.asarray(plan.seg_orig_len)
         sp = np.asarray(plan.seg_pat)
-        if sp.shape[1]:
-            st3 = st[:, :, None]
-            covered = (sl[:, :, None] > 0) & (jj >= st3) & (
-                jj < st3 + sl[:, :, None])  # [B, GS, L]
-            slotat = np.where(covered, sp[:, :, None], -1).max(axis=1)
-            startat = np.where(covered, st3, 0).max(axis=1)
-        else:
-            slotat = np.full((b, length_axis), -1, np.int32)
-            startat = np.zeros((b, length_axis), np.int32)
-        owned = slotat >= 0
-        sl_clip = np.clip(slotat, 0, radix.shape[1] - 1)
-        rows_i = np.arange(b)[:, None]
-        own_act = act[rows_i, sl_clip] > 0
         vs = np.asarray(plan.pat_val_start)
         rows = np.clip(vs, 0, val_bytes.shape[0] - 1)
         vw_slot = vw_packed[rows]
         vl_slot = val_len[rows].astype(np.int32)
-        out = {
-            "weight": weight,
-            "bitpos": bitpos,
-            "ownbit": np.where(owned & own_act, bitpos[rows_i, sl_clip],
-                               31).astype(np.int32),
-            "isstart": (owned & (startat == np.arange(
-                length_axis)[None, :])).astype(np.int32),
-            "svl": np.where(owned, vl_slot[rows_i, sl_clip], 0).astype(
-                np.int32),
-            "svw": np.where(owned, vw_slot[rows_i, sl_clip],
-                            np.uint32(0)).astype(np.uint32),
-        }
-    object.__setattr__(plan, "_scalar_fields_cache", (ct, out))
+        chunk = _row_chunk or max(
+            1, (64 << 20) // max(1, sp.shape[1] * length_axis))
+    for lo in range(0, b, chunk):
+        hi = min(lo + chunk, b)
+        r = slice(lo, hi)
+        if is_match:
+            stt = ((jj == mpos[r, :, None])
+                   & (act[r, :, None] > 0))  # [C, M, L], <=1 slot per j
+            startp = (stt * (bitpos[r, :, None] + 1)).sum(1)
+            out["startp"][r] = np.where(startp == 0, 31, startp - 1)
+            out["svl"][r] = (stt * vl_slot[r, :, None]).sum(1)
+            out["svw"][r] = (stt.astype(np.uint32)
+                             * vw_slot[r, :, None]).sum(1, dtype=np.uint32)
+            if tier != "single":
+                ps = mpos[r, :, None]
+                inside = (jj >= ps) & (jj < ps + mlen[r, :, None])
+                out["ins_bits"][r] = (inside * weight[r, :, None]).sum(1)
+        else:
+            if sp.shape[1]:
+                st3 = st[r, :, None]
+                covered = (sl[r, :, None] > 0) & (jj >= st3) & (
+                    jj < st3 + sl[r, :, None])  # [C, GS, L]
+                slotat = np.where(covered, sp[r, :, None], -1).max(axis=1)
+                startat = np.where(covered, st3, 0).max(axis=1)
+            else:
+                slotat = np.full((hi - lo, length_axis), -1, np.int32)
+                startat = np.zeros((hi - lo, length_axis), np.int32)
+            owned = slotat >= 0
+            sl_clip = np.clip(slotat, 0, radix.shape[1] - 1)
+            rows_i = np.arange(lo, hi)[:, None]
+            own_act = act[rows_i, sl_clip] > 0
+            out["ownbit"][r] = np.where(
+                owned & own_act, bitpos[rows_i, sl_clip], 31)
+            out["isstart"][r] = (
+                owned & (startat == np.arange(length_axis)[None, :]))
+            out["svl"][r] = np.where(owned, vl_slot[rows_i, sl_clip], 0)
+            out["svw"][r] = np.where(owned, vw_slot[rows_i, sl_clip],
+                                     np.uint32(0))
+    if _row_chunk is None:
+        object.__setattr__(plan, "_scalar_fields_cache", (ct, out))
     return out
 
 
@@ -1178,8 +1194,10 @@ def fused_expand_md5(
             pbase = jnp.sum(
                 blk_base * pre["weight"][blk_word], axis=1
             )[:, None]
-            startp = pre["startp"][blk_word]
-            svl_j = pre["svl"][blk_word]
+            # Per-byte fields ship u8 (hashmob-scale memory); widen
+            # after the block gather.
+            startp = pre["startp"][blk_word].astype(_I32)
+            svl_j = pre["svl"][blk_word].astype(_I32)
             svw_j = pre["svw"][blk_word]
             ins_bits = None if single else pre["ins_bits"][blk_word]
         else:
@@ -1431,9 +1449,9 @@ def fused_expand_suball_md5(
             pbase = jnp.sum(
                 blk_base * pre["weight"][blk_word], axis=1
             )[:, None]
-            ownbit = pre["ownbit"][blk_word]
-            isstart = pre["isstart"][blk_word]
-            svl_j = pre["svl"][blk_word]
+            ownbit = pre["ownbit"][blk_word].astype(_I32)
+            isstart = pre["isstart"][blk_word].astype(_I32)
+            svl_j = pre["svl"][blk_word].astype(_I32)
             svw_j = pre["svw"][blk_word]
         else:
             act, bitpos, _, pbase = _scalar_units_prelude(

@@ -457,6 +457,22 @@ class TestScalarUnits:
                                       np.asarray(state_b))
         assert np.asarray(emit_a).any()
 
+    @pytest.mark.parametrize("mode", ["default", "suball"])
+    def test_pre_fields_chunking(self, mode):
+        # The bounded-memory row chunking must be invisible: tiny chunks
+        # produce exactly the full-batch fields.
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_fields,
+        )
+
+        spec = AttackSpec(mode=mode, algo="md5")
+        ct, plan = _arrays(spec, sub=K1_MAP)
+        full = scalar_units_fields(plan, ct)
+        tiny = scalar_units_fields(plan, ct, _row_chunk=2)
+        assert sorted(full) == sorted(tiny)
+        for k in full:
+            np.testing.assert_array_equal(full[k], tiny[k])
+
     def test_fuzz_parity(self):
         # Randomized K=1 tables (multichar keys, empty/multibyte values,
         # binary bytes) through whichever tier the gate picks — the bit
