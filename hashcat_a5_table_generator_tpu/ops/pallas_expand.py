@@ -13,8 +13,10 @@ Scope (``eligible``): all four generation modes — match plans
 (default/reverse, ``main.go:168-261`` semantics via ``ops.expand_matches``'s
 non-overlapping-match formulation) and substitute-all plans (``-s``/
 ``-s -r``, ``main.go:308-440`` via ``ops.expand_suball``'s segment
-formulation) — every shipped hash (MD5/MD4/SHA-1/NTLM, single hash block:
-out_width <= 55, or <= 27 for NTLM whose UTF-16LE expansion doubles bytes),
+formulation) — every shipped hash (MD5/MD4/SHA-1/NTLM; up to three
+chained hash blocks, i.e. candidates to 183 bytes — 91 for NTLM whose
+UTF-16LE expansion doubles bytes — with each lane's digest selected
+after its own padding block),
 fixed-stride layout with stride a multiple of 128, full-enumeration AND
 count-windowed plans (the in-kernel suffix-count DP walk,
 ``_decode_tile_windowed``),
@@ -86,7 +88,7 @@ _G = _grid_height_from_env()
 
 #: Soft caps keeping the fully-unrolled kernel's compile time bounded.
 _MAX_SLOTS = 24
-_MAX_TOKENS = 32
+_MAX_TOKENS = 64
 _MAX_OPTIONS = 8
 _MAX_SEGMENTS = 64  # suball kernel only (match kernels pass 0)
 #: Windowed plans: suffix-count DP column bound (window <= 8 per the
@@ -129,9 +131,12 @@ def eligible(
         and block_stride <= (1 << 24)
         and num_blocks % _G == 0
         and num_blocks > 0
-        # Single hash block: <=55 candidate bytes incl. terminator; NTLM's
-        # UTF-16LE expansion doubles every byte.
-        and 0 < out_width <= (27 if algo == "ntlm" else 55)
+        # Up to _MAX_HASH_BLOCKS chained hash blocks: the longest
+        # candidate (doubled under NTLM's UTF-16LE expansion) plus
+        # terminator and length must fit 64 * n bytes.
+        and 0 < out_width
+        and (out_width * (2 if algo == "ntlm" else 1) + 9
+             <= 64 * _MAX_HASH_BLOCKS)
         and 1 <= num_slots <= _MAX_SLOTS
         and 1 <= token_width <= _MAX_TOKENS
         and 1 <= max_val_len <= 4
@@ -525,7 +530,9 @@ def _make_scalar_kernel(
     added: ``winv[G, M+1, K2]``, ``radix[G, M]``, ``bitpos[G, M]``
     (``num_slots`` sizes the DP walk).
     """
-    assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
+    assert 0 < out_width and _hash_blocks_for(
+        out_width, 2 if algo == "ntlm" else 1
+    ) <= _MAX_HASH_BLOCKS, out_width
     assert kind in ("match", "suball"), kind
     assert not (single_span and kind != "match")
     assert not windowed or num_slots is not None
@@ -656,18 +663,33 @@ def _decode_tile(rank, base, radix, m, g, s):
     return digits
 
 
-#: Message words a <=55-byte candidate (plus its 0x80 terminator) can touch.
-_N_MSG_WORDS = 14
+#: Hash blocks the fused kernels will chain: 3 covers candidates to 183
+#: bytes (the 64-byte dictionary bucket expanded by 2-byte values).
+_MAX_HASH_BLOCKS = 3
+
+
+def _hash_blocks_for(out_width: "int | None", scale: int) -> int:
+    """Static hash-block count for a launch: the longest emitted
+    candidate (``out_width`` bytes, doubled under utf16) plus terminator
+    and 8-byte length must fit ``64 * n`` bytes."""
+    if out_width is None:
+        return 1
+    return max(1, -(-(int(out_width) * scale + 9) // 64))
 
 
 def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
                         *, big_endian_length=False, utf16=False,
-                        max_unit_len=4, out_width=None):
-    """Assemble the padded single-block message (16 u32 words on (G, S)
-    tiles, little-endian byte order — SHA-1 byte-swaps in its schedule)
-    from per-unit output spans: unit j contributes bytes ``unit_word[j]``
-    at offsets ``unit_start[j] .. +unit_len[j]``; 0x80 terminator after
-    the data; bit length in word 14 (LE) or byte-swapped word 15 (BE).
+                        max_unit_len=4, out_width=None, hash_blocks=1):
+    """Assemble the padded message (``16 * hash_blocks`` u32 words on
+    (G, S) tiles, little-endian byte order — SHA-1 byte-swaps in its
+    schedule) from per-unit output spans: unit j contributes bytes
+    ``unit_word[j]`` at offsets ``unit_start[j] .. +unit_len[j]``; 0x80
+    terminator after the data; bit length in the LAST WORDS OF EACH
+    LANE'S OWN padding block — word ``16k + 14`` (LE) / byte-swapped
+    ``16k + 15`` (BE) for the block ``k`` whose 64-byte window holds the
+    lane's terminator+length (later blocks are ignored by the per-lane
+    state select in :func:`_hash_units`, so their length words may hold
+    anything for shorter lanes).
 
     ``utf16``: NTLM's hashcat-style expansion — every candidate byte
     becomes the code unit ``byte | 0x0000``, i.e. byte offsets double and
@@ -688,7 +710,11 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
     than placing each byte separately.  For utf16 the unit first expands
     into two 2-code-unit pieces (even byte offsets, same machinery)."""
     scale = 2 if utf16 else 1
-    msg = [jnp.zeros((g, s), _U32) for _ in range(16)]
+    msg = [jnp.zeros((g, s), _U32) for _ in range(16 * hash_blocks)]
+    # Data (and the terminator) can reach every word except the LAST
+    # block's two length words; inner blocks' words 14/15 hold data for
+    # lanes long enough to need the next block.
+    nw_data = 16 * hash_blocks - 2
 
     def place(off, blen, word, j_span):
         """OR ``word``'s low ``blen`` bytes into msg at byte offset
@@ -704,7 +730,7 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
         hi = jnp.where(sh > 0, wm >> ((_U32(32) - sh) & _U32(31)), _U32(0))
         widx = off >> 2
         sel_prev = None
-        for w_i in range(min(_N_MSG_WORDS, j_span + 1)):
+        for w_i in range(min(nw_data, j_span + 1)):
             sel = widx == w_i
             contrib = jnp.where(sel, lo, _U32(0))
             if sel_prev is not None:
@@ -712,8 +738,8 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
             msg[w_i] = msg[w_i] | contrib
             sel_prev = sel
         # hi spill past the last lo word (within the message bound).
-        w_last = min(_N_MSG_WORDS, j_span + 1)
-        if w_last < _N_MSG_WORDS:
+        w_last = min(nw_data, j_span + 1)
+        if w_last < nw_data:
             msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
 
     mul = max(1, int(max_unit_len))
@@ -742,33 +768,49 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
     # Emitted candidates end at <= out_width bytes, so the terminator can
     # only land in the first (out_width*scale)//4 + 1 words; overlong
     # lanes are masked garbage either way.
-    n_term = (_N_MSG_WORDS if out_width is None
-              else min(_N_MSG_WORDS, (int(out_width) * scale) // 4 + 1))
+    n_term = (nw_data if out_width is None
+              else min(nw_data, (int(out_width) * scale) // 4 + 1))
     for w_i in range(n_term):
         msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
     bits = (end * 8).astype(_U32)
     if big_endian_length:
-        # SHA-1: the 64-bit BE bit length occupies bytes 56..63; its low
-        # 32 bits are bytes 60..63 = LE word 15 byte-swapped. msg[14]
-        # (bytes 56..59, the BE high half) stays 0 for <2^29-bit messages.
-        msg[15] = (
+        # SHA-1: the 64-bit BE bit length occupies the padding block's
+        # bytes 56..63; its low 32 bits are that block's LE word 15
+        # byte-swapped (the BE high half, word 14, stays data-or-zero —
+        # zero in the padding block for <2^29-bit messages).
+        bits = (
             ((bits & _U32(0xFF)) << 24)
             | ((bits & _U32(0xFF00)) << 8)
             | ((bits >> 8) & _U32(0xFF00))
             | (bits >> 24)
         )
+    lw = 15 if big_endian_length else 14
+    if hash_blocks == 1:
+        msg[lw] = bits
     else:
-        msg[14] = bits  # bit length, low word; msg[15] stays 0
+        # Per-lane padding block k: terminator + 8-byte length fit block
+        # k iff end <= 64*(k+1) - 9.  Later blocks are ignored by the
+        # state select, so the LAST block's length word can be
+        # unconditional; inner blocks' must not clobber longer lanes'
+        # data words.
+        for k in range(hash_blocks):
+            if k + 1 == hash_blocks:
+                msg[16 * k + lw] = msg[16 * k + lw] | bits
+            else:
+                fits = end <= (64 * (k + 1) - 9)
+                msg[16 * k + lw] = msg[16 * k + lw] | jnp.where(
+                    fits, bits, _U32(0)
+                )
     return msg
 
 
-def _md5_rounds(msg, g, s):
+def _md5_rounds(msg, g, s, init=None):
     """The unrolled 64-round MD5 compression on (G, S) u32 tiles (same
-    chain as ops.pallas_md5). Returns the four output state words."""
-    a = jnp.full((g, s), _U32(_MD5_INIT[0]))
-    b = jnp.full((g, s), _U32(_MD5_INIT[1]))
-    c = jnp.full((g, s), _U32(_MD5_INIT[2]))
-    d = jnp.full((g, s), _U32(_MD5_INIT[3]))
+    chain as ops.pallas_md5). Returns the four output state words;
+    ``init`` chains a previous block's state (None = the IV)."""
+    if init is None:
+        init = tuple(jnp.full((g, s), _U32(k)) for k in _MD5_INIT)
+    a, b, c, d = init
     for i in range(64):
         if i < 16:
             f = (b & c) | (~b & d)
@@ -786,25 +828,19 @@ def _md5_rounds(msg, g, s):
         sh = _MD5_S[i]
         rotated = (rot << _U32(sh)) | (rot >> _U32(32 - sh))
         a, d, c, b = d, c, b, b + rotated
-    return (
-        a + _U32(_MD5_INIT[0]),
-        b + _U32(_MD5_INIT[1]),
-        c + _U32(_MD5_INIT[2]),
-        d + _U32(_MD5_INIT[3]),
-    )
+    return (a + init[0], b + init[1], c + init[2], d + init[3])
 
 
 def _rotl_tile(x, sh: int):
     return (x << _U32(sh)) | (x >> _U32(32 - sh))
 
 
-def _md4_rounds(msg, g, s):
+def _md4_rounds(msg, g, s, init=None):
     """Unrolled MD4 (RFC 1320 — the NTLM core) on (G, S) u32 tiles,
-    mirroring ``ops.hashes._md4_block``."""
-    a = jnp.full((g, s), _U32(_MD4_INIT[0]))
-    b = jnp.full((g, s), _U32(_MD4_INIT[1]))
-    c = jnp.full((g, s), _U32(_MD4_INIT[2]))
-    d = jnp.full((g, s), _U32(_MD4_INIT[3]))
+    mirroring ``ops.hashes._md4_block``; ``init`` chains blocks."""
+    if init is None:
+        init = tuple(jnp.full((g, s), _U32(k)) for k in _MD4_INIT)
+    a, b, c, d = init
     for j, k in enumerate(range(16)):
         a2 = _rotl_tile(a + ((b & c) | (~b & d)) + msg[k], (3, 7, 11, 19)[j % 4])
         a, b, c, d = d, a2, b, c
@@ -819,15 +855,10 @@ def _md4_rounds(msg, g, s):
             a + (b ^ c ^ d) + msg[k] + _U32(0x6ED9EBA1), (3, 9, 11, 15)[j % 4]
         )
         a, b, c, d = d, a2, b, c
-    return (
-        a + _U32(_MD4_INIT[0]),
-        b + _U32(_MD4_INIT[1]),
-        c + _U32(_MD4_INIT[2]),
-        d + _U32(_MD4_INIT[3]),
-    )
+    return (a + init[0], b + init[1], c + init[2], d + init[3])
 
 
-def _sha1_rounds(msg, g, s):
+def _sha1_rounds(msg, g, s, init=None):
     """Unrolled 80-round SHA-1 on (G, S) u32 tiles: byte-swaps the shared
     little-endian message layout into the big-endian schedule, rolling
     16-word window for the expansion (mirrors ``ops.hashes._sha1_block``)."""
@@ -842,11 +873,9 @@ def _sha1_rounds(msg, g, s):
     w = [bswap(m) for m in msg]
     for t in range(16, 80):
         w.append(_rotl_tile(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
-    a = jnp.full((g, s), _U32(_SHA1_INIT[0]))
-    b = jnp.full((g, s), _U32(_SHA1_INIT[1]))
-    c = jnp.full((g, s), _U32(_SHA1_INIT[2]))
-    d = jnp.full((g, s), _U32(_SHA1_INIT[3]))
-    e = jnp.full((g, s), _U32(_SHA1_INIT[4]))
+    if init is None:
+        init = tuple(jnp.full((g, s), _U32(k)) for k in _SHA1_INIT)
+    a, b, c, d, e = init
     for t in range(80):
         if t < 20:
             f = (b & c) | (~b & d)
@@ -858,34 +887,42 @@ def _sha1_rounds(msg, g, s):
             f = b ^ c ^ d
         tmp = _rotl_tile(a, 5) + f + e + _U32(_SHA1_K[t // 20]) + w[t]
         e, d, c, b, a = d, c, _rotl_tile(b, 30), a, tmp
-    return (
-        a + _U32(_SHA1_INIT[0]),
-        b + _U32(_SHA1_INIT[1]),
-        c + _U32(_SHA1_INIT[2]),
-        d + _U32(_SHA1_INIT[3]),
-        e + _U32(_SHA1_INIT[4]),
-    )
+    return (a + init[0], b + init[1], c + init[2], d + init[3],
+            e + init[4])
 
 
 def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s,
                 max_unit_len=4, out_width=None):
     """Message assembly + compression for one algo; returns the state-word
-    tuple (4 for MD5/MD4/NTLM, 5 for SHA-1)."""
-    if algo == "ntlm":
-        msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
-                                  g, s, utf16=True,
-                                  max_unit_len=max_unit_len,
-                                  out_width=out_width)
-        return _md4_rounds(msg, g, s)
+    tuple (4 for MD5/MD4/NTLM, 5 for SHA-1).
+
+    Long launches (``out_width`` past one hash block) build a
+    ``16 * n``-word message and chain up to ``n`` compressions; each
+    lane's digest is the state after ITS OWN padding block (terminator +
+    length fit block k iff ``end <= 64*(k+1) - 9``), selected per lane —
+    shorter lanes simply ignore the later blocks' garbage."""
+    utf16 = algo == "ntlm"
+    scale = 2 if utf16 else 1
+    nblocks = _hash_blocks_for(out_width, scale)
+    rounds = {"md5": _md5_rounds, "md4": _md4_rounds, "ntlm": _md4_rounds,
+              "sha1": _sha1_rounds}[algo]
     msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
-                              g, s, big_endian_length=algo == "sha1",
+                              g, s, utf16=utf16,
+                              big_endian_length=algo == "sha1",
                               max_unit_len=max_unit_len,
-                              out_width=out_width)
-    if algo == "md5":
-        return _md5_rounds(msg, g, s)
-    if algo == "md4":
-        return _md4_rounds(msg, g, s)
-    return _sha1_rounds(msg, g, s)
+                              out_width=out_width, hash_blocks=nblocks)
+    state = rounds(msg[:16], g, s)
+    if nblocks == 1:
+        return state
+    end = out_len * scale
+    final = state
+    for k in range(1, nblocks):
+        state = rounds(msg[16 * k:16 * (k + 1)], g, s, init=state)
+        needs_k = end > (64 * k - 9)  # lane's padding block is >= k
+        final = tuple(
+            jnp.where(needs_k, sw, fw) for sw, fw in zip(state, final)
+        )
+    return final
 
 
 def _grouped_hash_units(algo, unit_start, unit_len, unit_word, out_len,
@@ -944,7 +981,9 @@ def _make_kernel(
     # Single-hash-block scope: every emitted candidate (out_len <=
     # out_width, doubled for NTLM) plus its terminator must fit below the
     # length words.
-    assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
+    assert 0 < out_width and _hash_blocks_for(
+        out_width, 2 if algo == "ntlm" else 1
+    ) <= _MAX_HASH_BLOCKS, out_width
 
     def kernel(tok, wlen, radix, base, count, inside, start,
                *rest):
@@ -1277,7 +1316,9 @@ def _make_suball_kernel(
     start), vopt[G, P, K] u32, vlen[G, P, K] i32.
     Outputs: state[G, KS, S] u32 (KS = DIGEST_WORDS[algo]), emit[G, S] i32.
     """
-    assert 0 < out_width <= (27 if algo == "ntlm" else 55), out_width
+    assert 0 < out_width and _hash_blocks_for(
+        out_width, 2 if algo == "ntlm" else 1
+    ) <= _MAX_HASH_BLOCKS, out_width
 
     def kernel(tok, wlen, pradix, base, count, slotat, startat,
                *rest):

@@ -188,12 +188,17 @@ def test_eligible_bounds():
     assert eligible(**{**base, "mode": "suball", "num_segments": 33})
     # Windowed plans are eligible WITH their DP table's column count.
     assert eligible(**{**base, "windowed": True, "win_k2": 3})
+    # Multi-block widening: out_width up to 3 chained hash blocks.
+    assert eligible(**{**base, "out_width": 119})
+    assert eligible(**{**base, "out_width": 183})
+    assert eligible(**{**base, "algo": "ntlm", "out_width": 91})
     for bad in (
         dict(mode="plain"), dict(algo="sha256"),
         dict(windowed=True),  # windowed without win_k2: no DP table
         dict(windowed=True, win_k2=11),
-        dict(block_stride=96), dict(num_blocks=12), dict(out_width=56),
-        dict(max_val_len=5), dict(max_options=9), dict(token_width=64),
+        dict(block_stride=96), dict(num_blocks=12), dict(out_width=184),
+        dict(algo="ntlm", out_width=92),
+        dict(max_val_len=5), dict(max_options=9), dict(token_width=65),
         dict(num_segments=65),
     ):
         assert not eligible(**{**base, **bad}), bad
@@ -699,6 +704,88 @@ class TestProductionWiring:
         res = sweep.run_crack(rec)
         assert calls and all(t == "single" for t in calls)
         assert {h.candidate for h in res.hits} == set(planted)
+
+
+#: 4-byte values reach multi-block output widths at small token counts,
+#: keeping the interpret-mode cost of these tests bounded.
+MB_MAP = {b"a": [b"\xf0\x9f\x98\x80"], b"s": [b"\xf0\x9f\x98\x81"]}
+
+
+class TestMultiBlock:
+    """Long candidates through chained hash blocks: each lane's digest
+    must be the state after ITS OWN padding block, with short and long
+    lanes mixed in one launch."""
+
+    def _parity(self, spec, words, *, sub=MB_MAP, algo=None):
+        algo = algo or spec.algo
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words(words))
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            _hash_blocks_for,
+        )
+
+        scale = 2 if algo == "ntlm" else 1
+        assert _hash_blocks_for(plan.out_width, scale) >= 2
+        runner = (_run_both_suball if spec.mode.startswith("suball")
+                  else _run_both)
+        kw = {}
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        tier = scalar_units_for(plan)
+        if tier:
+            kw["scalar_units"] = tier
+        saw = False
+        for emit_x, emit_p, state_x, state_p in runner(
+            spec, plan, ct, algo=algo, **kw
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+
+    def test_md5_mixed_block_counts(self):
+        # Mixed 1/2-block lanes in one launch: the per-lane state select
+        # must pick each lane's own padding block.
+        self._parity(AttackSpec(mode="default", algo="md5"),
+                     [b"go", b"assassin-sassafras-aa"])
+
+    def test_md5_three_blocks_windowed(self):
+        # 30 matchable positions x 4-byte values reach the 3-block width;
+        # the count window keeps the enumerated space tiny (sum of
+        # C(30, 0..2) = 466 ranks) AND covers windowed + multi-block
+        # together.
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            _hash_blocks_for,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5", min_substitute=0,
+                          max_substitute=2)
+        ct = compile_table(MB_MAP)
+        plan = build_plan(spec, ct, pack_words([b"a" * 30 + b"x" * 10]))
+        assert plan.windowed and _hash_blocks_for(plan.out_width, 1) == 3
+        self._parity(spec, [b"a" * 30 + b"x" * 10])
+
+    def test_sha1_two_blocks(self):
+        self._parity(AttackSpec(mode="default", algo="sha1"),
+                     [b"assassin-sassafras-aa"])
+
+    def test_ntlm_two_blocks(self):
+        self._parity(AttackSpec(mode="default", algo="ntlm"),
+                     [b"go", b"assassin-sass-a"])
+
+    def test_suball_two_blocks(self):
+        self._parity(AttackSpec(mode="suball", algo="md5"),
+                     [b"assassin-sassafras-aa"])
+
+    def test_general_kernel_two_blocks(self):
+        # K=2 table: the general (non-scalar) kernel through the shared
+        # multi-block tail.
+        sub = {b"a": [b"\xf0\x9f\x98\x80", b"\xf0\x9f\x98\x82"],
+               b"s": [b"5"]}
+        self._parity(AttackSpec(mode="default", algo="md5"),
+                     [b"assassin-sassafras-aa"], sub=sub)
 
 
 @pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
