@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import sys
 from dataclasses import dataclass
-from typing import Dict, List, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -43,6 +43,10 @@ from ..ops.hashes import HASH_FNS
 from ..ops.membership import DigestSet, digest_member
 from ..ops.packing import PackedWords
 from ..tables.compile import CompiledTable
+
+#: Host plan objects (mode-dispatched) and device-pytree aliases.
+Plan = Union[MatchPlan, SubAllPlan]
+ArrayTree = Dict[str, jnp.ndarray]
 
 #: The four reference generation modes (``main.go:80-92``).
 MODES = ("default", "reverse", "suball", "suball-reverse")
@@ -58,7 +62,7 @@ class AttackSpec:
     min_substitute: int = 0
     max_substitute: int = 15
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         if self.mode not in MODES:
             raise ValueError(f"unknown mode {self.mode!r}; one of {MODES}")
         if self.algo not in HASH_FNS:
@@ -76,8 +80,8 @@ class AttackSpec:
 
 
 def build_plan(
-    spec: AttackSpec, ct: CompiledTable, packed: PackedWords, **kwargs
-):
+    spec: AttackSpec, ct: CompiledTable, packed: PackedWords, **kwargs: Any
+) -> Plan:
     """Mode-dispatched host plan construction.
 
     Match plans get the spec's EFFECTIVE window so a tight ``-m/-x`` can
@@ -109,7 +113,7 @@ def table_arrays(ct: CompiledTable) -> Dict[str, jnp.ndarray]:
     }
 
 
-def plan_arrays(plan) -> Dict[str, jnp.ndarray]:
+def plan_arrays(plan: Plan) -> Dict[str, jnp.ndarray]:
     if isinstance(plan, MatchPlan):
         keys = ("tokens", "lengths", "match_pos", "match_len", "match_radix",
                 "match_val_start")
@@ -145,8 +149,11 @@ def digest_arrays(ds: DigestSet) -> Dict[str, jnp.ndarray]:
     return {"rows": jnp.asarray(ds.rows), "bitmap": jnp.asarray(ds.bitmap)}
 
 
-def _expand(spec: AttackSpec, plan, table, blocks, *, num_lanes, out_width,
-            block_stride=None, radix2=False):
+def _expand(
+    spec: AttackSpec, plan: ArrayTree, table: ArrayTree, blocks: ArrayTree,
+    *, num_lanes: int, out_width: int, block_stride: "int | None" = None,
+    radix2: bool = False,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit).
 
     ``radix2`` (static): all plan radices <= 2 (``k_opts == 1``) — the
@@ -206,7 +213,7 @@ def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
     return np.unpackbits(bytes_, bitorder="little")[:num_lanes].astype(bool)
 
 
-def scalar_units_arrays(plan, ct) -> Dict[str, jnp.ndarray]:
+def scalar_units_arrays(plan: Plan, ct: CompiledTable) -> Dict[str, jnp.ndarray]:
     """Device copies of ``pallas_expand.scalar_units_fields``, namespaced
     for the plan dict (``su_*``).  Callers merge them into
     :func:`plan_arrays`' output when the fused kernel may take launches:
@@ -225,7 +232,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
                     fused_scalar_units: bool = False,
-                    radix2: bool = False):
+                    radix2: bool = False) -> Callable[..., ArrayTree]:
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
@@ -256,7 +263,9 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     # trace-build time, so the flag picks the compiled program.
     hash_fn = maybe_pallas_hash_fn(spec.algo, HASH_FNS[spec.algo])
 
-    def expand_and_hash(plan, table, blocks):
+    def expand_and_hash(
+        plan: ArrayTree, table: ArrayTree, blocks: ArrayTree
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         if fused_expand_opts is not None:
             from ..ops.pallas_expand import (
                 fused_expand_md5,
@@ -302,7 +311,10 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
         del word_row  # hit cursors are host-derived from lane indices
         return hash_fn(cand, cand_len), emit
 
-    def body(plan, table, digests, blocks):
+    def body(
+        plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
+        blocks: ArrayTree,
+    ) -> ArrayTree:
         state, emit = expand_and_hash(plan, table, blocks)
         member = digest_member(state, digests["rows"], digests["bitmap"])
         hit = member & emit
@@ -319,7 +331,7 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
                     fused_scalar_units: bool = False,
-                    radix2: bool = False):
+                    radix2: bool = False) -> Callable[..., ArrayTree]:
     """Build the fused expand->hash->match step (single device).
 
     Returns ``step(plan, table, blocks, digests) -> dict`` with the packed
@@ -331,22 +343,31 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                            fused_scalar_units=fused_scalar_units,
                            radix2=radix2)
 
-    def step(plan, table, blocks, digests):
+    def step(
+        plan: ArrayTree, table: ArrayTree, blocks: ArrayTree,
+        digests: ArrayTree,
+    ) -> ArrayTree:
         return body(plan, table, digests, blocks)
 
     return jax.jit(step)
 
 
-def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                         block_stride: int | None = None,
-                         radix2: bool = False):
+def make_candidates_body(
+    spec: AttackSpec, *, num_lanes: int, out_width: int,
+    block_stride: "int | None" = None, radix2: bool = False,
+) -> Callable[
+    [ArrayTree, ArrayTree, ArrayTree],
+    Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray],
+]:
     """The un-jitted expand-only body, shared by the single-device
     candidates step and the shard_map'd candidates step.
 
     ``body(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
     """
 
-    def body(plan, table, blocks):
+    def body(
+        plan: ArrayTree, table: ArrayTree, blocks: ArrayTree
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         return _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
             out_width=out_width, block_stride=block_stride, radix2=radix2,
@@ -355,9 +376,13 @@ def make_candidates_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     return body
 
 
-def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                         block_stride: int | None = None,
-                         radix2: bool = False):
+def make_candidates_step(
+    spec: AttackSpec, *, num_lanes: int, out_width: int,
+    block_stride: "int | None" = None, radix2: bool = False,
+) -> Callable[
+    [ArrayTree, ArrayTree, ArrayTree],
+    Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray],
+]:
     """Build the expand-only step for the stdout-candidates sink.
 
     Returns ``step(plan, table, blocks) -> (cand, cand_len, word_row, emit)``.
@@ -374,7 +399,7 @@ def make_candidates_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
 
 
 def decode_variant(
-    plan, ct: CompiledTable, spec: AttackSpec, word_idx: int, rank: int
+    plan: Plan, ct: CompiledTable, spec: AttackSpec, word_idx: int, rank: int
 ) -> bytes:
     """Reconstruct the candidate bytes of one variant on the host.
 
@@ -441,7 +466,7 @@ def decode_variant(
 
 
 def lane_cursor(
-    plan, batch: BlockBatch, lanes: Sequence[int]
+    plan: Plan, batch: BlockBatch, lanes: Sequence[int]
 ) -> List[Tuple[int, int]]:
     """Map device lane indices back to (word_row, global variant rank).
 

@@ -149,11 +149,12 @@ def eligible(
 
 
 def k_opts_for(plan) -> int:
-    """Static per-key option count K — the kernel's K-way value select
-    width. Works for match AND substitute-all plans (both expose the
-    ``pat_radix`` slot-radix matrix). Single source shared by production
-    gating (:func:`opts_for`), the parity tests, and the A/B probe, so
-    they can never drift apart."""
+    """Static per-key option count K (Python int scalar) — the kernel's
+    K-way value select width, from the plan's ``pat_radix`` int32
+    ``[B, P]`` slot-radix matrix. Works for match AND substitute-all
+    plans. Single source shared by production gating (:func:`opts_for`),
+    the parity tests, and the A/B probe, so they can never drift
+    apart."""
     return max(1, int(plan.pat_radix.max()) - 1)
 
 
@@ -232,8 +233,10 @@ def opts_for_config(spec, plan, ct, *, block_stride, num_blocks,
 
 def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
     """Production gate: :func:`opts_for_config` under the env opt-out
-    (:func:`enabled_by_env`).  Default-on on TPU devices; the XLA
-    expand+hash pair remains for ineligible configs and non-TPU backends."""
+    (:func:`enabled_by_env`).  Returns the static option count K (int
+    scalar) when the fused kernel should run, None otherwise.
+    Default-on on TPU devices; the XLA expand+hash pair remains for
+    ineligible configs and non-TPU backends."""
     if not enabled_by_env():
         return None
     if os.environ.get("A5GEN_PALLAS") == "expand" and not _on_tpu():
@@ -356,9 +359,23 @@ def scalar_units_for(plan) -> "bool | str":
     mp = getattr(plan, "match_pos", None)
     if mp is None:
         return True
-    mp = np.asarray(mp)
-    act = np.asarray(plan.match_radix) > 1
-    if not np.where(act, np.asarray(plan.match_len) > 1, False).any():
+    return _scalar_units_tier(mp, plan.match_len, plan.match_radix)
+
+
+def _scalar_units_tier(
+    match_pos: np.ndarray,
+    match_len: np.ndarray,
+    match_radix: np.ndarray,
+) -> "bool | str":
+    """The unique-start verdict from concrete match arrays.
+
+    Shared by the host gate (:func:`scalar_units_for`) and the wrapper's
+    re-validation (:func:`_check_scalar_units_gate`) so the two can never
+    drift apart.  ``match_pos/match_len/match_radix`` are ``[B, M]``
+    int arrays (host numpy or concrete device values)."""
+    mp = np.asarray(match_pos)
+    act = np.asarray(match_radix) > 1
+    if not np.where(act, np.asarray(match_len) > 1, False).any():
         # Single-byte spans: at most one key can match at a position, so
         # start uniqueness is automatic.
         return "single"
@@ -368,6 +385,44 @@ def scalar_units_for(plan) -> "bool | str":
     pos = np.where(act, mp, -1 - np.arange(m, dtype=mp.dtype)[None, :])
     srt = np.sort(pos, axis=1)
     return not bool((srt[:, 1:] == srt[:, :-1]).any())
+
+
+def _check_scalar_units_gate(
+    scalar_units: "bool | str",
+    match_pos: "jnp.ndarray",
+    match_len: "jnp.ndarray",
+    match_radix: "jnp.ndarray",
+) -> None:
+    """Re-validate a caller-passed ``scalar_units`` verdict host-side.
+
+    The K=1 fast kernel packs one match START per byte position; a truthy
+    ``scalar_units`` for a plan with colliding starts silently corrupts
+    the packed startp encode (production always gates via
+    :func:`scalar_units_for`, but the wrapper must not trust a bypassed
+    gate).  Runs only when the match arrays are concrete — inside a trace
+    (tracer arguments) the host plan is unavailable and the caller's
+    verdict necessarily stands."""
+    if any(
+        isinstance(a, jax.core.Tracer)
+        for a in (match_pos, match_len, match_radix)
+    ):
+        return
+    tier = _scalar_units_tier(match_pos, match_len, match_radix)
+    if not tier:
+        raise ValueError(
+            "scalar_units was passed truthy but the plan has colliding "
+            "match starts (scalar_units_for(plan) is False); the K=1 "
+            "fast kernel would corrupt the packed start encode. Gate "
+            "via scalar_units_for(plan)."
+        )
+    if scalar_units == "single" and tier != "single":
+        raise ValueError(
+            'scalar_units="single" was passed but the plan has active '
+            "multi-byte match spans (scalar_units_for(plan) returns "
+            'True, not "single"); the single-span kernel drops its '
+            "coverage bitmask and would mis-splice overlapping spans. "
+            "Gate via scalar_units_for(plan)."
+        )
 
 
 def scalar_units_fields(plan, ct, *, _row_chunk=None) -> "dict | None":
@@ -1220,6 +1275,12 @@ def fused_expand_md5(
     start_b = (jj == ps).astype(_I32)
 
     if scalar_units and k_opts == 1:
+        # A bypassed scalar_units_for gate must raise, not silently
+        # corrupt the packed startp encode (checked host-side when the
+        # match arrays are concrete).
+        _check_scalar_units_gate(
+            scalar_units, match_pos, match_len, match_radix
+        )
         # K=1 scalar-units fast path (PERF.md §11): pack each active
         # slot's chosen bit at its active-rank position; per-byte
         # coverage / start / value fields become block-uniform [NB, L]

@@ -118,8 +118,10 @@ def md5_pallas(
 
 def maybe_pallas_hash_fn(algo: str, hash_fn):
     """The ``A5GEN_PALLAS=1`` hook: returns the Pallas-backed hash for MD5
-    on a TPU backend, the given XLA ``hash_fn`` otherwise. Checked at
-    trace-build time (the flag selects the compiled program, not a runtime
+    on a TPU backend, the given XLA ``hash_fn`` otherwise. Either way
+    the returned callable keeps the hash contract
+    ``uint8[B, W], int32[B] -> uint32[B, 4]``. Checked at trace-build
+    time (the flag selects the compiled program, not a runtime
     branch)."""
     import os
 

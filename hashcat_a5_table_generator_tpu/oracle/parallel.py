@@ -23,9 +23,26 @@ from __future__ import annotations
 
 import io
 import multiprocessing as mp
-import sys
 import traceback
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+if TYPE_CHECKING:  # runtime import cycle + optional toolchain
+    # multiprocessing.Queue is a typeshed *function*; the class generic
+    # usable in annotations lives in multiprocessing.queues.
+    from multiprocessing.queues import Queue as MpQueue
+
+    from ..native.oracle_engine import NativeDefaultOracle
+    from ..ops.membership import HostDigestLookup
+    from ..runtime.sinks import CandidateWriter
 
 #: Flush worker output to the parent at this granularity: large enough to
 #: amortize queue overhead, small enough to bound memory at
@@ -39,7 +56,10 @@ _QUEUE_DEPTH = 8
 _ERROR = -1  # sentinel word index carrying a worker traceback
 
 
-def _maybe_native(sub_map, kw: dict, *, hex_unsafe: bool):
+def _maybe_native(
+    sub_map: Dict[bytes, List[bytes]], kw: Dict[str, Any], *,
+    hex_unsafe: bool,
+) -> "Optional[NativeDefaultOracle]":
     """A NativeDefaultOracle when the ONE shared predicate admits this
     mode/config, else None — the single engine-selection point for both
     worker kinds (candidates pass their writer's hex_unsafe; crack passes
@@ -71,9 +91,9 @@ def _worker_candidates(
     n_workers: int,
     words: Sequence[bytes],
     sub_map: Dict[bytes, List[bytes]],
-    kw: dict,
+    kw: Dict[str, Any],
     hex_unsafe: bool,
-    out_q: "mp.Queue",
+    out_q: "MpQueue[Tuple[int, Any, bool]]",
 ) -> None:
     """Expand words ``wid, wid+N, ...``; emit per-word encoded chunks
     ``(word_idx, (blob, n_candidates), last)`` in word order.
@@ -130,10 +150,10 @@ def _worker_crack(
     n_workers: int,
     words: Sequence[bytes],
     sub_map: Dict[bytes, List[bytes]],
-    kw: dict,
+    kw: Dict[str, Any],
     algo: str,
-    digests,
-    out_q: "mp.Queue",
+    digests: "HostDigestLookup",
+    out_q: "MpQueue[Tuple[int, Any, bool]]",
 ) -> None:
     """Hash every candidate of this worker's words; emit per-word hit
     lists ``(word_idx, [(digest_hex, cand)], True)``.  Generation feeds
@@ -144,7 +164,7 @@ def _worker_crack(
 
     native = _maybe_native(sub_map, kw, hex_unsafe=False)
 
-    def word_iter(word):
+    def word_iter(word: bytes) -> "Any":
         if native is not None:
             return native.iter_word(
                 word, kw.get("min_substitute", 0),
@@ -172,7 +192,7 @@ class OracleWorkerError(RuntimeError):
     """A worker process raised; carries its traceback text."""
 
 
-def _fork_ctx():
+def _fork_ctx() -> mp.context.BaseContext:
     """The fork start context (workers inherit words/tables by
     copy-on-write; args are never pickled) — with a clear error where
     fork does not exist (Windows) instead of a raw ValueError."""
@@ -184,8 +204,13 @@ def _fork_ctx():
     return mp.get_context("fork")
 
 
-def _drain_in_order(queues, procs, n_words: int, n_workers: int,
-                    consume) -> None:
+def _drain_in_order(
+    queues: "Sequence[MpQueue[Tuple[int, Any, bool]]]",
+    procs: Sequence[mp.Process],
+    n_words: int,
+    n_workers: int,
+    consume: Callable[[int, Any], None],
+) -> None:
     """Pull each word's items from its owner's queue, in global word
     order (each worker produces ITS words in increasing order, so
     per-queue arrival order matches).  A worker that dies WITHOUT its
@@ -218,11 +243,11 @@ def _drain_in_order(queues, procs, n_words: int, n_workers: int,
 def run_candidates_parallel(
     words: Sequence[bytes],
     sub_map: Dict[bytes, List[bytes]],
-    writer,
+    writer: "CandidateWriter",
     *,
     n_workers: int,
     hex_unsafe: bool = False,
-    **iter_kw,
+    **iter_kw: Any,
 ) -> int:
     """Stream every word's candidates to ``writer`` in reference
     (``--threads 1``) order using ``n_workers`` processes.  Returns the
@@ -252,7 +277,7 @@ def run_candidates_parallel(
         p.start()
     wrote = [0]
 
-    def consume(i, payload):
+    def consume(i: int, payload: Tuple[bytes, int]) -> None:
         blob, n = payload
         if blob:
             writer.write_block(blob, n)
@@ -270,12 +295,12 @@ def run_candidates_parallel(
 def run_crack_parallel(
     words: Sequence[bytes],
     sub_map: Dict[bytes, List[bytes]],
-    digests,
+    digests: "Any",
     algo: str,
-    on_hit,
+    on_hit: Callable[[str, bytes], None],
     *,
     n_workers: int,
-    **iter_kw,
+    **iter_kw: Any,
 ) -> int:
     """Oracle crack across ``n_workers`` processes; ``on_hit(digest_hex,
     cand)`` fires in reference word order.  Returns the hit count."""
@@ -310,7 +335,7 @@ def run_crack_parallel(
         p.start()
     n_hits = [0]
 
-    def consume(i, hits):
+    def consume(i: int, hits: List[Tuple[str, bytes]]) -> None:
         for dig_hex, cand in hits:
             on_hit(dig_hex, cand)
             n_hits[0] += 1

@@ -55,7 +55,7 @@ from ..models.attack import (
     unpack_bits,
 )
 from ..oracle.engines import iter_candidates
-from ..ops.blocks import BlockBatch, make_blocks
+from ..ops.blocks import make_blocks
 from ..ops.membership import HostDigestLookup, build_digest_set
 from ..ops.packing import PackedWords, pack_words
 from ..tables.compile import compile_table

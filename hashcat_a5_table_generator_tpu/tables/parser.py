@@ -34,7 +34,7 @@ Known, documented divergences from the Go binary (degenerate inputs only):
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, Iterable, List, Mapping
+from typing import Any, Callable, Dict, Iterable, List, Mapping
 
 logger = logging.getLogger("tpu_a5.tables")
 
@@ -158,7 +158,7 @@ def merge_substitution_tables(
     return merged
 
 
-def load_tables(paths: Iterable[str], **kwargs) -> SubstitutionMap:
+def load_tables(paths: Iterable[str], **kwargs: Any) -> SubstitutionMap:
     """Read + merge several table files, as the reference driver does."""
     return merge_substitution_tables(
         read_substitution_table(p, **kwargs) for p in paths

@@ -1,0 +1,55 @@
+#!/usr/bin/env bash
+# The repo's whole static-analysis pass as one command (local + CI).
+#
+#   scripts/lint.sh            # run everything available
+#   scripts/lint.sh --require-all   # fail if ruff/mypy are missing (CI)
+#
+# Three layers, any failure fails the script:
+#   1. ruff      — pyflakes + pycodestyle errors ([tool.ruff] in pyproject)
+#   2. mypy      — typed public API, strict on leaf modules ([tool.mypy])
+#   3. graftlint — repo-specific JAX/Pallas rules (tools/graftlint)
+#
+# ruff and mypy are OPTIONAL locally (the TPU dev containers bake only the
+# jax toolchain; nothing may be pip-installed there) and mandatory in CI
+# via --require-all. graftlint is stdlib-only and always runs.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+
+REQUIRE_ALL=0
+if [ "${1:-}" = "--require-all" ]; then
+    REQUIRE_ALL=1
+fi
+
+fail=0
+
+run_optional() {
+    local name="$1"
+    shift
+    if command -v "$name" >/dev/null 2>&1; then
+        echo "== $name =="
+        if ! "$@"; then
+            echo "lint.sh: $name FAILED" >&2
+            fail=1
+        fi
+    elif [ "$REQUIRE_ALL" = 1 ]; then
+        echo "lint.sh: $name is required (--require-all) but not installed" >&2
+        fail=1
+    else
+        echo "== $name == SKIPPED (not installed; pip install -e '.[dev]')"
+    fi
+}
+
+run_optional ruff ruff check .
+run_optional mypy mypy
+
+echo "== graftlint =="
+if ! python -m tools.graftlint; then
+    echo "lint.sh: graftlint FAILED" >&2
+    fail=1
+fi
+
+if [ "$fail" = 0 ]; then
+    echo "lint.sh: all checks passed"
+fi
+exit "$fail"

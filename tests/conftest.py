@@ -58,3 +58,20 @@ def upstream_reference() -> pathlib.Path:
     if not UPSTREAM_REFERENCE.is_dir():
         pytest.skip("upstream reference snapshot not available")
     return UPSTREAM_REFERENCE
+
+
+@pytest.fixture
+def compile_watcher():
+    """Factory for :class:`tools.graftlint.runtime.CompileWatcher`:
+    counts JAX compilation-cache misses around hot-path regions and
+    fails on cache-busting argument signatures (see
+    tests/test_compile_cache.py)."""
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT))
+    try:
+        from tools.graftlint.runtime import CompileWatcher
+    finally:
+        sys.path.pop(0)
+
+    return CompileWatcher

@@ -1,0 +1,7 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL001 must flag: int literal wider than uint32 in ops/ arithmetic."""
+
+
+def mix(x):
+    """uint32 [N] lane mix."""
+    return (x * 0x100000001) + 0x123456789AB

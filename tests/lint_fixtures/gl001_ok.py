@@ -1,0 +1,10 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL001 must pass: uint32-sized literals, plus a suppressed wide mask."""
+
+#: 64-bit length mask, deliberately wide (host-side message-length math).
+LEN_MASK = 0xFFFFFFFFFFFFFFFF  # graftlint: disable=GL001
+
+
+def mix(x):
+    """uint32 [N] lane mix."""
+    return (x ^ 0xDEADBEEF) + 0xFFFFFFFF

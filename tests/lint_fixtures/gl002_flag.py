@@ -1,0 +1,12 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL002 must flag: float literal and widening dtype in a traced body."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def scale(x):
+    """uint32 [N] -> uint32 [N]."""
+    y = x.astype(jnp.int64)
+    return y * 1.5

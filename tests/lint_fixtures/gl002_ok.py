@@ -1,0 +1,18 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL002 must pass: integer-only kernel; host-side float stays host-side."""
+
+import jax
+
+#: Host-side tuning ratio (module scope, never traced).
+HOST_RATIO = 1.5
+
+
+def plan_budget(n):
+    """Host helper: int scalar budget from a float ratio."""
+    return int(n * HOST_RATIO)
+
+
+@jax.jit
+def scale(x):
+    """uint32 [N] -> uint32 [N]."""
+    return (x << 1) + 3

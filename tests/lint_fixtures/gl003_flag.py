@@ -1,0 +1,11 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL003 must flag: concretizing a tracer inside a jitted body."""
+
+import jax
+
+
+@jax.jit
+def count_hits(hits):
+    """bool [N] -> int scalar."""
+    total = hits.sum().item()
+    return total + int(hits)

@@ -1,0 +1,16 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL003 must pass: host wrapper concretizes AFTER the jitted body."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def count_hits(hits):
+    """bool [N] -> int32 scalar (on device)."""
+    return jnp.sum(hits.astype(jnp.int32))
+
+
+def fetch_count(hits):
+    """Host wrapper: device scalar -> Python int (outside the trace)."""
+    return int(count_hits(hits).item())

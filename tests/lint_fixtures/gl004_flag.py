@@ -1,0 +1,11 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL004 must flag: host numpy applied to a traced argument."""
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def checksum(words):
+    """uint32 [N] -> uint32 scalar."""
+    return np.bitwise_xor.reduce(np.asarray(words))

@@ -1,0 +1,14 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL004 must pass: numpy on static constants inside a kernel is fine
+(the repo's precompute idiom); jnp handles the traced values."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def rotate(x):
+    """uint32 [N, 16] -> uint32 [N, 16]."""
+    perm = np.array([0, 4, 8, 12, 1, 5, 9, 13, 2, 6, 10, 14, 3, 7, 11, 15])
+    return jnp.take(x, jnp.asarray(perm), axis=1)

@@ -1,0 +1,19 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL005 must flag: Python loops driven by traced arguments — iterating
+a tracer, a range() over a traced scalar, and a while on a traced
+condition."""
+
+import jax
+
+
+@jax.jit
+def fold(words, n):
+    """uint32 [N] -> uint32 scalar."""
+    acc = 0
+    for w in words:
+        acc = acc ^ w
+    for i in range(n):
+        acc = acc + i
+    while n > acc:
+        acc = acc + 1
+    return acc

@@ -1,0 +1,16 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL005 must pass: static bounds — literal ranges (the kernels' round
+idiom) and shape-derived ranges (static at trace time)."""
+
+import jax
+
+
+@jax.jit
+def fold(words):
+    """uint32 [N, 16] -> uint32 [N]."""
+    acc = words[:, 0]
+    for i in range(1, 16):
+        acc = acc ^ words[:, i]
+    for j in range(words.shape[1]):
+        acc = acc + j
+    return acc

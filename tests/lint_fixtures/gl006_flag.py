@@ -1,0 +1,18 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL006 must flag: jitting config-like params as traced arguments."""
+
+import jax
+
+
+def run(x, algo, out_width):
+    """uint32 [N] -> uint32 [N] under a config."""
+    return x if algo == "md5" else x[:out_width]
+
+
+fast_run = jax.jit(run)
+
+
+@jax.jit
+def stepper(x, block_stride):
+    """uint32 [N] -> uint32 [N]."""
+    return x * block_stride

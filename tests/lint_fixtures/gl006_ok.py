@@ -1,0 +1,29 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL006 must pass: config params marked static (or closed over)."""
+
+from functools import partial
+
+import jax
+
+
+def run(x, algo, out_width):
+    """uint32 [N] -> uint32 [N] under a config."""
+    return x if algo == "md5" else x[:out_width]
+
+
+fast_run = jax.jit(run, static_argnames=("algo", "out_width"))
+
+
+@partial(jax.jit, static_argnames=("block_stride",))
+def stepper(x, block_stride):
+    """uint32 [N] -> uint32 [N]."""
+    return x * block_stride
+
+
+def make_step(algo):
+    """The builder idiom: config closed over, data-only signature."""
+
+    def step(x):
+        return x
+
+    return jax.jit(step)

@@ -1,0 +1,14 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/tables/_fixture.py
+"""GL007 must flag: entropy and wall clock in a deterministic layer."""
+
+import random
+import time
+
+
+def shuffle_keys(keys):
+    random.shuffle(keys)
+    return keys
+
+
+def stamp():
+    return time.time()

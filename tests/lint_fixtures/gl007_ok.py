@@ -1,0 +1,13 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/tables/_fixture.py
+"""GL007 must pass: canonical (sorted) orders, no entropy, no clocks."""
+
+
+def canonical_keys(keys):
+    return sorted(keys)
+
+
+def stable_hash(data):
+    acc = 0
+    for b in data:
+        acc = (acc * 31 + b) & 0xFFFFFFFF
+    return acc

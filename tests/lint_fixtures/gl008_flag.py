@@ -1,0 +1,11 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL008 must flag: public ops without a shape/dtype contract."""
+
+
+def expand(tokens, lengths):
+    return tokens
+
+
+def pack(rows):
+    """Pack the rows for launch."""
+    return rows

@@ -1,0 +1,16 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL008 must pass: the package's shape/dtype docstring convention."""
+
+
+def expand(tokens, lengths):
+    """Expand candidates: ``uint8 [B, L], int32 [B] -> uint8 [N, W]``."""
+    return tokens
+
+
+def pack(rows):
+    """Pack rows into launch order (shape-preserving, uint32)."""
+    return rows
+
+
+def _internal(buf):
+    return buf

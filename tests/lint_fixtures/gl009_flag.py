@@ -1,0 +1,7 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL009 must flag: bare print() in a library module (stdout is the
+candidate byte stream)."""
+
+
+def report(n):
+    print(f"emitted {n} candidates")

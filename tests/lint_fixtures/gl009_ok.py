@@ -1,0 +1,8 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL009 must pass: diagnostics go to stderr."""
+
+import sys
+
+
+def report(n):
+    print(f"emitted {n} candidates", file=sys.stderr)

@@ -1,0 +1,11 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL010 must flag: mutable defaults shared across calls."""
+
+
+def collect(hit, acc=[]):
+    acc.append(hit)
+    return acc
+
+
+def configure(overrides={}, *, tags=set()):
+    return overrides, tags

@@ -1,0 +1,13 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL010 must pass: None sentinels, construction inside the body."""
+
+
+def collect(hit, acc=None):
+    if acc is None:
+        acc = []
+    acc.append(hit)
+    return acc
+
+
+def configure(overrides=None, *, tags=()):
+    return dict(overrides or {}), tuple(tags)

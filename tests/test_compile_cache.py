@@ -1,0 +1,126 @@
+"""Runtime compile-cache analyzer (tools/graftlint/runtime.py) around the
+hot expand->hash->match path: the production sweep launches ONE compiled
+program per (geometry, config), so any per-launch recompilation is a
+cache-busting argument signature — on TPU a multi-second stall every
+launch.  The static rules (GL006) catch the shapes of this bug; this is
+the runtime gate that catches the event itself."""
+
+import hashlib
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    digest_arrays,
+    make_crack_step,
+    plan_arrays,
+    table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+
+LEET = {b"a": [b"4", b"@"], b"s": [b"5", b"$"], b"o": [b"0"], b"e": [b"3"]}
+WORDS = [b"password", b"assassin", b"glasses"]
+STRIDE = 128
+NB = 4  # blocks per launch -> 512 lanes
+
+
+def _fixed_stride_batches(plan, min_batches=2):
+    """Cut the keyspace into >= min_batches same-shape launches (the
+    production fixed-stride TPU geometry: padded to NB blocks)."""
+    batches = []
+    w = rank = 0
+    while True:
+        batch, w, rank = make_blocks(
+            plan, start_word=w, start_rank=rank, max_variants=NB * STRIDE,
+            max_blocks=NB, fixed_stride=STRIDE,
+        )
+        if batch.total == 0:
+            break
+        batches.append(pad_batch(batch, NB))
+    assert len(batches) >= min_batches, "keyspace too small for the test"
+    return batches
+
+
+class TestHotPathCacheStability:
+    def test_crack_step_compiles_once_across_launches(self, compile_watcher):
+        """Launch-to-launch, only block VALUES change — the compiled
+        program must be reused (zero new cache entries after warmup)."""
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(LEET)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        ds = build_digest_set(
+            [hashlib.md5(b"decoy").digest()], spec.algo
+        )
+        step = make_crack_step(
+            spec, num_lanes=NB * STRIDE, out_width=plan.out_width,
+            block_stride=STRIDE,
+        )
+        p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+        batches = _fixed_stride_batches(plan)
+
+        watcher = compile_watcher(step)
+        # Warmup launch: exactly one trace+compile for the whole step.
+        with watcher.expect(1, label="warmup"):
+            int(step(p, t, block_arrays(batches[0]), d)["n_emitted"])
+        # Every further launch: same signature, zero compiles.
+        with watcher.expect(0, label="steady-state launches"):
+            for batch in batches[1:]:
+                int(step(p, t, block_arrays(batch), d)["n_emitted"])
+
+    def test_digest_set_swap_does_not_recompile(self, compile_watcher):
+        """Re-targeting (new digest values, same digest-set geometry)
+        must not recompile — the sweep reuses the step across target
+        reloads."""
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(LEET)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        step = make_crack_step(
+            spec, num_lanes=NB * STRIDE, out_width=plan.out_width,
+            block_stride=STRIDE,
+        )
+        p, t = plan_arrays(plan), table_arrays(ct)
+        blocks = block_arrays(_fixed_stride_batches(plan)[0])
+
+        d1 = digest_arrays(build_digest_set(
+            [hashlib.md5(b"one").digest()], spec.algo))
+        d2 = digest_arrays(build_digest_set(
+            [hashlib.md5(b"two").digest()], spec.algo))
+        watcher = compile_watcher(step)
+        int(step(p, t, blocks, d1)["n_emitted"])  # warmup
+        with watcher.expect(0, label="digest swap"):
+            int(step(p, t, blocks, d2)["n_emitted"])
+
+
+class TestWatcherSelfCheck:
+    """The analyzer itself must detect misses, or the guards above are
+    vacuous."""
+
+    def test_detects_shape_bust(self, compile_watcher):
+        f = jax.jit(lambda x: x * 2)
+        watcher = compile_watcher(f)
+        f(jnp.ones((4,), jnp.int32)).block_until_ready()
+        with pytest.raises(AssertionError, match="cache-busting"):
+            with watcher.expect(0):
+                # New shape: a fresh signature-cache entry.
+                f(jnp.ones((5,), jnp.int32)).block_until_ready()
+
+    def test_counts_warmup_compile(self, compile_watcher):
+        f = jax.jit(lambda x: x + 1)
+        watcher = compile_watcher(f)
+        with watcher.expect(1):
+            f(jnp.ones((3,), jnp.int32)).block_until_ready()
+        assert watcher.new_entries() == 1
+
+    def test_cache_hit_is_silent(self, compile_watcher):
+        f = jax.jit(lambda x: x - 1)
+        watcher = compile_watcher(f)
+        f(jnp.ones((2,), jnp.int32)).block_until_ready()
+        with watcher.expect(0):
+            f(jnp.ones((2,), jnp.int32) * 7).block_until_ready()

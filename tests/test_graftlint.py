@@ -1,0 +1,131 @@
+"""graftlint rule corpus: every rule must both FLAG its hazard and stay
+quiet on the idiomatic alternative.  Fixture snippets live in
+tests/lint_fixtures/ as ``<code>_flag.py`` / ``<code>_ok.py`` pairs,
+each declaring the virtual package path it is linted under (rules are
+path-scoped: ops/ dtype rules, library stdout rules, ...)."""
+
+import pathlib
+import re
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftlint import (  # noqa: E402
+    ALL_RULES,
+    lint_paths,
+    lint_source,
+)
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent / "lint_fixtures"
+CODES = [rule.code for rule in ALL_RULES]
+
+_VPATH_RE = re.compile(r"#\s*graftlint-virtual-path:\s*(\S+)")
+
+
+def _load_fixture(code: str, kind: str):
+    path = FIXTURE_DIR / f"{code.lower()}_{kind}.py"
+    source = path.read_text(encoding="utf-8")
+    match = _VPATH_RE.search(source)
+    assert match, f"{path.name} must declare # graftlint-virtual-path:"
+    return source, match.group(1)
+
+
+def test_issue_floor_of_eight_rules():
+    """The tentpole contract: >= 8 repo-specific rules, stable codes."""
+    assert len(ALL_RULES) >= 8
+    assert len(set(CODES)) == len(CODES), "duplicate rule codes"
+    for rule in ALL_RULES:
+        assert re.fullmatch(r"GL\d{3}", rule.code)
+        assert rule.name and rule.summary and rule.rationale
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_rule_flags_its_hazard(code):
+    source, vpath = _load_fixture(code, "flag")
+    findings = lint_source(source, vpath, select=[code])
+    assert findings, f"{code} did not flag its hazard fixture"
+    assert all(f.code == code for f in findings)
+    assert all(f.path == vpath for f in findings)
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_rule_passes_the_idiom(code):
+    source, vpath = _load_fixture(code, "ok")
+    findings = lint_source(source, vpath, select=[code])
+    assert not findings, (
+        f"{code} false-positived on its ok fixture: "
+        + "; ".join(f.render() for f in findings)
+    )
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_fixture_pair_exists(code):
+    for kind in ("flag", "ok"):
+        assert (FIXTURE_DIR / f"{code.lower()}_{kind}.py").is_file()
+
+
+def test_suppression_comment_silences_one_line():
+    source, vpath = _load_fixture("GL001", "flag")
+    suppressed = "\n".join(
+        line + "  # graftlint: disable=GL001"
+        if not line.lstrip().startswith("#") else line
+        for line in source.splitlines()
+    )
+    assert not lint_source(suppressed, vpath, select=["GL001"])
+
+
+def test_path_scoping_gates_ops_rules():
+    """The same hazard outside ops/ is out of scope for ops-only rules."""
+    source, _ = _load_fixture("GL001", "flag")
+    outside = "hashcat_a5_table_generator_tpu/runtime/_fixture.py"
+    assert not lint_source(source, outside, select=["GL001"])
+
+
+def test_select_unknown_code_raises():
+    with pytest.raises(ValueError, match="unknown rule code"):
+        lint_source("x = 1\n", "m.py", select=["GL999"])
+
+
+def test_repo_is_clean():
+    """The acceptance gate scripts/lint.sh enforces, as a test: the
+    shipped package must lint clean."""
+    findings = lint_paths(
+        [
+            str(REPO_ROOT / "hashcat_a5_table_generator_tpu"),
+            str(REPO_ROOT / "tools"),
+        ]
+    )
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    """0 on clean, 1 on findings, 2 on unknown rule code."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    env_cwd = str(REPO_ROOT)
+    runs = {
+        0: [sys.executable, "-m", "tools.graftlint", str(clean)],
+        2: [
+            sys.executable, "-m", "tools.graftlint",
+            "--select", "GL999", str(clean),
+        ],
+    }
+    for expected, cmd in runs.items():
+        proc = subprocess.run(
+            cmd, cwd=env_cwd, capture_output=True, text=True, timeout=120
+        )
+        assert proc.returncode == expected, proc.stderr
+    dirty = tmp_path / "hashcat_a5_table_generator_tpu" / "ops"
+    dirty.mkdir(parents=True)
+    (dirty / "bad.py").write_text("WIDE = 0x1FFFFFFFF\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", str(tmp_path)],
+        cwd=env_cwd, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "GL001" in proc.stdout

@@ -523,11 +523,9 @@ class TestScalarUnits:
 
     def test_collision_table_parity_on_general_path(self):
         # The exact config the gate rejects must still be correct via the
-        # general kernel. NOTE: the wrapper does NOT re-check collisions —
-        # it trusts the caller to pass scalar_units_for(plan)'s verdict
-        # (production does); passing True for a colliding plan would
-        # corrupt the packed start encode. This pins the general-kernel
-        # pairing the gate falls back to.
+        # general kernel (the wrapper re-checks a bypassed gate and
+        # raises — see test_bypassed_gate_raises). This pins the
+        # general-kernel pairing the gate falls back to.
         spec = AttackSpec(mode="default", algo="md5")
         sub = {b"s": [b"5"], b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
         ct = compile_table(sub)
@@ -538,6 +536,76 @@ class TestScalarUnits:
             np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
             saw = saw or emit_x.any()
         assert saw
+
+    def test_bypassed_gate_raises(self):
+        # Passing scalar_units truthy for a plan the host gate rejects
+        # must raise host-side, not silently corrupt the packed startp
+        # encode (the wrapper re-validates when arrays are concrete).
+        import jax.numpy as jnp
+
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"s": [b"5"], b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words([b"misses", b"sass"]))
+        assert not scalar_units_for(plan)
+        batch, _, _ = make_blocks(
+            plan, start_word=0, start_rank=0, max_variants=8 * STRIDE,
+            max_blocks=8, fixed_stride=STRIDE,
+        )
+        batch = pad_batch(batch, 8)
+        with pytest.raises(ValueError, match="colliding match starts"):
+            fused_expand_md5(
+                jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.match_pos), jnp.asarray(plan.match_len),
+                jnp.asarray(plan.match_radix),
+                jnp.asarray(plan.match_val_start),
+                jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
+                jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
+                jnp.asarray(batch.count),
+                num_lanes=8 * STRIDE, out_width=plan.out_width,
+                min_substitute=spec.effective_min,
+                max_substitute=spec.max_substitute, block_stride=STRIDE,
+                k_opts=1, scalar_units=True, interpret=True,
+            )
+
+    def test_bypassed_single_tier_raises(self):
+        # A plan with active multi-byte spans qualifies as True but not
+        # "single"; claiming "single" drops the coverage bitmask and must
+        # be rejected the same way.
+        import jax.numpy as jnp
+
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"ss": [b"\xc3\x9f"], b"a": [b"4"]}
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words([b"glass", b"haas"]))
+        assert scalar_units_for(plan) is True
+        batch, _, _ = make_blocks(
+            plan, start_word=0, start_rank=0, max_variants=8 * STRIDE,
+            max_blocks=8, fixed_stride=STRIDE,
+        )
+        batch = pad_batch(batch, 8)
+        with pytest.raises(ValueError, match="multi-byte match spans"):
+            fused_expand_md5(
+                jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.match_pos), jnp.asarray(plan.match_len),
+                jnp.asarray(plan.match_radix),
+                jnp.asarray(plan.match_val_start),
+                jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len),
+                jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
+                jnp.asarray(batch.count),
+                num_lanes=8 * STRIDE, out_width=plan.out_width,
+                min_substitute=spec.effective_min,
+                max_substitute=spec.max_substitute, block_stride=STRIDE,
+                k_opts=1, scalar_units="single", interpret=True,
+            )
 
 
 class TestProductionWiring:
@@ -810,9 +878,13 @@ def test_eligible_algo_bounds():
                 max_val_len=2, max_options=2)
     for algo in ("md4", "sha1"):
         assert eligible(**{**base, "algo": algo})
-    # NTLM halves the single-block candidate budget (UTF-16LE doubling).
-    assert not eligible(**{**base, "algo": "ntlm"})
+    # NTLM halves the candidate budget (UTF-16LE doubling); with the
+    # multi-block widening it is eligible up to out_width 91, mirroring
+    # test_eligible_bounds.
+    assert eligible(**{**base, "algo": "ntlm"})
     assert eligible(**{**base, "algo": "ntlm", "out_width": 27})
+    assert eligible(**{**base, "algo": "ntlm", "out_width": 91})
+    assert not eligible(**{**base, "algo": "ntlm", "out_width": 92})
 
 
 @pytest.mark.parametrize("algo", ["sha1", "ntlm"])

@@ -1,0 +1,1 @@
+"""Repo-local developer tooling (not shipped with the package)."""

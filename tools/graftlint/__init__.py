@@ -1,0 +1,121 @@
+"""graftlint — repo-specific static analysis for the TPU hash engine.
+
+A stdlib-only (``ast`` + ``tokenize``) pass with rules for the hazards
+this codebase actually has: implicit dtype promotion in the uint32 hash
+arithmetic, host-side escapes inside jitted/Pallas bodies, recompiling
+``jax.jit`` call sites, nondeterminism in parity-critical layers, and
+the shape/dtype docstring contract on the public op surface.
+
+Typed public API::
+
+    from tools.graftlint import lint_source, lint_paths, ALL_RULES
+
+    findings = lint_source(src, path="hashcat_a5_table_generator_tpu/ops/x.py")
+    findings = lint_paths(["hashcat_a5_table_generator_tpu"])
+
+Suppress a finding on one line with ``# graftlint: disable=GL001``.
+Run as ``python -m tools.graftlint`` (see ``scripts/lint.sh``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, List, Optional, Sequence
+
+from .context import FileContext, build_context
+from .findings import Finding
+from .rules import ALL_RULES, RULES_BY_CODE, Rule
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_CODE",
+    "Finding",
+    "Rule",
+    "FileContext",
+    "lint_source",
+    "lint_file",
+    "lint_paths",
+]
+
+
+def _select_rules(select: Optional[Iterable[str]]) -> List[Rule]:
+    if select is None:
+        return list(ALL_RULES)
+    codes = list(select)
+    unknown = [c for c in codes if c not in RULES_BY_CODE]
+    if unknown:
+        raise ValueError(f"unknown rule code(s): {', '.join(unknown)}")
+    return [RULES_BY_CODE[c] for c in codes]
+
+
+def lint_source(
+    source: str,
+    path: str,
+    *,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint ``source`` as though it lived at ``path``.
+
+    ``path`` drives rule scoping (ops/ rules, library rules, ...), so
+    fixture tests lint snippets under virtual package paths.  ``select``
+    restricts to specific rule codes.  Raises ``SyntaxError`` on an
+    unparseable file.
+    """
+    ctx = build_context(source, path)
+    findings: List[Finding] = []
+    for rule in _select_rules(select):
+        if not rule.applies(ctx):
+            continue
+        for finding in rule.check(ctx):
+            if not ctx.is_suppressed(finding.line, finding.code):
+                findings.append(finding)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def lint_file(
+    path: str, *, select: Optional[Iterable[str]] = None
+) -> List[Finding]:
+    """Lint one file from disk."""
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    return lint_source(source, path, select=select)
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    """Expand files/directories into a sorted list of ``.py`` files.
+
+    A path that does not exist, or is a file without a ``.py`` suffix,
+    raises ``ValueError`` — a typo'd path in CI must be a loud usage
+    error, never a silently-vacuous (clean) lint run."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [
+                    d
+                    for d in dirnames
+                    if d not in ("__pycache__", ".git", ".venv", "node_modules")
+                ]
+                for name in filenames:
+                    if name.endswith(".py"):
+                        out.append(os.path.join(dirpath, name))
+        elif os.path.isfile(path):
+            if not path.endswith(".py"):
+                raise ValueError(f"not a Python file: {path}")
+            out.append(path)
+        else:
+            raise ValueError(f"no such file or directory: {path}")
+    return sorted(out)
+
+
+def lint_paths(
+    paths: Sequence[str],
+    *,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Lint every ``.py`` file under ``paths`` (files or directories)."""
+    findings: List[Finding] = []
+    for file_path in iter_python_files(paths):
+        findings.extend(lint_file(file_path, select=select))
+    return findings

@@ -1,0 +1,102 @@
+"""graftlint command line: ``python -m tools.graftlint [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error — the contract
+``scripts/lint.sh`` and CI key on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from . import ALL_RULES, lint_paths
+
+#: What ``python -m tools.graftlint`` scans with no arguments.
+DEFAULT_PATHS = ("hashcat_a5_table_generator_tpu", "tools")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "JAX/Pallas-aware static analysis for the TPU hash engine "
+            "(dtype promotion, trace escapes, recompilation hazards, "
+            "determinism, op doc contracts)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=list(DEFAULT_PATHS),
+        help=f"files or directories to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--select",
+        metavar="CODES",
+        help="comma-separated rule codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules",
+        action="store_true",
+        help="print the rule table and exit",
+    )
+    parser.add_argument(
+        "-v",
+        "--verbose",
+        action="store_true",
+        help="with --list-rules, include each rule's rationale",
+    )
+    return parser
+
+
+def _list_rules(verbose: bool) -> None:
+    for rule in ALL_RULES:
+        print(f"{rule.code}  {rule.name}: {rule.summary}")
+        if verbose:
+            print(f"       {rule.rationale}")
+
+
+def _silence_stdout() -> None:
+    """Point stdout at devnull after EPIPE so the interpreter's exit
+    flush cannot re-raise and clobber the documented exit code."""
+    devnull = os.open(os.devnull, os.O_WRONLY)
+    os.dup2(devnull, sys.stdout.fileno())
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_rules:
+        try:
+            _list_rules(args.verbose)
+        except BrokenPipeError:  # e.g. piped into head
+            _silence_stdout()
+        return 0
+    select: Optional[List[str]] = None
+    if args.select:
+        select = [c.strip() for c in args.select.split(",") if c.strip()]
+    try:
+        findings = lint_paths(args.paths, select=select)
+    except ValueError as exc:
+        print(f"graftlint: error: {exc}", file=sys.stderr)
+        return 2
+    except SyntaxError as exc:
+        print(f"graftlint: parse error: {exc}", file=sys.stderr)
+        return 2
+    try:
+        for finding in findings:
+            print(finding.render())
+    except BrokenPipeError:  # e.g. piped into head; keep the exit contract
+        _silence_stdout()
+    if findings:
+        print(
+            f"graftlint: {len(findings)} finding(s)",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

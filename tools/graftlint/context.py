@@ -1,0 +1,326 @@
+"""Per-file analysis context shared by every graftlint rule.
+
+One parse per file: the :class:`FileContext` owns the AST, the
+suppression-comment map, and the *traced-body* analysis (which function
+bodies execute under ``jax.jit`` tracing or inside a Pallas kernel) that
+the dtype- and tracing-hazard rules all need.  Rules stay tiny AST walks
+over this shared state.
+
+Traced-body detection is lexical and intentionally conservative:
+
+* functions decorated with ``jax.jit`` / ``jit`` / ``pjit`` (bare or via
+  ``functools.partial``),
+* functions whose name is passed to a ``jax.jit(...)`` call anywhere in
+  the same module,
+* Pallas kernels: functions passed as the first argument to
+  ``pl.pallas_call`` / ``pallas_call``, or whose name is ``kernel`` /
+  ends in ``_kernel`` (this repo's kernel-factory idiom builds ``def
+  kernel(...)`` closures and launches them through a shared epilogue, so
+  the ``pallas_call`` site only ever sees a parameter name),
+* anything lexically nested inside one of the above.
+
+Cross-module tracing (a body built here, jitted elsewhere) is invisible
+to a single-file pass; the rules accept that as a false-negative rather
+than risk flagging host-side numpy code (``ops/blocks.py`` does heavy
+deliberate ``int64`` work on the host).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Union
+
+#: The package whose layout the path-scoped rules understand.
+PACKAGE = "hashcat_a5_table_generator_tpu"
+
+#: ``# graftlint: disable=GL001[,GL002...]`` on a line suppresses those
+#: codes for that line.
+_DISABLE_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Z0-9, ]+)")
+
+#: Kernel naming idiom: ``def kernel`` / ``def _md5_kernel`` — but NOT
+#: the ``_make_*kernel`` factories, whose bodies are host-side closure
+#: prep around the inner ``def kernel``.
+_KERNEL_NAME_RE = re.compile(r"^(?!_?make_)(?!_make_).*?(^|_)kernel$")
+
+FunctionNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def root_name(node: ast.AST) -> Optional[str]:
+    """The Name at the root of an Attribute/Subscript/Call chain.
+
+    ``x``, ``x.foo``, ``x[0].bar``, ``x.astype(...)`` all root at ``x``;
+    used to decide whether an expression derives from a traced-function
+    parameter."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+def param_names(fn: FunctionNode) -> Set[str]:
+    """All parameter names of a function/lambda."""
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _is_jit_callable(func: ast.AST) -> bool:
+    """Does this expression name ``jax.jit`` (or bare ``jit``/``pjit``)?"""
+    name = dotted_name(func)
+    return name in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+def _partial_of_jit(call: ast.Call) -> bool:
+    """``partial(jax.jit, ...)`` / ``functools.partial(jax.jit, ...)``."""
+    if dotted_name(call.func) not in ("partial", "functools.partial"):
+        return False
+    return bool(call.args) and _is_jit_callable(call.args[0])
+
+
+def _jit_decorated(fn: Union[ast.FunctionDef, ast.AsyncFunctionDef]) -> bool:
+    for dec in fn.decorator_list:
+        if _is_jit_callable(dec):
+            return True
+        if isinstance(dec, ast.Call):
+            if _is_jit_callable(dec.func) or _partial_of_jit(dec):
+                return True
+    return False
+
+
+@dataclass
+class FileContext:
+    """Parsed file + shared analyses, handed to every rule."""
+
+    path: str
+    source: str
+    tree: ast.Module
+    #: line -> set of suppressed rule codes on that line
+    suppressed: Dict[int, Set[str]] = field(default_factory=dict)
+    #: traced roots (jitted functions and pallas kernels)
+    traced_roots: List[FunctionNode] = field(default_factory=list)
+    #: every node lexically inside a traced root (by id())
+    _traced_ids: Set[int] = field(default_factory=set)
+    #: union of the enclosing traced functions' parameter names per node
+    _traced_params: Dict[int, Set[str]] = field(default_factory=dict)
+
+    # -- path scoping ---------------------------------------------------
+
+    @property
+    def posix_path(self) -> str:
+        return self.path.replace("\\", "/")
+
+    def _in_package_dir(self, sub: str) -> bool:
+        return f"{PACKAGE}/{sub}/" in self.posix_path
+
+    @property
+    def in_ops(self) -> bool:
+        return self._in_package_dir("ops")
+
+    @property
+    def in_tables(self) -> bool:
+        return self._in_package_dir("tables")
+
+    @property
+    def in_utils(self) -> bool:
+        return self._in_package_dir("utils")
+
+    @property
+    def in_package(self) -> bool:
+        return f"{PACKAGE}/" in self.posix_path
+
+    @property
+    def is_library(self) -> bool:
+        """Package module that is not a CLI entry point (whose stdout IS
+        the candidate stream contract)."""
+        if not self.in_package:
+            return False
+        base = self.posix_path.rsplit("/", 1)[-1]
+        return base not in ("cli.py", "__main__.py")
+
+    # -- suppression ----------------------------------------------------
+
+    def is_suppressed(self, line: int, code: str) -> bool:
+        return code in self.suppressed.get(line, set())
+
+    # -- traced bodies --------------------------------------------------
+
+    def is_traced(self, node: ast.AST) -> bool:
+        """Is this node lexically inside a jitted/Pallas body?"""
+        return id(node) in self._traced_ids
+
+    def traced_params_at(self, node: ast.AST) -> Set[str]:
+        """Parameter names of the traced function(s) enclosing ``node``
+        (empty set when the node is not traced)."""
+        return self._traced_params.get(id(node), set())
+
+    def functions(self) -> Iterator[FunctionNode]:
+        for node in ast.walk(self.tree):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                yield node
+
+
+def _jitted_names(tree: ast.Module) -> Set[str]:
+    """Names passed to ``jax.jit(...)`` / ``pl.pallas_call(...)`` calls
+    anywhere in the module, in any of the three call forms:
+    ``jax.jit(fn)``, ``partial(jax.jit, ...)(fn)``, and
+    ``partial(jax.jit, fn, ...)``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Name):
+            if _is_jit_callable(node.func):
+                names.add(first.id)
+            elif dotted_name(node.func) in ("pl.pallas_call", "pallas_call"):
+                names.add(first.id)
+            elif isinstance(node.func, ast.Call) and _partial_of_jit(
+                node.func
+            ):
+                # partial(jax.jit, ...)(fn)
+                names.add(first.id)
+        if (
+            _partial_of_jit(node)
+            and len(node.args) >= 2
+            and isinstance(node.args[1], ast.Name)
+        ):
+            # partial(jax.jit, fn, ...): the wrapped target is arg 1.
+            names.add(node.args[1].id)
+    return names
+
+
+def _collect_suppressions(source: str) -> Dict[int, Set[str]]:
+    suppressed: Dict[int, Set[str]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            match = _DISABLE_RE.search(tok.string)
+            if match:
+                codes = {c.strip() for c in match.group(1).split(",")}
+                suppressed.setdefault(tok.start[0], set()).update(
+                    c for c in codes if c
+                )
+    except tokenize.TokenError:
+        pass
+    return suppressed
+
+
+def build_context(source: str, path: str) -> FileContext:
+    """Parse ``source`` (linted as ``path``) into a FileContext.
+
+    Raises ``SyntaxError`` for unparseable files — the CLI reports those
+    as hard errors rather than findings."""
+    tree = ast.parse(source, filename=path)
+    ctx = FileContext(
+        path=path,
+        source=source,
+        tree=tree,
+        suppressed=_collect_suppressions(source),
+    )
+
+    jitted = _jitted_names(tree)
+    for fn in ctx.functions():
+        is_root = False
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if _jit_decorated(fn):
+                is_root = True
+            elif fn.name in jitted:
+                is_root = True
+            elif _KERNEL_NAME_RE.search(fn.name):
+                is_root = True
+        if is_root:
+            ctx.traced_roots.append(fn)
+
+    # Mark everything lexically inside a traced root, accumulating the
+    # parameter names of every enclosing function (nested defs inside a
+    # kernel still close over the kernel's refs).
+    def mark(node: ast.AST, params: Set[str]) -> None:
+        ctx._traced_ids.add(id(node))
+        ctx._traced_params.setdefault(id(node), set()).update(params)
+        for child in ast.iter_child_nodes(node):
+            child_params = params
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                child_params = params | param_names(child)
+            mark(child, child_params)
+
+    for root in ctx.traced_roots:
+        mark(root, param_names(root))
+
+    return ctx
+
+
+def module_imports(tree: ast.Module) -> Iterator[Union[ast.Import, ast.ImportFrom]]:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            yield node
+
+
+def public_top_level_functions(
+    tree: ast.Module,
+) -> Iterator[ast.FunctionDef]:
+    """Top-level ``def``s not starting with ``_`` (the module's public
+    API surface)."""
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef) and not node.name.startswith("_"):
+            yield node
+
+
+def call_keywords(call: ast.Call) -> Set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg is not None}
+
+
+def literal_ints(tree: ast.AST) -> Iterator[ast.Constant]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+                and not isinstance(node.value, bool):
+            yield node
+
+
+def iter_calls(tree: ast.AST) -> Iterator[ast.Call]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def first_line(doc: Optional[str]) -> str:
+    return (doc or "").strip().splitlines()[0] if doc else ""
+
+
+def walk_scoped(
+    roots: Sequence[ast.AST],
+) -> Iterator[ast.AST]:
+    for root in roots:
+        yield from ast.walk(root)

@@ -1,0 +1,32 @@
+"""Finding and rule-metadata types — graftlint's typed public surface.
+
+Everything the CLI prints and the tests assert on is a
+:class:`Finding`; rules produce them and never print directly, so the
+same rule code drives the CLI, the pytest fixtures, and any future
+editor integration.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location.
+
+    ``path`` is the path the file was linted AS (fixture tests lint
+    snippets under a *virtual* path so path-scoped rules apply);
+    ``line``/``col`` are 1-based line and 0-based column, matching
+    ``ast`` node coordinates.
+    """
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+
+    def render(self) -> str:
+        """``path:line:col: CODE message`` — the CLI output line."""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"

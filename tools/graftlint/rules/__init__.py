@@ -1,0 +1,34 @@
+"""Rule registry: every graftlint rule, in code order.
+
+Each rule module groups one hazard family; add new rules by appending
+to the family module and they are picked up here.  ``ALL_RULES`` is the
+single source the CLI, the public API, and the fixture tests iterate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .base import Rule
+from .docs import OpDocstringContract
+from .dtype import FloatLiteralInKernel, UnmaskedWideInt
+from .hygiene import MutableDefaultArg, Nondeterminism, StdoutPrint
+from .jit import JitMissingStaticArgnames
+from .tracing import HostEscapeInTrace, LoopOverTracer, NumpyInTrace
+
+ALL_RULES: List[Rule] = [
+    UnmaskedWideInt(),
+    FloatLiteralInKernel(),
+    HostEscapeInTrace(),
+    NumpyInTrace(),
+    LoopOverTracer(),
+    JitMissingStaticArgnames(),
+    Nondeterminism(),
+    OpDocstringContract(),
+    StdoutPrint(),
+    MutableDefaultArg(),
+]
+
+RULES_BY_CODE: Dict[str, Rule] = {rule.code: rule for rule in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_CODE", "Rule"]

@@ -1,0 +1,39 @@
+"""Rule protocol: path scoping + one AST pass over a FileContext."""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterator
+
+from ..context import FileContext
+from ..findings import Finding
+
+
+class Rule(abc.ABC):
+    """One static check.  Subclasses set the class metadata and
+    implement :meth:`applies` (path scope) and :meth:`check`."""
+
+    #: Stable id, ``GLnnn``; fixture files and suppression comments key
+    #: on it.
+    code: str = ""
+    #: Short kebab-case name for ``--list-rules``.
+    name: str = ""
+    #: One-line description of what is flagged.
+    summary: str = ""
+    #: Why this matters *in this repo* (shown by ``--list-rules -v``).
+    rationale: str = ""
+
+    @abc.abstractmethod
+    def applies(self, ctx: FileContext) -> bool:
+        """Whether this rule runs on the file at all (path scope)."""
+
+    @abc.abstractmethod
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        """Yield findings; suppression filtering happens in the driver."""
+
+    def finding(
+        self, ctx: FileContext, line: int, col: int, message: str
+    ) -> Finding:
+        return Finding(
+            path=ctx.path, line=line, col=col, code=self.code, message=message
+        )

@@ -1,0 +1,78 @@
+"""Shape/dtype docstring contract on the public op surface.
+
+Every public function in ``ops/`` is a tensor program whose caller must
+know exact shapes and dtypes — the kernels are byte-layout-sensitive
+(packed uint32 words, ``[B, M]`` match matrices, ``[NB]`` block
+cursors).  The repo's convention documents these inline (``uint8 [B,
+L]`` etc.); this rule makes the convention load-bearing.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from ..context import FileContext, public_top_level_functions
+from ..findings import Finding
+from .base import Rule
+
+#: Evidence that a docstring states shapes/dtypes: a bracketed shape
+#: (``[B, L]``), an explicit dtype word, or shape/dtype/scalar prose.
+_SHAPE_DTYPE_RE = re.compile(
+    r"\[[^\]]+\]"
+    r"|\b(u?int(8|16|32|64)|float(16|32|64)|bool|bfloat16)\b"
+    r"|\b(shape[sd]?|dtypes?|scalar|array|bytes)\b",
+    re.IGNORECASE,
+)
+
+
+class OpDocstringContract(Rule):
+    code = "GL008"
+    name = "op-docstring-contract"
+    summary = (
+        "public ops/ function without a shape/dtype-stating docstring"
+    )
+    rationale = (
+        "ops/ functions pass byte-layout-sensitive tensors (packed "
+        "uint32 words, [B, M] match matrices); an undocumented shape "
+        "contract is how dtype drift between the XLA and Pallas paths "
+        "slips in. State shapes/dtypes like the rest of the package: "
+        "``uint8 [B, L]``."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_ops
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in public_top_level_functions(ctx.tree):
+            doc = ast.get_docstring(fn)
+            if not doc:
+                yield self.finding(
+                    ctx,
+                    fn.lineno,
+                    fn.col_offset,
+                    f"public op {fn.name}() has no docstring; state "
+                    "its shape/dtype contract",
+                )
+                continue
+            # Inline `# dtype [shape]` comments on the signature count:
+            # the repo annotates parameters that way.  The header is the
+            # signature span BEFORE the docstring statement (computed
+            # from the docstring node's line, not quote-style splitting,
+            # so '''-quoted docstrings can't leak body text into it).
+            seg = ast.get_source_segment(ctx.source, fn) or ""
+            doc_stmt = fn.body[0]  # the docstring Expr (doc is non-empty)
+            header = "\n".join(
+                seg.splitlines()[: max(doc_stmt.lineno - fn.lineno, 0)]
+            )
+            if not _SHAPE_DTYPE_RE.search(doc) and not _SHAPE_DTYPE_RE.search(
+                header
+            ):
+                yield self.finding(
+                    ctx,
+                    fn.lineno,
+                    fn.col_offset,
+                    f"docstring of public op {fn.name}() states no "
+                    "shape/dtype contract (no [shape] or dtype word)",
+                )

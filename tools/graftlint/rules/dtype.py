@@ -1,0 +1,109 @@
+"""Dtype-promotion hazards in the uint32 hash arithmetic (``ops/``).
+
+The engine's correctness contract is byte-exact parity with the Go
+reference; every hash kernel works in uint32 lanes.  NumPy/JAX silently
+promote mixed-width arithmetic, so a Python int literal that does not
+fit uint32 — or a float literal reaching a kernel — produces an
+int64/float intermediate that truncates differently from the reference
+(or errors only on TPU where x64 is disabled).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..context import FileContext, dotted_name, literal_ints
+from ..findings import Finding
+from .base import Rule
+
+#: uint32 ceiling: literals above this cannot be uint32 operands.
+_U32_MAX = 0xFFFFFFFF
+
+#: dtype constructors/names that widen uint32 lanes when they appear in
+#: traced kernel arithmetic.
+_WIDE_DTYPES = frozenset(
+    {
+        "np.int64",
+        "np.uint64",
+        "np.float64",
+        "np.float32",
+        "jnp.int64",
+        "jnp.uint64",
+        "jnp.float64",
+        "jnp.float32",
+    }
+)
+
+
+class UnmaskedWideInt(Rule):
+    code = "GL001"
+    name = "unmasked-wide-int"
+    summary = (
+        "integer literal wider than uint32 in an ops/ module"
+    )
+    rationale = (
+        "ops/ kernels do uint32 hash arithmetic; a literal > 0xFFFFFFFF "
+        "promotes the whole expression to int64 (or raises on TPU with "
+        "x64 disabled), silently breaking byte-exact parity with the Go "
+        "reference. Mask host-side (utils/) or split the constant."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_ops
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in literal_ints(ctx.tree):
+            if node.value > _U32_MAX:
+                yield self.finding(
+                    ctx,
+                    node.lineno,
+                    node.col_offset,
+                    f"int literal {node.value:#x} does not fit uint32; "
+                    "uint32 hash arithmetic would promote to int64 "
+                    "(mask host-side or split the constant)",
+                )
+
+
+class FloatLiteralInKernel(Rule):
+    code = "GL002"
+    name = "float-in-kernel"
+    summary = (
+        "float literal or widening dtype inside a jitted/Pallas body "
+        "in ops/"
+    )
+    rationale = (
+        "The hash pipeline is integer-only end to end; a float literal "
+        "(or an int64/float dtype constructor) inside a traced ops/ "
+        "body promotes uint32 lanes and diverges from the reference "
+        "bit patterns. Host-side ops/ code (e.g. blocks.py int64 rank "
+        "math) is deliberately out of scope."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_ops
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not ctx.is_traced(node):
+                continue
+            if isinstance(node, ast.Constant) and isinstance(
+                node.value, float
+            ):
+                yield self.finding(
+                    ctx,
+                    node.lineno,
+                    node.col_offset,
+                    f"float literal {node.value!r} inside a traced "
+                    "kernel body (integer-only uint32 pipeline)",
+                )
+            elif isinstance(node, ast.Attribute):
+                name = dotted_name(node)
+                if name in _WIDE_DTYPES:
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"widening dtype {name} inside a traced kernel "
+                        "body (uint32 lanes would promote)",
+                    )

@@ -1,0 +1,165 @@
+"""Repo-hygiene rules: determinism, the stdout contract, defaults.
+
+The candidate engine must be bit-reproducible (checkpoint/resume and
+multi-host stripes assume identical re-enumeration) and its stdout is a
+*data channel* — the reference streams raw candidate bytes, so a stray
+``print()`` corrupts the wordlist a consumer pipes into hashcat.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..context import FileContext, call_keywords, dotted_name
+from ..findings import Finding
+from .base import Rule
+
+#: Modules whose import into deterministic code is a red flag.
+_NONDET_MODULES = frozenset({"random", "secrets", "uuid"})
+
+#: Call prefixes that read wall clock or entropy.
+_NONDET_CALLS = (
+    "time.time",
+    "time.time_ns",
+    "time.perf_counter",
+    "time.monotonic",
+    "datetime.now",
+    "datetime.utcnow",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "os.urandom",
+    "np.random",
+    "numpy.random",
+    "random.",
+    "secrets.",
+    "uuid.",
+)
+
+
+class Nondeterminism(Rule):
+    code = "GL007"
+    name = "nondeterminism"
+    summary = (
+        "entropy/wall-clock use in deterministic packages "
+        "(ops/, tables/, utils/)"
+    )
+    rationale = (
+        "Enumeration order and table compilation must be bit-stable: "
+        "checkpoints resume by (word, rank) cursor and multi-host "
+        "stripes re-derive their slice independently. Randomness or "
+        "time-dependent behavior in these layers silently breaks "
+        "resume parity. (runtime/ progress reporting may read clocks; "
+        "it is out of scope.)"
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_ops or ctx.in_tables or ctx.in_utils
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    base = alias.name.split(".", 1)[0]
+                    if base in _NONDET_MODULES:
+                        yield self.finding(
+                            ctx,
+                            node.lineno,
+                            node.col_offset,
+                            f"import of {alias.name!r} in a "
+                            "deterministic package",
+                        )
+            elif isinstance(node, ast.ImportFrom):
+                base = (node.module or "").split(".", 1)[0]
+                if base in _NONDET_MODULES:
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"import from {node.module!r} in a "
+                        "deterministic package",
+                    )
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name is None:
+                    continue
+                if name.startswith(_NONDET_CALLS):
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"{name}() reads entropy/wall clock in a "
+                        "deterministic package",
+                    )
+
+
+class StdoutPrint(Rule):
+    code = "GL009"
+    name = "stdout-print"
+    summary = "print() without file= in a library module"
+    rationale = (
+        "stdout is the candidate byte stream (reference parity: raw "
+        "bytes piped into hashcat); a bare print() interleaves text "
+        "with candidate data and corrupts the wordlist. Diagnostics "
+        "must go to stderr (file=sys.stderr); cli.py/__main__.py own "
+        "their stdout and are exempt."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.is_library
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+                and "file" not in call_keywords(node)
+            ):
+                yield self.finding(
+                    ctx,
+                    node.lineno,
+                    node.col_offset,
+                    "print() without file= writes to the candidate "
+                    "stdout stream; use file=sys.stderr",
+                )
+
+
+class MutableDefaultArg(Rule):
+    code = "GL010"
+    name = "mutable-default-arg"
+    summary = "mutable default argument (list/dict/set literal or call)"
+    rationale = (
+        "A mutable default is created once at def time and shared "
+        "across calls; sweep/runtime objects are long-lived, so state "
+        "leaks across launches and table reloads."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_package or "tools/" in ctx.posix_path
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ctx.functions():
+            if isinstance(fn, ast.Lambda):
+                continue
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]
+            for default in defaults:
+                bad = isinstance(
+                    default, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                              ast.DictComp, ast.SetComp)
+                ) or (
+                    isinstance(default, ast.Call)
+                    and dotted_name(default.func)
+                    in ("list", "dict", "set", "bytearray")
+                )
+                if bad:
+                    yield self.finding(
+                        ctx,
+                        default.lineno,
+                        default.col_offset,
+                        f"mutable default argument in {fn.name}(); "
+                        "use None and construct inside the body",
+                    )

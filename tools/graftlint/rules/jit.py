@@ -1,0 +1,140 @@
+"""``jax.jit`` call sites missing ``static_argnames`` for config params.
+
+Passing a config-like value (``algo``, ``out_width``, ``block_stride``,
+...) as a traced argument does not error — JAX hashes the abstract
+value, so every distinct config retraces and recompiles the program.
+On the sweep hot path a recompile is tens of seconds of TPU stall; the
+repo's convention is that config travels as static keyword arguments
+(or is closed over by a builder, the ``make_*_step`` idiom).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Union
+
+from ..context import (
+    FileContext,
+    call_keywords,
+    dotted_name,
+)
+from ..findings import Finding
+from .base import Rule
+
+#: Parameter names that are launch-static configuration in this repo.
+_CONFIG_PARAM_RE = re.compile(
+    r"^(algo|mode|interpret|windowed|radix2|scalar_units|k_opts"
+    r"|num_(lanes|blocks|slots|segments)"
+    r"|(block|out|token|key|val)_(stride|width)"
+    r"|(min|max)_(substitute|options|val_len|key_len))$"
+)
+
+_FuncDef = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+
+def _config_params(fn: _FuncDef) -> List[str]:
+    a = fn.args
+    names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    return [n for n in names if _CONFIG_PARAM_RE.match(n)]
+
+
+def _module_functions(tree: ast.Module) -> Dict[str, _FuncDef]:
+    """Top-level defs and ``name = lambda ...`` assignments."""
+    out: Dict[str, _FuncDef] = {}
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+        elif isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Lambda
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = node.value
+    return out
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    return dotted_name(call.func) in ("jax.jit", "jit", "pjit", "jax.pjit")
+
+
+class JitMissingStaticArgnames(Rule):
+    code = "GL006"
+    name = "jit-missing-static-argnames"
+    summary = (
+        "jax.jit over a function with config-like params but no "
+        "static_argnames/static_argnums"
+    )
+    rationale = (
+        "Config params (algo/mode/out_width/...) traced as device "
+        "values make every distinct config a fresh trace+compile — a "
+        "silent multi-second stall per sweep configuration. Mark them "
+        "static or close over them in a builder (make_*_step idiom)."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_package
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        functions = _module_functions(ctx.tree)
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not _is_jit_call(node):
+                continue
+            if {"static_argnames", "static_argnums"} & call_keywords(node):
+                continue
+            target: Optional[_FuncDef] = None
+            target_desc = ""
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = functions.get(node.args[0].id)
+                target_desc = node.args[0].id
+            elif node.args and isinstance(node.args[0], ast.Lambda):
+                target = node.args[0]
+                target_desc = "<lambda>"
+            if target is None:
+                continue  # built elsewhere: the builder idiom, not checkable
+            config = _config_params(target)
+            if config:
+                yield self.finding(
+                    ctx,
+                    node.lineno,
+                    node.col_offset,
+                    f"jax.jit({target_desc}) traces config param(s) "
+                    f"{', '.join(repr(c) for c in config)}; mark them "
+                    "static_argnames or close over them in a builder",
+                )
+
+        # Decorator form: @jax.jit / @partial(jax.jit, ...) directly on a
+        # def with config-like params.
+        for name, fn in functions.items():
+            if isinstance(fn, ast.Lambda):
+                continue
+            for dec in fn.decorator_list:
+                has_static = False
+                is_jit = False
+                if dotted_name(dec) in ("jax.jit", "jit", "pjit", "jax.pjit"):
+                    is_jit = True
+                elif isinstance(dec, ast.Call):
+                    inner = dec.args[0] if dec.args else None
+                    if _is_jit_call(dec) or (
+                        dotted_name(dec.func)
+                        in ("partial", "functools.partial")
+                        and inner is not None
+                        and dotted_name(inner)
+                        in ("jax.jit", "jit", "pjit", "jax.pjit")
+                    ):
+                        is_jit = True
+                        has_static = bool(
+                            {"static_argnames", "static_argnums"}
+                            & call_keywords(dec)
+                        )
+                if is_jit and not has_static:
+                    config = _config_params(fn)
+                    if config:
+                        yield self.finding(
+                            ctx,
+                            fn.lineno,
+                            fn.col_offset,
+                            f"@jax.jit on {name}() traces config "
+                            f"param(s) {', '.join(repr(c) for c in config)};"
+                            " add static_argnames",
+                        )

@@ -1,0 +1,238 @@
+"""Host-side escapes inside traced (jit / Pallas) bodies.
+
+A jitted body runs once at trace time over abstract tracers; anything
+that forces a concrete value — ``.item()``, host numpy on a tracer, a
+Python loop iterating a tracer — either raises ``TracerArrayConversion``
+at trace time or (worse) silently bakes a trace-time constant into the
+compiled program, which for this engine means a wrong table for every
+launch after the first.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional, Set
+
+from ..context import FileContext, dotted_name, root_name
+from ..findings import Finding
+from .base import Rule
+
+#: Methods that force a concrete host value out of a device array.
+_ESCAPE_METHODS = frozenset(
+    {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+)
+
+#: Builtins that concretize a tracer when applied to one.  ``len()`` is
+#: deliberately absent: on a JAX array it returns the static leading
+#: dimension and is trace-safe.
+_ESCAPE_BUILTINS = frozenset({"int", "float", "bool"})
+
+#: numpy module aliases as imported across this repo.
+_NP_ALIASES = frozenset({"np", "numpy", "onp"})
+
+
+def _param_rooted(node: ast.AST, params: Set[str]) -> Optional[str]:
+    """The traced parameter an expression derives from, if any."""
+    root = root_name(node)
+    return root if root in params else None
+
+
+#: Attribute accesses on a tracer that yield STATIC (trace-safe) values.
+_STATIC_ATTRS = frozenset({"shape", "ndim", "dtype", "size", "weak_type"})
+
+
+def _tracer_valued(node: ast.AST, params: Set[str]) -> Optional[str]:
+    """The traced parameter an expression's VALUE derives from — None
+    when the chain passes through a static attribute (``x.shape[0]`` is
+    a Python int at trace time, not a tracer)."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return None
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Name):
+            return node.id if node.id in params else None
+        else:
+            return None
+
+
+def _loop_condition_tracer(test: ast.AST, params: Set[str]) -> Optional[str]:
+    """A traced parameter the loop condition's value depends on, if any.
+
+    Checks the bare test plus the operands of top-level Compare/BoolOp/
+    UnaryOp chains and the arguments of calls (``jnp.any(mask)``) —
+    ``len(xs)`` is exempt (static leading dim)."""
+    stack = [test]
+    while stack:
+        node = stack.pop()
+        root = _tracer_valued(node, params)
+        if root is not None:
+            return root
+        if isinstance(node, ast.Compare):
+            stack.append(node.left)
+            stack.extend(node.comparators)
+        elif isinstance(node, ast.BoolOp):
+            stack.extend(node.values)
+        elif isinstance(node, ast.UnaryOp):
+            stack.append(node.operand)
+        elif isinstance(node, ast.Call):
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id == "len"):
+                stack.extend(node.args)
+    return None
+
+
+class HostEscapeInTrace(Rule):
+    code = "GL003"
+    name = "host-escape-in-trace"
+    summary = (
+        ".item()/.tolist()/int()/float() on a tracer inside a "
+        "jitted/Pallas body"
+    )
+    rationale = (
+        "Concretizing a tracer raises at trace time at best; at worst "
+        "(e.g. on a weak-typed scalar) it bakes the first launch's "
+        "value into the compiled program and every later launch "
+        "silently reuses it. Hot-path wrappers must pull host values "
+        "BEFORE entering the traced body."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_package
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.is_traced(node):
+                continue
+            params = ctx.traced_params_at(node)
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _ESCAPE_METHODS
+                and not node.args
+            ):
+                yield self.finding(
+                    ctx,
+                    node.lineno,
+                    node.col_offset,
+                    f".{func.attr}() inside a traced body forces a "
+                    "host value out of a tracer",
+                )
+            elif (
+                isinstance(func, ast.Name)
+                and func.id in _ESCAPE_BUILTINS
+                and len(node.args) == 1
+                and _param_rooted(node.args[0], params)
+            ):
+                root = _param_rooted(node.args[0], params)
+                yield self.finding(
+                    ctx,
+                    node.lineno,
+                    node.col_offset,
+                    f"{func.id}() applied to traced argument {root!r} "
+                    "concretizes a tracer inside a jitted body",
+                )
+
+
+class NumpyInTrace(Rule):
+    code = "GL004"
+    name = "numpy-in-trace"
+    summary = "host numpy applied to a traced argument in a jitted body"
+    rationale = (
+        "np.* on a tracer either raises TracerArrayConversionError or "
+        "constant-folds at trace time — the launch-invariant result of "
+        "the FIRST launch gets compiled in. Static precomputes on "
+        "Python/np constants inside kernels are fine and not flagged; "
+        "only calls whose arguments derive from traced parameters are."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_package
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call) or not ctx.is_traced(node):
+                continue
+            name = dotted_name(node.func)
+            if name is None:
+                continue
+            alias = name.split(".", 1)[0]
+            if alias not in _NP_ALIASES:
+                continue
+            params = ctx.traced_params_at(node)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                root = _param_rooted(arg, params)
+                if root is not None:
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"{name}(...) applied to traced argument "
+                        f"{root!r}: host numpy does not trace (use jnp, "
+                        "or hoist to the host wrapper)",
+                    )
+                    break
+
+
+class LoopOverTracer(Rule):
+    code = "GL005"
+    name = "loop-over-tracer"
+    summary = "Python for/while loop iterating a traced argument"
+    rationale = (
+        "A Python loop over a tracer unrolls over its (concrete) length "
+        "at best and raises at worst; per-element iteration belongs in "
+        "lax.fori_loop/scan or vectorized lane math. Loops over "
+        "range(static) — the kernels' round-unroll idiom — are fine."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_package
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not ctx.is_traced(node):
+                continue
+            if isinstance(node, ast.For):
+                params = ctx.traced_params_at(node)
+                if isinstance(node.iter, ast.Call):
+                    # range(n)/zip(a, b)/enumerate(xs): the loop bound
+                    # itself must be static — range(x.shape[0]) is fine,
+                    # range(n) over a traced scalar is not.
+                    for arg in node.iter.args:
+                        root = _tracer_valued(arg, params)
+                        if root is not None:
+                            yield self.finding(
+                                ctx,
+                                node.lineno,
+                                node.col_offset,
+                                f"for-loop bound derives from traced "
+                                f"argument {root!r}; use "
+                                "lax.fori_loop/scan or a static shape",
+                            )
+                            break
+                    continue
+                root = _tracer_valued(node.iter, params)
+                if root is not None:
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"for-loop iterates traced argument {root!r}; "
+                        "use lax.fori_loop/scan or vectorize",
+                    )
+            elif isinstance(node, ast.While):
+                params = ctx.traced_params_at(node)
+                root = _loop_condition_tracer(node.test, params)
+                if root is not None:
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"while-loop condition reads traced argument "
+                        f"{root!r}; trace-time Python control flow "
+                        "cannot depend on device values",
+                    )

@@ -1,0 +1,136 @@
+"""Runtime analyzer: JAX compilation-cache misses around hot paths.
+
+Static rules catch *shapes* of recompilation hazards (GL006); this
+watcher catches the actual event.  The engine's hot loop launches one
+compiled program per (geometry, config) — a cache-busting argument
+signature (a Python scalar that varies per launch, a weak-typed const,
+an accidentally-traced config) shows up as a growing ``jax.jit`` cache,
+and on TPU each miss is a multi-second stall mid-sweep.
+
+Usage (see the ``compile_watcher`` pytest fixture in
+``tests/conftest.py``)::
+
+    watcher = CompileWatcher(step_fn)
+    with watcher.expect(1):          # first launch: one compile
+        step_fn(plan, table, blocks, digests)
+    with watcher.expect(0):          # same signature: cache hit only
+        step_fn(plan2, table, blocks, digests)
+
+``CompileWatcher`` prefers per-function cache sizes (``_cache_size()``
+on jitted callables — exact and local); when a watched callable does
+not expose one it falls back to the process-global backend-compile
+event counter from ``jax.monitoring``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Iterator, List, Optional, Sequence
+
+#: Module-level counter fed by the jax.monitoring listener (registered
+#: once; listeners cannot be unregistered).
+_BACKEND_COMPILES = 0
+_LISTENER_READY = False
+
+#: The duration event JAX records once per backend (XLA) compilation.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+def _ensure_listener() -> bool:
+    """Register the global compile-event listener (idempotent).
+
+    Returns False when ``jax.monitoring`` is unavailable."""
+    global _LISTENER_READY
+    if _LISTENER_READY:
+        return True
+    try:
+        import jax.monitoring as monitoring
+
+        def _on_duration(name: str, secs: float, **kw: Any) -> None:
+            global _BACKEND_COMPILES
+            if name == _COMPILE_EVENT:
+                _BACKEND_COMPILES += 1
+
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        return False
+    _LISTENER_READY = True
+    return True
+
+
+def backend_compile_count() -> int:
+    """Process-global count of backend compilations seen so far."""
+    _ensure_listener()
+    return _BACKEND_COMPILES
+
+
+def _cache_size(fn: Callable[..., Any]) -> Optional[int]:
+    """The jitted callable's signature-cache entry count, if exposed."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return None
+    try:
+        return int(probe())
+    except Exception:
+        return None
+
+
+class CompileWatcher:
+    """Counts new compilation-cache entries across a code region.
+
+    Watches specific jitted callables when given (exact, per-function);
+    otherwise watches the process-global backend-compile counter (off
+    by nested jits, but catches every miss).
+    """
+
+    def __init__(self, *functions: Callable[..., Any]) -> None:
+        self.functions: Sequence[Callable[..., Any]] = functions
+        self._have_sizes = bool(functions) and all(
+            _cache_size(fn) is not None for fn in functions
+        )
+        if not self._have_sizes and not _ensure_listener():
+            # A guard with no counting source would pass every expect()
+            # vacuously; a broken gate must be loud, never silently
+            # clean (same principle as iter_python_files).
+            raise RuntimeError(
+                "CompileWatcher has no counting source: the watched "
+                "callable(s) expose no _cache_size() and "
+                "jax.monitoring's duration-event listener is "
+                "unavailable on this jax version"
+            )
+        self._baseline: List[int] = []
+        self.snapshot()
+
+    def snapshot(self) -> None:
+        """Re-baseline: subsequent :meth:`new_entries` counts from here."""
+        if self._have_sizes:
+            self._baseline = [
+                _cache_size(fn) or 0 for fn in self.functions
+            ]
+        else:
+            self._baseline = [backend_compile_count()]
+
+    def new_entries(self) -> int:
+        """Cache entries (or backend compiles) added since the last
+        snapshot."""
+        if self._have_sizes:
+            sizes = [_cache_size(fn) or 0 for fn in self.functions]
+            return sum(s - b for s, b in zip(sizes, self._baseline))
+        return backend_compile_count() - self._baseline[0]
+
+    @contextlib.contextmanager
+    def expect(self, at_most: int, *, label: str = "") -> Iterator[None]:
+        """Fail (AssertionError) when the region compiles more than
+        ``at_most`` new programs — the cache-busting-signature guard."""
+        self.snapshot()
+        yield
+        got = self.new_entries()
+        if got > at_most:
+            where = f" [{label}]" if label else ""
+            raise AssertionError(
+                f"compilation-cache guard{where}: {got} new compiled "
+                f"program(s), expected at most {at_most}. A hot-path "
+                "argument signature is cache-busting (varying Python "
+                "scalar, weak-typed const, or config traced instead of "
+                "static)."
+            )
