@@ -51,6 +51,63 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
 NORTH_STAR = 1e10  # hashes/sec/chip target, BASELINE.json / BASELINE.md
 
+#: Committed last-good on-chip record (bench resilience, VERDICT r5 #2):
+#: every successful accelerator measurement overwrites it, and any run
+#: that ends on the CPU fallback (or fails outright) embeds it as a
+#: labeled "last_tpu" field — so the driver artifact carries TPU evidence
+#: across tunnel outages instead of only a cpu number.
+TPU_LAST_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "BENCH_TPU_LAST.json"
+)
+
+
+def save_tpu_last(record: dict) -> None:
+    """Persist a successful accelerator record (best effort — the bench
+    number must never be lost to a read-only checkout)."""
+    entry = {
+        k: record[k]
+        for k in ("metric", "value", "unit", "lanes", "blocks", "arm",
+                  "kernel", "platform", "device_kind", "mode", "table")
+        if k in record
+    }
+    entry["timestamp"] = time.strftime(
+        "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+    )
+    try:
+        sha = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(TPU_LAST_PATH), capture_output=True,
+            text=True, timeout=10,
+        ).stdout.strip()
+        if sha:
+            entry["git_sha"] = sha
+    except Exception:
+        pass
+    try:
+        with open(TPU_LAST_PATH, "w") as fh:
+            json.dump(entry, fh, indent=2)
+            fh.write("\n")
+    except OSError as e:
+        print(f"# could not write {TPU_LAST_PATH}: {e}", file=sys.stderr)
+
+
+def load_tpu_last() -> "dict | None":
+    try:
+        with open(TPU_LAST_PATH) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def attach_tpu_evidence(record: dict) -> dict:
+    """Accelerator attempts failed: label the record with the committed
+    last-good on-chip measurement so the artifact still carries TPU
+    evidence (clearly marked as historical, not this run's)."""
+    last = load_tpu_last()
+    if last is not None:
+        record["last_tpu"] = last
+    return record
+
 
 def metric_name(algo: str) -> str:
     return f"{algo}_candidate_hashes_per_sec_per_chip"
@@ -507,6 +564,10 @@ def run_worker(args: argparse.Namespace) -> None:
         raise SystemExit("all arms failed")
     print(json.dumps(record))
     sys.stdout.flush()
+    if not args.worker and dev.platform != "cpu":
+        # Direct (--platform) accelerator run, no orchestrator above us:
+        # persist the last-good on-chip record here.
+        save_tpu_last(record)
 
 
 # ----------------------------------------------------------- orchestrator --
@@ -674,6 +735,14 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         }
 
     def emit(record):
+        if record.get("platform") and record["platform"] != "cpu":
+            # A live accelerator measurement: refresh the committed
+            # last-good record.
+            save_tpu_last(record)
+        else:
+            # CPU fallback carried the number: embed the last on-chip
+            # measurement so the artifact keeps TPU evidence.
+            attach_tpu_evidence(record)
         if failures:
             record["failed_attempts"] = failures
         print(json.dumps(record))
@@ -761,9 +830,9 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         emit(rec)
         return
 
-    print(json.dumps(error_record(
+    print(json.dumps(attach_tpu_evidence(error_record(
         args.algo, "all platform attempts failed", failed_attempts=failures,
-    )))
+    ))))
     sys.exit(2)
 
 

@@ -556,6 +556,21 @@ class _DedupRecorder:
         return self.inner.hits
 
 
+def _print_routing(res) -> None:
+    """Word-routing summary (stderr): device-clean / cascade-closed /
+    oracle-fallback counts — the instrument behind the closure acceptance
+    numbers (PERF.md §14). Silent when the whole dictionary is clean."""
+    r = getattr(res, "routing", None) or {}
+    if not (r.get("device_closed") or r.get("oracle_fallback")):
+        return
+    print(
+        f"{PROG}: word routing: {r.get('device_clean', 0)} device-clean, "
+        f"{r.get('device_closed', 0)} device-closed, "
+        f"{r.get('oracle_fallback', 0)} oracle-fallback",
+        file=sys.stderr,
+    )
+
+
 def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
                       label: str, retry_notice: str = ""):
     """Elastic recovery (SURVEY.md §5): candidate generation is pure and
@@ -765,6 +780,7 @@ def _run_device(args, sub_map, packed) -> int:
                     f"{res.n_hits} hits, {res.n_emitted} candidates hashed",
                     file=sys.stderr,
                 )
+            _print_routing(res)
             _maybe_exit_pod_local(args, nprocs)
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
@@ -782,15 +798,16 @@ def _run_device(args, sub_map, packed) -> int:
                 # the concatenation is a per-word-preserving permutation
                 # of the single-host bucket-major stream.
                 try:
-                    run_candidates_multihost(
+                    res = run_candidates_multihost(
                         spec, sub_map, packed, writer, cfg,
                         resume=not args.no_resume,
                         gather=args.pod_hits == "gathered",
                     )
+                    _print_routing(res)
                 except PeerLossError as e:
                     _die_peer_loss(e)
             else:
-                _run_with_retries(
+                res = _run_with_retries(
                     lambda resume: make_sweep().run_candidates(
                         writer, resume=resume
                     ),
@@ -802,6 +819,7 @@ def _run_device(args, sub_map, packed) -> int:
                         "(at-least-once stream)"
                     ),
                 )
+                _print_routing(res)
     _maybe_exit_pod_local(args, nprocs)
     return 0
 

@@ -124,6 +124,12 @@ def plan_arrays(plan: Plan) -> Dict[str, jnp.ndarray]:
                 "seg_orig_start", "seg_orig_len", "seg_pat")
         if plan.windowed:
             keys = keys + ("win_v",)
+        if plan.close_next is not None:
+            # Cascade-closed plans carry their own value table (compiled
+            # rows + closed-cascade rows) and the joint-index fields; the
+            # kernels use cval_* INSTEAD of table_arrays' val_*.
+            keys = keys + ("close_next", "close_mul",
+                           "cval_bytes", "cval_len")
     else:
         raise TypeError(f"unknown plan type {type(plan)!r}")
     return {k: jnp.asarray(getattr(plan, k)) for k in keys}
@@ -179,9 +185,12 @@ def _expand(
     return expand_suball(
         plan["tokens"], plan["lengths"], plan["pat_radix"],
         plan["pat_val_start"], plan["seg_orig_start"], plan["seg_orig_len"],
-        plan["seg_pat"], table["val_bytes"], table["val_len"],
+        plan["seg_pat"],
+        plan.get("cval_bytes", table["val_bytes"]),
+        plan.get("cval_len", table["val_len"]),
         blocks["word"], blocks["base"], blocks["count"], blocks["offset"],
         win_v=plan.get("win_v"),
+        close_next=plan.get("close_next"), close_mul=plan.get("close_mul"),
         **common,
     )
 
@@ -300,8 +309,11 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                 plan["tokens"], plan["lengths"], plan["pat_radix"],
                 plan["pat_val_start"], plan["seg_orig_start"],
                 plan["seg_orig_len"], plan["seg_pat"],
-                table["val_bytes"], table["val_len"],
+                plan.get("cval_bytes", table["val_bytes"]),
+                plan.get("cval_len", table["val_len"]),
                 blocks["word"], blocks["base"], blocks["count"],
+                close_next=plan.get("close_next"),
+                close_mul=plan.get("close_mul"),
                 **common,
             )
         cand, cand_len, word_row, emit = _expand(
@@ -424,8 +436,13 @@ def decode_variant(
             raise ValueError(f"rank {rank} out of range for word {word_idx}")
     word = bytes(plan.tokens[word_idx, : plan.lengths[word_idx]])
 
+    # Cascade-closed plans read from the plan's extended value table.
+    cval = getattr(plan, "cval_bytes", None)
+    val_bytes = ct.val_bytes if cval is None else cval
+    val_lens = ct.val_len if cval is None else plan.cval_len
+
     def val(vrow: int) -> bytes:
-        return bytes(ct.val_bytes[vrow, : ct.val_len[vrow]])
+        return bytes(val_bytes[vrow, : val_lens[vrow]])
 
     if isinstance(plan, MatchPlan):
         chosen = [
@@ -453,6 +470,7 @@ def decode_variant(
     if not (spec.effective_min <= count <= spec.max_substitute):
         raise ValueError("variant outside the count window")
     out = []
+    close_next = getattr(plan, "close_next", None)
     for g in range(plan.num_segments):
         slot = int(plan.seg_pat[word_idx, g])
         start = int(plan.seg_orig_start[word_idx, g])
@@ -460,7 +478,17 @@ def decode_variant(
         if slot < 0 or digits[slot] == 0:
             out.append(word[start : start + length])
         else:
-            vrow = int(plan.pat_val_start[word_idx, slot]) + digits[slot] - 1
+            jd = digits[slot] - 1
+            if close_next is not None:
+                # Joint closure index: own digit scaled by the successor
+                # radix product, plus each successor's digit at its place.
+                mul = plan.close_mul[word_idx, slot]
+                jd = (digits[slot] - 1) * int(mul[0])
+                for s_i in range(close_next.shape[2]):
+                    nxt = int(close_next[word_idx, slot, s_i])
+                    if nxt >= 0:
+                        jd += digits[nxt] * int(mul[1 + s_i])
+            vrow = int(plan.pat_val_start[word_idx, slot]) + jd
             out.append(val(vrow))
     return b"".join(out)
 

@@ -20,17 +20,34 @@ are one prefix sum; bytes are two gathers. No recursion, no dynamic shapes.
 
 Exactness ("fast path") conditions, checked per word at plan time:
 
-* the table has no cascade hazard among the word's present patterns
-  (``CompiledTable.cascade_hazard``) — otherwise the sorted-order ReplaceAll
-  cascade could re-match inserted text;
 * greedy leftmost occurrences of different patterns don't overlap — otherwise
   WHICH occurrences get replaced depends on the chosen subset, not the word;
 * the table has no empty key (a ``=x`` line makes ReplaceAll insert between
-  every character — oracle-only semantics).
+  every character — oracle-only semantics);
+* any cascade hazard among the word's present patterns
+  (``CompiledTable.cascade_hazard`` — the sorted-order ReplaceAll cascade
+  re-matching inserted text) is **closable**: every possible re-match lies
+  wholly INSIDE an inserted value (containment, never boundary-crossing —
+  ``CompiledTable.cascade_crossing``), so the cascade's effect on a span is
+  a statically-known value rewrite. Closable hazard slots get a **joint
+  value table** built at plan time (:func:`_close_pattern_set`): slot ``p``
+  with hazard successors ``q1 < q2 < ...`` stores one pre-cascaded value row
+  per joint digit combination ``(d_p, d_q1, ...)`` — exactly
+  ``v.replace(q1, u1).replace(q2, u2)...`` in sorted-pattern order — and the
+  kernels address it with a mixed-radix index over the successors' decoded
+  digits (``close_next`` / ``close_mul``). Words whose hazards all close
+  this way run on device (``closed=True``); the device stream stays
+  word-multiset-identical to the oracle by construction.
 
-Words failing these checks get ``fallback=True`` and are routed through the
-byte-exact CPU oracle by the runtime; all six reference tables except the
-bidirectional qwerty-azerty are fast-path for every word.
+Words failing these checks — cross-pattern overlaps, empty keys, and
+*genuinely pathological* hazards (boundary-crossing rewrites, splice-joining
+empty values, or joint tables past the closure caps) — get ``fallback=True``
+and are routed through the byte-exact CPU oracle by the runtime. With
+closure, the bidirectional qwerty-azerty table's hazard words (10.2% of a
+rockyou-class dictionary, ~23% of its candidates — PERF.md §5) run on
+device; only the (vanishing) cap-overflow words still fall back.
+``A5GEN_CASCADE_CLOSE=off`` disables closure (every hazard word falls back,
+the pre-closure behavior) — the escape hatch and the A/B lever.
 
 Work unit: a **block** ``(word, base_digits, count)`` covering a contiguous
 range of the word's variant space. Blocks are how huge single-word spaces are
@@ -42,13 +59,14 @@ everything on device stays uint32.
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
-from ..tables.compile import CompiledTable
+from ..tables.compile import CompiledTable, boundary_match_possible
 from .blocks import (  # noqa: F401  — re-exported: this module defined them first
     MAX_BLOCK,
     BlockBatch,
@@ -64,6 +82,192 @@ from .expand_matches import (
     windowed_plan_fields,
 )
 from .packing import PackedWords
+
+#: Cascade-closure caps. A hazard slot's joint value table covers its own
+#: options × every successor's radix; past these bounds the word stays on
+#: the oracle (the table would bloat the plan and the Pallas kernel's
+#: K-way select). MAX_CLOSE_OPTS=12 covers every common qwerty-azerty
+#: hazard set ({a,q}: 2 rows; {",",m}: 9; {A,Q,q} / {",",";"}: 12); only
+#: words holding 3+ mutually-hazardous patterns (e.g. , ; m together)
+#: overflow.
+MAX_CLOSE_SUCC = 3
+MAX_CLOSE_OPTS = 12
+
+
+def close_enabled() -> bool:
+    """Cascade closure is ON by default; ``A5GEN_CASCADE_CLOSE`` set to
+    ``off``/``0``/``no`` reverts to routing every hazard word through the
+    CPU oracle (the pre-closure behavior — escape hatch and A/B lever)."""
+    return os.environ.get("A5GEN_CASCADE_CLOSE", "").lower() not in (
+        "off", "0", "no",
+    )
+
+
+def _close_pattern_set(
+    ct: CompiledTable, kis: Tuple[int, ...], first_option_only: bool
+) -> "Optional[Tuple[List[List[int]], List[Optional[List[bytes]]]]]":
+    """Try to close the ReplaceAll cascade for a word whose present patterns
+    are ``kis`` (ascending key indices; caller guarantees no cross-pattern
+    overlaps and no empty key — those words stay oracle-routed).
+
+    Walks each slot's *reachable span texts* stage by stage through the
+    later-sorted patterns: original span bytes are safe by the overlap
+    invariant (any match touching an unreplaced span would be an
+    occurrence-claim conflict in the original word), so only inserted /
+    rewritten values are tracked. A later pattern that could match CROSSING
+    a reachable text's boundary (``tables.compile.boundary_match_possible``
+    — includes the empty-value splice join) makes the word genuinely
+    pathological; a pattern matching INSIDE one becomes a hazard successor
+    and forks the reachable set by its options. Multi-level rewrites
+    (a successor's replacement re-matched by a later pattern) are handled
+    by the same walk — the successor list simply grows.
+
+    Returns ``(succ, rows)`` per local slot — ``succ[i]``: ascending local
+    slot indices of slot i's hazard successors; ``rows[i]``: the closed
+    value table (None when slot i needs no closure), one pre-cascaded row
+    per joint digit combination in lexicographic ``(d_i, d_j1, d_j2, ...)``
+    order with the LAST successor's digit varying fastest — or None when
+    the word is pathological (boundary crossing or closure caps)."""
+    keys = [ct.keys[ki] for ki in kis]
+    vals: List[List[bytes]] = []
+    for ki in kis:
+        s0, c = int(ct.val_start[ki]), int(ct.val_count[ki])
+        if first_option_only:
+            c = min(1, c)
+        vals.append([
+            bytes(ct.val_bytes[s0 + o, : ct.val_len[s0 + o]])
+            for o in range(c)
+        ])
+    n = len(kis)
+    succ: List[List[int]] = []
+    rows: List[Optional[List[bytes]]] = []
+    for i in range(n):
+        reach = list(dict.fromkeys(vals[i]))
+        s_i: List[int] = []
+        for j in range(i + 1, n):
+            q = keys[j]
+            if any(boundary_match_possible(t, q) for t in reach):
+                return None  # splice/crossing rewrite: oracle only
+            if any(q in t for t in reach):
+                s_i.append(j)
+                if len(s_i) > MAX_CLOSE_SUCC:
+                    return None
+                reach = list(dict.fromkeys(
+                    reach
+                    + [t.replace(q, u) for t in reach for u in vals[j]]
+                ))
+        if s_i:
+            jopts = len(vals[i])
+            for j in s_i:
+                jopts *= len(vals[j]) + 1
+            if jopts > MAX_CLOSE_OPTS:
+                return None
+            out: List[bytes] = []
+
+            def build(t: bytes, idx: int) -> None:
+                if idx == len(s_i):
+                    out.append(t)
+                    return
+                j = s_i[idx]
+                build(t, idx + 1)  # successor skipped (digit 0)
+                for u in vals[j]:
+                    # Sorted-pattern cascade order: successors ascend, so
+                    # the replace chain IS the oracle's Q4 order.
+                    build(t.replace(keys[j], u), idx + 1)
+
+            for v in vals[i]:
+                build(v, 0)
+            rows.append(out)
+        else:
+            rows.append(None)
+        succ.append(s_i)
+    return succ, rows
+
+
+#: Pattern-set closure record: the _close_pattern_set result (successor
+#: lists + closed value rows per local slot), shared by every word whose
+#: present-pattern set matches.
+_SetClosure = Tuple[List[List[int]], List[Optional[List[bytes]]]]
+
+
+def _closure_fields(
+    ct: CompiledTable,
+    closure_sets: Dict[Tuple[int, ...], _SetClosure],
+    word_sets: Dict[Tuple[int, ...], List[int]],
+    key_radix: np.ndarray,
+    pat_val_start: np.ndarray,
+    num_p: int,
+    batch: int,
+):
+    """Materialize plan fields from pattern-SET closures (shared by both
+    plan builders; mutates ``pat_val_start`` rows of closed slots to point
+    into the extended value table). All work is per distinct pattern set
+    (azerty-class dictionaries have a handful), with the set's word rows
+    assigned by one fancy index each — no per-word Python loop, matching
+    the fast builder's scaling contract.
+
+    ``closure_sets`` maps a present-pattern key-index tuple to its
+    ``(succ, rows)`` closure; ``word_sets`` maps the same keys to the
+    ascending word rows holding that set; ``key_radix`` is the per-key
+    ``options + 1`` (options already clamped for suball-reverse).
+
+    Returns ``(close_next [B,P,S], close_mul [B,P,S+1], cval_bytes,
+    cval_len, close_opts, wmax)`` — ``close_mul[..., 0]`` is the OWN
+    digit's multiplier (1 on non-closed slots, so the uniform device
+    address ``val_start + (d-1)*mul0 + Σ d_succ*mul_s`` degenerates to the
+    classic ``val_start + d - 1``); ``wmax [B, num_p]`` holds each closed
+    slot's widest pre-cascaded row (-1 elsewhere) for output-width sizing.
+    Closed value rows are deduplicated by ``(key, successor-key tuple)``;
+    insertion order is by each set's FIRST word row, so the fast and
+    scalar builders produce identical extended tables."""
+    s_max = 1
+    for succ, rows in closure_sets.values():
+        for sl, r in enumerate(rows):
+            if r is not None:
+                s_max = max(s_max, len(succ[sl]))
+    close_next = np.full((batch, num_p, s_max), -1, dtype=np.int32)
+    close_mul = np.zeros((batch, num_p, s_max + 1), dtype=np.int32)
+    close_mul[:, :, 0] = 1
+    wmax = np.full((batch, num_p), -1, dtype=np.int64)
+    v0 = int(ct.val_bytes.shape[0])
+    ext_rows: List[bytes] = []
+    ext_base: Dict[tuple, int] = {}
+    close_opts = 0
+    for kis in sorted(word_sets, key=lambda k: word_sets[k][0]):
+        succ, rows = closure_sets[kis]
+        rws = np.asarray(word_sets[kis], dtype=np.int64)
+        for sl, r in enumerate(rows):
+            if r is None:
+                continue
+            key = (kis[sl], tuple(kis[j] for j in succ[sl]))
+            if key not in ext_base:
+                ext_base[key] = v0 + len(ext_rows)
+                ext_rows.extend(r)
+            pat_val_start[rws, sl] = ext_base[key]
+            mul = 1
+            for s_i in range(len(succ[sl]) - 1, -1, -1):
+                j = succ[sl][s_i]
+                close_next[rws, sl, s_i] = j
+                close_mul[rws, sl, 1 + s_i] = mul
+                mul *= int(key_radix[kis[j]])
+            close_mul[rws, sl, 0] = mul
+            close_opts = max(close_opts, len(r))
+            wmax[rws, sl] = max((len(x) for x in r), default=0)
+    width = max(
+        int(ct.val_bytes.shape[1]),
+        max((len(x) for x in ext_rows), default=1),
+        1,
+    )
+    e = len(ext_rows)
+    cval_bytes = np.zeros((v0 + e, width), dtype=np.uint8)
+    cval_bytes[:v0, : ct.val_bytes.shape[1]] = ct.val_bytes
+    cval_len = np.zeros((v0 + e,), dtype=np.int32)
+    cval_len[:v0] = ct.val_len
+    for r_i, x in enumerate(ext_rows):
+        if x:
+            cval_bytes[v0 + r_i, : len(x)] = np.frombuffer(x, dtype=np.uint8)
+        cval_len[v0 + r_i] = len(x)
+    return close_next, close_mul, cval_bytes, cval_len, close_opts, wmax
 
 
 @dataclass(frozen=True)
@@ -90,6 +294,17 @@ class SubAllPlan:
     win_v: "np.ndarray | None" = None  # int32 [B, P+1, K+2] suffix counts
     #   (see expand_matches.MatchPlan.win_v — identical scheme over
     #   pattern slots)
+    # --- cascade closure (all None/0 when no word needed closure) --------
+    closed: "np.ndarray | None" = None  # bool [B] — device-closed words
+    close_next: "np.ndarray | None" = None  # int32 [B, P, S] — successor
+    #   slots of each pattern slot (-1 inactive)
+    close_mul: "np.ndarray | None" = None  # int32 [B, P, S+1] — joint value
+    #   index multipliers; column 0 multiplies the slot's OWN digit-1
+    cval_bytes: "np.ndarray | None" = None  # uint8 [V+E, W] — plan value
+    #   table: the compiled table's rows + closed-cascade rows (device
+    #   kernels use this INSTEAD of table_arrays' val_bytes when present)
+    cval_len: "np.ndarray | None" = None  # int32 [V+E]
+    close_opts: int = 0  # widest closed joint table (rows per slot)
 
     @property
     def batch(self) -> int:
@@ -190,11 +405,43 @@ def _build_suball_plan_fast(
         span_count += sel.sum(axis=1)
 
     coverage = np.cumsum(cover_delta[:, :width], axis=1)  # [B, L]
-    fallback_mask = (coverage > 1).any(axis=1)
+    overlap_mask = (coverage > 1).any(axis=1)
+    hazard_mask = np.zeros(b, dtype=bool)
     if ct.cascade_hazard.any():
         hz = ct.cascade_hazard.astype(np.int32)
         m = present.astype(np.int32) @ hz  # hazardous-predecessor counts
-        fallback_mask |= ((m > 0) & present).any(axis=1)
+        hazard_mask = ((m > 0) & present).any(axis=1)
+    fallback_mask = overlap_mask | hazard_mask
+
+    # Cascade closure: containment-only hazard words keep the device path
+    # (their hazard slots get joint value tables — see the module
+    # docstring). Closure analysis runs once per present-pattern SET:
+    # azerty-class tables have a handful of distinct hazard sets across a
+    # whole dictionary, and every downstream materialization stays
+    # set-level too (one fancy index per set — no per-word Python loop).
+    closed_mask = np.zeros(b, dtype=bool)
+    closure_sets: Dict[Tuple[int, ...], _SetClosure] = {}
+    word_sets: Dict[Tuple[int, ...], List[int]] = {}
+    if close_enabled() and bool(hazard_mask.any()):
+        set_cache: Dict[Tuple[int, ...], "Optional[_SetClosure]"] = {}
+        for i in np.nonzero(hazard_mask & ~overlap_mask)[0]:
+            kis = tuple(int(x) for x in np.nonzero(present[i])[0])
+            if kis not in set_cache:
+                set_cache[kis] = _close_pattern_set(
+                    ct, kis, first_option_only
+                )
+            cl = set_cache[kis]
+            if cl is not None:
+                fallback_mask[i] = False
+                if any(r is not None for r in cl[1]):
+                    closure_sets[kis] = cl
+                    word_sets.setdefault(kis, []).append(int(i))
+                    closed_mask[i] = True
+                # All-None rows: the (conservative) table-level hazard
+                # never manifests under this mode's option set (e.g. the
+                # hazard value is clamped away in suball-reverse) — the
+                # plain span-splice path is exact, so the word is CLEAN,
+                # not closed.
 
     # Slots: the word's present keys in ascending order. Fallback rows
     # are neutralized below (radix 1) in BOTH paths, so dead rows never
@@ -211,6 +458,15 @@ def _build_suball_plan_fast(
     slot_of = krank[pw, pk]
     pat_radix[pw, slot_of] = key_radix[pk]
     pat_val_start[pw, slot_of] = ct.val_start[pk]
+    # Closure fields before neutralization: closed words keep live radices
+    # and get their hazard slots re-pointed into the extended value table.
+    close_next = close_mul = cval_bytes = cval_len = wmax = None
+    close_opts = 0
+    if closure_sets:
+        (close_next, close_mul, cval_bytes, cval_len, close_opts,
+         wmax) = _closure_fields(
+            ct, closure_sets, word_sets, key_radix, pat_val_start, num_p, b
+        )
     pat_radix[fallback_mask] = 1
     pat_val_start[fallback_mask] = 0
 
@@ -259,6 +515,22 @@ def _build_suball_plan_fast(
     orows, ocols = np.nonzero(occ_len > 0)
     word_delta = np.zeros(b, dtype=np.int64)
     np.add.at(word_delta, orows, delta_per_key[occ_key[orows, ocols]])
+    # Closed words: a rewritten row can outgrow the table's widest value
+    # (v.replace can lengthen), so their growth re-sums over the closed
+    # tables' widest rows — vectorized over the closed occurrences via
+    # the wmax [B, P] matrix (same scatter scheme as the base delta).
+    if wmax is not None:
+        in_closed = closed_mask[orows]
+        r2, c2 = orows[in_closed], ocols[in_closed]
+        ki2 = occ_key[r2, c2]
+        w2 = wmax[r2, krank[r2, ki2]]
+        contrib = np.where(
+            w2 >= 0,
+            np.maximum(0, w2 - occ_len[r2, c2]),
+            delta_per_key[ki2],
+        )
+        word_delta[closed_mask] = 0
+        np.add.at(word_delta, r2, contrib)
     word_delta[fallback_mask] = 0
     max_delta = int(word_delta.max())
     if out_width is None:
@@ -286,6 +558,12 @@ def _build_suball_plan_fast(
         out_width=out_width,
         windowed=windowed,
         win_v=win_v,
+        closed=closed_mask if closure_sets else None,
+        close_next=close_next,
+        close_mul=close_mul,
+        cval_bytes=cval_bytes,
+        cval_len=cval_len,
+        close_opts=close_opts,
     )
 
 
@@ -317,6 +595,9 @@ def build_suball_plan(
     hazard = ct.cascade_hazard
 
     per_word: List[dict] = []
+    closure_sets: Dict[Tuple[int, ...], _SetClosure] = {}
+    word_sets: Dict[Tuple[int, ...], List[int]] = {}
+    set_cache: Dict[Tuple[int, ...], "Optional[_SetClosure]"] = {}
     max_p = 1
     max_s = 1
     for i in range(b):
@@ -324,9 +605,9 @@ def build_suball_plan(
         slots: List[int] = []  # key indices, ascending = sorted patterns
         spans: List[Tuple[int, int, int]] = []  # (start, klen, slot)
         claimed = np.zeros(len(word), dtype=bool)
-        fallback = ct.has_empty_key
+        overlap = ct.has_empty_key
         for ki, key in enumerate(ct.keys):
-            if not key or fallback:
+            if not key or overlap:
                 continue
             pos = word.find(key)
             if pos < 0:
@@ -336,16 +617,35 @@ def build_suball_plan(
             while pos >= 0:
                 end = pos + len(key)
                 if claimed[pos:end].any():
-                    fallback = True  # cross-pattern overlap: subset-dependent
+                    overlap = True  # cross-pattern overlap: subset-dependent
                     break
                 claimed[pos:end] = True
                 spans.append((pos, len(key), slot))
                 pos = word.find(key, end)
-        if not fallback and len(slots) > 1:
+        hazardous = False
+        if not overlap and len(slots) > 1:
             ks = np.asarray(slots)
-            fallback = bool(hazard[np.ix_(ks, ks)].any())
+            hazardous = bool(hazard[np.ix_(ks, ks)].any())
+        fallback = overlap or hazardous
+        closure = None
+        if hazardous and not overlap and close_enabled():
+            kis = tuple(slots)
+            if kis not in set_cache:
+                set_cache[kis] = _close_pattern_set(
+                    ct, kis, first_option_only
+                )
+            cl = set_cache[kis]
+            if cl is not None:
+                fallback = False
+                if any(r is not None for r in cl[1]):
+                    closure = cl
+                    closure_sets[kis] = cl
+                    word_sets.setdefault(kis, []).append(i)
+                # else: hazard never manifests under this option set —
+                # clean, not closed (mirrors the fast path).
         spans.sort()
-        per_word.append({"slots": slots, "spans": spans, "fallback": fallback})
+        per_word.append({"slots": slots, "spans": spans,
+                         "fallback": fallback, "closure": closure})
         max_p = max(max_p, len(slots))
         max_s = max(max_s, len(spans))
 
@@ -384,10 +684,17 @@ def build_suball_plan(
             g += 1
             cursor = start + klen
             ki = info["slots"][slot]
-            vs, vc = int(ct.val_start[ki]), int(ct.val_count[ki])
-            widest = max(
-                (int(ct.val_len[vs + o]) for o in range(vc)), default=klen
-            )
+            closure = info["closure"]
+            if closure is not None and closure[1][slot] is not None:
+                # Closed slot: growth is bounded by the joint table's
+                # widest pre-cascaded row, not the raw value rows.
+                widest = max(len(x) for x in closure[1][slot])
+            else:
+                vs, vc = int(ct.val_start[ki]), int(ct.val_count[ki])
+                widest = max(
+                    (int(ct.val_len[vs + o]) for o in range(vc)),
+                    default=klen,
+                )
             delta += max(0, widest - klen)
         word_len = int(packed.lengths[i])
         if cursor < word_len:
@@ -398,6 +705,23 @@ def build_suball_plan(
 
     if out_width is None:
         out_width = max(4, -(-(width + max_delta) // 4) * 4)
+
+    # Closure fields before neutralization (mirrors the fast path).
+    close_next = close_mul = cval_bytes = cval_len = None
+    close_opts = 0
+    closed_mask = np.zeros((b,), dtype=bool)
+    if closure_sets:
+        for rws in word_sets.values():
+            closed_mask[rws] = True
+        vc_k = ct.val_count.astype(np.int64)
+        opts_k = np.minimum(1, vc_k) if first_option_only else vc_k
+        close_next, close_mul, cval_bytes, cval_len, close_opts, _ = (
+            _closure_fields(
+                ct, closure_sets, word_sets,
+                (opts_k + 1).astype(np.int32),
+                pat_val_start, num_p, b,
+            )
+        )
 
     # Neutralize fallback rows (mirrored in the fast path): their slots
     # are dead — the oracle re-derives those words — and must not sway
@@ -429,6 +753,12 @@ def build_suball_plan(
         out_width=out_width,
         windowed=windowed,
         win_v=win_v,
+        closed=closed_mask if closure_sets else None,
+        close_next=close_next,
+        close_mul=close_mul,
+        cval_bytes=cval_bytes,
+        cval_len=cval_len,
+        close_opts=close_opts,
     )
 
 
@@ -454,6 +784,8 @@ def expand_suball(
     block_stride: int | None = None,
     win_v: jnp.ndarray | None = None,
     radix2: bool = False,
+    close_next: jnp.ndarray | None = None,  # int32 [B, P, S]
+    close_mul: jnp.ndarray | None = None,  # int32 [B, P, S+1]
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -465,7 +797,10 @@ def expand_suball(
     block plus per-block broadcasts instead of per-lane searchsorted +
     gathers (see ``expand_matches.expand_matches``). ``win_v``: windowed
     plans unrank only in-window digit vectors (``expand_matches.
-    decode_digits``; block bases are scalar ranks).
+    decode_digits``; block bases are scalar ranks). ``close_next`` /
+    ``close_mul``: cascade-closed plans address a slot's value row by the
+    joint index over its own and its hazard-successors' digits (the
+    ``val_bytes`` passed must then be the plan's extended ``cval_bytes``).
     """
     n = num_lanes
     p = pat_radix.shape[1]
@@ -490,17 +825,32 @@ def expand_suball(
     active = radix > 1
     chosen_count = jnp.sum((digits > 0) & active, axis=1)
 
+    # Per-slot value-row offset: the joint closure index for closed plans
+    # (successor digits gathered once and folded in with their mixed-radix
+    # multipliers), plain ``digit - 1`` otherwise.
+    if close_next is not None:
+        cn = field(close_next)  # [N, P, S]
+        cm = field(close_mul)  # [N, P, S+1]
+        s_ax = cn.shape[2]
+        idx = jnp.clip(cn, 0, p - 1).reshape(-1, p * s_ax)
+        dsucc = jnp.take_along_axis(digits, idx, axis=1).reshape(
+            -1, p, s_ax
+        )
+        jd = (digits - 1) * cm[:, :, 0] + jnp.sum(
+            jnp.where(cn >= 0, dsucc * cm[:, :, 1:], 0), axis=2
+        )
+    else:
+        jd = digits - 1
+
     # Per-segment output lengths and value rows for this variant.
     is_span = spat_w >= 0
-    seg_digit = jnp.take_along_axis(
-        digits, jnp.where(is_span, spat_w, 0), axis=1
-    )
+    safe_slot = jnp.where(is_span, spat_w, 0)
+    seg_digit = jnp.take_along_axis(digits, safe_slot, axis=1)
     seg_digit = jnp.where(is_span, seg_digit, 0)
     chosen = seg_digit > 0
-    vstart = jnp.take_along_axis(
-        pvs_w, jnp.where(is_span, spat_w, 0), axis=1
-    )
-    opt_row = jnp.where(chosen, vstart + seg_digit - 1, 0)
+    vstart = jnp.take_along_axis(pvs_w, safe_slot, axis=1)
+    seg_jd = jnp.take_along_axis(jd, safe_slot, axis=1)
+    opt_row = jnp.where(chosen, vstart + seg_jd, 0)
     seg_len = jnp.where(chosen, val_len[opt_row], olen_w)  # [N, G]
 
     seg_end = jnp.cumsum(seg_len, axis=1)  # inclusive ends [N, G]

@@ -87,9 +87,16 @@ def _grid_height_from_env() -> int:
 _G = _grid_height_from_env()
 
 #: Soft caps keeping the fully-unrolled kernel's compile time bounded.
+#: _MAX_OPTIONS bounds the K-way value-select width, which for
+#: cascade-closed suball plans spans JOINT closure tables
+#: (expand_suball.MAX_CLOSE_OPTS=12: qwerty-azerty's widest common hazard
+#: sets reach 12 rows); plain plans stay capped at the historical
+#: _MAX_RAW_OPTIONS per-key bound (opts_for_config) so the widening never
+#: grows non-closed kernels.
 _MAX_SLOTS = 24
 _MAX_TOKENS = 64
-_MAX_OPTIONS = 8
+_MAX_OPTIONS = 12
+_MAX_RAW_OPTIONS = 8
 _MAX_SEGMENTS = 64  # suball kernel only (match kernels pass 0)
 #: Windowed plans: suffix-count DP column bound (window <= 8 per the
 #: plan-side eligibility, +2 DP columns).
@@ -149,13 +156,22 @@ def eligible(
 
 
 def k_opts_for(plan) -> int:
-    """Static per-key option count K (Python int scalar) — the kernel's
-    K-way value select width, from the plan's ``pat_radix`` int32
-    ``[B, P]`` slot-radix matrix. Works for match AND substitute-all
-    plans. Single source shared by production gating (:func:`opts_for`),
-    the parity tests, and the A/B probe, so they can never drift
-    apart."""
+    """Static per-key option count K (Python int scalar) — the DECODE's
+    radix bound, from the plan's ``pat_radix`` int32 ``[B, P]`` slot-radix
+    matrix. Works for match AND substitute-all plans. Single source shared
+    by production gating (:func:`opts_for`), the parity tests, and the A/B
+    probe, so they can never drift apart."""
     return max(1, int(plan.pat_radix.max()) - 1)
+
+
+def k_vals_for(plan) -> int:
+    """Static VALUE-SELECT width (Python int scalar), from the plan's
+    int32 ``[B, P]`` slot-radix matrix widened to the joint closure
+    tables of a cascade-closed suball plan (``SubAllPlan.close_opts`` —
+    a closed slot's value row is addressed by its own AND its
+    successors' digits, so the K-way select must span the joint table).
+    Equals :func:`k_opts_for` for every non-closed plan."""
+    return max(k_opts_for(plan), int(getattr(plan, "close_opts", 0) or 0))
 
 
 def enabled_by_env() -> bool:
@@ -212,7 +228,16 @@ def opts_for_config(spec, plan, ct, *, block_stride, num_blocks,
     probe (interpret-mode tests, A/B probes that pin the platform)."""
     if require_tpu and not _on_tpu():
         return None
-    max_options = k_opts_for(plan)
+    # Value-select width: joint closure tables widen K past the raw
+    # per-key option count, and the closed rows live in the plan's own
+    # value table (whose width bounds the u32 packing). The RAW per-key
+    # count keeps its historical cap — the wider _MAX_OPTIONS admits only
+    # the closure tables, never bigger plain kernels.
+    if k_opts_for(plan) > _MAX_RAW_OPTIONS:
+        return None
+    max_options = k_vals_for(plan)
+    cval = getattr(plan, "cval_bytes", None)
+    max_val_len = int(ct.max_val_len if cval is None else cval.shape[1])
     ok = eligible(
         mode=spec.mode,
         algo=spec.algo,
@@ -222,7 +247,7 @@ def opts_for_config(spec, plan, ct, *, block_stride, num_blocks,
         out_width=int(plan.out_width),
         num_slots=int(plan.num_slots),
         token_width=int(plan.tokens.shape[1]),
-        max_val_len=int(ct.max_val_len),
+        max_val_len=max_val_len,
         max_options=max_options,
         num_segments=int(getattr(plan, "num_segments", 0)),
         win_k2=(int(plan.win_v.shape[2])
@@ -355,6 +380,13 @@ def scalar_units_for(plan) -> "bool | str":
     the kernel drops its coverage bitmask entirely.  Both truthy values
     thread through ``fused_scalar_units`` unchanged."""
     if k_opts_for(plan) != 1:
+        return False
+    if getattr(plan, "close_next", None) is not None:
+        # Cascade-closed plans: a span's VALUE depends on other slots'
+        # digits (the joint closure index), so the block-uniform per-byte
+        # value fields the scalar kernel relies on don't exist. The
+        # general kernel carries closed plans. (Gate on the FIELD, like
+        # the wrapper's raise — never on a derived count.)
         return False
     mp = getattr(plan, "match_pos", None)
     if mp is None:
@@ -1190,21 +1222,32 @@ def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
     # Inside shard_map the outputs vary over whatever mesh axes the
     # inputs vary over (the per-device block batches) — shard_map's
     # check_vma rejects a bare ShapeDtypeStruct there, so propagate the
-    # union of the inputs' varying axes explicitly.
-    vma = frozenset()
-    for x in inputs:
-        vma = vma | getattr(jax.typeof(x), "vma", frozenset())
+    # union of the inputs' varying axes explicitly.  Older JAX (< 0.6)
+    # has neither jax.typeof nor the vma field; its shard_map tracks
+    # replication differently, so a plain ShapeDtypeStruct is correct
+    # there.
+    typeof = getattr(jax, "typeof", None)
+    if typeof is not None:
+        vma = frozenset()
+        for x in inputs:
+            vma = vma | getattr(typeof(x), "vma", frozenset())
+        out_shape = [
+            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32,
+                                 vma=vma),
+            jax.ShapeDtypeStruct((nb, stride), jnp.int32, vma=vma),
+        ]
+    else:
+        out_shape = [
+            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32),
+            jax.ShapeDtypeStruct((nb, stride), jnp.int32),
+        ]
 
     state, emit = pl.pallas_call(
         kernel,
         grid=(nb // _G,),
         in_specs=[row_spec(x.shape[1:]) for x in inputs],
         out_specs=[row_spec((n_state, stride)), row_spec((stride,))],
-        out_shape=[
-            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32,
-                                 vma=vma),
-            jax.ShapeDtypeStruct((nb, stride), jnp.int32, vma=vma),
-        ],
+        out_shape=out_shape,
         interpret=interpret,
     )(*inputs)
     state = state.transpose(0, 2, 1).reshape(num_lanes, n_state)
@@ -1358,7 +1401,7 @@ def _make_suball_kernel(
     *, g: int, s: int, p: int, length_axis: int,
     k_opts: int, out_width: int, min_substitute: int, max_substitute: int,
     algo: str = "md5", win_k2: "int | None" = None,
-    max_val_len: int = 4,
+    max_val_len: int = 4, close_s: "int | None" = None,
 ):
     """Per-step kernel body for substitute-all plans (``-s`` / ``-s -r``).
 
@@ -1369,13 +1412,21 @@ def _make_suball_kernel(
     in-word byte passes through — exactly ``ops.expand_suball``'s segment
     cumsum, re-expressed per position so the shared unit/message helpers
     apply. No overlap/clash concept exists here (plans pre-resolve spans;
-    hazard words never reach the device).
+    non-closable hazard words never reach the device).
 
     Ref shapes per grid step: tok[G, L] i32, wlen[G, 1] i32,
     pradix[G, P] i32, base[G, P] i32, count[G, 1] i32, slotat[G, L] i32
     (pattern slot owning byte j, -1 free), startat[G, L] i32 (its span
     start), vopt[G, P, K] u32, vlen[G, P, K] i32.
     Outputs: state[G, KS, S] u32 (KS = DIGEST_WORDS[algo]), emit[G, S] i32.
+
+    ``close_s`` (cascade-closed plans, ``expand_suball`` closure): static
+    successor-axis width; adds two refs after vlen — cnext[G, P, S] i32
+    (successor slot of each pattern slot, -1 inactive) and
+    cmul[G, P, S+1] i32 (joint value index multipliers, col 0 = own
+    digit's) — and the K-way value select runs on the JOINT index
+    ``(d-1)*mul0 + Σ d_succ*mul_s`` instead of ``d-1``. None (every
+    non-closed plan) traces the exact pre-closure kernel.
     """
     assert 0 < out_width and _hash_blocks_for(
         out_width, 2 if algo == "ntlm" else 1
@@ -1383,11 +1434,14 @@ def _make_suball_kernel(
 
     def kernel(tok, wlen, pradix, base, count, slotat, startat,
                *rest):
-        if win_k2 is not None:
-            winv, vopt, vlen, state_ref, emit_ref = rest
-        else:
-            winv = None
-            vopt, vlen, state_ref, emit_ref = rest
+        rest = list(rest)
+        winv = rest.pop(0) if win_k2 is not None else None
+        vopt, vlen = rest[0], rest[1]
+        rest = rest[2:]
+        if close_s is not None:
+            cnext, cmul = rest[0], rest[1]
+            rest = rest[2:]
+        state_ref, emit_ref = rest
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
         lane_ok = rank < count[:, 0][:, None]
 
@@ -1405,6 +1459,24 @@ def _make_suball_kernel(
                 active & (digits[sl] > 0)
             ).astype(_I32)
 
+        # Cascade closure: per-slot JOINT value index over the slot's own
+        # and its successors' digits. Successor digits resolve through an
+        # unrolled compare-select (cnext is per-block data; `digits` is a
+        # static list) — only traced for closed plans.
+        if close_s is not None:
+            joint = []
+            for sl in range(p):
+                acc = (digits[sl] - 1) * cmul[:, sl, 0][:, None]
+                for s_i in range(close_s):
+                    nt = cnext[:, sl, s_i][:, None]  # [G, 1]
+                    ds = jnp.zeros((g, s), _I32)
+                    # Successors are always LATER slots (sorted-pattern
+                    # order), so the compare-select only spans sl+1..p-1.
+                    for t2 in range(sl + 1, p):
+                        ds = jnp.where(nt == t2, digits[t2], ds)
+                    acc = acc + ds * cmul[:, sl, 1 + s_i][:, None]
+                joint.append(acc)
+
         # Per-slot selected value word/length (K-way compare select).
         val_w = []
         val_l = []
@@ -1414,8 +1486,12 @@ def _make_suball_kernel(
             for k in range(k_opts):
                 # K=1: digit 1 is the only option (radix-1 slots always
                 # decode 0, so `> 0` is safe for padded slots too).
-                sel = (digits[sl] > 0 if k_opts == 1
-                       else digits[sl] == (k + 1))
+                if close_s is not None:
+                    sel = (digits[sl] > 0) & (joint[sl] == k)
+                elif k_opts == 1:
+                    sel = digits[sl] > 0
+                else:
+                    sel = digits[sl] == (k + 1)
                 vw = jnp.where(sel, vopt[:, sl, k][:, None], vw)
                 vl = jnp.where(sel, vlen[:, sl, k][:, None], vl)
             val_w.append(vw)
@@ -1500,20 +1576,32 @@ def fused_expand_suball_md5(
     scalar_units: bool = False,
     pre: "dict | None" = None,  # scalar_units_fields device arrays
     interpret: bool = False,
+    close_next: "jnp.ndarray | None" = None,  # int32 [B, P, S] (closure)
+    close_mul: "jnp.ndarray | None" = None,  # int32 [B, P, S+1]
 ):
     """Fused decode+splice+hash for substitute-all fixed-stride launches.
 
     Same contract as :func:`fused_expand_md5` (including the ``win_v``
     count-windowed decode and the K=1 ``scalar_units`` fast path —
-    substitute-all plans qualify unconditionally, segments are disjoint);
-    callers must have checked :func:`eligible` with the plan's
-    ``num_segments``.
+    non-closed substitute-all plans qualify unconditionally, segments are
+    disjoint); callers must have checked :func:`eligible` with the plan's
+    ``num_segments``.  ``close_next`` / ``close_mul`` (cascade-closed
+    plans): per-slot joint value addressing — ``val_bytes`` must then be
+    the plan's extended ``cval_bytes`` and ``k_opts`` its
+    :func:`k_vals_for` width; closed plans never take the scalar-units
+    path (``scalar_units_for`` returns False for them).
     """
     interpret = interpret or _interpret_by_env()
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     p = pat_radix.shape[1]
     gs = seg_pat.shape[1]
     length_axis = tokens.shape[1]
+    if close_next is not None and scalar_units:
+        raise ValueError(
+            "cascade-closed plans cannot take the scalar-units kernel "
+            "(joint value tables are per-lane, not block-uniform); gate "
+            "via scalar_units_for(plan)"
+        )
 
     tok_b = tokens[blk_word].astype(_I32)
     wlen_b = lengths[blk_word][:, None]
@@ -1602,15 +1690,18 @@ def fused_expand_suball_md5(
         algo=algo,
         win_k2=None if win_v is None else int(win_v.shape[2]),
         max_val_len=int(val_bytes.shape[1]),
+        close_s=None if close_next is None else int(close_next.shape[2]),
     )
     inputs = [tok_b, wlen_b, pradix_b, blk_base, count_b, slotat_b,
               startat_b]
     if win_v is not None:
         inputs.append(win_v[blk_word])
+    inputs += [vopt_b, vlen_b]
+    if close_next is not None:
+        inputs += [close_next[blk_word], close_mul[blk_word]]
     return _launch_fused(
         kernel,
-        tuple(inputs) + (
-         vopt_b, vlen_b),
+        tuple(inputs),
         nb=nb, stride=block_stride, num_lanes=num_lanes,
         n_state=DIGEST_WORDS[algo], interpret=interpret,
     )

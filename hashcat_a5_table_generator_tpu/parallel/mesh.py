@@ -35,6 +35,21 @@ from ..models.attack import AttackSpec, make_candidates_body, make_fused_body
 from ..ops.blocks import BlockBatch, make_blocks, pad_batch
 
 
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+    """``jax.shard_map`` across JAX versions: promoted to the top-level
+    namespace (with ``check_vma``) in newer JAX; older releases ship it as
+    ``jax.experimental.shard_map`` with the equivalent ``check_rep``
+    knob."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as esm
+
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
+
+
 def make_mesh(n_devices: int | None = None, *, axis_name: str = "data") -> Mesh:
     """A 1-D mesh over the first ``n_devices`` local devices (all, if None)."""
     devices = jax.devices()
@@ -160,7 +175,7 @@ def make_sharded_crack_step(
 
     rep = P()
     shard = P(axis_name)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(rep, rep, rep, shard),
@@ -206,7 +221,7 @@ def make_sharded_candidates_step(
 
     rep = P()
     shard = P(axis_name)
-    mapped = jax.shard_map(
+    mapped = _shard_map(
         local_step,
         mesh=mesh,
         in_specs=(rep, rep, shard),

@@ -589,6 +589,8 @@ def run_crack_multihost(
         words_done=allgather_sum(res.words_done),
         resumed=allgather_sum(int(res.resumed)) > 0,
         wall_s=allgather_max(res.wall_s),
+        routing={k: allgather_sum(int(v)) for k, v in
+                 sorted(res.routing.items())},
     )
 
 
@@ -630,4 +632,6 @@ def run_candidates_multihost(
         words_done=allgather_sum(res.words_done),
         resumed=allgather_sum(int(res.resumed)) > 0,
         wall_s=allgather_max(res.wall_s),
+        routing={k: allgather_sum(int(v)) for k, v in
+                 sorted(res.routing.items())},
     )

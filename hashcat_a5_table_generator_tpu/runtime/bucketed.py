@@ -60,11 +60,22 @@ class _BucketProgress:
         self.word_base = 0
         self.emit_base = 0
         self.hit_base = 0
+        self._routing: dict = {}
 
     def advance(self, words: int, emitted: int, hits: int) -> None:
         self.word_base += words
         self.emit_base += emitted
         self.hit_base += hits
+
+    def set_routing(self, routing: dict) -> None:
+        # Per-bucket routing accumulates into whole-dictionary counts.
+        # Guarded like Sweep's call site: a custom reporter implementing
+        # only the pre-routing interface must keep working.
+        for k, v in routing.items():
+            self._routing[k] = self._routing.get(k, 0) + int(v)
+        inner_set = getattr(self.inner, "set_routing", None)
+        if inner_set is not None:
+            inner_set(self._routing)
 
     def seed_emitted(self, emitted: int) -> None:
         self.inner.seed_emitted(self.emit_base + emitted)
@@ -144,6 +155,10 @@ class BucketedSweep:
     def _merge(self, results: List[SweepResult], t0: float) -> SweepResult:
         hits = [h for r in results for h in r.hits]
         hits.sort(key=lambda h: (h.word_index, h.variant_rank))
+        routing: Dict[str, int] = {}
+        for r in results:
+            for k, v in r.routing.items():
+                routing[k] = routing.get(k, 0) + int(v)
         return SweepResult(
             n_emitted=sum(r.n_emitted for r in results),
             n_hits=sum(r.n_hits for r in results),
@@ -151,6 +166,7 @@ class BucketedSweep:
             words_done=sum(r.words_done for r in results),
             resumed=any(r.resumed for r in results),
             wall_s=time.monotonic() - t0,
+            routing=routing,
         )
 
     def run_crack(self, recorder=None, *, resume: bool = True) -> SweepResult:

@@ -33,6 +33,13 @@ class ProgressReporter:
         self._last = float("-inf")
         self._last_emitted = 0
         self._last_t = self._t0
+        self._routing: "dict | None" = None
+
+    def set_routing(self, routing: dict) -> None:
+        """Attach the sweep's word-routing counts (device_clean /
+        device_closed / oracle_fallback — a plan-time fact, constant over
+        the run); included in every progress line once known."""
+        self._routing = dict(routing)
 
     def seed_emitted(self, emitted: int) -> None:
         """Base the first rate window on a resumed sweep's prior count, so
@@ -50,18 +57,17 @@ class ProgressReporter:
         rate = (emitted - self._last_emitted) / window
         self._last, self._last_t = now, now
         self._last_emitted = emitted
+        body = {
+            "words": [words_done, self.total_words],
+            "candidates": emitted,
+            "cand_per_sec": round(rate, 1),
+            "hits": hits,
+            "elapsed_s": round(now - self._t0, 2),
+        }
+        if self._routing is not None:
+            body["routing"] = self._routing
         print(
-            json.dumps(
-                {
-                    "progress": {
-                        "words": [words_done, self.total_words],
-                        "candidates": emitted,
-                        "cand_per_sec": round(rate, 1),
-                        "hits": hits,
-                        "elapsed_s": round(now - self._t0, 2),
-                    }
-                }
-            ),
+            json.dumps({"progress": body}),
             file=self.stream,
             flush=True,
         )

@@ -144,6 +144,8 @@ class SweepResult:
     words_done: int = 0
     resumed: bool = False
     wall_s: float = 0.0
+    #: word routing counts: device_clean / device_closed / oracle_fallback
+    routing: Dict[str, int] = field(default_factory=dict)
 
 
 class _FallbackPrefetcher:
@@ -255,10 +257,14 @@ class Sweep:
         # from one enumeration scheme must never resume under the other —
         # the scheme is part of the fingerprint's mode token. (Scheme choice
         # is deterministic in the fingerprinted inputs; the token guards
-        # against cross-version resumes.)
+        # against cross-version resumes.) Cascade closure likewise changes
+        # WHICH words the device cursor covers (closed words leave the
+        # fallback set), so it gets its own token.
+        closed_arr = getattr(self.plan, "closed", None)
+        n_closed = int(closed_arr.sum()) if closed_arr is not None else 0
         mode_token = spec.mode + (
             "+windowed" if getattr(self.plan, "windowed", False) else ""
-        )
+        ) + ("+closed" if n_closed else "")
         self.fingerprint = sweep_fingerprint(
             mode_token,
             spec.algo,
@@ -274,6 +280,17 @@ class Sweep:
         self.fallback_rows: List[int] = [
             int(i) for i in np.nonzero(self.plan.fallback)[0]
         ]
+        #: three-way word routing (PERF.md §5/§14): clean device words,
+        #: cascade-closed device words, oracle-routed pathological words.
+        self.routing: Dict[str, int] = {
+            "device_clean": self.n_words - n_closed - len(self.fallback_rows),
+            "device_closed": n_closed,
+            "oracle_fallback": len(self.fallback_rows),
+        }
+        set_routing = getattr(self.config.progress, "set_routing", None)
+        if set_routing is not None:
+            set_routing(self.routing)
+
     def _auto_num_blocks(self, kind: str) -> int:
         """Resolve ``num_blocks=None``: the measured per-arm best geometry
         (PERF.md §9b/§11) — when the fused Pallas kernel will take the
@@ -770,6 +787,7 @@ class Sweep:
             words_done=self.n_words,
             resumed=resumed,
             wall_s=state.wall_s,
+            routing=dict(self.routing),
         )
 
     # ------------------------------------------------------------------
@@ -872,6 +890,7 @@ class Sweep:
             words_done=self.n_words,
             resumed=resumed,
             wall_s=state.wall_s,
+            routing=dict(self.routing),
         )
 
     @staticmethod

@@ -30,8 +30,20 @@ map is compiled once, host-side, into:
   diverge from the ReplaceAll cascade, at the cost of some exact-but-flagged
   words. ``cascade_free`` (no hazard at all) holds for monodirectional
   transliteration tables (qwerty-cyrillic, greek-hebrew, czech, german,
-  qwerty-greek); bidirectional tables like qwerty-azerty have hazards and
-  route hazard-affected words through the exact oracle path.
+  qwerty-greek); bidirectional tables like qwerty-azerty have hazards.
+
+  The hazard cases split further: ``cascade_crossing[K, K]`` flags the
+  BOUNDARY cases (b)-(d) only. A hazard pair that is containment-only
+  (``cascade_hazard & ~cascade_crossing`` — every possible ``q`` match
+  against an inserted ``v`` lies wholly inside ``v``) is a pure value
+  REWRITE: the effect of the later ReplaceAll on the span is exactly
+  ``v.replace(q, chosen_u)``, computable at plan-build time. The
+  substitute-all planner (``ops.expand_suball``) closes such cascades on
+  device — each affected pattern slot gets a joint value table over its
+  own digit and its hazard-successors' digits — so containment-hazard
+  words (the 10.2% fallback share of qwerty-azerty, PERF.md §5) stay on
+  the device path; only crossing cases (and cap overflows) remain
+  oracle-routed.
 
 Everything here is host-side numpy; the arrays are uploaded to device once per
 sweep and shared by every batch.
@@ -67,6 +79,7 @@ class CompiledTable:
     max_key_len: int
     max_val_len: int
     cascade_hazard: np.ndarray  # bool [K, K] — see module docstring
+    cascade_crossing: np.ndarray  # bool [K, K] — boundary cases (b)-(d) only
     has_empty_key: bool  # a b"" key exists (inert outside substitute-all)
 
     @property
@@ -106,14 +119,13 @@ class CompiledTable:
         ]
 
 
-def _touching_match_possible(v: bytes, q: bytes) -> bool:
-    """Could a ReplaceAll of pattern ``q`` match text touching an inserted
-    value ``v``? Word-independent over-approximation — see the module
-    docstring's (a)-(d). Every real cascade divergence satisfies one of
-    these: a match intersecting ``v`` covers a prefix, suffix, or all of
-    ``v``, with any overhang coming from surrounding context."""
-    if q in v:  # (a) contained in the inserted text
-        return True
+def boundary_match_possible(v: bytes, q: bytes) -> bool:
+    """Could a ReplaceAll of pattern ``q`` match text CROSSING a boundary of
+    inserted text ``v`` — the module docstring's cases (b)-(d)?
+    Word-independent over-approximation over arbitrary surrounding context.
+    Containment (case (a)) is deliberately NOT flagged: a fully-contained
+    re-match is a pure value rewrite, which the cascade-closure plans apply
+    statically (``ops.expand_suball``)."""
     if len(v) < len(q) and v in q:  # (d) spans v plus context on both sides
         return True
     for n in range(1, min(len(q), len(v) + 1)):
@@ -122,6 +134,16 @@ def _touching_match_possible(v: bytes, q: bytes) -> bool:
         if q[:n] == v[-n:]:  # (c) crosses v's right boundary
             return True
     return False
+
+
+def _touching_match_possible(v: bytes, q: bytes) -> bool:
+    """Could a ReplaceAll of pattern ``q`` match text touching an inserted
+    value ``v``? Word-independent over-approximation — see the module
+    docstring's (a)-(d). Every real cascade divergence satisfies one of
+    these: a match intersecting ``v`` covers a prefix, suffix, or all of
+    ``v``, with any overhang coming from surrounding context."""
+    # (a) contained in the inserted text, else a boundary crossing.
+    return q in v or boundary_match_possible(v, q)
 
 
 def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
@@ -170,6 +192,7 @@ def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
             byte_to_key[key[0]] = i
 
     cascade_hazard = np.zeros((k, k), dtype=bool)
+    cascade_crossing = np.zeros((k, k), dtype=bool)
     for p in range(k):
         for q in range(p + 1, k):  # only later-sorted patterns can re-match
             # keys[q] is never empty here: b"" sorts first, so it cannot be a
@@ -178,6 +201,12 @@ def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
             key_q = keys[q]
             cascade_hazard[p, q] = any(
                 _touching_match_possible(
+                    flat_values[val_start[p] + j], key_q
+                )
+                for j in range(val_count[p])
+            )
+            cascade_crossing[p, q] = any(
+                boundary_match_possible(
                     flat_values[val_start[p] + j], key_q
                 )
                 for j in range(val_count[p])
@@ -195,5 +224,6 @@ def compile_table(sub_map: SubstitutionMap) -> CompiledTable:
         max_key_len=max_key_len,
         max_val_len=max_val_len,
         cascade_hazard=cascade_hazard,
+        cascade_crossing=cascade_crossing,
         has_empty_key=b"" in sub_map,
     )

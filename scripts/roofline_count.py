@@ -78,6 +78,10 @@ def main():
     ap.add_argument("--algo", default="md5")
     ap.add_argument("--stride", type=int, default=128)
     ap.add_argument("--words", type=int, default=256)
+    ap.add_argument("--table", default="qwerty-cyrillic",
+                    help="built-in layout (qwerty-azerty produces a "
+                         "cascade-CLOSED suball plan — the joint-value "
+                         "kernel variant, PERF.md §14)")
     ap.add_argument("--no-scalar-units", action="store_true",
                     help="force the general kernel even when the plan "
                          "qualifies for the K=1 scalar-units path")
@@ -106,10 +110,10 @@ def main():
     spec = AttackSpec(mode=args.mode, algo=args.algo,
                       min_substitute=args.min_substitute,
                       max_substitute=args.max_substitute)
-    ct = compile_table(get_layout("qwerty-cyrillic").to_substitution_map())
+    ct = compile_table(get_layout(args.table).to_substitution_map())
     packed = pack_words(synth_wordlist(args.words))
     plan = build_plan(spec, ct, packed)
-    k = pe.k_opts_for(plan)
+    k = pe.k_vals_for(plan)  # value-select width (joint closure tables)
     nb = 16
     stride = args.stride
     batch, _, _ = make_blocks(
@@ -125,6 +129,9 @@ def main():
     )
 
     p, t, b = plan_arrays(plan), table_arrays(ct), block_arrays(batch, num_blocks=nb)
+    # Cascade-closed plans carry their own value table + joint fields.
+    vb = p.get("cval_bytes", t["val_bytes"])
+    vl = p.get("cval_len", t["val_len"])
 
     common = dict(
         num_lanes=nb * stride, out_width=int(plan.out_width),
@@ -145,8 +152,10 @@ def main():
         fn = lambda: pe.fused_expand_suball_md5(  # noqa: E731
             p["tokens"], p["lengths"], p["pat_radix"], p["pat_val_start"],
             p["seg_orig_start"], p["seg_orig_len"], p["seg_pat"],
-            t["val_bytes"], t["val_len"],
-            b["word"], b["base"], b["count"], **common,
+            vb, vl,
+            b["word"], b["base"], b["count"],
+            close_next=p.get("close_next"), close_mul=p.get("close_mul"),
+            **common,
         )
 
     jpr = jax.make_jaxpr(fn)()
@@ -159,8 +168,11 @@ def main():
     assert inner is not None, "no pallas_call in trace"
     g = pe._G
     ops, by_prim = count_kernel_ops(inner, g, stride)
-    print(f"mode={args.mode} algo={args.algo} stride={stride} "
-          f"slots={plan.num_slots} tokens={plan.tokens.shape[1]} K={k}")
+    closed = getattr(plan, "closed", None)
+    n_closed = int(closed.sum()) if closed is not None else 0
+    print(f"mode={args.mode} algo={args.algo} table={args.table} "
+          f"stride={stride} slots={plan.num_slots} "
+          f"tokens={plan.tokens.shape[1]} K={k} closed_words={n_closed}")
     print(f"kernel vector ops per candidate: {ops:.0f}")
     for name, w in by_prim.most_common(12):
         print(f"  {name:>22}: {w:8.1f}")

@@ -44,3 +44,52 @@ def test_worker_respects_block_layout_flag():
     assert b"(stride 64)" in r.stderr
     rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
     assert rec["value"] > 0
+
+
+def test_tpu_last_record_save_and_attach(tmp_path, monkeypatch):
+    """Bench resilience (VERDICT r5 #2): a successful accelerator record
+    overwrites the committed last-good file; a CPU-fallback or error
+    record embeds it as the labeled `last_tpu` field."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    path = tmp_path / "BENCH_TPU_LAST.json"
+    monkeypatch.setattr(bench, "TPU_LAST_PATH", str(path))
+    rec = {
+        "metric": "md5_candidate_hashes_per_sec_per_chip",
+        "value": 5.41e8, "unit": "hashes/sec", "lanes": 1 << 22,
+        "blocks": 32768, "arm": "pallas", "kernel": "scalar-bitmask",
+        "platform": "tpu", "device_kind": "TPU v5 lite",
+        "vs_baseline": 0.0541,  # non-whitelisted keys must not persist
+    }
+    bench.save_tpu_last(rec)
+    saved = json.loads(path.read_text())
+    assert saved["value"] == 5.41e8
+    assert saved["platform"] == "tpu"
+    assert "timestamp" in saved
+    assert "vs_baseline" not in saved
+
+    cpu_rec = {"value": 7.4e6, "platform": "cpu"}
+    bench.attach_tpu_evidence(cpu_rec)
+    assert cpu_rec["last_tpu"]["value"] == 5.41e8
+
+    # Missing/corrupt file: the record passes through unlabeled.
+    path.write_text("{not json")
+    clean = {"value": 1.0}
+    bench.attach_tpu_evidence(clean)
+    assert "last_tpu" not in clean
+
+
+def test_committed_tpu_last_is_valid():
+    """The checked-in BENCH_TPU_LAST.json (seeded from the round-5
+    on-chip session, PERF.md §11) must stay parseable with the fields
+    the driver artifact embeds."""
+    rec = json.loads((REPO / "BENCH_TPU_LAST.json").read_text())
+    for key in ("metric", "value", "unit", "platform", "device_kind",
+                "arm", "timestamp"):
+        assert key in rec, key
+    assert rec["platform"] != "cpu"
+    assert rec["value"] > 0

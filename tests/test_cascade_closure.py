@@ -1,0 +1,378 @@
+"""Cascade-closure parity suite (PERF.md §14).
+
+The substitute-all planner closes containment-only ReplaceAll hazards on
+device (``ops.expand_suball``): hazard slots get joint value tables holding
+the statically pre-cascaded rewrites. These tests pin the whole contract:
+
+* the qwerty-azerty table — the reference's headline bidirectional config
+  and the one shipped table with hazards — runs END-TO-END with the device
+  stream word-multiset-identical to the CPU oracle, and its fallback share
+  drops below 1% on a rockyou-class wordlist (the acceptance number);
+* randomized synthetic hazard tables (seeded fuzz — the hypothesis-driven
+  twin lives in test_property.py) keep multiset parity for every
+  non-fallback word, closure on or off;
+* the Q4 canonicalized sorted-pattern cascade ORDER is what closure bakes
+  into its joint tables (order vectors with order-sensitive rewrites);
+* the three-way routing stats (device-clean / device-closed /
+  oracle-fallback) are reported by the sweep — the instrument the
+  acceptance criterion reads.
+"""
+
+import io
+import json
+import random
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    build_plan,
+    decode_variant,
+)
+from hashcat_a5_table_generator_tpu.oracle.engines import (
+    iter_candidates,
+    process_word_substitute_all,
+)
+from hashcat_a5_table_generator_tpu.ops.expand_suball import (
+    MAX_CLOSE_OPTS,
+    _close_pattern_set,
+    build_suball_plan,
+)
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.runtime.progress import ProgressReporter
+from hashcat_a5_table_generator_tpu.runtime.sinks import CandidateWriter
+from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep, SweepConfig
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import BUILTIN_LAYOUTS
+
+from test_expand_suball import assert_parity, run_device_suball
+
+AZERTY = BUILTIN_LAYOUTS["qwerty-azerty"].to_substitution_map()
+
+
+def _rockyou_like(n: int, seed: int = 0):
+    """The bench's deterministic rockyou-class generator (lowercase stems +
+    digit tails) — the population PERF.md §5's 10.2% was measured on."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+    try:
+        from bench import synth_wordlist
+    finally:
+        sys.path.pop(0)
+    return synth_wordlist(n, seed)
+
+
+class TestAzertyEndToEnd:
+    def test_hazard_words_close_with_parity(self):
+        # Every hazard pair class the table has: a+q, w+z, m+",", case
+        # pairs, plus clean and empty words.
+        words = [b"aqua", b"wizard", b"ma,am", b"qa", b"zw", b"AQ",
+                 b"password", b"", b"a", b"Pa,ss", b"jazzqa"]
+        fallbacks = assert_parity(AZERTY, words)
+        assert not fallbacks  # all azerty hazards here are closable
+        ct = compile_table(AZERTY)
+        plan = build_suball_plan(ct, pack_words(words))
+        assert plan.closed is not None
+        for i, w in enumerate(words):
+            has_aq = b"a" in w and b"q" in w
+            has_wz = b"w" in w and b"z" in w
+            has_mc = b"m" in w and b"," in w
+            expect = has_aq or has_wz or has_mc or (b"A" in w and b"Q" in w)
+            assert bool(plan.closed[i]) == expect, w
+
+    def test_fallback_share_below_one_percent(self):
+        # The acceptance number: PERF.md §5 measured 10.2% of words
+        # falling back pre-closure; closure must push it under 1%.
+        words = _rockyou_like(5000)
+        sweep = Sweep(AttackSpec(mode="suball", algo="md5"), AZERTY, words,
+                      config=SweepConfig(lanes=1 << 12, num_blocks=32))
+        r = sweep.routing
+        assert r["device_clean"] + r["device_closed"] + \
+            r["oracle_fallback"] == 5000
+        assert r["device_closed"] > 0  # hazard words exist and closed
+        assert r["oracle_fallback"] / 5000 < 0.01
+
+    def test_sweep_stream_matches_oracle(self):
+        # End-to-end candidates mode over hazard-heavy words: global
+        # word-order with per-word multiset parity, closure active.
+        words = [b"zaq", b"aqua", b"xyz", b"wz,m", b"maze"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        sweep = Sweep(spec, AZERTY, words,
+                      config=SweepConfig(lanes=256, num_blocks=16))
+        assert not sweep.fallback_rows  # everything closed or clean
+        buf = io.BytesIO()
+        with CandidateWriter(buf) as w:
+            res = sweep.run_candidates(w)
+        got = buf.getvalue().splitlines()
+        pos = 0
+        for word in words:
+            seg = list(iter_candidates(word, AZERTY, 0, 15,
+                                       substitute_all=True))
+            assert Counter(got[pos:pos + len(seg)]) == Counter(seg), word
+            pos += len(seg)
+        assert pos == len(got) == res.n_emitted
+        assert res.routing["device_closed"] >= 3
+
+
+class TestQ4OrderVectors:
+    """Closure bakes the Q4 sorted-pattern ReplaceAll order into its joint
+    tables; these vectors have order-SENSITIVE rewrites, so any deviation
+    from the canonical order changes bytes."""
+
+    def test_two_stage_chain_order(self):
+        # 'a'->'b' then 'b'->'c': with both chosen the span must cascade
+        # a -> b -> c (sorted order), never stop at 'b'.
+        got, fallbacks = run_device_suball(
+            {b"a": [b"b"], b"b": [b"c"]}, [b"ab"], 0, 15
+        )
+        assert not fallbacks
+        assert got[0] == Counter({b"ab": 1, b"bb": 1, b"ac": 1, b"cc": 1})
+
+    def test_three_stage_chain_order(self):
+        got, fallbacks = run_device_suball(
+            {b"a": [b"b"], b"b": [b"c"], b"c": [b"d"]}, [b"abc"], 0, 15
+        )
+        assert not fallbacks
+        # Full choice: a->b->c->d everywhere (strictly sorted cascade).
+        assert got[0][b"ddd"] == 1
+        # b,c chosen without a: 'abc' -> 'acc' -> 'add'... order pins it.
+        want = Counter(process_word_substitute_all(
+            b"abc", {b"a": [b"b"], b"b": [b"c"], b"c": [b"d"]}, 0, 15
+        ))
+        assert got[0] == want
+
+    def test_multiplicity_of_rewritten_values(self):
+        # Q7 under closure: duplicate JOINT rows must keep multiplicity
+        # ('a'->'bb' with 'b'->'c' gives 'cc'; distinct digit combos that
+        # collide byte-wise stay distinct candidates).
+        sub = {b"a": [b"bb"], b"b": [b"c"]}
+        got, fallbacks = run_device_suball(sub, [b"ab"], 0, 15)
+        assert not fallbacks
+        assert got[0] == Counter(process_word_substitute_all(
+            b"ab", sub, 0, 15
+        ))
+
+
+class TestSyntheticFuzz:
+    """Seeded random hazard tables × words: device multiset == oracle for
+    every non-fallback word, and closure never changes WHAT is emitted —
+    only where it's computed. (The hypothesis twin in test_property.py
+    drives the same invariant through decode_variant when hypothesis is
+    installed; this one always runs.)"""
+
+    ALPHA = b"abc"
+
+    def _random_table(self, rng):
+        table = {}
+        for _ in range(rng.randint(1, 4)):
+            klen = rng.randint(1, 2)
+            key = bytes(rng.choice(self.ALPHA) for _ in range(klen))
+            vals = []
+            for _ in range(rng.randint(1, 2)):
+                vlen = rng.randint(0, 3)
+                vals.append(bytes(
+                    rng.choice(self.ALPHA + b"XY") for _ in range(vlen)
+                ))
+            table.setdefault(key, []).extend(vals)
+        return table
+
+    def _random_words(self, rng):
+        return [
+            bytes(rng.choice(self.ALPHA) for _ in range(rng.randint(0, 6)))
+            for _ in range(rng.randint(1, 4))
+        ]
+
+    @pytest.mark.parametrize("seed", range(40))
+    def test_fuzz_parity(self, seed):
+        rng = random.Random(seed)
+        table = self._random_table(rng)
+        words = self._random_words(rng)
+        mn = rng.randint(0, 2)
+        mx = rng.randint(mn, 6)
+        fallbacks = assert_parity(table, words, mn, mx)
+        # Closure must only ever SHRINK the fallback set vs closure-off.
+        ct = compile_table(table)
+        import hashcat_a5_table_generator_tpu.ops.expand_suball as es
+
+        plan_on = build_suball_plan(ct, pack_words(words))
+        import os
+
+        os.environ["A5GEN_CASCADE_CLOSE"] = "off"
+        try:
+            plan_off = build_suball_plan(ct, pack_words(words))
+        finally:
+            del os.environ["A5GEN_CASCADE_CLOSE"]
+        assert set(np.nonzero(plan_on.fallback)[0]) <= set(
+            np.nonzero(plan_off.fallback)[0]
+        )
+        assert es.close_enabled()
+        assert fallbacks == set(np.nonzero(plan_on.fallback)[0])
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_fuzz_decode_variant(self, seed):
+        # Host-side decode over every rank equals the oracle multiset for
+        # non-fallback words (the enumeration-theorem invariant, closure
+        # included).
+        rng = random.Random(1000 + seed)
+        table = self._random_table(rng)
+        words = self._random_words(rng)
+        spec = AttackSpec(mode="suball", algo="md5")
+        ct = compile_table(table)
+        plan = build_plan(spec, ct, pack_words(words))
+        for i, word in enumerate(words):
+            if plan.fallback[i] or plan.n_variants[i] > 4096:
+                continue
+            got = Counter()
+            for rank in range(plan.n_variants[i]):
+                try:
+                    got[decode_variant(plan, ct, spec, i, rank)] += 1
+                except ValueError:
+                    pass
+            want = Counter(process_word_substitute_all(
+                word, table, spec.effective_min, spec.max_substitute
+            ))
+            assert got == want, (word, table)
+
+
+class TestClosureAnalysis:
+    def test_crossing_value_rejected(self):
+        ct = compile_table({b"a": [b"c"], b"cb": [b"Z"]})
+        kis = tuple(range(ct.num_keys))
+        assert _close_pattern_set(ct, kis, False) is None
+
+    def test_empty_value_splice_rejected(self):
+        # b'' inserted value joins context: any later pattern could match
+        # across the splice — pathological.
+        ct = compile_table({b"a": [b""], b"bc": [b"Z"]})
+        assert _close_pattern_set(ct, (0, 1), False) is None
+
+    def test_cap_overflow_falls_back(self):
+        # Joint combos past MAX_CLOSE_OPTS stay on the oracle.
+        sub = {b",": [b";", b"m", b"M"], b"m": [b",", b";"],
+               b";": [b"m", b",", b"M"], b"M": [b";", b","]}
+        ct = compile_table(sub)
+        plan = build_suball_plan(ct, pack_words([b"m,", b"mM,"]))
+        assert plan.closed is not None and bool(plan.closed[0])
+        assert bool(plan.fallback[1])  # 3*4*3 = 36 > MAX_CLOSE_OPTS
+        assert MAX_CLOSE_OPTS == 12
+        assert_parity(sub, [b"m,", b"mM,"])
+
+    def test_clamped_away_hazard_is_clean_not_closed(self):
+        # suball-reverse clamps to subs[0]; a hazard living only in the
+        # clamped-away option never manifests, so the word must be CLEAN
+        # (span-splice path, scalar-units still eligible) — neither closed
+        # (which would crash the K=1 fused path) nor fallback.
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        sub = {b"a": [b"X", b"b"], b"b": [b"c"]}
+        spec = AttackSpec(mode="suball-reverse", algo="md5")
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words([b"ab"]))
+        assert not plan.fallback[0]
+        assert plan.closed is None and plan.close_next is None
+        assert scalar_units_for(plan)  # K=1 fast path stays open
+        got = Counter()
+        for rank in range(plan.n_variants[0]):
+            try:
+                got[decode_variant(plan, ct, spec, 0, rank)] += 1
+            except ValueError:
+                pass
+        want = Counter(iter_candidates(
+            b"ab", sub, 0, 15, substitute_all=True, reverse=True
+        ))
+        assert got == want
+
+    def test_first_option_only_closure(self):
+        # suball-reverse clamps to subs[0]; the joint tables must use the
+        # clamped option sets.
+        sub = {b"a": [b"b", b"x"], b"b": [b"c", b"d"]}
+        words = [b"ab", b"ba"]
+        spec = AttackSpec(mode="suball-reverse", algo="md5")
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words(words))
+        assert plan.closed is not None and plan.closed.all()
+        for i, word in enumerate(words):
+            got = Counter()
+            for rank in range(plan.n_variants[i]):
+                try:
+                    got[decode_variant(plan, ct, spec, i, rank)] += 1
+                except ValueError:
+                    pass
+            want = Counter(iter_candidates(
+                word, sub, 0, 15, substitute_all=True, reverse=True
+            ))
+            assert got == want, word
+
+
+def test_raw_option_cap_unchanged_by_closure_widening():
+    # _MAX_OPTIONS grew 8 -> 12 to admit joint closure tables; a PLAIN
+    # table with 9+ options per key must still be rejected (the compile
+    # -time soft cap the old bound enforced).
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        opts_for_config,
+    )
+
+    sub = {b"a": [b"%d" % i for i in range(9)]}
+    spec = AttackSpec(mode="suball", algo="md5")
+    ct = compile_table(sub)
+    plan = build_plan(spec, ct, pack_words([b"aa"]))
+    assert opts_for_config(spec, plan, ct, block_stride=128,
+                           num_blocks=16, require_tpu=False) is None
+    # A closed azerty plan (joint width 9 > 8) stays eligible.
+    ct_az = compile_table(AZERTY)
+    plan_az = build_plan(spec, ct_az, pack_words([b"ma,am"]))
+    assert plan_az.close_opts == 9
+    assert opts_for_config(spec, plan_az, ct_az, block_stride=128,
+                           num_blocks=16, require_tpu=False) == 9
+
+
+class TestRoutingStats:
+    def test_azerty_classification_pinned(self):
+        # The instrument the acceptance criterion reads: exact three-way
+        # split for a handful of words whose classes are known.
+        words = [
+            b"password",  # 'a' present, no partner -> clean
+            b"aqua",      # a+q hazard -> closed
+            b"wizard",    # w+z hazard -> closed
+            b"xyxy",      # no patterns at all -> clean
+            b"m,;",       # , + ; + m joint table overflow -> oracle
+        ]
+        sweep = Sweep(AttackSpec(mode="suball", algo="md5"), AZERTY, words,
+                      config=SweepConfig(lanes=256, num_blocks=16))
+        assert sweep.routing == {
+            "device_clean": 2,
+            "device_closed": 2,
+            "oracle_fallback": 1,
+        }
+
+    def test_routing_in_progress_json_and_result(self):
+        words = [b"aqua", b"xyxy", b"m,;"]
+        stream = io.StringIO()
+        progress = ProgressReporter(len(words), every_s=0.0, stream=stream)
+        spec = AttackSpec(mode="suball", algo="md5")
+        sweep = Sweep(spec, AZERTY, words,
+                      config=SweepConfig(lanes=256, num_blocks=16,
+                                         progress=progress))
+        buf = io.BytesIO()
+        with CandidateWriter(buf) as w:
+            res = sweep.run_candidates(w)
+        want = {"device_clean": 1, "device_closed": 1, "oracle_fallback": 1}
+        assert res.routing == want
+        lines = [json.loads(x) for x in stream.getvalue().splitlines()]
+        assert lines and all(
+            x["progress"]["routing"] == want for x in lines
+        )
+
+    def test_match_mode_routing_all_clean(self):
+        sweep = Sweep(AttackSpec(mode="default", algo="md5"),
+                      {b"a": [b"4"]}, [b"aa", b"bb"],
+                      config=SweepConfig(lanes=256, num_blocks=16))
+        assert sweep.routing == {
+            "device_clean": 2, "device_closed": 0, "oracle_fallback": 0,
+        }

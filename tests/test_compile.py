@@ -54,6 +54,35 @@ def test_compile_cascade_hazard_detection():
     assert compile_table({b"": [b"z"], b"a": [b"xy"]}).has_empty_key
 
 
+def test_compile_cascade_crossing_classification():
+    from hashcat_a5_table_generator_tpu.tables.compile import (
+        boundary_match_possible,
+    )
+
+    # Containment-only hazard: flagged hazardous but NOT crossing — the
+    # closure planner may rewrite it on device.
+    ct = compile_table({b"a": [b"b"], b"b": [b"c"]})
+    i, j = ct.key_index(b"a"), ct.key_index(b"b")
+    assert ct.cascade_hazard[i, j] and not ct.cascade_crossing[i, j]
+    # Boundary crossing (case c: 'cb' starts with the suffix of value 'c').
+    ct = compile_table({b"a": [b"c"], b"cb": [b"Z"]})
+    i, j = ct.key_index(b"a"), ct.key_index(b"cb")
+    assert ct.cascade_hazard[i, j] and ct.cascade_crossing[i, j]
+    # Empty value (case d: splice join) is a crossing hazard.
+    ct = compile_table({b"a": [b""], b"bc": [b"Z"]})
+    i, j = ct.key_index(b"a"), ct.key_index(b"bc")
+    assert ct.cascade_crossing[i, j]
+    # The predicate itself: containment is deliberately not "crossing".
+    assert not boundary_match_possible(b"bb", b"b")
+    assert boundary_match_possible(b"c", b"cb")  # left overhang
+    assert boundary_match_possible(b"c", b"bc")  # right overhang
+    assert boundary_match_possible(b"", b"x")  # splice join
+    # qwerty-azerty: every hazard pair is containment-only — the whole
+    # table closes on device (PERF.md §14).
+    az = compile_table(BUILTIN_LAYOUTS["qwerty-azerty"].to_substitution_map())
+    assert az.cascade_hazard.any() and not az.cascade_crossing.any()
+
+
 def test_compile_empty_key_and_empty_map():
     ct = compile_table({b"": [b"x"]})
     assert ct.has_empty_key and not ct.all_keys_single_byte

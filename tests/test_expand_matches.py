@@ -481,9 +481,12 @@ class TestWindowedEnumeration:
         from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
 
         sub = {k.encode(): [k.upper().encode()] for k in "setonird"}
-        sub[b"a"] = [b"bb"]  # replacement re-contains pattern 'b'...
-        sub[b"b"] = [b"c"]  # ...so words holding both a and b are hazards
-        words = [b"ab", b"considerations", b"ba", b"introductions"]
+        # Boundary-CROSSING hazard (the inserted 'c' can extend into a new
+        # 'cb' match): genuinely pathological, so it stays oracle-routed
+        # even with cascade closure.
+        sub[b"a"] = [b"c"]
+        sub[b"cb"] = [b"Z"]
+        words = [b"acb", b"considerations", b"cba", b"introductions"]
         spec = AttackSpec(mode="suball", algo="md5",
                           min_substitute=0, max_substitute=2)
         sweep, got = self._sweep_counter(spec, sub, words)

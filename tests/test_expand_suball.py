@@ -30,6 +30,16 @@ def run_device_suball(sub_map, words, min_sub, max_sub, lanes=4096):
     ct = compile_table(sub_map)
     packed = pack_words(words)
     plan = build_suball_plan(ct, packed)
+    # Cascade-closed plans carry their own value table and joint-index
+    # fields — exactly what models.attack._expand wires in production.
+    val_bytes = ct.val_bytes if plan.cval_bytes is None else plan.cval_bytes
+    val_len = ct.val_len if plan.cval_len is None else plan.cval_len
+    close_kw = {}
+    if plan.close_next is not None:
+        close_kw = dict(
+            close_next=jnp.asarray(plan.close_next),
+            close_mul=jnp.asarray(plan.close_mul),
+        )
     results = {i: Counter() for i in range(len(words))}
     w, rank = 0, 0
     while True:
@@ -46,8 +56,8 @@ def run_device_suball(sub_map, words, min_sub, max_sub, lanes=4096):
             jnp.asarray(plan.seg_orig_start),
             jnp.asarray(plan.seg_orig_len),
             jnp.asarray(plan.seg_pat),
-            jnp.asarray(ct.val_bytes),
-            jnp.asarray(ct.val_len),
+            jnp.asarray(val_bytes),
+            jnp.asarray(val_len),
             jnp.asarray(batch.word),
             jnp.asarray(batch.base_digits),
             jnp.asarray(batch.count),
@@ -56,6 +66,7 @@ def run_device_suball(sub_map, words, min_sub, max_sub, lanes=4096):
             out_width=plan.out_width,
             min_substitute=min_sub,
             max_substitute=max_sub,
+            **close_kw,
         )
         cand = np.asarray(cand)
         cand_len = np.asarray(cand_len)
@@ -110,13 +121,30 @@ def test_overlapping_patterns_fall_back():
     assert 0 in fallbacks and 1 in fallbacks and 2 not in fallbacks
 
 
-def test_cascade_hazard_falls_back():
-    # 'b' sorts after 'a' and is inserted by it: hazard when both present.
+def test_cascade_hazard_closes_on_device():
+    # 'b' sorts after 'a' and is inserted by it: a containment-only hazard
+    # when both are present. Cascade closure keeps such words on the
+    # device path (closed joint value tables), byte-parity with the
+    # oracle; assert_parity checks every NON-fallback word.
+    sub_map = {b"a": [b"b"], b"b": [b"c"]}
+    fallbacks = assert_parity(sub_map, [b"ab", b"a", b"b", b"aabb"])
+    assert not fallbacks
+    ct = compile_table(sub_map)
+    plan = build_suball_plan(ct, pack_words([b"ab", b"a", b"b"]))
+    assert plan.closed is not None and list(plan.closed) == [
+        True, False, False,
+    ]
+    # Words containing only one side of the hazard stay on the CLEAN path.
+    assert_parity(sub_map, [b"a", b"b", b"xa", b"bx"])
+
+
+def test_cascade_hazard_env_escape_hatch(monkeypatch):
+    # A5GEN_CASCADE_CLOSE=off restores the pre-closure routing: every
+    # hazard word falls back to the oracle.
+    monkeypatch.setenv("A5GEN_CASCADE_CLOSE", "off")
     sub_map = {b"a": [b"b"], b"b": [b"c"]}
     _, fallbacks = run_device_suball(sub_map, [b"ab", b"a", b"b"], 0, 15)
     assert fallbacks == {0}
-    # Words containing only one side of the hazard stay on the fast path.
-    assert_parity(sub_map, [b"a", b"b", b"xa", b"bx"])
 
 
 def test_cascade_boundary_crossing_falls_back():
@@ -234,6 +262,29 @@ def assert_fast_plan_equiv(fast, slow):
         # Scalar width also covers fallback words' dead spans; fast sizes
         # only what the device will see.
         assert fast.out_width <= slow.out_width
+    # Cascade-closure fields: identical classification, joint tables and
+    # extended value rows (the dedup insertion order is word-ascending in
+    # both paths, so even row ORDER must agree).
+    b = fast.batch
+    fc = fast.closed if fast.closed is not None else np.zeros(b, bool)
+    sc = slow.closed if slow.closed is not None else np.zeros(b, bool)
+    np.testing.assert_array_equal(fc, sc, err_msg="closed")
+    assert fast.close_opts == slow.close_opts
+    if fc.any():
+        s_ax = fast.close_next.shape[2]
+        assert slow.close_next.shape[2] == s_ax
+        np.testing.assert_array_equal(
+            fast.close_next[:, :p], slow.close_next[:, :p],
+            err_msg="close_next",
+        )
+        np.testing.assert_array_equal(
+            fast.close_mul[:, :p], slow.close_mul[:, :p],
+            err_msg="close_mul",
+        )
+        for plan in (fast, slow):
+            assert (plan.close_next[:, p:] == -1).all()
+        np.testing.assert_array_equal(fast.cval_bytes, slow.cval_bytes)
+        np.testing.assert_array_equal(fast.cval_len, slow.cval_len)
 
 
 class TestFastPlanPath:
@@ -248,7 +299,9 @@ class TestFastPlanPath:
         {b"s": [b"\xc3\x9f", b"$"], b"e": [b"3"]},  # 2-byte values
         {b"ss": [b"\xc3\x9f"], b"a": [b"4"], b"b": [b"8"]},  # multi-char key
         {b"ab": [b"X"], b"bc": [b"Y"], b"c": [b"Z"]},  # overlap -> fallback
-        {b"a": [b"b"], b"b": [b"c"]},  # cascade hazard pair
+        {b"a": [b"b"], b"b": [b"c"]},  # cascade hazard pair (closable)
+        {b"a": [b"bb"], b"b": [b"c", b"q"]},  # closable, multi-option succ
+        {b"a": [b"c"], b"cb": [b"Z"]},  # crossing hazard -> pathological
     ]
     WORDS = [b"", b"a", b"abc", b"aabbcc", b"zzz", b"cabbage",
              b"mississippi", b"abcabcabc", b"q" * 20, b"sesames",

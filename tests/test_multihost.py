@@ -276,6 +276,7 @@ def test_initialize_explicit_single_process_is_noop():
     assert multihost.initialize(process_id=0) == (0, 1)
 
 
+@pytest.mark.slow  # multi-process pod kill/recovery: ~100 s of subprocess barriers
 def test_peer_loss_survivor_aborts_loudly_then_resumes(tmp_path):
     """VERDICT r4 #6: kill one of two processes mid-sweep; the survivor
     must exit LOUDLY (nonzero, resume instructions on stderr) instead of
@@ -389,6 +390,7 @@ def test_peer_loss_survivor_aborts_loudly_then_resumes(tmp_path):
     assert got_plains == planted
 
 
+@pytest.mark.slow  # deliberately slow peer: ~25 s wall
 def test_slow_peer_does_not_trip_failure_detector(tmp_path):
     """A STRAGGLER is not a dead peer: with the detection threshold far
     below the straggler's delay, the waiting process must keep waiting
@@ -467,6 +469,7 @@ def test_slow_peer_does_not_trip_failure_detector(tmp_path):
     assert got_plains == planted
 
 
+@pytest.mark.slow  # elastic 3-process pod: ~60 s of subprocess barriers
 def test_pod_hits_local_is_elastic_and_union_complete(tmp_path):
     """--pod-hits local: (a) two healthy hosts each report exactly their
     own stripe's hits and the union equals the single-host hit set;

@@ -54,8 +54,10 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
     appended)."""
     import jax.numpy as jnp
 
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import k_vals_for
+
     lanes = num_blocks * STRIDE
-    k_opts = k_opts_for(plan)
+    k_opts = k_vals_for(plan)
     w = rank = 0
     outs = []
     while True:
@@ -66,9 +68,19 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
         if batch.total == 0:
             break
         batch = pad_batch(batch, num_blocks)
+        # Cascade-closed plans carry their own value table + joint-index
+        # fields (exactly what models.attack wires in production).
+        vb = ct.val_bytes if getattr(plan, "cval_bytes", None) is None \
+            else plan.cval_bytes
+        vl = ct.val_len if getattr(plan, "cval_len", None) is None \
+            else plan.cval_len
+        close_kw = {}
+        if getattr(plan, "close_next", None) is not None:
+            close_kw = dict(close_next=jnp.asarray(plan.close_next),
+                            close_mul=jnp.asarray(plan.close_mul))
         args = tuple(
             jnp.asarray(getattr(plan, f)) for f in plan_fields
-        ) + (jnp.asarray(ct.val_bytes), jnp.asarray(ct.val_len))
+        ) + (jnp.asarray(vb), jnp.asarray(vl))
         blocks = (
             jnp.asarray(batch.word), jnp.asarray(batch.base_digits),
             jnp.asarray(batch.count), jnp.asarray(batch.offset),
@@ -82,11 +94,12 @@ def _sweep_both(spec, plan, ct, plan_fields, xla_fn, fused_fn, *,
         if getattr(plan, "windowed", False):
             # Both paths take the same suffix-count DP table.
             common["win_v"] = jnp.asarray(plan.win_v)
-        cand, clen, _, emit_x = xla_fn(*args, *blocks, **common)
+        cand, clen, _, emit_x = xla_fn(*args, *blocks, **common, **close_kw)
         state_x = HASH_FNS[algo](cand, clen)
         state_p, emit_p = fused_fn(
             *args, blocks[0], blocks[1], blocks[2],
-            k_opts=k_opts, algo=algo, interpret=True, **common, **fused_kw,
+            k_opts=k_opts, algo=algo, interpret=True, **common, **close_kw,
+            **fused_kw,
         )
         outs.append((
             np.asarray(emit_x), np.asarray(emit_p),
@@ -198,7 +211,7 @@ def test_eligible_bounds():
         dict(windowed=True, win_k2=11),
         dict(block_stride=96), dict(num_blocks=12), dict(out_width=184),
         dict(algo="ntlm", out_width=92),
-        dict(max_val_len=5), dict(max_options=9), dict(token_width=65),
+        dict(max_val_len=5), dict(max_options=13), dict(token_width=65),
         dict(num_segments=65),
     ):
         assert not eligible(**{**base, **bad}), bad
@@ -784,7 +797,8 @@ class TestMultiBlock:
     must be the state after ITS OWN padding block, with short and long
     lanes mixed in one launch."""
 
-    def _parity(self, spec, words, *, sub=MB_MAP, algo=None):
+    def _parity(self, spec, words, *, sub=MB_MAP, algo=None,
+                num_blocks=8):
         algo = algo or spec.algo
         ct = compile_table(sub)
         plan = build_plan(spec, ct, pack_words(words))
@@ -796,7 +810,7 @@ class TestMultiBlock:
         assert _hash_blocks_for(plan.out_width, scale) >= 2
         runner = (_run_both_suball if spec.mode.startswith("suball")
                   else _run_both)
-        kw = {}
+        kw = {"num_blocks": num_blocks}
         from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
             scalar_units_for,
         )
@@ -813,12 +827,25 @@ class TestMultiBlock:
             saw = saw or emit_x.any()
         assert saw
 
+    @pytest.mark.slow  # super-linear interpret cost: ~3k 2-block lanes
     def test_md5_mixed_block_counts(self):
         # Mixed 1/2-block lanes in one launch: the per-lane state select
         # must pick each lane's own padding block.
         self._parity(AttackSpec(mode="default", algo="md5"),
                      [b"go", b"assassin-sassafras-aa"])
 
+    def test_md5_mixed_block_counts_sampled(self, monkeypatch):
+        # The default-run sample of the mixed-block contract: same
+        # per-lane padding-block select, interpret-sized space (146
+        # ranks — 'go' lanes stay 1-block, the long word's lanes 2-block;
+        # G=2 keeps the padded interpret lanes at 256, not 1024).
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+
+        monkeypatch.setattr(pe, "_G", 2)
+        self._parity(AttackSpec(mode="default", algo="md5"),
+                     [b"go", b"assassin" + b"-" * 41], num_blocks=2)
+
+    @pytest.mark.slow  # super-linear interpret cost: 3-block x windowed
     def test_md5_three_blocks_windowed(self):
         # 30 matchable positions x 4-byte values reach the 3-block width;
         # the count window keeps the enumerated space tiny (sum of
@@ -835,25 +862,44 @@ class TestMultiBlock:
         assert plan.windowed and _hash_blocks_for(plan.out_width, 1) == 3
         self._parity(spec, [b"a" * 30 + b"x" * 10])
 
+    @pytest.mark.slow  # super-linear interpret cost: 80-round x ~3k lanes
     def test_sha1_two_blocks(self):
         self._parity(AttackSpec(mode="default", algo="sha1"),
                      [b"assassin-sassafras-aa"])
 
+    def test_sha1_two_blocks_sampled(self, monkeypatch):
+        # Default-run sample: SHA-1 through the 2-block tail at 146 ranks.
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+
+        monkeypatch.setattr(pe, "_G", 2)
+        self._parity(AttackSpec(mode="default", algo="sha1"),
+                     [b"assassin" + b"-" * 41], num_blocks=2)
+
+    @pytest.mark.slow  # super-linear interpret cost (see sha1 sample)
     def test_ntlm_two_blocks(self):
         self._parity(AttackSpec(mode="default", algo="ntlm"),
                      [b"go", b"assassin-sass-a"])
 
-    def test_suball_two_blocks(self):
-        self._parity(AttackSpec(mode="suball", algo="md5"),
-                     [b"assassin-sassafras-aa"])
+    def test_suball_two_blocks(self, monkeypatch):
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
 
-    def test_general_kernel_two_blocks(self):
+        monkeypatch.setattr(pe, "_G", 4)
+        self._parity(AttackSpec(mode="suball", algo="md5"),
+                     [b"assassin-sassafras-aa"], num_blocks=4)
+
+    def test_general_kernel_two_blocks(self, monkeypatch):
         # K=2 table: the general (non-scalar) kernel through the shared
-        # multi-block tail.
+        # multi-block tail. The word's unmatched '-' tail pushes out_width
+        # past one hash block (49 bytes + two 'a' matches growing 3 bytes
+        # each = 56 > 55) while the variant space stays interpret-sized
+        # (3^2 * 2^4 = 144 ranks).
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+
+        monkeypatch.setattr(pe, "_G", 2)
         sub = {b"a": [b"\xf0\x9f\x98\x80", b"\xf0\x9f\x98\x82"],
                b"s": [b"5"]}
         self._parity(AttackSpec(mode="default", algo="md5"),
-                     [b"assassin-sassafras-aa"], sub=sub)
+                     [b"assassin" + b"-" * 41], sub=sub, num_blocks=2)
 
 
 @pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
@@ -988,3 +1034,98 @@ def test_grid_height_override_parity(monkeypatch):
         np.testing.assert_array_equal(sp[ep], sp2[ep2])
         saw = saw or ep.any()
     assert saw  # the comparison must not be vacuous
+
+
+class TestCascadeClosure:
+    """Cascade-CLOSED suball plans through the fused kernel: the joint
+    value select (digits of the slot AND its hazard successors) must match
+    the XLA closure path bit-for-bit, and the gates must route closed
+    plans to the general kernel at the widened K."""
+
+    def _parity(self, sub, words, spec=None, expect_windowed=None):
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            scalar_units_for,
+        )
+
+        spec = spec or AttackSpec(mode="suball", algo="md5")
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words(words))
+        assert plan.closed is not None and plan.closed.any()
+        assert scalar_units_for(plan) is False  # joint values: general only
+        if expect_windowed is not None:
+            assert plan.windowed == expect_windowed
+        saw = False
+        for emit_x, emit_p, state_x, state_p in _run_both_suball(
+            spec, plan, ct
+        ):
+            np.testing.assert_array_equal(emit_x, emit_p)
+            np.testing.assert_array_equal(state_x[emit_x], state_p[emit_p])
+            saw = saw or emit_x.any()
+        assert saw
+        return plan
+
+    def test_simple_chain(self):
+        self._parity({b"a": [b"b"], b"b": [b"c"]},
+                     [b"ab", b"a", b"aabb", b"zz"])
+
+    def test_multi_option_joint_tables(self):
+        # 2-option slot with a 2-option successor: joint tables reach 6
+        # rows; mixed closed/clean/fallback words in one launch.
+        self._parity({b"a": [b"b", b"bb"], b"b": [b"c", b"d"]},
+                     [b"ab", b"ba", b"b", b"xx", b"aab"])
+
+    def test_azerty_hazard_words(self):
+        from hashcat_a5_table_generator_tpu.tables.layouts import (
+            BUILTIN_LAYOUTS,
+        )
+
+        sub = BUILTIN_LAYOUTS["qwerty-azerty"].to_substitution_map()
+        plan = self._parity(sub, [b"aqua", b"zw", b"ma,am", b"pass"])
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            k_vals_for,
+            opts_for_config,
+        )
+
+        # The production gate must admit the closed plan at the widened K.
+        spec = AttackSpec(mode="suball", algo="md5")
+        assert opts_for_config(
+            spec, plan, compile_table(sub), block_stride=STRIDE,
+            num_blocks=8, require_tpu=False,
+        ) == k_vals_for(plan) == plan.close_opts
+
+    def test_windowed_closed_plan(self):
+        # Count-windowed decode + joint closure values in one kernel.
+        spec = AttackSpec(mode="suball", algo="md5", min_substitute=1,
+                          max_substitute=1)
+        self._parity({b"a": [b"b"], b"b": [b"c"], b"x": [b"y"],
+                      b"z": [b"q"]},
+                     [b"abxz", b"axzb", b"xz"], spec=spec,
+                     expect_windowed=True)
+
+    def test_scalar_units_path_rejects_closed(self):
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            fused_expand_suball_md5,
+        )
+
+        ct = compile_table({b"a": [b"b"], b"b": [b"c"]})
+        plan = build_plan(AttackSpec(mode="suball", algo="md5"), ct,
+                          pack_words([b"ab"]))
+        import jax.numpy as jnp
+
+        with pytest.raises(ValueError, match="scalar-units"):
+            fused_expand_suball_md5(
+                jnp.asarray(plan.tokens), jnp.asarray(plan.lengths),
+                jnp.asarray(plan.pat_radix),
+                jnp.asarray(plan.pat_val_start),
+                jnp.asarray(plan.seg_orig_start),
+                jnp.asarray(plan.seg_orig_len), jnp.asarray(plan.seg_pat),
+                jnp.asarray(plan.cval_bytes), jnp.asarray(plan.cval_len),
+                jnp.zeros(8, jnp.int32),
+                jnp.zeros((8, plan.num_slots), jnp.int32),
+                jnp.zeros(8, jnp.int32),
+                num_lanes=8 * STRIDE, out_width=plan.out_width,
+                min_substitute=0, max_substitute=15, block_stride=STRIDE,
+                k_opts=2, scalar_units=True, interpret=True,
+                close_next=jnp.asarray(plan.close_next),
+                close_mul=jnp.asarray(plan.close_mul),
+            )

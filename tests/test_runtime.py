@@ -174,11 +174,13 @@ def test_candidates_mode_matches_oracle(mode):
 
 
 def test_candidates_mode_fallback_interleaved_in_word_order():
-    # "ab" + {a=b, b=c} in suball mode is a cascade hazard (a's
-    # replacement IS pattern b) -> oracle fallback; surrounding words run on
-    # device. Word-order must hold globally.
-    sub = {b"a": [b"b"], b"b": [b"c"], b"z": [b"q"]}
-    words = [b"zz", b"ab", b"za"]
+    # "acb" + {a=c, cb=Z} in suball mode is a boundary-CROSSING cascade
+    # hazard (the inserted 'c' extends the original 'b' into a new 'cb'
+    # match) — genuinely pathological, so it stays oracle-routed even with
+    # cascade closure; surrounding words run on device. Word-order must
+    # hold globally.
+    sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+    words = [b"zz", b"acb", b"za"]
     spec = AttackSpec(mode="suball", algo="md5")
     sweep = Sweep(spec, sub, words, config=SweepConfig(**SMALL_CFG))
     assert len(sweep.fallback_rows) >= 1, "fixture must exercise fallback"
@@ -229,9 +231,11 @@ def test_fallback_prefetcher_overlaps_and_cleans_up():
     )
     from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep, SweepConfig
 
-    # ReplaceAll cascade hazard: value 'bb' re-contains pattern 'b'.
-    sub = {b"a": [b"bb"], b"b": [b"c"]}
-    words = [b"ab", b"ba", b"zz", b"aab"]
+    # Boundary-crossing ReplaceAll hazard: the value 'c' inserted for 'a'
+    # can join the neighboring original 'b' into a new 'cb' match — not
+    # closable, so these words are genuinely oracle-routed.
+    sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+    words = [b"acb", b"cba", b"zz", b"aacb"]
     spec = AttackSpec(mode="suball", algo="md5")
     sweep = Sweep(spec, sub, words, config=SweepConfig(lanes=64, num_blocks=16))
     assert sweep.fallback_rows  # hazard words exist
@@ -263,10 +267,11 @@ def test_fallback_prefetcher_overlaps_and_cleans_up():
 
 
 def test_crack_mode_fallback_hits():
-    sub = {b"a": [b"b"], b"b": [b"c"], b"z": [b"q"]}
-    words = [b"zz", b"ab", b"za"]
+    # Boundary-crossing hazard: 'acb' stays oracle-routed (not closable).
+    sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+    words = [b"zz", b"acb", b"za"]
     spec = AttackSpec(mode="suball", algo="md5")
-    fb_cand = oracle_lines(spec, sub, [b"ab"])[-1]
+    fb_cand = oracle_lines(spec, sub, [b"acb"])[-1]
     dev_cand = oracle_lines(spec, sub, [b"zz"])[-1]
     digests = [hashlib.md5(fb_cand).digest(), hashlib.md5(dev_cand).digest()]
     sweep = Sweep(spec, sub, words, digests, config=SweepConfig(**SMALL_CFG))
@@ -431,12 +436,13 @@ class TestMultiDeviceSweep:
         assert n8 == n1 == len(oracle)
 
     def test_crack_with_fallback_words_equal_single_device(self):
-        # Cascade-hazard words route through the oracle on BOTH paths and
-        # must interleave identically with the sharded device stream.
-        sub = {b"a": [b"b"], b"b": [b"c"], b"z": [b"q"]}
-        words = [b"zz", b"ab", b"za", b"zab", b"azz"]
+        # Genuinely pathological (boundary-crossing) hazard words route
+        # through the oracle on BOTH paths and must interleave identically
+        # with the sharded device stream.
+        sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+        words = [b"zz", b"acb", b"za", b"zacb", b"azz"]
         spec = AttackSpec(mode="suball", algo="md5")
-        fb_cand = oracle_lines(spec, sub, [b"ab"])[-1]
+        fb_cand = oracle_lines(spec, sub, [b"acb"])[-1]
         dev_cand = oracle_lines(spec, sub, [b"azz"])[-1]
         digests = [hashlib.md5(fb_cand).digest(),
                    hashlib.md5(dev_cand).digest()]
