@@ -144,8 +144,10 @@ def synth_wordlist(n: int, seed: int = 0):
 
 def build_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--lanes", type=int, default=1 << 22,
-                    help="variant lanes per launch")
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="variant lanes per launch (default 2^22; "
+                         "--superstep-ab defaults to the §4c CPU peak, "
+                         "2048)")
     ap.add_argument("--blocks", type=int, default=None,
                     help="static block count per launch (default: each arm's "
                          "measured best geometry — xla lanes/128; pallas "
@@ -183,7 +185,183 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write a jax.profiler trace of the timed window here")
     ap.add_argument("--worker", action="store_true",
                     help="run the measurement in this process (internal)")
+    ap.add_argument("--superstep-ab", action="store_true",
+                    help="measure the superstep executor against the "
+                         "per-launch pipeline instead of the kernel arms: "
+                         "records hashes/s, launches-per-fetch, and per-"
+                         "step HOST overhead (block cut + dispatch) for "
+                         "both loops as one JSON line (PERF.md §15). "
+                         "Defaults to the measured CPU peak geometry "
+                         "(2048 lanes x 32 blocks, §4c) unless --lanes/"
+                         "--blocks override")
     return ap
+
+
+# ------------------------------------------------------- superstep A/B --
+
+
+def run_superstep_ab(args: argparse.Namespace) -> None:
+    """A/B the device-resident superstep executor against the per-launch
+    pipeline (PERF.md §15): both arms hash the SAME block stream through
+    the same fused body; the per-launch arm pays a host block cut + a
+    dispatch per step, the superstep arm one dispatch per ``fetch_chunk``
+    steps and zero host cutting.  Prints ONE JSON line with per-arm
+    hashes/s and host-overhead seconds per step."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        AttackSpec,
+        block_arrays,
+        build_plan,
+        digest_arrays,
+        make_fused_body,
+        make_superstep_step,
+        plan_arrays,
+        superstep_arrays,
+        table_arrays,
+    )
+    from hashcat_a5_table_generator_tpu.ops.blocks import (
+        make_blocks,
+        superstep_index,
+    )
+    from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+    from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import k_opts_for
+    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    dev = jax.devices()[0]
+    # Default: the §4c CPU-peak geometry, where the per-launch pipeline is
+    # dispatch-bound — exactly the regime the superstep targets (an
+    # explicit --lanes/--blocks is honored; main() resolves the None).
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    steps = 16
+    if lanes % nb:
+        raise SystemExit("--superstep-ab needs blocks dividing lanes")
+    stride = lanes // nb
+
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout(args.table).to_substitution_map()
+    ct = compile_table(sub_map)
+    plan = build_plan(spec, ct, pack_words(synth_wordlist(args.words)))
+    host_digest = HOST_DIGEST[spec.algo]
+    ds = build_digest_set(
+        [host_digest(b"bench-decoy-%d" % i) for i in range(1024)], spec.algo
+    )
+    idx = superstep_index(plan, stride)
+    if idx is None:
+        raise SystemExit("--superstep-ab: plan is not superstep-eligible")
+    _cum, _totals, total_blocks = idx
+    radix2 = k_opts_for(plan) == 1
+    windowed = bool(getattr(plan, "windowed", False))
+
+    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    ss = superstep_arrays(plan, stride)
+    # The per-launch arm runs the PRODUCTION crack-step contract —
+    # hit_bits + both counts, with the counts chained into a device
+    # accumulator exactly like Sweep.run_crack's chunked loop.  An
+    # emitted-count-only accumulator (the kernel bench's shape) lets XLA
+    # dead-code-eliminate the membership stage, which the superstep arm
+    # necessarily keeps alive — the arms must pay the same device work.
+    body = make_fused_body(spec, num_lanes=lanes, out_width=plan.out_width,
+                           block_stride=stride, radix2=radix2)
+    step = jax.jit(lambda p_, t_, b_, d_: body(p_, t_, d_, b_))
+    accum = jax.jit(lambda acc, ne, nh: acc + jnp.stack([ne, nh]))
+    sstep = make_superstep_step(
+        spec, num_lanes=lanes, num_blocks=nb, out_width=plan.out_width,
+        block_stride=stride, steps=steps, hit_cap=256,
+        total_blocks=total_blocks, windowed=windowed, radix2=radix2,
+    )
+    acc_zero = jnp.zeros((2,), jnp.int32)
+    n_super = max(1, total_blocks // (steps * nb))
+
+    def per_launch_arm() -> dict:
+        """`steps`-launch rounds with the production per-launch recipe:
+        host cut + dispatch per step, one counter fetch per round."""
+        hashed, launches, cut_s, disp_s = 0, 0, 0.0, 0.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < args.seconds:
+            w, rank = 0, 0
+            acc = acc_zero
+            for _ in range(steps):
+                tc = time.perf_counter()
+                batch, w, rank = make_blocks(
+                    plan, start_word=w, start_rank=rank,
+                    max_variants=lanes, max_blocks=nb, fixed_stride=stride,
+                )
+                blocks = block_arrays(batch, num_blocks=nb)
+                td = time.perf_counter()
+                out = step(p, t, blocks, d)
+                acc = accum(acc, out["n_emitted"], out["n_hits"])
+                te = time.perf_counter()
+                cut_s += td - tc
+                disp_s += te - td
+                launches += 1
+            hashed += int(acc[0])  # completion barrier per round
+        wall = time.perf_counter() - t0
+        return {
+            "hashes_per_sec": hashed / wall,
+            "launches": launches,
+            "launches_per_fetch": steps,
+            "cut_s_per_step": cut_s / max(launches, 1),
+            "dispatch_s_per_step": disp_s / max(launches, 1),
+            "host_s_per_step": (cut_s + disp_s) / max(launches, 1),
+        }
+
+    def superstep_arm() -> dict:
+        hashed, launches, disp_s = 0, 0, 0.0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < args.seconds:
+            b0 = (launches // steps) % n_super * (steps * nb)
+            td = time.perf_counter()
+            out = sstep(p, t, d, ss, np.int32(b0))
+            disp_s += time.perf_counter() - td
+            hashed += int(out["n_emitted"])  # completion barrier
+            launches += steps
+        wall = time.perf_counter() - t0
+        return {
+            "hashes_per_sec": hashed / wall,
+            "launches": launches,
+            "launches_per_fetch": steps,
+            "cut_s_per_step": 0.0,
+            "dispatch_s_per_step": disp_s / max(launches, 1),
+            "host_s_per_step": disp_s / max(launches, 1),
+        }
+
+    # Warm both compiled programs before timing.
+    batch0, _, _ = make_blocks(plan, start_word=0, start_rank=0,
+                               max_variants=lanes, max_blocks=nb,
+                               fixed_stride=stride)
+    int(step(p, t, block_arrays(batch0, num_blocks=nb), d)["n_emitted"])
+    int(accum(acc_zero, jnp.int32(0), jnp.int32(0))[0])
+    int(sstep(p, t, d, ss, np.int32(0))["n_emitted"])
+
+    per_launch = per_launch_arm()
+    superstep = superstep_arm()
+    record = {
+        "metric": "superstep_host_overhead_ab",
+        "unit": "seconds/step (host) + hashes/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "per_launch": per_launch,
+        "superstep": superstep,
+        "host_overhead_ratio": (
+            per_launch["host_s_per_step"]
+            / max(superstep["host_s_per_step"], 1e-12)
+        ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
 
 
 # ----------------------------------------------------------------- worker --
@@ -838,7 +1016,16 @@ def run_orchestrator(args: argparse.Namespace) -> None:
 
 def main() -> None:
     args = build_parser().parse_args()
-    if args.worker or args.platform:
+    if args.lanes is None:
+        # Unset vs explicit matters: --superstep-ab targets the small §4c
+        # peak, the kernel bench the big accelerator launch; an explicit
+        # --lanes is honored by both.
+        args.lanes = 2048 if args.superstep_ab else (1 << 22)
+    if args.superstep_ab:
+        # Focused loop-level A/B (PERF.md §15); runs on the pinned (or
+        # default) platform in-process, no orchestrator.
+        run_superstep_ab(args)
+    elif args.worker or args.platform:
         # --worker: orchestrator subprocess.  --platform: the user pinned a
         # backend — run in-process at the requested geometry with no kill
         # deadline (the init-timeout abort still guards a wedged init).

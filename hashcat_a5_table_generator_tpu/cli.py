@@ -140,6 +140,19 @@ def build_parser() -> argparse.ArgumentParser:
                          "full round trip over remote-device links; chunks "
                          "grow adaptively 1..N; default: the sweep "
                          "runtime's tuned value — PERF.md §4b)")
+    ap.add_argument("--superstep", type=_superstep_arg, default=None,
+                    metavar="N|auto|off",
+                    help="crack mode: fuse N launches into one device "
+                         "dispatch via the device-resident superstep "
+                         "executor — block cutting runs ON DEVICE from "
+                         "per-sweep index arrays and the host fetches "
+                         "counters + hits once per superstep (PERF.md "
+                         "§15). 'auto' (default) engages when the plan "
+                         "and geometry qualify, with --fetch-chunk steps "
+                         "per superstep; 'off' keeps the per-launch "
+                         "pipeline (A5GEN_SUPERSTEP=off is the env "
+                         "equivalent). The candidate/hit streams are "
+                         "identical either way")
     ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
                     default="auto",
                     help="variant-block layout: 'packed' = tightly-packed "
@@ -226,6 +239,24 @@ def _buckets_arg(value: str):
             f"got {value!r}"
         )
     return widths
+
+
+def _superstep_arg(value: str):
+    """--superstep: 'auto' (None — engage when eligible), 'off' (0), or
+    a positive steps-per-superstep count."""
+    if value == "auto":
+        return None
+    if value == "off":
+        return 0
+    try:
+        n = int(value)
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"must be a positive integer, 'auto', or 'off', got {value!r}"
+        )
+    return n
 
 
 def _positive_int(value: str):
@@ -571,6 +602,21 @@ def _print_routing(res) -> None:
     )
 
 
+def _print_superstep(res) -> None:
+    """Superstep-executor summary (stderr): supersteps run, launches per
+    fetch, overflow replays — the per-launch-overhead instrument behind
+    PERF.md §15.  Silent when the per-launch pipeline ran."""
+    s = getattr(res, "superstep", None) or {}
+    if not s.get("supersteps"):
+        return
+    print(
+        f"{PROG}: superstep: {s['supersteps']} supersteps x "
+        f"{s.get('launches_per_fetch', 0)} launches/fetch "
+        f"({s.get('replays', 0)} overflow replays)",
+        file=sys.stderr,
+    )
+
+
 def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
                       label: str, retry_notice: str = ""):
     """Elastic recovery (SURVEY.md §5): candidate generation is pure and
@@ -711,6 +757,7 @@ def _run_device(args, sub_map, packed) -> int:
         lanes=args.lanes,
         num_blocks=args.blocks,
         devices=args.devices,
+        superstep=args.superstep,
         **cfg_kw,
         packed_blocks={"auto": None, "packed": True, "stride": False}[
             args.block_layout
@@ -781,6 +828,7 @@ def _run_device(args, sub_map, packed) -> int:
                     file=sys.stderr,
                 )
             _print_routing(res)
+            _print_superstep(res)
             _maybe_exit_pod_local(args, nprocs)
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:

@@ -237,33 +237,22 @@ def scalar_units_arrays(plan: Plan, ct: CompiledTable) -> Dict[str, jnp.ndarray]
     return {f"su_{k}": jnp.asarray(v) for k, v in fields.items()}
 
 
-def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
-                    block_stride: int | None = None,
-                    fused_expand_opts: int | None = None,
-                    fused_scalar_units: bool = False,
-                    radix2: bool = False) -> Callable[..., ArrayTree]:
-    """The un-jitted fused expand->hash->match body, shared by the
-    single-device step and the shard_map'd step (which psums the counts).
+def make_fused_lane_body(
+    spec: AttackSpec, *, num_lanes: int, out_width: int,
+    block_stride: int | None = None,
+    fused_expand_opts: int | None = None,
+    fused_scalar_units: bool = False,
+    radix2: bool = False,
+) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]:
+    """The lane-level fused expand->hash->match core.
 
-    ``body(plan, table, digests, blocks) -> dict`` with the packed per-lane
-    hit mask ``hit_bits`` (``uint32[ceil(lanes/32)]``, see
-    :func:`pack_bits`) and *local* scalar counts ``n_emitted``/``n_hits``.
-    Hit word/rank cursors are host-derived from lane indices
-    (:func:`lane_cursor`), so lanes are the only per-hit payload.
+    ``lane_body(plan, table, digests, blocks) -> (hit bool[N], emit
+    bool[N])`` — shared by :func:`make_fused_body` (which packs the hit
+    mask into the per-launch fetch contract) and the superstep executor
+    (:func:`make_superstep_step`, which consumes raw lane masks on device
+    and never ships them to the host).  Knob semantics are
+    :func:`make_fused_body`'s.
 
-    ``block_stride``: static lanes-per-block for fixed-stride batches
-    (``make_blocks(fixed_stride=...)``) — the TPU fast path; ``None`` keeps
-    the variable-offset layout.
-
-    ``fused_expand_opts``: static per-key option count K enabling the fused
-    Pallas decode+splice+MD5 kernel (``ops.pallas_expand``) in place of the
-    XLA expand+hash pair. Callers gate via ``pallas_expand.opts_for`` —
-    eligibility is a plan/table property this builder cannot see.
-
-    ``fused_scalar_units``: selects the fused kernel's K=1 scalar-units
-    fast path (PERF.md §11). Callers gate via
-    ``pallas_expand.scalar_units_for`` — the unique-start property lives
-    on the host plan.
     """
     from ..ops.pallas_md5 import maybe_pallas_hash_fn
 
@@ -323,13 +312,56 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
         del word_row  # hit cursors are host-derived from lane indices
         return hash_fn(cand, cand_len), emit
 
+    def lane_body(
+        plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
+        blocks: ArrayTree,
+    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        state, emit = expand_and_hash(plan, table, blocks)
+        member = digest_member(state, digests["rows"], digests["bitmap"])
+        return member & emit, emit
+
+    return lane_body
+
+
+def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
+                    block_stride: int | None = None,
+                    fused_expand_opts: int | None = None,
+                    fused_scalar_units: bool = False,
+                    radix2: bool = False) -> Callable[..., ArrayTree]:
+    """The un-jitted fused expand->hash->match body, shared by the
+    single-device step and the shard_map'd step (which psums the counts).
+
+    ``body(plan, table, digests, blocks) -> dict`` with the packed per-lane
+    hit mask ``hit_bits`` (``uint32[ceil(lanes/32)]``, see
+    :func:`pack_bits`) and *local* scalar counts ``n_emitted``/``n_hits``.
+    Hit word/rank cursors are host-derived from lane indices
+    (:func:`lane_cursor`), so lanes are the only per-hit payload.
+
+    ``block_stride``: static lanes-per-block for fixed-stride batches
+    (``make_blocks(fixed_stride=...)``) — the TPU fast path; ``None`` keeps
+    the variable-offset layout.
+
+    ``fused_expand_opts``: static per-key option count K enabling the fused
+    Pallas decode+splice+MD5 kernel (``ops.pallas_expand``) in place of the
+    XLA expand+hash pair. Callers gate via ``pallas_expand.opts_for`` —
+    eligibility is a plan/table property this builder cannot see.
+
+    ``fused_scalar_units``: selects the fused kernel's K=1 scalar-units
+    fast path (PERF.md §11). Callers gate via
+    ``pallas_expand.scalar_units_for`` — the unique-start property lives
+    on the host plan.
+    """
+    lane_body = make_fused_lane_body(
+        spec, num_lanes=num_lanes, out_width=out_width,
+        block_stride=block_stride, fused_expand_opts=fused_expand_opts,
+        fused_scalar_units=fused_scalar_units, radix2=radix2,
+    )
+
     def body(
         plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
         blocks: ArrayTree,
     ) -> ArrayTree:
-        state, emit = expand_and_hash(plan, table, blocks)
-        member = digest_member(state, digests["rows"], digests["bitmap"])
-        hit = member & emit
+        hit, emit = lane_body(plan, table, digests, blocks)
         return {
             "hit_bits": pack_bits(hit),
             "n_emitted": jnp.sum(emit.astype(jnp.int32)),
@@ -337,6 +369,188 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
         }
 
     return body
+
+
+def superstep_arrays(plan: Plan, stride: int) -> "ArrayTree | None":
+    """Device copies of the fixed-stride block index for the superstep
+    executor's ON-DEVICE block cutter (``ops.blocks.superstep_index``
+    narrowed to int32), shipped ONCE per sweep like ``plan_arrays``:
+
+    * ``cum`` int32 [B+1] — cumulative block index (fallback and finished
+      words occupy zero width, exactly as the host fast cutter sees them),
+    * ``totals`` int32 [B] — per-word variant totals,
+    * ``radix`` int32 [B, P] — per-slot radices for the device-side
+      mixed-radix base decompose (unused by windowed plans, whose block
+      bases are scalar ranks).
+
+    Returns None when the plan cannot be cut in int32 on device (huge
+    words / cursor overflow) — callers then keep the per-launch path.
+    """
+    from ..ops.blocks import superstep_index
+
+    idx = superstep_index(plan, stride)
+    if idx is None:
+        return None
+    cum, totals, _total_blocks = idx
+    return {
+        "cum": jnp.asarray(cum),
+        "totals": jnp.asarray(totals),
+        "radix": jnp.asarray(np.asarray(plan.pat_radix, dtype=np.int32)),
+    }
+
+
+def make_superstep_body(
+    spec: AttackSpec, *, num_lanes: int, out_width: int, block_stride: int,
+    num_blocks: int, steps: int, hit_cap: int, total_blocks: int,
+    windowed: bool = False, step_advance: "int | None" = None,
+    fused_expand_opts: int | None = None, fused_scalar_units: bool = False,
+    radix2: bool = False,
+) -> Callable[..., ArrayTree]:
+    """The un-jitted superstep executor: ``steps`` fused
+    expand->hash->membership launches in ONE device program, with the
+    block cutting done on device (PERF.md §15).
+
+    ``body(plan, table, digests, ss, b0) -> dict`` where ``ss`` is
+    :func:`superstep_arrays`' tree and ``b0`` an int32 scalar — the global
+    fixed-stride block index the superstep starts at.  A ``lax.scan``
+    carries the block cursor: each step cuts its ``num_blocks`` blocks
+    from ``ss`` (searchsorted over the cumulative index + mixed-radix
+    decompose — the device twin of ``ops.blocks``' vectorized host
+    cutter), runs the fused lane body, and accumulates
+
+    * ``n_emitted`` / ``n_hits`` — int32 scalars over the whole superstep
+      (callers bound ``steps * num_lanes`` below 2^31);
+    * ``hit_word`` / ``hit_rank`` int32 [hit_cap] — a capped hit buffer in
+      cursor order.  Hits are RARE, so the scatter that lands them runs
+      under a ``lax.cond`` only on steps whose hit count is nonzero;
+      entries past ``hit_cap`` are dropped on device and the host detects
+      the overflow from ``n_hits`` (``dev_hits``) and replays the
+      superstep through the per-launch path — never a dropped hit.
+    * ``dev_hits`` int32 [1] — this device's own hit count (the overflow
+      test under ``shard_map``, where ``n_hits`` is the global psum).
+
+    ``step_advance``: global blocks consumed per scan step —
+    ``num_blocks`` on one device, ``num_blocks * n_devices`` under the
+    sharded executor (every device advances past the whole launch).
+    ``total_blocks`` (static): blocks in the sweep; the tail superstep's
+    out-of-range blocks cut zero-count (fully masked) blocks, so no tail
+    special-casing exists anywhere.
+    """
+    lane_body = make_fused_lane_body(
+        spec, num_lanes=num_lanes, out_width=out_width,
+        block_stride=block_stride, fused_expand_opts=fused_expand_opts,
+        fused_scalar_units=fused_scalar_units, radix2=radix2,
+    )
+    stride = block_stride
+    advance = int(step_advance or num_blocks)
+
+    def cut_blocks(ss: ArrayTree, b0: jnp.ndarray):
+        """One launch's blocks from the device-resident index: the exact
+        arithmetic of ``ops.blocks._make_blocks_stride_fast`` in int32."""
+        b = b0 + jnp.arange(num_blocks, dtype=jnp.int32)
+        cum, totals = ss["cum"], ss["totals"]
+        nwords = totals.shape[0]
+        w = jnp.clip(
+            jnp.searchsorted(cum, b, side="right").astype(jnp.int32) - 1,
+            0, max(nwords - 1, 0),
+        )
+        # Blocks past the sweep's end keep count 0 (their lanes fail the
+        # rank < count test, like pad_batch's padding); the where also
+        # discards the wrapped int32 products out-of-range blocks compute.
+        valid = b < jnp.int32(total_blocks)
+        rank0 = jnp.where(valid, (b - cum[w]) * jnp.int32(stride), 0)
+        count = jnp.where(
+            valid, jnp.clip(totals[w] - rank0, 0, stride), 0
+        )
+        p = ss["radix"].shape[1]
+        if windowed:
+            # Windowed plans cursor by scalar rank in slot 0 (the device
+            # unranks through win_v), mirroring make_blocks.
+            base = jnp.zeros((num_blocks, p), jnp.int32)
+            base = base.at[:, 0].set(rank0)
+        else:
+            rad = ss["radix"][w]  # [NB, P]
+            digs = []
+            t = rank0
+            for s in range(p):
+                r = rad[:, s]
+                digs.append(t % r)
+                t = t // r
+            base = jnp.stack(digs, axis=1)
+        blocks = {
+            "word": w,
+            "base": base,
+            "count": count,
+            "offset": jnp.arange(num_blocks, dtype=jnp.int32)
+            * jnp.int32(stride),
+        }
+        return blocks, rank0
+
+    def body(
+        plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
+        ss: ArrayTree, b0: jnp.ndarray,
+    ) -> ArrayTree:
+        lane = jnp.arange(num_lanes, dtype=jnp.int32)
+        blk = lane // jnp.int32(stride)
+        lane_in = lane - blk * jnp.int32(stride)
+
+        def one(carry, _):
+            b0c, ne, nh, hw, hr = carry
+            blocks, rank0 = cut_blocks(ss, b0c)
+            hit, emit = lane_body(plan, table, digests, blocks)
+            nh_step = jnp.sum(hit.astype(jnp.int32))
+
+            def record(bufs):
+                hw0, hr0 = bufs
+                # Compacting scatter: hit lanes land at consecutive
+                # buffer slots in lane (= cursor) order; non-hit lanes
+                # and overflow all target the trash slot [hit_cap].
+                pos = nh + jnp.cumsum(hit.astype(jnp.int32)) - 1
+                idx = jnp.where(
+                    hit, jnp.minimum(pos, hit_cap), hit_cap
+                )
+                w_lane = blocks["word"][blk]
+                r_lane = rank0[blk] + lane_in
+                return hw0.at[idx].set(w_lane), hr0.at[idx].set(r_lane)
+
+            hw, hr = jax.lax.cond(
+                nh_step > 0, record, lambda bufs: bufs, (hw, hr)
+            )
+            carry = (
+                b0c + jnp.int32(advance),
+                ne + jnp.sum(emit.astype(jnp.int32)),
+                nh + nh_step,
+                hw,
+                hr,
+            )
+            return carry, None
+
+        zero = jnp.zeros((), jnp.int32)
+        init = (
+            jnp.asarray(b0, jnp.int32), zero, zero,
+            jnp.full((hit_cap + 1,), -1, jnp.int32),
+            jnp.zeros((hit_cap + 1,), jnp.int32),
+        )
+        (_, ne, nh, hw, hr), _ = jax.lax.scan(
+            one, init, None, length=steps
+        )
+        return {
+            "n_emitted": ne,
+            "n_hits": nh,
+            "dev_hits": nh[None],
+            "hit_word": hw[:hit_cap],
+            "hit_rank": hr[:hit_cap],
+        }
+
+    return body
+
+
+def make_superstep_step(spec: AttackSpec, **kwargs: Any
+                        ) -> Callable[..., ArrayTree]:
+    """Jitted :func:`make_superstep_body` (single device).  ``step(plan,
+    table, digests, ss, b0) -> dict``; pass ``b0`` as an int32 scalar
+    array so consecutive supersteps reuse one compiled program."""
+    return jax.jit(make_superstep_body(spec, **kwargs))
 
 
 def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,

@@ -274,6 +274,49 @@ def make_blocks(
     return batch, w, rank
 
 
+def superstep_index(plan, stride: int):
+    """int32 view of the fixed-stride block index for the DEVICE-side
+    cutter (``models.attack.make_superstep_step``): the superstep executor
+    cuts each launch's blocks on device from these per-sweep arrays, so
+    the host stops paying a per-launch cutting pass (PERF.md §15).
+
+    Returns ``(cum int32[B+1], totals int32[B], total_blocks int)`` or
+    ``None`` when the plan cannot be cut in pure int32 on device:
+
+    * any huge word (``>= _HUGE_WORD``; the host scalar cutter owns those),
+    * any per-word variant total at/above ``MAX_BLOCK`` (device ranks and
+      hit cursors are int32),
+    * a cumulative block index that overflows int32.
+
+    The arrays are exactly ``_stride_index``'s (same cache), narrowed —
+    so the device cutter and the host fast cutter can never disagree.
+    """
+    entry = _stride_index(plan, stride)
+    if entry is None:
+        return None
+    cum, totals, huge = entry
+    if huge.any():
+        return None
+    if len(totals) and int(totals.max()) >= MAX_BLOCK:
+        return None
+    total_blocks = int(cum[-1])
+    if total_blocks >= (1 << 31):
+        return None
+    return cum.astype(np.int32), totals.astype(np.int32), total_blocks
+
+
+def block_cursor(plan, stride: int, cum: np.ndarray, b: int
+                 ) -> Tuple[int, int]:
+    """Host (word, rank) cursor of global fixed-stride block index ``b``
+    — the same convention ``_make_blocks_stride_fast`` returns as its
+    next cursor, so superstep boundaries and per-launch cursors are
+    interchangeable in checkpoints."""
+    if b >= int(cum[-1]):
+        return plan.batch, 0
+    w = int(np.searchsorted(cum, b, side="right") - 1)
+    return w, int(b - cum[w]) * stride
+
+
 def pad_batch(batch: BlockBatch, num_blocks: int) -> BlockBatch:
     """Pad a batch to exactly ``num_blocks`` blocks with zero-count blocks.
 

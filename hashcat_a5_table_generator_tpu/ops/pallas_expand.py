@@ -803,10 +803,14 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
     # lanes long enough to need the next block.
     nw_data = 16 * hash_blocks - 2
 
-    def place(off, blen, word, j_span):
+    def place(off, blen, word, j_span, term_hi=None):
         """OR ``word``'s low ``blen`` bytes into msg at byte offset
-        ``off`` (all (G, S) tiles; blen in 0..4).  ``j_span``: static cap
-        on the highest word index the piece can reach."""
+        ``off`` (all (G, S) tiles; blen in 0..4 — 5 for the final unit's
+        terminator-folded piece).  ``j_span``: static cap on the highest
+        word index the piece can reach.  ``term_hi``: lanes whose folded
+        piece is 5 bytes (a full 4-byte unit + the appended terminator) —
+        the 5th byte cannot live in ``word``'s u32, so it rides the hi
+        word at the piece's own sub-word offset, for ANY ``sh``."""
         sh8 = (blen * 8) & 31
         mask = (_U32(1) << sh8.astype(_U32)) - _U32(1)
         mask = jnp.where(blen >= 4, _U32(0xFFFFFFFF), mask)
@@ -815,6 +819,8 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
         lo = wm << sh
         # Shift-by-32 is undefined: mask the amount and select instead.
         hi = jnp.where(sh > 0, wm >> ((_U32(32) - sh) & _U32(31)), _U32(0))
+        if term_hi is not None:
+            hi = hi | jnp.where(term_hi, _U32(0x80) << sh, _U32(0))
         widx = off >> 2
         sel_prev = None
         for w_i in range(min(nw_data, j_span + 1)):
@@ -830,13 +836,34 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
             msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
 
     mul = max(1, int(max_unit_len))
+    # Terminator fold (PERF.md §7a ranked lever 3): ``cum`` is monotone
+    # and trailing units are zero-length, so the FINAL unit's piece ends
+    # at ``out_len`` for EVERY lane — appending the 0x80 terminator to
+    # that one piece replaces the whole per-word terminator scan below.
+    # utf16 keeps the scan: its expanded terminator (byte ``2*out_len``)
+    # can land past both split pieces' 4-byte windows.
+    fold_term = not utf16 and len(unit_start) > 0
     for j in range(len(unit_start)):
         us, ul, uw = unit_start[j], unit_len[j], unit_word[j]
         # Highest word index unit j's LO part can reach: its start offset
         # is at most mul*j (hi spills one word further inside place()).
         span = (scale * mul * j) // 4
         if not utf16:
-            place(us, ul, uw, span)
+            if fold_term and j == len(unit_start) - 1:
+                # Clear the piece's bytes at/above ``ul`` (ungrouped token
+                # units carry garbage there), plant 0x80 at byte ``ul``;
+                # a full 4-byte piece's terminator rides the hi word.
+                sh_t = _U32(8) * (ul & 3).astype(_U32)
+                ge4 = ul >= 4
+                keep = jnp.where(
+                    ge4, _U32(0xFFFFFFFF), (_U32(1) << sh_t) - _U32(1)
+                )
+                uw = (uw & keep) | jnp.where(
+                    ge4, _U32(0), _U32(0x80) << sh_t
+                )
+                place(us, ul + 1, uw, span, term_hi=ge4)
+            else:
+                place(us, ul, uw, span)
         else:
             # Bytes b0..b3 -> code units (b0|b1<<16) at 2*us and
             # (b2|b3<<16) at 2*us+4.
@@ -850,15 +877,16 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
             place(off, blen_lo, lo16, span)
             place(off + 4, blen_hi, hi16, span + 1)
     end = out_len * scale
-    mark = _U32(0x80) << (_U32(8) * (end & 3).astype(_U32))
-    widx = end >> 2
-    # Emitted candidates end at <= out_width bytes, so the terminator can
-    # only land in the first (out_width*scale)//4 + 1 words; overlong
-    # lanes are masked garbage either way.
-    n_term = (nw_data if out_width is None
-              else min(nw_data, (int(out_width) * scale) // 4 + 1))
-    for w_i in range(n_term):
-        msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
+    if not fold_term:
+        mark = _U32(0x80) << (_U32(8) * (end & 3).astype(_U32))
+        widx = end >> 2
+        # Emitted candidates end at <= out_width bytes, so the terminator
+        # can only land in the first (out_width*scale)//4 + 1 words;
+        # overlong lanes are masked garbage either way.
+        n_term = (nw_data if out_width is None
+                  else min(nw_data, (int(out_width) * scale) // 4 + 1))
+        for w_i in range(n_term):
+            msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
     bits = (end * 8).astype(_U32)
     if big_endian_length:
         # SHA-1: the 64-bit BE bit length occupies the padding block's

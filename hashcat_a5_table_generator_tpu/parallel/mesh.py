@@ -31,7 +31,12 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..models.attack import AttackSpec, make_candidates_body, make_fused_body
+from ..models.attack import (
+    AttackSpec,
+    make_candidates_body,
+    make_fused_body,
+    make_superstep_body,
+)
 from ..ops.blocks import BlockBatch, make_blocks, pad_batch
 
 
@@ -189,6 +194,63 @@ def make_sharded_crack_step(
         # replicated plan/table refs with sharded block refs (JAX's own
         # error message recommends exactly this switch).
         check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def make_sharded_superstep_step(
+    spec: AttackSpec,
+    mesh: Mesh,
+    *,
+    lanes_per_device: int,
+    axis_name: str = "data",
+    num_blocks: int,
+    **kwargs,
+):
+    """The superstep executor, shard_map'd over a 1-D mesh.
+
+    Each device runs the SAME ``lax.scan`` superstep body
+    (``models.attack.make_superstep_body``) over its own block-cursor
+    stripe: device ``d`` of ``D`` starts at ``b0 + d * num_blocks`` and
+    every scan step advances all devices by ``D * num_blocks`` — exactly
+    the contiguous per-launch ranges ``make_device_blocks`` cuts, so the
+    sharded superstep sweeps the identical (word, rank) stream.
+
+    Input pytrees: ``plan``/``table``/``digests``/``ss`` replicated;
+    ``b0`` an int32 [D] of per-device start block indices, sharded.
+    Outputs: ``n_emitted``/``n_hits`` psum'd (replicated scalars);
+    ``dev_hits`` int32 [D] and the per-device hit buffers
+    ``hit_word``/``hit_rank`` int32 [D * hit_cap] sharded on the leading
+    axis (device ``d``'s slots at ``[d * hit_cap, (d+1) * hit_cap)``).
+    The host merges per-device slices and sorts by (word, rank) — cursor
+    order, identical to the single-device stream.
+    """
+    n_devices = int(np.prod(mesh.devices.shape))
+    body = make_superstep_body(
+        spec, num_lanes=lanes_per_device, num_blocks=num_blocks,
+        step_advance=num_blocks * n_devices, **kwargs,
+    )
+
+    def local_step(plan, table, digests, ss, b0):
+        out = body(plan, table, digests, ss, b0[0])
+        out["n_emitted"] = jax.lax.psum(out["n_emitted"], axis_name)
+        out["n_hits"] = jax.lax.psum(out["n_hits"], axis_name)
+        return out
+
+    rep = P()
+    shard = P(axis_name)
+    mapped = _shard_map(
+        local_step,
+        mesh=mesh,
+        in_specs=(rep, rep, rep, rep, shard),
+        out_specs={
+            "n_emitted": rep,
+            "n_hits": rep,
+            "dev_hits": shard,
+            "hit_word": shard,
+            "hit_rank": shard,
+        },
+        check_vma=False,  # see make_sharded_crack_step
     )
     return jax.jit(mapped)
 

@@ -490,6 +490,24 @@ def gather_hits(hits: Sequence) -> List:
     return combined
 
 
+#: SweepResult.superstep keys, reduced in FIXED order: every process must
+#: run the identical collective sequence even when its own stripe ran the
+#: per-launch path (empty stats) — key-set-dependent gathers would wedge
+#: the pod.
+_SUPERSTEP_KEYS = ("supersteps", "launches", "replays")
+
+
+def _reduce_superstep(stats: Dict[str, int]) -> Dict[str, int]:
+    """Pod-wide superstep stats: counters sum, the launches-per-fetch
+    ratio maxes (hosts share one config; stripes differ only via the
+    int32 step cap).  Returns {} when no stripe ran the executor."""
+    out = {k: allgather_sum(int(stats.get(k, 0))) for k in _SUPERSTEP_KEYS}
+    out["launches_per_fetch"] = int(
+        allgather_max(float(stats.get("launches_per_fetch", 0)))
+    )
+    return out if any(out.values()) else {}
+
+
 def _host_config(config, process_id: int):
     """Per-host copy of a SweepConfig: checkpoint paths get a process
     suffix (each host checkpoints its own stripe cursor independently)."""
@@ -591,6 +609,7 @@ def run_crack_multihost(
         wall_s=allgather_max(res.wall_s),
         routing={k: allgather_sum(int(v)) for k, v in
                  sorted(res.routing.items())},
+        superstep=_reduce_superstep(res.superstep),
     )
 
 

@@ -156,9 +156,18 @@ class BucketedSweep:
         hits = [h for r in results for h in r.hits]
         hits.sort(key=lambda h: (h.word_index, h.variant_rank))
         routing: Dict[str, int] = {}
+        superstep: Dict[str, int] = {}
         for r in results:
             for k, v in r.routing.items():
                 routing[k] = routing.get(k, 0) + int(v)
+            # Superstep stats accumulate across buckets; the per-sweep
+            # launches_per_fetch ratio is reported as the max (buckets
+            # share one config, so they only differ via the int32 cap).
+            for k, v in getattr(r, "superstep", {}).items():
+                if k == "launches_per_fetch":
+                    superstep[k] = max(superstep.get(k, 0), int(v))
+                else:
+                    superstep[k] = superstep.get(k, 0) + int(v)
         return SweepResult(
             n_emitted=sum(r.n_emitted for r in results),
             n_hits=sum(r.n_hits for r in results),
@@ -167,6 +176,7 @@ class BucketedSweep:
             resumed=any(r.resumed for r in results),
             wall_s=time.monotonic() - t0,
             routing=routing,
+            superstep=superstep,
         )
 
     def run_crack(self, recorder=None, *, resume: bool = True) -> SweepResult:

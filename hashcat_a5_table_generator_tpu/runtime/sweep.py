@@ -49,13 +49,15 @@ from ..models.attack import (
     lane_cursor,
     make_candidates_step,
     make_crack_step,
+    make_superstep_step,
     plan_arrays,
     scalar_units_arrays,
+    superstep_arrays,
     table_arrays,
     unpack_bits,
 )
 from ..oracle.engines import iter_candidates
-from ..ops.blocks import make_blocks
+from ..ops.blocks import block_cursor, make_blocks, superstep_index
 from ..ops.membership import HostDigestLookup, build_digest_set
 from ..ops.packing import PackedWords, pack_words
 from ..tables.compile import compile_table
@@ -97,6 +99,21 @@ class SweepConfig:
     #   sweeps and fast backends keep per-launch checkpoint granularity.
     devices: Optional[int] = 1  # 1 = single-device; N = shard over first N
     #                             local devices; None = all local devices
+    superstep: "Optional[int]" = None  # crack mode: launches fused into ONE
+    #   device dispatch via the device-resident superstep executor (a
+    #   lax.scan cuts each step's blocks ON DEVICE from per-sweep index
+    #   arrays — no per-launch host cutting, dispatch, or block-field
+    #   transfer; PERF.md §15). None = auto: engage when the plan/geometry
+    #   qualify (fixed-stride layout, int32-safe block index), with
+    #   fetch_chunk steps per superstep. 0 = off (the per-launch pipeline).
+    #   N >= 1 pins the steps-per-superstep (capped so a superstep's int32
+    #   emitted-count accumulator cannot overflow). The streams are
+    #   identical either way; A5GEN_SUPERSTEP=off is the env escape hatch.
+    superstep_hit_cap: int = 4096  # capped device (word, rank) hit buffer
+    #   carried through the superstep scan, PER DEVICE. A superstep whose
+    #   device-local hits exceed the cap is replayed exactly through the
+    #   per-launch path (hits are rare; replay is the graceful-degradation
+    #   guarantee — never a dropped hit).
     packed_blocks: Optional[bool] = None  # True = variable-offset (tightly
     #   packed) block layout; False = fixed-stride blocks (stride = lanes //
     #   num_blocks) — the kernels map lane -> block arithmetically instead
@@ -146,6 +163,10 @@ class SweepResult:
     wall_s: float = 0.0
     #: word routing counts: device_clean / device_closed / oracle_fallback
     routing: Dict[str, int] = field(default_factory=dict)
+    #: superstep executor stats (empty when the per-launch path ran):
+    #: supersteps / launches (steps executed inside them) / replays
+    #: (overflow supersteps re-run per-launch) / launches_per_fetch
+    superstep: Dict[str, int] = field(default_factory=dict)
 
 
 class _FallbackPrefetcher:
@@ -447,6 +468,13 @@ class Sweep:
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
                 )
+                # Step-build context the superstep executor reuses (same
+                # device-resident arrays, same kernel selection — the two
+                # paths must trace the identical fused body).
+                self._step_ctx = dict(
+                    arrays=(p, t, darrs), fused_opts=fused_opts,
+                    scalar_units=scalar_units, radix2=radix2, stride=stride,
+                )
                 return (lambda blocks: step(p, t, blocks, darrs)), 1, None
             step = make_candidates_step(
                 spec, num_lanes=cfg.lanes, out_width=plan.out_width,
@@ -480,6 +508,10 @@ class Sweep:
                     digest_arrays(build_digest_set(self.digests, spec.algo)),
                 ),
             )
+            self._step_ctx = dict(
+                arrays=(p, t, darrs), fused_opts=fused_opts,
+                scalar_units=scalar_units, radix2=radix2, stride=stride,
+            )
             return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
         step = make_sharded_candidates_step(
             spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width,
@@ -487,6 +519,230 @@ class Sweep:
         )
         p, t = replicate(mesh, (plan_arrays(plan), table_arrays(self.ct)))
         return (lambda blocks: step(p, t, blocks)), n_devices, mesh
+
+    # ------------------------------------------------------------------
+    # Superstep executor (crack mode, PERF.md §15)
+    # ------------------------------------------------------------------
+
+    def _superstep_steps(self) -> Optional[int]:
+        """Requested steps-per-superstep, or None when the superstep
+        executor is off (``SweepConfig.superstep=0`` or
+        ``A5GEN_SUPERSTEP=off``)."""
+        import os
+
+        env = os.environ.get("A5GEN_SUPERSTEP", "")
+        # Same off-spellings as A5GEN_CASCADE_CLOSE (expand_suball.
+        # close_enabled) — the two escape hatches must share a convention.
+        if env.lower() in ("off", "0", "no"):
+            return None
+        if env.lower() not in ("", "auto", "on", "1"):
+            import sys
+
+            print(
+                f"a5gen: warning: unrecognized A5GEN_SUPERSTEP={env!r} "
+                "(want off|0|no|auto); keeping the default (superstep on "
+                "for eligible crack sweeps)",
+                file=sys.stderr,
+            )
+        cfg = self.config
+        if cfg.superstep is not None and int(cfg.superstep) <= 0:
+            return None
+        return max(
+            1, int(cfg.superstep) if cfg.superstep else int(cfg.fetch_chunk)
+        )
+
+    def _make_superstep(self, cursor: SweepCursor, n_devices: int, mesh):
+        """Build this run's superstep executor, or None when the
+        per-launch pipeline should carry it: config/env opt-out, packed
+        block layout, an int32-unsafe block index (huge words), or a
+        stride-misaligned resume cursor (cross-geometry checkpoints).
+
+        Returns a descriptor dict whose ``call(b0)`` dispatches one
+        superstep starting at global block index ``b0`` — ONE device
+        program running ``steps`` fused launches with on-device block
+        cutting (``models.attack.make_superstep_body``).  Must run after
+        :meth:`_make_launch` (which resolves the geometry and stashes the
+        step-build context the executor shares)."""
+        steps = self._superstep_steps()
+        if steps is None:
+            return None
+        cfg, plan = self.config, self.plan
+        stride = cfg.resolve_block_stride()
+        if stride is None:
+            return None
+        idx = superstep_index(plan, stride)
+        if idx is None:
+            return None
+        cum, _totals, total_blocks = idx
+        # Normalize the cursor exactly as make_blocks does (skip fallback
+        # and finished words), then require stride alignment — misaligned
+        # resumes keep the scalar per-launch path, as they always have.
+        w, rank = cursor.word, cursor.rank
+        while w < plan.batch and (
+            plan.fallback[w] or rank >= plan.n_variants[w]
+        ):
+            w, rank = w + 1, 0
+        if w < plan.batch and rank % stride:
+            return None
+        b0 = total_blocks if w >= plan.batch else int(cum[w]) + rank // stride
+        # The superstep's device accumulator is int32: cap steps so a
+        # worst case of every lane emitting cannot reach 2^31 per fetch.
+        steps = max(1, min(
+            steps, ((1 << 31) - 1) // max(1, cfg.lanes * n_devices)
+        ))
+        # The tail superstep's device cursor overshoots the sweep end by
+        # up to one full superstep (those blocks cut zero-count); the
+        # overshot indices must themselves stay int32, or `b < total`
+        # comparisons wrap negative and resurrect word-0 blocks.
+        if (
+            total_blocks + (steps + 1) * cfg.num_blocks * n_devices
+            >= (1 << 31)
+        ):
+            return None
+        ctx = self._step_ctx
+        hit_cap = int(cfg.superstep_hit_cap)
+        common = dict(
+            out_width=plan.out_width, block_stride=stride, steps=steps,
+            hit_cap=hit_cap, total_blocks=total_blocks,
+            windowed=bool(getattr(plan, "windowed", False)),
+            fused_expand_opts=ctx["fused_opts"],
+            fused_scalar_units=ctx["scalar_units"], radix2=ctx["radix2"],
+        )
+        p, t, darrs = ctx["arrays"]
+        if n_devices == 1:
+            step = make_superstep_step(
+                self.spec, num_lanes=cfg.lanes, num_blocks=cfg.num_blocks,
+                **common,
+            )
+            ss = superstep_arrays(plan, stride)
+
+            def call(b: int):
+                return step(p, t, darrs, ss, np.int32(b))
+        else:
+            from ..parallel.mesh import (
+                make_sharded_superstep_step,
+                replicate,
+                shard_leading,
+            )
+
+            step = make_sharded_superstep_step(
+                self.spec, mesh, lanes_per_device=cfg.lanes,
+                num_blocks=cfg.num_blocks, **common,
+            )
+            ss = replicate(mesh, superstep_arrays(plan, stride))
+            nb = cfg.num_blocks
+
+            def call(b: int):
+                b0_dev = shard_leading(mesh, np.asarray(
+                    [b + d * nb for d in range(n_devices)], np.int32
+                ))
+                return step(p, t, darrs, ss, b0_dev)
+
+        return {
+            "call": call,
+            "steps": steps,
+            "stride": stride,
+            "cum": cum,
+            "total_blocks": total_blocks,
+            "hit_cap": hit_cap,
+            "b0": b0,
+            "advance": steps * cfg.num_blocks * n_devices,
+        }
+
+    def _drive_superstep(
+        self, ss, state: CheckpointState, launch: Callable, n_devices: int,
+        mesh, device_hit: Callable, fallback_candidate: Callable,
+        prefetch, last_ckpt: List[float], process_launch_hits: Callable,
+    ) -> Dict[str, int]:
+        """The superstep launch loop: one dispatch and ONE host fetch per
+        ``steps`` fused launches.  Supersteps are double-buffered like
+        launches (``max_in_flight``); the counter fetch is each
+        superstep's completion barrier (the §0 honest-sync rule — no
+        ``block_until_ready``).  A device whose capped hit buffer
+        overflowed triggers an exact per-launch replay of that superstep's
+        block range; checkpoint/progress land at superstep boundaries."""
+        cfg, plan = self.config, self.plan
+        cum, stride = ss["cum"], ss["stride"]
+        total_blocks, hit_cap = ss["total_blocks"], ss["hit_cap"]
+        advance = ss["advance"]
+        stats = {"supersteps": 0, "launches": 0, "replays": 0,
+                 "launches_per_fetch": ss["steps"]}
+        pending: deque = deque()
+        b0 = ss["b0"]
+        while b0 < total_blocks or pending:
+            while b0 < total_blocks and len(pending) < cfg.max_in_flight:
+                pending.append((b0, ss["call"](b0)))
+                b0 += advance
+            sb0, out = pending.popleft()
+            ne = int(out["n_emitted"])  # completion barrier (scalar fetch)
+            nh = int(out["n_hits"])
+            end_b = min(sb0 + advance, total_blocks)
+            end_w, end_r = block_cursor(plan, stride, cum, end_b)
+            if nh:
+                dev_hits = np.asarray(out["dev_hits"])
+                if int(dev_hits.max()) > hit_cap:
+                    # Graceful degradation: the capped device buffer
+                    # dropped entries — replay this superstep exactly
+                    # through the per-launch path (its hit processing is
+                    # the accounting; the scan's counts stand).
+                    stats["replays"] += 1
+                    self._replay_superstep(
+                        sb0, end_b, ss, launch, n_devices, mesh,
+                        process_launch_hits,
+                    )
+                else:
+                    hw = np.asarray(out["hit_word"])
+                    hr = np.asarray(out["hit_rank"])
+                    entries: List[Tuple[int, int]] = []
+                    for d in range(n_devices):
+                        k = int(dev_hits[d])
+                        lo = d * hit_cap
+                        entries.extend(zip(hw[lo:lo + k].tolist(),
+                                           hr[lo:lo + k].tolist()))
+                    # (word, rank) sort = cursor order: device stripes
+                    # interleave by scan step, so the raw buffer order is
+                    # per-device, not global.
+                    entries.sort()
+                    for w_row, rank in entries:
+                        device_hit(int(w_row), int(rank))
+            # Fallback words wholly before the cursor are due now.
+            self._flush_fallback_until(
+                end_w, state, fallback_candidate, prefetch
+            )
+            state.n_emitted += ne
+            state.cursor = SweepCursor(end_w, end_r)
+            stats["supersteps"] += 1
+            stats["launches"] += ss["steps"]
+            self._maybe_checkpoint(state, last_ckpt)
+            if cfg.progress:
+                cfg.progress.update(
+                    words_done=end_w,
+                    emitted=state.n_emitted,
+                    hits=state.n_hits,
+                )
+        return stats
+
+    def _replay_superstep(
+        self, b_lo: int, b_hi: int, ss, launch: Callable, n_devices: int,
+        mesh, process_launch_hits: Callable,
+    ) -> None:
+        """Exact per-launch replay of one superstep's block range — the
+        hit-buffer overflow fallback.  The host fast cutter shares the
+        device cutter's index arrays, so the replay cuts the SAME blocks
+        and its per-launch hit bitmasks recover every dropped hit."""
+        plan = self.plan
+        stride, cum = ss["stride"], ss["cum"]
+        w, rank = block_cursor(plan, stride, cum, b_lo)
+        end = block_cursor(plan, stride, cum, b_hi)
+        for segments, out, cur in self._launches(
+            SweepCursor(w, rank), launch, n_devices=n_devices, mesh=mesh
+        ):
+            if int(out["n_hits"]):
+                process_launch_hits(segments, out)
+            if (cur.word, cur.rank) >= end:
+                # In-flight launches past the range are dropped unfetched
+                # (their hits belong to later supersteps' own buffers).
+                break
 
     def _launches(
         self, cursor: SweepCursor, launch: Callable, *, n_devices: int = 1,
@@ -669,6 +925,34 @@ class Sweep:
         accum = jax.jit(lambda acc, ne, nh: acc + jnp.stack([ne, nh]))
         acc_zero = jnp.zeros((2,), jnp.int32)
 
+        def device_hit(w_row: int, rank: int) -> None:
+            """One device-flagged hit, shared by the per-launch and
+            superstep paths: flush oracle words that sit before this
+            hit's word (the hit list stays word-ordered), re-derive the
+            candidate, re-verify its digest on the host, record."""
+            self._flush_fallback_until(
+                w_row, state, fallback_candidate, prefetch
+            )
+            cand = decode_variant(plan, self.ct, spec, w_row, rank)
+            dig = self._host_digest(cand)
+            # Host re-verification: the device flagged this lane;
+            # its digest must really be in the target set.
+            if not self._digest_contains(dig):
+                raise RuntimeError(
+                    f"device hit failed host re-verification: "
+                    f"word {w_row} rank {rank} candidate {cand!r}"
+                )
+            state.n_hits += 1
+            state.hits.append((w_row, rank))
+            recorder.emit(
+                HitRecord(
+                    word_index=int(self.packed.index[w_row]),
+                    variant_rank=rank,
+                    candidate=cand,
+                    digest_hex=dig.hex(),
+                )
+            )
+
         def process_launch_hits(segments, out) -> None:
             hit = unpack_bits(out["hit_bits"], cfg.lanes * n_devices)
             # Segments are cursor-ordered (device d's lane slice precedes
@@ -677,30 +961,7 @@ class Sweep:
             for batch, lo, hi in segments:
                 lanes = np.nonzero(hit[lo:hi])[0]
                 for w_row, rank in lane_cursor(plan, batch, lanes):
-                    # Flush oracle words that sit before this hit's word
-                    # so the hit list stays word-ordered.
-                    self._flush_fallback_until(
-                        w_row, state, fallback_candidate, prefetch
-                    )
-                    cand = decode_variant(plan, self.ct, spec, w_row, rank)
-                    dig = self._host_digest(cand)
-                    # Host re-verification: the device flagged this lane;
-                    # its digest must really be in the target set.
-                    if not self._digest_contains(dig):
-                        raise RuntimeError(
-                            f"device hit failed host re-verification: "
-                            f"word {w_row} rank {rank} candidate {cand!r}"
-                        )
-                    state.n_hits += 1
-                    state.hits.append((w_row, rank))
-                    recorder.emit(
-                        HitRecord(
-                            word_index=int(self.packed.index[w_row]),
-                            variant_rank=rank,
-                            candidate=cand,
-                            digest_hex=dig.hex(),
-                        )
-                    )
+                    device_hit(w_row, rank)
 
         t0 = time.monotonic()
         last_ckpt = [t0]
@@ -754,16 +1015,25 @@ class Sweep:
                 chunk_len = max(1, chunk_len // 2)
             last_drain[0] = time.monotonic()
 
+        superstep_stats: Dict[str, int] = {}
+        sstep = self._make_superstep(cursor, n_devices, mesh)
         try:
-            for item in self._launches(
-                cursor, launch, n_devices=n_devices, mesh=mesh
-            ):
-                out = item[1]
-                acc = accum(acc, out["n_emitted"], out["n_hits"])
-                chunk.append(item)
-                if len(chunk) >= chunk_len:
-                    drain_chunk()
-            drain_chunk()
+            if sstep is not None:
+                superstep_stats = self._drive_superstep(
+                    sstep, state, launch, n_devices, mesh,
+                    device_hit, fallback_candidate, prefetch, last_ckpt,
+                    process_launch_hits,
+                )
+            else:
+                for item in self._launches(
+                    cursor, launch, n_devices=n_devices, mesh=mesh
+                ):
+                    out = item[1]
+                    acc = accum(acc, out["n_emitted"], out["n_hits"])
+                    chunk.append(item)
+                    if len(chunk) >= chunk_len:
+                        drain_chunk()
+                drain_chunk()
             # Tail: any fallback words at/after the last device word.
             self._flush_fallback_until(
                 self.n_words, state, fallback_candidate, prefetch
@@ -788,6 +1058,7 @@ class Sweep:
             resumed=resumed,
             wall_s=state.wall_s,
             routing=dict(self.routing),
+            superstep=superstep_stats,
         )
 
     # ------------------------------------------------------------------

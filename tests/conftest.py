@@ -36,6 +36,32 @@ REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 UPSTREAM_REFERENCE = pathlib.Path("/root/reference")
 
 
+@pytest.hookimpl(trylast=True)
+def pytest_collection_modifyitems(config, items):
+    """Tier-1 budget guard (CI sets ``A5GEN_FORBID_SLOW=1``): the tier-1
+    command deselects ``slow`` tests via ``-m 'not slow'``; if that filter
+    ever drifts (dropped flag, edited expression), slow-marked tests
+    silently join the default collection and blow the 870 s budget.
+    Under the env flag, any SELECTED item carrying the marker is a hard
+    collection error — the regression surfaces in CI before it bites.
+    Local full-suite runs (env unset) are unaffected.
+
+    ``trylast``: the mark plugin's own (trylast) deselection hook runs
+    before this conftest one, so ``items`` here is the post-filter
+    selection — with the filter intact the guard sees no slow items."""
+    if os.environ.get("A5GEN_FORBID_SLOW") != "1":
+        return
+    leaked = [item.nodeid for item in items
+              if item.get_closest_marker("slow") is not None]
+    if leaked:
+        raise pytest.UsageError(
+            "A5GEN_FORBID_SLOW=1: slow-marked tests are in the selected "
+            "set (the tier-1 '-m not slow' filter has drifted): "
+            + ", ".join(leaked[:5])
+            + (f" ... +{len(leaked) - 5} more" if len(leaked) > 5 else "")
+        )
+
+
 @pytest.fixture(scope="session")
 def reference_tables(tmp_path_factory) -> pathlib.Path:
     """Directory of parity-fixture ``.table`` files, regenerated from the

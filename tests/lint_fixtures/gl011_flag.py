@@ -1,0 +1,44 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL011 must flag: host syncs inside lax loop bodies.
+
+A scan/while body runs per device iteration; ``int()``/``.item()``/
+``np.asarray`` on its carry forces a host round trip per step — the
+exact overhead the superstep executor exists to remove.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def sweep_scan(plan, b0, steps):
+    def step(carry, _):
+        cursor, total = carry
+        count = jnp.minimum(cursor, 128)
+        total = total + int(carry[1])  # host sync on the carry
+        host_view = np.asarray(carry)  # host numpy on the carry
+        done = count.item()  # per-iteration scalar fetch
+        return (cursor + 1, total + done + host_view.sum()), None
+
+    return lax.scan(step, (b0, jnp.zeros((), jnp.int32)), None,
+                    length=steps)
+
+
+def sweep_lambda(xs):
+    # Inline lambda bodies are loop bodies too.
+    return lax.scan(lambda c, x: (c + int(c), None), jnp.int32(0), xs)
+
+
+def sweep_while(limit):
+    def cond(carry):
+        return carry[0] < limit
+
+    def body(carry):
+        cursor, total = carry
+        total = total + int(carry[0])  # host sync on the carry
+        return (cursor + 1, total)
+
+    # Keyword-style call (jax's own signature names) resolves too.
+    return lax.while_loop(cond_fun=cond, body_fun=body,
+                          init_val=(jnp.int32(0), jnp.int32(0)))

@@ -1,0 +1,40 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL011 must pass: pure lax loop bodies, host fetch AFTER the loop.
+
+The superstep idiom: the scan carries device values only; the single
+fetch after the loop is the completion barrier for the whole chain.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def sweep_scan(plan, b0, steps):
+    def step(carry, _):
+        cursor, total = carry
+        count = jnp.minimum(cursor, 128)
+        return (cursor + 1, total + count), None
+
+    carry, _ = lax.scan(step, (b0, jnp.zeros((), jnp.int32)), None,
+                        length=steps)
+    # Host sync OUTSIDE the loop: one fetch per superstep.
+    return int(carry[1])
+
+
+def summarize(batch):
+    # np on plain host data outside any loop body is fine.
+    counts = np.asarray(batch)
+    return counts.sum()
+
+
+def unrelated_helper(rows):
+    # Shares a loop body's NAME but lives in a different scope: host
+    # syncs here are ordinary host code, not per-iteration device work.
+    def step(row, total):
+        return total + int(row)
+
+    acc = 0
+    for r in rows:
+        acc = step(r, acc)
+    return acc

@@ -1,0 +1,308 @@
+"""Device-resident superstep executor (PERF.md §15): on/off parity of
+hits and candidate streams, overflow→replay, mid-superstep resume, the
+escape hatches, and the bench A/B record shape.
+
+The superstep path must be STREAM-INVISIBLE: every test here runs the
+same sweep through the per-launch pipeline (``superstep=0``) and the
+superstep executor and pins the results equal — hits by full
+(word_index, rank, candidate) tuples, candidates byte-for-byte.
+"""
+
+import hashlib
+import io
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.runtime import (
+    CandidateWriter,
+    HitRecorder,
+    Sweep,
+    SweepConfig,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a"]
+
+
+def oracle_lines(spec, sub_map, words):
+    out = []
+    for w in words:
+        out.extend(
+            iter_candidates(
+                w, sub_map, spec.min_substitute, spec.max_substitute,
+                substitute_all=spec.mode.startswith("suball"),
+                reverse=spec.mode in ("reverse", "suball-reverse"),
+            )
+        )
+    return out
+
+
+def hit_tuples(res):
+    return [(h.word_index, h.variant_rank, h.candidate) for h in res.hits]
+
+
+def run_crack(spec, sub_map, words, digests, *, superstep, devices=1,
+              **cfg_kw):
+    cfg = SweepConfig(lanes=64, num_blocks=16, superstep=superstep,
+                      devices=devices, **cfg_kw)
+    sweep = Sweep(spec, sub_map, words, digests, config=cfg)
+    return sweep.run_crack()
+
+
+class TestSuperstepParity:
+    """superstep on == superstep off, bit for bit."""
+
+    @pytest.mark.parametrize("mode", ["default", "suball"])
+    def test_hits_and_counts_equal_per_launch(self, mode):
+        spec = AttackSpec(mode=mode, algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[0], oracle[len(oracle) // 3], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(40)]
+
+        off = run_crack(spec, LEET, WORDS, digests, superstep=0)
+        on = run_crack(spec, LEET, WORDS, digests, superstep=None)
+        assert on.n_emitted == off.n_emitted == len(oracle)
+        assert hit_tuples(on) == hit_tuples(off)
+        assert {h.candidate for h in on.hits} == set(planted)
+        # The executor really ran (off path reports no superstep stats).
+        assert on.superstep["supersteps"] >= 1
+        assert on.superstep["launches_per_fetch"] >= 1
+        assert off.superstep == {}
+
+    def test_suball_with_fallback_words_interleaved(self):
+        # Boundary-crossing ReplaceAll hazard: 'acb' words stay
+        # oracle-routed; the superstep cursor must skip them and the hit
+        # list must interleave identically with the per-launch path.
+        sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+        words = [b"zz", b"acb", b"za", b"zacb", b"azz"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        fb_cand = oracle_lines(spec, sub, [b"acb"])[-1]
+        dev_cand = oracle_lines(spec, sub, [b"azz"])[-1]
+        digests = [hashlib.md5(fb_cand).digest(),
+                   hashlib.md5(dev_cand).digest()]
+
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=None)
+        sweep = Sweep(spec, sub, words, digests, config=cfg)
+        assert sweep.fallback_rows, "fixture must exercise fallback"
+        on = sweep.run_crack()
+        off = run_crack(spec, sub, words, digests, superstep=0)
+        assert hit_tuples(on) == hit_tuples(off)
+        assert {h.candidate for h in on.hits} == {fb_cand, dev_cand}
+        assert on.superstep["supersteps"] >= 1
+
+    def test_multi_device_equals_per_launch(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[1], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+
+        off = run_crack(spec, LEET, WORDS, digests, superstep=0, devices=8)
+        on = run_crack(spec, LEET, WORDS, digests, superstep=None, devices=8)
+        one = run_crack(spec, LEET, WORDS, digests, superstep=None)
+        assert hit_tuples(on) == hit_tuples(off) == hit_tuples(one)
+        assert on.n_emitted == off.n_emitted == one.n_emitted
+        assert on.superstep["supersteps"] >= 1
+
+    def test_windowed_plan_parity(self):
+        spec = AttackSpec(mode="default", algo="md5",
+                          min_substitute=1, max_substitute=1)
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[0]).digest(),
+                   hashlib.md5(oracle[-1]).digest()]
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=None)
+        sweep = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        assert sweep.plan.windowed, "window must engage the DP plan"
+        on = sweep.run_crack()
+        off = run_crack(spec, LEET, WORDS, digests, superstep=0)
+        assert hit_tuples(on) == hit_tuples(off)
+        assert on.n_emitted == off.n_emitted == len(oracle)
+
+    def test_candidates_stream_byte_identical(self):
+        # Candidates mode must ship every lane's bytes regardless, so the
+        # superstep applies to crack mode only — the flag must be a
+        # byte-exact no-op on the candidate stream.
+        spec = AttackSpec(mode="default", algo="md5")
+
+        def stream(sstep):
+            cfg = SweepConfig(lanes=64, num_blocks=16, superstep=sstep)
+            sweep = Sweep(spec, LEET, WORDS, config=cfg)
+            buf = io.BytesIO()
+            with CandidateWriter(buf) as w:
+                sweep.run_candidates(w)
+            return buf.getvalue()
+
+        assert stream(None) == stream(0)
+
+
+class TestOverflowReplay:
+    def test_hit_buffer_overflow_replays_exactly(self):
+        """Planted hit density above the cap: the device buffer drops
+        entries, the driver replays that superstep per-launch, and the
+        final hit list is byte-identical to the per-launch run."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, [b"password", b"sesame"])
+        dense = [hashlib.md5(c).digest() for c in oracle[:40]]
+
+        off = run_crack(spec, LEET, WORDS, dense, superstep=0)
+        on = run_crack(spec, LEET, WORDS, dense, superstep=None,
+                       superstep_hit_cap=8)
+        assert on.superstep["replays"] >= 1
+        assert hit_tuples(on) == hit_tuples(off)
+        assert on.n_hits == off.n_hits == 40
+        assert on.n_emitted == off.n_emitted
+
+    def test_cap_exactly_reached_needs_no_replay(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, [b"password"])
+        planted = sorted(set(oracle[:4]))
+        digests = [hashlib.md5(c).digest() for c in planted]
+        on = run_crack(spec, LEET, WORDS, digests, superstep=None,
+                       superstep_hit_cap=len(planted))
+        off = run_crack(spec, LEET, WORDS, digests, superstep=0)
+        assert on.superstep["replays"] == 0
+        assert hit_tuples(on) == hit_tuples(off)
+
+
+class TestSuperstepResume:
+    def test_interrupted_mid_superstep_resumes_identically(self, tmp_path):
+        """A crash between supersteps leaves a boundary checkpoint; the
+        resumed run's final hit list equals the uninterrupted run's."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[3], oracle[-2]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+
+        want = run_crack(spec, LEET, WORDS, digests, superstep=None)
+
+        path = str(tmp_path / "ss.json")
+        # superstep=1: one launch per superstep -> several superstep
+        # boundaries (and checkpoints, every_s=0) inside the sweep.
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=1,
+                          checkpoint_path=path, checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        from hashcat_a5_table_generator_tpu.runtime import load_checkpoint
+
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+        assert partial.cursor.word < len(WORDS)
+
+        second = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        got = second.run_crack()
+        assert got.resumed
+        assert sorted(h.candidate for h in got.hits) == sorted(
+            h.candidate for h in want.hits
+        )
+        assert {h.candidate for h in got.hits} == set(planted)
+
+    def test_superstep_checkpoint_resumes_on_per_launch_path(self, tmp_path):
+        """A superstep-boundary checkpoint is a plain (word, rank) cursor:
+        resuming it with the executor OFF completes the identical sweep."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[-1]).digest()]
+        path = str(tmp_path / "cross.json")
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=1,
+                          checkpoint_path=path, checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+
+        cfg2 = SweepConfig(lanes=64, num_blocks=16, superstep=0,
+                           checkpoint_path=path, checkpoint_every_s=0.0)
+        got = Sweep(spec, LEET, WORDS, digests, config=cfg2).run_crack()
+        assert got.resumed
+        want = run_crack(spec, LEET, WORDS, digests, superstep=0)
+        assert hit_tuples(got) == hit_tuples(want)
+
+
+class TestEscapeHatches:
+    def test_env_off_disables_executor(self, monkeypatch):
+        monkeypatch.setenv("A5GEN_SUPERSTEP", "off")
+        spec = AttackSpec(mode="default", algo="md5")
+        digests = [hashlib.md5(b"nope").digest()]
+        res = run_crack(spec, LEET, WORDS, digests, superstep=None)
+        assert res.superstep == {}
+
+    def test_config_zero_disables_executor(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        digests = [hashlib.md5(b"nope").digest()]
+        res = run_crack(spec, LEET, WORDS, digests, superstep=0)
+        assert res.superstep == {}
+
+    def test_packed_layout_falls_back_to_per_launch(self):
+        # The executor needs the fixed-stride layout; an explicit packed
+        # request keeps the per-launch pipeline, stream unchanged.
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[-1]).digest()]
+        res = run_crack(spec, LEET, WORDS, digests, superstep=None,
+                        packed_blocks=True)
+        assert res.superstep == {}
+        assert {h.candidate for h in res.hits} == {oracle[-1]}
+
+    def test_cli_superstep_arg(self):
+        from hashcat_a5_table_generator_tpu.cli import build_parser
+
+        ap = build_parser()
+        assert ap.parse_args(["d", "-t", "x"]).superstep is None
+        assert ap.parse_args(["d", "-t", "x", "--superstep", "off"]
+                             ).superstep == 0
+        assert ap.parse_args(["d", "-t", "x", "--superstep", "auto"]
+                             ).superstep is None
+        assert ap.parse_args(["d", "-t", "x", "--superstep", "8"]
+                             ).superstep == 8
+        with pytest.raises(SystemExit):
+            ap.parse_args(["d", "-t", "x", "--superstep", "-3"])
+
+
+def test_bench_superstep_ab_record_shape():
+    """The §15 measurement instrument: one JSON line, both arms, the
+    host-overhead ratio the acceptance criterion reads."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--superstep-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "400", "--seconds", "1"],
+        capture_output=True, timeout=240, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "superstep_host_overhead_ab"
+    for arm in ("per_launch", "superstep"):
+        assert rec[arm]["hashes_per_sec"] > 0
+        assert rec[arm]["launches"] >= 16
+        assert rec[arm]["host_s_per_step"] >= 0
+    # The superstep arm cuts zero blocks on the host by construction.
+    assert rec["superstep"]["cut_s_per_step"] == 0.0
+    assert rec["host_overhead_ratio"] > 1.0

@@ -14,7 +14,12 @@ from .docs import OpDocstringContract
 from .dtype import FloatLiteralInKernel, UnmaskedWideInt
 from .hygiene import MutableDefaultArg, Nondeterminism, StdoutPrint
 from .jit import JitMissingStaticArgnames
-from .tracing import HostEscapeInTrace, LoopOverTracer, NumpyInTrace
+from .tracing import (
+    HostEscapeInTrace,
+    HostSyncInLoopBody,
+    LoopOverTracer,
+    NumpyInTrace,
+)
 
 ALL_RULES: List[Rule] = [
     UnmaskedWideInt(),
@@ -27,6 +32,7 @@ ALL_RULES: List[Rule] = [
     OpDocstringContract(),
     StdoutPrint(),
     MutableDefaultArg(),
+    HostSyncInLoopBody(),
 ]
 
 RULES_BY_CODE: Dict[str, Rule] = {rule.code: rule for rule in ALL_RULES}

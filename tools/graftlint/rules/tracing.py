@@ -13,7 +13,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional, Set
 
-from ..context import FileContext, dotted_name, root_name
+from ..context import FileContext, dotted_name, param_names, root_name
 from ..findings import Finding
 from .base import Rule
 
@@ -176,6 +176,161 @@ class NumpyInTrace(Rule):
                         "or hoist to the host wrapper)",
                     )
                     break
+
+
+#: ``lax`` loop combinators and the (positional index, keyword name) of
+#: each per-iteration function argument — the functions whose purity the
+#: superstep executor (and any future scan) depends on.  Keyword names
+#: are jax's own signature names, so keyword-style calls resolve too.
+_LAX_LOOP_BODIES = {
+    "scan": ((0, "f"),),
+    "while_loop": ((0, "cond_fun"), (1, "body_fun")),
+    "fori_loop": ((2, "body_fun"),),
+}
+
+_LAX_PREFIXES = ("lax", "jax.lax")
+
+
+def _scope_defs(scope: ast.AST):
+    """FunctionDefs defined DIRECTLY in ``scope`` (descending through
+    plain statements, pruning at nested function/lambda bodies) — the
+    defs a bare name used in that scope can lexically resolve to."""
+    out = []
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append(node)
+            continue  # a nested def's own body is a different scope
+        if isinstance(node, ast.Lambda):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _resolve_body_name(name: str, site: ast.AST, parents) -> list:
+    """Lexical resolution of a loop-body reference: walk the call site's
+    enclosing function scopes outward (then the module) and return the
+    same-named defs of the FIRST scope that has any — never defs that
+    merely share the name inside unrelated functions."""
+    scope = parents.get(id(site))
+    while scope is not None:
+        if isinstance(
+            scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+        ):
+            defs = [d for d in _scope_defs(scope) if d.name == name]
+            if defs:
+                return defs
+        scope = parents.get(id(scope))
+    return []
+
+
+def _loop_bodies(tree: ast.Module):
+    """Loop-body function nodes passed to ``lax.scan`` /
+    ``lax.while_loop`` / ``lax.fori_loop`` anywhere in the module:
+    inline lambdas at the call sites plus named defs resolved through
+    the call site's lexical scope chain."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    bodies = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name is None:
+            continue
+        head, _, tail = name.rpartition(".")
+        if tail not in _LAX_LOOP_BODIES or (
+            head and head not in _LAX_PREFIXES
+        ):
+            continue
+        kwmap = {kw.arg: kw.value for kw in node.keywords}
+        for pos, kwname in _LAX_LOOP_BODIES[tail]:
+            arg = (node.args[pos] if pos < len(node.args)
+                   else kwmap.get(kwname))
+            if isinstance(arg, ast.Lambda):
+                bodies.append(arg)
+            elif isinstance(arg, ast.Name):
+                bodies.extend(_resolve_body_name(arg.id, node, parents))
+    return bodies
+
+
+class HostSyncInLoopBody(Rule):
+    code = "GL011"
+    name = "host-sync-in-loop-body"
+    summary = (
+        "int()/.item()/np.asarray()/block_until_ready() inside a "
+        "lax.scan/while_loop/fori_loop body"
+    )
+    rationale = (
+        "A lax loop body runs entirely on device; forcing a host value "
+        "out of its carry or activations (int(), .item(), np.asarray, "
+        "block_until_ready) raises at trace time at best and silently "
+        "bakes a first-iteration constant in at worst. The superstep "
+        "executor's whole point is that NO host sync happens between "
+        "scan steps — the one fetch per superstep is the completion "
+        "barrier; keep loop bodies pure and fetch after the loop."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        return ctx.in_package
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        seen: Set[int] = set()
+        for fn in _loop_bodies(ctx.tree):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            yield from self._scan_body(ctx, fn)
+
+    def _scan_body(self, ctx: FileContext, fn) -> Iterator[Finding]:
+        params = param_names(fn)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in _ESCAPE_METHODS
+                and not node.args
+            ):
+                yield self.finding(
+                    ctx, node.lineno, node.col_offset,
+                    f".{func.attr}() inside a lax loop body is a "
+                    "host sync per iteration; fetch once after the "
+                    "loop instead",
+                )
+            elif (
+                isinstance(func, ast.Name)
+                and func.id in _ESCAPE_BUILTINS
+                and len(node.args) == 1
+                and _param_rooted(node.args[0], params)
+            ):
+                yield self.finding(
+                    ctx, node.lineno, node.col_offset,
+                    f"{func.id}() on loop-carried value "
+                    f"{root_name(node.args[0])!r} inside a lax loop "
+                    "body concretizes the carry per iteration",
+                )
+            else:
+                name = dotted_name(func)
+                if name is None:
+                    continue
+                alias = name.split(".", 1)[0]
+                if alias not in _NP_ALIASES:
+                    continue
+                for arg in (list(node.args)
+                            + [kw.value for kw in node.keywords]):
+                    if _param_rooted(arg, params) is not None:
+                        yield self.finding(
+                            ctx, node.lineno, node.col_offset,
+                            f"{name}(...) on loop-carried value "
+                            "inside a lax loop body forces a host "
+                            "round trip per iteration (use jnp)",
+                        )
+                        break
 
 
 class LoopOverTracer(Rule):
