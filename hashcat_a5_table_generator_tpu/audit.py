@@ -1,0 +1,132 @@
+"""The ``@audited_entry`` registry: the package's semantic-audit surface.
+
+``tools/graftaudit`` (the jaxpr/HLO-level audit tier — PERF.md §16) needs
+a mechanical answer to "which compiled programs must uphold which
+invariants?".  This module is that answer: kernels and step builders
+declare themselves with :func:`audited_entry`, and the audit driver pairs
+each registered name with a concrete launch configuration
+(``tools/graftaudit/harness.py``) to trace, lower, and check.
+
+Stdlib-only on purpose — importing this module must never pull in jax or
+``tools/``; registration is metadata, the heavy lifting lives entirely in
+the audit tool.  The registry is therefore safe to populate at import
+time from ``ops/``, ``models/`` and ``parallel/``.
+
+Entry kinds (what the audit does with the entry):
+
+* ``"pallas_kernel"``  — a fused Pallas wrapper; traced (interpret mode,
+  CPU) for op-count budgets (``KERNEL_BUDGETS.json``), static bounds and
+  grid-overlap checks, and kernel float-purity.
+* ``"integer_stage"``  — a hash/membership primitive whose whole trace
+  must stay in integer dtypes (no float ``convert_element_type`` leaks).
+* ``"fused_body"``     — an end-to-end expand→hash→membership body;
+  lowered + XLA-compiled (CPU) for dead-stage detection (the PERF.md §15
+  DCE trap) and host-transfer audits.
+* ``"sharded_body"``   — same checks through ``shard_map`` on a 1-device
+  mesh (the sharded twins must not lose stages either).
+
+``stages``: the pipeline stages whose primitives must survive into the
+optimized module (any of ``"expand"``, ``"hash"``, ``"membership"``) —
+only meaningful for the body kinds.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, Tuple, TypeVar
+
+#: Decoration preserves the wrapped callable's exact type (the strict-
+#: typed ``models``/``ops`` surfaces must not erase to bare Callable).
+_F = TypeVar("_F", bound=Callable)
+
+#: Valid ``kind`` values, in the order the audit reports them.
+ENTRY_KINDS = (
+    "pallas_kernel",
+    "integer_stage",
+    "fused_body",
+    "sharded_body",
+)
+
+#: Valid ``stages`` members (see ``tools/graftaudit/stages.py`` for the
+#: source-module marker sets each one maps to).
+PIPELINE_STAGES = ("expand", "hash", "membership")
+
+
+@dataclass(frozen=True)
+class AuditedEntry:
+    """One registered audit target (metadata only — no example inputs)."""
+
+    name: str
+    fn: Callable
+    kind: str
+    #: Pipeline stages that must survive XLA optimization (body kinds).
+    stages: Tuple[str, ...] = ()
+    #: Key into ``KERNEL_BUDGETS.json`` when this entry also anchors an
+    #: op-count budget family (pallas kernels; the harness may register
+    #: several budget configs per entry).
+    budget_keys: Tuple[str, ...] = ()
+    module: str = ""
+    qualname: str = ""
+
+
+#: name -> entry; populated by decoration at module import.
+AUDIT_REGISTRY: Dict[str, AuditedEntry] = {}
+
+
+def audited_entry(
+    name: str,
+    *,
+    kind: str,
+    stages: Tuple[str, ...] = (),
+    budget_keys: Tuple[str, ...] = (),
+) -> Callable[[_F], _F]:
+    """Register the decorated callable as a semantic-audit entry point.
+
+    Pure bookkeeping: the callable is returned unchanged (zero runtime
+    overhead on the hot path), and duplicate names raise at import time
+    so two kernels can never silently shadow one audit slot.
+    """
+    if kind not in ENTRY_KINDS:
+        raise ValueError(
+            f"audited_entry {name!r}: unknown kind {kind!r}; "
+            f"one of {ENTRY_KINDS}"
+        )
+    for stage in stages:
+        if stage not in PIPELINE_STAGES:
+            raise ValueError(
+                f"audited_entry {name!r}: unknown stage {stage!r}; "
+                f"members must be in {PIPELINE_STAGES}"
+            )
+
+    def deco(fn: _F) -> _F:
+        existing = AUDIT_REGISTRY.get(name)
+        if existing is not None and (
+            existing.module != fn.__module__
+            or existing.qualname != fn.__qualname__
+        ):
+            raise ValueError(
+                f"audited_entry {name!r} registered twice "
+                f"({existing.module}.{existing.qualname} and "
+                f"{fn.__module__}.{fn.__qualname__})"
+            )
+        # Same module+qualname: idempotent re-registration, so
+        # importlib.reload of an audited module (a pattern the test
+        # suite uses) refreshes the entry instead of raising.
+        AUDIT_REGISTRY[name] = AuditedEntry(
+            name=name,
+            fn=fn,
+            kind=kind,
+            stages=tuple(stages),
+            budget_keys=tuple(budget_keys),
+            module=fn.__module__,
+            qualname=fn.__qualname__,
+        )
+        return fn
+
+    return deco
+
+
+def registered_entries() -> Dict[str, AuditedEntry]:
+    """Snapshot of the registry (import the audited modules first — the
+    audit driver does; see ``tools/graftaudit/harness.py``)."""
+    return dict(AUDIT_REGISTRY)

@@ -36,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..audit import audited_entry
 from ..ops.blocks import BlockBatch, pad_batch
 from ..ops.expand_matches import MatchPlan, build_match_plan, expand_matches
 from ..ops.expand_suball import SubAllPlan, build_suball_plan, expand_suball
@@ -323,6 +324,11 @@ def make_fused_lane_body(
     return lane_body
 
 
+@audited_entry(
+    "models.make_fused_body",
+    kind="fused_body",
+    stages=("expand", "hash", "membership"),
+)
 def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
@@ -399,6 +405,11 @@ def superstep_arrays(plan: Plan, stride: int) -> "ArrayTree | None":
     }
 
 
+@audited_entry(
+    "models.make_superstep_body",
+    kind="fused_body",
+    stages=("expand", "hash", "membership"),
+)
 def make_superstep_body(
     spec: AttackSpec, *, num_lanes: int, out_width: int, block_stride: int,
     num_blocks: int, steps: int, hit_cap: int, total_blocks: int,

@@ -23,6 +23,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..runtime.env import env_str
 from ..ops.packing import (
     DEFAULT_BUCKETS,
     DEFAULT_MAX_WORD_BYTES,
@@ -79,7 +80,7 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    if os.environ.get("A5_NATIVE", "1") == "0":
+    if env_str("A5_NATIVE", "1") == "0":
         return None
     path = _build()
     if path is None:

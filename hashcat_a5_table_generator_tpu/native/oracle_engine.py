@@ -24,6 +24,8 @@ import subprocess
 import sys
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..runtime.env import env_str
+
 _SRC = pathlib.Path(__file__).with_name("oracle.cpp")
 _ABI = 4
 _lib: Optional[ctypes.CDLL] = None
@@ -77,7 +79,7 @@ def load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    if os.environ.get("A5_NATIVE", "1") == "0":
+    if env_str("A5_NATIVE", "1") == "0":
         return None
     path = _build()
     if path is None:

@@ -59,13 +59,13 @@ everything on device stays uint32.
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..runtime.env import env_str
 from ..tables.compile import CompiledTable, boundary_match_possible
 from .blocks import (  # noqa: F401  — re-exported: this module defined them first
     MAX_BLOCK,
@@ -98,7 +98,7 @@ def close_enabled() -> bool:
     """Cascade closure is ON by default; ``A5GEN_CASCADE_CLOSE`` set to
     ``off``/``0``/``no`` reverts to routing every hazard word through the
     CPU oracle (the pre-closure behavior — escape hatch and A/B lever)."""
-    return os.environ.get("A5GEN_CASCADE_CLOSE", "").lower() not in (
+    return env_str("A5GEN_CASCADE_CLOSE").lower() not in (
         "off", "0", "no",
     )
 

@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..audit import audited_entry
+
 _U32 = jnp.uint32
 
 
@@ -251,18 +253,21 @@ def _run_blocks(block_fn, init, words, n_blocks):
     return jnp.stack(state, axis=-1)
 
 
+@audited_entry("ops.hashes.md5", kind="integer_stage")
 def md5(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
     """MD5 of each row: ``uint8[B, W], int32[B] -> uint32[B, 4]`` state words."""
     words, n_blocks = pad_message(msg, length, big_endian_length=False)
     return _run_blocks(_md5_block, _MD5_INIT, words, n_blocks)
 
 
+@audited_entry("ops.hashes.md4", kind="integer_stage")
 def md4(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
     """MD4 of each row: ``uint8[B, W], int32[B] -> uint32[B, 4]`` state words."""
     words, n_blocks = pad_message(msg, length, big_endian_length=False)
     return _run_blocks(_md4_block, _MD4_INIT, words, n_blocks)
 
 
+@audited_entry("ops.hashes.sha1", kind="integer_stage")
 def sha1(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
     """SHA-1 of each row: ``uint8[B, W], int32[B] -> uint32[B, 5]`` state words."""
     words, n_blocks = pad_message(msg, length, big_endian_length=True)
@@ -278,6 +283,7 @@ def utf16le_expand(msg: jnp.ndarray, length: jnp.ndarray) -> Tuple[jnp.ndarray, 
     return out, length.astype(jnp.int32) * 2
 
 
+@audited_entry("ops.hashes.ntlm", kind="integer_stage")
 def ntlm(msg: jnp.ndarray, length: jnp.ndarray) -> jnp.ndarray:
     """NTLM: MD4 over the UTF-16LE expansion. ``uint32[B, 4]`` state words."""
     wide, wide_len = utf16le_expand(msg, length)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..audit import audited_entry
 from .hashes import BIG_ENDIAN_DIGEST, DIGEST_WORDS, digest_to_words
 
 
@@ -209,6 +210,7 @@ def bitmap_probe(digest: jnp.ndarray, bitmap: jnp.ndarray) -> jnp.ndarray:
     return (word >> (idx & _U32(31))) & _U32(1) != 0
 
 
+@audited_entry("ops.digest_member", kind="integer_stage")
 def digest_member(
     digest: jnp.ndarray,  # uint32 [N, K]
     rows: jnp.ndarray,  # uint32 [D, K] row-sorted

@@ -34,12 +34,12 @@ nonsense message by construction in both paths, and both mask them.
 
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..audit import audited_entry
+from ..runtime.env import env_is, read_env
 from .hashes import (
     _MD4_G,
     _MD4_H,
@@ -65,7 +65,7 @@ _I32 = jnp.int32
 
 
 def _grid_height_from_env() -> int:
-    raw = os.environ.get("A5GEN_PALLAS_G")
+    raw = read_env("A5GEN_PALLAS_G")
     if raw is None or raw == "":
         return 8
     try:
@@ -181,7 +181,7 @@ def enabled_by_env() -> bool:
     which selects *that* kernel and therefore also opts this one out).
     Unrecognized values warn and keep the default — a typo must not
     silently disable the fast path."""
-    val = os.environ.get("A5GEN_PALLAS")
+    val = read_env("A5GEN_PALLAS")
     if val is None or val == "":
         return True
     if val == "expand":
@@ -206,7 +206,7 @@ def _interpret_by_env() -> bool:
     plumbing) on the CPU backend, where compiled pallas is unavailable —
     the e2e wiring test uses it so a threading bug cannot hide until a
     TPU run."""
-    return os.environ.get("A5GEN_PALLAS_INTERPRET") == "1"
+    return env_is("A5GEN_PALLAS_INTERPRET", "1")
 
 
 def _on_tpu() -> bool:
@@ -264,7 +264,7 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
     ineligible configs and non-TPU backends."""
     if not enabled_by_env():
         return None
-    if os.environ.get("A5GEN_PALLAS") == "expand" and not _on_tpu():
+    if env_is("A5GEN_PALLAS", "expand") and not _on_tpu():
         # An EXPLICIT opt-in deserves a diagnostic when it can't be
         # honored; the default-on (env unset) case falls back silently.
         import sys
@@ -1283,6 +1283,11 @@ def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
     return state, emit
 
 
+@audited_entry(
+    "ops.fused_expand_md5",
+    kind="pallas_kernel",
+    budget_keys=("scalar", "sha1", "general", "2-hash-block", "ntlm"),
+)
 def fused_expand_md5(
     tokens: jnp.ndarray,  # uint8 [B, L] — plan token matrix
     lengths: jnp.ndarray,  # int32 [B]
@@ -1579,6 +1584,11 @@ def _make_suball_kernel(
     return kernel
 
 
+@audited_entry(
+    "ops.fused_expand_suball_md5",
+    kind="pallas_kernel",
+    budget_keys=("suball",),
+)
 def fused_expand_suball_md5(
     tokens: jnp.ndarray,  # uint8 [B, L] — plan token matrix
     lengths: jnp.ndarray,  # int32 [B]

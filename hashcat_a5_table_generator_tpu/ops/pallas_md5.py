@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..audit import audited_entry
+from ..runtime.env import env_is
 from .hashes import _MD5_INIT, _MD5_K, _MD5_S, _blocks_for_width, pad_message
 
 _U32 = jnp.uint32
@@ -81,6 +83,7 @@ def pallas_supported(num_lanes: int, width: int) -> bool:
     )
 
 
+@audited_entry("ops.md5_pallas", kind="pallas_kernel")
 def md5_pallas(
     msg: jnp.ndarray, length: jnp.ndarray, *, interpret: bool = False
 ) -> jnp.ndarray:
@@ -123,9 +126,7 @@ def maybe_pallas_hash_fn(algo: str, hash_fn):
     ``uint8[B, W], int32[B] -> uint32[B, 4]``. Checked at trace-build
     time (the flag selects the compiled program, not a runtime
     branch)."""
-    import os
-
-    if algo == "md5" and os.environ.get("A5GEN_PALLAS") == "1":
+    if algo == "md5" and env_is("A5GEN_PALLAS", "1"):
         # Check the DEVICE platform, not the backend name: the remote
         # tunnel registers a backend whose name differs from its device
         # platform ("tpu" devices behind an "axon" backend).

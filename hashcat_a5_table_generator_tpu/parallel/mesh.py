@@ -31,6 +31,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..audit import audited_entry
 from ..models.attack import (
     AttackSpec,
     make_candidates_body,
@@ -137,6 +138,11 @@ def stack_blocks(
     }
 
 
+@audited_entry(
+    "parallel.make_sharded_crack_step",
+    kind="sharded_body",
+    stages=("expand", "hash", "membership"),
+)
 def make_sharded_crack_step(
     spec: AttackSpec,
     mesh: Mesh,
@@ -198,6 +204,11 @@ def make_sharded_crack_step(
     return jax.jit(mapped)
 
 
+@audited_entry(
+    "parallel.make_sharded_superstep_step",
+    kind="sharded_body",
+    stages=("expand", "hash", "membership"),
+)
 def make_sharded_superstep_step(
     spec: AttackSpec,
     mesh: Mesh,

@@ -39,6 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops.packing import PackedWords
+from ..runtime.env import read_env
 
 __all__ = [
     "PeerLossError",
@@ -351,7 +352,7 @@ def _dcn_timeout() -> float:
     warning) on malformed values — a typo must not crash the pod at the
     END of a sweep, which is when the first collective runs.
     :func:`initialize` calls this too, so the warning fires at startup."""
-    raw = os.environ.get("A5GEN_DCN_TIMEOUT")
+    raw = read_env("A5GEN_DCN_TIMEOUT")
     if raw is None or raw == "":
         return _DEFAULT_DCN_TIMEOUT
     try:

@@ -1,0 +1,50 @@
+"""The single read point for ``A5GEN_*`` environment knobs (GL012).
+
+The engine grew one escape-hatch env var per subsystem —
+``A5GEN_PALLAS``, ``A5GEN_PALLAS_G``, ``A5GEN_PALLAS_INTERPRET``,
+``A5GEN_CASCADE_CLOSE``, ``A5GEN_SUPERSTEP``, ``A5GEN_DCN_TIMEOUT``, … —
+each with its own ad-hoc ``os.environ`` read.  Sprawled reads make the
+knob surface unauditable (graftlint GL012 now flags direct reads outside
+this module).  Every accessor here is a thin, *semantics preserving*
+wrapper — call sites keep their bespoke parsing, vocabularies and
+warnings (the off-spellings deliberately differ per knob and are pinned
+by tests), they just read through one door.
+
+Deliberately dependency-free (stdlib only): ``ops/`` modules import this
+at module top level, and the ``runtime`` package's eager imports
+(checkpoint/progress/sinks) are jax-free, so no import cycle exists.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+#: The engine's one pre-``A5GEN_`` knob, grandfathered by name: renaming
+#: it would break documented user environments (README, PERF.md §10).
+_LEGACY_KNOBS = frozenset({"A5_NATIVE"})
+
+
+def read_env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Raw accessor: ``os.environ.get`` restricted to the engine's knob
+    namespace (``A5GEN_*`` plus the grandfathered ``A5_NATIVE``).  Every
+    other helper in this module funnels through here, so "what can the
+    environment change?" has one grep-able answer."""
+    if not name.startswith("A5GEN_") and name not in _LEGACY_KNOBS:
+        raise ValueError(
+            f"read_env is the A5GEN_* accessor; got {name!r} "
+            "(read other variables with os.environ directly)"
+        )
+    return os.environ.get(name, default)
+
+
+def env_str(name: str, default: str = "") -> str:
+    """String knob with a non-None default."""
+    value = read_env(name)
+    return default if value is None else value
+
+
+def env_is(name: str, literal: str) -> bool:
+    """Exact-match test (``A5GEN_PALLAS == "1"`` and friends)."""
+    return read_env(name) == literal

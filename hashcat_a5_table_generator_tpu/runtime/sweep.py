@@ -528,9 +528,9 @@ class Sweep:
         """Requested steps-per-superstep, or None when the superstep
         executor is off (``SweepConfig.superstep=0`` or
         ``A5GEN_SUPERSTEP=off``)."""
-        import os
+        from .env import env_str
 
-        env = os.environ.get("A5GEN_SUPERSTEP", "")
+        env = env_str("A5GEN_SUPERSTEP")
         # Same off-spellings as A5GEN_CASCADE_CLOSE (expand_suball.
         # close_enabled) — the two escape hatches must share a convention.
         if env.lower() in ("off", "0", "no"):

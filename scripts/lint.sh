@@ -4,14 +4,20 @@
 #   scripts/lint.sh            # run everything available
 #   scripts/lint.sh --require-all   # fail if ruff/mypy are missing (CI)
 #
-# Three layers, any failure fails the script:
+# Four layers, any failure fails the script:
 #   1. ruff      — pyflakes + pycodestyle errors ([tool.ruff] in pyproject)
 #   2. mypy      — typed public API, strict on leaf modules ([tool.mypy])
-#   3. graftlint — repo-specific JAX/Pallas rules (tools/graftlint)
+#   3. graftlint — repo-specific JAX/Pallas AST rules (tools/graftlint),
+#                  over the package, tools/, bench.py AND scripts/
+#   4. graftaudit — jaxpr/HLO-level semantic audits (tools/graftaudit):
+#                  kernel op budgets (KERNEL_BUDGETS.json), dead-stage
+#                  (DCE) detection, float/transfer purity, Pallas bounds.
+#                  Trace/lower only, CPU backend — PERF.md §16.
 #
 # ruff and mypy are OPTIONAL locally (the TPU dev containers bake only the
 # jax toolchain; nothing may be pip-installed there) and mandatory in CI
-# via --require-all. graftlint is stdlib-only and always runs.
+# via --require-all. graftlint is stdlib-only and always runs; graftaudit
+# needs jax (always present — it is the package's core dependency).
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -44,8 +50,15 @@ run_optional ruff ruff check .
 run_optional mypy mypy
 
 echo "== graftlint =="
-if ! python -m tools.graftlint; then
+if ! python -m tools.graftlint hashcat_a5_table_generator_tpu tools \
+        bench.py scripts; then
     echo "lint.sh: graftlint FAILED" >&2
+    fail=1
+fi
+
+echo "== graftaudit =="
+if ! env JAX_PLATFORMS=cpu python -m tools.graftaudit; then
+    echo "lint.sh: graftaudit FAILED" >&2
     fail=1
 fi
 

@@ -1,16 +1,10 @@
 """Roofline input: count the fused kernel's per-candidate VPU op budget.
 
-The fused Pallas kernel (`ops/pallas_expand.py`) is straight-line
-elementwise code on (G, S) = (8, 128k) tiles — every traced op is a VPU
-vector instruction processing one op for each lane it covers.  Counting
-the kernel jaxpr's equations, weighted by how many (8, 128) native
-vregs each op's shape spans, therefore gives ops-per-candidate directly:
-
-    ops/candidate = sum(eqn_vregs) / (G * S / 1024 vregs) / lanes-per-vreg
-                  = weighted_eqns * 1024 / (G * S)
-
-(S = block stride; at the headline geometry stride=128, so G*S = one
-vreg and ops/candidate = plain weighted eqn count.)
+Thin CLI over the repo's ONE op counter —
+``tools.graftaudit.counter.count_kernel_ops`` — which also backs the
+``KERNEL_BUDGETS.json`` gate (``python -m tools.graftaudit``), so the
+roofline numbers, the CI budget pins, and PERF.md §7/§7a can never
+drift apart.  See the counter module for the vreg-weighted model.
 
 That number divided into the VPU's per-chip op rate brackets the
 hashes/s ceiling — see PERF.md §7 for the analysis this feeds.
@@ -24,7 +18,6 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from collections import Counter
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -32,44 +25,10 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-
-
-def count_kernel_ops(jaxpr, g, s):
-    """Weighted eqn count of the pallas kernel jaxpr: each eqn costs
-    ceil(elements / 1024) native (8,128) vregs; ops/candidate normalizes
-    by the tile's own vreg span so sub-tile ops (e.g. (G,1) scalars that
-    still burn a whole vreg) are charged fairly."""
-    tile_vregs = max(1, (g * s) // 1024)
-    total = 0.0
-    by_prim = Counter()
-
-    def walk(jx):
-        nonlocal total
-        for eqn in jx.eqns:
-            # Recurse through call-like wrappers (jnp.where etc. trace as
-            # nested jit eqns) — only leaf primitives are instructions.
-            sub = eqn.params.get("jaxpr")
-            if sub is not None and hasattr(sub, "eqns"):
-                walk(sub)
-                continue
-            if sub is not None and hasattr(getattr(sub, "jaxpr", None),
-                                           "eqns"):
-                walk(sub.jaxpr)
-                continue
-            outs = eqn.outvars
-            elems = max(
-                int(np.prod(v.aval.shape)) if v.aval.shape else 1
-                for v in outs
-            )
-            vregs = max(1, -(-elems // 1024))
-            w = vregs / tile_vregs
-            total += w
-            by_prim[eqn.primitive.name] += w
-
-    walk(jaxpr)
-    return total, by_prim
+from tools.graftaudit.counter import (  # noqa: E402
+    count_kernel_ops,
+    kernel_jaxpr_of,
+)
 
 
 def main():
@@ -78,6 +37,11 @@ def main():
     ap.add_argument("--algo", default="md5")
     ap.add_argument("--stride", type=int, default=128)
     ap.add_argument("--words", type=int, default=256)
+    ap.add_argument("--word-width", type=int, default=None,
+                    help="synthesize WORDS all-lowercase words of this "
+                         "exact byte width instead of the rockyou-like "
+                         "mix (width 60 reproduces the 2-hash-block "
+                         "budget tier: out_width 120)")
     ap.add_argument("--table", default="qwerty-cyrillic",
                     help="built-in layout (qwerty-azerty produces a "
                          "cascade-CLOSED suball plan — the joint-value "
@@ -102,16 +66,21 @@ def main():
     from hashcat_a5_table_generator_tpu.tables.compile import compile_table
     from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
 
-    import sys
-
-    sys.path.insert(0, "/root/repo")
     from bench import synth_wordlist
 
     spec = AttackSpec(mode=args.mode, algo=args.algo,
                       min_substitute=args.min_substitute,
                       max_substitute=args.max_substitute)
     ct = compile_table(get_layout(args.table).to_substitution_map())
-    packed = pack_words(synth_wordlist(args.words))
+    if args.word_width is not None:
+        # The harness's generator, not a copy: --word-width 60 must keep
+        # reproducing the pinned 2-hash-block tier.
+        from tools.graftaudit.harness import long_wordlist
+
+        words = long_wordlist(args.words, args.word_width)
+    else:
+        words = synth_wordlist(args.words)
+    packed = pack_words(words)
     plan = build_plan(spec, ct, packed)
     k = pe.k_vals_for(plan)  # value-select width (joint closure tables)
     nb = 16
@@ -158,14 +127,7 @@ def main():
             **common,
         )
 
-    jpr = jax.make_jaxpr(fn)()
-    # Find the pallas_call eqn and pull its inner kernel jaxpr.
-    inner = None
-    for eqn in jpr.eqns:
-        if eqn.primitive.name == "pallas_call":
-            inner = eqn.params["jaxpr"]
-            break
-    assert inner is not None, "no pallas_call in trace"
+    inner = kernel_jaxpr_of(jax.make_jaxpr(fn)())
     g = pe._G
     ops, by_prim = count_kernel_ops(inner, g, stride)
     closed = getattr(plan, "closed", None)

@@ -49,7 +49,9 @@ def pytest_collection_modifyitems(config, items):
     ``trylast``: the mark plugin's own (trylast) deselection hook runs
     before this conftest one, so ``items`` here is the post-filter
     selection — with the filter intact the guard sees no slow items."""
-    if os.environ.get("A5GEN_FORBID_SLOW") != "1":
+    from hashcat_a5_table_generator_tpu.runtime.env import env_is
+
+    if not env_is("A5GEN_FORBID_SLOW", "1"):
         return
     leaked = [item.nodeid for item in items
               if item.get_closest_marker("slow") is not None]

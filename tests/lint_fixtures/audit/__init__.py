@@ -1,0 +1,9 @@
+"""graftaudit fixture corpus: one deliberately-broken kernel/body per
+semantic check, each with a clean twin (tests/test_graftaudit.py).
+
+Unlike the graftlint corpus (source snippets linted under virtual
+paths), these are REAL traceable jax programs — the audit operates on
+jaxprs and optimized HLO, so the fixtures must actually trace/compile.
+Every fixture is tiny (hundreds of lanes, one or two grid steps): the
+whole corpus traces in seconds on the CPU backend.
+"""

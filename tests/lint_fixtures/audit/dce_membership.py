@@ -1,0 +1,55 @@
+"""Dead-stage fixture: the PERF.md §15 membership-DCE reproduction.
+
+``broken_body`` is the exact trap shape PR 3 found in the kernel bench:
+the loop computes hash + membership but ACCUMULATES ONLY ``n_emitted``,
+so XLA dead-code-eliminates the digest-membership stage (and the hash
+feeding it) from the optimized module — while every parity test stays
+green, because parity tests consume hits.  ``clean_body`` keeps the
+hits live (the production crack-step contract).
+
+Both route through the real ``ops.hashes.md5`` / ``ops.digest_member``
+so the audit's source-metadata stage markers apply.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hashcat_a5_table_generator_tpu.ops.hashes import md5
+from hashcat_a5_table_generator_tpu.ops.membership import (
+    build_digest_set,
+    digest_member,
+)
+
+#: Checked stages: this fixture has no expand stage by construction.
+STAGES = ("hash", "membership")
+
+
+def example_args():
+    ds = build_digest_set([bytes(16), bytes(range(16))], "md5")
+    msgs = jnp.zeros((256, 16), jnp.uint8)
+    lens = jnp.full((256,), 8, jnp.int32)
+    return (
+        msgs, lens, jnp.asarray(ds.rows), jnp.asarray(ds.bitmap),
+    )
+
+
+def clean_body(msgs, lens, rows, bitmap):
+    """Hash + membership with the hit count LIVE (honest contract)."""
+    emit = lens > 0
+    state = md5(msgs, lens)
+    hit = digest_member(state, rows, bitmap) & emit
+    return {
+        "n_emitted": jnp.sum(emit.astype(jnp.int32)),
+        "n_hits": jnp.sum(hit.astype(jnp.int32)),
+    }
+
+
+def broken_body(msgs, lens, rows, bitmap):
+    """The §15 trap: hash + membership traced, but only ``n_emitted``
+    escapes — XLA drops both stages from the optimized module."""
+    emit = lens > 0
+    state = md5(msgs, lens)
+    hit = digest_member(state, rows, bitmap) & emit
+    del hit  # emitted-only accumulator: the membership consumer is gone
+    return {"n_emitted": jnp.sum(emit.astype(jnp.int32))}

@@ -1,0 +1,27 @@
+"""Float-purity fixture: a hash-like reduction that leaks through f32.
+
+``broken_stage`` is the classic accident: ``jnp.mean`` (or a true ``/``)
+promotes uint32 lanes to float32, silently losing bits above 2^24 —
+digests are exact or worthless.  ``clean_stage`` is the integer idiom.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def example_args():
+    return (jnp.zeros((128, 4), jnp.uint32),)
+
+
+def clean_stage(state):
+    """Pure integer mixing (the repo's real kernels look like this)."""
+    acc = state[:, 0] ^ (state[:, 1] << jnp.uint32(7))
+    acc = acc + state[:, 2] * jnp.uint32(0x9E3779B9)
+    return acc ^ state[:, 3]
+
+
+def broken_stage(state):
+    """A float round trip in the middle of uint32 arithmetic."""
+    centered = state - jnp.mean(state, axis=0)  # promotes to float!
+    return centered.astype(jnp.uint32)[:, 0]

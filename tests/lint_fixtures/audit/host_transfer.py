@@ -1,0 +1,35 @@
+"""Host-transfer fixture: a callback smuggled into a scan body.
+
+``broken_sweep`` plants ``jax.debug.print`` inside the ``lax.scan``
+step — a device→host round trip PER STEP, which silently turns the
+superstep executor's one-fetch-per-superstep contract into S hidden
+syncs.  ``clean_sweep`` is the same loop without the callback.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def example_args():
+    return (jnp.arange(64, dtype=jnp.int32),)
+
+
+def clean_sweep(xs):
+    def step(carry, x):
+        carry = carry + jnp.sum(x)
+        return carry, None
+
+    total, _ = jax.lax.scan(step, jnp.int32(0), xs.reshape(8, 8))
+    return total
+
+
+def broken_sweep(xs):
+    def step(carry, x):
+        carry = carry + jnp.sum(x)
+        jax.debug.print("step total {t}", t=carry)  # host sync per step!
+        return carry, None
+
+    total, _ = jax.lax.scan(step, jnp.int32(0), xs.reshape(8, 8))
+    return total

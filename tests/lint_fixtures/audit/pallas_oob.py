@@ -1,0 +1,61 @@
+"""Pallas bounds fixture: a store past the BlockSpec block extent.
+
+``broken_launch``'s kernel writes row 4 of a 4-row output block —
+statically out of bounds, and Pallas does NOT validate static integer
+indices at trace time (only ``pl.dslice`` forms are checked), so on
+chip this clobbers a VMEM neighbor.  ``clean_launch`` writes the last
+valid row.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK = (4, 4, 8)
+
+
+def _launch(kernel, x):
+    return pl.pallas_call(
+        kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec(_BLOCK, lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec(_BLOCK, lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, 4, 8), jnp.int32),
+        interpret=True,
+    )(x)
+
+
+def example_args():
+    return (jnp.zeros((8, 4, 8), jnp.int32),)
+
+
+def clean_launch(x):
+    def kernel(x_ref, o_ref):
+        o_ref[:, 3, :] = x_ref[:, 0, :] + 1  # last valid row
+
+    return _launch(kernel, x)
+
+
+def broken_launch(x):
+    def kernel(x_ref, o_ref):
+        o_ref[:, 4, :] = x_ref[:, 0, :] + 1  # one past the block extent
+
+    return _launch(kernel, x)
+
+
+def broken_launch_dslice(x):
+    """A traced-CONSTANT dslice start: NDIndexer cannot validate it at
+    trace time (unlike a plain-int ``pl.dslice``, which raises), so the
+    start arrives in the kernel jaxpr as a Literal holding a 0-d array —
+    the audit must still resolve it and flag rows [2, 6) > 4."""
+
+    def kernel(x_ref, o_ref):
+        pl.store(
+            o_ref,
+            (slice(None), pl.dslice(jnp.int32(2), 3), slice(None)),
+            jnp.broadcast_to(x_ref[:, 0, :][:, None, :], (4, 3, 8)) + 1,
+        )
+
+    return _launch(kernel, x)

@@ -1,0 +1,43 @@
+"""Pallas race fixture: two grid steps writing the same output block.
+
+``broken_launch`` pins the OUTPUT index_map to block 0 while the grid
+has two steps — on TPU the sequential grid makes step 1 silently
+overwrite step 0 (and interpret mode happens to agree), which is a
+race/correctness bug whenever the revisit is unintended; no kernel in
+this repo accumulates across grid steps.  ``clean_launch`` maps each
+grid step to its own block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_BLOCK = (4, 8)
+
+
+def _launch(out_index_map, x):
+    def kernel(x_ref, o_ref):
+        o_ref[:, :] = x_ref[:, :] * 2
+
+    return pl.pallas_call(
+        kernel,
+        grid=(2,),
+        in_specs=[pl.BlockSpec(_BLOCK, lambda i: (i, 0))],
+        out_specs=pl.BlockSpec(_BLOCK, out_index_map),
+        out_shape=jax.ShapeDtypeStruct((8, 8), jnp.int32),
+        interpret=True,
+    )(x)
+
+
+def example_args():
+    return (jnp.zeros((8, 8), jnp.int32),)
+
+
+def clean_launch(x):
+    return _launch(lambda i: (i, 0), x)
+
+
+def broken_launch(x):
+    return _launch(lambda i: (0, 0), x)  # every step writes block 0

@@ -1,0 +1,22 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL012 must flag: direct reads of A5GEN_* knobs outside runtime/env.py.
+
+Every read form counts — ``os.environ.get``, ``os.getenv``, and a
+``Load``-context subscript; sprawled reads fragment the knob surface
+and let off-spelling vocabularies drift between subsystems.
+"""
+
+import os
+from os import environ
+
+
+def kernel_enabled() -> bool:
+    return os.environ.get("A5GEN_PALLAS", "") != "off"  # direct read
+
+
+def superstep_steps() -> str:
+    return os.getenv("A5GEN_SUPERSTEP", "auto")  # direct read
+
+
+def dcn_timeout() -> str:
+    return environ["A5GEN_DCN_TIMEOUT"]  # direct subscript read

@@ -1,0 +1,29 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/ops/_fixture.py
+"""GL012 stays quiet on the idiom: reads through the runtime/env.py
+accessor (read_env/env_str/env_is), env WRITES (probe scripts pinning a
+configuration), and reads of non-A5GEN variables (not this rule's
+surface)."""
+
+import os
+
+from ..runtime.env import env_is, env_str, read_env
+
+
+def kernel_enabled() -> bool:
+    return env_str("A5GEN_PALLAS").lower() != "off"
+
+
+def superstep_steps() -> str:
+    return read_env("A5GEN_SUPERSTEP") or "auto"
+
+
+def interpret_forced() -> bool:
+    return env_is("A5GEN_PALLAS_INTERPRET", "1")
+
+
+def pin_for_probe() -> None:
+    os.environ["A5GEN_PALLAS"] = "expand"  # a WRITE: probe plumbing
+
+
+def unrelated() -> str:
+    return os.environ.get("XLA_FLAGS", "")  # not an A5GEN_ knob

@@ -1,0 +1,302 @@
+"""graftaudit corpus: every semantic check must FIRE on its
+deliberately-broken fixture and stay quiet on the clean twin.
+
+The fixtures (tests/lint_fixtures/audit/) are real traceable jax
+programs — the audit operates on jaxprs and optimized HLO, not source —
+kept tiny so the whole suite traces/compiles in seconds on the CPU
+backend.  The full-repo audit itself (every registered entry, the
+KERNEL_BUDGETS.json gate) runs as a blocking CI step; here we pin the
+check MACHINERY plus the cheap repo-level contracts (registry/harness
+coverage, one budget tier against the committed pin).
+"""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from tools.graftaudit import (  # noqa: E402
+    AuditFinding,
+    audit_float_purity,
+    audit_host_transfers,
+    audit_pallas,
+    audit_stages,
+    compare_budgets,
+    count_traced_kernel,
+    load_budgets,
+)
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent / "lint_fixtures" / "audit"
+
+
+def _fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        f"audit_fixture_{name}", FIXTURE_DIR / f"{name}.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# Dead-stage detection (the PERF.md §15 membership-DCE reproduction)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadStage:
+    def test_broken_body_loses_membership(self):
+        mod = _fixture("dce_membership")
+        findings = audit_stages(
+            mod.broken_body, mod.example_args(), "fixture.dce", mod.STAGES
+        )
+        assert findings, "membership DCE not detected"
+        assert all(f.check == "dead-stage" for f in findings)
+        dead = {f.message.split(" ")[1] for f in findings}
+        assert "membership" in dead  # the §15 trap itself
+
+    def test_clean_body_keeps_all_stages(self):
+        mod = _fixture("dce_membership")
+        findings = audit_stages(
+            mod.clean_body, mod.example_args(), "fixture.dce", mod.STAGES
+        )
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# Float purity
+# ---------------------------------------------------------------------------
+
+
+class TestFloatLeak:
+    def test_broken_stage_flagged(self):
+        mod = _fixture("float_leak")
+        findings = audit_float_purity(
+            mod.broken_stage, mod.example_args(), "fixture.float"
+        )
+        assert len(findings) == 1
+        assert findings[0].check == "float-leak"
+        assert "float" in findings[0].message
+
+    def test_clean_stage_passes(self):
+        mod = _fixture("float_leak")
+        assert audit_float_purity(
+            mod.clean_stage, mod.example_args(), "fixture.float"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Host transfers in loop bodies
+# ---------------------------------------------------------------------------
+
+
+class TestHostTransfer:
+    def test_callback_in_scan_flagged_as_per_step(self):
+        mod = _fixture("host_transfer")
+        findings = audit_host_transfers(
+            mod.broken_sweep, mod.example_args(), "fixture.transfer"
+        )
+        assert findings, "debug.print in scan body not detected"
+        assert all(f.check == "host-transfer" for f in findings)
+        assert any("per step" in f.message for f in findings)
+
+    def test_clean_scan_passes(self):
+        mod = _fixture("host_transfer")
+        assert audit_host_transfers(
+            mod.clean_sweep, mod.example_args(), "fixture.transfer"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Pallas bounds + grid overlap
+# ---------------------------------------------------------------------------
+
+
+class TestPallasBounds:
+    def test_oob_store_flagged(self):
+        mod = _fixture("pallas_oob")
+        findings = audit_pallas(
+            mod.broken_launch, "fixture.oob", *mod.example_args()
+        )
+        assert findings, "static OOB store not detected"
+        assert all(f.check == "pallas-bounds" for f in findings)
+        assert any("index 4" in f.message for f in findings)
+
+    def test_traced_constant_dslice_oob_flagged(self):
+        """A Literal (0-d array) dslice start must still resolve
+        statically — pallas itself cannot validate this form."""
+        mod = _fixture("pallas_oob")
+        findings = audit_pallas(
+            mod.broken_launch_dslice, "fixture.oob", *mod.example_args()
+        )
+        assert findings, "traced-constant OOB dslice not detected"
+        assert all(f.check == "pallas-bounds" for f in findings)
+
+    def test_in_bounds_store_passes(self):
+        mod = _fixture("pallas_oob")
+        assert audit_pallas(
+            mod.clean_launch, "fixture.oob", *mod.example_args()
+        ) == []
+
+    def test_overlapping_grid_writes_flagged(self):
+        mod = _fixture("pallas_overlap")
+        findings = audit_pallas(
+            mod.broken_launch, "fixture.overlap", *mod.example_args()
+        )
+        assert findings, "overlapping grid writes not detected"
+        assert all(f.check == "pallas-race" for f in findings)
+        assert any("not injective" in f.message for f in findings)
+
+    def test_injective_grid_passes(self):
+        mod = _fixture("pallas_overlap")
+        assert audit_pallas(
+            mod.clean_launch, "fixture.overlap", *mod.example_args()
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Budget gate (pure comparison logic + one measured tier vs the pin)
+# ---------------------------------------------------------------------------
+
+
+class TestBudgets:
+    BUDGETS = {
+        "tolerance_pct": 2.0,
+        "kernels": {
+            "scalar": {"ops_per_candidate": 1000.0, "config": ""},
+            "ghost": {"ops_per_candidate": 50.0, "config": ""},
+        },
+    }
+
+    def test_drift_beyond_tolerance_fails_both_directions(self):
+        for measured, sign in ((1025.0, "+"), (975.0, "-")):
+            findings, rows = compare_budgets(
+                {"scalar": measured, "ghost": 50.0}, self.BUDGETS
+            )
+            budget = [f for f in findings if f.check == "budget"]
+            assert len(budget) == 1 and "scalar" == budget[0].entry
+            assert sign in budget[0].message
+            assert any(r[0] == "scalar" and r[4] == "DRIFT" for r in rows)
+
+    def test_within_tolerance_passes(self):
+        findings, rows = compare_budgets(
+            {"scalar": 1015.0, "ghost": 50.0}, self.BUDGETS
+        )
+        assert [f for f in findings if f.check == "budget"] == []
+        assert all(r[4] == "ok" for r in rows)
+
+    def test_unpinned_and_unmeasured_are_config_findings(self):
+        findings, _ = compare_budgets({"scalar": 1000.0}, self.BUDGETS)
+        assert any(
+            f.check == "config" and f.entry == "ghost" for f in findings
+        )
+        findings, _ = compare_budgets(
+            {"scalar": 1000.0, "ghost": 50.0, "new": 10.0}, self.BUDGETS
+        )
+        assert any(
+            f.check == "config" and f.entry == "new" for f in findings
+        )
+
+    def test_drifted_pin_fails_the_real_cli(self):
+        """End-to-end budget-drift fixture: the CLI against a budgets
+        file whose scalar pin is ~6% off must exit 1 with a named
+        ``budget scalar`` finding (the other five tiers stay green, so
+        the failure is attributable)."""
+        proc = subprocess.run(
+            [
+                sys.executable, "-m", "tools.graftaudit",
+                "--select", "budgets",
+                "--budgets", str(FIXTURE_DIR / "budgets_drift.json"),
+            ],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "budget scalar:" in proc.stdout
+        assert "DRIFT" in proc.stderr  # the diff table names the tier
+
+    def test_committed_pin_matches_live_scalar_count(self):
+        """The cheap end-to-end anchor: the committed KERNEL_BUDGETS.json
+        'scalar' tier must match a live trace+count (the CI graftaudit
+        step checks every tier; this keeps the contract in tier-1)."""
+        from tools.graftaudit import harness
+
+        budgets = load_budgets()
+        cfg = harness.budget_configs()["scalar"]
+        fn, g, s = cfg.build()
+        ops, _ = count_traced_kernel(fn, g, s)
+        pinned = budgets["kernels"]["scalar"]["ops_per_candidate"]
+        tol = budgets["tolerance_pct"] / 100.0
+        assert abs(ops - pinned) <= pinned * tol
+
+
+# ---------------------------------------------------------------------------
+# Registry/harness coverage + CLI contract
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_every_registered_entry_has_a_config(self):
+        from tools.graftaudit import harness
+
+        findings = harness.coverage_findings()
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_registry_spans_all_three_layers(self):
+        """The audit surface covers ops/, models/ AND parallel/ — losing
+        a layer's registrations must fail loudly."""
+        from tools.graftaudit import harness
+
+        modules = {e.module for e in harness.registered_entries().values()}
+        for layer in (".ops.", ".models.", ".parallel."):
+            assert any(layer in m for m in modules), f"no entries in {layer}"
+
+    def test_finding_render_contract(self):
+        f = AuditFinding("budget", "scalar", "drifted")
+        assert f.render() == "budget scalar: drifted"
+
+    def test_reload_of_audited_module_is_idempotent(self):
+        """importlib.reload re-executes @audited_entry decorations (a
+        pattern tests/test_native.py already uses); same module+qualname
+        must re-register, not raise."""
+        import importlib
+
+        from hashcat_a5_table_generator_tpu.ops import hashes
+
+        importlib.reload(hashes)  # raises if registration isn't idempotent
+
+    def test_conflicting_registration_still_raises(self):
+        from hashcat_a5_table_generator_tpu.audit import audited_entry
+
+        with pytest.raises(ValueError, match="registered twice"):
+            @audited_entry("ops.hashes.md5", kind="integer_stage")
+            def md5():  # a DIFFERENT callable claiming the name
+                pass
+
+
+@pytest.mark.slow
+class TestFullAudit:
+    def test_repo_audit_clean_and_under_budget(self):
+        """`python -m tools.graftaudit` passes clean on the repo inside
+        the 120 s acceptance budget (CI runs this as a blocking step;
+        slow-marked here to keep tier-1 wall down)."""
+        import time
+
+        t0 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftaudit"],
+            cwd=REPO_ROOT,
+            capture_output=True,
+            text=True,
+            timeout=600,
+        )
+        elapsed = time.monotonic() - t0
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert elapsed < 120, f"audit took {elapsed:.0f}s (budget 120s)"

@@ -24,7 +24,9 @@ from collections import Counter
 
 import pytest
 
-REFERENCE_BIN = os.environ.get("A5GEN_REFERENCE_BIN")
+from hashcat_a5_table_generator_tpu.runtime.env import read_env
+
+REFERENCE_BIN = read_env("A5GEN_REFERENCE_BIN")
 
 pytestmark = pytest.mark.skipif(
     not REFERENCE_BIN or not os.path.isfile(REFERENCE_BIN),

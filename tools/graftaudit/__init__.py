@@ -1,0 +1,78 @@
+"""graftaudit — jaxpr/HLO-level semantic audits for the TPU hash engine.
+
+Where graftlint (``tools/graftlint``) reads SOURCE, this tier reads what
+XLA actually compiles: it traces and lowers every ``@audited_entry``
+kernel and pipeline body (``hashcat_a5_table_generator_tpu.audit``) on
+the CPU backend — trace/lower only, nothing executes — and checks the
+semantic invariants AST analysis cannot see:
+
+* pinned per-kernel op budgets (``KERNEL_BUDGETS.json``, ±2%),
+* dead-stage detection (the PERF.md §15 membership-DCE trap),
+* float purity of the integer hash pipeline,
+* no device→host callbacks inside compiled sweep/superstep bodies,
+* Pallas static bounds and grid write-overlap (race) checks.
+
+Typed public API::
+
+    from tools.graftaudit import (
+        AuditFinding,
+        audit_float_purity, audit_host_transfers,
+        audit_pallas, audit_stage_text, stage_survival,
+        count_kernel_ops,
+    )
+
+Run as ``python -m tools.graftaudit`` (see ``scripts/lint.sh`` and the
+CI ``graftaudit`` job); ``--update-budgets`` is the deliberate
+budget-update workflow (PERF.md §16).
+"""
+
+from __future__ import annotations
+
+from .bounds import audit_pallas, audit_pallas_jaxpr
+from .budgets import (
+    DEFAULT_BUDGETS_PATH,
+    compare_budgets,
+    load_budgets,
+    render_table,
+    save_budgets,
+)
+from .counter import count_kernel_ops, count_traced_kernel, kernel_jaxpr_of
+from .findings import CHECKS, AuditFinding
+from .purity import audit_float_purity, audit_float_purity_jaxpr
+from .stages import (
+    STAGE_MARKERS,
+    audit_stage_text,
+    audit_stages,
+    compiled_text,
+    stage_survival,
+)
+from .transfers import (
+    TRANSFER_PRIMITIVES,
+    audit_host_transfers,
+    audit_host_transfers_jaxpr,
+)
+
+__all__ = [
+    "AuditFinding",
+    "CHECKS",
+    "DEFAULT_BUDGETS_PATH",
+    "STAGE_MARKERS",
+    "TRANSFER_PRIMITIVES",
+    "audit_float_purity",
+    "audit_float_purity_jaxpr",
+    "audit_host_transfers",
+    "audit_host_transfers_jaxpr",
+    "audit_pallas",
+    "audit_pallas_jaxpr",
+    "audit_stage_text",
+    "audit_stages",
+    "compare_budgets",
+    "compiled_text",
+    "count_kernel_ops",
+    "count_traced_kernel",
+    "kernel_jaxpr_of",
+    "load_budgets",
+    "render_table",
+    "save_budgets",
+    "stage_survival",
+]

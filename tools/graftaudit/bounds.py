@@ -1,0 +1,239 @@
+"""Check (d): Pallas memory safety — static bounds + grid write overlap.
+
+Compiled Pallas has no bounds checking: an out-of-range ``pl.load`` /
+``pl.store`` (or ``ref[...]`` sugar) reads or clobbers whatever VMEM
+neighbors the block, and interpret-mode CPU tests won't necessarily
+catch it (numpy wraps negative indices; masked OOB lanes can alias into
+valid data).  Two static checks over the traced kernel jaxpr:
+
+* **bounds** — every ``get``/``swap``/``masked_load``/``masked_swap``
+  indexer with static components must stay inside the ref's block shape
+  (this repo's kernels index with Python-static slices/ints, so almost
+  everything is statically decidable; dynamic indices are skipped — the
+  check is conservative, never wrong).
+* **race** — each *output* BlockSpec ``index_map`` must be injective
+  over the grid: two grid steps mapping to the same output block means
+  the second silently overwrites the first (on TPU grids are sequential,
+  so this "works" nondeterministically in interpret mode and corrupts
+  results on chip when the revisit is unintended — no kernel in this
+  repo accumulates across grid steps).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Iterator, List, Optional
+
+from .findings import AuditFinding
+
+#: Kernel-level ref access primitives (name -> index of the ref invar,
+#: params key holding the indexer pytree).
+_ACCESS_PRIMS = {
+    "get": ("tree",),
+    "swap": ("tree",),
+    "masked_load": ("args_tree",),
+    "masked_swap": ("args_tree",),
+}
+
+#: Grid enumeration cap for the injectivity check; audit grids are tiny
+#: (a handful of steps), the cap only guards against someone auditing a
+#: production-size launch.
+_MAX_GRID_POINTS = 4096
+
+
+def _indexers_of(eqn) -> Iterator:
+    """NDIndexer objects of one access eqn, robust to the leaf layout
+    differences between ``get``/``swap`` (tree) and the masked forms
+    (args_tree, value interleaved)."""
+    import jax.tree_util as jtu
+
+    (tree_key,) = _ACCESS_PRIMS[eqn.primitive.name]
+    tree = eqn.params.get(tree_key)
+    if tree is None:
+        return
+    leaves = list(eqn.invars[1:])
+    unflat = None
+    # Leaf layouts differ by primitive: get/swap's ``tree`` spans only
+    # the indexer leaves (ref and value ride outside it), while the
+    # masked forms' ``args_tree`` flattens (ref, indexers, value, mask)
+    # — the ref itself is a leaf.  Try the layouts until the treedef
+    # accepts one; the NDIndexer scan below ignores non-indexer leaves.
+    for cand in (leaves, list(eqn.invars), leaves[1:], leaves[:-1]):
+        try:
+            unflat = jtu.tree_unflatten(tree, cand)
+            break
+        except ValueError:
+            continue
+    if unflat is None:
+        return
+    stack = [unflat]
+    while stack:
+        node = stack.pop()
+        if type(node).__name__ == "NDIndexer":
+            yield node
+        elif isinstance(node, (tuple, list)):
+            stack.extend(node)
+
+
+def _static_int(x) -> Optional[int]:
+    """Python int of a static index component, else None (dynamic).
+
+    Handles plain ints, numpy integer scalars, and jax ``Literal``s —
+    whose ``.val`` is a 0-d numpy ARRAY, not a scalar (a traced-constant
+    ``pl.dslice(jnp.int32(6), ...)`` start arrives that way)."""
+    if isinstance(x, bool):
+        return None
+    if isinstance(x, int):
+        return x
+    import numpy as np
+
+    for cand in (x, getattr(x, "val", None)):  # x itself, or Literal.val
+        if isinstance(cand, bool):
+            return None
+        if isinstance(cand, (int, np.integer)):
+            return int(cand)
+        if (
+            isinstance(cand, np.ndarray)
+            and cand.ndim == 0
+            and np.issubdtype(cand.dtype, np.integer)
+        ):
+            return int(cand)
+    return None
+
+
+def _check_indexer(nd, ref_shape, where: str) -> List[str]:
+    """Human-readable violations of one NDIndexer against a ref shape."""
+    problems: List[str] = []
+    indices = getattr(nd, "indices", ())
+    for dim, (idx, size) in enumerate(zip(indices, ref_shape)):
+        if type(idx).__name__ == "Slice":
+            start = _static_int(getattr(idx, "start", None))
+            length = _static_int(getattr(idx, "size", None))
+            stride = _static_int(getattr(idx, "stride", None)) or 1
+            if start is None or length is None:
+                continue  # dynamic slice start: not statically decidable
+            last = start + (length - 1) * stride
+            if start < 0 or (length > 0 and last >= size):
+                problems.append(
+                    f"dim {dim}: slice [{start}:{start + length * stride}"
+                    f":{stride}] outside block extent {size} ({where})"
+                )
+        else:
+            point = _static_int(idx)
+            if point is None:
+                continue  # dynamic scalar index
+            if not 0 <= point < size:
+                problems.append(
+                    f"dim {dim}: index {point} outside block extent "
+                    f"{size} ({where})"
+                )
+    return problems
+
+
+def _kernel_access_findings(kernel_jaxpr, entry: str) -> List[AuditFinding]:
+    findings: List[AuditFinding] = []
+    for eqn in kernel_jaxpr.eqns:
+        name = eqn.primitive.name
+        if name not in _ACCESS_PRIMS:
+            # Recurse into nested control flow inside the kernel body.
+            for val in eqn.params.values():
+                for cand in (
+                    val if isinstance(val, (tuple, list)) else (val,)
+                ):
+                    inner = (
+                        cand if hasattr(cand, "eqns")
+                        else getattr(cand, "jaxpr", None)
+                    )
+                    if hasattr(inner, "eqns"):
+                        findings.extend(
+                            _kernel_access_findings(inner, entry)
+                        )
+            continue
+        ref_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()) or ())
+        if not ref_shape:
+            continue
+        kind = "load" if name in ("get", "masked_load") else "store"
+        for nd in _indexers_of(eqn):
+            for problem in _check_indexer(nd, ref_shape, kind):
+                findings.append(
+                    AuditFinding(
+                        "pallas-bounds", entry,
+                        f"{kind} {problem}; block shape "
+                        f"{ref_shape} (BlockSpec)",
+                    )
+                )
+    return findings
+
+
+def _race_findings(eqn, entry: str) -> List[AuditFinding]:
+    import jax
+
+    gm = eqn.params.get("grid_mapping")
+    if gm is None:
+        return []
+    grid = tuple(getattr(gm, "grid", ()) or ())
+    if not grid or not all(isinstance(d, int) for d in grid):
+        return []  # dynamic grid: not statically decidable
+    total = 1
+    for d in grid:
+        total *= max(1, d)
+    if total > _MAX_GRID_POINTS or total <= 1:
+        return []
+    findings: List[AuditFinding] = []
+    for out_i, bm in enumerate(gm.block_mappings_output):
+        im = bm.index_map_jaxpr
+        seen = {}
+        for point in itertools.product(*(range(d) for d in grid)):
+            try:
+                block = tuple(
+                    int(x)
+                    for x in jax.core.eval_jaxpr(
+                        im.jaxpr, im.consts, *point
+                    )
+                )
+            except Exception:  # dynamic index map: skip this output
+                break
+            if block in seen and seen[block] != point:
+                findings.append(
+                    AuditFinding(
+                        "pallas-race", entry,
+                        f"output {out_i}: grid steps {seen[block]} and "
+                        f"{point} both write block {block} "
+                        f"(index_map not injective over grid {grid}) — "
+                        "overlapping grid writes race",
+                    )
+                )
+                break
+            seen[block] = point
+    return findings
+
+
+def audit_pallas_jaxpr(closed_jaxpr, entry: str) -> List[AuditFinding]:
+    """Bounds + race findings for every pallas_call in a traced
+    computation."""
+    from .counter import iter_pallas_eqns
+
+    findings: List[AuditFinding] = []
+    for eqn in iter_pallas_eqns(closed_jaxpr.jaxpr):
+        findings.extend(
+            _kernel_access_findings(eqn.params["jaxpr"], entry)
+        )
+        findings.extend(_race_findings(eqn, entry))
+    return findings
+
+
+def audit_pallas(fn, entry: str, *args) -> List[AuditFinding]:
+    """Trace ``fn(*args)`` and audit every pallas_call inside."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        return [
+            AuditFinding(
+                "config", entry,
+                f"failed to trace for pallas audit: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+    return audit_pallas_jaxpr(closed, entry)

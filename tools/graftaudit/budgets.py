@@ -1,0 +1,162 @@
+"""Check (a): pinned ops/candidate budgets for every audited kernel.
+
+``KERNEL_BUDGETS.json`` (repo root) pins the jaxpr-counted VPU op budget
+of each fused-kernel tier at the PERF.md §7a geometry.  The audit
+re-counts every tier and fails on drift beyond the pinned tolerance —
+both directions: a silent +2% is a perf regression, a silent −2% means
+the kernel changed and the perf narrative (and this file) are stale.
+
+Deliberate updates are one command:
+
+    python -m tools.graftaudit --update-budgets
+
+which rewrites the file from the current counts; the diff then lands in
+review next to the kernel change that caused it (workflow: PERF.md §16).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from .findings import AuditFinding
+
+#: Repo-root budgets file (the committed pin).
+DEFAULT_BUDGETS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "KERNEL_BUDGETS.json",
+)
+
+#: Allowed relative drift before the audit fails, percent.
+DEFAULT_TOLERANCE_PCT = 2.0
+
+
+def load_budgets(path: str = DEFAULT_BUDGETS_PATH) -> dict:
+    with open(path, "r", encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def save_budgets(
+    measured: Dict[str, float],
+    descriptions: Dict[str, str],
+    path: str = DEFAULT_BUDGETS_PATH,
+    tolerance_pct: float = DEFAULT_TOLERANCE_PCT,
+) -> None:
+    """Rewrite the budgets file from current counts (the deliberate
+    update workflow).  Counts are stored to 0.1 op — the counter is
+    deterministic, sub-op noise would only churn diffs."""
+    doc = {
+        "_comment": (
+            "Pinned per-candidate VPU op budgets for the fused kernels "
+            "(tools/graftaudit, PERF.md §16). Counted from the kernel "
+            "jaxpr at the §7a geometry; CI fails on drift beyond "
+            "tolerance_pct. Deliberate update: "
+            "python -m tools.graftaudit --update-budgets"
+        ),
+        "tolerance_pct": tolerance_pct,
+        "kernels": {
+            key: {
+                "ops_per_candidate": round(measured[key], 1),
+                "config": descriptions.get(key, ""),
+            }
+            for key in sorted(measured)
+        },
+    }
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def compare_budgets(
+    measured: Dict[str, float],
+    budgets: dict,
+    failed: "frozenset[str] | set[str]" = frozenset(),
+) -> Tuple[List[AuditFinding], List[Tuple[str, float, float, float, str]]]:
+    """Measured vs pinned.  Returns ``(findings, rows)`` where each row
+    is ``(key, pinned, measured, drift_pct, verdict)`` — the CI summary
+    table renders rows for EVERY tier, drifted or not.  ``failed``:
+    keys whose config exists but crashed (already reported by the
+    caller) — they get a FAILED row, not misleading delete-the-pin
+    advice."""
+    tol = float(budgets.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
+    pinned = budgets.get("kernels", {})
+    findings: List[AuditFinding] = []
+    rows: List[Tuple[str, float, float, float, str]] = []
+
+    for key in sorted(set(pinned) | set(measured) | set(failed)):
+        if key in failed:
+            want = pinned.get(key, {}).get(
+                "ops_per_candidate", float("nan")
+            )
+            rows.append((key, float(want), float("nan"), float("nan"),
+                         "FAILED"))
+            continue
+        if key not in measured:
+            findings.append(
+                AuditFinding(
+                    "config", key,
+                    "pinned in KERNEL_BUDGETS.json but no audit config "
+                    "measures it (delete the pin or add the harness "
+                    "config)",
+                )
+            )
+            continue
+        if key not in pinned:
+            findings.append(
+                AuditFinding(
+                    "config", key,
+                    "audited kernel has no pinned budget; run "
+                    "python -m tools.graftaudit --update-budgets and "
+                    "commit KERNEL_BUDGETS.json",
+                )
+            )
+            rows.append((key, float("nan"), measured[key], float("nan"),
+                         "UNPINNED"))
+            continue
+        want = float(pinned[key]["ops_per_candidate"])
+        got = measured[key]
+        drift = (got - want) / want * 100.0 if want else float("inf")
+        ok = abs(drift) <= tol
+        rows.append((key, want, got, drift, "ok" if ok else "DRIFT"))
+        if not ok:
+            findings.append(
+                AuditFinding(
+                    "budget", key,
+                    f"ops/candidate {got:.1f} vs pinned {want:.1f} "
+                    f"({drift:+.2f}%, tolerance ±{tol:g}%). "
+                    "If deliberate: python -m tools.graftaudit "
+                    "--update-budgets and commit the diff with the "
+                    "kernel change (PERF.md §16).",
+                )
+            )
+    return findings, rows
+
+
+def render_table(rows, markdown: bool = False) -> str:
+    """The per-kernel budget diff table (CLI stderr + CI job summary)."""
+    header = ("kernel", "pinned", "measured", "drift", "verdict")
+    body = [
+        (
+            key,
+            "-" if pinned != pinned else f"{pinned:.1f}",  # NaN -> "-"
+            "-" if got != got else f"{got:.1f}",
+            "-" if drift != drift else f"{drift:+.2f}%",
+            verdict,
+        )
+        for key, pinned, got, drift, verdict in rows
+    ]
+    if markdown:
+        lines = [
+            "| " + " | ".join(header) + " |",
+            "|" + "|".join("---" for _ in header) + "|",
+        ]
+        lines += ["| " + " | ".join(r) + " |" for r in body]
+        return "\n".join(lines)
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in body)) if body
+        else len(header[i])
+        for i in range(len(header))
+    ]
+    fmt = "  ".join(f"{{:<{w}}}" for w in widths)
+    return "\n".join([fmt.format(*header)] + [fmt.format(*r) for r in body])

@@ -1,0 +1,351 @@
+"""graftaudit command line: ``python -m tools.graftaudit``.
+
+The semantic audit tier (PERF.md §16): traces and XLA-lowers every
+``@audited_entry`` kernel/body on the CPU backend — never executing
+anything — and checks
+
+* ``budget``        pinned ops/candidate per kernel (KERNEL_BUDGETS.json, ±tol)
+* ``dead-stage``    expand/hash/membership survive XLA optimization (§15 trap)
+* ``float-leak``    integer hash pipeline stays float-free
+* ``host-transfer`` no callbacks inside compiled sweep/superstep bodies
+* ``pallas``        static load/store bounds + grid write-overlap
+
+Exit codes: 0 clean, 1 findings, 2 usage error — same contract as
+graftlint, keyed on by ``scripts/lint.sh`` and CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import List, Optional, Sequence
+
+#: Check-group names accepted by ``--select``.
+CHECK_GROUPS = ("budgets", "stages", "purity", "transfers", "pallas")
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftaudit",
+        description=(
+            "jaxpr/HLO-level semantic audit: kernel op budgets, "
+            "dead-stage (DCE) detection, float/transfer purity, Pallas "
+            "bounds & race checks. Trace/lower only — runs entirely on "
+            "the CPU backend."
+        ),
+    )
+    parser.add_argument(
+        "--select",
+        metavar="GROUPS",
+        help=f"comma-separated check groups (default: all of "
+             f"{','.join(CHECK_GROUPS)})",
+    )
+    parser.add_argument(
+        "--budgets",
+        metavar="PATH",
+        help="KERNEL_BUDGETS.json to check against (default: repo root)",
+    )
+    parser.add_argument(
+        "--update-budgets",
+        action="store_true",
+        help="rewrite the budgets file from current counts (the "
+             "deliberate-update workflow, PERF.md §16) and exit 0",
+    )
+    parser.add_argument(
+        "--list-entries",
+        action="store_true",
+        help="print the audited-entry registry and exit",
+    )
+    parser.add_argument(
+        "--summary",
+        metavar="PATH",
+        help="append the markdown budget diff table to PATH (CI: pass "
+             "\"$GITHUB_STEP_SUMMARY\")",
+    )
+    return parser
+
+
+def _selected(select: Optional[str]) -> List[str]:
+    if not select:
+        return list(CHECK_GROUPS)
+    groups = [g.strip() for g in select.split(",") if g.strip()]
+    unknown = [g for g in groups if g not in CHECK_GROUPS]
+    if unknown:
+        raise ValueError(
+            f"unknown check group(s): {', '.join(unknown)} "
+            f"(want {', '.join(CHECK_GROUPS)})"
+        )
+    return groups
+
+
+def _list_entries() -> None:
+    from . import harness
+
+    entries = harness.registered_entries()
+    budgets = harness.budget_configs()
+    for name in sorted(entries):
+        e = entries[name]
+        extra = ""
+        if e.budget_keys:
+            extra = f"  budgets={','.join(e.budget_keys)}"
+        if e.stages:
+            extra += f"  stages={','.join(e.stages)}"
+        print(f"{e.kind:<14} {name}  [{e.module}]{extra}")
+    print(f"{len(entries)} entries, {len(budgets)} budget tiers")
+
+
+def run_audit(
+    groups: Sequence[str],
+    budgets_path: Optional[str] = None,
+    update_budgets: bool = False,
+    summary_path: Optional[str] = None,
+) -> int:
+    """The full audit; returns the process exit code."""
+    from . import budgets as budgets_mod
+    from . import harness
+    from .findings import AuditFinding
+
+    t0 = time.monotonic()
+    findings: List[AuditFinding] = []
+    entries = harness.registered_entries()
+    bcfgs = harness.budget_configs()
+    bodycfgs = harness.body_configs()
+    stagecfgs = harness.stage_configs()
+    extracfgs = harness.extra_kernel_configs()
+
+    # -- registry/harness sync: every entry must be audited ----------------
+    findings.extend(harness.coverage_findings())
+
+    path = budgets_path or budgets_mod.DEFAULT_BUDGETS_PATH
+
+    # -- trace each budget config ONCE; budgets/pallas/purity all read the
+    # -- same closed jaxpr (tracing is the expensive step in the 120 s
+    # -- budget; a failed build is one finding, not one per consumer)
+    traced = {}  # key -> (closed_jaxpr, g, s)
+    need_budget_counts = "budgets" in groups or update_budgets
+    if need_budget_counts or "pallas" in groups or "purity" in groups:
+        import jax
+
+        for key, cfg in bcfgs.items():
+            try:
+                fn, g, s = cfg.build()
+                traced[key] = (jax.make_jaxpr(fn)(), g, s)
+            except Exception as exc:  # noqa: BLE001 — report, don't crash
+                findings.append(
+                    AuditFinding(
+                        "config", key,
+                        f"budget config failed to trace: "
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                )
+
+    if need_budget_counts:
+        from .counter import count_kernel_ops, kernel_jaxpr_of
+
+        measured = {}
+        for key, (closed, g, s) in traced.items():
+            try:
+                measured[key] = count_kernel_ops(
+                    kernel_jaxpr_of(closed), g, s
+                )[0]
+            except ValueError as exc:  # no pallas_call in the trace
+                findings.append(AuditFinding("config", key, str(exc)))
+        if update_budgets:
+            if findings:
+                # Refuse to rewrite the pins over broken configs: a
+                # partial budgets file would silently drop tiers.
+                for finding in findings:
+                    print(finding.render())
+                print(
+                    "graftaudit: NOT writing budgets — fix the "
+                    f"{len(findings)} finding(s) above first",
+                    file=sys.stderr,
+                )
+                return 1
+            try:
+                tol = float(
+                    budgets_mod.load_budgets(path).get(
+                        "tolerance_pct", budgets_mod.DEFAULT_TOLERANCE_PCT
+                    )
+                )
+            except (FileNotFoundError, ValueError):
+                tol = budgets_mod.DEFAULT_TOLERANCE_PCT
+            budgets_mod.save_budgets(
+                measured,
+                {k: c.description for k, c in bcfgs.items()},
+                path,
+                tolerance_pct=tol,
+            )
+            print(f"graftaudit: wrote {len(measured)} budgets to {path}")
+            return 0
+        try:
+            pinned = budgets_mod.load_budgets(path)
+        except FileNotFoundError:
+            findings.append(
+                AuditFinding(
+                    "config", "KERNEL_BUDGETS.json",
+                    f"budgets file missing at {path}; seed it with "
+                    "python -m tools.graftaudit --update-budgets",
+                )
+            )
+            pinned = {"kernels": {}}
+        except ValueError as exc:  # malformed JSON (merge markers, edits)
+            findings.append(
+                AuditFinding(
+                    "config", "KERNEL_BUDGETS.json",
+                    f"budgets file at {path} is not valid JSON ({exc}); "
+                    "fix it or regenerate with --update-budgets",
+                )
+            )
+            pinned = {"kernels": {}}
+        failed = frozenset(bcfgs) - frozenset(measured)
+        b_findings, rows = budgets_mod.compare_budgets(
+            measured, pinned, failed=failed
+        )
+        findings.extend(b_findings)
+        table = budgets_mod.render_table(rows)
+        print(f"per-kernel op budgets (tolerance "
+              f"±{pinned.get('tolerance_pct', 2.0):g}%):\n{table}",
+              file=sys.stderr)
+        if summary_path:
+            md = budgets_mod.render_table(rows, markdown=True)
+            with open(summary_path, "a", encoding="utf-8") as fh:
+                fh.write("### graftaudit kernel budgets\n\n")
+                fh.write(md + "\n")
+
+    # -- pallas bounds/races over every kernel trace -----------------------
+    if "pallas" in groups:
+        import jax
+
+        from .bounds import audit_pallas_jaxpr
+
+        for key, (closed, _, _) in traced.items():
+            findings.extend(
+                audit_pallas_jaxpr(closed, f"{bcfgs[key].entry}[{key}]")
+            )
+        for name, build in extracfgs.items():
+            try:
+                fn, _, _ = build()
+                closed = jax.make_jaxpr(fn)()
+            except Exception as exc:  # noqa: BLE001
+                findings.append(
+                    AuditFinding(
+                        "config", name,
+                        f"failed to trace for pallas audit: "
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                )
+                continue
+            findings.extend(audit_pallas_jaxpr(closed, name))
+
+    # -- float purity: integer stages + float-free kernel tiers ------------
+    if "purity" in groups:
+        from .counter import kernel_jaxpr_of
+        from .purity import audit_float_purity, audit_float_purity_jaxpr
+
+        for name, cfg in sorted(stagecfgs.items()):
+            try:
+                fn, args = cfg.build()
+            except Exception as exc:  # noqa: BLE001 — report, don't crash
+                findings.append(
+                    AuditFinding(
+                        "config", name,
+                        f"stage config failed to build: "
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                )
+                continue
+            findings.extend(audit_float_purity(fn, args, name))
+        for key, (closed, _, _) in traced.items():
+            cfg = bcfgs[key]
+            if not cfg.float_free:
+                continue
+            try:
+                kernel = kernel_jaxpr_of(closed)
+            except ValueError as exc:
+                if not need_budget_counts:  # else already reported above
+                    findings.append(AuditFinding("config", key, str(exc)))
+                continue
+            findings.extend(
+                audit_float_purity_jaxpr(kernel, f"{cfg.entry}[{key}]")
+            )
+
+    # -- bodies: dead-stage + host transfers -------------------------------
+    if "stages" in groups or "transfers" in groups:
+        from .stages import audit_stage_text, compiled_text
+        from .transfers import audit_host_transfers
+
+        for name, cfg in sorted(bodycfgs.items()):
+            entry = entries.get(name)
+            stages = entry.stages if entry is not None else ()
+            try:
+                fn, args = cfg.build()
+            except Exception as exc:  # noqa: BLE001
+                findings.append(
+                    AuditFinding(
+                        "config", name,
+                        f"body config failed to build: "
+                        f"{type(exc).__name__}: {exc}",
+                    )
+                )
+                continue
+            if "transfers" in groups:
+                findings.extend(audit_host_transfers(fn, args, name))
+            if "stages" in groups and stages:
+                try:
+                    text = compiled_text(fn, args)
+                except Exception as exc:  # noqa: BLE001
+                    findings.append(
+                        AuditFinding(
+                            "config", name,
+                            f"body failed to lower/compile on CPU: "
+                            f"{type(exc).__name__}: {exc}",
+                        )
+                    )
+                    continue
+                findings.extend(audit_stage_text(text, name, stages))
+
+    for finding in findings:
+        print(finding.render())
+    elapsed = time.monotonic() - t0
+    n_entries = len(entries)
+    if findings:
+        print(
+            f"graftaudit: {len(findings)} finding(s) across {n_entries} "
+            f"entries in {elapsed:.1f}s",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"graftaudit: clean — {n_entries} entries, "
+        f"{len(bcfgs)} budget tiers, {elapsed:.1f}s",
+        file=sys.stderr,
+    )
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    # Trace/lower only: pin the CPU backend before jax ever initializes
+    # (idempotent if the caller already set it).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    args = _build_parser().parse_args(argv)
+    if args.list_entries:
+        _list_entries()
+        return 0
+    try:
+        groups = _selected(args.select)
+    except ValueError as exc:
+        print(f"graftaudit: error: {exc}", file=sys.stderr)
+        return 2
+    return run_audit(
+        groups,
+        budgets_path=args.budgets,
+        update_budgets=args.update_budgets,
+        summary_path=args.summary,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

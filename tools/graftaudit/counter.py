@@ -1,0 +1,106 @@
+"""The repo's ONE kernel op counter (jaxpr-weighted vreg model).
+
+Moved here from ``scripts/roofline_count.py`` so the roofline CLI, the
+``KERNEL_BUDGETS.json`` gate, and PERF.md §7/§7a all read the same
+implementation — two counters would inevitably drift and the budget gate
+would pin the wrong number.
+
+Model: the fused Pallas kernels are straight-line elementwise code on
+``(G, S)`` tiles — every traced op is a VPU vector instruction.  Each
+eqn costs ``ceil(elements / 1024)`` native (8, 128) vregs, normalized by
+the tile's own vreg span, so
+
+    ops/candidate = weighted_eqns * 1024 / (G * S)
+
+(at the headline stride 128 geometry ``G * S`` is one vreg and
+ops/candidate is the plain weighted eqn count).  Divided into the VPU's
+per-chip op rate this brackets the hashes/s ceiling — PERF.md §7.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Iterator, List, Tuple
+
+import numpy as np
+
+
+def count_kernel_ops(jaxpr, g: int, s: int) -> Tuple[float, Counter]:
+    """Weighted eqn count of a Pallas kernel jaxpr.
+
+    Sub-tile ops (e.g. ``(G, 1)`` scalars that still burn a whole vreg)
+    are charged fairly by the per-eqn ``ceil(elements/1024)`` vreg cost.
+    Returns ``(ops_per_candidate, Counter by primitive name)``.
+    """
+    tile_vregs = max(1, (g * s) // 1024)
+    total = 0.0
+    by_prim: Counter = Counter()
+
+    def walk(jx) -> None:
+        nonlocal total
+        for eqn in jx.eqns:
+            # Recurse through call-like wrappers (jnp.where etc. trace as
+            # nested jit eqns) — only leaf primitives are instructions.
+            sub = eqn.params.get("jaxpr")
+            if sub is not None and hasattr(sub, "eqns"):
+                walk(sub)
+                continue
+            if sub is not None and hasattr(getattr(sub, "jaxpr", None),
+                                           "eqns"):
+                walk(sub.jaxpr)
+                continue
+            outs = eqn.outvars
+            elems = max(
+                int(np.prod(v.aval.shape)) if v.aval.shape else 1
+                for v in outs
+            )
+            vregs = max(1, -(-elems // 1024))
+            w = vregs / tile_vregs
+            total += w
+            by_prim[eqn.primitive.name] += w
+
+    walk(jaxpr)
+    return total, by_prim
+
+
+def iter_pallas_eqns(jaxpr) -> Iterator:
+    """Yield every ``pallas_call`` eqn in ``jaxpr``, recursing through
+    nested sub-jaxprs (scan/while/cond bodies, inner jits)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from iter_pallas_eqns(sub)
+
+
+def _sub_jaxprs(eqn) -> List:
+    """Inner jaxprs of one eqn, whatever param shape they hide in."""
+    out = []
+    if eqn.primitive.name == "pallas_call":
+        # The kernel jaxpr is the *kernel body*, not host-level dataflow;
+        # pallas-in-pallas does not exist — don't descend.
+        return out
+    for val in eqn.params.values():
+        for cand in val if isinstance(val, (tuple, list)) else (val,):
+            if hasattr(cand, "eqns"):
+                out.append(cand)
+            elif hasattr(getattr(cand, "jaxpr", None), "eqns"):
+                out.append(cand.jaxpr)
+    return out
+
+
+def kernel_jaxpr_of(closed_jaxpr):
+    """The FIRST pallas kernel jaxpr inside a traced computation (the
+    fused wrappers launch exactly one ``pallas_call``).  Raises
+    ``ValueError`` when none is present — a budget config that stopped
+    reaching the Pallas path must fail loudly, not count XLA ops."""
+    for eqn in iter_pallas_eqns(closed_jaxpr.jaxpr):
+        return eqn.params["jaxpr"]
+    raise ValueError("no pallas_call in trace")
+
+
+def count_traced_kernel(fn, g: int, s: int) -> Tuple[float, Counter]:
+    """Trace ``fn()`` (zero-arg thunk) and count its Pallas kernel."""
+    import jax
+
+    return count_kernel_ops(kernel_jaxpr_of(jax.make_jaxpr(fn)()), g, s)

@@ -1,0 +1,39 @@
+"""Typed findings — graftaudit's public result surface.
+
+Mirrors ``tools/graftlint/findings.py``: checks produce findings and
+never print, so one implementation drives the CLI, the pytest fixture
+corpus, and the CI summary table.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+#: Stable check identifiers (the ``check`` field of every finding).
+CHECKS = (
+    "budget",        # KERNEL_BUDGETS.json ops/candidate drift
+    "dead-stage",    # stage primitives DCE'd out of the optimized module
+    "float-leak",    # float convert_element_type in the integer pipeline
+    "host-transfer", # device->host callback inside a compiled body
+    "pallas-bounds", # pl.load/pl.store outside the BlockSpec block
+    "pallas-race",   # two grid steps write the same output block
+    "config",        # registry/harness/budgets-file disagreement
+)
+
+
+@dataclass(frozen=True)
+class AuditFinding:
+    """One semantic-audit violation.
+
+    ``check`` is one of :data:`CHECKS`; ``entry`` is the registry entry
+    name (or budget key) the violation was found in — the unit a reader
+    greps for.
+    """
+
+    check: str
+    entry: str
+    message: str
+
+    def render(self) -> str:
+        """``CHECK entry: message`` — the CLI output line."""
+        return f"{self.check} {self.entry}: {self.message}"

@@ -1,0 +1,470 @@
+"""Concrete launch configurations for every registered audit entry.
+
+The ``@audited_entry`` registry (``hashcat_a5_table_generator_tpu.audit``)
+names WHAT must be audited; this module supplies HOW — the example
+plans, tables, digest sets and geometries each entry is traced/lowered
+with.  Everything here is CPU-only and trace/lower-only: no kernel ever
+executes, so the whole audit runs on the tier-1 host inside its 120 s
+budget.
+
+The budget configs reproduce the exact geometries PERF.md §7a counts
+(qwerty-cyrillic × rockyou-class words, stride 128, NB=16) so
+``KERNEL_BUDGETS.json`` pins the same numbers the perf narrative quotes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Tuple
+
+# Trace/lower-only: force the CPU backend before jax initializes (the
+# audit must behave identically on a TPU host and in CI).
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+# Importing these populates AUDIT_REGISTRY (decoration side effect).
+from hashcat_a5_table_generator_tpu import audit as _audit  # noqa: E402
+from hashcat_a5_table_generator_tpu.models import attack as _attack  # noqa: E402
+from hashcat_a5_table_generator_tpu.ops import (  # noqa: E402,F401
+    hashes as _hashes,
+    membership as _membership,
+    pallas_expand as _pe,
+    pallas_md5 as _pm,
+)
+from hashcat_a5_table_generator_tpu.parallel import mesh as _mesh  # noqa: E402
+
+registered_entries = _audit.registered_entries
+
+
+@dataclass(frozen=True)
+class BudgetConfig:
+    """One pinned kernel geometry: ``build()`` returns a zero-arg trace
+    thunk plus the ``(g, s)`` tile the counter normalizes by."""
+
+    key: str
+    entry: str  # registry entry the kernel belongs to
+    description: str
+    build: Callable[[], Tuple[Callable, int, int]]
+    #: The kernel tier must trace float-free (K=1 scalar-units / radix2
+    #: tiers; the general kernel's f32 ``_exact_div`` decode is exempt).
+    float_free: bool = True
+
+
+@dataclass(frozen=True)
+class BodyConfig:
+    """One lowerable end-to-end body: ``build()`` returns ``(fn, args)``
+    such that ``jax.jit(fn).lower(*args)`` compiles it."""
+
+    entry: str
+    build: Callable[[], Tuple[Callable, tuple]]
+
+
+@dataclass(frozen=True)
+class StageConfig:
+    """One integer-stage trace: ``build()`` returns ``(fn, args)`` for
+    ``jax.make_jaxpr``."""
+
+    entry: str
+    build: Callable[[], Tuple[Callable, tuple]]
+
+
+# ---------------------------------------------------------------------------
+# Shared fixture state (built once per process; construction is host-side
+# numpy work measured in hundreds of ms)
+# ---------------------------------------------------------------------------
+
+_STRIDE = 128
+_NB = 16
+
+
+def _synth_wordlist(n: int, seed: int = 0) -> List[bytes]:
+    """``bench.synth_wordlist`` — imported, not copied, so the budget
+    geometry and the bench geometry can never drift apart."""
+    import bench
+
+    return bench.synth_wordlist(n, seed)
+
+
+def long_wordlist(n: int = 64, width: int = 60, seed: int = 0) -> List[bytes]:
+    """All-lowercase ``width``-byte words: with qwerty-cyrillic's 2-byte
+    values the plan's out_width is ``2 * width`` — 120 bytes, the
+    2-hash-block tier PERF.md §7a quotes.  Public: ``scripts/
+    roofline_count.py --word-width`` reuses it so the roofline's long
+    config and the pinned budget tier cannot drift apart."""
+    rng = np.random.default_rng(seed)
+    return [
+        bytes(rng.integers(ord("a"), ord("z") + 1, size=width,
+                           dtype=np.uint8))
+        for _ in range(n)
+    ]
+
+
+class _Fixtures:
+    """Lazily-built, cached plan/table/block trees shared by configs."""
+
+    def __init__(self) -> None:
+        self._cache: Dict[tuple, object] = {}
+
+    def table(self, name: str = "qwerty-cyrillic"):
+        from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+        from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+
+        key = ("table", name)
+        if key not in self._cache:
+            self._cache[key] = compile_table(
+                get_layout(name).to_substitution_map()
+            )
+        return self._cache[key]
+
+    def plan(self, mode: str, algo: str, words_key: str = "rockyou"):
+        from hashcat_a5_table_generator_tpu.models.attack import (
+            AttackSpec,
+            build_plan,
+        )
+        from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+
+        key = ("plan", mode, algo, words_key)
+        if key not in self._cache:
+            spec = AttackSpec(mode=mode, algo=algo)
+            words = (
+                long_wordlist() if words_key == "long"
+                else _synth_wordlist(256 if words_key == "rockyou" else 64)
+            )
+            self._cache[key] = (
+                spec, build_plan(spec, self.table(), pack_words(words))
+            )
+        return self._cache[key]
+
+    def digest_set(self, algo: str):
+        from hashcat_a5_table_generator_tpu.ops.membership import (
+            build_digest_set,
+        )
+
+        key = ("digests", algo)
+        if key not in self._cache:
+            nbytes = {"md5": 16, "md4": 16, "ntlm": 16, "sha1": 20}[algo]
+            self._cache[key] = build_digest_set(
+                [bytes(nbytes), bytes(range(nbytes))], algo
+            )
+        return self._cache[key]
+
+    def blocks(self, plan, nb: int = _NB, stride: int = _STRIDE):
+        from hashcat_a5_table_generator_tpu.ops.blocks import (
+            make_blocks,
+            pad_batch,
+        )
+
+        batch, _, _ = make_blocks(
+            plan, start_word=0, start_rank=0, max_variants=nb * stride,
+            max_blocks=nb, fixed_stride=stride,
+        )
+        return pad_batch(batch, nb)
+
+
+_FIX = _Fixtures()
+
+
+# ---------------------------------------------------------------------------
+# Budget configs (KERNEL_BUDGETS.json keys)
+# ---------------------------------------------------------------------------
+
+
+def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
+                 words_key: str = "rockyou") -> Tuple[Callable, int, int]:
+    """The roofline trace: one fused-kernel launch at the §7a geometry."""
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        block_arrays,
+        plan_arrays,
+        table_arrays,
+    )
+
+    spec, plan = _FIX.plan(mode, algo, words_key)
+    ct = _FIX.table()
+    batch = _FIX.blocks(plan)
+    p = plan_arrays(plan)
+    t = table_arrays(ct)
+    b = block_arrays(batch, num_blocks=_NB)
+    k = _pe.k_vals_for(plan)
+    vb = p.get("cval_bytes", t["val_bytes"])
+    vl = p.get("cval_len", t["val_len"])
+    common = dict(
+        num_lanes=_NB * _STRIDE, out_width=int(plan.out_width),
+        min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute,
+        block_stride=_STRIDE, k_opts=k, algo=algo, interpret=True,
+        scalar_units=scalar_units and _pe.scalar_units_for(plan),
+    )
+    if mode in ("default", "reverse"):
+        fn = lambda: _pe.fused_expand_md5(  # noqa: E731
+            p["tokens"], p["lengths"], p["match_pos"], p["match_len"],
+            p["match_radix"], p["match_val_start"],
+            t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"], **common,
+        )
+    else:
+        fn = lambda: _pe.fused_expand_suball_md5(  # noqa: E731
+            p["tokens"], p["lengths"], p["pat_radix"], p["pat_val_start"],
+            p["seg_orig_start"], p["seg_orig_len"], p["seg_pat"],
+            vb, vl,
+            b["word"], b["base"], b["count"],
+            close_next=p.get("close_next"), close_mul=p.get("close_mul"),
+            **common,
+        )
+    return fn, _pe._G, _STRIDE
+
+
+def budget_configs() -> Dict[str, BudgetConfig]:
+    """The pinned kernel tiers, keyed as in ``KERNEL_BUDGETS.json``."""
+    mk = BudgetConfig
+    return {
+        c.key: c
+        for c in (
+            mk("scalar", "ops.fused_expand_md5",
+               "default/md5 scalar-units tier (§7a headline)",
+               lambda: _fused_thunk("default", "md5")),
+            mk("suball", "ops.fused_expand_suball_md5",
+               "suball/md5 scalar-units tier",
+               lambda: _fused_thunk("suball", "md5")),
+            mk("sha1", "ops.fused_expand_md5",
+               "default/sha1 scalar-units tier (80-round schedule)",
+               lambda: _fused_thunk("default", "sha1")),
+            mk("general", "ops.fused_expand_md5",
+               "default/md5 general kernel (K-way select, f32 decode)",
+               lambda: _fused_thunk("default", "md5", scalar_units=False),
+               float_free=False),
+            mk("2-hash-block", "ops.fused_expand_md5",
+               "default/md5 at out_width 120 (2 chained hash blocks)",
+               lambda: _fused_thunk("default", "md5", words_key="long")),
+            mk("ntlm", "ops.fused_expand_md5",
+               "default/ntlm scalar-units tier (UTF-16LE expansion)",
+               lambda: _fused_thunk("default", "ntlm")),
+        )
+    }
+
+
+# ---------------------------------------------------------------------------
+# Body configs (dead-stage + host-transfer checks)
+# ---------------------------------------------------------------------------
+
+
+def _crack_args(nb: int = 8, stride: int = _STRIDE):
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        block_arrays,
+        digest_arrays,
+        plan_arrays,
+        table_arrays,
+    )
+
+    spec, plan = _FIX.plan("default", "md5", "small")
+    batch = _FIX.blocks(plan, nb=nb, stride=stride)
+    return (
+        spec, plan,
+        plan_arrays(plan),
+        table_arrays(_FIX.table()),
+        digest_arrays(_FIX.digest_set("md5")),
+        block_arrays(batch, num_blocks=nb),
+    )
+
+
+def _fused_body_config() -> Tuple[Callable, tuple]:
+    spec, plan, p, t, d, b = _crack_args()
+    body = _attack.make_fused_body(
+        spec, num_lanes=8 * _STRIDE, out_width=int(plan.out_width),
+        block_stride=_STRIDE, radix2=_pe.k_opts_for(plan) == 1,
+    )
+    return body, (p, t, d, b)
+
+
+def _superstep_args():
+    from hashcat_a5_table_generator_tpu.models.attack import superstep_arrays
+    from hashcat_a5_table_generator_tpu.ops.blocks import superstep_index
+
+    spec, plan, p, t, d, _ = _crack_args()
+    ss = superstep_arrays(plan, _STRIDE)
+    total_blocks = int(superstep_index(plan, _STRIDE)[2])
+    return spec, plan, p, t, d, ss, total_blocks
+
+
+def _superstep_body_config() -> Tuple[Callable, tuple]:
+    spec, plan, p, t, d, ss, total_blocks = _superstep_args()
+    body = _attack.make_superstep_body(
+        spec, num_lanes=8 * _STRIDE, out_width=int(plan.out_width),
+        block_stride=_STRIDE, num_blocks=8, steps=2, hit_cap=32,
+        total_blocks=total_blocks, radix2=_pe.k_opts_for(plan) == 1,
+    )
+    return body, (p, t, d, ss, jnp.int32(0))
+
+
+def _sharded_crack_config() -> Tuple[Callable, tuple]:
+    from hashcat_a5_table_generator_tpu.parallel.mesh import (
+        make_mesh,
+        stack_blocks,
+    )
+
+    spec, plan, p, t, d, _ = _crack_args()
+    mesh = make_mesh(1)
+    batch = _FIX.blocks(plan, nb=8)
+    blocks = stack_blocks([batch], num_blocks=8)
+    step = _mesh.make_sharded_crack_step(
+        spec, mesh, lanes_per_device=8 * _STRIDE,
+        out_width=int(plan.out_width), block_stride=_STRIDE,
+        radix2=_pe.k_opts_for(plan) == 1,
+    )
+    return step, (p, t, d, blocks)
+
+
+def _sharded_superstep_config() -> Tuple[Callable, tuple]:
+    from hashcat_a5_table_generator_tpu.parallel.mesh import make_mesh
+
+    spec, plan, p, t, d, ss, total_blocks = _superstep_args()
+    mesh = make_mesh(1)
+    step = _mesh.make_sharded_superstep_step(
+        spec, mesh, lanes_per_device=8 * _STRIDE, num_blocks=8,
+        out_width=int(plan.out_width), block_stride=_STRIDE, steps=2,
+        hit_cap=32, total_blocks=total_blocks,
+        radix2=_pe.k_opts_for(plan) == 1,
+    )
+    return step, (p, t, d, ss, np.zeros((1,), np.int32))
+
+
+def body_configs() -> Dict[str, BodyConfig]:
+    return {
+        c.entry: c
+        for c in (
+            BodyConfig("models.make_fused_body", _fused_body_config),
+            BodyConfig("models.make_superstep_body", _superstep_body_config),
+            BodyConfig(
+                "parallel.make_sharded_crack_step", _sharded_crack_config
+            ),
+            BodyConfig(
+                "parallel.make_sharded_superstep_step",
+                _sharded_superstep_config,
+            ),
+        )
+    }
+
+
+# ---------------------------------------------------------------------------
+# Integer-stage configs (float-purity traces)
+# ---------------------------------------------------------------------------
+
+
+def _hash_stage(fn) -> Callable[[], Tuple[Callable, tuple]]:
+    def build() -> Tuple[Callable, tuple]:
+        msg = jnp.zeros((128, 16), jnp.uint8)
+        length = jnp.full((128,), 8, jnp.int32)
+        return fn, (msg, length)
+
+    return build
+
+
+def _membership_stage() -> Tuple[Callable, tuple]:
+    ds = _FIX.digest_set("md5")
+    digest = jnp.zeros((128, 4), jnp.uint32)
+    return _membership.digest_member, (
+        digest, jnp.asarray(ds.rows), jnp.asarray(ds.bitmap)
+    )
+
+
+def stage_configs() -> Dict[str, StageConfig]:
+    return {
+        c.entry: c
+        for c in (
+            StageConfig("ops.hashes.md5", _hash_stage(_hashes.md5)),
+            StageConfig("ops.hashes.md4", _hash_stage(_hashes.md4)),
+            StageConfig("ops.hashes.sha1", _hash_stage(_hashes.sha1)),
+            StageConfig("ops.hashes.ntlm", _hash_stage(_hashes.ntlm)),
+            StageConfig("ops.digest_member", _membership_stage),
+        )
+    }
+
+
+# ---------------------------------------------------------------------------
+# Standalone pallas-kernel configs without a budget key (bounds checks)
+# ---------------------------------------------------------------------------
+
+
+def _md5_pallas_thunk() -> Tuple[Callable, int, int]:
+    n = 128 * 64  # the kernel's minimum whole-tile geometry
+    msg = jnp.zeros((n, 16), jnp.uint8)
+    length = jnp.full((n,), 8, jnp.int32)
+    return (
+        lambda: _pm.md5_pallas(msg, length, interpret=True),
+        64, 128,
+    )
+
+
+def extra_kernel_configs() -> Dict[str, Callable[[], Tuple[Callable, int, int]]]:
+    """Pallas entries audited for bounds/races but not budget-pinned
+    (``md5_pallas`` is the hash-only kernel — its op count is the MD5
+    floor, not a per-candidate budget)."""
+    return {"ops.md5_pallas": _md5_pallas_thunk}
+
+
+# ---------------------------------------------------------------------------
+# Registry/harness sync
+# ---------------------------------------------------------------------------
+
+
+def coverage_findings():
+    """Every ``@audited_entry`` must have a harness config and every
+    declared budget key must exist (and vice versa — budgets.py checks
+    the file side).  Shared by the CLI and tests/test_graftaudit.py so
+    an uncovered registration fails BOTH the audit and the suite."""
+    from .findings import AuditFinding
+
+    findings = []
+    entries = registered_entries()
+    bcfgs = budget_configs()
+    bodycfgs = body_configs()
+    stagecfgs = stage_configs()
+    extracfgs = extra_kernel_configs()
+    for name, entry in sorted(entries.items()):
+        if entry.kind == "pallas_kernel":
+            covered = name in extracfgs or any(
+                c.entry == name for c in bcfgs.values()
+            )
+        elif entry.kind == "integer_stage":
+            covered = name in stagecfgs
+        else:
+            covered = name in bodycfgs
+        if not covered:
+            findings.append(
+                AuditFinding(
+                    "config", name,
+                    f"registered with @audited_entry ({entry.module}) "
+                    "but tools/graftaudit/harness.py has no launch "
+                    "config for it — add one (the registry and harness "
+                    "must cover each other)",
+                )
+            )
+        for key in entry.budget_keys:
+            if key not in bcfgs:
+                findings.append(
+                    AuditFinding(
+                        "config", name,
+                        f"declares budget key {key!r} but no budget "
+                        "config defines it",
+                    )
+                )
+    for key, cfg in bcfgs.items():
+        entry = entries.get(cfg.entry)
+        if entry is None or key not in entry.budget_keys:
+            findings.append(
+                AuditFinding(
+                    "config", key,
+                    f"budget config targets {cfg.entry!r} which does "
+                    "not declare this key in @audited_entry",
+                )
+            )
+    return findings

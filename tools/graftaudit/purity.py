@@ -1,0 +1,83 @@
+"""Check (c1): float purity of the integer hash/membership pipeline.
+
+The hash rounds and the digest-set membership search are pure uint32/
+int32 arithmetic; a float ``convert_element_type`` sneaking in (an
+accidental ``jnp.mean``, a ``/`` where ``//`` was meant, a numpy float
+scalar promoting a whole chain) silently costs precision above 2^24 —
+the exact bug class ``_exact_div``'s ±1 fixup exists to contain, except
+*outside* its guarded scope nothing contains it.  The audit traces each
+``integer_stage`` entry and the K=1 kernel tiers and fails on ANY
+floating-point dtype in the jaxpr.
+
+(The general K-way kernel's f32 mixed-radix decode is the one deliberate
+float island — PERF.md §7; its budget config opts out via
+``float_free=False``.)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .findings import AuditFinding
+
+
+def _is_float(aval) -> bool:
+    dtype = getattr(aval, "dtype", None)
+    return dtype is not None and np.issubdtype(dtype, np.floating)
+
+
+def float_eqns(jaxpr, _path: str = "") -> List[str]:
+    """Descriptions of every float-producing eqn, recursing through
+    nested jaxprs (scan/cond bodies, inner jits, pallas kernels)."""
+    out: List[str] = []
+    for eqn in jaxpr.eqns:
+        subs = []
+        for val in eqn.params.values():
+            for cand in val if isinstance(val, (tuple, list)) else (val,):
+                if hasattr(cand, "eqns"):
+                    subs.append(cand)
+                elif hasattr(getattr(cand, "jaxpr", None), "eqns"):
+                    subs.append(cand.jaxpr)
+        if subs:
+            for sub in subs:
+                out.extend(float_eqns(sub, _path))
+            continue
+        for v in eqn.outvars:
+            if _is_float(v.aval):
+                out.append(f"{eqn.primitive.name} -> {v.aval.str_short()}")
+                break
+    return out
+
+
+def audit_float_purity(fn, args, entry: str) -> List[AuditFinding]:
+    """Trace ``fn(*args)`` and fail on any float dtype in the jaxpr."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        return [
+            AuditFinding(
+                "config", entry,
+                f"failed to trace for float-purity: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+    return audit_float_purity_jaxpr(closed.jaxpr, entry)
+
+
+def audit_float_purity_jaxpr(jaxpr, entry: str) -> List[AuditFinding]:
+    leaks = float_eqns(jaxpr)
+    if not leaks:
+        return []
+    shown = "; ".join(leaks[:4]) + ("; …" if len(leaks) > 4 else "")
+    return [
+        AuditFinding(
+            "float-leak", entry,
+            f"{len(leaks)} float-typed eqn(s) in the integer pipeline "
+            f"({shown}) — uint32 hash arithmetic must never pass "
+            "through float (precision loss above 2^24)",
+        )
+    ]

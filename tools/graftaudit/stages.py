@@ -1,0 +1,99 @@
+"""Check (b): dead-stage detection — the PERF.md §15 DCE trap, gated.
+
+XLA dead-code-eliminates any stage whose outputs are unused: PR 3 found
+a timed loop that accumulated only ``n_emitted`` silently dropped the
+whole digest-membership stage (3× flattering at 2048 lanes) — and no
+test failed, because parity tests consume the hits.  This check makes
+that class mechanical: every fused entry point is lowered and
+XLA-COMPILED (CPU, optimization on), and each declared pipeline stage
+must leave at least one instruction in the optimized module.
+
+Stage survival is detected from instruction *source metadata*: XLA
+preserves each op's ``source_file`` through optimization and drops it
+with the op, so "some instruction still points into
+``ops/membership.py``" is exactly "the membership stage survived".
+This is robust to fusion/reassociation (which constant- or
+opcode-matching is not) and needs no knowledge of the kernel's shape.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence, Tuple
+
+from .findings import AuditFinding
+
+#: Source files whose surviving instructions prove each stage alive.
+#: The fused Pallas kernel implements expand AND hash in one file, so
+#: ``pallas_expand.py`` witnesses both.
+STAGE_MARKERS: Dict[str, Tuple[str, ...]] = {
+    "expand": (
+        "/ops/expand_matches.py",
+        "/ops/expand_suball.py",
+        "/ops/pallas_expand.py",
+    ),
+    "hash": (
+        "/ops/hashes.py",
+        "/ops/pallas_md5.py",
+        "/ops/pallas_expand.py",
+    ),
+    "membership": ("/ops/membership.py",),
+}
+
+
+def compiled_text(fn, args) -> str:
+    """Lower + XLA-compile ``fn(*args)`` on the current (CPU) backend and
+    return the optimized module text.  ``fn`` may already be jitted —
+    jit-of-jit lowers fine and keeps one code path here."""
+    import jax
+
+    return jax.jit(fn).lower(*args).compile().as_text()
+
+
+def stage_survival(text: str) -> Dict[str, bool]:
+    """Which pipeline stages left instructions in an optimized module."""
+    return {
+        stage: any(marker in text for marker in markers)
+        for stage, markers in STAGE_MARKERS.items()
+    }
+
+
+def audit_stage_text(
+    text: str, entry: str, stages: Sequence[str]
+) -> List[AuditFinding]:
+    """Findings for every declared stage missing from ``text``."""
+    if "source_file=" not in text:
+        # Metadata stripped (nonstandard XLA flags): the check cannot
+        # run — failing loudly beats vacuously passing.
+        return [
+            AuditFinding(
+                "config", entry,
+                "optimized HLO carries no source_file metadata; "
+                "dead-stage detection needs it (check XLA/JAX flags)",
+            )
+        ]
+    alive = stage_survival(text)
+    return [
+        AuditFinding(
+            "dead-stage", entry,
+            f"the {stage} stage left no instructions in the optimized "
+            f"module — XLA dead-code-eliminated it (the PERF.md §15 "
+            f"trap class: some consumer of its outputs was dropped)",
+        )
+        for stage in stages
+        if not alive.get(stage, False)
+    ]
+
+
+def audit_stages(fn, args, entry: str, stages: Sequence[str]) -> List[AuditFinding]:
+    """Compile ``fn(*args)`` and check every declared stage survived."""
+    try:
+        text = compiled_text(fn, args)
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        return [
+            AuditFinding(
+                "config", entry,
+                f"body failed to lower/compile on CPU: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+    return audit_stage_text(text, entry, stages)

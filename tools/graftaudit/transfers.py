@@ -1,0 +1,86 @@
+"""Check (c2): no device→host transfers inside compiled sweep bodies.
+
+The launch loop's whole design is "two scalars and two small masks per
+fetch" (honest-sync rule, PERF.md §0/§15): a callback smuggled into a
+jitted body — ``jax.debug.print``, ``io_callback``, ``pure_callback``,
+host ``debug_callback`` — forces a device→host round trip *per
+invocation*, and inside a ``lax.scan``/``while_loop`` body it fires per
+STEP, turning the superstep executor's one-fetch-per-superstep contract
+into S hidden syncs.  graftlint GL011 catches the lexical ``int()``/
+``.item()`` forms; this audit catches what only the trace can see.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from .findings import AuditFinding
+
+#: Primitives that are host round trips by construction.
+TRANSFER_PRIMITIVES = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "infeed",
+        "outfeed",
+        "host_callback_call",
+    }
+)
+
+#: Primitives whose sub-jaxprs re-run per device-side iteration — a
+#: transfer inside one is a per-step sync, the worst case.
+_LOOP_PRIMITIVES = frozenset({"scan", "while", "fori"})
+
+
+def find_transfers(jaxpr, in_loop: bool = False) -> List[Tuple[str, bool]]:
+    """``(primitive_name, inside_loop_body)`` for every transfer eqn."""
+    out: List[Tuple[str, bool]] = []
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name in TRANSFER_PRIMITIVES:
+            out.append((name, in_loop))
+        child_in_loop = in_loop or name in _LOOP_PRIMITIVES
+        for val in eqn.params.values():
+            for cand in val if isinstance(val, (tuple, list)) else (val,):
+                if hasattr(cand, "eqns"):
+                    out.extend(find_transfers(cand, child_in_loop))
+                elif hasattr(getattr(cand, "jaxpr", None), "eqns"):
+                    out.extend(find_transfers(cand.jaxpr, child_in_loop))
+    return out
+
+
+def audit_host_transfers(fn, args, entry: str) -> List[AuditFinding]:
+    """Trace ``fn(*args)`` and flag every transfer primitive."""
+    import jax
+
+    try:
+        closed = jax.make_jaxpr(fn)(*args)
+    except Exception as exc:  # noqa: BLE001 — report, don't crash
+        return [
+            AuditFinding(
+                "config", entry,
+                f"failed to trace for host-transfer audit: "
+                f"{type(exc).__name__}: {exc}",
+            )
+        ]
+    return audit_host_transfers_jaxpr(closed.jaxpr, entry)
+
+
+def audit_host_transfers_jaxpr(jaxpr, entry: str) -> List[AuditFinding]:
+    found = find_transfers(jaxpr)
+    findings: List[AuditFinding] = []
+    for name, in_loop in found:
+        where = (
+            "inside a device loop body (fires per step!)"
+            if in_loop
+            else "in the compiled body"
+        )
+        findings.append(
+            AuditFinding(
+                "host-transfer", entry,
+                f"{name} {where} — device->host round trip breaks the "
+                "one-fetch-per-launch contract (PERF.md §15)",
+            )
+        )
+    return findings

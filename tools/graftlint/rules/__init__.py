@@ -12,6 +12,7 @@ from typing import Dict, List
 from .base import Rule
 from .docs import OpDocstringContract
 from .dtype import FloatLiteralInKernel, UnmaskedWideInt
+from .envvars import EnvVarSprawl
 from .hygiene import MutableDefaultArg, Nondeterminism, StdoutPrint
 from .jit import JitMissingStaticArgnames
 from .tracing import (
@@ -33,6 +34,7 @@ ALL_RULES: List[Rule] = [
     StdoutPrint(),
     MutableDefaultArg(),
     HostSyncInLoopBody(),
+    EnvVarSprawl(),
 ]
 
 RULES_BY_CODE: Dict[str, Rule] = {rule.code: rule for rule in ALL_RULES}

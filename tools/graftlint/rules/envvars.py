@@ -1,0 +1,98 @@
+"""Env-knob hygiene: the ``A5GEN_*`` surface has ONE read point.
+
+The engine's escape hatches (``A5GEN_PALLAS``, ``A5GEN_SUPERSTEP``,
+``A5GEN_CASCADE_CLOSE``, ``A5GEN_DCN_TIMEOUT``, …) each started as a
+one-off ``os.environ`` read; sprawled reads make the knob surface
+unauditable and let "off" vocabularies drift between subsystems.
+``runtime/env.py`` is now the single accessor — every library read goes
+through it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..context import FileContext, dotted_name
+from ..findings import Finding
+from .base import Rule
+
+#: The accessor module — the one place direct reads are the point.
+_ACCESSOR_SUFFIX = "/runtime/env.py"
+
+#: Call forms that read the process environment.
+_ENV_GET_CALLS = ("os.environ.get", "environ.get", "os.getenv", "getenv")
+
+#: Subscript bases that read the process environment.
+_ENV_MAPS = ("os.environ", "environ")
+
+
+#: Grandfathered pre-``A5GEN_`` knobs (mirrors ``runtime/env.py``).
+_LEGACY_KNOBS = frozenset({"A5_NATIVE"})
+
+
+def _env_name_literal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_knob(name: Optional[str]) -> bool:
+    return name is not None and (
+        name.startswith("A5GEN_") or name in _LEGACY_KNOBS
+    )
+
+
+class EnvVarSprawl(Rule):
+    code = "GL012"
+    name = "env-var-sprawl"
+    summary = (
+        "direct os.environ/os.getenv read of an A5GEN_*/A5_NATIVE knob "
+        "outside runtime/env.py"
+    )
+    rationale = (
+        "Every A5GEN_* escape hatch must read through the "
+        "runtime/env.py accessor: one grep-able knob surface, one "
+        "shared off-spelling vocabulary, and graftaudit/bench can "
+        "reason about what the environment changes. Writes (probe "
+        "scripts and tests pinning a configuration) are fine — only "
+        "reads sprawl."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        # Everything we lint except the accessor itself; fixture tests
+        # lint under virtual package paths, so path scoping is enough.
+        return not ctx.posix_path.endswith(_ACCESSOR_SUFFIX)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                if dotted_name(node.func) not in _ENV_GET_CALLS:
+                    continue
+                if not node.args:
+                    continue
+                name = _env_name_literal(node.args[0])
+                if _is_knob(name):
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"direct read of {name}; use the "
+                        "runtime/env.py accessor (read_env/env_str/"
+                        "env_is)",
+                    )
+            elif isinstance(node, ast.Subscript):
+                if not isinstance(node.ctx, ast.Load):
+                    continue  # writes/deletes are probe/test plumbing
+                if dotted_name(node.value) not in _ENV_MAPS:
+                    continue
+                name = _env_name_literal(node.slice)
+                if _is_knob(name):
+                    yield self.finding(
+                        ctx,
+                        node.lineno,
+                        node.col_offset,
+                        f"direct read of {name}; use the "
+                        "runtime/env.py accessor (read_env/env_str/"
+                        "env_is)",
+                    )
