@@ -179,6 +179,12 @@ def build_parser() -> argparse.ArgumentParser:
                          "only the CPU-fallback reserve remains")
     ap.add_argument("--init-timeout", type=float, default=150.0,
                     help="seconds the worker waits for accelerator init")
+    ap.add_argument("--init-retry-budget", type=float, default=240.0,
+                    help="cap on CUMULATIVE wall spent on accelerator "
+                         "attempts that die before device init; once "
+                         "exceeded the orchestrator stops retrying the "
+                         "wedged backend and takes the CPU fallback "
+                         "(BENCH_r05 burned ~6 min of init timeouts)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) before init")
     ap.add_argument("--profile-dir", default=None,
@@ -194,6 +200,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "Defaults to the measured CPU peak geometry "
                          "(2048 lanes x 32 blocks, §4c) unless --lanes/"
                          "--blocks override")
+    ap.add_argument("--stride-ab", action="store_true",
+                    help="measure block stride 128 vs 256 x emission "
+                         "scheme perslot vs bytescan (A5GEN_EMIT arms) "
+                         "on the production crack-step contract: per-arm "
+                         "hashes/s AND jaxpr-counted kernel ops/candidate "
+                         "(tools/graftaudit/counter — the same counter "
+                         "that pins KERNEL_BUDGETS.json), winner in one "
+                         "JSON line (PERF.md §7a lever 2 / §17)")
     return ap
 
 
@@ -359,6 +373,201 @@ def run_superstep_ab(args: argparse.Namespace) -> None:
             per_launch["host_s_per_step"]
             / max(superstep["host_s_per_step"], 1e-12)
         ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+# --------------------------------------------------------- stride/emit A/B --
+
+
+def run_stride_ab(args: argparse.Namespace) -> None:
+    """A/B block stride 128 vs 256 x emission scheme perslot vs bytescan
+    (PERF.md §7a ranked lever 2 / §17) on the production crack-step
+    contract.  Each arm records hashes/s from a timed window AND the
+    fused kernel's jaxpr-counted ops/candidate at that (stride, scheme) —
+    produced by ``tools.graftaudit.counter``, the same implementation
+    that pins ``KERNEL_BUDGETS.json``, so BENCH records and the budget
+    gate can never quote different numbers.  One JSON line; the winner is
+    the fastest measured arm, with op counts alongside so on-chip runs
+    can confirm (or refute) the op model's stride-256 prediction."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import jax.numpy as jnp
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        AttackSpec,
+        block_arrays,
+        build_plan,
+        digest_arrays,
+        make_fused_body,
+        piece_arrays,
+        plan_arrays,
+        scalar_units_arrays,
+        table_arrays,
+    )
+    from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+    from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+    from hashcat_a5_table_generator_tpu.ops.packing import (
+        pack_words,
+        piece_schema_for,
+    )
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        _G as pallas_g,
+        fused_expand_md5,
+        fused_expand_suball_md5,
+        k_opts_for,
+        k_vals_for,
+        opts_for_config,
+        scalar_units_for,
+    )
+    from hashcat_a5_table_generator_tpu.runtime.env import emit_scheme
+    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+    from tools.graftaudit.counter import count_traced_kernel
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    ct = compile_table(get_layout(args.table).to_substitution_map())
+    plan = build_plan(spec, ct, pack_words(synth_wordlist(args.words)))
+    host_digest = HOST_DIGEST[spec.algo]
+    ds = build_digest_set(
+        [host_digest(b"bench-decoy-%d" % i) for i in range(1024)], spec.algo
+    )
+    radix2 = k_opts_for(plan) == 1
+    scalar_units = scalar_units_for(plan)
+    schema = piece_schema_for(plan, ct)
+    p0, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
+    if scalar_units:
+        p0.update(scalar_units_arrays(plan, ct))
+    p1 = dict(p0)
+    if schema is not None:
+        p1.update(piece_arrays(schema))
+
+    def kernel_ops(stride: int, pieces) -> "float | None":
+        """ops/candidate of the fused kernel at (stride, scheme) — the
+        KERNEL_BUDGETS counter over an interpret-mode trace (device-
+        independent; NB tiny, the count normalizes per candidate)."""
+        nb = max(pallas_g, 2048 // stride)
+        batch, _, _ = make_blocks(
+            plan, start_word=0, start_rank=0, max_variants=nb * stride,
+            max_blocks=nb, fixed_stride=stride,
+        )
+        b = block_arrays(batch, num_blocks=nb)
+        k = k_vals_for(plan)
+        common = dict(
+            num_lanes=nb * stride, out_width=int(plan.out_width),
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute, block_stride=stride,
+            k_opts=k, algo=spec.algo, interpret=True,
+            scalar_units=scalar_units, pieces=pieces,
+        )
+        try:
+            if spec.mode in ("default", "reverse"):
+                fn = lambda: fused_expand_md5(  # noqa: E731
+                    p0["tokens"], p0["lengths"], p0["match_pos"],
+                    p0["match_len"], p0["match_radix"],
+                    p0["match_val_start"], t["val_bytes"], t["val_len"],
+                    b["word"], b["base"], b["count"], **common,
+                )
+            else:
+                fn = lambda: fused_expand_suball_md5(  # noqa: E731
+                    p0["tokens"], p0["lengths"], p0["pat_radix"],
+                    p0["pat_val_start"], p0["seg_orig_start"],
+                    p0["seg_orig_len"], p0["seg_pat"],
+                    p0.get("cval_bytes", t["val_bytes"]),
+                    p0.get("cval_len", t["val_len"]),
+                    b["word"], b["base"], b["count"],
+                    close_next=p0.get("close_next"),
+                    close_mul=p0.get("close_mul"), **common,
+                )
+            ops, _ = count_traced_kernel(fn, pallas_g, stride)
+            return round(ops, 1)
+        except Exception as e:  # pragma: no cover - config-dependent
+            print(f"# [stride-ab] op count failed at stride {stride}: {e}",
+                  file=sys.stderr)
+            return None
+
+    def time_arm(stride: int, pieces, parr) -> dict:
+        """Timed window on the production crack-step contract (hit_bits +
+        BOTH counts chained device-side: an emitted-only accumulator lets
+        XLA DCE the membership stage — the §15 honesty trap)."""
+        if lanes % stride:
+            return {"error": f"lanes {lanes} not divisible by {stride}"}
+        nb = lanes // stride
+        fused = opts_for_config(spec, plan, ct, block_stride=stride,
+                                num_blocks=nb)
+        body = make_fused_body(
+            spec, num_lanes=lanes, out_width=plan.out_width,
+            block_stride=stride, fused_expand_opts=fused,
+            fused_scalar_units=scalar_units, radix2=radix2, pieces=pieces,
+        )
+        def _acc(p_, t_, b_, d_, tot):
+            out = body(p_, t_, d_, b_)
+            return tot + jnp.stack([out["n_emitted"], out["n_hits"]])
+
+        acc_step = jax.jit(_acc)
+        batches = []
+        w, rank = 0, 0
+        for _ in range(args.batches):
+            batch, w, rank = make_blocks(
+                plan, start_word=w, start_rank=rank, max_variants=lanes,
+                max_blocks=nb, fixed_stride=stride,
+            )
+            if batch.total == 0:
+                break
+            batches.append(block_arrays(batch, num_blocks=nb))
+        if not batches:
+            return {"error": "wordlist produced no variant blocks"}
+        zero = jnp.zeros((2,), jnp.int32)
+        int(acc_step(parr, t, batches[0], d, zero)[0])  # warmup/compile
+        hashed, launches = 0, 0
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < args.seconds:
+            tot = zero
+            for i in range(8):
+                tot = acc_step(parr, t, batches[i % len(batches)], d, tot)
+            hashed += int(tot[0])  # completion barrier
+            launches += 8
+        wall = time.perf_counter() - t0
+        return {
+            "value": hashed / wall,
+            "launches": launches,
+            "path": "pallas" if fused is not None else "xla",
+        }
+
+    arms = {}
+    for stride in (128, 256):
+        for scheme, pieces, parr in (
+            ("perslot", schema, p1), ("bytescan", None, p0),
+        ):
+            if scheme == "perslot" and schema is None:
+                continue  # plan ineligible (or A5GEN_EMIT=bytescan)
+            name = f"stride{stride}-{scheme}"
+            print(f"# [stride-ab] arm {name}", file=sys.stderr)
+            sub = time_arm(stride, pieces, parr)
+            sub["ops_per_candidate"] = kernel_ops(stride, pieces)
+            arms[name] = sub
+
+    ok = {k: v for k, v in arms.items() if "error" not in v}
+    winner = max(ok, key=lambda k: ok[k]["value"]) if ok else None
+    record = {
+        "metric": "stride_emit_ab",
+        "unit": "hashes/sec + kernel ops/candidate",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "emit_default": emit_scheme(),
+        "arms": arms,
+        "winner": winner,
+        # The ops numbers come from the SAME counter that pins these
+        # budgets — cross-reference for reviewers.
+        "budget_file": "KERNEL_BUDGETS.json",
     }
     print(json.dumps(record))
     sys.stdout.flush()
@@ -825,7 +1034,8 @@ def _attempt(argv: list[str], env: dict, init_grace: float, run_grace: float,
             break
     if record is not None and rc != 0:
         record["worker_rc"] = rc
-    return record, tail, rc
+    return record, tail, rc, extended or "# device:" in stderr, \
+        time.monotonic() - t0
 
 
 def run_orchestrator(args: argparse.Namespace) -> None:
@@ -894,13 +1104,16 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         env = dict(os.environ)
         argv = [sys.executable, me, "--worker"] + extra
         print(f"# attempt[{name}]: {' '.join(argv[2:])}", file=sys.stderr)
-        record, tail, rc = _attempt(
+        record, tail, rc, init_ok, wall_s = _attempt(
             argv, env, init_grace, run_grace, max_total=max_total,
         )
+        if not init_ok:
+            init_wait[0] += wall_s
         if record is not None:
             record["attempt"] = name
             return record
-        failures.append({"attempt": name, "rc": rc,
+        failures.append({"attempt": name, "rc": rc, "init_ok": init_ok,
+                         "wall_s": round(wall_s, 1),
                          "stderr_tail": tail[-600:]})
         return None
 
@@ -964,12 +1177,21 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         return merged
 
     failures = []
+    init_wait = [0.0]  # cumulative wall burnt on attempts that never init'd
     tried_tpu_plugin = False
     backoff = 10.0
     while True:
         remaining = total_deadline - time.monotonic()
         spendable = remaining - cpu_need
         if spendable < 75:
+            break
+        if init_wait[0] >= args.init_retry_budget:
+            # The backend never even initialized across this much wall:
+            # stop feeding the wedge and leave the rest of the budget to
+            # the CPU fallback (BENCH_r05 burned ~6 min here).
+            print(f"# orchestrator: init-retry budget exhausted "
+                  f"({init_wait[0]:.0f}s >= {args.init_retry_budget:.0f}s); "
+                  "taking the CPU fallback", file=sys.stderr)
             break
         # Default platform resolution (the axon TPU tunnel, when present).
         # A wedged init is killed at init_grace; a successful init may run
@@ -1017,11 +1239,17 @@ def run_orchestrator(args: argparse.Namespace) -> None:
 def main() -> None:
     args = build_parser().parse_args()
     if args.lanes is None:
-        # Unset vs explicit matters: --superstep-ab targets the small §4c
-        # peak, the kernel bench the big accelerator launch; an explicit
-        # --lanes is honored by both.
-        args.lanes = 2048 if args.superstep_ab else (1 << 22)
-    if args.superstep_ab:
+        # Unset vs explicit matters: --superstep-ab/--stride-ab target
+        # small focused geometries, the kernel bench the big accelerator
+        # launch; an explicit --lanes is honored by all.
+        args.lanes = (
+            2048 if (args.superstep_ab or args.stride_ab) else (1 << 22)
+        )
+    if args.stride_ab:
+        # Focused stride/emission A/B (PERF.md §7a lever 2 / §17); runs
+        # on the pinned (or default) platform in-process.
+        run_stride_ab(args)
+    elif args.superstep_ab:
         # Focused loop-level A/B (PERF.md §15); runs on the pinned (or
         # default) platform in-process, no orchestrator.
         run_superstep_ab(args)

@@ -159,12 +159,15 @@ def digest_arrays(ds: DigestSet) -> Dict[str, jnp.ndarray]:
 def _expand(
     spec: AttackSpec, plan: ArrayTree, table: ArrayTree, blocks: ArrayTree,
     *, num_lanes: int, out_width: int, block_stride: "int | None" = None,
-    radix2: bool = False,
+    radix2: bool = False, pieces=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit).
 
     ``radix2`` (static): all plan radices <= 2 (``k_opts == 1``) — the
     decode collapses to bit extraction (``expand_matches.decode_digits``).
+    ``pieces`` (static): the plan's ``packing.PieceSchema`` — selects the
+    per-slot piece splice (PERF.md §17); device tables ride the plan dict
+    (``pp_*``, :func:`piece_arrays`).
     """
     common = dict(
         num_lanes=num_lanes,
@@ -173,6 +176,11 @@ def _expand(
         max_substitute=spec.max_substitute,
         block_stride=block_stride,
         radix2=radix2,
+        pieces=pieces,
+        piece_tables=(
+            {k[3:]: v for k, v in plan.items() if k.startswith("pp_")}
+            or None
+        ) if pieces is not None else None,
     )
     if spec.mode in ("default", "reverse"):
         return expand_matches(
@@ -238,12 +246,31 @@ def scalar_units_arrays(plan: Plan, ct: CompiledTable) -> Dict[str, jnp.ndarray]
     return {f"su_{k}": jnp.asarray(v) for k, v in fields.items()}
 
 
+def piece_arrays(pieces) -> Dict[str, jnp.ndarray]:
+    """Device copies of a ``packing.PieceSchema``'s data tables,
+    namespaced for the plan dict (``pp_*``) like
+    :func:`scalar_units_arrays` — shipped once per sweep so the wrappers
+    and the XLA splice prep launches with row gathers only."""
+    if pieces is None:
+        return {}
+    out = {
+        "pp_pw": jnp.asarray(pieces.gw),
+        "pp_pl": jnp.asarray(pieces.gl),
+    }
+    if pieces.sel_bit is not None:
+        out["pp_sbit"] = jnp.asarray(pieces.sel_bit)
+    if pieces.sel_slot is not None:
+        out["pp_sslot"] = jnp.asarray(pieces.sel_slot)
+    return out
+
+
 def make_fused_lane_body(
     spec: AttackSpec, *, num_lanes: int, out_width: int,
     block_stride: int | None = None,
     fused_expand_opts: int | None = None,
     fused_scalar_units: bool = False,
     radix2: bool = False,
+    pieces=None,
 ) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]:
     """The lane-level fused expand->hash->match core.
 
@@ -277,10 +304,14 @@ def make_fused_lane_body(
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
                 scalar_units=fused_scalar_units,
-                # su_* entries (scalar_units_arrays): word-level fields
-                # precomputed per sweep; the wrapper preps by gathering.
+                # su_*/pp_* entries (scalar_units_arrays/piece_arrays):
+                # word-level fields precomputed once per sweep; the
+                # wrapper preps by gathering.
                 pre={k[3:]: v for k, v in plan.items()
-                     if k.startswith("su_")} or None,
+                     if k.startswith(("su_", "pp_"))} or None,
+                # Per-slot piece emission (PERF.md §17): the schema is
+                # static trace structure, its tables ride `pre`.
+                pieces=pieces,
                 algo=spec.algo,
                 # Count-windowed plans carry win_v; the kernel walks the
                 # suffix-count DP in place of the mixed-radix decode.
@@ -309,6 +340,7 @@ def make_fused_lane_body(
         cand, cand_len, word_row, emit = _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
             out_width=out_width, block_stride=block_stride, radix2=radix2,
+            pieces=pieces,
         )
         del word_row  # hit cursors are host-derived from lane indices
         return hash_fn(cand, cand_len), emit
@@ -333,7 +365,8 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
                     fused_scalar_units: bool = False,
-                    radix2: bool = False) -> Callable[..., ArrayTree]:
+                    radix2: bool = False,
+                    pieces=None) -> Callable[..., ArrayTree]:
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
@@ -361,6 +394,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
         spec, num_lanes=num_lanes, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
+        pieces=pieces,
     )
 
     def body(
@@ -415,7 +449,7 @@ def make_superstep_body(
     num_blocks: int, steps: int, hit_cap: int, total_blocks: int,
     windowed: bool = False, step_advance: "int | None" = None,
     fused_expand_opts: int | None = None, fused_scalar_units: bool = False,
-    radix2: bool = False,
+    radix2: bool = False, pieces=None,
 ) -> Callable[..., ArrayTree]:
     """The un-jitted superstep executor: ``steps`` fused
     expand->hash->membership launches in ONE device program, with the
@@ -451,6 +485,7 @@ def make_superstep_body(
         spec, num_lanes=num_lanes, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
+        pieces=pieces,
     )
     stride = block_stride
     advance = int(step_advance or num_blocks)
@@ -568,7 +603,8 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     block_stride: int | None = None,
                     fused_expand_opts: int | None = None,
                     fused_scalar_units: bool = False,
-                    radix2: bool = False) -> Callable[..., ArrayTree]:
+                    radix2: bool = False,
+                    pieces=None) -> Callable[..., ArrayTree]:
     """Build the fused expand->hash->match step (single device).
 
     Returns ``step(plan, table, blocks, digests) -> dict`` with the packed
@@ -578,7 +614,7 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
                            block_stride=block_stride,
                            fused_expand_opts=fused_expand_opts,
                            fused_scalar_units=fused_scalar_units,
-                           radix2=radix2)
+                           radix2=radix2, pieces=pieces)
 
     def step(
         plan: ArrayTree, table: ArrayTree, blocks: ArrayTree,
@@ -592,6 +628,7 @@ def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,
 def make_candidates_body(
     spec: AttackSpec, *, num_lanes: int, out_width: int,
     block_stride: "int | None" = None, radix2: bool = False,
+    pieces=None,
 ) -> Callable[
     [ArrayTree, ArrayTree, ArrayTree],
     Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray],
@@ -608,6 +645,7 @@ def make_candidates_body(
         return _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
             out_width=out_width, block_stride=block_stride, radix2=radix2,
+            pieces=pieces,
         )
 
     return body
@@ -616,6 +654,7 @@ def make_candidates_body(
 def make_candidates_step(
     spec: AttackSpec, *, num_lanes: int, out_width: int,
     block_stride: "int | None" = None, radix2: bool = False,
+    pieces=None,
 ) -> Callable[
     [ArrayTree, ArrayTree, ArrayTree],
     Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray],
@@ -626,7 +665,8 @@ def make_candidates_step(
     """
     return jax.jit(
         make_candidates_body(spec, num_lanes=num_lanes, out_width=out_width,
-                             block_stride=block_stride, radix2=radix2)
+                             block_stride=block_stride, radix2=radix2,
+                             pieces=pieces)
     )
 
 

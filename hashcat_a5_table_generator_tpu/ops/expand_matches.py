@@ -533,6 +533,70 @@ def lane_fields(
     return rank, lane_ok, w, base, field
 
 
+def splice_pieces(schema, tables, field, col_variant, *, n, out_width):
+    """Per-slot piece materialization — the XLA twin of the Pallas piece
+    kernels (``pallas_expand._make_piece_kernel``; PERF.md §17), shared by
+    both expansion paths so CPU fallback, the bench ``xla`` arm, and the
+    fused kernels stay ONE algorithm.
+
+    Walks the plan's :class:`ops.packing.PieceSchema` groups in output
+    order: selects each group's precomputed word(s)/length by the variant
+    index (``col_variant(c) -> int32[N]``), unpacks the selected bytes,
+    and lands them at the lane-local prefix offset with compare-selects
+    over the output columns (never scatters).  The terminator pseudo-byte
+    in the tail group's bytes is masked off by the trailing
+    ``o < out_len`` zero-fill, so candidate buffers stay byte-identical
+    to the unit-scan splice.  Returns ``(out uint8[N, W], out_len)``.
+    """
+    o = jnp.arange(out_width, dtype=jnp.int32)[None, :]  # [1, W]
+    out = jnp.zeros((n, out_width), jnp.uint8)
+    cum = jnp.zeros((n,), jnp.int32)
+    pw, pl = tables["pw"], tables["pl"]
+    for gi, grp in enumerate(schema.groups):
+        n_var, n_words = grp.n_variants, grp.n_words
+        idx = None
+        if n_var > 1:
+            sel = grp.sel_cols
+            if len(sel) == 1:
+                # Clamp: a suball padding column aliases slot 0, whose
+                # digit/joint index can exceed this column's variant
+                # rows (all of which are empty for the padding word) —
+                # select_n with an out-of-range index is undefined.
+                idx = jnp.minimum(col_variant(sel[0]), n_var - 1)
+            else:  # merged binary columns: packed chosen bits
+                idx = jnp.zeros((n,), jnp.int32)
+                for i, c in enumerate(sel):
+                    idx = idx | (
+                        (col_variant(c) > 0).astype(jnp.int32) << i
+                    )
+
+        def pick(rows):
+            return rows[0] if idx is None else jax.lax.select_n(idx, *rows)
+
+        l = pick([
+            field(pl[:, gi, v]).astype(jnp.int32) for v in range(n_var)
+        ])
+        words = [
+            pick([field(pw[:, gi, v, w]) for v in range(n_var)])
+            for w in range(n_words)
+        ]
+        # Place the selected bytes: piece byte bi lands at output column
+        # cum + bi when bi < l (a handful of [N, W] compare-selects; the
+        # total byte count across groups is the schema's max_out).
+        for bi in range(4 * n_words):
+            if bi >= out_width:
+                break
+            byte = (words[bi // 4] >> jnp.uint32(8 * (bi % 4))).astype(
+                jnp.uint8
+            )
+            m = (o == (cum + bi)[:, None]) & (bi < l)[:, None]
+            out = jnp.where(m, byte[:, None], out)
+        cum = cum + l
+    out_len = cum - 1  # the placed tail includes the terminator byte
+    out = jnp.where(o < out_len[:, None], out, jnp.uint8(0))
+    return out, out_len
+
+
 def expand_matches(
     tokens: jnp.ndarray,  # uint8 [B, L]
     lengths: jnp.ndarray,  # int32 [B]
@@ -555,6 +619,8 @@ def expand_matches(
     win_v: jnp.ndarray | None = None,
     splice_impl: str | None = None,
     radix2: bool = False,
+    pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
+    piece_tables: "dict | None" = None,  # device copies of pieces' arrays
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -606,6 +672,24 @@ def expand_matches(
 
     chosen = digits > 0  # [N, M]
     chosen_count = jnp.sum(chosen, axis=1)
+
+    if pieces is not None:
+        # Per-slot piece emission: schema column c IS match slot c; the
+        # schema's static-disjoint-span guarantee makes overlap clashes
+        # impossible, so the emit mask needs no clash term.
+        tabs = piece_tables or {
+            "pw": jnp.asarray(pieces.gw), "pl": jnp.asarray(pieces.gl)
+        }
+        out, out_len = splice_pieces(
+            pieces, tabs, field, lambda c: digits[:, c],
+            n=n, out_width=out_width,
+        )
+        emit = (
+            lane_ok
+            & (chosen_count >= min_substitute)
+            & (chosen_count <= max_substitute)
+        )
+        return out, out_len.astype(jnp.int32), w, emit
 
     # Per-match selected value rows/lengths.
     opt_row = mvs_w + digits - 1  # valid where chosen

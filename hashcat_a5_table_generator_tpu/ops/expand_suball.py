@@ -786,6 +786,8 @@ def expand_suball(
     radix2: bool = False,
     close_next: jnp.ndarray | None = None,  # int32 [B, P, S]
     close_mul: jnp.ndarray | None = None,  # int32 [B, P, S+1]
+    pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
+    piece_tables: "dict | None" = None,  # device copies of pieces' arrays
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
@@ -841,6 +843,38 @@ def expand_suball(
         )
     else:
         jd = digits - 1
+
+    if pieces is not None:
+        # Per-slot piece emission (the XLA twin of the piece kernels):
+        # schema columns are the plan's pattern segments in word order;
+        # each column's variant index is its owning slot's digit (joint
+        # value index + 1 under cascade closure — expand_matches.
+        # splice_pieces is the shared materializer).
+        from .expand_matches import splice_pieces
+
+        tabs = piece_tables or {
+            "pw": jnp.asarray(pieces.gw), "pl": jnp.asarray(pieces.gl)
+        }
+        sslot = (piece_tables or {}).get("sslot")
+        if sslot is None:
+            sslot = jnp.asarray(pieces.sel_slot)
+        sslot_w = field(sslot)  # [N, C]
+        col_d = jnp.take_along_axis(digits, sslot_w, axis=1)
+        if close_next is not None:
+            col_jd = jnp.take_along_axis(jd, sslot_w, axis=1)
+            col_var = jnp.where(col_d > 0, 1 + col_jd, 0)
+        else:
+            col_var = col_d
+        out, out_len = splice_pieces(
+            pieces, tabs, field, lambda c: col_var[:, c],
+            n=n, out_width=out_width,
+        )
+        emit = (
+            lane_ok
+            & (chosen_count >= min_substitute)
+            & (chosen_count <= max_substitute)
+        )
+        return out, out_len.astype(jnp.int32), w, emit
 
     # Per-segment output lengths and value rows for this variant.
     is_span = spat_w >= 0

@@ -19,7 +19,7 @@ and I/O errors propagate.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -201,3 +201,467 @@ def read_wordlist(
     if data.endswith(b"\n"):
         words.pop()  # split() produced a trailing empty element, not a word
     return words
+
+
+# ---------------------------------------------------------------------------
+# Per-slot piece emission: precomputed piece tables (PERF.md §17)
+# ---------------------------------------------------------------------------
+#
+# The fused kernels' "unit scheme" resolved output bytes per ORIGINAL byte
+# position — O(L) per-lane selects even though only the <= S substitution
+# slots vary per lane (PERF.md §7a ranked lever 1).  The per-slot scheme
+# re-expresses a candidate as a short sequence of PIECES in output order:
+# one piece per substitution site (its literal gap from the previous site
+# folded in as a block-uniform prefix) plus one literal tail piece (with
+# the 0x80 terminator folded into its precomputed bytes).  Everything
+# block-uniform — gap bytes, skip bytes, value bytes, their lengths — is
+# packed here, on the host, into per-word VARIANT tables: a piece's
+# possible byte strings, one row per choice of its slot's digit.  Adjacent
+# pieces whose combined worst-case length fits one u32 are merged into one
+# GROUP whose variant table enumerates the combined choices, so the kernel
+# selects a whole 4-byte group word with ONE N-way select and places it
+# with ONE (lo, hi) word-pair scatter; lane-local work per group is the
+# variant index, two selects (word + length), and a prefix-sum add.
+
+
+@dataclass(frozen=True)
+class PieceGroup:
+    """Static shape of one emission group (see :class:`PieceSchema`).
+
+    ``sel_cols``: the selector column ids (into the schema's column axis)
+    whose digits index this group's variant table — low bit / least
+    significant factor first; empty for the literal tail group.
+    ``n_variants``/``n_words``: live extent inside the padded ``gw``/``gl``
+    tables.  ``off_cap``: static upper bound on the group's output byte
+    offset (sum of prior groups' max lengths) — the placement span bound.
+    ``has_term``: the 0x80 terminator byte is folded into this group's
+    variant bytes (always the last group), so its table lengths are
+    placed-length = candidate bytes + 1.
+    """
+
+    sel_cols: Tuple[int, ...]
+    n_variants: int
+    n_words: int
+    off_cap: int
+    has_term: bool = False
+
+
+@dataclass(frozen=True)
+class PieceSchema:
+    """Host-precomputed per-slot emission plan for one (plan, table) pair.
+
+    Data tables (numpy; gathered per block by the wrappers):
+      ``gw`` uint32 [B, NG, VM, NW] — group variant words (little-endian
+      packed bytes), ``gl`` uint8 [B, NG, VM] — placed byte lengths.
+      ``sel_bit`` uint8 [B, C] — the chosen-bit position of each selector
+      column's slot in the packed chosen vector (suball plans; match
+      plans' column c IS slot/bit c, so ``None``).
+      ``sel_slot`` int32 [B, C] — the decode slot driving each column
+      (suball plans; ``None`` = identity).
+
+    ``groups`` is the static emission order; ``closed`` marks cascade-
+    closed suball plans (variant index = 1 + joint value index instead of
+    the raw digit).  ``max_out`` bounds every lane's placed bytes
+    (including the terminator) — the static placement budget.
+    """
+
+    kind: str  # "match" | "suball"
+    groups: Tuple[PieceGroup, ...]
+    gw: np.ndarray
+    gl: np.ndarray
+    sel_bit: Optional[np.ndarray] = None
+    sel_slot: Optional[np.ndarray] = None
+    closed: bool = False
+    max_out: int = 0
+    n_cols: int = 0
+
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+
+#: Grouping caps: a merged group's worst-case bytes must fit one u32, its
+#: variant table at most ``_MAX_GROUP_VARIANTS`` rows (memory: tables are
+#: per word), and a standalone piece at most ``_MAX_PIECE_WORDS`` u32s
+#: (beyond that the per-byte scan is the better formulation anyway).
+_MAX_GROUP_BYTES = 4
+_MAX_GROUP_VARIANTS = 4
+_MAX_PIECE_WORDS = 4
+#: Widest single-column variant table (cascade closure's joint tables
+#: reach 12 rows + skip).
+_MAX_COL_VARIANTS = 13
+
+
+def _col_val_len(col_opts, col_vstart, val_len, vmax):
+    """Per-(word, column, option) value lengths ``[B, C, vmax]`` (0 past a
+    column's own option count)."""
+    b, c = col_opts.shape
+    out = np.zeros((b, c, max(vmax, 1)), np.int32)
+    nrows = val_len.shape[0]
+    for v in range(vmax):
+        row = np.clip(col_vstart + v, 0, max(nrows - 1, 0))
+        out[:, :, v] = np.where(col_opts > v, val_len[row], 0)
+    return out
+
+
+def build_piece_schema(
+    tokens: np.ndarray,  # uint8 [B, L]
+    lengths: np.ndarray,  # int32 [B]
+    col_pos: np.ndarray,  # int32 [B, C] — span start (output order)
+    col_len: np.ndarray,  # int32 [B, C] — span length, 0 = no span
+    col_opts: np.ndarray,  # int32 [B, C] — selectable options (0 = literal)
+    col_vstart: np.ndarray,  # int32 [B, C] — value row of option 1
+    val_bytes: np.ndarray,  # uint8 [V, W]
+    val_len: np.ndarray,  # int32 [V]
+    *,
+    kind: str,
+    sel_slot: "np.ndarray | None" = None,  # int32 [B, C]
+    sel_bit: "np.ndarray | None" = None,  # int32 [B, C]
+    closed: bool = False,
+) -> "PieceSchema | None":
+    """Build the per-slot piece tables, or None when the plan's geometry
+    cannot take the scheme (static spans unsorted/overlapping, a piece
+    past the word cap, or a variant table past the row cap).
+
+    Columns are substitution sites in OUTPUT order; each column's piece is
+    the literal gap since the previous site (block-uniform bytes) plus the
+    site's span — original bytes when skipped (variant 0), the chosen
+    option's value bytes otherwise.  A final tail column carries the
+    trailing literals plus the 0x80 terminator (for NTLM's UTF-16LE
+    expansion the terminator pseudo-byte expands to exactly the padded
+    message's ``80 00`` pair, so no kernel terminator scan remains).
+    """
+    b, length_axis = tokens.shape
+    c_axis = col_pos.shape[1]
+    if b == 0:
+        return None
+    lengths = lengths.astype(np.int64)
+    has_span = col_len > 0
+    # Effective span starts: spanless columns sit at the running cursor so
+    # gap arithmetic stays monotone.
+    prev_end = np.zeros(b, np.int64)
+    gap_start = np.zeros((b, c_axis), np.int64)
+    gap_len = np.zeros((b, c_axis), np.int64)
+    for c in range(c_axis):
+        pos_c = np.where(has_span[:, c], col_pos[:, c].astype(np.int64),
+                         prev_end)
+        g = pos_c - prev_end
+        if (g < 0).any():
+            return None  # overlapping or unsorted static spans
+        gap_start[:, c] = prev_end
+        gap_len[:, c] = g
+        end_c = pos_c + np.where(has_span[:, c], col_len[:, c], 0)
+        if (end_c > lengths).any():
+            return None
+        prev_end = end_c
+    tail_start = prev_end
+    tail_len = lengths - tail_start
+    if (tail_len < 0).any():
+        return None
+
+    opts_max = [int(col_opts[:, c].max(initial=0)) for c in range(c_axis)]
+    if any(o + 1 > _MAX_COL_VARIANTS for o in opts_max):
+        return None
+    vl3 = _col_val_len(col_opts, col_vstart, val_len, max(opts_max or [0]))
+
+    # --- emission columns: the output-order byte stream ------------------
+    # Literal runs (gaps between sites, and the trailing tail + 0x80
+    # terminator) are SPLIT into <=4-byte chunks, each a variant-free
+    # column — a matchless 16-byte bucket word must not veto the whole
+    # plan by demanding one 17-byte piece.  Selector columns carry only
+    # their own span (skip) / value variants.
+    ecols: List[dict] = []
+
+    def add_lit(start, run_len, *, term):
+        total = run_len + (1 if term else 0)  # +1: terminator byte
+        for k in range(0, int(total.max(initial=0)), 4):
+            ecols.append({
+                "kind": "lit", "start": start, "src_len": run_len,
+                "off": k, "term": term,
+                "max": int(np.clip(total - k, 0, 4).max(initial=0)),
+            })
+
+    for c in range(c_axis):
+        add_lit(gap_start[:, c], gap_len[:, c], term=False)
+        widest = np.maximum(
+            np.where(has_span[:, c], col_len[:, c], 0),
+            vl3[:, c, : max(opts_max[c], 1)].max(axis=1)
+            if opts_max[c] else 0,
+        )
+        mx = int(widest.max(initial=0))
+        if mx == 0 and opts_max[c] == 0:
+            continue  # padding column in every word
+        ecols.append({"kind": "sel", "c": c, "max": mx})
+    add_lit(tail_start, tail_len, term=True)
+
+    # --- static grouping: greedy adjacent packing -----------------------
+    # A group merges consecutive emission columns while (a) worst-case
+    # bytes fit one u32, (b) the variant product stays small, (c) every
+    # merged selector column is binary (the kernel indexes merged groups
+    # by packed chosen bits).  A column too wide to merge stands alone
+    # with ceil(maxlen/4) words.
+    specs: List[List[dict]] = []
+    cur: "List[dict] | None" = None
+
+    def col_variants(e):
+        return opts_max[e["c"]] + 1 if e["kind"] == "sel" else 1
+
+    def cur_bytes(spec):
+        return sum(e["max"] for e in spec)
+
+    def cur_variants(spec):
+        v = 1
+        for e in spec:
+            v *= col_variants(e)
+        return v
+
+    for e in ecols:
+        v_c = col_variants(e)
+        sel_after = (
+            [] if cur is None
+            else [x for x in cur if col_variants(x) > 1]
+        ) + ([e] if v_c > 1 else [])
+        can_merge = (
+            cur is not None
+            and cur_bytes(cur) + e["max"] <= _MAX_GROUP_BYTES
+            and cur_variants(cur) * v_c <= _MAX_GROUP_VARIANTS
+            and (len(sel_after) <= 1
+                 or all(col_variants(x) == 2 for x in sel_after))
+        )
+        if can_merge:
+            cur.append(e)
+        else:
+            if cur is not None:
+                specs.append(cur)
+            cur = [e]
+    if cur is not None:
+        specs.append(cur)
+    if not specs:
+        return None
+
+    ng = len(specs)
+    vmax = max(cur_variants(s) for s in specs)
+    nwmax = max(-(-max(cur_bytes(s), 1) // 4) for s in specs)
+    if nwmax > _MAX_PIECE_WORDS or vmax > max(
+        _MAX_GROUP_VARIANTS, _MAX_COL_VARIANTS
+    ):
+        return None
+
+    gb = np.zeros((b, ng, vmax, nwmax * 4), np.uint8)
+    gl = np.zeros((b, ng, vmax), np.int64)
+    nrows = val_bytes.shape[0]
+    vw = val_bytes.shape[1]
+    rows_iota = np.arange(b)
+
+    def emit_bytes(gi, vi, at_len, data, dlen):
+        """OR bytes ([B, K] u8 + [B] length) into group (gi, vi) at the
+        running per-word offset ``at_len``; returns the new offset."""
+        for j in range(data.shape[1]):
+            live = j < dlen
+            pos = np.clip(at_len + j, 0, nwmax * 4 - 1)
+            old = gb[rows_iota, gi, vi, pos]
+            gb[rows_iota, gi, vi, pos] = np.where(live, data[:, j], old)
+        return at_len + dlen
+
+    def gather_tok(start, width):
+        if width == 0:
+            return np.zeros((b, 0), np.uint8)
+        idx = np.clip(
+            start[:, None] + np.arange(width)[None, :], 0, length_axis - 1
+        )
+        return np.take_along_axis(tokens, idx.astype(np.int64), axis=1)
+
+    def lit_chunk(e):
+        """One <=4-byte literal chunk: bytes [off, off+4) of the run
+        (plus the 0x80 terminator at the run's own end for the tail)."""
+        rel = e["src_len"] - e["off"]  # bytes of the run in/after chunk
+        width = e["max"]
+        data = gather_tok(e["start"] + e["off"], width)
+        for j in range(width):
+            dead = rel <= j
+            data[:, j] = np.where(dead, 0, data[:, j])
+            if e["term"]:
+                data[:, j] = np.where(rel == j, 0x80, data[:, j])
+        ln = np.clip(rel + (1 if e["term"] else 0), 0, 4)
+        return data, ln
+
+    for gi, spec in enumerate(specs):
+        sel = [e["c"] for e in spec if col_variants(e) > 1]
+        n_var = cur_variants(spec)
+        for vi in range(n_var):
+            # Decompose the variant index into per-selector digits,
+            # low column first (the kernel packs bits the same way).
+            digits = {}
+            rem = vi
+            for c in sel:
+                digits[c] = rem % (opts_max[c] + 1)
+                rem //= opts_max[c] + 1
+            at = np.zeros(b, np.int64)
+            for e in spec:
+                if e["kind"] == "lit":
+                    data, ln = lit_chunk(e)
+                    at = emit_bytes(gi, vi, at, data, ln)
+                    continue
+                c = e["c"]
+                d = digits.get(c, 0)
+                if d == 0:
+                    ln = np.where(has_span[:, c], col_len[:, c], 0
+                                  ).astype(np.int64)
+                    data = gather_tok(gap_start[:, c] + gap_len[:, c],
+                                      int(col_len[:, c].max(initial=0)))
+                else:
+                    row = np.clip(col_vstart[:, c] + d - 1, 0,
+                                  max(nrows - 1, 0))
+                    ln = np.where(
+                        col_opts[:, c] >= d, vl3[:, c, d - 1], 0
+                    ).astype(np.int64)
+                    data = val_bytes[row][:, :vw]
+                at = emit_bytes(gi, vi, at, data, ln)
+            gl[:, gi, vi] = at
+
+    gw = np.zeros((b, ng, vmax, nwmax), np.uint32)
+    for w in range(nwmax):
+        for k in range(4):
+            gw[:, :, :, w] |= gb[:, :, :, 4 * w + k].astype(
+                np.uint32
+            ) << np.uint32(8 * k)
+
+    groups = []
+    off = 0
+    for gi, spec in enumerate(specs):
+        sel = tuple(e["c"] for e in spec if col_variants(e) > 1)
+        nbytes = cur_bytes(spec)
+        groups.append(
+            PieceGroup(
+                sel_cols=sel,
+                n_variants=cur_variants(spec),
+                n_words=-(-max(nbytes, 1) // 4),
+                off_cap=off,
+                has_term=any(e["kind"] == "lit" and e["term"]
+                             for e in spec),
+            )
+        )
+        off += nbytes
+
+    return PieceSchema(
+        kind=kind,
+        groups=tuple(groups),
+        gw=gw,
+        gl=gl.astype(np.uint8),
+        sel_bit=None if sel_bit is None else sel_bit.astype(np.uint8),
+        sel_slot=None if sel_slot is None else sel_slot.astype(np.int32),
+        closed=closed,
+        max_out=off,
+        n_cols=c_axis,
+    )
+
+
+def _suball_piece_cols(plan) -> "tuple | None":
+    """Per-column arrays for a substitute-all plan: one column per PATTERN
+    segment (occurrence), in word order, with gap segments folded into the
+    following column's literal prefix by interval arithmetic.  Returns
+    ``(pos, ln, opts, vstart, sel_slot, sel_bit, closed)`` or None."""
+    seg_pat = np.asarray(plan.seg_pat)
+    seg_start = np.asarray(plan.seg_orig_start)
+    seg_len = np.asarray(plan.seg_orig_len)
+    radix = np.asarray(plan.pat_radix)
+    pvs = np.asarray(plan.pat_val_start)
+    b, _ = seg_pat.shape
+    p = radix.shape[1]
+    is_pat = seg_pat >= 0
+    fb = np.asarray(plan.fallback)
+    if fb.any():
+        # Oracle-routed words never reach the device; blank their columns
+        # so their (possibly degenerate) segment data can't veto the
+        # schema for everyone else.
+        is_pat = is_pat & ~fb[:, None]
+    c_axis = max(1, int(is_pat.sum(axis=1).max(initial=0)))
+    cols = np.cumsum(is_pat, axis=1) - 1
+    rows, segs = np.nonzero(is_pat)
+    cc = cols[rows, segs]
+    pos = np.zeros((b, c_axis), np.int32)
+    ln = np.zeros((b, c_axis), np.int32)
+    slot = np.zeros((b, c_axis), np.int32)
+    pos[rows, cc] = seg_start[rows, segs]
+    ln[rows, cc] = seg_len[rows, segs]
+    slot[rows, cc] = seg_pat[rows, segs]
+    # Joint-closure plans: a slot's value row is indexed by the JOINT
+    # digit (own + successors), so the column's variant count is the
+    # joint table's row count, not radix - 1.
+    closed = getattr(plan, "close_next", None) is not None
+    if closed:
+        cn = np.asarray(plan.close_next)
+        cm = np.asarray(plan.close_mul)
+        succ_r = np.where(
+            cn >= 0,
+            np.take_along_axis(
+                radix, np.clip(cn, 0, p - 1).reshape(b, -1), axis=1
+            ).reshape(cn.shape),
+            1,
+        )
+        # Own digit d is in [1, radix-1] when the slot is chosen, so the
+        # kernel's (d-1)*mul0 term peaks at (radix-2)*mul0.
+        jmax = (radix - 2).clip(min=0) * cm[:, :, 0] + (
+            (succ_r - 1) * cm[:, :, 1:]
+        ).sum(axis=2)
+        slot_opts = np.where(radix > 1, jmax + 1, 0)
+    else:
+        slot_opts = (radix - 1).clip(min=0)
+    act = (radix > 1).astype(np.int32)
+    bitpos = np.cumsum(act, axis=1) - act
+    take = lambda a: np.take_along_axis(a, slot, axis=1)  # noqa: E731
+    opts = np.where(ln > 0, take(slot_opts), 0)
+    vstart = take(pvs)
+    sel_bit = np.where(ln > 0, take(bitpos), 31)
+    return pos, ln, opts, vstart, slot, sel_bit, closed
+
+
+def piece_schema_for(plan, ct) -> "PieceSchema | None":
+    """The per-slot emission gate: a :class:`PieceSchema` when the plan's
+    static geometry supports piece emission (and ``A5GEN_EMIT`` doesn't
+    opt out), else None — callers fall back to the per-byte unit scan.
+
+    The schema's tables are ``gw uint32 [B, NG, VM, NW]`` group variant
+    words and ``gl uint8 [B, NG, VM]`` placed lengths (plus suball's
+    ``sel_slot int32 [B, C]`` / ``sel_bit uint8 [B, C]`` selector
+    columns).  Cached on the plan object (plans are frozen, keyed by
+    table identity), like ``pallas_expand.scalar_units_fields``."""
+    from ..runtime.env import emit_scheme
+
+    if emit_scheme() != "perslot":
+        return None
+    cache = getattr(plan, "_piece_schema_cache", None)
+    if cache is not None and cache[0] is ct:
+        return cache[1]
+    tokens = np.asarray(plan.tokens)
+    lengths = np.asarray(plan.lengths)
+    if getattr(plan, "match_pos", None) is not None:
+        radix = np.asarray(plan.match_radix)
+        schema = build_piece_schema(
+            tokens, lengths,
+            np.asarray(plan.match_pos), np.asarray(plan.match_len),
+            (radix - 1).clip(min=0), np.asarray(plan.match_val_start),
+            np.asarray(ct.val_bytes), np.asarray(ct.val_len),
+            kind="match",
+        )
+    else:
+        cols = _suball_piece_cols(plan)
+        if cols is None:
+            schema = None
+        else:
+            pos, ln, opts, vstart, slot, sel_bit, closed = cols
+            vb = getattr(plan, "cval_bytes", None)
+            vl = getattr(plan, "cval_len", None)
+            if vb is None:
+                vb, vl = np.asarray(ct.val_bytes), np.asarray(ct.val_len)
+            schema = build_piece_schema(
+                tokens, lengths, pos, ln, opts, vstart,
+                np.asarray(vb), np.asarray(vl),
+                kind="suball", sel_slot=slot, sel_bit=sel_bit,
+                closed=closed,
+            )
+    try:
+        object.__setattr__(plan, "_piece_schema_cache", (ct, schema))
+    except AttributeError:  # pragma: no cover - non-dataclass plan stubs
+        pass
+    return schema

@@ -764,9 +764,81 @@ def _hash_blocks_for(out_width: "int | None", scale: int) -> int:
     return max(1, -(-(int(out_width) * scale + 9) // 64))
 
 
+def _place_word(msg, nw_data, off, blen, word, j_span, term_hi=None):
+    """OR ``word``'s low ``blen`` bytes into the ``msg`` word list at byte
+    offset ``off`` (all (G, S) tiles; blen in 0..4 — 5 for a terminator-
+    folded final piece).  ``j_span``: static cap on the highest word index
+    the piece's LO part can reach (its hi half spills one further).
+    ``term_hi``: lanes whose folded piece is 5 bytes — the 5th byte rides
+    the hi word at the piece's own sub-word offset.  The single placement
+    primitive behind both the per-byte unit scan and the per-slot piece
+    emission (PERF.md §7a lever 1 / §17)."""
+    sh8 = (blen * 8) & 31
+    mask = (_U32(1) << sh8.astype(_U32)) - _U32(1)
+    mask = jnp.where(blen >= 4, _U32(0xFFFFFFFF), mask)
+    wm = word & mask
+    sh = (_U32(8) * (off & 3).astype(_U32))
+    lo = wm << sh
+    # Shift-by-32 is undefined: mask the amount and select instead.
+    hi = jnp.where(sh > 0, wm >> ((_U32(32) - sh) & _U32(31)), _U32(0))
+    if term_hi is not None:
+        hi = hi | jnp.where(term_hi, _U32(0x80) << sh, _U32(0))
+    widx = off >> 2
+    sel_prev = None
+    for w_i in range(min(nw_data, j_span + 1)):
+        sel = widx == w_i
+        contrib = jnp.where(sel, lo, _U32(0))
+        if sel_prev is not None:
+            contrib = contrib | jnp.where(sel_prev, hi, _U32(0))
+        msg[w_i] = msg[w_i] | contrib
+        sel_prev = sel
+    # hi spill past the last lo word (within the message bound).
+    w_last = min(nw_data, j_span + 1)
+    if w_last < nw_data:
+        msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
+
+
+def _length_words(msg, end, *, big_endian_length, hash_blocks):
+    """Fold the 64-bit message bit length into the padding block's length
+    words: word ``16k + 14`` (LE) / byte-swapped ``16k + 15`` (BE) for the
+    block whose window holds the lane's terminator+length (shared by both
+    emission schemes — see :func:`_message_from_units`)."""
+    bits = (end * 8).astype(_U32)
+    if big_endian_length:
+        # SHA-1: the 64-bit BE bit length occupies the padding block's
+        # bytes 56..63; its low 32 bits are that block's LE word 15
+        # byte-swapped (the BE high half, word 14, stays data-or-zero —
+        # zero in the padding block for <2^29-bit messages).
+        bits = (
+            ((bits & _U32(0xFF)) << 24)
+            | ((bits & _U32(0xFF00)) << 8)
+            | ((bits >> 8) & _U32(0xFF00))
+            | (bits >> 24)
+        )
+    lw = 15 if big_endian_length else 14
+    if hash_blocks == 1:
+        msg[lw] = bits
+    else:
+        # Per-lane padding block k: terminator + 8-byte length fit block
+        # k iff end <= 64*(k+1) - 9.  Later blocks are ignored by the
+        # state select, so the LAST block's length word can be
+        # unconditional; inner blocks' must not clobber longer lanes'
+        # data words.
+        for k in range(hash_blocks):
+            if k + 1 == hash_blocks:
+                msg[16 * k + lw] = msg[16 * k + lw] | bits
+            else:
+                fits = end <= (64 * (k + 1) - 9)
+                msg[16 * k + lw] = msg[16 * k + lw] | jnp.where(
+                    fits, bits, _U32(0)
+                )
+    return msg
+
+
 def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
                         *, big_endian_length=False, utf16=False,
-                        max_unit_len=4, out_width=None, hash_blocks=1):
+                        max_unit_len=4, out_width=None, hash_blocks=1,
+                        with_end=False):
     """Assemble the padded message (``16 * hash_blocks`` u32 words on
     (G, S) tiles, little-endian byte order — SHA-1 byte-swaps in its
     schedule) from per-unit output spans: unit j contributes bytes
@@ -804,36 +876,8 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
     nw_data = 16 * hash_blocks - 2
 
     def place(off, blen, word, j_span, term_hi=None):
-        """OR ``word``'s low ``blen`` bytes into msg at byte offset
-        ``off`` (all (G, S) tiles; blen in 0..4 — 5 for the final unit's
-        terminator-folded piece).  ``j_span``: static cap on the highest
-        word index the piece can reach.  ``term_hi``: lanes whose folded
-        piece is 5 bytes (a full 4-byte unit + the appended terminator) —
-        the 5th byte cannot live in ``word``'s u32, so it rides the hi
-        word at the piece's own sub-word offset, for ANY ``sh``."""
-        sh8 = (blen * 8) & 31
-        mask = (_U32(1) << sh8.astype(_U32)) - _U32(1)
-        mask = jnp.where(blen >= 4, _U32(0xFFFFFFFF), mask)
-        wm = word & mask
-        sh = (_U32(8) * (off & 3).astype(_U32))
-        lo = wm << sh
-        # Shift-by-32 is undefined: mask the amount and select instead.
-        hi = jnp.where(sh > 0, wm >> ((_U32(32) - sh) & _U32(31)), _U32(0))
-        if term_hi is not None:
-            hi = hi | jnp.where(term_hi, _U32(0x80) << sh, _U32(0))
-        widx = off >> 2
-        sel_prev = None
-        for w_i in range(min(nw_data, j_span + 1)):
-            sel = widx == w_i
-            contrib = jnp.where(sel, lo, _U32(0))
-            if sel_prev is not None:
-                contrib = contrib | jnp.where(sel_prev, hi, _U32(0))
-            msg[w_i] = msg[w_i] | contrib
-            sel_prev = sel
-        # hi spill past the last lo word (within the message bound).
-        w_last = min(nw_data, j_span + 1)
-        if w_last < nw_data:
-            msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
+        """Whole-unit placement (see :func:`_place_word`)."""
+        _place_word(msg, nw_data, off, blen, word, j_span, term_hi=term_hi)
 
     mul = max(1, int(max_unit_len))
     # Terminator fold (PERF.md §7a ranked lever 3): ``cum`` is monotone
@@ -887,36 +931,9 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
                   else min(nw_data, (int(out_width) * scale) // 4 + 1))
         for w_i in range(n_term):
             msg[w_i] = msg[w_i] | jnp.where(widx == w_i, mark, _U32(0))
-    bits = (end * 8).astype(_U32)
-    if big_endian_length:
-        # SHA-1: the 64-bit BE bit length occupies the padding block's
-        # bytes 56..63; its low 32 bits are that block's LE word 15
-        # byte-swapped (the BE high half, word 14, stays data-or-zero —
-        # zero in the padding block for <2^29-bit messages).
-        bits = (
-            ((bits & _U32(0xFF)) << 24)
-            | ((bits & _U32(0xFF00)) << 8)
-            | ((bits >> 8) & _U32(0xFF00))
-            | (bits >> 24)
-        )
-    lw = 15 if big_endian_length else 14
-    if hash_blocks == 1:
-        msg[lw] = bits
-    else:
-        # Per-lane padding block k: terminator + 8-byte length fit block
-        # k iff end <= 64*(k+1) - 9.  Later blocks are ignored by the
-        # state select, so the LAST block's length word can be
-        # unconditional; inner blocks' must not clobber longer lanes'
-        # data words.
-        for k in range(hash_blocks):
-            if k + 1 == hash_blocks:
-                msg[16 * k + lw] = msg[16 * k + lw] | bits
-            else:
-                fits = end <= (64 * (k + 1) - 9)
-                msg[16 * k + lw] = msg[16 * k + lw] | jnp.where(
-                    fits, bits, _U32(0)
-                )
-    return msg
+    msg = _length_words(msg, end, big_endian_length=big_endian_length,
+                        hash_blocks=hash_blocks)
+    return (msg, end) if with_end else msg
 
 
 def _md5_rounds(msg, g, s, init=None):
@@ -1019,19 +1036,27 @@ def _hash_units(algo, unit_start, unit_len, unit_word, out_len, g, s,
     utf16 = algo == "ntlm"
     scale = 2 if utf16 else 1
     nblocks = _hash_blocks_for(out_width, scale)
+    msg, end = _message_from_units(unit_start, unit_len, unit_word,
+                                   out_len, g, s, utf16=utf16,
+                                   big_endian_length=algo == "sha1",
+                                   max_unit_len=max_unit_len,
+                                   out_width=out_width,
+                                   hash_blocks=nblocks, with_end=True)
+    return _compress_message(algo, msg, end, g, s, hash_blocks=nblocks)
+
+
+def _compress_message(algo, msg, end, g, s, *, hash_blocks):
+    """Chain ``hash_blocks`` compressions over an assembled message and
+    select each lane's digest after ITS OWN padding block (terminator +
+    length fit block k iff ``end <= 64*(k+1) - 9``) — shared by both
+    emission schemes."""
     rounds = {"md5": _md5_rounds, "md4": _md4_rounds, "ntlm": _md4_rounds,
               "sha1": _sha1_rounds}[algo]
-    msg = _message_from_units(unit_start, unit_len, unit_word, out_len,
-                              g, s, utf16=utf16,
-                              big_endian_length=algo == "sha1",
-                              max_unit_len=max_unit_len,
-                              out_width=out_width, hash_blocks=nblocks)
     state = rounds(msg[:16], g, s)
-    if nblocks == 1:
+    if hash_blocks == 1:
         return state
-    end = out_len * scale
     final = state
-    for k in range(1, nblocks):
+    for k in range(1, hash_blocks):
         state = rounds(msg[16 * k:16 * (k + 1)], g, s, init=state)
         needs_k = end > (64 * k - 9)  # lane's padding block is >= k
         final = tuple(
@@ -1070,6 +1095,246 @@ def _grouped_hash_units(algo, unit_start, unit_len, unit_word, out_len,
         unit_start, unit_len, unit_word = g_start, g_len, g_word
     return _hash_units(algo, unit_start, unit_len, unit_word, out_len,
                        g, s, max_unit_len=mu * gsz, out_width=out_width)
+
+
+def _shr_static(x, n: int):
+    """``x >> n`` for a static shift up to 63 on i32 tiles.  Shifts past
+    31 are split in two (packed chosen vectors stay below 2^26, so the
+    result there is exactly 0 — never implementation-defined)."""
+    if n <= 31:
+        return x >> n if n else x
+    return (x >> 31) >> (n - 31)
+
+
+def _select_rows(idx, rows, g, s):
+    """N-way variant select on (G, S) tiles: ``rows[idx]`` per lane.
+    ``rows`` are (G,) ref slices (block-uniform variant words/lengths),
+    broadcast once along the lane axis; one ``lax.select_n`` replaces the
+    compare-select chain."""
+    cases = [jax.lax.broadcast_in_dim(r, (g, s), (0,)) for r in rows]
+    if len(cases) == 1:
+        return cases[0]
+    return jax.lax.select_n(idx, *cases)
+
+
+def _make_piece_kernel(
+    *, g: int, s: int, kind: str, schema, num_slots: int, k_opts: int,
+    out_width: int, min_substitute: int, max_substitute: int,
+    algo: str = "md5", scalar: bool = False, windowed: bool = False,
+    close_s: "int | None" = None,
+):
+    """Per-slot piece-emission kernel body (PERF.md §17) — ONE builder for
+    every tier (match/suball × scalar/general × full/windowed × closed).
+
+    The unit scheme's O(L) per-byte resolution is replaced by the plan's
+    :class:`ops.packing.PieceSchema`: per emission GROUP the kernel forms
+    a variant index from the group's slots' digits (scalar tiers: a bit
+    field of the packed chosen vector), selects the group's precomputed
+    word(s) and placed length with one ``select_n`` each, places the
+    word(s) via the shared :func:`_place_word` scatter at the lane-local
+    prefix offset, and advances the prefix sum.  Literal gaps, skip
+    bytes, value bytes AND the 0x80 terminator live in the host tables
+    (the tail group's bytes carry the terminator, which under NTLM's
+    UTF-16LE expansion lands as exactly the padded message's ``80 00``
+    pair — no terminator scan remains in any tier).
+
+    Ref order (VMEM per grid step): ``count[G, 1]``, then the decode refs
+    — scalar full: ``pbase[G, 1]``; windowed: ``base[G, M]``,
+    ``radix[G, M]``, ``winv[G, M+1, K2]``; general: ``base[G, M]``,
+    ``radix[G, M]`` — then suball selector refs (scalar: ``selbit[G, C]``
+    (+ ``bitpos[G, P]`` when windowed); general: ``selslot[G, C]``), then
+    closure refs (``cnext``/``cmul``), then the piece tables
+    ``gw[G, NG, VM, NW] u32`` / ``gl[G, NG, VM] i32``.
+    Outputs: ``state[G, KS, S] u32``, ``emit[G, S] i32`` — identical
+    contract to :func:`_make_kernel`.
+    """
+    utf16 = algo == "ntlm"
+    scale = 2 if utf16 else 1
+    hash_blocks = _hash_blocks_for(out_width, scale)
+    assert 0 < out_width and hash_blocks <= _MAX_HASH_BLOCKS, out_width
+    assert kind in ("match", "suball"), kind
+    groups = schema.groups
+    closed = bool(schema.closed)
+
+    def kernel(count, *rest):
+        rest = list(rest)
+        pbase = base = radix = winv = None
+        if scalar and not windowed:
+            pbase = rest.pop(0)
+        else:
+            base = rest.pop(0)
+            radix = rest.pop(0)
+            if windowed:
+                winv = rest.pop(0)
+        selbit = selslot = bitpos = None
+        if kind == "suball":
+            if scalar:
+                if windowed:
+                    bitpos = rest.pop(0)
+                selbit = rest.pop(0)
+            else:
+                selslot = rest.pop(0)
+        cnext = cmul = None
+        if close_s is not None:
+            cnext = rest.pop(0)
+            cmul = rest.pop(0)
+        gw, gl = rest.pop(0), rest.pop(0)
+        state_ref, emit_ref = rest
+
+        rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
+        lane_ok = rank < count[:, 0][:, None]
+
+        # --- decode: digits and/or the packed chosen vector -------------
+        digits = cb = None
+        if scalar and not windowed:
+            cb = pbase[:, 0][:, None] + rank
+        elif windowed:
+            digits = _decode_tile_windowed(
+                rank, base, winv, radix, num_slots, g, s, k_opts
+            )
+        else:
+            decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
+            digits = decode(rank, base, radix, num_slots, g, s)
+        if scalar and windowed:
+            # Pack the DP walk's chosen bits so the piece selectors read
+            # one vector (match plans: slot c IS bit c — active slots are
+            # a prefix; suball: per-block bit positions).
+            cb = jnp.zeros((g, s), _I32)
+            for sl in range(num_slots):
+                bit = (digits[sl] > 0).astype(_I32)
+                if kind == "match":
+                    cb = cb | (bit << sl)
+                else:
+                    cb = cb | (bit << bitpos[:, sl][:, None])
+        if cb is not None:
+            chosen_count = _popcount_tile(cb)
+        else:
+            chosen_count = jnp.zeros((g, s), _I32)
+            for sl in range(num_slots):
+                chosen_count = chosen_count + (digits[sl] > 0).astype(_I32)
+
+        # Cascade closure (suball general only): per-slot JOINT value
+        # index over the slot's own and its successors' digits — same
+        # unrolled compare-select as the byte-scan kernel.
+        joint = None
+        if close_s is not None:
+            joint = []
+            for sl in range(num_slots):
+                acc = (digits[sl] - 1) * cmul[:, sl, 0][:, None]
+                for s_i in range(close_s):
+                    nt = cnext[:, sl, s_i][:, None]
+                    ds = jnp.zeros((g, s), _I32)
+                    for t2 in range(sl + 1, num_slots):
+                        ds = jnp.where(nt == t2, digits[t2], ds)
+                    acc = acc + ds * cmul[:, sl, 1 + s_i][:, None]
+                joint.append(acc)
+
+        def col_variant(c):
+            """Column c's variant index (0 = skip) as a (G, S) i32."""
+            if kind == "match":
+                d = digits[c]
+            else:  # suball general: digit of the owning pattern slot
+                d = jnp.zeros((g, s), _I32)
+                jc = jnp.zeros((g, s), _I32) if closed else None
+                for sl in range(num_slots):
+                    here = selslot[:, c][:, None] == sl
+                    d = jnp.where(here, digits[sl], d)
+                    if closed:
+                        jc = jnp.where(here, joint[sl], jc)
+                if closed:
+                    return jnp.where(d > 0, 1 + jc, 0)
+            return d
+
+        # --- per-group emission ------------------------------------------
+        msg = [jnp.zeros((g, s), _U32) for _ in range(16 * hash_blocks)]
+        nw_data = 16 * hash_blocks - 2
+        cum = jnp.zeros((g, s), _I32)
+        for gi, grp in enumerate(groups):
+            n_var, n_words = grp.n_variants, grp.n_words
+            idx = None
+            if n_var > 1:
+                sel = grp.sel_cols
+                if cb is not None:
+                    if kind == "match" and sel == tuple(
+                        range(sel[0], sel[0] + len(sel))
+                    ):
+                        # Adjacent slots: one bit-field extract indexes
+                        # the whole merged group.
+                        idx = _shr_static(cb, sel[0]) & (
+                            (1 << len(sel)) - 1
+                        )
+                    else:
+                        idx = jnp.zeros((g, s), _I32)
+                        for i, c in enumerate(sel):
+                            if kind == "match":
+                                bit = _shr_static(cb, c) & 1
+                            else:
+                                bit = (
+                                    cb >> selbit[:, c][:, None]
+                                ) & 1
+                            idx = idx | (bit << i)
+                elif len(sel) == 1:
+                    # Clamp: padding columns (words with fewer pattern
+                    # segments than the column axis) alias slot 0, whose
+                    # digit/joint index can exceed this column's variant
+                    # rows — every row of a padding column is empty, so
+                    # any in-range row is correct, but select_n with an
+                    # out-of-range index is undefined on TPU.
+                    idx = jnp.minimum(col_variant(sel[0]), n_var - 1)
+                else:  # merged binary columns under a digit decode
+                    idx = jnp.zeros((g, s), _I32)
+                    for i, c in enumerate(sel):
+                        idx = idx | (
+                            (col_variant(c) > 0).astype(_I32) << i
+                        )
+            l = _select_rows(idx, [gl[:, gi, v] for v in range(n_var)],
+                             g, s)
+            for w in range(n_words):
+                wd = _select_rows(
+                    idx, [gw[:, gi, v, w] for v in range(n_var)], g, s
+                )
+                off = cum if w == 0 else cum + 4 * w
+                blen = l if n_words == 1 else jnp.clip(l - 4 * w, 0, 4)
+                span = (grp.off_cap + 4 * w) // 4
+                if not utf16:
+                    _place_word(msg, nw_data, off, blen, wd,
+                                min(span, nw_data))
+                else:
+                    # Bytes b0..b3 -> code units (b0|b1<<16) at 2*off and
+                    # (b2|b3<<16) at 2*off+4 (the shared split-piece
+                    # machinery; the terminator pseudo-byte expands to
+                    # the message's 80 00 pair).
+                    lo16 = (wd & _U32(0xFF)) | ((wd & _U32(0xFF00)) << 8)
+                    hi16 = ((wd >> 16) & _U32(0xFF)) | (
+                        ((wd >> 24) & _U32(0xFF)) << 16
+                    )
+                    off2 = off * 2
+                    blen_lo = jnp.minimum(blen, 2) * 2
+                    blen_hi = jnp.maximum(blen - 2, 0) * 2
+                    span2 = (2 * (grp.off_cap + 4 * w)) // 4
+                    _place_word(msg, nw_data, off2, blen_lo, lo16,
+                                min(span2, nw_data))
+                    _place_word(msg, nw_data, off2 + 4, blen_hi, hi16,
+                                min(span2 + 1, nw_data))
+            cum = cum + l
+        # The tail group's placed bytes include the terminator.
+        out_len = cum - 1
+        end = out_len * scale if scale != 1 else out_len
+        msg = _length_words(msg, end, big_endian_length=algo == "sha1",
+                            hash_blocks=hash_blocks)
+        state = _compress_message(algo, msg, end, g, s,
+                                  hash_blocks=hash_blocks)
+        for w_i, sw in enumerate(state):
+            state_ref[:, w_i, :] = sw
+
+        emit = (
+            lane_ok
+            & (chosen_count >= min_substitute)
+            & (chosen_count <= max_substitute)
+        )
+        emit_ref[:, :] = emit.astype(_I32)
+
+    return kernel
 
 
 def _make_kernel(
@@ -1283,6 +1548,18 @@ def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
     return state, emit
 
 
+def _piece_tables(pieces, pre, blk_word):
+    """Per-block piece tables for the piece kernels: device copies from
+    ``pre`` (``piece_arrays`` — shipped once per sweep) when present,
+    else the schema's own host arrays (trace-time constants; the harness
+    and direct calls)."""
+    if pre is not None and "pw" in pre:
+        gw_all, gl_all = pre["pw"], pre["pl"]
+    else:
+        gw_all, gl_all = jnp.asarray(pieces.gw), jnp.asarray(pieces.gl)
+    return gw_all[blk_word], gl_all[blk_word].astype(_I32)
+
+
 @audited_entry(
     "ops.fused_expand_md5",
     kind="pallas_kernel",
@@ -1311,6 +1588,7 @@ def fused_expand_md5(
     win_v: "jnp.ndarray | None" = None,  # int32 [B, M+1, K2] (windowed)
     scalar_units: bool = False,
     pre: "dict | None" = None,  # scalar_units_fields device arrays
+    pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
     interpret: bool = False,
 ):
     """Fused decode+splice+hash for a fixed-stride launch.
@@ -1330,6 +1608,39 @@ def fused_expand_md5(
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
+
+    if pieces is not None:
+        # Per-slot piece emission (PERF.md §17): the whole byte-position
+        # scan is replaced by the schema's precomputed group tables.
+        scalar = bool(scalar_units) and k_opts == 1
+        gw_b, gl_b = _piece_tables(pieces, pre, blk_word)
+        if scalar and win_v is None:
+            if pre is not None and "weight" in pre:
+                pbase = jnp.sum(
+                    blk_base * pre["weight"][blk_word], axis=1
+                )[:, None]
+            else:
+                _, _, _, pbase = _scalar_units_prelude(
+                    match_radix[blk_word], blk_base
+                )
+            inputs = (blk_count[:, None], pbase, gw_b, gl_b)
+        else:
+            inputs = (blk_count[:, None], blk_base,
+                      match_radix[blk_word])
+            if win_v is not None:
+                inputs = inputs + (win_v[blk_word],)
+            inputs = inputs + (gw_b, gl_b)
+        kernel = _make_piece_kernel(
+            g=_G, s=block_stride, kind="match", schema=pieces,
+            num_slots=m, k_opts=k_opts, out_width=out_width,
+            min_substitute=min_substitute, max_substitute=max_substitute,
+            algo=algo, scalar=scalar, windowed=win_v is not None,
+        )
+        return _launch_fused(
+            kernel, inputs, nb=nb, stride=block_stride,
+            num_lanes=num_lanes, n_state=DIGEST_WORDS[algo],
+            interpret=interpret,
+        )
 
     # Block-level gathers (NB rows — the cheap granularity): per-block word
     # fields and per-(block, slot, option) packed value words.
@@ -1613,6 +1924,7 @@ def fused_expand_suball_md5(
     win_v: "jnp.ndarray | None" = None,  # int32 [B, P+1, K2] (windowed)
     scalar_units: bool = False,
     pre: "dict | None" = None,  # scalar_units_fields device arrays
+    pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
     interpret: bool = False,
     close_next: "jnp.ndarray | None" = None,  # int32 [B, P, S] (closure)
     close_mul: "jnp.ndarray | None" = None,  # int32 [B, P, S+1]
@@ -1639,6 +1951,66 @@ def fused_expand_suball_md5(
             "cascade-closed plans cannot take the scalar-units kernel "
             "(joint value tables are per-lane, not block-uniform); gate "
             "via scalar_units_for(plan)"
+        )
+
+    if pieces is not None:
+        # Per-slot piece emission (PERF.md §17): segments ARE the pieces;
+        # gap segments fold into the schema's literal prefixes.
+        scalar = bool(scalar_units) and k_opts == 1
+        gw_b, gl_b = _piece_tables(pieces, pre, blk_word)
+        if scalar:
+            if pre is not None and "sbit" in pre:
+                selbit_b = pre["sbit"][blk_word].astype(_I32)
+            else:
+                selbit_b = jnp.asarray(
+                    pieces.sel_bit
+                )[blk_word].astype(_I32)
+        inputs = (blk_count[:, None],)
+        if scalar and win_v is None:
+            if pre is not None and "weight" in pre:
+                pbase = jnp.sum(
+                    blk_base * pre["weight"][blk_word], axis=1
+                )[:, None]
+            else:
+                _, _, _, pbase = _scalar_units_prelude(
+                    pat_radix[blk_word], blk_base
+                )
+            inputs += (pbase, selbit_b)
+        elif scalar:
+            if pre is not None and "bitpos" in pre:
+                bitpos_b = pre["bitpos"][blk_word]
+            else:
+                _, bitpos_b, _, _ = _scalar_units_prelude(
+                    pat_radix[blk_word], blk_base
+                )
+            inputs += (blk_base, pat_radix[blk_word], win_v[blk_word],
+                       bitpos_b, selbit_b)
+        else:
+            if pre is not None and "sslot" in pre:
+                selslot_b = pre["sslot"][blk_word]
+            else:
+                selslot_b = jnp.asarray(
+                    pieces.sel_slot
+                )[blk_word].astype(_I32)
+            inputs += (blk_base, pat_radix[blk_word])
+            if win_v is not None:
+                inputs += (win_v[blk_word],)
+            inputs += (selslot_b,)
+            if close_next is not None:
+                inputs += (close_next[blk_word], close_mul[blk_word])
+        inputs += (gw_b, gl_b)
+        kernel = _make_piece_kernel(
+            g=_G, s=block_stride, kind="suball", schema=pieces,
+            num_slots=p, k_opts=k_opts, out_width=out_width,
+            min_substitute=min_substitute, max_substitute=max_substitute,
+            algo=algo, scalar=scalar, windowed=win_v is not None,
+            close_s=(None if close_next is None
+                     else int(close_next.shape[2])),
+        )
+        return _launch_fused(
+            kernel, inputs, nb=nb, stride=block_stride,
+            num_lanes=num_lanes, n_state=DIGEST_WORDS[algo],
+            interpret=interpret,
         )
 
     tok_b = tokens[blk_word].astype(_I32)

@@ -154,6 +154,7 @@ def make_sharded_crack_step(
     fused_expand_opts: int | None = None,
     fused_scalar_units: bool = False,
     radix2: bool = False,
+    pieces=None,
 ):
     """The fused crack step, shard_map'd over a 1-D mesh.
 
@@ -174,6 +175,7 @@ def make_sharded_crack_step(
         spec, num_lanes=lanes_per_device, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
+        pieces=pieces,
     )
 
     def local_step(plan, table, digests, blocks):
@@ -275,6 +277,7 @@ def make_sharded_candidates_step(
     axis_name: str = "data",
     block_stride: int | None = None,
     radix2: bool = False,
+    pieces=None,
 ):
     """The expand-only step, shard_map'd over a 1-D mesh.
 
@@ -289,7 +292,7 @@ def make_sharded_candidates_step(
     """
     local_step = make_candidates_body(
         spec, num_lanes=lanes_per_device, out_width=out_width,
-        block_stride=block_stride, radix2=radix2,
+        block_stride=block_stride, radix2=radix2, pieces=pieces,
     )
 
     rep = P()

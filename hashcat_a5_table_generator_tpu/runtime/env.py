@@ -48,3 +48,24 @@ def env_str(name: str, default: str = "") -> str:
 def env_is(name: str, literal: str) -> bool:
     """Exact-match test (``A5GEN_PALLAS == "1"`` and friends)."""
     return read_env(name) == literal
+
+
+def emit_scheme() -> str:
+    """Message-emission scheme knob: ``A5GEN_EMIT`` selects between the
+    per-slot piece emission (``perslot`` — the default; PERF.md §17) and
+    the legacy per-byte unit scan (``bytescan`` — the A/B arm and escape
+    hatch, kept for one release).  Unrecognized values warn and keep the
+    default — a typo must not silently change the compiled kernels."""
+    val = read_env("A5GEN_EMIT")
+    if val is None or val in ("", "perslot"):
+        return "perslot"
+    if val == "bytescan":
+        return "bytescan"
+    import sys
+
+    print(
+        f"a5gen: warning: unrecognized A5GEN_EMIT={val!r} "
+        "(want perslot|bytescan); keeping the default (perslot)",
+        file=sys.stderr,
+    )
+    return "perslot"

@@ -50,6 +50,7 @@ from ..models.attack import (
     make_candidates_step,
     make_crack_step,
     make_superstep_step,
+    piece_arrays,
     plan_arrays,
     scalar_units_arrays,
     superstep_arrays,
@@ -436,6 +437,7 @@ class Sweep:
         spec, cfg, plan = self.spec, self.config, self.plan
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()
+        from ..ops.packing import piece_schema_for
         from ..ops.pallas_expand import (
             k_opts_for,
             opts_for,
@@ -453,17 +455,23 @@ class Sweep:
         # K=1 tables (all radices <= 2): the XLA decode collapses to bit
         # extraction (expand_matches.decode_digits radix2 path).
         radix2 = k_opts_for(plan) == 1
+        # Per-slot piece emission (PERF.md §17; A5GEN_EMIT=bytescan opts
+        # out): one schema drives the Pallas kernels AND the XLA splice.
+        pieces = piece_schema_for(plan, self.ct)
         if n_devices == 1:
             p, t = plan_arrays(plan), table_arrays(self.ct)
             if fused_opts is not None and scalar_units:
                 # Word-level scalar-unit fields precomputed once per
                 # sweep; the kernel wrapper preps by gathering.
                 p.update(scalar_units_arrays(plan, self.ct))
+            if pieces is not None:
+                p.update(piece_arrays(pieces))
             if kind == "crack":
                 step = make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
                     block_stride=stride, fused_expand_opts=fused_opts,
                     fused_scalar_units=scalar_units, radix2=radix2,
+                    pieces=pieces,
                 )
                 darrs = digest_arrays(
                     build_digest_set(self.digests, spec.algo)
@@ -474,11 +482,12 @@ class Sweep:
                 self._step_ctx = dict(
                     arrays=(p, t, darrs), fused_opts=fused_opts,
                     scalar_units=scalar_units, radix2=radix2, stride=stride,
+                    pieces=pieces,
                 )
                 return (lambda blocks: step(p, t, blocks, darrs)), 1, None
             step = make_candidates_step(
                 spec, num_lanes=cfg.lanes, out_width=plan.out_width,
-                block_stride=stride, radix2=radix2,
+                block_stride=stride, radix2=radix2, pieces=pieces,
             )
             return (lambda blocks: step(p, t, blocks)), 1, None
 
@@ -496,10 +505,13 @@ class Sweep:
                 out_width=plan.out_width, block_stride=stride,
                 fused_expand_opts=fused_opts,
                 fused_scalar_units=scalar_units, radix2=radix2,
+                pieces=pieces,
             )
             parr = plan_arrays(plan)
             if fused_opts is not None and scalar_units:
                 parr.update(scalar_units_arrays(plan, self.ct))
+            if pieces is not None:
+                parr.update(piece_arrays(pieces))
             p, t, darrs = replicate(
                 mesh,
                 (
@@ -511,13 +523,17 @@ class Sweep:
             self._step_ctx = dict(
                 arrays=(p, t, darrs), fused_opts=fused_opts,
                 scalar_units=scalar_units, radix2=radix2, stride=stride,
+                pieces=pieces,
             )
             return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
         step = make_sharded_candidates_step(
             spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width,
-            block_stride=stride, radix2=radix2,
+            block_stride=stride, radix2=radix2, pieces=pieces,
         )
-        p, t = replicate(mesh, (plan_arrays(plan), table_arrays(self.ct)))
+        parr = plan_arrays(plan)
+        if pieces is not None:
+            parr.update(piece_arrays(pieces))
+        p, t = replicate(mesh, (parr, table_arrays(self.ct)))
         return (lambda blocks: step(p, t, blocks)), n_devices, mesh
 
     # ------------------------------------------------------------------
@@ -607,6 +623,7 @@ class Sweep:
             windowed=bool(getattr(plan, "windowed", False)),
             fused_expand_opts=ctx["fused_opts"],
             fused_scalar_units=ctx["scalar_units"], radix2=ctx["radix2"],
+            pieces=ctx["pieces"],
         )
         p, t, darrs = ctx["arrays"]
         if n_devices == 1:

@@ -49,6 +49,12 @@ def main():
     ap.add_argument("--no-scalar-units", action="store_true",
                     help="force the general kernel even when the plan "
                          "qualifies for the K=1 scalar-units path")
+    ap.add_argument("--emit", choices=("perslot", "bytescan"),
+                    default="perslot",
+                    help="emission scheme to count: per-slot pieces "
+                         "(production default, PERF.md §17) or the "
+                         "legacy per-byte unit scan (the A5GEN_EMIT="
+                         "bytescan escape hatch)")
     ap.add_argument("--min-substitute", type=int, default=0,
                     help="count-window floor (tight windows produce "
                          "windowed plans — the DP-decode kernel)")
@@ -62,7 +68,10 @@ def main():
     )
     from hashcat_a5_table_generator_tpu.ops import pallas_expand as pe
     from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
-    from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+    from hashcat_a5_table_generator_tpu.ops.packing import (
+        pack_words,
+        piece_schema_for,
+    )
     from hashcat_a5_table_generator_tpu.tables.compile import compile_table
     from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
 
@@ -109,6 +118,8 @@ def main():
         block_stride=stride, k_opts=k, algo=args.algo, interpret=True,
         scalar_units=(not args.no_scalar_units
                       and pe.scalar_units_for(plan)),
+        pieces=(piece_schema_for(plan, ct) if args.emit == "perslot"
+                else None),
     )
     if args.mode in ("default", "reverse"):
         fn = lambda: pe.fused_expand_md5(  # noqa: E731
@@ -132,9 +143,13 @@ def main():
     ops, by_prim = count_kernel_ops(inner, g, stride)
     closed = getattr(plan, "closed", None)
     n_closed = int(closed.sum()) if closed is not None else 0
+    pieces = common["pieces"]
+    emit = "perslot" if pieces is not None else "bytescan"
     print(f"mode={args.mode} algo={args.algo} table={args.table} "
           f"stride={stride} slots={plan.num_slots} "
-          f"tokens={plan.tokens.shape[1]} K={k} closed_words={n_closed}")
+          f"tokens={plan.tokens.shape[1]} K={k} closed_words={n_closed} "
+          f"emit={emit}"
+          + (f" groups={pieces.num_groups}" if pieces is not None else ""))
     print(f"kernel vector ops per candidate: {ops:.0f}")
     for name, w in by_prim.most_common(12):
         print(f"  {name:>22}: {w:8.1f}")

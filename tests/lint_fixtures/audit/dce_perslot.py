@@ -1,0 +1,85 @@
+"""Dead-stage fixture pair for the PER-SLOT emission body (PERF.md §17).
+
+The §15 membership-DCE trap, re-armed against the new splice: the
+per-slot piece path rebuilt the expand stage around host-precomputed
+group tables, so this pair proves (a) the production crack-step contract
+still keeps expand+hash+membership alive through the piece splice, and
+(b) an emitted-only accumulator over the SAME piece body still lets XLA
+drop the membership stage — i.e. the audit's stage markers keep working
+on the rewritten body, not just the legacy one ``dce_membership.py``
+pins.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    digest_arrays,
+    make_fused_body,
+    piece_arrays,
+    plan_arrays,
+    table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
+from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    pack_words,
+    piece_schema_for,
+)
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+
+#: All three crack stages must survive in the clean body.
+STAGES = ("expand", "hash", "membership")
+
+_NB, _STRIDE = 8, 128
+
+
+def _setup():
+    spec = AttackSpec(mode="default", algo="md5")
+    ct = compile_table({b"a": [b"X"], b"e": [b"3"], b"o": [b"0"]})
+    plan = build_plan(spec, ct, pack_words([b"paooaeoale", b"aeaeae"]))
+    pieces = piece_schema_for(plan, ct)
+    assert pieces is not None, "fixture plan must be piece-eligible"
+    batch, _, _ = make_blocks(
+        plan, start_word=0, start_rank=0, max_variants=_NB * _STRIDE,
+        max_blocks=_NB, fixed_stride=_STRIDE,
+    )
+    p = plan_arrays(plan)
+    p.update(piece_arrays(pieces))
+    ds = build_digest_set([bytes(16), bytes(range(16))], "md5")
+    return spec, plan, pieces, p, table_arrays(ct), digest_arrays(ds), \
+        block_arrays(pad_batch(batch, _NB), num_blocks=_NB)
+
+
+def example_args():
+    _, _, _, p, t, d, b = _setup()
+    return (p, t, d, b)
+
+
+def _body():
+    spec, plan, pieces, *_ = _setup()
+    return make_fused_body(
+        spec, num_lanes=_NB * _STRIDE, out_width=int(plan.out_width),
+        block_stride=_STRIDE, radix2=True, pieces=pieces,
+    )
+
+
+def clean_body(p, t, d, b):
+    """The production crack-step contract over the piece splice: hits
+    stay live, so all three stages must survive optimization."""
+    return _body()(p, t, d, b)
+
+
+def broken_body(p, t, d, b):
+    """The §15 trap shape over the piece splice: only ``n_emitted``
+    escapes, so XLA drops membership (and the hash feeding it)."""
+    out = _body()(p, t, d, b)
+    return {"n_emitted": out["n_emitted"]}
+
+
+def __graftlint_skip__():  # pragma: no cover - marker only
+    """Fixture corpus: excluded from repo-wide lint sweeps."""

@@ -93,3 +93,48 @@ def test_committed_tpu_last_is_valid():
         assert key in rec, key
     assert rec["platform"] != "cpu"
     assert rec["value"] > 0
+
+
+def test_parser_has_stride_ab_and_init_retry_budget():
+    """New knobs (PERF.md §17): the stride/emission A/B arm and the
+    orchestrator's cap on cumulative pre-init retry wall."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    args = bench.build_parser().parse_args([])
+    assert args.stride_ab is False
+    assert args.init_retry_budget == 240.0
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.slow
+def test_stride_ab_record_shape():
+    """--stride-ab: one JSON record with per-arm hashes/s AND the
+    budget-counter ops/candidate, plus the winner and the
+    KERNEL_BUDGETS cross-reference."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--stride-ab",
+         "--platform", "cpu", "--words", "300", "--seconds", "1",
+         "--batches", "2"],
+        capture_output=True, timeout=420, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    rec = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert rec["metric"] == "stride_emit_ab"
+    assert rec["budget_file"] == "KERNEL_BUDGETS.json"
+    assert rec["winner"] in rec["arms"]
+    assert rec["emit_default"] in ("perslot", "bytescan")
+    for name in ("stride128-perslot", "stride128-bytescan",
+                 "stride256-perslot", "stride256-bytescan"):
+        arm = rec["arms"][name]
+        assert arm["value"] > 0
+        assert arm["ops_per_candidate"] > 0
+        assert arm["path"] in ("pallas", "xla")
+    # The per-slot scheme must not count MORE ops than bytescan at the
+    # same stride — the whole point of the rewrite.
+    assert (rec["arms"]["stride128-perslot"]["ops_per_candidate"]
+            < rec["arms"]["stride128-bytescan"]["ops_per_candidate"])

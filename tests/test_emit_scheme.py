@@ -1,0 +1,364 @@
+"""Per-slot piece emission parity suite (PERF.md §17).
+
+The emission scheme rewrite (per-byte unit scan -> per-slot pieces with
+host-precomputed group variant tables) must be BYTE-IDENTICAL on every
+path it landed on: the XLA splices (``expand_matches`` / ``expand_suball``)
+and the fused Pallas kernels (every tier: scalar/general x full/windowed x
+match/suball, closed plans, NTLM's split pieces, multi-hash-block widths).
+These tests fuzz randomized tables and wordlists through BOTH schemes —
+``A5GEN_EMIT=bytescan`` (the escape hatch, selected here by simply not
+passing a schema) against the per-slot default — and require exact
+equality of emitted candidates / digests.
+"""
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    plan_arrays,
+    table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops import pallas_expand as pe
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
+from hashcat_a5_table_generator_tpu.ops.expand_matches import expand_matches
+from hashcat_a5_table_generator_tpu.ops.expand_suball import expand_suball
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    build_piece_schema,
+    pack_words,
+    piece_schema_for,
+)
+from hashcat_a5_table_generator_tpu.runtime.env import emit_scheme
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from hashcat_a5_table_generator_tpu.tables.layouts import BUILTIN_LAYOUTS
+
+MODES = ("default", "reverse", "suball", "suball-reverse")
+ALGOS = ("md5", "md4", "sha1", "ntlm")
+
+NB, STRIDE = 8, 128
+
+
+def rand_table(rng, *, k_opts=3, val_len=3, alphabet=b"abcdefgh"):
+    """Random single-byte-key substitution map over a small alphabet."""
+    sub = {}
+    for key in rng.choice(list(alphabet), size=4, replace=False):
+        n_opt = int(rng.integers(1, k_opts + 1))
+        vals = []
+        for _ in range(n_opt):
+            w = int(rng.integers(1, val_len + 1))
+            vals.append(bytes(
+                rng.choice(list(b"XYZ0123"), size=w).astype(np.uint8)
+            ))
+        sub[bytes([int(key)])] = vals
+    return sub
+
+
+def rand_words(rng, n=6, width=9, alphabet=b"abcdefgh~!"):
+    return [
+        bytes(rng.choice(list(alphabet),
+                         size=int(rng.integers(1, width))).astype(np.uint8))
+        for _ in range(n)
+    ]
+
+
+def _setup(spec, sub, words, **plan_kw):
+    ct = compile_table(sub)
+    plan = build_plan(spec, ct, pack_words(words), **plan_kw)
+    schema = piece_schema_for(plan, ct)
+    batch, _, _ = make_blocks(
+        plan, start_word=0, start_rank=0, max_variants=NB * STRIDE,
+        max_blocks=NB, fixed_stride=STRIDE,
+    )
+    b = block_arrays(pad_batch(batch, NB), num_blocks=NB)
+    return ct, plan, schema, plan_arrays(plan), table_arrays(ct), b
+
+
+def run_xla(spec, plan, parr, t, b, pieces):
+    common = dict(
+        num_lanes=NB * STRIDE, out_width=plan.out_width,
+        min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute, block_stride=STRIDE,
+        win_v=parr.get("win_v"), pieces=pieces,
+    )
+    if spec.mode in ("default", "reverse"):
+        return expand_matches(
+            parr["tokens"], parr["lengths"], parr["match_pos"],
+            parr["match_len"], parr["match_radix"],
+            parr["match_val_start"], t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"], b["offset"], **common,
+        )
+    return expand_suball(
+        parr["tokens"], parr["lengths"], parr["pat_radix"],
+        parr["pat_val_start"], parr["seg_orig_start"],
+        parr["seg_orig_len"], parr["seg_pat"],
+        parr.get("cval_bytes", t["val_bytes"]),
+        parr.get("cval_len", t["val_len"]),
+        b["word"], b["base"], b["count"], b["offset"],
+        close_next=parr.get("close_next"),
+        close_mul=parr.get("close_mul"), **common,
+    )
+
+
+def run_pallas(spec, plan, ct, parr, t, b, pieces, *, algo,
+               scalar_units=None):
+    k = pe.k_vals_for(plan)
+    if scalar_units is None:
+        scalar_units = pe.scalar_units_for(plan)
+    common = dict(
+        num_lanes=NB * STRIDE, out_width=int(plan.out_width),
+        min_substitute=spec.effective_min,
+        max_substitute=spec.max_substitute, block_stride=STRIDE,
+        k_opts=k, algo=algo, interpret=True,
+        scalar_units=scalar_units, win_v=parr.get("win_v"),
+        pieces=pieces,
+    )
+    if spec.mode in ("default", "reverse"):
+        return pe.fused_expand_md5(
+            parr["tokens"], parr["lengths"], parr["match_pos"],
+            parr["match_len"], parr["match_radix"],
+            parr["match_val_start"], t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"], **common,
+        )
+    return pe.fused_expand_suball_md5(
+        parr["tokens"], parr["lengths"], parr["pat_radix"],
+        parr["pat_val_start"], parr["seg_orig_start"],
+        parr["seg_orig_len"], parr["seg_pat"],
+        parr.get("cval_bytes", t["val_bytes"]),
+        parr.get("cval_len", t["val_len"]),
+        b["word"], b["base"], b["count"],
+        close_next=parr.get("close_next"),
+        close_mul=parr.get("close_mul"), **common,
+    )
+
+
+def assert_xla_parity(spec, plan, schema, parr, t, b):
+    """Candidate buffers of both schemes must agree on emitted lanes."""
+    assert schema is not None, "plan unexpectedly piece-ineligible"
+    c0, l0, w0, e0 = map(np.asarray, run_xla(spec, plan, parr, t, b, None))
+    c1, l1, w1, e1 = map(np.asarray, run_xla(spec, plan, parr, t, b,
+                                             schema))
+    assert (e0 == e1).all()
+    assert (l0[e0] == l1[e0]).all()
+    assert (w0[e0] == w1[e0]).all()
+    assert (c0[e0] == c1[e0]).all()
+    return int(e0.sum())
+
+
+def assert_pallas_parity(spec, plan, ct, schema, parr, t, b, *, algo,
+                         scalar_units=None):
+    assert schema is not None, "plan unexpectedly piece-ineligible"
+    s0, e0 = map(np.asarray, run_pallas(
+        spec, plan, ct, parr, t, b, None, algo=algo,
+        scalar_units=scalar_units,
+    ))
+    s1, e1 = map(np.asarray, run_pallas(
+        spec, plan, ct, parr, t, b, schema, algo=algo,
+        scalar_units=scalar_units,
+    ))
+    assert (e0 == e1).all()
+    assert (s0[e0] == s1[e0]).all()
+    return int(e0.sum())
+
+
+class TestXlaFuzzParity:
+    """The XLA splice twins, fuzzed (algo-independent: the splice
+    produces candidate BYTES; the hash stage is shared downstream)."""
+
+    @pytest.mark.parametrize("mode", MODES)
+    def test_random_tables(self, mode):
+        rng = np.random.default_rng(hash(mode) % (1 << 31))
+        emitted = 0
+        for trial in range(4):
+            spec = AttackSpec(mode=mode, algo="md5")
+            words = rand_words(rng)
+            sub = rand_table(rng)
+            ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+            if schema is None:
+                continue  # rare geometry rejection — covered elsewhere
+            emitted += assert_xla_parity(spec, plan, schema, parr, t, b)
+        assert emitted > 0
+
+    @pytest.mark.parametrize("mode", ("default", "suball"))
+    def test_windowed_plans(self, mode):
+        # Tight window over many matches: every char is a key, so a
+        # 12-char word's windowed total (~80) undercuts the full 2^12
+        # space by far more than the 2x gate.
+        spec = AttackSpec(mode=mode, algo="md5", min_substitute=1,
+                          max_substitute=2)
+        sub = {bytes([c]): [b"Q", b"RR"] for c in b"abcdef"}
+        words = [b"abcdefabcdef", b"fedcbafedcba", b"abc"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert plan.windowed, "fixture must exercise the windowed decode"
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+
+    def test_closed_suball_plan(self):
+        sub = BUILTIN_LAYOUTS["qwerty-azerty"].to_substitution_map()
+        spec = AttackSpec(mode="suball", algo="md5")
+        words = [b"aqwzsxm,", b"marmalade", b"qqaazz", b"azerty"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert plan.close_next is not None
+        assert schema is not None and schema.closed
+        assert assert_xla_parity(spec, plan, schema, parr, t, b) > 0
+
+
+class TestPallasFuzzParity:
+    """The fused kernels, fuzzed per (mode, algo) — interpret mode."""
+
+    @pytest.mark.parametrize("mode,algo", [
+        ("default", "md5"), ("default", "ntlm"),
+        ("reverse", "sha1"), ("reverse", "md5"),
+        ("suball", "md4"), ("suball", "md5"),
+        ("suball-reverse", "ntlm"), ("suball-reverse", "sha1"),
+    ])
+    def test_general_kernel(self, mode, algo):
+        rng = np.random.default_rng(hash((mode, algo)) % (1 << 31))
+        spec = AttackSpec(mode=mode, algo=algo)
+        words = rand_words(rng, n=5, width=8)
+        sub = rand_table(rng)
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        if schema is None:
+            pytest.skip("randomized geometry rejected the schema")
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo=algo,
+            scalar_units=False,
+        ) > 0
+
+    @pytest.mark.parametrize("mode,algo", [
+        ("default", "md5"), ("default", "ntlm"), ("default", "sha1"),
+        ("default", "md4"), ("reverse", "md5"), ("suball", "md5"),
+        ("suball-reverse", "ntlm"),
+    ])
+    def test_scalar_kernel(self, mode, algo):
+        # K=1 tables (reverse modes clamp radix to 2 anyway; here the
+        # table itself is 1:1 so default/suball hit K=1 too).
+        rng = np.random.default_rng(hash((algo, mode)) % (1 << 31))
+        spec = AttackSpec(mode=mode, algo=algo)
+        words = rand_words(rng, n=5, width=8)
+        sub = rand_table(rng, k_opts=1)
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        if schema is None:
+            pytest.skip("randomized geometry rejected the schema")
+        assert pe.scalar_units_for(plan)
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo=algo,
+        ) > 0
+
+    def test_ntlm_multiword_split_pieces(self):
+        # 3-byte values on longer words force multi-u32 pieces whose
+        # UTF-16LE expansion crosses word boundaries — the split-piece
+        # case the terminator pseudo-byte must survive.
+        spec = AttackSpec(mode="default", algo="ntlm")
+        # A 5-byte key's skip span needs a 2-u32 piece, whose UTF-16LE
+        # expansion crosses message-word boundaries.
+        words = [b"xabcdex", b"abcdeabcde", b"zabcde", b"qq"]
+        sub = {b"abcde": [b"XYZ", b"#"]}
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert schema is not None
+        assert max(g.n_words for g in schema.groups) >= 2
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo="ntlm",
+            scalar_units=False,
+        ) > 0
+
+    def test_windowed_scalar_parity(self):
+        spec = AttackSpec(mode="default", algo="md5", min_substitute=1,
+                          max_substitute=2)
+        sub = {bytes([c]): [b"QQ"] for c in b"abcdef"}
+        words = [b"abcdefabcdef", b"fedcbafedcba", b"abc"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert plan.windowed and pe.scalar_units_for(plan)
+        assert_pallas_parity(spec, plan, ct, schema, parr, t, b,
+                             algo="md5")
+
+    def test_windowed_suball_parity_both_tiers(self):
+        # The suball windowed piece kernels: the scalar tier packs the
+        # DP walk's chosen bits through the per-block bitpos ref; the
+        # general tier resolves each column's digit via sel_slot.
+        spec = AttackSpec(mode="suball", algo="md5", min_substitute=1,
+                          max_substitute=2)
+        sub = {bytes([c]): [b"QQ"] for c in b"abcdef"}
+        words = [b"abcdefabcdef", b"fedcbafedcba", b"abc"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert plan.windowed and pe.scalar_units_for(plan)
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo="md5",
+        ) > 0
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo="md5",
+            scalar_units=False,
+        ) > 0
+
+    def test_closed_suball_kernel(self):
+        sub = BUILTIN_LAYOUTS["qwerty-azerty"].to_substitution_map()
+        spec = AttackSpec(mode="suball", algo="md5")
+        words = [b"aqwzsxm,", b"marmalade", b"qqaazz", b"azerty"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert schema is not None and schema.closed
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo="md5",
+            scalar_units=False,
+        ) > 0
+
+
+class TestGates:
+    def test_env_escape_hatch(self, monkeypatch):
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"a": [b"X"]}
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words([b"banana"]))
+        monkeypatch.setenv("A5GEN_EMIT", "bytescan")
+        assert emit_scheme() == "bytescan"
+        assert piece_schema_for(plan, ct) is None
+        monkeypatch.setenv("A5GEN_EMIT", "perslot")
+        assert emit_scheme() == "perslot"
+        assert piece_schema_for(plan, ct) is not None
+
+    def test_env_typo_warns_and_keeps_default(self, monkeypatch, capsys):
+        monkeypatch.setenv("A5GEN_EMIT", "bytescn")
+        assert emit_scheme() == "perslot"
+        assert "A5GEN_EMIT" in capsys.readouterr().err
+
+    def test_matchless_bucket_word_chunks_its_tail(self):
+        # A 16-byte word with no matches must not veto the schema: its
+        # tail splits into <=4-byte literal chunk groups instead of one
+        # over-wide piece (the production bucket-16 case).
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"a": [b"X"], b"e": [b"3"]}
+        words = [b"zzzzzzzzzzzzzzzz", b"banana", b"eeeaaa"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert schema is not None
+        assert all(g.n_words == 1 for g in schema.groups)
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+        assert_pallas_parity(spec, plan, ct, schema, parr, t, b,
+                             algo="md5")
+
+    def test_schema_refuses_overlapping_static_spans(self):
+        # Keys "ab" and "b": matches at (0, len 2) and (1, len 1) overlap
+        # STATICALLY — piece emission cannot express the skip geometry,
+        # so the gate must return None (bytescan carries the plan).
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table({b"ab": [b"X"], b"b": [b"Y"]})
+        plan = build_plan(spec, ct, pack_words([b"abab"]))
+        assert piece_schema_for(plan, ct) is None
+
+    def test_schema_cache_keyed_by_table(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table({b"a": [b"X"]})
+        plan = build_plan(spec, ct, pack_words([b"banana"]))
+        s1 = piece_schema_for(plan, ct)
+        assert piece_schema_for(plan, ct) is s1  # cached
+
+    def test_builder_rejects_unsorted_spans(self):
+        tokens = np.zeros((1, 8), np.uint8)
+        lengths = np.full((1,), 8, np.int32)
+        pos = np.asarray([[4, 1]], np.int32)  # descending -> refuse
+        ln = np.asarray([[1, 1]], np.int32)
+        opts = np.asarray([[1, 1]], np.int32)
+        vstart = np.zeros((1, 2), np.int32)
+        vb = np.zeros((1, 2), np.uint8)
+        vl = np.ones((1,), np.int32)
+        assert build_piece_schema(
+            tokens, lengths, pos, ln, opts, vstart, vb, vl, kind="match",
+        ) is None

@@ -67,6 +67,27 @@ class TestDeadStage:
         )
         assert findings == []
 
+    def test_perslot_broken_body_loses_membership(self):
+        # §15 trap regression against the PER-SLOT emission body
+        # (PERF.md §17): the rewritten expand stage must not hide the
+        # membership DCE from the stage markers.
+        mod = _fixture("dce_perslot")
+        findings = audit_stages(
+            mod.broken_body, mod.example_args(), "fixture.dce_perslot",
+            mod.STAGES,
+        )
+        assert findings, "membership DCE not detected on the piece body"
+        dead = {f.message.split(" ")[1] for f in findings}
+        assert "membership" in dead
+
+    def test_perslot_clean_body_keeps_all_stages(self):
+        mod = _fixture("dce_perslot")
+        findings = audit_stages(
+            mod.clean_body, mod.example_args(), "fixture.dce_perslot",
+            mod.STAGES,
+        )
+        assert findings == []
+
 
 # ---------------------------------------------------------------------------
 # Float purity

@@ -188,6 +188,8 @@ def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
         table_arrays,
     )
 
+    from hashcat_a5_table_generator_tpu.ops.packing import piece_schema_for
+
     spec, plan = _FIX.plan(mode, algo, words_key)
     ct = _FIX.table()
     batch = _FIX.blocks(plan)
@@ -203,6 +205,9 @@ def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
         max_substitute=spec.max_substitute,
         block_stride=_STRIDE, k_opts=k, algo=algo, interpret=True,
         scalar_units=scalar_units and _pe.scalar_units_for(plan),
+        # The production emission scheme: per-slot pieces when the plan
+        # qualifies (A5GEN_EMIT=bytescan pins the legacy scan instead).
+        pieces=piece_schema_for(plan, ct),
     )
     if mode in ("default", "reverse"):
         fn = lambda: _pe.fused_expand_md5(  # noqa: E731
@@ -261,15 +266,20 @@ def _crack_args(nb: int = 8, stride: int = _STRIDE):
     from hashcat_a5_table_generator_tpu.models.attack import (
         block_arrays,
         digest_arrays,
+        piece_arrays,
         plan_arrays,
         table_arrays,
     )
+    from hashcat_a5_table_generator_tpu.ops.packing import piece_schema_for
 
     spec, plan = _FIX.plan("default", "md5", "small")
     batch = _FIX.blocks(plan, nb=nb, stride=stride)
+    parr = plan_arrays(plan)
+    pieces = piece_schema_for(plan, _FIX.table())
+    parr.update(piece_arrays(pieces))
     return (
-        spec, plan,
-        plan_arrays(plan),
+        spec, plan, pieces,
+        parr,
         table_arrays(_FIX.table()),
         digest_arrays(_FIX.digest_set("md5")),
         block_arrays(batch, num_blocks=nb),
@@ -277,10 +287,11 @@ def _crack_args(nb: int = 8, stride: int = _STRIDE):
 
 
 def _fused_body_config() -> Tuple[Callable, tuple]:
-    spec, plan, p, t, d, b = _crack_args()
+    spec, plan, pieces, p, t, d, b = _crack_args()
     body = _attack.make_fused_body(
         spec, num_lanes=8 * _STRIDE, out_width=int(plan.out_width),
         block_stride=_STRIDE, radix2=_pe.k_opts_for(plan) == 1,
+        pieces=pieces,
     )
     return body, (p, t, d, b)
 
@@ -289,18 +300,19 @@ def _superstep_args():
     from hashcat_a5_table_generator_tpu.models.attack import superstep_arrays
     from hashcat_a5_table_generator_tpu.ops.blocks import superstep_index
 
-    spec, plan, p, t, d, _ = _crack_args()
+    spec, plan, pieces, p, t, d, _ = _crack_args()
     ss = superstep_arrays(plan, _STRIDE)
     total_blocks = int(superstep_index(plan, _STRIDE)[2])
-    return spec, plan, p, t, d, ss, total_blocks
+    return spec, plan, pieces, p, t, d, ss, total_blocks
 
 
 def _superstep_body_config() -> Tuple[Callable, tuple]:
-    spec, plan, p, t, d, ss, total_blocks = _superstep_args()
+    spec, plan, pieces, p, t, d, ss, total_blocks = _superstep_args()
     body = _attack.make_superstep_body(
         spec, num_lanes=8 * _STRIDE, out_width=int(plan.out_width),
         block_stride=_STRIDE, num_blocks=8, steps=2, hit_cap=32,
         total_blocks=total_blocks, radix2=_pe.k_opts_for(plan) == 1,
+        pieces=pieces,
     )
     return body, (p, t, d, ss, jnp.int32(0))
 
@@ -311,14 +323,14 @@ def _sharded_crack_config() -> Tuple[Callable, tuple]:
         stack_blocks,
     )
 
-    spec, plan, p, t, d, _ = _crack_args()
+    spec, plan, pieces, p, t, d, _ = _crack_args()
     mesh = make_mesh(1)
     batch = _FIX.blocks(plan, nb=8)
     blocks = stack_blocks([batch], num_blocks=8)
     step = _mesh.make_sharded_crack_step(
         spec, mesh, lanes_per_device=8 * _STRIDE,
         out_width=int(plan.out_width), block_stride=_STRIDE,
-        radix2=_pe.k_opts_for(plan) == 1,
+        radix2=_pe.k_opts_for(plan) == 1, pieces=pieces,
     )
     return step, (p, t, d, blocks)
 
@@ -326,13 +338,13 @@ def _sharded_crack_config() -> Tuple[Callable, tuple]:
 def _sharded_superstep_config() -> Tuple[Callable, tuple]:
     from hashcat_a5_table_generator_tpu.parallel.mesh import make_mesh
 
-    spec, plan, p, t, d, ss, total_blocks = _superstep_args()
+    spec, plan, pieces, p, t, d, ss, total_blocks = _superstep_args()
     mesh = make_mesh(1)
     step = _mesh.make_sharded_superstep_step(
         spec, mesh, lanes_per_device=8 * _STRIDE, num_blocks=8,
         out_width=int(plan.out_width), block_stride=_STRIDE, steps=2,
         hit_cap=32, total_blocks=total_blocks,
-        radix2=_pe.k_opts_for(plan) == 1,
+        radix2=_pe.k_opts_for(plan) == 1, pieces=pieces,
     )
     return step, (p, t, d, ss, np.zeros((1,), np.int32))
 
