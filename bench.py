@@ -200,6 +200,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "Defaults to the measured CPU peak geometry "
                          "(2048 lanes x 32 blocks, §4c) unless --lanes/"
                          "--blocks override")
+    ap.add_argument("--pipeline-ab", action="store_true",
+                    help="measure the double-buffered superstep pipeline "
+                         "against the barriered superstep drive on the "
+                         "production crack contract (PERF.md §18): "
+                         "per-step host overhead (fetch-to-dispatch gap), "
+                         "dead device time (the non-overlapped share), "
+                         "overlap ratio (device busy during the gap), and "
+                         "wall — one JSON line. Defaults to the §4c CPU "
+                         "peak geometry like --superstep-ab")
     ap.add_argument("--stride-ab", action="store_true",
                     help="measure block stride 128 vs 256 x emission "
                          "scheme perslot vs bytescan (A5GEN_EMIT arms) "
@@ -214,42 +223,49 @@ def build_parser() -> argparse.ArgumentParser:
 # ------------------------------------------------------- superstep A/B --
 
 
-def run_superstep_ab(args: argparse.Namespace) -> None:
-    """A/B the device-resident superstep executor against the per-launch
-    pipeline (PERF.md §15): both arms hash the SAME block stream through
-    the same fused body; the per-launch arm pays a host block cut + a
-    dispatch per step, the superstep arm one dispatch per ``fetch_chunk``
-    steps and zero host cutting.  Prints ONE JSON line with per-arm
-    hashes/s and host-overhead seconds per step."""
+def _ab_crack_plan(args: argparse.Namespace):
+    """The crack contract every A/B arm benches: spec, compiled table,
+    plan over the synthetic wordlist, and a decoy digest set that keeps
+    the membership stage live without ever hitting."""
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        AttackSpec,
+        build_plan,
+    )
+    from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
+    from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    ct = compile_table(get_layout(args.table).to_substitution_map())
+    plan = build_plan(spec, ct, pack_words(synth_wordlist(args.words)))
+    host_digest = HOST_DIGEST[spec.algo]
+    ds = build_digest_set(
+        [host_digest(b"bench-decoy-%d" % i) for i in range(1024)], spec.algo
+    )
+    return spec, ct, plan, ds
+
+
+def _ab_superstep_fixture(args: argparse.Namespace, flag: str) -> dict:
+    """Shared --superstep-ab / --pipeline-ab setup: the §4c CPU-peak
+    geometry (2048 lanes × 32 blocks × 16 steps unless --lanes/--blocks
+    override), the crack plan, device arrays, and ONE compiled superstep
+    program — so the arms can never drift onto different contracts."""
     import jax
 
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
 
     from hashcat_a5_table_generator_tpu.models.attack import (
-        AttackSpec,
-        block_arrays,
-        build_plan,
         digest_arrays,
-        make_fused_body,
         make_superstep_step,
         plan_arrays,
         superstep_arrays,
         table_arrays,
     )
-    from hashcat_a5_table_generator_tpu.ops.blocks import (
-        make_blocks,
-        superstep_index,
-    )
-    from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
-    from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+    from hashcat_a5_table_generator_tpu.ops.blocks import superstep_index
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import k_opts_for
-    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
-    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
-    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
-
-    import jax.numpy as jnp
-    import numpy as np
 
     dev = jax.devices()[0]
     # Default: the §4c CPU-peak geometry, where the per-launch pipeline is
@@ -259,43 +275,72 @@ def run_superstep_ab(args: argparse.Namespace) -> None:
     nb = args.blocks if args.blocks is not None else 32
     steps = 16
     if lanes % nb:
-        raise SystemExit("--superstep-ab needs blocks dividing lanes")
+        raise SystemExit(f"{flag} needs blocks dividing lanes")
     stride = lanes // nb
+    hit_cap = 256
 
-    spec = AttackSpec(mode=args.mode, algo=args.algo)
-    sub_map = get_layout(args.table).to_substitution_map()
-    ct = compile_table(sub_map)
-    plan = build_plan(spec, ct, pack_words(synth_wordlist(args.words)))
-    host_digest = HOST_DIGEST[spec.algo]
-    ds = build_digest_set(
-        [host_digest(b"bench-decoy-%d" % i) for i in range(1024)], spec.algo
-    )
+    spec, ct, plan, ds = _ab_crack_plan(args)
     idx = superstep_index(plan, stride)
     if idx is None:
-        raise SystemExit("--superstep-ab: plan is not superstep-eligible")
+        raise SystemExit(f"{flag}: plan is not superstep-eligible")
     _cum, _totals, total_blocks = idx
     radix2 = k_opts_for(plan) == 1
     windowed = bool(getattr(plan, "windowed", False))
+    sstep = make_superstep_step(
+        spec, num_lanes=lanes, num_blocks=nb, out_width=plan.out_width,
+        block_stride=stride, steps=steps, hit_cap=hit_cap,
+        total_blocks=total_blocks, windowed=windowed, radix2=radix2,
+    )
+    return {
+        "dev": dev, "lanes": lanes, "nb": nb, "steps": steps,
+        "stride": stride, "hit_cap": hit_cap, "spec": spec, "plan": plan,
+        "total_blocks": total_blocks, "radix2": radix2,
+        "n_super": max(1, total_blocks // (steps * nb)),
+        "p": plan_arrays(plan), "t": table_arrays(ct),
+        "d": digest_arrays(ds), "ss": superstep_arrays(plan, stride),
+        "sstep": sstep,
+    }
 
-    p, t, d = plan_arrays(plan), table_arrays(ct), digest_arrays(ds)
-    ss = superstep_arrays(plan, stride)
+
+def run_superstep_ab(args: argparse.Namespace) -> None:
+    """A/B the device-resident superstep executor against the per-launch
+    pipeline (PERF.md §15): both arms hash the SAME block stream through
+    the same fused body; the per-launch arm pays a host block cut + a
+    dispatch per step, the superstep arm one dispatch per ``fetch_chunk``
+    steps and zero host cutting.  Prints ONE JSON line with per-arm
+    hashes/s and host-overhead seconds per step."""
+    fx = _ab_superstep_fixture(args, "--superstep-ab")
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        block_arrays,
+        make_fused_body,
+        superstep_buffers,
+    )
+    from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
+
+    dev, plan = fx["dev"], fx["plan"]
+    lanes, nb, steps, stride = (
+        fx["lanes"], fx["nb"], fx["steps"], fx["stride"]
+    )
+    hit_cap, n_super = fx["hit_cap"], fx["n_super"]
+    p, t, d, ss, sstep = fx["p"], fx["t"], fx["d"], fx["ss"], fx["sstep"]
+
     # The per-launch arm runs the PRODUCTION crack-step contract —
     # hit_bits + both counts, with the counts chained into a device
     # accumulator exactly like Sweep.run_crack's chunked loop.  An
     # emitted-count-only accumulator (the kernel bench's shape) lets XLA
     # dead-code-eliminate the membership stage, which the superstep arm
     # necessarily keeps alive — the arms must pay the same device work.
-    body = make_fused_body(spec, num_lanes=lanes, out_width=plan.out_width,
-                           block_stride=stride, radix2=radix2)
+    body = make_fused_body(fx["spec"], num_lanes=lanes,
+                           out_width=plan.out_width, block_stride=stride,
+                           radix2=fx["radix2"])
     step = jax.jit(lambda p_, t_, b_, d_: body(p_, t_, d_, b_))
     accum = jax.jit(lambda acc, ne, nh: acc + jnp.stack([ne, nh]))
-    sstep = make_superstep_step(
-        spec, num_lanes=lanes, num_blocks=nb, out_width=plan.out_width,
-        block_stride=stride, steps=steps, hit_cap=256,
-        total_blocks=total_blocks, windowed=windowed, radix2=radix2,
-    )
     acc_zero = jnp.zeros((2,), jnp.int32)
-    n_super = max(1, total_blocks // (steps * nb))
 
     def per_launch_arm() -> dict:
         """`steps`-launch rounds with the production per-launch recipe:
@@ -332,13 +377,16 @@ def run_superstep_ab(args: argparse.Namespace) -> None:
 
     def superstep_arm() -> dict:
         hashed, launches, disp_s = 0, 0, 0.0
+        bufs = superstep_buffers(hit_cap)
         t0 = time.perf_counter()
         while time.perf_counter() - t0 < args.seconds:
             b0 = (launches // steps) % n_super * (steps * nb)
             td = time.perf_counter()
-            out = sstep(p, t, d, ss, np.int32(b0))
+            out = sstep(p, t, d, ss, np.int32(b0), bufs)
             disp_s += time.perf_counter() - td
             hashed += int(out["n_emitted"])  # completion barrier
+            bufs = {"hit_word": out["hit_word"],
+                    "hit_rank": out["hit_rank"]}
             launches += steps
         wall = time.perf_counter() - t0
         return {
@@ -356,7 +404,8 @@ def run_superstep_ab(args: argparse.Namespace) -> None:
                                fixed_stride=stride)
     int(step(p, t, block_arrays(batch0, num_blocks=nb), d)["n_emitted"])
     int(accum(acc_zero, jnp.int32(0), jnp.int32(0))[0])
-    int(sstep(p, t, d, ss, np.int32(0))["n_emitted"])
+    int(sstep(p, t, d, ss, np.int32(0),
+              superstep_buffers(hit_cap))["n_emitted"])
 
     per_launch = per_launch_arm()
     superstep = superstep_arm()
@@ -372,6 +421,126 @@ def run_superstep_ab(args: argparse.Namespace) -> None:
         "host_overhead_ratio": (
             per_launch["host_s_per_step"]
             / max(superstep["host_s_per_step"], 1e-12)
+        ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+# -------------------------------------------------------- pipeline A/B --
+
+
+def run_pipeline_ab(args: argparse.Namespace) -> None:
+    """A/B the double-buffered superstep pipeline against the barriered
+    superstep drive (PERF.md §18).  Both arms run the SAME compiled
+    superstep program over the same block stream with the production
+    per-superstep host recipe (one counters fetch + buffer recycling);
+    they differ ONLY in drive depth — the barriered arm fetches each
+    superstep right after dispatching it (the device idles through the
+    host's fetch-to-dispatch gap), the pipelined arm dispatches superstep
+    N+1 into the second buffer set before fetching N's counters, so the
+    gap overlaps the in-flight superstep's compute.  Per-step host
+    overhead is that gap; the DEAD share is the portion with no superstep
+    in flight (device idle) — the number the pipeline exists to remove.
+    Overlap is a HOST-SIDE proxy: a gap counts as overlapped when a
+    superstep was in flight (dispatched, not yet fetched) while it ran —
+    the host cannot see whether the device finished early, so where the
+    gap exceeds a superstep's compute (the ~65 ms tunnel) overlap_ratio
+    is an upper bound and dead_s_per_step a lower bound; at the CPU §4c
+    geometry (compute >> gap) the proxy is tight.  Prints ONE JSON
+    line."""
+    from collections import deque
+
+    fx = _ab_superstep_fixture(args, "--pipeline-ab")
+
+    import numpy as np
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        superstep_buffers,
+    )
+
+    dev = fx["dev"]
+    lanes, nb, steps = fx["lanes"], fx["nb"], fx["steps"]
+    hit_cap, n_super = fx["hit_cap"], fx["n_super"]
+    p, t, d, ss, sstep = fx["p"], fx["t"], fx["d"], fx["ss"], fx["sstep"]
+
+    def drive_arm(depth: int) -> dict:
+        """One timed window at in-flight depth 1 (barriered) or 2
+        (pipelined): the production drive recipe minus hit processing
+        (the decoy digests never hit)."""
+        free = [superstep_buffers(hit_cap) for _ in range(depth)]
+        inflight: deque = deque()
+        hashed = supersteps = 0
+        gap_s = dead_s = 0.0
+        t0 = time.perf_counter()
+        mark = t0  # last fetch-return (or start): the gap opens here
+        while time.perf_counter() - t0 < args.seconds or inflight:
+            had_inflight = bool(inflight)
+            dispatched = False
+            while (
+                len(inflight) < depth and free
+                and time.perf_counter() - t0 < args.seconds
+            ):
+                b0 = supersteps + len(inflight)
+                b0 = b0 % n_super * (steps * nb)
+                inflight.append(sstep(p, t, d, ss, np.int32(b0),
+                                      free.pop()))
+                dispatched = True
+            if not inflight:
+                break
+            now = time.perf_counter()
+            # The fetch-to-dispatch gap just closed: host-side work the
+            # barriered arm pays as dead device time.  Overlapped iff a
+            # superstep was already in flight while the gap ran (depth 2
+            # steady state); the fill gap before the first dispatch is
+            # honestly dead in both arms.
+            if supersteps or dispatched:
+                gap = now - mark
+                gap_s += gap
+                if not had_inflight:
+                    dead_s += gap
+            out = inflight.popleft()
+            ne, _nh = (int(x) for x in np.asarray(out["counters"]))
+            hashed += ne
+            free.append({"hit_word": out["hit_word"],
+                         "hit_rank": out["hit_rank"]})
+            supersteps += 1
+            mark = time.perf_counter()
+        wall = time.perf_counter() - t0
+        launches = supersteps * steps
+        return {
+            "hashes_per_sec": hashed / wall,
+            "wall_s": wall,
+            "supersteps": supersteps,
+            "launches": launches,
+            "launches_per_fetch": steps,
+            "host_s_per_step": gap_s / max(launches, 1),
+            "dead_s_per_step": dead_s / max(launches, 1),
+            "overlap_ratio": (
+                (gap_s - dead_s) / gap_s if gap_s > 0 else 0.0
+            ),
+        }
+
+    # Warm the one compiled program (both arms share it), then measure.
+    warm = sstep(p, t, d, ss, np.int32(0), superstep_buffers(hit_cap))
+    int(np.asarray(warm["counters"])[0])
+    barriered = drive_arm(1)
+    pipelined = drive_arm(2)
+    record = {
+        "metric": "pipeline_host_overhead_ab",
+        "unit": "seconds/step (host gap, dead share) + hashes/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "steps_per_superstep": steps,
+        "barriered": barriered,
+        "pipelined": pipelined,
+        # The acceptance ratio: dead device time per step, barriered over
+        # pipelined — the pipeline's whole job is sending this to ~0.
+        "host_overhead_ratio": (
+            barriered["dead_s_per_step"]
+            / max(pipelined["dead_s_per_step"], 1e-12)
         ),
     }
     print(json.dumps(record))
@@ -399,9 +568,7 @@ def run_stride_ab(args: argparse.Namespace) -> None:
     import jax.numpy as jnp
 
     from hashcat_a5_table_generator_tpu.models.attack import (
-        AttackSpec,
         block_arrays,
-        build_plan,
         digest_arrays,
         make_fused_body,
         piece_arrays,
@@ -410,11 +577,7 @@ def run_stride_ab(args: argparse.Namespace) -> None:
         table_arrays,
     )
     from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks
-    from hashcat_a5_table_generator_tpu.ops.membership import build_digest_set
-    from hashcat_a5_table_generator_tpu.ops.packing import (
-        pack_words,
-        piece_schema_for,
-    )
+    from hashcat_a5_table_generator_tpu.ops.packing import piece_schema_for
     from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
         _G as pallas_g,
         fused_expand_md5,
@@ -425,20 +588,11 @@ def run_stride_ab(args: argparse.Namespace) -> None:
         scalar_units_for,
     )
     from hashcat_a5_table_generator_tpu.runtime.env import emit_scheme
-    from hashcat_a5_table_generator_tpu.tables.compile import compile_table
-    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
-    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
     from tools.graftaudit.counter import count_traced_kernel
 
     dev = jax.devices()[0]
     lanes = args.lanes
-    spec = AttackSpec(mode=args.mode, algo=args.algo)
-    ct = compile_table(get_layout(args.table).to_substitution_map())
-    plan = build_plan(spec, ct, pack_words(synth_wordlist(args.words)))
-    host_digest = HOST_DIGEST[spec.algo]
-    ds = build_digest_set(
-        [host_digest(b"bench-decoy-%d" % i) for i in range(1024)], spec.algo
-    )
+    spec, ct, plan, ds = _ab_crack_plan(args)
     radix2 = k_opts_for(plan) == 1
     scalar_units = scalar_units_for(plan)
     schema = piece_schema_for(plan, ct)
@@ -1239,13 +1393,17 @@ def run_orchestrator(args: argparse.Namespace) -> None:
 def main() -> None:
     args = build_parser().parse_args()
     if args.lanes is None:
-        # Unset vs explicit matters: --superstep-ab/--stride-ab target
-        # small focused geometries, the kernel bench the big accelerator
-        # launch; an explicit --lanes is honored by all.
+        # Unset vs explicit matters: the focused A/B modes target small
+        # geometries, the kernel bench the big accelerator launch; an
+        # explicit --lanes is honored by all.
         args.lanes = (
-            2048 if (args.superstep_ab or args.stride_ab) else (1 << 22)
+            2048
+            if (args.superstep_ab or args.stride_ab or args.pipeline_ab)
+            else (1 << 22)
         )
-    if args.stride_ab:
+    if args.pipeline_ab:
+        run_pipeline_ab(args)
+    elif args.stride_ab:
         # Focused stride/emission A/B (PERF.md §7a lever 2 / §17); runs
         # on the pinned (or default) platform in-process.
         run_stride_ab(args)

@@ -253,10 +253,11 @@ def piece_arrays(pieces) -> Dict[str, jnp.ndarray]:
     and the XLA splice prep launches with row gathers only."""
     if pieces is None:
         return {}
-    out = {
-        "pp_pw": jnp.asarray(pieces.gw),
-        "pp_pl": jnp.asarray(pieces.gl),
-    }
+    out = {"pp_pl": jnp.asarray(pieces.gl)}
+    if pieces.gw is not None:
+        out["pp_pw"] = jnp.asarray(pieces.gw)
+    if pieces.gw16 is not None:
+        out["pp_pw16"] = jnp.asarray(pieces.gw16)
     if pieces.sel_bit is not None:
         out["pp_sbit"] = jnp.asarray(pieces.sel_bit)
     if pieces.sel_slot is not None:
@@ -455,22 +456,34 @@ def make_superstep_body(
     expand->hash->membership launches in ONE device program, with the
     block cutting done on device (PERF.md §15).
 
-    ``body(plan, table, digests, ss, b0) -> dict`` where ``ss`` is
-    :func:`superstep_arrays`' tree and ``b0`` an int32 scalar — the global
-    fixed-stride block index the superstep starts at.  A ``lax.scan``
+    ``body(plan, table, digests, ss, b0, bufs) -> dict`` where ``ss`` is
+    :func:`superstep_arrays`' tree, ``b0`` an int32 scalar — the global
+    fixed-stride block index the superstep starts at — and ``bufs`` one
+    of the driver's alternating device hit-buffer sets
+    (``{"hit_word", "hit_rank"}`` int32 ``[hit_cap + 1]``; PERF.md §18).
+    The scan's compacting scatter writes THIS superstep's hits into the
+    incoming buffers (no in-body allocation or reset: the host reads
+    only the first ``dev_hits`` entries, all freshly written, so stale
+    tails are harmless), which lets the jit wrapper DONATE them — the
+    pipelined driver cycles two sets so superstep N+1 can be dispatched
+    into set B before set A's counters are fetched.  A ``lax.scan``
     carries the block cursor: each step cuts its ``num_blocks`` blocks
     from ``ss`` (searchsorted over the cumulative index + mixed-radix
     decompose — the device twin of ``ops.blocks``' vectorized host
     cutter), runs the fused lane body, and accumulates
 
-    * ``n_emitted`` / ``n_hits`` — int32 scalars over the whole superstep
-      (callers bound ``steps * num_lanes`` below 2^31);
-    * ``hit_word`` / ``hit_rank`` int32 [hit_cap] — a capped hit buffer in
-      cursor order.  Hits are RARE, so the scatter that lands them runs
-      under a ``lax.cond`` only on steps whose hit count is nonzero;
-      entries past ``hit_cap`` are dropped on device and the host detects
-      the overflow from ``n_hits`` (``dev_hits``) and replays the
-      superstep through the per-launch path — never a dropped hit.
+    * ``counters`` int32 [2] — ``[n_emitted, n_hits]`` stacked so the
+      driver's per-superstep completion barrier is ONE device→host
+      fetch (the scalars also ride along unstacked for the bench and
+      the sharded reducers; callers bound ``steps * num_lanes`` below
+      2^31);
+    * ``hit_word`` / ``hit_rank`` int32 [hit_cap + 1] — the donated
+      buffers, hits compacted in cursor order (slot ``hit_cap`` is the
+      trash slot).  Hits are RARE, so the scatter runs under a
+      ``lax.cond`` only on steps whose hit count is nonzero; entries
+      past ``hit_cap`` are dropped on device and the host detects the
+      overflow from ``n_hits`` (``dev_hits``) and replays the superstep
+      through the per-launch path — never a dropped hit.
     * ``dev_hits`` int32 [1] — this device's own hit count (the overflow
       test under ``shard_map``, where ``n_hits`` is the global psum).
 
@@ -534,7 +547,7 @@ def make_superstep_body(
 
     def body(
         plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
-        ss: ArrayTree, b0: jnp.ndarray,
+        ss: ArrayTree, b0: jnp.ndarray, bufs: ArrayTree,
     ) -> ArrayTree:
         lane = jnp.arange(num_lanes, dtype=jnp.int32)
         blk = lane // jnp.int32(stride)
@@ -574,29 +587,54 @@ def make_superstep_body(
         zero = jnp.zeros((), jnp.int32)
         init = (
             jnp.asarray(b0, jnp.int32), zero, zero,
-            jnp.full((hit_cap + 1,), -1, jnp.int32),
-            jnp.zeros((hit_cap + 1,), jnp.int32),
+            bufs["hit_word"], bufs["hit_rank"],
         )
         (_, ne, nh, hw, hr), _ = jax.lax.scan(
             one, init, None, length=steps
         )
         return {
+            "counters": jnp.stack([ne, nh]),
             "n_emitted": ne,
             "n_hits": nh,
             "dev_hits": nh[None],
-            "hit_word": hw[:hit_cap],
-            "hit_rank": hr[:hit_cap],
+            "hit_word": hw,
+            "hit_rank": hr,
         }
 
     return body
 
 
+def superstep_buffers(hit_cap: int) -> ArrayTree:
+    """One device hit-buffer set for the superstep executor (slot
+    ``hit_cap`` is the trash slot).  The pipelined driver allocates TWO
+    and alternates them (PERF.md §18); contents never need resetting —
+    the body's compacting scatter overwrites every entry the host will
+    read."""
+    return {
+        "hit_word": jnp.full((hit_cap + 1,), -1, jnp.int32),
+        "hit_rank": jnp.zeros((hit_cap + 1,), jnp.int32),
+    }
+
+
+def _buffer_donation() -> "tuple[int, ...]":
+    """``donate_argnums`` for the superstep step's ``bufs`` argument:
+    donation lets XLA alias each superstep's output hit buffers to the
+    incoming set (true double buffering — no per-superstep allocation).
+    The CPU backend does not implement donation and would warn on every
+    compile, so only real accelerators request it; the driver's buffer
+    cycling is semantically identical either way."""
+    return () if jax.default_backend() == "cpu" else (5,)
+
+
 def make_superstep_step(spec: AttackSpec, **kwargs: Any
                         ) -> Callable[..., ArrayTree]:
     """Jitted :func:`make_superstep_body` (single device).  ``step(plan,
-    table, digests, ss, b0) -> dict``; pass ``b0`` as an int32 scalar
-    array so consecutive supersteps reuse one compiled program."""
-    return jax.jit(make_superstep_body(spec, **kwargs))
+    table, digests, ss, b0, bufs) -> dict``; pass ``b0`` as an int32
+    scalar array so consecutive supersteps reuse one compiled program,
+    and ``bufs`` one of the driver's alternating
+    :func:`superstep_buffers` sets (donated off-CPU)."""
+    return jax.jit(make_superstep_body(spec, **kwargs),
+                   donate_argnums=_buffer_donation())
 
 
 def make_crack_step(spec: AttackSpec, *, num_lanes: int, out_width: int,

@@ -533,27 +533,52 @@ def lane_fields(
     return rank, lane_ok, w, base, field
 
 
+def piece_device_tables(pieces) -> dict:
+    """Device copies of a :class:`ops.packing.PieceSchema`'s data tables
+    for :func:`splice_pieces`: ``pl`` uint8 [B, NG, V] lengths, plus
+    ``pw`` uint32 [B, NG, V, NW] and/or ``pw16`` uint16 [B, NG16, VM]
+    variant words when present — the same optional-key layout as
+    ``models.attack.piece_arrays`` strips into ``piece_tables``, as the
+    trace-time-constant fallback for direct calls and tests."""
+    tabs = {"pl": jnp.asarray(pieces.gl)}
+    if pieces.gw is not None:
+        tabs["pw"] = jnp.asarray(pieces.gw)
+    if pieces.gw16 is not None:
+        tabs["pw16"] = jnp.asarray(pieces.gw16)
+    return tabs
+
+
 def splice_pieces(schema, tables, field, col_variant, *, n, out_width):
     """Per-slot piece materialization — the XLA twin of the Pallas piece
-    kernels (``pallas_expand._make_piece_kernel``; PERF.md §17), shared by
-    both expansion paths so CPU fallback, the bench ``xla`` arm, and the
-    fused kernels stay ONE algorithm.
+    kernels (``pallas_expand._make_piece_kernel``; PERF.md §17/§18),
+    shared by both expansion paths so CPU fallback, the bench ``xla``
+    arm, and the fused kernels stay ONE algorithm.
 
     Walks the plan's :class:`ops.packing.PieceSchema` groups in output
     order: selects each group's precomputed word(s)/length by the variant
     index (``col_variant(c) -> int32[N]``), unpacks the selected bytes,
     and lands them at the lane-local prefix offset with compare-selects
-    over the output columns (never scatters).  The terminator pseudo-byte
-    in the tail group's bytes is masked off by the trailing
-    ``o < out_len`` zero-fill, so candidate buffers stay byte-identical
-    to the unit-scan splice.  Returns ``(out uint8[N, W], out_len)``.
+    over the output columns (never scatters).  Mirrors the kernels'
+    hierarchical-placement structure: narrow groups read the u16
+    ``pw16`` table, fixed-length groups (``len_fixed``) skip the length
+    select, and a run of fixed groups keeps the running offset a Python
+    int so their column compares broadcast block-uniform.  The
+    terminator pseudo-byte in the tail group's bytes is masked off by
+    the trailing ``o < out_len`` zero-fill, so candidate buffers stay
+    byte-identical to the unit-scan splice.  Returns
+    ``(out uint8[N, W], out_len)``.
     """
     o = jnp.arange(out_width, dtype=jnp.int32)[None, :]  # [1, W]
     out = jnp.zeros((n, out_width), jnp.uint8)
-    cum = jnp.zeros((n,), jnp.int32)
-    pw, pl = tables["pw"], tables["pl"]
+    cum_static = 0
+    cum = None  # dynamic offset once any group's length varies
+    pl = tables["pl"]
+    pw = tables.get("pw")
+    pw16 = tables.get("pw16")
     for gi, grp in enumerate(schema.groups):
         n_var, n_words = grp.n_variants, grp.n_words
+        if grp.len_fixed == 0:
+            continue  # empty in every launched word: nothing placed
         idx = None
         if n_var > 1:
             sel = grp.sel_cols
@@ -573,26 +598,55 @@ def splice_pieces(schema, tables, field, col_variant, *, n, out_width):
         def pick(rows):
             return rows[0] if idx is None else jax.lax.select_n(idx, *rows)
 
-        l = pick([
-            field(pl[:, gi, v]).astype(jnp.int32) for v in range(n_var)
-        ])
-        words = [
-            pick([field(pw[:, gi, v, w]) for v in range(n_var)])
-            for w in range(n_words)
-        ]
+        if grp.packed16:
+            words = [pick([
+                field(pw16[:, grp.tab_idx, v]) for v in range(n_var)
+            ]).astype(jnp.uint32)]
+        else:
+            words = [
+                pick([field(pw[:, grp.tab_idx, v, w])
+                      for v in range(n_var)])
+                for w in range(n_words)
+            ]
+        l = grp.len_fixed
+        if l is None:
+            l = pick([
+                field(pl[:, gi, v]).astype(jnp.int32) for v in range(n_var)
+            ])
+        off = cum_static if cum is None else cum
         # Place the selected bytes: piece byte bi lands at output column
-        # cum + bi when bi < l (a handful of [N, W] compare-selects; the
+        # off + bi when bi < l (a handful of [N, W] compare-selects; the
         # total byte count across groups is the schema's max_out).
         for bi in range(4 * n_words):
             if bi >= out_width:
                 break
+            if isinstance(l, int) and bi >= l:
+                break
             byte = (words[bi // 4] >> jnp.uint32(8 * (bi % 4))).astype(
                 jnp.uint8
             )
-            m = (o == (cum + bi)[:, None]) & (bi < l)[:, None]
+            if isinstance(off, int):
+                if off + bi >= out_width:
+                    break
+                m = o == (off + bi)
+            else:
+                m = o == (off + bi)[:, None]
+            if not isinstance(l, int):
+                m = m & (bi < l)[:, None]
             out = jnp.where(m, byte[:, None], out)
-        cum = cum + l
-    out_len = cum - 1  # the placed tail includes the terminator byte
+        if isinstance(l, int):
+            if cum is None:
+                cum_static += l
+            else:
+                cum = cum + l
+        elif cum is not None:
+            cum = cum + l
+        else:
+            cum = l if cum_static == 0 else l + cum_static
+    if cum is None:  # every group fixed: the whole length is static
+        out_len = jnp.full((n,), cum_static - 1, jnp.int32)
+    else:
+        out_len = cum - 1  # the placed tail includes the terminator byte
     out = jnp.where(o < out_len[:, None], out, jnp.uint8(0))
     return out, out_len
 
@@ -677,9 +731,7 @@ def expand_matches(
         # Per-slot piece emission: schema column c IS match slot c; the
         # schema's static-disjoint-span guarantee makes overlap clashes
         # impossible, so the emit mask needs no clash term.
-        tabs = piece_tables or {
-            "pw": jnp.asarray(pieces.gw), "pl": jnp.asarray(pieces.gl)
-        }
+        tabs = piece_tables or piece_device_tables(pieces)
         out, out_len = splice_pieces(
             pieces, tabs, field, lambda c: digits[:, c],
             n=n, out_width=out_width,

@@ -65,7 +65,7 @@ from typing import Dict, List, Optional, Tuple
 import jax.numpy as jnp
 import numpy as np
 
-from ..runtime.env import env_str
+from ..runtime.env import env_opt_out
 from ..tables.compile import CompiledTable, boundary_match_possible
 from .blocks import (  # noqa: F401  — re-exported: this module defined them first
     MAX_BLOCK,
@@ -98,9 +98,7 @@ def close_enabled() -> bool:
     """Cascade closure is ON by default; ``A5GEN_CASCADE_CLOSE`` set to
     ``off``/``0``/``no`` reverts to routing every hazard word through the
     CPU oracle (the pre-closure behavior — escape hatch and A/B lever)."""
-    return env_str("A5GEN_CASCADE_CLOSE").lower() not in (
-        "off", "0", "no",
-    )
+    return not env_opt_out("A5GEN_CASCADE_CLOSE", "device cascade closure")
 
 
 def _close_pattern_set(
@@ -850,11 +848,9 @@ def expand_suball(
         # each column's variant index is its owning slot's digit (joint
         # value index + 1 under cascade closure — expand_matches.
         # splice_pieces is the shared materializer).
-        from .expand_matches import splice_pieces
+        from .expand_matches import piece_device_tables, splice_pieces
 
-        tabs = piece_tables or {
-            "pw": jnp.asarray(pieces.gw), "pl": jnp.asarray(pieces.gl)
-        }
+        tabs = piece_tables or piece_device_tables(pieces)
         sslot = (piece_tables or {}).get("sslot")
         if sslot is None:
             sslot = jnp.asarray(pieces.sel_slot)

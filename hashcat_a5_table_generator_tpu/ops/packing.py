@@ -233,10 +233,23 @@ class PieceGroup:
     significant factor first; empty for the literal tail group.
     ``n_variants``/``n_words``: live extent inside the padded ``gw``/``gl``
     tables.  ``off_cap``: static upper bound on the group's output byte
-    offset (sum of prior groups' max lengths) — the placement span bound.
+    offset (sum of prior groups' data-max placed lengths over launched
+    words × reachable variants).  ``off_floor``: the matching static
+    LOWER bound.  Together they are the group's reachable byte window —
+    the hierarchical-placement lever (PERF.md §18): the kernels place a
+    group's words only inside ``[off_floor//4, off_cap//4 (+spill)]``
+    instead of scanning from word 0, and a degenerate window
+    (``off_floor == off_cap``) collapses the whole dynamic scatter to a
+    static shift-OR.  ``len_fixed``: the group's placed length when it is
+    the same for every launched word and reachable variant (None =
+    varies) — a run of fixed groups keeps the running offset static.
     ``has_term``: the 0x80 terminator byte is folded into this group's
     variant bytes (always the last group), so its table lengths are
     placed-length = candidate bytes + 1.
+    ``packed16``/``tab_idx``: where the group's variant words live —
+    row ``tab_idx`` of the u16 ``gw16`` table (single-word groups whose
+    every variant fits 2 bytes; halves their VMEM footprint) or of the
+    u32 ``gw`` table (everything else).
     """
 
     sel_cols: Tuple[int, ...]
@@ -244,6 +257,10 @@ class PieceGroup:
     n_words: int
     off_cap: int
     has_term: bool = False
+    off_floor: int = 0
+    len_fixed: Optional[int] = None
+    packed16: bool = False
+    tab_idx: int = 0
 
 
 @dataclass(frozen=True)
@@ -251,8 +268,13 @@ class PieceSchema:
     """Host-precomputed per-slot emission plan for one (plan, table) pair.
 
     Data tables (numpy; gathered per block by the wrappers):
-      ``gw`` uint32 [B, NG, VM, NW] — group variant words (little-endian
-      packed bytes), ``gl`` uint8 [B, NG, VM] — placed byte lengths.
+      ``gw`` uint32 [B, NGW, VM, NW] — wide groups' variant words
+      (little-endian packed bytes; ``None`` when every group packs to
+      u16), ``gw16`` uint16 [B, NG16, VM] — narrow single-word groups
+      whose every variant fits 2 bytes (``None`` when no group
+      qualifies; the per-group ``packed16`` gate, PERF.md §18),
+      ``gl`` uint8 [B, NG, VM] — placed byte lengths (all groups, in
+      emission order).
       ``sel_bit`` uint8 [B, C] — the chosen-bit position of each selector
       column's slot in the packed chosen vector (suball plans; match
       plans' column c IS slot/bit c, so ``None``).
@@ -267,8 +289,9 @@ class PieceSchema:
 
     kind: str  # "match" | "suball"
     groups: Tuple[PieceGroup, ...]
-    gw: np.ndarray
+    gw: Optional[np.ndarray]
     gl: np.ndarray
+    gw16: Optional[np.ndarray] = None
     sel_bit: Optional[np.ndarray] = None
     sel_slot: Optional[np.ndarray] = None
     closed: bool = False
@@ -318,6 +341,7 @@ def build_piece_schema(
     sel_slot: "np.ndarray | None" = None,  # int32 [B, C]
     sel_bit: "np.ndarray | None" = None,  # int32 [B, C]
     closed: bool = False,
+    launched: "np.ndarray | None" = None,  # bool [B] — device-launched rows
 ) -> "PieceSchema | None":
     """Build the per-slot piece tables, or None when the plan's geometry
     cannot take the scheme (static spans unsorted/overlapping, a piece
@@ -330,11 +354,23 @@ def build_piece_schema(
     trailing literals plus the 0x80 terminator (for NTLM's UTF-16LE
     expansion the terminator pseudo-byte expands to exactly the padded
     message's ``80 00`` pair, so no kernel terminator scan remains).
+
+    ``launched`` masks the rows the device will actually launch (suball
+    plans route hazard words to the oracle): the per-group placement
+    windows ``off_floor``/``off_cap`` — and the ``len_fixed`` static-run
+    detection — are computed over launched rows × reachable variants
+    only, so an oracle-routed word's degenerate columns cannot widen the
+    hierarchical-placement windows for everyone else (PERF.md §18).
     """
     b, length_axis = tokens.shape
     c_axis = col_pos.shape[1]
     if b == 0:
         return None
+    launched_rows = (
+        np.ones(b, bool) if launched is None else np.asarray(launched, bool)
+    )
+    if not launched_rows.any():
+        return None  # every word oracle-routed; the schema would be unused
     lengths = lengths.astype(np.int64)
     has_span = col_len > 0
     # Effective span starts: spanless columns sit at the running cursor so
@@ -449,6 +485,11 @@ def build_piece_schema(
 
     gb = np.zeros((b, ng, vmax, nwmax * 4), np.uint8)
     gl = np.zeros((b, ng, vmax), np.int64)
+    #: variant (gi, vi) is reachable for word b — the kernels can select
+    #: it on an EMITTED lane (a selector digit d needs col_opts >= d).
+    #: Bounds the placement windows; unreachable variants only ever feed
+    #: masked garbage lanes.
+    reach = np.zeros((b, ng, vmax), bool)
     nrows = val_bytes.shape[0]
     vw = val_bytes.shape[1]
     rows_iota = np.arange(b)
@@ -493,9 +534,13 @@ def build_piece_schema(
             # low column first (the kernel packs bits the same way).
             digits = {}
             rem = vi
+            rch = np.ones(b, bool)
             for c in sel:
                 digits[c] = rem % (opts_max[c] + 1)
                 rem //= opts_max[c] + 1
+                if digits[c] > 0:
+                    rch &= col_opts[:, c] >= digits[c]
+            reach[:, gi, vi] = rch
             at = np.zeros(b, np.int64)
             for e in spec:
                 if e["kind"] == "lit":
@@ -526,32 +571,73 @@ def build_piece_schema(
                 np.uint32
             ) << np.uint32(8 * k)
 
+    # Per-group placed-length extrema over launched rows × reachable
+    # variants — the hierarchical-placement windows (PERF.md §18).
+    big = 1 << 30
+    live = reach[launched_rows]
+    glv = gl[launched_rows]
+    gwv = gw[launched_rows]
+    g_min = np.where(live, glv, big).min(axis=(0, 2))
+    g_max = np.where(live, glv, -1).max(axis=(0, 2))
+
     groups = []
-    off = 0
+    floor_off = cap_off = 0
+    n16 = nwide = 0
     for gi, spec in enumerate(specs):
         sel = tuple(e["c"] for e in spec if col_variants(e) > 1)
         nbytes = cur_bytes(spec)
+        n_words = -(-max(nbytes, 1) // 4)
+        mn, mx = int(g_min[gi]), int(g_max[gi])
+        # 16-bit table gate: single-word groups whose every variant word
+        # fits 2 bytes move to the u16 ``gw16`` table (halved VMEM
+        # loads).  Like the placement windows above, the gate maxes over
+        # launched rows × reachable variants only — a fallback word's or
+        # unreachable variant's wide entry must not keep everyone else
+        # in the u32 table (each row is read only by its own word, so
+        # the u16 cast truncating a masked-out entry is unobservable).
+        p16 = n_words == 1 and int(
+            np.where(live[:, gi], gwv[:, gi, :, 0], 0).max(initial=0)
+        ) < (1 << 16)
         groups.append(
             PieceGroup(
                 sel_cols=sel,
                 n_variants=cur_variants(spec),
-                n_words=-(-max(nbytes, 1) // 4),
-                off_cap=off,
+                n_words=n_words,
+                off_cap=cap_off,
                 has_term=any(e["kind"] == "lit" and e["term"]
                              for e in spec),
+                off_floor=floor_off,
+                len_fixed=mn if mn == mx else None,
+                packed16=p16,
+                tab_idx=n16 if p16 else nwide,
             )
         )
-        off += nbytes
+        if p16:
+            n16 += 1
+        else:
+            nwide += 1
+        floor_off += mn
+        cap_off += mx
+
+    wide_idx = [gi for gi, grp in enumerate(groups) if not grp.packed16]
+    p16_idx = [gi for gi, grp in enumerate(groups) if grp.packed16]
+    gw_wide = gw[:, wide_idx] if wide_idx else None
+    gw16 = (
+        # (index then slice — a list at axis 1 combined with the basic
+        # integer 0 at axis 3 would hoist the advanced axes to the front)
+        gw[:, p16_idx][..., 0].astype(np.uint16) if p16_idx else None
+    )
 
     return PieceSchema(
         kind=kind,
         groups=tuple(groups),
-        gw=gw,
+        gw=gw_wide,
         gl=gl.astype(np.uint8),
+        gw16=gw16,
         sel_bit=None if sel_bit is None else sel_bit.astype(np.uint8),
         sel_slot=None if sel_slot is None else sel_slot.astype(np.int32),
         closed=closed,
-        max_out=off,
+        max_out=cap_off,
         n_cols=c_axis,
     )
 
@@ -635,6 +721,7 @@ def piece_schema_for(plan, ct) -> "PieceSchema | None":
         return cache[1]
     tokens = np.asarray(plan.tokens)
     lengths = np.asarray(plan.lengths)
+    launched = ~np.asarray(plan.fallback, bool)
     if getattr(plan, "match_pos", None) is not None:
         radix = np.asarray(plan.match_radix)
         schema = build_piece_schema(
@@ -642,7 +729,7 @@ def piece_schema_for(plan, ct) -> "PieceSchema | None":
             np.asarray(plan.match_pos), np.asarray(plan.match_len),
             (radix - 1).clip(min=0), np.asarray(plan.match_val_start),
             np.asarray(ct.val_bytes), np.asarray(ct.val_len),
-            kind="match",
+            kind="match", launched=launched,
         )
     else:
         cols = _suball_piece_cols(plan)
@@ -658,7 +745,7 @@ def piece_schema_for(plan, ct) -> "PieceSchema | None":
                 tokens, lengths, pos, ln, opts, vstart,
                 np.asarray(vb), np.asarray(vl),
                 kind="suball", sel_slot=slot, sel_bit=sel_bit,
-                closed=closed,
+                closed=closed, launched=launched,
             )
     try:
         object.__setattr__(plan, "_piece_schema_cache", (ct, schema))

@@ -770,9 +770,10 @@ def _place_word(msg, nw_data, off, blen, word, j_span, term_hi=None):
     folded final piece).  ``j_span``: static cap on the highest word index
     the piece's LO part can reach (its hi half spills one further).
     ``term_hi``: lanes whose folded piece is 5 bytes — the 5th byte rides
-    the hi word at the piece's own sub-word offset.  The single placement
-    primitive behind both the per-byte unit scan and the per-slot piece
-    emission (PERF.md §7a lever 1 / §17)."""
+    the hi word at the piece's own sub-word offset.  The byte-scan
+    emission's placement primitive (PERF.md §7a lever 1); the per-slot
+    piece kernels use the window-bounded :func:`_place_piece` instead
+    (PERF.md §18)."""
     sh8 = (blen * 8) & 31
     mask = (_U32(1) << sh8.astype(_U32)) - _U32(1)
     mask = jnp.where(blen >= 4, _U32(0xFFFFFFFF), mask)
@@ -796,6 +797,62 @@ def _place_word(msg, nw_data, off, blen, word, j_span, term_hi=None):
     w_last = min(nw_data, j_span + 1)
     if w_last < nw_data:
         msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
+
+
+def _place_piece(msg, nw_data, off, wd, *, floor, cap):
+    """OR one PRE-MASKED piece word into the message at byte offset
+    ``off`` — the piece kernels' hierarchical placement (PERF.md §18).
+
+    Pre-masked: the schema's ``gw``/``gw16`` tables zero every byte past
+    a variant's placed length, so no ``blen`` mask is built here — the
+    byte length drops out of placement entirely and only the offset
+    remains.  ``floor``/``cap`` are the group word's static reachable
+    byte window (``PieceGroup.off_floor``/``off_cap`` plus the word's
+    ``4*w``): for every EMITTED lane ``floor <= off <= cap``, so the
+    select chain runs only over the window's words ``floor//4..cap//4``
+    (the hi half spills one word further) instead of scanning from word
+    0.  Degenerate windows collapse further:
+
+    * ``off`` a Python int (every prior group's placed length is static)
+      — the whole dynamic scatter becomes a static shift-OR;
+    * ``floor//4 == cap//4`` — the lo word index is static even though
+      the sub-word shift is not: no selects, just shifts and ORs.
+
+    Masked garbage lanes may carry out-of-window offsets; their bytes
+    land nowhere (or in their own garbage message), never in another
+    lane's."""
+    if isinstance(off, int):
+        w_i = off >> 2
+        sh = 8 * (off & 3)
+        if w_i < nw_data:
+            msg[w_i] = msg[w_i] | (wd << _U32(sh) if sh else wd)
+        if sh and w_i + 1 < nw_data:
+            msg[w_i + 1] = msg[w_i + 1] | (wd >> _U32(32 - sh))
+        return
+    sh = _U32(8) * (off & 3).astype(_U32)
+    lo = wd << sh
+    # Shift-by-32 is undefined: mask the amount and select instead.
+    hi = jnp.where(sh > 0, wd >> ((_U32(32) - sh) & _U32(31)), _U32(0))
+    w_lo = max(0, floor >> 2)
+    w_hi = min(cap >> 2, nw_data - 1)
+    if w_lo >= nw_data:
+        return
+    if w_lo == w_hi:
+        msg[w_lo] = msg[w_lo] | lo
+        if w_lo + 1 < nw_data:
+            msg[w_lo + 1] = msg[w_lo + 1] | hi
+        return
+    widx = off >> 2
+    sel_prev = None
+    for w_i in range(w_lo, w_hi + 1):
+        sel = widx == w_i
+        contrib = jnp.where(sel, lo, _U32(0))
+        if sel_prev is not None:
+            contrib = contrib | jnp.where(sel_prev, hi, _U32(0))
+        msg[w_i] = msg[w_i] | contrib
+        sel_prev = sel
+    if w_hi + 1 < nw_data:
+        msg[w_hi + 1] = msg[w_hi + 1] | jnp.where(sel_prev, hi, _U32(0))
 
 
 def _length_words(msg, end, *, big_endian_length, hash_blocks):
@@ -1123,28 +1180,35 @@ def _make_piece_kernel(
     algo: str = "md5", scalar: bool = False, windowed: bool = False,
     close_s: "int | None" = None,
 ):
-    """Per-slot piece-emission kernel body (PERF.md §17) — ONE builder for
-    every tier (match/suball × scalar/general × full/windowed × closed).
+    """Per-slot piece-emission kernel body (PERF.md §17/§18) — ONE
+    builder for every tier (match/suball × scalar/general × full/
+    windowed × closed).
 
     The unit scheme's O(L) per-byte resolution is replaced by the plan's
     :class:`ops.packing.PieceSchema`: per emission GROUP the kernel forms
     a variant index from the group's slots' digits (scalar tiers: a bit
     field of the packed chosen vector), selects the group's precomputed
-    word(s) and placed length with one ``select_n`` each, places the
-    word(s) via the shared :func:`_place_word` scatter at the lane-local
-    prefix offset, and advances the prefix sum.  Literal gaps, skip
-    bytes, value bytes AND the 0x80 terminator live in the host tables
-    (the tail group's bytes carry the terminator, which under NTLM's
-    UTF-16LE expansion lands as exactly the padded message's ``80 00``
-    pair — no terminator scan remains in any tier).
+    word(s) and placed length with one ``select_n`` each (u16 table rows
+    for ``packed16`` groups, widened after the select), places the
+    word(s) via the window-bounded :func:`_place_piece` scatter at the
+    lane-local prefix offset, and advances the prefix sum — which stays
+    a Python int through any run of fixed-length groups, collapsing
+    their placement to static shift-ORs (the hierarchical-placement
+    lever, PERF.md §18).  Literal gaps, skip bytes, value bytes AND the
+    0x80 terminator live in the host tables (the tail group's bytes
+    carry the terminator, which under NTLM's UTF-16LE expansion lands
+    as exactly the padded message's ``80 00`` pair — no terminator scan
+    remains in any tier).
 
     Ref order (VMEM per grid step): ``count[G, 1]``, then the decode refs
     — scalar full: ``pbase[G, 1]``; windowed: ``base[G, M]``,
     ``radix[G, M]``, ``winv[G, M+1, K2]``; general: ``base[G, M]``,
     ``radix[G, M]`` — then suball selector refs (scalar: ``selbit[G, C]``
     (+ ``bitpos[G, P]`` when windowed); general: ``selslot[G, C]``), then
-    closure refs (``cnext``/``cmul``), then the piece tables
-    ``gw[G, NG, VM, NW] u32`` / ``gl[G, NG, VM] i32``.
+    closure refs (``cnext``/``cmul``), then the piece tables — the wide
+    groups' ``gw[G, NGW, VM, NW] u32`` (absent when every group packs to
+    u16), the narrow groups' ``gw16[G, NG16, VM] u16`` (absent when none
+    does), and ``gl[G, NG, VM] i32`` (all groups, emission order).
     Outputs: ``state[G, KS, S] u32``, ``emit[G, S] i32`` — identical
     contract to :func:`_make_kernel`.
     """
@@ -1178,7 +1242,9 @@ def _make_piece_kernel(
         if close_s is not None:
             cnext = rest.pop(0)
             cmul = rest.pop(0)
-        gw, gl = rest.pop(0), rest.pop(0)
+        gw = rest.pop(0) if schema.gw is not None else None
+        gw16 = rest.pop(0) if schema.gw16 is not None else None
+        gl = rest.pop(0)
         state_ref, emit_ref = rest
 
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
@@ -1246,11 +1312,19 @@ def _make_piece_kernel(
             return d
 
         # --- per-group emission ------------------------------------------
+        # The running offset stays a PYTHON INT (``cum_static``) while
+        # every group so far has a fixed placed length (``len_fixed``) —
+        # a run of fixed groups costs zero offset arithmetic and their
+        # placement collapses to static shift-ORs; the first varying
+        # group switches to the dynamic prefix sum (PERF.md §18).
         msg = [jnp.zeros((g, s), _U32) for _ in range(16 * hash_blocks)]
         nw_data = 16 * hash_blocks - 2
-        cum = jnp.zeros((g, s), _I32)
+        cum_static = 0
+        cum = None  # dynamic offset once any group's length varies
         for gi, grp in enumerate(groups):
             n_var, n_words = grp.n_variants, grp.n_words
+            if grp.len_fixed == 0:
+                continue  # empty in every launched word: nothing placed
             idx = None
             if n_var > 1:
                 sel = grp.sel_cols
@@ -1287,38 +1361,61 @@ def _make_piece_kernel(
                         idx = idx | (
                             (col_variant(c) > 0).astype(_I32) << i
                         )
-            l = _select_rows(idx, [gl[:, gi, v] for v in range(n_var)],
-                             g, s)
+            off0 = cum_static if cum is None else cum
             for w in range(n_words):
-                wd = _select_rows(
-                    idx, [gw[:, gi, v, w] for v in range(n_var)], g, s
-                )
-                off = cum if w == 0 else cum + 4 * w
-                blen = l if n_words == 1 else jnp.clip(l - 4 * w, 0, 4)
-                span = (grp.off_cap + 4 * w) // 4
+                if grp.packed16:
+                    # u16 variant table: halved VMEM loads; widen after
+                    # the select (one convert per group).
+                    wd = _select_rows(
+                        idx, [gw16[:, grp.tab_idx, v] for v in range(n_var)],
+                        g, s,
+                    ).astype(_U32)
+                else:
+                    wd = _select_rows(
+                        idx, [gw[:, grp.tab_idx, v, w] for v in range(n_var)],
+                        g, s,
+                    )
+                off = off0 if w == 0 else off0 + 4 * w
+                floor = grp.off_floor + 4 * w
+                cap = grp.off_cap + 4 * w
                 if not utf16:
-                    _place_word(msg, nw_data, off, blen, wd,
-                                min(span, nw_data))
+                    _place_piece(msg, nw_data, off, wd,
+                                 floor=floor, cap=cap)
                 else:
                     # Bytes b0..b3 -> code units (b0|b1<<16) at 2*off and
                     # (b2|b3<<16) at 2*off+4 (the shared split-piece
                     # machinery; the terminator pseudo-byte expands to
                     # the message's 80 00 pair).
                     lo16 = (wd & _U32(0xFF)) | ((wd & _U32(0xFF00)) << 8)
-                    hi16 = ((wd >> 16) & _U32(0xFF)) | (
-                        ((wd >> 24) & _U32(0xFF)) << 16
-                    )
                     off2 = off * 2
-                    blen_lo = jnp.minimum(blen, 2) * 2
-                    blen_hi = jnp.maximum(blen - 2, 0) * 2
-                    span2 = (2 * (grp.off_cap + 4 * w)) // 4
-                    _place_word(msg, nw_data, off2, blen_lo, lo16,
-                                min(span2, nw_data))
-                    _place_word(msg, nw_data, off2 + 4, blen_hi, hi16,
-                                min(span2 + 1, nw_data))
-            cum = cum + l
+                    _place_piece(msg, nw_data, off2, lo16,
+                                 floor=2 * floor, cap=2 * cap)
+                    if not grp.packed16:
+                        # packed16 rows are u16: bytes 2-3 are statically
+                        # zero, so the hi pair would OR nothing.
+                        hi16 = ((wd >> 16) & _U32(0xFF)) | (
+                            ((wd >> 24) & _U32(0xFF)) << 16
+                        )
+                        _place_piece(msg, nw_data, off2 + 4, hi16,
+                                     floor=2 * floor + 4, cap=2 * cap + 4)
+            if grp.len_fixed is not None:
+                if cum is None:
+                    cum_static += grp.len_fixed
+                else:
+                    cum = cum + grp.len_fixed
+            else:
+                l = _select_rows(
+                    idx, [gl[:, gi, v] for v in range(n_var)], g, s
+                )
+                if cum is not None:
+                    cum = cum + l
+                else:
+                    cum = l if cum_static == 0 else l + cum_static
         # The tail group's placed bytes include the terminator.
-        out_len = cum - 1
+        if cum is None:  # every group fixed: the whole length is static
+            out_len = jnp.full((g, s), cum_static - 1, _I32)
+        else:
+            out_len = cum - 1
         end = out_len * scale if scale != 1 else out_len
         msg = _length_words(msg, end, big_endian_length=algo == "sha1",
                             hash_blocks=hash_blocks)
@@ -1552,12 +1649,25 @@ def _piece_tables(pieces, pre, blk_word):
     """Per-block piece tables for the piece kernels: device copies from
     ``pre`` (``piece_arrays`` — shipped once per sweep) when present,
     else the schema's own host arrays (trace-time constants; the harness
-    and direct calls)."""
-    if pre is not None and "pw" in pre:
-        gw_all, gl_all = pre["pw"], pre["pl"]
+    and direct calls).  Returns the ref tuple in kernel order — the u32
+    ``gw`` block rows, the u16 ``gw16`` rows (each omitted when the
+    schema has no groups in that table), then the ``gl`` lengths."""
+    if pre is not None and "pl" in pre:
+        gw_all = pre.get("pw")
+        gw16_all = pre.get("pw16")
+        gl_all = pre["pl"]
     else:
-        gw_all, gl_all = jnp.asarray(pieces.gw), jnp.asarray(pieces.gl)
-    return gw_all[blk_word], gl_all[blk_word].astype(_I32)
+        gw_all = None if pieces.gw is None else jnp.asarray(pieces.gw)
+        gw16_all = (
+            None if pieces.gw16 is None else jnp.asarray(pieces.gw16)
+        )
+        gl_all = jnp.asarray(pieces.gl)
+    tabs = ()
+    if gw_all is not None:
+        tabs += (gw_all[blk_word],)
+    if gw16_all is not None:
+        tabs += (gw16_all[blk_word],)
+    return tabs + (gl_all[blk_word].astype(_I32),)
 
 
 @audited_entry(
@@ -1613,7 +1723,7 @@ def fused_expand_md5(
         # Per-slot piece emission (PERF.md §17): the whole byte-position
         # scan is replaced by the schema's precomputed group tables.
         scalar = bool(scalar_units) and k_opts == 1
-        gw_b, gl_b = _piece_tables(pieces, pre, blk_word)
+        tabs = _piece_tables(pieces, pre, blk_word)
         if scalar and win_v is None:
             if pre is not None and "weight" in pre:
                 pbase = jnp.sum(
@@ -1623,13 +1733,13 @@ def fused_expand_md5(
                 _, _, _, pbase = _scalar_units_prelude(
                     match_radix[blk_word], blk_base
                 )
-            inputs = (blk_count[:, None], pbase, gw_b, gl_b)
+            inputs = (blk_count[:, None], pbase) + tabs
         else:
             inputs = (blk_count[:, None], blk_base,
                       match_radix[blk_word])
             if win_v is not None:
                 inputs = inputs + (win_v[blk_word],)
-            inputs = inputs + (gw_b, gl_b)
+            inputs = inputs + tabs
         kernel = _make_piece_kernel(
             g=_G, s=block_stride, kind="match", schema=pieces,
             num_slots=m, k_opts=k_opts, out_width=out_width,
@@ -1957,7 +2067,7 @@ def fused_expand_suball_md5(
         # Per-slot piece emission (PERF.md §17): segments ARE the pieces;
         # gap segments fold into the schema's literal prefixes.
         scalar = bool(scalar_units) and k_opts == 1
-        gw_b, gl_b = _piece_tables(pieces, pre, blk_word)
+        tabs = _piece_tables(pieces, pre, blk_word)
         if scalar:
             if pre is not None and "sbit" in pre:
                 selbit_b = pre["sbit"][blk_word].astype(_I32)
@@ -1998,7 +2108,7 @@ def fused_expand_suball_md5(
             inputs += (selslot_b,)
             if close_next is not None:
                 inputs += (close_next[blk_word], close_mul[blk_word])
-        inputs += (gw_b, gl_b)
+        inputs += tabs
         kernel = _make_piece_kernel(
             g=_G, s=block_stride, kind="suball", schema=pieces,
             num_slots=p, k_opts=k_opts, out_width=out_width,

@@ -230,24 +230,35 @@ def make_sharded_superstep_step(
     sharded superstep sweeps the identical (word, rank) stream.
 
     Input pytrees: ``plan``/``table``/``digests``/``ss`` replicated;
-    ``b0`` an int32 [D] of per-device start block indices, sharded.
-    Outputs: ``n_emitted``/``n_hits`` psum'd (replicated scalars);
-    ``dev_hits`` int32 [D] and the per-device hit buffers
-    ``hit_word``/``hit_rank`` int32 [D * hit_cap] sharded on the leading
-    axis (device ``d``'s slots at ``[d * hit_cap, (d+1) * hit_cap)``).
-    The host merges per-device slices and sorts by (word, rank) — cursor
-    order, identical to the single-device stream.
+    ``b0`` an int32 [D] of per-device start block indices, sharded;
+    ``bufs`` the per-device hit-buffer sets — int32
+    ``[D * (hit_cap + 1)]`` sharded on the leading axis, donated off-CPU
+    exactly like the single-device step (the pipelined driver cycles two
+    sets; PERF.md §18).
+    Outputs: ``counters`` (= psum'd ``[n_emitted, n_hits]``, the
+    driver's single per-superstep fetch) and the scalar counts
+    replicated; ``dev_hits`` int32 [D] and the per-device hit buffers
+    ``hit_word``/``hit_rank`` int32 [D * (hit_cap + 1)] sharded on the
+    leading axis (device ``d``'s slots at
+    ``[d * (hit_cap + 1), (d+1) * (hit_cap + 1))``, slot ``hit_cap`` the
+    trash slot).  The host merges per-device slices and sorts by
+    (word, rank) — cursor order, identical to the single-device stream.
     """
+    from ..models.attack import _buffer_donation
+
     n_devices = int(np.prod(mesh.devices.shape))
     body = make_superstep_body(
         spec, num_lanes=lanes_per_device, num_blocks=num_blocks,
         step_advance=num_blocks * n_devices, **kwargs,
     )
 
-    def local_step(plan, table, digests, ss, b0):
-        out = body(plan, table, digests, ss, b0[0])
-        out["n_emitted"] = jax.lax.psum(out["n_emitted"], axis_name)
-        out["n_hits"] = jax.lax.psum(out["n_hits"], axis_name)
+    def local_step(plan, table, digests, ss, b0, bufs):
+        out = body(plan, table, digests, ss, b0[0], bufs)
+        # ONE collective per superstep: counters stacks
+        # [n_emitted, n_hits], so the replicated scalars are its rows.
+        out["counters"] = jax.lax.psum(out["counters"], axis_name)
+        out["n_emitted"] = out["counters"][0]
+        out["n_hits"] = out["counters"][1]
         return out
 
     rep = P()
@@ -255,8 +266,9 @@ def make_sharded_superstep_step(
     mapped = _shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(rep, rep, rep, rep, shard),
+        in_specs=(rep, rep, rep, rep, shard, shard),
         out_specs={
+            "counters": rep,
             "n_emitted": rep,
             "n_hits": rep,
             "dev_hits": shard,
@@ -265,7 +277,7 @@ def make_sharded_superstep_step(
         },
         check_vma=False,  # see make_sharded_crack_step
     )
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=_buffer_donation())
 
 
 def make_sharded_candidates_step(

@@ -500,11 +500,15 @@ _SUPERSTEP_KEYS = ("supersteps", "launches", "replays")
 
 def _reduce_superstep(stats: Dict[str, int]) -> Dict[str, int]:
     """Pod-wide superstep stats: counters sum, the launches-per-fetch
-    ratio maxes (hosts share one config; stripes differ only via the
-    int32 step cap).  Returns {} when no stripe ran the executor."""
+    ratio and the pipelined flag max (hosts share one config; stripes
+    differ only via the int32 step cap).  Returns {} when no stripe ran
+    the executor."""
     out = {k: allgather_sum(int(stats.get(k, 0))) for k in _SUPERSTEP_KEYS}
     out["launches_per_fetch"] = int(
         allgather_max(float(stats.get("launches_per_fetch", 0)))
+    )
+    out["pipelined"] = int(
+        allgather_max(float(stats.get("pipelined", 0)))
     )
     return out if any(out.values()) else {}
 

@@ -161,10 +161,11 @@ class BucketedSweep:
             for k, v in r.routing.items():
                 routing[k] = routing.get(k, 0) + int(v)
             # Superstep stats accumulate across buckets; the per-sweep
-            # launches_per_fetch ratio is reported as the max (buckets
-            # share one config, so they only differ via the int32 cap).
+            # launches_per_fetch ratio and the pipelined flag are
+            # reported as the max (buckets share one config, so they
+            # only differ via the int32 cap).
             for k, v in getattr(r, "superstep", {}).items():
-                if k == "launches_per_fetch":
+                if k in ("launches_per_fetch", "pipelined"):
                     superstep[k] = max(superstep.get(k, 0), int(v))
                 else:
                     superstep[k] = superstep.get(k, 0) + int(v)

@@ -6,9 +6,10 @@ The engine grew one escape-hatch env var per subsystem —
 each with its own ad-hoc ``os.environ`` read.  Sprawled reads make the
 knob surface unauditable (graftlint GL012 now flags direct reads outside
 this module).  Every accessor here is a thin, *semantics preserving*
-wrapper — call sites keep their bespoke parsing, vocabularies and
-warnings (the off-spellings deliberately differ per knob and are pinned
-by tests), they just read through one door.
+wrapper — call sites with bespoke vocabularies keep their own parsing
+(``A5GEN_EMIT``), while the on-by-default escape hatches share ONE
+off-spelling convention via :func:`env_opt_out` — either way the reads
+go through one door.
 
 Deliberately dependency-free (stdlib only): ``ops/`` modules import this
 at module top level, and the ``runtime`` package's eager imports
@@ -48,6 +49,45 @@ def env_str(name: str, default: str = "") -> str:
 def env_is(name: str, literal: str) -> bool:
     """Exact-match test (``A5GEN_PALLAS == "1"`` and friends)."""
     return read_env(name) == literal
+
+
+#: (name, value) pairs already warned about — accessors like
+#: ``close_enabled`` are called from per-word planning loops, and one
+#: typo must produce one diagnostic, not one per word.
+_WARNED: set = set()
+
+
+def env_opt_out(name: str, default_desc: str) -> bool:
+    """Shared parse for the on-by-default escape hatches
+    (``A5GEN_SUPERSTEP``, ``A5GEN_CASCADE_CLOSE``, ``A5GEN_PIPELINE``):
+    returns True when the hatch is pulled (``off``/``0``/``no``).  Any
+    other value outside the on-spellings (empty/``auto``/``on``/``1``)
+    warns (once per value) and keeps the default — a typo must not
+    silently change behavior."""
+    val = env_str(name)
+    if val.lower() in ("off", "0", "no"):
+        return True
+    if val.lower() not in ("", "auto", "on", "1"):
+        if (name, val) not in _WARNED:
+            _WARNED.add((name, val))
+            import sys
+
+            print(
+                f"a5gen: warning: unrecognized {name}={val!r} "
+                f"(want off|0|no or on|1|auto); keeping the default "
+                f"({default_desc})",
+                file=sys.stderr,
+            )
+    return False
+
+
+def pipeline_enabled() -> bool:
+    """Superstep-pipeline knob: ``A5GEN_PIPELINE`` set to ``off``/``0``/
+    ``no`` pins the barriered superstep drive (fetch immediately after
+    dispatch) instead of the double-buffered pipeline (PERF.md §18)."""
+    return not env_opt_out(
+        "A5GEN_PIPELINE", "pipelined superstep drive"
+    )
 
 
 def emit_scheme() -> str:

@@ -110,6 +110,18 @@ class SweepConfig:
     #   N >= 1 pins the steps-per-superstep (capped so a superstep's int32
     #   emitted-count accumulator cannot overflow). The streams are
     #   identical either way; A5GEN_SUPERSTEP=off is the env escape hatch.
+    pipeline: Optional[bool] = None  # crack mode: double-buffered superstep
+    #   drive (PERF.md §18). The driver keeps TWO alternating device hit/
+    #   counter buffer sets and dispatches superstep N+1 into set B before
+    #   fetching set A's counters, so the once-per-superstep fetch overlaps
+    #   the next superstep's compute instead of barriering the chain (the
+    #   honest-sync rule moves: the fetch of set A is the completion
+    #   barrier for superstep N ONLY). Replay and checkpoints land at the
+    #   fetched (lagged) superstep boundary; shutdown drains the in-flight
+    #   superstep. None = auto: on whenever the superstep executor engages
+    #   and max_in_flight >= 2. False = barriered drive (fetch right after
+    #   dispatch — the A/B arm). A5GEN_PIPELINE=off is the env escape
+    #   hatch; the streams are identical either way.
     superstep_hit_cap: int = 4096  # capped device (word, rank) hit buffer
     #   carried through the superstep scan, PER DEVICE. A superstep whose
     #   device-local hits exceed the cap is replayed exactly through the
@@ -544,22 +556,12 @@ class Sweep:
         """Requested steps-per-superstep, or None when the superstep
         executor is off (``SweepConfig.superstep=0`` or
         ``A5GEN_SUPERSTEP=off``)."""
-        from .env import env_str
+        from .env import env_opt_out
 
-        env = env_str("A5GEN_SUPERSTEP")
-        # Same off-spellings as A5GEN_CASCADE_CLOSE (expand_suball.
-        # close_enabled) — the two escape hatches must share a convention.
-        if env.lower() in ("off", "0", "no"):
+        if env_opt_out(
+            "A5GEN_SUPERSTEP", "superstep on for eligible crack sweeps"
+        ):
             return None
-        if env.lower() not in ("", "auto", "on", "1"):
-            import sys
-
-            print(
-                f"a5gen: warning: unrecognized A5GEN_SUPERSTEP={env!r} "
-                "(want off|0|no|auto); keeping the default (superstep on "
-                "for eligible crack sweeps)",
-                file=sys.stderr,
-            )
         cfg = self.config
         if cfg.superstep is not None and int(cfg.superstep) <= 0:
             return None
@@ -567,18 +569,40 @@ class Sweep:
             1, int(cfg.superstep) if cfg.superstep else int(cfg.fetch_chunk)
         )
 
+    def _pipeline_depth(self) -> int:
+        """In-flight superstep budget for :meth:`_drive_superstep`:
+        ``max_in_flight`` buffer sets (default 2 — the double-buffered
+        pipeline, PERF.md §18; deeper configs keep the pre-§18 loop's
+        dispatch-ahead contract for long-latency links) unless the
+        config or ``A5GEN_PIPELINE`` pins the barriered drive."""
+        from .env import pipeline_enabled
+
+        cfg = self.config
+        if not pipeline_enabled():
+            return 1
+        if not (cfg.pipeline is None or cfg.pipeline):
+            return 1
+        # max_in_flight bounds the in-flight working set even when the
+        # pipeline is explicitly requested — it is the device-memory
+        # contract the per-launch path honors too (one buffer set per
+        # in-flight superstep).
+        return max(1, int(cfg.max_in_flight))
+
     def _make_superstep(self, cursor: SweepCursor, n_devices: int, mesh):
         """Build this run's superstep executor, or None when the
         per-launch pipeline should carry it: config/env opt-out, packed
         block layout, an int32-unsafe block index (huge words), or a
         stride-misaligned resume cursor (cross-geometry checkpoints).
 
-        Returns a descriptor dict whose ``call(b0)`` dispatches one
-        superstep starting at global block index ``b0`` — ONE device
-        program running ``steps`` fused launches with on-device block
-        cutting (``models.attack.make_superstep_body``).  Must run after
-        :meth:`_make_launch` (which resolves the geometry and stashes the
-        step-build context the executor shares)."""
+        Returns a descriptor dict whose ``call(b0, bufs)`` dispatches one
+        superstep starting at global block index ``b0`` into the device
+        hit-buffer set ``bufs`` — ONE device program running ``steps``
+        fused launches with on-device block cutting
+        (``models.attack.make_superstep_body``); ``make_bufs()``
+        allocates one buffer set (the pipelined driver cycles ``depth``
+        of them).
+        Must run after :meth:`_make_launch` (which resolves the geometry
+        and stashes the step-build context the executor shares)."""
         steps = self._superstep_steps()
         if steps is None:
             return None
@@ -601,6 +625,18 @@ class Sweep:
         if w < plan.batch and rank % stride:
             return None
         b0 = total_blocks if w >= plan.batch else int(cum[w]) + rank // stride
+        if w < plan.batch and block_cursor(plan, stride, cum, b0) != (w, rank):
+            # Resume integrity: the executor's start block must round-trip
+            # to the (normalized) checkpoint cursor exactly — a cum/cursor
+            # mismatch here would silently re-sweep or skip blocks, and a
+            # drained pipelined run must land where the checkpoint says it
+            # did (cross-path resumes pin this in tests).
+            raise RuntimeError(
+                f"superstep resume cursor mismatch: block {b0} decodes to "
+                f"{block_cursor(plan, stride, cum, b0)}, checkpoint says "
+                f"({w}, {rank}); the checkpoint does not match this "
+                "plan/geometry"
+            )
         # The superstep's device accumulator is int32: cap steps so a
         # worst case of every lane emitting cannot reach 2^31 per fetch.
         steps = max(1, min(
@@ -627,14 +663,17 @@ class Sweep:
         )
         p, t, darrs = ctx["arrays"]
         if n_devices == 1:
+            from ..models.attack import superstep_buffers
+
             step = make_superstep_step(
                 self.spec, num_lanes=cfg.lanes, num_blocks=cfg.num_blocks,
                 **common,
             )
             ss = superstep_arrays(plan, stride)
+            make_bufs = lambda: superstep_buffers(hit_cap)  # noqa: E731
 
-            def call(b: int):
-                return step(p, t, darrs, ss, np.int32(b))
+            def call(b: int, bufs):
+                return step(p, t, darrs, ss, np.int32(b), bufs)
         else:
             from ..parallel.mesh import (
                 make_sharded_superstep_step,
@@ -649,14 +688,27 @@ class Sweep:
             ss = replicate(mesh, superstep_arrays(plan, stride))
             nb = cfg.num_blocks
 
-            def call(b: int):
+            def make_bufs():
+                per_dev = hit_cap + 1
+                return shard_leading(mesh, {
+                    "hit_word": np.full(
+                        (n_devices * per_dev,), -1, np.int32
+                    ),
+                    "hit_rank": np.zeros(
+                        (n_devices * per_dev,), np.int32
+                    ),
+                })
+
+            def call(b: int, bufs):
                 b0_dev = shard_leading(mesh, np.asarray(
                     [b + d * nb for d in range(n_devices)], np.int32
                 ))
-                return step(p, t, darrs, ss, b0_dev)
+                return step(p, t, darrs, ss, b0_dev, bufs)
 
         return {
             "call": call,
+            "make_bufs": make_bufs,
+            "depth": self._pipeline_depth(),
             "steps": steps,
             "stride": stride,
             "cum": cum,
@@ -671,28 +723,40 @@ class Sweep:
         mesh, device_hit: Callable, fallback_candidate: Callable,
         prefetch, last_ckpt: List[float], process_launch_hits: Callable,
     ) -> Dict[str, int]:
-        """The superstep launch loop: one dispatch and ONE host fetch per
-        ``steps`` fused launches.  Supersteps are double-buffered like
-        launches (``max_in_flight``); the counter fetch is each
-        superstep's completion barrier (the §0 honest-sync rule — no
-        ``block_until_ready``).  A device whose capped hit buffer
-        overflowed triggers an exact per-launch replay of that superstep's
-        block range; checkpoint/progress land at superstep boundaries."""
+        """The superstep launch loop: one dispatch and ONE device→host
+        fetch per ``steps`` fused launches.  The drive is double-buffered
+        over ``depth`` alternating device hit-buffer sets
+        (``max_in_flight``, default 2 — PERF.md §18): superstep N+1 is
+        dispatched into set B before set A's counters are fetched, so
+        the fetch overlaps the next
+        superstep's compute — the honest-sync rule moves to the lagged
+        barrier: fetching set A completes superstep N ONLY, never the
+        in-flight one, and nothing calls ``block_until_ready``.  A set is
+        recycled only after its counters (and any hit slice) were
+        consumed, which with donation makes the cycle a true double
+        buffer.  A device whose capped hit buffer overflowed triggers an
+        exact per-launch replay of that superstep's block range;
+        checkpoint/progress/replay all land at the FETCHED (lagged)
+        superstep boundary, and the loop exits only once the in-flight
+        superstep is drained."""
         cfg, plan = self.config, self.plan
         cum, stride = ss["cum"], ss["stride"]
         total_blocks, hit_cap = ss["total_blocks"], ss["hit_cap"]
-        advance = ss["advance"]
+        advance, depth = ss["advance"], ss["depth"]
         stats = {"supersteps": 0, "launches": 0, "replays": 0,
-                 "launches_per_fetch": ss["steps"]}
-        pending: deque = deque()
+                 "launches_per_fetch": ss["steps"],
+                 "pipelined": int(depth > 1)}
+        free_bufs = [ss["make_bufs"]() for _ in range(depth)]
+        inflight: deque = deque()
         b0 = ss["b0"]
-        while b0 < total_blocks or pending:
-            while b0 < total_blocks and len(pending) < cfg.max_in_flight:
-                pending.append((b0, ss["call"](b0)))
+        while b0 < total_blocks or inflight:
+            while b0 < total_blocks and len(inflight) < depth:
+                inflight.append((b0, ss["call"](b0, free_bufs.pop())))
                 b0 += advance
-            sb0, out = pending.popleft()
-            ne = int(out["n_emitted"])  # completion barrier (scalar fetch)
-            nh = int(out["n_hits"])
+            sb0, out = inflight.popleft()
+            # The ONE per-superstep fetch — the completion barrier for
+            # superstep N only (N+1 keeps running on device).
+            ne, nh = (int(x) for x in np.asarray(out["counters"]))
             end_b = min(sb0 + advance, total_blocks)
             end_w, end_r = block_cursor(plan, stride, cum, end_b)
             if nh:
@@ -710,10 +774,11 @@ class Sweep:
                 else:
                     hw = np.asarray(out["hit_word"])
                     hr = np.asarray(out["hit_rank"])
+                    per_dev = hit_cap + 1  # trailing trash slot
                     entries: List[Tuple[int, int]] = []
                     for d in range(n_devices):
                         k = int(dev_hits[d])
-                        lo = d * hit_cap
+                        lo = d * per_dev
                         entries.extend(zip(hw[lo:lo + k].tolist(),
                                            hr[lo:lo + k].tolist()))
                     # (word, rank) sort = cursor order: device stripes
@@ -722,6 +787,11 @@ class Sweep:
                     entries.sort()
                     for w_row, rank in entries:
                         device_hit(int(w_row), int(rank))
+            # Superstep N's buffers are fully consumed — recycle the set
+            # for superstep N+2 (donation aliases the next dispatch's
+            # outputs onto it).
+            free_bufs.append({"hit_word": out["hit_word"],
+                              "hit_rank": out["hit_rank"]})
             # Fallback words wholly before the cursor are due now.
             self._flush_fallback_until(
                 end_w, state, fallback_candidate, prefetch

@@ -1,0 +1,217 @@
+"""Drive-loop fetch-discipline fixture: the double-fetch regression.
+
+``broken_drive`` commits the two pipeline-killing sins the
+``drive-fetch`` audit exists for (PERF.md §18): it coerces the counters
+of the superstep it JUST dispatched (a completion barrier on the
+in-flight buffer set — the overlap is gone) and it fetches the popped
+superstep's result twice unconditionally (the second fetch re-barriers
+what the stacked-counters contract made one round trip).
+``clean_drive`` is the sanctioned shape: one unconditional fetch of the
+popped result, hit buffers only behind the hit-count guard.
+
+AST-only fixtures: the audit reads source, nothing here ever runs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+def clean_drive(call, make_bufs, total, advance, depth, process_hits):
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        ne, nh = (int(x) for x in np.asarray(out["counters"]))
+        if nh:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def clean_drive_bound_counters(call, make_bufs, total, advance, depth,
+                               process_hits):
+    """Sanctioned shape, counters BOUND first: the ``np.asarray`` is the
+    one round trip; ``int(counters[i])`` is host arithmetic on the
+    already-materialized array, not a second fetch."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        counters = np.asarray(out["counters"])
+        ne = int(counters[0])
+        nh = int(counters[1])
+        if nh:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def clean_drive_annotated(call, make_bufs, total, advance, depth,
+                          process_hits, annotate):
+    """Sanctioned shape under a profiler annotation: the ``with`` block
+    does not gate its body, but the hit guard nested inside it still
+    does — the guarded hit-slice fetch must stay conditional."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        with annotate("a5.consume_superstep"):
+            ne, nh = (int(x) for x in np.asarray(out["counters"]))
+            if nh:
+                dev_hits = np.asarray(out["dev_hits"])
+                process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def broken_drive_unbound(call, make_bufs, total, advance, depth,
+                         process_hits):
+    """Sin 1 in the production dispatch shape: the call result is never
+    bound to a name — it goes straight into the deque — and the barrier
+    fetch reaches the in-flight superstep THROUGH the container."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        # Fetching through the deque barriers the JUST-dispatched
+        # superstep exactly like naming it first would.
+        done += int(inflight[-1][1]["n_emitted"])
+        sb0, out = inflight.popleft()
+        ne, nh = (int(x) for x in np.asarray(out["counters"]))
+        if nh:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def broken_drive_guard_fetch(call, make_bufs, total, advance, depth,
+                             process_hits):
+    """Sin 2 hidden in a CONDITION: the second unconditional fetch is
+    written as the hit guard's test — it still runs every superstep."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        ne, nh = (int(x) for x in np.asarray(out["counters"]))
+        if int(out["n_hits"]):
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def clean_drive_inline_coercion(call, make_bufs, total, advance, depth,
+                                process_hits):
+    """Sanctioned shape spelled INLINE: ``int(np.asarray(...)[0])`` is
+    one round trip — the inner ``asarray`` is the fetch, the outer
+    ``int`` is host arithmetic on its materialized result."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        ne = int(np.asarray(out["counters"])[0])
+        if ne:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def broken_drive_loop_fetch(call, make_bufs, total, advance, depth,
+                            process_hits):
+    """The double-fetch regression written as a LOOP: a single ``int()``
+    call node in a per-key loop is two device round trips per
+    superstep."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        totals = {}
+        for key in ("n_emitted", "n_hits"):
+            totals[key] = int(out[key])
+        if totals["n_hits"]:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += totals["n_emitted"]
+    return done
+
+
+def broken_drive(call, make_bufs, total, advance, depth, process_hits):
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            fresh = call(b0, free.pop())
+            # Sin 1: fetching the just-dispatched superstep's counters
+            # barriers the in-flight buffer set — no overlap remains.
+            done += int(fresh["n_emitted"])
+            inflight.append((b0, fresh))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        ne, nh = (int(x) for x in np.asarray(out["counters"]))
+        # Sin 2: a SECOND unconditional fetch of the popped result — the
+        # double-fetch regression (two round trips per superstep).
+        nh = int(out["n_hits"])
+        if nh:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done

@@ -108,6 +108,19 @@ def test_parser_has_stride_ab_and_init_retry_budget():
     assert args.init_retry_budget == 240.0
 
 
+def test_parser_has_pipeline_ab():
+    """The §18 pipeline A/B arm rides the same parser contract as
+    --superstep-ab (default-off flag, §4c geometry defaulting)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    args = bench.build_parser().parse_args([])
+    assert args.pipeline_ab is False
+    assert bench.build_parser().parse_args(["--pipeline-ab"]).pipeline_ab
+
+
 import pytest  # noqa: E402
 
 

@@ -11,6 +11,8 @@ passing a schema) against the per-slot default — and require exact
 equality of emitted candidates / digests.
 """
 
+import zlib
+
 import numpy as np
 import pytest
 
@@ -300,6 +302,129 @@ class TestPallasFuzzParity:
             spec, plan, ct, schema, parr, t, b, algo="md5",
             scalar_units=False,
         ) > 0
+
+
+class TestHierarchicalPlacement:
+    """The word-bucketed placement windows (PERF.md §18): per-group
+    static [off_floor, off_cap] byte windows bound the scatter, fixed
+    groups (``len_fixed``) keep the running offset static, and narrow
+    groups move to the u16 ``gw16`` table — all of which must stay
+    byte-invisible next to the bytescan twin."""
+
+    def test_variable_length_values_open_windows(self):
+        # 1- vs 3-byte options make every selector group's placed length
+        # vary, so downstream groups get real (floor < cap) windows.
+        spec = AttackSpec(mode="default", algo="md5")
+        sub = {b"a": [b"Z", b"XYZ"], b"e": [b"9", b"123"]}
+        words = [b"banana-tree", b"elephant", b"weave", b"qqq"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert schema is not None
+        assert any(g.off_floor < g.off_cap for g in schema.groups)
+        assert any(g.off_floor == g.off_cap for g in schema.groups)
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+        assert_pallas_parity(spec, plan, ct, schema, parr, t, b,
+                             algo="md5", scalar_units=False)
+
+    @pytest.mark.parametrize("algo", ["md5", "ntlm"])
+    def test_all_fixed_groups_collapse_to_static_placement(self, algo):
+        # Length-preserving 1:1 values with uniform match geometry:
+        # every group's placed length is fixed, so the whole scatter
+        # lowers to static shift-ORs (degenerate windows) — including
+        # NTLM's split pieces and the terminator-folded tail.
+        spec = AttackSpec(mode="default", algo=algo)
+        sub = {b"a": [b"4"], b"o": [b"0"], b"s": [b"5"]}
+        words = [b"password"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert schema is not None
+        assert all(g.len_fixed is not None for g in schema.groups)
+        assert all(g.off_floor == g.off_cap for g in schema.groups)
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo=algo,
+        ) > 0
+
+    @pytest.mark.parametrize("algo", ["md5", "ntlm"])
+    def test_gw16_carries_short_groups(self, algo):
+        # Standalone 4-variant selector columns can't merge with each
+        # other (the variant-product cap), so groups stay <= 2 bytes
+        # (gap + 1-byte span) and every variant word fits u16 — the
+        # whole table moves to gw16.  NTLM pins the utf16 split where
+        # the packed16 hi pair is statically zero and elided.
+        spec = AttackSpec(mode="default", algo=algo)
+        sub = {b"a": [b"X", b"Y", b"Z"]}
+        words = [b"banana", b"cabana", b"baobab"]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert schema is not None
+        assert schema.gw16 is not None
+        assert any(g.packed16 for g in schema.groups)
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+        assert_pallas_parity(spec, plan, ct, schema, parr, t, b,
+                             algo=algo, scalar_units=False)
+
+    @pytest.mark.parametrize("mode,algo", [
+        ("default", "md5"), ("default", "ntlm"), ("suball", "md5"),
+    ])
+    def test_window_fuzz_long_words(self, mode, algo):
+        # Seeded fuzz at 2-hash-block-like widths: long words × mixed
+        # 1..3-byte values stack many groups, so late groups' windows
+        # and the multi-block terminator fold are all exercised.
+        # zlib.crc32, not hash(): str hashing is salted per process, and
+        # this test makes a seed-dependent structural assertion below.
+        rng = np.random.default_rng(
+            zlib.crc32(f"win-{mode}-{algo}".encode())
+        )
+        spec = AttackSpec(mode=mode, algo=algo)
+        sub = rand_table(rng, k_opts=2, val_len=3)
+        words = [
+            bytes(rng.choice(list(b"abcdefgh~!"),
+                             size=int(rng.integers(20, 30))).astype(
+                np.uint8))
+            for _ in range(4)
+        ]
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        if schema is None:
+            pytest.skip("randomized geometry rejected the schema")
+        assert any(g.off_floor < g.off_cap for g in schema.groups)
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+        assert assert_pallas_parity(
+            spec, plan, ct, schema, parr, t, b, algo=algo,
+            scalar_units=False,
+        ) > 0
+
+    def test_suball_fallback_words_do_not_widen_windows(self):
+        # A hazard word routed to the oracle has blanked columns (its
+        # whole word becomes tail literals); the windows must be
+        # computed over LAUNCHED words only, or its full-length tail
+        # would stretch every group's cap.
+        sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"qq"]}
+        words = [b"za", b"acbacbacbacbacb", b"az"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert plan.fallback.any(), "fixture must exercise fallback"
+        assert schema is not None
+        launched_len = max(
+            int(l) for l, fb in zip(plan.lengths, plan.fallback) if not fb
+        )
+        # The cap can exceed the launched byte budget only by value
+        # growth (+1 terminator) — never by the fallback word's length.
+        assert schema.max_out <= 2 * launched_len + 1
+        assert_xla_parity(spec, plan, schema, parr, t, b)
+
+    def test_suball_fallback_words_do_not_veto_packed16(self):
+        # Same masking rule for the u16 gate: the oracle-routed word's
+        # 4-byte tail chunks (>= 2^16 as u32 words) sit in groups whose
+        # LAUNCHED entries all fit 2 bytes — they must still move to
+        # gw16 (the fallback row is never read by a launched lane, so
+        # its truncated entry is unobservable).
+        sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"qq"]}
+        words = [b"za", b"acbacbacbacbacb", b"az"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        ct, plan, schema, parr, t, b = _setup(spec, sub, words)
+        assert plan.fallback.any(), "fixture must exercise fallback"
+        assert schema is not None
+        assert schema.gw16 is not None
+        assert any(g.packed16 for g in schema.groups)
+        assert_xla_parity(spec, plan, schema, parr, t, b)
 
 
 class TestGates:

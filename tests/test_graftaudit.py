@@ -134,6 +134,128 @@ class TestHostTransfer:
 
 
 # ---------------------------------------------------------------------------
+# Pipelined drive-loop fetch discipline (PERF.md §18)
+# ---------------------------------------------------------------------------
+
+
+class TestDriveFetch:
+    def test_double_fetch_and_inflight_fetch_flagged(self):
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        findings = audit_drive_loop(mod.broken_drive, "fixture.drive")
+        assert all(f.check == "drive-fetch" for f in findings)
+        # Both regressions: barriering the in-flight superstep and the
+        # second unconditional fetch of the popped one.
+        assert any("in-flight" in f.message for f in findings)
+        assert any("unconditional" in f.message for f in findings)
+
+    def test_unbound_dispatch_fetch_flagged(self):
+        # The production dispatch shape binds nothing (the call result
+        # goes straight into the deque) — fetching the in-flight
+        # superstep THROUGH the container must still be a finding.
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        findings = audit_drive_loop(
+            mod.broken_drive_unbound, "fixture.drive"
+        )
+        assert any("in-flight" in f.message for f in findings)
+
+    def test_guard_fetch_flagged(self):
+        # A fetch written as the hit guard's TEST runs every superstep
+        # — it must count as the second unconditional fetch.
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        findings = audit_drive_loop(
+            mod.broken_drive_guard_fetch, "fixture.drive"
+        )
+        assert any("unconditional" in f.message for f in findings)
+
+    def test_clean_drive_passes(self):
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        assert audit_drive_loop(mod.clean_drive, "fixture.drive") == []
+
+    def test_clean_drive_inline_coercion_passes(self):
+        # ``int(np.asarray(out[...])[0])`` is ONE round trip (the inner
+        # asarray); the outer coercion must not be double-counted.
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        assert audit_drive_loop(
+            mod.clean_drive_inline_coercion, "fixture.drive"
+        ) == []
+
+    def test_loop_fetch_flagged(self):
+        # A single fetch call NODE inside a nested loop is N round
+        # trips per superstep — the double-fetch regression written as
+        # a loop must still trip the exactly-one tally.
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        findings = audit_drive_loop(
+            mod.broken_drive_loop_fetch, "fixture.drive"
+        )
+        assert any("unconditional" in f.message for f in findings)
+
+    def test_clean_drive_annotated_passes(self):
+        # A `with` block (profiler annotation) does not gate its body:
+        # the guarded hit fetch nested inside it must stay conditional
+        # instead of being flat-walked into a second unconditional one.
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        assert audit_drive_loop(
+            mod.clean_drive_annotated, "fixture.drive"
+        ) == []
+
+    def test_clean_drive_bound_counters_passes(self):
+        # Binding the fetched counters to a name and subscript-coercing
+        # it (``counters = np.asarray(...); int(counters[0])``) is host
+        # arithmetic after ONE round trip — a valid refactor of the
+        # generator shape, not a double fetch.
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        assert audit_drive_loop(
+            mod.clean_drive_bound_counters, "fixture.drive"
+        ) == []
+
+    def test_block_until_ready_flagged(self, tmp_path):
+        import importlib.util
+        import textwrap
+
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        p = tmp_path / "sync_fx.py"
+        p.write_text(textwrap.dedent(
+            """
+            def synced_drive(pending, call):
+                while pending:
+                    out = pending.popleft()
+                    out['hit_word'].block_until_ready()
+                    ne = int(out['counters'])
+            """
+        ))
+        spec = importlib.util.spec_from_file_location("sync_fx", p)
+        m = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(m)
+        findings = audit_drive_loop(m.synced_drive, "fixture.sync")
+        assert any("block_until_ready" in f.message for f in findings)
+
+    def test_production_drive_loop_is_clean(self):
+        from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        assert audit_drive_loop(
+            Sweep._drive_superstep, "runtime.Sweep._drive_superstep"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
 # Pallas bounds + grid overlap
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,294 @@
+"""Double-buffered superstep pipeline (PERF.md §18): the pipelined drive
+must be STREAM-INVISIBLE next to the barriered drive and the per-launch
+path — hits by full (word_index, rank, candidate) tuples, counts exact —
+across match/suball (fallback interleave), windowed plans, 8-device
+sharding, overflow replay, and mid-superstep resume including the
+cross-path round trip (pipelined → per-launch → pipelined).  Plus the
+``A5GEN_PIPELINE`` escape hatch and the ``--pipeline-ab`` bench record
+shape (slow-marked: it compiles and times a subprocess bench).
+"""
+
+import hashlib
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.runtime import (
+    HitRecorder,
+    Sweep,
+    SweepConfig,
+)
+from tests.test_superstep import (
+    LEET,
+    WORDS,
+    hit_tuples,
+    oracle_lines,
+)
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def run_crack(spec, sub_map, words, digests, *, pipeline, superstep=None,
+              devices=1, **cfg_kw):
+    cfg = SweepConfig(lanes=64, num_blocks=16, superstep=superstep,
+                      pipeline=pipeline, devices=devices, **cfg_kw)
+    sweep = Sweep(spec, sub_map, words, digests, config=cfg)
+    return sweep.run_crack()
+
+
+class TestPipelineParity:
+    """pipelined == barriered == per-launch, bit for bit."""
+
+    @pytest.mark.parametrize("mode", ["default", "suball"])
+    def test_hits_and_counts_equal_across_drives(self, mode):
+        spec = AttackSpec(mode=mode, algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[0], oracle[len(oracle) // 2], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(40)]
+
+        piped = run_crack(spec, LEET, WORDS, digests, pipeline=True)
+        barred = run_crack(spec, LEET, WORDS, digests, pipeline=False)
+        launch = run_crack(spec, LEET, WORDS, digests, pipeline=None,
+                           superstep=0)
+        assert piped.n_emitted == barred.n_emitted == launch.n_emitted
+        assert hit_tuples(piped) == hit_tuples(barred) == hit_tuples(launch)
+        assert {h.candidate for h in piped.hits} == set(planted)
+        assert piped.superstep["pipelined"] == 1
+        assert barred.superstep["pipelined"] == 0
+        assert launch.superstep == {}
+
+    def test_deeper_pipeline_parity(self):
+        # max_in_flight > 2 keeps the pre-§18 dispatch-ahead contract
+        # (one buffer set per in-flight superstep, depth follows the
+        # config) — a depth-3 drive must stay stream-identical to the
+        # barriered one.
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[0]).digest(),
+                   hashlib.md5(oracle[-1]).digest()]
+        deep = run_crack(spec, LEET, WORDS, digests, pipeline=True,
+                         max_in_flight=3)
+        barred = run_crack(spec, LEET, WORDS, digests, pipeline=False)
+        assert deep.superstep["pipelined"] == 1
+        assert deep.n_emitted == barred.n_emitted
+        assert hit_tuples(deep) == hit_tuples(barred)
+
+    def test_suball_fallback_interleave(self):
+        # Oracle-routed hazard words must interleave identically at the
+        # pipeline's LAGGED superstep boundaries.
+        sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+        words = [b"zz", b"acb", b"za", b"zacb", b"azz"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        fb_cand = oracle_lines(spec, sub, [b"acb"])[-1]
+        dev_cand = oracle_lines(spec, sub, [b"azz"])[-1]
+        digests = [hashlib.md5(fb_cand).digest(),
+                   hashlib.md5(dev_cand).digest()]
+
+        cfg = SweepConfig(lanes=64, num_blocks=16, pipeline=True)
+        sweep = Sweep(spec, sub, words, digests, config=cfg)
+        assert sweep.fallback_rows, "fixture must exercise fallback"
+        piped = sweep.run_crack()
+        barred = run_crack(spec, sub, words, digests, pipeline=False)
+        assert hit_tuples(piped) == hit_tuples(barred)
+        assert {h.candidate for h in piped.hits} == {fb_cand, dev_cand}
+
+    def test_windowed_plan_parity(self):
+        spec = AttackSpec(mode="default", algo="md5",
+                          min_substitute=1, max_substitute=1)
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[0]).digest(),
+                   hashlib.md5(oracle[-1]).digest()]
+        cfg = SweepConfig(lanes=64, num_blocks=16, pipeline=True)
+        sweep = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        assert sweep.plan.windowed
+        piped = sweep.run_crack()
+        barred = run_crack(spec, LEET, WORDS, digests, pipeline=False)
+        assert hit_tuples(piped) == hit_tuples(barred)
+        assert piped.n_emitted == barred.n_emitted == len(oracle)
+
+    def test_eight_device_sharded_parity(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[1], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+
+        piped = run_crack(spec, LEET, WORDS, digests, pipeline=True,
+                          devices=8)
+        barred = run_crack(spec, LEET, WORDS, digests, pipeline=False,
+                           devices=8)
+        one = run_crack(spec, LEET, WORDS, digests, pipeline=True)
+        assert hit_tuples(piped) == hit_tuples(barred) == hit_tuples(one)
+        assert piped.n_emitted == barred.n_emitted == one.n_emitted
+        assert piped.superstep["pipelined"] == 1
+
+    def test_overflow_replay_under_pipeline(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, [b"password", b"sesame"])
+        dense = [hashlib.md5(c).digest() for c in oracle[:40]]
+
+        barred = run_crack(spec, LEET, WORDS, dense, pipeline=False,
+                           superstep_hit_cap=8)
+        piped = run_crack(spec, LEET, WORDS, dense, pipeline=True,
+                          superstep_hit_cap=8)
+        assert piped.superstep["replays"] >= 1
+        assert hit_tuples(piped) == hit_tuples(barred)
+        assert piped.n_hits == barred.n_hits == 40
+
+
+class TestPipelineResume:
+    def test_mid_sweep_resume_lands_at_lagged_boundary(self, tmp_path):
+        """A crash with a superstep in flight leaves a checkpoint at the
+        FETCHED (lagged) boundary; resume completes the identical
+        stream — the in-flight superstep's work is simply redone."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[3], oracle[-2]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        want = run_crack(spec, LEET, WORDS, digests, pipeline=True)
+
+        path = str(tmp_path / "pl.json")
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=1,
+                          pipeline=True, checkpoint_path=path,
+                          checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        from hashcat_a5_table_generator_tpu.runtime import load_checkpoint
+
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+        assert partial.cursor.word < len(WORDS)
+
+        second = Sweep(spec, LEET, WORDS, digests, config=cfg)
+        got = second.run_crack()
+        assert got.resumed
+        assert sorted(h.candidate for h in got.hits) == sorted(
+            h.candidate for h in want.hits
+        )
+
+    def test_cross_path_resume_round_trip(self, tmp_path):
+        """pipelined → per-launch → pipelined: a pipelined checkpoint is
+        a plain (word, rank) cursor, resumable by the per-launch path,
+        whose own checkpoint the pipeline can pick back up (the resume
+        round-trip assert in _make_superstep guards the decode)."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[1], oracle[len(oracle) // 2], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        path = str(tmp_path / "cross.json")
+
+        class Boom(Exception):
+            pass
+
+        def exploding(after):
+            class R(HitRecorder):
+                def emit(self, record):
+                    super().emit(record)
+                    if len(self.hits) >= after:
+                        raise Boom()
+            return R()
+
+        cfg_piped = SweepConfig(lanes=64, num_blocks=16, superstep=1,
+                                pipeline=True, checkpoint_path=path,
+                                checkpoint_every_s=0.0)
+        with pytest.raises(Boom):
+            Sweep(spec, LEET, WORDS, digests,
+                  config=cfg_piped).run_crack(exploding(1))
+
+        cfg_launch = SweepConfig(lanes=64, num_blocks=16, superstep=0,
+                                 checkpoint_path=path,
+                                 checkpoint_every_s=0.0)
+        with pytest.raises(Boom):
+            Sweep(spec, LEET, WORDS, digests,
+                  config=cfg_launch).run_crack(exploding(2))
+
+        got = Sweep(spec, LEET, WORDS, digests,
+                    config=cfg_piped).run_crack()
+        assert got.resumed
+        want = run_crack(spec, LEET, WORDS, digests, pipeline=True)
+        assert sorted(h.candidate for h in got.hits) == sorted(
+            h.candidate for h in want.hits
+        )
+        assert {h.candidate for h in got.hits} == set(planted)
+
+
+class TestEscapeHatches:
+    def test_env_off_pins_barriered_drive(self, monkeypatch):
+        monkeypatch.setenv("A5GEN_PIPELINE", "off")
+        spec = AttackSpec(mode="default", algo="md5")
+        digests = [hashlib.md5(b"nope").digest()]
+        res = run_crack(spec, LEET, WORDS, digests, pipeline=None)
+        assert res.superstep["supersteps"] >= 1
+        assert res.superstep["pipelined"] == 0
+
+    def test_env_typo_warns_and_keeps_default(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            pipeline_enabled,
+        )
+
+        monkeypatch.setenv("A5GEN_PIPELINE", "offf")
+        assert pipeline_enabled()
+        assert "A5GEN_PIPELINE" in capsys.readouterr().err
+
+    def test_config_false_pins_barriered_drive(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        digests = [hashlib.md5(b"nope").digest()]
+        res = run_crack(spec, LEET, WORDS, digests, pipeline=False)
+        assert res.superstep["pipelined"] == 0
+
+    def test_single_in_flight_budget_disables_pipeline(self):
+        # max_in_flight=1 forbids dispatch-ahead; auto must degrade to
+        # the barriered drive, stream unchanged.
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[-1]).digest()]
+        res = run_crack(spec, LEET, WORDS, digests, pipeline=None,
+                        max_in_flight=1)
+        assert res.superstep["pipelined"] == 0
+        assert {h.candidate for h in res.hits} == {oracle[-1]}
+
+
+@pytest.mark.slow
+def test_bench_pipeline_ab_record_shape():
+    """The §18 measurement instrument: one JSON line, both arms, the
+    dead-time ratio the acceptance criterion reads.  Slow-marked: it
+    compiles and times a subprocess bench (~1 min on the tier-1 host)."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--pipeline-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "400", "--seconds", "2"],
+        capture_output=True, timeout=240, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "pipeline_host_overhead_ab"
+    for arm in ("barriered", "pipelined"):
+        assert rec[arm]["hashes_per_sec"] > 0
+        assert rec[arm]["launches"] >= 16
+        assert rec[arm]["host_s_per_step"] >= 0
+        assert 0.0 <= rec[arm]["overlap_ratio"] <= 1.0
+    # The barriered arm never overlaps by construction.  The pipelined
+    # arm's dead share undercutting it by the ≤0.5x acceptance bar is a
+    # MEASUREMENT (PERF.md §18b), not a shape invariant — a preempted
+    # host thread can open an un-overlapped gap in a 2 s window, so the
+    # record-shape test only pins that SOME overlap happened.
+    assert rec["barriered"]["overlap_ratio"] == 0.0
+    assert rec["pipelined"]["overlap_ratio"] > 0.0
+    assert rec["host_overhead_ratio"] > 1.0

@@ -15,6 +15,7 @@ CHECKS = (
     "dead-stage",    # stage primitives DCE'd out of the optimized module
     "float-leak",    # float convert_element_type in the integer pipeline
     "host-transfer", # device->host callback inside a compiled body
+    "drive-fetch",   # superstep drive loop breaks fetch discipline (§18)
     "pallas-bounds", # pl.load/pl.store outside the BlockSpec block
     "pallas-race",   # two grid steps write the same output block
     "config",        # registry/harness/budgets-file disagreement

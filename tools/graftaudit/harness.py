@@ -314,7 +314,7 @@ def _superstep_body_config() -> Tuple[Callable, tuple]:
         total_blocks=total_blocks, radix2=_pe.k_opts_for(plan) == 1,
         pieces=pieces,
     )
-    return body, (p, t, d, ss, jnp.int32(0))
+    return body, (p, t, d, ss, jnp.int32(0), _attack.superstep_buffers(32))
 
 
 def _sharded_crack_config() -> Tuple[Callable, tuple]:
@@ -346,7 +346,11 @@ def _sharded_superstep_config() -> Tuple[Callable, tuple]:
         hit_cap=32, total_blocks=total_blocks,
         radix2=_pe.k_opts_for(plan) == 1, pieces=pieces,
     )
-    return step, (p, t, d, ss, np.zeros((1,), np.int32))
+    bufs = {
+        "hit_word": np.full((33,), -1, np.int32),
+        "hit_rank": np.zeros((33,), np.int32),
+    }
+    return step, (p, t, d, ss, np.zeros((1,), np.int32), bufs)
 
 
 def body_configs() -> Dict[str, BodyConfig]:

@@ -1,4 +1,5 @@
-"""Check (c2): no device→host transfers inside compiled sweep bodies.
+"""Check (c2): no device→host transfers inside compiled sweep bodies,
+and fetch discipline in the PIPELINED superstep drive loop.
 
 The launch loop's whole design is "two scalars and two small masks per
 fetch" (honest-sync rule, PERF.md §0/§15): a callback smuggled into a
@@ -8,11 +9,26 @@ invocation*, and inside a ``lax.scan``/``while_loop`` body it fires per
 STEP, turning the superstep executor's one-fetch-per-superstep contract
 into S hidden syncs.  graftlint GL011 catches the lexical ``int()``/
 ``.item()`` forms; this audit catches what only the trace can see.
+
+The second half (:func:`audit_drive_loop`) audits the HOST side of the
+same contract for the double-buffered drive (PERF.md §18): the drive
+loop must issue exactly ONE unconditional device→host fetch per
+superstep (the stacked counters of the POPPED, i.e. oldest, in-flight
+superstep — its lagged completion barrier), may fetch the hit buffers
+only behind a hit-count guard, must never fetch a result dispatched in
+the same iteration's fill loop (that would barrier the IN-FLIGHT
+superstep and undo the overlap), and must never call
+``block_until_ready``.  A second unconditional fetch is the classic
+double-fetch regression — it turns the pipeline back into a barrier
+without failing a single parity test.
 """
 
 from __future__ import annotations
 
-from typing import List, Tuple
+import ast
+import inspect
+import textwrap
+from typing import List, Set, Tuple
 
 from .findings import AuditFinding
 
@@ -65,6 +81,273 @@ def audit_host_transfers(fn, args, entry: str) -> List[AuditFinding]:
             )
         ]
     return audit_host_transfers_jaxpr(closed.jaxpr, entry)
+
+
+#: Call shapes that coerce a device value to the host: builtins applied
+#: to (derivatives of) a fetched result, numpy/jax coercions, and the
+#: explicit sync.
+_FETCH_BUILTINS = frozenset({"int", "float", "bool"})
+_FETCH_ATTRS = frozenset({"asarray", "array", "item", "device_get"})
+
+
+def _base_names(node: ast.AST) -> Set[str]:
+    """Every bare Name referenced under ``node``."""
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _assigned_names(target: ast.AST) -> Set[str]:
+    """Names bound by an assignment target (tuples included)."""
+    return {
+        n.id
+        for n in ast.walk(target)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+
+
+def _is_fetch_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id in _FETCH_BUILTINS
+    if isinstance(f, ast.Attribute):
+        return f.attr in _FETCH_ATTRS
+    return False
+
+
+def audit_drive_loop(fn, entry: str) -> List[AuditFinding]:
+    """Statically audit a superstep drive loop's fetch discipline.
+
+    Walks ``fn``'s outermost ``while`` loop: names bound from a
+    ``.popleft()`` (and anything derived from them) are the FETCHED
+    superstep — the only sanctioned fetch target; names bound inside the
+    nested dispatch (fill) ``while`` are IN-FLIGHT and must never be
+    coerced to the host.  Exactly one unconditional fetch of the popped
+    result per iteration (the counters barrier); any other fetch must
+    sit under an ``if`` (the rare hit-slice path).  ``block_until_ready``
+    anywhere in the function is a finding on its own.
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"drive loop source unavailable for fetch audit: {exc}",
+            )
+        ]
+    findings: List[AuditFinding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "block_until_ready"
+        ):
+            findings.append(
+                AuditFinding(
+                    "drive-fetch", entry,
+                    "block_until_ready in the superstep drive loop — a "
+                    "sync on the in-flight buffer set barriers the "
+                    "pipeline (PERF.md §18); the popped counters fetch "
+                    "is the only sanctioned barrier",
+                )
+            )
+    fdef = next(
+        (n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)), None
+    )
+    outer = next(
+        (n for n in (fdef.body if fdef else []) if isinstance(n, ast.While)),
+        None,
+    )
+    if outer is None:
+        findings.append(
+            AuditFinding(
+                "config", entry,
+                "drive loop has no top-level while loop to audit",
+            )
+        )
+        return findings
+
+    popped: Set[str] = set()
+    inflight: Set[str] = set()
+    for stmt in ast.walk(outer):
+        if isinstance(stmt, ast.Assign):
+            val = stmt.value
+            if (
+                isinstance(val, ast.Call)
+                and isinstance(val.func, ast.Attribute)
+                and val.func.attr == "popleft"
+            ):
+                for t in stmt.targets:
+                    popped |= _assigned_names(t)
+    # Derived names: assignments whose value mentions a popped name.
+    changed = True
+    while changed:
+        changed = False
+        for stmt in ast.walk(outer):
+            if isinstance(stmt, ast.Assign):
+                if _base_names(stmt.value) & popped:
+                    new = set()
+                    for t in stmt.targets:
+                        new |= _assigned_names(t)
+                    if new - popped:
+                        popped |= new
+                        changed = True
+    inner = next(
+        (n for n in outer.body if isinstance(n, ast.While)), None
+    )
+    if inner is not None:
+        for stmt in ast.walk(inner):
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    inflight |= _assigned_names(t)
+            # The production dispatch binds nothing: it appends the call
+            # result straight into the pending deque.  The CONTAINER is
+            # then the in-flight handle — a fetch through it (e.g.
+            # ``int(pending[-1][1][...])``) barriers the pipeline just
+            # as surely as a fetch of a named result.
+            if (
+                isinstance(stmt, ast.Call)
+                and isinstance(stmt.func, ast.Attribute)
+                and stmt.func.attr in ("append", "appendleft")
+                and isinstance(stmt.func.value, ast.Name)
+            ):
+                inflight.add(stmt.func.value.id)
+    # Aliases of in-flight values bound in the OUTER body (e.g.
+    # ``fut = pending[-1]``) are in-flight too; the popped names stay
+    # sanctioned (``out = pending.popleft()`` mentions the container but
+    # binds the fetched-superstep result).
+    changed = True
+    while changed:
+        changed = False
+        for stmt in ast.walk(outer):
+            if isinstance(stmt, ast.Assign):
+                if _base_names(stmt.value) & inflight:
+                    new = set()
+                    for t in stmt.targets:
+                        new |= _assigned_names(t)
+                    if new - popped - inflight:
+                        inflight |= new - popped
+                        changed = True
+    inflight -= popped
+    # Names bound DIRECTLY from a fetch call (``counters =
+    # np.asarray(out["counters"])``) hold host-materialized values: a
+    # later subscript coercion of them (``int(counters[0])``) is host
+    # arithmetic, not another device round trip — the binding fetch is
+    # the one that counts.  Plain re-bindings inherit the property.
+    hostside: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for stmt in ast.walk(outer):
+            if isinstance(stmt, ast.Assign):
+                val = stmt.value
+                bases = _base_names(val)
+                if (
+                    isinstance(val, ast.Call) and _is_fetch_call(val)
+                ) or (bases and bases <= hostside):
+                    new = set()
+                    for t in stmt.targets:
+                        new |= _assigned_names(t)
+                    if new - hostside:
+                        hostside |= new
+                        changed = True
+
+    def fetch_nodes(node, conditional: bool, looped: bool):
+        out = []
+        for sub in ast.walk(node):
+            if _is_fetch_call(sub):
+                names = set()
+                for arg in sub.args:
+                    names |= _base_names(arg)
+                out.append((sub, names, conditional, looped))
+        return out
+
+    def fetches_in(stmts, conditional: bool, looped: bool = False):
+        out = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.If, ast.For, ast.While)):
+                # The TEST runs every iteration — a fetch written as a
+                # condition (``if int(out["n_hits"]):``) is as
+                # unconditional as a bare statement.
+                test = getattr(stmt, "test", getattr(stmt, "iter", None))
+                if test is not None:
+                    out += fetch_nodes(test, conditional, looped)
+                cond = conditional or isinstance(stmt, ast.If)
+                # A nested loop's body runs per-iteration: ONE fetch
+                # call node there is MANY round trips per superstep —
+                # the double-fetch regression written as a loop.
+                loop = looped or not isinstance(stmt, ast.If)
+                out += fetches_in(stmt.body, cond, loop)
+                out += fetches_in(stmt.orelse, cond, looped)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                # A context manager (profiler annotation, lock) does not
+                # gate its body — guards nested INSIDE it must keep
+                # their conditionality instead of being walked flat.
+                for item in stmt.items:
+                    out += fetch_nodes(item.context_expr, conditional,
+                                       looped)
+                out += fetches_in(stmt.body, conditional, looped)
+                continue
+            if isinstance(stmt, ast.Try):
+                out += fetches_in(stmt.body, conditional, looped)
+                for h in stmt.handlers:
+                    out += fetches_in(h.body, True, looped)
+                out += fetches_in(stmt.orelse, True, looped)
+                out += fetches_in(stmt.finalbody, conditional, looped)
+                continue
+            out += fetch_nodes(stmt, conditional, looped)
+        return out
+
+    unconditional_popped = 0
+    for node, names, conditional, looped in fetches_in(outer.body, False):
+        if names & inflight:
+            findings.append(
+                AuditFinding(
+                    "drive-fetch", entry,
+                    "device→host fetch of a just-dispatched (in-flight) "
+                    "superstep's result — barriers the pipeline's "
+                    "overlap; only the POPPED superstep may be fetched "
+                    "(PERF.md §18)",
+                )
+            )
+        elif names & popped:
+            if isinstance(node.func, ast.Name):
+                # int()/float() on a popped DERIVATIVE (an already-
+                # fetched numpy value) is host arithmetic, not a new
+                # device round trip; only the coercion landing directly
+                # on the device result counts.  An arg that CONTAINS a
+                # fetch call (``int(np.asarray(out[...])[0])``) is the
+                # inline spelling of the bound form — the inner call is
+                # the round trip and is counted on its own.
+                direct = any(
+                    isinstance(a, ast.Subscript)
+                    and _base_names(a) & popped
+                    and not _base_names(a) <= hostside
+                    and not any(_is_fetch_call(s) for s in ast.walk(a))
+                    for a in node.args
+                )
+                if not direct:
+                    continue
+            if not conditional:
+                # Inside a nested loop one call NODE is N executions —
+                # count it as (at least) two round trips so the
+                # exactly-one tally trips.
+                unconditional_popped += 2 if looped else 1
+    if unconditional_popped != 1:
+        findings.append(
+            AuditFinding(
+                "drive-fetch", entry,
+                f"{unconditional_popped} unconditional device→host "
+                "fetches of the popped superstep per iteration (want "
+                "exactly one — the stacked counters barrier; hit-buffer "
+                "fetches belong behind the hit-count guard). A second "
+                "unconditional fetch is the double-fetch regression "
+                "(PERF.md §18)",
+            )
+        )
+    return findings
 
 
 def audit_host_transfers_jaxpr(jaxpr, entry: str) -> List[AuditFinding]:
