@@ -209,6 +209,20 @@ def build_parser() -> argparse.ArgumentParser:
                          "overlap ratio (device busy during the gap), and "
                          "wall — one JSON line. Defaults to the §4c CPU "
                          "peak geometry like --superstep-ab")
+    ap.add_argument("--stream-ab", action="store_true",
+                    help="measure streaming chunked plan compilation "
+                         "against whole-dictionary materialization on "
+                         "the production crack contract (PERF.md §19): "
+                         "time-to-first-candidate, chunk-compile "
+                         "overlap ratio, peak resident plan bytes, and "
+                         "wall for both arms — one JSON line. The "
+                         "streaming arm chunks --words into >= 4 chunks; "
+                         "defaults to the §4c CPU peak geometry like "
+                         "--superstep-ab")
+    ap.add_argument("--stream-chunks", type=int, default=4,
+                    help="--stream-ab: chunk count the streaming arm "
+                         "splits --words into (default 4 — the minimum "
+                         "the §19 overlap criterion is stated at)")
     ap.add_argument("--stride-ab", action="store_true",
                     help="measure block stride 128 vs 256 x emission "
                          "scheme perslot vs bytescan (A5GEN_EMIT arms) "
@@ -542,6 +556,139 @@ def run_pipeline_ab(args: argparse.Namespace) -> None:
             barriered["dead_s_per_step"]
             / max(pipelined["dead_s_per_step"], 1e-12)
         ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+# --------------------------------------------------------- streaming A/B --
+
+
+class _TtfcProbe:
+    """Minimal progress reporter capturing the wall-clock of the FIRST
+    drive update — the sweep runtime reports progress at every drain
+    (counters fetch), so the first update IS time-to-first-candidate
+    under the same definition for both arms (streaming reports the same
+    instant in ``SweepResult.stream['ttfc_s']``; the whole arm has no
+    stream stats, hence this probe)."""
+
+    def __init__(self) -> None:
+        self.first: "float | None" = None
+
+    def seed_emitted(self, n: int) -> None:
+        pass
+
+    def update(self, **kw) -> None:
+        if self.first is None:
+            self.first = time.perf_counter()
+
+    def final(self, **kw) -> None:
+        pass
+
+
+def run_stream_ab(args: argparse.Namespace) -> None:
+    """A/B streaming chunked ingestion against whole-dictionary plan
+    materialization (PERF.md §19) on the production crack contract: the
+    same wordlist × table × decoy digests swept end-to-end through
+    ``Sweep.run_crack`` twice — whole (one plan + schema compile before
+    any launch) vs streaming (``--stream-chunks`` chunks, worker-thread
+    compile overlapped with the device sweep).  Reports per-arm wall,
+    hashes/s, and time-to-first-candidate, plus the streaming arm's
+    compile-overlap ratio and peak resident plan bytes, and asserts the
+    two arms emitted identical candidate counts (byte parity proper is
+    the test suite's job; the bench must still refuse to time diverging
+    arms).  Prints ONE JSON line."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+    from hashcat_a5_table_generator_tpu.runtime.sweep import (
+        Sweep,
+        SweepConfig,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    if lanes % nb:
+        raise SystemExit("--stream-ab needs blocks dividing lanes")
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout(args.table).to_substitution_map()
+    words = synth_wordlist(args.words)
+    host_digest = HOST_DIGEST[spec.algo]
+    digests = [
+        host_digest(b"bench-decoy-%d" % i) for i in range(1024)
+    ]
+    n_chunks = max(2, int(args.stream_chunks))
+    chunk_words = max(1, -(-args.words // n_chunks))
+
+    def arm(stream: bool) -> dict:
+        probe = _TtfcProbe()
+        cfg = SweepConfig(
+            lanes=lanes, num_blocks=nb,
+            stream_chunk_words=(chunk_words if stream else "off"),
+            progress=probe,
+        )
+        t0 = time.perf_counter()
+        sweep = Sweep(spec, sub_map, words, digests, config=cfg)
+        res = sweep.run_crack(resume=False)
+        wall = time.perf_counter() - t0
+        rec = {
+            "wall_s": wall,
+            "hashes_per_sec": res.n_emitted / max(res.wall_s, 1e-9),
+            "n_emitted": res.n_emitted,
+            # From Sweep construction: the whole arm's plan + schema
+            # compile and the streaming arm's prescan + first chunk
+            # both count — the user-visible time to first results.
+            "ttfc_s": (
+                probe.first - t0 if probe.first is not None else wall
+            ),
+            "supersteps": res.superstep.get("supersteps", 0),
+        }
+        if stream:
+            rec["stream"] = dict(res.stream)
+        return rec
+
+    whole = arm(stream=False)
+    streaming = arm(stream=True)
+    if streaming["n_emitted"] != whole["n_emitted"]:
+        raise SystemExit(
+            f"--stream-ab arms diverged: streaming emitted "
+            f"{streaming['n_emitted']}, whole {whole['n_emitted']} — "
+            "refusing to report timings for non-identical work"
+        )
+    st = streaming["stream"]
+    record = {
+        "metric": "stream_ingestion_ab",
+        "unit": "seconds (ttfc, compile overlap) + hashes/sec",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "chunk_words": chunk_words,
+        "chunks": st.get("chunks", 0),
+        "whole": whole,
+        "streaming": streaming,
+        # The §19 acceptance instruments: ttfc against the whole arm
+        # and against one chunk's compile (the <= 1.5x bar), and the
+        # share of chunk-compile wall hidden behind the device sweep
+        # (the >= 70% bar at >= 4 chunks).
+        "ttfc_ratio": streaming["ttfc_s"] / max(whole["ttfc_s"], 1e-9),
+        "ttfc_vs_chunk_compile": (
+            streaming["ttfc_s"]
+            / max(st.get("first_chunk_compile_s", 0.0), 1e-9)
+        ),
+        "overlap_ratio": st.get("overlap_ratio", 0.0),
+        "steady_overlap_ratio": st.get("steady_overlap_ratio", 0.0),
+        "peak_resident_plan_bytes": st.get(
+            "peak_resident_plan_bytes", 0
+        ),
+        "chunk_bytes_max": st.get("chunk_bytes_max", 0),
     }
     print(json.dumps(record))
     sys.stdout.flush()
@@ -1398,10 +1545,15 @@ def main() -> None:
         # explicit --lanes is honored by all.
         args.lanes = (
             2048
-            if (args.superstep_ab or args.stride_ab or args.pipeline_ab)
+            if (args.superstep_ab or args.stride_ab or args.pipeline_ab
+                or args.stream_ab)
             else (1 << 22)
         )
-    if args.pipeline_ab:
+    if args.stream_ab:
+        # Streaming-ingestion A/B (PERF.md §19); runs on the pinned (or
+        # default) platform in-process.
+        run_stream_ab(args)
+    elif args.pipeline_ab:
         run_pipeline_ab(args)
     elif args.stride_ab:
         # Focused stride/emission A/B (PERF.md §7a lever 2 / §17); runs

@@ -153,6 +153,29 @@ def build_parser() -> argparse.ArgumentParser:
                          "pipeline (A5GEN_SUPERSTEP=off is the env "
                          "equivalent). The candidate/hit streams are "
                          "identical either way")
+    ap.add_argument("--stream-chunk-words", type=_stream_chunk_arg,
+                    default="auto", metavar="N|auto|off",
+                    help="device backend: compile the dictionary's plan "
+                         "in word CHUNKS on a host worker thread while "
+                         "the device sweeps the previous chunk, freeing "
+                         "consumed chunks — resident plan memory stays "
+                         "O(chunk) at any dictionary size, and time-to-"
+                         "first-candidate drops to one chunk's schema "
+                         "compile plus a light whole-dictionary prescan "
+                         "(PERF.md §19). 'auto' (default) engages "
+                         "when the dictionary spans more than one "
+                         "~64 MB-of-plan chunk; 'off' always "
+                         "materializes the whole plan "
+                         "(A5GEN_STREAM=off is the env equivalent); N "
+                         "chunks at N words. The candidate/hit streams "
+                         "and checkpoints are identical either way")
+    ap.add_argument("--schema-cache", metavar="DIR",
+                    help="device backend: persist compiled per-slot "
+                         "piece schemas under DIR (keyed by wordlist x "
+                         "table digest + format version), so repeat "
+                         "sweeps of the same inputs skip schema "
+                         "compilation (A5GEN_SCHEMA_CACHE is the env "
+                         "equivalent)")
     ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
                     default="auto",
                     help="variant-block layout: 'packed' = tightly-packed "
@@ -248,6 +271,23 @@ def _superstep_arg(value: str):
         return None
     if value == "off":
         return 0
+    try:
+        n = int(value)
+        if n < 1:
+            raise ValueError
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            f"must be a positive integer, 'auto', or 'off', got {value!r}"
+        )
+    return n
+
+
+def _stream_chunk_arg(value: str):
+    """--stream-chunk-words: 'auto' (engage when the dictionary spans
+    >1 auto-sized chunk), 'off' (always whole-dictionary), or a positive
+    chunk word count."""
+    if value in ("auto", "off"):
+        return value
     try:
         n = int(value)
         if n < 1:
@@ -617,6 +657,23 @@ def _print_superstep(res) -> None:
     )
 
 
+def _print_stream(res) -> None:
+    """Streaming-ingestion summary (stderr): chunks swept, compile
+    overlap, peak resident plan bytes — the instruments behind the §19
+    acceptance numbers.  Silent when the whole-dictionary path ran."""
+    s = getattr(res, "stream", None) or {}
+    if not s.get("chunks_swept"):
+        return
+    print(
+        f"{PROG}: stream: {s['chunks_swept']}/{s.get('chunks', 0)} chunks "
+        f"x {s.get('chunk_words', 0)} words, "
+        f"{100.0 * s.get('overlap_ratio', 0.0):.0f}% compile overlapped, "
+        f"peak plan {s.get('peak_resident_plan_bytes', 0) / 1e6:.1f} MB "
+        f"(ttfc {s.get('ttfc_s', 0.0):.2f}s)",
+        file=sys.stderr,
+    )
+
+
 def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
                       label: str, retry_notice: str = ""):
     """Elastic recovery (SURVEY.md §5): candidate generation is pure and
@@ -758,6 +815,8 @@ def _run_device(args, sub_map, packed) -> int:
         num_blocks=args.blocks,
         devices=args.devices,
         superstep=args.superstep,
+        stream_chunk_words=args.stream_chunk_words,
+        schema_cache=args.schema_cache,
         **cfg_kw,
         packed_blocks={"auto": None, "packed": True, "stride": False}[
             args.block_layout
@@ -829,6 +888,7 @@ def _run_device(args, sub_map, packed) -> int:
                 )
             _print_routing(res)
             _print_superstep(res)
+            _print_stream(res)
             _maybe_exit_pod_local(args, nprocs)
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
@@ -868,6 +928,7 @@ def _run_device(args, sub_map, packed) -> int:
                     ),
                 )
                 _print_routing(res)
+                _print_stream(res)
     _maybe_exit_pod_local(args, nprocs)
     return 0
 

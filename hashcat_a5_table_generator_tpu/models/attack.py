@@ -253,7 +253,9 @@ def piece_arrays(pieces) -> Dict[str, jnp.ndarray]:
     and the XLA splice prep launches with row gathers only."""
     if pieces is None:
         return {}
-    out = {"pp_pl": jnp.asarray(pieces.gl)}
+    out = {}
+    if pieces.gl is not None:
+        out["pp_pl"] = jnp.asarray(pieces.gl)
     if pieces.gw is not None:
         out["pp_pw"] = jnp.asarray(pieces.gw)
     if pieces.gw16 is not None:
@@ -412,7 +414,8 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
     return body
 
 
-def superstep_arrays(plan: Plan, stride: int) -> "ArrayTree | None":
+def superstep_arrays(plan: Plan, stride: int,
+                     idx: "tuple | None" = None) -> "ArrayTree | None":
     """Device copies of the fixed-stride block index for the superstep
     executor's ON-DEVICE block cutter (``ops.blocks.superstep_index``
     narrowed to int32), shipped ONCE per sweep like ``plan_arrays``:
@@ -422,21 +425,31 @@ def superstep_arrays(plan: Plan, stride: int) -> "ArrayTree | None":
     * ``totals`` int32 [B] — per-word variant totals,
     * ``radix`` int32 [B, P] — per-slot radices for the device-side
       mixed-radix base decompose (unused by windowed plans, whose block
-      bases are scalar ranks).
+      bases are scalar ranks),
+    * ``total`` int32 [] — the sweep's block count, carried as DATA so
+      sweeps of different sizes (streaming chunks, PERF.md §19) share
+      one compiled superstep program instead of baking the bound into
+      the trace.
 
     Returns None when the plan cannot be cut in int32 on device (huge
     words / cursor overflow) — callers then keep the per-launch path.
+    ``idx``: a precomputed ``ops.blocks.superstep_index`` result, so a
+    caller that already built the host index (the sweep runtime — per
+    CHUNK on the streaming worker thread) doesn't pay the O(batch)
+    cumulative build twice.
     """
     from ..ops.blocks import superstep_index
 
-    idx = superstep_index(plan, stride)
+    if idx is None:
+        idx = superstep_index(plan, stride)
     if idx is None:
         return None
-    cum, totals, _total_blocks = idx
+    cum, totals, total_blocks = idx
     return {
         "cum": jnp.asarray(cum),
         "totals": jnp.asarray(totals),
         "radix": jnp.asarray(np.asarray(plan.pat_radix, dtype=np.int32)),
+        "total": jnp.asarray(np.int32(total_blocks)),
     }
 
 
@@ -490,9 +503,12 @@ def make_superstep_body(
     ``step_advance``: global blocks consumed per scan step —
     ``num_blocks`` on one device, ``num_blocks * n_devices`` under the
     sharded executor (every device advances past the whole launch).
-    ``total_blocks`` (static): blocks in the sweep; the tail superstep's
+    ``total_blocks``: blocks in the sweep; the tail superstep's
     out-of-range blocks cut zero-count (fully masked) blocks, so no tail
-    special-casing exists anywhere.
+    special-casing exists anywhere.  When the ``ss`` tree carries the
+    bound as data (``ss["total"]``, the post-§19 contract) this static
+    value is only a fallback — sweeps of different length then share one
+    compiled program (streaming chunk plans).
     """
     lane_body = make_fused_lane_body(
         spec, num_lanes=num_lanes, out_width=out_width,
@@ -516,7 +532,12 @@ def make_superstep_body(
         # Blocks past the sweep's end keep count 0 (their lanes fail the
         # rank < count test, like pad_batch's padding); the where also
         # discards the wrapped int32 products out-of-range blocks compute.
-        valid = b < jnp.int32(total_blocks)
+        # The bound rides the ss tree as DATA (``superstep_arrays``), so
+        # different-size sweeps — streaming chunks — reuse one compiled
+        # program; ``total_blocks`` stays the static fallback for direct
+        # callers with pre-§19 ss trees.
+        tot = ss.get("total")
+        valid = b < (jnp.int32(total_blocks) if tot is None else tot)
         rank0 = jnp.where(valid, (b - cum[w]) * jnp.int32(stride), 0)
         count = jnp.where(
             valid, jnp.clip(totals[w] - rank0, 0, stride), 0

@@ -316,19 +316,26 @@ def unrank_windowed(
     return digits
 
 
-def windowed_plan_fields(
+def windowed_chunk_terms(
     radix_matrix: np.ndarray,
     n_variants: List[int],
     min_substitute: "int | None",
     max_substitute: "int | None",
     zero_mask: "np.ndarray | None" = None,
-) -> "Tuple[bool, np.ndarray | None, List[int]]":
-    """Shared windowed-enumeration eligibility + table construction for both
-    plan builders: bounds check, suffix-count DP, 2x lane-saving gate.
+) -> "Tuple[bool, np.ndarray | None, List[int] | None, int, int]":
+    """The batch-ADDITIVE terms of the windowed-enumeration decision:
+    ``(eligible, win_v, win_totals, sum_win, sum_full)``.
 
-    ``zero_mask`` marks words whose totals are forced to 0 (suball's
-    oracle-routed hazard words). Returns ``(windowed, win_v, n_variants)``
-    — unchanged inputs when ineligible.
+    ONE implementation serves both consumers: ``windowed_plan_fields``
+    votes ``eligible and windowed_gate(sum_win, sum_full)`` over a whole
+    batch, and the streaming prescan (``Sweep._stream_prescan``,
+    PERF.md §19) accumulates the sums chunk by chunk and votes the
+    identical way over their totals — the decision MUST be computed by
+    the same code or streaming and whole-dictionary runs could pick
+    different enumeration schemes for the same inputs (different
+    fingerprints, renumbered ranks).  ``eligible`` is False on an
+    out-of-bounds window or an int32-overflowing per-word DP (per-word
+    properties, so chunk-wise conjunction equals the whole-batch test).
     """
     if (
         min_substitute is None
@@ -336,14 +343,66 @@ def windowed_plan_fields(
         or not 0 <= min_substitute <= max_substitute <= WINDOWED_MAX_SUBST
         or radix_matrix.shape[0] == 0
     ):
-        return False, None, n_variants
+        return False, None, None, 0, 0
     v, totals = _windowed_tables(radix_matrix, min_substitute, max_substitute)
     if v is None:
-        return False, None, n_variants
+        return False, None, None, 0, 0
     if zero_mask is not None:
         totals = [0 if zero_mask[i] else t for i, t in enumerate(totals)]
     full = sum(min(t, 1 << 62) for t in n_variants)
-    if sum(totals) * 2 > full:
+    return True, v, totals, sum(totals), full
+
+
+def windowed_gate(sum_win: int, sum_full: int) -> bool:
+    """The 2x-lane-saving vote: windowed enumeration engages only when
+    it at least halves the lane count.  The one place the threshold
+    lives (see :func:`windowed_chunk_terms`)."""
+    return sum_win * 2 <= sum_full
+
+
+def windowed_plan_fields(
+    radix_matrix: np.ndarray,
+    n_variants: List[int],
+    min_substitute: "int | None",
+    max_substitute: "int | None",
+    zero_mask: "np.ndarray | None" = None,
+    force: "bool | None" = None,
+) -> "Tuple[bool, np.ndarray | None, List[int]]":
+    """Shared windowed-enumeration eligibility + table construction for both
+    plan builders: bounds check, suffix-count DP, 2x lane-saving gate
+    (all via :func:`windowed_chunk_terms` — the streaming prescan votes
+    with the same terms).
+
+    ``zero_mask`` marks words whose totals are forced to 0 (suball's
+    oracle-routed hazard words). Returns ``(windowed, win_v, n_variants)``
+    — unchanged inputs when ineligible.
+
+    ``force`` pins the decision instead of deciding it here: the
+    2x-lane-saving gate is a BATCH-level property, so a streaming sweep
+    (which sees one chunk at a time) decides once over the whole
+    dictionary and forces every chunk plan the same way — rank numbering
+    must be chunk-invariant (PERF.md §19).  ``False`` = full enumeration
+    unconditionally; ``True`` = windowed, skipping only the saving gate
+    (the eligibility bounds still apply — the caller guaranteed them
+    globally, and a violated bound here is a caller bug worth raising
+    on).
+    """
+    if force is False:
+        return False, None, n_variants
+    eligible, v, totals, sum_win, sum_full = windowed_chunk_terms(
+        radix_matrix, n_variants, min_substitute, max_substitute,
+        zero_mask=zero_mask,
+    )
+    if not eligible:
+        if force:
+            raise ValueError(
+                "force_windowed=True but this batch is not windowed-"
+                f"eligible (window [{min_substitute}, {max_substitute}] "
+                "out of bounds, or a word's windowed total overflows the "
+                "int32 cursor budget)"
+            )
+        return False, None, n_variants
+    if force is None and not windowed_gate(sum_win, sum_full):
         return False, None, n_variants
     return True, v, totals
 
@@ -396,6 +455,7 @@ def build_match_plan(
     out_width: int | None = None,
     min_substitute: int | None = None,
     max_substitute: int | None = None,
+    force_windowed: bool | None = None,
 ) -> MatchPlan:
     """Host-side plan construction for default (``first_option_only=False``)
     or reverse (``True``) mode.
@@ -406,6 +466,10 @@ def build_match_plan(
     saving over full enumeration), the plan switches to count-windowed
     enumeration: ranks walk only in-window digit vectors via the ``win_v``
     DP instead of masking the full mixed-radix space.
+
+    ``force_windowed`` pins the enumeration scheme (streaming chunk
+    plans: the scheme is a batch-level decision the streaming sweep
+    makes once over the whole dictionary; see ``windowed_plan_fields``).
     """
     b, width = packed.tokens.shape
 
@@ -451,7 +515,8 @@ def build_match_plan(
         out_width = rounded_out_width(width, max_delta)
 
     windowed, win_v, n_variants = windowed_plan_fields(
-        match_radix, n_variants, min_substitute, max_substitute
+        match_radix, n_variants, min_substitute, max_substitute,
+        force=force_windowed,
     )
 
     return MatchPlan(
@@ -535,12 +600,16 @@ def lane_fields(
 
 def piece_device_tables(pieces) -> dict:
     """Device copies of a :class:`ops.packing.PieceSchema`'s data tables
-    for :func:`splice_pieces`: ``pl`` uint8 [B, NG, V] lengths, plus
-    ``pw`` uint32 [B, NG, V, NW] and/or ``pw16`` uint16 [B, NG16, VM]
-    variant words when present — the same optional-key layout as
-    ``models.attack.piece_arrays`` strips into ``piece_tables``, as the
-    trace-time-constant fallback for direct calls and tests."""
-    tabs = {"pl": jnp.asarray(pieces.gl)}
+    for :func:`splice_pieces`: ``pl`` uint8 [B, NGD, V] dynamic-group
+    lengths (absent for all-fixed schemas — their lengths are static),
+    plus ``pw`` uint32 [B, NG, V, NW] and/or ``pw16`` uint16
+    [B, NG16, VM] variant words when present — the same optional-key
+    layout as ``models.attack.piece_arrays`` strips into
+    ``piece_tables``, as the trace-time-constant fallback for direct
+    calls and tests."""
+    tabs = {}
+    if pieces.gl is not None:
+        tabs["pl"] = jnp.asarray(pieces.gl)
     if pieces.gw is not None:
         tabs["pw"] = jnp.asarray(pieces.gw)
     if pieces.gw16 is not None:
@@ -572,7 +641,9 @@ def splice_pieces(schema, tables, field, col_variant, *, n, out_width):
     out = jnp.zeros((n, out_width), jnp.uint8)
     cum_static = 0
     cum = None  # dynamic offset once any group's length varies
-    pl = tables["pl"]
+    # ``pl`` ships only the DYNAMIC groups' rows (``grp.gl_idx``); an
+    # all-fixed schema ships none (PERF.md §19).
+    pl = tables.get("pl")
     pw = tables.get("pw")
     pw16 = tables.get("pw16")
     for gi, grp in enumerate(schema.groups):
@@ -611,7 +682,8 @@ def splice_pieces(schema, tables, field, col_variant, *, n, out_width):
         l = grp.len_fixed
         if l is None:
             l = pick([
-                field(pl[:, gi, v]).astype(jnp.int32) for v in range(n_var)
+                field(pl[:, grp.gl_idx, v]).astype(jnp.int32)
+                for v in range(n_var)
             ])
         off = cum_static if cum is None else cum
         # Place the selected bytes: piece byte bi lands at output column

@@ -325,6 +325,7 @@ def _build_suball_plan_fast(
     out_width: "int | None",
     min_substitute: "int | None",
     max_substitute: "int | None",
+    force_windowed: "bool | None" = None,
 ) -> "SubAllPlan | None":
     """Vectorized plan construction for every table WITHOUT an empty key
     (the ``=x`` line routes all words to the oracle — rare and cheap, so
@@ -540,7 +541,7 @@ def _build_suball_plan_fast(
 
     windowed, win_v, n_variants = windowed_plan_fields(
         pat_radix, n_variants, min_substitute, max_substitute,
-        zero_mask=fallback_mask,
+        zero_mask=fallback_mask, force=force_windowed,
     )
     return SubAllPlan(
         tokens=packed.tokens,
@@ -573,6 +574,7 @@ def build_suball_plan(
     out_width: int | None = None,
     min_substitute: int | None = None,
     max_substitute: int | None = None,
+    force_windowed: bool | None = None,
 ) -> SubAllPlan:
     """Host-side plan construction (numpy + bytes.find; the C++ packer will
     take this over for the file-to-plan hot path).
@@ -585,7 +587,7 @@ def build_suball_plan(
     fast = _build_suball_plan_fast(
         ct, packed, first_option_only=first_option_only,
         out_width=out_width, min_substitute=min_substitute,
-        max_substitute=max_substitute,
+        max_substitute=max_substitute, force_windowed=force_windowed,
     )
     if fast is not None:
         return fast
@@ -734,7 +736,7 @@ def build_suball_plan(
     # full-enumeration convention above.
     windowed, win_v, n_variants = windowed_plan_fields(
         pat_radix, n_variants, min_substitute, max_substitute,
-        zero_mask=fallback_mask,
+        zero_mask=fallback_mask, force=force_windowed,
     )
 
     return SubAllPlan(

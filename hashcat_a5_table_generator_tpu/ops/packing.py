@@ -250,6 +250,10 @@ class PieceGroup:
     row ``tab_idx`` of the u16 ``gw16`` table (single-word groups whose
     every variant fits 2 bytes; halves their VMEM footprint) or of the
     u32 ``gw`` table (everything else).
+    ``gl_idx``: the group's row in the sliced ``gl`` length table —
+    meaningful only for dynamic-length groups (``len_fixed is None``);
+    fixed-length groups never read a length row, so the table ships only
+    the dynamic rows (the gw/gw16 split applied to lengths, PERF.md §19).
     """
 
     sel_cols: Tuple[int, ...]
@@ -261,6 +265,7 @@ class PieceGroup:
     len_fixed: Optional[int] = None
     packed16: bool = False
     tab_idx: int = 0
+    gl_idx: int = 0
 
 
 @dataclass(frozen=True)
@@ -273,8 +278,11 @@ class PieceSchema:
       u16), ``gw16`` uint16 [B, NG16, VM] — narrow single-word groups
       whose every variant fits 2 bytes (``None`` when no group
       qualifies; the per-group ``packed16`` gate, PERF.md §18),
-      ``gl`` uint8 [B, NG, VM] — placed byte lengths (all groups, in
-      emission order).
+      ``gl`` uint8 [B, NGD, VM] — placed byte lengths of the
+      DYNAMIC-length groups only, in emission order (fixed-length
+      groups fold their length into the static prefix offset and never
+      read a row; ``None`` when every group is fixed — all-fixed
+      schemas ship no length table at all, PERF.md §19).
       ``sel_bit`` uint8 [B, C] — the chosen-bit position of each selector
       column's slot in the packed chosen vector (suball plans; match
       plans' column c IS slot/bit c, so ``None``).
@@ -290,7 +298,7 @@ class PieceSchema:
     kind: str  # "match" | "suball"
     groups: Tuple[PieceGroup, ...]
     gw: Optional[np.ndarray]
-    gl: np.ndarray
+    gl: Optional[np.ndarray]
     gw16: Optional[np.ndarray] = None
     sel_bit: Optional[np.ndarray] = None
     sel_slot: Optional[np.ndarray] = None
@@ -582,7 +590,7 @@ def build_piece_schema(
 
     groups = []
     floor_off = cap_off = 0
-    n16 = nwide = 0
+    n16 = nwide = n_dyn = 0
     for gi, spec in enumerate(specs):
         sel = tuple(e["c"] for e in spec if col_variants(e) > 1)
         nbytes = cur_bytes(spec)
@@ -610,12 +618,15 @@ def build_piece_schema(
                 len_fixed=mn if mn == mx else None,
                 packed16=p16,
                 tab_idx=n16 if p16 else nwide,
+                gl_idx=n_dyn if mn != mx else 0,
             )
         )
         if p16:
             n16 += 1
         else:
             nwide += 1
+        if mn != mx:
+            n_dyn += 1
         floor_off += mn
         cap_off += mx
 
@@ -627,12 +638,19 @@ def build_piece_schema(
         # integer 0 at axis 3 would hoist the advanced axes to the front)
         gw[:, p16_idx][..., 0].astype(np.uint16) if p16_idx else None
     )
+    # Length-table slicing (PERF.md §19): fixed-length groups fold their
+    # length into the static prefix and never read a row, so the shipped
+    # ``gl`` keeps only the dynamic groups' rows (the gw/gw16 split
+    # applied to lengths); an all-fixed schema ships no table at all.
+    dyn_idx = [gi for gi, grp in enumerate(groups)
+               if grp.len_fixed is None]
+    gl_dyn = gl[:, dyn_idx].astype(np.uint8) if dyn_idx else None
 
     return PieceSchema(
         kind=kind,
         groups=tuple(groups),
         gw=gw_wide,
-        gl=gl.astype(np.uint8),
+        gl=gl_dyn,
         gw16=gw16,
         sel_bit=None if sel_bit is None else sel_bit.astype(np.uint8),
         sel_slot=None if sel_slot is None else sel_slot.astype(np.int32),
@@ -702,17 +720,25 @@ def _suball_piece_cols(plan) -> "tuple | None":
     return pos, ln, opts, vstart, slot, sel_bit, closed
 
 
-def piece_schema_for(plan, ct) -> "PieceSchema | None":
+def piece_schema_for(plan, ct, cache_dir: "str | None" = None
+                     ) -> "PieceSchema | None":
     """The per-slot emission gate: a :class:`PieceSchema` when the plan's
     static geometry supports piece emission (and ``A5GEN_EMIT`` doesn't
     opt out), else None — callers fall back to the per-byte unit scan.
 
     The schema's tables are ``gw uint32 [B, NG, VM, NW]`` group variant
-    words and ``gl uint8 [B, NG, VM]`` placed lengths (plus suball's
+    words and ``gl uint8 [B, NGD, VM]`` placed lengths (plus suball's
     ``sel_slot int32 [B, C]`` / ``sel_bit uint8 [B, C]`` selector
     columns).  Cached on the plan object (plans are frozen, keyed by
-    table identity), like ``pallas_expand.scalar_units_fields``."""
-    from ..runtime.env import emit_scheme
+    table identity), like ``pallas_expand.scalar_units_fields``.
+
+    ``cache_dir`` (or ``A5GEN_SCHEMA_CACHE``) additionally persists the
+    compiled schema on disk, keyed by a digest of the exact build inputs
+    (word tokens, column geometry, value tables) + the schema format
+    version — repeat sweeps of the same wordlist × table skip the
+    compile entirely (the compile-once seam of the service mode,
+    ROADMAP item 1)."""
+    from ..runtime.env import emit_scheme, schema_cache_dir
 
     if emit_scheme() != "perslot":
         return None
@@ -722,33 +748,350 @@ def piece_schema_for(plan, ct) -> "PieceSchema | None":
     tokens = np.asarray(plan.tokens)
     lengths = np.asarray(plan.lengths)
     launched = ~np.asarray(plan.fallback, bool)
+    build_kw = None
     if getattr(plan, "match_pos", None) is not None:
         radix = np.asarray(plan.match_radix)
-        schema = build_piece_schema(
-            tokens, lengths,
-            np.asarray(plan.match_pos), np.asarray(plan.match_len),
-            (radix - 1).clip(min=0), np.asarray(plan.match_val_start),
-            np.asarray(ct.val_bytes), np.asarray(ct.val_len),
+        build_kw = dict(
+            tokens=tokens, lengths=lengths,
+            col_pos=np.asarray(plan.match_pos),
+            col_len=np.asarray(plan.match_len),
+            col_opts=(radix - 1).clip(min=0),
+            col_vstart=np.asarray(plan.match_val_start),
+            val_bytes=np.asarray(ct.val_bytes),
+            val_len=np.asarray(ct.val_len),
             kind="match", launched=launched,
         )
     else:
         cols = _suball_piece_cols(plan)
-        if cols is None:
-            schema = None
-        else:
+        if cols is not None:
             pos, ln, opts, vstart, slot, sel_bit, closed = cols
             vb = getattr(plan, "cval_bytes", None)
             vl = getattr(plan, "cval_len", None)
             if vb is None:
                 vb, vl = np.asarray(ct.val_bytes), np.asarray(ct.val_len)
-            schema = build_piece_schema(
-                tokens, lengths, pos, ln, opts, vstart,
-                np.asarray(vb), np.asarray(vl),
+            build_kw = dict(
+                tokens=tokens, lengths=lengths,
+                col_pos=pos, col_len=ln, col_opts=opts, col_vstart=vstart,
+                val_bytes=np.asarray(vb), val_len=np.asarray(vl),
                 kind="suball", sel_slot=slot, sel_bit=sel_bit,
                 closed=closed, launched=launched,
             )
+    if build_kw is None:
+        schema = None
+    else:
+        if cache_dir is None:
+            cache_dir = schema_cache_dir()
+        if cache_dir:
+            key = _schema_cache_key(build_kw)
+            hit, schema = load_piece_schema(cache_dir, key)
+            if not hit:
+                schema = build_piece_schema(**build_kw)
+                save_piece_schema(cache_dir, key, schema)
+        else:
+            schema = build_piece_schema(**build_kw)
     try:
         object.__setattr__(plan, "_piece_schema_cache", (ct, schema))
     except AttributeError:  # pragma: no cover - non-dataclass plan stubs
         pass
     return schema
+
+
+# ---------------------------------------------------------------------------
+# On-disk PieceSchema cache (ROADMAP item 1's compile-once seam)
+# ---------------------------------------------------------------------------
+
+#: Bump on ANY change to the PieceSchema layout or the grouping rules —
+#: the version is part of the cache key, so stale entries are simply
+#: never looked up again (no in-place migration).
+SCHEMA_CACHE_VERSION = 1
+
+#: PieceGroup fields serialized into a cache entry's JSON header, in
+#: constructor order.
+_GROUP_FIELDS = ("sel_cols", "n_variants", "n_words", "off_cap", "has_term",
+                 "off_floor", "len_fixed", "packed16", "tab_idx", "gl_idx")
+
+_SCHEMA_ARRAYS = ("gw", "gl", "gw16", "sel_bit", "sel_slot")
+
+
+def _schema_cache_key(build_kw: dict) -> str:
+    """Digest of the exact :func:`build_piece_schema` inputs + format
+    version: dtype/shape/bytes of every array, the kind/closed flags, and
+    the grouping caps (a cap change regroups without a code change to the
+    schema layout itself)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(
+        f"a5gen-piece-schema|v{SCHEMA_CACHE_VERSION}"
+        f"|{build_kw['kind']}|{int(bool(build_kw.get('closed')))}"
+        f"|{_MAX_GROUP_BYTES},{_MAX_GROUP_VARIANTS}"
+        f",{_MAX_PIECE_WORDS},{_MAX_COL_VARIANTS}|".encode()
+    )
+    for name in ("tokens", "lengths", "col_pos", "col_len", "col_opts",
+                 "col_vstart", "val_bytes", "val_len", "sel_slot",
+                 "sel_bit", "launched"):
+        arr = build_kw.get(name)
+        if arr is None:
+            h.update(b"|-|")
+            continue
+        arr = np.ascontiguousarray(arr)
+        h.update(f"|{name}:{arr.dtype}:{arr.shape}|".encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def save_piece_schema(cache_dir: str, key: str,
+                      schema: "PieceSchema | None") -> None:
+    """Persist one cache entry atomically (tmp + rename): the schema's
+    arrays (``gw`` uint32, ``gl`` uint8, ``gw16`` uint16, ``sel_bit``
+    uint8, ``sel_slot`` int32 — whichever are present) as npz members
+    plus a JSON header with the static group structure.  ``None`` (the
+    plan's geometry refuses piece emission) is cached too — the refusal
+    walk is not free and the answer is as deterministic as the schema."""
+    import json
+    import os
+
+    os.makedirs(cache_dir, exist_ok=True)
+    path = os.path.join(cache_dir, f"{key}.npz")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    if schema is None:
+        header = {"version": SCHEMA_CACHE_VERSION, "schema": None}
+        arrays = {}
+    else:
+        header = {
+            "version": SCHEMA_CACHE_VERSION,
+            "schema": {
+                "kind": schema.kind,
+                "closed": bool(schema.closed),
+                "max_out": int(schema.max_out),
+                "n_cols": int(schema.n_cols),
+                "groups": [
+                    {f: getattr(g, f) for f in _GROUP_FIELDS}
+                    for g in schema.groups
+                ],
+            },
+        }
+        arrays = {
+            name: getattr(schema, name)
+            for name in _SCHEMA_ARRAYS
+            if getattr(schema, name) is not None
+        }
+    try:
+        with open(tmp, "wb") as fh:
+            np.savez(fh, header=np.frombuffer(
+                json.dumps(header).encode(), dtype=np.uint8
+            ), **arrays)
+        os.replace(tmp, path)
+    except OSError:  # pragma: no cover - cache dir races/ENOSPC
+        # The cache is an accelerator, never a correctness dependency:
+        # a failed write just means the next run recompiles.
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+def load_piece_schema(cache_dir: str, key: str
+                      ) -> "Tuple[bool, PieceSchema | None]":
+    """Load one cache entry: ``(hit, schema)``.  A missing, corrupt, or
+    version-mismatched entry is a miss (the caller rebuilds and
+    overwrites) — never an error."""
+    import json
+    import os
+
+    path = os.path.join(cache_dir, f"{key}.npz")
+    if not os.path.exists(path):
+        return False, None
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            header = json.loads(bytes(data["header"]).decode())
+            if header.get("version") != SCHEMA_CACHE_VERSION:
+                return False, None
+            meta = header["schema"]
+            if meta is None:
+                return True, None
+            groups = tuple(
+                PieceGroup(**{
+                    **g, "sel_cols": tuple(g["sel_cols"]),
+                })
+                for g in meta["groups"]
+            )
+            arrays = {
+                name: (np.asarray(data[name]) if name in data else None)
+                for name in _SCHEMA_ARRAYS
+            }
+            return True, PieceSchema(
+                kind=meta["kind"],
+                groups=groups,
+                closed=bool(meta["closed"]),
+                max_out=int(meta["max_out"]),
+                n_cols=int(meta["n_cols"]),
+                **arrays,
+            )
+    except (OSError, KeyError, ValueError, json.JSONDecodeError):
+        return False, None
+
+
+# ---------------------------------------------------------------------------
+# Streaming ingestion: chunked plan compilation (PERF.md §19)
+# ---------------------------------------------------------------------------
+#
+# Hashmob-scale dictionaries (10^8+ words) must not bound resident memory
+# or time-to-first-candidate: the sweep runtime splits the packed batch
+# into word CHUNKS, compiles each chunk's plan + PieceSchema + device
+# arrays on a host worker thread while the device sweeps the previous
+# chunk, and frees consumed chunks — resident plan state is O(ring ×
+# chunk), independent of dictionary length.  This module owns the
+# generic pieces (slicing, sizing, the bounded compile ring); the sweep
+# runtime injects the actual compile function (plans are a models-layer
+# concern).
+
+
+def slice_packed(packed: PackedWords, lo: int, hi: int) -> PackedWords:
+    """Word rows ``[lo, hi)`` as a zero-copy view batch — ``tokens``
+    uint8 [hi-lo, width], ``lengths`` int32 [hi-lo], ``index`` int64
+    [hi-lo]: the slice keeps the parent's width and original dictionary
+    indices, so hits from a chunk report the same positions the
+    whole-batch plan would."""
+    return PackedWords(
+        tokens=packed.tokens[lo:hi],
+        lengths=packed.lengths[lo:hi],
+        index=packed.index[lo:hi],
+    )
+
+
+#: Streaming chunk sizing target: ~64 MB of compiled plan per chunk.
+DEFAULT_CHUNK_TARGET_BYTES = 64 << 20
+
+#: Conservative compiled-plan bytes per word per packed byte: plan
+#: fields + piece tables + device mirrors run tens of times the raw word
+#: bytes (gw alone is up to NG×VM×NW×4 per word).
+_EST_PLAN_BYTES_PER_TOKEN = 64
+
+
+def auto_chunk_words(
+    width: int, target_bytes: int = DEFAULT_CHUNK_TARGET_BYTES
+) -> int:
+    """Chunk word count (scalar int) targeting ``target_bytes`` of
+    compiled plan for uint8 [B, width] token batches: the per-word byte
+    estimate scales with the packed width (wider words grow more
+    emission groups and wider windows).  Floor 1024 — tiny chunks drown
+    in per-chunk dispatch/compile overhead."""
+    est = _EST_PLAN_BYTES_PER_TOKEN * max(4, int(width))
+    return max(1024, int(target_bytes) // est)
+
+
+def chunk_bounds(n_words: int, chunk_words: int) -> List[Tuple[int, int]]:
+    """Uniform ``[lo, hi)`` word ranges of ``chunk_words`` (last chunk
+    ragged).  Uniform bounds keep the chunk→word mapping arithmetic, so
+    a resumed global cursor finds its chunk without replaying the
+    split."""
+    cw = int(chunk_words)
+    if cw < 1:
+        raise ValueError(f"chunk_words must be >= 1, got {chunk_words}")
+    return [(lo, min(lo + cw, n_words)) for lo in range(0, n_words, cw)]
+
+
+@dataclass
+class PlanChunk:
+    """One compiled dictionary chunk, produced by the worker thread.
+
+    ``payload`` carries whatever the injected compile function attached
+    (device plan arrays, launch callables, superstep context — the sweep
+    runtime's business); ``host_bytes`` is the chunk's resident
+    plan-array footprint (host numpy; the device mirrors are the same
+    sizes), the number the bounded-memory contract is enforced against.
+    ``release()`` frees the chunk exactly once — device arrays deleted,
+    host references dropped — via the compile function's releaser.
+    """
+
+    index: int
+    lo: int
+    hi: int
+    plan: object = None
+    pieces: object = None
+    payload: dict = None
+    host_bytes: int = 0
+    compile_s: float = 0.0
+    t_start: float = 0.0
+    t_end: float = 0.0
+    releaser: "object" = None
+
+    def release(self) -> None:
+        rel, self.releaser = self.releaser, None
+        if rel is not None:
+            rel(self)
+        self.plan = self.pieces = self.payload = None
+
+
+class ChunkCompiler:
+    """The bounded chunk-compile ring (PERF.md §19).
+
+    ONE worker thread compiles chunks in word order via the injected
+    ``compile_fn(index, lo, hi) -> PlanChunk``; at most ``prefetch``
+    (default 1) compiled-or-compiling chunks sit ahead of the chunk the
+    caller is currently sweeping, so chunk N+1's host compile (and its
+    async host→device transfers, issued inside ``compile_fn`` on the
+    worker) overlaps the device sweep of chunk N while resident memory
+    stays O(ring × chunk).  Iteration yields chunks in order and
+    re-raises any worker exception at the consuming ``next()``.
+    """
+
+    def __init__(self, compile_fn, bounds: Sequence[Tuple[int, int]], *,
+                 start: int = 0, prefetch: int = 1) -> None:
+        import time as _time
+        from collections import deque
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._fn = compile_fn
+        self._time = _time
+        self._bounds = list(bounds)
+        self._next = start
+        self._prefetch = max(1, int(prefetch))
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="a5-chunk-compile"
+        )
+        self._futs = deque()
+        #: per-chunk compile windows [(t_start, t_end)] and their total
+        #: wall — the overlap instrument (monotonic clock).
+        self.windows: List[Tuple[float, float]] = []
+        self.compile_wall_s = 0.0
+        self._fill()
+
+    def _fill(self) -> None:
+        # The ring bound: the chunk being swept was already popped, so
+        # outstanding futures ARE the prefetch window — exactly one
+        # chunk compiles/waits ahead at the default depth.
+        while (
+            self._next < len(self._bounds)
+            and len(self._futs) < self._prefetch
+        ):
+            ci = self._next
+            lo, hi = self._bounds[ci]
+            self._futs.append(self._ex.submit(self._timed, ci, lo, hi))
+            self._next += 1
+
+    def _timed(self, ci: int, lo: int, hi: int) -> PlanChunk:
+        t0 = self._time.monotonic()
+        chunk = self._fn(ci, lo, hi)
+        chunk.t_start = t0
+        chunk.t_end = self._time.monotonic()
+        chunk.compile_s = chunk.t_end - t0
+        return chunk
+
+    def __iter__(self) -> "Iterable[PlanChunk]":
+        while self._futs:
+            chunk = self._futs.popleft().result()  # re-raises worker errors
+            self.windows.append((chunk.t_start, chunk.t_end))
+            self.compile_wall_s += chunk.compile_s
+            self._fill()
+            yield chunk
+
+    def close(self) -> None:
+        """Stop compiling; safe after an aborted sweep.  Chunks already
+        compiled are NOT released here — the caller owns consumed chunks
+        and an aborted in-flight future still completes on the worker."""
+        for fut in self._futs:
+            fut.cancel()
+        self._ex.shutdown(wait=True)
+        self._futs.clear()

@@ -1208,7 +1208,9 @@ def _make_piece_kernel(
     closure refs (``cnext``/``cmul``), then the piece tables — the wide
     groups' ``gw[G, NGW, VM, NW] u32`` (absent when every group packs to
     u16), the narrow groups' ``gw16[G, NG16, VM] u16`` (absent when none
-    does), and ``gl[G, NG, VM] i32`` (all groups, emission order).
+    does), and ``gl[G, NGD, VM] i32`` — the DYNAMIC-length groups' rows
+    only, indexed by ``grp.gl_idx`` (absent when every group is fixed:
+    all-fixed schemas ship no length table, PERF.md §19).
     Outputs: ``state[G, KS, S] u32``, ``emit[G, S] i32`` — identical
     contract to :func:`_make_kernel`.
     """
@@ -1244,7 +1246,7 @@ def _make_piece_kernel(
             cmul = rest.pop(0)
         gw = rest.pop(0) if schema.gw is not None else None
         gw16 = rest.pop(0) if schema.gw16 is not None else None
-        gl = rest.pop(0)
+        gl = rest.pop(0) if schema.gl is not None else None
         state_ref, emit_ref = rest
 
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
@@ -1405,7 +1407,7 @@ def _make_piece_kernel(
                     cum = cum + grp.len_fixed
             else:
                 l = _select_rows(
-                    idx, [gl[:, gi, v] for v in range(n_var)], g, s
+                    idx, [gl[:, grp.gl_idx, v] for v in range(n_var)], g, s
                 )
                 if cum is not None:
                     cum = cum + l
@@ -1650,24 +1652,27 @@ def _piece_tables(pieces, pre, blk_word):
     ``pre`` (``piece_arrays`` — shipped once per sweep) when present,
     else the schema's own host arrays (trace-time constants; the harness
     and direct calls).  Returns the ref tuple in kernel order — the u32
-    ``gw`` block rows, the u16 ``gw16`` rows (each omitted when the
-    schema has no groups in that table), then the ``gl`` lengths."""
-    if pre is not None and "pl" in pre:
+    ``gw`` block rows, the u16 ``gw16`` rows, then the sliced ``gl``
+    lengths (each omitted when the schema has no groups in that table;
+    all-fixed schemas ship no ``gl`` at all, PERF.md §19)."""
+    if pre is not None and any(k in pre for k in ("pl", "pw", "pw16")):
         gw_all = pre.get("pw")
         gw16_all = pre.get("pw16")
-        gl_all = pre["pl"]
+        gl_all = pre.get("pl")
     else:
         gw_all = None if pieces.gw is None else jnp.asarray(pieces.gw)
         gw16_all = (
             None if pieces.gw16 is None else jnp.asarray(pieces.gw16)
         )
-        gl_all = jnp.asarray(pieces.gl)
+        gl_all = None if pieces.gl is None else jnp.asarray(pieces.gl)
     tabs = ()
     if gw_all is not None:
         tabs += (gw_all[blk_word],)
     if gw16_all is not None:
         tabs += (gw16_all[blk_word],)
-    return tabs + (gl_all[blk_word].astype(_I32),)
+    if gl_all is not None:
+        tabs += (gl_all[blk_word].astype(_I32),)
+    return tabs
 
 
 @audited_entry(

@@ -329,3 +329,21 @@ def shard_leading(mesh: Mesh, tree, *, axis_name: str = "data"):
     """Shard a pytree's arrays over their leading axis."""
     sharding = NamedSharding(mesh, P(axis_name))
     return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def delete_tree(tree) -> None:
+    """Explicitly free a pytree's device buffers (replicated or sharded:
+    ``Array.delete`` drops every addressable shard).  The streaming
+    chunk ring (PERF.md §19) calls this on each consumed chunk's plan /
+    superstep arrays so resident device memory tracks the ring, not the
+    dictionary — waiting for the GC would let freed chunks pile up
+    behind Python reference cycles.  Host numpy leaves and
+    already-deleted arrays are ignored."""
+    for arr in jax.tree_util.tree_leaves(tree):
+        delete = getattr(arr, "delete", None)
+        if delete is None:
+            continue
+        try:
+            delete()
+        except RuntimeError:  # pragma: no cover - already freed
+            pass

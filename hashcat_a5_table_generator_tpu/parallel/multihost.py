@@ -615,6 +615,11 @@ def run_crack_multihost(
         routing={k: allgather_sum(int(v)) for k, v in
                  sorted(res.routing.items())},
         superstep=_reduce_superstep(res.superstep),
+        # Streaming stats stay HOST-LOCAL (no collectives): chunk
+        # sizing, compile overlap, and resident bounds describe this
+        # host's own stripe ring — a pod-wide sum would mean nothing,
+        # and a key-set-dependent gather could wedge the pod.
+        stream=dict(res.stream),
     )
 
 
@@ -658,4 +663,5 @@ def run_candidates_multihost(
         wall_s=allgather_max(res.wall_s),
         routing={k: allgather_sum(int(v)) for k, v in
                  sorted(res.routing.items())},
+        stream=dict(res.stream),  # host-local (see run_crack_multihost)
     )

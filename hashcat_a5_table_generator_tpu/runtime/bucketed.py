@@ -157,6 +157,7 @@ class BucketedSweep:
         hits.sort(key=lambda h: (h.word_index, h.variant_rank))
         routing: Dict[str, int] = {}
         superstep: Dict[str, int] = {}
+        stream: Dict[str, float] = {}
         for r in results:
             for k, v in r.routing.items():
                 routing[k] = routing.get(k, 0) + int(v)
@@ -169,6 +170,36 @@ class BucketedSweep:
                     superstep[k] = max(superstep.get(k, 0), int(v))
                 else:
                     superstep[k] = superstep.get(k, 0) + int(v)
+            # Streaming stats (PERF.md §19): counters and walls sum
+            # across buckets, peaks/bounds take the max.  The sweep-
+            # local scalars (ttfc_s, resumed_chunk,
+            # first_chunk_compile_s) are claimed only when the FIRST
+            # bucket streamed — buckets run sequentially, so a later
+            # streaming bucket's ttfc says nothing about the run's
+            # time to first candidate (an earlier whole-path bucket
+            # already emitted).  Overlap RATIOS are recomputed from the
+            # summed terms below — a first-bucket ratio next to summed
+            # walls would be self-inconsistent.
+            for k, v in getattr(r, "stream", {}).items():
+                if k in ("peak_resident_plan_bytes", "chunk_bytes_max",
+                         "chunk_words", "prefetch", "ring"):
+                    stream[k] = max(stream.get(k, 0), v)
+                elif k in ("ttfc_s", "resumed_chunk",
+                           "first_chunk_compile_s"):
+                    if r is results[0]:
+                        stream[k] = v
+                elif k in ("overlap_ratio", "steady_overlap_ratio"):
+                    pass  # derived; recomputed from the summed terms
+                else:
+                    stream[k] = stream.get(k, 0) + v
+        if stream.get("compile_wall_s", 0) > 0:
+            wall = stream["compile_wall_s"]
+            over = stream.get("compile_overlap_s", 0.0)
+            first = stream.get("first_chunk_compile_s", 0.0)
+            stream["overlap_ratio"] = over / wall
+            stream["steady_overlap_ratio"] = (
+                over / (wall - first) if wall - first > 0 else 0.0
+            )
         return SweepResult(
             n_emitted=sum(r.n_emitted for r in results),
             n_hits=sum(r.n_hits for r in results),
@@ -178,6 +209,7 @@ class BucketedSweep:
             wall_s=time.monotonic() - t0,
             routing=routing,
             superstep=superstep,
+            stream=stream,
         )
 
     def run_crack(self, recorder=None, *, resume: bool = True) -> SweepResult:

@@ -56,6 +56,14 @@ class CheckpointState:
     hits: List[Tuple[int, int]] = field(default_factory=list)  # (word, rank)
     fallback_done: int = 0  # fallback words fully re-expanded so far
     wall_s: float = 0.0
+    #: streaming-ingestion extension (PERF.md §19): the active
+    #: ``{"chunk": i, "chunk_words": N}`` when a streaming sweep wrote
+    #: the checkpoint.  Purely informational — the (word, rank) cursor
+    #: is GLOBAL either way, so a streaming checkpoint resumes under the
+    #: whole-dictionary path (which ignores this) and vice versa, and a
+    #: resume under a different chunk size just re-derives the chunk
+    #: from the cursor.
+    stream: Optional[Dict] = None
     version: int = FORMAT_VERSION
 
 
@@ -167,6 +175,7 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
         hits=[(int(w), int(r)) for w, r in doc["hits"]],
         fallback_done=int(doc.get("fallback_done", 0)),
         wall_s=float(doc["wall_s"]),
+        stream=doc.get("stream"),
     )
 
 

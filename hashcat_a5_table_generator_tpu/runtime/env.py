@@ -90,6 +90,23 @@ def pipeline_enabled() -> bool:
     )
 
 
+def stream_enabled() -> bool:
+    """Streaming-ingestion knob: ``A5GEN_STREAM`` set to ``off``/``0``/
+    ``no`` pins whole-dictionary plan materialization instead of the
+    chunked streaming pipeline (PERF.md §19) — the one-release escape
+    hatch mirroring ``A5GEN_SUPERSTEP``/``A5GEN_PIPELINE``."""
+    return not env_opt_out(
+        "A5GEN_STREAM", "streaming plan pipeline for chunked dictionaries"
+    )
+
+
+def schema_cache_dir() -> "Optional[str]":
+    """On-disk PieceSchema cache directory (``A5GEN_SCHEMA_CACHE``;
+    empty/unset = no persistent cache).  ``SweepConfig.schema_cache`` /
+    ``--schema-cache`` override this per run."""
+    return read_env("A5GEN_SCHEMA_CACHE") or None
+
+
 def emit_scheme() -> str:
     """Message-emission scheme knob: ``A5GEN_EMIT`` selects between the
     per-slot piece emission (``perslot`` — the default; PERF.md §17) and

@@ -33,6 +33,7 @@ N's outputs are fetched, so host block-cutting and device compute overlap
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -72,6 +73,68 @@ from .checkpoint import (
 )
 from .progress import ProgressReporter
 from .sinks import CandidateWriter, HitRecord, HitRecorder
+
+
+#: Process-level jitted-step memo, shared ACROSS Sweep instances: the
+#: step factories (make_crack_step & co.) are pure functions of their
+#: static config, so two sweeps with identical config can reuse one jit
+#: object — and its compiled executables — instead of re-tracing and
+#: re-compiling the same program (repeat sweeps in one process, the
+#: resident service seam of ROADMAP item 1, and a test suite that
+#: otherwise rebuilds the same tiny-geometry programs hundreds of
+#: times).  Keys carry every input the traced body depends on: the
+#: sweep-level static config, the mesh CONTENT (device ids) for
+#: shard_map'd steps — JAX meshes and shardings compare by content, so
+#: a step closed over one sweep's Mesh serves another sweep's
+#: content-equal mesh; that equality is load-bearing when bumping the
+#: pinned jax version — and the kernel-selection env knobs read at
+#: trace time.  Bounded in practice: distinct static configs per
+#: process are few.
+_STEP_CACHE: Dict = {}
+_STEP_CACHE_LOCK = threading.Lock()
+#: (step key, argument-shape signature) pairs already executed — the
+#: streaming chunk worker's warmup dispatch is skipped when the
+#: compiled executable demonstrably exists (PERF.md §19).
+_WARMED_STEPS: set = set()
+#: Env knobs that change the TRACED body without appearing in the
+#: sweep-level static config (Pallas kernel selection/interpret mode).
+_STEP_ENV_KNOBS = ("A5GEN_PALLAS", "A5GEN_PALLAS_G",
+                   "A5GEN_PALLAS_INTERPRET")
+
+
+def _step_env_key() -> tuple:
+    from .env import env_str
+
+    return tuple(env_str(k) for k in _STEP_ENV_KNOBS)
+
+
+def _tree_shape_sig(tree) -> tuple:
+    """Hashable (shape, dtype) signature of a pytree's arrays — with a
+    step's cache key, it identifies one compiled executable (jit
+    specializes per argument shapes), so the chunk worker can tell
+    whether a warmup dispatch would actually compile anything."""
+    import jax
+
+    return tuple(
+        (tuple(x.shape), str(getattr(x, "dtype", type(x))))
+        for x in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def _pieces_static(pieces) -> "Optional[tuple]":
+    """The hashable STATIC trace structure of a ``packing.PieceSchema``
+    — everything the kernel builders bake into the traced program (the
+    data tables ride the plan dict as inputs).  Step-cache key material:
+    two chunk plans with equal static structure share one compiled
+    step."""
+    if pieces is None:
+        return None
+    return (
+        pieces.kind, pieces.groups, pieces.closed, pieces.n_cols,
+        pieces.max_out, pieces.gw is None, pieces.gw16 is None,
+        pieces.gl is None, pieces.sel_bit is None,
+        pieces.sel_slot is None,
+    )
 
 
 @dataclass
@@ -135,6 +198,25 @@ class SweepConfig:
     #   since the f32 decode + vectorized cutter landed — PERF.md §4c),
     #   packed otherwise. The layouts are stream-identical; only throughput
     #   differs.
+    stream_chunk_words: "Optional[int | str]" = None  # streaming plan
+    #   pipeline (PERF.md §19): compile the dictionary's plan + piece
+    #   schema in word CHUNKS on a host worker thread while the device
+    #   sweeps the previous chunk, with consumed chunks freed — resident
+    #   plan memory is O(ring x chunk) regardless of dictionary length,
+    #   and time-to-first-candidate is one chunk's schema compile plus a
+    #   cheap whole-dictionary prescan (the light vectorized fraction of
+    #   the plan build; the dominant schema/table compile streams). None /
+    #   'auto' = engage when the dictionary spans more than one
+    #   auto-sized (~64 MB of compiled plan) chunk; 0 / 'off' = always
+    #   materialize whole; N = chunk at N words (engages when the
+    #   dictionary exceeds N). The candidate/hit streams, checkpoints
+    #   and fingerprints are identical either way (a streaming
+    #   checkpoint resumes under the whole-dictionary path and vice
+    #   versa); A5GEN_STREAM=off is the env escape hatch.
+    schema_cache: Optional[str] = None  # on-disk PieceSchema cache dir
+    #   (default: A5GEN_SCHEMA_CACHE): repeat sweeps of the same
+    #   wordlist x table skip schema compilation — the service mode's
+    #   compile-once seam (ROADMAP item 1).
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
@@ -180,6 +262,12 @@ class SweepResult:
     #: supersteps / launches (steps executed inside them) / replays
     #: (overflow supersteps re-run per-launch) / launches_per_fetch
     superstep: Dict[str, int] = field(default_factory=dict)
+    #: streaming-ingestion stats (empty when the whole-dictionary path
+    #: ran, PERF.md §19): chunks / chunks_swept / chunk_words /
+    #: compile_wall_s / compile_overlap_s / overlap_ratio / ttfc_s
+    #: (time to the first device results fetch) /
+    #: peak_resident_plan_bytes / chunk_bytes_max / ring
+    stream: Dict[str, float] = field(default_factory=dict)
 
 
 class _FallbackPrefetcher:
@@ -286,18 +374,52 @@ class Sweep:
             words if isinstance(words, PackedWords) else pack_words(list(words))
         )
         self.n_words = self.packed.batch
-        self.plan = build_plan(spec, self.ct, self.packed)
+        #: jitted step programs + shared device arrays, keyed by static
+        #: trace config — streaming chunks with identical schema
+        #: structure share one compiled program (PERF.md §19).
+        #: per-sweep device residents (table/digest arrays); compiled
+        #: step programs live in the process-level _STEP_CACHE.
+        self._step_cache: Dict = {}
+        self._mesh = None
+        self._ttfc: List[Optional[float]] = [None]
+        self._run_t0 = 0.0
+        self._stream_lock = threading.Lock()
+        self._stream_resident = 0
+        self._stream_peak = 0
+        self._stream_chunk_max = 0
+        #: streaming-ingestion decision (PERF.md §19): chunk bounds +
+        #: the batch-level plan facts, or None = whole-dictionary plan.
+        self._stream = self._resolve_streaming()
+        if self._stream is None:
+            self.plan = build_plan(spec, self.ct, self.packed)
+            closed_arr = getattr(self.plan, "closed", None)
+            n_closed = int(closed_arr.sum()) if closed_arr is not None else 0
+            windowed = bool(getattr(self.plan, "windowed", False))
+            #: fallback word rows in word order (oracle-routed,
+            #: SURVEY.md §2.4)
+            self.fallback_rows: List[int] = [
+                int(i) for i in np.nonzero(self.plan.fallback)[0]
+            ]
+        else:
+            # Streaming: plans are chunk-local; the batch-level facts
+            # the fingerprint, routing, and every chunk plan must agree
+            # on come from one cheap prescan (O(chunk) resident).
+            self.plan = None
+            self._stream.update(self._stream_prescan())
+            n_closed = self._stream["n_closed"]
+            windowed = self._stream["windowed"]
+            self.fallback_rows = self._stream["fallback_rows"]
         # Windowed plans renumber every (word, rank) cursor, so a checkpoint
         # from one enumeration scheme must never resume under the other —
         # the scheme is part of the fingerprint's mode token. (Scheme choice
-        # is deterministic in the fingerprinted inputs; the token guards
-        # against cross-version resumes.) Cascade closure likewise changes
-        # WHICH words the device cursor covers (closed words leave the
-        # fallback set), so it gets its own token.
-        closed_arr = getattr(self.plan, "closed", None)
-        n_closed = int(closed_arr.sum()) if closed_arr is not None else 0
+        # is deterministic in the fingerprinted inputs — the streaming
+        # prescan reproduces the whole-batch decision exactly, so
+        # streaming and whole-dictionary runs fingerprint identically;
+        # the token guards against cross-version resumes.) Cascade
+        # closure likewise changes WHICH words the device cursor covers
+        # (closed words leave the fallback set), so it gets its own token.
         mode_token = spec.mode + (
-            "+windowed" if getattr(self.plan, "windowed", False) else ""
+            "+windowed" if windowed else ""
         ) + ("+closed" if n_closed else "")
         self.fingerprint = sweep_fingerprint(
             mode_token,
@@ -310,10 +432,6 @@ class Sweep:
             digest_lookup=self._digest_lookup,  # reuse its one sort
         )
         self._host_digest = HOST_DIGEST[spec.algo]
-        #: fallback word rows in word order (oracle-routed, SURVEY.md §2.4)
-        self.fallback_rows: List[int] = [
-            int(i) for i in np.nonzero(self.plan.fallback)[0]
-        ]
         #: three-way word routing (PERF.md §5/§14): clean device words,
         #: cascade-closed device words, oracle-routed pathological words.
         self.routing: Dict[str, int] = {
@@ -325,7 +443,130 @@ class Sweep:
         if set_routing is not None:
             set_routing(self.routing)
 
-    def _auto_num_blocks(self, kind: str) -> int:
+    # ------------------------------------------------------------------
+    # Streaming ingestion (PERF.md §19)
+    # ------------------------------------------------------------------
+
+    def _resolve_streaming(self) -> "Optional[dict]":
+        """The streaming-ingestion decision: chunk word count + bounds,
+        or None for whole-dictionary plan materialization.
+
+        ``SweepConfig.stream_chunk_words``: None/'auto' = engage when
+        the dictionary spans more than one auto-sized (~64 MB of
+        compiled plan) chunk; 0/'off' = never; N = chunk at N words.
+        ``A5GEN_STREAM=off`` is the one-release escape hatch.  A
+        dictionary that fits one chunk keeps the whole path — it IS the
+        chunk, and the whole path skips the ring machinery."""
+        from ..ops.packing import auto_chunk_words, chunk_bounds
+        from .env import stream_enabled
+
+        requested = self.config.stream_chunk_words
+        if requested in (0, "off") or not stream_enabled():
+            return None
+        if requested in (None, "auto"):
+            cw = auto_chunk_words(self.packed.width)
+        else:
+            cw = int(requested)
+            if cw < 1:
+                raise ValueError(
+                    "SweepConfig.stream_chunk_words must be >= 1, "
+                    f"'auto', or 'off'; got {requested!r}"
+                )
+        if self.n_words <= cw:
+            return None
+        return {
+            "chunk_words": cw,
+            "bounds": chunk_bounds(self.n_words, cw),
+            # Exactly ONE chunk compiles/waits ahead of the chunk being
+            # swept (the ring contract graftaudit pins; deeper prefetch
+            # would trade memory for nothing — the worker is one thread).
+            "prefetch": 1,
+        }
+
+    def _stream_prescan(self) -> dict:
+        """One cheap vectorized pass over the dictionary, chunk by chunk
+        (plans built and DISCARDED — O(chunk) resident), computing the
+        batch-level facts every chunk plan must agree on:
+
+        * ``out_width`` — the global candidate-buffer width (a chunk
+          sizing it locally would change kernel shapes mid-sweep);
+        * ``windowed`` — the count-windowed enumeration decision.  Its
+          2x-lane-saving gate sums over the WHOLE batch
+          (``expand_matches.windowed_plan_fields``), so the streaming
+          sweep reproduces the whole-dictionary decision here and
+          FORCES every chunk plan the same way — rank numbering must be
+          chunk-invariant or checkpoints/hits would renumber;
+        * ``fallback_rows`` / ``n_closed`` — global oracle routing, so
+          fallback interleave, the prefetcher, the fingerprint's mode
+          token, and the routing stats are identical to the whole path.
+
+        This pass IS O(dictionary) host work — global decisions cannot
+        be cheaper — but only the light fraction of the compile: the
+        vectorized match scan and the windowed DP, never the PieceSchema
+        variant tables, placement windows, or device arrays, which are
+        the dominant cost and stream per chunk behind the device sweep
+        (measured split in PERF.md §19b).  The chunk plans built here
+        are rebuilt by the ring's worker — the price of O(chunk)
+        residency."""
+        from ..ops.expand_matches import (
+            variant_totals,
+            windowed_chunk_terms,
+            windowed_gate,
+        )
+        from ..ops.packing import slice_packed
+
+        spec = self.spec
+        emin, emax = spec.effective_min, spec.max_substitute
+        win_ok = True
+        sum_win = sum_full = 0
+        out_width = 4
+        fallback_rows: List[int] = []
+        n_closed = 0
+        for lo, hi in self._stream["bounds"]:
+            # force_windowed=False: the prescan reads only out_width /
+            # fallback / closed / the (neutralized) radix matrix — all
+            # computed before the windowed step — so building the
+            # chunk's win_v DP here would run the dominant prescan term
+            # twice (windowed_chunk_terms below is the one that counts).
+            plan = build_plan(
+                spec, self.ct, slice_packed(self.packed, lo, hi),
+                force_windowed=False,
+            )
+            out_width = max(out_width, plan.out_width)
+            fb = np.asarray(plan.fallback, bool)
+            fallback_rows.extend(
+                lo + int(i) for i in np.nonzero(fb)[0]
+            )
+            closed_arr = getattr(plan, "closed", None)
+            if closed_arr is not None:
+                n_closed += int(np.asarray(closed_arr).sum())
+            if win_ok:
+                # The gate's terms come from the SAME implementation the
+                # whole-batch decision uses (windowed_chunk_terms):
+                # per-word eligibility conjoins, the sums accumulate,
+                # and the final vote is the shared windowed_gate.  The
+                # plan's radix matrix and full totals arrive fallback-
+                # neutralized exactly as the builders pass them.
+                radix = np.asarray(plan.pat_radix)
+                full = variant_totals(radix)
+                n_var = [0 if fb[i] else t for i, t in enumerate(full)]
+                ok, _v, _totals, sw, sf = windowed_chunk_terms(
+                    radix, n_var, emin, emax, zero_mask=fb,
+                )
+                if not ok:
+                    win_ok = False
+                else:
+                    sum_win += sw
+                    sum_full += sf
+        windowed = bool(win_ok and windowed_gate(sum_win, sum_full))
+        return {
+            "out_width": out_width,
+            "windowed": windowed,
+            "fallback_rows": fallback_rows,
+            "n_closed": n_closed,
+        }
+
+    def _auto_num_blocks(self, kind: str, plan) -> int:
         """Resolve ``num_blocks=None``: the measured per-arm best geometry
         (PERF.md §9b/§11) — when the fused Pallas kernel will take the
         launch, the K=1 scalar-units path peaks at stride 128 (best
@@ -334,18 +575,20 @@ class Sweep:
         space fills larger strides poorly); the XLA path peaks at
         stride 128.  Candidates mode never engages the fused kernel
         (``make_candidates_step`` has no fused path), so it always gets
-        the XLA-best stride."""
+        the XLA-best stride.  Streaming sweeps resolve on the FIRST
+        chunk's plan and keep the geometry for the whole sweep (jit
+        shape stability across chunks)."""
         from ..ops.pallas_expand import opts_for, scalar_units_for
 
         lanes = self.config.lanes
         if kind == "crack":
-            if scalar_units_for(self.plan):
+            if scalar_units_for(plan):
                 pref = 128
             else:
                 pref = 256 if self.spec.mode.startswith("suball") else 512
             if lanes % pref == 0:
                 nb = lanes // pref
-                if opts_for(self.spec, self.plan, self.ct,
+                if opts_for(self.spec, plan, self.ct,
                             block_stride=pref, num_blocks=nb) is not None:
                     return nb
         if lanes % 128 == 0:
@@ -434,19 +677,78 @@ class Sweep:
             raise ValueError(f"SweepConfig.devices must be >= 1, got {n}")
         return n
 
-    def _make_launch(self, kind: str):
-        """Build this run's launch callable: ``kind`` is 'crack' or
+    def _get_step(self, key: tuple, build: Callable):
+        """Shared compiled-program cache: jitted steps keyed by their
+        static trace config, so streaming chunks — and repeat sweeps in
+        the same process — with identical config reuse ONE jit object
+        (and its compiled executables) instead of re-tracing
+        (PERF.md §19; the process-level ``_STEP_CACHE``).  The env-knob
+        suffix keeps sweeps under different Pallas selection/interpret
+        settings on separate programs."""
+        key = key + (_step_env_key(),)
+        with _STEP_CACHE_LOCK:
+            step = _STEP_CACHE.get(key)
+        if step is None:
+            step = build()
+            with _STEP_CACHE_LOCK:
+                # A benign race: concurrent builders produce equivalent
+                # pure programs; first write wins.
+                step = _STEP_CACHE.setdefault(key, step)
+        return step
+
+    def _get_mesh(self, n_devices: int):
+        """One mesh per sweep: streaming chunks must replicate onto the
+        SAME mesh or shardings drift between chunks."""
+        if self._mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(n_devices)
+        return self._mesh
+
+    def _schema_cache_dir(self) -> "Optional[str]":
+        from .env import schema_cache_dir
+
+        return self.config.schema_cache or schema_cache_dir()
+
+    def _shared_device_arrays(self, kind: str, mesh) -> tuple:
+        """Chunk-independent device residents, built once per sweep:
+        the compiled table's value arrays and (crack) the digest set —
+        streaming must NOT re-transfer these per chunk."""
+        key = ("shared-arrays", kind, mesh is not None)
+        got = self._step_cache.get(key)
+        if got is None:
+            t = table_arrays(self.ct)
+            darrs = (
+                digest_arrays(build_digest_set(self.digests, self.spec.algo))
+                if kind == "crack" else None
+            )
+            if mesh is not None:
+                from ..parallel.mesh import replicate
+
+                t = replicate(mesh, t)
+                if darrs is not None:
+                    darrs = replicate(mesh, darrs)
+            got = (t, darrs)
+            self._step_cache[key] = got
+        return got
+
+    def _make_launch(self, kind: str, plan):
+        """Build a launch callable over one compiled plan — the whole
+        dictionary, or one streaming chunk.  ``kind`` is 'crack' or
         'candidates'.  Single-device builds the plain jitted step; multi-
         device builds the shard_map'd step over a 1-D mesh with plan/table
         (and digests, for crack) replicated.  Returns
-        (launch(blocks) -> out, n_devices, mesh)."""
+        ``(launch(blocks) -> out, n_devices, mesh, step_ctx)`` — the
+        step-build context the superstep executor (and the streaming
+        chunk driver) reuses: same device-resident arrays, same kernel
+        selection, so the paths trace the identical fused body."""
         if self.config.num_blocks is None:
             from dataclasses import replace
 
             self.config = replace(
-                self.config, num_blocks=self._auto_num_blocks(kind)
+                self.config, num_blocks=self._auto_num_blocks(kind, plan)
             )
-        spec, cfg, plan = self.spec, self.config, self.plan
+        spec, cfg = self.spec, self.config
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()
         from ..ops.packing import piece_schema_for
@@ -469,84 +771,83 @@ class Sweep:
         radix2 = k_opts_for(plan) == 1
         # Per-slot piece emission (PERF.md §17; A5GEN_EMIT=bytescan opts
         # out): one schema drives the Pallas kernels AND the XLA splice.
-        pieces = piece_schema_for(plan, self.ct)
+        pieces = piece_schema_for(
+            plan, self.ct, cache_dir=self._schema_cache_dir()
+        )
+        # ``spec`` is baked into every traced body (mode picks the
+        # expansion kernel, algo the hash, the window the emit mask) —
+        # it MUST be key material or sweeps of different attacks would
+        # share a program (AttackSpec is frozen, hence hashable).
+        skey = (kind, spec, n_devices, cfg.lanes, plan.out_width, stride,
+                fused_opts, scalar_units, radix2, _pieces_static(pieces))
+        step_ctx = dict(
+            fused_opts=fused_opts, scalar_units=scalar_units,
+            radix2=radix2, stride=stride, pieces=pieces, step_key=skey,
+        )
         if n_devices == 1:
-            p, t = plan_arrays(plan), table_arrays(self.ct)
+            t, darrs = self._shared_device_arrays(kind, None)
+            p = plan_arrays(plan)
             if fused_opts is not None and scalar_units:
                 # Word-level scalar-unit fields precomputed once per
-                # sweep; the kernel wrapper preps by gathering.
+                # plan; the kernel wrapper preps by gathering.
                 p.update(scalar_units_arrays(plan, self.ct))
             if pieces is not None:
                 p.update(piece_arrays(pieces))
+            step_ctx["arrays"] = (p, t, darrs)
             if kind == "crack":
-                step = make_crack_step(
+                step = self._get_step(skey, lambda: make_crack_step(
                     spec, num_lanes=cfg.lanes, out_width=plan.out_width,
                     block_stride=stride, fused_expand_opts=fused_opts,
                     fused_scalar_units=scalar_units, radix2=radix2,
                     pieces=pieces,
+                ))
+                return (
+                    (lambda blocks: step(p, t, blocks, darrs)),
+                    1, None, step_ctx,
                 )
-                darrs = digest_arrays(
-                    build_digest_set(self.digests, spec.algo)
-                )
-                # Step-build context the superstep executor reuses (same
-                # device-resident arrays, same kernel selection — the two
-                # paths must trace the identical fused body).
-                self._step_ctx = dict(
-                    arrays=(p, t, darrs), fused_opts=fused_opts,
-                    scalar_units=scalar_units, radix2=radix2, stride=stride,
-                    pieces=pieces,
-                )
-                return (lambda blocks: step(p, t, blocks, darrs)), 1, None
-            step = make_candidates_step(
+            step = self._get_step(skey, lambda: make_candidates_step(
                 spec, num_lanes=cfg.lanes, out_width=plan.out_width,
                 block_stride=stride, radix2=radix2, pieces=pieces,
-            )
-            return (lambda blocks: step(p, t, blocks)), 1, None
+            ))
+            return (lambda blocks: step(p, t, blocks)), 1, None, step_ctx
 
         from ..parallel.mesh import (
-            make_mesh,
             make_sharded_candidates_step,
             make_sharded_crack_step,
             replicate,
         )
 
-        mesh = make_mesh(n_devices)
+        mesh = self._get_mesh(n_devices)
+        # shard_map closures bind the mesh; JAX meshes compare by
+        # content, so keying on the device ids shares programs across
+        # sweeps over the same devices.
+        skey = skey + (tuple(int(d.id) for d in mesh.devices.flat),)
+        t, darrs = self._shared_device_arrays(kind, mesh)
+        parr = plan_arrays(plan)
+        if kind == "crack" and fused_opts is not None and scalar_units:
+            parr.update(scalar_units_arrays(plan, self.ct))
+        if pieces is not None:
+            parr.update(piece_arrays(pieces))
+        p = replicate(mesh, parr)
+        step_ctx["arrays"] = (p, t, darrs)
         if kind == "crack":
-            step = make_sharded_crack_step(
+            step = self._get_step(skey, lambda: make_sharded_crack_step(
                 spec, mesh, lanes_per_device=cfg.lanes,
                 out_width=plan.out_width, block_stride=stride,
                 fused_expand_opts=fused_opts,
                 fused_scalar_units=scalar_units, radix2=radix2,
                 pieces=pieces,
+            ))
+            return (
+                (lambda blocks: step(p, t, darrs, blocks)),
+                n_devices, mesh, step_ctx,
             )
-            parr = plan_arrays(plan)
-            if fused_opts is not None and scalar_units:
-                parr.update(scalar_units_arrays(plan, self.ct))
-            if pieces is not None:
-                parr.update(piece_arrays(pieces))
-            p, t, darrs = replicate(
-                mesh,
-                (
-                    parr,
-                    table_arrays(self.ct),
-                    digest_arrays(build_digest_set(self.digests, spec.algo)),
-                ),
-            )
-            self._step_ctx = dict(
-                arrays=(p, t, darrs), fused_opts=fused_opts,
-                scalar_units=scalar_units, radix2=radix2, stride=stride,
-                pieces=pieces,
-            )
-            return (lambda blocks: step(p, t, darrs, blocks)), n_devices, mesh
-        step = make_sharded_candidates_step(
-            spec, mesh, lanes_per_device=cfg.lanes, out_width=plan.out_width,
-            block_stride=stride, radix2=radix2, pieces=pieces,
-        )
-        parr = plan_arrays(plan)
-        if pieces is not None:
-            parr.update(piece_arrays(pieces))
-        p, t = replicate(mesh, (parr, table_arrays(self.ct)))
-        return (lambda blocks: step(p, t, blocks)), n_devices, mesh
+        step = self._get_step(skey, lambda: make_sharded_candidates_step(
+            spec, mesh, lanes_per_device=cfg.lanes,
+            out_width=plan.out_width, block_stride=stride, radix2=radix2,
+            pieces=pieces,
+        ))
+        return (lambda blocks: step(p, t, blocks)), n_devices, mesh, step_ctx
 
     # ------------------------------------------------------------------
     # Superstep executor (crack mode, PERF.md §15)
@@ -588,25 +889,23 @@ class Sweep:
         # in-flight superstep).
         return max(1, int(cfg.max_in_flight))
 
-    def _make_superstep(self, cursor: SweepCursor, n_devices: int, mesh):
-        """Build this run's superstep executor, or None when the
-        per-launch pipeline should carry it: config/env opt-out, packed
-        block layout, an int32-unsafe block index (huge words), or a
-        stride-misaligned resume cursor (cross-geometry checkpoints).
+    def _superstep_static(self, plan, n_devices: int, mesh, step_ctx):
+        """The cursor-independent half of the superstep build: the
+        compiled step (shared via the step cache — the trace no longer
+        bakes the sweep's block count, so equal-structure streaming
+        chunks reuse one program), the device-resident index arrays,
+        and the dispatch closure.  None when the executor cannot take
+        this plan: config/env opt-out, packed block layout, or an
+        int32-unsafe block index (huge words).
 
-        Returns a descriptor dict whose ``call(b0, bufs)`` dispatches one
-        superstep starting at global block index ``b0`` into the device
-        hit-buffer set ``bufs`` — ONE device program running ``steps``
-        fused launches with on-device block cutting
-        (``models.attack.make_superstep_body``); ``make_bufs()``
-        allocates one buffer set (the pipelined driver cycles ``depth``
-        of them).
-        Must run after :meth:`_make_launch` (which resolves the geometry
-        and stashes the step-build context the executor shares)."""
+        Streaming calls this ON THE WORKER THREAD (the ss-array
+        transfers and the XLA compile overlap the previous chunk's
+        device sweep); the whole path calls it lazily from
+        :meth:`_make_superstep`."""
         steps = self._superstep_steps()
         if steps is None:
             return None
-        cfg, plan = self.config, self.plan
+        cfg = self.config
         stride = cfg.resolve_block_stride()
         if stride is None:
             return None
@@ -614,6 +913,125 @@ class Sweep:
         if idx is None:
             return None
         cum, _totals, total_blocks = idx
+        # The superstep's device accumulator is int32: cap steps so a
+        # worst case of every lane emitting cannot reach 2^31 per fetch.
+        steps = max(1, min(
+            steps, ((1 << 31) - 1) // max(1, cfg.lanes * n_devices)
+        ))
+        # The tail superstep's device cursor overshoots the sweep end by
+        # up to one full superstep (those blocks cut zero-count); the
+        # overshot indices must themselves stay int32, or `b < total`
+        # comparisons wrap negative and resurrect word-0 blocks.
+        if (
+            total_blocks + (steps + 1) * cfg.num_blocks * n_devices
+            >= (1 << 31)
+        ):
+            return None
+        hit_cap = int(cfg.superstep_hit_cap)
+        common = dict(
+            out_width=plan.out_width, block_stride=stride, steps=steps,
+            hit_cap=hit_cap, total_blocks=total_blocks,
+            windowed=bool(getattr(plan, "windowed", False)),
+            fused_expand_opts=step_ctx["fused_opts"],
+            fused_scalar_units=step_ctx["scalar_units"],
+            radix2=step_ctx["radix2"],
+            pieces=step_ctx["pieces"],
+        )
+        # ``total_blocks`` rides the ss tree as data, so it is NOT key
+        # material — chunks of different length share the program.
+        skey = ("superstep", self.spec, n_devices, cfg.lanes,
+                cfg.num_blocks, plan.out_width, stride, steps, hit_cap,
+                common["windowed"], step_ctx["fused_opts"],
+                step_ctx["scalar_units"], step_ctx["radix2"],
+                _pieces_static(step_ctx["pieces"]))
+        if mesh is not None:
+            skey = skey + (tuple(int(d.id) for d in mesh.devices.flat),)
+        p, t, darrs = step_ctx["arrays"]
+        if n_devices == 1:
+            from ..models.attack import superstep_buffers
+
+            step = self._get_step(skey, lambda: make_superstep_step(
+                self.spec, num_lanes=cfg.lanes, num_blocks=cfg.num_blocks,
+                **common,
+            ))
+            ss = superstep_arrays(plan, stride, idx=idx)
+            make_bufs = lambda: superstep_buffers(hit_cap)  # noqa: E731
+
+            def call(b: int, bufs):
+                return step(p, t, darrs, ss, np.int32(b), bufs)
+        else:
+            from ..parallel.mesh import (
+                make_sharded_superstep_step,
+                replicate,
+                shard_leading,
+            )
+
+            step = self._get_step(
+                skey, lambda: make_sharded_superstep_step(
+                    self.spec, mesh, lanes_per_device=cfg.lanes,
+                    num_blocks=cfg.num_blocks, **common,
+                )
+            )
+            ss = replicate(mesh, superstep_arrays(plan, stride, idx=idx))
+            nb = cfg.num_blocks
+
+            def make_bufs():
+                per_dev = hit_cap + 1
+                return shard_leading(mesh, {
+                    "hit_word": np.full(
+                        (n_devices * per_dev,), -1, np.int32
+                    ),
+                    "hit_rank": np.zeros(
+                        (n_devices * per_dev,), np.int32
+                    ),
+                })
+
+            def call(b: int, bufs):
+                b0_dev = shard_leading(mesh, np.asarray(
+                    [b + d * nb for d in range(n_devices)], np.int32
+                ))
+                return step(p, t, darrs, ss, b0_dev, bufs)
+
+        return {
+            "call": call,
+            "make_bufs": make_bufs,
+            "ss": ss,
+            "key": skey,
+            "steps": steps,
+            "stride": stride,
+            "cum": cum,
+            "total_blocks": total_blocks,
+            "hit_cap": hit_cap,
+            "advance": steps * cfg.num_blocks * n_devices,
+        }
+
+    def _make_superstep(self, plan, cursor: SweepCursor, n_devices: int,
+                        mesh, step_ctx):
+        """Build this plan's superstep executor, or None when the
+        per-launch pipeline should carry it: static ineligibility
+        (:meth:`_superstep_static`) or a stride-misaligned resume cursor
+        (cross-geometry checkpoints).
+
+        Returns a descriptor dict whose ``call(b0, bufs)`` dispatches one
+        superstep starting at plan-local block index ``b0`` into the
+        device hit-buffer set ``bufs`` — ONE device program running
+        ``steps`` fused launches with on-device block cutting
+        (``models.attack.make_superstep_body``); ``make_bufs()``
+        allocates one buffer set (the pipelined driver cycles ``depth``
+        of them).
+        Must run after :meth:`_make_launch` (which resolves the geometry
+        and returns the step-build context the executor shares; the
+        streaming worker pre-builds the static half into
+        ``step_ctx['ss_static']``)."""
+        if "ss_static" not in step_ctx:
+            step_ctx["ss_static"] = self._superstep_static(
+                plan, n_devices, mesh, step_ctx
+            )
+        st = step_ctx["ss_static"]
+        if st is None:
+            return None
+        cum, stride = st["cum"], st["stride"]
+        total_blocks = st["total_blocks"]
         # Normalize the cursor exactly as make_blocks does (skip fallback
         # and finished words), then require stride alignment — misaligned
         # resumes keep the scalar per-launch path, as they always have.
@@ -637,91 +1055,13 @@ class Sweep:
                 f"({w}, {rank}); the checkpoint does not match this "
                 "plan/geometry"
             )
-        # The superstep's device accumulator is int32: cap steps so a
-        # worst case of every lane emitting cannot reach 2^31 per fetch.
-        steps = max(1, min(
-            steps, ((1 << 31) - 1) // max(1, cfg.lanes * n_devices)
-        ))
-        # The tail superstep's device cursor overshoots the sweep end by
-        # up to one full superstep (those blocks cut zero-count); the
-        # overshot indices must themselves stay int32, or `b < total`
-        # comparisons wrap negative and resurrect word-0 blocks.
-        if (
-            total_blocks + (steps + 1) * cfg.num_blocks * n_devices
-            >= (1 << 31)
-        ):
-            return None
-        ctx = self._step_ctx
-        hit_cap = int(cfg.superstep_hit_cap)
-        common = dict(
-            out_width=plan.out_width, block_stride=stride, steps=steps,
-            hit_cap=hit_cap, total_blocks=total_blocks,
-            windowed=bool(getattr(plan, "windowed", False)),
-            fused_expand_opts=ctx["fused_opts"],
-            fused_scalar_units=ctx["scalar_units"], radix2=ctx["radix2"],
-            pieces=ctx["pieces"],
-        )
-        p, t, darrs = ctx["arrays"]
-        if n_devices == 1:
-            from ..models.attack import superstep_buffers
-
-            step = make_superstep_step(
-                self.spec, num_lanes=cfg.lanes, num_blocks=cfg.num_blocks,
-                **common,
-            )
-            ss = superstep_arrays(plan, stride)
-            make_bufs = lambda: superstep_buffers(hit_cap)  # noqa: E731
-
-            def call(b: int, bufs):
-                return step(p, t, darrs, ss, np.int32(b), bufs)
-        else:
-            from ..parallel.mesh import (
-                make_sharded_superstep_step,
-                replicate,
-                shard_leading,
-            )
-
-            step = make_sharded_superstep_step(
-                self.spec, mesh, lanes_per_device=cfg.lanes,
-                num_blocks=cfg.num_blocks, **common,
-            )
-            ss = replicate(mesh, superstep_arrays(plan, stride))
-            nb = cfg.num_blocks
-
-            def make_bufs():
-                per_dev = hit_cap + 1
-                return shard_leading(mesh, {
-                    "hit_word": np.full(
-                        (n_devices * per_dev,), -1, np.int32
-                    ),
-                    "hit_rank": np.zeros(
-                        (n_devices * per_dev,), np.int32
-                    ),
-                })
-
-            def call(b: int, bufs):
-                b0_dev = shard_leading(mesh, np.asarray(
-                    [b + d * nb for d in range(n_devices)], np.int32
-                ))
-                return step(p, t, darrs, ss, b0_dev, bufs)
-
-        return {
-            "call": call,
-            "make_bufs": make_bufs,
-            "depth": self._pipeline_depth(),
-            "steps": steps,
-            "stride": stride,
-            "cum": cum,
-            "total_blocks": total_blocks,
-            "hit_cap": hit_cap,
-            "b0": b0,
-            "advance": steps * cfg.num_blocks * n_devices,
-        }
+        return {**st, "depth": self._pipeline_depth(), "b0": b0}
 
     def _drive_superstep(
         self, ss, state: CheckpointState, launch: Callable, n_devices: int,
         mesh, device_hit: Callable, fallback_candidate: Callable,
         prefetch, last_ckpt: List[float], process_launch_hits: Callable,
+        plan=None, row_base: int = 0,
     ) -> Dict[str, int]:
         """The superstep launch loop: one dispatch and ONE device→host
         fetch per ``steps`` fused launches.  The drive is double-buffered
@@ -738,8 +1078,12 @@ class Sweep:
         exact per-launch replay of that superstep's block range;
         checkpoint/progress/replay all land at the FETCHED (lagged)
         superstep boundary, and the loop exits only once the in-flight
-        superstep is drained."""
-        cfg, plan = self.config, self.plan
+        superstep is drained.  ``plan``/``row_base`` scope the drive to
+        one compiled plan region (a streaming chunk: plan rows are
+        dictionary rows ``row_base + local``); the whole-dictionary path
+        passes neither."""
+        cfg = self.config
+        plan = self.plan if plan is None else plan
         cum, stride = ss["cum"], ss["stride"]
         total_blocks, hit_cap = ss["total_blocks"], ss["hit_cap"]
         advance, depth = ss["advance"], ss["depth"]
@@ -757,6 +1101,8 @@ class Sweep:
             # The ONE per-superstep fetch — the completion barrier for
             # superstep N only (N+1 keeps running on device).
             ne, nh = (int(x) for x in np.asarray(out["counters"]))
+            if self._ttfc[0] is None:
+                self._ttfc[0] = time.monotonic()
             end_b = min(sb0 + advance, total_blocks)
             end_w, end_r = block_cursor(plan, stride, cum, end_b)
             if nh:
@@ -769,7 +1115,7 @@ class Sweep:
                     stats["replays"] += 1
                     self._replay_superstep(
                         sb0, end_b, ss, launch, n_devices, mesh,
-                        process_launch_hits,
+                        process_launch_hits, plan=plan,
                     )
                 else:
                     hw = np.asarray(out["hit_word"])
@@ -792,18 +1138,20 @@ class Sweep:
             # outputs onto it).
             free_bufs.append({"hit_word": out["hit_word"],
                               "hit_rank": out["hit_rank"]})
-            # Fallback words wholly before the cursor are due now.
+            # Fallback words wholly before the cursor are due now
+            # (cursors/flush are GLOBAL dictionary rows: plan-local
+            # words translate by the region's row base).
             self._flush_fallback_until(
-                end_w, state, fallback_candidate, prefetch
+                row_base + end_w, state, fallback_candidate, prefetch
             )
             state.n_emitted += ne
-            state.cursor = SweepCursor(end_w, end_r)
+            state.cursor = SweepCursor(row_base + end_w, end_r)
             stats["supersteps"] += 1
             stats["launches"] += ss["steps"]
             self._maybe_checkpoint(state, last_ckpt)
             if cfg.progress:
                 cfg.progress.update(
-                    words_done=end_w,
+                    words_done=row_base + end_w,
                     emitted=state.n_emitted,
                     hits=state.n_hits,
                 )
@@ -811,18 +1159,19 @@ class Sweep:
 
     def _replay_superstep(
         self, b_lo: int, b_hi: int, ss, launch: Callable, n_devices: int,
-        mesh, process_launch_hits: Callable,
+        mesh, process_launch_hits: Callable, plan=None,
     ) -> None:
         """Exact per-launch replay of one superstep's block range — the
         hit-buffer overflow fallback.  The host fast cutter shares the
         device cutter's index arrays, so the replay cuts the SAME blocks
         and its per-launch hit bitmasks recover every dropped hit."""
-        plan = self.plan
+        plan = self.plan if plan is None else plan
         stride, cum = ss["stride"], ss["cum"]
         w, rank = block_cursor(plan, stride, cum, b_lo)
         end = block_cursor(plan, stride, cum, b_hi)
         for segments, out, cur in self._launches(
-            SweepCursor(w, rank), launch, n_devices=n_devices, mesh=mesh
+            SweepCursor(w, rank), launch, n_devices=n_devices, mesh=mesh,
+            plan=plan,
         ):
             if int(out["n_hits"]):
                 process_launch_hits(segments, out)
@@ -833,16 +1182,19 @@ class Sweep:
 
     def _launches(
         self, cursor: SweepCursor, launch: Callable, *, n_devices: int = 1,
-        mesh=None,
+        mesh=None, plan=None,
     ) -> Iterator[Tuple[list, object, SweepCursor]]:
         """Double-buffered launch stream: yields (segments, device out,
         cursor AFTER this launch); ``segments`` is a cursor-ordered list of
         ``(batch, lane_lo, lane_hi)`` — one entry per device, slicing the
         launch's flat lane axis. Dispatch runs ``max_in_flight`` ahead of
-        fetch, so host block-cutting overlaps device execution."""
+        fetch, so host block-cutting overlaps device execution.
+        ``plan`` scopes the stream to one compiled plan region (a
+        streaming chunk); cursors here are plan-LOCAL."""
         import jax.profiler
 
         cfg = self.config
+        plan = self.plan if plan is None else plan
         stride = cfg.resolve_block_stride()
         pending: deque = deque()
         w, rank = cursor.word, cursor.rank
@@ -853,7 +1205,7 @@ class Sweep:
             with jax.profiler.TraceAnnotation("a5.host_cut_blocks"):
                 if n_devices == 1:
                     batch, w2, rank2 = make_blocks(
-                        self.plan,
+                        plan,
                         start_word=w,
                         start_rank=rank,
                         max_variants=lanes,
@@ -872,7 +1224,7 @@ class Sweep:
                     )
 
                     batches, w2, rank2 = make_device_blocks(
-                        self.plan,
+                        plan,
                         n_devices=n_devices,
                         lanes_per_device=lanes,
                         start_word=w,
@@ -952,6 +1304,46 @@ class Sweep:
     # Crack mode
     # ------------------------------------------------------------------
 
+    def _word_plan(self, w_row: int):
+        """A cached single-word plan for streaming hit re-derivation:
+        per-word plan fields are batch-independent, and the enumeration
+        scheme/out_width are forced to the prescan's global decisions,
+        so decoding (word 0, rank) here is byte-exact with the chunk
+        plan that flagged the hit — without recompiling its chunk."""
+        from ..ops.packing import slice_packed
+
+        cache = getattr(self, "_word_plan_cache", None)
+        if cache is None:
+            cache = self._word_plan_cache = {}
+        plan1 = cache.get(w_row)
+        if plan1 is None:
+            plan1 = build_plan(
+                self.spec, self.ct,
+                slice_packed(self.packed, w_row, w_row + 1),
+                out_width=self._stream["out_width"],
+                force_windowed=self._stream["windowed"],
+            )
+            cache[w_row] = plan1
+        return plan1
+
+    def _rederive_hit(self, w_row: int, rank: int) -> bytes:
+        """Candidate bytes of a checkpointed hit (resume replay).
+        Fallback-word hits carry a DFS index, not a variant rank —
+        re-derive via the oracle.  Streaming sweeps have no whole-
+        dictionary plan; a single-word mini-plan decodes the hit
+        without recompiling its (already-swept) chunk."""
+        if self._stream is None:
+            plan, row = self.plan, w_row
+        else:
+            plan, row = self._word_plan(w_row), 0
+        if plan.fallback[row]:
+            return next(
+                c
+                for i, c in enumerate(self._oracle_candidates(w_row))
+                if i == rank
+            )
+        return decode_variant(plan, self.ct, self.spec, row, rank)
+
     def run_crack(
         self,
         recorder: Optional[HitRecorder] = None,
@@ -959,27 +1351,16 @@ class Sweep:
         resume: bool = True,
     ) -> SweepResult:
         """Fused expand→hash→membership; only hits return to the host."""
-        spec, cfg, plan = self.spec, self.config, self.plan
+        cfg = self.config
         recorder = recorder if recorder is not None else HitRecorder()
         state, resumed = self._load_state(resume)
         if cfg.progress is not None:
             cfg.progress.seed_emitted(state.n_emitted)
 
-        launch, n_devices, mesh = self._make_launch("crack")
-
         # Replay checkpointed hits into the recorder (resume produces the
-        # same final hit list a never-interrupted run would). Fallback-word
-        # hits carry a DFS index, not a variant rank — re-derive via oracle.
-        fallback_set = set(self.fallback_rows)
+        # same final hit list a never-interrupted run would).
         for w_row, rank in state.hits:
-            if w_row in fallback_set:
-                cand = next(
-                    c
-                    for i, c in enumerate(self._oracle_candidates(w_row))
-                    if i == rank
-                )
-            else:
-                cand = decode_variant(plan, self.ct, spec, w_row, rank)
+            cand = self._rederive_hit(w_row, rank)
             recorder.emit(
                 HitRecord(
                     word_index=int(self.packed.index[w_row]),
@@ -1003,24 +1384,93 @@ class Sweep:
                     )
                 )
 
+        t0 = time.monotonic()
+        self._run_t0 = t0
+        self._ttfc = [None]
+        last_ckpt = [t0]
+        prefetch = self._make_prefetcher(state)
+        superstep_stats: Dict[str, int] = {}
+        stream_stats: Dict[str, float] = {}
+        try:
+            if self._stream is not None:
+                superstep_stats, stream_stats = self._run_stream(
+                    "crack", state,
+                    lambda chunk, local: self._crack_plan_region(
+                        chunk.plan, chunk.lo, chunk.payload, state, local,
+                        recorder, fallback_candidate, prefetch, last_ckpt,
+                    ),
+                    fallback_candidate, prefetch,
+                )
+            else:
+                launch, n_devices, mesh, step_ctx = self._make_launch(
+                    "crack", self.plan
+                )
+                payload = dict(launch=launch, n_devices=n_devices,
+                               mesh=mesh, step_ctx=step_ctx)
+                # A resumed streaming checkpoint's chunk marker is stale
+                # under whole-dictionary materialization.
+                state.stream = None
+                superstep_stats = self._crack_plan_region(
+                    self.plan, 0, payload, state, state.cursor,
+                    recorder, fallback_candidate, prefetch, last_ckpt,
+                )
+            # Tail: any fallback words at/after the last device word.
+            self._flush_fallback_until(
+                self.n_words, state, fallback_candidate, prefetch
+            )
+        finally:
+            if prefetch is not None:
+                prefetch.close()
+        state.cursor = SweepCursor(word=self.n_words, rank=0)
+        state.wall_s += time.monotonic() - t0
+        self._maybe_checkpoint(state, last_ckpt, force=True)
+        if cfg.progress:
+            cfg.progress.final(
+                words_done=self.n_words,
+                emitted=state.n_emitted,
+                hits=state.n_hits,
+            )
+        return SweepResult(
+            n_emitted=state.n_emitted,
+            n_hits=state.n_hits,
+            hits=recorder.hits,
+            words_done=self.n_words,
+            resumed=resumed,
+            wall_s=state.wall_s,
+            routing=dict(self.routing),
+            superstep=superstep_stats,
+            stream=stream_stats,
+        )
+
+    def _crack_plan_region(
+        self, plan, row_base: int, payload: dict, state: CheckpointState,
+        local_cursor: SweepCursor, recorder, fallback_candidate: Callable,
+        prefetch, last_ckpt: List[float],
+    ) -> Dict[str, int]:
+        """Drive the crack loop over ONE compiled plan region — the
+        whole dictionary (``row_base`` 0) or one streaming chunk (plan
+        rows are dictionary rows ``[row_base, row_base + plan.batch)``).
+        ``local_cursor`` is plan-local; everything written to ``state``
+        (cursor, hits, fallback flushes) is global.  Returns the
+        region's superstep stats ({} when the per-launch pipeline
+        ran)."""
+        spec, cfg = self.spec, self.config
+        launch, n_devices = payload["launch"], payload["n_devices"]
+        mesh, step_ctx = payload["mesh"], payload["step_ctx"]
+
         import jax
         import jax.numpy as jnp
 
-        # Per-launch counts chain into a device-side accumulator; the host
-        # fetches it once per chunk (see SweepConfig.fetch_chunk). The fetch
-        # is the completion barrier for the chunk's whole launch chain.
-        accum = jax.jit(lambda acc, ne, nh: acc + jnp.stack([ne, nh]))
-        acc_zero = jnp.zeros((2,), jnp.int32)
-
-        def device_hit(w_row: int, rank: int) -> None:
+        def device_hit(w_local: int, rank: int) -> None:
             """One device-flagged hit, shared by the per-launch and
             superstep paths: flush oracle words that sit before this
             hit's word (the hit list stays word-ordered), re-derive the
             candidate, re-verify its digest on the host, record."""
+            w_row = row_base + w_local
             self._flush_fallback_until(
                 w_row, state, fallback_candidate, prefetch
             )
-            cand = decode_variant(plan, self.ct, spec, w_row, rank)
+            cand = decode_variant(plan, self.ct, spec, w_local, rank)
             dig = self._host_digest(cand)
             # Host re-verification: the device flagged this lane;
             # its digest must really be in the target set.
@@ -1047,13 +1497,27 @@ class Sweep:
             # word-ordered.
             for batch, lo, hi in segments:
                 lanes = np.nonzero(hit[lo:hi])[0]
-                for w_row, rank in lane_cursor(plan, batch, lanes):
-                    device_hit(w_row, rank)
+                for w_local, rank in lane_cursor(plan, batch, lanes):
+                    device_hit(w_local, rank)
 
-        t0 = time.monotonic()
-        last_ckpt = [t0]
-        cursor = state.cursor
-        prefetch = self._make_prefetcher(state)
+        sstep = self._make_superstep(
+            plan, local_cursor, n_devices, mesh, step_ctx
+        )
+        if sstep is not None:
+            return self._drive_superstep(
+                sstep, state, launch, n_devices, mesh,
+                device_hit, fallback_candidate, prefetch, last_ckpt,
+                process_launch_hits, plan=plan, row_base=row_base,
+            )
+
+        # Per-launch counts chain into a device-side accumulator; the host
+        # fetches it once per chunk (see SweepConfig.fetch_chunk). The fetch
+        # is the completion barrier for the chunk's whole launch chain.
+        accum = self._get_step(
+            ("accum",),
+            lambda: jax.jit(lambda acc, ne, nh: acc + jnp.stack([ne, nh])),
+        )
+        acc_zero = jnp.zeros((2,), jnp.int32)
         chunk: List[tuple] = []
         # The device accumulator is int32: cap the chunk so a worst case of
         # every lane emitting cannot reach 2^31 counts per chunk.
@@ -1070,6 +1534,8 @@ class Sweep:
             if not chunk:
                 return
             ne_delta, nh_delta = (int(x) for x in np.asarray(acc))
+            if self._ttfc[0] is None:
+                self._ttfc[0] = time.monotonic()
             if nh_delta:
                 # Rare path: find the hit-bearing launches (scalar probe
                 # each) and fetch only their masks.
@@ -1077,18 +1543,19 @@ class Sweep:
                     if int(out_i["n_hits"]):
                         process_launch_hits(segments_i, out_i)
             end_cursor = chunk[-1][2]
+            end_word = row_base + end_cursor.word
             # Fallback words wholly before the cursor are due now.
             self._flush_fallback_until(
-                end_cursor.word, state, fallback_candidate, prefetch
+                end_word, state, fallback_candidate, prefetch
             )
             state.n_emitted += ne_delta
-            state.cursor = end_cursor
+            state.cursor = SweepCursor(end_word, end_cursor.rank)
             chunk = []
             acc = acc_zero
             self._maybe_checkpoint(state, last_ckpt)
             if cfg.progress:
                 cfg.progress.update(
-                    words_done=end_cursor.word,
+                    words_done=end_word,
                     emitted=state.n_emitted,
                     hits=state.n_hits,
                 )
@@ -1102,51 +1569,268 @@ class Sweep:
                 chunk_len = max(1, chunk_len // 2)
             last_drain[0] = time.monotonic()
 
-        superstep_stats: Dict[str, int] = {}
-        sstep = self._make_superstep(cursor, n_devices, mesh)
-        try:
-            if sstep is not None:
-                superstep_stats = self._drive_superstep(
-                    sstep, state, launch, n_devices, mesh,
-                    device_hit, fallback_candidate, prefetch, last_ckpt,
-                    process_launch_hits,
-                )
-            else:
-                for item in self._launches(
-                    cursor, launch, n_devices=n_devices, mesh=mesh
-                ):
-                    out = item[1]
-                    acc = accum(acc, out["n_emitted"], out["n_hits"])
-                    chunk.append(item)
-                    if len(chunk) >= chunk_len:
-                        drain_chunk()
+        for item in self._launches(
+            local_cursor, launch, n_devices=n_devices, mesh=mesh, plan=plan
+        ):
+            out = item[1]
+            acc = accum(acc, out["n_emitted"], out["n_hits"])
+            chunk.append(item)
+            if len(chunk) >= chunk_len:
                 drain_chunk()
-            # Tail: any fallback words at/after the last device word.
-            self._flush_fallback_until(
-                self.n_words, state, fallback_candidate, prefetch
-            )
-        finally:
-            if prefetch is not None:
-                prefetch.close()
-        state.cursor = SweepCursor(word=self.n_words, rank=0)
-        state.wall_s += time.monotonic() - t0
-        self._maybe_checkpoint(state, last_ckpt, force=True)
-        if cfg.progress:
-            cfg.progress.final(
-                words_done=self.n_words,
-                emitted=state.n_emitted,
-                hits=state.n_hits,
-            )
-        return SweepResult(
-            n_emitted=state.n_emitted,
-            n_hits=state.n_hits,
-            hits=recorder.hits,
-            words_done=self.n_words,
-            resumed=resumed,
-            wall_s=state.wall_s,
-            routing=dict(self.routing),
-            superstep=superstep_stats,
+        drain_chunk()
+        return {}
+
+    # ------------------------------------------------------------------
+    # Streaming chunk ring (PERF.md §19)
+    # ------------------------------------------------------------------
+
+    def _compile_chunk(self, kind: str, ci: int, lo: int, hi: int):
+        """ONE chunk's full compile, run on the ring's worker thread
+        (PERF.md §19): the chunk plan (enumeration scheme and out_width
+        forced to the prescan's global decisions), its PieceSchema
+        (through the on-disk cache when configured), the device plan /
+        superstep arrays (async ``device_put`` — the transfer overlaps
+        the previous chunk's device sweep), and a warmup dispatch that
+        forces any new XLA compile HERE instead of in the drive loop.
+        Returns the ring's :class:`ops.packing.PlanChunk`."""
+        import jax
+
+        from ..ops.packing import PlanChunk, slice_packed
+
+        plan = build_plan(
+            self.spec, self.ct, slice_packed(self.packed, lo, hi),
+            out_width=self._stream["out_width"],
+            force_windowed=self._stream["windowed"],
         )
+        launch, n_devices, mesh, step_ctx = self._make_launch(kind, plan)
+        payload = dict(launch=launch, n_devices=n_devices, mesh=mesh,
+                       step_ctx=step_ctx)
+        st = None
+        if kind == "crack":
+            st = self._superstep_static(plan, n_devices, mesh, step_ctx)
+            step_ctx["ss_static"] = st
+        # The warmup exists to force XLA compiles onto this worker; when
+        # the (step, argument shapes) pair already executed — equal-size
+        # chunks with equal schema structure, the step cache's whole
+        # point — the executable exists and the warmup would just burn a
+        # launch of masked device compute against the live sweep.
+        if st is not None:
+            wkey = (st["key"], _step_env_key(), _tree_shape_sig(
+                (step_ctx["arrays"][0], st["ss"])
+            ))
+            if wkey not in _WARMED_STEPS:
+                # Superstep warmup: one dispatch starting past the
+                # chunk's last block — every cut block is invalid
+                # (zero-count), the throwaway buffer set absorbs the
+                # donation, and the fetch below blocks THIS thread until
+                # compile + run finish.
+                warm = st["call"](
+                    int(st["total_blocks"]), st["make_bufs"]()
+                )
+                np.asarray(warm["counters"])
+                _WARMED_STEPS.add(wkey)
+        else:
+            # num_blocks is warm-key material the step key deliberately
+            # omits (the traced program doesn't depend on it, but the
+            # executable specializes on the [num_blocks, ...] blocks
+            # argument this warmup dispatches).
+            wkey = (step_ctx["step_key"], self.config.num_blocks,
+                    _step_env_key(),
+                    _tree_shape_sig(step_ctx["arrays"][0]))
+            if wkey not in _WARMED_STEPS:
+                if self._warm_launch(kind, launch, plan, n_devices, mesh):
+                    # Only a dispatch that actually ran proves the
+                    # executable exists (an all-fallback chunk cuts no
+                    # blocks and warms nothing).
+                    _WARMED_STEPS.add(wkey)
+        leaves = jax.tree_util.tree_leaves(step_ctx["arrays"][0])
+        if st is not None:
+            leaves += jax.tree_util.tree_leaves(st["ss"])
+        chunk_bytes = int(sum(int(getattr(x, "nbytes", 0)) for x in leaves))
+        with self._stream_lock:
+            self._stream_resident += chunk_bytes
+            self._stream_peak = max(
+                self._stream_peak, self._stream_resident
+            )
+            self._stream_chunk_max = max(self._stream_chunk_max,
+                                         chunk_bytes)
+        return PlanChunk(
+            index=ci, lo=lo, hi=hi, plan=plan,
+            pieces=step_ctx["pieces"], payload=payload,
+            host_bytes=chunk_bytes, releaser=self._release_chunk,
+        )
+
+    def _warm_launch(self, kind: str, launch: Callable, plan,
+                     n_devices: int, mesh) -> bool:
+        """Force a per-launch step's XLA compile on the worker thread:
+        cut and dispatch the region's first block batch, discard the
+        outputs (launches are pure — the drive re-cuts and re-runs it).
+        Returns whether a dispatch actually ran — a chunk that cuts no
+        blocks (every word oracle-routed) warms nothing."""
+        cfg = self.config
+        stride = cfg.resolve_block_stride()
+        if n_devices == 1:
+            batch, _w, _r = make_blocks(
+                plan, start_word=0, start_rank=0, max_variants=cfg.lanes,
+                max_blocks=cfg.num_blocks, fixed_stride=stride,
+            )
+            if batch.total == 0:
+                return False
+            blocks = block_arrays(batch, num_blocks=cfg.num_blocks)
+        else:
+            from ..parallel.mesh import (
+                make_device_blocks,
+                shard_leading,
+                stack_blocks,
+            )
+
+            batches, _w, _r = make_device_blocks(
+                plan, n_devices=n_devices, lanes_per_device=cfg.lanes,
+                start_word=0, start_rank=0, max_blocks=cfg.num_blocks,
+                fixed_stride=stride,
+            )
+            if sum(b.total for b in batches) == 0:
+                return False
+            blocks = shard_leading(
+                mesh, stack_blocks(batches, num_blocks=cfg.num_blocks)
+            )
+        out = launch(blocks)
+        # Block this worker until the compile (and the one discarded
+        # launch) completed — the drive loop must never pay it.
+        np.asarray(out["n_emitted"] if kind == "crack" else out[3])
+        return True
+
+    def _release_chunk(self, chunk) -> None:
+        """Free a consumed chunk before the ring advances: the chunk's
+        device plan + superstep arrays are deleted explicitly (the
+        shared table/digest residents and the drive's hit buffers are
+        NOT the chunk's to free); host references are dropped by
+        ``PlanChunk.release``."""
+        from ..parallel.mesh import delete_tree
+
+        ctx = chunk.payload["step_ctx"]
+        delete_tree(ctx["arrays"][0])
+        st = ctx.get("ss_static")
+        if st is not None:
+            delete_tree(st["ss"])
+        with self._stream_lock:
+            self._stream_resident -= chunk.host_bytes
+
+    def _sweep_chunks(self, compiler, drive_chunk: Callable) -> None:
+        """The chunk ring's consume loop (PERF.md §19), kept to the
+        auditable shape graftaudit's chunk-ring check pins
+        (``tools.graftaudit.transfers.audit_chunk_ring``): iterate the
+        compiler ring DIRECTLY (materializing it would resurrect the
+        O(dictionary) memory this pipeline removes), no host→device
+        transfers in the loop body (the worker thread owns every
+        transfer), and release each consumed chunk unconditionally
+        before the ring advances — resident plan memory stays
+        O(ring × chunk)."""
+        for chunk in compiler:
+            drive_chunk(chunk)
+            chunk.release()
+
+    def _run_stream(
+        self, kind: str, state: CheckpointState, drive_region: Callable,
+        fallback_candidate: Callable, prefetch,
+    ) -> "Tuple[Dict[str, int], Dict[str, float]]":
+        """The streaming drive (PERF.md §19): resume lands on the chunk
+        containing the checkpoint cursor (already-swept chunks are never
+        recompiled — the prescan plus a mini-plan per checkpointed hit
+        cover everything resume needs), then the ring sweeps chunk N
+        while the worker compiles N+1.  Returns (superstep stats merged
+        across chunks, stream stats)."""
+        from ..ops.packing import ChunkCompiler
+
+        bounds = self._stream["bounds"]
+        cw = self._stream["chunk_words"]
+        start_ci = next(
+            (ci for ci, (_lo, hi) in enumerate(bounds)
+             if state.cursor.word < hi),
+            len(bounds),
+        )
+        superstep_stats: Dict[str, int] = {}
+        stream: Dict[str, float] = {
+            "chunks": len(bounds),
+            "chunks_swept": 0,
+            "chunk_words": cw,
+            "prefetch": self._stream["prefetch"],
+            # Resident bound: the chunk being swept + the prefetch
+            # window + the one the worker may have started before the
+            # consumer released its predecessor.
+            "ring": self._stream["prefetch"] + 2,
+            "resumed_chunk": start_ci,
+        }
+        self._stream_resident = 0
+        self._stream_peak = 0
+        self._stream_chunk_max = 0
+        if start_ci >= len(bounds):
+            return superstep_stats, stream
+        compiler = ChunkCompiler(
+            lambda ci, lo, hi: self._compile_chunk(kind, ci, lo, hi),
+            bounds, start=start_ci, prefetch=self._stream["prefetch"],
+        )
+        t_drive0: List[Optional[float]] = [None]
+
+        def drive_chunk(chunk) -> None:
+            if t_drive0[0] is None:
+                t_drive0[0] = time.monotonic()
+            w = state.cursor.word
+            local = (
+                SweepCursor(w - chunk.lo, state.cursor.rank)
+                if chunk.lo <= w < chunk.hi
+                else SweepCursor(0, 0)
+            )
+            sstats = drive_region(chunk, local) or {}
+            for k, v in sstats.items():
+                if k in ("launches_per_fetch", "pipelined"):
+                    superstep_stats[k] = max(
+                        superstep_stats.get(k, 0), int(v)
+                    )
+                else:
+                    superstep_stats[k] = superstep_stats.get(k, 0) + int(v)
+            # Fallback words at the chunk's tail are due before the ring
+            # advances; the cursor lands exactly on the next chunk's lo,
+            # and the checkpoint remembers which chunk was active.
+            self._flush_fallback_until(
+                chunk.hi, state, fallback_candidate, prefetch
+            )
+            state.cursor = SweepCursor(chunk.hi, 0)
+            state.stream = {"chunk": chunk.index, "chunk_words": cw}
+            stream["chunks_swept"] += 1
+
+        try:
+            self._sweep_chunks(compiler, drive_chunk)
+        finally:
+            compiler.close()
+        t_end = time.monotonic()
+        overlap = 0.0
+        if t_drive0[0] is not None:
+            for a, b in compiler.windows:
+                overlap += max(0.0, min(b, t_end) - max(a, t_drive0[0]))
+        wall = compiler.compile_wall_s
+        first = (
+            compiler.windows[0][1] - compiler.windows[0][0]
+            if compiler.windows else 0.0
+        )
+        stream.update({
+            "compile_wall_s": wall,
+            "first_chunk_compile_s": first,
+            "compile_overlap_s": overlap,
+            # Chunk 0 compiles before anything can overlap it (that IS
+            # time-to-first-candidate); the steady ratio excludes it.
+            "overlap_ratio": (overlap / wall) if wall > 0 else 0.0,
+            "steady_overlap_ratio": (
+                overlap / (wall - first) if wall - first > 0 else 0.0
+            ),
+            "ttfc_s": (
+                self._ttfc[0] - self._run_t0
+                if self._ttfc[0] is not None else 0.0
+            ),
+            "peak_resident_plan_bytes": self._stream_peak,
+            "chunk_bytes_max": self._stream_chunk_max,
+        })
+        return superstep_stats, stream
 
     # ------------------------------------------------------------------
     # Candidates mode (reference-compatible stdout surface)
@@ -1165,68 +1849,41 @@ class Sweep:
         checkpoint and a crash are re-emitted on resume (tune the window
         with ``checkpoint_every_s``); crack mode has no such duplication —
         hits are keyed by (word, rank) in the checkpoint itself."""
-        spec, cfg, plan = self.spec, self.config, self.plan
+        cfg = self.config
         state, resumed = self._load_state(resume)
         if cfg.progress is not None:
             cfg.progress.seed_emitted(state.n_emitted)
-
-        launch, n_devices, mesh = self._make_launch("candidates")
 
         def fallback_candidate(row: int, i: int, cand: bytes) -> None:
             writer.emit(cand)
 
         t0 = time.monotonic()
+        self._run_t0 = t0
+        self._ttfc = [None]
         last_ckpt = [t0]
-        cursor = state.cursor
         prefetch = self._make_prefetcher(state)
+        stream_stats: Dict[str, float] = {}
         try:
-            for segments, out, cursor in self._launches(
-                cursor, launch, n_devices=n_devices, mesh=mesh
-            ):
-                cand, clen, _, emit = out
-                cand = np.asarray(cand)
-                clen = np.asarray(clen).astype(np.int32)
-                emit = np.asarray(emit)
-                # Segments in cursor order; within each device's lane slice,
-                # walk blocks in order — fallback words interleave at their
-                # word position. Within a fallback-free run of blocks, the
-                # write is one vectorized ragged flatten (newline planted at
-                # clen).
-                for batch, seg_lo, _seg_hi in segments:
-                    nb = len(batch.count)
-                    b0 = 0
-                    while b0 < nb:
-                        w0 = int(batch.word[b0])
-                        self._flush_fallback_until(
-                            w0, state, fallback_candidate, prefetch
-                        )
-                        b1 = b0
-                        next_fb = (
-                            self.fallback_rows[state.fallback_done]
-                            if state.fallback_done < len(self.fallback_rows)
-                            else self.n_words
-                        )
-                        while b1 < nb and int(batch.word[b1]) <= next_fb:
-                            b1 += 1
-                        lo = seg_lo + int(batch.offset[b0])
-                        hi = seg_lo + int(
-                            batch.offset[b1 - 1] + batch.count[b1 - 1]
-                        )
-                        n = self._write_lane_range(
-                            writer, cand, clen, emit, lo, hi
-                        )
-                        state.n_emitted += n
-                        b0 = b1
-                state.cursor = cursor
-                self._maybe_checkpoint(
-                    state, last_ckpt, before_save=writer.flush
+            if self._stream is not None:
+                _sstats, stream_stats = self._run_stream(
+                    "candidates", state,
+                    lambda chunk, local: self._candidates_plan_region(
+                        chunk.plan, chunk.lo, chunk.payload, state, local,
+                        writer, fallback_candidate, prefetch, last_ckpt,
+                    ),
+                    fallback_candidate, prefetch,
                 )
-                if cfg.progress:
-                    cfg.progress.update(
-                        words_done=cursor.word,
-                        emitted=state.n_emitted,
-                        hits=0,
-                    )
+            else:
+                launch, n_devices, mesh, step_ctx = self._make_launch(
+                    "candidates", self.plan
+                )
+                payload = dict(launch=launch, n_devices=n_devices,
+                               mesh=mesh, step_ctx=step_ctx)
+                state.stream = None  # see run_crack
+                self._candidates_plan_region(
+                    self.plan, 0, payload, state, state.cursor,
+                    writer, fallback_candidate, prefetch, last_ckpt,
+                )
             self._flush_fallback_until(
                 self.n_words, state, fallback_candidate, prefetch
             )
@@ -1249,7 +1906,73 @@ class Sweep:
             resumed=resumed,
             wall_s=state.wall_s,
             routing=dict(self.routing),
+            stream=stream_stats,
         )
+
+    def _candidates_plan_region(
+        self, plan, row_base: int, payload: dict, state: CheckpointState,
+        local_cursor: SweepCursor, writer: CandidateWriter,
+        fallback_candidate: Callable, prefetch, last_ckpt: List[float],
+    ) -> None:
+        """Stream one compiled plan region's candidates to ``writer`` —
+        the whole dictionary (``row_base`` 0) or one streaming chunk.
+        The region twin of :meth:`_crack_plan_region`: local cursors in,
+        global state out."""
+        cfg = self.config
+        launch, n_devices = payload["launch"], payload["n_devices"]
+        mesh = payload["mesh"]
+        for segments, out, cursor in self._launches(
+            local_cursor, launch, n_devices=n_devices, mesh=mesh, plan=plan
+        ):
+            cand, clen, _, emit = out
+            cand = np.asarray(cand)
+            clen = np.asarray(clen).astype(np.int32)
+            emit = np.asarray(emit)
+            if self._ttfc[0] is None:
+                self._ttfc[0] = time.monotonic()
+            # Segments in cursor order; within each device's lane slice,
+            # walk blocks in order — fallback words interleave at their
+            # word position. Within a fallback-free run of blocks, the
+            # write is one vectorized ragged flatten (newline planted at
+            # clen).
+            for batch, seg_lo, _seg_hi in segments:
+                nb = len(batch.count)
+                b0 = 0
+                while b0 < nb:
+                    w0 = row_base + int(batch.word[b0])
+                    self._flush_fallback_until(
+                        w0, state, fallback_candidate, prefetch
+                    )
+                    b1 = b0
+                    next_fb = (
+                        self.fallback_rows[state.fallback_done]
+                        if state.fallback_done < len(self.fallback_rows)
+                        else self.n_words
+                    )
+                    while (
+                        b1 < nb
+                        and row_base + int(batch.word[b1]) <= next_fb
+                    ):
+                        b1 += 1
+                    lo = seg_lo + int(batch.offset[b0])
+                    hi = seg_lo + int(
+                        batch.offset[b1 - 1] + batch.count[b1 - 1]
+                    )
+                    n = self._write_lane_range(
+                        writer, cand, clen, emit, lo, hi
+                    )
+                    state.n_emitted += n
+                    b0 = b1
+            state.cursor = SweepCursor(row_base + cursor.word, cursor.rank)
+            self._maybe_checkpoint(
+                state, last_ckpt, before_save=writer.flush
+            )
+            if cfg.progress:
+                cfg.progress.update(
+                    words_done=row_base + cursor.word,
+                    emitted=state.n_emitted,
+                    hits=0,
+                )
 
     @staticmethod
     def _write_lane_range(

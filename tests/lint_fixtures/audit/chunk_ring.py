@@ -1,0 +1,81 @@
+"""Chunk-ring consume-discipline fixtures (PERF.md §19).
+
+The streaming drive pops compiled chunks off the worker ring, sweeps
+each one, and releases it before the ring advances — that loop's shape
+IS the bounded-memory and compile-overlap contract.  ``clean_ring`` is
+the sanctioned form; the ``broken_*`` variants each commit one of the
+regressions ``audit_chunk_ring`` exists to catch: a synchronous
+transfer/compile inside the consume loop (serializes host work the ring
+overlaps), a materialized ring (every chunk resident at once), a
+conditional or missing release (chunks leak past the ring bound), and a
+chunk hoarded into a container (the same leak spelled differently).
+
+AST-only fixtures: the audit reads source, nothing here ever runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def clean_ring(compiler, drive_chunk):
+    for chunk in compiler:
+        drive_chunk(chunk)
+        chunk.release()
+
+
+def broken_ring_transfer(compiler, drive_chunk, jnp):
+    """Sin 1: a host→device transfer in the consume loop — the chunk's
+    arrays were supposed to be prefetched by the worker; re-shipping
+    them here barriers the sweep behind the transfer."""
+    for chunk in compiler:
+        tables = jnp.asarray(chunk.plan.tokens)
+        drive_chunk(chunk, tables)
+        chunk.release()
+
+
+def broken_ring_compile(compiler, drive_chunk, spec, ct, packed):
+    """Sin 1 spelled as a compile: building the plan in the consume
+    loop re-serializes the exact host work the ring's worker thread
+    exists to overlap."""
+    for chunk in compiler:
+        plan = build_plan(spec, ct, packed)  # noqa: F821 — AST fixture
+        drive_chunk(chunk, plan)
+        chunk.release()
+
+
+def broken_ring_materialized(compiler, drive_chunk):
+    """Sin 2: materializing the ring — every chunk compiled and resident
+    before the first sweep, O(dictionary) memory again."""
+    for chunk in list(compiler):
+        drive_chunk(chunk)
+        chunk.release()
+
+
+def broken_ring_conditional_release(compiler, drive_chunk):
+    """Sin 3: a conditional release — error paths (or hit-bearing
+    chunks, or whatever the guard keys on) leak their arrays past the
+    ring bound."""
+    for chunk in compiler:
+        ok = drive_chunk(chunk)
+        if ok:
+            chunk.release()
+
+
+def broken_ring_no_release(compiler, drive_chunk):
+    """Sin 3, fully absent: nothing ever frees the consumed chunk."""
+    done = 0
+    for chunk in compiler:
+        done += int(np.int64(drive_chunk(chunk)))
+    return done
+
+
+def broken_ring_hoard(compiler, drive_chunk):
+    """Sin 4: consumed chunks collected into a list — released or not,
+    the container keeps them alive."""
+    swept = []
+    for chunk in compiler:
+        drive_chunk(chunk)
+        swept.append(chunk)
+        chunk.release()
+    return swept

@@ -256,6 +256,80 @@ class TestDriveFetch:
 
 
 # ---------------------------------------------------------------------------
+# Streaming chunk-ring consume discipline (PERF.md §19)
+# ---------------------------------------------------------------------------
+
+
+class TestChunkRing:
+    def test_clean_ring_passes(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        assert audit_chunk_ring(mod.clean_ring, "fixture.ring") == []
+
+    def test_transfer_in_loop_flagged(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        findings = audit_chunk_ring(
+            mod.broken_ring_transfer, "fixture.ring"
+        )
+        assert any("asarray" in f.message for f in findings)
+        assert all(f.check == "chunk-ring" for f in findings)
+
+    def test_compile_in_loop_flagged(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        findings = audit_chunk_ring(
+            mod.broken_ring_compile, "fixture.ring"
+        )
+        assert any("build_plan" in f.message for f in findings)
+
+    def test_materialized_ring_flagged(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        findings = audit_chunk_ring(
+            mod.broken_ring_materialized, "fixture.ring"
+        )
+        assert any("materializ" in f.message for f in findings)
+
+    def test_conditional_release_flagged(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        findings = audit_chunk_ring(
+            mod.broken_ring_conditional_release, "fixture.ring"
+        )
+        assert any("release" in f.message for f in findings)
+
+    def test_missing_release_flagged(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        findings = audit_chunk_ring(
+            mod.broken_ring_no_release, "fixture.ring"
+        )
+        assert any("release" in f.message for f in findings)
+
+    def test_hoarded_chunk_flagged(self):
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        mod = _fixture("chunk_ring")
+        findings = audit_chunk_ring(mod.broken_ring_hoard, "fixture.ring")
+        assert any("container" in f.message for f in findings)
+
+    def test_production_chunk_ring_is_clean(self):
+        from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
+        from tools.graftaudit.transfers import audit_chunk_ring
+
+        assert audit_chunk_ring(
+            Sweep._sweep_chunks, "runtime.Sweep._sweep_chunks"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
 # Pallas bounds + grid overlap
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,468 @@
+"""Streaming plan pipeline (PERF.md §19): chunked ingestion must be
+STREAM-INVISIBLE next to whole-dictionary materialization — hits by full
+(word_index, rank, candidate) tuples, candidate streams byte-for-byte —
+across match/suball (fallback interleave), windowed plans, words
+straddling chunk boundaries, and 8-device sharding; fingerprints are
+identical so checkpoints cross paths both ways, mid-chunk resume never
+recompiles swept chunks, and resident plan memory is bounded by
+ring × chunk.  Plus the ``A5GEN_STREAM`` escape hatch, the on-disk
+PieceSchema cache, and the ``--stream-ab`` bench record shape
+(slow-marked: it compiles and times a subprocess bench).
+"""
+
+import hashlib
+import io
+import json
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec, build_plan
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    ChunkCompiler,
+    PlanChunk,
+    auto_chunk_words,
+    chunk_bounds,
+    load_piece_schema,
+    pack_words,
+    piece_schema_for,
+    save_piece_schema,
+    slice_packed,
+)
+from hashcat_a5_table_generator_tpu.runtime import (
+    CandidateWriter,
+    HitRecorder,
+    Sweep,
+    SweepConfig,
+    load_checkpoint,
+)
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+from tests.test_superstep import LEET, WORDS, hit_tuples, oracle_lines
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def make_sweep(spec, sub_map, words, digests=(), *, chunk, devices=1,
+               **cfg_kw):
+    cfg = SweepConfig(lanes=64, num_blocks=16, devices=devices,
+                      stream_chunk_words=chunk, **cfg_kw)
+    return Sweep(spec, sub_map, words, digests, config=cfg)
+
+
+def run_crack(spec, sub_map, words, digests, *, chunk, devices=1, **cfg_kw):
+    return make_sweep(
+        spec, sub_map, words, digests, chunk=chunk, devices=devices,
+        **cfg_kw
+    ).run_crack()
+
+
+def candidate_bytes(spec, sub_map, words, *, chunk, **cfg_kw):
+    buf = io.BytesIO()
+    with CandidateWriter(stream=buf) as writer:
+        make_sweep(
+            spec, sub_map, words, chunk=chunk, **cfg_kw
+        ).run_candidates(writer)
+    return buf.getvalue()
+
+
+class TestStreamParity:
+    """streaming == whole, bit for bit, on every mode the device runs."""
+
+    # Tier-1 budget: the default tier keeps one fast representative per
+    # claim; the heavier variants (second mode, windowed, 8-device,
+    # cross-path resume) are slow-marked per the 870 s contract.
+    @pytest.mark.parametrize("mode", [
+        "default", pytest.param("suball", marks=pytest.mark.slow),
+    ])
+    def test_crack_hits_and_counts(self, mode):
+        spec = AttackSpec(mode=mode, algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[0], oracle[len(oracle) // 2], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(40)]
+
+        whole = run_crack(spec, LEET, WORDS, digests, chunk="off")
+        streamed = run_crack(spec, LEET, WORDS, digests, chunk=2)
+        assert streamed.n_emitted == whole.n_emitted == len(oracle)
+        assert hit_tuples(streamed) == hit_tuples(whole)
+        assert {h.candidate for h in streamed.hits} == set(planted)
+        assert whole.stream == {}
+        assert streamed.stream["chunks"] == 3
+        assert streamed.stream["chunks_swept"] == 3
+
+    def test_suball_fallback_interleave_across_chunks(self):
+        # Oracle-routed hazard words sit at chunk boundaries: the global
+        # fallback bookkeeping (prescan) must interleave them exactly
+        # where the whole-dictionary plan does.
+        sub = {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+        words = [b"zz", b"acb", b"za", b"zacb", b"azz"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        fb_cand = oracle_lines(spec, sub, [b"acb"])[-1]
+        dev_cand = oracle_lines(spec, sub, [b"azz"])[-1]
+        digests = [hashlib.md5(fb_cand).digest(),
+                   hashlib.md5(dev_cand).digest()]
+
+        sweep = make_sweep(spec, sub, words, digests, chunk=2)
+        assert sweep.fallback_rows, "fixture must exercise fallback"
+        streamed = sweep.run_crack()
+        whole = run_crack(spec, sub, words, digests, chunk="off")
+        assert hit_tuples(streamed) == hit_tuples(whole)
+        assert {h.candidate for h in streamed.hits} == {fb_cand, dev_cand}
+
+    @pytest.mark.slow
+    def test_windowed_plan_forced_globally(self):
+        # The count-windowed decision is a BATCH-level gate; chunks must
+        # inherit the global decision or ranks renumber mid-sweep.
+        spec = AttackSpec(mode="default", algo="md5",
+                          min_substitute=1, max_substitute=1)
+        oracle = oracle_lines(spec, LEET, WORDS)
+        digests = [hashlib.md5(oracle[0]).digest(),
+                   hashlib.md5(oracle[-1]).digest()]
+        whole_sweep = make_sweep(spec, LEET, WORDS, digests, chunk="off")
+        assert whole_sweep.plan.windowed
+        stream_sweep = make_sweep(spec, LEET, WORDS, digests, chunk=2)
+        assert stream_sweep._stream["windowed"]
+        assert stream_sweep.fingerprint == whole_sweep.fingerprint
+        whole = whole_sweep.run_crack()
+        streamed = stream_sweep.run_crack()
+        assert hit_tuples(streamed) == hit_tuples(whole)
+        assert streamed.n_emitted == whole.n_emitted == len(oracle)
+
+    @pytest.mark.parametrize("mode", ["default", "suball"])
+    def test_candidates_byte_parity(self, mode):
+        sub = (
+            LEET if mode == "default"
+            else {b"a": [b"c"], b"cb": [b"Z"], b"z": [b"q"]}
+        )
+        words = (
+            WORDS if mode == "default"
+            else [b"zz", b"acb", b"za", b"zacb", b"azz"]
+        )
+        spec = AttackSpec(mode=mode, algo="md5")
+        whole = candidate_bytes(spec, sub, words, chunk="off")
+        streamed = candidate_bytes(spec, sub, words, chunk=2)
+        assert streamed == whole
+
+    def test_boundary_straddling_bucket_words(self):
+        # chunk=1: every word is its own chunk, and lanes=64 splits each
+        # word's variant space across many launches — every boundary is
+        # a chunk boundary AND a launch boundary.
+        spec = AttackSpec(mode="default", algo="md5")
+        whole = candidate_bytes(spec, LEET, WORDS, chunk="off")
+        streamed = candidate_bytes(spec, LEET, WORDS, chunk=1)
+        assert streamed == whole
+
+    @pytest.mark.slow
+    def test_eight_device_sharded_parity(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[1], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+
+        streamed = run_crack(spec, LEET, WORDS, digests, chunk=3,
+                             devices=8)
+        whole = run_crack(spec, LEET, WORDS, digests, chunk="off",
+                          devices=8)
+        one = run_crack(spec, LEET, WORDS, digests, chunk=3)
+        assert hit_tuples(streamed) == hit_tuples(whole) == hit_tuples(one)
+        assert streamed.n_emitted == whole.n_emitted == one.n_emitted
+        assert streamed.stream["chunks_swept"] == 2
+
+    def test_auto_keeps_whole_path_for_small_dictionaries(self):
+        # 'auto' engages only past one auto-sized chunk: a 5-word
+        # dictionary stays on the whole path (it IS the chunk).
+        spec = AttackSpec(mode="default", algo="md5")
+        sweep = make_sweep(spec, LEET, WORDS,
+                           [hashlib.md5(b"nope").digest()], chunk="auto")
+        assert sweep._stream is None
+        assert sweep.plan is not None
+        assert auto_chunk_words(16) >= 1024
+
+    def test_invalid_chunk_words_raises(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        with pytest.raises(ValueError):
+            make_sweep(spec, LEET, WORDS, (), chunk=0.5)
+
+
+class TestStreamResume:
+    def test_mid_chunk_resume_completes_identically(self, tmp_path):
+        """A crash mid-dictionary leaves a plain global (word, rank)
+        cursor plus the active-chunk marker; a streaming resume starts
+        at the cursor's chunk (never recompiling swept ones) and the
+        final hit list matches an uninterrupted run."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[3], oracle[-2]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        want = run_crack(spec, LEET, WORDS, digests, chunk=2)
+
+        path = str(tmp_path / "stream.json")
+        cfg_kw = dict(checkpoint_path=path, checkpoint_every_s=0.0,
+                      superstep=1)
+
+        class Boom(Exception):
+            pass
+
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = make_sweep(spec, LEET, WORDS, digests, chunk=2, **cfg_kw)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+        assert partial.cursor.word < len(WORDS)
+
+        second = make_sweep(spec, LEET, WORDS, digests, chunk=2, **cfg_kw)
+        got = second.run_crack()
+        assert got.resumed
+        assert sorted(h.candidate for h in got.hits) == sorted(
+            h.candidate for h in want.hits
+        )
+        assert got.stream["resumed_chunk"] >= 0
+        done = load_checkpoint(path, second.fingerprint)
+        assert done.stream is not None
+        assert done.stream["chunk_words"] == 2
+
+    @pytest.mark.slow
+    def test_cross_path_resume_round_trip(self, tmp_path):
+        """streaming → whole → streaming: the fingerprint and the
+        (word, rank) cursor are path-independent, so a streaming
+        checkpoint resumes under whole-dictionary materialization and
+        its checkpoint resumes back under streaming."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, LEET, WORDS)
+        planted = sorted({oracle[1], oracle[len(oracle) // 2], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        path = str(tmp_path / "cross.json")
+        cfg_kw = dict(checkpoint_path=path, checkpoint_every_s=0.0,
+                      superstep=1)
+
+        class Boom(Exception):
+            pass
+
+        def exploding(after):
+            class R(HitRecorder):
+                def emit(self, record):
+                    super().emit(record)
+                    if len(self.hits) >= after:
+                        raise Boom()
+            return R()
+
+        with pytest.raises(Boom):
+            make_sweep(spec, LEET, WORDS, digests, chunk=2,
+                       **cfg_kw).run_crack(exploding(1))
+        with pytest.raises(Boom):
+            make_sweep(spec, LEET, WORDS, digests, chunk="off",
+                       **cfg_kw).run_crack(exploding(2))
+        got = make_sweep(spec, LEET, WORDS, digests, chunk=2,
+                         **cfg_kw).run_crack()
+        assert got.resumed
+        want = run_crack(spec, LEET, WORDS, digests, chunk=2)
+        assert sorted(h.candidate for h in got.hits) == sorted(
+            h.candidate for h in want.hits
+        )
+        assert {h.candidate for h in got.hits} == set(planted)
+
+
+class TestBoundedMemory:
+    def test_resident_plan_bytes_bounded_by_ring(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        digests = [hashlib.md5(b"nope").digest()]
+        # superstep=0: the bound is about plan arrays, and the per-launch
+        # path skips five per-chunk superstep compiles (tier-1 budget).
+        res = run_crack(spec, LEET, WORDS, digests, chunk=1, superstep=0)
+        s = res.stream
+        assert s["chunks_swept"] == len(WORDS)
+        assert s["chunk_bytes_max"] > 0
+        # The bounded-memory contract: the chunk being swept + the
+        # prefetch window + one compile in flight — NEVER the whole
+        # dictionary's plan.
+        assert (
+            s["peak_resident_plan_bytes"]
+            <= s["ring"] * s["chunk_bytes_max"]
+        )
+
+    def test_compiler_ring_caps_outstanding_chunks(self):
+        peak = [0]
+        live = [0]
+
+        def compile_fn(ci, lo, hi):
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+
+            def releaser(chunk):
+                live[0] -= 1
+
+            return PlanChunk(index=ci, lo=lo, hi=hi, releaser=releaser)
+
+        bounds = chunk_bounds(10, 2)
+        compiler = ChunkCompiler(compile_fn, bounds, prefetch=1)
+        seen = []
+        for chunk in compiler:
+            seen.append((chunk.index, chunk.lo, chunk.hi))
+            chunk.release()
+        compiler.close()
+        assert seen == [(i, lo, hi) for i, (lo, hi) in enumerate(bounds)]
+        assert peak[0] <= 3  # swept + prefetched + one being compiled
+
+    def test_compiler_propagates_worker_errors(self):
+        def compile_fn(ci, lo, hi):
+            raise RuntimeError("schema exploded")
+
+        compiler = ChunkCompiler(compile_fn, chunk_bounds(4, 2))
+        with pytest.raises(RuntimeError, match="schema exploded"):
+            next(iter(compiler))
+        compiler.close()
+
+
+class TestEscapeHatches:
+    def test_env_off_pins_whole_path(self, monkeypatch):
+        monkeypatch.setenv("A5GEN_STREAM", "off")
+        spec = AttackSpec(mode="default", algo="md5")
+        sweep = make_sweep(spec, LEET, WORDS,
+                           [hashlib.md5(b"nope").digest()], chunk=2)
+        assert sweep._stream is None
+        res = sweep.run_crack()
+        assert res.stream == {}
+
+    def test_env_typo_warns_and_keeps_default(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            stream_enabled,
+        )
+
+        monkeypatch.setenv("A5GEN_STREAM", "offf")
+        assert stream_enabled()
+        assert "A5GEN_STREAM" in capsys.readouterr().err
+
+
+class TestSchemaCache:
+    def _plan(self, words=(b"password", b"sesame")):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(LEET)
+        return spec, ct, build_plan(spec, ct, pack_words(list(words)))
+
+    def test_disk_roundtrip_hits_second_time(self, tmp_path, monkeypatch):
+        cache = str(tmp_path / "schemas")
+        _spec, ct, plan = self._plan()
+        s1 = piece_schema_for(plan, ct, cache_dir=cache)
+        assert s1 is not None
+        files = list(pathlib.Path(cache).glob("*.npz"))
+        assert len(files) == 1
+        # Second, fresh plan over identical inputs must LOAD, not build:
+        # break the builder to prove the hit.
+        import hashcat_a5_table_generator_tpu.ops.packing as packing
+
+        def boom(*a, **k):  # pragma: no cover - must never run
+            raise AssertionError("cache miss: build_piece_schema ran")
+
+        monkeypatch.setattr(packing, "build_piece_schema", boom)
+        _spec2, ct2, plan2 = self._plan()
+        s2 = piece_schema_for(plan2, ct2, cache_dir=cache)
+        assert s2 is not None
+        assert s2.groups == s1.groups
+        assert s2.kind == s1.kind and s2.max_out == s1.max_out
+        for name in ("gw", "gl", "gw16", "sel_bit", "sel_slot"):
+            a, b = getattr(s1, name), getattr(s2, name)
+            assert (a is None) == (b is None)
+            if a is not None:
+                np.testing.assert_array_equal(a, b)
+
+    def test_ineligible_plan_refusal_is_cached_too(self, tmp_path):
+        # Overlapping static spans refuse the schema; the (deterministic)
+        # refusal is cached so repeat sweeps skip the walk.
+        cache = str(tmp_path / "schemas")
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table({b"ab": [b"X"], b"b": [b"Y"]})
+        plan = build_plan(spec, ct, pack_words([b"abab"]))
+        assert piece_schema_for(plan, ct, cache_dir=cache) is None
+        files = list(pathlib.Path(cache).glob("*.npz"))
+        assert len(files) == 1
+        plan2 = build_plan(spec, ct, pack_words([b"abab"]))
+        assert piece_schema_for(plan2, ct, cache_dir=cache) is None
+
+    def test_corrupt_entry_is_a_miss_not_an_error(self, tmp_path):
+        cache = tmp_path / "schemas"
+        cache.mkdir()
+        (cache / ("ab" * 32 + ".npz")).write_bytes(b"not an npz")
+        hit, schema = load_piece_schema(str(cache), "ab" * 32)
+        assert hit is False and schema is None
+
+    @pytest.mark.slow
+    def test_sweep_config_threads_cache_dir(self, tmp_path):
+        cache = str(tmp_path / "schemas")
+        spec = AttackSpec(mode="default", algo="md5")
+        res = run_crack(
+            spec, LEET, WORDS, [hashlib.md5(b"nope").digest()],
+            chunk=2, schema_cache=cache,
+        )
+        assert res.n_emitted > 0
+        assert list(pathlib.Path(cache).glob("*.npz"))
+
+    def test_gl_table_ships_dynamic_groups_only(self):
+        # The §19 gl-slicing satellite: fixed-length groups never read a
+        # length row, so the shipped table covers exactly the dynamic
+        # groups (all-fixed schemas ship none).
+        _spec, ct, plan = self._plan()
+        schema = piece_schema_for(plan, ct)
+        dyn = [g for g in schema.groups if g.len_fixed is None]
+        if dyn:
+            assert schema.gl is not None
+            assert schema.gl.shape[1] == len(dyn)
+            assert [g.gl_idx for g in dyn] == list(range(len(dyn)))
+        else:  # pragma: no cover - fixture-dependent
+            assert schema.gl is None
+        # An all-fixed schema (single word, no substitutions varying
+        # length) must ship no gl at all.
+        spec = AttackSpec(mode="default", algo="md5")
+        ct2 = compile_table({b"a": [b"X"]})  # same-length value
+        plan2 = build_plan(spec, ct2, pack_words([b"banana"]))
+        schema2 = piece_schema_for(plan2, ct2)
+        assert schema2 is not None
+        assert all(g.len_fixed is not None for g in schema2.groups)
+        assert schema2.gl is None
+
+
+def test_slice_packed_keeps_global_indices():
+    packed = pack_words(WORDS)
+    part = slice_packed(packed, 2, 5)
+    assert part.batch == 3
+    assert list(part.index) == [2, 3, 4]
+    assert part.word(0) == WORDS[2]
+
+
+@pytest.mark.slow
+def test_bench_stream_ab_record_shape():
+    """The §19 measurement instrument: one JSON line, both arms, the
+    ttfc/overlap/resident-bytes numbers the acceptance criteria read.
+    Slow-marked: it compiles and times a subprocess bench."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--stream-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "2000"],
+        capture_output=True, timeout=540, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "stream_ingestion_ab"
+    assert rec["chunks"] >= 4
+    assert rec["whole"]["n_emitted"] == rec["streaming"]["n_emitted"] > 0
+    st = rec["streaming"]["stream"]
+    assert st["chunks_swept"] == rec["chunks"]
+    assert st["peak_resident_plan_bytes"] <= (
+        st["ring"] * st["chunk_bytes_max"]
+    )
+    assert rec["ttfc_vs_chunk_compile"] > 0
+    assert 0.0 <= rec["overlap_ratio"] <= 1.0
+    assert 0.0 <= rec["steady_overlap_ratio"] <= 1.0
+    for arm in ("whole", "streaming"):
+        assert rec[arm]["ttfc_s"] > 0
+        assert rec[arm]["wall_s"] >= rec[arm]["ttfc_s"]

@@ -275,17 +275,29 @@ def run_audit(
     # -- bodies: dead-stage + host transfers -------------------------------
     if "stages" in groups or "transfers" in groups:
         from .stages import audit_stage_text, compiled_text
-        from .transfers import audit_drive_loop, audit_host_transfers
+        from .transfers import (
+            audit_chunk_ring,
+            audit_drive_loop,
+            audit_host_transfers,
+        )
 
         if "transfers" in groups:
             # Host side of the one-fetch-per-superstep contract: the
-            # pipelined drive loop's fetch discipline (PERF.md §18).
+            # pipelined drive loop's fetch discipline (PERF.md §18),
+            # and the streaming chunk ring's consume discipline —
+            # worker-owned transfers, unconditional release (§19).
             from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
 
             findings.extend(
                 audit_drive_loop(
                     Sweep._drive_superstep,
                     "runtime.Sweep._drive_superstep",
+                )
+            )
+            findings.extend(
+                audit_chunk_ring(
+                    Sweep._sweep_chunks,
+                    "runtime.Sweep._sweep_chunks",
                 )
             )
 

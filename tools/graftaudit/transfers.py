@@ -350,6 +350,156 @@ def audit_drive_loop(fn, entry: str) -> List[AuditFinding]:
     return findings
 
 
+#: Call names that move data between host and device — none of them
+#: belong in the chunk ring's consume loop (the worker thread owns every
+#: transfer; a synchronous one in the drive barriers the sweep behind
+#: host work the ring exists to overlap).
+_RING_TRANSFER_CALLS = frozenset(
+    {
+        "device_put",
+        "device_get",
+        "replicate",
+        "shard_leading",
+        "asarray",
+        "array",
+        "plan_arrays",
+        "piece_arrays",
+        "superstep_arrays",
+        "table_arrays",
+        "digest_arrays",
+        "build_plan",
+        "piece_schema_for",
+    }
+)
+
+
+def _call_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def audit_chunk_ring(fn, entry: str) -> List[AuditFinding]:
+    """Statically audit the streaming chunk ring's consume loop
+    (PERF.md §19): the loop that pops compiled chunks off the worker
+    ring and drives the device over each one.
+
+    The contract the ring's bounded-memory and overlap claims rest on:
+
+    * the loop iterates the compiler ring DIRECTLY (a bare name) —
+      wrapping it in ``list(...)``/a comprehension materializes every
+      chunk and resurrects the O(dictionary) memory streaming removes;
+    * no host↔device transfer or plan/schema compile call in the loop
+      body — the worker thread owns those, overlapped with the sweep; a
+      synchronous one here re-serializes compile behind the device;
+    * the consumed chunk is released exactly once, UNCONDITIONALLY, as
+      a top-level statement of the loop body, before the ring advances
+      — a skipped or conditional release leaks chunks past the ring
+      bound;
+    * the loop variable never escapes into a container
+      (``.append``/``.add``) — chunk hoarding is the same leak spelled
+      differently.
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"chunk ring source unavailable for audit: {exc}",
+            )
+        ]
+    findings: List[AuditFinding] = []
+    fdef = next(
+        (n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)), None
+    )
+    loop = next(
+        (n for n in (fdef.body if fdef else []) if isinstance(n, ast.For)),
+        None,
+    )
+    if loop is None:
+        findings.append(
+            AuditFinding(
+                "config", entry,
+                "chunk ring has no top-level for loop to audit",
+            )
+        )
+        return findings
+    if not isinstance(loop.iter, ast.Name):
+        findings.append(
+            AuditFinding(
+                "chunk-ring", entry,
+                "chunk loop does not iterate the compiler ring directly "
+                "— materializing the ring (list(...), a comprehension) "
+                "holds every chunk at once and voids the O(ring × "
+                "chunk) memory bound (PERF.md §19)",
+            )
+        )
+    loop_vars = _assigned_names(loop.target)
+    for sub in ast.walk(loop):
+        if not isinstance(sub, ast.Call):
+            continue
+        name = _call_name(sub)
+        if name in _RING_TRANSFER_CALLS:
+            findings.append(
+                AuditFinding(
+                    "chunk-ring", entry,
+                    f"{name}() inside the chunk consume loop — "
+                    "transfers and plan/schema compiles belong to the "
+                    "ring's worker thread; a synchronous one here "
+                    "serializes host work the ring exists to overlap "
+                    "(PERF.md §19)",
+                )
+            )
+        if name in ("append", "appendleft", "add") and any(
+            _base_names(a) & loop_vars for a in sub.args
+        ):
+            findings.append(
+                AuditFinding(
+                    "chunk-ring", entry,
+                    "consumed chunk escapes into a container — hoarded "
+                    "chunks outlive the ring and void the bounded-"
+                    "memory contract (PERF.md §19)",
+                )
+            )
+    # Release discipline: exactly one unconditional top-level
+    # ``<chunk>.release()`` per iteration.
+    releases = 0
+    for stmt in loop.body:
+        if (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+            and stmt.value.func.attr == "release"
+            and _base_names(stmt.value.func.value) & loop_vars
+        ):
+            releases += 1
+    nested_releases = sum(
+        1
+        for sub in ast.walk(loop)
+        if isinstance(sub, ast.Call)
+        and isinstance(sub.func, ast.Attribute)
+        and sub.func.attr == "release"
+        and _base_names(sub.func.value) & loop_vars
+    )
+    if releases != 1 or nested_releases != releases:
+        findings.append(
+            AuditFinding(
+                "chunk-ring", entry,
+                f"{releases} unconditional top-level chunk release(s) "
+                f"per iteration ({nested_releases} total) — want exactly "
+                "one, before the ring advances: a missing or conditional "
+                "release leaks consumed chunks past the ring bound "
+                "(PERF.md §19)",
+            )
+        )
+    return findings
+
+
 def audit_host_transfers_jaxpr(jaxpr, entry: str) -> List[AuditFinding]:
     found = find_transfers(jaxpr)
     findings: List[AuditFinding] = []
