@@ -176,6 +176,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "sweeps of the same inputs skip schema "
                          "compilation (A5GEN_SCHEMA_CACHE is the env "
                          "equivalent)")
+    ap.add_argument("--schema-cache-max-mb", type=float, default=None,
+                    metavar="MB",
+                    help="LRU size cap on the --schema-cache directory: "
+                         "after each write, oldest-atime entries are "
+                         "evicted until the cache fits (long-lived "
+                         "service processes must not grow it without "
+                         "bound; A5GEN_SCHEMA_CACHE_MAX_MB is the env "
+                         "equivalent; default unbounded)")
     ap.add_argument("--block-layout", choices=("auto", "packed", "stride"),
                     default="auto",
                     help="variant-block layout: 'packed' = tightly-packed "
@@ -664,9 +672,16 @@ def _print_stream(res) -> None:
     s = getattr(res, "stream", None) or {}
     if not s.get("chunks_swept"):
         return
+    # A resumed streaming sweep reports its chunk position (the
+    # CheckpointState.stream marker that placed it there).
+    resumed = (
+        f", resumed at chunk {s['resumed_chunk']}"
+        if getattr(res, "resumed", False) and "resumed_chunk" in s
+        else ""
+    )
     print(
         f"{PROG}: stream: {s['chunks_swept']}/{s.get('chunks', 0)} chunks "
-        f"x {s.get('chunk_words', 0)} words, "
+        f"x {s.get('chunk_words', 0)} words{resumed}, "
         f"{100.0 * s.get('overlap_ratio', 0.0):.0f}% compile overlapped, "
         f"peak plan {s.get('peak_resident_plan_bytes', 0) / 1e6:.1f} MB "
         f"(ttfc {s.get('ttfc_s', 0.0):.2f}s)",
@@ -817,6 +832,7 @@ def _run_device(args, sub_map, packed) -> int:
         superstep=args.superstep,
         stream_chunk_words=args.stream_chunk_words,
         schema_cache=args.schema_cache,
+        schema_cache_max_mb=args.schema_cache_max_mb,
         **cfg_kw,
         packed_blocks={"auto": None, "packed": True, "stride": False}[
             args.block_layout
@@ -933,7 +949,92 @@ def _run_device(args, sub_map, packed) -> int:
     return 0
 
 
+def _build_serve_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog=f"{PROG} serve",
+        description=(
+            "Resident engine service mode (PERF.md §20): compile once, "
+            "serve many sweeps. Jobs arrive as JSONL on stdin (or a unix "
+            "socket), interleave at superstep boundaries on one device, "
+            "and share compiled programs and the schema cache; events "
+            "(hit/done/paused/...) stream back as JSONL on stdout."
+        ),
+    )
+    ap.add_argument("--socket", metavar="PATH",
+                    help="listen on a unix socket instead of stdin "
+                         "(one JSONL session per connection, all "
+                         "sharing the engine)")
+    ap.add_argument("--lanes", type=int, default=None,
+                    help="default variant lanes per launch for jobs "
+                         "that don't override it (same default as the "
+                         "sweep CLI)")
+    ap.add_argument("--blocks", type=int, default=None,
+                    help="default device block slots per launch")
+    ap.add_argument("--devices", type=_devices_arg, default=1, metavar="N",
+                    help="default device count per job")
+    ap.add_argument("--superstep", type=_superstep_arg, default=None,
+                    metavar="N|auto|off", help="default superstep knob")
+    ap.add_argument("--stream-chunk-words", type=_stream_chunk_arg,
+                    default="auto", metavar="N|auto|off",
+                    help="default streaming-ingestion knob")
+    ap.add_argument("--schema-cache", metavar="DIR",
+                    help="on-disk PieceSchema cache shared by every job")
+    ap.add_argument("--schema-cache-max-mb", type=float, default=None,
+                    metavar="MB",
+                    help="LRU size cap on the schema cache (long-lived "
+                         "process hygiene; default unbounded)")
+    ap.add_argument("--max-word-bytes", type=int, default=64 * 1024,
+                    help="reject job dictionary lines longer than this")
+    return ap
+
+
+def _run_serve(argv: Sequence[str]) -> int:
+    """``a5gen serve``: one resident engine, jobs over JSONL."""
+    args = _build_serve_parser().parse_args(argv)
+    from .runtime.engine import Engine, serve_socket, serve_stdio
+    from .runtime.sweep import SweepConfig
+
+    if args.lanes is None or args.blocks is None:
+        import jax
+
+        on_cpu = jax.default_backend() == "cpu"
+        if args.lanes is None:
+            args.lanes = (1 << 17) if on_cpu else (1 << 22)
+        if args.blocks is None and on_cpu:
+            args.blocks = 1024
+    defaults = SweepConfig(
+        lanes=args.lanes,
+        num_blocks=args.blocks,
+        devices=args.devices,
+        superstep=args.superstep,
+        stream_chunk_words=args.stream_chunk_words,
+        schema_cache=args.schema_cache,
+        schema_cache_max_mb=args.schema_cache_max_mb,
+    )
+    engine = Engine(defaults)
+    print(f"{PROG}: serving on "
+          f"{args.socket or 'stdin'} (JSONL; op=shutdown or EOF ends)",
+          file=sys.stderr)
+    try:
+        if args.socket:
+            serve_socket(engine, args.socket,
+                         max_word_bytes=args.max_word_bytes)
+        else:
+            serve_stdio(engine, sys.stdin, sys.stdout,
+                        max_word_bytes=args.max_word_bytes)
+    finally:
+        engine.close()
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "serve":
+        # Subcommand surface: the resident service mode has its own
+        # flag set (job semantics arrive per JSONL submission, not as
+        # process flags).
+        return _run_serve(list(argv[1:]))
     ap = build_parser()
     args = ap.parse_args(argv)
     if args.list_layouts:

@@ -18,6 +18,7 @@ and I/O errors propagate.
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -720,7 +721,8 @@ def _suball_piece_cols(plan) -> "tuple | None":
     return pos, ln, opts, vstart, slot, sel_bit, closed
 
 
-def piece_schema_for(plan, ct, cache_dir: "str | None" = None
+def piece_schema_for(plan, ct, cache_dir: "str | None" = None,
+                     max_mb: "float | None" = None
                      ) -> "PieceSchema | None":
     """The per-slot emission gate: a :class:`PieceSchema` when the plan's
     static geometry supports piece emission (and ``A5GEN_EMIT`` doesn't
@@ -737,7 +739,10 @@ def piece_schema_for(plan, ct, cache_dir: "str | None" = None
     (word tokens, column geometry, value tables) + the schema format
     version — repeat sweeps of the same wordlist × table skip the
     compile entirely (the compile-once seam of the service mode,
-    ROADMAP item 1)."""
+    ROADMAP item 1).  ``max_mb`` caps the cache directory's size:
+    after a write, oldest-atime entries are evicted until it fits
+    (:func:`enforce_schema_cache_cap` — long-lived engine hygiene,
+    PERF.md §20)."""
     from ..runtime.env import emit_scheme, schema_cache_dir
 
     if emit_scheme() != "perslot":
@@ -787,6 +792,8 @@ def piece_schema_for(plan, ct, cache_dir: "str | None" = None
             if not hit:
                 schema = build_piece_schema(**build_kw)
                 save_piece_schema(cache_dir, key, schema)
+                if max_mb is not None:
+                    enforce_schema_cache_cap(cache_dir, max_mb)
         else:
             schema = build_piece_schema(**build_kw)
     try:
@@ -804,6 +811,81 @@ def piece_schema_for(plan, ct, cache_dir: "str | None" = None
 #: the version is part of the cache key, so stale entries are simply
 #: never looked up again (no in-place migration).
 SCHEMA_CACHE_VERSION = 1
+
+#: Process-wide on-disk schema-cache instrumentation (PERF.md §20):
+#: hits/misses/bytes through :func:`load_piece_schema` /
+#: :func:`save_piece_schema` plus LRU-cap evictions.  A long-lived
+#: engine process needs these to tell compile-once from
+#: compile-every-job; ``SweepResult.schema_cache`` reports per-run
+#: deltas and the resident engine reports process totals.
+_SCHEMA_CACHE_STATS = {
+    "hits": 0,
+    "misses": 0,
+    "bytes_read": 0,
+    "bytes_written": 0,
+    "evictions": 0,
+}
+_SCHEMA_CACHE_STATS_LOCK = threading.Lock()
+
+
+def schema_cache_stats() -> dict:
+    """Snapshot of the process-level schema-cache counters — each a
+    plain scalar int: hits / misses / bytes read / bytes written /
+    evictions."""
+    with _SCHEMA_CACHE_STATS_LOCK:
+        return dict(_SCHEMA_CACHE_STATS)
+
+
+def _count_cache(**deltas: int) -> None:
+    with _SCHEMA_CACHE_STATS_LOCK:
+        for key, d in deltas.items():
+            _SCHEMA_CACHE_STATS[key] += d
+
+
+def enforce_schema_cache_cap(cache_dir: str, max_mb: float) -> int:
+    """LRU size cap for a long-lived process's schema cache: evict
+    oldest-ATIME entries until the total bytes of the directory's
+    ``*.npz`` entries fit ``max_mb`` (reads touch atime, so
+    recently-hit entries survive —
+    subject to the filesystem's atime policy, which on relatime mounts
+    is granular but monotonic enough for an eviction ORDER).  Returns
+    the number of entries evicted; racing processes are tolerated (a
+    concurrently-deleted entry is skipped, and eviction of an entry
+    another process still wants is just a future miss — corrupt/absent
+    entries were already miss-not-error)."""
+    import os
+
+    cap = int(max_mb * (1 << 20))
+    try:
+        names = os.listdir(cache_dir)
+    except OSError:
+        return 0
+    entries = []
+    for name in names:
+        if not name.endswith(".npz"):
+            continue
+        path = os.path.join(cache_dir, name)
+        try:
+            st = os.stat(path)
+        except OSError:  # pragma: no cover - concurrent eviction
+            continue
+        entries.append((st.st_atime, st.st_size, path))
+    total = sum(size for _, size, _ in entries)
+    if total <= cap:
+        return 0
+    evicted = 0
+    for _atime, size, path in sorted(entries):
+        if total <= cap:
+            break
+        try:
+            os.unlink(path)
+        except OSError:  # pragma: no cover - concurrent eviction
+            continue
+        total -= size
+        evicted += 1
+    if evicted:
+        _count_cache(evictions=evicted)
+    return evicted
 
 #: PieceGroup fields serialized into a cache entry's JSON header, in
 #: constructor order.
@@ -881,7 +963,10 @@ def save_piece_schema(cache_dir: str, key: str,
             np.savez(fh, header=np.frombuffer(
                 json.dumps(header).encode(), dtype=np.uint8
             ), **arrays)
+            fh.flush()
+            written = fh.tell()
         os.replace(tmp, path)
+        _count_cache(bytes_written=written)
     except OSError:  # pragma: no cover - cache dir races/ENOSPC
         # The cache is an accelerator, never a correctness dependency:
         # a failed write just means the next run recompiles.
@@ -901,14 +986,18 @@ def load_piece_schema(cache_dir: str, key: str
 
     path = os.path.join(cache_dir, f"{key}.npz")
     if not os.path.exists(path):
+        _count_cache(misses=1)
         return False, None
     try:
+        nbytes = os.stat(path).st_size
         with np.load(path, allow_pickle=False) as data:
             header = json.loads(bytes(data["header"]).decode())
             if header.get("version") != SCHEMA_CACHE_VERSION:
+                _count_cache(misses=1)
                 return False, None
             meta = header["schema"]
             if meta is None:
+                _count_cache(hits=1, bytes_read=nbytes)
                 return True, None
             groups = tuple(
                 PieceGroup(**{
@@ -920,6 +1009,7 @@ def load_piece_schema(cache_dir: str, key: str
                 name: (np.asarray(data[name]) if name in data else None)
                 for name in _SCHEMA_ARRAYS
             }
+            _count_cache(hits=1, bytes_read=nbytes)
             return True, PieceSchema(
                 kind=meta["kind"],
                 groups=groups,
@@ -929,6 +1019,7 @@ def load_piece_schema(cache_dir: str, key: str
                 **arrays,
             )
     except (OSError, KeyError, ValueError, json.JSONDecodeError):
+        _count_cache(misses=1)
         return False, None
 
 

@@ -19,7 +19,8 @@ from .checkpoint import (  # noqa: F401
 from .progress import ProgressReporter  # noqa: F401
 from .sinks import CandidateWriter, HitRecord, HitRecorder  # noqa: F401
 
-_LAZY = ("Sweep", "SweepConfig", "SweepResult", "BucketedSweep")
+_LAZY = ("Sweep", "SweepConfig", "SweepResult", "BucketedSweep", "Engine",
+         "EngineJob")
 
 
 def __getattr__(name: str):
@@ -27,6 +28,10 @@ def __getattr__(name: str):
         from .bucketed import BucketedSweep
 
         return BucketedSweep
+    if name in ("Engine", "EngineJob"):
+        from . import engine
+
+        return getattr(engine, name)
     if name in _LAZY:
         from . import sweep
 

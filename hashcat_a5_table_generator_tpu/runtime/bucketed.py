@@ -158,9 +158,13 @@ class BucketedSweep:
         routing: Dict[str, int] = {}
         superstep: Dict[str, int] = {}
         stream: Dict[str, float] = {}
+        schema_cache: Dict[str, int] = {}
         for r in results:
             for k, v in r.routing.items():
                 routing[k] = routing.get(k, 0) + int(v)
+            # Schema-cache activity (PERF.md §20d): plain counter sums.
+            for k, v in getattr(r, "schema_cache", {}).items():
+                schema_cache[k] = schema_cache.get(k, 0) + int(v)
             # Superstep stats accumulate across buckets; the per-sweep
             # launches_per_fetch ratio and the pipelined flag are
             # reported as the max (buckets share one config, so they
@@ -210,6 +214,7 @@ class BucketedSweep:
             routing=routing,
             superstep=superstep,
             stream=stream,
+            schema_cache=schema_cache,
         )
 
     def run_crack(self, recorder=None, *, resume: bool = True) -> SweepResult:

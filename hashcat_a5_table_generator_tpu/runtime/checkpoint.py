@@ -125,11 +125,37 @@ def sweep_fingerprint(
     return h.hexdigest()
 
 
-def save_checkpoint(path: str, state: CheckpointState) -> None:
-    """Atomically write ``state`` as JSON (tmp file + rename)."""
+def state_to_doc(state: CheckpointState) -> Dict:
+    """``state`` as a JSON-serializable document — the on-disk
+    checkpoint format, also the wire format of the service mode's
+    pause/migrate handoff (a paused job IS its checkpoint; ranks
+    stringify because variant spaces exceed JSON's safe ints)."""
     doc = asdict(state)
     doc["cursor"] = {"word": state.cursor.word, "rank": str(state.cursor.rank)}
     doc["hits"] = [[w, str(r)] for w, r in state.hits]
+    return doc
+
+
+def state_from_doc(doc: Dict) -> CheckpointState:
+    """Inverse of :func:`state_to_doc` (no fingerprint validation here —
+    the sweep's ``_load_state`` / :func:`load_checkpoint` own that)."""
+    return CheckpointState(
+        fingerprint=doc["fingerprint"],
+        cursor=SweepCursor(
+            word=int(doc["cursor"]["word"]), rank=int(doc["cursor"]["rank"])
+        ),
+        n_emitted=int(doc["n_emitted"]),
+        n_hits=int(doc["n_hits"]),
+        hits=[(int(w), int(r)) for w, r in doc["hits"]],
+        fallback_done=int(doc.get("fallback_done", 0)),
+        wall_s=float(doc["wall_s"]),
+        stream=doc.get("stream"),
+    )
+
+
+def save_checkpoint(path: str, state: CheckpointState) -> None:
+    """Atomically write ``state`` as JSON (tmp file + rename)."""
+    doc = state_to_doc(state)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
         json.dump(doc, fh)
@@ -165,18 +191,7 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
             "(mode/window/table/wordlist/digests changed); delete it to "
             "start over"
         )
-    return CheckpointState(
-        fingerprint=doc["fingerprint"],
-        cursor=SweepCursor(
-            word=int(doc["cursor"]["word"]), rank=int(doc["cursor"]["rank"])
-        ),
-        n_emitted=int(doc["n_emitted"]),
-        n_hits=int(doc["n_hits"]),
-        hits=[(int(w), int(r)) for w, r in doc["hits"]],
-        fallback_done=int(doc.get("fallback_done", 0)),
-        wall_s=float(doc["wall_s"]),
-        stream=doc.get("stream"),
-    )
+    return state_from_doc(doc)
 
 
 def save_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> None:

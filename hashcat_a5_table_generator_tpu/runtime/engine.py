@@ -1,0 +1,847 @@
+"""Resident engine service mode (PERF.md §20, ROADMAP item 1).
+
+The north-star workload is heavy traffic from many tenants, but a cold
+CLI run pays the whole-program compile before its first candidate
+(76.7 s in BENCH_r03).  This module keeps ONE process resident: the
+:class:`Engine` owns the process-wide compiled-step cache
+(``runtime.sweep._STEP_CACHE``), the on-disk PieceSchema cache, and a
+job queue, and multiplexes many tenant sweeps through one drive loop.
+
+The substrate is the machine protocol the sweep runtime exposes
+(``Sweep.crack_machine`` / ``Sweep.candidates_machine``): each sweep is
+an explicitly resumable generator that yields at every consumed fetch
+boundary (a superstep, or a per-launch chunk drain) with its
+:class:`CheckpointState` consistent.  The engine's scheduler groups
+admitted jobs by static trace config — same-group jobs ride ONE
+compiled superstep program (the step cache dedupes the build; N equal
+small jobs cost one compile, not N) — and round-robins ``next()``
+across the machines, so jobs interleave at superstep boundaries on one
+device without ever co-mingling their (word, rank) cursors: per-job hit
+attribution is the existing cursor bookkeeping, untouched.
+
+Hits are delivered asynchronously per job: the once-per-superstep fetch
+feeds a bounded per-job queue (:meth:`EngineJob.iter_hits`), so a
+tenant streams its own hits while the engine keeps serving others.
+Pause, resume, and cancel are tenant operations riding
+:class:`CheckpointState`: pausing closes the job's machine at its last
+fetched boundary and hands back the state object — a migrating job is
+just that checkpoint submitted to another engine (same semantic inputs,
+any geometry).  A solo job through the engine is byte-identical to
+``run_crack``/``run_candidates`` by construction: the engine runs the
+SAME generator those paths exhaust.
+
+Front-ends: a Python API (``Engine.submit(...)``), and the ``a5gen
+serve`` subcommand speaking JSONL over stdin/stdout or a unix socket
+(:func:`serve_stdio` / :func:`serve_socket`) — one line per job
+submission or control op, one line per event (hit/done/paused/...).
+
+graftaudit pins the drive loop's discipline
+(``tools.graftaudit.transfers.audit_serve_loop``): the serve round
+advances each runnable job by exactly ONE boundary tick per round and
+never fetches device data itself — the machines own every device→host
+round trip, so the one-fetch-per-superstep contract (PERF.md §18)
+survives interleaving.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import json
+import queue
+import threading
+from dataclasses import replace
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .checkpoint import CheckpointState, state_from_doc, state_to_doc
+from .sinks import CandidateWriter, HitRecord
+
+
+class JobCancelled(Exception):
+    """Raised by :meth:`EngineJob.result` for a cancelled job."""
+
+
+class JobFailed(Exception):
+    """Raised by :meth:`EngineJob.result` for a failed job; ``__cause__``
+    is the machine's exception."""
+
+
+#: End-of-stream sentinel on a job's hit queue.
+_HITS_END = object()
+
+
+class EngineJob:
+    """One tenant sweep's handle: state, async hits, result, and the
+    pause/resume/cancel controls.
+
+    Lifecycle: ``queued`` → ``running`` → one of ``done`` / ``paused`` /
+    ``cancelled`` / ``failed``.  All mutation happens on the engine's
+    serve thread; the handle's events make the transitions waitable from
+    tenant threads."""
+
+    def __init__(self, job_id: str, kind: str, submit_args: dict,
+                 hit_queue_depth: int) -> None:
+        self.id = job_id
+        self.kind = kind  # 'crack' | 'candidates'
+        self.state = "queued"
+        #: the pause/migrate handoff: a deep copy of the machine's
+        #: CheckpointState, set when the job parks (and on done, for
+        #: inspection).
+        self.checkpoint: Optional[CheckpointState] = None
+        self.result_value = None
+        self.error: Optional[BaseException] = None
+        #: time-to-first-fetch relative to the machine's start (None
+        #: until known) — the warm-vs-cold instrument --serve-ab reads.
+        self.ttfc_s: Optional[float] = None
+        self._submit_args = submit_args  # engine-side resume/migrate
+        self._hits: "queue.Queue" = queue.Queue(maxsize=hit_queue_depth)
+        self._settled = threading.Event()  # done/paused/cancelled/failed
+        self._pause_req = threading.Event()
+        self._cancel_req = threading.Event()
+
+    # -- tenant surface ------------------------------------------------
+
+    def iter_hits(self):
+        """Yield this job's :class:`HitRecord` s as they are fetched
+        (bounded queue — a slow consumer backpressures the engine:
+        while this job's queue is full, NO tenant advances, so crack
+        jobs expecting more than ``hit_queue_depth`` hits must drain
+        this iterator concurrently, or raise the depth).  Ends when the
+        job settles; a paused job's stream ends too (the resumed job
+        gets a fresh handle and re-plays checkpointed hits into it)."""
+        while True:
+            try:
+                item = self._hits.get(timeout=0.2)
+            except queue.Empty:
+                # Settled with an empty queue = end of stream (the
+                # settle-side sentinel is best-effort only).
+                if self._settled.is_set() and self._hits.empty():
+                    return
+                continue
+            if item is _HITS_END:
+                return
+            yield item
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Wait until the job settles (done/paused/cancelled/failed)."""
+        return self._settled.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the job's :class:`SweepResult`.  Raises
+        :class:`JobCancelled` / :class:`JobFailed` accordingly, and
+        ``TimeoutError`` if the job has not settled in time (a PAUSED
+        job never produces a result — resume it first)."""
+        if not self._settled.wait(timeout):
+            raise TimeoutError(f"job {self.id} still {self.state}")
+        if self.state == "cancelled":
+            raise JobCancelled(f"job {self.id} was cancelled")
+        if self.state == "failed":
+            raise JobFailed(f"job {self.id} failed") from self.error
+        if self.state == "paused":
+            raise JobFailed(
+                f"job {self.id} is paused — resume it (Engine.resume or "
+                "submit its checkpoint elsewhere) to get a result"
+            )
+        return self.result_value
+
+    def request_pause(self) -> None:
+        """Ask the engine to park this job at its next superstep
+        boundary (non-blocking; see :meth:`pause`)."""
+        self._pause_req.set()
+
+    def pause(self, timeout: Optional[float] = None) -> CheckpointState:
+        """Park the job at its next fetched boundary and return its
+        CheckpointState — the migrate token another engine resumes
+        from.  Pausing an already-settled job returns its final state's
+        checkpoint if one exists."""
+        self.request_pause()
+        if not self._settled.wait(timeout):
+            raise TimeoutError(f"job {self.id} did not park in time")
+        if self.state == "paused":
+            return self.checkpoint
+        if self.state == "done":
+            # Raced completion: the sweep finished before the park.
+            return self.checkpoint
+        raise JobFailed(
+            f"job {self.id} settled as {self.state!r} before pausing"
+        ) from self.error
+
+    def cancel(self) -> None:
+        """Ask the engine to drop this job at its next boundary
+        (non-blocking; in-flight device work is abandoned — the
+        machine's close runs the sweep's cleanup)."""
+        self._cancel_req.set()
+
+    # -- engine-side helpers (serve thread only) -----------------------
+
+    def _push_hit(self, record: HitRecord) -> None:
+        # Bounded backpressure, but never a deadlock the tenant cannot
+        # break: a full queue blocks the serve thread (by contract)
+        # UNTIL the consumer drains — or this job is cancelled/paused,
+        # which drops further queue delivery (the hit already sits in
+        # the machine's CheckpointState and the recorder's ordered
+        # list, so cancel loses nothing the result reports and a
+        # resumed job replays everything from its checkpoint).
+        while not (self._cancel_req.is_set() or self._pause_req.is_set()):
+            try:
+                self._hits.put(record, timeout=0.2)
+                return
+            except queue.Full:
+                continue
+
+    def _settle(self, state: str) -> None:
+        self.state = state
+        self._settled.set()
+        try:
+            # Best-effort wakeup; iter_hits also terminates on the
+            # settled flag, so a full queue cannot block settling.
+            self._hits.put_nowait(_HITS_END)
+        except queue.Full:
+            pass
+
+
+class _JobRecorder:
+    """Hit recorder feeding a job's bounded async queue while keeping
+    the ordered list the :class:`SweepResult` reports — the per-job
+    delivery seam of the once-per-superstep fetch."""
+
+    def __init__(self, job: EngineJob) -> None:
+        self.hits: List[HitRecord] = []
+        self._job = job
+
+    def emit(self, record: HitRecord) -> None:
+        self.hits.append(record)
+        self._job._push_hit(record)
+
+
+class _Slot:
+    """One admitted job on the scheduler: its Sweep, its machine, and
+    its group (static-trace-config) key."""
+
+    def __init__(self, job: EngineJob, sweep, machine, group: str,
+                 seq: int) -> None:
+        self.job = job
+        self.sweep = sweep
+        self.machine = machine
+        self.group = group
+        self.seq = seq
+
+
+class Engine:
+    """The resident multi-tenant sweep engine (PERF.md §20).
+
+    ``defaults`` seeds every job's :class:`SweepConfig` (a job's
+    ``config=`` overrides it wholesale); sharing one geometry across
+    jobs is what lets the step cache serve them all from one compiled
+    program.  ``auto=True`` (default) runs the serve loop on a daemon
+    thread; ``auto=False`` is the embedder's mode — call
+    :meth:`run_until_idle` (or :meth:`_admit` + :meth:`_serve_round`)
+    yourself, which is also how the tests make pause/cancel timing
+    deterministic."""
+
+    def __init__(self, defaults=None, *, hit_queue_depth: int = 4096,
+                 auto: bool = True) -> None:
+        from ..ops.packing import schema_cache_stats
+        from .sweep import SweepConfig, step_cache_stats
+
+        self.defaults = defaults if defaults is not None else SweepConfig()
+        self._hit_queue_depth = int(hit_queue_depth)
+        self._pending: "queue.Queue" = queue.Queue()
+        self._active: List[_Slot] = []
+        self._ids = itertools.count()
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._shutdown = False
+        self._counts = {
+            "jobs_submitted": 0, "jobs_done": 0, "jobs_failed": 0,
+            "jobs_cancelled": 0, "jobs_paused": 0, "supersteps_served": 0,
+        }
+        self._groups: Dict[str, int] = {}
+        self._step0 = step_cache_stats()
+        self._schema0 = schema_cache_stats()
+        self._thread: Optional[threading.Thread] = None
+        if auto:
+            self._thread = threading.Thread(
+                target=self._serve_forever, name="a5-engine-serve",
+                daemon=True,
+            )
+            self._thread.start()
+
+    # -- tenant surface ------------------------------------------------
+
+    def submit(
+        self,
+        spec,
+        sub_map: Dict[bytes, List[bytes]],
+        words,
+        digests: Sequence[bytes] = (),
+        *,
+        config=None,
+        kind: str = "crack",
+        writer: Optional[CandidateWriter] = None,
+        resume_state: Optional[CheckpointState] = None,
+        job_id: Optional[str] = None,
+    ) -> EngineJob:
+        """Queue one tenant sweep; returns its :class:`EngineJob`
+        handle immediately.  ``kind='crack'`` needs ``digests`` and
+        streams hits; ``kind='candidates'`` needs a ``writer``.
+        ``resume_state`` is a paused job's CheckpointState (this
+        engine's or another's) — the migrate handoff; its fingerprint
+        must match the job's semantic inputs."""
+        if kind not in ("crack", "candidates"):
+            raise ValueError(f"kind must be 'crack' or 'candidates', "
+                             f"got {kind!r}")
+        if kind == "candidates" and writer is None:
+            raise ValueError("candidates jobs need a writer=")
+        if self._shutdown:
+            raise RuntimeError("engine is shut down")
+        job = EngineJob(
+            job_id if job_id is not None else f"job-{next(self._ids)}",
+            kind,
+            dict(spec=spec, sub_map=sub_map, words=words, digests=digests,
+                 config=config, writer=writer),
+            self._hit_queue_depth,
+        )
+        job._resume_state = resume_state
+        with self._lock:
+            self._counts["jobs_submitted"] += 1
+        self._pending.put(job)
+        self._wake.set()
+        return job
+
+    def resume(self, job: EngineJob) -> EngineJob:
+        """Re-admit a PAUSED job from its checkpoint (same engine; for
+        cross-engine migration call ``other.submit(..., resume_state=
+        job.checkpoint)`` with the same semantic inputs).  Returns a
+        fresh handle under the same job id."""
+        if job.state != "paused" or job.checkpoint is None:
+            raise ValueError(f"job {job.id} is {job.state}, not paused")
+        a = job._submit_args
+        return self.submit(
+            a["spec"], a["sub_map"], a["words"], a["digests"],
+            config=a["config"], kind=job.kind, writer=a["writer"],
+            resume_state=job.checkpoint, job_id=job.id,
+        )
+
+    def stats(self) -> dict:
+        """Engine observability: job counts, static-config groups, and
+        the compile-amortization counters — compiled-program builds vs
+        cache hits (process step cache) and on-disk schema-cache
+        activity, both as deltas since this engine started."""
+        from ..ops.packing import schema_cache_stats
+        from .sweep import _stats_delta, step_cache_stats
+
+        with self._lock:
+            counts = dict(self._counts)
+            groups = dict(self._groups)
+            active = len(self._active)
+        steps = _stats_delta(self._step0, step_cache_stats())
+        return {
+            **counts,
+            "jobs_active": active,
+            "jobs_queued": self._pending.qsize(),
+            "groups": groups,
+            "programs_compiled": steps.get("misses", 0),
+            "program_cache_hits": steps.get("hits", 0),
+            "schema_cache": _stats_delta(self._schema0,
+                                         schema_cache_stats()),
+        }
+
+    def close(self, *, cancel: bool = False,
+              timeout: Optional[float] = None) -> None:
+        """Stop serving.  Default drains: queued and active jobs finish
+        first; ``cancel=True`` drops them at the next boundary."""
+        if cancel:
+            with self._lock:
+                slots = list(self._active)
+            for slot in slots:
+                slot.job.cancel()
+            while True:
+                try:
+                    job = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                # Never admitted: settle the handle here so waiters
+                # unblock (the serve thread will not see this job).
+                self._settle_counts(job, "cancelled")
+        self._shutdown = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        else:
+            # Embedder mode has no serve thread to drain the jobs —
+            # drain here so close() keeps its settle-everything
+            # contract (cancelled slots retire on their next round).
+            self.run_until_idle()
+        # A submit that raced past the shutdown check may have enqueued
+        # AFTER the serve loop exited; nothing will ever admit it —
+        # settle the stragglers so no handle waits forever.
+        while True:
+            try:
+                job = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            self._settle_counts(job, "cancelled")
+
+    def __enter__(self) -> "Engine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(cancel=exc[0] is not None)
+
+    # -- scheduler (serve thread) --------------------------------------
+
+    def _serve_forever(self) -> None:
+        while True:
+            self._admit()
+            with self._lock:
+                idle = not self._active
+            if idle:
+                if self._shutdown and self._pending.empty():
+                    return
+                self._wake.wait(0.05)
+                self._wake.clear()
+                continue
+            self._serve_round()
+
+    def run_until_idle(self) -> None:
+        """Manual-mode drive: admit and serve until no job is active or
+        queued (embedders owning the loop; tests)."""
+        while True:
+            self._admit()
+            with self._lock:
+                idle = not self._active
+            if idle and self._pending.empty():
+                return
+            self._serve_round()
+
+    def _admit(self) -> None:
+        """Drain the submission queue into scheduler slots: build each
+        job's Sweep (plan + prescan compile — host work, on this
+        thread) and its machine, and group it by static trace config so
+        same-config jobs ride one compiled program and run adjacently."""
+        while True:
+            try:
+                job = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if job._cancel_req.is_set():
+                self._settle_counts(job, "cancelled")
+                continue
+            try:
+                slot = self._build_slot(job)
+            except Exception as exc:  # noqa: BLE001 — job-scoped failure
+                job.error = exc
+                self._settle_counts(job, "failed")
+                continue
+            job.state = "running"
+            with self._lock:
+                self._active.append(slot)
+                self._groups[slot.group] = self._groups.get(slot.group,
+                                                            0) + 1
+                # Same-group jobs adjacent, groups in admission order:
+                # warm programs serve their whole group back to back.
+                self._active.sort(key=lambda s: (s.group, s.seq))
+
+    def _build_slot(self, job: EngineJob) -> _Slot:
+        from .sweep import Sweep
+
+        a = job._submit_args
+        cfg = a["config"] if a["config"] is not None else self.defaults
+        sweep = Sweep(a["spec"], a["sub_map"], a["words"], a["digests"],
+                      config=cfg)
+        if job.kind == "crack":
+            recorder = _JobRecorder(job)
+            machine = sweep.crack_machine(
+                recorder, resume=False, state=job._resume_state
+            )
+        else:
+            machine = sweep.candidates_machine(
+                a["writer"], resume=False, state=job._resume_state
+            )
+        return _Slot(job, sweep, machine, self._group_key(a["spec"], cfg),
+                     next(self._ids))
+
+    def _group_key(self, spec, cfg) -> str:
+        """Static-trace-config grouping key: jobs agreeing here trace
+        the same program shapes (the step cache's own keys add the
+        plan-derived statics; this is the scheduler-visible prefix)."""
+        return (
+            f"{spec.mode}|{spec.algo}|{spec.min_substitute}"
+            f"|{spec.max_substitute}|{cfg.lanes}|{cfg.num_blocks}"
+            f"|{cfg.devices}|{cfg.superstep}"
+        )
+
+    def _serve_round(self) -> None:
+        """One multiplexing round — the resident drive loop graftaudit
+        pins (``audit_serve_loop``, PERF.md §20): every runnable job
+        advances by exactly ONE fetched-boundary tick per round (one
+        ``next()``), so tenants interleave at superstep granularity and
+        no job monopolizes the device; the machines own every
+        device→host fetch and the one-fetch-per-superstep discipline
+        (PERF.md §18) — a fetch here would barrier every tenant behind
+        one job's in-flight work.  Control (pause/cancel) is handled at
+        the same boundaries, where each machine's CheckpointState is
+        consistent by construction."""
+        for slot in self._round_slots():
+            if slot.job._cancel_req.is_set():
+                self._retire(slot, "cancelled")
+                continue
+            if slot.job._pause_req.is_set():
+                self._park(slot)
+                continue
+            try:
+                next(slot.machine)
+            except StopIteration as done:
+                self._finish(slot, done.value)
+            except Exception as exc:  # noqa: BLE001 — job-scoped failure
+                self._drop(slot)
+                slot.job.error = exc
+                self._settle_counts(slot.job, "failed")
+            else:
+                with self._lock:
+                    self._counts["supersteps_served"] += 1
+
+    def _round_slots(self) -> List[_Slot]:
+        with self._lock:
+            return list(self._active)
+
+    def _drop(self, slot: _Slot) -> None:
+        with self._lock:
+            if slot in self._active:
+                self._active.remove(slot)
+            self._groups[slot.group] -= 1
+            if not self._groups[slot.group]:
+                del self._groups[slot.group]
+
+    def _settle_counts(self, job: EngineJob, state: str) -> None:
+        with self._lock:
+            self._counts[f"jobs_{state}"] += 1
+        job._settle(state)
+
+    def _checkpoint_of(self, slot: _Slot) -> CheckpointState:
+        """A stable copy of the machine's live CheckpointState (the
+        machine keeps mutating its own on resume elsewhere).  A job
+        parked before its machine ever ticked has no active state yet —
+        its checkpoint IS the start of the sweep (resume replays from
+        the origin cursor), never None: the pause/migrate contract
+        always hands back a resumable state."""
+        state = slot.sweep.active_state
+        if state is None:
+            state = CheckpointState(fingerprint=slot.sweep.fingerprint)
+        return copy.deepcopy(state)
+
+    def _park(self, slot: _Slot) -> None:
+        slot.machine.close()  # runs the sweep's cleanup finallys
+        self._drop(slot)
+        slot.job.checkpoint = self._checkpoint_of(slot)
+        self._settle_counts(slot.job, "paused")
+
+    def _retire(self, slot: _Slot, state: str) -> None:
+        slot.machine.close()
+        self._drop(slot)
+        self._settle_counts(slot.job, state)
+
+    def _finish(self, slot: _Slot, result) -> None:
+        self._drop(slot)
+        job = slot.job
+        job.result_value = result
+        job.checkpoint = self._checkpoint_of(slot)
+        ttfc = slot.sweep._ttfc[0]
+        job.ttfc_s = (
+            ttfc - slot.sweep._run_t0 if ttfc is not None else None
+        )
+        self._settle_counts(job, "done")
+
+
+# ---------------------------------------------------------------------------
+# JSONL service front-end (``a5gen serve``)
+# ---------------------------------------------------------------------------
+#
+# One request per line on stdin (or a unix-socket connection), one event
+# per line out.  Ops:
+#
+#   {"op": "submit", "id": "j1", <job fields>}     -> accepted, hit*, done
+#   {"op": "pause",  "id": "j1"}                   -> paused {checkpoint}
+#   {"op": "resume", "id": "j1"}                   -> accepted (same id)
+#   {"op": "cancel", "id": "j1"}                   -> cancelled
+#   {"op": "stats"}                                -> stats
+#   {"op": "shutdown"}  (or EOF)                   -> bye
+#
+# Job fields: "tables": [paths] or "table_map": {key: [subs...]} inline;
+# "dict": wordlist path or "words": [inline strings]; "digests": left-list
+# path or "digest_list": [hex strings] (crack mode — omit both for a
+# candidates job, which then needs "output": path); "algo", "mode"
+# ("default"/"reverse"/"suball"/"suball-reverse"), "table_min"/"table_max";
+# "config": SweepConfig subset {lanes, blocks, superstep, devices,
+# fetch_chunk, stream_chunk_words, schema_cache, schema_cache_max_mb};
+# "checkpoint": a previously returned pause checkpoint (migrate-in).
+
+
+#: SweepConfig fields a JSONL job may override ("blocks" aliases
+#: num_blocks to match the CLI flag).
+_JOB_CONFIG_FIELDS = {
+    "lanes": "lanes", "blocks": "num_blocks", "superstep": "superstep",
+    "devices": "devices", "fetch_chunk": "fetch_chunk",
+    "stream_chunk_words": "stream_chunk_words",
+    "schema_cache": "schema_cache",
+    "schema_cache_max_mb": "schema_cache_max_mb",
+}
+
+
+def _job_from_doc(doc: dict, defaults, max_word_bytes: int):
+    """Parse one submit document into ``Engine.submit`` arguments."""
+    from ..models.attack import AttackSpec
+    from ..tables.parser import load_tables
+
+    if "table_map" in doc:
+        sub_map = {
+            k.encode("utf-8"): [v.encode("utf-8") for v in vals]
+            for k, vals in doc["table_map"].items()
+        }
+    elif doc.get("tables"):
+        sub_map = load_tables(doc["tables"])
+    else:
+        raise ValueError("job needs 'tables' (paths) or 'table_map'")
+    if "words" in doc:
+        words = [w.encode("utf-8") for w in doc["words"]]
+    elif doc.get("dict"):
+        from ..ops.packing import read_wordlist
+
+        words = read_wordlist(doc["dict"], max_word_bytes=max_word_bytes)
+    else:
+        raise ValueError("job needs 'dict' (path) or 'words'")
+    algo = doc.get("algo", "md5")
+    crack = "digests" in doc or "digest_list" in doc
+    if "digest_list" in doc:
+        digests = [bytes.fromhex(h) for h in doc["digest_list"]]
+    elif doc.get("digests"):
+        # The CLI's left-list parser (vectorized, hashcat-style lines);
+        # a layering exception the front-end owns, not the Engine.
+        from ..cli import _read_digests
+
+        digests = _read_digests(doc["digests"], algo)
+    else:
+        digests = ()
+    mode = doc.get("mode", "default")
+    if mode not in ("default", "reverse", "suball", "suball-reverse"):
+        raise ValueError(f"unknown mode {mode!r}")
+    spec = AttackSpec(
+        mode=mode, algo=algo,
+        min_substitute=int(doc.get("table_min", 0)),
+        max_substitute=int(doc.get("table_max", 15)),
+    )
+    cfg = defaults
+    overrides = doc.get("config") or {}
+    unknown = set(overrides) - set(_JOB_CONFIG_FIELDS)
+    if unknown:
+        raise ValueError(f"unknown config field(s): {sorted(unknown)}")
+    if overrides:
+        cfg = replace(cfg, **{
+            _JOB_CONFIG_FIELDS[k]: v for k, v in overrides.items()
+        })
+    resume_state = (
+        state_from_doc(doc["checkpoint"]) if doc.get("checkpoint") else None
+    )
+    kind = "crack" if crack else "candidates"
+    writer = None
+    if kind == "candidates":
+        if not doc.get("output"):
+            raise ValueError(
+                "candidates jobs (no digests) need 'output': a path the "
+                "candidate stream is written to"
+            )
+        # A migrated-in job resumes FROM its checkpoint cursor — the
+        # candidates before it were already written; truncating the
+        # output would silently drop them, so resume appends.
+        mode = "ab" if resume_state is not None else "wb"
+        writer = CandidateWriter(open(doc["output"], mode))
+    return dict(spec=spec, sub_map=sub_map, words=words, digests=digests,
+                config=cfg, kind=kind, writer=writer,
+                resume_state=resume_state)
+
+
+class _JsonlSession:
+    """One JSONL command stream against a shared :class:`Engine`."""
+
+    def __init__(self, engine: Engine, fin, fout, *,
+                 max_word_bytes: int = 64 * 1024) -> None:
+        self._engine = engine
+        self._fin = fin
+        self._fout = fout
+        self._out_lock = threading.Lock()
+        self._max_word_bytes = max_word_bytes
+        self._jobs: Dict[str, EngineJob] = {}
+
+    def _emit(self, obj: dict) -> None:
+        with self._out_lock:
+            self._fout.write(json.dumps(obj) + "\n")
+            self._fout.flush()
+
+    def _pump_job(self, job: EngineJob) -> None:
+        """Per-job event pump (own thread): stream hits as they land,
+        then the settling event."""
+        for rec in job.iter_hits():
+            self._emit({
+                "id": job.id, "event": "hit",
+                "digest": rec.digest_hex,
+                "plain_hex": rec.candidate.hex(),
+                "word_index": rec.word_index,
+                "rank": str(rec.variant_rank),
+            })
+        # Terminal states release the candidates writer (flush + close);
+        # a PAUSED job keeps it open — resume continues the stream.
+        if job.state != "paused":
+            writer = job._submit_args.get("writer")
+            if writer is not None:
+                writer.close()
+        if job.state == "done":
+            res = job.result_value
+            done = {
+                "id": job.id, "event": "done",
+                "n_hits": res.n_hits, "n_emitted": res.n_emitted,
+                "wall_s": res.wall_s, "resumed": res.resumed,
+            }
+            if job.ttfc_s is not None:
+                done["ttfc_s"] = job.ttfc_s
+            if res.schema_cache:
+                done["schema_cache"] = res.schema_cache
+            self._emit(done)
+        elif job.state == "paused":
+            self._emit({
+                "id": job.id, "event": "paused",
+                "checkpoint": state_to_doc(job.checkpoint),
+            })
+        elif job.state == "cancelled":
+            self._emit({"id": job.id, "event": "cancelled"})
+        else:
+            self._emit({
+                "id": job.id, "event": "failed",
+                "error": f"{type(job.error).__name__}: {job.error}",
+            })
+
+    def _handle(self, doc: dict) -> bool:
+        """Dispatch one op; returns False on shutdown."""
+        op = doc.get("op", "submit")
+        jid = doc.get("id")
+        if op == "shutdown":
+            self._emit({"event": "bye"})
+            return False
+        if op == "stats":
+            self._emit({"event": "stats", **self._engine.stats()})
+            return True
+        if op == "submit":
+            kw = _job_from_doc(doc, self._engine.defaults,
+                               self._max_word_bytes)
+            try:
+                job = self._engine.submit(job_id=jid, **kw)
+            except BaseException:
+                # No job (and no pump) exists to own the candidates
+                # writer _job_from_doc opened — release it here.
+                if kw.get("writer") is not None:
+                    kw["writer"].close()
+                raise
+            self._jobs[job.id] = job
+            self._emit({"id": job.id, "event": "accepted",
+                        "kind": job.kind})
+            threading.Thread(
+                target=self._pump_job, args=(job,),
+                name=f"a5-serve-pump-{job.id}", daemon=True,
+            ).start()
+            return True
+        job = self._jobs.get(jid)
+        if job is None:
+            raise ValueError(f"unknown job id {jid!r}")
+        if op == "pause":
+            job.pause()  # the pump emits the paused event + checkpoint
+        elif op == "resume":
+            new = self._engine.resume(job)
+            self._jobs[new.id] = new
+            self._emit({"id": new.id, "event": "accepted",
+                        "kind": new.kind, "resumed": True})
+            threading.Thread(
+                target=self._pump_job, args=(new,),
+                name=f"a5-serve-pump-{new.id}", daemon=True,
+            ).start()
+        elif op == "cancel":
+            job.cancel()
+        else:
+            raise ValueError(f"unknown op {op!r}")
+        return True
+
+    def run(self) -> bool:
+        """Process the stream; True when an explicit ``shutdown`` op
+        ended it (a plain EOF — a disconnecting client — returns False,
+        so a socket server keeps serving the other sessions)."""
+        for line in self._fin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+                keep_going = self._handle(doc)
+            except Exception as exc:  # noqa: BLE001 — protocol-scoped
+                self._emit({
+                    "event": "error",
+                    "error": f"{type(exc).__name__}: {exc}",
+                })
+                continue
+            if not keep_going:
+                return True
+        return False
+
+
+def serve_stdio(engine: Engine, fin, fout, *,
+                max_word_bytes: int = 64 * 1024) -> None:
+    """Serve one JSONL command stream (``a5gen serve`` over stdin)."""
+    _JsonlSession(engine, fin, fout,
+                  max_word_bytes=max_word_bytes).run()
+
+
+def serve_socket(engine: Engine, path: str, *,
+                 max_word_bytes: int = 64 * 1024,
+                 ready: Optional[Callable[[], None]] = None) -> None:
+    """Serve JSONL sessions over a unix socket at ``path`` (one session
+    per connection, all sharing ``engine``); returns when a session
+    sends an explicit ``shutdown`` op — a client that merely
+    disconnects (EOF, a health probe) ends only its own session."""
+    import os
+    import socket
+
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    stop = threading.Event()
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        srv.bind(path)
+        srv.listen()
+        srv.settimeout(0.2)
+        if ready is not None:
+            ready()
+        while not stop.is_set():
+            try:
+                conn, _addr = srv.accept()
+            except socket.timeout:
+                continue
+
+            def _session(conn=conn) -> None:
+                with conn:
+                    fin = conn.makefile("r", encoding="utf-8")
+                    fout = conn.makefile("w", encoding="utf-8")
+                    shutdown = _JsonlSession(
+                        engine, fin, fout, max_word_bytes=max_word_bytes
+                    ).run()
+                if shutdown:
+                    stop.set()
+
+            threading.Thread(
+                target=_session, name="a5-serve-conn", daemon=True
+            ).start()
+    finally:
+        srv.close()
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass

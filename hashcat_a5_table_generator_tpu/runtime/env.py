@@ -107,6 +107,35 @@ def schema_cache_dir() -> "Optional[str]":
     return read_env("A5GEN_SCHEMA_CACHE") or None
 
 
+def schema_cache_max_mb() -> "Optional[float]":
+    """LRU size cap (MB) on the on-disk PieceSchema cache
+    (``A5GEN_SCHEMA_CACHE_MAX_MB``; empty/unset = unbounded).
+    ``SweepConfig.schema_cache_max_mb`` / ``--schema-cache-max-mb``
+    override this per run; an unparseable value warns once and keeps
+    the cache unbounded — a typo must not start evicting."""
+    val = read_env("A5GEN_SCHEMA_CACHE_MAX_MB")
+    if val in (None, ""):
+        return None
+    try:
+        mb = float(val)
+        if mb <= 0:
+            raise ValueError
+    except ValueError:
+        name_val = ("A5GEN_SCHEMA_CACHE_MAX_MB", val)
+        if name_val not in _WARNED:
+            _WARNED.add(name_val)
+            import sys
+
+            print(
+                f"a5gen: warning: unrecognized "
+                f"A5GEN_SCHEMA_CACHE_MAX_MB={val!r} (want a positive "
+                "number of megabytes); keeping the cache unbounded",
+                file=sys.stderr,
+            )
+        return None
+    return mb
+
+
 def emit_scheme() -> str:
     """Message-emission scheme knob: ``A5GEN_EMIT`` selects between the
     per-slot piece emission (``perslot`` — the default; PERF.md §17) and

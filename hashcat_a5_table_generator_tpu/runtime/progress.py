@@ -34,12 +34,20 @@ class ProgressReporter:
         self._last_emitted = 0
         self._last_t = self._t0
         self._routing: "dict | None" = None
+        self._stream: "dict | None" = None
 
     def set_routing(self, routing: dict) -> None:
         """Attach the sweep's word-routing counts (device_clean /
         device_closed / oracle_fallback — a plan-time fact, constant over
         the run); included in every progress line once known."""
         self._routing = dict(routing)
+
+    def set_stream(self, stream: dict) -> None:
+        """Attach a streaming sweep's chunk position
+        (``CheckpointState.stream``: the active ``{"chunk", "chunk_words"}``
+        marker — updated per chunk, seeded immediately on a resumed
+        streaming sweep); included in every progress line once known."""
+        self._stream = dict(stream)
 
     def seed_emitted(self, emitted: int) -> None:
         """Base the first rate window on a resumed sweep's prior count, so
@@ -66,6 +74,8 @@ class ProgressReporter:
         }
         if self._routing is not None:
             body["routing"] = self._routing
+        if self._stream is not None:
+            body["stream"] = self._stream
         print(
             json.dumps({"progress": body}),
             file=self.stream,

@@ -92,6 +92,13 @@ from .sinks import CandidateWriter, HitRecord, HitRecorder
 #: process are few.
 _STEP_CACHE: Dict = {}
 _STEP_CACHE_LOCK = threading.Lock()
+#: Process-wide step-cache instrumentation: a miss is a program BUILD
+#: (trace + XLA compile on first dispatch), a hit is a job riding an
+#: already-built program — the compile-amortization number the resident
+#: engine's stats and ``bench.py --serve-ab`` report (PERF.md §20).
+_STEP_CACHE_STATS: Dict[str, int] = {"hits": 0, "misses": 0}
+
+
 #: (step key, argument-shape signature) pairs already executed — the
 #: streaming chunk worker's warmup dispatch is skipped when the
 #: compiled executable demonstrably exists (PERF.md §19).
@@ -102,10 +109,38 @@ _STEP_ENV_KNOBS = ("A5GEN_PALLAS", "A5GEN_PALLAS_G",
                    "A5GEN_PALLAS_INTERPRET")
 
 
+def step_cache_stats() -> Dict[str, int]:
+    """Snapshot of the process-level compiled-step cache counters."""
+    with _STEP_CACHE_LOCK:
+        return dict(_STEP_CACHE_STATS)
+
+
 def _step_env_key() -> tuple:
     from .env import env_str
 
     return tuple(env_str(k) for k in _STEP_ENV_KNOBS)
+
+
+def _exhaust(machine: "Iterator") -> "SweepResult":
+    """Run a sweep machine to completion and return its result — the
+    solo (non-interleaved) drive ``run_crack``/``run_candidates`` wrap
+    around the machine protocol (PERF.md §20)."""
+    while True:
+        try:
+            next(machine)
+        except StopIteration as done:
+            return done.value
+
+
+def _stats_delta(before: Dict[str, int], after: Dict[str, int]
+                 ) -> Dict[str, int]:
+    """Nonzero counter deltas between two stats snapshots (the run's
+    share of the process-wide schema-cache activity)."""
+    return {
+        k: after[k] - before.get(k, 0)
+        for k in after
+        if after[k] - before.get(k, 0)
+    }
 
 
 def _tree_shape_sig(tree) -> tuple:
@@ -217,6 +252,11 @@ class SweepConfig:
     #   (default: A5GEN_SCHEMA_CACHE): repeat sweeps of the same
     #   wordlist x table skip schema compilation — the service mode's
     #   compile-once seam (ROADMAP item 1).
+    schema_cache_max_mb: Optional[float] = None  # LRU size cap on the
+    #   on-disk schema cache (default: A5GEN_SCHEMA_CACHE_MAX_MB; None =
+    #   unbounded): after each write the cache evicts oldest-atime
+    #   entries until it fits — long-lived engine processes must not
+    #   grow the cache without bound (PERF.md §20).
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
@@ -268,6 +308,15 @@ class SweepResult:
     #: (time to the first device results fetch) /
     #: peak_resident_plan_bytes / chunk_bytes_max / ring
     stream: Dict[str, float] = field(default_factory=dict)
+    #: on-disk PieceSchema cache activity over this run's window
+    #: (hits / misses / bytes_read / bytes_written / evictions deltas of
+    #: the PROCESS-wide ``ops.packing`` counters; empty when no cache
+    #: dir is configured or nothing was looked up — PERF.md §20).
+    #: Solo runs own their window; under a multiplexing engine,
+    #: interleaved jobs' activity lands in whichever open window
+    #: observes it — per-job attribution is ``Engine.stats()``'s
+    #: process totals, not this field.
+    schema_cache: Dict[str, int] = field(default_factory=dict)
 
 
 class _FallbackPrefetcher:
@@ -383,6 +432,10 @@ class Sweep:
         self._mesh = None
         self._ttfc: List[Optional[float]] = [None]
         self._run_t0 = 0.0
+        #: the live machine's CheckpointState (PERF.md §20): set when a
+        #: crack/candidates machine starts, read by the resident engine
+        #: for pause (deep-copied into the job's checkpoint) and stats.
+        self.active_state: Optional[CheckpointState] = None
         self._stream_lock = threading.Lock()
         self._stream_resident = 0
         self._stream_peak = 0
@@ -656,8 +709,27 @@ class Sweep:
         self._native_oracle_cache = eng
         return eng
 
-    def _load_state(self, resume: bool) -> Tuple[CheckpointState, bool]:
+    def _load_state(
+        self, resume: bool, state: "Optional[CheckpointState]" = None
+    ) -> Tuple[CheckpointState, bool]:
+        """Resolve the run's starting state: an injected in-memory
+        ``state`` (the resident engine's pause→migrate handoff — a
+        paused job IS its CheckpointState, PERF.md §20) wins over the
+        on-disk checkpoint; both validate the sweep fingerprint."""
         cfg = self.config
+        if state is not None:
+            if state.fingerprint != self.fingerprint:
+                raise ValueError(
+                    "checkpoint state was written by a different sweep "
+                    "(mode/window/table/wordlist/digests changed); it "
+                    "cannot resume this one"
+                )
+            import copy
+
+            # The caller's token stays pristine (it may be re-submitted
+            # to another engine if this resume dies); the machine
+            # mutates only its own copy.
+            return copy.deepcopy(state), True
         if resume and cfg.checkpoint_path:
             state = load_checkpoint(cfg.checkpoint_path, self.fingerprint)
             if state is not None:
@@ -688,6 +760,7 @@ class Sweep:
         key = key + (_step_env_key(),)
         with _STEP_CACHE_LOCK:
             step = _STEP_CACHE.get(key)
+            _STEP_CACHE_STATS["hits" if step is not None else "misses"] += 1
         if step is None:
             step = build()
             with _STEP_CACHE_LOCK:
@@ -709,6 +782,13 @@ class Sweep:
         from .env import schema_cache_dir
 
         return self.config.schema_cache or schema_cache_dir()
+
+    def _schema_cache_max_mb(self) -> "Optional[float]":
+        from .env import schema_cache_max_mb
+
+        if self.config.schema_cache_max_mb is not None:
+            return self.config.schema_cache_max_mb
+        return schema_cache_max_mb()
 
     def _shared_device_arrays(self, kind: str, mesh) -> tuple:
         """Chunk-independent device residents, built once per sweep:
@@ -772,7 +852,8 @@ class Sweep:
         # Per-slot piece emission (PERF.md §17; A5GEN_EMIT=bytescan opts
         # out): one schema drives the Pallas kernels AND the XLA splice.
         pieces = piece_schema_for(
-            plan, self.ct, cache_dir=self._schema_cache_dir()
+            plan, self.ct, cache_dir=self._schema_cache_dir(),
+            max_mb=self._schema_cache_max_mb(),
         )
         # ``spec`` is baked into every traced body (mode picks the
         # expansion kernel, algo the hash, the window the emit mask) —
@@ -1062,9 +1143,17 @@ class Sweep:
         mesh, device_hit: Callable, fallback_candidate: Callable,
         prefetch, last_ckpt: List[float], process_launch_hits: Callable,
         plan=None, row_base: int = 0,
-    ) -> Dict[str, int]:
+    ) -> "Iterator[None]":
         """The superstep launch loop: one dispatch and ONE device→host
-        fetch per ``steps`` fused launches.  The drive is double-buffered
+        fetch per ``steps`` fused launches.  A GENERATOR — the explicitly
+        resumable state machine of the service mode (PERF.md §20): it
+        yields once per FETCHED superstep, with ``state`` consistent at
+        that lagged boundary, so a resident engine can interleave many
+        sweeps by round-robining ``next()`` across their machines (and
+        abandon one mid-sweep: the machine's state IS the checkpoint).
+        The generator's return value (``StopIteration.value`` /
+        ``yield from``) is the region's superstep stats dict.
+        The drive is double-buffered
         over ``depth`` alternating device hit-buffer sets
         (``max_in_flight``, default 2 — PERF.md §18): superstep N+1 is
         dispatched into set B before set A's counters are fetched, so
@@ -1155,6 +1244,7 @@ class Sweep:
                     emitted=state.n_emitted,
                     hits=state.n_hits,
                 )
+            yield
         return stats
 
     def _replay_superstep(
@@ -1349,13 +1439,45 @@ class Sweep:
         recorder: Optional[HitRecorder] = None,
         *,
         resume: bool = True,
+        state: "Optional[CheckpointState]" = None,
     ) -> SweepResult:
-        """Fused expand→hash→membership; only hits return to the host."""
+        """Fused expand→hash→membership; only hits return to the host.
+
+        The implementation IS :meth:`crack_machine`, exhausted — the
+        resident engine (PERF.md §20) runs the identical generator with
+        interleaving, so a solo job through the engine is byte-identical
+        to this path by construction."""
+        return _exhaust(self.crack_machine(recorder, resume=resume,
+                                           state=state))
+
+    def crack_machine(
+        self,
+        recorder: Optional[HitRecorder] = None,
+        *,
+        resume: bool = True,
+        state: "Optional[CheckpointState]" = None,
+    ) -> "Iterator[None]":
+        """The crack sweep as an explicitly resumable state machine
+        (PERF.md §20): a generator yielding at every consumed fetch
+        boundary (superstep or chunk drain), with its
+        :class:`CheckpointState` — exposed as ``self.active_state`` —
+        consistent at each yield.  ``next()`` advances one boundary;
+        closing the generator abandons the sweep cleanly (worker
+        threads stopped, wall accounted, state at the last boundary —
+        the engine's pause/cancel); exhausting it returns the
+        :class:`SweepResult` via ``StopIteration.value``.  An injected
+        ``state`` (a paused machine's) resumes exactly like an on-disk
+        checkpoint."""
+        from ..ops.packing import schema_cache_stats
+
         cfg = self.config
         recorder = recorder if recorder is not None else HitRecorder()
-        state, resumed = self._load_state(resume)
+        state, resumed = self._load_state(resume, state)
+        self.active_state = state
+        sc0 = schema_cache_stats()
         if cfg.progress is not None:
             cfg.progress.seed_emitted(state.n_emitted)
+        self._report_stream_position(state)
 
         # Replay checkpointed hits into the recorder (resume produces the
         # same final hit list a never-interrupted run would).
@@ -1393,7 +1515,7 @@ class Sweep:
         stream_stats: Dict[str, float] = {}
         try:
             if self._stream is not None:
-                superstep_stats, stream_stats = self._run_stream(
+                superstep_stats, stream_stats = yield from self._run_stream(
                     "crack", state,
                     lambda chunk, local: self._crack_plan_region(
                         chunk.plan, chunk.lo, chunk.payload, state, local,
@@ -1410,7 +1532,7 @@ class Sweep:
                 # A resumed streaming checkpoint's chunk marker is stale
                 # under whole-dictionary materialization.
                 state.stream = None
-                superstep_stats = self._crack_plan_region(
+                superstep_stats = yield from self._crack_plan_region(
                     self.plan, 0, payload, state, state.cursor,
                     recorder, fallback_candidate, prefetch, last_ckpt,
                 )
@@ -1421,8 +1543,11 @@ class Sweep:
         finally:
             if prefetch is not None:
                 prefetch.close()
+            # In the finally so an ABANDONED machine (the engine's
+            # pause/cancel closes the generator mid-sweep) still accrues
+            # its run time into the checkpointable state.
+            state.wall_s += time.monotonic() - t0
         state.cursor = SweepCursor(word=self.n_words, rank=0)
-        state.wall_s += time.monotonic() - t0
         self._maybe_checkpoint(state, last_ckpt, force=True)
         if cfg.progress:
             cfg.progress.final(
@@ -1440,20 +1565,23 @@ class Sweep:
             routing=dict(self.routing),
             superstep=superstep_stats,
             stream=stream_stats,
+            schema_cache=_stats_delta(sc0, schema_cache_stats()),
         )
 
     def _crack_plan_region(
         self, plan, row_base: int, payload: dict, state: CheckpointState,
         local_cursor: SweepCursor, recorder, fallback_candidate: Callable,
         prefetch, last_ckpt: List[float],
-    ) -> Dict[str, int]:
+    ) -> "Iterator[None]":
         """Drive the crack loop over ONE compiled plan region — the
         whole dictionary (``row_base`` 0) or one streaming chunk (plan
         rows are dictionary rows ``[row_base, row_base + plan.batch)``).
         ``local_cursor`` is plan-local; everything written to ``state``
-        (cursor, hits, fallback flushes) is global.  Returns the
-        region's superstep stats ({} when the per-launch pipeline
-        ran)."""
+        (cursor, hits, fallback flushes) is global.  A generator in the
+        machine protocol (PERF.md §20): yields at every consumed fetch
+        boundary (superstep or per-launch chunk drain) with ``state``
+        consistent; returns the region's superstep stats ({} when the
+        per-launch pipeline ran)."""
         spec, cfg = self.spec, self.config
         launch, n_devices = payload["launch"], payload["n_devices"]
         mesh, step_ctx = payload["mesh"], payload["step_ctx"]
@@ -1504,11 +1632,11 @@ class Sweep:
             plan, local_cursor, n_devices, mesh, step_ctx
         )
         if sstep is not None:
-            return self._drive_superstep(
+            return (yield from self._drive_superstep(
                 sstep, state, launch, n_devices, mesh,
                 device_hit, fallback_candidate, prefetch, last_ckpt,
                 process_launch_hits, plan=plan, row_base=row_base,
-            )
+            ))
 
         # Per-launch counts chain into a device-side accumulator; the host
         # fetches it once per chunk (see SweepConfig.fetch_chunk). The fetch
@@ -1577,6 +1705,7 @@ class Sweep:
             chunk.append(item)
             if len(chunk) >= chunk_len:
                 drain_chunk()
+                yield
         drain_chunk()
         return {}
 
@@ -1716,7 +1845,8 @@ class Sweep:
         with self._stream_lock:
             self._stream_resident -= chunk.host_bytes
 
-    def _sweep_chunks(self, compiler, drive_chunk: Callable) -> None:
+    def _sweep_chunks(self, compiler, drive_chunk: Callable
+                      ) -> "Iterator[None]":
         """The chunk ring's consume loop (PERF.md §19), kept to the
         auditable shape graftaudit's chunk-ring check pins
         (``tools.graftaudit.transfers.audit_chunk_ring``): iterate the
@@ -1725,21 +1855,23 @@ class Sweep:
         transfers in the loop body (the worker thread owns every
         transfer), and release each consumed chunk unconditionally
         before the ring advances — resident plan memory stays
-        O(ring × chunk)."""
+        O(ring × chunk).  ``drive_chunk`` is a machine-protocol
+        generator (PERF.md §20); its boundary yields pass through."""
         for chunk in compiler:
-            drive_chunk(chunk)
+            yield from drive_chunk(chunk)
             chunk.release()
 
     def _run_stream(
         self, kind: str, state: CheckpointState, drive_region: Callable,
         fallback_candidate: Callable, prefetch,
-    ) -> "Tuple[Dict[str, int], Dict[str, float]]":
+    ) -> "Iterator[None]":
         """The streaming drive (PERF.md §19): resume lands on the chunk
         containing the checkpoint cursor (already-swept chunks are never
         recompiled — the prescan plus a mini-plan per checkpointed hit
         cover everything resume needs), then the ring sweeps chunk N
-        while the worker compiles N+1.  Returns (superstep stats merged
-        across chunks, stream stats)."""
+        while the worker compiles N+1.  A machine-protocol generator
+        (PERF.md §20; ``drive_region`` must be one too): returns
+        (superstep stats merged across chunks, stream stats)."""
         from ..ops.packing import ChunkCompiler
 
         bounds = self._stream["bounds"]
@@ -1772,7 +1904,7 @@ class Sweep:
         )
         t_drive0: List[Optional[float]] = [None]
 
-        def drive_chunk(chunk) -> None:
+        def drive_chunk(chunk) -> "Iterator[None]":
             if t_drive0[0] is None:
                 t_drive0[0] = time.monotonic()
             w = state.cursor.word
@@ -1781,7 +1913,7 @@ class Sweep:
                 if chunk.lo <= w < chunk.hi
                 else SweepCursor(0, 0)
             )
-            sstats = drive_region(chunk, local) or {}
+            sstats = (yield from drive_region(chunk, local)) or {}
             for k, v in sstats.items():
                 if k in ("launches_per_fetch", "pipelined"):
                     superstep_stats[k] = max(
@@ -1797,10 +1929,11 @@ class Sweep:
             )
             state.cursor = SweepCursor(chunk.hi, 0)
             state.stream = {"chunk": chunk.index, "chunk_words": cw}
+            self._report_stream_position(state)
             stream["chunks_swept"] += 1
 
         try:
-            self._sweep_chunks(compiler, drive_chunk)
+            yield from self._sweep_chunks(compiler, drive_chunk)
         finally:
             compiler.close()
         t_end = time.monotonic()
@@ -1832,6 +1965,20 @@ class Sweep:
         })
         return superstep_stats, stream
 
+    def _report_stream_position(self, state: CheckpointState) -> None:
+        """Surface ``CheckpointState.stream`` (the active chunk marker)
+        in the progress JSON: resumed streaming sweeps — and live ones —
+        report their chunk position, not just the global cursor.  A
+        sweep running the WHOLE-dictionary path reports nothing: a
+        streaming checkpoint's marker is stale there (the run nulls
+        it), and chunk numbering under a different chunk size would be
+        somebody else's anyway."""
+        if self._stream is None:
+            return
+        set_stream = getattr(self.config.progress, "set_stream", None)
+        if set_stream is not None and state.stream is not None:
+            set_stream(state.stream)
+
     # ------------------------------------------------------------------
     # Candidates mode (reference-compatible stdout surface)
     # ------------------------------------------------------------------
@@ -1841,6 +1988,7 @@ class Sweep:
         writer: CandidateWriter,
         *,
         resume: bool = True,
+        state: "Optional[CheckpointState]" = None,
     ) -> SweepResult:
         """Stream every candidate to ``writer`` in word order (in-word order
         is variant-rank order; per-word multiset parity with the oracle).
@@ -1848,11 +1996,30 @@ class Sweep:
         Resume is at-least-once: candidates written between the last
         checkpoint and a crash are re-emitted on resume (tune the window
         with ``checkpoint_every_s``); crack mode has no such duplication —
-        hits are keyed by (word, rank) in the checkpoint itself."""
+        hits are keyed by (word, rank) in the checkpoint itself.  The
+        implementation is :meth:`candidates_machine`, exhausted."""
+        return _exhaust(self.candidates_machine(writer, resume=resume,
+                                                state=state))
+
+    def candidates_machine(
+        self,
+        writer: CandidateWriter,
+        *,
+        resume: bool = True,
+        state: "Optional[CheckpointState]" = None,
+    ) -> "Iterator[None]":
+        """Candidates mode in the machine protocol (PERF.md §20): the
+        crack machine's twin — yields at every consumed launch batch,
+        returns the :class:`SweepResult`; see :meth:`crack_machine`."""
+        from ..ops.packing import schema_cache_stats
+
         cfg = self.config
-        state, resumed = self._load_state(resume)
+        state, resumed = self._load_state(resume, state)
+        self.active_state = state
+        sc0 = schema_cache_stats()
         if cfg.progress is not None:
             cfg.progress.seed_emitted(state.n_emitted)
+        self._report_stream_position(state)
 
         def fallback_candidate(row: int, i: int, cand: bytes) -> None:
             writer.emit(cand)
@@ -1865,7 +2032,7 @@ class Sweep:
         stream_stats: Dict[str, float] = {}
         try:
             if self._stream is not None:
-                _sstats, stream_stats = self._run_stream(
+                _sstats, stream_stats = yield from self._run_stream(
                     "candidates", state,
                     lambda chunk, local: self._candidates_plan_region(
                         chunk.plan, chunk.lo, chunk.payload, state, local,
@@ -1880,7 +2047,7 @@ class Sweep:
                 payload = dict(launch=launch, n_devices=n_devices,
                                mesh=mesh, step_ctx=step_ctx)
                 state.stream = None  # see run_crack
-                self._candidates_plan_region(
+                yield from self._candidates_plan_region(
                     self.plan, 0, payload, state, state.cursor,
                     writer, fallback_candidate, prefetch, last_ckpt,
                 )
@@ -1890,8 +2057,8 @@ class Sweep:
         finally:
             if prefetch is not None:
                 prefetch.close()
+            state.wall_s += time.monotonic() - t0  # see crack_machine
         state.cursor = SweepCursor(word=self.n_words, rank=0)
-        state.wall_s += time.monotonic() - t0
         self._maybe_checkpoint(state, last_ckpt, force=True,
                                before_save=writer.flush)
         if cfg.progress:
@@ -1907,17 +2074,19 @@ class Sweep:
             wall_s=state.wall_s,
             routing=dict(self.routing),
             stream=stream_stats,
+            schema_cache=_stats_delta(sc0, schema_cache_stats()),
         )
 
     def _candidates_plan_region(
         self, plan, row_base: int, payload: dict, state: CheckpointState,
         local_cursor: SweepCursor, writer: CandidateWriter,
         fallback_candidate: Callable, prefetch, last_ckpt: List[float],
-    ) -> None:
+    ) -> "Iterator[None]":
         """Stream one compiled plan region's candidates to ``writer`` —
         the whole dictionary (``row_base`` 0) or one streaming chunk.
         The region twin of :meth:`_crack_plan_region`: local cursors in,
-        global state out."""
+        global state out, one machine-protocol yield per consumed
+        launch (PERF.md §20)."""
         cfg = self.config
         launch, n_devices = payload["launch"], payload["n_devices"]
         mesh = payload["mesh"]
@@ -1973,6 +2142,7 @@ class Sweep:
                     emitted=state.n_emitted,
                     hits=0,
                 )
+            yield
 
     @staticmethod
     def _write_lane_range(

@@ -1,0 +1,100 @@
+"""Serve-round discipline fixtures: the resident engine's multiplexing
+loop (PERF.md §20).
+
+``clean_round`` is the sanctioned shape: one ``next()`` tick per
+runnable job per round, control handled at the same boundaries, no
+device→host fetch anywhere — the machines own the per-superstep
+barrier.  The ``broken_*`` variants commit the three serve-loop sins:
+draining one job to completion inside the round (monopolization — the
+other tenants starve), double-ticking every job (one tenant's boundary
+latency doubles everyone's), and fetching device data in the scheduler
+(barriers every tenant behind one job's in-flight superstep).
+
+AST-only fixtures: the audit reads source, nothing here ever runs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def clean_round(slots, finish, fail):
+    for slot in slots:
+        if slot.cancelled:
+            finish(slot, None)
+            continue
+        try:
+            next(slot.machine)
+        except StopIteration as done:
+            finish(slot, done.value)
+        except Exception as exc:  # noqa: BLE001 — job-scoped failure
+            fail(slot, exc)
+
+
+def broken_drain_round(slots, finish, fail):
+    """Monopolization: the first job runs to completion while every
+    other tenant waits — the whole point of interleaving at superstep
+    boundaries is gone."""
+    for slot in slots:
+        while True:
+            try:
+                next(slot.machine)
+            except StopIteration as done:
+                finish(slot, done.value)
+                break
+
+
+def broken_guarded_drain_round(slots, finish, fail):
+    """The monopolization regression hidden behind a guard: the drain
+    loop sits under an ``if``/``try`` — it still drains one tenant to
+    completion while the rest starve."""
+    for slot in slots:
+        if not slot.cancelled:
+            try:
+                while True:
+                    next(slot.machine)
+            except StopIteration as done:
+                finish(slot, done.value)
+
+
+def broken_condition_drain_round(slots, finish, fail):
+    """Monopolization written as a loop CONDITION: the tick in the
+    while test runs per iteration — the drain, spelled differently."""
+    for slot in slots:
+        while next(slot.machine, None) is not None:
+            pass
+        finish(slot, None)
+
+
+def broken_double_tick_round(slots, finish, fail):
+    """Two boundary ticks per job per round: a half-fair drain — one
+    tenant's superstep latency is now two of everyone else's."""
+    for slot in slots:
+        try:
+            next(slot.machine)
+            next(slot.machine)
+        except StopIteration as done:
+            finish(slot, done.value)
+
+
+def broken_fetch_round(slots, finish, fail):
+    """A device→host fetch in the scheduler: coercing one job's device
+    counters barriers EVERY tenant behind that job's in-flight work."""
+    for slot in slots:
+        if int(np.asarray(slot.out["counters"])[0]) > 0:
+            finish(slot, None)
+            continue
+        try:
+            next(slot.machine)
+        except StopIteration as done:
+            finish(slot, done.value)
+
+
+def broken_sync_round(slots, finish, fail):
+    """The same barrier spelled explicitly."""
+    for slot in slots:
+        slot.out["counters"].block_until_ready()
+        try:
+            next(slot.machine)
+        except StopIteration as done:
+            finish(slot, done.value)

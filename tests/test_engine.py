@@ -1,0 +1,523 @@
+"""Resident engine service mode (PERF.md §20): a solo job through the
+engine must be byte-identical to ``run_crack``/``run_candidates`` (the
+engine runs the SAME machine those paths exhaust — these tests pin it),
+multiplexed jobs keep per-job hit attribution, pause/resume/cancel ride
+``CheckpointState`` across engine instances, warm jobs share compiled
+programs (the compile-once seam), and the schema cache reports hygiene
+counters and honors its LRU cap.  Plus the JSONL service front-end and
+the ``--serve-ab`` bench record shape (slow-marked: subprocess bench).
+
+Tier-1 budget: fast tests share the test suite's 64-lane × 16-block
+geometry so the process step cache serves them all; the heavier mode
+variants are slow-marked per the 870 s contract.
+"""
+
+import hashlib
+import io
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.runtime import (
+    CandidateWriter,
+    Sweep,
+    SweepConfig,
+)
+from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+    CheckpointState,
+    SweepCursor,
+    state_from_doc,
+    state_to_doc,
+)
+from hashcat_a5_table_generator_tpu.runtime.engine import (
+    Engine,
+    JobFailed,
+    serve_stdio,
+)
+from tests.test_superstep import LEET, WORDS, hit_tuples, oracle_lines
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+LONG_WORDS = WORDS * 4  # spans several 64-lane supersteps at superstep=1
+
+
+def cfg(**kw):
+    return SweepConfig(lanes=64, num_blocks=16, **kw)
+
+
+def planted_digests(spec, sub_map, words, picks=(0, -1), decoys=20):
+    oracle = oracle_lines(spec, sub_map, words)
+    planted = sorted({oracle[i] for i in picks})
+    digests = [hashlib.md5(c).digest() for c in planted]
+    digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(decoys)]
+    return planted, digests
+
+
+def full_hits(res):
+    """Ordered full-record tuples: the byte-exact stream comparison."""
+    return [
+        (h.word_index, h.variant_rank, h.candidate, h.digest_hex)
+        for h in res.hits
+    ]
+
+
+class TestSoloParity:
+    """engine == run_crack / run_candidates, bit for bit: the engine
+    exhausts the identical machine, so a solo job cannot drift."""
+
+    @pytest.mark.parametrize("mode", [
+        "default", pytest.param("suball", marks=pytest.mark.slow),
+    ])
+    def test_crack_parity(self, mode):
+        spec = AttackSpec(mode=mode, algo="md5")
+        _planted, digests = planted_digests(spec, LEET, WORDS, (0, 7, -1))
+        want = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        eng = Engine(cfg(), auto=False)
+        job = eng.submit(spec, LEET, WORDS, digests)
+        eng.run_until_idle()
+        got = job.result(timeout=0)
+        assert full_hits(got) == full_hits(want)
+        assert got.n_emitted == want.n_emitted
+        assert got.routing == want.routing
+
+    def test_streaming_crack_parity(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        _planted, digests = planted_digests(spec, LEET, WORDS, (0, -1))
+        c = cfg(stream_chunk_words=2)
+        want = Sweep(spec, LEET, WORDS, digests, config=c).run_crack()
+        assert want.stream["chunks_swept"] == 3
+        eng = Engine(c, auto=False)
+        job = eng.submit(spec, LEET, WORDS, digests)
+        eng.run_until_idle()
+        got = job.result(timeout=0)
+        assert full_hits(got) == full_hits(want)
+        assert got.n_emitted == want.n_emitted
+        assert got.stream["chunks_swept"] == 3
+
+    def test_candidates_byte_parity(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        want = io.BytesIO()
+        with CandidateWriter(stream=want) as w:
+            Sweep(spec, LEET, WORDS, config=cfg()).run_candidates(w)
+        got = io.BytesIO()
+        eng = Engine(cfg(), auto=False)
+        job = eng.submit(spec, LEET, WORDS, kind="candidates",
+                         writer=CandidateWriter(stream=got))
+        eng.run_until_idle()
+        res = job.result(timeout=0)
+        job._submit_args["writer"].close()
+        assert got.getvalue() == want.getvalue()
+        assert res.n_emitted > 0
+
+    @pytest.mark.slow
+    def test_windowed_crack_parity(self):
+        spec = AttackSpec(mode="default", algo="md5",
+                          min_substitute=1, max_substitute=1)
+        _planted, digests = planted_digests(spec, LEET, WORDS, (0, -1))
+        sweep = Sweep(spec, LEET, WORDS, digests, config=cfg())
+        assert sweep.plan.windowed
+        want = sweep.run_crack()
+        eng = Engine(cfg(), auto=False)
+        job = eng.submit(spec, LEET, WORDS, digests)
+        eng.run_until_idle()
+        assert full_hits(job.result(timeout=0)) == full_hits(want)
+
+
+class TestMultiplexing:
+    def test_two_job_packed_superstep_parity(self):
+        """Two jobs interleave at superstep boundaries on one shared
+        compiled program; each job's hit stream is exactly its solo
+        run's — per-job (word, rank) attribution never crosses jobs.
+        (words2 is a permutation of job 1's dictionary: equal batch
+        shapes land both jobs on ONE compiled executable — the packed
+        case the scheduler groups for.)"""
+        spec = AttackSpec(mode="default", algo="md5")
+        _p1, digests1 = planted_digests(spec, LEET, LONG_WORDS, (0, 5))
+        words2 = LONG_WORDS[::-1]
+        _p2, digests2 = planted_digests(spec, LEET, words2, (1, -1))
+        c = cfg(superstep=1)
+        want1 = Sweep(spec, LEET, LONG_WORDS, digests1,
+                      config=c).run_crack()
+        want2 = Sweep(spec, LEET, words2, digests2, config=c).run_crack()
+
+        eng = Engine(c, auto=False)
+        j1 = eng.submit(spec, LEET, LONG_WORDS, digests1)
+        j2 = eng.submit(spec, LEET, words2, digests2)
+        eng._admit()
+        assert len(eng._active) == 2
+        # Both jobs still running after one round each = interleaved.
+        eng._serve_round()
+        assert j1.state == "running" and j2.state == "running"
+        assert len({s.group for s in eng._active}) == 1  # packed group
+        eng.run_until_idle()
+        assert full_hits(j1.result(timeout=0)) == full_hits(want1)
+        assert full_hits(j2.result(timeout=0)) == full_hits(want2)
+        assert j1.result(0).n_emitted == want1.n_emitted
+        assert j2.result(0).n_emitted == want2.n_emitted
+
+    def test_warm_jobs_compile_no_new_programs(self):
+        """The compile-amortization claim: after one job of a config
+        has run, further equal jobs build ZERO new programs — they ride
+        the process step cache (N jobs, one program build)."""
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, WORDS, (0,))
+        eng = Engine(cfg(), auto=False)
+        first = eng.submit(spec, LEET, WORDS, digests)
+        eng.run_until_idle()
+        first.result(timeout=0)
+        compiled_after_first = eng.stats()["programs_compiled"]
+        jobs = [eng.submit(spec, LEET, WORDS, digests) for _ in range(3)]
+        eng.run_until_idle()
+        for j in jobs:
+            assert j.result(timeout=0).n_hits == first.result(0).n_hits
+        stats = eng.stats()
+        assert stats["programs_compiled"] == compiled_after_first
+        assert stats["program_cache_hits"] > 0
+        assert stats["jobs_done"] == 4
+
+    def test_async_hit_delivery(self):
+        """Hits stream through the bounded per-job queue as the
+        once-per-superstep fetch lands them, not at job end."""
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, WORDS, (0, 3, -1))
+        eng = Engine(cfg())  # auto serve thread
+        try:
+            job = eng.submit(spec, LEET, WORDS, digests)
+            got = list(job.iter_hits())  # drains until the job settles
+            res = job.result(timeout=30)
+            assert [
+                (h.word_index, h.variant_rank) for h in got
+            ] == [(h.word_index, h.variant_rank) for h in res.hits]
+            assert len(got) == res.n_hits > 0
+        finally:
+            eng.close()
+
+
+class TestTenantOps:
+    def test_pause_checkpoint_resume_on_second_engine(self):
+        """Pause parks the job at a fetched superstep boundary and its
+        CheckpointState resumes on a DIFFERENT engine to the identical
+        final stream — a migrating job is just a checkpoint."""
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, LONG_WORDS, (0, 5, -1))
+        c = cfg(superstep=1)
+        want = Sweep(spec, LEET, LONG_WORDS, digests, config=c).run_crack()
+
+        eng_a = Engine(c, auto=False)
+        job = eng_a.submit(spec, LEET, LONG_WORDS, digests)
+        eng_a._admit()
+        eng_a._serve_round()
+        eng_a._serve_round()
+        job.request_pause()
+        eng_a._serve_round()
+        assert job.state == "paused"
+        ck = job.checkpoint
+        assert ck is not None
+        assert (ck.cursor.word, ck.cursor.rank) > (0, 0)
+        assert ck.cursor.word < len(LONG_WORDS)  # genuinely mid-sweep
+
+        eng_b = Engine(c, auto=False)
+        job2 = eng_b.submit(spec, LEET, LONG_WORDS, digests,
+                            resume_state=ck)
+        eng_b.run_until_idle()
+        got = job2.result(timeout=0)
+        assert got.resumed
+        assert full_hits(got) == full_hits(want)
+        assert got.n_emitted == want.n_emitted
+
+    def test_pause_round_trips_through_json(self):
+        """The JSONL pause/migrate wire format: state_to_doc/state_from_doc
+        survive json encoding, including >2^63 variant ranks."""
+        state = CheckpointState(
+            fingerprint="fp", cursor=SweepCursor(3, 10**25),
+            n_emitted=7, n_hits=1, hits=[(2, 10**24)], fallback_done=1,
+            wall_s=0.5, stream={"chunk": 2, "chunk_words": 4},
+        )
+        doc = json.loads(json.dumps(state_to_doc(state)))
+        assert state_from_doc(doc) == state
+
+    def test_resume_same_engine(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, LONG_WORDS, (0, -1))
+        c = cfg(superstep=1)
+        want = Sweep(spec, LEET, LONG_WORDS, digests, config=c).run_crack()
+        eng = Engine(c, auto=False)
+        job = eng.submit(spec, LEET, LONG_WORDS, digests)
+        eng._admit()
+        eng._serve_round()
+        job.request_pause()
+        eng._serve_round()
+        assert job.state == "paused"
+        job2 = eng.resume(job)
+        assert job2.id == job.id
+        eng.run_until_idle()
+        assert full_hits(job2.result(timeout=0)) == full_hits(want)
+
+    def test_cancel_mid_superstep_keeps_other_tenants(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, LONG_WORDS, (0, -1))
+        c = cfg(superstep=1)
+        want_other = Sweep(spec, LEET, WORDS, digests, config=c).run_crack()
+        eng = Engine(c, auto=False)
+        victim = eng.submit(spec, LEET, LONG_WORDS, digests)
+        other = eng.submit(spec, LEET, WORDS, digests)
+        eng._admit()
+        eng._serve_round()
+        assert victim.state == "running"
+        victim.cancel()
+        eng.run_until_idle()
+        assert victim.state == "cancelled"
+        with pytest.raises(Exception):
+            victim.result(timeout=0)
+        assert full_hits(other.result(timeout=0)) == full_hits(want_other)
+        assert eng.stats()["jobs_cancelled"] == 1
+
+    def test_pause_before_first_tick_hands_back_origin_checkpoint(self):
+        """Pausing a job whose machine never ticked still yields a
+        RESUMABLE checkpoint — the start of the sweep, never None."""
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, WORDS, (0, -1))
+        want = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        eng = Engine(cfg(), auto=False)
+        job = eng.submit(spec, LEET, WORDS, digests)
+        eng._admit()
+        job.request_pause()
+        eng._serve_round()  # parks before any machine tick
+        assert job.state == "paused"
+        ck = job.checkpoint
+        assert ck is not None
+        assert (ck.cursor.word, ck.cursor.rank) == (0, 0)
+        json.dumps(state_to_doc(ck))  # the JSONL pump must not crash
+        eng2 = Engine(cfg(), auto=False)
+        job2 = eng2.submit(spec, LEET, WORDS, digests, resume_state=ck)
+        eng2.run_until_idle()
+        assert full_hits(job2.result(timeout=0)) == full_hits(want)
+
+    def test_resume_fingerprint_mismatch_fails_loudly(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, WORDS, (0,))
+        eng = Engine(cfg(), auto=False)
+        bad = CheckpointState(fingerprint="not-this-sweep")
+        job = eng.submit(spec, LEET, WORDS, digests, resume_state=bad)
+        eng.run_until_idle()
+        assert job.state == "failed"
+        with pytest.raises(JobFailed) as exc:
+            job.result(timeout=0)
+        assert "different sweep" in str(exc.value.__cause__)
+
+
+class TestSchemaCacheHygiene:
+    def test_counters_surface_in_sweep_result(self, tmp_path):
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, WORDS, (0,))
+        c = cfg(schema_cache=str(tmp_path))
+        first = Sweep(spec, LEET, WORDS, digests, config=c).run_crack()
+        assert first.schema_cache.get("misses", 0) >= 1
+        assert first.schema_cache.get("bytes_written", 0) > 0
+        second = Sweep(spec, LEET, WORDS, digests, config=c).run_crack()
+        assert second.schema_cache.get("hits", 0) >= 1
+        assert second.schema_cache.get("bytes_read", 0) > 0
+        assert second.schema_cache.get("misses", 0) == 0
+        assert hit_tuples(second) == hit_tuples(first)
+
+    def test_lru_cap_evicts_oldest_atime(self, tmp_path):
+        from hashcat_a5_table_generator_tpu.ops.packing import (
+            enforce_schema_cache_cap,
+            schema_cache_stats,
+        )
+
+        paths = []
+        for i in range(4):
+            p = tmp_path / f"entry{i}.npz"
+            p.write_bytes(bytes(1 << 20))  # 1 MB each
+            paths.append(p)
+        now = time.time()
+        for i, p in enumerate(paths):  # entry0 oldest atime
+            import os
+
+            os.utime(p, (now - 1000 + i * 100, now))
+        before = schema_cache_stats()
+        evicted = enforce_schema_cache_cap(str(tmp_path), max_mb=2.5)
+        assert evicted == 2
+        assert not paths[0].exists() and not paths[1].exists()
+        assert paths[2].exists() and paths[3].exists()
+        after = schema_cache_stats()
+        assert after["evictions"] - before.get("evictions", 0) == 2
+        # Under the cap: a no-op.
+        assert enforce_schema_cache_cap(str(tmp_path), max_mb=2.5) == 0
+
+    def test_corrupt_entry_still_a_miss_after_counters(self, tmp_path):
+        """Counter instrumentation must not change the corrupt-entry
+        contract: a garbage file is a MISS, never an error."""
+        from hashcat_a5_table_generator_tpu.ops.packing import (
+            load_piece_schema,
+            schema_cache_stats,
+        )
+
+        (tmp_path / "deadbeef.npz").write_bytes(b"not an npz at all")
+        before = schema_cache_stats()
+        hit, schema = load_piece_schema(str(tmp_path), "deadbeef")
+        assert (hit, schema) == (False, None)
+        assert schema_cache_stats()["misses"] - before["misses"] == 1
+
+    def test_engine_stats_report_schema_cache(self, tmp_path):
+        spec = AttackSpec(mode="default", algo="md5")
+        _p, digests = planted_digests(spec, LEET, WORDS, (0,))
+        c = cfg(schema_cache=str(tmp_path))
+        eng = Engine(c, auto=False)
+        for _ in range(2):
+            eng.submit(spec, LEET, WORDS, digests)
+        eng.run_until_idle()
+        sc = eng.stats()["schema_cache"]
+        assert sc.get("misses", 0) >= 1  # first job compiled + wrote
+        assert sc.get("hits", 0) >= 1  # second job loaded
+
+
+class TestJsonlService:
+    def test_stdin_session_submit_hit_done(self):
+        # Same words/digest-count fixture as the solo parity test, so
+        # the session rides the executables this suite already built.
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, digests = planted_digests(spec, LEET, WORDS, (3,),
+                                           decoys=22)
+        dig = hashlib.md5(planted[0]).digest()
+        eng = Engine(cfg())
+        try:
+            reqs = io.StringIO(
+                json.dumps({
+                    "op": "submit", "id": "t1",
+                    "words": [w.decode() for w in WORDS],
+                    "table_map": {"a": ["4", "@"], "o": ["0"],
+                                  "s": ["$", "5"], "e": ["3"]},
+                    "algo": "md5",
+                    "digest_list": [d.hex() for d in digests],
+                }) + "\n" + json.dumps({"op": "stats"}) + "\n"
+                + json.dumps({"op": "shutdown"}) + "\n"
+            )
+            out = io.StringIO()
+            serve_stdio(eng, reqs, out)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if '"done"' in out.getvalue():
+                    break
+                time.sleep(0.05)
+            events = [json.loads(ln) for ln in
+                      out.getvalue().splitlines() if ln.strip()]
+            by_event = {}
+            for e in events:
+                by_event.setdefault(e["event"], []).append(e)
+            assert by_event["accepted"][0]["id"] == "t1"
+            (hit,) = by_event["hit"]
+            assert hit["digest"] == dig.hex()
+            assert bytes.fromhex(hit["plain_hex"]) == planted[0]
+            (done,) = by_event["done"]
+            assert done["n_hits"] == 1 and done["n_emitted"] > 0
+            assert "jobs_submitted" in by_event["stats"][0]
+            assert by_event["bye"]
+        finally:
+            eng.close()
+
+    def test_bad_job_reports_error_and_keeps_session(self):
+        eng = Engine(cfg())
+        try:
+            reqs = io.StringIO(
+                '{"op":"submit","id":"x"}\n'
+                '{"op":"nope","id":"x"}\n{"op":"shutdown"}\n'
+            )
+            out = io.StringIO()
+            serve_stdio(eng, reqs, out)
+            events = [json.loads(ln) for ln in
+                      out.getvalue().splitlines() if ln.strip()]
+            assert [e["event"] for e in events] == ["error", "error", "bye"]
+        finally:
+            eng.close()
+
+    @pytest.mark.slow
+    def test_unix_socket_session(self, tmp_path):
+        import socket
+        import threading
+
+        from hashcat_a5_table_generator_tpu.runtime.engine import (
+            serve_socket,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, _d = planted_digests(spec, LEET, [b"password"], (3,))
+        dig = hashlib.md5(planted[0]).digest()
+        path = str(tmp_path / "a5.sock")
+        eng = Engine(cfg())
+        ready = threading.Event()
+        th = threading.Thread(
+            target=serve_socket, args=(eng, path),
+            kwargs={"ready": ready.set}, daemon=True,
+        )
+        th.start()
+        try:
+            assert ready.wait(10)
+            # A client that merely disconnects (a health probe) must
+            # end only ITS session, not the server.
+            probe = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            probe.connect(path)
+            probe.close()
+            with socket.socket(socket.AF_UNIX, socket.SOCK_STREAM) as s:
+                s.connect(path)
+                fin = s.makefile("r", encoding="utf-8")
+                fout = s.makefile("w", encoding="utf-8")
+                fout.write(json.dumps({
+                    "op": "submit", "id": "s1", "words": ["password"],
+                    "table_map": {"a": ["4", "@"], "o": ["0"],
+                                  "s": ["$", "5"], "e": ["3"]},
+                    "digest_list": [dig.hex()],
+                }) + "\n")
+                fout.flush()
+                got = [json.loads(fin.readline()) for _ in range(3)]
+                assert [e["event"] for e in got] == [
+                    "accepted", "hit", "done",
+                ]
+                fout.write('{"op":"shutdown"}\n')
+                fout.flush()
+            th.join(10)
+        finally:
+            eng.close()
+
+
+@pytest.mark.slow
+def test_bench_serve_ab_record_shape():
+    """The §20 measurement instrument: one JSON line, both arms, the
+    ttfc/wall/compile-count numbers the acceptance criteria read —
+    including the compile-once assertion (engine arm builds fewer
+    programs than the N-cold-runs arm).  Slow-marked: it compiles and
+    times a subprocess bench."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--serve-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "600", "--serve-jobs", "3"],
+        capture_output=True, timeout=540, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "serve_mode_ab"
+    assert rec["jobs"] == 3
+    assert len(rec["cold"]["jobs"]) == 3
+    assert len(rec["engine"]["jobs"]) == 4  # + the idle warm probe job
+    emitted = {j["n_emitted"] for j in rec["cold"]["jobs"]}
+    emitted |= {j["n_emitted"] for j in rec["engine"]["jobs"]}
+    assert len(emitted) == 1 and emitted.pop() > 0
+    # The compile-once assertion: one resident program build serves
+    # every job; the cold arm rebuilds per job.
+    assert rec["engine"]["programs_compiled"] < rec["cold"][
+        "programs_compiled"
+    ]
+    assert rec["cold"]["programs_compiled"] >= 3
+    assert rec["engine"]["program_cache_hits"] > 0
+    for key in ("warm_ttfc_ratio", "warm_ttfc_batch_ratio",
+                "wall_ratio", "compile_ratio"):
+        assert rec[key] > 0
+    assert rec["engine"]["ttfc_warm_idle_s"] < rec["cold"]["ttfc_mean_s"]

@@ -330,6 +330,88 @@ class TestChunkRing:
 
 
 # ---------------------------------------------------------------------------
+# Resident-engine serve-round discipline (PERF.md §20)
+# ---------------------------------------------------------------------------
+
+
+class TestServeLoop:
+    def test_clean_round_passes(self):
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        assert audit_serve_loop(mod.clean_round, "fixture.serve") == []
+
+    def test_drain_monopolization_flagged(self):
+        # Draining one job to completion inside the round starves the
+        # other tenants — the monopolization regression.
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        findings = audit_serve_loop(
+            mod.broken_drain_round, "fixture.serve"
+        )
+        assert any("monopoliz" in f.message for f in findings)
+        assert all(f.check == "serve-loop" for f in findings)
+
+    def test_guarded_drain_monopolization_flagged(self):
+        # The drain loop hidden under if/try still monopolizes — the
+        # nesting flag must survive every statement shape.
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        findings = audit_serve_loop(
+            mod.broken_guarded_drain_round, "fixture.serve"
+        )
+        assert any("monopoliz" in f.message for f in findings)
+
+    def test_condition_drain_flagged(self):
+        # The drain written as a while CONDITION still runs per
+        # iteration — loop heads count as looped ticks.
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        findings = audit_serve_loop(
+            mod.broken_condition_drain_round, "fixture.serve"
+        )
+        assert any("monopoliz" in f.message for f in findings)
+
+    def test_double_tick_flagged(self):
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        findings = audit_serve_loop(
+            mod.broken_double_tick_round, "fixture.serve"
+        )
+        assert any("2 machine tick" in f.message for f in findings)
+
+    def test_fetch_in_round_flagged(self):
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        findings = audit_serve_loop(
+            mod.broken_fetch_round, "fixture.serve"
+        )
+        assert any("fetch" in f.message for f in findings)
+
+    def test_block_until_ready_flagged(self):
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        mod = _fixture("serve_loop")
+        findings = audit_serve_loop(
+            mod.broken_sync_round, "fixture.serve"
+        )
+        assert any("block_until_ready" in f.message for f in findings)
+
+    def test_production_serve_round_is_clean(self):
+        from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from tools.graftaudit.transfers import audit_serve_loop
+
+        assert audit_serve_loop(
+            Engine._serve_round, "runtime.Engine._serve_round"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
 # Pallas bounds + grid overlap
 # ---------------------------------------------------------------------------
 

@@ -429,6 +429,49 @@ class TestSchemaCache:
         assert schema2.gl is None
 
 
+def test_progress_json_carries_chunk_position(tmp_path):
+    """CheckpointState.stream surfaces in the progress JSON: live
+    streaming sweeps report their chunk marker per line, and a RESUMED
+    streaming sweep seeds it immediately from the checkpoint."""
+    from hashcat_a5_table_generator_tpu.runtime import ProgressReporter
+
+    spec = AttackSpec(mode="default", algo="md5")
+    oracle = oracle_lines(spec, LEET, WORDS)
+    digests = [hashlib.md5(oracle[0]).digest()]
+    buf = io.StringIO()
+    prog = ProgressReporter(len(WORDS), every_s=0.0, stream=buf)
+    path = str(tmp_path / "ck.json")
+    res = make_sweep(
+        spec, LEET, WORDS, digests, chunk=2, progress=prog,
+        checkpoint_path=path, checkpoint_every_s=0.0,
+    ).run_crack()
+    markers = [
+        json.loads(ln)["progress"].get("stream")
+        for ln in buf.getvalue().splitlines()
+    ]
+    assert {"chunk": 0, "chunk_words": 2} in markers
+    assert {"chunk": 2, "chunk_words": 2} in markers
+    assert res.stream["chunks_swept"] == 3
+
+    # Resume with a mid-stream checkpoint: the marker is seeded from
+    # CheckpointState.stream before any chunk completes.
+    sweep = make_sweep(spec, LEET, WORDS, digests, chunk=2,
+                       checkpoint_path=path)
+    state = load_checkpoint(path, sweep.fingerprint)
+    state.stream = {"chunk": 1, "chunk_words": 2}
+    buf2 = io.StringIO()
+    prog2 = ProgressReporter(len(WORDS), every_s=0.0, stream=buf2)
+    sweep.config.progress = prog2
+    machine = sweep.crack_machine(state=state)
+    try:
+        next(machine)
+    except StopIteration:
+        pass
+    machine.close()
+    first = json.loads(buf2.getvalue().splitlines()[0])
+    assert first["progress"]["stream"] == {"chunk": 1, "chunk_words": 2}
+
+
 def test_slice_packed_keeps_global_indices():
     packed = pack_words(WORDS)
     part = slice_packed(packed, 2, 5)

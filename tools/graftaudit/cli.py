@@ -279,13 +279,19 @@ def run_audit(
             audit_chunk_ring,
             audit_drive_loop,
             audit_host_transfers,
+            audit_serve_loop,
         )
 
         if "transfers" in groups:
             # Host side of the one-fetch-per-superstep contract: the
             # pipelined drive loop's fetch discipline (PERF.md §18),
-            # and the streaming chunk ring's consume discipline —
-            # worker-owned transfers, unconditional release (§19).
+            # the streaming chunk ring's consume discipline —
+            # worker-owned transfers, unconditional release (§19) —
+            # and the resident engine's serve round: callback-free,
+            # one machine tick per job per round, no fetches (§20).
+            from hashcat_a5_table_generator_tpu.runtime.engine import (
+                Engine,
+            )
             from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
 
             findings.extend(
@@ -298,6 +304,12 @@ def run_audit(
                 audit_chunk_ring(
                     Sweep._sweep_chunks,
                     "runtime.Sweep._sweep_chunks",
+                )
+            )
+            findings.extend(
+                audit_serve_loop(
+                    Engine._serve_round,
+                    "runtime.Engine._serve_round",
                 )
             )
 

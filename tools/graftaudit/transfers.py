@@ -350,6 +350,150 @@ def audit_drive_loop(fn, entry: str) -> List[AuditFinding]:
     return findings
 
 
+def audit_serve_loop(fn, entry: str) -> List[AuditFinding]:
+    """Statically audit the resident engine's multiplexing round
+    (PERF.md §20) — the drive loop that interleaves many tenant sweeps
+    by advancing their machines at superstep boundaries.
+
+    The contract that keeps the one-fetch-per-superstep discipline
+    (PERF.md §18) alive ACROSS interleaved jobs:
+
+    * the machines own every device→host round trip — any fetch-shaped
+      call (``int()``/``np.asarray()``/``.item()``/...) in the serve
+      round barriers EVERY tenant behind one job's in-flight device
+      work, and ``block_until_ready`` anywhere is the same sin spelled
+      explicitly;
+    * each runnable job advances by exactly ONE boundary tick per round
+      — one ``next()`` call node in the round's job loop.  Zero ticks
+      is a round that serves nobody; two is double-stepping (one
+      tenant's latency doubles everyone's); a ``next()`` inside a
+      NESTED loop is the monopolization regression — draining one job
+      to completion while the other tenants starve.
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"serve loop source unavailable for audit: {exc}",
+            )
+        ]
+    findings: List[AuditFinding] = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "block_until_ready"
+        ):
+            findings.append(
+                AuditFinding(
+                    "serve-loop", entry,
+                    "block_until_ready in the serve round — a sync here "
+                    "barriers every tenant behind one job's device work "
+                    "(PERF.md §20); the machines own the per-superstep "
+                    "barrier",
+                )
+            )
+        if _is_fetch_call(node):
+            findings.append(
+                AuditFinding(
+                    "serve-loop", entry,
+                    "device→host fetch in the serve round — the sweep "
+                    "machines own every round trip (the lagged counters "
+                    "barrier, PERF.md §18); a fetch in the scheduler "
+                    "barriers every tenant (PERF.md §20)",
+                )
+            )
+    fdef = next(
+        (n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)), None
+    )
+    loop = next(
+        (n for n in (fdef.body if fdef else [])
+         if isinstance(n, (ast.For, ast.While))),
+        None,
+    )
+    if loop is None:
+        findings.append(
+            AuditFinding(
+                "config", entry,
+                "serve round has no top-level job loop to audit",
+            )
+        )
+        return findings
+
+    def is_tick(node) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "next"
+        )
+
+    def tick_nodes(stmts, looped: bool):
+        # Recurse with the loop flag carried through EVERY nesting
+        # shape — a drain loop hidden under if/try/with must still
+        # read as looped (the sibling drive-loop audit learned the
+        # same lesson about guarded fetches).
+        out = []
+        for stmt in stmts:
+            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+                # The loop HEAD evaluates per iteration too — a tick in
+                # a while condition is the drain written as a test.
+                head = (
+                    stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor))
+                    else stmt.test
+                )
+                out += [(n, True) for n in ast.walk(head) if is_tick(n)]
+                for body in (stmt.body, stmt.orelse):
+                    out += tick_nodes(body, True)
+                continue
+            if isinstance(stmt, ast.If):
+                out += [(n, looped) for n in ast.walk(stmt.test)
+                        if is_tick(n)]
+                out += tick_nodes(stmt.body, looped)
+                out += tick_nodes(stmt.orelse, looped)
+                continue
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    out += [(n, looped)
+                            for n in ast.walk(item.context_expr)
+                            if is_tick(n)]
+                out += tick_nodes(stmt.body, looped)
+                continue
+            if isinstance(stmt, ast.Try):
+                for body in (stmt.body, stmt.orelse, stmt.finalbody):
+                    out += tick_nodes(body, looped)
+                for h in stmt.handlers:
+                    out += tick_nodes(h.body, looped)
+                continue
+            out += [(n, looped) for n in ast.walk(stmt) if is_tick(n)]
+        return out
+
+    ticks = tick_nodes(loop.body, False)
+    if any(looped for _n, looped in ticks):
+        findings.append(
+            AuditFinding(
+                "serve-loop", entry,
+                "next() inside a nested loop of the serve round — "
+                "draining one job to completion monopolizes the engine "
+                "and starves the other tenants; one boundary tick per "
+                "job per round (PERF.md §20)",
+            )
+        )
+    n_ticks = len(ticks)
+    if n_ticks != 1:
+        findings.append(
+            AuditFinding(
+                "serve-loop", entry,
+                f"{n_ticks} machine tick(s) (next() call nodes) per job "
+                "per serve round (want exactly one): each runnable job "
+                "advances one fetched superstep boundary per round, so "
+                "tenants interleave fairly (PERF.md §20)",
+            )
+        )
+    return findings
+
+
 #: Call names that move data between host and device — none of them
 #: belong in the chunk ring's consume loop (the worker thread owns every
 #: transfer; a synchronous one in the drive barriers the sweep behind
