@@ -239,6 +239,15 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--serve-ab: equal small jobs per arm (default "
                          "4 — the N the §20 amortization criterion is "
                          "stated at)")
+    ap.add_argument("--telemetry-ab", action="store_true",
+                    help="measure the telemetry layer's wall overhead "
+                         "(PERF.md §21) on the production crack "
+                         "contract: instrumented (registry + span "
+                         "timeline) vs A5GEN_TELEMETRY=off arms "
+                         "alternating run-for-run, overhead ratio vs "
+                         "the ≤1%% bar — one JSON line. Defaults to "
+                         "the §4c CPU peak geometry like "
+                         "--superstep-ab")
     ap.add_argument("--stride-ab", action="store_true",
                     help="measure block stride 128 vs 256 x emission "
                          "scheme perslot vs bytescan (A5GEN_EMIT arms) "
@@ -705,6 +714,152 @@ def run_stream_ab(args: argparse.Namespace) -> None:
             "peak_resident_plan_bytes", 0
         ),
         "chunk_bytes_max": st.get("chunk_bytes_max", 0),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+# ----------------------------------------------------------- telemetry A/B --
+
+
+def run_telemetry_ab(args: argparse.Namespace) -> None:
+    """A/B the telemetry layer's overhead (PERF.md §21) on the
+    production crack contract: the same wordlist × table × decoy
+    digests swept end-to-end through ``Sweep.run_crack``, instrumented
+    (registry + span timeline live at every fetch boundary) vs
+    ``A5GEN_TELEMETRY=off``.  Sweep construction (plan/schema compile —
+    identical host work either way) stays OUTSIDE the timed window so
+    the ratio measures the per-fetch instrumentation, which is where
+    the overhead risk lives; arms alternate run-for-run so host drift
+    cannot masquerade as overhead.  Honesty guards: the instrumented
+    arm must actually have recorded spans and the off arm must not
+    (else the A/B compares off against off), and both arms must emit
+    identical counts.  Bar: overhead_ratio ≤ 1% wall.  Prints ONE JSON
+    line."""
+    import os
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+    from hashcat_a5_table_generator_tpu.runtime import telemetry
+    from hashcat_a5_table_generator_tpu.runtime.sweep import (
+        Sweep,
+        SweepConfig,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    if lanes % nb:
+        raise SystemExit("--telemetry-ab needs blocks dividing lanes")
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout(args.table).to_substitution_map()
+    words = synth_wordlist(args.words)
+    host_digest = HOST_DIGEST[spec.algo]
+    digests = [
+        host_digest(b"bench-decoy-%d" % i) for i in range(1024)
+    ]
+    from hashcat_a5_table_generator_tpu.runtime.env import read_env
+
+    prior = read_env("A5GEN_TELEMETRY")
+
+    def one_run(off: bool) -> "tuple[float, int, int]":
+        """(timed run_crack wall, emitted, fetch spans recorded)."""
+        if off:
+            os.environ["A5GEN_TELEMETRY"] = "off"
+        else:
+            os.environ.pop("A5GEN_TELEMETRY", None)
+        sweep = Sweep(
+            spec, sub_map, words, digests,
+            config=SweepConfig(lanes=lanes, num_blocks=nb),
+        )
+        snap0 = telemetry.snapshot()
+        t0 = time.perf_counter()
+        res = sweep.run_crack(resume=False)
+        wall = time.perf_counter() - t0
+        d = telemetry.delta(snap0, telemetry.snapshot())
+        spans = sum(
+            v["value"] for k, v in d.items()
+            if k.startswith("sweep.fetches.")
+        )
+        return wall, res.n_emitted, spans
+
+    try:
+        one_run(off=True)   # warm both arms' compiled steps (shared)
+        one_run(off=False)
+        arms = {"off": [], "instrumented": []}
+        spans = {"off": 0, "instrumented": 0}
+        emitted = {"off": None, "instrumented": None}
+        t_bench = time.perf_counter()
+        while (
+            not arms["off"]
+            or time.perf_counter() - t_bench < args.seconds
+        ):
+            for name, off in (("off", True), ("instrumented", False)):
+                wall, ne, sp = one_run(off)
+                arms[name].append(wall)
+                spans[name] += sp
+                if emitted[name] is None:
+                    emitted[name] = ne
+                elif emitted[name] != ne:
+                    raise SystemExit(
+                        f"--telemetry-ab {name} arm emitted {ne}, "
+                        f"expected {emitted[name]} — nondeterministic "
+                        "work; refusing to report timings"
+                    )
+    finally:
+        if prior is None:
+            os.environ.pop("A5GEN_TELEMETRY", None)
+        else:
+            os.environ["A5GEN_TELEMETRY"] = prior
+    if emitted["off"] != emitted["instrumented"]:
+        raise SystemExit(
+            f"--telemetry-ab arms diverged: instrumented emitted "
+            f"{emitted['instrumented']}, off {emitted['off']} — the "
+            "hatch must never change results"
+        )
+    if spans["instrumented"] == 0 or spans["off"] != 0:
+        raise SystemExit(
+            f"--telemetry-ab honesty check failed: instrumented arm "
+            f"recorded {spans['instrumented']} fetch spans, off arm "
+            f"{spans['off']} (want >0 and 0) — the arms are not "
+            "actually A and B"
+        )
+
+    def arm_record(name: str) -> dict:
+        walls = arms[name]
+        mean = sum(walls) / len(walls)
+        return {
+            "wall_s_mean": mean,
+            "wall_s_min": min(walls),
+            "runs": len(walls),
+            "hashes_per_sec": emitted[name] / max(mean, 1e-9),
+            "fetch_spans": spans[name],
+        }
+
+    inst, off = arm_record("instrumented"), arm_record("off")
+    record = {
+        "metric": "telemetry_overhead_ab",
+        "unit": "run_crack wall seconds + overhead ratio",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "n_emitted": emitted["off"],
+        "instrumented": inst,
+        "off": off,
+        # The §21 acceptance instrument: instrumented-vs-off wall
+        # overhead on the production contract; bar ≤ 1%.
+        "overhead_ratio": inst["wall_s_mean"] / max(
+            off["wall_s_mean"], 1e-9
+        ) - 1.0,
+        "bar": 0.01,
     }
     print(json.dumps(record))
     sys.stdout.flush()
@@ -1770,7 +1925,7 @@ def main() -> None:
         args.lanes = (
             2048
             if (args.superstep_ab or args.stride_ab or args.pipeline_ab
-                or args.stream_ab or args.serve_ab)
+                or args.stream_ab or args.serve_ab or args.telemetry_ab)
             else (1 << 22)
         )
     if args.words is None:
@@ -1778,7 +1933,11 @@ def main() -> None:
         # — the regime the resident engine amortizes); everything else
         # keeps the historical default.
         args.words = 1000 if args.serve_ab else 50000
-    if args.serve_ab:
+    if args.telemetry_ab:
+        # Telemetry-overhead A/B (PERF.md §21); runs on the pinned (or
+        # default) platform in-process.
+        run_telemetry_ab(args)
+    elif args.serve_ab:
         # Resident-engine service-mode A/B (PERF.md §20); runs on the
         # pinned (or default) platform in-process.
         run_serve_ab(args)

@@ -232,6 +232,17 @@ def build_parser() -> argparse.ArgumentParser:
                     help="write a jax.profiler trace of the device sweep to "
                          "DIR (inspect with TensorBoard / Perfetto); host "
                          "stages are annotated (block cutting, output fetch)")
+    ap.add_argument("--profile-dir", metavar="DIR", dest="profile",
+                    help="alias of --profile: wrap the sweep in "
+                         "jax.profiler.trace(DIR) with per-superstep "
+                         "TraceAnnotation phase spans — a guarded no-op "
+                         "when the profiler is unavailable on this jax "
+                         "version (PERF.md §21)")
+    ap.add_argument("--metrics-json", metavar="FILE",
+                    help="after the sweep, write the final telemetry "
+                         "snapshot (metrics registry + per-sweep span "
+                         "summary) as JSON to FILE; A5GEN_TELEMETRY=off "
+                         "disables the instrumentation (PERF.md §21)")
     ap.add_argument("--hex-unsafe", action="store_true",
                     help="wrap line-corrupting candidates in $HEX[...]")
     ap.add_argument("--bug-compat", action="store_true",
@@ -756,6 +767,49 @@ def _die_peer_loss(e) -> None:
     os._exit(3)
 
 
+def _write_metrics_json(path, sweeps, *, pod_gather: bool = False) -> None:
+    """``--metrics-json`` (PERF.md §21): the process-wide telemetry
+    registry snapshot plus each built sweep's span-timeline summary
+    (bucketed sweeps report one summary per width).  Written AFTER the
+    sweep so the snapshot is final; under ``A5GEN_TELEMETRY=off`` the
+    file still lands, with whatever the always-on counters recorded.
+
+    ``pod_gather``: gathered multihost runs all-gather every host's
+    snapshot through the registry's fixed-order merge
+    (``parallel.multihost.allgather_metrics`` — every process must
+    call it, which holds because the pod convention is the same
+    command, hence the same flag, on every host) and mark the doc
+    ``pod_merged``.  The pod paths build their sweeps internally, so
+    ``spans`` stays {} there — per-stripe span aggregates still ride
+    the merged registry (``sweep.host_gap_s``/``dead_host_s``/…).
+    Elastic mode (``--pod-hits local``) promises zero collectives, so
+    it writes the host-local snapshot."""
+    if not path:
+        return
+    import json
+
+    from .runtime import telemetry
+
+    spans = {}
+    for obj in sweeps:
+        inner = getattr(obj, "sweeps", None)
+        if inner is not None:  # BucketedSweep: per-width timelines
+            for width, s in inner.items():
+                spans[f"w{width}"] = s.timeline.summary()
+        else:
+            spans["sweep"] = obj.timeline.summary()
+    if pod_gather:
+        from .parallel.multihost import allgather_metrics
+
+        doc = {"metrics": allgather_metrics(), "spans": spans,
+               "pod_merged": True}
+    else:
+        doc = {"metrics": telemetry.snapshot(), "spans": spans}
+    with open(path, "w") as fh:
+        json.dump(doc, fh, indent=2)
+        fh.write("\n")
+
+
 def _run_device(args, sub_map, packed) -> int:
     """``packed`` is a PackedWords batch or a ``{width: PackedWords}``
     bucket dict (native fast path) — the device backend never materializes
@@ -842,19 +896,22 @@ def _run_device(args, sub_map, packed) -> int:
         progress=progress,
     )
 
+    built_sweeps: list = []
+
     def make_sweep(digests=()):
-        if bucketed:
-            return BucketedSweep(spec, sub_map, packed, digests, config=cfg)
-        return Sweep(spec, sub_map, packed, digests, config=cfg)
+        s = (
+            BucketedSweep(spec, sub_map, packed, digests, config=cfg)
+            if bucketed
+            else Sweep(spec, sub_map, packed, digests, config=cfg)
+        )
+        built_sweeps.append(s)
+        return s
 
-    from contextlib import nullcontext
+    # --profile/--profile-dir: guarded — a no-op (with the sweep still
+    # running) wherever jax.profiler is unavailable (PERF.md §21).
+    from .runtime.telemetry import profiler_trace
 
-    if args.profile:
-        import jax.profiler
-
-        trace_ctx = jax.profiler.trace(args.profile)
-    else:
-        trace_ctx = nullcontext()
+    trace_ctx = profiler_trace(args.profile)
 
     with trace_ctx:
         if args.digests is not None:
@@ -905,6 +962,10 @@ def _run_device(args, sub_map, packed) -> int:
             _print_routing(res)
             _print_superstep(res)
             _print_stream(res)
+            _write_metrics_json(
+                args.metrics_json, built_sweeps,
+                pod_gather=nprocs > 1 and args.pod_hits == "gathered",
+            )
             _maybe_exit_pod_local(args, nprocs)
             return 0
         with CandidateWriter(hex_unsafe=args.hex_unsafe) as writer:
@@ -945,6 +1006,10 @@ def _run_device(args, sub_map, packed) -> int:
                 )
                 _print_routing(res)
                 _print_stream(res)
+    _write_metrics_json(
+        args.metrics_json, built_sweeps,
+        pod_gather=nprocs > 1 and args.pod_hits == "gathered",
+    )
     _maybe_exit_pod_local(args, nprocs)
     return 0
 

@@ -18,7 +18,6 @@ and I/O errors propagate.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -817,29 +816,33 @@ SCHEMA_CACHE_VERSION = 1
 #: :func:`save_piece_schema` plus LRU-cap evictions.  A long-lived
 #: engine process needs these to tell compile-once from
 #: compile-every-job; ``SweepResult.schema_cache`` reports per-run
-#: deltas and the resident engine reports process totals.
-_SCHEMA_CACHE_STATS = {
-    "hits": 0,
-    "misses": 0,
-    "bytes_read": 0,
-    "bytes_written": 0,
-    "evictions": 0,
-}
-_SCHEMA_CACHE_STATS_LOCK = threading.Lock()
+#: deltas and the resident engine reports process totals.  The storage
+#: is the process-wide telemetry registry (PERF.md §21) — this module
+#: keeps only the derived dict view callers always consumed.
+_SCHEMA_CACHE_KEYS = (
+    "hits", "misses", "bytes_read", "bytes_written", "evictions",
+)
 
 
 def schema_cache_stats() -> dict:
     """Snapshot of the process-level schema-cache counters — each a
     plain scalar int: hits / misses / bytes read / bytes written /
-    evictions."""
-    with _SCHEMA_CACHE_STATS_LOCK:
-        return dict(_SCHEMA_CACHE_STATS)
+    evictions.  A derived view of the ``schema_cache.*`` telemetry
+    counters (one source of truth; the registry's snapshot/delta/merge
+    subsume the old bespoke dict)."""
+    from ..runtime.telemetry import counter
+
+    return {
+        k: int(counter(f"schema_cache.{k}").value)
+        for k in _SCHEMA_CACHE_KEYS
+    }
 
 
 def _count_cache(**deltas: int) -> None:
-    with _SCHEMA_CACHE_STATS_LOCK:
-        for key, d in deltas.items():
-            _SCHEMA_CACHE_STATS[key] += d
+    from ..runtime.telemetry import counter
+
+    for key, d in deltas.items():
+        counter(f"schema_cache.{key}").add(int(d))
 
 
 def enforce_schema_cache_cap(cache_dir: str, max_mb: float) -> int:
@@ -1171,11 +1174,28 @@ class ChunkCompiler:
         return chunk
 
     def __iter__(self) -> "Iterable[PlanChunk]":
+        from ..runtime import telemetry
+
         while self._futs:
             chunk = self._futs.popleft().result()  # re-raises worker errors
             self.windows.append((chunk.t_start, chunk.t_end))
             self.compile_wall_s += chunk.compile_s
             self._fill()
+            if telemetry.enabled():
+                # Ring occupancy AFTER the refill: the chunks compiled/
+                # compiling ahead of the one being handed out (PERF.md
+                # §21; the host-side consume boundary — never a device
+                # round trip).
+                telemetry.counter("stream.chunks_compiled").add(1)
+                telemetry.counter("stream.compile_wall_s").add(
+                    chunk.compile_s
+                )
+                telemetry.histogram("stream.chunk_compile_s").observe(
+                    chunk.compile_s
+                )
+                telemetry.gauge("stream.ring_occupancy").set(
+                    len(self._futs)
+                )
             yield chunk
 
     def close(self) -> None:

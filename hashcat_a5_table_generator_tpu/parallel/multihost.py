@@ -50,6 +50,7 @@ __all__ = [
     "gather_hits",
     "allgather_sum",
     "allgather_max",
+    "allgather_metrics",
     "run_crack_multihost",
     "run_candidates_multihost",
 ]
@@ -491,26 +492,58 @@ def gather_hits(hits: Sequence) -> List:
     return combined
 
 
-#: SweepResult.superstep keys, reduced in FIXED order: every process must
-#: run the identical collective sequence even when its own stripe ran the
-#: per-launch path (empty stats) — key-set-dependent gathers would wedge
-#: the pod.
-_SUPERSTEP_KEYS = ("supersteps", "launches", "replays")
-
-
 def _reduce_superstep(stats: Dict[str, int]) -> Dict[str, int]:
     """Pod-wide superstep stats: counters sum, the launches-per-fetch
     ratio and the pipelined flag max (hosts share one config; stripes
     differ only via the int32 step cap).  Returns {} when no stripe ran
-    the executor."""
-    out = {k: allgather_sum(int(stats.get(k, 0))) for k in _SUPERSTEP_KEYS}
-    out["launches_per_fetch"] = int(
-        allgather_max(float(stats.get("launches_per_fetch", 0)))
-    )
-    out["pipelined"] = int(
-        allgather_max(float(stats.get("pipelined", 0)))
-    )
+    the executor.
+
+    The key semantics ride ``runtime.telemetry.SUPERSTEP_MERGE`` — the
+    same spec the bucketed merge uses — walked in the spec's FIXED
+    order: every process must run the identical collective sequence
+    even when its own stripe ran the per-launch path (empty stats);
+    key-set-dependent gathers would wedge the pod."""
+    from ..runtime.telemetry import SUPERSTEP_MERGE
+
+    out = {
+        k: allgather_sum(int(stats.get(k, 0)))
+        for k in SUPERSTEP_MERGE.sum_keys
+    }
+    for k in SUPERSTEP_MERGE.max_keys:
+        out[k] = int(allgather_max(float(stats.get(k, 0))))
     return out if any(out.values()) else {}
+
+
+def allgather_metrics(snap: "Optional[Dict]" = None) -> Dict:
+    """Pod-wide telemetry: all-gather each host's registry snapshot
+    (JSON on the wire, padded like :func:`gather_hits`) and merge via
+    the registry's own fixed-order merge (``runtime.telemetry.merge``)
+    — counters/histogram buckets sum, gauges follow their declared
+    aggregation.  Every process returns the identical merged snapshot.
+    ONE collective regardless of key sets (the payload is opaque
+    bytes), so ragged per-host metric sets cannot wedge the pod."""
+    import jax
+
+    from ..runtime import telemetry
+
+    if snap is None:
+        snap = telemetry.snapshot()
+    if jax.process_count() == 1:
+        # Degenerate pod: no collective to run (and process_allgather
+        # drops the leading axis at size 1) — the merge of one.
+        return telemetry.merge([snap])
+    payload = json.dumps(snap).encode()
+    n = len(payload)
+    lens = _allgather(np.asarray([n], dtype=np.int64))[:, 0]
+    width = max(1, int(lens.max()))
+    buf = np.zeros(width, dtype=np.uint8)
+    buf[:n] = np.frombuffer(payload, dtype=np.uint8)
+    bufs = _allgather(buf)
+    snaps = []
+    for p in range(bufs.shape[0]):
+        raw = bytes(bufs[p, : int(lens[p])])
+        snaps.append(json.loads(raw) if raw else {})
+    return telemetry.merge(snaps)
 
 
 def _host_config(config, process_id: int):

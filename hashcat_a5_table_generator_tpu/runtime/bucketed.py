@@ -33,6 +33,7 @@ from dataclasses import replace
 from typing import Dict, List, Optional, Sequence
 
 from ..ops.packing import PackedWords
+from . import telemetry
 from .checkpoint import check_bucket_manifest, save_bucket_manifest
 from .sweep import Sweep, SweepConfig, SweepResult
 
@@ -79,6 +80,13 @@ class _BucketProgress:
 
     def seed_emitted(self, emitted: int) -> None:
         self.inner.seed_emitted(self.emit_base + emitted)
+
+    def seed_hits(self, hits: int) -> None:
+        # Guarded like set_routing: pre-seed_hits custom reporters keep
+        # working.
+        inner_seed = getattr(self.inner, "seed_hits", None)
+        if inner_seed is not None:
+            inner_seed(self.hit_base + hits)
 
     def update(self, *, words_done: int, emitted: int, hits: int,
                force: bool = False) -> None:
@@ -155,47 +163,29 @@ class BucketedSweep:
     def _merge(self, results: List[SweepResult], t0: float) -> SweepResult:
         hits = [h for r in results for h in r.hits]
         hits.sort(key=lambda h: (h.word_index, h.variant_rank))
-        routing: Dict[str, int] = {}
-        superstep: Dict[str, int] = {}
-        stream: Dict[str, float] = {}
-        schema_cache: Dict[str, int] = {}
-        for r in results:
-            for k, v in r.routing.items():
-                routing[k] = routing.get(k, 0) + int(v)
-            # Schema-cache activity (PERF.md §20d): plain counter sums.
-            for k, v in getattr(r, "schema_cache", {}).items():
-                schema_cache[k] = schema_cache.get(k, 0) + int(v)
-            # Superstep stats accumulate across buckets; the per-sweep
-            # launches_per_fetch ratio and the pipelined flag are
-            # reported as the max (buckets share one config, so they
-            # only differ via the int32 cap).
-            for k, v in getattr(r, "superstep", {}).items():
-                if k in ("launches_per_fetch", "pipelined"):
-                    superstep[k] = max(superstep.get(k, 0), int(v))
-                else:
-                    superstep[k] = superstep.get(k, 0) + int(v)
-            # Streaming stats (PERF.md §19): counters and walls sum
-            # across buckets, peaks/bounds take the max.  The sweep-
-            # local scalars (ttfc_s, resumed_chunk,
-            # first_chunk_compile_s) are claimed only when the FIRST
-            # bucket streamed — buckets run sequentially, so a later
-            # streaming bucket's ttfc says nothing about the run's
-            # time to first candidate (an earlier whole-path bucket
-            # already emitted).  Overlap RATIOS are recomputed from the
-            # summed terms below — a first-bucket ratio next to summed
-            # walls would be self-inconsistent.
-            for k, v in getattr(r, "stream", {}).items():
-                if k in ("peak_resident_plan_bytes", "chunk_bytes_max",
-                         "chunk_words", "prefetch", "ring"):
-                    stream[k] = max(stream.get(k, 0), v)
-                elif k in ("ttfc_s", "resumed_chunk",
-                           "first_chunk_compile_s"):
-                    if r is results[0]:
-                        stream[k] = v
-                elif k in ("overlap_ratio", "steady_overlap_ratio"):
-                    pass  # derived; recomputed from the summed terms
-                else:
-                    stream[k] = stream.get(k, 0) + v
+        # Per-key merge semantics live in ONE place — the telemetry
+        # merge specs (PERF.md §21; the multihost reducers walk the
+        # same specs): routing/schema-cache counters sum, superstep
+        # counters sum with ratio/flag max, stream walls sum with
+        # peaks max and sweep-local scalars (ttfc_s, resumed_chunk,
+        # first_chunk_compile_s) claimed by the FIRST bucket only —
+        # buckets run sequentially, so a later streaming bucket's ttfc
+        # says nothing about the run's time to first candidate.
+        # Overlap RATIOS are derived: recomputed below from the summed
+        # terms (a first-bucket ratio next to summed walls would be
+        # self-inconsistent).
+        routing = telemetry.ROUTING_MERGE.merge(
+            [r.routing for r in results]
+        )
+        schema_cache = telemetry.SCHEMA_CACHE_MERGE.merge(
+            [getattr(r, "schema_cache", {}) for r in results]
+        )
+        superstep = telemetry.SUPERSTEP_MERGE.merge(
+            [getattr(r, "superstep", {}) for r in results]
+        )
+        stream = telemetry.STREAM_MERGE.merge(
+            [getattr(r, "stream", {}) for r in results]
+        )
         if stream.get("compile_wall_s", 0) > 0:
             wall = stream["compile_wall_s"]
             over = stream.get("compile_overlap_s", 0.0)

@@ -156,12 +156,18 @@ def state_from_doc(doc: Dict) -> CheckpointState:
 def save_checkpoint(path: str, state: CheckpointState) -> None:
     """Atomically write ``state`` as JSON (tmp file + rename)."""
     doc = state_to_doc(state)
+    blob = json.dumps(doc)
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as fh:
-        json.dump(doc, fh)
+        fh.write(blob)
         fh.flush()
         os.fsync(fh.fileno())
     os.replace(tmp, path)
+    from . import telemetry
+
+    if telemetry.enabled():
+        telemetry.counter("checkpoint.saves").add(1)
+        telemetry.counter("checkpoint.bytes_written").add(len(blob))
 
 
 def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:

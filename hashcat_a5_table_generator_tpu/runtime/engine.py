@@ -53,6 +53,7 @@ import threading
 from dataclasses import replace
 from typing import Callable, Dict, List, Optional, Sequence
 
+from . import telemetry
 from .checkpoint import CheckpointState, state_from_doc, state_to_doc
 from .sinks import CandidateWriter, HitRecord
 
@@ -93,6 +94,10 @@ class EngineJob:
         #: time-to-first-fetch relative to the machine's start (None
         #: until known) — the warm-vs-cold instrument --serve-ab reads.
         self.ttfc_s: Optional[float] = None
+        #: the sweep's span-timeline digest (PERF.md §21), set when the
+        #: job settles; the serve front-end attaches it to the
+        #: ``done``/``paused`` event.
+        self.span_summary: dict = {}
         self._submit_args = submit_args  # engine-side resume/migrate
         self._hits: "queue.Queue" = queue.Queue(maxsize=hit_queue_depth)
         self._settled = threading.Event()  # done/paused/cancelled/failed
@@ -305,6 +310,7 @@ class Engine:
         job._resume_state = resume_state
         with self._lock:
             self._counts["jobs_submitted"] += 1
+        telemetry.counter("engine.jobs_submitted").add(1)
         self._pending.put(job)
         self._wake.set()
         return job
@@ -501,6 +507,7 @@ class Engine:
             else:
                 with self._lock:
                     self._counts["supersteps_served"] += 1
+                telemetry.counter("engine.supersteps_served").add(1)
 
     def _round_slots(self) -> List[_Slot]:
         with self._lock:
@@ -517,6 +524,7 @@ class Engine:
     def _settle_counts(self, job: EngineJob, state: str) -> None:
         with self._lock:
             self._counts[f"jobs_{state}"] += 1
+        telemetry.counter(f"engine.jobs_{state}").add(1)
         job._settle(state)
 
     def _checkpoint_of(self, slot: _Slot) -> CheckpointState:
@@ -535,6 +543,7 @@ class Engine:
         slot.machine.close()  # runs the sweep's cleanup finallys
         self._drop(slot)
         slot.job.checkpoint = self._checkpoint_of(slot)
+        slot.job.span_summary = slot.sweep.timeline.summary()
         self._settle_counts(slot.job, "paused")
 
     def _retire(self, slot: _Slot, state: str) -> None:
@@ -551,6 +560,7 @@ class Engine:
         job.ttfc_s = (
             ttfc - slot.sweep._run_t0 if ttfc is not None else None
         )
+        job.span_summary = slot.sweep.timeline.summary()
         self._settle_counts(job, "done")
 
 
@@ -566,6 +576,8 @@ class Engine:
 #   {"op": "resume", "id": "j1"}                   -> accepted (same id)
 #   {"op": "cancel", "id": "j1"}                   -> cancelled
 #   {"op": "stats"}                                -> stats
+#   {"op": "metrics"}                              -> metrics (registry
+#                                   JSON snapshot + Prometheus text)
 #   {"op": "shutdown"}  (or EOF)                   -> bye
 #
 # Job fields: "tables": [paths] or "table_map": {key: [subs...]} inline;
@@ -706,12 +718,17 @@ class _JsonlSession:
                 done["ttfc_s"] = job.ttfc_s
             if res.schema_cache:
                 done["schema_cache"] = res.schema_cache
+            if job.span_summary:
+                done["spans"] = job.span_summary
             self._emit(done)
         elif job.state == "paused":
-            self._emit({
+            paused = {
                 "id": job.id, "event": "paused",
                 "checkpoint": state_to_doc(job.checkpoint),
-            })
+            }
+            if job.span_summary:
+                paused["spans"] = job.span_summary
+            self._emit(paused)
         elif job.state == "cancelled":
             self._emit({"id": job.id, "event": "cancelled"})
         else:
@@ -729,6 +746,18 @@ class _JsonlSession:
             return False
         if op == "stats":
             self._emit({"event": "stats", **self._engine.stats()})
+            return True
+        if op == "metrics":
+            # The observability surface of a RUNNING engine (PERF.md
+            # §21): the process-wide registry as a JSON snapshot plus
+            # its Prometheus text exposition — a scrape adapter needs
+            # only this op.
+            snap = telemetry.snapshot()
+            self._emit({
+                "event": "metrics",
+                "metrics": snap,
+                "prometheus": telemetry.to_prometheus(snap),
+            })
             return True
         if op == "submit":
             kw = _job_from_doc(doc, self._engine.defaults,

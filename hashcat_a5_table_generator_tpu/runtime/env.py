@@ -100,6 +100,17 @@ def stream_enabled() -> bool:
     )
 
 
+def telemetry_enabled() -> bool:
+    """Telemetry knob: ``A5GEN_TELEMETRY`` set to ``off``/``0``/``no``
+    disables the hot-path instrumentation — span-timeline appends,
+    per-fetch registry updates, progress enrichment (PERF.md §21).
+    Counters backing result surfaces (schema/step cache stats) always
+    record; the hatch changes observability, never results."""
+    return not env_opt_out(
+        "A5GEN_TELEMETRY", "telemetry registry + span timeline on"
+    )
+
+
 def schema_cache_dir() -> "Optional[str]":
     """On-disk PieceSchema cache directory (``A5GEN_SCHEMA_CACHE``;
     empty/unset = no persistent cache).  ``SweepConfig.schema_cache`` /

@@ -32,6 +32,7 @@ class ProgressReporter:
         self._t0 = clock()
         self._last = float("-inf")
         self._last_emitted = 0
+        self._last_hits = 0
         self._last_t = self._t0
         self._routing: "dict | None" = None
         self._stream: "dict | None" = None
@@ -55,6 +56,12 @@ class ProgressReporter:
         one's first few seconds."""
         self._last_emitted = emitted
 
+    def seed_hits(self, hits: int) -> None:
+        """``seed_emitted``'s twin for the hit-rate window: a resumed
+        crack sweep re-reports its checkpointed hits up front, and they
+        must not inflate this process's first ``hits_per_sec``."""
+        self._last_hits = hits
+
     def update(
         self, *, words_done: int, emitted: int, hits: int, force: bool = False
     ) -> None:
@@ -63,19 +70,30 @@ class ProgressReporter:
             return
         window = max(now - self._last_t, 1e-9)
         rate = (emitted - self._last_emitted) / window
+        hit_rate = (hits - self._last_hits) / window
         self._last, self._last_t = now, now
         self._last_emitted = emitted
+        self._last_hits = hits
         body = {
             "words": [words_done, self.total_words],
             "candidates": emitted,
             "cand_per_sec": round(rate, 1),
             "hits": hits,
+            "hits_per_sec": round(hit_rate, 3),
             "elapsed_s": round(now - self._t0, 2),
         }
         if self._routing is not None:
             body["routing"] = self._routing
         if self._stream is not None:
             body["stream"] = self._stream
+        # Registry-derived enrichment (PERF.md §21; keys in README):
+        # pipeline dead-time share, chunk-ring occupancy, cache hit
+        # rates — silent when A5GEN_TELEMETRY=off or nothing recorded.
+        from .telemetry import progress_fields
+
+        extra = progress_fields()
+        if extra:
+            body["telemetry"] = extra
         print(
             json.dumps({"progress": body}),
             file=self.stream,

@@ -64,6 +64,7 @@ from ..ops.membership import HostDigestLookup, build_digest_set
 from ..ops.packing import PackedWords, pack_words
 from ..tables.compile import compile_table
 from ..utils.digests import HOST_DIGEST
+from . import telemetry
 from .checkpoint import (
     CheckpointState,
     SweepCursor,
@@ -92,11 +93,6 @@ from .sinks import CandidateWriter, HitRecord, HitRecorder
 #: process are few.
 _STEP_CACHE: Dict = {}
 _STEP_CACHE_LOCK = threading.Lock()
-#: Process-wide step-cache instrumentation: a miss is a program BUILD
-#: (trace + XLA compile on first dispatch), a hit is a job riding an
-#: already-built program — the compile-amortization number the resident
-#: engine's stats and ``bench.py --serve-ab`` report (PERF.md §20).
-_STEP_CACHE_STATS: Dict[str, int] = {"hits": 0, "misses": 0}
 
 
 #: (step key, argument-shape signature) pairs already executed — the
@@ -110,9 +106,16 @@ _STEP_ENV_KNOBS = ("A5GEN_PALLAS", "A5GEN_PALLAS_G",
 
 
 def step_cache_stats() -> Dict[str, int]:
-    """Snapshot of the process-level compiled-step cache counters."""
-    with _STEP_CACHE_LOCK:
-        return dict(_STEP_CACHE_STATS)
+    """Snapshot of the process-level compiled-step cache counters: a
+    miss is a program BUILD (trace + XLA compile on first dispatch), a
+    hit is a job riding an already-built program — the compile-
+    amortization number the resident engine's stats and ``bench.py
+    --serve-ab`` report (PERF.md §20).  A derived view of the
+    ``step_cache.*`` telemetry counters (PERF.md §21)."""
+    return {
+        k: int(telemetry.counter(f"step_cache.{k}").value)
+        for k in ("hits", "misses")
+    }
 
 
 def _step_env_key() -> tuple:
@@ -436,6 +439,10 @@ class Sweep:
         #: crack/candidates machine starts, read by the resident engine
         #: for pause (deep-copied into the job's checkpoint) and stats.
         self.active_state: Optional[CheckpointState] = None
+        #: per-sweep superstep span timeline (PERF.md §21): one record
+        #: per consumed fetch boundary; the engine's ``done``/``paused``
+        #: events and ``--metrics-json`` report its summary.
+        self.timeline = telemetry.SpanTimeline()
         self._stream_lock = threading.Lock()
         self._stream_resident = 0
         self._stream_peak = 0
@@ -760,7 +767,9 @@ class Sweep:
         key = key + (_step_env_key(),)
         with _STEP_CACHE_LOCK:
             step = _STEP_CACHE.get(key)
-            _STEP_CACHE_STATS["hits" if step is not None else "misses"] += 1
+        telemetry.counter(
+            "step_cache.hits" if step is not None else "step_cache.misses"
+        ).add(1)
         if step is None:
             step = build()
             with _STEP_CACHE_LOCK:
@@ -1184,9 +1193,15 @@ class Sweep:
         b0 = ss["b0"]
         while b0 < total_blocks or inflight:
             while b0 < total_blocks and len(inflight) < depth:
-                inflight.append((b0, ss["call"](b0, free_bufs.pop())))
+                # The dispatch wall-clock rides the deque as plain data;
+                # the telemetry record itself happens only at the fetch
+                # boundary below (audit_telemetry pins that the in-
+                # flight window stays instrumentation-free).
+                inflight.append(
+                    (b0, time.monotonic(), ss["call"](b0, free_bufs.pop()))
+                )
                 b0 += advance
-            sb0, out = inflight.popleft()
+            sb0, disp_t, out = inflight.popleft()
             # The ONE per-superstep fetch — the completion barrier for
             # superstep N only (N+1 keeps running on device).
             ne, nh = (int(x) for x in np.asarray(out["counters"]))
@@ -1194,14 +1209,18 @@ class Sweep:
                 self._ttfc[0] = time.monotonic()
             end_b = min(sb0 + advance, total_blocks)
             end_w, end_r = block_cursor(plan, stride, cum, end_b)
+            replayed = False
+            hit_occupancy = 0.0
             if nh:
                 dev_hits = np.asarray(out["dev_hits"])
+                hit_occupancy = int(dev_hits.max()) / max(hit_cap, 1)
                 if int(dev_hits.max()) > hit_cap:
                     # Graceful degradation: the capped device buffer
                     # dropped entries — replay this superstep exactly
                     # through the per-launch path (its hit processing is
                     # the accounting; the scan's counts stand).
                     stats["replays"] += 1
+                    replayed = True
                     self._replay_superstep(
                         sb0, end_b, ss, launch, n_devices, mesh,
                         process_launch_hits, plan=plan,
@@ -1237,6 +1256,21 @@ class Sweep:
             state.cursor = SweepCursor(row_base + end_w, end_r)
             stats["supersteps"] += 1
             stats["launches"] += ss["steps"]
+            # Span record at the consumed (lagged) fetch boundary —
+            # already host-side, so the overlap invariant is untouched
+            # (PERF.md §21); in-flight depth 0 here means the fetch gap
+            # was dead device time (the barriered arm's signature).
+            with telemetry.profiler_span("a5.superstep.consume"):
+                self.timeline.record_fetch(
+                    kind="superstep", index=stats["supersteps"],
+                    dispatched_at=disp_t, inflight=len(inflight),
+                    launches=ss["steps"], emitted=ne, hits=nh,
+                    hit_occupancy=hit_occupancy, replayed=replayed,
+                    chunk=(
+                        row_base // self._stream["chunk_words"]
+                        if self._stream is not None else None
+                    ),
+                )
             self._maybe_checkpoint(state, last_ckpt)
             if cfg.progress:
                 cfg.progress.update(
@@ -1281,8 +1315,6 @@ class Sweep:
         fetch, so host block-cutting overlaps device execution.
         ``plan`` scopes the stream to one compiled plan region (a
         streaming chunk); cursors here are plan-LOCAL."""
-        import jax.profiler
-
         cfg = self.config
         plan = self.plan if plan is None else plan
         stride = cfg.resolve_block_stride()
@@ -1291,8 +1323,9 @@ class Sweep:
         lanes = cfg.lanes
         while True:
             # Annotated so a --profile trace shows how much wall-clock the
-            # host-side scheduler costs vs the overlapped device launches.
-            with jax.profiler.TraceAnnotation("a5.host_cut_blocks"):
+            # host-side scheduler costs vs the overlapped device launches
+            # (guarded: a no-op wherever the profiler is unavailable).
+            with telemetry.profiler_span("a5.host_cut_blocks"):
                 if n_devices == 1:
                     batch, w2, rank2 = make_blocks(
                         plan,
@@ -1477,6 +1510,11 @@ class Sweep:
         sc0 = schema_cache_stats()
         if cfg.progress is not None:
             cfg.progress.seed_emitted(state.n_emitted)
+            # Checkpointed hits are re-reported below; they belong to an
+            # earlier process's windows, not this one's first rate.
+            seed_hits = getattr(cfg.progress, "seed_hits", None)
+            if seed_hits is not None:
+                seed_hits(state.n_hits)
         self._report_stream_position(state)
 
         # Replay checkpointed hits into the recorder (resume produces the
@@ -1678,8 +1716,15 @@ class Sweep:
             )
             state.n_emitted += ne_delta
             state.cursor = SweepCursor(end_word, end_cursor.rank)
+            n_launches = len(chunk)
             chunk = []
             acc = acc_zero
+            # Span record at the consumed chunk-drain boundary (the
+            # per-launch path's fetch barrier, PERF.md §21).
+            self.timeline.record_fetch(
+                kind="drain", launches=n_launches, emitted=ne_delta,
+                hits=nh_delta,
+            )
             self._maybe_checkpoint(state, last_ckpt)
             if cfg.progress:
                 cfg.progress.update(
@@ -1914,13 +1959,13 @@ class Sweep:
                 else SweepCursor(0, 0)
             )
             sstats = (yield from drive_region(chunk, local)) or {}
-            for k, v in sstats.items():
-                if k in ("launches_per_fetch", "pipelined"):
-                    superstep_stats[k] = max(
-                        superstep_stats.get(k, 0), int(v)
-                    )
-                else:
-                    superstep_stats[k] = superstep_stats.get(k, 0) + int(v)
+            # Per-chunk accumulation rides the SAME key semantics the
+            # bucketed and multihost mergers use (PERF.md §21a) — a new
+            # max-semantics key added to the spec cannot silently sum
+            # here while maxing there.
+            superstep_stats.update(
+                telemetry.SUPERSTEP_MERGE.merge([superstep_stats, sstats])
+            )
             # Fallback words at the chunk's tail are due before the ring
             # advances; the cursor lands exactly on the next chunk's lo,
             # and the checkpoint remembers which chunk was active.
@@ -2133,6 +2178,9 @@ class Sweep:
                     state.n_emitted += n
                     b0 = b1
             state.cursor = SweepCursor(row_base + cursor.word, cursor.rank)
+            # Span record at the consumed launch boundary (candidates
+            # mode's fetch barrier, PERF.md §21).
+            self.timeline.record_fetch(kind="launch", launches=1)
             self._maybe_checkpoint(
                 state, last_ckpt, before_save=writer.flush
             )

@@ -1,0 +1,606 @@
+"""Process-wide telemetry: ONE metrics registry + the superstep span
+timeline (PERF.md §21).
+
+The engine grew one ad-hoc counter surface per subsystem —
+``schema_cache_stats()`` in ops/packing, ``_STEP_CACHE_STATS`` in
+runtime/sweep, routing/superstep/stream dicts on ``SweepResult``, each
+with its own bespoke merge in runtime/bucketed and parallel/multihost —
+and no way to observe a *running* engine at all.  This module is the
+one place operational signals live:
+
+* :class:`MetricsRegistry` — thread-safe counters, gauges, and
+  fixed-bucket histograms with plain-dict ``snapshot()`` /
+  :func:`delta` / :func:`merge` semantics.  The scattered counters are
+  now derived views of registry snapshots (``schema_cache_stats``,
+  ``step_cache_stats`` keep their shapes), and the bucketed/multihost
+  stat merges ride the shared :class:`MergeSpec` key semantics instead
+  of re-encoding sum-vs-max per call site.
+* :class:`SpanTimeline` — a bounded per-sweep ring of superstep span
+  records, appended ONLY at already-host-side fetch boundaries (the
+  drive loop's lagged counters barrier), so the pipeline overlap
+  invariant (PERF.md §18) is untouched.  graftaudit's
+  ``audit_telemetry`` statically pins that: no registry/timeline call
+  may sit inside a jitted body, a scan body, or the in-flight window of
+  the drive loop.
+* :func:`profiler_span` — ``jax.profiler.TraceAnnotation`` behind a
+  guard, a no-op wherever the profiler is unavailable.
+
+``A5GEN_TELEMETRY=off`` (``runtime/env.telemetry_enabled``) disables
+the hot-path instrumentation — span appends, per-fetch registry
+updates, progress enrichment — which is what ``bench.py
+--telemetry-ab`` measures (bar: ≤1% wall overhead on the production
+crack contract).  Counters that back existing RESULT surfaces
+(schema-cache and step-cache stats) always record: the hatch must
+never change what a sweep reports, only what it instruments.
+
+Deliberately dependency-free (stdlib only), like ``runtime/env.py``:
+``ops/`` modules import this at module top level and the eager
+``runtime`` imports stay jax-free.  GL013 enforces the flip side: the
+registry owns timing, so ``runtime/`` code outside this module must
+not grow new ``time.monotonic()`` accumulation patterns.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def enabled() -> bool:
+    """Whether hot-path telemetry records (``A5GEN_TELEMETRY`` hatch).
+
+    Re-read per call — the bench A/B flips the environment between
+    arms — but only ever consulted at host-side fetch/compile
+    boundaries, never per candidate."""
+    from .env import telemetry_enabled
+
+    return telemetry_enabled()
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+#: Default histogram bucket edges for wall-clock seconds: fetch gaps
+#: span ~1e-5 s (CPU §4c pipeline) to whole-superstep stalls.
+DEFAULT_TIME_EDGES: Tuple[float, ...] = (
+    1e-5, 2.5e-5, 1e-4, 2.5e-4, 1e-3, 2.5e-3, 1e-2, 2.5e-2,
+    0.1, 0.25, 1.0, 2.5, 10.0,
+)
+
+
+class Counter:
+    """Monotonic counter (int or float adds).  Always records — result
+    surfaces (schema/step cache stats) are derived from counters, and
+    the ``A5GEN_TELEMETRY`` hatch must not change results."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def add(self, n=1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _snap(self) -> dict:
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Point-in-time value with a declared merge aggregation
+    (``max``/``sum``/``last``) — snapshots carry the policy so
+    :func:`merge` needs no out-of-band table."""
+
+    __slots__ = ("name", "agg", "_value", "_lock")
+
+    def __init__(self, name: str, agg: str = "last") -> None:
+        if agg not in ("max", "sum", "last"):
+            raise ValueError(f"gauge agg must be max|sum|last, got {agg!r}")
+        self.name = name
+        self.agg = agg
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v) -> None:
+        with self._lock:
+            self._value = v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    def _snap(self) -> dict:
+        return {"type": "gauge", "value": self.value, "agg": self.agg}
+
+
+class Histogram:
+    """Fixed-bucket histogram.  ``edges`` are upper bounds (Prometheus
+    ``le`` semantics: bucket ``i`` counts observations ``<= edges[i]``);
+    one implicit overflow bucket past the last edge.  Edges are part of
+    the snapshot, so merge can refuse mismatched layouts loudly."""
+
+    __slots__ = ("name", "edges", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, name: str,
+                 edges: Sequence[float] = DEFAULT_TIME_EDGES) -> None:
+        edges = tuple(float(e) for e in edges)
+        if not edges or any(a >= b for a, b in zip(edges, edges[1:])):
+            raise ValueError(
+                f"histogram edges must be strictly ascending, got {edges}"
+            )
+        self.name = name
+        self.edges = edges
+        self._counts = [0] * (len(edges) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, v) -> None:
+        v = float(v)
+        # bisect_left on the upper bounds: the first edge >= v is v's
+        # ``le`` bucket; past the last edge lands in the overflow slot.
+        i = bisect_left(self.edges, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    def _snap(self) -> dict:
+        with self._lock:
+            return {
+                "type": "histogram",
+                "edges": list(self.edges),
+                "counts": list(self._counts),
+                "sum": self._sum,
+                "count": self._count,
+            }
+
+
+class MetricsRegistry:
+    """Name → metric, with get-or-create accessors (call sites never
+    coordinate creation) and a plain-dict snapshot."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def _get(self, name: str, cls, *args, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, *args, **kw)
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} is a {type(m).__name__}, "
+                f"not a {cls.__name__}"
+            )
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str, agg: str = "last") -> Gauge:
+        return self._get(name, Gauge, agg)
+
+    def histogram(self, name: str,
+                  edges: Sequence[float] = DEFAULT_TIME_EDGES) -> Histogram:
+        return self._get(name, Histogram, edges)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """JSON-able ``{name: {"type", "value"/...}}`` in sorted name
+        order — deterministic, so multihost exchanges and test
+        comparisons never depend on creation order."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        return {name: m._snap() for name, m in metrics}
+
+    def reset(self) -> None:
+        """Drop every metric (tests only — production counters are
+        process-lifetime; deltas, not resets, scope them to a run)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: The process-wide registry every subsystem publishes into.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str) -> Counter:
+    return REGISTRY.counter(name)
+
+
+def gauge(name: str, agg: str = "last") -> Gauge:
+    return REGISTRY.gauge(name, agg)
+
+
+def histogram(name: str,
+              edges: Sequence[float] = DEFAULT_TIME_EDGES) -> Histogram:
+    return REGISTRY.histogram(name, edges)
+
+
+def snapshot() -> Dict[str, dict]:
+    return REGISTRY.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Snapshot algebra: delta / merge / exposition
+# ---------------------------------------------------------------------------
+
+
+def delta(before: Dict[str, dict], after: Dict[str, dict]
+          ) -> Dict[str, dict]:
+    """One run's share of the process counters: counters and histograms
+    subtract (metrics absent from ``before`` count from zero); gauges
+    pass through ``after`` (a point-in-time value has no delta).  Only
+    nonzero entries survive — a delta is a report, not a registry
+    dump."""
+    out: Dict[str, dict] = {}
+    for name, snap in after.items():
+        prev = before.get(name)
+        if snap["type"] == "counter":
+            base = prev["value"] if prev else 0
+            d = snap["value"] - base
+            if d:
+                out[name] = {"type": "counter", "value": d}
+        elif snap["type"] == "histogram":
+            if prev and prev.get("edges") != snap["edges"]:
+                prev = None  # re-created with new edges: delta from zero
+            counts = [
+                c - (prev["counts"][i] if prev else 0)
+                for i, c in enumerate(snap["counts"])
+            ]
+            count = snap["count"] - (prev["count"] if prev else 0)
+            if count:
+                out[name] = {
+                    "type": "histogram", "edges": list(snap["edges"]),
+                    "counts": counts,
+                    "sum": snap["sum"] - (prev["sum"] if prev else 0.0),
+                    "count": count,
+                }
+        else:
+            # Gauges are point-in-time: the "delta" is the current
+            # value, reported only when it moved (or is new) so an
+            # unchanged registry yields an empty report.
+            if prev is None or snap["value"] != prev["value"]:
+                out[name] = dict(snap)
+    return out
+
+
+def merge(snapshots: Iterable[Dict[str, dict]]) -> Dict[str, dict]:
+    """Combine snapshots from many sources (buckets, hosts, engines):
+    counters and histogram buckets sum (histogram edge layouts must
+    match — mismatched edges fail loudly instead of blending apples),
+    gauges follow their declared ``agg``.  Keys are processed in sorted
+    order, so every participant of a multihost exchange reduces the
+    identical sequence (the fixed-order rule collectives require)."""
+    out: Dict[str, dict] = {}
+    for snap in snapshots:
+        for name in sorted(snap):
+            entry = snap[name]
+            cur = out.get(name)
+            if cur is None:
+                out[name] = json.loads(json.dumps(entry))  # deep copy
+                continue
+            if cur["type"] != entry["type"]:
+                raise ValueError(
+                    f"metric {name!r} merges a {cur['type']} with a "
+                    f"{entry['type']}"
+                )
+            if entry["type"] == "counter":
+                cur["value"] += entry["value"]
+            elif entry["type"] == "histogram":
+                if cur["edges"] != entry["edges"]:
+                    raise ValueError(
+                        f"histogram {name!r} edge layouts differ: "
+                        f"{cur['edges']} vs {entry['edges']}"
+                    )
+                cur["counts"] = [
+                    a + b for a, b in zip(cur["counts"], entry["counts"])
+                ]
+                cur["sum"] += entry["sum"]
+                cur["count"] += entry["count"]
+            else:
+                agg = cur.get("agg", "last")
+                if agg == "sum":
+                    cur["value"] += entry["value"]
+                elif agg == "max":
+                    cur["value"] = max(cur["value"], entry["value"])
+                else:
+                    cur["value"] = entry["value"]
+    return out
+
+
+def _prom_name(name: str, prefix: str) -> str:
+    out = "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name
+    )
+    return f"{prefix}_{out}"
+
+
+def to_prometheus(snap: Dict[str, dict], prefix: str = "a5gen") -> str:
+    """Prometheus text exposition (v0.0.4) of a snapshot: counters,
+    gauges, and cumulative ``le``-bucketed histograms with ``+Inf``,
+    ``_sum`` and ``_count`` series."""
+    lines: List[str] = []
+    for name in sorted(snap):
+        entry = snap[name]
+        pname = _prom_name(name, prefix)
+        if entry["type"] == "histogram":
+            lines.append(f"# TYPE {pname} histogram")
+            cum = 0
+            for edge, c in zip(entry["edges"], entry["counts"]):
+                cum += c
+                lines.append(f'{pname}_bucket{{le="{edge:g}"}} {cum}')
+            lines.append(
+                f'{pname}_bucket{{le="+Inf"}} {entry["count"]}'
+            )
+            lines.append(f"{pname}_sum {entry['sum']:g}")
+            lines.append(f"{pname}_count {entry['count']}")
+        else:
+            lines.append(f"# TYPE {pname} {entry['type']}")
+            v = entry["value"]
+            lines.append(f"{pname} {v:g}" if isinstance(v, float)
+                         else f"{pname} {v}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Shared stat-dict merge semantics (bucketed + multihost ride these)
+# ---------------------------------------------------------------------------
+
+
+class MergeSpec:
+    """Key semantics of one scattered-stat dict: which keys sum (the
+    default for anything undeclared), which take the max, which belong
+    to the FIRST contributor only (sweep-local scalars like ttfc), and
+    which are derived ratios the merger recomputes (never blended).
+
+    ``runtime/bucketed.py`` merges through :meth:`merge`; the multihost
+    reducers walk :attr:`sum_keys` / :attr:`max_keys` in fixed order so
+    every process runs the identical collective sequence — ONE place
+    now says what each key means."""
+
+    def __init__(self, *, sum_keys: Sequence[str] = (),
+                 max_keys: Sequence[str] = (),
+                 first_keys: Sequence[str] = (),
+                 derived_keys: Sequence[str] = ()) -> None:
+        self.sum_keys = tuple(sum_keys)
+        self.max_keys = tuple(max_keys)
+        self.first_keys = tuple(first_keys)
+        self.derived_keys = tuple(derived_keys)
+
+    def merge(self, dicts: Sequence[Dict]) -> Dict:
+        out: Dict = {}
+        for i, d in enumerate(dicts):
+            for k, v in d.items():
+                if k in self.derived_keys:
+                    continue
+                if k in self.max_keys:
+                    out[k] = max(out.get(k, 0), v)
+                elif k in self.first_keys:
+                    if i == 0:
+                        out[k] = v
+                else:
+                    out[k] = out.get(k, 0) + v
+        return out
+
+
+#: ``SweepResult.superstep`` (PERF.md §15/§18): counters sum; the
+#: steps-per-fetch ratio and the pipelined flag describe one shared
+#: config, so they max.
+SUPERSTEP_MERGE = MergeSpec(
+    sum_keys=("supersteps", "launches", "replays"),
+    max_keys=("launches_per_fetch", "pipelined"),
+)
+
+#: ``SweepResult.stream`` (PERF.md §19): walls/counters sum,
+#: peaks/bounds max, sweep-local scalars belong to the first streaming
+#: contributor, overlap ratios are derived from the summed terms.
+STREAM_MERGE = MergeSpec(
+    sum_keys=("chunks", "chunks_swept", "compile_wall_s",
+              "compile_overlap_s"),
+    max_keys=("peak_resident_plan_bytes", "chunk_bytes_max",
+              "chunk_words", "prefetch", "ring"),
+    first_keys=("ttfc_s", "resumed_chunk", "first_chunk_compile_s"),
+    derived_keys=("overlap_ratio", "steady_overlap_ratio"),
+)
+
+#: ``SweepResult.routing`` / ``SweepResult.schema_cache``: plain
+#: counter sums.
+ROUTING_MERGE = MergeSpec()
+SCHEMA_CACHE_MERGE = MergeSpec()
+
+
+# ---------------------------------------------------------------------------
+# Superstep span timeline
+# ---------------------------------------------------------------------------
+
+
+class SpanTimeline:
+    """Bounded per-sweep ring of fetch-boundary span records.
+
+    One record per CONSUMED fetch (superstep counters barrier or
+    per-launch chunk drain), appended by the drive loop at the already-
+    host-side boundary — the timeline never adds a device round trip,
+    and its ring bound (``capacity``, default 512) keeps a
+    billion-superstep sweep's memory flat.  Each record carries the
+    fetch wall-clock, the host gap since the previous consumed fetch,
+    the in-flight depth at the fetch (0 = the gap was dead device
+    time), hit-buffer occupancy, overflow-replay and chunk markers.
+
+    The timeline also publishes the aggregate registry metrics
+    (``sweep.fetch_gap_s`` histogram, ``sweep.host_gap_s`` /
+    ``sweep.dead_host_s`` / per-kind fetch counters) — the registry
+    owns timing (GL013): drive loops call :meth:`record_fetch` and
+    never accumulate ``time.monotonic()`` themselves."""
+
+    def __init__(self, capacity: int = 512, clock=time.monotonic) -> None:
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._n = 0
+        self._last_fetch: Optional[float] = None
+        self._gap_s = 0.0
+        self._dead_s = 0.0
+        self._max_inflight = 0
+
+    def record_fetch(self, *, kind: str = "superstep", index: int = 0,
+                     dispatched_at: Optional[float] = None,
+                     inflight: int = 0, launches: int = 0,
+                     emitted: int = 0, hits: int = 0,
+                     hit_occupancy: float = 0.0, replayed: bool = False,
+                     chunk: Optional[int] = None) -> None:
+        """Append one span at a consumed fetch boundary and publish the
+        aggregates.  No-op under ``A5GEN_TELEMETRY=off``."""
+        if not enabled():
+            return
+        now = self._clock()
+        rec = {
+            "t": now, "kind": kind, "index": int(index),
+            "inflight": int(inflight), "emitted": int(emitted),
+            "hits": int(hits),
+        }
+        if dispatched_at is not None:
+            rec["queued_s"] = now - dispatched_at
+        if hit_occupancy:
+            rec["hit_occupancy"] = float(hit_occupancy)
+        if replayed:
+            rec["replayed"] = True
+        if chunk is not None:
+            rec["chunk"] = int(chunk)
+        gap = None
+        with self._lock:
+            if self._last_fetch is not None:
+                gap = now - self._last_fetch
+                rec["gap_s"] = gap
+                self._gap_s += gap
+                if inflight == 0:
+                    self._dead_s += gap
+            self._last_fetch = now
+            self._n += 1
+            self._max_inflight = max(self._max_inflight, int(inflight))
+            self._ring.append(rec)
+        counter(f"sweep.fetches.{kind}").add(1)
+        if launches:
+            counter("sweep.launches").add(int(launches))
+        if emitted:
+            counter("sweep.candidates").add(int(emitted))
+        if hits:
+            counter("sweep.hits").add(int(hits))
+        if replayed:
+            counter("sweep.overflow_replays").add(1)
+        if gap is not None:
+            histogram("sweep.fetch_gap_s").observe(gap)
+            counter("sweep.host_gap_s").add(gap)
+            if inflight == 0:
+                counter("sweep.dead_host_s").add(gap)
+
+    def spans(self) -> List[dict]:
+        """The retained span records, oldest first."""
+        with self._lock:
+            return list(self._ring)
+
+    def summary(self) -> dict:
+        """Per-sweep span digest for ``done``/``paused`` events and
+        ``--metrics-json``: span/drop counts, host-gap totals, the
+        dead (no superstep in flight) share of the gap, and the peak
+        in-flight depth.  Empty dict when nothing recorded."""
+        with self._lock:
+            n = self._n
+            if not n:
+                return {}
+            retained = len(self._ring)
+            gap_s, dead_s = self._gap_s, self._dead_s
+            max_inflight = self._max_inflight
+            last = self._ring[-1]
+        out = {
+            "spans": n,
+            "dropped": n - retained,
+            "host_gap_s": round(gap_s, 6),
+            "dead_host_s": round(dead_s, 6),
+            "max_inflight": max_inflight,
+            "last_kind": last["kind"],
+        }
+        if gap_s > 0:
+            out["dead_share"] = round(dead_s / gap_s, 4)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Progress enrichment + profiler hooks
+# ---------------------------------------------------------------------------
+
+
+def progress_fields() -> dict:
+    """Registry-derived fields for the progress JSON line (PERF.md §21;
+    keys documented in README): pipeline dead-time share, chunk-ring
+    occupancy, and cache hit rates.  Only fields with signal appear;
+    {} when telemetry is off or nothing has recorded yet."""
+    if not enabled():
+        return {}
+    out: dict = {}
+    gap = counter("sweep.host_gap_s").value
+    if gap > 0:
+        out["dead_share"] = round(
+            counter("sweep.dead_host_s").value / gap, 4
+        )
+    ring = gauge("stream.ring_occupancy").value
+    if ring:
+        out["ring_occupancy"] = int(ring)
+    for label, prefix in (("schema_cache_hit_rate", "schema_cache"),
+                          ("step_cache_hit_rate", "step_cache")):
+        hits = counter(f"{prefix}.hits").value
+        misses = counter(f"{prefix}.misses").value
+        if hits + misses:
+            out[label] = round(hits / (hits + misses), 4)
+    return out
+
+
+def profiler_span(name: str):
+    """A ``jax.profiler.TraceAnnotation`` span, or a null context when
+    the profiler (or that API) is unavailable on this jax version — the
+    drive loops annotate phases unconditionally and the guard keeps
+    them importable everywhere."""
+    try:
+        import jax.profiler as _prof
+
+        ta = getattr(_prof, "TraceAnnotation", None)
+        if ta is not None:
+            return ta(name)
+    except Exception:  # pragma: no cover - jax-less / broken profiler
+        pass
+    import contextlib
+
+    return contextlib.nullcontext()
+
+
+def profiler_trace(path: Optional[str]):
+    """``jax.profiler.trace(path)`` behind the same guard; a null
+    context when ``path`` is falsy or the profiler is unavailable
+    (``--profile-dir`` must degrade to a no-op, not a crash)."""
+    import contextlib
+
+    if not path:
+        return contextlib.nullcontext()
+    try:
+        import jax.profiler as _prof
+
+        tracer = getattr(_prof, "trace", None)
+        if tracer is not None:
+            return tracer(path)
+    except Exception:  # pragma: no cover - jax-less / broken profiler
+        pass
+    return contextlib.nullcontext()

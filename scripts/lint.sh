@@ -8,11 +8,14 @@
 #   1. ruff      — pyflakes + pycodestyle errors ([tool.ruff] in pyproject)
 #   2. mypy      — typed public API, strict on leaf modules ([tool.mypy])
 #   3. graftlint — repo-specific JAX/Pallas AST rules (tools/graftlint),
-#                  over the package, tools/, bench.py AND scripts/
+#                  over the package, tools/, bench.py AND scripts/ —
+#                  incl. GL013 (timing accumulation belongs to the
+#                  telemetry registry, PERF.md §21)
 #   4. graftaudit — jaxpr/HLO-level semantic audits (tools/graftaudit):
 #                  kernel op budgets (KERNEL_BUDGETS.json), dead-stage
-#                  (DCE) detection, float/transfer purity, Pallas bounds.
-#                  Trace/lower only, CPU backend — PERF.md §16.
+#                  (DCE) detection, float/transfer purity, Pallas bounds,
+#                  and audit_telemetry (registry/timeline calls off the
+#                  hot path). Trace/lower only, CPU backend — PERF.md §16.
 #
 # ruff and mypy are OPTIONAL locally (the TPU dev containers bake only the
 # jax toolchain; nothing may be pip-installed there) and mandatory in CI

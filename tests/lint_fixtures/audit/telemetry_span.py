@@ -1,0 +1,91 @@
+"""Telemetry-placement fixture (PERF.md §21): registry/timeline calls
+must stay off the hot path.
+
+``broken_drive_inflight`` records a span inside the dispatch fill loop
+— host work inserted into the in-flight window narrows the pipeline
+overlap (PERF.md §18) without failing a parity test.  ``broken_scan``
+calls the registry from a ``lax.scan`` body handed to ``jit`` — at
+best it records once at trace time (lying metrics), at worst it
+smuggles a per-step host round trip into the compiled program.  The
+clean twins show the sanctioned shape: dispatch wall-clocks ride the
+deque as plain data, and the ONE telemetry call lands at the consumed
+fetch boundary.
+
+AST-only fixtures: the audit reads source, nothing here ever runs.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+
+
+def clean_drive(call, make_bufs, total, advance, depth, timeline):
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            # A bare monotonic stamp is DATA, not a telemetry call.
+            inflight.append((b0, time.monotonic(), call(b0, free.pop())))
+            b0 += advance
+        sb0, disp_t, out = inflight.popleft()
+        ne, nh = (int(x) for x in np.asarray(out["counters"]))
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        # The sanctioned placement: the consumed fetch boundary.
+        timeline.record_fetch(dispatched_at=disp_t,
+                              inflight=len(inflight), emitted=ne, hits=nh)
+        done += ne
+    return done
+
+
+def broken_drive_inflight(call, make_bufs, total, advance, depth,
+                          timeline):
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            # SIN: a span record per DISPATCH sits in the in-flight
+            # window — host work between dispatches eats the overlap.
+            timeline.record_fetch(kind="dispatch", index=b0)
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        ne, nh = (int(x) for x in np.asarray(out["counters"]))
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def clean_scan(jit, scan, telemetry, xs):
+    def body(carry, x):
+        return carry + x, x
+
+    def step(xs_):
+        return scan(body, 0, xs_)
+
+    total, _ys = jit(step)(xs)
+    # Post-fetch, host-side: the sanctioned placement.
+    telemetry.counter("scan.total").add(int(total))
+    return total
+
+
+def broken_scan(jit, scan, telemetry, xs):
+    def body(carry, x):
+        # SIN: a registry call inside the scan body — trace-time at
+        # best, a smuggled per-step host round trip at worst.
+        telemetry.counter("scan.steps").add(1)
+        return carry + x, x
+
+    def step(xs_):
+        return scan(body, 0, xs_)
+
+    total, _ys = jit(step)(xs)
+    return total

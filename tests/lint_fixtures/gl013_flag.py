@@ -1,0 +1,34 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL013 must flag: ad-hoc elapsed-time arithmetic in ``runtime/``.
+
+Every accumulation form counts — an augmented add of a clock
+difference, a plain elapsed assignment, and accumulating the raw clock
+itself; the telemetry registry (runtime/telemetry.py) owns timing so
+merge/report semantics live in one place (PERF.md §21).
+"""
+
+import time
+
+
+def drive(launch, batches):
+    waited = 0.0
+    for batch in batches:
+        t0 = time.monotonic()
+        launch(batch)
+        waited += time.monotonic() - t0  # accumulation: GL013
+    return waited
+
+
+def run_window(launch):
+    t0 = time.perf_counter()
+    launch()
+    elapsed = time.perf_counter() - t0  # elapsed assignment: GL013
+    return elapsed
+
+
+def wall_clock_total(steps):
+    total = 0.0
+    for step in steps:
+        step()
+        total += time.time()  # raw clock accumulation: GL013
+    return total

@@ -412,6 +412,65 @@ class TestServeLoop:
 
 
 # ---------------------------------------------------------------------------
+# Telemetry placement (PERF.md §21): off the hot path
+# ---------------------------------------------------------------------------
+
+
+class TestTelemetryAudit:
+    def test_clean_drive_passes(self):
+        from tools.graftaudit.telemetry import audit_telemetry
+
+        mod = _fixture("telemetry_span")
+        assert audit_telemetry(mod.clean_drive, "fixture.tl") == []
+
+    def test_inflight_window_record_flagged(self):
+        # A span record inside the dispatch fill loop — host work in
+        # the in-flight window eats the pipeline overlap.
+        from tools.graftaudit.telemetry import audit_telemetry
+
+        mod = _fixture("telemetry_span")
+        findings = audit_telemetry(
+            mod.broken_drive_inflight, "fixture.tl"
+        )
+        assert any("in-flight window" in f.message for f in findings)
+        assert all(f.check == "telemetry" for f in findings)
+
+    def test_clean_scan_passes(self):
+        from tools.graftaudit.telemetry import audit_telemetry
+
+        mod = _fixture("telemetry_span")
+        assert audit_telemetry(mod.clean_scan, "fixture.tl") == []
+
+    def test_scan_body_record_flagged(self):
+        # A registry call inside a scan body handed to jit: trace-time
+        # lies at best, a smuggled per-step host round trip at worst.
+        from tools.graftaudit.telemetry import audit_telemetry
+
+        mod = _fixture("telemetry_span")
+        findings = audit_telemetry(mod.broken_scan, "fixture.tl")
+        assert any("traced body" in f.message for f in findings)
+
+    def test_production_drive_loop_is_clean(self):
+        from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
+        from tools.graftaudit.telemetry import audit_telemetry
+
+        assert audit_telemetry(
+            Sweep._drive_superstep, "runtime.Sweep._drive_superstep"
+        ) == []
+        assert audit_telemetry(
+            Sweep._launches, "runtime.Sweep._launches"
+        ) == []
+
+    def test_production_step_builders_are_clean(self):
+        import hashcat_a5_table_generator_tpu.models.attack as attack
+        import hashcat_a5_table_generator_tpu.parallel.mesh as mesh
+        from tools.graftaudit.telemetry import audit_telemetry_module
+
+        assert audit_telemetry_module(attack) == []
+        assert audit_telemetry_module(mesh) == []
+
+
+# ---------------------------------------------------------------------------
 # Pallas bounds + grid overlap
 # ---------------------------------------------------------------------------
 

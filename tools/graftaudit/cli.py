@@ -9,6 +9,9 @@ anything — and checks
 * ``float-leak``    integer hash pipeline stays float-free
 * ``host-transfer`` no callbacks inside compiled sweep/superstep bodies
 * ``pallas``        static load/store bounds + grid write-overlap
+* ``telemetry``     registry/timeline calls stay off the hot path: none
+                    in jitted/scan bodies or the drive loop's in-flight
+                    window (PERF.md §21)
 
 Exit codes: 0 clean, 1 findings, 2 usage error — same contract as
 graftlint, keyed on by ``scripts/lint.sh`` and CI.
@@ -23,7 +26,8 @@ import time
 from typing import List, Optional, Sequence
 
 #: Check-group names accepted by ``--select``.
-CHECK_GROUPS = ("budgets", "stages", "purity", "transfers", "pallas")
+CHECK_GROUPS = ("budgets", "stages", "purity", "transfers", "pallas",
+                "telemetry")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -64,6 +68,15 @@ def _build_parser() -> argparse.ArgumentParser:
         help="append the markdown budget diff table to PATH (CI: pass "
              "\"$GITHUB_STEP_SUMMARY\")",
     )
+    parser.add_argument(
+        "--metrics-json",
+        metavar="PATH",
+        help="write the audit run's telemetry snapshot (the process-"
+             "wide registry — step/schema cache activity from the "
+             "traced builds — plus audit entry/finding/elapsed gauges) "
+             "as JSON to PATH; CI uploads it as a job artifact "
+             "(PERF.md §21)",
+    )
     return parser
 
 
@@ -101,6 +114,7 @@ def run_audit(
     budgets_path: Optional[str] = None,
     update_budgets: bool = False,
     summary_path: Optional[str] = None,
+    metrics_json: Optional[str] = None,
 ) -> int:
     """The full audit; returns the process exit code."""
     from . import budgets as budgets_mod
@@ -343,10 +357,51 @@ def run_audit(
                     continue
                 findings.extend(audit_stage_text(text, name, stages))
 
+    # -- telemetry placement: registry/timeline calls off the hot path ----
+    if "telemetry" in groups:
+        import hashcat_a5_table_generator_tpu.models.attack as _attack
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as _pe
+        import hashcat_a5_table_generator_tpu.ops.pallas_md5 as _pm
+        import hashcat_a5_table_generator_tpu.parallel.mesh as _mesh
+        from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
+
+        from .telemetry import audit_telemetry, audit_telemetry_module
+
+        findings.extend(
+            audit_telemetry(
+                Sweep._drive_superstep, "runtime.Sweep._drive_superstep"
+            )
+        )
+        findings.extend(
+            audit_telemetry(
+                Sweep._launches, "runtime.Sweep._launches"
+            )
+        )
+        for mod in (_attack, _mesh, _pe, _pm):
+            findings.extend(audit_telemetry_module(mod))
+
     for finding in findings:
         print(finding.render())
     elapsed = time.monotonic() - t0
     n_entries = len(entries)
+    if metrics_json:
+        import json
+
+        from hashcat_a5_table_generator_tpu.runtime import telemetry
+
+        telemetry.gauge("graftaudit.entries").set(n_entries)
+        telemetry.gauge("graftaudit.findings").set(len(findings))
+        telemetry.gauge("graftaudit.elapsed_s").set(round(elapsed, 3))
+        with open(metrics_json, "w", encoding="utf-8") as fh:
+            json.dump(
+                {
+                    "metrics": telemetry.snapshot(),
+                    "groups": list(groups),
+                    "findings": len(findings),
+                },
+                fh, indent=2,
+            )
+            fh.write("\n")
     if findings:
         print(
             f"graftaudit: {len(findings)} finding(s) across {n_entries} "
@@ -380,6 +435,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         budgets_path=args.budgets,
         update_budgets=args.update_budgets,
         summary_path=args.summary,
+        metrics_json=args.metrics_json,
     )
 
 

@@ -1,0 +1,233 @@
+"""Check: telemetry stays off the hot path (PERF.md §21).
+
+The telemetry layer's whole contract is that it observes the engine
+WITHOUT changing its sync structure: span records and registry updates
+happen only at already-host-side fetch boundaries.  Two ways to break
+that silently:
+
+* a registry/timeline call inside a **jitted or scan body** — at best
+  it records once at trace time (lying metrics), at worst it smuggles a
+  host callback into the compiled program (a per-step device→host round
+  trip, the §15 sin with a new face);
+* a registry/timeline call inside the **in-flight window** of the
+  pipelined drive loop (the dispatch fill loop, PERF.md §18) — host
+  work inserted between dispatches narrows the overlap the pipeline
+  exists to create, without failing a single parity test.
+
+``audit_telemetry`` statically walks a function (or a whole module) and
+flags telemetry-shaped calls in either context.  Telemetry-shaped =
+the dotted call chain mentions the telemetry surface (``telemetry``,
+``timeline``, ``metric``, ``registry``) or uses its recording methods
+(``record_fetch``/``record_drain``/``observe``).  Bare
+``time.monotonic()`` stamps are NOT flagged — passing a dispatch
+wall-clock through the in-flight deque as plain data is the sanctioned
+pattern (the record itself happens at the fetch boundary).
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List, Optional, Set
+
+from .findings import AuditFinding
+
+#: Substrings of a dotted call chain that mark the telemetry surface.
+_TELEMETRY_SUBSTRINGS = ("telemetry", "timeline", "metric", "registry")
+
+#: Recording method names that are telemetry no matter the receiver.
+_TELEMETRY_METHODS = frozenset({"record_fetch", "record_drain", "observe"})
+
+#: Call names whose function argument becomes a device-side body: a
+#: telemetry call inside one records at trace time (or worse).
+_TRACED_WRAPPERS = frozenset(
+    {"scan", "while_loop", "fori_loop", "jit", "pjit", "pallas_call",
+     "checkpoint", "remat"}
+)
+
+#: Decorator names that make a def's body a traced body.
+_JIT_DECORATORS = frozenset({"jit", "pjit"})
+
+
+def _dotted_parts(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts
+
+
+def _is_telemetry_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    parts = _dotted_parts(node.func)
+    if not parts:
+        return False
+    if parts[0] in _TELEMETRY_METHODS:  # method name (attr chain head)
+        return True
+    low = ".".join(parts).lower()
+    return any(s in low for s in _TELEMETRY_SUBSTRINGS)
+
+
+def _decorator_names(fdef: ast.AST) -> Set[str]:
+    names: Set[str] = set()
+    for dec in getattr(fdef, "decorator_list", []):
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        parts = _dotted_parts(node)
+        names.update(parts)
+        # functools.partial(jit, ...) / jit(...) with args: the wrapper
+        # name may sit in the call's arguments too.
+        if isinstance(dec, ast.Call):
+            for a in dec.args:
+                names.update(_dotted_parts(a))
+    return names
+
+
+def _traced_defs(tree: ast.AST) -> List[ast.AST]:
+    """Function/lambda nodes whose bodies are traced: jit-decorated
+    defs, and defs/lambdas whose name (or node) is an argument to a
+    scan/while_loop/fori_loop/jit/pallas_call call anywhere in the
+    tree."""
+    defs = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    traced: List[ast.AST] = []
+    for name, fdef in defs.items():
+        if _decorator_names(fdef) & _JIT_DECORATORS:
+            traced.append(fdef)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        parts = _dotted_parts(node.func)
+        if not parts or parts[0] not in _TRACED_WRAPPERS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Lambda):
+                traced.append(arg)
+            elif isinstance(arg, ast.Name) and arg.id in defs:
+                traced.append(defs[arg.id])
+    return traced
+
+
+def _audit_tree(tree: ast.AST, entry: str) -> List[AuditFinding]:
+    findings: List[AuditFinding] = []
+
+    # (a) telemetry inside traced (jitted / scan / kernel) bodies.
+    seen: Set[int] = set()
+    for body in _traced_defs(tree):
+        if id(body) in seen:
+            continue
+        seen.add(id(body))
+        inner = body.body if isinstance(body.body, list) else [body.body]
+        for stmt in inner:
+            for sub in ast.walk(stmt):
+                if _is_telemetry_call(sub):
+                    name = getattr(body, "name", "<lambda>")
+                    findings.append(
+                        AuditFinding(
+                            "telemetry", entry,
+                            f"telemetry call inside traced body "
+                            f"{name!r} (jit/scan/kernel) — records at "
+                            "trace time at best, smuggles a per-step "
+                            "host round trip at worst; telemetry "
+                            "belongs at host-side fetch boundaries "
+                            "(PERF.md §21)",
+                        )
+                    )
+
+    # (b) telemetry inside the drive loop's in-flight (dispatch fill)
+    # window: the nested while of the outermost while loop.
+    fdef = next(
+        (n for n in ast.walk(tree)
+         if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))),
+        None,
+    )
+    outer = next(
+        (n for n in (fdef.body if fdef else [])
+         if isinstance(n, ast.While)),
+        None,
+    )
+    if outer is not None:
+        inner = next(
+            (n for n in outer.body if isinstance(n, ast.While)), None
+        )
+        if inner is not None:
+            for sub in ast.walk(inner):
+                if _is_telemetry_call(sub):
+                    findings.append(
+                        AuditFinding(
+                            "telemetry", entry,
+                            "telemetry call inside the drive loop's "
+                            "in-flight window (the dispatch fill loop) "
+                            "— host work between dispatches narrows "
+                            "the pipeline overlap (PERF.md §18/§21); "
+                            "record at the consumed fetch boundary, "
+                            "and pass dispatch wall-clocks through the "
+                            "deque as plain data",
+                        )
+                    )
+    return findings
+
+
+def audit_telemetry(fn, entry: str) -> List[AuditFinding]:
+    """Statically audit one function (a drive loop, a step builder) for
+    telemetry calls in traced bodies or the in-flight window."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"source unavailable for telemetry audit: {exc}",
+            )
+        ]
+    return _audit_tree(tree, entry)
+
+
+def audit_telemetry_module(module, entry: Optional[str] = None
+                           ) -> List[AuditFinding]:
+    """Module-wide variant: every traced body in ``module`` (scan
+    bodies in the step builders, Pallas kernels) must be telemetry-
+    free.  The in-flight-window check only fires on drive-loop-shaped
+    functions, which modules of kernel builders don't have."""
+    entry = entry or module.__name__
+    try:
+        src = inspect.getsource(module)
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"module source unavailable for telemetry audit: {exc}",
+            )
+        ]
+    # Only the traced-body half applies module-wide: walk each def
+    # independently so nested drive-shaped functions elsewhere don't
+    # confuse the window check.
+    findings: List[AuditFinding] = []
+    seen: Set[int] = set()
+    for body in _traced_defs(tree):
+        if id(body) in seen:
+            continue
+        seen.add(id(body))
+        for sub in ast.walk(body):
+            if sub is body:
+                continue
+            if _is_telemetry_call(sub):
+                name = getattr(body, "name", "<lambda>")
+                findings.append(
+                    AuditFinding(
+                        "telemetry", entry,
+                        f"telemetry call inside traced body {name!r} "
+                        "(jit/scan/kernel) — records at trace time at "
+                        "best, smuggles a per-step host round trip at "
+                        "worst (PERF.md §21)",
+                    )
+                )
+    return findings

@@ -15,6 +15,7 @@ from .dtype import FloatLiteralInKernel, UnmaskedWideInt
 from .envvars import EnvVarSprawl
 from .hygiene import MutableDefaultArg, Nondeterminism, StdoutPrint
 from .jit import JitMissingStaticArgnames
+from .timing import TimingAccumulation
 from .tracing import (
     HostEscapeInTrace,
     HostSyncInLoopBody,
@@ -35,6 +36,7 @@ ALL_RULES: List[Rule] = [
     MutableDefaultArg(),
     HostSyncInLoopBody(),
     EnvVarSprawl(),
+    TimingAccumulation(),
 ]
 
 RULES_BY_CODE: Dict[str, Rule] = {rule.code: rule for rule in ALL_RULES}

@@ -1,0 +1,107 @@
+"""Timing hygiene: the telemetry registry owns timing in ``runtime/``.
+
+PERF.md §21 moved wall-clock instrumentation into
+``runtime/telemetry.py`` (span timeline + registry histograms); ad-hoc
+``t0 = time.monotonic(); acc += time.monotonic() - t0`` accumulation
+scattered through the runtime is exactly the drift the registry exists
+to end — each pattern re-invents merge/report semantics and none of it
+is visible to the ``metrics`` op or ``--metrics-json``.
+
+The rule flags timing *accumulation* (a subtraction or augmented
+assignment involving a clock call), not bare stamps: passing a single
+``time.monotonic()`` reading through a deque as data — the drive
+loop's dispatch stamp — is the sanctioned pattern (the arithmetic
+happens inside the timeline, at the fetch boundary).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..context import PACKAGE, FileContext, dotted_name
+from ..findings import Finding
+from .base import Rule
+
+#: Clock reads whose arithmetic belongs to the registry.
+_CLOCK_CALLS = frozenset(
+    {"time.monotonic", "time.time", "time.perf_counter",
+     "monotonic", "perf_counter"}
+)
+
+#: The module timing belongs to.
+_TELEMETRY_SUFFIX = "/runtime/telemetry.py"
+
+#: Pre-§21 runtime modules with existing accumulation patterns
+#: (wall_s bookkeeping, adaptive drain cycles, overlap windows) —
+#: grandfathered rather than rewritten in the same PR that lands the
+#: rule.  New runtime modules (and new files) get no pass; shrink this
+#: list as the patterns migrate into the timeline.
+_GRANDFATHERED = (
+    f"{PACKAGE}/runtime/sweep.py",
+    f"{PACKAGE}/runtime/bucketed.py",
+)
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and dotted_name(node.func) in _CLOCK_CALLS
+    )
+
+
+def _has_clock_arith(node: ast.AST) -> bool:
+    """A subtraction with a clock call on either side anywhere under
+    ``node`` — the elapsed-seconds idiom."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Sub):
+            if _is_clock_call(sub.left) or _is_clock_call(sub.right):
+                return True
+    return False
+
+
+class TimingAccumulation(Rule):
+    code = "GL013"
+    name = "timing-accumulation"
+    summary = (
+        "direct time.monotonic()/time.time() timing accumulation in "
+        "runtime/ outside telemetry.py (the registry owns timing)"
+    )
+    rationale = (
+        "Scattered elapsed-time arithmetic re-invents merge and report "
+        "semantics per call site and is invisible to the metrics "
+        "registry (PERF.md §21). Record through the SpanTimeline / "
+        "registry histograms instead; bare clock stamps passed as data "
+        "are fine — only the arithmetic is the registry's job."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        path = ctx.posix_path
+        if f"{PACKAGE}/runtime/" not in path:
+            return False
+        if path.endswith(_TELEMETRY_SUFFIX):
+            return False
+        return not any(path.endswith(g) for g in _GRANDFATHERED)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.AugAssign):
+                if _has_clock_arith(node.value) or _is_clock_call(
+                    node.value
+                ):
+                    yield self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        "timing accumulation outside the telemetry "
+                        "registry; record via runtime/telemetry.py "
+                        "(SpanTimeline.record_fetch / histogram "
+                        ".observe)",
+                    )
+            elif isinstance(node, ast.Assign):
+                if _has_clock_arith(node.value):
+                    yield self.finding(
+                        ctx, node.lineno, node.col_offset,
+                        "elapsed-time arithmetic outside the telemetry "
+                        "registry; record via runtime/telemetry.py "
+                        "(the registry owns timing; bare stamps as "
+                        "data are fine)",
+                    )
