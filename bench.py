@@ -239,6 +239,21 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--serve-ab: equal small jobs per arm (default "
                          "4 — the N the §20 amortization criterion is "
                          "stated at)")
+    ap.add_argument("--pack-ab", action="store_true",
+                    help="measure cross-job packed dispatch (PERF.md "
+                         "§22) against the per-job round-robin: N "
+                         "compatible small jobs per arm through a warm "
+                         "resident Engine, parity-asserted per-job "
+                         "emitted counts vs solo runs, fill ratio, "
+                         "aggregate wall ratio, concurrent-admission "
+                         "warm ttfc, and per-job span fairness — one "
+                         "JSON line. Defaults to the §4c CPU peak "
+                         "geometry like --serve-ab")
+    ap.add_argument("--pack-jobs", type=int, default=4,
+                    help="--pack-ab: compatible small jobs per arm "
+                         "(default 4 — the underfilled-N the §22 "
+                         "acceptance criterion is stated at; must "
+                         "divide --blocks)")
     ap.add_argument("--telemetry-ab", action="store_true",
                     help="measure the telemetry layer's wall overhead "
                          "(PERF.md §21) on the production crack "
@@ -1068,6 +1083,178 @@ def run_serve_ab(args: argparse.Namespace) -> None:
             cold["programs_compiled"]
             / max(engine["programs_compiled"], 1)
         ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+def run_pack_ab(args: argparse.Namespace) -> None:
+    """A/B the cross-job packed dispatch (PERF.md §22) against the PR 8
+    per-job round-robin on the production crack contract: the same N
+    compatible small jobs (one synthetic wordlist, per-tenant decoy
+    digest sets — the underfilled-superstep regime packing targets)
+    swept through a resident Engine per arm, both arms WARM (a
+    throwaway batch first, so the measurement is dispatch amortization,
+    not compile).  Reports per-arm aggregate wall, the packed arm's
+    fill ratio (occupied / total lanes per dispatch), concurrent-
+    admission warm ttfc (batch mean over a fresh batch on the warm
+    engine — §20's 0.123 s comparator), per-job span fairness (max/min
+    host-gap share from the PR 9 timeline), and parity-asserts every
+    job's emitted count against its own SOLO run.  One JSON line."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+    from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+    from hashcat_a5_table_generator_tpu.runtime.sweep import (
+        Sweep,
+        SweepConfig,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    if lanes % nb:
+        raise SystemExit("--pack-ab needs blocks dividing lanes")
+    n_jobs = max(2, int(args.pack_jobs))
+    if nb % n_jobs:
+        raise SystemExit(
+            f"--pack-ab needs --blocks ({nb}) divisible by --pack-jobs "
+            f"({n_jobs}) so every job owns an equal segment"
+        )
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout(args.table).to_substitution_map()
+    words = synth_wordlist(args.words)
+    host_digest = HOST_DIGEST[spec.algo]
+    job_digests = [
+        [host_digest(b"bench-decoy-%d-%d" % (j, i)) for i in range(256)]
+        for j in range(n_jobs)
+    ]
+    # superstep=4: the underfilled contract's superstep size.  The auto
+    # default (fetch_chunk = 16 launches per superstep) targets long
+    # sweeps; for jobs a fraction of ONE superstep long it just pads
+    # every dispatch — and ttfc — with masked scan steps, identically
+    # in both arms.  4 keeps several supersteps per job (so the span
+    # fairness instrument has data) without that padding.
+    base_cfg = SweepConfig(lanes=lanes, num_blocks=nb, superstep=4)
+
+    solo = []
+    for j in range(n_jobs):
+        res = Sweep(spec, sub_map, words, job_digests[j],
+                    config=base_cfg).run_crack(resume=False)
+        solo.append(res.n_emitted)
+
+    def arm(pack: bool) -> dict:
+        engine = Engine(base_cfg, auto=False, pack=pack)
+        try:
+            def batch(probes=None):
+                handles = []
+                submits = []
+                for j in range(n_jobs):
+                    cfg = base_cfg
+                    if probes is not None:
+                        probe = _TtfcProbe()
+                        probes.append(probe)
+                        from dataclasses import replace
+
+                        cfg = replace(base_cfg, progress=probe)
+                    submits.append(time.perf_counter())
+                    handles.append(engine.submit(
+                        spec, sub_map, words, job_digests[j], config=cfg
+                    ))
+                return handles, submits
+
+            def run_batch(probes=None):
+                handles, submits = batch(probes)
+                engine.run_until_idle()
+                return handles, submits
+
+            run_batch()  # warm: programs compiled here (both arms)
+            # The measured batch splits admission from serving: the
+            # plan builds are identical work in both arms (measured as
+            # admit_wall_s); the SERVE wall is the dispatch+consume
+            # phase packing exists to amortize — the §22 wall-ratio
+            # instrument compares it.
+            t0 = time.perf_counter()
+            handles, _ = batch()
+            engine._admit()  # builds + fuse, no dispatch
+            t1 = time.perf_counter()
+            engine.run_until_idle()
+            wall = time.perf_counter() - t1
+            results = [h.result(timeout=0) for h in handles]
+            emitted = [r.n_emitted for r in results]
+            gaps = [
+                h.span_summary.get("host_gap_s", 0.0) for h in handles
+            ]
+            fairness = (
+                max(gaps) / min(gaps) if gaps and min(gaps) > 0 else None
+            )
+            # Concurrent-admission warm ttfc: a fresh batch on the warm
+            # engine, each job's first consumed fetch since ITS submit
+            # (admission builds INCLUDED — that is what a tenant waits).
+            probes: list = []
+            handles, submits = run_batch(probes)
+            for h in handles:
+                h.result(timeout=0)
+            ttfc = [
+                probes[i].first - submits[i]
+                for i in range(n_jobs)
+                if probes[i].first is not None
+            ]
+            stats = engine.stats()
+            return {
+                "wall_s": wall,
+                "admit_wall_s": t1 - t0,
+                "jobs": n_jobs,
+                "emitted": emitted,
+                "warm_ttfc_batch_mean_s": (
+                    sum(ttfc) / len(ttfc) if ttfc else None
+                ),
+                "span_fairness_maxmin": fairness,
+                "packed_dispatches": stats["packed_dispatches"],
+                "fill_ratio": stats["packed_fill"],
+                "supersteps_served": stats["supersteps_served"],
+            }
+        finally:
+            engine.close()
+
+    packed = arm(True)
+    rr = arm(False)
+    for name, a in (("packed", packed), ("round-robin", rr)):
+        if a["emitted"] != solo:
+            raise SystemExit(
+                f"--pack-ab {name} arm diverged from solo runs: "
+                f"{a['emitted']} vs {solo} — refusing to report timings "
+                "for non-identical work"
+            )
+    if packed["packed_dispatches"] == 0:
+        raise SystemExit(
+            "--pack-ab packed arm never fused — the jobs were expected "
+            "to be compatible by construction"
+        )
+    record = {
+        "metric": "pack_mode_ab",
+        "unit": "seconds (wall, ttfc) + ratios",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "jobs": n_jobs,
+        "packed": packed,
+        "round_robin": rr,
+        # §22 acceptance instruments: aggregate SERVE-wall ratio (the
+        # >=1.3x bar for underfilled jobs; admission builds are
+        # identical work in both arms and reported as admit_wall_s),
+        # the packed arm's fill ratio, and the concurrent-admission
+        # warm ttfc (vs §20's 0.123 s; builds included).
+        "wall_ratio": rr["wall_s"] / max(packed["wall_s"], 1e-9),
+        "fill_ratio": packed["fill_ratio"],
+        "warm_ttfc_batch_s": packed["warm_ttfc_batch_mean_s"],
     }
     print(json.dumps(record))
     sys.stdout.flush()
@@ -1925,15 +2112,27 @@ def main() -> None:
         args.lanes = (
             2048
             if (args.superstep_ab or args.stride_ab or args.pipeline_ab
-                or args.stream_ab or args.serve_ab or args.telemetry_ab)
+                or args.stream_ab or args.serve_ab or args.telemetry_ab
+                or args.pack_ab)
             else (1 << 22)
         )
     if args.words is None:
         # --serve-ab's contract is N equal SMALL jobs (compile-dominant
-        # — the regime the resident engine amortizes); everything else
-        # keeps the historical default.
-        args.words = 1000 if args.serve_ab else 50000
-    if args.telemetry_ab:
+        # — the regime the resident engine amortizes); --pack-ab's is N
+        # UNDERFILLED jobs (dispatch-dominant — the regime packing
+        # amortizes); everything else keeps the historical default.
+        # --pack-ab wants UNDERFILLED jobs: each job's whole block range
+        # is a fraction of one superstep's lane capacity at the §4c
+        # geometry — the regime cross-job packing amortizes (PERF.md
+        # §22).
+        args.words = (
+            1000 if args.serve_ab else 24 if args.pack_ab else 50000
+        )
+    if args.pack_ab:
+        # Cross-job packing A/B (PERF.md §22); runs on the pinned (or
+        # default) platform in-process.
+        run_pack_ab(args)
+    elif args.telemetry_ab:
         # Telemetry-overhead A/B (PERF.md §21); runs on the pinned (or
         # default) platform in-process.
         run_telemetry_ab(args)

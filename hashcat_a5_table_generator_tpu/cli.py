@@ -1050,6 +1050,18 @@ def _build_serve_parser() -> argparse.ArgumentParser:
                          "process hygiene; default unbounded)")
     ap.add_argument("--max-word-bytes", type=int, default=64 * 1024,
                     help="reject job dictionary lines longer than this")
+    ap.add_argument("--pack", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="cross-job packed superstep dispatch (PERF.md "
+                         "§22): fuse compatible tenants' block ranges "
+                         "into one dispatch. auto = on unless "
+                         "A5GEN_PACK=off; off = the per-job dispatch "
+                         "path")
+    ap.add_argument("--admission-worker", choices=("on", "off"),
+                    default="on",
+                    help="build admitted jobs' plans on a bounded "
+                         "worker thread instead of the serve round "
+                         "(PERF.md §22); off = synchronous admission")
     return ap
 
 
@@ -1076,7 +1088,11 @@ def _run_serve(argv: Sequence[str]) -> int:
         schema_cache=args.schema_cache,
         schema_cache_max_mb=args.schema_cache_max_mb,
     )
-    engine = Engine(defaults)
+    engine = Engine(
+        defaults,
+        pack={"auto": None, "on": True, "off": False}[args.pack],
+        admission_worker=args.admission_worker == "on",
+    )
     print(f"{PROG}: serving on "
           f"{args.socket or 'stdin'} (JSONL; op=shutdown or EOF ends)",
           file=sys.stderr)

@@ -114,7 +114,11 @@ def table_arrays(ct: CompiledTable) -> Dict[str, jnp.ndarray]:
     }
 
 
-def plan_arrays(plan: Plan) -> Dict[str, jnp.ndarray]:
+def plan_array_keys(plan: Plan) -> Tuple[str, ...]:
+    """The plan fields :func:`plan_arrays` ships to device, in order —
+    exposed so host-side consumers (the cross-job fuse layer's
+    compatibility signatures and row concatenation, PERF.md §22) can
+    walk the SAME field set without materializing device buffers."""
     if isinstance(plan, MatchPlan):
         keys = ("tokens", "lengths", "match_pos", "match_len", "match_radix",
                 "match_val_start")
@@ -133,7 +137,11 @@ def plan_arrays(plan: Plan) -> Dict[str, jnp.ndarray]:
                            "cval_bytes", "cval_len")
     else:
         raise TypeError(f"unknown plan type {type(plan)!r}")
-    return {k: jnp.asarray(getattr(plan, k)) for k in keys}
+    return keys
+
+
+def plan_arrays(plan: Plan) -> Dict[str, jnp.ndarray]:
+    return {k: jnp.asarray(getattr(plan, k)) for k in plan_array_keys(plan)}
 
 
 def block_arrays(
@@ -246,25 +254,36 @@ def scalar_units_arrays(plan: Plan, ct: CompiledTable) -> Dict[str, jnp.ndarray]
     return {f"su_{k}": jnp.asarray(v) for k, v in fields.items()}
 
 
+def piece_host_tables(pieces) -> Dict[str, np.ndarray]:
+    """A ``packing.PieceSchema``'s data tables as HOST arrays under
+    their plan-dict names (``pp_*``) — the one naming map, shared by
+    :func:`piece_arrays` (which device-puts them) and the cross-job
+    fuse layer (which signatures and concatenates them host-side,
+    PERF.md §22)."""
+    if pieces is None:
+        return {}
+    out = {}
+    if pieces.gl is not None:
+        out["pp_pl"] = pieces.gl
+    if pieces.gw is not None:
+        out["pp_pw"] = pieces.gw
+    if pieces.gw16 is not None:
+        out["pp_pw16"] = pieces.gw16
+    if pieces.sel_bit is not None:
+        out["pp_sbit"] = pieces.sel_bit
+    if pieces.sel_slot is not None:
+        out["pp_sslot"] = pieces.sel_slot
+    return out
+
+
 def piece_arrays(pieces) -> Dict[str, jnp.ndarray]:
     """Device copies of a ``packing.PieceSchema``'s data tables,
     namespaced for the plan dict (``pp_*``) like
     :func:`scalar_units_arrays` — shipped once per sweep so the wrappers
     and the XLA splice prep launches with row gathers only."""
-    if pieces is None:
-        return {}
-    out = {}
-    if pieces.gl is not None:
-        out["pp_pl"] = jnp.asarray(pieces.gl)
-    if pieces.gw is not None:
-        out["pp_pw"] = jnp.asarray(pieces.gw)
-    if pieces.gw16 is not None:
-        out["pp_pw16"] = jnp.asarray(pieces.gw16)
-    if pieces.sel_bit is not None:
-        out["pp_sbit"] = jnp.asarray(pieces.sel_bit)
-    if pieces.sel_slot is not None:
-        out["pp_sslot"] = jnp.asarray(pieces.sel_slot)
-    return out
+    return {
+        k: jnp.asarray(v) for k, v in piece_host_tables(pieces).items()
+    }
 
 
 def make_fused_lane_body(
@@ -274,6 +293,7 @@ def make_fused_lane_body(
     fused_scalar_units: bool = False,
     radix2: bool = False,
     pieces=None,
+    n_seg: int | None = None,
 ) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]:
     """The lane-level fused expand->hash->match core.
 
@@ -284,6 +304,14 @@ def make_fused_lane_body(
     and never ships them to the host).  Knob semantics are
     :func:`make_fused_body`'s.
 
+    ``n_seg`` (static): the cross-job packed dispatch (PERF.md §22) —
+    the lane axis is partitioned into ``n_seg`` equal contiguous
+    job-segment spans, and each lane's digest is tested against its own
+    segment's target set via :func:`ops.membership.digest_member_seg`
+    (``digests`` then carries the stacked per-segment
+    rows/bitmap/row_lo/row_hi).  Everything before membership is
+    per-lane arithmetic over the packed plan rows, so segmentation
+    changes nothing there.
     """
     from ..ops.pallas_md5 import maybe_pallas_hash_fn
 
@@ -348,12 +376,31 @@ def make_fused_lane_body(
         del word_row  # hit cursors are host-derived from lane indices
         return hash_fn(cand, cand_len), emit
 
+    if n_seg is not None and num_lanes % n_seg:
+        raise ValueError(
+            f"packed lane axis ({num_lanes}) must divide into n_seg "
+            f"({n_seg}) equal segment spans"
+        )
+
     def lane_body(
         plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
         blocks: ArrayTree,
     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
         state, emit = expand_and_hash(plan, table, blocks)
-        member = digest_member(state, digests["rows"], digests["bitmap"])
+        if n_seg is None:
+            member = digest_member(state, digests["rows"],
+                                   digests["bitmap"])
+        else:
+            from ..ops.membership import digest_member_seg
+
+            seg = (
+                jnp.arange(num_lanes, dtype=jnp.int32)
+                // jnp.int32(num_lanes // n_seg)
+            )
+            member = digest_member_seg(
+                state, digests["rows"], digests["bitmap"],
+                digests["row_lo"], digests["row_hi"], seg,
+            )
         return member & emit, emit
 
     return lane_body
@@ -453,6 +500,41 @@ def superstep_arrays(plan: Plan, stride: int,
     }
 
 
+def packed_superstep_arrays(
+    plans: Sequence[Plan], idxs: Sequence[tuple],
+) -> "tuple[ArrayTree, np.ndarray, np.ndarray] | None":
+    """Device copies of SEVERAL plans' block indexes fused into one
+    packed superstep index (PERF.md §22) — the per-segment job-row twin
+    of :func:`superstep_arrays`.  ``idxs`` are the plans'
+    ``ops.blocks.superstep_index`` results (one per job, same stride).
+
+    The returned tree replaces the solo ``total`` bound with per-segment
+    ``seg_end`` rows (job ``j``'s blocks end at ``seg_end[j]``, carried
+    as DATA), and the cutter arrays cover the concatenated packed row
+    space; ``radix`` requires every plan to agree on ``num_slots``
+    (packed-group eligibility, enforced by the fuse layer).  Returns
+    ``(ss tree, blk_base int64[S+1], row_base int64[S+1])`` — the host
+    keeps the bases to map packed rows/blocks back to per-job ones — or
+    ``None`` when the packed index would overflow int32.
+    """
+    from ..ops.blocks import packed_block_index
+
+    packed = packed_block_index(idxs)
+    if packed is None:
+        return None
+    cum, totals, blk_base, row_base, seg_end = packed
+    radix = np.concatenate(
+        [np.asarray(p.pat_radix, dtype=np.int32) for p in plans]
+    )
+    ss = {
+        "cum": jnp.asarray(cum),
+        "totals": jnp.asarray(totals),
+        "radix": jnp.asarray(radix),
+        "seg_end": jnp.asarray(seg_end),
+    }
+    return ss, blk_base, row_base
+
+
 @audited_entry(
     "models.make_superstep_body",
     kind="fused_body",
@@ -463,7 +545,7 @@ def make_superstep_body(
     num_blocks: int, steps: int, hit_cap: int, total_blocks: int,
     windowed: bool = False, step_advance: "int | None" = None,
     fused_expand_opts: int | None = None, fused_scalar_units: bool = False,
-    radix2: bool = False, pieces=None,
+    radix2: bool = False, pieces=None, n_seg: int | None = None,
 ) -> Callable[..., ArrayTree]:
     """The un-jitted superstep executor: ``steps`` fused
     expand->hash->membership launches in ONE device program, with the
@@ -509,20 +591,48 @@ def make_superstep_body(
     bound as data (``ss["total"]``, the post-§19 contract) this static
     value is only a fallback — sweeps of different length then share one
     compiled program (streaming chunk plans).
+
+    ``n_seg``: the cross-job packed dispatch (PERF.md §22).  The block
+    axis of every scan step is partitioned into ``n_seg`` equal
+    contiguous job segments (``num_blocks // n_seg`` blocks each);
+    ``b0`` becomes an int32 ``[n_seg]`` row of per-job packed block
+    cursors, ``ss`` carries per-segment end bounds (``seg_end``,
+    :func:`packed_superstep_arrays`) in place of ``total``, and the
+    scan carry accumulates PER-SEGMENT counter rows — ``counters`` is
+    int32 ``[2, n_seg]`` (row 0 emitted, row 1 hits, one column per
+    job), so per-job counts survive the single per-superstep fetch and
+    packed-vs-solo count parity holds by construction.  Hits land in
+    the shared buffers tagged by their PACKED plan row (the host maps
+    rows back to jobs via the fuse layer's row bases).  Membership runs
+    per segment (:func:`ops.membership.digest_member_seg`) so no lane
+    is ever tested against another tenant's digests.
     """
     lane_body = make_fused_lane_body(
         spec, num_lanes=num_lanes, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
-        pieces=pieces,
+        pieces=pieces, n_seg=n_seg,
     )
     stride = block_stride
     advance = int(step_advance or num_blocks)
+    if n_seg is not None and num_blocks % n_seg:
+        raise ValueError(
+            f"packed dispatch needs num_blocks ({num_blocks}) divisible "
+            f"by n_seg ({n_seg})"
+        )
 
     def cut_blocks(ss: ArrayTree, b0: jnp.ndarray):
         """One launch's blocks from the device-resident index: the exact
-        arithmetic of ``ops.blocks._make_blocks_stride_fast`` in int32."""
-        b = b0 + jnp.arange(num_blocks, dtype=jnp.int32)
+        arithmetic of ``ops.blocks._make_blocks_stride_fast`` in int32.
+        Packed (``n_seg``): each job segment's blocks come from its own
+        cursor row and stop at its own ``seg_end`` bound."""
+        if n_seg is None:
+            b = b0 + jnp.arange(num_blocks, dtype=jnp.int32)
+        else:
+            nbs = num_blocks // n_seg
+            off = jnp.arange(num_blocks, dtype=jnp.int32)
+            seg_of_block = off // jnp.int32(nbs)
+            b = b0[seg_of_block] + (off - seg_of_block * jnp.int32(nbs))
         cum, totals = ss["cum"], ss["totals"]
         nwords = totals.shape[0]
         w = jnp.clip(
@@ -535,9 +645,13 @@ def make_superstep_body(
         # The bound rides the ss tree as DATA (``superstep_arrays``), so
         # different-size sweeps — streaming chunks — reuse one compiled
         # program; ``total_blocks`` stays the static fallback for direct
-        # callers with pre-§19 ss trees.
-        tot = ss.get("total")
-        valid = b < (jnp.int32(total_blocks) if tot is None else tot)
+        # callers with pre-§19 ss trees.  Packed dispatches bound each
+        # segment by its own job's end instead.
+        if n_seg is None:
+            tot = ss.get("total")
+            valid = b < (jnp.int32(total_blocks) if tot is None else tot)
+        else:
+            valid = b < ss["seg_end"][seg_of_block]
         rank0 = jnp.where(valid, (b - cum[w]) * jnp.int32(stride), 0)
         count = jnp.where(
             valid, jnp.clip(totals[w] - rank0, 0, stride), 0
@@ -578,14 +692,31 @@ def make_superstep_body(
             b0c, ne, nh, hw, hr = carry
             blocks, rank0 = cut_blocks(ss, b0c)
             hit, emit = lane_body(plan, table, digests, blocks)
-            nh_step = jnp.sum(hit.astype(jnp.int32))
+            if n_seg is None:
+                ne_step = jnp.sum(emit.astype(jnp.int32))
+                nh_step = jnp.sum(hit.astype(jnp.int32))
+                nh_sofar = nh
+                nh_any = nh_step
+                b_adv = jnp.int32(advance)
+            else:
+                # Per-segment counter rows: each job's lanes are one
+                # contiguous span, so the segment sums are a reshape.
+                ne_step = jnp.sum(
+                    emit.reshape(n_seg, -1).astype(jnp.int32), axis=1
+                )
+                nh_step = jnp.sum(
+                    hit.reshape(n_seg, -1).astype(jnp.int32), axis=1
+                )
+                nh_sofar = jnp.sum(nh)
+                nh_any = jnp.sum(nh_step)
+                b_adv = jnp.int32(advance // n_seg)
 
             def record(bufs):
                 hw0, hr0 = bufs
                 # Compacting scatter: hit lanes land at consecutive
                 # buffer slots in lane (= cursor) order; non-hit lanes
                 # and overflow all target the trash slot [hit_cap].
-                pos = nh + jnp.cumsum(hit.astype(jnp.int32)) - 1
+                pos = nh_sofar + jnp.cumsum(hit.astype(jnp.int32)) - 1
                 idx = jnp.where(
                     hit, jnp.minimum(pos, hit_cap), hit_cap
                 )
@@ -594,18 +725,21 @@ def make_superstep_body(
                 return hw0.at[idx].set(w_lane), hr0.at[idx].set(r_lane)
 
             hw, hr = jax.lax.cond(
-                nh_step > 0, record, lambda bufs: bufs, (hw, hr)
+                nh_any > 0, record, lambda bufs: bufs, (hw, hr)
             )
             carry = (
-                b0c + jnp.int32(advance),
-                ne + jnp.sum(emit.astype(jnp.int32)),
+                b0c + b_adv,
+                ne + ne_step,
                 nh + nh_step,
                 hw,
                 hr,
             )
             return carry, None
 
-        zero = jnp.zeros((), jnp.int32)
+        zero = (
+            jnp.zeros((), jnp.int32) if n_seg is None
+            else jnp.zeros((n_seg,), jnp.int32)
+        )
         init = (
             jnp.asarray(b0, jnp.int32), zero, zero,
             bufs["hit_word"], bufs["hit_rank"],
@@ -613,11 +747,16 @@ def make_superstep_body(
         (_, ne, nh, hw, hr), _ = jax.lax.scan(
             one, init, None, length=steps
         )
+        if n_seg is None:
+            counters, ne_tot, nh_tot = jnp.stack([ne, nh]), ne, nh
+        else:
+            counters = jnp.stack([ne, nh])  # [2, n_seg] — per-job rows
+            ne_tot, nh_tot = jnp.sum(ne), jnp.sum(nh)
         return {
-            "counters": jnp.stack([ne, nh]),
-            "n_emitted": ne,
-            "n_hits": nh,
-            "dev_hits": nh[None],
+            "counters": counters,
+            "n_emitted": ne_tot,
+            "n_hits": nh_tot,
+            "dev_hits": nh_tot[None],
             "hit_word": hw,
             "hit_rank": hr,
         }

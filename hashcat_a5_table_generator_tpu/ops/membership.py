@@ -83,6 +83,19 @@ class DigestSet:
         return int(self.rows.shape[0])
 
 
+def auto_bitmap_bits(n: int) -> int:
+    """The default prefilter sizing for an ``n``-digest set:
+    ``ceil(log2 n) + 10`` bits (≈0.1% false-positive density) clamped to
+    [16, DEFAULT_BITMAP_BITS].  Exposed so the cross-job fuse layer can
+    pick ONE common width for its stacked per-segment bitmaps
+    (PERF.md §22) without re-deriving the formula."""
+    import math
+
+    return min(
+        DEFAULT_BITMAP_BITS, max(16, math.ceil(math.log2(max(n, 2))) + 10)
+    )
+
+
 def build_digest_set(
     digests: Iterable,
     algo: str,
@@ -106,12 +119,7 @@ def build_digest_set(
     if not isinstance(digests, np.ndarray):
         digests = list(digests)
     if bitmap_bits is None:
-        import math
-
-        bitmap_bits = min(
-            DEFAULT_BITMAP_BITS,
-            max(16, math.ceil(math.log2(max(len(digests), 2))) + 10),
-        )
+        bitmap_bits = auto_bitmap_bits(len(digests))
     if bitmap_bits < 5:
         raise ValueError("bitmap_bits must be >= 5 (one uint32 word)")
     k = DIGEST_WORDS[algo]
@@ -246,6 +254,62 @@ def digest_member(
     lo, _ = jax.lax.fori_loop(0, steps, body, (lo0, hi0))
     found = jnp.clip(lo - 1, 0, d - 1)
     exact = jnp.all(rows[found] == digest, axis=-1) & (lo > 0)
+    return pre & exact
+
+
+def digest_member_seg(
+    digest: jnp.ndarray,  # uint32 [N, K]
+    rows: jnp.ndarray,  # uint32 [D_total, K] — per-segment sorted runs
+    bitmap: jnp.ndarray,  # uint32 [S, 2^bits/32] — one bitmap per segment
+    row_lo: jnp.ndarray,  # int32 [S] — segment row range start (inclusive)
+    row_hi: jnp.ndarray,  # int32 [S] — segment row range end (exclusive)
+    seg: jnp.ndarray,  # int32 [N] — each lane's segment id
+) -> jnp.ndarray:
+    """Per-segment exact membership: ``bool[N]`` (PERF.md §22).
+
+    The cross-job packed superstep fuses several tenants' lanes into one
+    dispatch; each lane's digest must be tested against its OWN job's
+    target set — testing against the union would flag cross-tenant
+    false hits and break packed-vs-solo count parity.  ``rows`` is the
+    jobs' sorted digest matrices concatenated (segment ``s`` owning rows
+    ``[row_lo[s], row_hi[s])`` — each run independently sorted, exactly
+    the rows the solo sweep searches), and ``bitmap`` stacks the
+    per-segment prefilters at a COMMON ``bitmap_bits`` (the bitmap is a
+    prefilter ANDed with the exact search, so a different bitmap size
+    than a solo run never changes results).
+
+    This is :func:`digest_member`'s binary search with the (lo, hi)
+    carry — already per-lane — initialized from the lane's segment
+    bounds instead of ``(0, D)``: each lane's search walks only its own
+    segment's sorted run.  An empty segment (``lo == hi``) never moves
+    and never matches.
+    """
+    n, k = digest.shape
+    d = rows.shape[0]
+    if d == 0:
+        return jnp.zeros((n,), dtype=bool)
+
+    bitmap_bits = int(np.log2(bitmap.shape[1])) + 5
+    idx = digest[:, 0] & _U32((1 << bitmap_bits) - 1)
+    word = bitmap[seg, (idx >> _U32(5)).astype(jnp.int32)]
+    pre = (word >> (idx & _U32(31))) & _U32(1) != 0
+
+    # log2 of the TOTAL row count bounds every segment's run; converged
+    # lanes are frozen by the (lo < hi) guard, so extra steps are no-ops.
+    steps = int(np.ceil(np.log2(max(d, 2)))) + 1
+    lo0 = row_lo[seg].astype(jnp.int32)
+    hi0 = row_hi[seg].astype(jnp.int32)
+
+    def body(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi) >> 1
+        le = _row_cmp_le(digest, rows[mid]) & (lo < hi)
+        return jnp.where(le, mid + 1, lo), jnp.where(le, hi, mid)
+
+    lo, _ = jax.lax.fori_loop(0, steps, body, (lo0, hi0))
+    found = jnp.clip(lo - 1, 0, d - 1)
+    # "Found something" is lo past the segment's OWN virtual -1 row.
+    exact = jnp.all(rows[found] == digest, axis=-1) & (lo > lo0)
     return pre & exact
 
 

@@ -28,6 +28,7 @@ from __future__ import annotations
 from typing import Dict, List, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -243,6 +244,13 @@ def make_sharded_superstep_step(
     ``[d * (hit_cap + 1), (d+1) * (hit_cap + 1))``, slot ``hit_cap`` the
     trash slot).  The host merges per-device slices and sorts by
     (word, rank) — cursor order, identical to the single-device stream.
+
+    Cross-job packed dispatch (``n_seg`` in ``kwargs``, PERF.md §22):
+    ``b0`` becomes int32 [D, n_seg] — device ``d``'s per-segment start
+    rows, each ``b0[j] + d * (num_blocks // n_seg)`` — and the SAME
+    single stacked collective now carries the segmented counter rows
+    (``counters`` int32 [2, n_seg] psum'd elementwise), so per-job
+    counts survive sharding without any extra psum.
     """
     from ..models.attack import _buffer_donation
 
@@ -255,10 +263,16 @@ def make_sharded_superstep_step(
     def local_step(plan, table, digests, ss, b0, bufs):
         out = body(plan, table, digests, ss, b0[0], bufs)
         # ONE collective per superstep: counters stacks
-        # [n_emitted, n_hits], so the replicated scalars are its rows.
+        # [n_emitted, n_hits] (per-segment COLUMNS under the packed
+        # dispatch), so the replicated scalars are its rows (or their
+        # segment sums).
         out["counters"] = jax.lax.psum(out["counters"], axis_name)
-        out["n_emitted"] = out["counters"][0]
-        out["n_hits"] = out["counters"][1]
+        if out["counters"].ndim == 1:
+            out["n_emitted"] = out["counters"][0]
+            out["n_hits"] = out["counters"][1]
+        else:
+            out["n_emitted"] = jnp.sum(out["counters"][0])
+            out["n_hits"] = jnp.sum(out["counters"][1])
         return out
 
     rep = P()

@@ -50,6 +50,7 @@ import itertools
 import json
 import queue
 import threading
+import time
 from dataclasses import replace
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -245,7 +246,8 @@ class Engine:
     deterministic."""
 
     def __init__(self, defaults=None, *, hit_queue_depth: int = 4096,
-                 auto: bool = True) -> None:
+                 auto: bool = True, pack: Optional[bool] = None,
+                 admission_worker: bool = True) -> None:
         from ..ops.packing import schema_cache_stats
         from .sweep import SweepConfig, step_cache_stats
 
@@ -262,8 +264,40 @@ class Engine:
             "jobs_cancelled": 0, "jobs_paused": 0, "supersteps_served": 0,
         }
         self._groups: Dict[str, int] = {}
+        #: cross-job physical packing (PERF.md §22): None = the
+        #: A5GEN_PACK env hatch decides (on by default); False restores
+        #: the PR 8 per-job dispatch path wholesale.
+        self._pack = pack
+        #: fused tenant groups currently dispatching (runtime.fuse).
+        self._fused: List = []
+        #: admission-time compile offload (PERF.md §22): plan/prescan/
+        #: schema builds run on ONE bounded worker thread (generalizing
+        #: the §19 ChunkCompiler pattern) instead of stalling the serve
+        #: round — warm-job admission under load stops paying the build
+        #: on the multiplexing thread.  None = build synchronously in
+        #: ``_admit`` (the pre-§22 behavior).
+        self._admit_ex = None
+        if admission_worker:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._admit_ex = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="a5-engine-admit"
+            )
+        #: completed builds: (job, slot | None, exc | None) — the worker
+        #: (or the sync path) produces, the serve thread consumes.
+        self._built: "queue.Queue" = queue.Queue()
+        self._building = 0  # builds in flight (under _lock)
+        self._in_build: set = set()  # their jobs, for close(cancel=True)
+        #: same-scheduler-key jobs drained from one submission burst are
+        #: staged until ALL their builds land, then fused together —
+        #: packing needs the whole batch's plans to concatenate.
+        #: Mutated under ``_lock`` (``close(cancel=True)`` snapshots it
+        #: from the caller thread).
+        self._staging: Dict[str, dict] = {}
+        self._cancel_all = False  # close(cancel=True) raced activations
         self._step0 = step_cache_stats()
         self._schema0 = schema_cache_stats()
+        self._packed0 = self._packed_counters()
         self._thread: Optional[threading.Thread] = None
         if auto:
             self._thread = threading.Thread(
@@ -271,6 +305,20 @@ class Engine:
                 daemon=True,
             )
             self._thread.start()
+
+    def _pack_on(self) -> bool:
+        if self._pack is not None:
+            return bool(self._pack)
+        from .env import pack_enabled
+
+        return pack_enabled()
+
+    @staticmethod
+    def _packed_counters() -> Dict[str, int]:
+        return {
+            k: int(telemetry.counter(f"engine.packed_{k}").value)
+            for k in ("dispatches", "lanes_occupied", "lanes_total")
+        }
 
     # -- tenant surface ------------------------------------------------
 
@@ -341,16 +389,31 @@ class Engine:
             counts = dict(self._counts)
             groups = dict(self._groups)
             active = len(self._active)
+            fused = len(self._fused)
+            building = self._building
         steps = _stats_delta(self._step0, step_cache_stats())
+        packed = _stats_delta(self._packed0, self._packed_counters())
         return {
             **counts,
             "jobs_active": active,
             "jobs_queued": self._pending.qsize(),
+            "jobs_building": building,
             "groups": groups,
             "programs_compiled": steps.get("misses", 0),
             "program_cache_hits": steps.get("hits", 0),
             "schema_cache": _stats_delta(self._schema0,
                                          schema_cache_stats()),
+            # Cross-job packing (PERF.md §22): fused groups currently
+            # dispatching, packed dispatches since engine start, and
+            # the aggregate fill ratio (occupied / total lanes across
+            # packed dispatches; 0 when none ran).
+            "fused_groups": fused,
+            "packed_dispatches": packed.get("dispatches", 0),
+            "packed_fill": (
+                packed.get("lanes_occupied", 0)
+                / packed["lanes_total"]
+                if packed.get("lanes_total") else 0.0
+            ),
         }
 
     def close(self, *, cancel: bool = False,
@@ -359,9 +422,28 @@ class Engine:
         first; ``cancel=True`` drops them at the next boundary."""
         if cancel:
             with self._lock:
+                # One snapshot closes the staging→active move gap: a
+                # slot not yet in any list is caught by _cancel_all at
+                # its activation.
+                self._cancel_all = True
                 slots = list(self._active)
+                building = list(self._in_build)
+                # Staged-ready slots (built, parked for their burst
+                # peers) must cancel too: they activate when their
+                # batch releases, and the cancel flag then retires them
+                # at their first round, before any machine tick.
+                staged = [
+                    s.job
+                    for stage in self._staging.values()
+                    for s in stage["ready"]
+                ]
             for slot in slots:
                 slot.job.cancel()
+            for job in building + staged:
+                # Builds in flight on the admission worker finish, then
+                # settle cancelled at collection (the cancel-req check
+                # in _finish_build).
+                job.cancel()
             while True:
                 try:
                     job = self._pending.get_nowait()
@@ -388,6 +470,12 @@ class Engine:
             except queue.Empty:
                 break
             self._settle_counts(job, "cancelled")
+        if self._admit_ex is not None:
+            # The drain above consumed every completed build; stop the
+            # worker (waits out any still-running build — its job was
+            # settled through the cancel path or served by the drain).
+            self._admit_ex.shutdown(wait=True)
+            self._collect_builds()
 
     def __enter__(self) -> "Engine":
         return self
@@ -399,10 +487,17 @@ class Engine:
 
     def _serve_forever(self) -> None:
         while True:
-            self._admit()
+            self._admit(wait=False)
             with self._lock:
                 idle = not self._active
+                building = self._building > 0
             if idle:
+                if building:
+                    self._wake.wait(0.05)
+                    self._wake.clear()
+                    continue
+                if self._staging and self._flush_staging():
+                    continue
                 if self._shutdown and self._pending.empty():
                     return
                 self._wake.wait(0.05)
@@ -411,43 +506,258 @@ class Engine:
             self._serve_round()
 
     def run_until_idle(self) -> None:
-        """Manual-mode drive: admit and serve until no job is active or
-        queued (embedders owning the loop; tests)."""
+        """Manual-mode drive: admit and serve until no job is active,
+        building, or queued (embedders owning the loop; tests)."""
         while True:
-            self._admit()
+            self._admit(wait=False)
             with self._lock:
-                idle = not self._active
-            if idle and self._pending.empty():
-                return
-            self._serve_round()
+                active = bool(self._active)
+                building = self._building > 0
+            if active:
+                self._serve_round()
+                continue
+            if building:
+                # Nothing to serve yet: block on the next completed
+                # build instead of spinning (bounded wait — a worker
+                # death would otherwise hang the embedder forever).
+                try:
+                    item = self._built.get(timeout=0.5)
+                except queue.Empty:
+                    continue
+                self._finish_build(*item)
+                continue
+            if not self._pending.empty():
+                continue
+            if self._staging and self._flush_staging():
+                continue
+            return
 
-    def _admit(self) -> None:
-        """Drain the submission queue into scheduler slots: build each
-        job's Sweep (plan + prescan compile — host work, on this
-        thread) and its machine, and group it by static trace config so
-        same-config jobs ride one compiled program and run adjacently."""
+    def _flush_staging(self) -> bool:
+        """Defensive drain: release any staged batches whose peers will
+        never arrive (a failed bookkeeping path must degrade to solo
+        admission, never to jobs stuck in staging)."""
+        released = False
+        with self._lock:
+            stages = list(self._staging.values())
+            self._staging.clear()
+        for stage in stages:
+            if stage["ready"]:
+                self._fuse_and_activate(stage["ready"])
+                released = True
+        return released
+
+    def _admit(self, wait: bool = True) -> None:
+        """Drain the submission queue into scheduler slots.  Each job's
+        Sweep build (plan + prescan + schema compile — host work) runs
+        on the bounded admission worker (PERF.md §22) so the serve
+        round keeps multiplexing the already-running tenants; completed
+        builds are collected here, grouped by static trace config (so
+        same-config jobs ride one compiled program and run adjacently),
+        and — when packing is on — same-burst compatible jobs are fused
+        into one packed dispatch group (``runtime.fuse``).
+
+        ``wait=True`` (the manual embedder API's contract: after
+        ``_admit()`` every submitted job IS a scheduler slot) blocks
+        until the in-flight builds land; the serve loops pass False and
+        collect completed builds opportunistically each round."""
         while True:
             try:
                 job = self._pending.get_nowait()
             except queue.Empty:
+                break
+            self._intake(job)
+        # The admission-build window IS the packing window: while the
+        # worker is still building this burst, peers arriving a few
+        # milliseconds apart (one JSONL line at a time through ``a5gen
+        # serve``) join the same staging batch — at zero added latency,
+        # since admission cannot outrun the build anyway.  The window
+        # closes when the worker drains OR at a hard deadline (a client
+        # submitting faster than builds complete must not extend it
+        # forever — jobs still have to activate and serve), and never
+        # opens at all while tenants are RUNNABLE: the serve round must
+        # keep multiplexing them during a build (the whole point of the
+        # admission offload), so a busy engine collects this burst over
+        # its ordinary rounds instead of lingering here.
+        with self._lock:
+            serving = bool(self._active)
+        if self._admit_ex is not None and self._pack_on() and not serving:
+            deadline = time.monotonic() + 0.25
+            while (
+                self._building - self._built.qsize() > 0
+                and time.monotonic() < deadline
+            ):
+                try:
+                    job = self._pending.get(timeout=0.002)
+                except queue.Empty:
+                    continue
+                self._intake(job)
+        self._collect_builds()
+        while wait:
+            with self._lock:
+                building = self._building > 0
+            if not building:
+                if self._staging:
+                    self._flush_staging()
                 return
-            if job._cancel_req.is_set():
-                self._settle_counts(job, "cancelled")
-                continue
             try:
-                slot = self._build_slot(job)
-            except Exception as exc:  # noqa: BLE001 — job-scoped failure
+                item = self._built.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self._finish_build(*item)
+
+    def _intake(self, job: EngineJob) -> None:
+        """One drained submission: honor a pre-admission cancel, stage
+        crack jobs for packing, and hand the build to the worker (or
+        build inline in sync-admission mode)."""
+        if job._cancel_req.is_set():
+            self._settle_counts(job, "cancelled")
+            return
+        if self._pack_on() and job.kind == "crack":
+            skey = self._staging_key(job)
+            with self._lock:
+                stage = self._staging.setdefault(
+                    skey, {"need": 0, "ready": []}
+                )
+                stage["need"] += 1
+            job._staging_key = skey
+        else:
+            job._staging_key = None
+        if self._admit_ex is None:
+            self._built.put(self._try_build(job))
+        else:
+            with self._lock:
+                self._building += 1
+                self._in_build.add(job)
+            self._admit_ex.submit(self._worker_build, job)
+
+    def _staging_key(self, job: EngineJob) -> str:
+        a = job._submit_args
+        cfg = a["config"] if a["config"] is not None else self.defaults
+        return f"{job.kind}|{self._group_key(a['spec'], cfg)}"
+
+    def _try_build(self, job: EngineJob):
+        try:
+            return job, self._build_slot(job), None
+        except Exception as exc:  # noqa: BLE001 — job-scoped failure
+            return job, None, exc
+
+    def _worker_build(self, job: EngineJob) -> None:
+        self._built.put(self._try_build(job))
+        self._wake.set()
+
+    def _collect_builds(self) -> None:
+        while True:
+            try:
+                job, slot, exc = self._built.get_nowait()
+            except queue.Empty:
+                return
+            self._finish_build(job, slot, exc)
+
+    def _finish_build(self, job: EngineJob, slot: "Optional[_Slot]",
+                      exc: "Optional[BaseException]") -> None:
+        """One completed admission build: settle failures (the worker's
+        error propagation seam), honor cancels that raced the build,
+        and either activate the slot solo or stage it until its
+        submission burst's peers are all built, then fuse."""
+        if self._admit_ex is not None:
+            with self._lock:
+                self._building -= 1
+                self._in_build.discard(job)
+        skey = getattr(job, "_staging_key", None)
+        with self._lock:
+            stage = self._staging.get(skey) if skey is not None else None
+        if exc is not None or job._cancel_req.is_set():
+            if exc is not None:
                 job.error = exc
                 self._settle_counts(job, "failed")
-                continue
-            job.state = "running"
-            with self._lock:
-                self._active.append(slot)
-                self._groups[slot.group] = self._groups.get(slot.group,
-                                                            0) + 1
-                # Same-group jobs adjacent, groups in admission order:
-                # warm programs serve their whole group back to back.
-                self._active.sort(key=lambda s: (s.group, s.seq))
+            else:
+                self._settle_counts(job, "cancelled")
+            if stage is not None:
+                with self._lock:
+                    stage["need"] -= 1
+                self._maybe_release(skey, stage)
+            return
+        if stage is None:
+            self._activate(slot)
+            return
+        with self._lock:
+            stage["ready"].append(slot)
+        self._maybe_release(skey, stage)
+
+    def _maybe_release(self, skey: str, stage: dict) -> None:
+        with self._lock:
+            if len(stage["ready"]) < stage["need"]:
+                return
+            self._staging.pop(skey, None)
+        self._fuse_and_activate(stage["ready"])
+
+    def _fuse_and_activate(self, slots: List["_Slot"]) -> None:
+        """Fuse a released staging batch: slots whose full packed keys
+        match (and that are individually pack-eligible) form fused
+        groups of the largest size ≥ 2 dividing the block count; the
+        rest — unique keys, ineligible plans, leftover odd members —
+        activate on the per-job dispatch path, exactly PR 8.  Packing
+        is an optimization, so every failure here is contained: an
+        eligibility-probe error demotes the job to solo dispatch, and a
+        group-build error (schema I/O, device memory on the packed
+        upload) fails ONLY the batch it was fusing — never the serve
+        thread."""
+        from .fuse import build_fused_group, pack_candidate
+
+        buckets: Dict[tuple, List[tuple]] = {}
+        solo: List[_Slot] = []
+        for slot in slots:
+            try:
+                cand = pack_candidate(slot.sweep, slot.job._resume_state)
+            except Exception:  # noqa: BLE001 — probe error = solo path
+                cand = None
+            if cand is None:
+                solo.append(slot)
+            else:
+                buckets.setdefault(cand["key"], []).append((slot, cand))
+        for _key, members in buckets.items():
+            while len(members) >= 2:
+                nb = members[0][1]["sweep"].config.num_blocks
+                take = len(members)
+                while take >= 2 and nb % take:
+                    take -= 1
+                if take < 2:
+                    break
+                chosen, members = members[:take], members[take:]
+                try:
+                    group = build_fused_group([c for _s, c in chosen])
+                except Exception as exc:  # noqa: BLE001 — batch-scoped
+                    for slot, _c in chosen:
+                        slot.machine.close()
+                        slot.job.error = exc
+                        self._settle_counts(slot.job, "failed")
+                    continue
+                if group is None:
+                    solo.extend(s for s, _c in chosen)
+                    continue
+                for slot, _c in chosen:
+                    group.register(slot.sweep)
+                    self._activate(slot)
+                with self._lock:
+                    self._fused.append(group)
+            solo.extend(s for s, _c in members)
+        for slot in solo:
+            self._activate(slot)
+
+    def _activate(self, slot: "_Slot") -> None:
+        if self._cancel_all:
+            # close(cancel=True) raced this slot between its snapshots
+            # and activation: honor the drop (the serve round retires
+            # cancel-flagged slots before any machine tick).
+            slot.job.cancel()
+        slot.job.state = "running"
+        with self._lock:
+            self._active.append(slot)
+            self._groups[slot.group] = self._groups.get(slot.group,
+                                                        0) + 1
+            # Same-group jobs adjacent, groups in admission order:
+            # warm programs serve their whole group back to back.
+            self._active.sort(key=lambda s: (s.group, s.seq))
 
     def _build_slot(self, job: EngineJob) -> _Slot:
         from .sweep import Sweep
@@ -488,7 +798,14 @@ class Engine:
         (PERF.md §18) — a fetch here would barrier every tenant behind
         one job's in-flight work.  Control (pause/cancel) is handled at
         the same boundaries, where each machine's CheckpointState is
-        consistent by construction."""
+        consistent by construction.
+
+        Fused tenant groups (PERF.md §22) are pumped FIRST — exactly one
+        packed dispatch+fetch per group per round (``runtime.fuse``,
+        audited by ``audit_pack_round``) — so every packed member's tick
+        below finds its split result already host-side; the member ticks
+        themselves stay one-per-job, packed or not."""
+        self._pump_groups()
         for slot in self._round_slots():
             if slot.job._cancel_req.is_set():
                 self._retire(slot, "cancelled")
@@ -513,7 +830,37 @@ class Engine:
         with self._lock:
             return list(self._active)
 
+    def _pump_groups(self) -> None:
+        """One packed dispatch round per fused group; drained groups
+        retire (their members already left via the machines' drive
+        finallys).  A pump error (device failure mid-dispatch) is
+        GROUP-scoped: its members fail — they can never receive another
+        result — and every other tenant keeps serving."""
+        with self._lock:
+            groups = list(self._fused)
+        for group in groups:
+            try:
+                group.pump()
+            except Exception as exc:  # noqa: BLE001 — group-scoped
+                for slot in self._round_slots():
+                    if getattr(slot.sweep, "_packed_source",
+                               None) is group:
+                        slot.machine.close()
+                        self._drop(slot)
+                        slot.job.error = exc
+                        self._settle_counts(slot.job, "failed")
+            if group.done:
+                with self._lock:
+                    if group in self._fused:
+                        self._fused.remove(group)
+
     def _drop(self, slot: _Slot) -> None:
+        # A packed member must park its segment even when its machine
+        # never started (close() on an unstarted generator skips the
+        # drive's own leave-in-finally); leave is idempotent.
+        src = getattr(slot.sweep, "_packed_source", None)
+        if src is not None:
+            src.leave(slot.sweep)
         with self._lock:
             if slot in self._active:
                 self._active.remove(slot)

@@ -111,6 +111,17 @@ def telemetry_enabled() -> bool:
     )
 
 
+def pack_enabled() -> bool:
+    """Cross-job physical packing knob (PERF.md §22): ``A5GEN_PACK``
+    set to ``off``/``0``/``no`` restores the resident engine's per-job
+    superstep dispatch (the PR 8 path) instead of fusing compatible
+    tenants' block ranges into one dispatch.  The streams are identical
+    either way; only fill ratio and dispatch count differ."""
+    return not env_opt_out(
+        "A5GEN_PACK", "cross-job packed superstep dispatch"
+    )
+
+
 def schema_cache_dir() -> "Optional[str]":
     """On-disk PieceSchema cache directory (``A5GEN_SCHEMA_CACHE``;
     empty/unset = no persistent cache).  ``SweepConfig.schema_cache`` /

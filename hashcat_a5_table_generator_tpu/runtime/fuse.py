@@ -1,0 +1,584 @@
+"""Cross-job physical packing: fused superstep dispatch (PERF.md §22).
+
+The resident engine (PERF.md §20) multiplexes tenants onto shared
+compiled programs, but each job still gets its own superstep dispatches
+— N small jobs pay N dispatch+fetch round trips per round, each with
+mostly-masked lanes (a 40-block job in a 512-block launch wastes 92% of
+the lane geometry the piece kernels were tuned for).  This module fuses
+compatible runnable jobs' block ranges into ONE physical dispatch:
+
+* the packed superstep program (``models.attack.make_superstep_body``
+  with ``n_seg``) partitions every scan step's block axis into equal
+  per-job segments, cuts each segment's blocks from its own job's
+  region of the packed index (``ops.blocks.packed_block_index``), and
+  accumulates PER-JOB counter rows in the scan carry — so the single
+  per-superstep fetch returns each tenant's own emitted/hit counts;
+* each lane's digest membership runs against its own job's target set
+  (``ops.membership.digest_member_seg``) — never the union, so packed
+  hit counts equal solo hit counts by construction;
+* hits land in the shared capped buffer tagged by their packed plan
+  row; the host maps rows back to jobs via the fuse bases and hands
+  every job exactly the (word, rank) entries its solo sweep would have
+  fetched.
+
+The consume side stays in the job machines: :class:`FusedGroup` owns
+dispatch and the one unconditional counters fetch per round
+(``pump()``, audited by ``graftaudit audit_pack_round``), and each
+member machine's ``Sweep._drive_packed`` pulls its own split result —
+cursor bookkeeping, fallback interleave, hit re-derivation/
+re-verification, checkpointing and the span timeline are the SAME code
+the solo drive runs, so per-job streams, checkpoints and telemetry
+attribution are byte-identical to solo runs.
+
+Eligibility is deliberately strict — packing is an optimization with a
+per-job-dispatch fallback, never a semantics change: jobs fuse only
+when they agree on the full static trace config (spec, geometry,
+superstep shape, out_width, windowed decision, plan-array trailing
+shapes) and each is solo-superstep-eligible with a stride-aligned
+cursor.  Streaming jobs, closed (cascade-closure) plans, and candidates
+jobs always keep the per-job path.  The packed program itself uses the
+generic XLA expansion tiers (no per-plan piece schema / scalar-units
+statics — those are per-wordlist trace structure no two tenants share);
+the emission scheme never changes WHAT is emitted (PERF.md §17's
+parity contract), only per-lane throughput, and for the underfilled
+small jobs packing targets, dispatch amortization dominates.
+
+``A5GEN_PACK=off`` (or ``Engine(pack=False)``) restores the PR 8
+per-job dispatch path wholesale.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import telemetry
+
+
+def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
+    """One job's packed-dispatch eligibility probe: returns the fuse
+    descriptor (plan, block index, aligned start cursor, and the static
+    compatibility key), or None when the job must keep the per-job
+    dispatch path (streaming, closed plan, superstep-ineligible,
+    misaligned resume cursor, unresolved geometry).
+
+    The compatibility key is everything the packed program's trace (and
+    the concatenation of the jobs' plan arrays) depends on: two jobs
+    with equal keys can share one packed program AND have their plan
+    rows concatenated without padding.
+    """
+    from ..ops.blocks import superstep_index
+
+    cfg = sweep.config
+    if sweep._stream is not None or sweep.plan is None:
+        return None
+    plan = sweep.plan
+    if getattr(plan, "close_next", None) is not None:
+        # Closed plans carry their own per-plan value tables; merging
+        # them would re-index the joint-closure rows — per-job dispatch
+        # keeps them exact.
+        return None
+    steps = sweep._superstep_steps()
+    if steps is None or cfg.num_blocks is None:
+        return None
+    try:
+        stride = cfg.resolve_block_stride()
+    except ValueError:
+        return None
+    if stride is None:
+        return None
+    idx = superstep_index(plan, stride)
+    if idx is None:
+        return None
+    cum, totals, total_blocks = idx
+    try:
+        n_devices = sweep._resolve_devices()
+    except Exception:  # noqa: BLE001 — device probe is env-dependent
+        return None
+    # The superstep accumulator cap (mirrors Sweep._superstep_static);
+    # packed per-segment rows only ever see a SUBSET of these lanes.
+    steps = max(1, min(
+        steps, ((1 << 31) - 1) // max(1, cfg.lanes * n_devices)
+    ))
+    # Start cursor: normalize exactly as make_blocks does, then require
+    # stride alignment (cross-geometry resumes keep the solo path).
+    w, rank = 0, 0
+    if resume_state is not None:
+        w, rank = resume_state.cursor.word, resume_state.cursor.rank
+    while w < plan.batch and (
+        plan.fallback[w] or rank >= plan.n_variants[w]
+    ):
+        w, rank = w + 1, 0
+    if w < plan.batch and rank % stride:
+        return None
+    b0 = total_blocks if w >= plan.batch else int(cum[w]) + rank // stride
+    windowed = bool(getattr(plan, "windowed", False))
+    # The per-slot piece schema (PERF.md §17) and the radix-2 decode
+    # collapse are plan-derived trace statics: compatible tenants must
+    # agree on them (the common case — same dictionary shape × same
+    # table family yields identical schema structure), and their data
+    # tables are batch-leading, so the packed program keeps the SAME
+    # emission tier solo runs use.  The remaining solo-only tiers (the
+    # fused Pallas kernels' per-plan scalar-unit statics) fall back to
+    # the XLA tier under packing — emission scheme and kernel tier
+    # never change WHAT is emitted (the §17 parity contract).
+    from ..models.attack import piece_host_tables, plan_array_keys
+    from ..ops.packing import piece_schema_for
+    from ..ops.pallas_expand import k_opts_for
+    from .sweep import _pieces_static
+
+    pieces = piece_schema_for(
+        plan, sweep.ct, cache_dir=sweep._schema_cache_dir(),
+        max_mb=sweep._schema_cache_max_mb(),
+    )
+    radix2 = k_opts_for(plan) == 1
+    # Trailing-shape signature of the plan + piece arrays: equal
+    # signatures concatenate row-wise with no padding, so the packed
+    # arrays are byte-wise each job's solo arrays stacked.  Host-array
+    # views only — signing an admission must not upload/download the
+    # plan through device buffers.
+    tree = {k: getattr(plan, k) for k in plan_array_keys(plan)}
+    tree.update(piece_host_tables(pieces))
+    sig = tuple(
+        (k, tuple(v.shape[1:]), str(v.dtype))
+        for k, v in sorted(tree.items())
+    )
+    key = (
+        sweep.spec, cfg.lanes, cfg.num_blocks, stride, steps,
+        int(cfg.superstep_hit_cap), plan.out_width, windowed, n_devices,
+        sweep._pipeline_depth(), sig, _pieces_static(pieces), radix2,
+    )
+    return {
+        "sweep": sweep,
+        "plan": plan,
+        "idx": idx,
+        "cum": cum,
+        "totals": totals,
+        "total_blocks": total_blocks,
+        "b0": b0,
+        "steps": steps,
+        "stride": stride,
+        "n_devices": n_devices,
+        "pieces": pieces,
+        "radix2": radix2,
+        "key": key,
+    }
+
+
+def _packed_digest_arrays(members: Sequence[dict]):
+    """Concatenate the members' target digest sets into the segmented
+    membership tree: per-segment sorted row runs + stacked bitmaps at a
+    common width (the widest member's default sizing — the bitmap is a
+    prefilter ANDed with the exact search, so width changes throughput,
+    never results) + per-segment row bounds."""
+    from ..ops.membership import auto_bitmap_bits, build_digest_set
+
+    def _count(digests) -> int:
+        if isinstance(digests, np.ndarray):
+            return int(digests.shape[0])
+        return len(digests)
+
+    bits = max(
+        auto_bitmap_bits(_count(m["sweep"].digests)) for m in members
+    )
+    sets = [
+        build_digest_set(
+            m["sweep"].digests, m["sweep"].spec.algo, bitmap_bits=bits
+        )
+        for m in members
+    ]
+    rows = np.concatenate([ds.rows for ds in sets])
+    bitmap = np.stack([ds.bitmap for ds in sets])
+    bounds = np.zeros(len(sets) + 1, dtype=np.int64)
+    for j, ds in enumerate(sets):
+        bounds[j + 1] = bounds[j] + ds.rows.shape[0]
+    return {
+        "rows": rows,
+        "bitmap": bitmap,
+        "row_lo": bounds[:-1].astype(np.int32),
+        "row_hi": bounds[1:].astype(np.int32),
+    }
+
+
+def _packed_plan_tree(members: Sequence[dict]):
+    """Concatenate the members' plan arrays row-wise into the packed
+    plan/table trees.  The jobs' value tables (each tenant brings its
+    own substitution table) concatenate too, with every per-word
+    value-row pointer (``match_val_start`` / ``pat_val_start``) shifted
+    by its job's value-table base — the one place the packed arrays are
+    not a plain stack of the solo ones.  Value tables may differ in
+    byte width; rows are read under ``val_len`` masks, so zero-padding
+    the narrow ones to the common width is unobservable."""
+    from ..models.attack import piece_host_tables, plan_array_keys
+
+    trees = []
+    for m in members:
+        plan = m["plan"]
+        tree = {
+            k: np.asarray(getattr(plan, k))
+            for k in plan_array_keys(plan)
+        }
+        # The per-slot piece tables ride the plan dict (``pp_*``)
+        # exactly as in the solo builders — all batch-leading.
+        tree.update({
+            k: np.asarray(v)
+            for k, v in piece_host_tables(m["pieces"]).items()
+        })
+        trees.append(tree)
+    vb = [np.asarray(m["sweep"].ct.val_bytes) for m in members]
+    vl = [np.asarray(m["sweep"].ct.val_len) for m in members]
+    vw = max(b.shape[1] for b in vb)
+    vb = [
+        np.pad(b, ((0, 0), (0, vw - b.shape[1]))) if b.shape[1] < vw
+        else b
+        for b in vb
+    ]
+    val_base = 0
+    off_key = "match_val_start" if "match_val_start" in trees[0] \
+        else "pat_val_start"
+    for j, tree in enumerate(trees):
+        tree[off_key] = tree[off_key] + np.int32(val_base)
+        val_base += vb[j].shape[0]
+    plan_tree = {
+        k: np.concatenate([t[k] for t in trees]) for k in trees[0]
+    }
+    table_tree = {
+        "val_bytes": np.concatenate(vb),
+        "val_len": np.concatenate(vl),
+    }
+    return plan_tree, table_tree
+
+
+def build_fused_group(members: Sequence[dict]) -> "Optional[FusedGroup]":
+    """Build one :class:`FusedGroup` from ≥2 :func:`pack_candidate`
+    descriptors sharing one compatibility key (the engine's job), or
+    None when the packed index would overflow int32 — callers then keep
+    per-job dispatch."""
+    from ..models.attack import packed_superstep_arrays
+
+    cfg = members[0]["sweep"].config
+    n_seg = len(members)
+    if n_seg < 2 or cfg.num_blocks % n_seg:
+        return None
+    packed = packed_superstep_arrays(
+        [m["plan"] for m in members], [m["idx"] for m in members]
+    )
+    if packed is None:
+        return None
+    ss_host, blk_base, row_base = packed
+    steps = members[0]["steps"]
+    n_devices = members[0]["n_devices"]
+    # The tail dispatch's overshot per-segment cursors must stay int32
+    # (mirrors Sweep._superstep_static's headroom check).
+    if (
+        int(blk_base[-1]) + (steps + 1) * cfg.num_blocks * n_devices
+        >= (1 << 31)
+    ):
+        return None
+    return FusedGroup(members, ss_host, blk_base, row_base)
+
+
+class FusedGroup:
+    """One fused tenant group: the packed program, its device arrays,
+    the per-segment block cursors, and the dispatch/fetch/split loop
+    the engine pumps once per serve round.
+
+    The drive contract (graftaudit ``audit_pack_round``): ``pump()``
+    dispatches at most ``depth`` packed supersteps ahead through the
+    ONE dispatch site (``self._call``), consumes exactly ONE
+    unconditional counters fetch per round, fetches the hit slice only
+    on hit-bearing supersteps, and never dispatches or fetches inside
+    the per-member split loop — per-member work there is pure host
+    bookkeeping over the already-materialized arrays.
+
+    A member that pauses, cancels, fails or finishes simply parks its
+    segment at its end bound (all its future blocks cut zero-count
+    masked lanes) — cohabitants are untouched, no retrace happens, and
+    the group retires when every member has left.
+    """
+
+    def __init__(self, members: Sequence[dict], ss_host, blk_base,
+                 row_base) -> None:
+        import jax.numpy as jnp
+
+        from ..models.attack import (
+            make_superstep_step,
+            superstep_buffers,
+        )
+
+        m0 = members[0]
+        sweep0 = m0["sweep"]
+        spec, cfg = sweep0.spec, sweep0.config
+        self.n_seg = len(members)
+        self.steps = m0["steps"]
+        self.stride = m0["stride"]
+        self._hit_cap = int(cfg.superstep_hit_cap)
+        self._n_devices = m0["n_devices"]
+        self._num_blocks = cfg.num_blocks
+        self._lanes = cfg.lanes
+        self._nbs = cfg.num_blocks // self.n_seg
+        self._blk_base = blk_base
+        self._row_base = row_base
+        self._members = list(members)
+        self._by_sweep: Dict[int, int] = {
+            id(m["sweep"]): j for j, m in enumerate(members)
+        }
+        self._active = [True] * self.n_seg
+        self._pending: List[deque] = [deque() for _ in members]
+        # Packed-global per-segment cursors; consumed tracks the fetched
+        # (lagged) boundary per segment for the drained/ticked guard.
+        self._b0 = np.asarray(
+            [int(blk_base[j]) + m["b0"] for j, m in enumerate(members)],
+            dtype=np.int64,
+        )
+        self._seg_end = blk_base[1:].astype(np.int64).copy()
+        self._consumed = self._b0.copy()
+        self._adv = self.steps * self._nbs * self._n_devices
+        #: in-flight packed-superstep budget (the members' shared
+        #: pipeline depth; surfaced for the drive's stats parity with
+        #: the solo "pipelined" flag).
+        self.depth = sweep0._pipeline_depth()
+        self._inflight: deque = deque()
+        self.dispatches = 0
+
+        plan_tree, table_tree = _packed_plan_tree(members)
+        dig_tree = _packed_digest_arrays(members)
+        windowed = bool(getattr(m0["plan"], "windowed", False))
+        from .sweep import _pieces_static
+
+        common = dict(
+            num_lanes=cfg.lanes, out_width=m0["plan"].out_width,
+            block_stride=self.stride, num_blocks=cfg.num_blocks,
+            steps=self.steps, hit_cap=self._hit_cap,
+            total_blocks=int(blk_base[-1]), windowed=windowed,
+            n_seg=self.n_seg, pieces=m0["pieces"], radix2=m0["radix2"],
+        )
+        skey = ("packed-superstep", spec, self.n_seg, self._n_devices,
+                cfg.lanes, cfg.num_blocks, m0["plan"].out_width,
+                self.stride, self.steps, self._hit_cap, windowed,
+                _pieces_static(m0["pieces"]), m0["radix2"])
+        if self._n_devices == 1:
+            self._p = {k: jnp.asarray(v) for k, v in plan_tree.items()}
+            self._t = {k: jnp.asarray(v) for k, v in table_tree.items()}
+            self._d = {k: jnp.asarray(v) for k, v in dig_tree.items()}
+            self._ss = {k: jnp.asarray(v) for k, v in ss_host.items()}
+            step = sweep0._get_step(skey, lambda: make_superstep_step(
+                spec, **common,
+            ))
+
+            def call(b0_rows, bufs):
+                return step(
+                    self._p, self._t, self._d, self._ss,
+                    jnp.asarray(b0_rows.astype(np.int32)), bufs,
+                )
+
+            def make_bufs():
+                return superstep_buffers(self._hit_cap)
+        else:
+            from ..parallel.mesh import (
+                make_sharded_superstep_step,
+                replicate,
+                shard_leading,
+            )
+
+            mesh = sweep0._get_mesh(self._n_devices)
+            skey = skey + tuple(int(d.id) for d in mesh.devices.flat)
+            step = sweep0._get_step(
+                skey, lambda: make_sharded_superstep_step(
+                    spec, mesh, lanes_per_device=cfg.lanes, **{
+                        k: v for k, v in common.items()
+                        if k != "num_lanes"
+                    },
+                )
+            )
+            self._p = replicate(mesh, plan_tree)
+            self._t = replicate(mesh, table_tree)
+            self._d = replicate(mesh, dig_tree)
+            self._ss = replicate(mesh, ss_host)
+            nbs, nd, cap = self._nbs, self._n_devices, self._hit_cap
+
+            def call(b0_rows, bufs):
+                b0_dev = shard_leading(mesh, np.stack([
+                    (b0_rows + d * nbs).astype(np.int32)
+                    for d in range(nd)
+                ]))
+                return step(self._p, self._t, self._d, self._ss,
+                            b0_dev, bufs)
+
+            def make_bufs():
+                per_dev = cap + 1
+                return shard_leading(mesh, {
+                    "hit_word": np.full((nd * per_dev,), -1, np.int32),
+                    "hit_rank": np.zeros((nd * per_dev,), np.int32),
+                })
+
+        self._call = call
+        self._free = [make_bufs() for _ in range(self.depth)]
+
+    # -- engine surface ------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        """Every member has left (finished, paused, cancelled, failed)."""
+        return not any(self._active)
+
+    def register(self, sweep) -> None:
+        """Bind a member sweep to its segment (the engine sets
+        ``sweep._packed_source`` to this group right after fusing)."""
+        sweep._packed_source = self
+
+    def member_cum(self, sweep) -> np.ndarray:
+        """The member's OWN solo cumulative block index (job-local) —
+        the machine's cursor/replay arithmetic runs against it."""
+        return self._members[self._by_sweep[id(sweep)]]["cum"]
+
+    def leave(self, sweep) -> None:
+        """Detach a member: park its segment at its end bound (future
+        scan steps cut only masked zero-count blocks for it — no
+        retrace, cohabitants unharmed) and drop its undelivered
+        results.  Idempotent; called from the machine's drive finally
+        on completion, pause, cancel and failure alike."""
+        j = self._by_sweep[id(sweep)]
+        self._active[j] = False
+        self._b0[j] = self._seg_end[j]
+        self._pending[j].clear()
+
+    def next_result(self, sweep) -> "Optional[dict]":
+        """The member's next consumed-superstep result, or None once its
+        block range is drained.  The engine pumps before ticking, so a
+        runnable member always finds its result here; a tick with no
+        result and work remaining is a scheduler bug and fails loudly
+        (silently ending the drive would lose keyspace)."""
+        j = self._by_sweep[id(sweep)]
+        if self._pending[j]:
+            return self._pending[j].popleft()
+        if self._consumed[j] < self._seg_end[j]:
+            raise RuntimeError(
+                "packed member ticked without a pumped result — the "
+                "engine must pump the fused group once per round before "
+                "ticking its members"
+            )
+        return None
+
+    # -- the packed drive (audit_pack_round pins this shape) -----------
+
+    def pump(self) -> bool:
+        """One packed round: dispatch ahead up to ``depth`` supersteps,
+        fetch the due one's counters (the ONE unconditional device→host
+        round trip), split per-member results into the pending queues.
+        Returns False when nothing was produced (group drained)."""
+        while self._work_remains() and len(self._inflight) < self.depth:
+            snap = self._b0.copy()
+            self._inflight.append(
+                (snap, time.monotonic(), self._call(snap, self._free.pop()))
+            )
+            self._b0 = np.minimum(self._b0 + self._adv, self._seg_end)
+        if not self._inflight:
+            return False
+        if not any(self._active):
+            # Every member left mid-flight: nobody will consume these
+            # results — drop the dispatches unfetched (their hits belong
+            # to block ranges the members' checkpoints will replay).
+            self._inflight.clear()
+            return False
+        snap, disp_t, out = self._inflight.popleft()
+        counters = np.asarray(out["counters"])  # [2, S] per-job rows
+        overflow = False
+        hit_occupancy = 0.0
+        entries: List[List[Tuple[int, int]]] = [
+            [] for _ in range(self.n_seg)
+        ]
+        if int(counters[1].sum()):
+            dev_hits = np.asarray(out["dev_hits"])
+            hit_occupancy = int(dev_hits.max()) / max(self._hit_cap, 1)
+            if int(dev_hits.max()) > self._hit_cap:
+                overflow = True
+            else:
+                hw = np.asarray(out["hit_word"])
+                hr = np.asarray(out["hit_rank"])
+                # Vectorized split: gather every device's valid slots,
+                # map packed rows to (segment, job-local row) wholesale
+                # — the per-member loop below only ever touches these
+                # already-host-side results.
+                per_dev = self._hit_cap + 1
+                lanes = np.arange(hw.shape[0])
+                valid = (lanes % per_dev) < dev_hits[lanes // per_dev]
+                rows, ranks = hw[valid], hr[valid]
+                segs = np.searchsorted(
+                    self._row_base, rows, side="right"
+                ) - 1
+                locs = rows - self._row_base[segs]
+                for j, w_loc, rank in zip(segs.tolist(), locs.tolist(),
+                                          ranks.tolist()):
+                    entries[j].append((w_loc, rank))
+        self._free.append({"hit_word": out["hit_word"],
+                           "hit_rank": out["hit_rank"]})
+        ne_rows, nh_rows = counters[0].tolist(), counters[1].tolist()
+        b_lo_rows = snap.tolist()
+        b_hi_rows = np.minimum(snap + self._adv, self._seg_end).tolist()
+        base_rows = self._blk_base[:-1].tolist()
+        occupied = 0
+        for j in range(self.n_seg):
+            b_lo, b_hi = b_lo_rows[j], b_hi_rows[j]
+            self._consumed[j] = b_hi
+            occupied += self._occupied(j, b_lo, b_hi)
+            if not self._active[j]:
+                continue
+            if b_lo >= b_hi:
+                # This member's range drained in an earlier superstep —
+                # no result to report, so its next tick sees None and
+                # finishes NOW instead of idling (with no-op spans and
+                # a withheld done event) until the slowest cohabitant
+                # drains the group.
+                continue
+            entries[j].sort()
+            self._pending[j].append({
+                "ne": ne_rows[j],
+                "nh": nh_rows[j],
+                "entries": entries[j],
+                "overflow": overflow and bool(nh_rows[j]),
+                "b_lo": b_lo - base_rows[j],
+                "b_hi": b_hi - base_rows[j],
+                "disp_t": disp_t,
+                "inflight": len(self._inflight),
+                "hit_occupancy": hit_occupancy,
+            })
+        self.dispatches += 1
+        # Result-surface counters (Engine.stats()'s packed_dispatches /
+        # packed_fill) record even under A5GEN_TELEMETRY=off — the PR 9
+        # off-hatch contract: the hatch changes observability, never
+        # results (same convention as the step_cache.* counters).
+        telemetry.counter("engine.packed_dispatches").add(1)
+        telemetry.counter("engine.packed_lanes_occupied").add(occupied)
+        telemetry.counter("engine.packed_lanes_total").add(
+            self.steps * self._lanes * self._n_devices
+        )
+        return True
+
+    # -- host bookkeeping ----------------------------------------------
+
+    def _work_remains(self) -> bool:
+        return bool(np.any(
+            np.asarray(self._active) & (self._b0 < self._seg_end)
+        ))
+
+    def _occupied(self, j: int, b_lo: int, b_hi: int) -> int:
+        """Variant lanes the member's block range [b_lo, b_hi) actually
+        occupies (packed-global blocks; zero-count tail blocks excluded)
+        — the fill-ratio instrument ``bench.py --pack-ab`` reports."""
+        if b_hi <= b_lo:
+            return 0
+        m = self._members[j]
+        base = int(self._blk_base[j])
+        blocks = np.arange(b_lo - base, b_hi - base, dtype=np.int64)
+        cum = np.asarray(m["cum"], dtype=np.int64)
+        totals = np.asarray(m["totals"], dtype=np.int64)
+        blocks = blocks[blocks < cum[-1]]
+        if not blocks.size:
+            return 0
+        w = np.searchsorted(cum, blocks, side="right") - 1
+        rank0 = (blocks - cum[w]) * self.stride
+        return int(np.clip(totals[w] - rank0, 0, self.stride).sum())

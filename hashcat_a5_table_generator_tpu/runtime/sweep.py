@@ -439,6 +439,12 @@ class Sweep:
         #: crack/candidates machine starts, read by the resident engine
         #: for pause (deep-copied into the job's checkpoint) and stats.
         self.active_state: Optional[CheckpointState] = None
+        #: cross-job packed dispatch source (PERF.md §22): a
+        #: ``runtime.fuse.FusedGroup`` the resident engine binds before
+        #: the machine's first tick; the crack drive then CONSUMES its
+        #: per-job split results instead of dispatching its own
+        #: supersteps (:meth:`_drive_packed`).  None = solo dispatch.
+        self._packed_source = None
         #: per-sweep superstep span timeline (PERF.md §21): one record
         #: per consumed fetch boundary; the engine's ``done``/``paused``
         #: events and ``--metrics-json`` report its summary.
@@ -1304,6 +1310,86 @@ class Sweep:
                 # (their hits belong to later supersteps' own buffers).
                 break
 
+    def _drive_packed(
+        self, src, plan, state: CheckpointState, launch: Callable,
+        n_devices: int, mesh, device_hit: Callable,
+        fallback_candidate: Callable, prefetch, last_ckpt: List[float],
+        process_launch_hits: Callable,
+    ) -> "Iterator[None]":
+        """The consume half of the cross-job packed drive (PERF.md
+        §22).  ``src`` is the engine's ``runtime.fuse.FusedGroup``: it
+        owns dispatch and the single per-round counters fetch across
+        ALL fused tenants; this generator pulls this job's own split
+        result per tick — per-job emitted/hit counts from the packed
+        program's segmented counter rows, (word, rank) hit entries
+        already mapped back to job-local plan rows — and runs the SAME
+        consume sequence as :meth:`_drive_superstep`'s post-fetch half
+        (fallback interleave at the cursor, host hit re-derivation +
+        re-verification, lagged-boundary checkpoint/progress, the
+        span-timeline record — so per-job telemetry attribution under
+        fused dispatches is the solo instrument, untouched).  The two
+        bodies must stay statement-for-statement mirrors: a consume fix
+        in either drive belongs in both.  Overflowed supersteps
+        replay this job's own block range through its per-launch path,
+        exactly like the solo drive.  Detaches from the group in the
+        finally, so completion, pause, cancel and failure all park the
+        job's segment without disturbing cohabitants."""
+        cfg = self.config
+        stride, cum = src.stride, src.member_cum(self)
+        stats = {"supersteps": 0, "launches": 0, "replays": 0,
+                 "launches_per_fetch": src.steps,
+                 "pipelined": int(src.depth > 1),
+                 "packed": src.n_seg}
+        try:
+            while True:
+                res = src.next_result(self)
+                if res is None:
+                    break
+                ne, nh = res["ne"], res["nh"]
+                if self._ttfc[0] is None:
+                    self._ttfc[0] = time.monotonic()
+                end_w, end_r = block_cursor(plan, stride, cum,
+                                            res["b_hi"])
+                replayed = False
+                if res["overflow"]:
+                    stats["replays"] += 1
+                    replayed = True
+                    self._replay_superstep(
+                        res["b_lo"], res["b_hi"],
+                        {"stride": stride, "cum": cum}, launch,
+                        n_devices, mesh, process_launch_hits, plan=plan,
+                    )
+                else:
+                    for w_row, rank in res["entries"]:
+                        device_hit(int(w_row), int(rank))
+                self._flush_fallback_until(
+                    end_w, state, fallback_candidate, prefetch
+                )
+                state.n_emitted += ne
+                state.cursor = SweepCursor(end_w, end_r)
+                stats["supersteps"] += 1
+                stats["launches"] += src.steps
+                with telemetry.profiler_span("a5.superstep.consume"):
+                    self.timeline.record_fetch(
+                        kind="superstep", index=stats["supersteps"],
+                        dispatched_at=res["disp_t"],
+                        inflight=res["inflight"], launches=src.steps,
+                        emitted=ne, hits=nh,
+                        hit_occupancy=res["hit_occupancy"],
+                        replayed=replayed,
+                    )
+                self._maybe_checkpoint(state, last_ckpt)
+                if cfg.progress:
+                    cfg.progress.update(
+                        words_done=end_w,
+                        emitted=state.n_emitted,
+                        hits=state.n_hits,
+                    )
+                yield
+        finally:
+            src.leave(self)
+        return stats
+
     def _launches(
         self, cursor: SweepCursor, launch: Callable, *, n_devices: int = 1,
         mesh=None, plan=None,
@@ -1665,6 +1751,18 @@ class Sweep:
                 lanes = np.nonzero(hit[lo:hi])[0]
                 for w_local, rank in lane_cursor(plan, batch, lanes):
                     device_hit(w_local, rank)
+
+        if self._packed_source is not None and row_base == 0 \
+                and self._stream is None:
+            # Cross-job packed dispatch (PERF.md §22): the engine's
+            # FusedGroup owns dispatch and the one-per-round fetch; this
+            # machine consumes its own split share through the SAME
+            # state/hit/fallback bookkeeping the solo drive runs.
+            return (yield from self._drive_packed(
+                self._packed_source, plan, state, launch, n_devices,
+                mesh, device_hit, fallback_candidate, prefetch,
+                last_ckpt, process_launch_hits,
+            ))
 
         sstep = self._make_superstep(
             plan, local_cursor, n_devices, mesh, step_ctx

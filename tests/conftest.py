@@ -35,6 +35,102 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 UPSTREAM_REFERENCE = pathlib.Path("/root/reference")
 
+#: Cached 2-process pod collectives capability (None = not probed yet).
+_POD_COLLECTIVES: "bool | None" = None
+
+_POD_PROBE_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # one local device per process
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from hashcat_a5_table_generator_tpu.parallel import multihost
+pid = int(sys.argv[1])
+multihost.initialize(f"127.0.0.1:{sys.argv[2]}", 2, pid)
+from jax.experimental.multihost_utils import process_allgather
+got = process_allgather(np.asarray([pid], np.int32))
+assert sorted(np.asarray(got).reshape(-1).tolist()) == [0, 1], got
+print("POD-OK")
+"""
+
+
+def pod_collectives_supported() -> bool:
+    """Whether THIS host can run a real 2-process ``jax.distributed``
+    pod with cross-process collectives.  CPU backends on the pinned jax
+    fail inside ``process_allgather`` with "Multiprocess computations
+    aren't implemented on the CPU backend" — an environment capability,
+    not a code regression — so the 2-process pod tests skip (not fail)
+    there, keeping the tier-1 DOTS_PASSED signal clean.  On backends
+    with real collectives the probe passes and the tests run.  One
+    probe per session (two tiny subprocesses), run lazily by the
+    ``pod_collectives`` fixture only when a pod test is selected."""
+    global _POD_COLLECTIVES
+    if _POD_COLLECTIVES is not None:
+        return _POD_COLLECTIVES
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        str(REPO_ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".py", delete=False
+    ) as fh:
+        fh.write(_POD_PROBE_CHILD)
+        script = fh.name
+    try:
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, str(p), str(port)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+            )
+            for p in range(2)
+        ]
+        outs = []
+        for p in procs:
+            try:
+                out, err = p.communicate(timeout=120)
+                outs.append((p.returncode, out, err))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                outs.append((1, b"", b""))
+        if all(rc == 0 and b"POD-OK" in out for rc, out, _e in outs):
+            _POD_COLLECTIVES = True
+        else:
+            # Only the KNOWN capability error downgrades to a skip; any
+            # other probe failure (a regression in multihost.initialize,
+            # a transient port race, a hang) reports SUPPORTED so the
+            # real pod tests run and fail loudly instead of being
+            # masked by a green skip.
+            _POD_COLLECTIVES = not any(
+                b"implemented on the CPU backend" in err
+                for _rc, _out, err in outs
+            )
+    finally:
+        os.unlink(script)
+    return _POD_COLLECTIVES
+
+
+@pytest.fixture
+def pod_collectives():
+    """Backend-capability guard for real 2-process pod tests: skip —
+    never fail — where multi-process collectives don't exist (the CPU
+    backend; see :func:`pod_collectives_supported`)."""
+    if not pod_collectives_supported():
+        pytest.skip(
+            "2-process pod collectives unavailable on this backend "
+            "(process_allgather: multiprocess computations aren't "
+            "implemented on the CPU backend)"
+        )
+
 
 @pytest.hookimpl(trylast=True)
 def pytest_collection_modifyitems(config, items):

@@ -98,3 +98,82 @@ def broken_sync_round(slots, finish, fail):
             next(slot.machine)
         except StopIteration as done:
             finish(slot, done.value)
+
+
+# ---------------------------------------------------------------------------
+# Packed-round fixtures (audit_pack_round, PERF.md §22): the fused
+# group's dispatch/fetch/split loop.  ``clean_packed_round`` is the
+# sanctioned shape — one dispatch site in the dispatch-ahead fill
+# while, ONE unconditional counters fetch, hit slice behind the
+# hit-count guard, per-member split as pure host bookkeeping.  The
+# broken variants commit the packed sins: dispatching per member
+# (the per-job-dispatch regression — the fused round degraded back to
+# N round trips), and a fetch hidden in the segment bookkeeping
+# (barriers the round once per member).
+# ---------------------------------------------------------------------------
+
+
+def clean_packed_round(self):
+    while self.work_remains() and len(self.inflight) < self.depth:
+        snap = self.b0.copy()
+        self.inflight.append((snap, 0.0, self._call(snap, self.free.pop())))
+        self.b0 = self.b0 + self.adv
+    if not self.inflight:
+        return False
+    snap, disp_t, out = self.inflight.popleft()
+    counters = np.asarray(out["counters"])
+    if int(counters[1].sum()):
+        dev_hits = np.asarray(out["dev_hits"])
+        if int(dev_hits.max()) <= self.cap:
+            hw = np.asarray(out["hit_word"])
+            self.split(hw, dev_hits)
+    ne_rows = counters[0].tolist()
+    for j, member in enumerate(self.members):
+        member.push(ne_rows[j], disp_t)
+    return True
+
+
+def broken_packed_perjob_dispatch(self):
+    """The per-job-dispatch regression: one device dispatch PER MEMBER
+    inside the split loop — the packed round quietly degraded back to N
+    round trips per round."""
+    for j, member in enumerate(self.members):
+        out = self._call(member.b0, self.free.pop())
+        self.inflight.append((member.b0, 0.0, out))
+    snap, disp_t, out = self.inflight.popleft()
+    counters = np.asarray(out["counters"])
+    for j, member in enumerate(self.members):
+        member.push(int(counters[0, j]), disp_t)
+    return True
+
+
+def broken_packed_segment_fetch(self):
+    """A fetch hidden in the segment bookkeeping: each member's counter
+    column is coerced from the DEVICE result inside the split loop —
+    one barrier per member instead of one per round."""
+    while self.work_remains() and len(self.inflight) < self.depth:
+        snap = self.b0.copy()
+        self.inflight.append((snap, 0.0, self._call(snap, self.free.pop())))
+        self.b0 = self.b0 + self.adv
+    snap, disp_t, out = self.inflight.popleft()
+    for j, member in enumerate(self.members):
+        member.push(int(np.asarray(out["counters"])[0, j]), disp_t)
+    return True
+
+
+def broken_packed_double_fetch(self):
+    """Two unconditional fetches per round: the counters AND the hit
+    buffers, hit-bearing or not — the §18 double-fetch regression in
+    packed clothing."""
+    while self.work_remains() and len(self.inflight) < self.depth:
+        snap = self.b0.copy()
+        self.inflight.append((snap, 0.0, self._call(snap, self.free.pop())))
+        self.b0 = self.b0 + self.adv
+    snap, disp_t, out = self.inflight.popleft()
+    counters = np.asarray(out["counters"])
+    hw = np.asarray(out["hit_word"])
+    ne_rows = counters[0].tolist()
+    for j, member in enumerate(self.members):
+        member.push(ne_rows[j], disp_t)
+    self.split(hw)
+    return True

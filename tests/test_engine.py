@@ -166,7 +166,10 @@ class TestMultiplexing:
         the process step cache (N jobs, one program build)."""
         spec = AttackSpec(mode="default", algo="md5")
         _p, digests = planted_digests(spec, LEET, WORDS, (0,))
-        eng = Engine(cfg(), auto=False)
+        # pack=False: this pins the PER-JOB dispatch path's amortization
+        # (a packed batch adds exactly one fused program on first use —
+        # its own compile-once claim lives in test_pack.py).
+        eng = Engine(cfg(), auto=False, pack=False)
         first = eng.submit(spec, LEET, WORDS, digests)
         eng.run_until_idle()
         first.result(timeout=0)

@@ -412,6 +412,63 @@ class TestServeLoop:
 
 
 # ---------------------------------------------------------------------------
+# Cross-job packed round discipline (PERF.md §22)
+# ---------------------------------------------------------------------------
+
+
+class TestPackRound:
+    def test_clean_packed_round_passes(self):
+        from tools.graftaudit.transfers import audit_pack_round
+
+        mod = _fixture("serve_loop")
+        assert audit_pack_round(
+            mod.clean_packed_round, "fixture.pack"
+        ) == []
+
+    def test_perjob_dispatch_flagged(self):
+        # The per-job-dispatch regression: a dispatch inside the member
+        # loop degrades the packed round back to N round trips.
+        from tools.graftaudit.transfers import audit_pack_round
+
+        mod = _fixture("serve_loop")
+        findings = audit_pack_round(
+            mod.broken_packed_perjob_dispatch, "fixture.pack"
+        )
+        assert any("per-job-dispatch" in f.message for f in findings)
+        assert all(f.check == "pack-round" for f in findings)
+
+    def test_segment_bookkeeping_fetch_flagged(self):
+        # A fetch hidden in the per-member segment bookkeeping barriers
+        # the round once per member.
+        from tools.graftaudit.transfers import audit_pack_round
+
+        mod = _fixture("serve_loop")
+        findings = audit_pack_round(
+            mod.broken_packed_segment_fetch, "fixture.pack"
+        )
+        assert any(
+            "fetch inside a for loop" in f.message for f in findings
+        )
+
+    def test_double_fetch_flagged(self):
+        from tools.graftaudit.transfers import audit_pack_round
+
+        mod = _fixture("serve_loop")
+        findings = audit_pack_round(
+            mod.broken_packed_double_fetch, "fixture.pack"
+        )
+        assert any("unconditional" in f.message for f in findings)
+
+    def test_production_pack_round_is_clean(self):
+        from hashcat_a5_table_generator_tpu.runtime.fuse import FusedGroup
+        from tools.graftaudit.transfers import audit_pack_round
+
+        assert audit_pack_round(
+            FusedGroup.pump, "runtime.fuse.FusedGroup.pump"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
 # Telemetry placement (PERF.md §21): off the hot path
 # ---------------------------------------------------------------------------
 

@@ -135,7 +135,7 @@ with open(os.path.join(outdir, f"out{pid}.json"), "w") as fh:
 """
 
 
-def test_two_process_crack_matches_single(tmp_path):
+def test_two_process_crack_matches_single(tmp_path, pod_collectives):
     # Single-process expectation via the ordinary sweep.
     from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
     from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
@@ -191,7 +191,7 @@ def test_two_process_crack_matches_single(tmp_path):
     assert {bytes.fromhex(h[2]) for h in results[0]["hits"]} == set(planted)
 
 
-def test_two_process_cli_crack_matches_single(tmp_path):
+def test_two_process_cli_crack_matches_single(tmp_path, pod_collectives):
     """The CLI pod surface (VERDICT r3 #3): two ``a5gen`` subprocesses with
     --coordinator/--num-processes/--process-id produce (on process 0's
     stdout) exactly the hit set a single-process run finds."""

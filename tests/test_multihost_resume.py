@@ -88,7 +88,8 @@ def _launch_pod(tmp_path, ck, digest_arg, tag):
     return [json.load(open(outdir / f"res{p}.json")) for p in range(2)]
 
 
-def test_pod_relaunch_resumes_bucketed_checkpoints(tmp_path):
+def test_pod_relaunch_resumes_bucketed_checkpoints(tmp_path,
+                                                   pod_collectives):
     from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
 
     leet = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}

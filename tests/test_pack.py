@@ -1,0 +1,404 @@
+"""Cross-job physical packing (PERF.md §22): compatible tenants' block
+ranges fuse into ONE superstep dispatch with per-job counter rows — and
+every per-job surface (hit stream, emitted counts, checkpoints, span
+timeline) stays byte-identical to solo runs.  Plus the admission-time
+compile offload: builds run on a bounded worker with error propagation
+and shutdown drain.
+
+Tier-1 budget: shares the suite's 64-lane × 16-block geometry; each
+distinct packed static config compiles one small program.
+"""
+
+import hashlib
+import json
+import pathlib
+import subprocess
+import sys
+import time
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.runtime import Sweep, SweepConfig
+from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+from tests.test_engine import cfg, full_hits, planted_digests
+from tests.test_superstep import LEET, WORDS, oracle_lines
+
+#: Distinct tenants over one dictionary shape: same packed token width
+#: and match-slot count (the packed-group compatibility the scheduler
+#: looks for), different word order and digest sets.
+WORDLISTS = [WORDS, WORDS[::-1], WORDS[3:] + WORDS[:3], WORDS[5:] + WORDS[:5]]
+
+
+def _jobs(spec, n, picks=(0, -1), decoys=8):
+    out = []
+    for i in range(n):
+        words = WORDLISTS[i % len(WORDLISTS)]
+        _planted, digests = planted_digests(
+            spec, LEET, words, picks, decoys=decoys
+        )
+        # Per-tenant decoys so no two jobs share a digest set.
+        digests += [hashlib.md5(b"tenant-%d" % i).digest()]
+        out.append((words, digests))
+    return out
+
+
+def _solo(spec, jobs, config):
+    return [
+        Sweep(spec, LEET, words, digests, config=config).run_crack(
+            resume=False
+        )
+        for words, digests in jobs
+    ]
+
+
+class TestPackedParity:
+    def test_four_job_packed_byte_parity(self):
+        """Four distinct tenants fuse into one dispatch group (16
+        blocks / 4 segments); every job's hit stream and emitted count
+        equals its solo run's, and the packed program compiled exactly
+        once."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 4, picks=(0, 4, -1))
+        c = cfg(superstep=2)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        stats = eng.stats()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        assert stats["packed_dispatches"] > 0
+        assert 0 < stats["packed_fill"] <= 1.0
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert g.n_emitted == w.n_emitted
+            assert g.superstep.get("packed") == 4
+        # A second equal batch rides the cached packed program.
+        eng2 = Engine(c, auto=False)
+        base = eng2.stats()["programs_compiled"]
+        handles = [eng2.submit(spec, LEET, w, d) for w, d in jobs]
+        eng2.run_until_idle()
+        assert eng2.stats()["programs_compiled"] == base
+        for h, w in zip(handles, want):
+            assert full_hits(h.result(timeout=0)) == full_hits(w)
+        eng2.close()
+
+    def test_heterogeneous_batch_windowed_and_streaming(self):
+        """A mixed burst: two packable tenants, one WINDOWED job (its
+        enumeration scheme is different static trace structure) and one
+        STREAMING job (chunked plans never pack).  The compatible pair
+        fuses; the others keep the per-job path; every job stays
+        byte-identical to solo."""
+        spec = AttackSpec(mode="default", algo="md5")
+        wspec = AttackSpec(mode="default", algo="md5",
+                           min_substitute=1, max_substitute=1)
+        jobs = _jobs(spec, 2)
+        _pw, wdigests = planted_digests(wspec, LEET, WORDS, (0, -1))
+        c = cfg()
+        cs = cfg(stream_chunk_words=2)
+        want = _solo(spec, jobs, c)
+        wsweep = Sweep(wspec, LEET, WORDS, wdigests, config=c)
+        assert wsweep.plan.windowed
+        want_w = wsweep.run_crack(resume=False)
+        want_s = Sweep(
+            spec, LEET, jobs[0][0], jobs[0][1], config=cs
+        ).run_crack(resume=False)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        hw = eng.submit(wspec, LEET, WORDS, wdigests)
+        hs = eng.submit(spec, LEET, jobs[0][0], jobs[0][1], config=cs)
+        eng.run_until_idle()
+        stats = eng.stats()
+        assert stats["packed_dispatches"] > 0  # the pair fused
+        for h, w in zip(handles, want):
+            got = h.result(timeout=0)
+            assert full_hits(got) == full_hits(w)
+            assert got.superstep.get("packed") == 2
+        got_w = hw.result(timeout=0)
+        assert full_hits(got_w) == full_hits(want_w)
+        assert "packed" not in got_w.superstep
+        got_s = hs.result(timeout=0)
+        assert full_hits(got_s) == full_hits(want_s)
+        assert got_s.stream["chunks_swept"] == want_s.stream["chunks_swept"]
+        eng.close()
+
+    def test_overflow_replays_per_job(self):
+        """A packed superstep whose shared hit buffer overflows replays
+        each hit-bearing member's own block range through its per-launch
+        path — never a dropped hit, per job."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0, 1, 2, 7, -1))
+        c = cfg(superstep=4, superstep_hit_cap=1)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        assert any(g.superstep.get("replays", 0) > 0 for g in got)
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert g.n_emitted == w.n_emitted
+
+    def test_uneven_members_release_early(self):
+        """Members of different sizes can fuse (the key compares
+        trailing shapes, not batch length); a member whose range drains
+        early finishes THEN — its superstep count is its own range's,
+        never inflated with no-op boundaries while the bigger
+        cohabitant keeps sweeping."""
+        from tests.test_engine import LONG_WORDS
+
+        spec = AttackSpec(mode="default", algo="md5")
+        _ps, dsmall = planted_digests(spec, LEET, WORDS, (0, -1))
+        _pb, dbig = planted_digests(spec, LEET, LONG_WORDS, (1, -1))
+        c = cfg(superstep=1)
+        want_s = Sweep(spec, LEET, WORDS, dsmall,
+                       config=c).run_crack(resume=False)
+        want_b = Sweep(spec, LEET, LONG_WORDS, dbig,
+                       config=c).run_crack(resume=False)
+        eng = Engine(c, auto=False)
+        hs = eng.submit(spec, LEET, WORDS, dsmall)
+        hb = eng.submit(spec, LEET, LONG_WORDS, dbig)
+        eng.run_until_idle()
+        assert eng.stats()["packed_dispatches"] > 0
+        got_s, got_b = hs.result(timeout=0), hb.result(timeout=0)
+        eng.close()
+        assert full_hits(got_s) == full_hits(want_s)
+        assert full_hits(got_b) == full_hits(want_b)
+        assert got_s.superstep["packed"] == got_b.superstep["packed"] == 2
+        assert (
+            got_s.superstep["supersteps"] < got_b.superstep["supersteps"]
+        )
+
+    def test_sharded_packed_parity(self):
+        """The sharded twin: two tenants fused over a 2-device mesh —
+        the segmented counter rows ride the single stacked psum."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0, -1))
+        c = cfg(devices=2)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        stats = eng.stats()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        assert stats["packed_dispatches"] > 0
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert g.n_emitted == w.n_emitted
+
+
+class TestTenantControl:
+    def test_pause_mid_fused_dispatch_leaves_cohabitants(self):
+        """Pausing one tenant mid-fused-dispatch parks only its segment:
+        cohabitants finish byte-identical, and the paused job resumes
+        from its checkpoint (on a second engine) to the same stream."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 4, picks=(0, -1))
+        c = cfg(superstep=1)  # many small supersteps -> park mid-sweep
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng._admit()
+        assert eng.stats()["fused_groups"] == 1
+        eng._serve_round()
+        victim = handles[1]
+        victim.request_pause()
+        eng.run_until_idle()
+        assert victim.state == "paused"
+        ck = victim.checkpoint
+        assert ck is not None
+        for i, h in enumerate(handles):
+            if i == 1:
+                continue
+            got = h.result(timeout=0)
+            assert full_hits(got) == full_hits(want[i])
+            assert got.n_emitted == want[i].n_emitted
+        # Migrate the paused tenant to a fresh engine.
+        eng2 = Engine(c, auto=False)
+        w, d = jobs[1]
+        resumed = eng2.submit(spec, LEET, w, d, resume_state=ck)
+        eng2.run_until_idle()
+        got = resumed.result(timeout=0)
+        assert full_hits(got) == full_hits(want[1])
+        assert got.n_emitted == want[1].n_emitted
+        eng.close()
+        eng2.close()
+
+    def test_cancel_mid_fused_dispatch_keeps_cohabitants(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0, -1))
+        c = cfg(superstep=1)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng._admit()
+        eng._serve_round()
+        handles[0].cancel()
+        eng.run_until_idle()
+        assert handles[0].state == "cancelled"
+        got = handles[1].result(timeout=0)
+        assert full_hits(got) == full_hits(want[1])
+        assert got.n_emitted == want[1].n_emitted
+        eng.close()
+
+    def test_span_attribution_under_fused_dispatch(self):
+        """Per-job telemetry: each fused tenant's span timeline records
+        ITS OWN consumed boundaries (one per packed superstep it rode),
+        not the group's aggregate."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0,))
+        c = cfg(superstep=2)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        for h in handles:
+            res = h.result(timeout=0)
+            spans = h.span_summary
+            assert spans["spans"] == res.superstep["supersteps"] > 0
+            assert spans["host_gap_s"] >= 0.0
+        eng.close()
+
+
+class TestPackKnobs:
+    def test_pack_off_restores_per_job_dispatch(self, monkeypatch):
+        """A5GEN_PACK=off: the PR 8 per-job dispatch path, byte-
+        identical streams, zero packed dispatches."""
+        monkeypatch.setenv("A5GEN_PACK", "off")
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0, -1))
+        c = cfg(superstep=2)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        stats = eng.stats()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        assert stats["packed_dispatches"] == 0
+        assert stats["fused_groups"] == 0
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert "packed" not in g.superstep
+
+    def test_engine_pack_false_overrides_env(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2)
+        eng = Engine(cfg(superstep=2), auto=False, pack=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        assert eng.stats()["packed_dispatches"] == 0
+        for h in handles:
+            h.result(timeout=0)
+        eng.close()
+
+
+class TestAdmissionWorker:
+    def test_build_error_propagates_and_engine_survives(self):
+        """A job whose build raises settles FAILED with the worker's
+        exception; peers in the same burst still run (and can still
+        fuse among themselves)."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0,))
+        c = cfg(superstep=2)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        bad = eng.submit(spec, LEET, [b"ok", "not-bytes"],
+                         jobs[0][1])
+        eng.run_until_idle()
+        assert bad.state == "failed"
+        assert bad.error is not None
+        with pytest.raises(Exception):
+            bad.result(timeout=0)
+        for h, w in zip(handles, want):
+            assert full_hits(h.result(timeout=0)) == full_hits(w)
+        eng.close()
+
+    def test_builds_run_off_the_serve_thread(self):
+        """The admission offload: the worker thread owns the build
+        (observable through the engine's jobs_building gauge while the
+        serve thread is parked)."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 1, picks=(0,))
+        eng = Engine(cfg(), auto=False)
+        assert eng._admit_ex is not None
+        h = eng.submit(spec, LEET, *jobs[0])
+        # Drain submissions onto the worker without waiting, then wait
+        # for the build to land and serve it.
+        eng._admit(wait=False)
+        eng.run_until_idle()
+        h.result(timeout=0)
+        eng.close()
+
+    def test_close_drains_pending_builds(self):
+        """close() settles every submitted job even when its build is
+        still queued — the shutdown drain contract."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 3, picks=(0,))
+        eng = Engine(cfg(superstep=2), auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.close()  # default drain: builds land, jobs run to done
+        for h in handles:
+            assert h.wait(timeout=30)
+            assert h.state == "done"
+
+    def test_close_cancel_drops_building_jobs(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 3, picks=(0,))
+        eng = Engine(cfg(superstep=2), auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.close(cancel=True)
+        for h in handles:
+            assert h.wait(timeout=30)
+            assert h.state in ("cancelled", "done")
+
+    def test_sync_admission_mode(self):
+        """admission_worker=False: builds happen inline in _admit — the
+        pre-§22 behavior, still packable."""
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0, -1))
+        c = cfg(superstep=2)
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False, admission_worker=False)
+        assert eng._admit_ex is None
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        assert eng.stats()["packed_dispatches"] > 0
+        for h, w in zip(handles, want):
+            assert full_hits(h.result(timeout=0)) == full_hits(w)
+        eng.close()
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+def test_bench_pack_ab_record_shape():
+    """The §22 measurement instrument: one JSON line, both arms, the
+    wall-ratio/fill/ttfc/fairness numbers the acceptance criteria read,
+    with per-job emitted counts parity-asserted against solo runs
+    inside the bench itself.  Slow-marked: subprocess bench."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--pack-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "16", "--pack-jobs", "4"],
+        capture_output=True, timeout=540, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "pack_mode_ab"
+    assert rec["jobs"] == 4
+    assert rec["packed"]["emitted"] == rec["round_robin"]["emitted"]
+    assert all(e > 0 for e in rec["packed"]["emitted"])
+    assert rec["packed"]["packed_dispatches"] > 0
+    assert rec["round_robin"]["packed_dispatches"] == 0
+    assert 0 < rec["fill_ratio"] <= 1.0
+    for key in ("wall_ratio", "warm_ttfc_batch_s"):
+        assert isinstance(rec[key], float) and rec[key] > 0
+    for arm in ("packed", "round_robin"):
+        assert rec[arm]["wall_s"] > 0
+        assert rec[arm]["admit_wall_s"] > 0

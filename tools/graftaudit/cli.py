@@ -293,6 +293,7 @@ def run_audit(
             audit_chunk_ring,
             audit_drive_loop,
             audit_host_transfers,
+            audit_pack_round,
             audit_serve_loop,
         )
 
@@ -324,6 +325,20 @@ def run_audit(
                 audit_serve_loop(
                     Engine._serve_round,
                     "runtime.Engine._serve_round",
+                )
+            )
+            # The packed round (PERF.md §22): _serve_round stays
+            # fetch-free — the fused group's pump owns the one packed
+            # dispatch + counters fetch per round, with its own pinned
+            # discipline.
+            from hashcat_a5_table_generator_tpu.runtime.fuse import (
+                FusedGroup,
+            )
+
+            findings.extend(
+                audit_pack_round(
+                    FusedGroup.pump,
+                    "runtime.fuse.FusedGroup.pump",
                 )
             )
 

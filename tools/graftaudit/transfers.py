@@ -115,6 +115,33 @@ def _is_fetch_call(node: ast.AST) -> bool:
     return False
 
 
+def _hostside_names(root: ast.AST) -> Set[str]:
+    """Names bound DIRECTLY from a fetch call (``counters =
+    np.asarray(out["counters"])``) — and transitively from them — hold
+    host-materialized values: a later coercion (``int(counters[0])``)
+    is host arithmetic, not another device round trip; the binding
+    fetch is the one that counts.  Shared by the drive-loop and
+    packed-round audits (one fixed-point, one behavior)."""
+    hostside: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for stmt in ast.walk(root):
+            if isinstance(stmt, ast.Assign):
+                val = stmt.value
+                bases = _base_names(val)
+                if (
+                    isinstance(val, ast.Call) and _is_fetch_call(val)
+                ) or (bases and bases <= hostside):
+                    new = set()
+                    for t in stmt.targets:
+                        new |= _assigned_names(t)
+                    if new - hostside:
+                        hostside |= new
+                        changed = True
+    return hostside
+
+
 def audit_drive_loop(fn, entry: str) -> List[AuditFinding]:
     """Statically audit a superstep drive loop's fetch discipline.
 
@@ -230,28 +257,7 @@ def audit_drive_loop(fn, entry: str) -> List[AuditFinding]:
                         inflight |= new - popped
                         changed = True
     inflight -= popped
-    # Names bound DIRECTLY from a fetch call (``counters =
-    # np.asarray(out["counters"])``) hold host-materialized values: a
-    # later subscript coercion of them (``int(counters[0])``) is host
-    # arithmetic, not another device round trip — the binding fetch is
-    # the one that counts.  Plain re-bindings inherit the property.
-    hostside: Set[str] = set()
-    changed = True
-    while changed:
-        changed = False
-        for stmt in ast.walk(outer):
-            if isinstance(stmt, ast.Assign):
-                val = stmt.value
-                bases = _base_names(val)
-                if (
-                    isinstance(val, ast.Call) and _is_fetch_call(val)
-                ) or (bases and bases <= hostside):
-                    new = set()
-                    for t in stmt.targets:
-                        new |= _assigned_names(t)
-                    if new - hostside:
-                        hostside |= new
-                        changed = True
+    hostside = _hostside_names(outer)
 
     def fetch_nodes(node, conditional: bool, looped: bool):
         out = []
@@ -489,6 +495,183 @@ def audit_serve_loop(fn, entry: str) -> List[AuditFinding]:
                 "per serve round (want exactly one): each runnable job "
                 "advances one fetched superstep boundary per round, so "
                 "tenants interleave fairly (PERF.md §20)",
+            )
+        )
+    return findings
+
+
+def _is_dispatch_call(node: ast.AST) -> bool:
+    """The fused group's one device dispatch site: ``self._call(...)``
+    (or a bare ``call(...)`` in fixtures)."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "call"
+    if isinstance(f, ast.Attribute):
+        return f.attr in ("call", "_call")
+    return False
+
+
+def audit_pack_round(fn, entry: str) -> List[AuditFinding]:
+    """Statically audit the cross-job packed dispatch round
+    (``runtime.fuse.FusedGroup.pump``, PERF.md §22).
+
+    The packed round exists to replace N per-job dispatch+fetch round
+    trips with ONE — so its own discipline is the whole point:
+
+    * exactly one dispatch call site (``self._call``), and never inside
+      a ``for`` loop — a dispatch in the per-member loop is the
+      per-job-dispatch regression, the packed round quietly degraded
+      back to N round trips per round;
+    * exactly one UNCONDITIONAL device→host fetch (the segmented
+      counters — the round's single completion barrier); the hit slice
+      may be fetched only behind the hit-count guard, exactly the solo
+      drive's contract (PERF.md §18);
+    * NO fetch of device results inside any ``for`` loop — per-member
+      splitting is host bookkeeping over the already-materialized
+      arrays; a fetch hidden in the segment bookkeeping barriers the
+      round once per member;
+    * ``block_until_ready`` nowhere.
+
+    Names bound directly from a fetch call (``counters =
+    np.asarray(out["counters"])``) are host-materialized — arithmetic
+    on them is not a round trip; device results are the names bound
+    from the in-flight ``popleft()`` and the dispatch call itself.
+    """
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"packed round source unavailable for audit: {exc}",
+            )
+        ]
+    findings: List[AuditFinding] = []
+    fdef = next(
+        (n for n in ast.walk(tree) if isinstance(n, ast.FunctionDef)), None
+    )
+    if fdef is None:
+        return [
+            AuditFinding("config", entry,
+                         "packed round has no function body to audit")
+        ]
+    for node in ast.walk(fdef):
+        if (
+            isinstance(node, ast.Attribute)
+            and node.attr == "block_until_ready"
+        ):
+            findings.append(
+                AuditFinding(
+                    "pack-round", entry,
+                    "block_until_ready in the packed round — the one "
+                    "counters fetch IS the round's completion barrier "
+                    "(PERF.md §22)",
+                )
+            )
+    # Device-result names: bound from the in-flight pop or a dispatch.
+    device: Set[str] = set()
+    for stmt in ast.walk(fdef):
+        if isinstance(stmt, ast.Assign):
+            val = stmt.value
+            popped = (
+                isinstance(val, ast.Call)
+                and isinstance(val.func, ast.Attribute)
+                and val.func.attr in ("popleft", "pop")
+            )
+            if popped or _is_dispatch_call(val):
+                for t in stmt.targets:
+                    device |= _assigned_names(t)
+    device -= _hostside_names(fdef)
+
+    dispatches: List[Tuple[ast.Call, bool]] = []
+    fetches: List[Tuple[ast.Call, bool, bool]] = []
+
+    def scan(node, conditional: bool, in_for: bool) -> None:
+        for sub in ast.walk(node):
+            if _is_dispatch_call(sub):
+                dispatches.append((sub, in_for))
+            elif _is_fetch_call(sub):
+                names = set()
+                for arg in sub.args:
+                    names |= _base_names(arg)
+                if not (names & device):
+                    continue  # host arithmetic on fetched values
+                fetches.append((sub, conditional, in_for))
+
+    def walk(stmts, conditional: bool, in_for: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan(stmt.iter, conditional, in_for)
+                walk(stmt.body, conditional, True)
+                walk(stmt.orelse, conditional, in_for)
+            elif isinstance(stmt, ast.While):
+                # The dispatch-ahead fill loop is a while by contract;
+                # a tick of its TEST runs per iteration like a body
+                # statement.
+                scan(stmt.test, conditional, in_for)
+                walk(stmt.body, conditional, in_for)
+                walk(stmt.orelse, conditional, in_for)
+            elif isinstance(stmt, ast.If):
+                scan(stmt.test, conditional, in_for)
+                walk(stmt.body, True, in_for)
+                walk(stmt.orelse, True, in_for)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    scan(item.context_expr, conditional, in_for)
+                walk(stmt.body, conditional, in_for)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, conditional, in_for)
+                for h in stmt.handlers:
+                    walk(h.body, True, in_for)
+                walk(stmt.orelse, True, in_for)
+                walk(stmt.finalbody, conditional, in_for)
+            else:
+                scan(stmt, conditional, in_for)
+
+    walk(fdef.body, False, False)
+    if any(in_for for _n, in_for in dispatches):
+        findings.append(
+            AuditFinding(
+                "pack-round", entry,
+                "device dispatch inside a for loop of the packed round "
+                "— the per-job-dispatch regression: the fused group "
+                "exists to issue ONE physical dispatch per round, not "
+                "one per member (PERF.md §22)",
+            )
+        )
+    if len(dispatches) != 1:
+        findings.append(
+            AuditFinding(
+                "pack-round", entry,
+                f"{len(dispatches)} dispatch call site(s) in the packed "
+                "round (want exactly one — the dispatch-ahead fill loop "
+                "drives it; PERF.md §22)",
+            )
+        )
+    if any(in_for for _n, _c, in_for in fetches):
+        findings.append(
+            AuditFinding(
+                "pack-round", entry,
+                "device→host fetch inside a for loop of the packed "
+                "round — a fetch hidden in the per-member segment "
+                "bookkeeping barriers the round once per member; split "
+                "results from the already-fetched arrays (PERF.md §22)",
+            )
+        )
+    n_uncond = sum(
+        1 for _n, conditional, _l in fetches if not conditional
+    )
+    if n_uncond != 1:
+        findings.append(
+            AuditFinding(
+                "pack-round", entry,
+                f"{n_uncond} unconditional device→host fetches per "
+                "packed round (want exactly one — the segmented "
+                "counters barrier; the hit slice belongs behind the "
+                "hit-count guard, PERF.md §22)",
             )
         )
     return findings
