@@ -1976,6 +1976,14 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         )
         if not init_ok:
             init_wait[0] += wall_s
+            # The r01-r05 init-flake pattern as a queryable registry
+            # signal (PERF.md §23), not just buried failed_attempts
+            # JSON: every attempt that never initialized counts, with
+            # its burnt wall.
+            from hashcat_a5_table_generator_tpu.runtime import telemetry
+
+            telemetry.counter("bench.init_retries").add(1)
+            telemetry.counter("bench.init_wall_s").add(wall_s)
         if record is not None:
             record["attempt"] = name
             return record
@@ -1993,6 +2001,17 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         }
 
     def emit(record):
+        # Registry-derived init-flake summary on the emitted record:
+        # the counters are the queryable signal, these fields make the
+        # artifact self-describing (PERF.md §23).
+        from hashcat_a5_table_generator_tpu.runtime import telemetry
+
+        retries = int(telemetry.counter("bench.init_retries").value)
+        if retries:
+            record["init_retries"] = retries
+            record["init_wall_s"] = round(
+                float(telemetry.counter("bench.init_wall_s").value), 1
+            )
         if record.get("platform") and record["platform"] != "cpu":
             # A live accelerator measurement: refresh the committed
             # last-good record.

@@ -122,6 +122,16 @@ def build_parser() -> argparse.ArgumentParser:
                          "checkpoint repeat after a retry (bound the window "
                          "with --checkpoint-every; a notice marks each "
                          "retry on stderr)")
+    ap.add_argument("--fetch-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="device backend: watchdog on each consumed "
+                         "device fetch — a fetch still pending after "
+                         "SECONDS raises a typed FetchTimeout, which "
+                         "the drive's transient-retry supervisor "
+                         "re-dispatches from the last fetched boundary "
+                         "(PERF.md §23). Default off: CPU sweeps and "
+                         "cold compiles legitimately stall longer than "
+                         "any sane timeout")
     ap.add_argument("--progress", action="store_true",
                     help="periodic JSON progress lines on stderr")
     ap.add_argument("--lanes", type=int, default=None,
@@ -805,9 +815,11 @@ def _write_metrics_json(path, sweeps, *, pod_gather: bool = False) -> None:
                "pod_merged": True}
     else:
         doc = {"metrics": telemetry.snapshot(), "spans": spans}
-    with open(path, "w") as fh:
-        json.dump(doc, fh, indent=2)
-        fh.write("\n")
+    # Same crash/power-loss discipline as checkpoints (PERF.md §23): a
+    # metrics file a collector scrapes must never be observed torn.
+    from .runtime.checkpoint import atomic_write_text
+
+    atomic_write_text(path, json.dumps(doc, indent=2) + "\n")
 
 
 def _run_device(args, sub_map, packed) -> int:
@@ -888,6 +900,7 @@ def _run_device(args, sub_map, packed) -> int:
         schema_cache=args.schema_cache,
         schema_cache_max_mb=args.schema_cache_max_mb,
         **cfg_kw,
+        fetch_timeout_s=args.fetch_timeout,
         packed_blocks={"auto": None, "packed": True, "stride": False}[
             args.block_layout
         ],
@@ -1050,6 +1063,18 @@ def _build_serve_parser() -> argparse.ArgumentParser:
                          "process hygiene; default unbounded)")
     ap.add_argument("--max-word-bytes", type=int, default=64 * 1024,
                     help="reject job dictionary lines longer than this")
+    ap.add_argument("--client-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="--socket only: close a connection whose "
+                         "client has sent nothing for SECONDS while no "
+                         "events flowed out either (a half-open client "
+                         "must not pin a server thread forever; a "
+                         "client quietly waiting for its job's results "
+                         "is NOT idle; PERF.md §23). The dropped "
+                         "client's jobs keep running, and the job "
+                         "registry is shared across connections, so a "
+                         "reconnecting session pauses/cancels/resumes "
+                         "them by id. Default off")
     ap.add_argument("--pack", choices=("auto", "on", "off"),
                     default="auto",
                     help="cross-job packed superstep dispatch (PERF.md "
@@ -1099,7 +1124,8 @@ def _run_serve(argv: Sequence[str]) -> int:
     try:
         if args.socket:
             serve_socket(engine, args.socket,
-                         max_word_bytes=args.max_word_bytes)
+                         max_word_bytes=args.max_word_bytes,
+                         client_timeout=args.client_timeout)
         else:
             serve_stdio(engine, sys.stdin, sys.stdout,
                         max_word_bytes=args.max_word_bytes)
@@ -1109,6 +1135,10 @@ def _run_serve(argv: Sequence[str]) -> int:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    # jax-free import: the typed corrupt-checkpoint error gets its
+    # remediation hint here (PERF.md §23).
+    from .runtime.checkpoint import CheckpointCorrupt
+
     if argv is None:
         argv = sys.argv[1:]
     if argv and argv[0] == "serve":
@@ -1222,6 +1252,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 args.dict_file, max_word_bytes=args.max_word_bytes
             )
         return _run_device(args, sub_map, packed)
+    except CheckpointCorrupt as e:
+        # Typed corrupt/truncated-checkpoint error (PERF.md §23): name
+        # the file and the failure, and say what to do about it.
+        raise SystemExit(
+            f"{PROG}: {e}\n"
+            f"{PROG}: remediation: delete (or restore from backup) the "
+            "named checkpoint file, or rerun with --no-resume to start "
+            "the sweep over"
+        )
     except ValueError as e:
         raise SystemExit(f"{PROG}: {e}")
     except OSError as e:

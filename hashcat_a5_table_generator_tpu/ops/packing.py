@@ -1127,8 +1127,15 @@ class ChunkCompiler:
     caller is currently sweeping, so chunk N+1's host compile (and its
     async host→device transfers, issued inside ``compile_fn`` on the
     worker) overlaps the device sweep of chunk N while resident memory
-    stays O(ring × chunk).  Iteration yields chunks in order and
-    re-raises any worker exception at the consuming ``next()``.
+    stays O(ring × chunk).  Iteration yields chunks in order.
+
+    Worker-death recovery (PERF.md §23): a chunk whose compile raised
+    restarts the executor ONCE — fresh worker thread, the failed chunk
+    (and everything queued behind it) resubmitted — before the error
+    propagates at the consuming ``next()``; a second failure
+    propagates.  One-shot transient faults (the ``chunk.compile``
+    injection point) recover invisibly; a deterministic compile bug
+    still fails after one extra attempt.
     """
 
     def __init__(self, compile_fn, bounds: Sequence[Tuple[int, int]], *,
@@ -1145,7 +1152,8 @@ class ChunkCompiler:
         self._ex = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="a5-chunk-compile"
         )
-        self._futs = deque()
+        self._futs = deque()  # (chunk index, Future) in chunk order
+        self._restarted = False
         #: per-chunk compile windows [(t_start, t_end)] and their total
         #: wall — the overlap instrument (monotonic clock).
         self.windows: List[Tuple[float, float]] = []
@@ -1162,10 +1170,14 @@ class ChunkCompiler:
         ):
             ci = self._next
             lo, hi = self._bounds[ci]
-            self._futs.append(self._ex.submit(self._timed, ci, lo, hi))
+            self._futs.append((ci, self._ex.submit(self._timed, ci, lo, hi)))
             self._next += 1
 
     def _timed(self, ci: int, lo: int, hi: int) -> PlanChunk:
+        from ..runtime import faults
+
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.fire("chunk.compile")
         t0 = self._time.monotonic()
         chunk = self._fn(ci, lo, hi)
         chunk.t_start = t0
@@ -1173,11 +1185,51 @@ class ChunkCompiler:
         chunk.compile_s = chunk.t_end - t0
         return chunk
 
+    def _restart_worker(self, failed_ci: int) -> "PlanChunk":
+        """Restart-once recovery: rebuild the executor, re-run the
+        failed chunk, and block for it (a second failure propagates).
+        The worker may already be COMPILING the next chunk when the
+        failure is observed — ``shutdown(wait=True, cancel_futures=
+        True)`` lets that in-progress compile finish (its completed
+        future stays valid and is KEPT, never recompiled) while
+        cancelling the never-started queue entries, which alone are
+        resubmitted on the fresh executor."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        from ..runtime import telemetry
+
+        telemetry.counter("faults.worker_restarts").add(1)
+        self._ex.shutdown(wait=True, cancel_futures=True)
+        self._ex = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="a5-chunk-compile"
+        )
+        pending = [(failed_ci, None)] + [
+            (ci, None if fut.cancelled() else fut)
+            for ci, fut in self._futs
+        ]
+        self._futs.clear()
+        for ci, fut in pending:
+            if fut is None:
+                lo, hi = self._bounds[ci]
+                fut = self._ex.submit(self._timed, ci, lo, hi)
+            self._futs.append((ci, fut))
+        _ci, fut = self._futs.popleft()
+        return fut.result()
+
     def __iter__(self) -> "Iterable[PlanChunk]":
         from ..runtime import telemetry
 
         while self._futs:
-            chunk = self._futs.popleft().result()  # re-raises worker errors
+            ci, fut = self._futs.popleft()
+            try:
+                chunk = fut.result()
+            except BaseException as exc:  # noqa: BLE001 — worker death
+                if self._restarted or isinstance(
+                    exc, (KeyboardInterrupt, SystemExit)
+                ):
+                    raise
+                self._restarted = True
+                chunk = self._restart_worker(ci)
             self.windows.append((chunk.t_start, chunk.t_end))
             self.compile_wall_s += chunk.compile_s
             self._fill()
@@ -1202,7 +1254,7 @@ class ChunkCompiler:
         """Stop compiling; safe after an aborted sweep.  Chunks already
         compiled are NOT released here — the caller owns consumed chunks
         and an aborted in-flight future still completes on the worker."""
-        for fut in self._futs:
+        for _ci, fut in self._futs:
             fut.cancel()
         self._ex.shutdown(wait=True)
         self._futs.clear()

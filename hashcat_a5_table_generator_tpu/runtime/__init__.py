@@ -10,8 +10,10 @@ import the checkpoint/progress/sink layers without it.
 """
 
 from .checkpoint import (  # noqa: F401
+    CheckpointCorrupt,
     CheckpointState,
     SweepCursor,
+    atomic_write_text,
     load_checkpoint,
     save_checkpoint,
     sweep_fingerprint,

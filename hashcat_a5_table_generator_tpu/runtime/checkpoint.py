@@ -10,8 +10,12 @@ can never silently resume the wrong sweep — note the fingerprint is
 deliberately independent of *launch geometry* (lanes/blocks), so a resumed
 run may retune those freely.
 
-Writes are atomic (tmp + rename) so a crash mid-checkpoint leaves the
-previous checkpoint intact.
+Writes are atomic AND durable (:func:`atomic_write_text`: tmp + fsync
++ rename + directory fsync), so a crash mid-checkpoint leaves the
+previous checkpoint intact and a power loss cannot tear the rename
+itself.  Corrupt or truncated files fail loudly as the typed
+:class:`CheckpointCorrupt` — never a raw ``JSONDecodeError`` with no
+path, and never a silent fresh start (PERF.md §23).
 """
 
 from __future__ import annotations
@@ -23,6 +27,42 @@ from dataclasses import asdict, dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from . import faults
+
+
+class CheckpointCorrupt(ValueError):
+    """A checkpoint/manifest file exists but cannot be parsed (torn
+    write, disk corruption, hand edit).  Carries the path and the
+    parse failure; the CLI adds a one-line remediation hint."""
+
+
+def atomic_write_text(path: str, blob: str) -> None:
+    """Crash- and power-loss-safe replace of ``path`` with ``blob``:
+    write a same-directory tmp file, flush + fsync the DATA, rename
+    over the target, then fsync the DIRECTORY so the rename itself is
+    durable.  tmp+rename alone is atomic against a crash between
+    syscalls but NOT against power-loss torn writes — without the data
+    fsync the rename can land while the blocks behind it never do.
+    Checkpoints, bucket manifests and ``--metrics-json`` all write
+    through here (PERF.md §23)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(blob)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dirname = os.path.dirname(os.path.abspath(path)) or "."
+    try:
+        dirfd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return  # exotic mount: the data fsync above still stands
+    try:
+        os.fsync(dirfd)
+    except OSError:
+        pass  # some filesystems refuse directory fsync
+    finally:
+        os.close(dirfd)
 
 #: v2: canonical word encoding is (int64 length vector, concatenated
 #: content) so packed batches hash buffer-at-a-time instead of per-word.
@@ -154,15 +194,15 @@ def state_from_doc(doc: Dict) -> CheckpointState:
 
 
 def save_checkpoint(path: str, state: CheckpointState) -> None:
-    """Atomically write ``state`` as JSON (tmp file + rename)."""
+    """Durably write ``state`` as JSON (:func:`atomic_write_text`).
+    The ``checkpoint.write`` injection point fires BEFORE any byte
+    lands, so an injected crash here proves the previous checkpoint
+    survives intact (PERF.md §23)."""
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("checkpoint.write")
     doc = state_to_doc(state)
     blob = json.dumps(doc)
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        fh.write(blob)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    atomic_write_text(path, blob)
     from . import telemetry
 
     if telemetry.enabled():
@@ -175,11 +215,12 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
 
     Raises ``ValueError`` on version or fingerprint mismatch (a checkpoint
     for a *different* sweep is an operator error worth surfacing, not a
-    silent fresh start)."""
+    silent fresh start) and :class:`CheckpointCorrupt` on a file that
+    exists but cannot be parsed — naming the path and the failure."""
     if not os.path.exists(path):
         return None
     with open(path) as fh:
-        doc = json.load(fh)
+        doc = _parse_doc(fh.read(), path)
     if doc.get("kind") == MANIFEST_KIND:
         raise ValueError(
             f"checkpoint {path!r} is a bucket manifest written by a "
@@ -197,7 +238,26 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
             "(mode/window/table/wordlist/digests changed); delete it to "
             "start over"
         )
-    return state_from_doc(doc)
+    try:
+        return state_from_doc(doc)
+    except (KeyError, TypeError, ValueError) as exc:
+        # Valid JSON, broken schema (hand edit, partial restore): same
+        # typed error as a torn file — the caller's remediation is
+        # identical either way.
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is corrupt: field parse failed "
+            f"({type(exc).__name__}: {exc})"
+        ) from exc
+
+
+def _parse_doc(raw: str, path: str) -> Dict:
+    try:
+        return json.loads(raw)
+    except json.JSONDecodeError as exc:
+        raise CheckpointCorrupt(
+            f"checkpoint {path!r} is corrupt or truncated: not valid "
+            f"JSON ({exc})"
+        ) from exc
 
 
 def save_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> None:
@@ -218,12 +278,7 @@ def save_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> None:
             for width, fp in sorted(fingerprints.items())
         },
     }
-    tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        json.dump(doc, fh)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    atomic_write_text(path, json.dumps(doc))
 
 
 def check_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> bool:
@@ -237,7 +292,7 @@ def check_bucket_manifest(path: str, fingerprints: Dict[int, str]) -> bool:
     if not os.path.exists(path):
         return False
     with open(path) as fh:
-        doc = json.load(fh)
+        doc = _parse_doc(fh.read(), path)
     if doc.get("kind") != MANIFEST_KIND:
         raise ValueError(
             f"checkpoint {path!r} is a single-sweep checkpoint, not a "

@@ -55,6 +55,7 @@ from dataclasses import replace
 from typing import Callable, Dict, List, Optional, Sequence
 
 from . import telemetry
+from . import faults as faults_mod
 from .checkpoint import CheckpointState, state_from_doc, state_to_doc
 from .sinks import CandidateWriter, HitRecord
 
@@ -209,14 +210,23 @@ class EngineJob:
 class _JobRecorder:
     """Hit recorder feeding a job's bounded async queue while keeping
     the ordered list the :class:`SweepResult` reports — the per-job
-    delivery seam of the once-per-superstep fetch."""
+    delivery seam of the once-per-superstep fetch.
 
-    def __init__(self, job: EngineJob) -> None:
+    ``mute``: how many leading emits to withhold from the ASYNC queue
+    while still rebuilding the ordered list — a restarted/demoted
+    machine (PERF.md §23) replays its checkpointed hits first, and the
+    tenant already received exactly those on the same handle."""
+
+    def __init__(self, job: EngineJob, mute: int = 0) -> None:
         self.hits: List[HitRecord] = []
         self._job = job
+        self._mute = int(mute)
 
     def emit(self, record: HitRecord) -> None:
         self.hits.append(record)
+        if self._mute > 0:
+            self._mute -= 1
+            return
         self._job._push_hit(record)
 
 
@@ -231,6 +241,11 @@ class _Slot:
         self.machine = machine
         self.group = group
         self.seq = seq
+        #: engine-level machine restarts consumed (PERF.md §23): a
+        #: transiently-failing machine is rebuilt from its own last
+        #: boundary up to ``Engine(job_retries=)`` times before the job
+        #: is quarantined.
+        self.restarts = 0
 
 
 class Engine:
@@ -247,10 +262,21 @@ class Engine:
 
     def __init__(self, defaults=None, *, hit_queue_depth: int = 4096,
                  auto: bool = True, pack: Optional[bool] = None,
-                 admission_worker: bool = True) -> None:
+                 admission_worker: bool = True,
+                 faults: "Optional[object]" = None,
+                 job_retries: int = 1) -> None:
         from ..ops.packing import schema_cache_stats
         from .sweep import SweepConfig, step_cache_stats
 
+        # Fault arming (PERF.md §23): an explicit plan/spec wins;
+        # otherwise A5GEN_FAULTS decides (unset = nothing armed).
+        if faults is not None:
+            faults_mod.install(faults)
+        else:
+            faults_mod.ensure_env()
+        #: machine restarts granted per job before quarantine
+        #: (PERF.md §23's degradation ladder).
+        self._job_retries = int(job_retries)
         self.defaults = defaults if defaults is not None else SweepConfig()
         self._hit_queue_depth = int(hit_queue_depth)
         self._pending: "queue.Queue" = queue.Queue()
@@ -623,7 +649,7 @@ class Engine:
         else:
             job._staging_key = None
         if self._admit_ex is None:
-            self._built.put(self._try_build(job))
+            self._built.put(self._safe_build(job))
         else:
             with self._lock:
                 self._building += 1
@@ -641,8 +667,24 @@ class Engine:
         except Exception as exc:  # noqa: BLE001 — job-scoped failure
             return job, None, exc
 
+    def _safe_build(self, job: EngineJob):
+        """``_try_build`` with a worker-death net (PERF.md §23): a
+        ``BaseException`` escaping the job-scoped ``except Exception``
+        (the fault layer's ``WorkerDeath``, a dying thread) must not
+        strand the build — it ships across the queue like any failure,
+        where ``_finish_build`` applies the restart-once recovery.
+        KeyboardInterrupt/SystemExit re-raise: in sync-admission mode
+        this runs on the CALLER's thread, and a Ctrl-C must stay a
+        Ctrl-C, never become a failed job."""
+        try:
+            return self._try_build(job)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as exc:  # noqa: BLE001 — worker death
+            return job, None, exc
+
     def _worker_build(self, job: EngineJob) -> None:
-        self._built.put(self._try_build(job))
+        self._built.put(self._safe_build(job))
         self._wake.set()
 
     def _collect_builds(self) -> None:
@@ -663,6 +705,31 @@ class Engine:
             with self._lock:
                 self._building -= 1
                 self._in_build.discard(job)
+        if (
+            exc is not None
+            and not isinstance(exc, Exception)
+            and not getattr(job, "_build_retried", False)
+        ):
+            # Worker-death recovery (PERF.md §23): a BaseException-class
+            # failure is the WORKER dying, not the job's inputs being
+            # bad — restart the executor once and re-run this build on
+            # the fresh worker before propagating.  A second death
+            # falls through to the ordinary failed settle below.
+            job._build_retried = True
+            telemetry.counter("faults.worker_restarts").add(1)
+            if self._admit_ex is not None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._admit_ex.shutdown(wait=False)
+                self._admit_ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="a5-engine-admit"
+                )
+                with self._lock:
+                    self._building += 1
+                    self._in_build.add(job)
+                self._admit_ex.submit(self._worker_build, job)
+                return
+            return self._finish_build(*self._safe_build(job))
         skey = getattr(job, "_staging_key", None)
         with self._lock:
             stage = self._staging.get(skey) if skey is not None else None
@@ -762,6 +829,12 @@ class Engine:
     def _build_slot(self, job: EngineJob) -> _Slot:
         from .sweep import Sweep
 
+        # The admission-build seam (PERF.md §23): fires on the worker
+        # thread (or inline in sync mode); an injected Exception is a
+        # job-scoped build failure, an injected WorkerDeath exercises
+        # the restart-the-executor-once recovery in _finish_build.
+        if faults_mod.ACTIVE is not None:
+            faults_mod.ACTIVE.fire("admission.build")
         a = job._submit_args
         cfg = a["config"] if a["config"] is not None else self.defaults
         sweep = Sweep(a["spec"], a["sub_map"], a["words"], a["digests"],
@@ -818,9 +891,7 @@ class Engine:
             except StopIteration as done:
                 self._finish(slot, done.value)
             except Exception as exc:  # noqa: BLE001 — job-scoped failure
-                self._drop(slot)
-                slot.job.error = exc
-                self._settle_counts(slot.job, "failed")
+                self._recover_job(slot, exc)
             else:
                 with self._lock:
                     self._counts["supersteps_served"] += 1
@@ -833,26 +904,113 @@ class Engine:
     def _pump_groups(self) -> None:
         """One packed dispatch round per fused group; drained groups
         retire (their members already left via the machines' drive
-        finallys).  A pump error (device failure mid-dispatch) is
-        GROUP-scoped: its members fail — they can never receive another
-        result — and every other tenant keeps serving."""
+        finallys).  A pump error — after the group's own transient
+        retries (PERF.md §23) — is GROUP-scoped and recoverable:
+        packing is an optimization, so the members DEMOTE to solo
+        machines resuming from their own last fetched boundaries
+        instead of failing; every other tenant keeps serving
+        untouched."""
         with self._lock:
             groups = list(self._fused)
         for group in groups:
             try:
                 group.pump()
             except Exception as exc:  # noqa: BLE001 — group-scoped
-                for slot in self._round_slots():
-                    if getattr(slot.sweep, "_packed_source",
-                               None) is group:
-                        slot.machine.close()
-                        self._drop(slot)
-                        slot.job.error = exc
-                        self._settle_counts(slot.job, "failed")
+                self._demote_group(group, exc)
             if group.done:
                 with self._lock:
                     if group in self._fused:
                         self._fused.remove(group)
+
+    def _demote_group(self, group, exc: BaseException) -> None:
+        """The degradation ladder's packed rung (PERF.md §23): a fused
+        group whose pump failed parks every member's segment and
+        rebuilds each member as a SOLO machine from its own last
+        consumed boundary — streams stay byte-exact (the checkpoint
+        discipline replays exactly the unconsumed blocks), the group
+        retires, and the jobs keep running on the per-job dispatch
+        path."""
+        import sys
+
+        telemetry.counter("engine.group_demotions").add(1)
+        members = [
+            slot for slot in self._round_slots()
+            if getattr(slot.sweep, "_packed_source", None) is group
+        ]
+        print(
+            f"a5gen: engine: packed dispatch failed "
+            f"({type(exc).__name__}: {exc}); demoting {len(members)} "
+            "tenant(s) to solo dispatch",
+            file=sys.stderr,
+        )
+        for slot in members:
+            # A failed rebuild must stay JOB-scoped: quarantine that
+            # member (checkpoint attached) and keep demoting the rest —
+            # the serve thread never dies here.
+            try:
+                self._rebuild_machine(slot)
+            except Exception as rebuild_exc:  # noqa: BLE001
+                self._quarantine(slot, rebuild_exc)
+
+    def _rebuild_machine(self, slot: _Slot) -> None:
+        """Fresh machine on the same sweep from its last consumed
+        boundary — the shared mechanics of demotion and transient
+        restart (PERF.md §23).  Closing the old machine runs the
+        drive's cleanup finallys (a packed segment parks); the rebuilt
+        machine resumes from a deep copy of the live state, solo.
+        Replayed checkpointed hits are muted on the job's async queue
+        (the tenant already received them on this handle) while still
+        rebuilding the recorder's ordered result list."""
+        slot.machine.close()
+        sweep = slot.sweep
+        # The rebuilt machine resets the sweep's ttfc instrument; the
+        # JOB's time-to-first-fetch is a fact about its first machine —
+        # capture it now so the done event doesn't report a bogus
+        # post-restart value (PERF.md §21's surface must stay honest
+        # across §23's recoveries).
+        if slot.job.ttfc_s is None and sweep._ttfc[0] is not None:
+            slot.job.ttfc_s = sweep._ttfc[0] - sweep._run_t0
+        src = getattr(sweep, "_packed_source", None)
+        if src is not None:
+            src.leave(sweep)
+            sweep._packed_source = None
+        state = self._checkpoint_of(slot)
+        if slot.job.kind == "crack":
+            recorder = _JobRecorder(slot.job, mute=len(state.hits))
+            slot.machine = sweep.crack_machine(
+                recorder, resume=False, state=state
+            )
+        else:
+            slot.machine = sweep.candidates_machine(
+                slot.job._submit_args["writer"], resume=False, state=state
+            )
+
+    def _recover_job(self, slot: _Slot, exc: BaseException) -> None:
+        """The engine half of the degradation ladder (PERF.md §23): a
+        machine that raised past the sweep's own retry supervision is
+        RESTARTED from its last consumed boundary (transient errors
+        only, ``Engine(job_retries=)`` times); past that the job is
+        QUARANTINED — settled ``failed`` with its last checkpoint
+        attached to the handle (and the serve front-end's ``failed``
+        event), so a client can resubmit it to another engine instead
+        of losing the sweep's progress."""
+        if faults_mod.is_transient(exc) and slot.restarts < \
+                self._job_retries:
+            slot.restarts += 1
+            telemetry.counter("engine.job_restarts").add(1)
+            try:
+                self._rebuild_machine(slot)
+                return
+            except Exception as rebuild_exc:  # noqa: BLE001
+                exc = rebuild_exc  # fall through to quarantine
+        self._quarantine(slot, exc)
+
+    def _quarantine(self, slot: _Slot, exc: BaseException) -> None:
+        self._drop(slot)
+        slot.job.error = exc
+        slot.job.checkpoint = self._checkpoint_of(slot)
+        slot.job.span_summary = slot.sweep.timeline.summary()
+        self._settle_counts(slot.job, "failed")
 
     def _drop(self, slot: _Slot) -> None:
         # A packed member must park its segment even when its machine
@@ -903,10 +1061,14 @@ class Engine:
         job = slot.job
         job.result_value = result
         job.checkpoint = self._checkpoint_of(slot)
-        ttfc = slot.sweep._ttfc[0]
-        job.ttfc_s = (
-            ttfc - slot.sweep._run_t0 if ttfc is not None else None
-        )
+        # A restarted/demoted job's ttfc was captured at rebuild time
+        # (the first machine's is the honest one); only fill it here
+        # when no recovery pre-seeded it.
+        if job.ttfc_s is None:
+            ttfc = slot.sweep._ttfc[0]
+            job.ttfc_s = (
+                ttfc - slot.sweep._run_t0 if ttfc is not None else None
+            )
         job.span_summary = slot.sweep.timeline.summary()
         self._settle_counts(job, "done")
 
@@ -945,6 +1107,16 @@ _JOB_CONFIG_FIELDS = {
     "stream_chunk_words": "stream_chunk_words",
     "schema_cache": "schema_cache",
     "schema_cache_max_mb": "schema_cache_max_mb",
+    # Robustness knobs (PERF.md §23): an on-disk checkpoint makes a
+    # served job survive ENGINE death — restart the engine, read the
+    # checkpoint file, resubmit with "checkpoint": <its doc> (the crash
+    # soak test's whole loop); the retry knobs tune the drive's
+    # transient-error supervision per job.
+    "checkpoint_path": "checkpoint_path",
+    "checkpoint_every_s": "checkpoint_every_s",
+    "retry_attempts": "retry_attempts",
+    "retry_backoff_s": "retry_backoff_s",
+    "fetch_timeout_s": "fetch_timeout_s",
 }
 
 
@@ -1021,39 +1193,55 @@ def _job_from_doc(doc: dict, defaults, max_word_bytes: int):
 
 
 class _JsonlSession:
-    """One JSONL command stream against a shared :class:`Engine`."""
+    """One JSONL command stream against a shared :class:`Engine`.
+
+    ``jobs``: the job registry — per-session by default (stdin mode);
+    the socket server passes ONE dict shared by every connection, so a
+    client dropped by the idle watchdog (or a crash) can reconnect and
+    pause/cancel/resume its still-running jobs by id (PERF.md §23).
+    Ops on an ADOPTED job (registered by another session) emit their
+    settling event on THIS session — the original session's pump is
+    gone with its socket."""
 
     def __init__(self, engine: Engine, fin, fout, *,
-                 max_word_bytes: int = 64 * 1024) -> None:
+                 max_word_bytes: int = 64 * 1024,
+                 jobs: "Optional[Dict[str, EngineJob]]" = None) -> None:
         self._engine = engine
         self._fin = fin
         self._fout = fout
         self._out_lock = threading.Lock()
         self._max_word_bytes = max_word_bytes
-        self._jobs: Dict[str, EngineJob] = {}
+        self._jobs: Dict[str, EngineJob] = (
+            jobs if jobs is not None else {}
+        )
+        #: job ids THIS session started a pump thread for (their events
+        #: flow there; adopted jobs' op results are emitted inline).
+        self._pumped: set = set()
+        #: activity stamps (bare clock reads, GL013-clean) the socket
+        #: server's idle watchdog polls: a session is stale only when
+        #: BOTH directions are — a client quietly waiting for hit/done
+        #: events is not idle (PERF.md §23).
+        self._last_read = time.monotonic()
+        self._last_write = time.monotonic()
+
+    def stale(self, timeout: float) -> bool:
+        """No inbound line AND no outbound event for ``timeout``
+        seconds — the idle watchdog's half-open test."""
+        return (
+            time.monotonic() - max(self._last_read, self._last_write)
+            >= float(timeout)
+        )
 
     def _emit(self, obj: dict) -> None:
         with self._out_lock:
             self._fout.write(json.dumps(obj) + "\n")
             self._fout.flush()
+            # A completed write proves the peer is draining — the
+            # watchdog must not drop a client that is merely waiting.
+            self._last_write = time.monotonic()
 
-    def _pump_job(self, job: EngineJob) -> None:
-        """Per-job event pump (own thread): stream hits as they land,
-        then the settling event."""
-        for rec in job.iter_hits():
-            self._emit({
-                "id": job.id, "event": "hit",
-                "digest": rec.digest_hex,
-                "plain_hex": rec.candidate.hex(),
-                "word_index": rec.word_index,
-                "rank": str(rec.variant_rank),
-            })
-        # Terminal states release the candidates writer (flush + close);
-        # a PAUSED job keeps it open — resume continues the stream.
-        if job.state != "paused":
-            writer = job._submit_args.get("writer")
-            if writer is not None:
-                writer.close()
+    def _emit_settled(self, job: EngineJob) -> None:
+        """The settling event for ``job``'s current terminal state."""
         if job.state == "done":
             res = job.result_value
             done = {
@@ -1079,13 +1267,59 @@ class _JsonlSession:
         elif job.state == "cancelled":
             self._emit({"id": job.id, "event": "cancelled"})
         else:
-            self._emit({
+            failed = {
                 "id": job.id, "event": "failed",
                 "error": f"{type(job.error).__name__}: {job.error}",
-            })
+            }
+            # Quarantine (PERF.md §23): a failed job's last checkpoint
+            # rides the event so the client can resubmit it to another
+            # engine ("checkpoint" on a fresh submit) instead of losing
+            # the sweep's progress.
+            if job.checkpoint is not None:
+                failed["checkpoint"] = state_to_doc(job.checkpoint)
+            self._emit(failed)
+
+    def _pump_job(self, job: EngineJob) -> None:
+        """Per-job event pump (own thread): stream hits as they land,
+        then the settling event.  A dead client (socket gone) must not
+        wedge the ENGINE: the bounded hit queue backpressures the serve
+        thread by contract, so once a write fails the pump keeps
+        DRAINING the queue, discarding — the job runs on, adoptable by
+        a reconnecting session (PERF.md §23)."""
+        client_gone = False
+        try:
+            for rec in job.iter_hits():
+                self._emit({
+                    "id": job.id, "event": "hit",
+                    "digest": rec.digest_hex,
+                    "plain_hex": rec.candidate.hex(),
+                    "word_index": rec.word_index,
+                    "rank": str(rec.variant_rank),
+                })
+        except (OSError, ValueError):
+            client_gone = True
+            for _rec in job.iter_hits():
+                pass
+        # Terminal states release the candidates writer (flush + close);
+        # a PAUSED job keeps it open — resume continues the stream.
+        if job.state != "paused":
+            writer = job._submit_args.get("writer")
+            if writer is not None:
+                writer.close()
+        if not client_gone:
+            try:
+                self._emit_settled(job)
+            except (OSError, ValueError):
+                pass  # client vanished between the last hit and here
 
     def _handle(self, doc: dict) -> bool:
         """Dispatch one op; returns False on shutdown."""
+        # The client-facing seam (PERF.md §23): an injected error here
+        # is protocol-scoped — the session reports an ``error`` event
+        # and keeps serving; the engine (and every other session) never
+        # notices.
+        if faults_mod.ACTIVE is not None:
+            faults_mod.ACTIVE.fire("serve.client")
         op = doc.get("op", "submit")
         jid = doc.get("id")
         if op == "shutdown":
@@ -1118,6 +1352,7 @@ class _JsonlSession:
                     kw["writer"].close()
                 raise
             self._jobs[job.id] = job
+            self._pumped.add(job.id)
             self._emit({"id": job.id, "event": "accepted",
                         "kind": job.kind})
             threading.Thread(
@@ -1128,11 +1363,18 @@ class _JsonlSession:
         job = self._jobs.get(jid)
         if job is None:
             raise ValueError(f"unknown job id {jid!r}")
+        # An op on a job another (dropped) session submitted: that
+        # session's pump died with its socket, so the settling event
+        # must flow HERE (PERF.md §23).
+        adopted = jid not in self._pumped
         if op == "pause":
-            job.pause()  # the pump emits the paused event + checkpoint
+            job.pause()  # blocks until parked (or raced done)
+            if adopted:
+                self._emit_settled(job)
         elif op == "resume":
             new = self._engine.resume(job)
             self._jobs[new.id] = new
+            self._pumped.add(new.id)
             self._emit({"id": new.id, "event": "accepted",
                         "kind": new.kind, "resumed": True})
             threading.Thread(
@@ -1141,6 +1383,9 @@ class _JsonlSession:
             ).start()
         elif op == "cancel":
             job.cancel()
+            if adopted:
+                job.wait()  # settles at the next boundary
+                self._emit_settled(job)
         else:
             raise ValueError(f"unknown op {op!r}")
         return True
@@ -1148,8 +1393,21 @@ class _JsonlSession:
     def run(self) -> bool:
         """Process the stream; True when an explicit ``shutdown`` op
         ended it (a plain EOF — a disconnecting client — returns False,
-        so a socket server keeps serving the other sessions)."""
-        for line in self._fin:
+        so a socket server keeps serving the other sessions).  A closed
+        or torn connection — including the socket server's idle
+        watchdog shutting down a stale one (PERF.md §23) — likewise
+        ends only THIS session: the client's jobs keep running, and in
+        socket mode the shared job registry lets a reconnecting
+        session pause/cancel/resume them by id."""
+        while True:
+            try:
+                line = self._fin.readline()
+            except (OSError, ValueError):
+                # Watchdog-closed or torn connection mid-read.
+                return False
+            if not line:
+                return False  # EOF: client disconnected
+            self._last_read = time.monotonic()
             line = line.strip()
             if not line:
                 continue
@@ -1164,7 +1422,6 @@ class _JsonlSession:
                 continue
             if not keep_going:
                 return True
-        return False
 
 
 def serve_stdio(engine: Engine, fin, fout, *,
@@ -1176,13 +1433,42 @@ def serve_stdio(engine: Engine, fin, fout, *,
 
 def serve_socket(engine: Engine, path: str, *,
                  max_word_bytes: int = 64 * 1024,
+                 client_timeout: Optional[float] = None,
                  ready: Optional[Callable[[], None]] = None) -> None:
     """Serve JSONL sessions over a unix socket at ``path`` (one session
     per connection, all sharing ``engine``); returns when a session
     sends an explicit ``shutdown`` op — a client that merely
-    disconnects (EOF, a health probe) ends only its own session."""
+    disconnects (EOF, a health probe) ends only its own session.
+
+    ``client_timeout`` (``serve --client-timeout``, default off): a
+    connection with no inbound line AND no outbound event for that
+    many seconds is shut down by a per-connection watchdog thread — a
+    half-open client cannot pin a server thread forever, while a
+    client quietly waiting for results (events still flowing out) is
+    never dropped, and no socket timeout ever lands mid-read or
+    mid-write (PERF.md §23).  The dropped client's jobs keep running,
+    and the job registry is shared across this server's sessions, so a
+    reconnecting client pauses/cancels/resumes them by id via the
+    existing ops."""
     import os
     import socket
+
+    #: one registry for every connection — reconnection = adoption.
+    shared_jobs: Dict[str, EngineJob] = {}
+
+    def _watchdog(conn, session: "_JsonlSession",
+                  done: threading.Event) -> None:
+        interval = max(0.05, float(client_timeout) / 4.0)
+        while not done.wait(interval):
+            if session.stale(client_timeout):
+                # Shutting the socket down unblocks the session's
+                # readline (EOF/OSError) and fails any pump write —
+                # the session winds down through its ordinary paths.
+                try:
+                    conn.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                return
 
     try:
         os.unlink(path)
@@ -1206,9 +1492,22 @@ def serve_socket(engine: Engine, path: str, *,
                 with conn:
                     fin = conn.makefile("r", encoding="utf-8")
                     fout = conn.makefile("w", encoding="utf-8")
-                    shutdown = _JsonlSession(
-                        engine, fin, fout, max_word_bytes=max_word_bytes
-                    ).run()
+                    session = _JsonlSession(
+                        engine, fin, fout,
+                        max_word_bytes=max_word_bytes,
+                        jobs=shared_jobs,
+                    )
+                    done = threading.Event()
+                    if client_timeout:
+                        threading.Thread(
+                            target=_watchdog,
+                            args=(conn, session, done),
+                            name="a5-serve-watchdog", daemon=True,
+                        ).start()
+                    try:
+                        shutdown = session.run()
+                    finally:
+                        done.set()
                 if shutdown:
                     stop.set()
 

@@ -158,6 +158,17 @@ def schema_cache_max_mb() -> "Optional[float]":
     return mb
 
 
+def faults_spec() -> "Optional[str]":
+    """Deterministic fault-injection arming (PERF.md §23):
+    ``A5GEN_FAULTS`` holds a fault-plan spec (grammar in
+    ``runtime/faults.py`` — e.g. ``superstep.dispatch:nth=2``);
+    empty/unset = no faults armed.  Parsed by ``runtime/faults.py`` at
+    Sweep/Engine construction, never at import; a malformed spec fails
+    loudly there — a typo must not silently certify recovery paths the
+    faults never exercised."""
+    return read_env("A5GEN_FAULTS") or None
+
+
 def emit_scheme() -> str:
     """Message-emission scheme knob: ``A5GEN_EMIT`` selects between the
     per-slot piece emission (``perslot`` — the default; PERF.md §17) and

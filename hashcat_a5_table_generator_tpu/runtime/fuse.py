@@ -55,7 +55,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from . import telemetry
+from . import faults, telemetry
 
 
 def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
@@ -150,6 +150,12 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         sweep.spec, cfg.lanes, cfg.num_blocks, stride, steps,
         int(cfg.superstep_hit_cap), plan.out_width, windowed, n_devices,
         sweep._pipeline_depth(), sig, _pieces_static(pieces), radix2,
+        # Fault-supervision knobs (PERF.md §23): the group runs ONE
+        # retry policy and ONE fetch watchdog for every member, so
+        # jobs that disagree on them must not fuse — a fail-fast
+        # tenant must never inherit a cohabitant's retry budget.
+        int(cfg.retry_attempts), float(cfg.retry_backoff_s),
+        cfg.fetch_timeout_s,
     )
     return {
         "sweep": sweep,
@@ -416,7 +422,14 @@ class FusedGroup:
                 })
 
         self._call = call
+        self._make_bufs = make_bufs
         self._free = [make_bufs() for _ in range(self.depth)]
+        #: fault-supervision knobs shared with the solo drive
+        #: (PERF.md §23); part of the pack_candidate compatibility
+        #: key, so every member genuinely agreed on them at fuse time.
+        self._retry_attempts = int(cfg.retry_attempts)
+        self._retry_backoff_s = float(cfg.retry_backoff_s)
+        self._fetch_timeout_s = cfg.fetch_timeout_s
 
     # -- engine surface ------------------------------------------------
 
@@ -469,23 +482,49 @@ class FusedGroup:
         """One packed round: dispatch ahead up to ``depth`` supersteps,
         fetch the due one's counters (the ONE unconditional device→host
         round trip), split per-member results into the pending queues.
-        Returns False when nothing was produced (group drained)."""
-        while self._work_remains() and len(self._inflight) < self.depth:
-            snap = self._b0.copy()
-            self._inflight.append(
-                (snap, time.monotonic(), self._call(snap, self._free.pop()))
-            )
-            self._b0 = np.minimum(self._b0 + self._adv, self._seg_end)
-        if not self._inflight:
-            return False
-        if not any(self._active):
-            # Every member left mid-flight: nobody will consume these
-            # results — drop the dispatches unfetched (their hits belong
-            # to block ranges the members' checkpoints will replay).
-            self._inflight.clear()
-            return False
-        snap, disp_t, out = self._inflight.popleft()
-        counters = np.asarray(out["counters"])  # [2, S] per-job rows
+        Returns False when nothing was produced (group drained).
+
+        Fault supervision (PERF.md §23): a transient device error in
+        the dispatch/fetch half is retried — in-flight dispatches
+        dropped, buffer sets rebuilt, per-segment cursors reset to
+        their last SPLIT boundary (``_consumed``; already-split pending
+        results survive, so nothing double-counts) — up to the shared
+        ``retry_attempts`` budget; past that (or on a non-transient
+        error) the exception propagates and the engine DEMOTES the
+        members to solo machines instead of failing them."""
+        attempts = 0
+        while True:
+            try:
+                while self._work_remains() and len(self._inflight) < \
+                        self.depth:
+                    if faults.ACTIVE is not None:
+                        faults.ACTIVE.fire("packed.pump")
+                    snap = self._b0.copy()
+                    self._inflight.append(
+                        (snap, time.monotonic(),
+                         self._call(snap, self._free.pop()))
+                    )
+                    self._b0 = np.minimum(
+                        self._b0 + self._adv, self._seg_end
+                    )
+                if not self._inflight:
+                    return False
+                if not any(self._active):
+                    # Every member left mid-flight: nobody will consume
+                    # these results — drop the dispatches unfetched
+                    # (their hits belong to block ranges the members'
+                    # checkpoints will replay).
+                    self._inflight.clear()
+                    return False
+                snap, disp_t, out = self._inflight.popleft()
+                faults.await_ready(out["counters"],
+                                   self._fetch_timeout_s)
+                counters = np.asarray(out["counters"])  # [2, S] rows
+            except Exception as exc:  # noqa: BLE001 — typed check inside
+                self._recover_pump(exc, attempts)
+                attempts += 1
+                continue
+            break
         overflow = False
         hit_occupancy = 0.0
         entries: List[List[Tuple[int, int]]] = [
@@ -559,6 +598,23 @@ class FusedGroup:
         return True
 
     # -- host bookkeeping ----------------------------------------------
+
+    def _recover_pump(self, exc: BaseException, attempts: int) -> None:
+        """The packed round's transient-recovery step (PERF.md §23):
+        the shared gate (:func:`faults.supervise_retry`) re-raises or
+        backs off; on retry, drop the in-flight dispatches, rebuild the
+        buffer sets, and reset every ACTIVE segment's cursor to its
+        last split boundary (parked segments stay parked) so the retry
+        re-dispatches exactly the unconsumed work."""
+        faults.supervise_retry(
+            exc, attempts, attempts_budget=self._retry_attempts,
+            backoff_s=self._retry_backoff_s, label="the packed round",
+        )
+        self._inflight.clear()
+        self._free = [self._make_bufs() for _ in range(self.depth)]
+        self._b0 = np.where(
+            np.asarray(self._active), self._consumed, self._seg_end
+        ).astype(np.int64)
 
     def _work_remains(self) -> bool:
         return bool(np.any(

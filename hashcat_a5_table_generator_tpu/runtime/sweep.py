@@ -64,7 +64,7 @@ from ..ops.membership import HostDigestLookup, build_digest_set
 from ..ops.packing import PackedWords, pack_words
 from ..tables.compile import compile_table
 from ..utils.digests import HOST_DIGEST
-from . import telemetry
+from . import faults, telemetry
 from .checkpoint import (
     CheckpointState,
     SweepCursor,
@@ -263,6 +263,27 @@ class SweepConfig:
     checkpoint_path: Optional[str] = None
     checkpoint_every_s: float = 30.0
     progress: Optional[ProgressReporter] = None
+    retry_attempts: int = 2  # fault supervision (PERF.md §23): max
+    #   CONSECUTIVE transient-device-error recoveries per drive before the
+    #   error propagates.  A recovery drops the in-flight dispatches and
+    #   re-dispatches from the last FETCHED boundary (the lagged-checkpoint
+    #   discipline makes that exact); the counter resets on every
+    #   successful fetch, so a long sweep survives many isolated flakes
+    #   while a persistent failure still surfaces after retry_attempts.
+    #   0 = no supervision (every device error propagates immediately).
+    retry_backoff_s: float = 0.05  # base of the exponential backoff
+    #   between recovery attempts (base * 2^attempt seconds; the wall
+    #   spent lands in the faults.backoff_s telemetry counter).
+    fetch_timeout_s: Optional[float] = None  # watchdog on each consumed
+    #   counters fetch: when set, the drive polls the device result's
+    #   readiness and raises a typed FetchTimeout — which the supervisor
+    #   treats as transient — instead of blocking forever on a wedged
+    #   device/tunnel.  Off by default (CPU sweeps and giant cold
+    #   compiles legitimately stall longer than any sane timeout).
+    faults: "Optional[object]" = None  # fault-injection arming (PERF.md
+    #   §23): a runtime/faults.py spec string or FaultPlan, installed
+    #   process-wide at Sweep construction.  None = A5GEN_FAULTS decides
+    #   (unset = nothing armed, the production no-op).
 
     def resolve_block_stride(self) -> Optional[int]:
         """Lanes-per-block of the fixed-stride layout; None = packed.
@@ -418,6 +439,12 @@ class Sweep:
         # lookup, ops.membership.HostDigestLookup).
         self._digest_lookup = HostDigestLookup(self.digests)
         self.config = config or SweepConfig()
+        # Fault arming (PERF.md §23): an explicit SweepConfig.faults plan
+        # wins; otherwise A5GEN_FAULTS decides (unset = nothing armed).
+        if self.config.faults is not None:
+            faults.install(self.config.faults)
+        else:
+            faults.ensure_env()
         self.ct = compile_table(sub_map)
         # A pre-packed batch (e.g. the native scanner's read_packed) is
         # accepted directly — the rockyou-scale path never materializes a
@@ -837,6 +864,12 @@ class Sweep:
         step-build context the superstep executor (and the streaming
         chunk driver) reuses: same device-resident arrays, same kernel
         selection, so the paths trace the identical fused body."""
+        # The accelerator-init seam (PERF.md §23): the class of flake
+        # that ate bench rounds r01-r05.  Recovery is the layer above —
+        # the CLI's --retries rebuild-and-resume, the bench
+        # orchestrator's init-retry budget, the engine's job restart.
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.fire("device.init")
         if self.config.num_blocks is None:
             from dataclasses import replace
 
@@ -1192,25 +1225,53 @@ class Sweep:
         total_blocks, hit_cap = ss["total_blocks"], ss["hit_cap"]
         advance, depth = ss["advance"], ss["depth"]
         stats = {"supersteps": 0, "launches": 0, "replays": 0,
-                 "launches_per_fetch": ss["steps"],
+                 "retries": 0, "launches_per_fetch": ss["steps"],
                  "pipelined": int(depth > 1)}
         free_bufs = [ss["make_bufs"]() for _ in range(depth)]
         inflight: deque = deque()
         b0 = ss["b0"]
+        consumed_b0 = ss["b0"]
+        attempts = 0
         while b0 < total_blocks or inflight:
-            while b0 < total_blocks and len(inflight) < depth:
-                # The dispatch wall-clock rides the deque as plain data;
-                # the telemetry record itself happens only at the fetch
-                # boundary below (audit_telemetry pins that the in-
-                # flight window stays instrumentation-free).
-                inflight.append(
-                    (b0, time.monotonic(), ss["call"](b0, free_bufs.pop()))
-                )
-                b0 += advance
-            sb0, disp_t, out = inflight.popleft()
-            # The ONE per-superstep fetch — the completion barrier for
-            # superstep N only (N+1 keeps running on device).
-            ne, nh = (int(x) for x in np.asarray(out["counters"]))
+            try:
+                while b0 < total_blocks and len(inflight) < depth:
+                    # The dispatch wall-clock rides the deque as plain
+                    # data; the telemetry record itself happens only at
+                    # the fetch boundary below (audit_telemetry pins that
+                    # the in-flight window stays instrumentation-free).
+                    if faults.ACTIVE is not None:
+                        faults.ACTIVE.fire("superstep.dispatch")
+                    inflight.append(
+                        (b0, time.monotonic(),
+                         ss["call"](b0, free_bufs.pop()))
+                    )
+                    b0 += advance
+                sb0, disp_t, out = inflight.popleft()
+                if faults.ACTIVE is not None:
+                    faults.ACTIVE.fire("superstep.fetch")
+                self._await_fetch(out["counters"])
+                # The ONE per-superstep fetch — the completion barrier
+                # for superstep N only (N+1 keeps running on device).
+                counters = np.asarray(out["counters"])
+            except Exception as exc:  # noqa: BLE001 — typed check inside
+                # Transient-device-error supervision (PERF.md §23):
+                # _retry_backoff re-raises unless exc is transient and
+                # attempts remain; recovery drops every in-flight
+                # dispatch (results unfetched — their blocks re-run),
+                # rebuilds the buffer sets (a dispatch may have consumed
+                # one before dying), and re-dispatches from the last
+                # FETCHED boundary, which the lagged-checkpoint
+                # discipline keeps exact.
+                self._retry_backoff(exc, attempts)
+                attempts += 1
+                stats["retries"] += 1
+                inflight.clear()
+                free_bufs[:] = [ss["make_bufs"]() for _ in range(depth)]
+                b0 = consumed_b0
+                continue
+            attempts = 0
+            consumed_b0 = sb0 + advance
+            ne, nh = int(counters[0]), int(counters[1])
             if self._ttfc[0] is None:
                 self._ttfc[0] = time.monotonic()
             end_b = min(sb0 + advance, total_blocks)
@@ -1390,6 +1451,43 @@ class Sweep:
             src.leave(self)
         return stats
 
+    # ------------------------------------------------------------------
+    # Fault supervision (PERF.md §23)
+    # ------------------------------------------------------------------
+
+    def _retry_backoff(self, exc: BaseException, attempts: int) -> None:
+        """The retry supervisor's gate over this sweep's config knobs —
+        re-raise vs count+backoff lives in ONE place,
+        :func:`faults.supervise_retry` (the packed pump shares it)."""
+        cfg = self.config
+        faults.supervise_retry(
+            exc, attempts, attempts_budget=cfg.retry_attempts,
+            backoff_s=cfg.retry_backoff_s, label="the sweep drive",
+        )
+
+    def _await_fetch(self, value) -> None:
+        """Watchdog on a consumed fetch: ``SweepConfig.fetch_timeout_s``
+        through the shared :func:`faults.await_ready` (the packed pump
+        rides the same helper).  Off by default: giant cold compiles
+        and CPU sweeps legitimately outlast any sane timeout."""
+        faults.await_ready(value, self.config.fetch_timeout_s)
+
+    def _dispatch_launch(self, launch: Callable, blocks):
+        """One per-launch-path dispatch under the same supervision as
+        the superstep drive: the ``superstep.dispatch`` injection point
+        covers both drive shapes, and a transient dispatch error is
+        retried with backoff (the launch is pure — re-dispatching the
+        same blocks is exact replay)."""
+        attempts = 0
+        while True:
+            try:
+                if faults.ACTIVE is not None:
+                    faults.ACTIVE.fire("superstep.dispatch")
+                return launch(blocks)
+            except Exception as exc:  # noqa: BLE001 — typed check inside
+                self._retry_backoff(exc, attempts)
+                attempts += 1
+
     def _launches(
         self, cursor: SweepCursor, launch: Callable, *, n_devices: int = 1,
         mesh=None, plan=None,
@@ -1450,7 +1548,7 @@ class Sweep:
                         (batches[d], d * lanes, (d + 1) * lanes)
                         for d in range(n_devices)
                     ]
-            out = launch(blocks)
+            out = self._dispatch_launch(launch, blocks)
             pending.append((segments, out, SweepCursor(w2, rank2)))
             w, rank = w2, rank2
             if len(pending) >= cfg.max_in_flight:
@@ -1472,7 +1570,26 @@ class Sweep:
                 # BEFORE the checkpoint asserts it (else a crash between
                 # the save and the flush loses output resume cannot replay).
                 before_save()
-            save_checkpoint(cfg.checkpoint_path, state)
+            try:
+                save_checkpoint(cfg.checkpoint_path, state)
+            except Exception as exc:  # noqa: BLE001 — periodic-save fate
+                # A PERIODIC save failure (disk full, injected
+                # checkpoint.write fault) must not kill a healthy sweep
+                # — the atomic write left the previous checkpoint
+                # intact, the state stays in memory, and the next
+                # interval retries.  The FINAL forced save is the
+                # durability the caller asked for: it propagates.
+                if force:
+                    raise
+                telemetry.counter("faults.checkpoint_errors").add(1)
+                import sys
+
+                print(
+                    f"a5gen: warning: checkpoint write failed "
+                    f"({type(exc).__name__}: {exc}); previous checkpoint "
+                    "intact, retrying at the next interval",
+                    file=sys.stderr,
+                )
             last[0] = now
 
     def _flush_fallback_until(

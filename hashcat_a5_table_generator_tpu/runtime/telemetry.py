@@ -402,7 +402,7 @@ class MergeSpec:
 #: steps-per-fetch ratio and the pipelined flag describe one shared
 #: config, so they max.
 SUPERSTEP_MERGE = MergeSpec(
-    sum_keys=("supersteps", "launches", "replays"),
+    sum_keys=("supersteps", "launches", "replays", "retries"),
     max_keys=("launches_per_fetch", "pipelined"),
 )
 

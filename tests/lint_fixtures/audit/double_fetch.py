@@ -215,3 +215,71 @@ def broken_drive(call, make_bufs, total, advance, depth, process_hits):
                      "hit_rank": out["hit_rank"]})
         done += ne
     return done
+
+
+def clean_drive_recovering(call, make_bufs, total, advance, depth,
+                           process_hits, recover):
+    """Sanctioned shape under the fault-supervision try (PERF.md §23):
+    the dispatch fill loop and the one counters fetch sit in a try
+    whose handler only does host-side recovery bookkeeping — still
+    exactly one unconditional fetch of the popped result."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    consumed = 0
+    done = 0
+    while b0 < total or inflight:
+        try:
+            while b0 < total and len(inflight) < depth:
+                inflight.append((b0, call(b0, free.pop())))
+                b0 += advance
+            sb0, out = inflight.popleft()
+            counters = np.asarray(out["counters"])
+        except Exception:
+            recover()
+            inflight.clear()
+            free[:] = [make_bufs() for _ in range(depth)]
+            b0 = consumed
+            continue
+        consumed = sb0 + advance
+        ne = int(counters[0])
+        nh = int(counters[1])
+        if nh:
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done
+
+
+def broken_drive_recovering_inflight_fetch(call, make_bufs, total,
+                                           advance, depth, process_hits,
+                                           recover):
+    """The in-flight fetch sin HIDDEN by the recovery try: the fill
+    loop now nests inside a Try, and the audit must still track its
+    dispatches as in-flight — fetching through the deque barriers the
+    pipeline exactly as it did pre-§23."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        try:
+            while b0 < total and len(inflight) < depth:
+                inflight.append((b0, call(b0, free.pop())))
+                b0 += advance
+            done += int(inflight[-1][1]["n_emitted"])  # in-flight fetch!
+            sb0, out = inflight.popleft()
+            counters = np.asarray(out["counters"])
+        except Exception:
+            recover()
+            continue
+        ne = int(counters[0])
+        if int(counters[1]):
+            dev_hits = np.asarray(out["dev_hits"])
+            process_hits(sb0, dev_hits)
+        free.append({"hit_word": out["hit_word"],
+                     "hit_rank": out["hit_rank"]})
+        done += ne
+    return done

@@ -1,0 +1,100 @@
+"""Fault-hook guard fixture (PERF.md §23): the injection seams in the
+drive/pump loops must keep the no-op-guarded shape —
+
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("point")
+
+``audit_fault_hooks`` must FIRE on a bare always-on hook (rule matching
+runs in the dispatch fill window on every arrival) and on a hook behind
+the WRONG guard, and stay quiet on the sanctioned shape — including a
+hook whose guard sits above a try block, the fault-supervised drive's
+real layout.
+
+AST-only fixtures: the audit reads source, nothing here ever runs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+def clean_drive_hooked(call, make_bufs, total, advance, depth, faults):
+    """The sanctioned shape: every fire() behind ACTIVE is not None."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            if faults.ACTIVE is not None:
+                faults.ACTIVE.fire("superstep.dispatch")
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        if faults.ACTIVE is not None:
+            faults.ACTIVE.fire("superstep.fetch")
+        done += consume(sb0, out)  # noqa: F821 — fixture stub
+        free.append(out)
+    return done
+
+
+def clean_drive_hooked_recovering(call, make_bufs, total, advance, depth,
+                                  faults, recover):
+    """Sanctioned shape under the fault-supervision try: the guard
+    stays immediately around each fire(), with the try wrapping the
+    whole dispatch/fetch half (the production drive's layout)."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        try:
+            while b0 < total and len(inflight) < depth:
+                if faults.ACTIVE is not None:
+                    faults.ACTIVE.fire("superstep.dispatch")
+                inflight.append((b0, call(b0, free.pop())))
+                b0 += advance
+            sb0, out = inflight.popleft()
+        except Exception:
+            b0 = recover(inflight, free)
+            continue
+        done += consume(sb0, out)  # noqa: F821 — fixture stub
+        free.append(out)
+    return done
+
+
+def broken_drive_bare_hook(call, make_bufs, total, advance, depth, faults):
+    """The finding: an always-on fire() in the dispatch fill window."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            faults.ACTIVE.fire("superstep.dispatch")  # no guard!
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        done += consume(sb0, out)  # noqa: F821 — fixture stub
+        free.append(out)
+    return done
+
+
+def broken_drive_wrong_guard(call, make_bufs, total, advance, depth,
+                             faults, debug):
+    """A guard that is not the ACTIVE-is-not-None test does not count:
+    the production no-op contract is the attribute check itself."""
+    free = [make_bufs() for _ in range(depth)]
+    inflight = deque()
+    b0 = 0
+    done = 0
+    while b0 < total or inflight:
+        while b0 < total and len(inflight) < depth:
+            if debug:
+                faults.ACTIVE.fire("superstep.dispatch")
+            inflight.append((b0, call(b0, free.pop())))
+            b0 += advance
+        sb0, out = inflight.popleft()
+        done += consume(sb0, out)  # noqa: F821 — fixture stub
+        free.append(out)
+    return done

@@ -1,0 +1,798 @@
+"""Fault-injection layer + supervised recovery (PERF.md §23).
+
+The fault matrix: for every named injection point the fault is armed
+and the DOCUMENTED recovery asserted — retry succeeds with byte-exact
+hit-stream parity, a failing packed group demotes to solo machines, a
+dead worker's executor restarts once, a corrupt checkpoint fails with
+the typed error — plus the spec-grammar/determinism unit tests and the
+SIGKILL crash-recovery soak (slow tier: kill ``a5gen serve`` mid-sweep
+at a fault-chosen boundary, restart, resubmit from the on-disk
+checkpoint, byte parity vs an uninterrupted run).
+
+Tier-1 budget: fast tests share the suite's 64-lane × 16-block
+geometry (one compiled program serves them all via the process step
+cache); the subprocess soak is slow-marked per the 870 s contract.
+"""
+
+import hashlib
+import io
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.runtime import faults, telemetry
+from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+    CheckpointCorrupt,
+    atomic_write_text,
+    check_bucket_manifest,
+    load_checkpoint,
+    save_bucket_manifest,
+    state_to_doc,
+)
+from hashcat_a5_table_generator_tpu.runtime.engine import (
+    Engine,
+    JobFailed,
+    serve_socket,
+    serve_stdio,
+)
+from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep, SweepConfig
+from tests.test_superstep import LEET, WORDS, oracle_lines
+
+LONG_WORDS = WORDS * 4  # spans ~12 supersteps at the 64-lane superstep=1
+
+
+def cfg(**kw):
+    kw.setdefault("superstep", 1)
+    return SweepConfig(lanes=64, num_blocks=16, **kw)
+
+
+def planted_digests(spec, words, picks=(0, 5, 200, -1), decoys=8):
+    oracle = oracle_lines(spec, LEET, words)
+    digests = sorted({hashlib.md5(oracle[i]).digest() for i in picks})
+    digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(decoys)]
+    return digests
+
+
+def full_hits(res):
+    return [
+        (h.word_index, h.variant_rank, h.candidate, h.digest_hex)
+        for h in res.hits
+    ]
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return AttackSpec(mode="default", algo="md5")
+
+
+@pytest.fixture(scope="module")
+def digests(spec):
+    return planted_digests(spec, LONG_WORDS)
+
+
+@pytest.fixture(scope="module")
+def baseline(spec, digests):
+    """The unfaulted run every matrix entry compares against (module-
+    scoped: one compile serves the whole file)."""
+    return Sweep(spec, LEET, LONG_WORDS, digests, config=cfg()).run_crack()
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan unit tests (no jax)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultPlan:
+    def test_nth_one_shot(self):
+        plan = faults.parse_plan("superstep.dispatch:nth=3")
+        for i in range(1, 6):
+            if i == 3:
+                with pytest.raises(faults.FaultInjected):
+                    plan.fire("superstep.dispatch")
+            else:
+                plan.fire("superstep.dispatch")
+        assert plan.fired == [("superstep.dispatch", 3)]
+        assert plan.calls("superstep.dispatch") == 5
+
+    def test_persist_keeps_firing(self):
+        plan = faults.parse_plan("packed.pump:nth=2,persist")
+        plan.fire("packed.pump")
+        for _ in range(3):
+            with pytest.raises(faults.FaultInjected):
+                plan.fire("packed.pump")
+        assert len(plan.fired) == 3
+
+    def test_probability_is_seed_deterministic(self):
+        def pattern(seed):
+            plan = faults.parse_plan(
+                f"serve.client:p=0.5,seed={seed},persist"
+            )
+            out = []
+            for _ in range(32):
+                try:
+                    plan.fire("serve.client")
+                    out.append(0)
+                except faults.FaultInjected:
+                    out.append(1)
+            return out
+
+        a, b = pattern(7), pattern(7)
+        assert a == b
+        assert 0 < sum(a) < 32
+        assert pattern(8) != a  # a different seed moves the pattern
+
+    def test_error_vocabulary(self):
+        plan = faults.parse_plan(
+            "superstep.fetch:error=FetchTimeout;"
+            "admission.build:error=WorkerDeath"
+        )
+        with pytest.raises(faults.FetchTimeout):
+            plan.fire("superstep.fetch")
+        with pytest.raises(faults.WorkerDeath):
+            plan.fire("admission.build")
+        # WorkerDeath escapes the job-scoped Exception nets by design.
+        assert not issubclass(faults.WorkerDeath, Exception)
+
+    def test_points_are_independent(self):
+        plan = faults.parse_plan("superstep.dispatch:nth=1")
+        plan.fire("superstep.fetch")  # different point: no fire
+        with pytest.raises(faults.FaultInjected):
+            plan.fire("superstep.dispatch")
+
+    def test_unknown_point_and_options_fail_loudly(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            faults.parse_plan("superstep.dsipatch:nth=1")
+        with pytest.raises(ValueError, match="unknown fault error"):
+            faults.parse_plan("superstep.fetch:error=Nope")
+        with pytest.raises(ValueError, match="unknown fault option"):
+            faults.parse_plan("superstep.fetch:bogus=1")
+        with pytest.raises(ValueError, match="nth= OR p="):
+            faults.parse_plan("superstep.fetch:nth=1,p=0.5")
+        with pytest.raises(ValueError, match="no injection points"):
+            faults.parse_plan(" ; ")
+
+    def test_armed_restores_previous_plan(self):
+        outer = faults.install("serve.client:nth=1")
+        with faults.armed("device.init:nth=1") as inner:
+            assert faults.ACTIVE is inner
+        assert faults.ACTIVE is outer
+        faults.clear()
+        assert faults.ACTIVE is None
+
+    def test_env_arming_follows_the_variable(self, monkeypatch):
+        monkeypatch.setenv("A5GEN_FAULTS", "device.init:nth=1")
+        faults.ensure_env()
+        assert faults.ACTIVE is not None
+        assert faults.ACTIVE.rules[0].point == "device.init"
+        monkeypatch.setenv("A5GEN_FAULTS", "")
+        faults.ensure_env()
+        assert faults.ACTIVE is None
+
+    def test_transient_classification(self):
+        assert faults.is_transient(faults.FaultInjected("x"))
+        assert faults.is_transient(faults.FetchTimeout("x"))
+        assert not faults.is_transient(ValueError("x"))
+
+        class XlaRuntimeError(RuntimeError):
+            pass
+
+        assert faults.is_transient(XlaRuntimeError("device lost"))
+
+
+class TestFetchWatchdog:
+    def test_unready_value_times_out_typed(self):
+        sweep = SimpleNamespace(config=cfg(fetch_timeout_s=0.05))
+        stuck = SimpleNamespace(is_ready=lambda: False)
+        with pytest.raises(faults.FetchTimeout, match="fetch_timeout_s"):
+            Sweep._await_fetch(sweep, stuck)
+
+    def test_ready_value_passes_and_off_is_noop(self):
+        sweep = SimpleNamespace(config=cfg(fetch_timeout_s=0.05))
+        Sweep._await_fetch(sweep, SimpleNamespace(is_ready=lambda: True))
+        # Watchdog off (default): even a stuck probe is never polled.
+        off = SimpleNamespace(config=cfg())
+        Sweep._await_fetch(off, SimpleNamespace(is_ready=lambda: False))
+        # No readiness probe (plain numpy): falls through to the fetch.
+        Sweep._await_fetch(sweep, object())
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix: superstep drive (dispatch / fetch)
+# ---------------------------------------------------------------------------
+
+
+class TestDriveSupervision:
+    def test_dispatch_fault_retries_with_parity(self, spec, digests,
+                                                baseline):
+        with faults.armed("superstep.dispatch:nth=3") as plan:
+            got = Sweep(
+                spec, LEET, LONG_WORDS, digests, config=cfg()
+            ).run_crack()
+        assert plan.fired == [("superstep.dispatch", 3)]
+        assert full_hits(got) == full_hits(baseline)
+        assert got.n_emitted == baseline.n_emitted
+        assert got.superstep["retries"] == 1
+        assert got.superstep["supersteps"] == baseline.superstep[
+            "supersteps"
+        ]
+
+    def test_fetch_timeout_fault_retries_with_parity(self, spec, digests,
+                                                     baseline):
+        before = telemetry.counter("faults.retries").value
+        with faults.armed("superstep.fetch:error=FetchTimeout,nth=2"):
+            got = Sweep(
+                spec, LEET, LONG_WORDS, digests, config=cfg()
+            ).run_crack()
+        assert full_hits(got) == full_hits(baseline)
+        assert got.n_emitted == baseline.n_emitted
+        assert telemetry.counter("faults.retries").value == before + 1
+
+    def test_persistent_fault_exhausts_attempts_and_raises(self, spec,
+                                                           digests):
+        with faults.armed("superstep.dispatch:persist"):
+            with pytest.raises(faults.FaultInjected):
+                Sweep(
+                    spec, LEET, LONG_WORDS, digests,
+                    config=cfg(retry_attempts=1),
+                ).run_crack()
+
+    def test_non_transient_error_propagates_unretried(self, spec,
+                                                      digests):
+        before = telemetry.counter("faults.retries").value
+        with faults.armed("superstep.fetch:error=OSError,nth=1"):
+            with pytest.raises(OSError):
+                Sweep(
+                    spec, LEET, LONG_WORDS, digests, config=cfg()
+                ).run_crack()
+        assert telemetry.counter("faults.retries").value == before
+
+    def test_per_launch_path_dispatch_fault_retries(self, spec, digests,
+                                                    baseline):
+        c = SweepConfig(lanes=64, num_blocks=16, superstep=0)
+        with faults.armed("superstep.dispatch:nth=2") as plan:
+            got = Sweep(spec, LEET, LONG_WORDS, digests, config=c
+                        ).run_crack()
+        assert plan.fired
+        assert full_hits(got) == full_hits(baseline)
+        assert got.n_emitted == baseline.n_emitted
+
+    def test_faults_armed_via_sweep_config(self, spec, digests, baseline):
+        got = Sweep(
+            spec, LEET, LONG_WORDS, digests,
+            config=cfg(faults="superstep.dispatch:nth=2"),
+        ).run_crack()
+        assert faults.ACTIVE.fired == [("superstep.dispatch", 2)]
+        assert full_hits(got) == full_hits(baseline)
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix: packed dispatch (pump retry, demotion ladder)
+# ---------------------------------------------------------------------------
+
+
+class TestPackedSupervision:
+    def _solo(self, spec, digest_sets):
+        return [
+            Sweep(spec, LEET, LONG_WORDS, d,
+                  config=cfg(superstep=4)).run_crack()
+            for d in digest_sets
+        ]
+
+    @pytest.fixture(scope="class")
+    def digest_sets(self, spec):
+        return [
+            planted_digests(spec, LONG_WORDS, (0, 5)),
+            planted_digests(spec, LONG_WORDS, (3, 200)),
+        ]
+
+    def test_pump_transient_retries_group_survives(self, spec,
+                                                   digest_sets):
+        solo = self._solo(spec, digest_sets)
+        with faults.armed("packed.pump:nth=2") as plan:
+            eng = Engine(cfg(superstep=4), auto=False)
+            jobs = [eng.submit(spec, LEET, LONG_WORDS, d)
+                    for d in digest_sets]
+            eng._admit(wait=True)
+            eng.run_until_idle()
+            res = [j.result(timeout=0) for j in jobs]
+        assert plan.fired
+        for got, want in zip(res, solo):
+            assert full_hits(got) == full_hits(want)
+            assert got.n_emitted == want.n_emitted
+            # Still packed: the group recovered instead of demoting.
+            assert got.superstep.get("packed") == 2
+
+    def test_pump_persistent_fault_demotes_to_solo(self, spec,
+                                                   digest_sets):
+        solo = self._solo(spec, digest_sets)
+        before = telemetry.counter("engine.group_demotions").value
+        with faults.armed("packed.pump:persist"):
+            eng = Engine(cfg(superstep=4), auto=False)
+            jobs = [eng.submit(spec, LEET, LONG_WORDS, d)
+                    for d in digest_sets]
+            eng._admit(wait=True)
+            eng.run_until_idle()
+            res = [j.result(timeout=0) for j in jobs]
+        assert telemetry.counter(
+            "engine.group_demotions"
+        ).value == before + 1
+        for got, want in zip(res, solo):
+            assert full_hits(got) == full_hits(want)
+            assert got.n_emitted == want.n_emitted
+        assert eng.stats()["fused_groups"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix: engine ladder (restart, quarantine), admission, workers
+# ---------------------------------------------------------------------------
+
+
+class TestEngineLadder:
+    def test_machine_restart_then_done_with_parity(self, spec, digests,
+                                                   baseline):
+        before = telemetry.counter("engine.job_restarts").value
+        with faults.armed("superstep.fetch:nth=4") as plan:
+            eng = Engine(cfg(retry_attempts=0), auto=False, pack=False,
+                         job_retries=1)
+            job = eng.submit(spec, LEET, LONG_WORDS, digests)
+            eng.run_until_idle()
+            res = job.result(timeout=0)
+        assert plan.fired
+        assert telemetry.counter(
+            "engine.job_restarts"
+        ).value == before + 1
+        assert full_hits(res) == full_hits(baseline)
+        assert res.n_emitted == baseline.n_emitted
+        # The handle's async stream has no duplicates: replayed
+        # checkpointed hits are muted on restart.
+        got_q = [(h.word_index, h.variant_rank) for h in job.iter_hits()]
+        assert got_q == [
+            (h.word_index, h.variant_rank) for h in baseline.hits
+        ]
+
+    def test_quarantine_attaches_checkpoint(self, spec, digests):
+        with faults.armed("superstep.fetch:nth=4,persist"):
+            eng = Engine(cfg(retry_attempts=0), auto=False, pack=False,
+                         job_retries=0)
+            job = eng.submit(spec, LEET, LONG_WORDS, digests)
+            eng.run_until_idle()
+        with pytest.raises(JobFailed):
+            job.result(timeout=0)
+        assert job.state == "failed"
+        assert job.checkpoint is not None
+        assert job.checkpoint.cursor.word > 0  # real progress retained
+        # The quarantine token resumes on a fresh engine, byte-exact.
+        faults.clear()
+        eng2 = Engine(cfg(), auto=False, pack=False)
+        job2 = eng2.submit(spec, LEET, LONG_WORDS, digests,
+                           resume_state=job.checkpoint)
+        eng2.run_until_idle()
+        res = job2.result(timeout=0)
+        want = Sweep(spec, LEET, LONG_WORDS, digests,
+                     config=cfg()).run_crack()
+        assert full_hits(res) == full_hits(want)
+        assert res.n_emitted == want.n_emitted
+
+    def test_admission_build_fault_is_job_scoped(self, spec, digests,
+                                                 baseline):
+        with faults.armed("admission.build:nth=1"):
+            eng = Engine(cfg(), auto=False, pack=False)
+            j1 = eng.submit(spec, LEET, LONG_WORDS, digests)
+            j2 = eng.submit(spec, LEET, LONG_WORDS, digests)
+            eng.run_until_idle()
+        assert j1.state == "failed"
+        assert isinstance(j1.error, faults.FaultInjected)
+        assert full_hits(j2.result(timeout=0)) == full_hits(baseline)
+
+    def test_admission_worker_death_restarts_executor_once(
+        self, spec, digests, baseline
+    ):
+        before = telemetry.counter("faults.worker_restarts").value
+        with faults.armed("admission.build:error=WorkerDeath,nth=1"):
+            eng = Engine(cfg(), auto=False, pack=False)
+            job = eng.submit(spec, LEET, LONG_WORDS, digests)
+            eng.run_until_idle()
+            res = job.result(timeout=0)
+        assert telemetry.counter(
+            "faults.worker_restarts"
+        ).value == before + 1
+        assert full_hits(res) == full_hits(baseline)
+
+    def test_chunk_compile_fault_restarts_worker_once(self, spec,
+                                                      digests, baseline):
+        c = cfg(stream_chunk_words=5)
+        want = Sweep(spec, LEET, LONG_WORDS, digests, config=c).run_crack()
+        assert want.stream["chunks_swept"] == 4
+        assert full_hits(want) == full_hits(baseline)
+        before = telemetry.counter("faults.worker_restarts").value
+        with faults.armed("chunk.compile:nth=2"):
+            got = Sweep(spec, LEET, LONG_WORDS, digests,
+                        config=c).run_crack()
+        assert telemetry.counter(
+            "faults.worker_restarts"
+        ).value == before + 1
+        assert full_hits(got) == full_hits(want)
+        assert got.n_emitted == want.n_emitted
+        assert got.stream["chunks_swept"] == 4
+
+
+# ---------------------------------------------------------------------------
+# Fault matrix: checkpoint.write, device.init, serve.client
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointFaults:
+    def test_periodic_write_failure_is_survived(self, spec, digests,
+                                                baseline, tmp_path):
+        path = str(tmp_path / "ck.json")
+        before = telemetry.counter("faults.checkpoint_errors").value
+        with faults.armed("checkpoint.write:nth=2"):
+            got = Sweep(
+                spec, LEET, LONG_WORDS, digests,
+                config=cfg(checkpoint_path=path, checkpoint_every_s=0.0),
+            ).run_crack()
+        assert full_hits(got) == full_hits(baseline)
+        assert telemetry.counter(
+            "faults.checkpoint_errors"
+        ).value == before + 1
+        # The final forced save landed and loads clean.
+        probe = Sweep(spec, LEET, LONG_WORDS, digests, config=cfg())
+        state = load_checkpoint(path, probe.fingerprint)
+        assert state is not None
+        assert state.cursor.word == len(LONG_WORDS)
+        assert state.n_hits == baseline.n_hits
+
+    def test_device_init_fault_survived_by_cli_retry_layer(
+        self, spec, digests, baseline
+    ):
+        """device.init's documented recovery is the rebuild-and-resume
+        layer above the sweep: the CLI's --retries supervisor
+        (_run_with_retries) — exercised here directly on the real
+        function."""
+        from hashcat_a5_table_generator_tpu.cli import _run_with_retries
+
+        with faults.armed("device.init:nth=1") as plan:
+            res = _run_with_retries(
+                lambda resume: Sweep(
+                    spec, LEET, LONG_WORDS, digests, config=cfg()
+                ).run_crack(resume=resume),
+                retries=1, default_resume=True, label="crack sweep",
+            )
+        assert plan.fired == [("device.init", 1)]
+        assert full_hits(res) == full_hits(baseline)
+        assert res.n_emitted == baseline.n_emitted
+
+
+class TestServeClientFault:
+    def test_client_fault_is_protocol_scoped(self):
+        with faults.armed("serve.client:nth=1"):
+            eng = Engine(cfg(), auto=False)
+            fin = io.StringIO(
+                json.dumps({"op": "stats"}) + "\n"
+                + json.dumps({"op": "stats"}) + "\n"
+                + json.dumps({"op": "shutdown"}) + "\n"
+            )
+            fout = io.StringIO()
+            serve_stdio(eng, fin, fout)
+            eng.close()
+        events = [json.loads(l) for l in fout.getvalue().splitlines()]
+        assert [e.get("event") for e in events] == [
+            "error", "stats", "bye"
+        ]
+        assert "FaultInjected" in events[0]["error"]
+
+
+class TestClientTimeout:
+    def test_idle_client_dropped_engine_keeps_serving(self, tmp_path):
+        eng = Engine(cfg(), auto=True)
+        path = str(tmp_path / "serve.sock")
+        ready = threading.Event()
+        t = threading.Thread(
+            target=serve_socket, args=(eng, path),
+            kwargs=dict(client_timeout=0.3, ready=ready.set),
+            daemon=True,
+        )
+        t.start()
+        assert ready.wait(10)
+        idle = socket.socket(socket.AF_UNIX)
+        idle.connect(path)
+        t0 = time.monotonic()
+        assert idle.recv(4096) == b""  # server closed the idle session
+        assert time.monotonic() - t0 < 5.0
+        idle.close()
+        # The engine (and the listener) survived the drop.
+        live = socket.socket(socket.AF_UNIX)
+        live.connect(path)
+        f = live.makefile("rw")
+        f.write(json.dumps({"op": "stats"}) + "\n")
+        f.flush()
+        assert json.loads(f.readline())["event"] == "stats"
+        f.write(json.dumps({"op": "shutdown"}) + "\n")
+        f.flush()
+        assert json.loads(f.readline())["event"] == "bye"
+        live.close()
+        t.join(10)
+        eng.close()
+
+    def test_reconnecting_client_adopts_dropped_sessions_jobs(
+        self, tmp_path, spec, digests
+    ):
+        """The --client-timeout contract's second half (PERF.md §23):
+        the socket server's job registry is shared across connections,
+        so a client dropped mid-job reconnects and controls the job by
+        id — here pausing it and receiving the checkpoint on the NEW
+        session."""
+        eng = Engine(cfg(), auto=True)
+        path = str(tmp_path / "serve.sock")
+        ready = threading.Event()
+        t = threading.Thread(
+            target=serve_socket, args=(eng, path),
+            kwargs=dict(ready=ready.set), daemon=True,
+        )
+        t.start()
+        assert ready.wait(10)
+        c1 = socket.socket(socket.AF_UNIX)
+        c1.connect(path)
+        f1 = c1.makefile("rw")
+        f1.write(json.dumps({
+            "op": "submit", "id": "adopt-me",
+            "table_map": {
+                k.decode(): [v.decode() for v in vals]
+                for k, vals in LEET.items()
+            },
+            "words": [w.decode() for w in LONG_WORDS],
+            "digest_list": [d.hex() for d in digests],
+        }) + "\n")
+        f1.flush()
+        assert json.loads(f1.readline())["event"] == "accepted"
+        c1.close()  # the client "dies" mid-job
+        c2 = socket.socket(socket.AF_UNIX)
+        c2.connect(path)
+        f2 = c2.makefile("rw")
+        f2.write(json.dumps({"op": "pause", "id": "adopt-me"}) + "\n")
+        f2.flush()
+        ev = json.loads(f2.readline())
+        # Raced completion is legal (tiny job); either way the NEW
+        # session got the settling event for the adopted job.
+        assert ev["id"] == "adopt-me"
+        assert ev["event"] in ("paused", "done")
+        if ev["event"] == "paused":
+            assert ev["checkpoint"]["fingerprint"]
+        f2.write(json.dumps({"op": "shutdown"}) + "\n")
+        f2.flush()
+        assert json.loads(f2.readline())["event"] == "bye"
+        c2.close()
+        t.join(10)
+        eng.close()
+
+
+# ---------------------------------------------------------------------------
+# Corrupt checkpoints (typed errors) + atomic writes
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointCorruption:
+    def test_truncated_json_raises_typed(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        with open(path, "w") as fh:
+            fh.write('{"fingerprint": "abc", "cursor"')
+        with pytest.raises(CheckpointCorrupt) as exc:
+            load_checkpoint(path, "abc")
+        assert path in str(exc.value)
+        assert "truncated" in str(exc.value)
+
+    def test_schema_breakage_raises_typed(self, tmp_path):
+        path = str(tmp_path / "ck.json")
+        from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+            FORMAT_VERSION,
+        )
+
+        with open(path, "w") as fh:
+            json.dump({"fingerprint": "abc", "version": FORMAT_VERSION,
+                       "cursor": {"word": "NaN-ish"}}, fh)
+        with pytest.raises(CheckpointCorrupt, match="field parse"):
+            load_checkpoint(path, "abc")
+
+    def test_corrupt_manifest_raises_typed(self, tmp_path):
+        path = str(tmp_path / "manifest.json")
+        with open(path, "w") as fh:
+            fh.write("not json at all")
+        with pytest.raises(CheckpointCorrupt):
+            check_bucket_manifest(path, {16: "fp"})
+
+    def test_corrupt_is_a_value_error(self):
+        # The CLI's existing ValueError surface still catches it; the
+        # dedicated hint branch must come first.
+        assert issubclass(CheckpointCorrupt, ValueError)
+
+    def test_cli_prints_remediation_hint(self, tmp_path, spec, digests):
+        from hashcat_a5_table_generator_tpu import cli
+
+        d = tmp_path
+        (d / "dict.txt").write_bytes(b"\n".join(LONG_WORDS) + b"\n")
+        (d / "leet.table").write_bytes(
+            b"a=4\na=@\no=0\ns=$\ns=5\ne=3\n"
+        )
+        (d / "left.txt").write_bytes(
+            b"\n".join(dg.hex().encode() for dg in digests) + b"\n"
+        )
+        ck = d / "ck.json"
+        ck.write_text('{"torn":')
+        with pytest.raises(SystemExit) as exc:
+            cli.main([
+                str(d / "dict.txt"), "-t", str(d / "leet.table"),
+                "--backend", "device", "--digests", str(d / "left.txt"),
+                "--buckets", "none", "--lanes", "64", "--blocks", "16",
+                "--checkpoint", str(ck),
+            ])
+        msg = str(exc.value)
+        assert "corrupt" in msg and "remediation" in msg
+        assert "--no-resume" in msg
+
+    def test_atomic_write_replaces_and_survives(self, tmp_path):
+        path = str(tmp_path / "out.json")
+        atomic_write_text(path, '{"v": 1}')
+        atomic_write_text(path, '{"v": 2}')
+        with open(path) as fh:
+            assert json.load(fh) == {"v": 2}
+        # No tmp litter left behind.
+        assert os.listdir(str(tmp_path)) == ["out.json"]
+
+    def test_manifest_roundtrip_via_atomic_writer(self, tmp_path):
+        path = str(tmp_path / "manifest.json")
+        save_bucket_manifest(path, {16: "fp16", 32: "fp32"})
+        assert check_bucket_manifest(path, {16: "fp16", 32: "fp32"})
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL crash-recovery soak (slow tier)
+# ---------------------------------------------------------------------------
+
+
+_SERVE_DRIVER = (
+    "import sys\n"
+    "import jax\n"
+    "jax.config.update('jax_platforms', 'cpu')\n"
+    "from hashcat_a5_table_generator_tpu.cli import main\n"
+    "sys.exit(main(sys.argv[1:]))"
+)
+
+
+def _connect(path, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            s = socket.socket(socket.AF_UNIX)
+            s.connect(path)
+            return s
+        except OSError:
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.1)
+
+
+@pytest.mark.slow
+class TestCrashRecoverySoak:
+    def test_sigkill_restart_resubmit_byte_parity(self, tmp_path, spec,
+                                                  digests, baseline):
+        """Drive ``a5gen serve`` over the unix socket, SIGKILL it at a
+        fault-chosen superstep boundary, restart a fresh engine,
+        resubmit from the on-disk checkpoint, and assert the recovered
+        run's hit stream reproduces the uninterrupted run byte-exactly
+        (with run 1's delivered hits a prefix of it)."""
+        sock = str(tmp_path / "serve.sock")
+        ck = str(tmp_path / "job.ck.json")
+        job_doc = {
+            "op": "submit", "id": "soak",
+            "table_map": {
+                k.decode(): [v.decode() for v in vals]
+                for k, vals in LEET.items()
+            },
+            "words": [w.decode() for w in LONG_WORDS],
+            "digest_list": [d.hex() for d in digests],
+            "config": {"checkpoint_path": ck, "checkpoint_every_s": 0.0},
+        }
+        serve_argv = ["serve", "--socket", sock, "--lanes", "64",
+                      "--blocks", "16", "--superstep", "1"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["A5GEN_FAULTS"] = "superstep.fetch:kill,nth=3"
+
+        p1 = subprocess.Popen(
+            [sys.executable, "-c", _SERVE_DRIVER, *serve_argv],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        run1_hits = []
+        try:
+            c1 = _connect(sock, timeout=120.0)
+            f1 = c1.makefile("rw")
+            f1.write(json.dumps(job_doc) + "\n")
+            f1.flush()
+            assert json.loads(f1.readline())["event"] == "accepted"
+            for line in f1:  # EOF when the process is SIGKILLed
+                ev = json.loads(line)
+                if ev.get("event") == "hit":
+                    run1_hits.append(
+                        (ev["word_index"], int(ev["rank"]),
+                         ev["plain_hex"], ev["digest"])
+                    )
+                elif ev.get("event") == "done":
+                    pytest.fail("fault did not kill the engine mid-sweep")
+            c1.close()
+            assert p1.wait(timeout=60) == -9  # SIGKILL, not a clean exit
+        finally:
+            if p1.poll() is None:
+                p1.kill()
+                p1.wait()
+
+        # The lagged-boundary checkpoint is on disk and intact.
+        probe = Sweep(spec, LEET, LONG_WORDS, digests, config=cfg())
+        state = load_checkpoint(ck, probe.fingerprint)
+        assert state is not None
+        assert 0 < state.cursor.word <= len(LONG_WORDS)
+
+        env2 = dict(env)
+        env2.pop("A5GEN_FAULTS")
+        p2 = subprocess.Popen(
+            [sys.executable, "-c", _SERVE_DRIVER, *serve_argv],
+            env=env2, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        run2_hits = []
+        try:
+            c2 = _connect(sock, timeout=120.0)
+            f2 = c2.makefile("rw")
+            resub = dict(job_doc)
+            resub["checkpoint"] = state_to_doc(state)
+            f2.write(json.dumps(resub) + "\n")
+            f2.flush()
+            assert json.loads(f2.readline())["event"] == "accepted"
+            done = None
+            for line in f2:
+                ev = json.loads(line)
+                if ev.get("event") == "hit":
+                    run2_hits.append(
+                        (ev["word_index"], int(ev["rank"]),
+                         ev["plain_hex"], ev["digest"])
+                    )
+                elif ev.get("event") == "done":
+                    done = ev
+                    break
+            assert done is not None and done["resumed"]
+            f2.write(json.dumps({"op": "shutdown"}) + "\n")
+            f2.flush()
+            p2.wait(timeout=60)
+        finally:
+            if p2.poll() is None:
+                p2.kill()
+                p2.wait()
+
+        want = [
+            (h.word_index, h.variant_rank, h.candidate.hex(),
+             h.digest_hex)
+            for h in baseline.hits
+        ]
+        # Byte parity: the recovered run (checkpoint replay + the
+        # resumed sweep) reproduces the uninterrupted hit stream
+        # exactly, and run 1's delivered hits are a prefix of it — the
+        # kill-at-a-fetch-boundary + checkpoint-every-boundary choice
+        # makes the concatenated (deduplicated) stream equal run 2's.
+        assert run2_hits == want
+        assert run1_hits == want[: len(run1_hits)]
+        assert done["n_hits"] == baseline.n_hits
+        assert done["n_emitted"] == baseline.n_emitted

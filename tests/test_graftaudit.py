@@ -246,12 +246,105 @@ class TestDriveFetch:
         findings = audit_drive_loop(m.synced_drive, "fixture.sync")
         assert any("block_until_ready" in f.message for f in findings)
 
+    def test_clean_recovering_drive_passes(self):
+        """The fault-supervision try (PERF.md §23) wraps the fill loop
+        and the one counters fetch; the audit must keep seeing exactly
+        one unconditional fetch through it."""
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        assert audit_drive_loop(
+            mod.clean_drive_recovering, "fixture.drive"
+        ) == []
+
+    def test_recovering_inflight_fetch_still_flagged(self):
+        """The Try must not HIDE the fill loop from the in-flight
+        tracking: a fetch through the deque inside the recovery try is
+        the same pipeline barrier it always was."""
+        from tools.graftaudit.transfers import audit_drive_loop
+
+        mod = _fixture("double_fetch")
+        findings = audit_drive_loop(
+            mod.broken_drive_recovering_inflight_fetch, "fixture.drive"
+        )
+        assert any("in-flight" in f.message for f in findings)
+
     def test_production_drive_loop_is_clean(self):
         from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
         from tools.graftaudit.transfers import audit_drive_loop
 
         assert audit_drive_loop(
             Sweep._drive_superstep, "runtime.Sweep._drive_superstep"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection hook shape (PERF.md §23)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultHooks:
+    def test_clean_guarded_hooks_pass(self):
+        from tools.graftaudit.faults import audit_fault_hooks
+
+        mod = _fixture("fault_hook")
+        assert audit_fault_hooks(mod.clean_drive_hooked, "fixture.fh") == []
+        assert audit_fault_hooks(
+            mod.clean_drive_hooked_recovering, "fixture.fh"
+        ) == []
+
+    def test_bare_hook_flagged(self):
+        from tools.graftaudit.faults import audit_fault_hooks
+
+        mod = _fixture("fault_hook")
+        findings = audit_fault_hooks(
+            mod.broken_drive_bare_hook, "fixture.fh"
+        )
+        assert len(findings) == 1
+        assert findings[0].check == "fault-hook"
+        assert "ACTIVE-is-not-None" in findings[0].message
+
+    def test_wrong_guard_flagged(self):
+        from tools.graftaudit.faults import audit_fault_hooks
+
+        mod = _fixture("fault_hook")
+        findings = audit_fault_hooks(
+            mod.broken_drive_wrong_guard, "fixture.fh"
+        )
+        assert [f.check for f in findings] == ["fault-hook"]
+
+    def test_production_hook_sites_are_clean(self):
+        from hashcat_a5_table_generator_tpu.ops.packing import (
+            ChunkCompiler,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+            save_checkpoint,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from hashcat_a5_table_generator_tpu.runtime.fuse import FusedGroup
+        from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
+        from tools.graftaudit.faults import audit_fault_hooks
+
+        for fn, name in (
+            (Sweep._drive_superstep, "Sweep._drive_superstep"),
+            (Sweep._dispatch_launch, "Sweep._dispatch_launch"),
+            (Sweep._make_launch, "Sweep._make_launch"),
+            (FusedGroup.pump, "FusedGroup.pump"),
+            (Engine._build_slot, "Engine._build_slot"),
+            (ChunkCompiler._timed, "ChunkCompiler._timed"),
+            (save_checkpoint, "save_checkpoint"),
+        ):
+            assert audit_fault_hooks(fn, name) == [], name
+
+    def test_production_pump_is_clean_for_pack_round(self):
+        """The pump's fault-supervision restructure (PERF.md §23) must
+        keep the packed-round discipline: one dispatch site, one
+        unconditional fetch."""
+        from hashcat_a5_table_generator_tpu.runtime.fuse import FusedGroup
+        from tools.graftaudit.transfers import audit_pack_round
+
+        assert audit_pack_round(
+            FusedGroup.pump, "runtime.fuse.FusedGroup.pump"
         ) == []
 
 

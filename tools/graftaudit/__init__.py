@@ -37,6 +37,7 @@ from .budgets import (
     save_budgets,
 )
 from .counter import count_kernel_ops, count_traced_kernel, kernel_jaxpr_of
+from .faults import audit_fault_hooks
 from .findings import CHECKS, AuditFinding
 from .purity import audit_float_purity, audit_float_purity_jaxpr
 from .stages import (
@@ -58,6 +59,7 @@ __all__ = [
     "DEFAULT_BUDGETS_PATH",
     "STAGE_MARKERS",
     "TRANSFER_PRIMITIVES",
+    "audit_fault_hooks",
     "audit_float_purity",
     "audit_float_purity_jaxpr",
     "audit_host_transfers",

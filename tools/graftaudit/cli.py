@@ -27,7 +27,7 @@ from typing import List, Optional, Sequence
 
 #: Check-group names accepted by ``--select``.
 CHECK_GROUPS = ("budgets", "stages", "purity", "transfers", "pallas",
-                "telemetry")
+                "telemetry", "faults")
 
 
 def _build_parser() -> argparse.ArgumentParser:
@@ -371,6 +371,31 @@ def run_audit(
                     )
                     continue
                 findings.extend(audit_stage_text(text, name, stages))
+
+    # -- fault hooks: injection seams keep the no-op-guarded shape ---------
+    if "faults" in groups:
+        from hashcat_a5_table_generator_tpu.ops.packing import (
+            ChunkCompiler,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+            save_checkpoint,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from hashcat_a5_table_generator_tpu.runtime.fuse import FusedGroup
+        from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
+
+        from .faults import audit_fault_hooks
+
+        for fn, name in (
+            (Sweep._drive_superstep, "runtime.Sweep._drive_superstep"),
+            (Sweep._dispatch_launch, "runtime.Sweep._dispatch_launch"),
+            (Sweep._make_launch, "runtime.Sweep._make_launch"),
+            (FusedGroup.pump, "runtime.fuse.FusedGroup.pump"),
+            (Engine._build_slot, "runtime.Engine._build_slot"),
+            (ChunkCompiler._timed, "ops.packing.ChunkCompiler._timed"),
+            (save_checkpoint, "runtime.checkpoint.save_checkpoint"),
+        ):
+            findings.extend(audit_fault_hooks(fn, name))
 
     # -- telemetry placement: registry/timeline calls off the hot path ----
     if "telemetry" in groups:

@@ -1,0 +1,106 @@
+"""Check: fault-injection hooks stay no-op-guarded (PERF.md §23).
+
+The fault layer's production-cost contract is ONE module-attribute
+``None`` check per seam::
+
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("superstep.dispatch")
+
+A bare ``fire(...)`` call — or one guarded by anything other than the
+``ACTIVE is not None`` test — runs rule matching (a lock, a dict
+lookup, an RNG draw) on every arrival, and the seams sit in the drive
+loops' dispatch fill windows, where host work between dispatches
+narrows the pipeline overlap the §18 instrument exists to protect.
+``audit_fault_hooks`` statically walks a drive/pump function and flags
+every ``fire`` call site that is not (transitively) inside an ``if``
+whose test is an ``is not None`` comparison mentioning ``ACTIVE``.
+"""
+
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import List
+
+from .findings import AuditFinding
+
+
+def _dotted_parts(node: ast.AST) -> List[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return parts
+
+
+def _is_fire_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id == "fire"
+    return isinstance(f, ast.Attribute) and f.attr == "fire"
+
+
+def _is_active_guard(test: ast.AST) -> bool:
+    """``<...>.ACTIVE is not None`` (any module spelling on the left)."""
+    if not isinstance(test, ast.Compare) or len(test.ops) != 1:
+        return False
+    if not isinstance(test.ops[0], ast.IsNot):
+        return False
+    comp = test.comparators[0]
+    if not (isinstance(comp, ast.Constant) and comp.value is None):
+        return False
+    return "ACTIVE" in _dotted_parts(test.left)
+
+
+def audit_fault_hooks(fn, entry: str) -> List[AuditFinding]:
+    """Flag every ``fire(...)`` call in ``fn`` not guarded by the
+    sanctioned ``ACTIVE is not None`` test — the no-op-guarded shape
+    the hot path requires (PERF.md §23)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"source unavailable for fault-hook audit: {exc}",
+            )
+        ]
+    findings: List[AuditFinding] = []
+
+    def flag_if_bare(node: ast.AST, guarded: bool) -> None:
+        if _is_fire_call(node) and not guarded:
+            findings.append(
+                AuditFinding(
+                    "fault-hook", entry,
+                    "fault-injection fire() without the ACTIVE-is-not-"
+                    "None guard — the production no-op contract is ONE "
+                    "attribute check per seam; a bare hook runs rule "
+                    "matching in the drive loop's dispatch window "
+                    "(PERF.md §23)",
+                )
+            )
+
+    def walk(node: ast.AST, guarded: bool) -> None:
+        if isinstance(node, ast.If):
+            inner = guarded or _is_active_guard(node.test)
+            for sub in ast.walk(node.test):
+                flag_if_bare(sub, guarded)  # the test runs pre-guard
+            for child in node.body:
+                walk(child, inner)
+            for child in node.orelse:
+                walk(child, guarded)
+            return
+        flag_if_bare(node, guarded)
+        for sub in ast.iter_child_nodes(node):
+            # If statements recurse above; every other child keeps the
+            # current guard state.
+            walk(sub, guarded)
+
+    walk(tree, False)
+    return findings

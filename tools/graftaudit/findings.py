@@ -16,6 +16,7 @@ CHECKS = (
     "float-leak",    # float convert_element_type in the integer pipeline
     "host-transfer", # device->host callback inside a compiled body
     "drive-fetch",   # superstep drive loop breaks fetch discipline (§18)
+    "fault-hook",    # fault-injection fire() missing the no-op guard (§23)
     "pallas-bounds", # pl.load/pl.store outside the BlockSpec block
     "pallas-race",   # two grid steps write the same output block
     "config",        # registry/harness/budgets-file disagreement

@@ -153,9 +153,12 @@ def _audit_tree(tree: ast.AST, entry: str) -> List[AuditFinding]:
         None,
     )
     if outer is not None:
-        inner = next(
-            (n for n in outer.body if isinstance(n, ast.While)), None
-        )
+        # The fill loop may sit inside the fault-supervision try
+        # (PERF.md §23) — keep finding it there, like the drive-fetch
+        # audit's _first_nested_while.
+        from .transfers import _first_nested_while
+
+        inner = _first_nested_while(outer.body)
         if inner is not None:
             for sub in ast.walk(inner):
                 if _is_telemetry_call(sub):
