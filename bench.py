@@ -42,6 +42,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -254,6 +255,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default 4 — the underfilled-N the §22 "
                          "acceptance criterion is stated at; must "
                          "divide --blocks)")
+    ap.add_argument("--pair-ab", action="store_true",
+                    help="measure the pair-lane tier (K=2 candidates "
+                         "per hash lane, PERF.md §24) against K=1 on "
+                         "the production superstep crack contract: "
+                         "identical plan/schema/geometry per arm, "
+                         "parity-asserted per-sweep emitted counts, "
+                         "per-arm hashes/s + the budget counter's "
+                         "ops/candidate + the fixture's eligibility "
+                         "share — one JSON line")
     ap.add_argument("--telemetry-ab", action="store_true",
                     help="measure the telemetry layer's wall overhead "
                          "(PERF.md §21) on the production crack "
@@ -1263,6 +1273,213 @@ def run_pack_ab(args: argparse.Namespace) -> None:
 # --------------------------------------------------------- stride/emit A/B --
 
 
+def run_pair_ab(args: argparse.Namespace) -> None:
+    """A/B the pair-lane tier (K=2 candidates per hash lane, PERF.md
+    §24) against K=1 on the production superstep crack contract.  Both
+    arms run the SAME plan, piece schema, digest set, and launch
+    geometry (``--lanes`` lanes × ``--blocks`` blocks × 16 steps)
+    through ONE compiled superstep program each; they differ ONLY in
+    the candidates-per-lane multiplier — the pair arm's blocks cover
+    2× the candidate ranks, so a full sweep takes half the dispatches.
+    Parity is enforced: both arms must emit the IDENTICAL candidate
+    count per full sweep, or the bench exits nonzero.  The record
+    carries per-arm hashes/s, the budget counter's ops/candidate at the
+    pinned stride-128 geometry (KERNEL_BUDGETS cross-ref), and the
+    fixture's pair-eligibility share."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    import numpy as np
+
+    from hashcat_a5_table_generator_tpu.models.attack import (
+        block_arrays,
+        digest_arrays,
+        make_superstep_step,
+        piece_arrays,
+        plan_arrays,
+        superstep_arrays,
+        superstep_buffers,
+        table_arrays,
+    )
+    from hashcat_a5_table_generator_tpu.ops.blocks import (
+        make_blocks,
+        superstep_index,
+    )
+    from hashcat_a5_table_generator_tpu.ops.packing import piece_schema_for
+    from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+        _G as pallas_g,
+        fused_expand_md5,
+        fused_expand_suball_md5,
+        k_opts_for,
+        k_vals_for,
+        pair_for_config,
+        scalar_units_for,
+    )
+    from tools.graftaudit.counter import count_traced_kernel
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    steps = 16
+    if lanes % nb:
+        raise SystemExit("--pair-ab needs blocks dividing lanes")
+    stride = lanes // nb
+    hit_cap = 256
+
+    spec, ct, plan, ds = _ab_crack_plan(args)
+    pieces = piece_schema_for(plan, ct)
+    pair_k = pair_for_config(spec, plan, pieces, block_stride=stride)
+    if pair_k is None:
+        raise SystemExit(
+            "--pair-ab: the fixture plan is not pair-eligible "
+            "(schema gate / hash-block count) — nothing to measure"
+        )
+    radix2 = k_opts_for(plan) == 1
+    scalar_units = scalar_units_for(plan)
+    p0 = plan_arrays(plan)
+    p = dict(p0)
+    p.update(piece_arrays(pieces))
+    t = table_arrays(ct)
+    d = digest_arrays(ds)
+    # Device-launched candidate share of the whole variant space — the
+    # pair tier covers exactly the device-swept candidates, so this IS
+    # the eligibility share of the fixture when the gate passes.
+    total_vars = sum(plan.n_variants)
+    launched_vars = sum(
+        t_ for t_, fb in zip(plan.n_variants, plan.fallback) if not fb
+    )
+    eligibility_share = launched_vars / max(total_vars, 1)
+
+    def arm(pairk: "int | None") -> dict:
+        rank_stride = stride * (pairk or 1)
+        idx = superstep_index(plan, rank_stride)
+        if idx is None:
+            raise SystemExit("--pair-ab: plan not superstep-eligible")
+        total_blocks = idx[2]
+        sstep = make_superstep_step(
+            spec, num_lanes=lanes, num_blocks=nb,
+            out_width=plan.out_width, block_stride=stride, steps=steps,
+            hit_cap=hit_cap, total_blocks=total_blocks,
+            windowed=bool(getattr(plan, "windowed", False)),
+            radix2=radix2, pieces=pieces, pair_k=pairk,
+        )
+        ss = superstep_arrays(plan, rank_stride, idx=idx)
+        n_super = max(1, -(-total_blocks // (steps * nb)))
+        bufs = superstep_buffers(hit_cap)
+        out = sstep(p, t, d, ss, np.int32(0), bufs)  # warm compile
+        int(out["n_emitted"])
+        bufs = {"hit_word": out["hit_word"], "hit_rank": out["hit_rank"]}
+        hashed, launches, sweeps = 0, 0, 0
+        per_sweep = None
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < args.seconds or sweeps == 0:
+            total = 0
+            for si in range(n_super):
+                out = sstep(p, t, d, ss, np.int32(si * steps * nb), bufs)
+                total += int(out["n_emitted"])  # completion barrier
+                bufs = {"hit_word": out["hit_word"],
+                        "hit_rank": out["hit_rank"]}
+                launches += steps
+            hashed += total
+            sweeps += 1
+            if per_sweep is None:
+                per_sweep = total
+        wall = time.perf_counter() - t0
+        return {
+            "hashes_per_sec": hashed / wall,
+            "emitted_per_sweep": per_sweep,
+            "dispatches_per_sweep": n_super,
+            "launches": launches,
+            "sweeps": sweeps,
+            "wall_s": round(wall, 3),
+        }
+
+    def kernel_ops(pairk: "int | None") -> "float | None":
+        """ops/candidate at the PINNED budget geometry (stride 128 ×
+        16 blocks), interpret-mode trace — device-independent, directly
+        comparable to KERNEL_BUDGETS.json."""
+        cstride = 128
+        cnb = max(pallas_g, 16)
+        rank_stride = cstride * (pairk or 1)
+        batch, _, _ = make_blocks(
+            plan, start_word=0, start_rank=0,
+            max_variants=cnb * rank_stride, max_blocks=cnb,
+            fixed_stride=rank_stride,
+        )
+        b = block_arrays(batch, num_blocks=cnb)
+        common = dict(
+            num_lanes=cnb * cstride, out_width=int(plan.out_width),
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute, block_stride=cstride,
+            k_opts=k_vals_for(plan), algo=spec.algo, interpret=True,
+            scalar_units=scalar_units, pieces=pieces,
+            pair=pairk is not None,
+        )
+        try:
+            if spec.mode in ("default", "reverse"):
+                fn = lambda: fused_expand_md5(  # noqa: E731
+                    p0["tokens"], p0["lengths"], p0["match_pos"],
+                    p0["match_len"], p0["match_radix"],
+                    p0["match_val_start"], t["val_bytes"], t["val_len"],
+                    b["word"], b["base"], b["count"], **common,
+                )
+            else:
+                fn = lambda: fused_expand_suball_md5(  # noqa: E731
+                    p0["tokens"], p0["lengths"], p0["pat_radix"],
+                    p0["pat_val_start"], p0["seg_orig_start"],
+                    p0["seg_orig_len"], p0["seg_pat"],
+                    p0.get("cval_bytes", t["val_bytes"]),
+                    p0.get("cval_len", t["val_len"]),
+                    b["word"], b["base"], b["count"], **common,
+                )
+            ops, _ = count_traced_kernel(
+                fn, pallas_g, cstride * (2 if pairk else 1)
+            )
+            return round(ops, 1)
+        except Exception as e:  # pragma: no cover - config-dependent
+            print(f"# [pair-ab] op count failed (pair={pairk}): {e}",
+                  file=sys.stderr)
+            return None
+
+    solo = arm(None)
+    pair = arm(pair_k)
+    if solo["emitted_per_sweep"] != pair["emitted_per_sweep"]:
+        raise SystemExit(
+            f"--pair-ab parity violation: solo swept "
+            f"{solo['emitted_per_sweep']} candidates, pair "
+            f"{pair['emitted_per_sweep']} — the tiers must emit the "
+            "identical stream"
+        )
+    solo["ops_per_candidate"] = kernel_ops(None)
+    pair["ops_per_candidate"] = kernel_ops(pair_k)
+    record = {
+        "metric": "pair_lane_ab",
+        "unit": "hashes/sec + ops/candidate",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "algo": args.algo,
+        "mode": args.mode,
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "pair_k": pair_k,
+        "eligibility_share": round(eligibility_share, 4),
+        "solo": solo,
+        "pair": pair,
+        "speedup": pair["hashes_per_sec"] / max(solo["hashes_per_sec"],
+                                                1e-12),
+        "ops_ratio": (
+            pair["ops_per_candidate"] / solo["ops_per_candidate"]
+            if pair["ops_per_candidate"] and solo["ops_per_candidate"]
+            else None
+        ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
 def run_stride_ab(args: argparse.Namespace) -> None:
     """A/B block stride 128 vs 256 x emission scheme perslot vs bytescan
     (PERF.md §7a ranked lever 2 / §17) on the production crack-step
@@ -1827,6 +2044,18 @@ def run_worker(args: argparse.Namespace) -> None:
 # ----------------------------------------------------------- orchestrator --
 
 
+#: Stderr signatures of a device-init-class transient that fired AFTER
+#: the backend handshake (the ``device.init`` fault seam, a tunnel drop
+#: during Sweep construction): the orchestrator treats these as
+#: retryable attempts inside ``--init-retry-budget``, exactly like a
+#: pre-init wedge (PERF.md §23).
+_DEVICE_INIT_RE = re.compile(
+    r"device\.init|Unable to initialize backend|"
+    r"failed to connect to.*tpu|DEADLINE_EXCEEDED.*initialize",
+    re.IGNORECASE,
+)
+
+
 def _attempt(argv: list[str], env: dict, init_grace: float, run_grace: float,
              max_total: float):
     """Run one worker subprocess under a dynamic deadline.
@@ -1974,7 +2203,16 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         record, tail, rc, init_ok, wall_s = _attempt(
             argv, env, init_grace, run_grace, max_total=max_total,
         )
-        if not init_ok:
+        attempts[0] += 1
+        # A ``device.init``-class failure AFTER backend init (the
+        # PERF.md §23 seam: Sweep construction flakes, tunnel drops
+        # mid-handshake) is the same transient as a pre-init wedge —
+        # it counts toward the SAME init-retry budget and the loop
+        # retries it as an attempt, never emits it as a dead record.
+        init_flake = not init_ok or (
+            record is None and _DEVICE_INIT_RE.search(tail) is not None
+        )
+        if init_flake:
             init_wait[0] += wall_s
             # The r01-r05 init-flake pattern as a queryable registry
             # signal (PERF.md §23), not just buried failed_attempts
@@ -1988,6 +2226,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
             record["attempt"] = name
             return record
         failures.append({"attempt": name, "rc": rc, "init_ok": init_ok,
+                         "init_flake": bool(init_flake),
                          "wall_s": round(wall_s, 1),
                          "stderr_tail": tail[-600:]})
         return None
@@ -2003,9 +2242,12 @@ def run_orchestrator(args: argparse.Namespace) -> None:
     def emit(record):
         # Registry-derived init-flake summary on the emitted record:
         # the counters are the queryable signal, these fields make the
-        # artifact self-describing (PERF.md §23).
+        # artifact self-describing (PERF.md §23).  ``attempts`` makes a
+        # flaky session diagnosable from the record alone: how many
+        # subprocesses it took to land this number.
         from hashcat_a5_table_generator_tpu.runtime import telemetry
 
+        record["attempts"] = attempts[0]
         retries = int(telemetry.counter("bench.init_retries").value)
         if retries:
             record["init_retries"] = retries
@@ -2063,6 +2305,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         return merged
 
     failures = []
+    attempts = [0]  # total subprocess attempts (emitted per record)
     init_wait = [0.0]  # cumulative wall burnt on attempts that never init'd
     tried_tpu_plugin = False
     backoff = 10.0
@@ -2132,7 +2375,7 @@ def main() -> None:
             2048
             if (args.superstep_ab or args.stride_ab or args.pipeline_ab
                 or args.stream_ab or args.serve_ab or args.telemetry_ab
-                or args.pack_ab)
+                or args.pack_ab or args.pair_ab)
             else (1 << 22)
         )
     if args.words is None:
@@ -2147,7 +2390,11 @@ def main() -> None:
         args.words = (
             1000 if args.serve_ab else 24 if args.pack_ab else 50000
         )
-    if args.pack_ab:
+    if args.pair_ab:
+        # Pair-lane tier A/B (PERF.md §24); runs on the pinned (or
+        # default) platform in-process.
+        run_pair_ab(args)
+    elif args.pack_ab:
         # Cross-job packing A/B (PERF.md §22); runs on the pinned (or
         # default) platform in-process.
         run_pack_ab(args)

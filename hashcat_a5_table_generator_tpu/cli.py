@@ -163,6 +163,17 @@ def build_parser() -> argparse.ArgumentParser:
                          "pipeline (A5GEN_SUPERSTEP=off is the env "
                          "equivalent). The candidate/hit streams are "
                          "identical either way")
+    ap.add_argument("--pair", choices=("auto", "on", "off"),
+                    default="auto",
+                    help="crack mode: pair-lane tier — pack 2 candidates "
+                         "per hash lane where the substitution geometry "
+                         "allows (schema-compile decides eligibility; "
+                         "PERF.md §24). 'auto' (default) engages when "
+                         "eligible; 'on' additionally WARNS when the "
+                         "plan is ineligible and K=1 runs; 'off' keeps "
+                         "K=1 (A5GEN_PAIR=off is the env equivalent). "
+                         "The candidate/hit streams are identical "
+                         "either way")
     ap.add_argument("--stream-chunk-words", type=_stream_chunk_arg,
                     default="auto", metavar="N|auto|off",
                     help="device backend: compile the dictionary's plan "
@@ -678,10 +689,11 @@ def _print_superstep(res) -> None:
     s = getattr(res, "superstep", None) or {}
     if not s.get("supersteps"):
         return
+    pair = f", pair K={s['pair']}" if s.get("pair") else ""
     print(
         f"{PROG}: superstep: {s['supersteps']} supersteps x "
         f"{s.get('launches_per_fetch', 0)} launches/fetch "
-        f"({s.get('replays', 0)} overflow replays)",
+        f"({s.get('replays', 0)} overflow replays{pair})",
         file=sys.stderr,
     )
 
@@ -896,6 +908,7 @@ def _run_device(args, sub_map, packed) -> int:
         num_blocks=args.blocks,
         devices=args.devices,
         superstep=args.superstep,
+        pair={"auto": None, "on": "on", "off": 0}[args.pair],
         stream_chunk_words=args.stream_chunk_words,
         schema_cache=args.schema_cache,
         schema_cache_max_mb=args.schema_cache_max_mb,
@@ -1052,6 +1065,9 @@ def _build_serve_parser() -> argparse.ArgumentParser:
                     help="default device count per job")
     ap.add_argument("--superstep", type=_superstep_arg, default=None,
                     metavar="N|auto|off", help="default superstep knob")
+    ap.add_argument("--pair", choices=("auto", "on", "off"),
+                    default="auto", help="default pair-lane knob "
+                    "(PERF.md §24)")
     ap.add_argument("--stream-chunk-words", type=_stream_chunk_arg,
                     default="auto", metavar="N|auto|off",
                     help="default streaming-ingestion knob")
@@ -1109,6 +1125,7 @@ def _run_serve(argv: Sequence[str]) -> int:
         num_blocks=args.blocks,
         devices=args.devices,
         superstep=args.superstep,
+        pair={"auto": None, "on": "on", "off": 0}[args.pair],
         stream_chunk_words=args.stream_chunk_words,
         schema_cache=args.schema_cache,
         schema_cache_max_mb=args.schema_cache_max_mb,

@@ -167,7 +167,7 @@ def digest_arrays(ds: DigestSet) -> Dict[str, jnp.ndarray]:
 def _expand(
     spec: AttackSpec, plan: ArrayTree, table: ArrayTree, blocks: ArrayTree,
     *, num_lanes: int, out_width: int, block_stride: "int | None" = None,
-    radix2: bool = False, pieces=None,
+    radix2: bool = False, pieces=None, pair_k: "int | None" = None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Trace-time kernel dispatch; returns (cand, cand_len, word_row, emit).
 
@@ -176,6 +176,10 @@ def _expand(
     ``pieces`` (static): the plan's ``packing.PieceSchema`` — selects the
     per-slot piece splice (PERF.md §17); device tables ride the plan dict
     (``pp_*``, :func:`piece_arrays`).
+    ``pair_k`` (static): the pair-lane tier (K=2, PERF.md §24) — blocks
+    then cover ``pair_k * block_stride`` candidate ranks and every
+    returned array has ``pair_k * num_lanes`` candidate rows
+    (rank ``= pair_k * r + p``); gate via ``pallas_expand.pair_for``.
     """
     common = dict(
         num_lanes=num_lanes,
@@ -185,6 +189,7 @@ def _expand(
         block_stride=block_stride,
         radix2=radix2,
         pieces=pieces,
+        pair_k=pair_k,
         piece_tables=(
             {k[3:]: v for k, v in plan.items() if k.startswith("pp_")}
             or None
@@ -294,6 +299,7 @@ def make_fused_lane_body(
     radix2: bool = False,
     pieces=None,
     n_seg: int | None = None,
+    pair_k: int | None = None,
 ) -> Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]:
     """The lane-level fused expand->hash->match core.
 
@@ -312,6 +318,12 @@ def make_fused_lane_body(
     rows/bitmap/row_lo/row_hi).  Everything before membership is
     per-lane arithmetic over the packed plan rows, so segmentation
     changes nothing there.
+
+    ``pair_k`` (static): the pair-lane tier (PERF.md §24) — each lane
+    carries ``pair_k`` (= 2) consecutive candidate ranks, so the body's
+    hit/emit masks cover ``pair_k * num_lanes`` candidates (rank
+    ``= 2r + p`` at row ``2r + p``) and membership simply runs over the
+    doubled candidate axis.  Gate via ``pallas_expand.pair_for``.
     """
     from ..ops.pallas_md5 import maybe_pallas_hash_fn
 
@@ -335,6 +347,7 @@ def make_fused_lane_body(
                 max_substitute=spec.max_substitute,
                 block_stride=block_stride, k_opts=fused_expand_opts,
                 scalar_units=fused_scalar_units,
+                pair=pair_k is not None,
                 # su_*/pp_* entries (scalar_units_arrays/piece_arrays):
                 # word-level fields precomputed once per sweep; the
                 # wrapper preps by gathering.
@@ -371,7 +384,7 @@ def make_fused_lane_body(
         cand, cand_len, word_row, emit = _expand(
             spec, plan, table, blocks, num_lanes=num_lanes,
             out_width=out_width, block_stride=block_stride, radix2=radix2,
-            pieces=pieces,
+            pieces=pieces, pair_k=pair_k,
         )
         del word_row  # hit cursors are host-derived from lane indices
         return hash_fn(cand, cand_len), emit
@@ -381,6 +394,8 @@ def make_fused_lane_body(
             f"packed lane axis ({num_lanes}) must divide into n_seg "
             f"({n_seg}) equal segment spans"
         )
+    #: candidate rows per launch — the lane axis × the pair multiplier.
+    num_cands = num_lanes * (pair_k or 1)
 
     def lane_body(
         plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
@@ -394,8 +409,8 @@ def make_fused_lane_body(
             from ..ops.membership import digest_member_seg
 
             seg = (
-                jnp.arange(num_lanes, dtype=jnp.int32)
-                // jnp.int32(num_lanes // n_seg)
+                jnp.arange(num_cands, dtype=jnp.int32)
+                // jnp.int32(num_cands // n_seg)
             )
             member = digest_member_seg(
                 state, digests["rows"], digests["bitmap"],
@@ -416,7 +431,8 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
                     fused_expand_opts: int | None = None,
                     fused_scalar_units: bool = False,
                     radix2: bool = False,
-                    pieces=None) -> Callable[..., ArrayTree]:
+                    pieces=None,
+                    pair_k: int | None = None) -> Callable[..., ArrayTree]:
     """The un-jitted fused expand->hash->match body, shared by the
     single-device step and the shard_map'd step (which psums the counts).
 
@@ -444,7 +460,7 @@ def make_fused_body(spec: AttackSpec, *, num_lanes: int, out_width: int,
         spec, num_lanes=num_lanes, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
-        pieces=pieces,
+        pieces=pieces, pair_k=pair_k,
     )
 
     def body(
@@ -546,6 +562,7 @@ def make_superstep_body(
     windowed: bool = False, step_advance: "int | None" = None,
     fused_expand_opts: int | None = None, fused_scalar_units: bool = False,
     radix2: bool = False, pieces=None, n_seg: int | None = None,
+    pair_k: int | None = None,
 ) -> Callable[..., ArrayTree]:
     """The un-jitted superstep executor: ``steps`` fused
     expand->hash->membership launches in ONE device program, with the
@@ -611,15 +628,22 @@ def make_superstep_body(
         spec, num_lanes=num_lanes, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
-        pieces=pieces, n_seg=n_seg,
+        pieces=pieces, n_seg=n_seg, pair_k=pair_k,
     )
     stride = block_stride
+    # Pair-lane tier (PERF.md §24): a block's CANDIDATE rank span is
+    # ``pair_k`` × its lane span — every rank cursor below walks in
+    # rank_stride units while the launch geometry stays ``num_lanes``
+    # lanes (hit ranks come back as true candidate ranks ``2r + p``).
+    rank_stride = block_stride * (pair_k or 1)
     advance = int(step_advance or num_blocks)
     if n_seg is not None and num_blocks % n_seg:
         raise ValueError(
             f"packed dispatch needs num_blocks ({num_blocks}) divisible "
             f"by n_seg ({n_seg})"
         )
+    if pair_k is not None and windowed:
+        raise ValueError("the pair tier requires full enumeration")
 
     def cut_blocks(ss: ArrayTree, b0: jnp.ndarray):
         """One launch's blocks from the device-resident index: the exact
@@ -652,9 +676,9 @@ def make_superstep_body(
             valid = b < (jnp.int32(total_blocks) if tot is None else tot)
         else:
             valid = b < ss["seg_end"][seg_of_block]
-        rank0 = jnp.where(valid, (b - cum[w]) * jnp.int32(stride), 0)
+        rank0 = jnp.where(valid, (b - cum[w]) * jnp.int32(rank_stride), 0)
         count = jnp.where(
-            valid, jnp.clip(totals[w] - rank0, 0, stride), 0
+            valid, jnp.clip(totals[w] - rank0, 0, rank_stride), 0
         )
         p = ss["radix"].shape[1]
         if windowed:
@@ -684,9 +708,12 @@ def make_superstep_body(
         plan: ArrayTree, table: ArrayTree, digests: ArrayTree,
         ss: ArrayTree, b0: jnp.ndarray, bufs: ArrayTree,
     ) -> ArrayTree:
-        lane = jnp.arange(num_lanes, dtype=jnp.int32)
-        blk = lane // jnp.int32(stride)
-        lane_in = lane - blk * jnp.int32(stride)
+        # Candidate-row axis: lanes × pair multiplier; ``lane_in`` is
+        # the in-block CANDIDATE rank, so hit ranks are exact under the
+        # pair tier (rank = rank0 + 2r + p).
+        lane = jnp.arange(num_lanes * (pair_k or 1), dtype=jnp.int32)
+        blk = lane // jnp.int32(rank_stride)
+        lane_in = lane - blk * jnp.int32(rank_stride)
 
         def one(carry, _):
             b0c, ne, nh, hw, hr = carry

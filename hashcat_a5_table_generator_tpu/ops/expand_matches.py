@@ -598,6 +598,77 @@ def lane_fields(
     return rank, lane_ok, w, base, field
 
 
+def pair_lane_fields(
+    blk_word, blk_base, blk_count, *, num_lanes, block_stride
+):
+    """Lane → block resolution for the pair-lane tier (K=2 candidates
+    per lane, PERF.md §24): each lane owns the consecutive CANDIDATE
+    ranks ``2r`` and ``2r+1`` of its block, so blocks cover
+    ``2 * block_stride`` ranks on ``block_stride`` lanes and
+    ``blk_count`` counts CANDIDATES (up to ``2 * block_stride``).
+
+    Returns ``(rank int32[N], ok0 bool[N], ok1 bool[N], w int32[N],
+    base int32[N, P], field)`` — the per-lane PAIR rank ``r``,
+    per-member validity masks (``2r + p < count``), word row, base
+    digits, and the per-word field expander.  Fixed-stride only: the
+    pair tier is gated on the stride layout.
+    """
+    n = num_lanes
+    if block_stride is None:
+        raise ValueError("the pair-lane tier requires a fixed-stride "
+                         "block layout")
+    nb = n // block_stride
+    if nb * block_stride != n or blk_word.shape[0] != nb:
+        raise ValueError(
+            f"pair-lane launch needs num_lanes divisible by the stride "
+            f"and exactly {n} // {block_stride} = {nb} blocks, got "
+            f"{blk_word.shape[0]}"
+        )
+    per_lane = per_lane_broadcast(nb, block_stride)
+    v = jnp.arange(n, dtype=jnp.int32)
+    blk = v // np.int32(block_stride)
+    rank = v - blk * np.int32(block_stride)
+    count = per_lane(blk_count)
+    ok0 = rank * 2 < count
+    ok1 = rank * 2 + 1 < count
+    w = per_lane(blk_word)
+    base = per_lane(blk_base)
+    field = lambda x: per_lane(x[blk_word])  # noqa: E731
+    return rank, ok0, ok1, w, base, field
+
+
+def interleave_pairs(*arrays):
+    """Interleave per-member arrays along a new candidate axis:
+    ``(a0[N, ...], a1[N, ...]) -> a[2N, ...]`` with member ``p`` of lane
+    ``r`` at row ``2r + p`` — the pair tier's rank attribution
+    (PERF.md §24)."""
+    stacked = jnp.stack(arrays, axis=1)
+    return stacked.reshape((-1,) + stacked.shape[2:])
+
+
+def splice_pieces_pair(
+    schema, tables, field, digits, d0_partner, col_variant, *,
+    n, out_width,
+):
+    """Both pair members' candidate buffers via the shared
+    :func:`splice_pieces` walk: the partner's variant vector is the
+    base's with the innermost column's index replaced
+    (``d0_partner int32[N]``) — the schema's pair gate guarantees only
+    that one column differs.  Returns ``(out0 uint8[N, W], len0
+    int32[N], out1 uint8[N, W], len1 int32[N])``; XLA CSE
+    dedupes the shared selects between the two walks (the Pallas pair
+    kernel shares them structurally — this is the parity twin, not the
+    budget-pinned path)."""
+    out0, len0 = splice_pieces(
+        schema, tables, field, col_variant, n=n, out_width=out_width
+    )
+    cv1 = lambda c: d0_partner if c == 0 else col_variant(c)  # noqa: E731
+    out1, len1 = splice_pieces(
+        schema, tables, field, cv1, n=n, out_width=out_width
+    )
+    return out0, len0, out1, len1
+
+
 def piece_device_tables(pieces) -> dict:
     """Device copies of a :class:`ops.packing.PieceSchema`'s data tables
     for :func:`splice_pieces`: ``pl`` uint8 [B, NGD, V] dynamic-group
@@ -747,8 +818,18 @@ def expand_matches(
     radix2: bool = False,
     pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
     piece_tables: "dict | None" = None,  # device copies of pieces' arrays
+    pair_k: "int | None" = None,  # pair-lane tier (K=2, PERF.md §24)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
+
+    ``pair_k=2`` selects the pair-lane tier (PERF.md §24): every lane
+    covers the two consecutive candidate ranks ``2r``/``2r+1`` (blocks
+    then span ``2 * block_stride`` ranks and ``blk_count`` counts
+    candidates), the mixed-radix index is decoded ONCE per lane (the
+    schema's pair gate guarantees the partner's digit vector is the
+    base's with slot 0's digit + 1), and the outputs interleave the
+    members — row ``2r + p``.  Requires a pair-eligible ``pieces``
+    schema, the fixed-stride layout, and full enumeration.
 
     Returns ``(cand uint8[N, out_width], cand_len int32[N], word_row int32[N],
     emit bool[N])`` — ``emit`` folds lane validity (rank in range), the
@@ -778,6 +859,45 @@ def expand_matches(
     n = num_lanes
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
+
+    if pair_k:
+        if pair_k != 2:
+            raise ValueError(f"pair_k must be 2 or None, got {pair_k}")
+        if pieces is None or not pieces.pair_ok or win_v is not None:
+            raise ValueError(
+                "the pair-lane tier needs a pair-eligible PieceSchema "
+                "and full enumeration; gate via "
+                "pallas_expand.pair_for_config"
+            )
+        rank, ok0, ok1, w, base, field = pair_lane_fields(
+            blk_word, blk_base, blk_count,
+            num_lanes=n, block_stride=block_stride,
+        )
+        radix = field(match_radix)
+        digits = decode_digits(
+            rank * 2, base, radix, field, None, m,
+            max_rank=2 * block_stride, radix2=radix2,
+        )
+        d0 = digits[:, 0]
+        d0p = jnp.minimum(d0 + 1, radix[:, 0] - 1)
+        tabs = piece_tables or piece_device_tables(pieces)
+        out0, len0, out1, len1 = splice_pieces_pair(
+            pieces, tabs, field, digits, d0p, lambda c: digits[:, c],
+            n=n, out_width=out_width,
+        )
+        cc0 = jnp.sum((digits > 0).astype(jnp.int32), axis=1)
+        cc1 = cc0 + (d0p > 0).astype(jnp.int32) - (d0 > 0).astype(
+            jnp.int32
+        )
+        window = lambda ok, cc: (  # noqa: E731
+            ok & (cc >= min_substitute) & (cc <= max_substitute)
+        )
+        return (
+            interleave_pairs(out0, out1),
+            interleave_pairs(len0, len1).astype(jnp.int32),
+            interleave_pairs(w, w),
+            interleave_pairs(window(ok0, cc0), window(ok1, cc1)),
+        )
 
     rank, lane_ok, w, base, field = lane_fields(
         blk_word, blk_base, blk_count, blk_offset,

@@ -788,12 +788,20 @@ def expand_suball(
     close_mul: jnp.ndarray | None = None,  # int32 [B, P, S+1]
     pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
     piece_tables: "dict | None" = None,  # device copies of pieces' arrays
+    pair_k: "int | None" = None,  # pair-lane tier (K=2, PERF.md §24)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Decode + materialize ``num_lanes`` variants.
 
     Returns ``(cand uint8[N, out_width], cand_len int32[N], word_row int32[N],
     emit bool[N])`` — ``emit`` folds together lane validity (rank in range)
     and the min/max chosen-pattern-count window.
+
+    ``pair_k=2`` selects the pair-lane tier (PERF.md §24; contract as in
+    ``expand_matches.expand_matches``): one decode covers candidate
+    ranks ``2r``/``2r+1`` per lane, and the schema's pair gate
+    guarantees slot 0 drives only column 0 — so the partner's variant
+    vector differs in that single column.  Outputs interleave to
+    ``2 * num_lanes`` candidate rows.
 
     ``block_stride``: fixed-stride batch layout — constant-divide lane ->
     block plus per-block broadcasts instead of per-lane searchsorted +
@@ -808,10 +816,34 @@ def expand_suball(
     p = pat_radix.shape[1]
     g = seg_orig_start.shape[1]
 
-    rank, lane_ok, w, base, field = lane_fields(
-        blk_word, blk_base, blk_count, blk_offset,
-        num_lanes=n, block_stride=block_stride,
-    )
+    if pair_k:
+        from .expand_matches import pair_lane_fields
+
+        if pair_k != 2:
+            raise ValueError(f"pair_k must be 2 or None, got {pair_k}")
+        if (
+            pieces is None or not pieces.pair_ok or win_v is not None
+            or close_next is not None
+        ):
+            raise ValueError(
+                "the pair-lane tier needs a pair-eligible PieceSchema, "
+                "full enumeration, and no cascade closure; gate via "
+                "pallas_expand.pair_for_config"
+            )
+        rank, ok0, ok1, w, base, field = pair_lane_fields(
+            blk_word, blk_base, blk_count,
+            num_lanes=n, block_stride=block_stride,
+        )
+        lane_ok = ok0  # per-member masks consumed below
+        rank_c = rank * 2
+        max_rank = 2 * block_stride
+    else:
+        rank, lane_ok, w, base, field = lane_fields(
+            blk_word, blk_base, blk_count, blk_offset,
+            num_lanes=n, block_stride=block_stride,
+        )
+        rank_c = rank
+        max_rank = block_stride or n
     radix = field(pat_radix)  # [N, P]
     spat_w = field(seg_pat)  # [N, G]
     pvs_w = field(pat_val_start)  # [N, P]
@@ -820,7 +852,7 @@ def expand_suball(
     tokens_w = field(tokens)  # [N, L]
 
     digits = decode_digits(
-        rank, base, radix, field, win_v, p, max_rank=block_stride or n,
+        rank_c, base, radix, field, win_v, p, max_rank=max_rank,
         radix2=radix2,
     )  # [N, P]
 
@@ -863,6 +895,36 @@ def expand_suball(
             col_var = jnp.where(col_d > 0, 1 + col_jd, 0)
         else:
             col_var = col_d
+        if pair_k:
+            from .expand_matches import (
+                interleave_pairs,
+                splice_pieces_pair,
+            )
+
+            d0 = digits[:, 0]
+            d0p = jnp.minimum(d0 + 1, radix[:, 0] - 1)
+            # Pair gate: slot 0 drives column 0 (and only it) on every
+            # launched row; garbage rows may alias — masked by emit.
+            col0p = jnp.where(sslot_w[:, 0] == 0, d0p, col_var[:, 0])
+            out0, len0, out1, len1 = splice_pieces_pair(
+                pieces, tabs, field, digits, col0p,
+                lambda c: col_var[:, c], n=n, out_width=out_width,
+            )
+            act0 = active[:, 0]
+            cc1 = chosen_count + (
+                (d0p > 0) & act0
+            ).astype(jnp.int32) - ((d0 > 0) & act0).astype(jnp.int32)
+            window = lambda ok, cc: (  # noqa: E731
+                ok & (cc >= min_substitute) & (cc <= max_substitute)
+            )
+            return (
+                interleave_pairs(out0, out1),
+                interleave_pairs(len0, len1).astype(jnp.int32),
+                interleave_pairs(w, w),
+                interleave_pairs(
+                    window(ok0, chosen_count), window(ok1, cc1)
+                ),
+            )
         out, out_len = splice_pieces(
             pieces, tabs, field, lambda c: col_var[:, c],
             n=n, out_width=out_width,

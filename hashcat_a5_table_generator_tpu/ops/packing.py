@@ -293,6 +293,19 @@ class PieceSchema:
     closed suball plans (variant index = 1 + joint value index instead of
     the raw digit).  ``max_out`` bounds every lane's placed bytes
     (including the terminator) — the static placement budget.
+
+    Pair-lane tier (PERF.md §24): ``pair_ok`` marks schemas whose
+    geometry admits K=2 candidates per hash lane — consecutive
+    combination ranks ``2r`` / ``2r+1`` share one index decompose
+    (every launched word's innermost slot has EVEN radix, so the
+    partner's digit vector is the base's with slot 0's digit + 1) and
+    differ only in the variant of ONE static emission group
+    (``pair_g0``, the group whose selector columns start with column
+    0).  ``pair_dmin``/``pair_dmax`` statically bound the partner-
+    minus-base placed-length delta of that group over launched rows ×
+    reachable pairs — the kernels widen the suffix groups' placement
+    windows by exactly this range (a 0/0 bound collapses the partner
+    to a pure patch of the innermost group's words).
     """
 
     kind: str  # "match" | "suball"
@@ -305,6 +318,10 @@ class PieceSchema:
     closed: bool = False
     max_out: int = 0
     n_cols: int = 0
+    pair_ok: bool = False
+    pair_g0: int = 0
+    pair_dmin: int = 0
+    pair_dmax: int = 0
 
     @property
     def num_groups(self) -> int:
@@ -630,6 +647,25 @@ def build_piece_schema(
         floor_off += mn
         cap_off += mx
 
+    # --- pair-lane gate (PERF.md §24) --------------------------------
+    # K=2 candidates per hash lane need consecutive ranks 2r / 2r+1 to
+    # share one index decompose and differ in ONE static group's
+    # variant: (a) every launched word's innermost slot (column 0) has
+    # EVEN radix (odd ``col_opts``) — or the word has no variants at
+    # all, so its lone partner lane is masked; (b) column 0 is the
+    # LOWEST selector factor of its group (construction order
+    # guarantees ascending ``sel_cols``, so this is "first"); (c) for
+    # suball schemas, slot 0 drives column 0 and ONLY column 0 on
+    # every launched row (a pattern occurring twice would patch two
+    # groups); closed schemas keep K=1 (the joint index couples
+    # columns).  ``pair_dmin/dmax`` bound the partner-minus-base
+    # placed-length delta of the pair group over launched rows ×
+    # reachable (even, odd) variant pairs.
+    pair_ok, pair_g0, pair_dmin, pair_dmax = _pair_gate(
+        groups, col_opts, launched_rows, gl, reach,
+        kind=kind, closed=closed, sel_slot=sel_slot, sel_bit=sel_bit,
+    )
+
     wide_idx = [gi for gi, grp in enumerate(groups) if not grp.packed16]
     p16_idx = [gi for gi, grp in enumerate(groups) if grp.packed16]
     gw_wide = gw[:, wide_idx] if wide_idx else None
@@ -657,7 +693,69 @@ def build_piece_schema(
         closed=closed,
         max_out=cap_off,
         n_cols=c_axis,
+        pair_ok=pair_ok,
+        pair_g0=pair_g0,
+        pair_dmin=pair_dmin,
+        pair_dmax=pair_dmax,
     )
+
+
+def _pair_gate(groups, col_opts, launched_rows, gl, reach, *,
+               kind, closed, sel_slot, sel_bit):
+    """The schema-level half of the pair-lane eligibility (see
+    :class:`PieceSchema`): returns ``(pair_ok, g0, dmin, dmax)``.
+    Wrapper-level facts (hash-block count, windowed decode, env hatch)
+    are checked by ``pallas_expand.pair_for_config``."""
+    if closed:
+        return False, 0, 0, 0
+    g0 = next(
+        (gi for gi, grp in enumerate(groups) if 0 in grp.sel_cols), None
+    )
+    if g0 is None:
+        return False, 0, 0, 0
+    if groups[g0].sel_cols[0] != 0:
+        return False, 0, 0, 0
+    rows = launched_rows
+    opts0 = np.asarray(col_opts)[:, 0]
+    inert = (np.asarray(col_opts) == 0).all(axis=1)
+    row_ok = (opts0 % 2 == 1) | inert
+    if kind == "suball":
+        # Column 0 must be driven by slot 0 (bit 0 of the packed
+        # chosen vector) and slot 0 by NO other column.
+        c_axis = col_opts.shape[1]
+        slot0_cols = (np.asarray(sel_slot) == 0) & (
+            np.asarray(col_opts) > 0
+        )
+        drives_only_c0 = slot0_cols[:, 1:].sum(axis=1) == 0 \
+            if c_axis > 1 else np.ones(len(opts0), bool)
+        col0_is_slot0 = (
+            (np.asarray(sel_slot)[:, 0] == 0)
+            & (np.asarray(sel_bit)[:, 0] == 0)
+        ) | (opts0 == 0)
+        row_ok = row_ok & col0_is_slot0 & drives_only_c0
+    if not row_ok[rows].all():
+        return False, 0, 0, 0
+    # Partner-minus-base length delta of the pair group over launched
+    # rows × reachable (even, odd) variant pairs.  Column 0 is the
+    # lowest factor, so pairs are consecutive variant indices (2i,
+    # 2i+1).
+    grp = groups[g0]
+    if grp.len_fixed is not None:
+        return True, g0, 0, 0
+    n_var = grp.n_variants
+    glv = gl[rows][:, g0, :]
+    rch = reach[rows][:, g0, :]
+    dmin, dmax = 0, 0
+    found = False
+    for v in range(0, n_var - 1, 2):
+        both = rch[:, v] & rch[:, v + 1]
+        if not both.any():
+            continue
+        d = (glv[:, v + 1] - glv[:, v])[both]
+        dmin = int(d.min()) if not found else min(dmin, int(d.min()))
+        dmax = int(d.max()) if not found else max(dmax, int(d.max()))
+        found = True
+    return True, g0, dmin, dmax
 
 
 def _suball_piece_cols(plan) -> "tuple | None":
@@ -808,8 +906,9 @@ def piece_schema_for(plan, ct, cache_dir: "str | None" = None,
 
 #: Bump on ANY change to the PieceSchema layout or the grouping rules —
 #: the version is part of the cache key, so stale entries are simply
-#: never looked up again (no in-place migration).
-SCHEMA_CACHE_VERSION = 1
+#: never looked up again (no in-place migration).  v2: pair-lane gate
+#: fields (PERF.md §24).
+SCHEMA_CACHE_VERSION = 2
 
 #: Process-wide on-disk schema-cache instrumentation (PERF.md §20):
 #: hits/misses/bytes through :func:`load_piece_schema` /
@@ -950,6 +1049,10 @@ def save_piece_schema(cache_dir: str, key: str,
                 "closed": bool(schema.closed),
                 "max_out": int(schema.max_out),
                 "n_cols": int(schema.n_cols),
+                "pair_ok": bool(schema.pair_ok),
+                "pair_g0": int(schema.pair_g0),
+                "pair_dmin": int(schema.pair_dmin),
+                "pair_dmax": int(schema.pair_dmax),
                 "groups": [
                     {f: getattr(g, f) for f in _GROUP_FIELDS}
                     for g in schema.groups
@@ -1019,6 +1122,10 @@ def load_piece_schema(cache_dir: str, key: str
                 closed=bool(meta["closed"]),
                 max_out=int(meta["max_out"]),
                 n_cols=int(meta["n_cols"]),
+                pair_ok=bool(meta["pair_ok"]),
+                pair_g0=int(meta["pair_g0"]),
+                pair_dmin=int(meta["pair_dmin"]),
+                pair_dmax=int(meta["pair_dmax"]),
                 **arrays,
             )
     except (OSError, KeyError, ValueError, json.JSONDecodeError):

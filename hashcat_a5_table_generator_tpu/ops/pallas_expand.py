@@ -280,6 +280,49 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
     )
 
 
+def pair_for_config(spec, plan, pieces, *,
+                    block_stride: "int | None") -> "int | None":
+    """Pure pair-lane eligibility (PERF.md §24; no env check): returns
+    the static candidates-per-lane K (a Python int scalar, 2) when
+    this launch configuration can take the pair tier, else None.
+
+    Wrapper-level half of the gate (the schema-level half lives in
+    ``packing.build_piece_schema``'s ``pair_ok``): a pair-eligible
+    per-slot schema, full enumeration (the windowed DP walks a
+    different rank order, so consecutive ranks do not share a
+    decompose), a single hash block (the whole point is amortizing the
+    one compression's message build — multi-block lanes have no idle
+    schedule words to elide), a fixed-stride layout whose DOUBLED
+    in-block candidate ranks stay inside the exact-f32-divide range,
+    and no cascade closure (``pair_ok`` already excludes it).
+    """
+    if pieces is None or not getattr(pieces, "pair_ok", False):
+        return None
+    if getattr(plan, "windowed", False):
+        return None
+    if getattr(plan, "close_next", None) is not None:
+        return None
+    if block_stride is None or 2 * block_stride > (1 << 24):
+        return None
+    scale = 2 if spec.algo == "ntlm" else 1
+    if _hash_blocks_for(int(plan.out_width), scale) != 1:
+        return None
+    return 2
+
+
+def pair_for(spec, plan, pieces, *,
+             block_stride: "int | None") -> "int | None":
+    """Production pair-lane gate: :func:`pair_for_config` under the
+    ``A5GEN_PAIR`` escape hatch.  Returns the static candidates-per-
+    lane count (a Python int scalar, 2) when the pair tier should run,
+    None otherwise."""
+    from ..runtime.env import pair_enabled
+
+    if not pair_enabled():
+        return None
+    return pair_for_config(spec, plan, pieces, block_stride=block_stride)
+
+
 def _exact_div(r, rs):
     """Floor ``r // rs`` via f32 divide + ±1 fixup (exact for |r| < 2^24;
     in-kernel ranks are < the block stride). Mirrors
@@ -799,6 +842,17 @@ def _place_word(msg, nw_data, off, blen, word, j_span, term_hi=None):
         msg[w_last] = msg[w_last] | jnp.where(sel_prev, hi, _U32(0))
 
 
+def _or_into(msg, w_i: int, contrib) -> None:
+    """OR ``contrib`` into message word ``w_i``, tracking statically-zero
+    words: a ``None`` entry means "no byte can ever land here", so the
+    first contribution ASSIGNS instead of ORing and untouched words stay
+    ``None`` all the way into the compression rounds, which skip their
+    adds entirely (the zero-word elision half of the MD5-floor attack,
+    PERF.md §24 — a short single-block message leaves most of the 16
+    schedule words statically zero)."""
+    msg[w_i] = contrib if msg[w_i] is None else msg[w_i] | contrib
+
+
 def _place_piece(msg, nw_data, off, wd, *, floor, cap):
     """OR one PRE-MASKED piece word into the message at byte offset
     ``off`` — the piece kernels' hierarchical placement (PERF.md §18).
@@ -825,9 +879,9 @@ def _place_piece(msg, nw_data, off, wd, *, floor, cap):
         w_i = off >> 2
         sh = 8 * (off & 3)
         if w_i < nw_data:
-            msg[w_i] = msg[w_i] | (wd << _U32(sh) if sh else wd)
+            _or_into(msg, w_i, wd << _U32(sh) if sh else wd)
         if sh and w_i + 1 < nw_data:
-            msg[w_i + 1] = msg[w_i + 1] | (wd >> _U32(32 - sh))
+            _or_into(msg, w_i + 1, wd >> _U32(32 - sh))
         return
     sh = _U32(8) * (off & 3).astype(_U32)
     lo = wd << sh
@@ -838,9 +892,9 @@ def _place_piece(msg, nw_data, off, wd, *, floor, cap):
     if w_lo >= nw_data:
         return
     if w_lo == w_hi:
-        msg[w_lo] = msg[w_lo] | lo
+        _or_into(msg, w_lo, lo)
         if w_lo + 1 < nw_data:
-            msg[w_lo + 1] = msg[w_lo + 1] | hi
+            _or_into(msg, w_lo + 1, hi)
         return
     widx = off >> 2
     sel_prev = None
@@ -849,10 +903,52 @@ def _place_piece(msg, nw_data, off, wd, *, floor, cap):
         contrib = jnp.where(sel, lo, _U32(0))
         if sel_prev is not None:
             contrib = contrib | jnp.where(sel_prev, hi, _U32(0))
-        msg[w_i] = msg[w_i] | contrib
+        _or_into(msg, w_i, contrib)
         sel_prev = sel
     if w_hi + 1 < nw_data:
-        msg[w_hi + 1] = msg[w_hi + 1] | jnp.where(sel_prev, hi, _U32(0))
+        _or_into(msg, w_hi + 1, jnp.where(sel_prev, hi, _U32(0)))
+
+
+def _shift_msg_static(src, dbytes: int, nw: int):
+    """Byte-shift a sparse message word list by a STATIC ``dbytes``
+    (positive = toward higher offsets): the pair tier's suffix
+    derivation (PERF.md §24).  The suffix groups' bytes are placed ONCE
+    into an isolated accumulator; the partner's copy is this pure
+    word-level funnel shift — 2 static shifts + 1 OR per populated
+    word, with no per-lane masks (``None`` entries are statically zero
+    and propagate)."""
+    if dbytes == 0:
+        return list(src[:nw])
+    out = []
+    for w in range(nw):
+        b0 = 4 * w - dbytes
+        w0, r = b0 >> 2, b0 & 3
+        lo = src[w0] if 0 <= w0 < len(src) else None
+        hi = src[w0 + 1] if 0 <= w0 + 1 < len(src) else None
+        acc = None
+        if lo is not None:
+            acc = lo if r == 0 else lo >> _U32(8 * r)
+        if r and hi is not None:
+            part = hi << _U32(32 - 8 * r)
+            acc = part if acc is None else acc | part
+        out.append(acc)
+    return out
+
+
+def _merge_msgs(nw: int, *parts):
+    """Word-wise OR of sparse message word lists (``None`` = statically
+    zero) into one ``nw``-word list — the pair tier's final member
+    assembly: shared prefix ∪ member overlay ∪ (shifted) suffix."""
+    out = []
+    for w in range(nw):
+        acc = None
+        for p in parts:
+            t = p[w] if w < len(p) else None
+            if t is None:
+                continue
+            acc = t if acc is None else acc | t
+        out.append(acc)
+    return out
 
 
 def _length_words(msg, end, *, big_endian_length, hash_blocks):
@@ -880,15 +976,14 @@ def _length_words(msg, end, *, big_endian_length, hash_blocks):
         # k iff end <= 64*(k+1) - 9.  Later blocks are ignored by the
         # state select, so the LAST block's length word can be
         # unconditional; inner blocks' must not clobber longer lanes'
-        # data words.
+        # data words.  ``None`` entries are statically zero (the piece
+        # kernels' sparse message lists) — the OR degrades to an assign.
         for k in range(hash_blocks):
             if k + 1 == hash_blocks:
-                msg[16 * k + lw] = msg[16 * k + lw] | bits
+                _or_into(msg, 16 * k + lw, bits)
             else:
                 fits = end <= (64 * (k + 1) - 9)
-                msg[16 * k + lw] = msg[16 * k + lw] | jnp.where(
-                    fits, bits, _U32(0)
-                )
+                _or_into(msg, 16 * k + lw, jnp.where(fits, bits, _U32(0)))
     return msg
 
 
@@ -996,16 +1091,26 @@ def _message_from_units(unit_start, unit_len, unit_word, out_len, g, s,
 def _md5_rounds(msg, g, s, init=None):
     """The unrolled 64-round MD5 compression on (G, S) u32 tiles (same
     chain as ops.pallas_md5). Returns the four output state words;
-    ``init`` chains a previous block's state (None = the IV)."""
+    ``init`` chains a previous block's state (None = the IV).
+
+    ``None`` message entries are STATICALLY zero (the piece kernels'
+    sparse message lists, see :func:`_or_into`): their schedule adds are
+    elided — for a short single-block message that removes one add per
+    round per untouched word (4 uses × ~8 idle words at the §7a
+    geometry), a direct cut into the ~640-op MD5 floor (PERF.md §24)."""
     if init is None:
         init = tuple(jnp.full((g, s), _U32(k)) for k in _MD5_INIT)
     a, b, c, d = init
     for i in range(64):
+        # Mux forms of the round functions (3 ops instead of 4 — the
+        # classic identity ``(x&y)|(~x&z) == z ^ (x & (y ^ z))``); bit-
+        # identical to ops.hashes' reference forms, ~32 fewer eqns per
+        # compression (PERF.md §24's direct floor cut).
         if i < 16:
-            f = (b & c) | (~b & d)
+            f = d ^ (b & (c ^ d))
             gidx = i
         elif i < 32:
-            f = (d & b) | (~d & c)
+            f = c ^ (d & (b ^ c))
             gidx = (5 * i + 1) % 16
         elif i < 48:
             f = b ^ c ^ d
@@ -1013,7 +1118,9 @@ def _md5_rounds(msg, g, s, init=None):
         else:
             f = c ^ (b | ~d)
             gidx = (7 * i) % 16
-        rot = a + f + _U32(_MD5_K[i]) + msg[gidx]
+        rot = a + f + _U32(_MD5_K[i])
+        if msg[gidx] is not None:
+            rot = rot + msg[gidx]
         sh = _MD5_S[i]
         rotated = (rot << _U32(sh)) | (rot >> _U32(32 - sh))
         a, d, c, b = d, c, b, b + rotated
@@ -1026,22 +1133,32 @@ def _rotl_tile(x, sh: int):
 
 def _md4_rounds(msg, g, s, init=None):
     """Unrolled MD4 (RFC 1320 — the NTLM core) on (G, S) u32 tiles,
-    mirroring ``ops.hashes._md4_block``; ``init`` chains blocks."""
+    mirroring ``ops.hashes._md4_block``; ``init`` chains blocks.
+    ``None`` message entries are statically zero — their adds are
+    elided (see :func:`_md5_rounds`)."""
     if init is None:
         init = tuple(jnp.full((g, s), _U32(k)) for k in _MD4_INIT)
     a, b, c, d = init
+
+    def addm(x, k):
+        return x if msg[k] is None else x + msg[k]
+
+    # Mux/majority identities as in :func:`_md5_rounds` — bit-identical,
+    # one fewer eqn per round.
     for j, k in enumerate(range(16)):
-        a2 = _rotl_tile(a + ((b & c) | (~b & d)) + msg[k], (3, 7, 11, 19)[j % 4])
+        a2 = _rotl_tile(addm(a + (d ^ (b & (c ^ d))), k),
+                        (3, 7, 11, 19)[j % 4])
         a, b, c, d = d, a2, b, c
     for j, k in enumerate(_MD4_G):
         a2 = _rotl_tile(
-            a + ((b & c) | (b & d) | (c & d)) + msg[k] + _U32(0x5A827999),
+            addm(a + ((b & (c | d)) | (c & d)), k) + _U32(0x5A827999),
             (3, 5, 9, 13)[j % 4],
         )
         a, b, c, d = d, a2, b, c
     for j, k in enumerate(_MD4_H):
         a2 = _rotl_tile(
-            a + (b ^ c ^ d) + msg[k] + _U32(0x6ED9EBA1), (3, 9, 11, 15)[j % 4]
+            addm(a + (b ^ c ^ d), k) + _U32(0x6ED9EBA1),
+            (3, 9, 11, 15)[j % 4],
         )
         a, b, c, d = d, a2, b, c
     return (a + init[0], b + init[1], c + init[2], d + init[3])
@@ -1050,9 +1167,12 @@ def _md4_rounds(msg, g, s, init=None):
 def _sha1_rounds(msg, g, s, init=None):
     """Unrolled 80-round SHA-1 on (G, S) u32 tiles: byte-swaps the shared
     little-endian message layout into the big-endian schedule, rolling
-    16-word window for the expansion (mirrors ``ops.hashes._sha1_block``)."""
+    16-word window for the expansion (mirrors ``ops.hashes._sha1_block``).
+    ``None`` message entries are statically zero — their byte-swaps,
+    schedule xors, and round adds are elided (see :func:`_md5_rounds`;
+    the 64-word expansion makes the propagation worth more here)."""
     def bswap(x):
-        return (
+        return None if x is None else (
             ((x & _U32(0xFF)) << 24)
             | ((x & _U32(0xFF00)) << 8)
             | ((x >> 8) & _U32(0xFF00))
@@ -1061,20 +1181,31 @@ def _sha1_rounds(msg, g, s, init=None):
 
     w = [bswap(m) for m in msg]
     for t in range(16, 80):
-        w.append(_rotl_tile(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
+        terms = [x for x in (w[t - 3], w[t - 8], w[t - 14], w[t - 16])
+                 if x is not None]
+        if not terms:
+            w.append(None)
+            continue
+        acc = terms[0]
+        for x in terms[1:]:
+            acc = acc ^ x
+        w.append(_rotl_tile(acc, 1))
     if init is None:
         init = tuple(jnp.full((g, s), _U32(k)) for k in _SHA1_INIT)
     a, b, c, d, e = init
     for t in range(80):
+        # Mux/majority identities as in :func:`_md5_rounds`.
         if t < 20:
-            f = (b & c) | (~b & d)
+            f = d ^ (b & (c ^ d))
         elif t < 40:
             f = b ^ c ^ d
         elif t < 60:
-            f = (b & c) | (b & d) | (c & d)
+            f = (b & (c | d)) | (c & d)
         else:
             f = b ^ c ^ d
-        tmp = _rotl_tile(a, 5) + f + e + _U32(_SHA1_K[t // 20]) + w[t]
+        tmp = _rotl_tile(a, 5) + f + e + _U32(_SHA1_K[t // 20])
+        if w[t] is not None:
+            tmp = tmp + w[t]
         e, d, c, b, a = d, c, _rotl_tile(b, 30), a, tmp
     return (a + init[0], b + init[1], c + init[2], d + init[3],
             e + init[4])
@@ -1178,7 +1309,7 @@ def _make_piece_kernel(
     *, g: int, s: int, kind: str, schema, num_slots: int, k_opts: int,
     out_width: int, min_substitute: int, max_substitute: int,
     algo: str = "md5", scalar: bool = False, windowed: bool = False,
-    close_s: "int | None" = None,
+    close_s: "int | None" = None, pair: bool = False,
 ):
     """Per-slot piece-emission kernel body (PERF.md §17/§18) — ONE
     builder for every tier (match/suball × scalar/general × full/
@@ -1213,6 +1344,22 @@ def _make_piece_kernel(
     all-fixed schemas ship no length table, PERF.md §19).
     Outputs: ``state[G, KS, S] u32``, ``emit[G, S] i32`` — identical
     contract to :func:`_make_kernel`.
+
+    ``pair`` (the pair-lane tier, PERF.md §24): every lane covers the
+    two consecutive candidate ranks ``2r``/``2r+1`` of its block
+    (blocks then span ``2s`` ranks; ``count`` counts CANDIDATES).  The
+    schema's pair gate guarantees the partner's digit vector is the
+    base's with slot 0's digit + 1 and that only the ``pair_g0`` group's
+    variant differs, so the kernel decodes ONCE, selects every group's
+    word/length ONCE (plus one extra select pair for ``pair_g0``'s
+    partner variant ``idx + 1``), shares the prefix groups' placement,
+    and derives the partner message by patching ``pair_g0``'s words —
+    forking the suffix placement only when the pair's placed-length
+    delta is nonzero (offsets shift by the schema's static
+    ``pair_dmin``/``pair_dmax`` bounds).  Both members' compressions
+    run (each with the zero-word elision), and the outputs interleave:
+    ``state[G, KS, 2S]`` / ``emit[G, 2S]`` with member ``p`` of lane
+    ``r`` at column ``2r + p`` — candidate rank order.
     """
     utf16 = algo == "ntlm"
     scale = 2 if utf16 else 1
@@ -1221,6 +1368,12 @@ def _make_piece_kernel(
     assert kind in ("match", "suball"), kind
     groups = schema.groups
     closed = bool(schema.closed)
+    if pair:
+        assert schema.pair_ok and hash_blocks == 1 and not windowed \
+            and close_s is None, "pair gate bypassed"
+    pair_g0 = schema.pair_g0 if pair else -1
+    pair_static = pair and schema.pair_dmin == schema.pair_dmax
+    pair_d = schema.pair_dmin if pair_static else None
 
     def kernel(count, *rest):
         rest = list(rest)
@@ -1250,19 +1403,41 @@ def _make_piece_kernel(
         state_ref, emit_ref = rest
 
         rank = jax.lax.broadcasted_iota(_I32, (g, s), 1)
-        lane_ok = rank < count[:, 0][:, None]
+        if pair:
+            # Each lane owns candidate ranks 2r / 2r+1; ``count`` counts
+            # candidates (up to 2s).
+            cand0 = rank * 2
+            ok0 = cand0 < count[:, 0][:, None]
+            ok1 = cand0 + 1 < count[:, 0][:, None]
+            lane_ok = ok0
+            rank_c = cand0
+        else:
+            lane_ok = rank < count[:, 0][:, None]
+            rank_c = rank
 
         # --- decode: digits and/or the packed chosen vector -------------
         digits = cb = None
         if scalar and not windowed:
-            cb = pbase[:, 0][:, None] + rank
+            # Pair: blocks start at even ranks and rank_c is even, so
+            # cb's bit 0 (slot 0's chosen bit) is 0 on EVERY lane — the
+            # partner is cb | 1, never materialized: only the pair
+            # group's variant index (+1) and the chosen count (+1) see
+            # it.
+            cb = pbase[:, 0][:, None] + rank_c
         elif windowed:
             digits = _decode_tile_windowed(
                 rank, base, winv, radix, num_slots, g, s, k_opts
             )
         else:
             decode = _decode_tile_radix2 if k_opts == 1 else _decode_tile
-            digits = decode(rank, base, radix, num_slots, g, s)
+            digits = decode(rank_c, base, radix, num_slots, g, s)
+        d0p = None
+        if pair and digits is not None:
+            # Partner digit of slot 0: the pair gate guarantees even
+            # radix, so digit + 1 never carries; the min only guards
+            # masked garbage lanes (and inactive radix-1 words, whose
+            # partner lanes are masked by ok1).
+            d0p = jnp.minimum(digits[0] + 1, radix[:, 0][:, None] - 1)
         if scalar and windowed:
             # Pack the DP walk's chosen bits so the piece selectors read
             # one vector (match plans: slot c IS bit c — active slots are
@@ -1280,6 +1455,14 @@ def _make_piece_kernel(
             chosen_count = jnp.zeros((g, s), _I32)
             for sl in range(num_slots):
                 chosen_count = chosen_count + (digits[sl] > 0).astype(_I32)
+        cc1 = None
+        if pair:
+            if cb is not None:
+                cc1 = chosen_count + 1  # partner flips bit 0 (0 -> 1)
+            else:
+                cc1 = chosen_count + (d0p > 0).astype(_I32) - (
+                    digits[0] > 0
+                ).astype(_I32)
 
         # Cascade closure (suball general only): per-slot JOINT value
         # index over the slot's own and its successors' digits — same
@@ -1319,15 +1502,42 @@ def _make_piece_kernel(
         # a run of fixed groups costs zero offset arithmetic and their
         # placement collapses to static shift-ORs; the first varying
         # group switches to the dynamic prefix sum (PERF.md §18).
-        msg = [jnp.zeros((g, s), _U32) for _ in range(16 * hash_blocks)]
+        # Message words start as ``None`` (statically zero) so untouched
+        # schedule words skip their compression adds (PERF.md §24).
+        #
+        # Pair bookkeeping: groups BEFORE ``pair_g0`` place into the
+        # shared ``msgA``.  With a STATIC length delta (the schema's
+        # bounds coincide — every shipped fixed-width value layout) the
+        # pair group's two variants land in per-member OVERLAYS at the
+        # SAME offset, the suffix groups place ONCE into the isolated
+        # ``msgS`` accumulator, and the partner's suffix is derived by
+        # a pure static funnel shift of ``msgS`` (no second placement,
+        # no masks — PERF.md §24's "no second splice").  A dynamic
+        # delta FORKS ``msgB`` instead: suffix groups place twice, the
+        # partner's offsets shifted per lane.
+        msgA = [None] * (16 * hash_blocks)
+        msgB = None
+        msgS = None
+        ovA = ovB = None
+        delta = 0  # partner-minus-base placed length (int or tile)
+        delta_msg = 0  # the same in message space (× utf16 scale)
         nw_data = 16 * hash_blocks - 2
         cum_static = 0
         cum = None  # dynamic offset once any group's length varies
         for gi, grp in enumerate(groups):
             n_var, n_words = grp.n_variants, grp.n_words
+            if gi == pair_g0:
+                if pair_static:
+                    ovA = [None] * len(msgA)
+                    ovB = [None] * len(msgA)
+                    msgS = [None] * len(msgA)
+                    delta = pair_d
+                    delta_msg = pair_d * scale
+                else:
+                    msgB = list(msgA)
             if grp.len_fixed == 0:
                 continue  # empty in every launched word: nothing placed
-            idx = None
+            idx = idx1 = None
             if n_var > 1:
                 sel = grp.sel_cols
                 if cb is not None:
@@ -1363,43 +1573,110 @@ def _make_piece_kernel(
                         idx = idx | (
                             (col_variant(c) > 0).astype(_I32) << i
                         )
-            off0 = cum_static if cum is None else cum
-            for w in range(n_words):
-                if grp.packed16:
-                    # u16 variant table: halved VMEM loads; widen after
-                    # the select (one convert per group).
-                    wd = _select_rows(
-                        idx, [gw16[:, grp.tab_idx, v] for v in range(n_var)],
-                        g, s,
-                    ).astype(_U32)
-                else:
-                    wd = _select_rows(
-                        idx, [gw[:, grp.tab_idx, v, w] for v in range(n_var)],
-                        g, s,
+                if gi == pair_g0:
+                    # Partner variant: column 0 is the group's lowest
+                    # factor and its base digit/bit is even, so the
+                    # partner index is idx + 1.  cb lanes are always
+                    # even in bit 0 (no clamp needed); digit-decoded
+                    # garbage lanes clamp like the base select.
+                    idx1 = idx + 1 if cb is not None else jnp.minimum(
+                        idx + 1, n_var - 1
                     )
-                off = off0 if w == 0 else off0 + 4 * w
-                floor = grp.off_floor + 4 * w
-                cap = grp.off_cap + 4 * w
-                if not utf16:
-                    _place_piece(msg, nw_data, off, wd,
-                                 floor=floor, cap=cap)
-                else:
-                    # Bytes b0..b3 -> code units (b0|b1<<16) at 2*off and
-                    # (b2|b3<<16) at 2*off+4 (the shared split-piece
-                    # machinery; the terminator pseudo-byte expands to
-                    # the message's 80 00 pair).
+
+            def sel_words(index):
+                words = []
+                for w in range(n_words):
+                    if grp.packed16:
+                        # u16 variant table: halved VMEM loads; widen
+                        # after the select (one convert per group).
+                        wd = _select_rows(
+                            index,
+                            [gw16[:, grp.tab_idx, v] for v in range(n_var)],
+                            g, s,
+                        ).astype(_U32)
+                    else:
+                        wd = _select_rows(
+                            index,
+                            [gw[:, grp.tab_idx, v, w] for v in range(n_var)],
+                            g, s,
+                        )
+                    words.append(wd)
+                return words
+
+            def split_pieces(words):
+                """(msg-space byte delta, tile, floor, cap) per placed
+                word — utf16 expands each u32 into its two code-unit
+                pieces ONCE, so shared suffix groups never convert
+                twice."""
+                out = []
+                # Static Python list of selected word tiles, never a
+                # traced value.
+                for w, wd in enumerate(words):  # graftlint: disable=GL005
+                    floor = grp.off_floor + 4 * w
+                    cap = grp.off_cap + 4 * w
+                    if not utf16:
+                        out.append((4 * w, wd, floor, cap))
+                        continue
+                    # Bytes b0..b3 -> code units (b0|b1<<16) at 2*off
+                    # and (b2|b3<<16) at 2*off+4; the terminator
+                    # pseudo-byte expands to the message's 80 00 pair.
                     lo16 = (wd & _U32(0xFF)) | ((wd & _U32(0xFF00)) << 8)
-                    off2 = off * 2
-                    _place_piece(msg, nw_data, off2, lo16,
-                                 floor=2 * floor, cap=2 * cap)
+                    out.append((8 * w, lo16, 2 * floor, 2 * cap))
                     if not grp.packed16:
-                        # packed16 rows are u16: bytes 2-3 are statically
-                        # zero, so the hi pair would OR nothing.
+                        # packed16 rows are u16: bytes 2-3 are
+                        # statically zero, so the hi pair would OR
+                        # nothing.
                         hi16 = ((wd >> 16) & _U32(0xFF)) | (
                             ((wd >> 24) & _U32(0xFF)) << 16
                         )
-                        _place_piece(msg, nw_data, off2 + 4, hi16,
-                                     floor=2 * floor + 4, cap=2 * cap + 4)
+                        out.append((8 * w + 4, hi16, 2 * floor + 4,
+                                    2 * cap + 4))
+                return out
+
+            def place(target, pieces_list, off_msg, shift=0):
+                """Place a group's pieces at message-space offset
+                ``off_msg`` (+ static window ``shift`` for the pair
+                suffix: the partner's reachable window moves by the
+                static delta bounds)."""
+                lo_x = shift if isinstance(shift, int) else (
+                    schema.pair_dmin * scale
+                )
+                hi_x = shift if isinstance(shift, int) else (
+                    schema.pair_dmax * scale
+                )
+                # Static Python list of (offset, tile, window) pieces,
+                # never a traced value.
+                for doff, tile, fl, cp in pieces_list:  # noqa: E501  # graftlint: disable=GL005
+                    o = off_msg if doff == 0 else off_msg + doff
+                    _place_piece(target, nw_data, o, tile,
+                                 floor=fl + lo_x, cap=cp + hi_x)
+
+            off0 = cum_static if cum is None else cum
+            off_msg = off0 * scale if scale != 1 else off0
+            piecesA = split_pieces(sel_words(idx))
+            if gi != pair_g0:
+                if msgS is not None:
+                    # Pair suffix, static delta: placed ONCE into the
+                    # isolated accumulator — the partner's copy is the
+                    # finalize-time funnel shift.
+                    place(msgS, piecesA, off_msg)
+                else:
+                    place(msgA, piecesA, off_msg)
+                    if msgB is not None:
+                        # Pair suffix, dynamic delta: same selected
+                        # words, the partner's offsets shifted by the
+                        # per-lane length delta (windows widened by the
+                        # schema's static bounds).
+                        place(msgB, piecesA, off_msg + delta_msg,
+                              shift=delta)
+            else:
+                piecesB = split_pieces(sel_words(idx1))
+                if ovA is not None:
+                    place(ovA, piecesA, off_msg)
+                    place(ovB, piecesB, off_msg)
+                else:
+                    place(msgA, piecesA, off_msg)
+                    place(msgB, piecesB, off_msg)
             if grp.len_fixed is not None:
                 if cum is None:
                     cum_static += grp.len_fixed
@@ -1409,6 +1686,13 @@ def _make_piece_kernel(
                 l = _select_rows(
                     idx, [gl[:, grp.gl_idx, v] for v in range(n_var)], g, s
                 )
+                if gi == pair_g0 and not pair_static:
+                    lB = _select_rows(
+                        idx1, [gl[:, grp.gl_idx, v] for v in range(n_var)],
+                        g, s,
+                    )
+                    delta = lB - l
+                    delta_msg = delta * scale if scale != 1 else delta
                 if cum is not None:
                     cum = cum + l
                 else:
@@ -1418,20 +1702,60 @@ def _make_piece_kernel(
             out_len = jnp.full((g, s), cum_static - 1, _I32)
         else:
             out_len = cum - 1
-        end = out_len * scale if scale != 1 else out_len
-        msg = _length_words(msg, end, big_endian_length=algo == "sha1",
-                            hash_blocks=hash_blocks)
-        state = _compress_message(algo, msg, end, g, s,
-                                  hash_blocks=hash_blocks)
-        for w_i, sw in enumerate(state):
-            state_ref[:, w_i, :] = sw
 
-        emit = (
-            lane_ok
-            & (chosen_count >= min_substitute)
-            & (chosen_count <= max_substitute)
+        def window(cc):
+            return (cc >= min_substitute) & (cc <= max_substitute)
+
+        if not pair:
+            end = out_len * scale if scale != 1 else out_len
+            msg = _length_words(msgA, end,
+                                big_endian_length=algo == "sha1",
+                                hash_blocks=hash_blocks)
+            state = _compress_message(algo, msg, end, g, s,
+                                      hash_blocks=hash_blocks)
+            for w_i, sw in enumerate(state):
+                state_ref[:, w_i, :] = sw
+            emit = lane_ok & window(chosen_count)
+            emit_ref[:, :] = emit.astype(_I32)
+            return
+
+        # --- pair finalize: two single-block compressions ---------------
+        zero_d = isinstance(delta, int) and delta == 0
+        out_lenB = out_len if zero_d else out_len + delta
+        endA = out_len * scale if scale != 1 else out_len
+        endB = endA if zero_d else (
+            out_lenB * scale if scale != 1 else out_lenB
         )
-        emit_ref[:, :] = emit.astype(_I32)
+        if ovA is not None:
+            # Static delta: member messages assemble from the shared
+            # prefix, each member's overlay of the pair group, and the
+            # once-placed suffix — the partner's suffix a pure static
+            # funnel shift (PERF.md §24).
+            nw = 16 * hash_blocks
+            mA = _merge_msgs(nw, msgA, ovA, msgS)
+            mB = _merge_msgs(
+                nw, msgA, ovB,
+                # Emitted lanes' data bytes never reach the length
+                # words (single-block gate), so the shifted suffix is
+                # capped at the data words.
+                _shift_msg_static(msgS, delta_msg, nw_data),
+            )
+        else:
+            mA, mB = msgA, msgB
+        mA = _length_words(mA, endA, big_endian_length=algo == "sha1",
+                           hash_blocks=1)
+        mB = _length_words(mB, endB, big_endian_length=algo == "sha1",
+                           hash_blocks=1)
+        stateA = _compress_message(algo, mA, endA, g, s, hash_blocks=1)
+        stateB = _compress_message(algo, mB, endB, g, s, hash_blocks=1)
+        # Members land in contiguous HALVES of the doubled lane axis;
+        # the wrapper interleaves to candidate-rank order outside the
+        # kernel (host-level XLA — free in the vreg budget).
+        for w_i, (swA, swB) in enumerate(zip(stateA, stateB)):
+            state_ref[:, w_i, :s] = swA
+            state_ref[:, w_i, s:] = swB
+        emit_ref[:, :s] = (ok0 & window(chosen_count)).astype(_I32)
+        emit_ref[:, s:] = (ok1 & window(cc1)).astype(_I32)
 
     return kernel
 
@@ -1599,12 +1923,17 @@ def _pack_val_options(val_bytes, val_len, vstart_b, k_opts: int):
 
 
 def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
-                  interpret):
+                  interpret, pair: bool = False):
     """Shared pallas_call epilogue for both fused wrappers: G-row block
     specs derived from each input's trailing shape, (state, emit) outputs
     reshaped to the flat lane contract. ``n_state`` = hash state words
-    (4 for MD5/MD4/NTLM, 5 for SHA-1)."""
+    (4 for MD5/MD4/NTLM, 5 for SHA-1).  ``pair``: the pair-lane tier
+    (PERF.md §24) — each lane yields TWO candidates, so the output lane
+    axis doubles (candidate ``2r + p`` at row ``2r + p``)."""
     from jax.experimental import pallas as pl
+
+    mult = 2 if pair else 1
+    s_out = stride * mult
 
     def row_spec(trail):
         return pl.BlockSpec(
@@ -1624,24 +1953,35 @@ def _launch_fused(kernel, inputs, *, nb, stride, num_lanes, n_state,
         for x in inputs:
             vma = vma | getattr(typeof(x), "vma", frozenset())
         out_shape = [
-            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32,
+            jax.ShapeDtypeStruct((nb, n_state, s_out), jnp.uint32,
                                  vma=vma),
-            jax.ShapeDtypeStruct((nb, stride), jnp.int32, vma=vma),
+            jax.ShapeDtypeStruct((nb, s_out), jnp.int32, vma=vma),
         ]
     else:
         out_shape = [
-            jax.ShapeDtypeStruct((nb, n_state, stride), jnp.uint32),
-            jax.ShapeDtypeStruct((nb, stride), jnp.int32),
+            jax.ShapeDtypeStruct((nb, n_state, s_out), jnp.uint32),
+            jax.ShapeDtypeStruct((nb, s_out), jnp.int32),
         ]
 
     state, emit = pl.pallas_call(
         kernel,
         grid=(nb // _G,),
         in_specs=[row_spec(x.shape[1:]) for x in inputs],
-        out_specs=[row_spec((n_state, stride)), row_spec((stride,))],
+        out_specs=[row_spec((n_state, s_out)), row_spec((s_out,))],
         out_shape=out_shape,
         interpret=interpret,
     )(*inputs)
+    if pair:
+        # The kernel writes members into contiguous halves of the
+        # doubled lane axis; interleave to candidate-rank order
+        # (row 2r + p) here, outside the budget-counted kernel.
+        state = jnp.stack(
+            [state[..., :stride], state[..., stride:]], axis=-1
+        ).transpose(0, 2, 3, 1).reshape(num_lanes * mult, n_state)
+        emit = jnp.stack(
+            [emit[:, :stride], emit[:, stride:]], axis=-1
+        ).reshape(num_lanes * mult) > 0
+        return state, emit
     state = state.transpose(0, 2, 1).reshape(num_lanes, n_state)
     emit = emit.reshape(num_lanes) > 0
     return state, emit
@@ -1678,7 +2018,8 @@ def _piece_tables(pieces, pre, blk_word):
 @audited_entry(
     "ops.fused_expand_md5",
     kind="pallas_kernel",
-    budget_keys=("scalar", "sha1", "general", "2-hash-block", "ntlm"),
+    budget_keys=("scalar", "scalar-solo", "sha1", "general",
+                 "2-hash-block", "ntlm"),
 )
 def fused_expand_md5(
     tokens: jnp.ndarray,  # uint8 [B, L] — plan token matrix
@@ -1705,8 +2046,15 @@ def fused_expand_md5(
     pre: "dict | None" = None,  # scalar_units_fields device arrays
     pieces=None,  # packing.PieceSchema — per-slot emission (PERF.md §17)
     interpret: bool = False,
+    pair: bool = False,  # pair-lane tier (K=2, PERF.md §24)
 ):
     """Fused decode+splice+hash for a fixed-stride launch.
+
+    ``pair`` (gate via :func:`pair_for_config`): the pair-lane tier —
+    blocks cover ``2 * block_stride`` candidate ranks on
+    ``block_stride`` lanes (``blk_count`` counts candidates), and the
+    returned arrays have ``2 * num_lanes`` candidate rows, member ``p``
+    of lane ``r`` at row ``2r + p``.
 
     Returns ``(state uint32[N, K], emit bool[N])`` (K =
     ``DIGEST_WORDS[algo]``) — the same contract as ``expand_matches`` +
@@ -1723,6 +2071,12 @@ def fused_expand_md5(
     nb = _validate_geometry(blk_word, block_stride, num_lanes)
     m = match_pos.shape[1]
     length_axis = tokens.shape[1]
+    if pair and (pieces is None or not pieces.pair_ok
+                 or win_v is not None):
+        raise ValueError(
+            "pair=True needs a pair-eligible PieceSchema and full "
+            "enumeration; gate via pair_for_config"
+        )
 
     if pieces is not None:
         # Per-slot piece emission (PERF.md §17): the whole byte-position
@@ -1750,11 +2104,17 @@ def fused_expand_md5(
             num_slots=m, k_opts=k_opts, out_width=out_width,
             min_substitute=min_substitute, max_substitute=max_substitute,
             algo=algo, scalar=scalar, windowed=win_v is not None,
+            pair=pair,
         )
         return _launch_fused(
             kernel, inputs, nb=nb, stride=block_stride,
             num_lanes=num_lanes, n_state=DIGEST_WORDS[algo],
-            interpret=interpret,
+            interpret=interpret, pair=pair,
+        )
+    if pair:
+        raise ValueError(
+            "pair=True requires the per-slot piece emission tier "
+            "(pieces); the byte-scan kernels keep K=1"
         )
 
     # Block-level gathers (NB rows — the cheap granularity): per-block word
@@ -2043,6 +2403,7 @@ def fused_expand_suball_md5(
     interpret: bool = False,
     close_next: "jnp.ndarray | None" = None,  # int32 [B, P, S] (closure)
     close_mul: "jnp.ndarray | None" = None,  # int32 [B, P, S+1]
+    pair: bool = False,  # pair-lane tier (K=2, PERF.md §24)
 ):
     """Fused decode+splice+hash for substitute-all fixed-stride launches.
 
@@ -2066,6 +2427,15 @@ def fused_expand_suball_md5(
             "cascade-closed plans cannot take the scalar-units kernel "
             "(joint value tables are per-lane, not block-uniform); gate "
             "via scalar_units_for(plan)"
+        )
+    if pair and (
+        pieces is None or not pieces.pair_ok or win_v is not None
+        or close_next is not None
+    ):
+        raise ValueError(
+            "pair=True needs a pair-eligible PieceSchema, full "
+            "enumeration, and no cascade closure; gate via "
+            "pair_for_config"
         )
 
     if pieces is not None:
@@ -2121,11 +2491,17 @@ def fused_expand_suball_md5(
             algo=algo, scalar=scalar, windowed=win_v is not None,
             close_s=(None if close_next is None
                      else int(close_next.shape[2])),
+            pair=pair,
         )
         return _launch_fused(
             kernel, inputs, nb=nb, stride=block_stride,
             num_lanes=num_lanes, n_state=DIGEST_WORDS[algo],
-            interpret=interpret,
+            interpret=interpret, pair=pair,
+        )
+    if pair:
+        raise ValueError(
+            "pair=True requires the per-slot piece emission tier "
+            "(pieces); the byte-scan kernels keep K=1"
         )
 
     tok_b = tokens[blk_word].astype(_I32)

@@ -156,6 +156,7 @@ def make_sharded_crack_step(
     fused_scalar_units: bool = False,
     radix2: bool = False,
     pieces=None,
+    pair_k: int | None = None,
 ):
     """The fused crack step, shard_map'd over a 1-D mesh.
 
@@ -176,7 +177,7 @@ def make_sharded_crack_step(
         spec, num_lanes=lanes_per_device, out_width=out_width,
         block_stride=block_stride, fused_expand_opts=fused_expand_opts,
         fused_scalar_units=fused_scalar_units, radix2=radix2,
-        pieces=pieces,
+        pieces=pieces, pair_k=pair_k,
     )
 
     def local_step(plan, table, digests, blocks):

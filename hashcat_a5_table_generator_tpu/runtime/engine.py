@@ -550,7 +550,7 @@ class Engine:
                     item = self._built.get(timeout=0.5)
                 except queue.Empty:
                     continue
-                self._finish_build(*item)
+                self._consume_built(item)
                 continue
             if not self._pending.empty():
                 continue
@@ -629,7 +629,7 @@ class Engine:
                 item = self._built.get(timeout=0.5)
             except queue.Empty:
                 continue
-            self._finish_build(*item)
+            self._consume_built(item)
 
     def _intake(self, job: EngineJob) -> None:
         """One drained submission: honor a pre-admission cancel, stage
@@ -649,7 +649,7 @@ class Engine:
         else:
             job._staging_key = None
         if self._admit_ex is None:
-            self._built.put(self._safe_build(job))
+            self._built.put(("job",) + self._safe_build(job))
         else:
             with self._lock:
                 self._building += 1
@@ -684,16 +684,51 @@ class Engine:
             return job, None, exc
 
     def _worker_build(self, job: EngineJob) -> None:
-        self._built.put(self._safe_build(job))
+        self._built.put(("job",) + self._safe_build(job))
         self._wake.set()
+
+    def _consume_built(self, item: tuple) -> None:
+        """One completed admission-worker product: a job build
+        (``("job", job, slot, exc)``) or an off-thread fuse build
+        (``("fuse", result)`` — activation-on-completion)."""
+        if item[0] == "fuse":
+            with self._lock:
+                self._building -= 1
+            return self._finish_fuse(item[1])
+        if item[0] == "fuse_death":
+            # Worker-death recovery for an off-thread fuse build
+            # (mirrors _finish_build's non-Exception branch): restart
+            # the executor once, re-run the SAME batch on the fresh
+            # worker; a second death settles the batch failed (the
+            # retried flag in _worker_fuse).  ``_building`` stays
+            # incremented — the resubmitted build's completion
+            # decrements it through the ordinary "fuse" path.
+            _slots, _exc = item[1], item[2]
+            telemetry.counter("faults.worker_restarts").add(1)
+            if self._admit_ex is not None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._admit_ex.shutdown(wait=False)
+                self._admit_ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="a5-engine-admit"
+                )
+                self._admit_ex.submit(self._worker_fuse, _slots, True)
+                return
+            with self._lock:  # pragma: no cover - sync mode never queues
+                self._building -= 1
+            return self._finish_fuse({
+                "groups": [], "solo": [], "failed": [(list(_slots),
+                                                      _exc)],
+            })
+        return self._finish_build(*item[1:])
 
     def _collect_builds(self) -> None:
         while True:
             try:
-                job, slot, exc = self._built.get_nowait()
+                item = self._built.get_nowait()
             except queue.Empty:
                 return
-            self._finish_build(job, slot, exc)
+            self._consume_built(item)
 
     def _finish_build(self, job: EngineJob, slot: "Optional[_Slot]",
                       exc: "Optional[BaseException]") -> None:
@@ -756,30 +791,72 @@ class Engine:
             if len(stage["ready"]) < stage["need"]:
                 return
             self._staging.pop(skey, None)
-        self._fuse_and_activate(stage["ready"])
+        self._queue_fuse(stage["ready"])
+
+    def _queue_fuse(self, slots: List["_Slot"]) -> None:
+        """Off-thread fuse build (PERF.md §22 lever 4 / §24): the
+        released batch's HEAVY half — ``pack_candidate`` probes, the
+        packed digest re-sort, the plan-array concatenation and device
+        upload inside ``build_fused_group`` — runs on the admission
+        worker, with activation-on-completion via the built queue; the
+        serve round keeps multiplexing running tenants instead of
+        stalling behind a large digest list's group build.  Sync-
+        admission mode (no worker) keeps the inline build."""
+        if self._admit_ex is None:
+            return self._fuse_and_activate(slots)
+        with self._lock:
+            self._building += 1
+        telemetry.counter("engine.fuse_builds_offthread").add(1)
+        self._admit_ex.submit(self._worker_fuse, slots)
+
+    def _worker_fuse(self, slots: List["_Slot"],
+                     retried: bool = False) -> None:
+        try:
+            res = self._prepare_fuse(slots)
+        except (KeyboardInterrupt, SystemExit):  # pragma: no cover
+            raise
+        except BaseException as exc:  # noqa: BLE001 — worker death
+            if isinstance(exc, Exception) or retried:
+                # Batch-scoped failure (or a second death): settle the
+                # members failed, exactly like a group-build error.
+                res = {"groups": [], "solo": [],
+                       "failed": [(list(slots), exc)]}
+            else:
+                # WorkerDeath-class (PERF.md §23): same restart-once +
+                # re-run recovery the job-build path gets — ship the
+                # death to the collector, which owns the executor.
+                self._built.put(("fuse_death", slots, exc))
+                self._wake.set()
+                return
+        self._built.put(("fuse", res))
+        self._wake.set()
 
     def _fuse_and_activate(self, slots: List["_Slot"]) -> None:
-        """Fuse a released staging batch: slots whose full packed keys
-        match (and that are individually pack-eligible) form fused
-        groups of the largest size ≥ 2 dividing the block count; the
-        rest — unique keys, ineligible plans, leftover odd members —
-        activate on the per-job dispatch path, exactly PR 8.  Packing
-        is an optimization, so every failure here is contained: an
-        eligibility-probe error demotes the job to solo dispatch, and a
-        group-build error (schema I/O, device memory on the packed
-        upload) fails ONLY the batch it was fusing — never the serve
-        thread."""
+        self._finish_fuse(self._prepare_fuse(slots))
+
+    def _prepare_fuse(self, slots: List["_Slot"]) -> dict:
+        """Fuse a released staging batch (the heavy, thread-safe half —
+        the slots are not yet active, so no other thread touches their
+        sweeps): slots whose full packed keys match (and that are
+        individually pack-eligible) form fused groups of the largest
+        size ≥ 2 dividing the block count; the rest — unique keys,
+        ineligible plans, leftover odd members — take the per-job
+        dispatch path, exactly PR 8.  Packing is an optimization, so
+        every failure here is contained: an eligibility-probe error
+        demotes the job to solo dispatch, and a group-build error
+        (schema I/O, device memory on the packed upload) fails ONLY the
+        batch it was fusing — never the serve thread."""
         from .fuse import build_fused_group, pack_candidate
 
+        out = {"groups": [], "solo": [], "failed": []}
         buckets: Dict[tuple, List[tuple]] = {}
-        solo: List[_Slot] = []
         for slot in slots:
             try:
                 cand = pack_candidate(slot.sweep, slot.job._resume_state)
             except Exception:  # noqa: BLE001 — probe error = solo path
                 cand = None
             if cand is None:
-                solo.append(slot)
+                out["solo"].append(slot)
             else:
                 buckets.setdefault(cand["key"], []).append((slot, cand))
         for _key, members in buckets.items():
@@ -794,21 +871,30 @@ class Engine:
                 try:
                     group = build_fused_group([c for _s, c in chosen])
                 except Exception as exc:  # noqa: BLE001 — batch-scoped
-                    for slot, _c in chosen:
-                        slot.machine.close()
-                        slot.job.error = exc
-                        self._settle_counts(slot.job, "failed")
+                    out["failed"].append(([s for s, _c in chosen], exc))
                     continue
                 if group is None:
-                    solo.extend(s for s, _c in chosen)
+                    out["solo"].extend(s for s, _c in chosen)
                     continue
-                for slot, _c in chosen:
-                    group.register(slot.sweep)
-                    self._activate(slot)
-                with self._lock:
-                    self._fused.append(group)
-            solo.extend(s for s, _c in members)
-        for slot in solo:
+                out["groups"].append((group, [s for s, _c in chosen]))
+            out["solo"].extend(s for s, _c in members)
+        return out
+
+    def _finish_fuse(self, res: dict) -> None:
+        """Activation-on-completion: the light half of a fuse build,
+        always on the collecting (serve/embedder) thread."""
+        for group, slots in res["groups"]:
+            for slot in slots:
+                group.register(slot.sweep)
+                self._activate(slot)
+            with self._lock:
+                self._fused.append(group)
+        for slots, exc in res["failed"]:
+            for slot in slots:
+                slot.machine.close()
+                slot.job.error = exc
+                self._settle_counts(slot.job, "failed")
+        for slot in res["solo"]:
             self._activate(slot)
 
     def _activate(self, slot: "_Slot") -> None:
@@ -1103,6 +1189,7 @@ class Engine:
 #: num_blocks to match the CLI flag).
 _JOB_CONFIG_FIELDS = {
     "lanes": "lanes", "blocks": "num_blocks", "superstep": "superstep",
+    "pair": "pair",
     "devices": "devices", "fetch_chunk": "fetch_chunk",
     "stream_chunk_words": "stream_chunk_words",
     "schema_cache": "schema_cache",

@@ -122,6 +122,18 @@ def pack_enabled() -> bool:
     )
 
 
+def pair_enabled() -> bool:
+    """Pair-lane tier knob (PERF.md §24): ``A5GEN_PAIR`` set to
+    ``off``/``0``/``no`` pins K=1 (one candidate per hash lane) instead
+    of packing two consecutive combination ranks into each lane where
+    the substitution geometry allows.  The candidate/hit streams are
+    identical either way; only per-candidate op cost differs.  One-
+    release escape hatch, same convention as ``A5GEN_PIPELINE``."""
+    return not env_opt_out(
+        "A5GEN_PAIR", "pair-lane (K=2) tier on for eligible schemas"
+    )
+
+
 def schema_cache_dir() -> "Optional[str]":
     """On-disk PieceSchema cache directory (``A5GEN_SCHEMA_CACHE``;
     empty/unset = no persistent cache).  ``SweepConfig.schema_cache`` /

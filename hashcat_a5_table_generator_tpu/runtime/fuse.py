@@ -135,6 +135,34 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         max_mb=sweep._schema_cache_max_mb(),
     )
     radix2 = k_opts_for(plan) == 1
+    # Pair-lane tier (PERF.md §24): the ONE decision point is the
+    # sweep's own gate, so packed and solo dispatches always agree.
+    # Pair-eligibility joins the compatibility key below — a K=2 job
+    # and a K=1 job trace different packed programs and never fuse.
+    pair_k = sweep._pair_k(plan, pieces, stride)
+    if pair_k is not None:
+        idx2 = superstep_index(plan, stride * pair_k)
+        aligned = w >= plan.batch or rank % (stride * pair_k) == 0
+        if idx2 is None or not aligned:
+            # int32 overflow at the doubled rank stride, or a resume
+            # cursor aligned for K=1 only: this job packs as a K=1
+            # tenant (its solo drive degrades the same way).
+            pair_k = None
+        else:
+            idx = idx2
+            cum, totals, total_blocks = idx
+            b0 = total_blocks if w >= plan.batch else (
+                int(cum[w]) + rank // (stride * pair_k)
+            )
+    rank_stride = stride * (pair_k or 1)
+    # Re-apply the int32 accumulator cap with the pair multiplier: a
+    # K=2 dispatch emits up to 2× the lanes per step, exactly as the
+    # solo drive's cap accounts for (sweep._superstep_static).
+    if pair_k is not None:
+        steps = max(1, min(
+            steps,
+            ((1 << 31) - 1) // max(1, cfg.lanes * n_devices * pair_k),
+        ))
     # Trailing-shape signature of the plan + piece arrays: equal
     # signatures concatenate row-wise with no padding, so the packed
     # arrays are byte-wise each job's solo arrays stacked.  Host-array
@@ -150,6 +178,7 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         sweep.spec, cfg.lanes, cfg.num_blocks, stride, steps,
         int(cfg.superstep_hit_cap), plan.out_width, windowed, n_devices,
         sweep._pipeline_depth(), sig, _pieces_static(pieces), radix2,
+        pair_k,
         # Fault-supervision knobs (PERF.md §23): the group runs ONE
         # retry policy and ONE fetch watchdog for every member, so
         # jobs that disagree on them must not fuse — a fail-fast
@@ -166,7 +195,11 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         "total_blocks": total_blocks,
         "b0": b0,
         "steps": steps,
-        "stride": stride,
+        # Cursor math walks in RANK stride units (pair_k × the lane
+        # stride); the kernel geometry keeps the lane stride.
+        "stride": rank_stride,
+        "lane_stride": stride,
+        "pair_k": pair_k,
         "n_devices": n_devices,
         "pieces": pieces,
         "radix2": radix2,
@@ -320,7 +353,8 @@ class FusedGroup:
         spec, cfg = sweep0.spec, sweep0.config
         self.n_seg = len(members)
         self.steps = m0["steps"]
-        self.stride = m0["stride"]
+        self.stride = m0["stride"]  # RANK stride (pair_k × lane stride)
+        self.pair_k = m0["pair_k"] or 0
         self._hit_cap = int(cfg.superstep_hit_cap)
         self._n_devices = m0["n_devices"]
         self._num_blocks = cfg.num_blocks
@@ -357,15 +391,17 @@ class FusedGroup:
 
         common = dict(
             num_lanes=cfg.lanes, out_width=m0["plan"].out_width,
-            block_stride=self.stride, num_blocks=cfg.num_blocks,
+            block_stride=m0["lane_stride"], num_blocks=cfg.num_blocks,
             steps=self.steps, hit_cap=self._hit_cap,
             total_blocks=int(blk_base[-1]), windowed=windowed,
             n_seg=self.n_seg, pieces=m0["pieces"], radix2=m0["radix2"],
+            pair_k=m0["pair_k"],
         )
         skey = ("packed-superstep", spec, self.n_seg, self._n_devices,
                 cfg.lanes, cfg.num_blocks, m0["plan"].out_width,
                 self.stride, self.steps, self._hit_cap, windowed,
-                _pieces_static(m0["pieces"]), m0["radix2"])
+                _pieces_static(m0["pieces"]), m0["radix2"],
+                m0["pair_k"])
         if self._n_devices == 1:
             self._p = {k: jnp.asarray(v) for k, v in plan_tree.items()}
             self._t = {k: jnp.asarray(v) for k, v in table_tree.items()}
@@ -594,6 +630,7 @@ class FusedGroup:
         telemetry.counter("engine.packed_lanes_occupied").add(occupied)
         telemetry.counter("engine.packed_lanes_total").add(
             self.steps * self._lanes * self._n_devices
+            * max(1, self.pair_k)
         )
         return True
 

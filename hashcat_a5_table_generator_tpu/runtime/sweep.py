@@ -172,6 +172,11 @@ def _pieces_static(pieces) -> "Optional[tuple]":
         pieces.max_out, pieces.gw is None, pieces.gw16 is None,
         pieces.gl is None, pieces.sel_bit is None,
         pieces.sel_slot is None,
+        # Pair-lane gate fields (PERF.md §24) are trace structure: the
+        # pair kernel bakes the patched group index and the static
+        # delta bounds into the program.
+        pieces.pair_ok, pieces.pair_g0, pieces.pair_dmin,
+        pieces.pair_dmax,
     )
 
 
@@ -223,6 +228,15 @@ class SweepConfig:
     #   and max_in_flight >= 2. False = barriered drive (fetch right after
     #   dispatch — the A/B arm). A5GEN_PIPELINE=off is the env escape
     #   hatch; the streams are identical either way.
+    pair: "Optional[int | str]" = None  # pair-lane tier (PERF.md §24):
+    #   K=2 candidates per hash lane where the substitution geometry
+    #   allows — the superstep executor's blocks then cover 2x the
+    #   candidate ranks per lane span, halving per-candidate message-
+    #   build cost (the schema-compile pair gate decides eligibility;
+    #   ineligible schemas keep K=1 exactly as before). None / 'auto' =
+    #   engage when eligible; 0 / 'off' = never. The candidate/hit
+    #   streams, checkpoints and fingerprints are identical either way;
+    #   A5GEN_PAIR=off is the env escape hatch (one release).
     superstep_hit_cap: int = 4096  # capped device (word, rank) hit buffer
     #   carried through the superstep scan, PER DEVICE. A superstep whose
     #   device-local hits exceed the cap is replayed exactly through the
@@ -1018,7 +1032,39 @@ class Sweep:
         # in-flight superstep).
         return max(1, int(cfg.max_in_flight))
 
-    def _superstep_static(self, plan, n_devices: int, mesh, step_ctx):
+    def _pair_k(self, plan, pieces, stride) -> "Optional[int]":
+        """The pair-lane decision for one compiled plan (PERF.md §24):
+        2 when the config, env hatch, schema pair gate, and wrapper
+        facts all admit K=2 candidates per lane, else None.  ONE
+        implementation — the fuse layer's ``pack_candidate`` calls this
+        too, so packed and solo dispatches can never disagree."""
+        from ..ops.pallas_expand import pair_for
+
+        cfg_pair = self.config.pair
+        if cfg_pair is not None and str(cfg_pair).lower() in (
+            "0", "off", "no", "false"
+        ):
+            return None
+        k = pair_for(self.spec, plan, pieces, block_stride=stride)
+        if k is None and str(cfg_pair).lower() in ("on", "1", "2", "true"):
+            # An EXPLICIT opt-in deserves a diagnostic when it can't be
+            # honored (the A5GEN_PALLAS=expand convention); auto falls
+            # back silently.
+            if not getattr(self, "_pair_warned", False):
+                self._pair_warned = True
+                import sys
+
+                print(
+                    "a5gen: warning: pair requested (--pair on) but "
+                    "this plan/config is not pair-eligible (schema "
+                    "gate, windowed decode, or hash-block count); "
+                    "running K=1",
+                    file=sys.stderr,
+                )
+        return k
+
+    def _superstep_static(self, plan, n_devices: int, mesh, step_ctx,
+                          force_solo: bool = False):
         """The cursor-independent half of the superstep build: the
         compiled step (shared via the step cache — the trace no longer
         bakes the sweep's block count, so equal-structure streaming
@@ -1038,14 +1084,30 @@ class Sweep:
         stride = cfg.resolve_block_stride()
         if stride is None:
             return None
-        idx = superstep_index(plan, stride)
+        # Pair-lane tier (PERF.md §24): blocks cover ``pair_k`` × the
+        # lane stride in CANDIDATE ranks, so the whole cursor fabric
+        # below (index, boundaries, checkpoints, replay ranges) walks
+        # in rank_stride units while the launch geometry stays
+        # ``cfg.lanes`` lanes.  An int32-overflowing pair index falls
+        # back to the solo tier rather than the per-launch path.
+        pair_k = (
+            None if force_solo
+            else self._pair_k(plan, step_ctx["pieces"], stride)
+        )
+        rank_stride = stride * (pair_k or 1)
+        idx = superstep_index(plan, rank_stride)
+        if idx is None and pair_k is not None:
+            pair_k, rank_stride = None, stride
+            idx = superstep_index(plan, stride)
         if idx is None:
             return None
         cum, _totals, total_blocks = idx
         # The superstep's device accumulator is int32: cap steps so a
         # worst case of every lane emitting cannot reach 2^31 per fetch.
         steps = max(1, min(
-            steps, ((1 << 31) - 1) // max(1, cfg.lanes * n_devices)
+            steps,
+            ((1 << 31) - 1)
+            // max(1, cfg.lanes * n_devices * (pair_k or 1)),
         ))
         # The tail superstep's device cursor overshoots the sweep end by
         # up to one full superstep (those blocks cut zero-count); the
@@ -1065,6 +1127,7 @@ class Sweep:
             fused_scalar_units=step_ctx["scalar_units"],
             radix2=step_ctx["radix2"],
             pieces=step_ctx["pieces"],
+            pair_k=pair_k,
         )
         # ``total_blocks`` rides the ss tree as data, so it is NOT key
         # material — chunks of different length share the program.
@@ -1072,7 +1135,7 @@ class Sweep:
                 cfg.num_blocks, plan.out_width, stride, steps, hit_cap,
                 common["windowed"], step_ctx["fused_opts"],
                 step_ctx["scalar_units"], step_ctx["radix2"],
-                _pieces_static(step_ctx["pieces"]))
+                _pieces_static(step_ctx["pieces"]), pair_k)
         if mesh is not None:
             skey = skey + (tuple(int(d.id) for d in mesh.devices.flat),)
         p, t, darrs = step_ctx["arrays"]
@@ -1083,7 +1146,7 @@ class Sweep:
                 self.spec, num_lanes=cfg.lanes, num_blocks=cfg.num_blocks,
                 **common,
             ))
-            ss = superstep_arrays(plan, stride, idx=idx)
+            ss = superstep_arrays(plan, rank_stride, idx=idx)
             make_bufs = lambda: superstep_buffers(hit_cap)  # noqa: E731
 
             def call(b: int, bufs):
@@ -1101,7 +1164,8 @@ class Sweep:
                     num_blocks=cfg.num_blocks, **common,
                 )
             )
-            ss = replicate(mesh, superstep_arrays(plan, stride, idx=idx))
+            ss = replicate(mesh, superstep_arrays(plan, rank_stride,
+                                                  idx=idx))
             nb = cfg.num_blocks
 
             def make_bufs():
@@ -1127,7 +1191,11 @@ class Sweep:
             "ss": ss,
             "key": skey,
             "steps": steps,
-            "stride": stride,
+            # Every cursor below (resume alignment, boundary decode,
+            # replay ranges) walks in RANK stride units — pair_k × the
+            # lane stride (PERF.md §24).
+            "stride": rank_stride,
+            "pair": pair_k or 0,
             "cum": cum,
             "total_blocks": total_blocks,
             "hit_cap": hit_cap,
@@ -1170,7 +1238,23 @@ class Sweep:
         ):
             w, rank = w + 1, 0
         if w < plan.batch and rank % stride:
-            return None
+            # Pair-misaligned but K=1-aligned (a checkpoint taken at an
+            # odd superstep boundary of a solo run): degrade to the K=1
+            # SUPERSTEP tier instead of the per-launch path — the same
+            # way pack_candidate degrades a misaligned tenant.  The
+            # region keeps the §15 dispatch amortization; only the pair
+            # multiplier is lost, and only for this resumed region.
+            lane_stride = stride // (st.get("pair") or 1)
+            if st.get("pair") and rank % lane_stride == 0:
+                st = step_ctx["ss_static"] = self._superstep_static(
+                    plan, n_devices, mesh, step_ctx, force_solo=True
+                )
+                if st is None:
+                    return None
+                cum, stride = st["cum"], st["stride"]
+                total_blocks = st["total_blocks"]
+            else:
+                return None
         b0 = total_blocks if w >= plan.batch else int(cum[w]) + rank // stride
         if w < plan.batch and block_cursor(plan, stride, cum, b0) != (w, rank):
             # Resume integrity: the executor's start block must round-trip
@@ -1226,7 +1310,8 @@ class Sweep:
         advance, depth = ss["advance"], ss["depth"]
         stats = {"supersteps": 0, "launches": 0, "replays": 0,
                  "retries": 0, "launches_per_fetch": ss["steps"],
-                 "pipelined": int(depth > 1)}
+                 "pipelined": int(depth > 1),
+                 "pair": int(ss.get("pair", 0))}
         free_bufs = [ss["make_bufs"]() for _ in range(depth)]
         inflight: deque = deque()
         b0 = ss["b0"]
@@ -1400,7 +1485,8 @@ class Sweep:
         stats = {"supersteps": 0, "launches": 0, "replays": 0,
                  "launches_per_fetch": src.steps,
                  "pipelined": int(src.depth > 1),
-                 "packed": src.n_seg}
+                 "packed": src.n_seg,
+                 "pair": int(getattr(src, "pair_k", 0))}
         try:
             while True:
                 res = src.next_result(self)

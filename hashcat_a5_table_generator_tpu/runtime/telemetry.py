@@ -399,11 +399,11 @@ class MergeSpec:
 
 
 #: ``SweepResult.superstep`` (PERF.md §15/§18): counters sum; the
-#: steps-per-fetch ratio and the pipelined flag describe one shared
-#: config, so they max.
+#: steps-per-fetch ratio and the pipelined/pair flags describe one
+#: shared config, so they max.
 SUPERSTEP_MERGE = MergeSpec(
     sum_keys=("supersteps", "launches", "replays", "retries"),
-    max_keys=("launches_per_fetch", "pipelined"),
+    max_keys=("launches_per_fetch", "pipelined", "pair"),
 )
 
 #: ``SweepResult.stream`` (PERF.md §19): walls/counters sum,

@@ -55,6 +55,14 @@ def main():
                          "(production default, PERF.md §17) or the "
                          "legacy per-byte unit scan (the A5GEN_EMIT="
                          "bytescan escape hatch)")
+    ap.add_argument("--pair", choices=("on", "off", "auto"),
+                    default="auto",
+                    help="pair-lane tier (K=2 candidates per lane, "
+                         "PERF.md §24): 'auto' (production default — "
+                         "engage when the schema's pair gate passes), "
+                         "'on' (error when ineligible), 'off' (the K=1 "
+                         "tier, reproducing the pre-§24 counts modulo "
+                         "the shared round/elision cuts)")
     ap.add_argument("--min-substitute", type=int, default=0,
                     help="count-window floor (tight windows produce "
                          "windowed plans — the DP-decode kernel)")
@@ -94,9 +102,23 @@ def main():
     k = pe.k_vals_for(plan)  # value-select width (joint closure tables)
     nb = 16
     stride = args.stride
+    pieces_maybe = (
+        piece_schema_for(plan, ct) if args.emit == "perslot" else None
+    )
+    pair_k = None
+    if args.pair != "off":
+        pair_k = pe.pair_for_config(
+            spec, plan, pieces_maybe, block_stride=stride
+        )
+        if pair_k is None and args.pair == "on":
+            raise SystemExit(
+                "--pair on: this plan/config is not pair-eligible "
+                "(schema gate, windowed decode, or hash-block count)"
+            )
+    rank_stride = stride * (pair_k or 1)
     batch, _, _ = make_blocks(
-        plan, start_word=0, start_rank=0, max_variants=nb * stride,
-        max_blocks=nb, fixed_stride=stride,
+        plan, start_word=0, start_rank=0, max_variants=nb * rank_stride,
+        max_blocks=nb, fixed_stride=rank_stride,
     )
     batch = pad_batch(batch, nb)
 
@@ -118,8 +140,8 @@ def main():
         block_stride=stride, k_opts=k, algo=args.algo, interpret=True,
         scalar_units=(not args.no_scalar_units
                       and pe.scalar_units_for(plan)),
-        pieces=(piece_schema_for(plan, ct) if args.emit == "perslot"
-                else None),
+        pieces=pieces_maybe,
+        pair=pair_k is not None,
     )
     if args.mode in ("default", "reverse"):
         fn = lambda: pe.fused_expand_md5(  # noqa: E731
@@ -140,7 +162,9 @@ def main():
 
     inner = kernel_jaxpr_of(jax.make_jaxpr(fn)())
     g = pe._G
-    ops, by_prim = count_kernel_ops(inner, g, stride)
+    # The pair tier yields 2 candidates per lane: normalize per
+    # CANDIDATE, exactly like the KERNEL_BUDGETS harness.
+    ops, by_prim = count_kernel_ops(inner, g, rank_stride)
     closed = getattr(plan, "closed", None)
     n_closed = int(closed.sum()) if closed is not None else 0
     pieces = common["pieces"]
@@ -148,7 +172,7 @@ def main():
     print(f"mode={args.mode} algo={args.algo} table={args.table} "
           f"stride={stride} slots={plan.num_slots} "
           f"tokens={plan.tokens.shape[1]} K={k} closed_words={n_closed} "
-          f"emit={emit}"
+          f"emit={emit} pair={pair_k or 1}"
           + (f" groups={pieces.num_groups}" if pieces is not None else ""))
     print(f"kernel vector ops per candidate: {ops:.0f}")
     for name, w in by_prim.most_common(12):

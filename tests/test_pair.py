@@ -1,0 +1,606 @@
+"""Pair-lane tier (K=2 candidates per hash lane, PERF.md §24).
+
+The pair tier must be STREAM-INVISIBLE: every test here pins the pair
+path's results equal to K=1 — hits by full (word_index, rank,
+candidate) tuples, candidate buffers byte-for-byte — across the XLA
+twin, the Pallas interpret kernels, the superstep drive, sharding, and
+resume.  Eligibility edges (odd innermost radix, windowed plans,
+bytescan hatch, multi-hash-block widths) must fall back to K=1, never
+change the stream.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import (
+    AttackSpec,
+    block_arrays,
+    build_plan,
+    plan_arrays,
+    table_arrays,
+)
+from hashcat_a5_table_generator_tpu.ops import pallas_expand as pe
+from hashcat_a5_table_generator_tpu.ops.blocks import make_blocks, pad_batch
+from hashcat_a5_table_generator_tpu.ops.expand_matches import expand_matches
+from hashcat_a5_table_generator_tpu.ops.expand_suball import expand_suball
+from hashcat_a5_table_generator_tpu.ops.hashes import HASH_FNS
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    pack_words,
+    piece_schema_for,
+)
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.runtime import Sweep, SweepConfig
+from hashcat_a5_table_generator_tpu.runtime.sinks import HitRecorder
+from hashcat_a5_table_generator_tpu.tables.compile import compile_table
+
+#: 1:1 option maps (radix 2 everywhere — even, so pair-eligible).
+#: STATIC delta: every value is 2 bytes (partner is +1 byte always).
+SUB_STATIC = {b"a": [b"@@"], b"o": [b"00"], b"s": [b"$$"], b"e": [b"33"]}
+#: DYNAMIC delta: 1- and 2-byte values mixed (delta 0 or +1 per word).
+SUB_DYN = {b"a": [b"@@"], b"o": [b"0"], b"s": [b"$"], b"e": [b"33"]}
+#: Odd innermost radix (2 options -> radix 3): pair-INELIGIBLE.
+SUB_ODD = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"]}
+
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a", b"assessor",
+         b"ease", b"oases"]
+
+
+def oracle_lines(spec, sub_map, words):
+    out = []
+    for w in words:
+        out.extend(
+            iter_candidates(
+                w, sub_map, spec.min_substitute, spec.max_substitute,
+                substitute_all=spec.mode.startswith("suball"),
+                reverse=spec.mode in ("reverse", "suball-reverse"),
+            )
+        )
+    return out
+
+
+def hit_tuples(res):
+    return [(h.word_index, h.variant_rank, h.candidate) for h in res.hits]
+
+
+def run_crack(spec, sub_map, words, digests, *, pair, devices=1,
+              superstep=None, **cfg_kw):
+    cfg = SweepConfig(lanes=64, num_blocks=16, superstep=superstep,
+                      devices=devices, pair=pair, **cfg_kw)
+    return Sweep(spec, sub_map, words, digests, config=cfg).run_crack()
+
+
+def _base_rank(plan, batch, b):
+    base = 0
+    scale = 1
+    w = int(batch.word[b])
+    for s in range(plan.num_slots):
+        base += int(batch.base_digits[b, s]) * scale
+        scale *= int(plan.pat_radix[w, s])
+    return base
+
+
+def _xla_stream(spec, ct, plan, *, pair_k, stride, nb):
+    """Whole-plan candidate stream via the XLA expand twin: sorted
+    (word, rank, bytes) tuples of every emitted candidate."""
+    p = plan_arrays(plan)
+    t = table_arrays(ct)
+    pieces = piece_schema_for(plan, ct)
+    rank_stride = stride * (pair_k or 1)
+    lanes = nb * stride
+    out = []
+    w, r = 0, 0
+    while w < plan.batch:
+        batch, w, r = make_blocks(
+            plan, start_word=w, start_rank=r,
+            max_variants=nb * rank_stride, max_blocks=nb,
+            fixed_stride=rank_stride,
+        )
+        if not len(batch.count):
+            break
+        batch = pad_batch(batch, nb)
+        b = block_arrays(batch, num_blocks=nb)
+        kw = dict(
+            num_lanes=lanes, out_width=int(plan.out_width),
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute, block_stride=stride,
+            radix2=pe.k_opts_for(plan) == 1, pieces=pieces,
+            pair_k=pair_k,
+        )
+        if spec.mode in ("default", "reverse"):
+            cand, clen, wr, emit = expand_matches(
+                p["tokens"], p["lengths"], p["match_pos"],
+                p["match_len"], p["match_radix"], p["match_val_start"],
+                t["val_bytes"], t["val_len"],
+                b["word"], b["base"], b["count"], b["offset"], **kw,
+            )
+        else:
+            cand, clen, wr, emit = expand_suball(
+                p["tokens"], p["lengths"], p["pat_radix"],
+                p["pat_val_start"], p["seg_orig_start"],
+                p["seg_orig_len"], p["seg_pat"],
+                t["val_bytes"], t["val_len"],
+                b["word"], b["base"], b["count"], b["offset"], **kw,
+            )
+        cand = np.asarray(cand)
+        clen = np.asarray(clen)
+        wr = np.asarray(wr)
+        emit = np.asarray(emit)
+        bases = [_base_rank(plan, batch, bi) for bi in range(nb)]
+        for i in np.nonzero(emit)[0]:
+            blk, rin = divmod(int(i), rank_stride)
+            out.append((int(wr[i]), bases[blk] + rin,
+                        bytes(cand[i, : clen[i]])))
+    return sorted(out)
+
+
+class TestPairGate:
+    """Schema-compile pair eligibility pins."""
+
+    def test_even_radix_match_schema_is_eligible(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(SUB_STATIC)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        sch = piece_schema_for(plan, ct)
+        assert sch.pair_ok
+        assert 0 in sch.groups[sch.pair_g0].sel_cols
+        # Every value is 2 bytes over 1-byte keys: the partner (chosen)
+        # variant is exactly one byte longer than the skip variant.
+        assert (sch.pair_dmin, sch.pair_dmax) == (1, 1)
+
+    def test_mixed_value_widths_bound_a_dynamic_delta(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(SUB_DYN)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        sch = piece_schema_for(plan, ct)
+        assert sch.pair_ok
+        assert sch.pair_dmin < sch.pair_dmax
+
+    def test_odd_innermost_radix_is_ineligible(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(SUB_ODD)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        sch = piece_schema_for(plan, ct)
+        assert not sch.pair_ok
+
+    def test_wrapper_gate_rejects_windowed_and_wide(self):
+        ct = compile_table(SUB_STATIC)
+        spec = AttackSpec(mode="default", algo="md5", min_substitute=1,
+                          max_substitute=1)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        pieces = piece_schema_for(plan, ct)
+        if getattr(plan, "windowed", False):
+            assert pe.pair_for_config(
+                spec, plan, pieces, block_stride=64
+            ) is None
+        # Multi-hash-block widths keep K=1 (nothing idle to amortize).
+        spec2 = AttackSpec(mode="default", algo="md5")
+        long_words = [bytes(range(97, 123)) * 2 + b"ab"]  # 54 bytes
+        plan2 = build_plan(spec2, ct, pack_words(long_words))
+        pieces2 = piece_schema_for(plan2, ct)
+        assert int(plan2.out_width) > 55
+        assert pe.pair_for_config(
+            spec2, plan2, pieces2, block_stride=64
+        ) is None
+
+    def test_fused_wrapper_raises_on_bypassed_gate(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(SUB_ODD)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        p = plan_arrays(plan)
+        t = table_arrays(ct)
+        batch, _, _ = make_blocks(plan, max_variants=8 * 256,
+                                  max_blocks=8, fixed_stride=256)
+        b = block_arrays(pad_batch(batch, 8), num_blocks=8)
+        with pytest.raises(ValueError, match="pair"):
+            pe.fused_expand_md5(
+                p["tokens"], p["lengths"], p["match_pos"],
+                p["match_len"], p["match_radix"], p["match_val_start"],
+                t["val_bytes"], t["val_len"],
+                b["word"], b["base"], b["count"],
+                num_lanes=8 * 128, out_width=int(plan.out_width),
+                min_substitute=spec.effective_min,
+                max_substitute=spec.max_substitute, block_stride=128,
+                k_opts=pe.k_vals_for(plan), interpret=True,
+                pieces=piece_schema_for(plan, ct), pair=True,
+            )
+
+
+class TestXlaPairParity:
+    """The XLA twin: pair streams == solo streams, byte for byte."""
+
+    @pytest.mark.parametrize("mode", [
+        "default", pytest.param("suball", marks=pytest.mark.slow),
+    ])
+    @pytest.mark.parametrize("sub", [
+        SUB_STATIC, pytest.param(SUB_DYN, marks=pytest.mark.slow),
+    ], ids=["static-delta", "dynamic-delta"])
+    def test_pair_stream_equals_solo(self, mode, sub):
+        spec = AttackSpec(mode=mode, algo="md5")
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words(WORDS))
+        pieces = piece_schema_for(plan, ct)
+        if not pieces.pair_ok:
+            pytest.skip("suball schema maps slot 0 off column 0 here")
+        solo = _xla_stream(spec, ct, plan, pair_k=None, stride=8, nb=4)
+        pair = _xla_stream(spec, ct, plan, pair_k=2, stride=8, nb=4)
+        assert solo == pair
+        assert len(solo) == len(oracle_lines(spec, sub, WORDS))
+
+    def test_suball_single_occurrence_pattern_pairs(self):
+        """A suball schema IS pair-eligible when pattern slot 0 drives
+        column 0 and nothing else — one occurrence per word."""
+        sub = {b"a": [b"@@"]}
+        words = [b"xaz", b"za", b"a", b"zzz", b"qqa"]
+        spec = AttackSpec(mode="suball", algo="md5")
+        ct = compile_table(sub)
+        plan = build_plan(spec, ct, pack_words(words))
+        assert piece_schema_for(plan, ct).pair_ok
+        solo = _xla_stream(spec, ct, plan, pair_k=None, stride=8, nb=2)
+        pair = _xla_stream(spec, ct, plan, pair_k=2, stride=8, nb=2)
+        assert solo == pair
+        assert len(solo) == len(oracle_lines(spec, sub, words))
+
+    @pytest.mark.slow
+    def test_seeded_fuzz_random_words(self):
+        rng = np.random.default_rng(7)
+        spec = AttackSpec(mode="default", algo="md5")
+        ct = compile_table(SUB_DYN)
+        for _ in range(2):
+            words = [
+                bytes(rng.choice(list(b"aoeszx"),
+                                 size=rng.integers(1, 9)))
+                for _ in range(12)
+            ]
+            plan = build_plan(spec, ct, pack_words(words))
+            solo = _xla_stream(spec, ct, plan, pair_k=None, stride=8,
+                               nb=4)
+            pair = _xla_stream(spec, ct, plan, pair_k=2, stride=8, nb=4)
+            assert solo == pair
+
+
+class TestPallasInterpretPairParity:
+    """The fused piece kernels in interpret mode: pair emit masks and
+    digests equal the XLA pair twin for every emitted lane."""
+
+    def test_scalar_tier_matches_xla(self):
+        self._check("md5", scalar_units=True)
+
+    def test_general_tier_matches_xla(self):
+        self._check("md5", scalar_units=False)
+
+    @pytest.mark.slow
+    @pytest.mark.parametrize("algo", ["ntlm", "sha1", "md4"])
+    def test_more_algos_match_xla(self, algo):
+        self._check(algo, scalar_units=True)
+
+    def _check(self, algo, *, scalar_units):
+        spec = AttackSpec(mode="default", algo=algo)
+        ct = compile_table(SUB_DYN)
+        words = [b"ase", b"oo", b"z", b"seas", b"es"]
+        plan = build_plan(spec, ct, pack_words(words))
+        pieces = piece_schema_for(plan, ct)
+        assert pieces.pair_ok
+        p = plan_arrays(plan)
+        t = table_arrays(ct)
+        stride, nb = 128, 8
+        batch, _, _ = make_blocks(plan, max_variants=nb * 2 * stride,
+                                  max_blocks=nb, fixed_stride=2 * stride)
+        batch = pad_batch(batch, nb)
+        b = block_arrays(batch, num_blocks=nb)
+        kw = dict(
+            num_lanes=nb * stride, out_width=int(plan.out_width),
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute, block_stride=stride,
+            radix2=True, pieces=pieces, pair_k=2,
+        )
+        cand, clen, _w, emit = expand_matches(
+            p["tokens"], p["lengths"], p["match_pos"], p["match_len"],
+            p["match_radix"], p["match_val_start"],
+            t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"], b["offset"], **kw,
+        )
+        want_state = np.asarray(HASH_FNS[algo](cand, clen))
+        want_emit = np.asarray(emit)
+        state, got_emit = pe.fused_expand_md5(
+            p["tokens"], p["lengths"], p["match_pos"], p["match_len"],
+            p["match_radix"], p["match_val_start"],
+            t["val_bytes"], t["val_len"],
+            b["word"], b["base"], b["count"],
+            num_lanes=nb * stride, out_width=int(plan.out_width),
+            min_substitute=spec.effective_min,
+            max_substitute=spec.max_substitute, block_stride=stride,
+            k_opts=pe.k_vals_for(plan), algo=algo, interpret=True,
+            scalar_units=scalar_units and pe.scalar_units_for(plan),
+            pieces=pieces, pair=True,
+        )
+        state = np.asarray(state)
+        got_emit = np.asarray(got_emit)
+        assert (got_emit == want_emit).all()
+        bad = np.nonzero(want_emit & (state != want_state).any(axis=1))[0]
+        assert bad.size == 0, f"digest mismatch at candidate rows {bad[:8]}"
+
+
+class TestPairSweepParity:
+    """End to end through the superstep drive."""
+
+    @pytest.mark.parametrize("sub", [
+        SUB_STATIC,
+        pytest.param(SUB_DYN, marks=pytest.mark.slow),
+    ], ids=["static-delta", "dynamic-delta"])
+    def test_pair_on_off_and_per_launch_agree(self, sub):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, sub, WORDS)
+        planted = sorted({oracle[0], oracle[len(oracle) // 3],
+                          oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        on = run_crack(spec, sub, WORDS, digests, pair=None)
+        off = run_crack(spec, sub, WORDS, digests, pair="off")
+        solo = run_crack(spec, sub, WORDS, digests, pair=None,
+                         superstep=0)
+        assert on.superstep["pair"] == 2
+        assert off.superstep["pair"] == 0
+        assert on.n_emitted == off.n_emitted == solo.n_emitted \
+            == len(oracle)
+        assert hit_tuples(on) == hit_tuples(off) == hit_tuples(solo)
+        assert {h.candidate for h in on.hits} == set(planted)
+
+    def test_ineligible_schema_falls_back_to_solo(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_ODD, WORDS)
+        digests = [hashlib.md5(oracle[-1]).digest()]
+        res = run_crack(spec, SUB_ODD, WORDS, digests, pair=None)
+        assert res.superstep["pair"] == 0  # odd radix: gate refused
+        assert res.n_emitted == len(oracle)
+
+    def test_sharded_pair_parity(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_STATIC, WORDS)
+        planted = sorted({oracle[1], oracle[-1]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        one = run_crack(spec, SUB_STATIC, WORDS, digests, pair=None)
+        eight = run_crack(spec, SUB_STATIC, WORDS, digests, pair=None,
+                          devices=8)
+        assert eight.superstep["pair"] == 2
+        assert hit_tuples(one) == hit_tuples(eight)
+        assert eight.n_emitted == one.n_emitted == len(oracle)
+
+    @pytest.mark.slow
+    def test_overflow_replays_through_solo_path(self):
+        """A pair superstep whose hit buffer overflows replays its block
+        range per-launch (K=1) — hits must still be exact."""
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_STATIC, WORDS)
+        digests = [hashlib.md5(c).digest() for c in oracle[:40]]
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=None,
+                          superstep_hit_cap=4, pair=None)
+        res = Sweep(spec, SUB_STATIC, WORDS, digests,
+                    config=cfg).run_crack()
+        assert res.superstep["pair"] == 2
+        assert res.superstep["replays"] >= 1
+        want = run_crack(spec, SUB_STATIC, WORDS, digests, pair="off",
+                         superstep=0)
+        assert hit_tuples(res) == hit_tuples(want)
+        assert res.n_hits == 40
+
+
+class TestPairPacked:
+    """The pair tier through the resident engine's packed dispatch."""
+
+    @pytest.mark.slow
+    def test_packed_pair_tenants_byte_parity(self):
+        from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from tests.test_engine import cfg, full_hits
+
+        spec = AttackSpec(mode="default", algo="md5")
+        lists = [WORDS, WORDS[::-1]]
+        jobs = []
+        for i, words in enumerate(lists):
+            oracle = oracle_lines(spec, SUB_STATIC, words)
+            digests = [hashlib.md5(oracle[0]).digest(),
+                       hashlib.md5(oracle[-1]).digest(),
+                       hashlib.md5(b"tenant-%d" % i).digest()]
+            jobs.append((words, digests))
+        c = cfg(superstep=2)
+        want = [
+            Sweep(spec, SUB_STATIC, w, d, config=c).run_crack(
+                resume=False
+            )
+            for w, d in jobs
+        ]
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, SUB_STATIC, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        for g, w in zip(got, want):
+            assert g.superstep.get("packed") == 2
+            assert g.superstep.get("pair") == 2
+            assert full_hits(g) == full_hits(w)
+            assert g.n_emitted == w.n_emitted
+
+    @pytest.mark.slow
+    def test_fuse_build_worker_death_restarts_once(self):
+        """A WorkerDeath during the off-thread fuse build restarts the
+        admission worker once and re-runs the SAME batch — the tenants
+        still fuse and stay byte-identical to solo (the job-build
+        path's recovery, extended to the fuse seam)."""
+        from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from hashcat_a5_table_generator_tpu.runtime.faults import (
+            WorkerDeath,
+        )
+        from tests.test_engine import cfg, full_hits
+
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = []
+        for words in (WORDS, WORDS[::-1]):
+            o = oracle_lines(spec, SUB_STATIC, words)
+            jobs.append((words, [hashlib.md5(o[0]).digest(),
+                                 hashlib.md5(o[-1]).digest()]))
+        c = cfg(superstep=2)
+        want = [
+            Sweep(spec, SUB_STATIC, w, d, config=c).run_crack(
+                resume=False
+            )
+            for w, d in jobs
+        ]
+        eng = Engine(c, auto=False)
+        orig = eng._prepare_fuse
+        fired = []
+
+        def dying(slots):
+            if not fired:
+                fired.append(True)
+                raise WorkerDeath("injected fuse-build death")
+            return orig(slots)
+
+        eng._prepare_fuse = dying
+        handles = [eng.submit(spec, SUB_STATIC, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        got = [h.result(timeout=5) for h in handles]
+        eng.close()
+        assert fired
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert g.superstep.get("packed") == 2
+
+    @pytest.mark.slow
+    def test_pair_and_solo_configs_never_fuse(self):
+        from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from tests.test_engine import cfg, full_hits
+
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_STATIC, WORDS)
+        digests = [hashlib.md5(oracle[-1]).digest()]
+        c_on = cfg(superstep=2)
+        c_off = cfg(superstep=2, pair=0)
+        want = Sweep(spec, SUB_STATIC, WORDS, digests,
+                     config=c_on).run_crack(resume=False)
+        eng = Engine(c_on, auto=False)
+        h1 = eng.submit(spec, SUB_STATIC, WORDS, digests)
+        h2 = eng.submit(spec, SUB_STATIC, WORDS, digests, config=c_off)
+        eng.run_until_idle()
+        g1, g2 = h1.result(timeout=0), h2.result(timeout=0)
+        eng.close()
+        # Disagreeing pair knobs = different static programs: neither
+        # packs with the other, both streams stay exact.
+        assert g1.superstep.get("packed") is None
+        assert g2.superstep.get("packed") is None
+        assert g1.superstep.get("pair") == 2
+        assert g2.superstep.get("pair") == 0
+        assert full_hits(g1) == full_hits(g2) == full_hits(want)
+
+
+class TestPairResume:
+    """Checkpoints are (word, rank) cursors — pair and solo runs resume
+    each other's checkpoints byte-exactly."""
+
+    @pytest.mark.parametrize("first_pair,second_pair", [
+        (None, "off"),
+        pytest.param("off", None, marks=pytest.mark.slow),
+    ], ids=["pair-to-solo", "solo-to-pair"])
+    def test_cross_tier_resume(self, tmp_path, first_pair, second_pair):
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_STATIC, WORDS)
+        # A LATE planted hit: several superstep boundaries (and
+        # checkpoints, every_s=0) pass before the recorder explodes, so
+        # the resumed run really starts mid-sweep.
+        planted = sorted({oracle[-2]})
+        digests = [hashlib.md5(c).digest() for c in planted]
+        want = run_crack(spec, SUB_STATIC, WORDS, digests, pair=None)
+
+        path = str(tmp_path / "pair.json")
+        cfg = SweepConfig(lanes=64, num_blocks=16, superstep=1,
+                          pair=first_pair, checkpoint_path=path,
+                          checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                raise Boom()
+
+        first = Sweep(spec, SUB_STATIC, WORDS, digests, config=cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+
+        cfg2 = SweepConfig(lanes=64, num_blocks=16, superstep=1,
+                           pair=second_pair, checkpoint_path=path,
+                           checkpoint_every_s=0.0)
+        got = Sweep(spec, SUB_STATIC, WORDS, digests,
+                    config=cfg2).run_crack()
+        assert got.resumed
+        assert sorted(h.candidate for h in got.hits) == sorted(
+            h.candidate for h in want.hits
+        )
+        # A cross-tier resume must stay on the SUPERSTEP executor —
+        # a K=1 checkpoint misaligned for K=2 degrades to the K=1
+        # superstep tier, never to the per-launch path.
+        assert got.superstep.get("supersteps", 0) >= 1
+
+
+@pytest.mark.slow
+def test_pair_ab_record_shape():
+    """bench --pair-ab end to end at toy scale: one JSON line with the
+    per-arm instruments, pair engaged, parity enforced by the bench."""
+    import json
+    import pathlib
+    import subprocess
+    import sys
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    out = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--pair-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "64", "--seconds", "1"],
+        capture_output=True, text=True, timeout=600,
+        cwd=str(repo),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "pair_lane_ab"
+    assert rec["pair_k"] == 2
+    assert rec["solo"]["emitted_per_sweep"] == \
+        rec["pair"]["emitted_per_sweep"]
+    assert rec["pair"]["dispatches_per_sweep"] <= \
+        rec["solo"]["dispatches_per_sweep"]
+    for arm in ("solo", "pair"):
+        assert rec[arm]["hashes_per_sec"] > 0
+        assert rec[arm]["ops_per_candidate"]
+    assert 0 < rec["eligibility_share"] <= 1.0
+
+
+class TestPairEscapeHatches:
+    def test_env_off_disables_pair(self, monkeypatch):
+        monkeypatch.setenv("A5GEN_PAIR", "off")
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_STATIC, WORDS)
+        digests = [hashlib.md5(oracle[0]).digest()]
+        res = run_crack(spec, SUB_STATIC, WORDS, digests, pair=None)
+        assert res.superstep["pair"] == 0
+        assert res.n_emitted == len(oracle)
+
+    def test_env_typo_warns_and_keeps_default(self, monkeypatch, capsys):
+        import hashcat_a5_table_generator_tpu.runtime.env as env
+
+        monkeypatch.setenv("A5GEN_PAIR", "offf")
+        monkeypatch.setattr(env, "_WARNED", set())
+        assert env.pair_enabled()  # typo keeps the default (on)
+        err = capsys.readouterr().err
+        assert "A5GEN_PAIR" in err and "offf" in err
+        # once per value
+        assert env.pair_enabled()
+        assert "A5GEN_PAIR" not in capsys.readouterr().err
+
+    def test_bytescan_hatch_keeps_k1(self, monkeypatch):
+        monkeypatch.setenv("A5GEN_EMIT", "bytescan")
+        spec = AttackSpec(mode="default", algo="md5")
+        oracle = oracle_lines(spec, SUB_STATIC, WORDS)
+        digests = [hashlib.md5(oracle[0]).digest()]
+        res = run_crack(spec, SUB_STATIC, WORDS, digests, pair=None)
+        # No piece schema under bytescan -> no pair tier, same stream.
+        assert res.superstep["pair"] == 0
+        assert res.n_emitted == len(oracle)

@@ -159,6 +159,9 @@ class _Fixtures:
         return self._cache[key]
 
     def blocks(self, plan, nb: int = _NB, stride: int = _STRIDE):
+        """``stride`` is the RANK stride — the pair tier (PERF.md §24)
+        cuts blocks covering ``2 * _STRIDE`` candidate ranks per
+        ``_STRIDE``-lane block."""
         from hashcat_a5_table_generator_tpu.ops.blocks import (
             make_blocks,
             pad_batch,
@@ -180,8 +183,15 @@ _FIX = _Fixtures()
 
 
 def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
-                 words_key: str = "rockyou") -> Tuple[Callable, int, int]:
-    """The roofline trace: one fused-kernel launch at the §7a geometry."""
+                 words_key: str = "rockyou",
+                 pair: str = "auto") -> Tuple[Callable, int, int]:
+    """The roofline trace: one fused-kernel launch at the §7a geometry.
+
+    ``pair``: the pair-lane tier (PERF.md §24) — ``"auto"`` matches
+    production (K=2 when the schema's pair gate passes; the counter's
+    tile then normalizes per CANDIDATE, ``2 * _STRIDE`` per block row),
+    ``"off"`` pins the K=1 tier (the ``--pair off`` reproducibility
+    arm)."""
     from hashcat_a5_table_generator_tpu.models.attack import (
         block_arrays,
         plan_arrays,
@@ -192,7 +202,14 @@ def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
 
     spec, plan = _FIX.plan(mode, algo, words_key)
     ct = _FIX.table()
-    batch = _FIX.blocks(plan)
+    pieces = piece_schema_for(plan, ct)
+    pair_k = None
+    if pair != "off":
+        pair_k = _pe.pair_for_config(
+            spec, plan, pieces, block_stride=_STRIDE
+        )
+    rank_stride = _STRIDE * (pair_k or 1)
+    batch = _FIX.blocks(plan, stride=rank_stride)
     p = plan_arrays(plan)
     t = table_arrays(ct)
     b = block_arrays(batch, num_blocks=_NB)
@@ -207,7 +224,8 @@ def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
         scalar_units=scalar_units and _pe.scalar_units_for(plan),
         # The production emission scheme: per-slot pieces when the plan
         # qualifies (A5GEN_EMIT=bytescan pins the legacy scan instead).
-        pieces=piece_schema_for(plan, ct),
+        pieces=pieces,
+        pair=pair_k is not None,
     )
     if mode in ("default", "reverse"):
         fn = lambda: _pe.fused_expand_md5(  # noqa: E731
@@ -225,33 +243,49 @@ def _fused_thunk(mode: str, algo: str, *, scalar_units: bool = True,
             close_next=p.get("close_next"), close_mul=p.get("close_mul"),
             **common,
         )
-    return fn, _pe._G, _STRIDE
+    return fn, _pe._G, rank_stride
 
 
 def budget_configs() -> Dict[str, BudgetConfig]:
-    """The pinned kernel tiers, keyed as in ``KERNEL_BUDGETS.json``."""
+    """The pinned kernel tiers, keyed as in ``KERNEL_BUDGETS.json``.
+
+    Tiers whose §7a geometry passes the pair gate (scalar / sha1 /
+    ntlm / general — single hash block, even innermost radix) pin the
+    PRODUCTION default since PERF.md §24: the pair-lane (K=2) kernel,
+    counted per candidate.  ``scalar-solo`` pins the K=1 tier of the
+    same geometry (the ``A5GEN_PAIR=off`` escape hatch and the
+    ``--pair off`` roofline arm); suball (slot 0 not bound to column
+    0 at this geometry) and 2-hash-block (multi-block) fall back to
+    K=1 automatically and pin that."""
     mk = BudgetConfig
     return {
         c.key: c
         for c in (
             mk("scalar", "ops.fused_expand_md5",
-               "default/md5 scalar-units tier (§7a headline)",
+               "default/md5 scalar-units tier (§7a headline; pair K=2)",
                lambda: _fused_thunk("default", "md5")),
+            mk("scalar-solo", "ops.fused_expand_md5",
+               "default/md5 scalar-units tier, pair OFF (K=1 — the "
+               "A5GEN_PAIR=off arm)",
+               lambda: _fused_thunk("default", "md5", pair="off")),
             mk("suball", "ops.fused_expand_suball_md5",
                "suball/md5 scalar-units tier",
                lambda: _fused_thunk("suball", "md5")),
             mk("sha1", "ops.fused_expand_md5",
-               "default/sha1 scalar-units tier (80-round schedule)",
+               "default/sha1 scalar-units tier (80-round schedule; "
+               "pair K=2)",
                lambda: _fused_thunk("default", "sha1")),
             mk("general", "ops.fused_expand_md5",
-               "default/md5 general kernel (K-way select, f32 decode)",
+               "default/md5 general kernel (K-way select, f32 decode; "
+               "pair K=2)",
                lambda: _fused_thunk("default", "md5", scalar_units=False),
                float_free=False),
             mk("2-hash-block", "ops.fused_expand_md5",
                "default/md5 at out_width 120 (2 chained hash blocks)",
                lambda: _fused_thunk("default", "md5", words_key="long")),
             mk("ntlm", "ops.fused_expand_md5",
-               "default/ntlm scalar-units tier (UTF-16LE expansion)",
+               "default/ntlm scalar-units tier (UTF-16LE expansion; "
+               "pair K=2)",
                lambda: _fused_thunk("default", "ntlm")),
         )
     }
