@@ -240,6 +240,24 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--serve-ab: equal small jobs per arm (default "
                          "4 — the N the §20 amortization criterion is "
                          "stated at)")
+    ap.add_argument("--fleet-ab", action="store_true",
+                    help="measure routed vs direct serve (PERF.md "
+                         "§25): the same N equal small jobs driven "
+                         "through one engine process directly over "
+                         "its unix socket, then through the same "
+                         "engine behind a FleetRouter — steady-state "
+                         "(each arm pre-warms with one untimed job), "
+                         "parity-asserted per-job emitted/hit counts, "
+                         "aggregate wall ratio (the router "
+                         "passthrough-overhead instrument; bar: "
+                         "within 5%%) — one JSON line. Spawns engine "
+                         "subprocesses; defaults to the §20 contract "
+                         "geometry like --serve-ab")
+    ap.add_argument("--fleet-place", choices=("affinity", "round-robin"),
+                    default="affinity",
+                    help="--fleet-ab: router placement arm (the "
+                         "round-robin control measures the same "
+                         "passthrough without affinity lookups)")
     ap.add_argument("--pack-ab", action="store_true",
                     help="measure cross-job packed dispatch (PERF.md "
                          "§22) against the per-job round-robin: N "
@@ -1092,6 +1110,212 @@ def run_serve_ab(args: argparse.Namespace) -> None:
         "compile_ratio": (
             cold["programs_compiled"]
             / max(engine["programs_compiled"], 1)
+        ),
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+def run_fleet_ab(args: argparse.Namespace) -> None:
+    """A/B routed vs direct serve on the §20 contract (PERF.md §25):
+    arm DIRECT drives N equal small jobs against one freshly spawned
+    ``a5gen serve`` engine over its unix socket; arm ROUTED drives the
+    identical jobs against an identically spawned engine behind a
+    :class:`FleetRouter`.  Both arms pre-warm with one untimed job so
+    the measured window is the steady-state hot path (the router adds
+    JSON re-framing + a table lookup per event — the §25 acceptance
+    bar is within 5% aggregate wall).  Parity-asserts per-job
+    emitted/hit counts across arms; prints ONE JSON line.
+
+    Runs NO jax in this process — both arms' device work happens in
+    the engine subprocesses, so the bench process never competes with
+    them for the backend."""
+    import os
+    import shutil
+    import socket
+    import tempfile
+
+    from hashcat_a5_table_generator_tpu.runtime.fleet import (
+        FleetRouter,
+        spawn_engines,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    n_jobs = max(2, int(args.serve_jobs))
+    words = synth_wordlist(args.words)
+    sub_map = get_layout(args.table).to_substitution_map()
+    import hashlib as _hashlib
+
+    digests = [
+        _hashlib.new(args.algo, b"bench-decoy-%d" % i).digest()
+        for i in range(1024)
+    ]
+    job_fields = {
+        "words": [w.decode() for w in words],
+        "table_map": {
+            k.decode(): [v.decode() for v in vals]
+            for k, vals in sub_map.items()
+        },
+        "algo": args.algo,
+        "mode": args.mode,
+        "digest_list": [d.hex() for d in digests],
+        "config": {"lanes": lanes, "blocks": nb},
+    }
+    env = dict(os.environ)
+    if args.platform:
+        env["JAX_PLATFORMS"] = args.platform
+
+    def spawn_one(tag: str):
+        d = tempfile.mkdtemp(prefix=f"a5-fleet-ab-{tag}-")
+        specs = spawn_engines(
+            1, d,
+            engine_args=["--lanes", str(lanes), "--blocks", str(nb),
+                         "--schema-cache", os.path.join(d, "cache")],
+            engine_id_prefix=tag, env=env,
+        )
+        return d, specs[0]
+
+    def direct_arm() -> dict:
+        d, (sock_path, _eid, proc) = spawn_one("direct")
+        conn = None
+        try:
+            deadline = time.monotonic() + 300
+            while True:
+                try:
+                    conn = socket.socket(socket.AF_UNIX)
+                    conn.connect(sock_path)
+                    break
+                except OSError:
+                    conn.close()
+                    conn = None
+                    if proc.poll() is not None:
+                        raise SystemExit(
+                            "--fleet-ab: direct-arm engine exited "
+                            f"with {proc.returncode}"
+                        )
+                    if time.monotonic() > deadline:
+                        raise SystemExit(
+                            "--fleet-ab: direct-arm engine never "
+                            "listened"
+                        )
+                    time.sleep(0.2)
+            f = conn.makefile("rw", encoding="utf-8")
+
+            def run_jobs(ids):
+                per = {}
+                for j in ids:
+                    f.write(json.dumps(
+                        {**job_fields, "op": "submit", "id": j}
+                    ) + "\n")
+                f.flush()
+                while len(per) < len(ids):
+                    ev = json.loads(f.readline())
+                    if ev.get("event") == "done":
+                        per[ev["id"]] = {
+                            "n_emitted": ev["n_emitted"],
+                            "n_hits": ev["n_hits"],
+                        }
+                    elif ev.get("event") in ("failed", "error"):
+                        raise SystemExit(
+                            f"--fleet-ab direct arm failed: {ev}"
+                        )
+                return per
+
+            run_jobs(["warm0"])  # untimed: the compile lands here
+            t0 = time.perf_counter()
+            per = run_jobs([f"d{i}" for i in range(n_jobs)])
+            wall = time.perf_counter() - t0
+            f.write('{"op":"shutdown"}\n')
+            f.flush()
+            proc.wait(timeout=60)
+            return {
+                "wall_s": wall,
+                "jobs_per_sec": n_jobs / max(wall, 1e-9),
+                "jobs": [per[f"d{i}"] for i in range(n_jobs)],
+            }
+        finally:
+            if conn is not None:
+                conn.close()
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+            shutil.rmtree(d, ignore_errors=True)
+
+    def routed_arm() -> dict:
+        d, (sock_path, eid, proc) = spawn_one("routed")
+        router = FleetRouter(place=args.fleet_place, poll_s=1.0)
+        try:
+            router.attach(sock_path, eid, proc=proc, timeout=300)
+            events: dict = {}
+
+            def submit(j):
+                events[j] = []
+                router.submit({**job_fields, "op": "submit", "id": j},
+                              emit=events[j].append)
+
+            def done_of(j):
+                if not router.wait(j, timeout=600):
+                    raise SystemExit(
+                        f"--fleet-ab routed arm: job {j} never settled"
+                    )
+                done = [e for e in events[j]
+                        if e.get("event") == "done"]
+                if not done:
+                    raise SystemExit(
+                        f"--fleet-ab routed arm: job {j} settled "
+                        f"{router.job(j).state} — {events[j][-3:]}"
+                    )
+                return {"n_emitted": done[0]["n_emitted"],
+                        "n_hits": done[0]["n_hits"]}
+
+            submit("warm0")
+            done_of("warm0")
+            t0 = time.perf_counter()
+            for i in range(n_jobs):
+                submit(f"r{i}")
+            jobs = [done_of(f"r{i}") for i in range(n_jobs)]
+            wall = time.perf_counter() - t0
+            return {
+                "wall_s": wall,
+                "jobs_per_sec": n_jobs / max(wall, 1e-9),
+                "jobs": jobs,
+            }
+        finally:
+            router.close(shutdown_engines=True)
+            shutil.rmtree(d, ignore_errors=True)
+
+    direct = direct_arm()
+    routed = routed_arm()
+    per_arm = [
+        tuple((j["n_emitted"], j["n_hits"]) for j in arm["jobs"])
+        for arm in (direct, routed)
+    ]
+    if len(set(per_arm)) != 1 or not all(
+        j["n_emitted"] > 0 for j in direct["jobs"]
+    ):
+        raise SystemExit(
+            f"--fleet-ab arms diverged: per-job counts {per_arm} — "
+            "refusing to report timings for non-identical work"
+        )
+    record = {
+        "metric": "fleet_ab",
+        "unit": "seconds (aggregate wall) + jobs/sec",
+        "platform": args.platform or "default",
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "jobs": n_jobs,
+        "place": args.fleet_place,
+        "direct": direct,
+        "routed": routed,
+        # The §25 passthrough instrument: routed wall over direct wall
+        # (1.0 = free; the acceptance bar is <= 1.05 on the §20
+        # contract).
+        "wall_ratio": routed["wall_s"] / max(direct["wall_s"], 1e-9),
+        "overhead_pct": 100.0 * (
+            routed["wall_s"] / max(direct["wall_s"], 1e-9) - 1.0
         ),
     }
     print(json.dumps(record))
@@ -2375,7 +2599,7 @@ def main() -> None:
             2048
             if (args.superstep_ab or args.stride_ab or args.pipeline_ab
                 or args.stream_ab or args.serve_ab or args.telemetry_ab
-                or args.pack_ab or args.pair_ab)
+                or args.pack_ab or args.pair_ab or args.fleet_ab)
             else (1 << 22)
         )
     if args.words is None:
@@ -2388,9 +2612,14 @@ def main() -> None:
         # geometry — the regime cross-job packing amortizes (PERF.md
         # §22).
         args.words = (
-            1000 if args.serve_ab else 24 if args.pack_ab else 50000
+            1000 if (args.serve_ab or args.fleet_ab)
+            else 24 if args.pack_ab else 50000
         )
-    if args.pair_ab:
+    if args.fleet_ab:
+        # Routed-vs-direct serve A/B (PERF.md §25); spawns engine
+        # subprocesses — no jax in this process.
+        run_fleet_ab(args)
+    elif args.pair_ab:
         # Pair-lane tier A/B (PERF.md §24); runs on the pinned (or
         # default) platform in-process.
         run_pair_ab(args)

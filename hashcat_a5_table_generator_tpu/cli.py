@@ -1055,6 +1055,10 @@ def _build_serve_parser() -> argparse.ArgumentParser:
                     help="listen on a unix socket instead of stdin "
                          "(one JSONL session per connection, all "
                          "sharing the engine)")
+    ap.add_argument("--engine-id", metavar="ID", default=None,
+                    help="identity label on this engine's telemetry "
+                         "series and fleet-router scrapes (PERF.md "
+                         "§25); default pid@host")
     ap.add_argument("--lanes", type=int, default=None,
                     help="default variant lanes per launch for jobs "
                          "that don't override it (same default as the "
@@ -1109,8 +1113,15 @@ def _build_serve_parser() -> argparse.ArgumentParser:
 def _run_serve(argv: Sequence[str]) -> int:
     """``a5gen serve``: one resident engine, jobs over JSONL."""
     args = _build_serve_parser().parse_args(argv)
+    from .runtime import telemetry
     from .runtime.engine import Engine, serve_socket, serve_stdio
     from .runtime.sweep import SweepConfig
+
+    # Serve mode always runs labeled: a router's merged scrape must
+    # distinguish members, and a lone engine's label is harmless.
+    telemetry.set_engine_id(
+        args.engine_id or telemetry.default_engine_id()
+    )
 
     if args.lanes is None or args.blocks is None:
         import jax
@@ -1151,6 +1162,137 @@ def _run_serve(argv: Sequence[str]) -> int:
     return 0
 
 
+def _build_fleet_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog=f"{PROG} fleet",
+        description=(
+            "Fleet mode (PERF.md §25): a front-end router over a pool "
+            "of engine processes.  Speaks the SAME JSONL serve "
+            "protocol upstream (submit/pause/resume/cancel/stats/"
+            "metrics/shutdown pass through; drain/migrate added), "
+            "places jobs by static-trace-config affinity to maximize "
+            "fuse/compile reuse, rebalances via pause→checkpoint→"
+            "resubmit, and survives engine death by crash-replaying "
+            "routed jobs from their last router-held checkpoints with "
+            "exactly-once hit redelivery."
+        ),
+    )
+    ap.add_argument("--engines", required=True, metavar="N|SOCK,...",
+                    help="an integer spawns N local engine processes "
+                         "(sharing this command's geometry flags and "
+                         "one schema cache); a comma-separated list "
+                         "of unix-socket paths attaches to engines "
+                         "already running")
+    ap.add_argument("--socket", metavar="PATH",
+                    help="listen for clients on a unix socket instead "
+                         "of stdin")
+    ap.add_argument("--place", choices=("affinity", "round-robin"),
+                    default="affinity",
+                    help="job placement: static-trace-config affinity "
+                         "(default; co-locate compatible jobs for "
+                         "fuse/compile reuse) or round-robin (the "
+                         "--fleet-ab control arm)")
+    ap.add_argument("--poll", type=float, default=2.0, metavar="S",
+                    help="engine health-scrape cadence (stats op + "
+                         "liveness; 0 disables)")
+    ap.add_argument("--replay-budget", type=int, default=1, metavar="N",
+                    help="checkpoint-bearing engine failures "
+                         "(quarantine) are resubmitted to another "
+                         "engine up to N times per job")
+    ap.add_argument("--engine-dir", metavar="DIR", default=None,
+                    help="spawn mode: directory for engine sockets "
+                         "(default: a temp dir)")
+    # Spawn-mode engine flags (mirror `a5gen serve`); also seed the
+    # router's affinity-token defaults in both modes.
+    ap.add_argument("--lanes", type=int, default=None)
+    ap.add_argument("--blocks", type=int, default=None)
+    ap.add_argument("--superstep", type=_superstep_arg, default=None,
+                    metavar="N|auto|off")
+    ap.add_argument("--pair", choices=("auto", "on", "off"),
+                    default="auto")
+    ap.add_argument("--schema-cache", metavar="DIR", default=None,
+                    help="the FLEET ARTIFACT STORE: one on-disk "
+                         "PieceSchema cache directory shared by every "
+                         "engine, so each plan×table compiles once "
+                         "fleet-wide (spawn mode default: a shared "
+                         "temp dir)")
+    ap.add_argument("--schema-cache-max-mb", type=float, default=None,
+                    metavar="MB")
+    return ap
+
+
+def _run_fleet(argv: Sequence[str]) -> int:
+    """``a5gen fleet``: router + engine pool, serve protocol upstream."""
+    import os
+    import tempfile
+
+    args = _build_fleet_parser().parse_args(argv)
+    from .runtime.fleet import (
+        FleetRouter,
+        serve_fleet_socket,
+        serve_fleet_stdio,
+        spawn_engines,
+    )
+    from .runtime.sweep import SweepConfig
+
+    defaults = SweepConfig(
+        lanes=args.lanes, num_blocks=args.blocks,
+        superstep=args.superstep,
+        pair={"auto": None, "on": "on", "off": 0}[args.pair],
+        schema_cache=args.schema_cache,
+        schema_cache_max_mb=args.schema_cache_max_mb,
+    )
+    router = FleetRouter(place=args.place, poll_s=args.poll,
+                         replay_budget=args.replay_budget,
+                         defaults=defaults)
+    spawned = False
+    try:
+        if args.engines.isdigit():
+            spawned = True
+            eng_dir = args.engine_dir or tempfile.mkdtemp(
+                prefix="a5-fleet-"
+            )
+            cache = args.schema_cache or os.path.join(
+                eng_dir, "schema-cache"
+            )
+            eng_args = ["--schema-cache", cache]
+            if args.lanes is not None:
+                eng_args += ["--lanes", str(args.lanes)]
+            if args.blocks is not None:
+                eng_args += ["--blocks", str(args.blocks)]
+            if args.superstep is not None:
+                eng_args += ["--superstep",
+                             "off" if args.superstep == 0
+                             else str(args.superstep)]
+            if args.pair != "auto":
+                eng_args += ["--pair", args.pair]
+            if args.schema_cache_max_mb is not None:
+                eng_args += ["--schema-cache-max-mb",
+                             str(args.schema_cache_max_mb)]
+            specs = spawn_engines(int(args.engines), eng_dir,
+                                  engine_args=eng_args)
+            for sock_path, eid, proc in specs:
+                router.attach(sock_path, eid, proc=proc)
+        else:
+            for ep in args.engines.split(","):
+                ep = ep.strip()
+                if ep:
+                    router.attach(ep)
+        n = len(router.engines())
+        print(f"{PROG}: fleet of {n} engine(s), routing on "
+              f"{args.socket or 'stdin'} (JSONL; op=shutdown ends)",
+              file=sys.stderr)
+        if args.socket:
+            serve_fleet_socket(router, args.socket)
+        else:
+            serve_fleet_stdio(router, sys.stdin, sys.stdout)
+    finally:
+        # Spawn mode owns its engines' lifetimes; attach mode leaves
+        # them serving for their other clients.
+        router.close(shutdown_engines=spawned)
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     # jax-free import: the typed corrupt-checkpoint error gets its
     # remediation hint here (PERF.md §23).
@@ -1163,6 +1305,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # flag set (job semantics arrive per JSONL submission, not as
         # process flags).
         return _run_serve(list(argv[1:]))
+    if argv and argv[0] == "fleet":
+        # Fleet mode (PERF.md §25): router + engine pool — jax-free in
+        # the router process; the engines are where device work runs.
+        return _run_fleet(list(argv[1:]))
     ap = build_parser()
     args = ap.parse_args(argv)
     if args.list_layouts:

@@ -1026,18 +1026,27 @@ def _schema_cache_key(build_kw: dict) -> str:
 
 def save_piece_schema(cache_dir: str, key: str,
                       schema: "PieceSchema | None") -> None:
-    """Persist one cache entry atomically (tmp + rename): the schema's
-    arrays (``gw`` uint32, ``gl`` uint8, ``gw16`` uint16, ``sel_bit``
-    uint8, ``sel_slot`` int32 — whichever are present) as npz members
-    plus a JSON header with the static group structure.  ``None`` (the
-    plan's geometry refuses piece emission) is cached too — the refusal
-    walk is not free and the answer is as deterministic as the schema."""
+    """Persist one cache entry atomically AND durably
+    (``checkpoint.atomic_write_bytes``: tmp + data fsync + rename +
+    directory fsync): the schema's arrays (``gw`` uint32, ``gl``
+    uint8, ``gw16`` uint16, ``sel_bit`` uint8, ``sel_slot`` int32 —
+    whichever are present) as npz members plus a JSON header with the
+    static group structure.  ``None`` (the plan's geometry refuses
+    piece emission) is cached too — the refusal walk is not free and
+    the answer is as deterministic as the schema.
+
+    The durable-replace discipline is what makes ONE cache directory
+    safe as a fleet-wide artifact store (PERF.md §25): N engines
+    racing on the same key each rename a fully-synced entry into
+    place — a reader sees some complete entry or none, never a torn
+    one (tmp names are pid-qualified, so concurrent writers never
+    collide on the tmp file either)."""
+    import io
     import json
     import os
 
     os.makedirs(cache_dir, exist_ok=True)
     path = os.path.join(cache_dir, f"{key}.npz")
-    tmp = f"{path}.tmp.{os.getpid()}"
     if schema is None:
         header = {"version": SCHEMA_CACHE_VERSION, "schema": None}
         arrays = {}
@@ -1064,22 +1073,21 @@ def save_piece_schema(cache_dir: str, key: str,
             for name in _SCHEMA_ARRAYS
             if getattr(schema, name) is not None
         }
+    from ..runtime.checkpoint import atomic_write_bytes
+
+    buf = io.BytesIO()
+    np.savez(buf, header=np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8
+    ), **arrays)
+    blob = buf.getvalue()
     try:
-        with open(tmp, "wb") as fh:
-            np.savez(fh, header=np.frombuffer(
-                json.dumps(header).encode(), dtype=np.uint8
-            ), **arrays)
-            fh.flush()
-            written = fh.tell()
-        os.replace(tmp, path)
-        _count_cache(bytes_written=written)
+        atomic_write_bytes(path, blob)
+        _count_cache(bytes_written=len(blob))
     except OSError:  # pragma: no cover - cache dir races/ENOSPC
         # The cache is an accelerator, never a correctness dependency:
-        # a failed write just means the next run recompiles.
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
+        # a failed write just means the next run recompiles (the
+        # writer cleaned its own tmp file).
+        pass
 
 
 def load_piece_schema(cache_dir: str, key: str

@@ -12,7 +12,9 @@ import the checkpoint/progress/sink layers without it.
 from .checkpoint import (  # noqa: F401
     CheckpointCorrupt,
     CheckpointState,
+    CheckpointWireIncompatible,
     SweepCursor,
+    atomic_write_bytes,
     atomic_write_text,
     load_checkpoint,
     save_checkpoint,

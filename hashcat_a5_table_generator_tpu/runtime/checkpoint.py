@@ -37,21 +37,39 @@ class CheckpointCorrupt(ValueError):
     parse failure; the CLI adds a one-line remediation hint."""
 
 
-def atomic_write_text(path: str, blob: str) -> None:
+class CheckpointWireIncompatible(ValueError):
+    """A checkpoint document's ``wire_version`` major does not match
+    this build's.  Raised by :func:`state_from_doc` so a cross-engine
+    migration (the fleet tier hands checkpoints between processes that
+    may run different builds) fails loudly instead of garbling
+    cursors."""
+
+
+def atomic_write_bytes(path: str, blob: bytes) -> None:
     """Crash- and power-loss-safe replace of ``path`` with ``blob``:
     write a same-directory tmp file, flush + fsync the DATA, rename
     over the target, then fsync the DIRECTORY so the rename itself is
     durable.  tmp+rename alone is atomic against a crash between
     syscalls but NOT against power-loss torn writes — without the data
     fsync the rename can land while the blocks behind it never do.
-    Checkpoints, bucket manifests and ``--metrics-json`` all write
-    through here (PERF.md §23)."""
+    Checkpoints, bucket manifests, ``--metrics-json`` and the shared
+    schema-cache entries (N fleet engines writing one directory) all
+    write through here (PERF.md §23/§25).  A failed write cleans its
+    tmp file before propagating — concurrent writers must never leave
+    litter a reader could mistake for an entry."""
     tmp = f"{path}.tmp.{os.getpid()}"
-    with open(tmp, "w") as fh:
-        fh.write(blob)
-        fh.flush()
-        os.fsync(fh.fileno())
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as fh:
+            fh.write(blob)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
     dirname = os.path.dirname(os.path.abspath(path)) or "."
     try:
         dirfd = os.open(dirname, os.O_RDONLY)
@@ -64,9 +82,26 @@ def atomic_write_text(path: str, blob: str) -> None:
     finally:
         os.close(dirfd)
 
+
+def atomic_write_text(path: str, blob: str) -> None:
+    """:func:`atomic_write_bytes` for text payloads (UTF-8)."""
+    atomic_write_bytes(path, blob.encode("utf-8"))
+
+
 #: v2: canonical word encoding is (int64 length vector, concatenated
 #: content) so packed batches hash buffer-at-a-time instead of per-word.
 FORMAT_VERSION = 2
+
+#: Wire format of the checkpoint DOCUMENT (``state_to_doc`` /
+#: ``state_from_doc``) — the pause/migrate handoff the service and
+#: fleet tiers ship between processes.  Distinct from FORMAT_VERSION
+#: (the cursor encoding): the wire version gates CROSS-BUILD handoffs.
+#: Major bumps are breaking (``state_from_doc`` rejects unknown majors
+#: with :class:`CheckpointWireIncompatible`); minors are additive and
+#: ignored by older readers.
+WIRE_VERSION = "1.0"
+
+_WIRE_MAJOR = int(WIRE_VERSION.split(".", 1)[0])
 
 #: ``kind`` marker distinguishing a bucketed sweep's top-level manifest
 #: from a single sweep's cursor checkpoint (both live at the user's
@@ -171,14 +206,45 @@ def state_to_doc(state: CheckpointState) -> Dict:
     pause/migrate handoff (a paused job IS its checkpoint; ranks
     stringify because variant spaces exceed JSON's safe ints)."""
     doc = asdict(state)
+    doc["wire_version"] = WIRE_VERSION
     doc["cursor"] = {"word": state.cursor.word, "rank": str(state.cursor.rank)}
     doc["hits"] = [[w, str(r)] for w, r in state.hits]
     return doc
 
 
+def check_wire_version(doc: Dict) -> None:
+    """Reject a checkpoint document whose ``wire_version`` major is not
+    this build's (:class:`CheckpointWireIncompatible`).  A document
+    with NO wire_version predates the field — it is a major-1 doc by
+    definition (the wire format has not changed since) and is
+    accepted; unparseable values are rejected like unknown majors."""
+    wv = doc.get("wire_version")
+    if wv is None:
+        return
+    try:
+        major = int(str(wv).split(".", 1)[0])
+    except ValueError:
+        raise CheckpointWireIncompatible(
+            f"checkpoint wire_version {wv!r} is not a MAJOR.MINOR "
+            "version string — refusing to migrate a document this "
+            "build cannot interpret"
+        ) from None
+    if major != _WIRE_MAJOR:
+        raise CheckpointWireIncompatible(
+            f"checkpoint wire_version {wv!r} has major {major}, but "
+            f"this build speaks {WIRE_VERSION} — cross-engine "
+            "migration across incompatible builds must fail loudly; "
+            "finish or restart the job on an engine of the writing "
+            "build"
+        )
+
+
 def state_from_doc(doc: Dict) -> CheckpointState:
     """Inverse of :func:`state_to_doc` (no fingerprint validation here —
-    the sweep's ``_load_state`` / :func:`load_checkpoint` own that)."""
+    the sweep's ``_load_state`` / :func:`load_checkpoint` own that;
+    the wire-version major IS validated — see
+    :func:`check_wire_version`)."""
+    check_wire_version(doc)
     return CheckpointState(
         fingerprint=doc["fingerprint"],
         cursor=SweepCursor(
@@ -240,6 +306,11 @@ def load_checkpoint(path: str, fingerprint: str) -> Optional[CheckpointState]:
         )
     try:
         return state_from_doc(doc)
+    except CheckpointWireIncompatible:
+        # A different-build checkpoint is an operator error with its
+        # own remediation (run it on the writing build), not file
+        # corruption — keep the typed error.
+        raise
     except (KeyError, TypeError, ValueError) as exc:
         # Valid JSON, broken schema (hand edit, partial restore): same
         # typed error as a torn file — the caller's remediation is

@@ -231,16 +231,18 @@ class _JobRecorder:
 
 
 class _Slot:
-    """One admitted job on the scheduler: its Sweep, its machine, and
-    its group (static-trace-config) key."""
+    """One admitted job on the scheduler: its Sweep, its machine, its
+    group (static-trace-config) key, and its affinity token (the
+    fleet router's placement signal, ``runtime.fuse.affinity_token``)."""
 
     def __init__(self, job: EngineJob, sweep, machine, group: str,
-                 seq: int) -> None:
+                 seq: int, token: str = "") -> None:
         self.job = job
         self.sweep = sweep
         self.machine = machine
         self.group = group
         self.seq = seq
+        self.token = token
         #: engine-level machine restarts consumed (PERF.md §23): a
         #: transiently-failing machine is rebuilt from its own last
         #: boundary up to ``Engine(job_retries=)`` times before the job
@@ -290,6 +292,10 @@ class Engine:
             "jobs_cancelled": 0, "jobs_paused": 0, "supersteps_served": 0,
         }
         self._groups: Dict[str, int] = {}
+        #: active slots per affinity token (runtime.fuse.affinity_token)
+        #: — the resident-group surface the fleet router's placement
+        #: reads through the stats op (PERF.md §25).
+        self._resident: Dict[str, int] = {}
         #: cross-job physical packing (PERF.md §22): None = the
         #: A5GEN_PACK env hatch decides (on by default); False restores
         #: the PR 8 per-job dispatch path wholesale.
@@ -360,13 +366,20 @@ class Engine:
         writer: Optional[CandidateWriter] = None,
         resume_state: Optional[CheckpointState] = None,
         job_id: Optional[str] = None,
+        mute: int = 0,
     ) -> EngineJob:
         """Queue one tenant sweep; returns its :class:`EngineJob`
         handle immediately.  ``kind='crack'`` needs ``digests`` and
         streams hits; ``kind='candidates'`` needs a ``writer``.
         ``resume_state`` is a paused job's CheckpointState (this
         engine's or another's) — the migrate handoff; its fingerprint
-        must match the job's semantic inputs."""
+        must match the job's semantic inputs.  ``mute`` withholds the
+        leading N hit emissions from the ASYNC delivery queue (the
+        ``_JobRecorder(mute=)`` discipline, PERF.md §23/§25): a
+        resumed machine replays its checkpointed hits first, and a
+        fleet router that already forwarded N hits downstream passes
+        ``mute=N`` so redelivery stays exactly-once — the ordered
+        result list still rebuilds in full either way."""
         if kind not in ("crack", "candidates"):
             raise ValueError(f"kind must be 'crack' or 'candidates', "
                              f"got {kind!r}")
@@ -374,6 +387,9 @@ class Engine:
             raise ValueError("candidates jobs need a writer=")
         if self._shutdown:
             raise RuntimeError("engine is shut down")
+        if mute and kind != "crack":
+            raise ValueError("mute= only applies to crack jobs (the "
+                             "async hit-delivery queue)")
         job = EngineJob(
             job_id if job_id is not None else f"job-{next(self._ids)}",
             kind,
@@ -382,6 +398,7 @@ class Engine:
             self._hit_queue_depth,
         )
         job._resume_state = resume_state
+        job._mute = max(0, int(mute))
         with self._lock:
             self._counts["jobs_submitted"] += 1
         telemetry.counter("engine.jobs_submitted").add(1)
@@ -417,11 +434,40 @@ class Engine:
             active = len(self._active)
             fused = len(self._fused)
             building = self._building
+            staged = sum(
+                len(stage["ready"]) for stage in self._staging.values()
+            )
+            resident = set(self._resident)
+            for stage in self._staging.values():
+                resident.update(
+                    s.token for s in stage["ready"] if s.token
+                )
         steps = _stats_delta(self._step0, step_cache_stats())
         packed = _stats_delta(self._packed0, self._packed_counters())
         return {
             **counts,
             "jobs_active": active,
+            # The fleet router's placement signals (PERF.md §25):
+            # runnable (= active; the alias names the scheduling
+            # state), staged (built, parked for burst peers), building
+            # (admission worker), and the resident affinity tokens —
+            # jobs whose token matches land here to maximize
+            # fuse/compile reuse.
+            "jobs_runnable": active,
+            "jobs_staged": staged,
+            "resident_groups": sorted(resident),
+            # The engine's RESOLVED token-relevant defaults: a job doc
+            # omitting a config field gets this value (``_job_from_doc``
+            # replaces only supplied fields), so a router must fill
+            # the same gaps with the same values or its doc tokens
+            # never match the resident ones.
+            "config_defaults": {
+                "lanes": self.defaults.lanes,
+                "blocks": self.defaults.num_blocks,
+                "superstep": self.defaults.superstep,
+                "devices": self.defaults.devices,
+                "pair": self.defaults.pair,
+            },
             "jobs_queued": self._pending.qsize(),
             "jobs_building": building,
             "groups": groups,
@@ -908,6 +954,10 @@ class Engine:
             self._active.append(slot)
             self._groups[slot.group] = self._groups.get(slot.group,
                                                         0) + 1
+            if slot.token:
+                self._resident[slot.token] = self._resident.get(
+                    slot.token, 0
+                ) + 1
             # Same-group jobs adjacent, groups in admission order:
             # warm programs serve their whole group back to back.
             self._active.sort(key=lambda s: (s.group, s.seq))
@@ -921,12 +971,14 @@ class Engine:
         # the restart-the-executor-once recovery in _finish_build.
         if faults_mod.ACTIVE is not None:
             faults_mod.ACTIVE.fire("admission.build")
+        from .fuse import affinity_token
+
         a = job._submit_args
         cfg = a["config"] if a["config"] is not None else self.defaults
         sweep = Sweep(a["spec"], a["sub_map"], a["words"], a["digests"],
                       config=cfg)
         if job.kind == "crack":
-            recorder = _JobRecorder(job)
+            recorder = _JobRecorder(job, mute=getattr(job, "_mute", 0))
             machine = sweep.crack_machine(
                 recorder, resume=False, state=job._resume_state
             )
@@ -935,7 +987,7 @@ class Engine:
                 a["writer"], resume=False, state=job._resume_state
             )
         return _Slot(job, sweep, machine, self._group_key(a["spec"], cfg),
-                     next(self._ids))
+                     next(self._ids), affinity_token(a["spec"], cfg))
 
     def _group_key(self, spec, cfg) -> str:
         """Static-trace-config grouping key: jobs agreeing here trace
@@ -1111,6 +1163,10 @@ class Engine:
             self._groups[slot.group] -= 1
             if not self._groups[slot.group]:
                 del self._groups[slot.group]
+            if slot.token and slot.token in self._resident:
+                self._resident[slot.token] -= 1
+                if not self._resident[slot.token]:
+                    del self._resident[slot.token]
 
     def _settle_counts(self, job: EngineJob, state: str) -> None:
         with self._lock:
@@ -1182,7 +1238,10 @@ class Engine:
 # ("default"/"reverse"/"suball"/"suball-reverse"), "table_min"/"table_max";
 # "config": SweepConfig subset {lanes, blocks, superstep, devices,
 # fetch_chunk, stream_chunk_words, schema_cache, schema_cache_max_mb};
-# "checkpoint": a previously returned pause checkpoint (migrate-in).
+# "checkpoint": a previously returned pause checkpoint (migrate-in);
+# "replay_mute": N — withhold the leading N hit emissions from event
+# delivery (the fleet router's exactly-once redelivery discipline; the
+# job's done counts still report the full stream).
 
 
 #: SweepConfig fields a JSONL job may override ("blocks" aliases
@@ -1261,6 +1320,13 @@ def _job_from_doc(doc: dict, defaults, max_word_bytes: int):
     resume_state = (
         state_from_doc(doc["checkpoint"]) if doc.get("checkpoint") else None
     )
+    # The fleet router's exactly-once redelivery knob (PERF.md §25):
+    # the first N hit emissions skip the async queue — the client
+    # already received exactly those through the router before a
+    # migrate/crash-replay resubmission.
+    mute = int(doc.get("replay_mute", 0))
+    if mute < 0:
+        raise ValueError(f"replay_mute must be >= 0, got {mute}")
     kind = "crack" if crack else "candidates"
     writer = None
     if kind == "candidates":
@@ -1276,7 +1342,7 @@ def _job_from_doc(doc: dict, defaults, max_word_bytes: int):
         writer = CandidateWriter(open(doc["output"], mode))
     return dict(spec=spec, sub_map=sub_map, words=words, digests=digests,
                 config=cfg, kind=kind, writer=writer,
-                resume_state=resume_state)
+                resume_state=resume_state, mute=mute)
 
 
 class _JsonlSession:
@@ -1498,14 +1564,22 @@ class _JsonlSession:
             line = line.strip()
             if not line:
                 continue
+            doc = None
             try:
                 doc = json.loads(line)
                 keep_going = self._handle(doc)
             except Exception as exc:  # noqa: BLE001 — protocol-scoped
-                self._emit({
+                err = {
                     "event": "error",
                     "error": f"{type(exc).__name__}: {exc}",
-                })
+                }
+                # Carry the failing op's job id when it named one: a
+                # routing layer (PERF.md §25) demuxes events by id, so
+                # an id-less error cannot be correlated to the op that
+                # caused it.
+                if isinstance(doc, dict) and doc.get("id") is not None:
+                    err["id"] = doc["id"]
+                self._emit(err)
                 continue
             if not keep_going:
                 return True

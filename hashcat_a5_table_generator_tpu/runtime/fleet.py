@@ -1,0 +1,1287 @@
+"""Fleet tier: a front-end router over a pool of engine processes
+(PERF.md §25, ROADMAP item 1).
+
+One resident ``a5gen serve`` process (PERF.md §20/§22) multiplexes many
+tenants but caps out at one host's worth; the fleet tier scales the
+SAME protocol across N engines.  :class:`FleetRouter` owns a pool of
+engine endpoints — spawned locally (:func:`spawn_engines`) or attached
+by unix-socket path — and speaks the serve protocol upstream, so
+existing clients work unmodified: ``submit``/``pause``/``resume``/
+``cancel``/``stats``/``metrics``/``shutdown`` pass through, and the
+router adds ``drain`` and ``migrate`` for operators.
+
+Everything the router does rides seams the engine tier already ships:
+
+* **Placement** is static-trace-config affinity
+  (``runtime.fuse.affinity_token``): a submit document's doc-level
+  static config hashes to the same token the engine computes for its
+  resident slots (reported through the ``stats`` op's
+  ``resident_groups``), so jobs that COULD share a compiled program or
+  fuse into one packed dispatch land on the engine already running
+  their kind; ties break on load score from the scraped placement
+  signals (runnable/staged/building counts).  ``place='round-robin'``
+  is the control arm ``bench.py --fleet-ab`` compares against.
+* **Rebalance** (drain/migrate) is pause → checkpoint over the wire →
+  resubmit with the checkpoint on the target engine — checkpoints are
+  a fingerprint-checked JSON wire format, so migration IS
+  resubmission (``wire_version``-gated across builds:
+  ``checkpoint.check_wire_version``).  ``drain`` empties an engine for
+  shutdown; a draining engine takes no new placements.
+* **Crash-replay**: an engine death (torn socket, watchdog-detected
+  wedge, reaped process) requeues every routed job from its last
+  router-held checkpoint onto the survivors.  Redelivery is
+  at-least-once at the engine (a resumed machine replays its
+  checkpointed hits), made EXACT by the existing muted-replay
+  discipline: the router forwards ``replay_mute`` = hits already
+  delivered downstream, and the engine's ``_JobRecorder(mute=)``
+  suppresses exactly that deterministic prefix — per-job hit streams
+  stay byte-identical to solo runs across engine deaths.
+* **Compile-once fleet-wide**: engines share one ``--schema-cache``
+  directory as the fleet artifact store; entries are written through
+  ``checkpoint.atomic_write_bytes`` (tmp + fsync + rename), so N
+  concurrent writers never tear an entry and each plan×table schema
+  compiles once across the fleet.
+
+Candidates jobs migrate/crash-replay by RESTART (cancel + fresh
+resubmission, output truncated) rather than checkpoint resume: their
+output file is engine-local and append-resume across processes would
+duplicate the tail.  Crack jobs — the service workload — get the exact
+checkpoint path.
+
+The router holds no device state and runs no jax: it is JSON, sockets
+and tables, so one router fronts many engine processes without
+competing for the accelerator.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from . import telemetry
+from .fuse import static_affinity_token
+
+#: Module path engines are spawned from (``python -m <this>``).
+_PACKAGE = __name__.rsplit(".", 2)[0]
+
+
+class FleetError(RuntimeError):
+    """A fleet-level operation failed (no live engine, an engine
+    rejected a routed document, an ack timed out)."""
+
+
+# ---------------------------------------------------------------------------
+# Engine endpoints
+# ---------------------------------------------------------------------------
+
+
+class EngineLink:
+    """Router-side handle of ONE engine: the JSONL socket, a reader
+    thread demuxing its event stream, and the routing bookkeeping the
+    placement reads.
+
+    Event demux: events carrying a job ``id`` flow to the router's
+    job-event handler — EXCEPT the ``accepted`` ack a pending
+    :meth:`request` is waiting for.  Id-less control replies
+    (``stats``/``metrics``/``bye``/``error``) answer the pending
+    request; the engine session handles ops sequentially per
+    connection, so one in-flight request per link (serialized by
+    ``_ctl_lock``) correlates exactly."""
+
+    def __init__(self, sock, endpoint: str, engine_id: str, *,
+                 proc: "Optional[subprocess.Popen]" = None,
+                 index: int = 0,
+                 on_event: Optional[Callable] = None,
+                 on_death: Optional[Callable] = None) -> None:
+        self.endpoint = endpoint
+        self.engine_id = engine_id
+        self.proc = proc
+        self.index = index
+        self.alive = True
+        self.draining = False
+        #: last scraped ``stats`` event (placement signals).
+        self.scrape: dict = {}
+        #: consecutive failed health scrapes (watchdog input).
+        self.misses = 0
+        #: router-level job ids currently routed here.
+        self.routed: set = set()
+        self._sock = sock
+        self._fin = sock.makefile("r", encoding="utf-8")
+        self._fout = sock.makefile("w", encoding="utf-8")
+        self._wlock = threading.Lock()
+        self._ctl_lock = threading.Lock()
+        self._waiter: "Optional[Tuple[Optional[str], queue.Queue]]" = None
+        #: id-less replies to drop: a timed-out stats/metrics/shutdown
+        #: request leaves its reply in flight, and the engine answers
+        #: per-connection in order — the NEXT id-less event is the
+        #: stale reply, not the new request's (see :meth:`request`).
+        self._skip_replies = 0
+        self._skip_lock = threading.Lock()
+        #: lazily-opened SECOND connection for health scrapes: the
+        #: engine serves one session per connection, so stats replies
+        #: here can never queue behind a blocking op (a pause parking
+        #: at a superstep boundary) on the main op stream — a healthy
+        #: engine mid-drain must not look dead to the watchdog, and a
+        #: scrape timeout must not desync the main link's reply
+        #: correlation.
+        self._health_sock = None
+        self._health_file = None
+        self._health_lock = threading.Lock()
+        self._closing = False
+        self._on_event = on_event
+        self._on_death = on_death
+        self._reader_thread = threading.Thread(
+            target=self._reader, name=f"a5-fleet-link-{engine_id}",
+            daemon=True,
+        )
+        self._reader_thread.start()
+
+    @classmethod
+    def connect(cls, endpoint: str, engine_id: Optional[str] = None,
+                *, timeout: float = 180.0, **kw) -> "EngineLink":
+        """Connect to an engine's unix socket, retrying until it is
+        listening (a freshly spawned engine binds only after its jax
+        import)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+                s.connect(endpoint)
+                break
+            except OSError:
+                s.close()
+                proc = kw.get("proc")
+                if proc is not None and proc.poll() is not None:
+                    raise FleetError(
+                        f"engine process for {endpoint!r} exited with "
+                        f"{proc.returncode} before listening"
+                    )
+                if time.monotonic() > deadline:
+                    raise FleetError(
+                        f"engine at {endpoint!r} not listening after "
+                        f"{timeout:g}s"
+                    )
+                time.sleep(0.1)
+        return cls(s, endpoint, engine_id or endpoint, **kw)
+
+    # -- wire ----------------------------------------------------------
+
+    def send(self, doc: dict) -> None:
+        with self._wlock:
+            self._fout.write(json.dumps(doc) + "\n")
+            self._fout.flush()
+
+    def request(self, doc: dict, *, timeout: float = 120.0) -> dict:
+        """Send one op and wait for its correlated reply; raises
+        :class:`FleetError` on an ``error`` reply, a timeout, or a
+        connection lost mid-wait.
+
+        Correlation survives timeouts: the engine answers ops in order
+        per connection, so when an op expecting an ID-LESS reply
+        (stats/metrics/shutdown) times out, the late reply is still
+        ahead of any later request's — the reader skips exactly that
+        many id-less events before answering the next waiter.  A
+        timed-out SUBMIT needs no skip: its late ``accepted`` carries
+        the job id and falls through to the event plane, which ignores
+        it."""
+        q: "queue.Queue" = queue.Queue()
+        with self._ctl_lock:
+            self._waiter = (doc.get("id"), q)
+            try:
+                self.send(doc)
+                ev = q.get(timeout=timeout)
+            except (OSError, ValueError) as exc:
+                raise FleetError(
+                    f"engine {self.engine_id}: send failed ({exc})"
+                ) from exc
+            except queue.Empty:
+                if doc.get("op") in ("stats", "metrics", "shutdown"):
+                    with self._skip_lock:
+                        self._skip_replies += 1
+                raise FleetError(
+                    f"engine {self.engine_id}: no reply to "
+                    f"{doc.get('op', 'submit')!r} in {timeout:g}s"
+                ) from None
+            finally:
+                self._waiter = None
+        if ev.get("event") == "error":
+            raise FleetError(
+                f"engine {self.engine_id}: {ev.get('error')}"
+            )
+        return ev
+
+    def health_request(self, doc: dict, *, timeout: float) -> dict:
+        """One synchronous op on the dedicated health connection
+        (opened lazily, re-opened after any failure — a timeout could
+        leave a partial reply in flight, so the connection is never
+        reused past an error)."""
+        with self._health_lock:
+            try:
+                if self._health_file is None:
+                    s = socket.socket(socket.AF_UNIX,
+                                      socket.SOCK_STREAM)
+                    s.settimeout(timeout)
+                    s.connect(self.endpoint)
+                    self._health_sock = s
+                    self._health_file = s.makefile(
+                        "rw", encoding="utf-8"
+                    )
+                self._health_sock.settimeout(timeout)
+                self._health_file.write(json.dumps(doc) + "\n")
+                self._health_file.flush()
+                line = self._health_file.readline()
+                if not line:
+                    raise OSError("health connection EOF")
+                return json.loads(line)
+            except (OSError, ValueError) as exc:
+                self._drop_health()
+                raise FleetError(
+                    f"engine {self.engine_id}: health scrape failed "
+                    f"({exc})"
+                ) from exc
+
+    def _drop_health(self) -> None:
+        if self._health_sock is not None:
+            try:
+                self._health_sock.close()
+            except OSError:
+                pass
+        self._health_sock = None
+        self._health_file = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def kill_socket(self) -> None:
+        """Tear the connection (watchdog path): the reader unwinds
+        through EOF and the router's death handler requeues the routed
+        jobs."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._health_lock:
+            self._drop_health()
+
+    def close(self) -> None:
+        """Intentional close (router shutdown): no death handling."""
+        self._closing = True
+        self.kill_socket()
+
+    # -- reader --------------------------------------------------------
+
+    def _reader(self) -> None:
+        try:
+            for line in self._fin:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue  # torn line mid-death: the EOF follows
+                jid = ev.get("id")
+                waiter = self._waiter
+                if jid is not None and not (
+                    waiter is not None
+                    and ev.get("event") in ("accepted", "error")
+                    and jid == waiter[0]
+                ):
+                    if self._on_event is not None:
+                        self._on_event(self, ev)
+                    continue
+                if jid is None:
+                    # A timed-out id-less request's late reply is
+                    # still ahead of the current request's in the
+                    # per-connection order — drop it, don't answer
+                    # the wrong waiter with it.
+                    with self._skip_lock:
+                        if self._skip_replies > 0:
+                            self._skip_replies -= 1
+                            continue
+                if waiter is not None:
+                    waiter[1].put(ev)
+                # else: unsolicited control event (dropped)
+        except (OSError, ValueError):
+            pass  # torn connection: fall through to death handling
+        finally:
+            self.alive = False
+            waiter = self._waiter
+            if waiter is not None:
+                waiter[1].put({
+                    "event": "error",
+                    "error": "engine connection lost",
+                })
+            if not self._closing and self._on_death is not None:
+                self._on_death(self)
+
+
+# ---------------------------------------------------------------------------
+# Routed jobs
+# ---------------------------------------------------------------------------
+
+
+class RoutedJob:
+    """Router-held state of one client job: the sanitized submit
+    document (re-submittable verbatim), the affinity token, the engine
+    currently running it, the count of hits already forwarded
+    downstream (the exactly-once mute), and the last router-held
+    checkpoint (the crash-replay origin)."""
+
+    def __init__(self, job_id: str, kind: str, doc: dict, token: str,
+                 emit: Optional[Callable]) -> None:
+        self.id = job_id
+        self.kind = kind  # 'crack' | 'candidates'
+        self.doc = doc
+        self.token = token
+        self.emit = emit
+        self.link: Optional[EngineLink] = None
+        self.n_forwarded = 0
+        #: last router-held checkpoint DOC (submit-time migrate-in,
+        #: pause events, quarantine events) — the crash-replay origin.
+        self.checkpoint: Optional[dict] = None
+        self.state = "queued"  # routed|paused|done|failed|cancelled
+        self.replays = 0
+        #: a drain/migrate is in flight: the next paused (crack) or
+        #: cancelled (candidates) event re-places instead of
+        #: forwarding downstream.
+        self.migrating = False
+        self.target: Optional[str] = None
+        #: the CURRENT placement's submit request has been acked by
+        #: the engine.  False while a dispatch is in flight — that
+        #: dispatching thread owns any failure, so the death handler
+        #: must not also requeue the job (double ownership would run
+        #: a ghost sweep under a table entry the dispatcher deletes).
+        self.acked = False
+        self.settled = threading.Event()
+
+    @property
+    def unsettled(self) -> bool:
+        return self.state in ("queued", "routed", "paused")
+
+
+# ---------------------------------------------------------------------------
+# The router
+# ---------------------------------------------------------------------------
+
+
+class FleetRouter:
+    """Front-end router over a pool of engines (PERF.md §25).
+
+    ``place``: ``'affinity'`` (default — co-locate equal-token jobs,
+    tie-break by load) or ``'round-robin'`` (the A/B control arm).
+    ``replay_budget``: checkpoint-bearing ``failed`` events (engine
+    quarantine) are resubmitted to another engine this many times per
+    job before the failure reaches the client.  ``poll_s``: health
+    scrape cadence (0 disables the poller — tests drive scrapes
+    manually); an engine missing ``poll_misses`` consecutive scrapes
+    (or whose process exited) is declared dead and its jobs
+    crash-replay.  ``defaults``: the SweepConfig the ENGINES were
+    started with — used only to fill doc-level gaps when computing
+    affinity tokens, so attach-mode routers should pass the engines'
+    flags (a mismatch degrades placement, never correctness)."""
+
+    def __init__(self, *, place: str = "affinity",
+                 replay_budget: int = 1, poll_s: float = 2.0,
+                 poll_misses: int = 3, defaults=None,
+                 control_timeout: float = 120.0) -> None:
+        if place not in ("affinity", "round-robin"):
+            raise ValueError(
+                f"place must be affinity|round-robin, got {place!r}"
+            )
+        self._place = place
+        self._replay_budget = int(replay_budget)
+        self._poll_s = float(poll_s)
+        self._poll_misses = int(poll_misses)
+        self._defaults = defaults
+        self._control_timeout = float(control_timeout)
+        self._links: List[EngineLink] = []
+        self._jobs: Dict[str, RoutedJob] = {}
+        self._lock = threading.Lock()
+        self._ids = itertools.count()
+        self._rr = itertools.count()
+        self._closed = False
+        #: fleet counters report as since-THIS-router deltas (the
+        #: Engine.stats() convention): the registry is process-wide,
+        #: and an embedder running several routers (tests, benches)
+        #: must not read its neighbors' deaths.
+        self._counters0 = {
+            name: int(telemetry.counter(f"fleet.{name}").value)
+            for name in ("engine_deaths", "jobs_replayed",
+                         "migrations")
+        }
+        self._poll_stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        #: re-dispatch work (crash-replay, migrate's second half,
+        #: quarantine resubmission) runs on THIS worker, never on a
+        #: link's reader thread: a reader dispatching to its own link
+        #: (the single-survivor fallback) would block the very loop
+        #: that must deliver the ack.
+        self._requeue: "queue.Queue" = queue.Queue()
+        self._requeue_thread = threading.Thread(
+            target=self._requeue_worker, name="a5-fleet-requeue",
+            daemon=True,
+        )
+        self._requeue_thread.start()
+        if self._poll_s > 0:
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, name="a5-fleet-health",
+                daemon=True,
+            )
+            self._poll_thread.start()
+
+    # -- pool management -----------------------------------------------
+
+    def attach(self, endpoint: str, engine_id: Optional[str] = None,
+               *, proc: "Optional[subprocess.Popen]" = None,
+               timeout: float = 180.0) -> EngineLink:
+        """Connect one engine endpoint into the pool (spawned or
+        pre-existing) and scrape it once so placement has signals
+        before the first poll tick."""
+        with self._lock:
+            index = len(self._links)
+        link = EngineLink.connect(
+            endpoint, engine_id, timeout=timeout, proc=proc,
+            index=index, on_event=self._on_job_event,
+            on_death=self._on_death,
+        )
+        with self._lock:
+            self._links.append(link)
+        self._scrape(link)
+        return link
+
+    def engines(self) -> List[EngineLink]:
+        with self._lock:
+            return list(self._links)
+
+    def _resolve(self, engine_id: str) -> EngineLink:
+        with self._lock:
+            for link in self._links:
+                if link.engine_id == engine_id:
+                    return link
+        raise FleetError(f"unknown engine {engine_id!r}")
+
+    # -- placement -----------------------------------------------------
+
+    def _doc_token(self, doc: dict) -> str:
+        """The submit document's affinity token — the same
+        static-trace-config prefix ``runtime.fuse.affinity_token``
+        hashes engine-side.  Config gaps fill from the ENGINES'
+        resolved defaults (scraped ``config_defaults`` — serve
+        resolves device-dependent lanes/blocks at start, and
+        ``_job_from_doc`` merges docs into exactly those), falling
+        back to the router's own ``defaults``; a heterogeneous pool
+        degrades placement quality, never correctness."""
+        cfg = doc.get("config") or {}
+        scraped: dict = {}
+        for link in self.engines():
+            scraped = link.scrape.get("config_defaults") or {}
+            if scraped:
+                break
+        d = self._defaults
+
+        def field(key, attr, fallback):
+            if key in cfg:
+                return cfg[key]
+            if key in scraped:
+                return scraped[key]
+            return getattr(d, attr, fallback) if d is not None \
+                else fallback
+
+        return static_affinity_token(
+            mode=doc.get("mode", "default"),
+            algo=doc.get("algo", "md5"),
+            table_min=int(doc.get("table_min", 0)),
+            table_max=int(doc.get("table_max", 15)),
+            lanes=field("lanes", "lanes", None),
+            num_blocks=field("blocks", "num_blocks", None),
+            superstep=field("superstep", "superstep", None),
+            devices=field("devices", "devices", 1),
+            pair=field("pair", "pair", None),
+        )
+
+    def _resident_tokens(self, link: EngineLink) -> set:
+        """The engine's resident affinity tokens as the router sees
+        them: its own routing table (authoritative for jobs IT placed)
+        unioned with the engine's last-scraped ``resident_groups`` (so
+        an attach-mode router also respects jobs other clients run
+        directly against the engine)."""
+        toks = set(link.scrape.get("resident_groups") or ())
+        with self._lock:
+            for jid in link.routed:
+                job = self._jobs.get(jid)
+                if job is not None and job.token:
+                    toks.add(job.token)
+        return toks
+
+    def _load_score(self, link: EngineLink) -> tuple:
+        s = link.scrape
+        return (
+            len(link.routed),
+            s.get("jobs_runnable", s.get("jobs_active", 0))
+            + s.get("jobs_staged", 0) + s.get("jobs_building", 0)
+            + s.get("jobs_queued", 0),
+            link.index,
+        )
+
+    def _pick(self, token: str,
+              exclude: Sequence[EngineLink] = ()) -> EngineLink:
+        with self._lock:
+            live = [
+                l for l in self._links if l.alive and not l.draining
+            ]
+        pool = [l for l in live if l not in exclude] or live
+        if not pool:
+            raise FleetError("no live engine to place the job on")
+        if self._place == "round-robin":
+            return pool[next(self._rr) % len(pool)]
+        matches = [
+            l for l in pool if token and token in
+            self._resident_tokens(l)
+        ]
+        return min(matches or pool, key=self._load_score)
+
+    # -- client surface (the serve protocol, routed) -------------------
+
+    def submit(self, doc: dict, emit: Optional[Callable] = None) -> dict:
+        """Route one submit document; returns the ``accepted`` event to
+        forward downstream.  The document passes through UNCHANGED to
+        the placed engine (clients keep their serve contract), except
+        the router strips and holds a migrate-in ``checkpoint`` as the
+        job's replay origin and re-injects it on dispatch."""
+        if self._closed:
+            raise FleetError("router is shut down")
+        jid = doc.get("id") or f"fleet-{next(self._ids)}"
+        kind = "crack" if (
+            "digests" in doc or "digest_list" in doc
+        ) else "candidates"
+        sdoc = {k: v for k, v in doc.items()
+                if k not in ("checkpoint", "replay_mute")}
+        sdoc["id"] = jid
+        sdoc["op"] = "submit"
+        job = RoutedJob(jid, kind, sdoc, self._doc_token(sdoc), emit)
+        job.checkpoint = doc.get("checkpoint")
+        job.n_forwarded = int(doc.get("replay_mute", 0))
+        with self._lock:
+            prev = self._jobs.get(jid)
+            if prev is not None and prev.unsettled:
+                raise FleetError(f"job id {jid!r} is still active")
+            self._jobs[jid] = job
+        try:
+            ack = dict(self._dispatch(job))
+        except FleetError:
+            # Never admitted anywhere: drop the table entry so the
+            # client can retry under the same id.
+            with self._lock:
+                if self._jobs.get(jid) is job:
+                    del self._jobs[jid]
+            raise
+        ack["engine"] = job.link.engine_id if job.link else None
+        telemetry.counter("fleet.jobs_routed").add(1)
+        return ack
+
+    def pause(self, jid: str) -> None:
+        job = self._job(jid)
+        if job.state != "routed" or job.link is None:
+            raise FleetError(f"job {jid!r} is {job.state}, not running")
+        job.link.send({"op": "pause", "id": jid})
+
+    def resume(self, jid: str) -> dict:
+        """Re-place a paused job from its router-held checkpoint;
+        returns the ``accepted`` event (``resumed`` flagged) to
+        forward downstream."""
+        job = self._job(jid)
+        if job.state != "paused":
+            raise FleetError(f"job {jid!r} is {job.state}, not paused")
+        ack = dict(self._dispatch(job))
+        ack["resumed"] = True
+        return ack
+
+    def cancel(self, jid: str) -> None:
+        job = self._job(jid)
+        if job.state == "routed" and job.link is not None:
+            job.link.send({"op": "cancel", "id": jid})
+            return
+        if job.state == "paused":
+            # Nothing runs engine-side: settle here and tell the
+            # client ourselves.
+            self._forward(job, {"id": jid, "event": "cancelled"})
+            self._settle(job, "cancelled")
+            return
+        raise FleetError(f"job {jid!r} is {job.state}")
+
+    def migrate(self, jid: str,
+                engine_id: Optional[str] = None) -> dict:
+        """Rebalance one running job: pause → checkpoint over the wire
+        → resubmit on the target (or placement-chosen) engine, with
+        already-delivered hits muted on redelivery.  Candidates jobs
+        RESTART on the target instead (cancel + fresh resubmission —
+        their output is engine-local).  Asynchronous: returns an ack;
+        the job continues streaming on its same client session."""
+        job = self._job(jid)
+        if job.state != "routed" or job.link is None:
+            raise FleetError(f"job {jid!r} is {job.state}, not running")
+        if engine_id is not None:
+            self._resolve(engine_id)  # fail loudly before pausing
+            if engine_id == job.link.engine_id:
+                return {"id": jid, "event": "migrating",
+                        "from": engine_id, "to": engine_id,
+                        "noop": True}
+        job.target = engine_id
+        job.migrating = True
+        telemetry.counter("fleet.migrations").add(1)
+        if job.kind == "crack":
+            job.link.send({"op": "pause", "id": jid})
+        else:
+            job.link.send({"op": "cancel", "id": jid})
+        return {"id": jid, "event": "migrating",
+                "from": job.link.engine_id,
+                "to": engine_id or "(placement)"}
+
+    def drain(self, engine_id: str) -> dict:
+        """Empty one engine for shutdown: no new placements land on
+        it, and every job routed there migrates off (placement picks
+        the targets).  Returns the count of jobs set migrating."""
+        link = self._resolve(engine_id)
+        link.draining = True
+        with self._lock:
+            jids = [
+                jid for jid in link.routed
+                if (j := self._jobs.get(jid)) is not None
+                and j.state == "routed" and not j.migrating
+            ]
+        for jid in jids:
+            self.migrate(jid)
+        return {"event": "draining", "engine": engine_id,
+                "jobs": len(jids)}
+
+    def stats(self) -> dict:
+        """The fleet's merged ``stats`` event: per-engine scrapes
+        summed (so serve clients reading job counts keep working) plus
+        a ``fleet`` section with per-engine detail and the router's
+        own counters."""
+        agg: dict = {}
+        members = []
+        for link in self.engines():
+            s = dict(link.scrape)
+            if link.alive:
+                try:
+                    s = self._scrape(link)
+                except FleetError:
+                    pass  # poller/watchdog owns the death call
+            if link.alive:
+                # Only LIVE engines sum into the fleet aggregate — a
+                # dead member's stale last scrape would double-count
+                # the jobs that crash-replayed onto the survivors
+                # (its detail row below still shows the final state).
+                for k, v in s.items():
+                    if isinstance(v, bool) or k in (
+                        "packed_fill", "config_defaults"
+                    ):
+                        continue
+                    if isinstance(v, (int, float)):
+                        agg[k] = agg.get(k, 0) + v
+                    elif isinstance(v, dict):
+                        cur = agg.setdefault(k, {})
+                        for gk, gv in v.items():
+                            if isinstance(gv, (int, float)) \
+                                    and not isinstance(gv, bool):
+                                cur[gk] = cur.get(gk, 0) + gv
+            members.append({
+                "engine": link.engine_id,
+                "endpoint": link.endpoint,
+                "alive": link.alive,
+                "draining": link.draining,
+                "jobs_routed": len(link.routed),
+                "resident_groups": sorted(
+                    self._resident_tokens(link)
+                ),
+                "packed_fill": s.get("packed_fill", 0.0),
+            })
+        with self._lock:
+            unsettled = sum(
+                1 for j in self._jobs.values() if j.unsettled
+            )
+        return {
+            "event": "stats",
+            **agg,
+            "fleet": {
+                "place": self._place,
+                "engines": members,
+                "engines_alive": sum(1 for m in members if m["alive"]),
+                "jobs_tracked": unsettled,
+                **{
+                    name: int(
+                        telemetry.counter(f"fleet.{name}").value
+                    ) - base
+                    for name, base in self._counters0.items()
+                },
+            },
+        }
+
+    def metrics(self) -> dict:
+        """Merged registry scrape: every live engine's snapshot (each
+        labeled with its engine identity) merged with the router's own
+        — counters sum fleet-wide, per-engine gauges stay per-engine
+        series (``telemetry.merge``) — plus the Prometheus text."""
+        snaps = []
+        for link in self.engines():
+            if not link.alive:
+                continue
+            try:
+                ev = link.request({"op": "metrics"},
+                                  timeout=self._control_timeout)
+            except FleetError:
+                continue
+            snaps.append(ev.get("metrics") or {})
+        snaps.append(telemetry.snapshot())
+        merged = telemetry.merge(snaps)
+        return {
+            "event": "metrics",
+            "metrics": merged,
+            "prometheus": telemetry.to_prometheus(merged),
+        }
+
+    def passthrough(self, doc: dict) -> None:
+        """Forward an op the router does not interpret to the engine
+        running its job — new serve ops stay fleet-compatible without
+        a router release (CONTRIBUTING: router-passthrough-safe)."""
+        job = self._job(doc.get("id"))
+        if job.link is None:
+            raise FleetError(f"job {job.id!r} is not on an engine")
+        job.link.send(doc)
+
+    def wait(self, jid: str, timeout: Optional[float] = None) -> bool:
+        """Block until a job settles (done/failed/cancelled) or pauses
+        — the embedder/test convenience."""
+        return self._job(jid).settled.wait(timeout)
+
+    def job(self, jid: str) -> RoutedJob:
+        return self._job(jid)
+
+    def close(self, *, shutdown_engines: bool = True,
+              timeout: float = 30.0) -> None:
+        """Stop routing.  ``shutdown_engines`` sends each engine the
+        shutdown op (and reaps spawned processes); attach-mode callers
+        pass False to leave the engines serving."""
+        self._closed = True
+        self._poll_stop.set()
+        self._requeue.put(None)
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=5.0)
+        self._requeue_thread.join(timeout=5.0)
+        for link in self.engines():
+            link._closing = True
+            if shutdown_engines and link.alive:
+                try:
+                    link.request({"op": "shutdown"}, timeout=timeout)
+                except FleetError:
+                    pass
+            link.close()
+            if shutdown_engines and link.proc is not None:
+                try:
+                    link.proc.wait(timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    link.proc.kill()
+                    link.proc.wait()
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- internals -----------------------------------------------------
+
+    def _job(self, jid) -> RoutedJob:
+        with self._lock:
+            job = self._jobs.get(jid)
+        if job is None:
+            raise FleetError(f"unknown job id {jid!r}")
+        return job
+
+    def _dispatch(self, job: RoutedJob,
+                  exclude: Sequence[EngineLink] = ()) -> dict:
+        """Place (or re-place) one job: pick an engine, ship the
+        document — with the router-held checkpoint and the
+        exactly-once mute for crack jobs — and bind the routing
+        state.  Raises :class:`FleetError` when no engine accepts.
+
+        The binding lands BEFORE the submit request goes out: the
+        engine's pump can start streaming hits the instant it accepts,
+        and the link reader must already resolve them to this job — a
+        bind-after-ack would drop the first fetch's hits on the
+        floor."""
+        target = job.target
+        job.target = None
+        link = (
+            self._resolve(target) if target is not None
+            else self._pick(job.token, exclude)
+        )
+        doc = dict(job.doc)
+        # The checkpoint rides for BOTH kinds (a client-provided
+        # candidates resume must keep the engine's append-resume
+        # contract — the router-initiated restart paths clear
+        # ``job.checkpoint`` instead); the mute is crack-only (it
+        # gates the hit-delivery queue).
+        if job.checkpoint is not None:
+            doc["checkpoint"] = job.checkpoint
+        if job.kind == "crack" and job.n_forwarded:
+            doc["replay_mute"] = job.n_forwarded
+        prev_state = job.state
+        with self._lock:
+            job.link = link
+            job.state = "routed"
+            job.acked = False
+            link.routed.add(job.id)
+            job.settled.clear()
+        try:
+            ack = link.request(doc, timeout=self._control_timeout)
+        except FleetError:
+            with self._lock:
+                if job.link is link:
+                    job.link = None
+                    job.state = prev_state
+                link.routed.discard(job.id)
+            raise
+        with self._lock:
+            job.acked = True
+        return ack
+
+    def _settle(self, job: RoutedJob, state: str) -> None:
+        with self._lock:
+            job.state = state
+            if job.link is not None:
+                job.link.routed.discard(job.id)
+                job.link = None
+            job.migrating = False
+            if state != "paused":
+                # Terminal: release the heavy references — the full
+                # submit document (a service-scale router must not
+                # retain every tenant's wordlist forever; the table
+                # entry itself stays as the id-reuse guard) and the
+                # session callback (a dead client's entry must not pin
+                # its outbound buffer).
+                job.doc = {"id": job.id}
+                job.emit = None
+        job.settled.set()
+
+    def _forward(self, job: RoutedJob, ev: dict) -> None:
+        emit = job.emit
+        if emit is None:
+            return
+        try:
+            emit(ev)
+        except (OSError, ValueError):
+            # Client gone: stop forwarding, keep the job running —
+            # the serve tier's dead-client discipline (PERF.md §23).
+            job.emit = None
+
+    def _remigrate(self, job: RoutedJob, old: EngineLink) -> None:
+        """The second half of a drain/migrate: the job parked (crack:
+        paused with checkpoint; candidates: cancelled, restart
+        fresh) — re-place it, muted, without bothering the client.  A
+        failed re-place must not strand the job silently: it settles
+        failed downstream with the checkpoint attached."""
+        job.migrating = False
+        with self._lock:
+            old.routed.discard(job.id)
+            job.link = None
+        self._requeue.put((job, (old,), None))
+
+    def _requeue_worker(self) -> None:
+        while True:
+            item = self._requeue.get()
+            if item is None:
+                return
+            job, exclude, counter = item
+            try:
+                self._dispatch(job, exclude)
+            except FleetError as exc:
+                self._fail_unplaceable(job, exc)
+            else:
+                if counter:
+                    telemetry.counter(counter).add(1)
+
+    def _fail_unplaceable(self, job: RoutedJob,
+                          exc: Exception) -> None:
+        ev = {"id": job.id, "event": "failed",
+              "error": f"FleetError: {exc}"}
+        if job.checkpoint is not None:
+            ev["checkpoint"] = job.checkpoint
+        # Forward BEFORE settling (here and in the event plane): a
+        # caller woken by ``wait()`` must find the terminal event
+        # already delivered.
+        self._forward(job, ev)
+        self._settle(job, "failed")
+
+    # -- engine event plane (link reader threads) ----------------------
+
+    def _on_job_event(self, link: EngineLink, ev: dict) -> None:
+        with self._lock:
+            job = self._jobs.get(ev.get("id"))
+        if job is None or job.link is not link:
+            return  # stale event from an engine the job left
+        event = ev.get("event")
+        if event == "hit":
+            job.n_forwarded += 1
+            self._forward(job, ev)
+        elif event == "done":
+            self._forward(job, ev)
+            self._settle(job, "done")
+        elif event == "paused":
+            job.checkpoint = ev.get("checkpoint")
+            if job.migrating:
+                self._remigrate(job, link)
+                return
+            with self._lock:
+                job.state = "paused"
+                link.routed.discard(job.id)
+                job.link = None
+            self._forward(job, ev)
+            job.settled.set()
+        elif event == "cancelled":
+            if job.migrating and job.kind == "candidates":
+                # Restart-style migration: the cancel was ours.
+                job.checkpoint = None
+                self._remigrate(job, link)
+                return
+            self._forward(job, ev)
+            self._settle(job, "cancelled")
+        elif event == "failed":
+            ck = ev.get("checkpoint")
+            if ck is not None and job.replays < self._replay_budget:
+                # Quarantine resubmission (PERF.md §23→§25): the
+                # failed event's checkpoint IS the migrate token.
+                job.replays += 1
+                job.checkpoint = ck
+                with self._lock:
+                    link.routed.discard(job.id)
+                    job.link = None
+                self._requeue.put((job, (link,),
+                                   "fleet.jobs_replayed"))
+                return
+            if ck is not None:
+                job.checkpoint = ck
+            self._forward(job, ev)
+            self._settle(job, "failed")
+        elif event == "accepted":
+            # A resumed/duplicate ack that missed the request window;
+            # nothing to do.
+            pass
+        else:
+            self._forward(job, ev)  # future per-job events pass through
+
+    def _on_death(self, link: EngineLink) -> None:
+        """Crash-replay (the fleet's whole point): every job routed to
+        the dead engine requeues onto the survivors from its last
+        router-held checkpoint, with already-forwarded hits muted so
+        the client stream stays exactly-once and byte-identical."""
+        if self._closed:
+            return
+        with self._lock:
+            if link not in self._links:
+                return
+            link.alive = False
+            # Only ACKED placements requeue here: a job whose dispatch
+            # request is still in flight belongs to its dispatching
+            # thread — that request is failing with "connection lost"
+            # right now, and its caller handles the job exactly once.
+            jobs = [
+                self._jobs[jid] for jid in sorted(link.routed)
+                if jid in self._jobs and self._jobs[jid].acked
+            ]
+            link.routed.clear()
+        telemetry.counter("fleet.engine_deaths").add(1)
+        if link.proc is not None and link.proc.poll() is None:
+            # Torn socket but live process: a half-dead engine must
+            # not keep burning the device for jobs we re-place.
+            try:
+                link.proc.terminate()
+            except OSError:
+                pass
+        for job in jobs:
+            job.link = None
+            job.migrating = False
+            job.target = None
+            if not job.unsettled or job.state == "paused":
+                continue
+            if job.kind == "candidates":
+                job.checkpoint = None  # restart: output truncates
+            self._requeue.put((job, (), "fleet.jobs_replayed"))
+
+    # -- health --------------------------------------------------------
+
+    def _scrape(self, link: EngineLink) -> dict:
+        # The stats op answers from a session thread (counter reads,
+        # no device work) on the link's DEDICATED health connection —
+        # blocking ops on the main op stream (a pause parking at a
+        # superstep boundary) can never make a healthy engine look
+        # dead.  The short cadence-scaled timeout bounds how long the
+        # watchdog takes to declare a wedged engine (poll_misses ×
+        # this).
+        ev = link.health_request(
+            {"op": "stats"},
+            timeout=max(2.0 * self._poll_s, 2.0),
+        )
+        if ev.get("event") == "error":
+            raise FleetError(
+                f"engine {link.engine_id}: {ev.get('error')}"
+            )
+        link.scrape = ev
+        link.misses = 0
+        return ev
+
+    def _poll_loop(self) -> None:
+        while not self._poll_stop.wait(self._poll_s):
+            for link in self.engines():
+                if not link.alive:
+                    continue
+                if link.proc is not None and link.proc.poll() is not None:
+                    link.kill_socket()  # reaped: reader EOF replays
+                    continue
+                try:
+                    self._scrape(link)
+                except FleetError:
+                    link.misses += 1
+                    if link.misses >= self._poll_misses:
+                        # Wedged engine (socket up, serve loop gone):
+                        # the watchdog declares it dead the same way a
+                        # torn socket would.
+                        link.kill_socket()
+
+
+# ---------------------------------------------------------------------------
+# Local engine spawning
+# ---------------------------------------------------------------------------
+
+
+def spawn_engines(n: int, directory: str, *,
+                  engine_args: Sequence[str] = (),
+                  engine_id_prefix: str = "eng",
+                  env: Optional[dict] = None,
+                  stderr=subprocess.DEVNULL
+                  ) -> List[Tuple[str, str, subprocess.Popen]]:
+    """Spawn ``n`` local ``a5gen serve`` engine processes, each on its
+    own unix socket under ``directory``, all sharing ``engine_args``
+    (geometry flags, and — the fleet artifact store — one
+    ``--schema-cache`` directory).  Returns ``(socket_path, engine_id,
+    proc)`` triples; callers attach them to a :class:`FleetRouter`
+    (which retries until each engine's post-jax-import bind lands)."""
+    os.makedirs(directory, exist_ok=True)
+    out = []
+    for i in range(int(n)):
+        sock = os.path.join(directory, f"{engine_id_prefix}{i}.sock")
+        eid = f"{engine_id_prefix}{i}"
+        cmd = [
+            sys.executable, "-m", _PACKAGE, "serve",
+            "--socket", sock, "--engine-id", eid, *engine_args,
+        ]
+        proc = subprocess.Popen(
+            cmd, env=env, stdout=subprocess.DEVNULL, stderr=stderr,
+        )
+        out.append((sock, eid, proc))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# JSONL front-ends (``a5gen fleet``): the serve protocol, routed
+# ---------------------------------------------------------------------------
+
+
+class _RouterSession:
+    """One upstream JSONL command stream against a shared
+    :class:`FleetRouter` — the same protocol ``_JsonlSession`` speaks
+    for one engine, so serve clients work unmodified.  Job events are
+    forwarded by the router from the engine links onto the session
+    that submitted the job; the job registry is router-global, so any
+    session operates on any job by id (the serve tier's adoption
+    semantics)."""
+
+    #: outbound event buffer per session; a client further behind than
+    #: this is dropped (see ``_emit``).
+    OUT_DEPTH = 4096
+
+    def __init__(self, router: FleetRouter, fin, fout) -> None:
+        self._router = router
+        self._fin = fin
+        self._fout = fout
+        #: all writes ride ONE bounded queue drained by a dedicated
+        #: writer thread: ``_emit`` is called from engine-link reader
+        #: threads (event forwarding), and a client that stops
+        #: draining its socket must never block a reader — that would
+        #: stall every tenant on that engine and make it look dead to
+        #: the watchdog.  A full queue means the client is
+        #: irrecoverably behind: the session goes dead (the router's
+        #: ``_forward`` then stops forwarding; jobs keep running — the
+        #: serve tier's dead-client discipline).
+        self._out: "queue.Queue" = queue.Queue(maxsize=self.OUT_DEPTH)
+        self._dead = False
+        self._writer = threading.Thread(
+            target=self._write_loop, name="a5-fleet-session-out",
+            daemon=True,
+        )
+        self._writer.start()
+
+    def _write_loop(self) -> None:
+        while True:
+            obj = self._out.get()
+            if obj is None:
+                return
+            if self._dead:
+                continue  # drain and discard: producers never block
+            try:
+                self._fout.write(json.dumps(obj) + "\n")
+                self._fout.flush()
+            except (OSError, ValueError):
+                self._dead = True
+
+    def _emit(self, obj: dict) -> None:
+        if self._dead:
+            raise OSError("client connection is gone")
+        try:
+            self._out.put_nowait(obj)
+        except queue.Full:
+            self._dead = True
+            raise OSError(
+                "client outbound queue overflowed (slow consumer)"
+            ) from None
+
+    def _handle(self, doc: dict) -> bool:
+        op = doc.get("op", "submit")
+        jid = doc.get("id")
+        if op == "shutdown":
+            self._emit({"event": "bye"})
+            return False
+        if op == "stats":
+            self._emit(self._router.stats())
+            return True
+        if op == "metrics":
+            self._emit(self._router.metrics())
+            return True
+        if op == "submit":
+            ack = self._router.submit(doc, emit=self._emit)
+            self._emit({
+                "id": ack.get("id", jid), "event": "accepted",
+                "kind": ack.get("kind"), "engine": ack.get("engine"),
+            })
+            return True
+        if op == "pause":
+            self._router.pause(jid)
+        elif op == "resume":
+            ack = self._router.resume(jid)
+            self._emit({
+                "id": jid, "event": "accepted",
+                "kind": ack.get("kind"), "resumed": True,
+            })
+        elif op == "cancel":
+            self._router.cancel(jid)
+        elif op == "migrate":
+            self._emit(self._router.migrate(jid, doc.get("engine")))
+        elif op == "drain":
+            self._emit(self._router.drain(doc.get("engine")))
+        elif jid is not None:
+            # Unknown op on a known job: pass through to its engine —
+            # new serve ops must not need a router release.
+            self._router.passthrough(doc)
+        else:
+            raise ValueError(f"unknown op {op!r}")
+        return True
+
+    def run(self) -> bool:
+        """Process the stream; True when an explicit ``shutdown``
+        ended it (EOF ends only this session).  Stops the writer
+        thread on exit, flushing whatever the client still drains
+        (the ``bye`` ack included)."""
+        try:
+            while True:
+                try:
+                    line = self._fin.readline()
+                except (OSError, ValueError):
+                    return False
+                if not line:
+                    return False
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    doc = json.loads(line)
+                    keep_going = self._handle(doc)
+                except OSError:
+                    return False  # this session's client is gone
+                except Exception as exc:  # noqa: BLE001 — protocol
+                    try:
+                        self._emit({
+                            "event": "error",
+                            "error": f"{type(exc).__name__}: {exc}",
+                        })
+                    except OSError:
+                        return False
+                    continue
+                if not keep_going:
+                    return True
+        finally:
+            self._out.put(None)
+            self._writer.join(timeout=5.0)
+            # Late forwards for still-running jobs must raise into
+            # the router's _forward (which then drops the callback),
+            # not buffer into a queue nobody drains.
+            self._dead = True
+
+
+def serve_fleet_stdio(router: FleetRouter, fin, fout) -> None:
+    """Serve one JSONL command stream against the router."""
+    _RouterSession(router, fin, fout).run()
+
+
+def serve_fleet_socket(router: FleetRouter, path: str, *,
+                       ready: Optional[Callable[[], None]] = None
+                       ) -> None:
+    """Serve JSONL sessions over a unix socket at ``path`` (one
+    session per connection, all sharing the router and its job
+    registry); returns when a session sends ``shutdown``."""
+    try:
+        os.unlink(path)
+    except FileNotFoundError:
+        pass
+    stop = threading.Event()
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    try:
+        srv.bind(path)
+        srv.listen()
+        srv.settimeout(0.2)
+        if ready is not None:
+            ready()
+        while not stop.is_set():
+            try:
+                conn, _addr = srv.accept()
+            except socket.timeout:
+                continue
+
+            def _session(conn=conn) -> None:
+                with conn:
+                    fin = conn.makefile("r", encoding="utf-8")
+                    fout = conn.makefile("w", encoding="utf-8")
+                    if _RouterSession(router, fin, fout).run():
+                        stop.set()
+
+            threading.Thread(
+                target=_session, name="a5-fleet-conn", daemon=True
+            ).start()
+    finally:
+        srv.close()
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass

@@ -58,6 +58,42 @@ import numpy as np
 from . import faults, telemetry
 
 
+def static_affinity_token(**fields) -> str:
+    """Stable 12-hex token over named static-config fields — the
+    hashable spelling of a compatibility key that survives the wire
+    (JSON, stats events, router tables).  Field ORDER is canonical
+    (sorted by name) and values stringify, so any process computing
+    the token from the same facts gets the same string."""
+    import hashlib
+
+    blob = "|".join(f"{k}={fields[k]!r}" for k in sorted(fields))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]
+
+
+def affinity_token(spec, cfg) -> str:
+    """The static-trace-config prefix of :func:`pack_candidate`'s
+    compatibility key as a stable hashable token (PERF.md §25).
+
+    This is everything the packed program's trace depends on that is
+    knowable WITHOUT building the job's plan — the scheduler-visible
+    half of the full key (which further refines on plan-derived
+    statics: trailing shapes, piece schema, radix2, pair
+    eligibility).  Equal tokens are therefore necessary, not
+    sufficient, for two jobs to fuse — exactly the right signal for
+    PLACEMENT: a router co-locating equal-token jobs maximizes the
+    chance the engine's step cache and fuse path find a match, and a
+    token mismatch proves they never will.  The fleet router computes
+    the same token from a submit document's doc-level fields
+    (``runtime.fleet``); the engine reports its resident slots' tokens
+    through the serve ``stats`` op."""
+    return static_affinity_token(
+        mode=spec.mode, algo=spec.algo,
+        table_min=spec.min_substitute, table_max=spec.max_substitute,
+        lanes=cfg.lanes, num_blocks=cfg.num_blocks,
+        superstep=cfg.superstep, devices=cfg.devices, pair=cfg.pair,
+    )
+
+
 def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
     """One job's packed-dispatch eligibility probe: returns the fuse
     descriptor (plan, block index, aligned start cursor, and the static

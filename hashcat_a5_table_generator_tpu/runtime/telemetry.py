@@ -217,6 +217,34 @@ class MetricsRegistry:
 #: The process-wide registry every subsystem publishes into.
 REGISTRY = MetricsRegistry()
 
+#: Engine identity label (PERF.md §25): set by ``a5gen serve
+#: --engine-id`` (default ``pid@host`` in serve mode), None outside
+#: service mode — standalone runs keep unlabeled series, so nothing
+#: downstream changes until a fleet actually exists.
+_ENGINE_ID: Optional[str] = None
+
+
+def set_engine_id(engine_id: Optional[str]) -> None:
+    """Label every subsequent :func:`snapshot` with this engine's
+    identity, so the fleet router's merged scrape distinguishes
+    members instead of silently blending same-named series.  ``None``
+    clears the label (tests)."""
+    global _ENGINE_ID
+    _ENGINE_ID = engine_id
+
+
+def default_engine_id() -> str:
+    """``pid@host`` — the ``--engine-id`` default: unique per process
+    on one host, stable for the process lifetime."""
+    import os
+    import socket as socket_mod
+
+    return f"{os.getpid()}@{socket_mod.gethostname()}"
+
+
+def engine_id() -> Optional[str]:
+    return _ENGINE_ID
+
 
 def counter(name: str) -> Counter:
     return REGISTRY.counter(name)
@@ -232,7 +260,11 @@ def histogram(name: str,
 
 
 def snapshot() -> Dict[str, dict]:
-    return REGISTRY.snapshot()
+    snap = REGISTRY.snapshot()
+    if _ENGINE_ID is not None:
+        for entry in snap.values():
+            entry["engine"] = _ENGINE_ID
+    return snap
 
 
 # ---------------------------------------------------------------------------
@@ -250,11 +282,14 @@ def delta(before: Dict[str, dict], after: Dict[str, dict]
     out: Dict[str, dict] = {}
     for name, snap in after.items():
         prev = before.get(name)
+        label = (
+            {"engine": snap["engine"]} if "engine" in snap else {}
+        )
         if snap["type"] == "counter":
             base = prev["value"] if prev else 0
             d = snap["value"] - base
             if d:
-                out[name] = {"type": "counter", "value": d}
+                out[name] = {"type": "counter", "value": d, **label}
         elif snap["type"] == "histogram":
             if prev and prev.get("edges") != snap["edges"]:
                 prev = None  # re-created with new edges: delta from zero
@@ -268,7 +303,7 @@ def delta(before: Dict[str, dict], after: Dict[str, dict]
                     "type": "histogram", "edges": list(snap["edges"]),
                     "counts": counts,
                     "sum": snap["sum"] - (prev["sum"] if prev else 0.0),
-                    "count": count,
+                    "count": count, **label,
                 }
         else:
             # Gauges are point-in-time: the "delta" is the current
@@ -279,26 +314,60 @@ def delta(before: Dict[str, dict], after: Dict[str, dict]
     return out
 
 
+def _series_key(name: str, engine_id) -> str:
+    """Merged-output key of a per-engine-kept series — the Prometheus
+    label spelling, so the merged dict reads like the exposition."""
+    return f'{name}{{engine="{engine_id or ""}"}}'
+
+
 def merge(snapshots: Iterable[Dict[str, dict]]) -> Dict[str, dict]:
     """Combine snapshots from many sources (buckets, hosts, engines):
     counters and histogram buckets sum (histogram edge layouts must
-    match — mismatched edges fail loudly instead of blending apples),
-    gauges follow their declared ``agg``.  Keys are processed in sorted
-    order, so every participant of a multihost exchange reduces the
-    identical sequence (the fixed-order rule collectives require)."""
+    match — mismatched edges fail loudly instead of blending apples;
+    a cross-engine sum drops the now-meaningless ``engine`` label),
+    gauges follow their declared ``agg`` — but ONLY among entries of
+    one engine: gauges carrying conflicting ``engine`` labels (a
+    fleet router's merged scrape, PERF.md §25) are kept as per-engine
+    series under :func:`_series_key` keys instead of silently
+    aggregating point-in-time values across members.  Keys are
+    processed in sorted order, so every participant of a multihost
+    exchange reduces the identical sequence (the fixed-order rule
+    collectives require)."""
     out: Dict[str, dict] = {}
+    split: set = set()  # gauge names gone per-engine
     for snap in snapshots:
         for name in sorted(snap):
             entry = snap[name]
-            cur = out.get(name)
+            key = name
+            if entry["type"] == "gauge":
+                if name in split:
+                    key = _series_key(name, entry.get("engine"))
+                else:
+                    cur = out.get(name)
+                    if (
+                        cur is not None
+                        and cur.get("engine") != entry.get("engine")
+                    ):
+                        # First conflict: re-key the resident series
+                        # and route this (and every later) entry to
+                        # its own engine's series.
+                        out[_series_key(name, cur.get("engine"))] = \
+                            out.pop(name)
+                        split.add(name)
+                        key = _series_key(name, entry.get("engine"))
+            cur = out.get(key)
             if cur is None:
-                out[name] = json.loads(json.dumps(entry))  # deep copy
+                out[key] = json.loads(json.dumps(entry))  # deep copy
                 continue
             if cur["type"] != entry["type"]:
                 raise ValueError(
                     f"metric {name!r} merges a {cur['type']} with a "
                     f"{entry['type']}"
                 )
+            if cur.get("engine") != entry.get("engine"):
+                # Summed across engines: the per-member label no
+                # longer describes the value.
+                cur.pop("engine", None)
             if entry["type"] == "counter":
                 cur["value"] += entry["value"]
             elif entry["type"] == "histogram":
@@ -330,30 +399,61 @@ def _prom_name(name: str, prefix: str) -> str:
     return f"{prefix}_{out}"
 
 
+def _prom_labels(entry: dict, extra: str = "") -> str:
+    """Label block for one series: the optional ``le`` bucket label
+    plus the ``engine`` identity label when the snapshot carries one
+    (PERF.md §25 — a fleet's merged scrape must distinguish
+    members)."""
+    parts = [extra] if extra else []
+    if "engine" in entry:
+        parts.append(f'engine="{entry["engine"]}"')
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
 def to_prometheus(snap: Dict[str, dict], prefix: str = "a5gen") -> str:
     """Prometheus text exposition (v0.0.4) of a snapshot: counters,
     gauges, and cumulative ``le``-bucketed histograms with ``+Inf``,
-    ``_sum`` and ``_count`` series."""
+    ``_sum`` and ``_count`` series.  Entries labeled with an engine
+    identity render it as an ``engine="..."`` label; per-engine-kept
+    series from :func:`merge` (their dict keys already spell the
+    label) render under their base name with the label from the
+    entry."""
     lines: List[str] = []
+    typed: set = set()  # one # TYPE line per metric name (the
+    # exposition format rejects duplicates — per-engine split series
+    # of one gauge share a single TYPE header)
     for name in sorted(snap):
         entry = snap[name]
-        pname = _prom_name(name, prefix)
+        # A merge()-split series key carries its label in the name;
+        # the entry's "engine" field is the authoritative rendering.
+        base = name.split("{", 1)[0]
+        pname = _prom_name(base, prefix)
+        label = _prom_labels(entry)
         if entry["type"] == "histogram":
-            lines.append(f"# TYPE {pname} histogram")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} histogram")
             cum = 0
             for edge, c in zip(entry["edges"], entry["counts"]):
                 cum += c
-                lines.append(f'{pname}_bucket{{le="{edge:g}"}} {cum}')
+                le = 'le="%g"' % edge
+                lines.append(
+                    f"{pname}_bucket{_prom_labels(entry, le)} {cum}"
+                )
+            inf = 'le="+Inf"'
             lines.append(
-                f'{pname}_bucket{{le="+Inf"}} {entry["count"]}'
+                f'{pname}_bucket{_prom_labels(entry, inf)} '
+                f'{entry["count"]}'
             )
-            lines.append(f"{pname}_sum {entry['sum']:g}")
-            lines.append(f"{pname}_count {entry['count']}")
+            lines.append(f"{pname}_sum{label} {entry['sum']:g}")
+            lines.append(f"{pname}_count{label} {entry['count']}")
         else:
-            lines.append(f"# TYPE {pname} {entry['type']}")
+            if pname not in typed:
+                typed.add(pname)
+                lines.append(f"# TYPE {pname} {entry['type']}")
             v = entry["value"]
-            lines.append(f"{pname} {v:g}" if isinstance(v, float)
-                         else f"{pname} {v}")
+            lines.append(f"{pname}{label} {v:g}" if isinstance(v, float)
+                         else f"{pname}{label} {v}")
     return "\n".join(lines) + "\n"
 
 

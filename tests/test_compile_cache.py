@@ -124,3 +124,106 @@ class TestWatcherSelfCheck:
         f(jnp.ones((2,), jnp.int32)).block_until_ready()
         with watcher.expect(0):
             f(jnp.ones((2,), jnp.int32) * 7).block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# Shared schema cache, concurrent-writer safe (PERF.md §25)
+# ---------------------------------------------------------------------------
+#
+# N fleet engines share ONE --schema-cache directory as the fleet
+# artifact store; entries are written through the durable atomic
+# replace (checkpoint.atomic_write_bytes), so a reader must only ever
+# see a COMPLETE entry from some writer generation — never a torn one.
+# The corrupt-entry=miss tests above cover the read side; this is the
+# write side under real cross-process contention.
+
+_HAMMER_WRITER = r"""
+import sys
+import numpy as np
+from hashcat_a5_table_generator_tpu.ops.packing import (
+    PieceGroup, PieceSchema, save_piece_schema,
+)
+
+cache_dir, key, fill, rounds = (
+    sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4])
+)
+group = PieceGroup(
+    sel_cols=(0,), n_variants=4, n_words=2, off_cap=16, has_term=True,
+    off_floor=0, len_fixed=None,
+)
+schema = PieceSchema(
+    kind="match", groups=(group,), closed=False, max_out=16, n_cols=1,
+    gw=np.full((64, 1, 4, 2), fill, dtype=np.uint32),  # ~128 KiB
+    gl=np.full((64, 1, 4), fill, dtype=np.uint8),
+    gw16=None, sel_bit=None, sel_slot=None,
+)
+for _ in range(rounds):
+    save_piece_schema(cache_dir, key, schema)
+print("WROTE")
+"""
+
+
+def test_two_process_schema_cache_write_hammer(tmp_path):
+    """Two writer processes hammer the SAME cache key while this
+    process reads it in a loop: with the durable atomic replace, the
+    entry — once it exists — is always a complete generation from ONE
+    writer (its arrays uniformly that writer's fill value), and never
+    degrades back to a miss (a miss after a hit would mean a reader
+    saw a torn or half-renamed file)."""
+    import subprocess
+    import sys as _sys
+
+    import numpy as np
+
+    from hashcat_a5_table_generator_tpu.ops.packing import (
+        load_piece_schema,
+    )
+
+    cache = str(tmp_path / "cache")
+    key = "hammered"
+    writers = [
+        subprocess.Popen(
+            [_sys.executable, "-c", _HAMMER_WRITER, cache, key,
+             str(fill), "60"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for fill in (1, 2)
+    ]
+    try:
+        seen_fills = set()
+        seen_hit = False
+        deadline = __import__("time").monotonic() + 60
+        while any(w.poll() is None for w in writers):
+            assert __import__("time").monotonic() < deadline
+            hit, schema = load_piece_schema(cache, key)
+            if not hit:
+                assert not seen_hit, (
+                    "entry vanished/teared after a successful read"
+                )
+                continue
+            seen_hit = True
+            assert schema is not None
+            gw = np.asarray(schema.gw)
+            fills = set(np.unique(gw).tolist())
+            assert len(fills) == 1, f"torn entry: mixed fills {fills}"
+            assert int(np.unique(np.asarray(schema.gl))[0]) in fills
+            seen_fills |= fills
+        for w in writers:
+            out, err = w.communicate(timeout=30)
+            assert w.returncode == 0, err.decode()[-500:]
+            assert b"WROTE" in out
+        # Final state: a complete entry from one of the two writers.
+        hit, schema = load_piece_schema(cache, key)
+        assert hit and schema is not None
+        assert seen_hit and seen_fills <= {1, 2}
+        # No tmp litter survives the contention.
+        import os as _os
+
+        assert [
+            n for n in _os.listdir(cache) if ".tmp." in n
+        ] == []
+    finally:
+        for w in writers:
+            if w.poll() is None:
+                w.kill()
+                w.wait()

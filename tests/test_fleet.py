@@ -1,0 +1,742 @@
+"""Fleet tier (PERF.md §25): router + multi-engine pool.
+
+Fast tier runs IN-PROCESS engine pools (two ``Engine`` instances
+behind real ``serve_socket`` unix sockets — full wire realism, no
+subprocess jax imports) sharing the suite's 64×16 geometry so the
+process step cache serves everything: multi-tenant parity through the
+router, pause/resume and migrate with exactly-once redelivery,
+crash-replay over a torn socket, the health watchdog, placement, the
+checkpoint wire-version gate, and the telemetry engine label.
+
+The REAL multi-process contracts are slow-marked: the kill-one-engine
+soak (spawned engines, SIGKILL mid-sweep, byte parity vs solo) and the
+affinity compile-reuse instrument (per-process step caches are what
+make 1-vs-2 program builds observable).
+"""
+
+import hashlib
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.runtime import telemetry
+from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+    CheckpointState,
+    CheckpointWireIncompatible,
+    SweepCursor,
+    WIRE_VERSION,
+    state_from_doc,
+    state_to_doc,
+)
+from hashcat_a5_table_generator_tpu.runtime.engine import (
+    Engine,
+    serve_socket,
+)
+from hashcat_a5_table_generator_tpu.runtime.fleet import (
+    FleetError,
+    FleetRouter,
+    spawn_engines,
+)
+from hashcat_a5_table_generator_tpu.runtime.fuse import affinity_token
+from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep, SweepConfig
+from tests.test_superstep import LEET, WORDS, oracle_lines
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SPEC = AttackSpec(mode="default", algo="md5")
+
+#: Long enough that pause/migrate/crash land mid-sweep at 64 lanes ×
+#: superstep=1 (the churn ops are gated on the job's FIRST forwarded
+#: hit, which arrives within the first supersteps), short enough for
+#: the tier-1 budget.
+BIG_WORDS = WORDS * 12
+
+
+def cfg(**kw):
+    return SweepConfig(lanes=64, num_blocks=16, superstep=1, **kw)
+
+
+def planted_digests(words, picks, decoys=20):
+    oracle = oracle_lines(SPEC, LEET, words)
+    planted = sorted({oracle[i] for i in picks})
+    digs = [hashlib.md5(c).digest() for c in planted]
+    digs += [hashlib.md5(b"decoy%d" % i).digest() for i in range(decoys)]
+    return digs
+
+
+def job_doc(jid, words, digests):
+    return {
+        "op": "submit", "id": jid,
+        "words": [w.decode() for w in words],
+        "table_map": {"a": ["4", "@"], "o": ["0"], "s": ["$", "5"],
+                      "e": ["3"]},
+        "digest_list": [d.hex() for d in digests],
+        "config": {"lanes": 64, "blocks": 16, "superstep": 1},
+    }
+
+
+def event_hits(events):
+    return [
+        (e["word_index"], int(e["rank"]), e["plain_hex"], e["digest"])
+        for e in events if e.get("event") == "hit"
+    ]
+
+
+def solo_hits(words, digests):
+    res = Sweep(SPEC, LEET, words, digests, config=cfg()).run_crack()
+    return res, [
+        (h.word_index, h.variant_rank, h.candidate.hex(), h.digest_hex)
+        for h in res.hits
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint wire-version discipline
+# ---------------------------------------------------------------------------
+
+
+class TestWireVersion:
+    def _state(self):
+        return CheckpointState(
+            fingerprint="fp", cursor=SweepCursor(3, 10**25),
+            n_emitted=7, n_hits=1, hits=[(2, 10**24)], wall_s=0.5,
+        )
+
+    def test_doc_carries_wire_version_and_round_trips(self):
+        doc = json.loads(json.dumps(state_to_doc(self._state())))
+        assert doc["wire_version"] == WIRE_VERSION
+        assert state_from_doc(doc) == self._state()
+
+    def test_missing_wire_version_accepted_as_major_1(self):
+        # Pre-bump documents (older builds, old on-disk checkpoints)
+        # carry no field; the wire format has not changed since.
+        doc = state_to_doc(self._state())
+        del doc["wire_version"]
+        assert state_from_doc(doc) == self._state()
+
+    def test_unknown_major_rejected_typed(self):
+        doc = state_to_doc(self._state())
+        doc["wire_version"] = "2.0"
+        with pytest.raises(CheckpointWireIncompatible) as exc:
+            state_from_doc(doc)
+        assert "major 2" in str(exc.value)
+
+    def test_minor_drift_accepted(self):
+        doc = state_to_doc(self._state())
+        doc["wire_version"] = "1.9"
+        assert state_from_doc(doc) == self._state()
+
+    def test_garbage_version_rejected_typed(self):
+        doc = state_to_doc(self._state())
+        doc["wire_version"] = "latest"
+        with pytest.raises(CheckpointWireIncompatible):
+            state_from_doc(doc)
+
+
+# ---------------------------------------------------------------------------
+# Affinity tokens: engine-side and router-side must agree
+# ---------------------------------------------------------------------------
+
+
+class TestAffinityToken:
+    def test_router_doc_token_matches_engine_token(self):
+        c = cfg()
+        router = FleetRouter(poll_s=0, defaults=c)
+        doc = {"algo": "md5", "mode": "default",
+               "config": {"lanes": 64, "blocks": 16, "superstep": 1}}
+        assert router._doc_token(doc) == affinity_token(SPEC, c)
+        router.close(shutdown_engines=False)
+
+    def test_token_distinguishes_static_config(self):
+        c = cfg()
+        base = affinity_token(SPEC, c)
+        assert affinity_token(
+            AttackSpec(mode="reverse", algo="md5"), c
+        ) != base
+        assert affinity_token(SPEC, cfg(pair=0)) != base
+        from dataclasses import replace
+
+        assert affinity_token(SPEC, replace(c, lanes=128)) != base
+
+
+# ---------------------------------------------------------------------------
+# Telemetry engine identity (satellite 3)
+# ---------------------------------------------------------------------------
+
+
+class TestEngineLabel:
+    def test_snapshot_and_prometheus_carry_engine_label(self):
+        telemetry.set_engine_id("e1@host")
+        try:
+            telemetry.counter("fleettest.label").add(3)
+            snap = telemetry.snapshot()
+            assert snap["fleettest.label"]["engine"] == "e1@host"
+            text = telemetry.to_prometheus(
+                {"fleettest.label": snap["fleettest.label"]}
+            )
+            assert 'a5gen_fleettest_label{engine="e1@host"} ' in text
+        finally:
+            telemetry.set_engine_id(None)
+        # Unlabeled again once cleared.
+        assert "engine" not in telemetry.snapshot()["fleettest.label"]
+
+    def test_merge_sums_counters_and_keeps_per_engine_gauges(self):
+        a = {
+            "jobs": {"type": "counter", "value": 2, "engine": "e1"},
+            "fill": {"type": "gauge", "value": 0.25, "agg": "last",
+                     "engine": "e1"},
+        }
+        b = {
+            "jobs": {"type": "counter", "value": 3, "engine": "e2"},
+            "fill": {"type": "gauge", "value": 0.75, "agg": "last",
+                     "engine": "e2"},
+        }
+        m = telemetry.merge([a, b])
+        # Counters sum fleet-wide; the per-member label no longer
+        # describes the summed value.
+        assert m["jobs"]["value"] == 5
+        assert "engine" not in m["jobs"]
+        # Conflicting-engine gauges keep per-engine series instead of
+        # silently last-one-wins.
+        assert "fill" not in m
+        assert m['fill{engine="e1"}']["value"] == 0.25
+        assert m['fill{engine="e2"}']["value"] == 0.75
+        text = telemetry.to_prometheus(m)
+        assert 'a5gen_fill{engine="e1"} 0.25' in text
+        assert 'a5gen_fill{engine="e2"} 0.75' in text
+
+    def test_merge_same_engine_gauges_still_aggregate(self):
+        a = {"g": {"type": "gauge", "value": 1, "agg": "max",
+                   "engine": "e1"}}
+        b = {"g": {"type": "gauge", "value": 4, "agg": "max",
+                   "engine": "e1"}}
+        m = telemetry.merge([a, b])
+        assert m["g"]["value"] == 4 and m["g"]["engine"] == "e1"
+
+
+# ---------------------------------------------------------------------------
+# Engine stats placement signals (satellite 4)
+# ---------------------------------------------------------------------------
+
+
+class TestStatsPlacementSignals:
+    def test_resident_groups_and_counts_surface(self):
+        digs = planted_digests(WORDS, (0,))
+        eng = Engine(cfg(), auto=False)
+        eng.submit(SPEC, LEET, WORDS, digs)
+        eng.submit(SPEC, LEET, WORDS, digs)
+        eng._admit()
+        stats = eng.stats()
+        assert stats["jobs_runnable"] == stats["jobs_active"] == 2
+        assert stats["jobs_staged"] == 0
+        (token,) = stats["resident_groups"]
+        assert token == affinity_token(SPEC, cfg())
+        eng.run_until_idle()
+        stats = eng.stats()
+        assert stats["jobs_runnable"] == 0
+        assert stats["resident_groups"] == []
+        assert "packed_fill" in stats
+
+
+# ---------------------------------------------------------------------------
+# Placement policy (router-level, stub links)
+# ---------------------------------------------------------------------------
+
+
+def _stub_link(engine_id, index, resident=(), load=0):
+    return types.SimpleNamespace(
+        engine_id=engine_id, index=index, alive=True, draining=False,
+        scrape={"resident_groups": list(resident),
+                "jobs_runnable": load},
+        routed=set(), misses=0,
+    )
+
+
+class TestPlacement:
+    def test_affinity_prefers_resident_token(self):
+        router = FleetRouter(poll_s=0)
+        busy = _stub_link("busy", 0, resident=("tok",), load=9)
+        idle = _stub_link("idle", 1, load=0)
+        router._links = [busy, idle]
+        # Matching token beats the load tie-break...
+        assert router._pick("tok") is busy
+        # ...and a non-matching job goes to the least-loaded engine.
+        assert router._pick("other") is idle
+
+    def test_round_robin_alternates(self):
+        router = FleetRouter(place="round-robin", poll_s=0)
+        a, b = _stub_link("a", 0), _stub_link("b", 1)
+        router._links = [a, b]
+        picks = {router._pick("tok").engine_id for _ in range(2)}
+        assert picks == {"a", "b"}
+
+    def test_draining_and_dead_excluded(self):
+        router = FleetRouter(poll_s=0)
+        a, b = _stub_link("a", 0, resident=("tok",)), _stub_link("b", 1)
+        a.draining = True
+        router._links = [a, b]
+        assert router._pick("tok") is b
+        b.alive = False
+        with pytest.raises(FleetError):
+            router._pick("tok")
+
+    def test_submit_with_no_engines_fails_loudly(self):
+        router = FleetRouter(poll_s=0)
+        with pytest.raises(FleetError):
+            router.submit(job_doc("j", WORDS, planted_digests(WORDS,
+                                                              (0,))))
+        router.close(shutdown_engines=False)
+
+
+# ---------------------------------------------------------------------------
+# In-process fleet: routing, churn, crash-replay, watchdog
+# ---------------------------------------------------------------------------
+
+
+def _start_engine(path):
+    eng = Engine(cfg())
+    ready = threading.Event()
+    threading.Thread(
+        target=serve_socket, args=(eng, path),
+        kwargs={"ready": ready.set}, daemon=True,
+    ).start()
+    assert ready.wait(30)
+    return eng
+
+
+class _Collector:
+    """Per-job event sink with a first-hit gate (deterministic
+    mid-sweep churn triggers)."""
+
+    def __init__(self):
+        self.events = []
+        self.first_hit = threading.Event()
+
+    def __call__(self, ev):
+        self.events.append(ev)
+        if ev.get("event") == "hit":
+            self.first_hit.set()
+
+
+@pytest.fixture()
+def fleet2(tmp_path):
+    engines = []
+    paths = []
+    for name in ("a", "b"):
+        p = str(tmp_path / f"{name}.sock")
+        engines.append(_start_engine(p))
+        paths.append(p)
+    router = FleetRouter(poll_s=0.5, defaults=cfg())
+    links = [router.attach(p, f"eng{i}") for i, p in enumerate(paths)]
+    try:
+        yield router, links, engines
+    finally:
+        router.close(shutdown_engines=False)
+        for eng in engines:
+            eng.close(cancel=True)
+
+
+class TestFleetInProcess:
+    def test_churn_mix_byte_parity(self, fleet2):
+        """The §25 fast-tier contract: 2 engines × 4 churning tenants
+        (plain / pause→resume / migrate / cancel) through the router —
+        every surviving job's hit stream byte-identical to solo
+        ``run_crack``."""
+        router, _links, _engines = fleet2
+        d_plain = planted_digests(WORDS, (0, -1))
+        d_pr = planted_digests(BIG_WORDS, (0, 5, -1), decoys=21)
+        d_mig = planted_digests(BIG_WORDS, (1, 6, -1), decoys=22)
+        d_can = planted_digests(BIG_WORDS, (2, -1), decoys=23)
+        cols = {j: _Collector() for j in ("plain", "pr", "mig", "can")}
+
+        router.submit(job_doc("plain", WORDS, d_plain),
+                      emit=cols["plain"])
+        router.submit(job_doc("pr", BIG_WORDS, d_pr), emit=cols["pr"])
+        router.submit(job_doc("mig", BIG_WORDS, d_mig),
+                      emit=cols["mig"])
+        router.submit(job_doc("can", BIG_WORDS, d_can),
+                      emit=cols["can"])
+
+        # Churn: pause 'pr' once it has streamed a hit, migrate 'mig'
+        # to the other engine mid-sweep, cancel 'can'.
+        assert cols["pr"].first_hit.wait(60)
+        try:
+            router.pause("pr")
+        except FleetError:
+            pass  # raced completion under host load
+        assert router.wait("pr", timeout=60)
+        assert cols["mig"].first_hit.wait(60)
+        src = router.job("mig").link
+        try:
+            dst = next(
+                l.engine_id for l in router.engines() if l is not src
+            )
+            router.migrate("mig", dst)
+        except FleetError:
+            pass  # raced completion under host load
+        try:
+            router.cancel("can")
+            cancelled = True
+        except FleetError:
+            cancelled = False  # raced completion under host load
+        # Resume the paused job (placement may move it — the
+        # checkpoint is the contract either way).
+        pr = router.job("pr")
+        if pr.state == "paused":
+            assert pr.checkpoint is not None
+            router.resume("pr")
+
+        for jid in ("plain", "pr", "mig"):
+            assert router.wait(jid, timeout=300), jid
+            assert router.job(jid).state == "done", (
+                jid, router.job(jid).state, cols[jid].events[-2:]
+            )
+        assert router.wait("can", timeout=60)
+        if cancelled:
+            assert router.job("can").state == "cancelled"
+            assert any(e.get("event") == "cancelled"
+                       for e in cols["can"].events)
+        else:
+            assert router.job("can").state == "done"
+
+        for jid, words, digs in (("plain", WORDS, d_plain),
+                                 ("pr", BIG_WORDS, d_pr),
+                                 ("mig", BIG_WORDS, d_mig)):
+            res, want = solo_hits(words, digs)
+            assert event_hits(cols[jid].events) == want, jid
+            (done,) = [e for e in cols[jid].events
+                       if e.get("event") == "done"]
+            assert done["n_hits"] == res.n_hits
+
+    def test_crash_replay_torn_socket_byte_parity(self, fleet2):
+        """Engine death by torn socket: the router requeues the routed
+        job onto the survivor from its last router-held checkpoint,
+        with already-forwarded hits muted — the client stream stays
+        exactly-once and byte-identical."""
+        router, _links, _engines = fleet2
+        digs = planted_digests(BIG_WORDS, (0, 3, -1))
+        col = _Collector()
+        router.submit(job_doc("c1", BIG_WORDS, digs), emit=col)
+        assert col.first_hit.wait(60)
+        router.job("c1").link.kill_socket()
+        assert router.wait("c1", timeout=300)
+        job = router.job("c1")
+        assert job.state == "done", (job.state, col.events[-2:])
+        _res, want = solo_hits(BIG_WORDS, digs)
+        assert event_hits(col.events) == want
+        fleet = router.stats()["fleet"]
+        assert fleet["engines_alive"] == 1
+        assert fleet["jobs_replayed"] >= 1
+
+    @pytest.mark.slow
+    def test_drain_empties_engine_and_jobs_finish(self, fleet2):
+        """Slow-marked for the tier-1 budget (a drain re-sweeps the
+        migrated job from its checkpoint); CI runs it in the fleet
+        soak step."""
+        router, _links, _engines = fleet2
+        digs = planted_digests(BIG_WORDS, (0, -1), decoys=24)
+        col = _Collector()
+        router.submit(job_doc("dr", BIG_WORDS, digs), emit=col)
+        assert col.first_hit.wait(60)
+        src = router.job("dr").link
+        ack = router.drain(src.engine_id)
+        assert ack["jobs"] == 1 and src.draining
+        assert router.wait("dr", timeout=300)
+        assert router.job("dr").state == "done"
+        _res, want = solo_hits(BIG_WORDS, digs)
+        assert event_hits(col.events) == want
+        # The drained engine took no new placements.
+        col2 = _Collector()
+        router.submit(job_doc("after", WORDS,
+                              planted_digests(WORDS, (0,))), emit=col2)
+        assert router.job("after").link is not src
+        assert router.wait("after", timeout=120)
+
+    def test_unknown_op_passthrough_and_errors(self, fleet2):
+        router, _links, _engines = fleet2
+        with pytest.raises(FleetError):
+            router.pause("nope")
+        with pytest.raises(FleetError):
+            router.migrate("nope")
+
+    def test_socket_front_end_serves_protocol(self, fleet2, tmp_path):
+        """A serve client pointed at the ROUTER's socket works
+        unmodified: submit → accepted / hit / done, stats answers with
+        the fleet section, shutdown gets its bye (the session's
+        outbound writer flushes before closing)."""
+        from hashcat_a5_table_generator_tpu.runtime.fleet import (
+            serve_fleet_socket,
+        )
+
+        router, _links, _engines = fleet2
+        path = str(tmp_path / "router.sock")
+        ready = threading.Event()
+        threading.Thread(
+            target=serve_fleet_socket, args=(router, path),
+            kwargs={"ready": ready.set}, daemon=True,
+        ).start()
+        assert ready.wait(10)
+        digs = planted_digests(WORDS, (0,))
+        _res, want = solo_hits(WORDS, digs)
+        with socket.socket(socket.AF_UNIX) as s:
+            s.connect(path)
+            f = s.makefile("rw", encoding="utf-8")
+            f.write(json.dumps(job_doc("sf1", WORDS, digs)) + "\n")
+            f.write(json.dumps({"op": "stats"}) + "\n")
+            f.flush()
+            events = []
+            while not any(e.get("event") == "done" for e in events):
+                events.append(json.loads(f.readline()))
+            by = {}
+            for e in events:
+                by.setdefault(e["event"], []).append(e)
+            assert by["accepted"][0]["engine"] in ("eng0", "eng1")
+            assert event_hits(by.get("hit", ())) == want
+            (st,) = by["stats"]
+            assert st["fleet"]["engines_alive"] == 2
+            f.write('{"op":"shutdown"}\n')
+            f.flush()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                ev = json.loads(f.readline() or "{}")
+                if ev.get("event") == "bye":
+                    break
+            else:
+                pytest.fail("no bye before deadline")
+
+
+@pytest.mark.slow
+class TestWatchdog:
+    """Slow-marked for the tier-1 budget (the watchdog must actually
+    sit through poll_misses scrape timeouts); CI runs it in the fleet
+    soak step."""
+
+    def test_wedged_engine_declared_dead_and_job_replayed(self,
+                                                          tmp_path):
+        """Liveness is the stats op: a fake engine that accepts a job
+        then stops answering scrapes is watchdog-killed, and its job
+        crash-replays onto a real engine."""
+        fake_path = str(tmp_path / "fake.sock")
+        stop = threading.Event()
+        #: stats served across ALL sessions — health scrapes reconnect
+        #: after each failure, so a per-session count would hand every
+        #: fresh connection one answer and the wedge would never show.
+        served_stats = [0]
+
+        def fake_engine():
+            srv = socket.socket(socket.AF_UNIX)
+            srv.bind(fake_path)
+            srv.listen()
+            srv.settimeout(0.2)
+            conns = []
+            while not stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except socket.timeout:
+                    continue
+                conns.append(conn)
+                f = conn.makefile("rw", encoding="utf-8")
+
+                def session(f=f):
+                    for line in f:
+                        doc = json.loads(line)
+                        if doc.get("op") == "submit":
+                            f.write(json.dumps({
+                                "id": doc["id"], "event": "accepted",
+                                "kind": "crack",
+                            }) + "\n")
+                            f.flush()
+                        elif doc.get("op") == "stats":
+                            served_stats[0] += 1
+                            if served_stats[0] <= 1:
+                                f.write('{"event":"stats"}\n')
+                                f.flush()
+                            # then: silence — the wedge
+
+                threading.Thread(target=session, daemon=True).start()
+            for c in conns:
+                c.close()
+            srv.close()
+
+        threading.Thread(target=fake_engine, daemon=True).start()
+        deadline = time.monotonic() + 10
+        while not os.path.exists(fake_path):
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+
+        real_path = str(tmp_path / "real.sock")
+        eng = _start_engine(real_path)
+        router = FleetRouter(poll_s=0.2, poll_misses=2,
+                             defaults=cfg())
+        try:
+            fake = router.attach(fake_path, "fake")
+            fake.scrape = {"resident_groups": [], "jobs_runnable": 0}
+            real = router.attach(real_path, "real")
+            # Pin the job onto the wedged engine.
+            real.draining = True
+            digs = planted_digests(WORDS, (0, -1))
+            col = _Collector()
+            router.submit(job_doc("w1", WORDS, digs), emit=col)
+            assert router.job("w1").link is fake
+            real.draining = False
+            assert router.wait("w1", timeout=120)
+            assert router.job("w1").state == "done"
+            assert not fake.alive
+            _res, want = solo_hits(WORDS, digs)
+            assert event_hits(col.events) == want
+        finally:
+            stop.set()
+            router.close(shutdown_engines=False)
+            eng.close(cancel=True)
+
+
+# ---------------------------------------------------------------------------
+# Spawned multi-process fleet (slow tier): SIGKILL soak + affinity
+# ---------------------------------------------------------------------------
+
+
+def _spawned_fleet(tmp_path, n=2, place="affinity"):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("A5GEN_FAULTS", None)
+    specs = spawn_engines(
+        n, str(tmp_path / "engines"),
+        engine_args=["--lanes", "64", "--blocks", "16",
+                     "--superstep", "1",
+                     "--schema-cache", str(tmp_path / "cache")],
+        env=env,
+    )
+    router = FleetRouter(place=place, poll_s=0.5, defaults=cfg())
+    for sock_path, eid, proc in specs:
+        router.attach(sock_path, eid, proc=proc, timeout=300)
+    return router, specs
+
+
+@pytest.mark.slow
+class TestSpawnedFleet:
+    def test_kill_one_engine_soak_byte_parity(self, tmp_path):
+        """The §25 top-tier contract, full strength: 2 engine
+        PROCESSES × 4 churning tenants through the router; one engine
+        is SIGKILLed mid-sweep and every routed job crash-replays onto
+        the survivor — per-job hit streams byte-identical to solo
+        ``run_crack``, exactly-once."""
+        soak_words = WORDS * 40  # slow tier: generous churn windows
+        router, specs = _spawned_fleet(tmp_path)
+        try:
+            jobs = {}
+            for i in range(4):
+                digs = planted_digests(soak_words, (i, 5 + i, -1),
+                                       decoys=20 + i)
+                col = _Collector()
+                jobs[f"j{i}"] = (digs, col)
+                router.submit(job_doc(f"j{i}", soak_words, digs),
+                              emit=col)
+            # Light churn on the side: pause+resume one tenant.
+            assert jobs["j0"][1].first_hit.wait(120)
+            try:
+                router.pause("j0")
+            except FleetError:
+                pass  # raced completion under host load
+            assert router.wait("j0", timeout=120)
+            if router.job("j0").state == "paused":
+                router.resume("j0")
+            # SIGKILL the engine carrying j1 once it is mid-sweep.
+            assert jobs["j1"][1].first_hit.wait(120)
+            victim = router.job("j1").link
+            os.kill(victim.proc.pid, signal.SIGKILL)
+            for jid, (digs, col) in jobs.items():
+                assert router.wait(jid, timeout=600), jid
+                assert router.job(jid).state == "done", (
+                    jid, router.job(jid).state, col.events[-2:]
+                )
+                res, want = solo_hits(soak_words, digs)
+                assert event_hits(col.events) == want, jid
+                (done,) = [e for e in col.events
+                           if e.get("event") == "done"]
+                assert done["n_hits"] == res.n_hits
+            fleet = router.stats()["fleet"]
+            assert fleet["engine_deaths"] == 1
+            assert fleet["jobs_replayed"] >= 1
+            assert victim.proc.poll() == -signal.SIGKILL
+        finally:
+            router.close(shutdown_engines=True)
+
+    def test_affinity_compile_reuse_vs_round_robin(self, tmp_path):
+        """The §25 affinity instrument: two compatible jobs through a
+        2-engine fleet land on ONE engine under affinity placement —
+        one shared program build serves both (step-cache counter, plus
+        the engine's one trivial accumulator jit) — while the
+        round-robin control arm splits them and every engine pays its
+        own builds: fleet-total compiles exactly double.  Per-process
+        step caches (spawned engines) are what make the counter
+        honest."""
+
+        def run_arm(place, subdir):
+            router, _specs = _spawned_fleet(tmp_path / subdir,
+                                            place=place)
+            try:
+                digs = planted_digests(WORDS, (0, -1))
+                cols = [_Collector(), _Collector()]
+                placed = []
+                for i, col in enumerate(cols):
+                    ack = router.submit(job_doc(f"a{i}", WORDS, digs),
+                                        emit=col)
+                    placed.append(ack["engine"])
+                for i in range(2):
+                    assert router.wait(f"a{i}", timeout=600)
+                    assert router.job(f"a{i}").state == "done"
+                stats = router.stats()
+                _res, want = solo_hits(WORDS, digs)
+                for col in cols:
+                    assert event_hits(col.events) == want
+                return placed, stats
+            finally:
+                router.close(shutdown_engines=True)
+
+        placed_aff, stats_aff = run_arm("affinity", "aff")
+        placed_rr, stats_rr = run_arm("round-robin", "rr")
+        # Affinity co-locates the compatible pair; the control splits.
+        assert len(set(placed_aff)) == 1
+        assert len(set(placed_rr)) == 2
+        # One engine's builds serve both jobs under affinity (the
+        # second job rides the step cache); round-robin compiles the
+        # identical set on BOTH engines — exactly double fleet-wide.
+        assert stats_rr["programs_compiled"] == \
+            2 * stats_aff["programs_compiled"]
+        assert stats_aff["program_cache_hits"] >= 1
+        assert stats_rr["program_cache_hits"] == 0
+
+
+@pytest.mark.slow
+def test_bench_fleet_ab_record_shape():
+    """The §25 passthrough instrument end-to-end: both arms run, the
+    parity gate holds inside the bench, and the JSON record carries
+    the wall ratio the acceptance criterion reads."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--fleet-ab",
+         "--platform", "cpu", "--lanes", "2048", "--blocks", "32",
+         "--words", "600", "--serve-jobs", "3"],
+        capture_output=True, timeout=540, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "fleet_ab"
+    assert rec["jobs"] == 3
+    assert len(rec["direct"]["jobs"]) == 3
+    assert len(rec["routed"]["jobs"]) == 3
+    emitted = {j["n_emitted"] for j in rec["direct"]["jobs"]}
+    emitted |= {j["n_emitted"] for j in rec["routed"]["jobs"]}
+    assert len(emitted) == 1 and emitted.pop() > 0
+    assert rec["wall_ratio"] > 0
+    assert "overhead_pct" in rec
