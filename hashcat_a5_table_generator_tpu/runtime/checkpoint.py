@@ -24,7 +24,7 @@ import hashlib
 import json
 import os
 from dataclasses import asdict, dataclass, field
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -151,7 +151,7 @@ def sweep_fingerprint(
     words: Sequence[bytes],
     digests: Sequence[bytes] = (),
     *,
-    digest_lookup=None,
+    digest_lookup: Optional[Any] = None,
 ) -> str:
     """SHA-256 over a canonical serialization of the sweep's semantic inputs.
 

@@ -755,6 +755,10 @@ class Engine:
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._admit_ex.shutdown(wait=False)
+                # graftrace: owner=collector -- exactly one thread
+                # collects builds (the serve thread in auto mode, the
+                # embedder in manual mode), so the executor restart is
+                # single-writer by construction (PERF.md S23/S26).
                 self._admit_ex = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="a5-engine-admit"
                 )
@@ -802,6 +806,10 @@ class Engine:
                 from concurrent.futures import ThreadPoolExecutor
 
                 self._admit_ex.shutdown(wait=False)
+                # graftrace: owner=collector -- exactly one thread
+                # collects builds (the serve thread in auto mode, the
+                # embedder in manual mode), so the executor restart is
+                # single-writer by construction (PERF.md S23/S26).
                 self._admit_ex = ThreadPoolExecutor(
                     max_workers=1, thread_name_prefix="a5-engine-admit"
                 )

@@ -162,7 +162,7 @@ def supervise_retry(exc: BaseException, attempts: int, *,
     time.sleep(delay)
 
 
-def await_ready(value, timeout_s: "Optional[float]") -> None:
+def await_ready(value: object, timeout_s: "Optional[float]") -> None:
     """The fetch watchdog (PERF.md §23), shared by the solo drive and
     the packed pump: when ``timeout_s`` is set, poll the device
     result's readiness (``jax.Array.is_ready``) and raise a typed
@@ -389,6 +389,6 @@ class armed:
         self.plan = install(self._spec)
         return self.plan
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, *exc: object) -> None:
         global ACTIVE, _ENV_SPEC
         ACTIVE, _ENV_SPEC = self._prev, self._prev_env

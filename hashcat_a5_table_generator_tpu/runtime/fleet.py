@@ -1100,6 +1100,16 @@ def spawn_engines(n: int, directory: str, *,
 # ---------------------------------------------------------------------------
 
 
+#: Serve ops deliberately left to ``_RouterSession._handle``'s
+#: unknown-op fallback (forwarded verbatim to the job's engine):
+#: id-carrying and router-state-free by construction.  graftrace GT004
+#: diffs the engine session's op table against the router's handled
+#: set ∪ this declaration — a new serve op with NEITHER is a lint
+#: failure (CONTRIBUTING: router-passthrough-safe), so the decision is
+#: a diff, not a review catch.
+ROUTER_PASSTHROUGH_OPS: frozenset = frozenset()
+
+
 class _RouterSession:
     """One upstream JSONL command stream against a shared
     :class:`FleetRouter` — the same protocol ``_JsonlSession`` speaks

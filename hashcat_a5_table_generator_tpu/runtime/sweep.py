@@ -2239,9 +2239,13 @@ class Sweep:
             "ring": self._stream["prefetch"] + 2,
             "resumed_chunk": start_ci,
         }
-        self._stream_resident = 0
-        self._stream_peak = 0
-        self._stream_chunk_max = 0
+        # Under the lock even though no ring worker exists yet: a
+        # bare reset here would race a straggling release if runs ever
+        # overlap, and the guard is what graftrace pins (PERF.md §26).
+        with self._stream_lock:
+            self._stream_resident = 0
+            self._stream_peak = 0
+            self._stream_chunk_max = 0
         if start_ci >= len(bounds):
             return superstep_stats, stream
         compiler = ChunkCompiler(

@@ -47,7 +47,8 @@ import threading
 import time
 from bisect import bisect_left
 from collections import deque
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import (Any, Callable, ContextManager, Dict, Iterable, List,
+                    Optional, Sequence, Tuple, Type, TypeVar)
 
 
 def enabled() -> bool:
@@ -82,15 +83,15 @@ class Counter:
 
     def __init__(self, name: str) -> None:
         self.name = name
-        self._value = 0
+        self._value: float = 0
         self._lock = threading.Lock()
 
-    def add(self, n=1) -> None:
+    def add(self, n: float = 1) -> None:
         with self._lock:
             self._value += n
 
     @property
-    def value(self):
+    def value(self) -> float:
         with self._lock:
             return self._value
 
@@ -113,12 +114,12 @@ class Gauge:
         self._value = 0.0
         self._lock = threading.Lock()
 
-    def set(self, v) -> None:
+    def set(self, v: float) -> None:
         with self._lock:
             self._value = v
 
     @property
-    def value(self):
+    def value(self) -> float:
         with self._lock:
             return self._value
 
@@ -148,7 +149,7 @@ class Histogram:
         self._count = 0
         self._lock = threading.Lock()
 
-    def observe(self, v) -> None:
+    def observe(self, v: float) -> None:
         v = float(v)
         # bisect_left on the upper bounds: the first edge >= v is v's
         # ``le`` bucket; past the last edge lands in the overflow slot.
@@ -169,6 +170,9 @@ class Histogram:
             }
 
 
+_M = TypeVar("_M")
+
+
 class MetricsRegistry:
     """Name → metric, with get-or-create accessors (call sites never
     coordinate creation) and a plain-dict snapshot."""
@@ -177,7 +181,7 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._metrics: Dict[str, object] = {}
 
-    def _get(self, name: str, cls, *args, **kw):
+    def _get(self, name: str, cls: Type[_M], *args: Any, **kw: Any) -> _M:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
@@ -314,7 +318,7 @@ def delta(before: Dict[str, dict], after: Dict[str, dict]
     return out
 
 
-def _series_key(name: str, engine_id) -> str:
+def _series_key(name: str, engine_id: Optional[str]) -> str:
     """Merged-output key of a per-engine-kept series — the Prometheus
     label spelling, so the merged dict reads like the exposition."""
     return f'{name}{{engine="{engine_id or ""}"}}'
@@ -547,7 +551,8 @@ class SpanTimeline:
     owns timing (GL013): drive loops call :meth:`record_fetch` and
     never accumulate ``time.monotonic()`` themselves."""
 
-    def __init__(self, capacity: int = 512, clock=time.monotonic) -> None:
+    def __init__(self, capacity: int = 512,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         self._ring: deque = deque(maxlen=max(1, int(capacity)))
         self._clock = clock
         self._lock = threading.Lock()
@@ -669,7 +674,7 @@ def progress_fields() -> dict:
     return out
 
 
-def profiler_span(name: str):
+def profiler_span(name: str) -> ContextManager[Any]:
     """A ``jax.profiler.TraceAnnotation`` span, or a null context when
     the profiler (or that API) is unavailable on this jax version — the
     drive loops annotate phases unconditionally and the guard keeps
@@ -687,7 +692,7 @@ def profiler_span(name: str):
     return contextlib.nullcontext()
 
 
-def profiler_trace(path: Optional[str]):
+def profiler_trace(path: Optional[str]) -> ContextManager[Any]:
     """``jax.profiler.trace(path)`` behind the same guard; a null
     context when ``path`` is falsy or the profiler is unavailable
     (``--profile-dir`` must degrade to a no-op, not a crash)."""

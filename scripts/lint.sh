@@ -4,7 +4,7 @@
 #   scripts/lint.sh            # run everything available
 #   scripts/lint.sh --require-all   # fail if ruff/mypy are missing (CI)
 #
-# Four layers, any failure fails the script:
+# Five layers, any failure fails the script:
 #   1. ruff      — pyflakes + pycodestyle errors ([tool.ruff] in pyproject)
 #   2. mypy      — typed public API, strict on leaf modules ([tool.mypy])
 #   3. graftlint — repo-specific JAX/Pallas AST rules (tools/graftlint),
@@ -16,11 +16,16 @@
 #                  (DCE) detection, float/transfer purity, Pallas bounds,
 #                  and audit_telemetry (registry/timeline calls off the
 #                  hot path). Trace/lower only, CPU backend — PERF.md §16.
+#   5. graftrace — thread-topology & lock-discipline analysis over the
+#                  threaded runtime, the chunk ring, and tools/ itself
+#                  (tools/graftrace): unguarded shared writes,
+#                  lock-order cycles, queue wait-for cycles, router
+#                  passthrough — PERF.md §26.
 #
 # ruff and mypy are OPTIONAL locally (the TPU dev containers bake only the
 # jax toolchain; nothing may be pip-installed there) and mandatory in CI
-# via --require-all. graftlint is stdlib-only and always runs; graftaudit
-# needs jax (always present — it is the package's core dependency).
+# via --require-all. graftlint and graftrace are stdlib-only and always
+# run; graftaudit needs jax (always present — the core dependency).
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -62,6 +67,12 @@ fi
 echo "== graftaudit =="
 if ! env JAX_PLATFORMS=cpu python -m tools.graftaudit; then
     echo "lint.sh: graftaudit FAILED" >&2
+    fail=1
+fi
+
+echo "== graftrace =="
+if ! python -m tools.graftrace; then
+    echo "lint.sh: graftrace FAILED" >&2
     fail=1
 fi
 

@@ -1,0 +1,21 @@
+# Annotation-guarded clean twin: the guard grammar silences writes a
+# lexical scan cannot prove — a per-line `guard=` claim (the lock is
+# held by protocol) and an attribute-level `owner=` claim on the
+# __init__ declaration (single-writer by construction).
+import threading
+
+
+class Annotated:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self.mode = "idle"  # graftrace: owner=serve
+        threading.Thread(target=self._worker, daemon=True).start()
+
+    def _worker(self):
+        self.total += 1  # graftrace: guard=_lock
+        self.mode = "busy"
+
+    def bump(self, n):
+        self.total += n  # graftrace: guard=_lock
+        self.mode = "drain"

@@ -1,0 +1,19 @@
+# Broken twin: `total` is written from both the worker thread entry
+# and the caller with no guard — the shape GT001 exists to catch.
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self):
+        self.total += 1  # unguarded shared write
+
+    def bump(self, n):
+        self.total += n  # unguarded shared write (caller side)

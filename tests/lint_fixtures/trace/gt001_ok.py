@@ -1,0 +1,25 @@
+# Clean twin of gt001_flag: every write to the shared counter holds
+# the declared lock, and the handoff list is a queue (a thread-safe
+# channel — calling into it is never a shared write).
+import queue
+import threading
+
+
+class Collector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0
+        self._inbox = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._worker, daemon=True
+        )
+        self._thread.start()
+
+    def _worker(self):
+        with self._lock:
+            self.total += 1
+        self._inbox.put("tick")
+
+    def bump(self, n):
+        with self._lock:
+            self.total += n

@@ -1,0 +1,25 @@
+# Broken twin: an ABBA lock-order cycle, half of it hidden behind a
+# call edge — `transfer` holds _a and calls _credit (which takes _b),
+# while the audit thread takes _b then _a lexically.
+import threading
+
+
+class Teller:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.balance = 0
+        threading.Thread(target=self._audit, daemon=True).start()
+
+    def transfer(self, n):
+        with self._a:
+            self._credit(n)  # acquire-while-holding: _a -> _b
+
+    def _credit(self, n):
+        with self._b:
+            self.balance += n
+
+    def _audit(self):
+        with self._b:
+            with self._a:  # lexical nesting: _b -> _a  (the cycle)
+                pass

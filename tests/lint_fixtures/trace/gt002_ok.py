@@ -1,0 +1,24 @@
+# Clean twin of gt002_flag: both paths take the locks in the same
+# order (_a before _b), so the acquisition graph is acyclic.
+import threading
+
+
+class Teller:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.balance = 0
+        threading.Thread(target=self._audit, daemon=True).start()
+
+    def transfer(self, n):
+        with self._a:
+            self._credit(n)  # _a -> _b, same as the audit thread
+
+    def _credit(self, n):
+        with self._b:
+            self.balance += n
+
+    def _audit(self):
+        with self._a:
+            with self._b:
+                pass

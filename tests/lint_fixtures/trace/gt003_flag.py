@@ -1,0 +1,37 @@
+# Broken twin: the fleet requeue-worker deadlock shape (PERF.md §25),
+# distilled.  The reader thread's death handler re-dispatches on the
+# reader itself; request() then blocks on the reply queue that only
+# the reader produces — a wait-for self-cycle, not a timing bug.
+import queue
+import threading
+
+
+class Link:
+    def __init__(self):
+        self._ctl_lock = threading.Lock()
+        self._reply = queue.Queue()
+        threading.Thread(target=self._reader, daemon=True).start()
+
+    def request(self, doc):
+        with self._ctl_lock:
+            self._send(doc)
+            return self._reply.get()  # blocks for the reply...
+
+    def _send(self, doc):
+        pass
+
+    def _reader(self):
+        for ev in self._events():
+            if ev == "reply":
+                # ...which only THIS thread ever delivers.
+                self._reply.put(ev)
+            else:
+                self._on_death()
+
+    def _events(self):
+        return []
+
+    def _on_death(self):
+        # BROKEN: re-dispatching on the reader thread blocks the very
+        # loop that must deliver the ack.
+        self.request({"op": "submit"})

@@ -1,0 +1,42 @@
+# Clean twin of gt003_flag: the queue-handoff discipline the real
+# FleetRouter ships — the reader never dispatches; death work rides a
+# bounded queue to a DEDICATED requeue worker, so the reader stays
+# free to deliver the ack the dispatch waits on.
+import queue
+import threading
+
+
+class Link:
+    def __init__(self):
+        self._ctl_lock = threading.Lock()
+        self._reply = queue.Queue()
+        self._requeue = queue.Queue()
+        threading.Thread(target=self._reader, daemon=True).start()
+        threading.Thread(
+            target=self._requeue_worker, daemon=True
+        ).start()
+
+    def request(self, doc):
+        with self._ctl_lock:
+            self._send(doc)
+            return self._reply.get()
+
+    def _send(self, doc):
+        pass
+
+    def _reader(self):
+        for ev in self._events():
+            if ev == "reply":
+                self._reply.put(ev)
+            else:
+                self._requeue.put(ev)  # hand off, never dispatch here
+
+    def _events(self):
+        return []
+
+    def _requeue_worker(self):
+        while True:
+            item = self._requeue.get()
+            if item is None:
+                return
+            self.request({"op": "submit"})

@@ -1,0 +1,32 @@
+# Broken twin: the engine session grows a 'rewind' op but the router
+# session neither handles it nor declares it passthrough-safe — the
+# CONTRIBUTING router-passthrough-safe rule, violated.
+
+
+class _JsonlSession:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "shutdown":
+            return False
+        if op == "submit":
+            return True
+        if op in ("pause", "cancel"):
+            return True
+        if op == "rewind":  # the new serve op
+            return True
+        raise ValueError(op)
+
+
+class _RouterSession:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "shutdown":
+            return False
+        if op == "submit":
+            return True
+        if op in ("pause", "cancel"):
+            return True
+        if doc.get("id") is not None:
+            self._router.passthrough(doc)
+            return True
+        raise ValueError(op)

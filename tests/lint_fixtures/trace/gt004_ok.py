@@ -1,0 +1,34 @@
+# Clean twin of gt004_flag: the new op carries an explicit router
+# decision — declared passthrough-safe (id-carrying, router-state-
+# free), so the unknown-op fallback forwards it by contract.
+
+ROUTER_PASSTHROUGH_OPS = frozenset({"rewind"})
+
+
+class _JsonlSession:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "shutdown":
+            return False
+        if op == "submit":
+            return True
+        if op in ("pause", "cancel"):
+            return True
+        if op == "rewind":
+            return True
+        raise ValueError(op)
+
+
+class _RouterSession:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "shutdown":
+            return False
+        if op == "submit":
+            return True
+        if op in ("pause", "cancel"):
+            return True
+        if doc.get("id") is not None:
+            self._router.passthrough(doc)
+            return True
+        raise ValueError(op)

@@ -1,0 +1,128 @@
+"""graftrace — thread-topology & lock-discipline static analysis.
+
+The concurrency tier of the repo's static stack (PERF.md §26):
+graftlint checks single-file AST hazards, graftaudit checks what XLA
+compiles, and graftrace checks what the THREADS do — entry points,
+shared attribute writes and their guards, lock-acquisition ordering,
+queue-handoff wait cycles, and the serve-protocol/router op diff.
+
+Checks:
+
+* **GT001** — unguarded write to a shared attribute (written from ≥ 2
+  thread entry points without a held lock, a thread-safe channel, or a
+  ``# graftrace: guard=<lock>|owner=<label>`` annotation)
+* **GT002** — cycle in the lock-acquisition graph (lexical nesting +
+  one-level call edges)
+* **GT003** — wait-for self-cycle: a thread entry blocking on an
+  unbounded ``queue.get()`` it is itself the only producer for (the
+  fleet requeue-worker deadlock shape)
+* **GT004** — serve op without a router decision
+  (CONTRIBUTING: router-passthrough-safe)
+
+Typed public API::
+
+    from tools.graftrace import analyze_paths, analyze_sources
+
+    findings, models = analyze_paths(["hashcat_a5_table_generator_tpu/runtime"])
+
+Run as ``python -m tools.graftrace`` (see ``scripts/lint.sh`` layer 5).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graftlint import iter_python_files
+
+from . import allowlist
+from .findings import Finding
+from .model import ClassModel, build_class_models, check_lock_cycles, \
+    check_queue_self_wait, check_shared_writes
+from .passthrough import check_passthrough
+
+__all__ = [
+    "ALL_CHECKS",
+    "Finding",
+    "ClassModel",
+    "analyze_sources",
+    "analyze_paths",
+]
+
+#: code -> one-line summary (the ``--list-checks`` table).
+ALL_CHECKS: Dict[str, str] = {
+    "GT001": "unguarded write to an attribute shared across thread "
+             "entry points",
+    "GT002": "cycle in the lock-acquisition graph (lexical + one-level "
+             "call edges)",
+    "GT003": "thread entry blocking on a queue only it produces "
+             "(wait-for self-cycle)",
+    "GT004": "serve op without a router decision "
+             "(router-passthrough-safe)",
+}
+
+
+def _selected(select: Optional[Iterable[str]]) -> List[str]:
+    if select is None:
+        return list(ALL_CHECKS)
+    codes = [c for c in select]
+    unknown = [c for c in codes if c not in ALL_CHECKS]
+    if unknown:
+        raise ValueError(
+            f"unknown check code(s): {', '.join(unknown)}"
+        )
+    return codes
+
+
+def analyze_sources(
+    items: Sequence[Tuple[str, str]],
+    *,
+    select: Optional[Iterable[str]] = None,
+    use_allowlist: bool = True,
+) -> Tuple[List[Finding], List[ClassModel]]:
+    """Analyze ``(source, path)`` pairs as one program.
+
+    Returns ``(findings, class_models)``; the models feed the topology
+    report.  ``use_allowlist=False`` surfaces grandfathered findings
+    (the shrink-only test's hook).  Raises ``SyntaxError`` on an
+    unparseable file and ``ValueError`` on an unknown check code."""
+    codes = _selected(select)
+    models: List[ClassModel] = []
+    annotations_by_path: Dict[str, Dict[int, Tuple[str, str]]] = {}
+    trees: Dict[str, ast.Module] = {}
+    for source, path in items:
+        file_models, annotations, tree = build_class_models(source, path)
+        models.extend(file_models)
+        annotations_by_path[path] = annotations
+        trees[path] = tree
+    findings: List[Finding] = []
+    for model in models:
+        ann = annotations_by_path.get(model.path, {})
+        if "GT001" in codes:
+            findings.extend(check_shared_writes(model, ann))
+        if "GT002" in codes:
+            findings.extend(check_lock_cycles(model))
+        if "GT003" in codes:
+            findings.extend(check_queue_self_wait(model))
+    if "GT004" in codes:
+        findings.extend(check_passthrough(trees))
+    if use_allowlist:
+        findings, _grandfathered = allowlist.split(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings, models
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    select: Optional[Iterable[str]] = None,
+    use_allowlist: bool = True,
+) -> Tuple[List[Finding], List[ClassModel]]:
+    """Analyze every ``.py`` file under ``paths`` as one program."""
+    items: List[Tuple[str, str]] = []
+    for file_path in iter_python_files(paths):
+        with open(file_path, "r", encoding="utf-8") as fh:
+            items.append((fh.read(), file_path))
+    return analyze_sources(
+        items, select=select, use_allowlist=use_allowlist
+    )

@@ -1,0 +1,148 @@
+"""graftrace command line: ``python -m tools.graftrace [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage/parse error — the contract
+``scripts/lint.sh`` and CI key on (same as graftlint/graftaudit).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import ALL_CHECKS, analyze_paths
+from .report import metrics, to_markdown
+
+#: What ``python -m tools.graftrace`` scans with no arguments: the
+#: threaded runtime, the chunk-compile ring, and tools/ itself (the
+#: interleave harness spawns threads too — the tier eats its own
+#: dogfood).
+DEFAULT_PATHS = (
+    "hashcat_a5_table_generator_tpu/runtime",
+    "hashcat_a5_table_generator_tpu/ops/packing.py",
+    "tools",
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftrace",
+        description=(
+            "Thread-topology & lock-discipline static analysis for the "
+            "threaded runtime (shared-write guards, lock-order cycles, "
+            "queue wait-for cycles, router passthrough)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=list(DEFAULT_PATHS),
+        help="files or directories to analyze "
+             f"(default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--select",
+        metavar="CODES",
+        help="comma-separated check codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-checks",
+        action="store_true",
+        help="print the check table and exit",
+    )
+    parser.add_argument(
+        "--report",
+        metavar="PATH",
+        help="write the thread-topology markdown report to PATH "
+             "('-' for stdout)",
+    )
+    parser.add_argument(
+        "--summary",
+        metavar="PATH",
+        help="append the topology report + finding counts to PATH "
+             "(CI: pass \"$GITHUB_STEP_SUMMARY\")",
+    )
+    parser.add_argument(
+        "--metrics-json",
+        metavar="PATH",
+        help="write run metrics (classes/entries/shared-attr/finding "
+             "counts) as JSON to PATH; CI uploads it as a job artifact",
+    )
+    parser.add_argument(
+        "--no-allowlist",
+        action="store_true",
+        help="surface grandfathered findings (the shrink-only list in "
+             "tools/graftrace/allowlist.py)",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_checks:
+        for code, summary in ALL_CHECKS.items():
+            print(f"{code}  {summary}")
+        return 0
+    select: Optional[List[str]] = None
+    if args.select:
+        select = [c.strip() for c in args.select.split(",") if c.strip()]
+    t0 = time.monotonic()
+    try:
+        findings, models = analyze_paths(
+            args.paths,
+            select=select,
+            use_allowlist=not args.no_allowlist,
+        )
+    except ValueError as exc:
+        print(f"graftrace: error: {exc}", file=sys.stderr)
+        return 2
+    except SyntaxError as exc:
+        print(f"graftrace: parse error: {exc}", file=sys.stderr)
+        return 2
+    elapsed = time.monotonic() - t0
+
+    report_md = to_markdown(models)
+    if args.report == "-":
+        print(report_md, end="")
+    elif args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            fh.write(report_md)
+    if args.summary:
+        with open(args.summary, "a", encoding="utf-8") as fh:
+            fh.write(report_md)
+            fh.write(
+                f"\n**graftrace**: {len(findings)} finding(s) over "
+                f"{len(models)} classes in {elapsed:.2f}s\n"
+            )
+            for f in findings:
+                fh.write(f"- `{f.render()}`\n")
+    if args.metrics_json:
+        counts: Dict[str, float] = {
+            "findings": len(findings), "elapsed_s": elapsed,
+        }
+        for code in ALL_CHECKS:
+            counts[f"findings_{code.lower()}"] = sum(
+                1 for f in findings if f.code == code
+            )
+        payload = metrics(models, counts)
+        with open(args.metrics_json, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    try:
+        for finding in findings:
+            print(finding.render())
+    except BrokenPipeError:  # piped into head; keep the exit contract
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    if findings:
+        print(f"graftrace: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

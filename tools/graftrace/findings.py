@@ -1,0 +1,33 @@
+"""Finding type — graftrace's typed output surface.
+
+Same contract as graftlint's: everything the CLI prints and the tests
+assert on is a :class:`Finding`; checks produce them and never print,
+so one check implementation drives the CLI, the fixtures, and CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One concurrency-discipline violation at a source location.
+
+    ``path`` is the path the file was analyzed AS (fixture tests feed
+    snippets under virtual paths); ``line``/``col`` are 1-based line
+    and 0-based column, matching ``ast`` node coordinates.  ``key`` is
+    the stable allowlist key (``Class.attr`` for shared-write findings,
+    a cycle/op signature for the graph checks) — the grandfather list
+    matches on it, never on line numbers."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    key: str = ""
+
+    def render(self) -> str:
+        """``path:line:col: CODE message`` — the CLI output line."""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"

@@ -1,0 +1,234 @@
+"""Deterministic-interleaving scheduler for the threaded runtime's
+race-window tests (PERF.md §26, part B of the graftrace tier).
+
+The static model proves guard DISCIPLINE; this harness makes the known
+race WINDOWS replayable.  It rides the existing fault seam: every
+instrumented yield point in the runtime already calls
+
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("<point>")
+
+so installing an :class:`Interleaver`'s plan turns those same named
+points into schedule gates — no new production hooks, and an unarmed
+run keeps the one-``None``-check hot path.
+
+Two modes:
+
+* **Breakpoint mode** (fully deterministic — the race-window tests):
+  ``hold(point)`` parks every thread that arrives at the point;
+  ``await_arrival`` observes the parked thread; the test then runs the
+  racing operation and ``release``/``release_all`` resumes.  The
+  interleaving is an explicit program, not a sleep race.
+* **Seeded-governor mode** (the schedule sweeps): ``auto(seed)``
+  releases parked threads one at a time in an order drawn from a
+  seeded RNG over the deterministically-sorted parked set.  The seed
+  replays the governor's CHOICES; tests assert invariants (byte
+  parity, settled states), never exact schedules.
+
+Parks are bounded (``park_timeout_s``): an orphaned gate times out and
+the thread proceeds, recording the timeout in :attr:`timeouts` so a
+test that forgot to release fails loudly instead of hanging tier-1.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+from hashcat_a5_table_generator_tpu.runtime import faults
+
+
+class _Plan(faults.FaultPlan):
+    """A rule-less FaultPlan whose only effect is gating arrivals."""
+
+    def __init__(self, interleaver: "Interleaver") -> None:
+        super().__init__([], seed=0)
+        self._interleaver = interleaver
+
+    def fire(self, point: str) -> None:  # pragma: no cover - trivial
+        self._interleaver._arrive(point)
+
+
+class Interleaver:
+    """Schedule gates over the fault-injection points.
+
+    Use as a context manager: entering installs the plan process-wide
+    (restoring whatever was armed before on exit) and exiting stops
+    the governor and releases every parked thread — a failing test
+    never strands runtime threads."""
+
+    def __init__(self, *, park_timeout_s: float = 30.0) -> None:
+        self._park_timeout_s = float(park_timeout_s)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._held: Set[str] = set()
+        #: parked threads: (point, ticket) -> release event
+        self._parked: Dict[Tuple[str, int], threading.Event] = {}
+        self._tickets = 0
+        self._closing = False
+        self._governor: Optional[threading.Thread] = None
+        self._governor_stop = threading.Event()
+        #: every arrival, in order — the replay log tests assert on.
+        self.arrivals: List[Tuple[str, int]] = []
+        #: parks that timed out (a test bug: assert this stays empty).
+        self.timeouts: List[Tuple[str, int]] = []
+        self._armed: Optional[faults.armed] = None
+
+    # -- context management --------------------------------------------
+
+    def __enter__(self) -> "Interleaver":
+        if self._closing:
+            # One-shot by design: after stop() the _closing latch makes
+            # _arrive a pass-through, so a reused instance would run
+            # UNSCHEDULED and pass vacuously — fail loudly instead.
+            raise RuntimeError(
+                "Interleaver is one-shot; create a new instance per "
+                "'with' block"
+            )
+        self._armed = faults.armed(_Plan(self))
+        self._armed.__enter__()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.stop()
+        assert self._armed is not None
+        self._armed.__exit__(*exc)
+
+    def stop(self) -> None:
+        """Stop the governor and release everything parked."""
+        self._governor_stop.set()
+        with self._cond:
+            self._closing = True
+            self._held.clear()
+            for ev in self._parked.values():
+                ev.set()
+            self._cond.notify_all()
+        if self._governor is not None:
+            self._governor.join(timeout=5.0)
+            self._governor = None
+
+    # -- breakpoint mode -----------------------------------------------
+
+    def hold(self, point: str) -> None:
+        """Park every subsequent arrival at ``point``."""
+        if point not in faults.POINTS:
+            raise ValueError(
+                f"unknown interleave point {point!r} "
+                f"(want one of {', '.join(sorted(faults.POINTS))})"
+            )
+        with self._cond:
+            self._held.add(point)
+
+    def release(self, point: str, n: int = 1) -> int:
+        """Resume up to ``n`` threads parked at ``point`` (oldest
+        first); returns how many were resumed.  The point stays held
+        for FUTURE arrivals — drop the gate with :meth:`unhold`."""
+        with self._cond:
+            # A released thread stays parked until it wakes and pops
+            # itself; skip already-set events so back-to-back releases
+            # resume DISTINCT threads instead of double-counting one.
+            keys = sorted(
+                (k for k in self._parked
+                 if k[0] == point and not self._parked[k].is_set()),
+                key=lambda k: k[1],
+            )[: max(0, int(n))]
+            for key in keys:
+                self._parked[key].set()
+            return len(keys)
+
+    def release_all(self, point: Optional[str] = None) -> int:
+        """Resume every thread parked at ``point`` (or anywhere)."""
+        with self._cond:
+            keys = [
+                k for k in self._parked
+                if (point is None or k[0] == point)
+                and not self._parked[k].is_set()
+            ]
+            for key in keys:
+                self._parked[key].set()
+            return len(keys)
+
+    def unhold(self, point: str) -> None:
+        """Drop the gate: future arrivals pass through (threads
+        already parked stay parked until released)."""
+        with self._cond:
+            self._held.discard(point)
+
+    def parked(self, point: Optional[str] = None) -> int:
+        with self._cond:
+            return sum(
+                1 for k in self._parked
+                if point is None or k[0] == point
+            )
+
+    def await_arrival(self, point: str, *, count: int = 1,
+                      timeout: float = 20.0) -> int:
+        """Block until ``count`` threads are parked at ``point``;
+        returns the parked count (raises on timeout — a schedule test
+        must never silently degrade into the sleep-and-hope it
+        replaces)."""
+        with self._cond:
+            ok = self._cond.wait_for(
+                lambda: sum(
+                    1 for k in self._parked if k[0] == point
+                ) >= count,
+                timeout=timeout,
+            )
+            got = sum(1 for k in self._parked if k[0] == point)
+        if not ok:
+            raise TimeoutError(
+                f"no arrival at {point!r} within {timeout:g}s "
+                f"(parked: {got})"
+            )
+        return got
+
+    # -- seeded-governor mode ------------------------------------------
+
+    def auto(self, seed: int, *, quantum_s: float = 0.02) -> None:
+        """Start the seeded governor: whenever threads are parked, one
+        (chosen by the seeded RNG over the sorted parked set) is
+        released per ``quantum_s`` tick.  The seed replays the
+        governor's choices."""
+        if self._governor is not None:
+            raise RuntimeError("governor already running")
+        rng = random.Random(int(seed))
+        self._governor_stop.clear()
+
+        def govern() -> None:
+            while not self._governor_stop.wait(quantum_s):
+                with self._cond:
+                    keys = sorted(
+                        k for k in self._parked
+                        if not self._parked[k].is_set()
+                    )
+                    if not keys:
+                        continue
+                    key = keys[rng.randrange(len(keys))]
+                    self._parked[key].set()
+
+        self._governor = threading.Thread(
+            target=govern, name="graftrace-governor", daemon=True
+        )
+        self._governor.start()
+
+    # -- the gate (called from runtime threads via the plan) -----------
+
+    def _arrive(self, point: str) -> None:
+        with self._cond:
+            if self._closing or point not in self._held:
+                return
+            ticket = self._tickets
+            self._tickets += 1
+            self.arrivals.append((point, ticket))
+            ev = threading.Event()
+            self._parked[(point, ticket)] = ev
+            self._cond.notify_all()
+        try:
+            if not ev.wait(self._park_timeout_s):
+                with self._cond:
+                    self.timeouts.append((point, ticket))
+        finally:
+            with self._cond:
+                self._parked.pop((point, ticket), None)
+                self._cond.notify_all()

@@ -1,0 +1,794 @@
+"""The static thread-topology model (PERF.md §26).
+
+One AST pass per file discovers, per class:
+
+* **Thread entry points** — methods handed to ``threading.Thread(
+  target=self.m)``, submitted to an executor (``self._ex.submit(
+  self.m, ...)``), or escaped as bound-method callbacks (``self.m``
+  passed as any call argument: the fleet's ``on_event=self._on_event``
+  reader-plane registrations).  Everything else is reachable from the
+  implicit ``(caller)`` entry — Python has no privacy, and the
+  embedder-mode APIs call underscore methods by contract.
+* **The per-class shared-state map** — ``self.<attr>`` writes
+  (assignment, augmented assignment, subscript stores, and mutating
+  method calls on non-thread-safe containers), attributed to every
+  entry point whose intra-class call closure reaches the writing
+  method.  An attribute written from ≥ 2 entries is SHARED.
+* **Guards** — a write is guarded when it happens lexically under
+  ``with self.<lock>:`` (or between explicit ``acquire``/``release``
+  on the same block), where ``<lock>`` is an attribute initialized
+  from ``threading.Lock``/``RLock``/``Condition``; a method whose
+  every intra-class call site holds a lock inherits that lock as its
+  *ambient* guard (the one-level interprocedural case: ``_drop_health``
+  under ``_health_lock``).  ``queue.Queue``/``threading.Event``/
+  ``deque`` attributes are thread-safe channels: calling into them is
+  never a shared write (the bounded-queue handoff discipline);
+  REBINDING one still is.
+* **The lock-acquisition graph** — lock → lock edges from lexical
+  nesting plus call edges one level deep (acquire-while-holding
+  through ``self.m()``); cycles are findings (GT002).
+* **Wait-for self-cycles** — a thread entry that blocks on an
+  unbounded ``queue.get()`` whose only in-class producers run on that
+  same entry can never be satisfied (GT003): the fleet
+  requeue-worker deadlock's distilled shape.
+
+Annotations (the guard grammar, checked not trusted)::
+
+    self._x = 0   # graftrace: guard=_lock   (held by protocol; the
+                  #   name must resolve to a real lock attribute)
+    self._y = 1   # graftrace: owner=serve   (single-writer claim;
+                  #   free-form thread label)
+
+An annotation on an ``__init__`` assignment covers every write of
+that attribute; on any other line it covers that line only.  Benign
+findings that predate the pass live in ``allowlist.py`` (shrink-only,
+one justification per entry — the GL013 grandfather discipline).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .findings import Finding
+
+#: ``# graftrace: guard=<lock>`` / ``# graftrace: owner=<label>``.
+_ANNOTATION_RE = re.compile(
+    r"#\s*graftrace:\s*(guard|owner)=([A-Za-z_][A-Za-z0-9_.-]*)"
+)
+
+#: Constructor dotted names → attribute kind.
+_TYPE_TABLE: Dict[str, str] = {
+    "threading.Lock": "lock", "Lock": "lock",
+    "threading.RLock": "rlock", "RLock": "rlock",
+    "threading.Condition": "lock", "Condition": "lock",
+    "threading.Semaphore": "lock", "threading.BoundedSemaphore": "lock",
+    "queue.Queue": "queue", "Queue": "queue",
+    "queue.SimpleQueue": "queue", "SimpleQueue": "queue",
+    "queue.LifoQueue": "queue", "queue.PriorityQueue": "queue",
+    "threading.Event": "event", "Event": "event",
+    "collections.deque": "deque", "deque": "deque",
+    "threading.Thread": "thread", "Thread": "thread",
+    "ThreadPoolExecutor": "executor",
+    "concurrent.futures.ThreadPoolExecutor": "executor",
+    "futures.ThreadPoolExecutor": "executor",
+}
+
+#: Kinds whose METHOD CALLS are thread-safe channels (never a shared
+#: write); rebinding the attribute itself is still a write.
+_SAFE_KINDS = frozenset(
+    {"lock", "rlock", "queue", "event", "deque", "thread", "executor"}
+)
+
+_LOCK_KINDS = frozenset({"lock", "rlock"})
+
+#: Container method calls that mutate the receiver.
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "remove", "discard", "pop",
+    "popleft", "popitem", "clear", "update", "extend", "insert",
+    "setdefault", "sort", "reverse",
+})
+
+#: The implicit entry for code reachable from ordinary method calls.
+CALLER = "(caller)"
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``attr`` when ``node`` is exactly ``self.attr``."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+@dataclass(frozen=True)
+class WriteSite:
+    """One mutation of ``self.<attr>``."""
+
+    attr: str
+    line: int
+    col: int
+    method: str
+    held: FrozenSet[str]
+    kind: str  # assign | augassign | mutate | delete
+
+
+@dataclass(frozen=True)
+class QueueOp:
+    attr: str
+    line: int
+    col: int
+    method: str
+    op: str  # get | put
+    blocking: bool  # an unbounded blocking get
+
+
+@dataclass(frozen=True)
+class LockEdge:
+    src: str
+    dst: str
+    line: int
+    method: str
+    via: str  # "" for lexical nesting, callee name for call edges
+
+
+@dataclass
+class MethodScan:
+    name: str
+    lineno: int
+    writes: List[WriteSite] = field(default_factory=list)
+    #: every ``self.m(...)`` call: (callee, line, locks held there)
+    call_sites: List[Tuple[str, int, FrozenSet[str]]] = field(
+        default_factory=list
+    )
+    #: every lock this method acquires (lexically, anywhere)
+    acquires: List[Tuple[str, int]] = field(default_factory=list)
+    #: lexical lock nesting edges recorded during the scan
+    nest_edges: List[LockEdge] = field(default_factory=list)
+    queue_ops: List[QueueOp] = field(default_factory=list)
+
+    @property
+    def calls(self) -> Set[str]:
+        return {callee for callee, _line, _held in self.call_sites}
+
+
+@dataclass
+class ClassModel:
+    """Everything graftrace knows about one class."""
+
+    name: str
+    path: str
+    lineno: int
+    #: attr -> kind from _TYPE_TABLE (any method's ``self.x = Ctor()``)
+    attr_kinds: Dict[str, str] = field(default_factory=dict)
+    #: attr -> __init__ assignment line (attr-level annotations)
+    decl_lines: Dict[str, int] = field(default_factory=dict)
+    methods: Dict[str, MethodScan] = field(default_factory=dict)
+    #: entry method name -> kind (thread | worker | callback)
+    entries: Dict[str, str] = field(default_factory=dict)
+
+    # -- derived (filled by finalize) ----------------------------------
+    #: entry name (incl. CALLER) -> reachable method set
+    reach: Dict[str, Set[str]] = field(default_factory=dict)
+    #: attr -> entry names that write it (outside __init__)
+    writers: Dict[str, Set[str]] = field(default_factory=dict)
+    shared: Set[str] = field(default_factory=set)
+    lock_edges: List[LockEdge] = field(default_factory=list)
+    #: shared attr -> "guard=x"/"owner=y" labels covering its writes
+    #: (filled by build_class_models; the topology report renders these
+    #: so a declared single-writer never looks like an unguarded one)
+    declared: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def lock_attrs(self) -> Set[str]:
+        return {
+            a for a, k in self.attr_kinds.items() if k in _LOCK_KINDS
+        }
+
+    def finalize(self) -> None:
+        """Compute reachability, writer attribution, shared set, and
+        the lock graph (lexical nesting + one-level call edges)."""
+        graph = {m: s.calls for m, s in self.methods.items()}
+
+        def closure(roots: Set[str]) -> Set[str]:
+            seen: Set[str] = set()
+            todo = [r for r in roots if r in self.methods]
+            while todo:
+                m = todo.pop()
+                if m in seen:
+                    continue
+                seen.add(m)
+                todo.extend(
+                    c for c in graph.get(m, ()) if c in self.methods
+                )
+            return seen
+
+        for entry in self.entries:
+            self.reach[entry] = closure({entry})
+        caller_roots = {
+            m for m in self.methods
+            if m not in self.entries and m != "__init__"
+        }
+        self.reach[CALLER] = closure(caller_roots)
+
+        for entry, methods in self.reach.items():
+            for m in methods:
+                if m == "__init__":
+                    continue
+                for w in self.methods[m].writes:
+                    self.writers.setdefault(w.attr, set()).add(entry)
+        self.shared = {
+            a for a, ents in self.writers.items() if len(ents) >= 2
+        }
+
+        locks = self.lock_attrs
+        edges: Dict[Tuple[str, str], LockEdge] = {}
+        for scan in self.methods.values():
+            for e in scan.nest_edges:
+                if e.src in locks and e.dst in locks:
+                    edges.setdefault((e.src, e.dst), e)
+            # Call edges, one level deep: a lock held across
+            # ``self.m()`` reaches every lock m acquires lexically.
+            for callee, line, held in scan.call_sites:
+                target = self.methods.get(callee)
+                if target is None or not held:
+                    continue
+                for lock in held:
+                    if lock not in locks:
+                        continue
+                    for dst, _dline in target.acquires:
+                        if dst in locks:
+                            edge = LockEdge(
+                                lock, dst, line, scan.name, callee
+                            )
+                            edges.setdefault((lock, dst), edge)
+        self.lock_edges = list(edges.values())
+
+    # -- queries -------------------------------------------------------
+
+    def entries_reaching(self, method: str) -> Set[str]:
+        return {
+            e for e, methods in self.reach.items() if method in methods
+        }
+
+    def all_writes(self, attr: str) -> List[WriteSite]:
+        out = [
+            w
+            for m, scan in self.methods.items()
+            if m != "__init__"
+            for w in scan.writes
+            if w.attr == attr
+        ]
+        out.sort(key=lambda w: (w.line, w.col))
+        return out
+
+
+def _collect_annotations(source: str) -> Dict[int, Tuple[str, str]]:
+    """line -> (kind, value) for ``# graftrace: guard=x / owner=y``.
+
+    A trailing comment annotates its own line; an annotation in a
+    comment-only line (or block) annotates the next code line below —
+    the readable form for multi-line statements."""
+    out: Dict[int, Tuple[str, str]] = {}
+    lines = source.splitlines()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _ANNOTATION_RE.search(tok.string)
+            if m is None:
+                continue
+            line = tok.start[0]
+            ann = (m.group(1), m.group(2))
+            if lines[line - 1].lstrip().startswith("#"):
+                # Comment-only line: attach to the code line below.
+                j = line
+                while j < len(lines) and (
+                    not lines[j].strip()
+                    or lines[j].lstrip().startswith("#")
+                ):
+                    j += 1
+                out.setdefault(j + 1, ann)
+            else:
+                out[line] = ann
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+class _MethodScanner:
+    """Scan one method body, tracking held locks block-linearly."""
+
+    def __init__(self, model: ClassModel, scan: MethodScan) -> None:
+        self._model = model
+        self._scan = scan
+
+    # -- entry ---------------------------------------------------------
+
+    def run(self, fn: ast.FunctionDef) -> None:
+        self._block(fn.body, [])
+
+    # -- blocks --------------------------------------------------------
+
+    def _block(self, stmts: Sequence[ast.stmt], held: List[str]) -> None:
+        held = list(held)
+        for stmt in stmts:
+            if isinstance(stmt, ast.With):
+                self._with(stmt, held)
+            elif isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                # Deferred execution: a nested def may run on another
+                # thread later, so it inherits NO held locks.
+                self._block(stmt.body, [])
+            elif isinstance(stmt, ast.ClassDef):
+                pass
+            elif self._acquire_release(stmt, held):
+                pass
+            else:
+                for expr_node in self._stmt_exprs(stmt):
+                    self._expr(expr_node, held)
+                self._writes(stmt, held)
+                for sub in self._sub_blocks(stmt):
+                    self._block(sub, held)
+
+    def _with(self, stmt: ast.With, held: List[str]) -> None:
+        acquired: List[str] = []
+        for item in stmt.items:
+            self._expr(item.context_expr, held + acquired)
+            lock = self._lock_of(item.context_expr)
+            if lock is not None:
+                for outer in held + acquired:
+                    self._scan.nest_edges.append(LockEdge(
+                        outer, lock, stmt.lineno, self._scan.name, ""
+                    ))
+                self._scan.acquires.append((lock, stmt.lineno))
+                acquired.append(lock)
+        self._block(stmt.body, held + acquired)
+
+    def _lock_of(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and attr in self._model.lock_attrs:
+            return attr
+        # ``with self._x.acquire_timeout():``-style wrappers are out of
+        # scope; ``with self._cond:`` is covered by the attr form.
+        return None
+
+    def _acquire_release(
+        self, stmt: ast.stmt, held: List[str]
+    ) -> bool:
+        """Handle bare ``self.X.acquire()`` / ``self.X.release()``
+        statements (block-linear held tracking)."""
+        if not (
+            isinstance(stmt, ast.Expr)
+            and isinstance(stmt.value, ast.Call)
+            and isinstance(stmt.value.func, ast.Attribute)
+        ):
+            return False
+        call = stmt.value
+        func = call.func
+        assert isinstance(func, ast.Attribute)
+        attr = _self_attr(func.value)
+        if attr is None or attr not in self._model.lock_attrs:
+            return False
+        if func.attr == "acquire":
+            for outer in held:
+                self._scan.nest_edges.append(LockEdge(
+                    outer, attr, stmt.lineno, self._scan.name, ""
+                ))
+            self._scan.acquires.append((attr, stmt.lineno))
+            held.append(attr)
+            return True
+        if func.attr == "release":
+            if attr in held:
+                held.remove(attr)
+            return True
+        return False
+
+    @staticmethod
+    def _sub_blocks(stmt: ast.stmt) -> Iterator[Sequence[ast.stmt]]:
+        for name in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, name, None)
+            if isinstance(sub, list) and sub and isinstance(
+                sub[0], ast.stmt
+            ):
+                yield sub
+        for handler in getattr(stmt, "handlers", ()):
+            yield handler.body
+
+    @staticmethod
+    def _stmt_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+        """The statement's own expression children (its sub-blocks are
+        recursed separately with held-lock tracking)."""
+        for name, value in ast.iter_fields(stmt):
+            if name in ("body", "orelse", "finalbody", "handlers"):
+                continue
+            if isinstance(value, ast.AST):
+                yield value
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.AST):
+                        yield v
+
+    # -- expressions ---------------------------------------------------
+
+    def _expr(self, node: ast.AST, held: List[str]) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub, held)
+            elif isinstance(
+                sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                pass  # nested defs handled at block level; lambdas rare
+
+    def _call(self, call: ast.Call, held: List[str]) -> None:
+        func = call.func
+        name = dotted_name(func)
+        # -- thread / worker entry registration ------------------------
+        if name in ("threading.Thread", "Thread"):
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target = _self_attr(kw.value)
+                    if target is not None:
+                        self._model.entries.setdefault(target, "thread")
+        if isinstance(func, ast.Attribute) and func.attr == "submit" \
+                and call.args:
+            target = _self_attr(call.args[0])
+            if target is not None:
+                self._model.entries.setdefault(target, "worker")
+        # -- bound-method escapes (callback entries) -------------------
+        for arg in list(call.args) + [
+            kw.value for kw in call.keywords
+        ]:
+            target = _self_attr(arg)
+            if target is not None and target in self._model.methods:
+                # Only methods escape; data attributes are just reads.
+                self._model.entries.setdefault(target, "callback")
+        # -- queue ops / container mutators on self attrs --------------
+        if isinstance(func, ast.Attribute):
+            attr = _self_attr(func.value)
+            if attr is not None:
+                kind = self._model.attr_kinds.get(attr)
+                if kind == "queue" and func.attr in (
+                    "get", "put", "get_nowait", "put_nowait"
+                ):
+                    op = "get" if func.attr.startswith("get") else "put"
+                    # Blocks forever only when block is (statically)
+                    # True AND no timeout is given: get(False) /
+                    # get(block=False) / any timeout never deadlock,
+                    # and a non-literal block value gets the benefit
+                    # of the doubt (false GT003s are lint failures).
+                    block_arg: Optional[ast.expr] = (
+                        call.args[0] if call.args else None
+                    )
+                    timeout_arg: Optional[ast.expr] = (
+                        call.args[1] if len(call.args) > 1 else None
+                    )
+                    for kw in call.keywords:
+                        if kw.arg == "block":
+                            block_arg = kw.value
+                        elif kw.arg == "timeout":
+                            timeout_arg = kw.value
+                    blocks_forever = (
+                        block_arg is None
+                        or (
+                            isinstance(block_arg, ast.Constant)
+                            and block_arg.value is True
+                        )
+                    ) and (
+                        timeout_arg is None
+                        or (
+                            isinstance(timeout_arg, ast.Constant)
+                            and timeout_arg.value is None
+                        )
+                    )
+                    blocking = func.attr == "get" and blocks_forever
+                    self._scan.queue_ops.append(QueueOp(
+                        attr, call.lineno, call.col_offset,
+                        self._scan.name, op, blocking,
+                    ))
+                elif (
+                    func.attr in _MUTATORS
+                    and kind not in _SAFE_KINDS
+                ):
+                    self._record_write(
+                        attr, call.lineno, call.col_offset, held,
+                        "mutate",
+                    )
+            # -- intra-class call edges --------------------------------
+            target = _self_attr(func)
+            if target is not None:
+                self._scan.call_sites.append(
+                    (target, call.lineno, frozenset(held))
+                )
+
+    # -- writes --------------------------------------------------------
+
+    def _writes(self, stmt: ast.stmt, held: List[str]) -> None:
+        targets: List[ast.AST] = []
+        kind = "assign"
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets = [stmt.target]
+        elif isinstance(stmt, ast.AugAssign):
+            targets = [stmt.target]
+            kind = "augassign"
+        elif isinstance(stmt, ast.Delete):
+            targets = list(stmt.targets)
+            kind = "delete"
+        for target in targets:
+            self._target(target, stmt, held, kind)
+
+    def _target(
+        self, target: ast.AST, stmt: ast.stmt, held: List[str],
+        kind: str,
+    ) -> None:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._target(elt, stmt, held, kind)
+            return
+        attr = _self_attr(target)
+        if attr is not None:
+            self._record_write(
+                attr, stmt.lineno, stmt.col_offset, held, kind
+            )
+            return
+        if isinstance(target, ast.Subscript):
+            attr = _self_attr(target.value)
+            if attr is not None and self._model.attr_kinds.get(
+                attr
+            ) not in _SAFE_KINDS:
+                self._record_write(
+                    attr, stmt.lineno, stmt.col_offset, held, "mutate"
+                )
+
+    def _record_write(
+        self, attr: str, line: int, col: int, held: List[str],
+        kind: str,
+    ) -> None:
+        self._scan.writes.append(WriteSite(
+            attr, line, col, self._scan.name, frozenset(held), kind
+        ))
+
+
+def _scan_attr_kinds(cls: ast.ClassDef, model: ClassModel) -> None:
+    """attr -> kind from ``self.x = Ctor()`` anywhere in the class
+    (first binding wins), plus __init__ declaration lines."""
+    for fn in cls.body:
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for node in ast.walk(fn):
+            value: Optional[ast.expr] = None
+            target: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                target, value = node.target, node.value
+            if target is None or value is None:
+                continue
+            attr = _self_attr(target)
+            if attr is None:
+                continue
+            if fn.name == "__init__":
+                model.decl_lines.setdefault(attr, node.lineno)
+            if isinstance(value, ast.Call):
+                ctor = dotted_name(value.func)
+                kind = _TYPE_TABLE.get(ctor or "")
+                if kind is not None:
+                    model.attr_kinds.setdefault(attr, kind)
+
+
+def build_class_models(
+    source: str, path: str
+) -> Tuple[List[ClassModel], Dict[int, Tuple[str, str]], ast.Module]:
+    """Parse ``source`` (analyzed as ``path``) into per-class models
+    plus the file's annotation map and parsed tree (returned so
+    callers feeding tree-level checks never parse twice).  Raises
+    ``SyntaxError`` on an unparseable file — the CLI reports those as
+    hard errors."""
+    tree = ast.parse(source, filename=path)
+    annotations = _collect_annotations(source)
+    models: List[ClassModel] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        model = ClassModel(node.name, path, node.lineno)
+        for fn in node.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                model.methods[fn.name] = MethodScan(fn.name, fn.lineno)
+        _scan_attr_kinds(node, model)
+        for fn in node.body:
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _MethodScanner(model, model.methods[fn.name]).run(fn)
+        model.finalize()
+        for attr in model.shared:
+            decl = annotations.get(model.decl_lines.get(attr, -1))
+            labels = [decl] if decl is not None else [
+                a for w in model.all_writes(attr)
+                if (a := annotations.get(w.line)) is not None
+            ]
+            if labels:
+                model.declared[attr] = ", ".join(
+                    sorted({f"{k}={v}" for k, v in labels})
+                )
+        models.append(model)
+    return models, annotations, tree
+
+
+# ---------------------------------------------------------------------------
+# Checks over the model
+# ---------------------------------------------------------------------------
+
+
+def check_shared_writes(
+    model: ClassModel, annotations: Dict[int, Tuple[str, str]]
+) -> Iterator[Finding]:
+    """GT001: every write to a shared attribute needs a guard — a held
+    lock, a thread-safe-channel type, or an explicit annotation."""
+    for attr in sorted(model.shared):
+        decl_ann = annotations.get(model.decl_lines.get(attr, -1))
+        if decl_ann is not None:
+            ann_kind, ann_value = decl_ann
+            if ann_kind == "guard" and ann_value not in model.lock_attrs:
+                yield Finding(
+                    model.path, model.decl_lines[attr], 0, "GT001",
+                    f"{model.name}.{attr}: guard={ann_value!r} names no "
+                    f"lock attribute of {model.name} (known: "
+                    f"{', '.join(sorted(model.lock_attrs)) or 'none'})",
+                    key=f"{model.name}.{attr}",
+                )
+            continue  # attribute-level annotation covers all writes
+        writers = ", ".join(sorted(model.writers.get(attr, ())))
+        guarded_sets: List[FrozenSet[str]] = []
+        for w in model.all_writes(attr):
+            ann = annotations.get(w.line)
+            if ann is not None:
+                ann_kind, ann_value = ann
+                if ann_kind == "guard" and ann_value not in \
+                        model.lock_attrs:
+                    yield Finding(
+                        model.path, w.line, w.col, "GT001",
+                        f"{model.name}.{attr}: guard={ann_value!r} "
+                        f"names no lock attribute of {model.name}",
+                        key=f"{model.name}.{attr}",
+                    )
+                continue
+            held = w.held | _ambient_locks(model, w.method)
+            if not held:
+                yield Finding(
+                    model.path, w.line, w.col, "GT001",
+                    f"unguarded write to shared {model.name}.{attr} "
+                    f"(written from: {writers}) in {w.method}; hold a "
+                    "declared lock, hand off through a queue, or "
+                    "annotate '# graftrace: guard=<lock>|owner=<label>'",
+                    key=f"{model.name}.{attr}",
+                )
+            else:
+                guarded_sets.append(frozenset(held))
+        if len(guarded_sets) >= 2 and not frozenset.intersection(
+            *guarded_sets
+        ):
+            first = model.all_writes(attr)[0]
+            locks = sorted({lk for s in guarded_sets for lk in s})
+            yield Finding(
+                model.path, first.line, first.col, "GT001",
+                f"inconsistent guards on shared {model.name}.{attr}: "
+                f"writes hold {', '.join(locks)} with no common lock",
+                key=f"{model.name}.{attr}",
+            )
+
+
+def _ambient_locks(model: ClassModel, method: str) -> Set[str]:
+    """Locks held at EVERY intra-class call site of ``method`` (one
+    level deep): a helper only ever called under a lock inherits it.
+    A single bare call site (or being a thread entry) clears it."""
+    if method in model.entries:
+        return set()
+    sites: List[FrozenSet[str]] = [
+        held
+        for scan in model.methods.values()
+        for callee, _line, held in scan.call_sites
+        if callee == method
+    ]
+    if not sites:
+        return set()
+    return set(frozenset.intersection(*sites))
+
+
+def check_lock_cycles(model: ClassModel) -> Iterator[Finding]:
+    """GT002: cycles in the lock-acquisition graph (lexical nesting +
+    one-level call edges).  A non-reentrant self-edge is a cycle of
+    length one."""
+    graph: Dict[str, List[LockEdge]] = {}
+    for e in model.lock_edges:
+        if e.src == e.dst and model.attr_kinds.get(e.src) == "rlock":
+            continue  # reentrant self-acquire is legal
+        graph.setdefault(e.src, []).append(e)
+
+    seen_cycles: Set[Tuple[str, ...]] = set()
+    path: List[str] = []
+    on_path: Set[str] = set()
+
+    def dfs(node: str) -> Iterator[Tuple[List[str], LockEdge]]:
+        for edge in graph.get(node, ()):
+            if edge.dst in on_path:
+                i = path.index(edge.dst)
+                yield path[i:] + [edge.dst], edge
+                continue
+            path.append(edge.dst)
+            on_path.add(edge.dst)
+            yield from dfs(edge.dst)
+            on_path.discard(edge.dst)
+            path.pop()
+
+    for start in sorted(graph):
+        path[:] = [start]
+        on_path = {start}
+        for cycle, edge in dfs(start):
+            canon = tuple(sorted(set(cycle)))
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            shape = " -> ".join(cycle)
+            via = f" (via self.{edge.via}())" if edge.via else ""
+            yield Finding(
+                model.path, edge.line, 0, "GT002",
+                f"lock-order cycle in {model.name}: {shape}{via} — "
+                "two threads taking these in opposite orders deadlock",
+                key=f"{model.name}:{'|'.join(canon)}",
+            )
+
+
+def check_queue_self_wait(model: ClassModel) -> Iterator[Finding]:
+    """GT003: a thread entry blocking on an unbounded ``get()`` of a
+    queue whose only in-class producers run on that same entry — the
+    wait can never be satisfied (the fleet requeue-worker deadlock
+    shape: re-dispatch work must hand off to a DIFFERENT thread than
+    the reader that must deliver the ack)."""
+    puts: Dict[str, Set[str]] = {}
+    gets: List[QueueOp] = []
+    for scan in model.methods.values():
+        for op in scan.queue_ops:
+            if op.op == "put":
+                puts.setdefault(op.attr, set()).update(
+                    model.entries_reaching(op.method)
+                )
+            elif op.blocking:
+                gets.append(op)
+    for op in gets:
+        producers = puts.get(op.attr, set())
+        if not producers:
+            continue  # cross-class producer: unknowable, stay quiet
+        for entry in sorted(model.entries_reaching(op.method)):
+            if entry == CALLER or entry not in model.entries:
+                continue
+            if producers <= {entry}:
+                yield Finding(
+                    model.path, op.line, op.col, "GT003",
+                    f"wait-for cycle in {model.name}: entry "
+                    f"'{entry}' blocks on {model.name}.{op.attr}."
+                    f"get() (in {op.method}) but the only producer "
+                    f"of that queue is '{entry}' itself — hand the "
+                    "work to a dedicated worker thread instead",
+                    key=f"{model.name}.{op.attr}",
+                )

@@ -1,0 +1,116 @@
+"""GT004: the CONTRIBUTING "router-passthrough-safe" rule, mechanized.
+
+The fleet router fronts engines with the engine's own JSONL protocol,
+so every op the engine session handles needs a ROUTER DECISION: either
+the router handles/forwards it explicitly (an ``op == "x"`` branch in
+``_RouterSession._handle``) or it is declared in the router module's
+``ROUTER_PASSTHROUGH_OPS`` frozenset (ops that are id-carrying and
+router-state-free by construction, forwarded by the unknown-op
+fallback).  A new serve op added to ``_JsonlSession._handle`` without
+either is a lint failure — the prose rule becomes a diff gate.
+
+Both op tables are extracted from the AST: every string constant
+compared against the ``op`` variable (``op == "x"``, ``op in ("a",
+"b")``) inside each class's ``_handle`` method.  The check runs only
+when the analyzed file set contains BOTH classes (scanning ``tools/``
+alone skips it); fixtures feed miniature twin classes under virtual
+paths through the same extraction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+#: The serve-session class whose ``_handle`` defines the op table.
+ENGINE_SESSION = "_JsonlSession"
+#: The router-session class whose ``_handle`` must decide each op.
+ROUTER_SESSION = "_RouterSession"
+#: Module-level declaration of deliberately-passed-through ops.
+PASSTHROUGH_DECL = "ROUTER_PASSTHROUGH_OPS"
+
+
+def _handle_ops(cls: ast.ClassDef) -> Tuple[Set[str], Optional[int]]:
+    """String constants compared against ``op`` in ``_handle``."""
+    ops: Set[str] = set()
+    line: Optional[int] = None
+    for fn in cls.body:
+        if not isinstance(fn, ast.FunctionDef) or fn.name != "_handle":
+            continue
+        line = fn.lineno
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            sides: List[ast.expr] = [node.left, *node.comparators]
+            if not any(
+                isinstance(s, ast.Name) and s.id == "op" for s in sides
+            ):
+                continue
+            for s in sides:
+                if isinstance(s, ast.Constant) and isinstance(
+                    s.value, str
+                ):
+                    ops.add(s.value)
+                elif isinstance(s, (ast.Tuple, ast.List, ast.Set)):
+                    ops.update(
+                        e.value for e in s.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                    )
+    return ops, line
+
+
+def _declared_passthrough(tree: ast.Module) -> Set[str]:
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None or not any(
+            isinstance(t, ast.Name) and t.id == PASSTHROUGH_DECL
+            for t in targets
+        ):
+            continue
+        try:
+            out = ast.literal_eval(
+                value.args[0] if isinstance(value, ast.Call)
+                and value.args else value
+            )
+        except (ValueError, TypeError):
+            return set()
+        return {op for op in out if isinstance(op, str)}
+    return set()
+
+
+def check_passthrough(
+    trees: Dict[str, ast.Module]
+) -> Iterator[Finding]:
+    """Diff the engine session's op table against the router's
+    handled + declared-passthrough set (GT004)."""
+    engine: Optional[Tuple[str, ast.ClassDef]] = None
+    router: Optional[Tuple[str, ast.ClassDef]] = None
+    for path, tree in sorted(trees.items()):
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                if node.name == ENGINE_SESSION and engine is None:
+                    engine = (path, node)
+                elif node.name == ROUTER_SESSION and router is None:
+                    router = (path, node)
+    if engine is None or router is None:
+        return  # partial file set: the diff needs both sides
+    engine_ops, engine_line = _handle_ops(engine[1])
+    router_ops, _router_line = _handle_ops(router[1])
+    router_ops |= _declared_passthrough(trees[router[0]])
+    for op in sorted(engine_ops - router_ops):
+        yield Finding(
+            engine[0], engine_line or engine[1].lineno, 0, "GT004",
+            f"serve op {op!r} ({ENGINE_SESSION}._handle) has no router "
+            f"decision: handle it in {ROUTER_SESSION}._handle or "
+            f"declare it in {PASSTHROUGH_DECL} "
+            "(CONTRIBUTING: router-passthrough-safe)",
+            key=f"op:{op}",
+        )

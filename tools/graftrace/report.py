@@ -1,0 +1,113 @@
+"""The thread-topology report: threads × shared attrs × guards.
+
+Rendered as markdown for the CI job summary (and ``--report`` locally)
+so every PR shows at a glance which classes own threads, what state
+they share, and what guards each shared attribute — the review surface
+CONTRIBUTING's "declare your shared state" rule points at.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Mapping, Tuple
+
+from .allowlist import ALLOWLIST
+from .model import CALLER, ClassModel, _ambient_locks
+
+
+def _allowlisted(model: ClassModel, attr: str) -> bool:
+    key = f"{model.name}.{attr}"
+    path = model.path.replace("\\", "/")
+    return any(
+        path.endswith(suffix) and k == key
+        for (suffix, k) in ALLOWLIST
+    )
+
+
+def _guards_of(model: ClassModel, attr: str) -> str:
+    kind = model.attr_kinds.get(attr)
+    if kind in ("queue", "event", "deque"):
+        return f"channel ({kind})"
+    held = [
+        sorted(w.held | _ambient_locks(model, w.method))
+        for w in model.all_writes(attr)
+    ]
+    common: List[str] = []
+    if held and all(held):
+        common = sorted(set(held[0]).intersection(*map(set, held[1:])))
+    if common:
+        return ", ".join(common)
+    # A declared or grandfathered attribute must never render like an
+    # unguarded hazard — the job-summary table is the review surface.
+    declared = model.declared.get(attr)
+    if declared:
+        return f"declared {declared}"
+    if _allowlisted(model, attr):
+        return "allowlisted (allowlist.py)"
+    if any(held):
+        return "mixed"
+    return "—"
+
+
+def class_rows(model: ClassModel) -> List[Tuple[str, str, str]]:
+    """(attr, writers, guard) rows for the class's shared attrs."""
+    rows = []
+    for attr in sorted(model.shared):
+        writers = ", ".join(sorted(model.writers.get(attr, ())))
+        rows.append((attr, writers, _guards_of(model, attr)))
+    return rows
+
+
+def to_markdown(models: List[ClassModel]) -> str:
+    """The full topology report over every analyzed class that owns a
+    thread entry (classes without one are single-threaded from this
+    model's point of view and stay out of the table)."""
+    lines = ["## graftrace thread topology", ""]
+    threaded = [m for m in models if m.entries]
+    if not threaded:
+        lines.append("_no thread entry points discovered_")
+        return "\n".join(lines) + "\n"
+    for model in threaded:
+        entries = ", ".join(
+            f"`{name}` ({kind})"
+            for name, kind in sorted(model.entries.items())
+        )
+        lines.append(f"### `{model.name}` — {model.path}")
+        lines.append(f"entries: {entries}, `{CALLER}`")
+        lines.append("")
+        rows = class_rows(model)
+        if rows:
+            lines.append("| shared attr | written from | guard |")
+            lines.append("| --- | --- | --- |")
+            for attr, writers, guard in rows:
+                lines.append(f"| `{attr}` | {writers} | {guard} |")
+        else:
+            lines.append("_no attribute written from ≥ 2 entries_")
+        if model.lock_edges:
+            edges = ", ".join(
+                f"`{e.src}` → `{e.dst}`"
+                + (f" (via `{e.via}`)" if e.via else "")
+                for e in sorted(
+                    model.lock_edges, key=lambda e: (e.src, e.dst)
+                )
+            )
+            lines.append("")
+            lines.append(f"lock order: {edges}")
+        lines.append("")
+    return "\n".join(lines) + "\n"
+
+
+def metrics(models: List[ClassModel],
+            counts: Mapping[str, float]) -> Dict[str, object]:
+    """The ``--metrics-json`` payload (the graftaudit artifact shape:
+    plain gauges a dashboard can diff across runs)."""
+    threaded = [m for m in models if m.entries]
+    return {
+        "graftrace": {
+            "classes_analyzed": len(models),
+            "classes_threaded": len(threaded),
+            "thread_entries": sum(len(m.entries) for m in threaded),
+            "shared_attrs": sum(len(m.shared) for m in threaded),
+            "lock_edges": sum(len(m.lock_edges) for m in models),
+            **counts,
+        }
+    }
