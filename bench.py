@@ -258,6 +258,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="--fleet-ab: router placement arm (the "
                          "round-robin control measures the same "
                          "passthrough without affinity lookups)")
+    ap.add_argument("--elastic-ab", action="store_true",
+                    help="measure routed-with-autoscale vs direct "
+                         "serve (PERF.md §27): the --fleet-ab "
+                         "contract with the elastic tier ARMED on the "
+                         "routed arm — admission control on, the "
+                         "autoscaler's control loop ticking "
+                         "(thresholds set so the steady state never "
+                         "scales) — pinning that elasticity costs "
+                         "nothing when nothing needs scaling (bar: "
+                         "within 5%% aggregate wall, the same §25 "
+                         "criterion). One JSON line; spawns engine "
+                         "subprocesses")
     ap.add_argument("--pack-ab", action="store_true",
                     help="measure cross-job packed dispatch (PERF.md "
                          "§22) against the per-job round-robin: N "
@@ -1116,7 +1128,8 @@ def run_serve_ab(args: argparse.Namespace) -> None:
     sys.stdout.flush()
 
 
-def run_fleet_ab(args: argparse.Namespace) -> None:
+def run_fleet_ab(args: argparse.Namespace,
+                 elastic: bool = False) -> None:
     """A/B routed vs direct serve on the §20 contract (PERF.md §25):
     arm DIRECT drives N equal small jobs against one freshly spawned
     ``a5gen serve`` engine over its unix socket; arm ROUTED drives the
@@ -1127,6 +1140,14 @@ def run_fleet_ab(args: argparse.Namespace) -> None:
     bar is within 5% aggregate wall).  Parity-asserts per-job
     emitted/hit counts across arms; prints ONE JSON line.
 
+    ``elastic=True`` (``--elastic-ab``, PERF.md §27) arms the elastic
+    tier on the routed arm: admission control ON (capacity + bounded
+    pending) and the autoscaler's control loop TICKING, with
+    thresholds the toy load never crosses — pinning that the elastic
+    machinery costs nothing at steady state (the same ≤5% bar vs the
+    direct arm; the record asserts no scale action fired, so the
+    measured window really is steady-state).
+
     Runs NO jax in this process — both arms' device work happens in
     the engine subprocesses, so the bench process never competes with
     them for the backend."""
@@ -1135,6 +1156,10 @@ def run_fleet_ab(args: argparse.Namespace) -> None:
     import socket
     import tempfile
 
+    from hashcat_a5_table_generator_tpu.runtime.autoscale import (
+        AutoscaleConfig,
+        Autoscaler,
+    )
     from hashcat_a5_table_generator_tpu.runtime.fleet import (
         FleetRouter,
         spawn_engines,
@@ -1245,9 +1270,33 @@ def run_fleet_ab(args: argparse.Namespace) -> None:
 
     def routed_arm() -> dict:
         d, (sock_path, eid, proc) = spawn_one("routed")
-        router = FleetRouter(place=args.fleet_place, poll_s=1.0)
+        if elastic:
+            # The §27 arm: admission control armed at bounds the toy
+            # load never hits — the cost measured is the capacity
+            # check + pending bookkeeping, not queueing.
+            router = FleetRouter(place=args.fleet_place, poll_s=1.0,
+                                 engine_capacity=64, max_pending=256)
+        else:
+            router = FleetRouter(place=args.fleet_place, poll_s=1.0)
+        scaler = None
         try:
             router.attach(sock_path, eid, proc=proc, timeout=300)
+            if elastic:
+                # Ticking for real (interval_s), thresholds the toy
+                # load cannot cross: the steady state must SCALE
+                # nothing while the loop runs — asserted below.
+                scaler = Autoscaler(
+                    router,
+                    lambda: (_ for _ in ()).throw(
+                        RuntimeError("steady-state arm must not spawn")
+                    ),
+                    AutoscaleConfig(
+                        min_engines=1, max_engines=2,
+                        scale_up_at=1e6, scale_down_at=0.0,
+                        up_window=2, down_window=10**6,
+                        cooldown_s=5.0, interval_s=0.25,
+                    ),
+                )
             events: dict = {}
 
             def submit(j):
@@ -1277,11 +1326,31 @@ def run_fleet_ab(args: argparse.Namespace) -> None:
                 submit(f"r{i}")
             jobs = [done_of(f"r{i}") for i in range(n_jobs)]
             wall = time.perf_counter() - t0
-            return {
+            out = {
                 "wall_s": wall,
                 "jobs_per_sec": n_jobs / max(wall, 1e-9),
                 "jobs": jobs,
             }
+            if scaler is not None:
+                scale = scaler.describe()
+                quarantined = router.stats()["fleet"][
+                    "engines_quarantined"
+                ]
+                if (scale["scale_ups"] or scale["scale_downs"]
+                        or scale["spawn_failures"] or quarantined):
+                    raise SystemExit(
+                        "--elastic-ab: the steady-state arm scaled, "
+                        "failed a spawn, or quarantined its engine "
+                        f"({scale}, quarantined={quarantined}) — the "
+                        "measured window is not steady-state; "
+                        "refusing to report"
+                    )
+                out["autoscale"] = {
+                    k: scale[k] for k in
+                    ("min", "max", "scale_ups", "scale_downs",
+                     "spawn_failures")
+                }
+            return out
         finally:
             router.close(shutdown_engines=True)
             shutil.rmtree(d, ignore_errors=True)
@@ -1300,7 +1369,7 @@ def run_fleet_ab(args: argparse.Namespace) -> None:
             "refusing to report timings for non-identical work"
         )
     record = {
-        "metric": "fleet_ab",
+        "metric": "elastic_ab" if elastic else "fleet_ab",
         "unit": "seconds (aggregate wall) + jobs/sec",
         "platform": args.platform or "default",
         "lanes": lanes,
@@ -1310,9 +1379,9 @@ def run_fleet_ab(args: argparse.Namespace) -> None:
         "place": args.fleet_place,
         "direct": direct,
         "routed": routed,
-        # The §25 passthrough instrument: routed wall over direct wall
-        # (1.0 = free; the acceptance bar is <= 1.05 on the §20
-        # contract).
+        # The §25 passthrough instrument (§27 reuses the bar with the
+        # elastic tier armed): routed wall over direct wall (1.0 =
+        # free; the acceptance bar is <= 1.05 on the §20 contract).
         "wall_ratio": routed["wall_s"] / max(direct["wall_s"], 1e-9),
         "overhead_pct": 100.0 * (
             routed["wall_s"] / max(direct["wall_s"], 1e-9) - 1.0
@@ -2599,7 +2668,8 @@ def main() -> None:
             2048
             if (args.superstep_ab or args.stride_ab or args.pipeline_ab
                 or args.stream_ab or args.serve_ab or args.telemetry_ab
-                or args.pack_ab or args.pair_ab or args.fleet_ab)
+                or args.pack_ab or args.pair_ab or args.fleet_ab
+                or args.elastic_ab)
             else (1 << 22)
         )
     if args.words is None:
@@ -2612,13 +2682,14 @@ def main() -> None:
         # geometry — the regime cross-job packing amortizes (PERF.md
         # §22).
         args.words = (
-            1000 if (args.serve_ab or args.fleet_ab)
+            1000 if (args.serve_ab or args.fleet_ab or args.elastic_ab)
             else 24 if args.pack_ab else 50000
         )
-    if args.fleet_ab:
-        # Routed-vs-direct serve A/B (PERF.md §25); spawns engine
-        # subprocesses — no jax in this process.
-        run_fleet_ab(args)
+    if args.fleet_ab or args.elastic_ab:
+        # Routed-vs-direct serve A/B (PERF.md §25), with the elastic
+        # tier armed on the routed arm under --elastic-ab (PERF.md
+        # §27); spawns engine subprocesses — no jax in this process.
+        run_fleet_ab(args, elastic=args.elastic_ab)
     elif args.pair_ab:
         # Pair-lane tier A/B (PERF.md §24); runs on the pinned (or
         # default) platform in-process.

@@ -1199,6 +1199,57 @@ def _build_fleet_parser() -> argparse.ArgumentParser:
                     help="checkpoint-bearing engine failures "
                          "(quarantine) are resubmitted to another "
                          "engine up to N times per job")
+    # Elastic tier (PERF.md §27): autoscaling + admission control.
+    ap.add_argument("--autoscale", metavar="MIN:MAX", default=None,
+                    help="enable the autoscaler (spawn mode only): "
+                         "keep between MIN and MAX engines, spawning "
+                         "on sustained backlog and draining+reaping "
+                         "idle or quarantined ones (--engines N is "
+                         "the initial size, clamped into [MIN,MAX])")
+    ap.add_argument("--scale-up-at", type=float, default=2.0,
+                    metavar="F",
+                    help="autoscale: backlog per engine (routed + "
+                         "queued + building + router-pending) that, "
+                         "sustained over the hysteresis window, "
+                         "spawns an engine")
+    ap.add_argument("--scale-down-at", type=float, default=0.25,
+                    metavar="F",
+                    help="autoscale: backlog per engine below which "
+                         "(sustained) the idlest engine drains and "
+                         "reaps")
+    ap.add_argument("--scale-window", type=int, default=2, metavar="N",
+                    help="autoscale hysteresis: consecutive "
+                         "observations over/under threshold before "
+                         "acting (scale-down uses 2N — shrinking is "
+                         "the cheaper mistake to delay)")
+    ap.add_argument("--scale-cooldown", type=float, default=10.0,
+                    metavar="S",
+                    help="autoscale: seconds between scale actions "
+                         "(flap damping; failed spawns retry after "
+                         "this too)")
+    ap.add_argument("--engine-capacity", type=int, default=32,
+                    metavar="N",
+                    help="admission control: routed jobs one engine "
+                         "accepts before new submits queue on the "
+                         "router (0 = unbounded, the pre-elastic "
+                         "behavior)")
+    ap.add_argument("--max-pending", type=int, default=256, metavar="N",
+                    help="admission control: the BOUNDED router-side "
+                         "pending queue; past it, submits are "
+                         "rejected typed ({\"error\": \"overloaded\", "
+                         "\"retry_after_s\": ...}) per --shed-policy")
+    ap.add_argument("--per-tenant", type=int, default=0, metavar="N",
+                    help="admission control: max unsettled jobs per "
+                         "submit-doc 'tenant' (0 = off; docs without "
+                         "a tenant are exempt)")
+    ap.add_argument("--shed-policy",
+                    choices=("reject", "queue", "oldest"),
+                    default="reject",
+                    help="what a full pending queue does to a new "
+                         "submit: reject it typed (default), shed the "
+                         "oldest pending job (deadline-carrying jobs "
+                         "first) to admit it, or queue unboundedly "
+                         "(the legacy escape hatch)")
     ap.add_argument("--engine-dir", metavar="DIR", default=None,
                     help="spawn mode: directory for engine sockets "
                          "(default: a temp dir)")
@@ -1242,10 +1293,50 @@ def _run_fleet(argv: Sequence[str]) -> int:
         schema_cache=args.schema_cache,
         schema_cache_max_mb=args.schema_cache_max_mb,
     )
+    autoscale = None
+    scale_cfg = None
+    if args.autoscale is not None:
+        if not args.engines.isdigit():
+            raise SystemExit(
+                f"{PROG}: --autoscale needs spawn mode (--engines N); "
+                "attached engines' lifetimes belong to their owners"
+            )
+        lo, _, hi = args.autoscale.partition(":")
+        try:
+            autoscale = (int(lo), int(hi))
+        except ValueError:
+            raise SystemExit(
+                f"{PROG}: --autoscale wants MIN:MAX integers, got "
+                f"{args.autoscale!r}"
+            ) from None
+        from .runtime.autoscale import AutoscaleConfig
+
+        # Validate the WHOLE elastic config before any engine spawns:
+        # a bad bound or threshold pair must fail the command cleanly,
+        # not traceback after processes are already running.
+        try:
+            scale_cfg = AutoscaleConfig(
+                min_engines=autoscale[0],
+                max_engines=autoscale[1],
+                scale_up_at=args.scale_up_at,
+                scale_down_at=args.scale_down_at,
+                up_window=args.scale_window,
+                down_window=2 * args.scale_window,
+                cooldown_s=args.scale_cooldown,
+                interval_s=max(args.poll, 0.5)
+                if args.poll > 0 else 1.0,
+            )
+        except ValueError as exc:
+            raise SystemExit(f"{PROG}: --autoscale: {exc}") from None
     router = FleetRouter(place=args.place, poll_s=args.poll,
                          replay_budget=args.replay_budget,
-                         defaults=defaults)
+                         defaults=defaults,
+                         engine_capacity=args.engine_capacity,
+                         max_pending=args.max_pending,
+                         per_tenant=args.per_tenant,
+                         shed_policy=args.shed_policy)
     spawned = False
+    scaler = None
     try:
         if args.engines.isdigit():
             spawned = True
@@ -1269,17 +1360,38 @@ def _run_fleet(argv: Sequence[str]) -> int:
             if args.schema_cache_max_mb is not None:
                 eng_args += ["--schema-cache-max-mb",
                              str(args.schema_cache_max_mb)]
-            specs = spawn_engines(int(args.engines), eng_dir,
-                                  engine_args=eng_args)
+            n0 = int(args.engines)
+            if autoscale is not None:
+                n0 = max(autoscale[0], min(n0, autoscale[1]))
+            specs = spawn_engines(n0, eng_dir, engine_args=eng_args)
             for sock_path, eid, proc in specs:
                 router.attach(sock_path, eid, proc=proc)
+            if scale_cfg is not None:
+                import itertools as _it
+
+                from .runtime.autoscale import Autoscaler
+
+                counter = _it.count(n0)
+
+                def _spawn_one():
+                    (spec,) = spawn_engines(
+                        1, eng_dir, engine_args=eng_args,
+                        start_index=next(counter),
+                    )
+                    return spec
+
+                scaler = Autoscaler(router, _spawn_one, scale_cfg)
         else:
             for ep in args.engines.split(","):
                 ep = ep.strip()
                 if ep:
                     router.attach(ep)
         n = len(router.engines())
-        print(f"{PROG}: fleet of {n} engine(s), routing on "
+        elastic = (
+            f", elastic {scaler.cfg.min_engines}:"
+            f"{scaler.cfg.max_engines}" if scaler is not None else ""
+        )
+        print(f"{PROG}: fleet of {n} engine(s){elastic}, routing on "
               f"{args.socket or 'stdin'} (JSONL; op=shutdown ends)",
               file=sys.stderr)
         if args.socket:

@@ -239,6 +239,45 @@ def check_wire_version(doc: Dict) -> None:
         )
 
 
+#: Fields a checkpoint wire document must carry to be resumable; the
+#: fleet router validates these at CAPTURE time (PERF.md §27) so a
+#: malformed document fails the pause/drain that produced it with a
+#: typed error instead of exploding later at crash-replay resubmit.
+_WIRE_REQUIRED = ("fingerprint", "cursor", "n_emitted", "n_hits",
+                  "hits", "wall_s")
+
+
+def validate_checkpoint_doc(doc: object) -> Dict:
+    """Structural validation of a checkpoint WIRE document without
+    materializing it: the wire-version major is this build's
+    (:func:`check_wire_version`) and every resumable field is present
+    (fingerprint, a word/rank cursor, the counters, the hit list).
+    Returns the doc (typed as a dict) so capture sites can hold it;
+    raises :class:`CheckpointCorrupt` / :class:`CheckpointWireIncompatible`
+    on anything a later ``state_from_doc`` would choke on."""
+    if not isinstance(doc, dict):
+        raise CheckpointCorrupt(
+            f"checkpoint document must be a JSON object, got "
+            f"{type(doc).__name__}"
+        )
+    check_wire_version(doc)
+    missing = [k for k in _WIRE_REQUIRED if k not in doc]
+    if missing:
+        raise CheckpointCorrupt(
+            f"checkpoint document is missing required field(s) "
+            f"{', '.join(missing)} — refusing to hold an unresumable "
+            "replay origin"
+        )
+    cursor = doc["cursor"]
+    if not (isinstance(cursor, dict) and "word" in cursor
+            and "rank" in cursor):
+        raise CheckpointCorrupt(
+            "checkpoint cursor must be an object with 'word' and "
+            f"'rank', got {cursor!r}"
+        )
+    return doc
+
+
 def state_from_doc(doc: Dict) -> CheckpointState:
     """Inverse of :func:`state_to_doc` (no fingerprint validation here —
     the sweep's ``_load_state`` / :func:`load_checkpoint` own that;

@@ -330,6 +330,7 @@ class Engine:
         self._step0 = step_cache_stats()
         self._schema0 = schema_cache_stats()
         self._packed0 = self._packed_counters()
+        self._ladder0 = self._ladder_counters()
         self._thread: Optional[threading.Thread] = None
         if auto:
             self._thread = threading.Thread(
@@ -350,6 +351,13 @@ class Engine:
         return {
             k: int(telemetry.counter(f"engine.packed_{k}").value)
             for k in ("dispatches", "lanes_occupied", "lanes_total")
+        }
+
+    @staticmethod
+    def _ladder_counters() -> Dict[str, int]:
+        return {
+            k: int(telemetry.counter(f"engine.{k}").value)
+            for k in ("group_demotions", "job_restarts")
         }
 
     # -- tenant surface ------------------------------------------------
@@ -444,6 +452,7 @@ class Engine:
                 )
         steps = _stats_delta(self._step0, step_cache_stats())
         packed = _stats_delta(self._packed0, self._packed_counters())
+        ladder = _stats_delta(self._ladder0, self._ladder_counters())
         return {
             **counts,
             "jobs_active": active,
@@ -475,6 +484,13 @@ class Engine:
             "program_cache_hits": steps.get("hits", 0),
             "schema_cache": _stats_delta(self._schema0,
                                          schema_cache_stats()),
+            # The fleet health ladder's strain signals (PERF.md §27):
+            # recovery-ladder activity since THIS engine started — a
+            # router scraping rising deltas degrades (and eventually
+            # quarantines) the engine instead of placing fresh tenants
+            # onto failing hardware.
+            "group_demotions": ladder.get("group_demotions", 0),
+            "job_restarts": ladder.get("job_restarts", 0),
             # Cross-job packing (PERF.md §22): fused groups currently
             # dispatching, packed dispatches since engine start, and
             # the aggregate fill ratio (occupied / total lanes across

@@ -35,6 +35,12 @@ new failure paths to add theirs):
 ``checkpoint.write``      before a checkpoint write (crash-before-write)
 ``serve.client``          per JSONL op handled by a serve session
 ``device.init``           at launch-builder entry (accelerator-init flake)
+``router.place``          in the fleet router's dispatch (placement +
+                          submit-over-the-wire) — a failed placement
+``link.send``             before each engine-link socket write — torn
+                          engine connection mid-op
+``engine.spawn``          in the autoscaler's scale-up (PERF.md §27) —
+                          a failed engine spawn backs off to the next tick
 ========================  ===================================================
 
 Arming: ``A5GEN_FAULTS=<spec>`` (read through ``runtime/env.py``),
@@ -115,6 +121,9 @@ POINTS = frozenset({
     "checkpoint.write",
     "serve.client",
     "device.init",
+    "router.place",
+    "link.send",
+    "engine.spawn",
 })
 
 

@@ -51,6 +51,36 @@ checkpoint path.
 The router holds no device state and runs no jax: it is JSON, sockets
 and tables, so one router fronts many engine processes without
 competing for the accelerator.
+
+The elastic half (PERF.md §27) makes the fleet overload-safe and
+self-managing:
+
+* **Admission control + backpressure**: placements are gated by
+  ``engine_capacity`` (routed jobs per engine); jobs that cannot place
+  ride a BOUNDED router-side pending queue (``max_pending``) and
+  dispatch as capacity frees.  Past the bound, ``submit`` fails with
+  the typed overload rejection (:class:`FleetOverloaded` — the JSONL
+  front-end renders ``{"error": "overloaded", "retry_after_s": ...}``)
+  instead of queueing silently; ``shed_policy`` picks the degradation
+  mode (``reject`` new arrivals, shed the ``oldest`` pending job, or
+  ``queue`` unboundedly — the legacy escape hatch).  Jobs carrying a
+  ``deadline_s`` are shed first (an expired deadline is already a
+  failed contract), and ``per_tenant`` caps one tenant's unsettled
+  jobs so a single client cannot monopolize the fleet.
+* **Health ladder + circuit breaking**: each engine walks ``healthy →
+  degraded → quarantined`` on scrape strain — slow scrapes, rising
+  ``group_demotions``/``job_restarts`` deltas (the §23 recovery ladder
+  leaking through an engine's stats), failed scrapes, and repeated
+  checkpoint-bearing job failures (quarantine resubmissions).  A
+  degraded engine places last; a QUARANTINED engine takes no
+  placements at all and is drained + replaced by the autoscaler —
+  the per-engine recovery ladder lifted to the fleet.
+* **Autoscaling** (``runtime/autoscale.py``): a router-owned control
+  loop spawns engines when sustained load crosses ``scale_up_at`` and
+  drains + reaps idle ones below ``scale_down_at``, with hysteresis
+  windows and a cooldown so churn cannot flap — spawn rides
+  :func:`spawn_engines`, drain rides the PR 13 drain path, so
+  placement, affinity and crash-replay are untouched.
 """
 
 from __future__ import annotations
@@ -64,9 +94,12 @@ import subprocess
 import sys
 import threading
 import time
+import zlib
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from . import faults as faults_mod
 from . import telemetry
+from .checkpoint import validate_checkpoint_doc
 from .fuse import static_affinity_token
 
 #: Module path engines are spawned from (``python -m <this>``).
@@ -76,6 +109,52 @@ _PACKAGE = __name__.rsplit(".", 2)[0]
 class FleetError(RuntimeError):
     """A fleet-level operation failed (no live engine, an engine
     rejected a routed document, an ack timed out)."""
+
+
+class FleetOverloaded(FleetError):
+    """The typed overload rejection (PERF.md §27): the router's bounded
+    admission surface is full (pending queue at ``max_pending``, or a
+    tenant over its in-flight cap).  Carries ``retry_after_s`` — the
+    router's backoff estimate — so clients back off instead of
+    hammering; the JSONL front-end renders it as
+    ``{"event": "error", "error": "overloaded", "retry_after_s": ...}``."""
+
+    def __init__(self, reason: str, retry_after_s: float) -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
+
+    def event(self, jid: "Optional[str]" = None) -> dict:
+        ev = {
+            "event": "error", "error": "overloaded",
+            "reason": self.reason,
+            "retry_after_s": self.retry_after_s,
+        }
+        if jid is not None:
+            ev["id"] = jid
+        return ev
+
+
+#: The health ladder's states (PERF.md §27), in degradation order.
+HEALTH_STATES = ("healthy", "degraded", "quarantined")
+
+
+class _NoCapacity(FleetError):
+    """Internal: every placeable engine is at ``engine_capacity`` —
+    the caller queues (admission control) instead of failing loudly."""
+
+
+def scraped_load(scrape: dict) -> int:
+    """An engine's internal load from its ``stats`` scrape — the ONE
+    definition placement (:meth:`FleetRouter._load_score`) and the
+    autoscaler's backlog signal share, so they can never disagree
+    about what "loaded" means."""
+    return (
+        scrape.get("jobs_runnable", scrape.get("jobs_active", 0))
+        + scrape.get("jobs_staged", 0)
+        + scrape.get("jobs_building", 0)
+        + scrape.get("jobs_queued", 0)
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -113,6 +192,25 @@ class EngineLink:
         self.misses = 0
         #: router-level job ids currently routed here.
         self.routed: set = set()
+        #: health-ladder state (PERF.md §27): ``healthy`` places
+        #: normally, ``degraded`` places last, ``quarantined`` never
+        #: places (the autoscaler drains + replaces it).  All ladder
+        #: fields are written by the ROUTER (scrape/event paths), never
+        #: by the link's own threads.
+        self.health = "healthy"
+        #: consecutive strained scrapes (slow/failed scrape, rising
+        #: recovery-ladder deltas) — degrade/quarantine input.
+        self.strikes = 0
+        #: consecutive clean scrapes while degraded — recovery input.
+        self.clean = 0
+        #: checkpoint-bearing job failures off this engine (quarantine
+        #: resubmissions) — the repeated-crash-replay ladder input.
+        self.replay_fails = 0
+        #: last scrape's recovery-ladder counters (delta base).
+        self.ladder_prev: dict = {}
+        #: next scheduled poll tick (monotonic; per-engine jitter so N
+        #: engines never stampede one scrape tick).
+        self.next_poll = 0.0
         self._sock = sock
         self._fin = sock.makefile("r", encoding="utf-8")
         self._fout = sock.makefile("w", encoding="utf-8")
@@ -175,6 +273,12 @@ class EngineLink:
     # -- wire ----------------------------------------------------------
 
     def send(self, doc: dict) -> None:
+        # The torn-engine-connection seam (PERF.md §27): an injected
+        # error here fails the op exactly like a mid-write socket tear —
+        # typed FleetError to the caller; the scrape path's failures
+        # additionally feed the health ladder.
+        if faults_mod.ACTIVE is not None:
+            faults_mod.ACTIVE.fire("link.send")
         with self._wlock:
             self._fout.write(json.dumps(doc) + "\n")
             self._fout.flush()
@@ -198,7 +302,7 @@ class EngineLink:
             try:
                 self.send(doc)
                 ev = q.get(timeout=timeout)
-            except (OSError, ValueError) as exc:
+            except (OSError, ValueError, faults_mod.FaultError) as exc:
                 raise FleetError(
                     f"engine {self.engine_id}: send failed ({exc})"
                 ) from exc
@@ -225,6 +329,12 @@ class EngineLink:
         reused past an error)."""
         with self._health_lock:
             try:
+                # The same torn-connection seam as :meth:`send`, on the
+                # dedicated health stream: an injected failure here is a
+                # failed scrape — retried once in-poll, then a watchdog
+                # miss plus a health-ladder strike (PERF.md §27).
+                if faults_mod.ACTIVE is not None:
+                    faults_mod.ACTIVE.fire("link.send")
                 if self._health_file is None:
                     s = socket.socket(socket.AF_UNIX,
                                       socket.SOCK_STREAM)
@@ -241,7 +351,7 @@ class EngineLink:
                 if not line:
                     raise OSError("health connection EOF")
                 return json.loads(line)
-            except (OSError, ValueError) as exc:
+            except (OSError, ValueError, faults_mod.FaultError) as exc:
                 self._drop_health()
                 raise FleetError(
                     f"engine {self.engine_id}: health scrape failed "
@@ -348,6 +458,14 @@ class RoutedJob:
         self.emit = emit
         self.link: Optional[EngineLink] = None
         self.n_forwarded = 0
+        #: admission-control identity (PERF.md §27): the submit doc's
+        #: ``tenant`` field; jobs without one share the anonymous
+        #: tenant and are exempt from the per-tenant cap.
+        self.tenant: Optional[str] = None
+        #: absolute shed deadline (monotonic) from the doc's
+        #: ``deadline_s`` — deadline-carrying jobs are shed FIRST under
+        #: overload, and an expired pending job sheds at the next pump.
+        self.deadline: Optional[float] = None
         #: last router-held checkpoint DOC (submit-time migrate-in,
         #: pause events, quarantine events) — the crash-replay origin.
         self.checkpoint: Optional[dict] = None
@@ -357,6 +475,14 @@ class RoutedJob:
         #: cancelled (candidates) event re-places instead of
         #: forwarding downstream.
         self.migrating = False
+        #: deferred telemetry counter name for a requeued job that
+        #: parked on the pending queue before re-placing.
+        self.requeue_counter: Optional[str] = None
+        #: popped from the pending queue by the requeue worker, its
+        #: dispatch in flight: cancel/resume must neither settle nor
+        #: re-admit a job in this window (set/cleared under the
+        #: router's lock).
+        self.claimed = False
         self.target: Optional[str] = None
         #: the CURRENT placement's submit request has been acked by
         #: the engine.  False while a dispatch is in flight — that
@@ -390,15 +516,42 @@ class FleetRouter:
     crash-replay.  ``defaults``: the SweepConfig the ENGINES were
     started with — used only to fill doc-level gaps when computing
     affinity tokens, so attach-mode routers should pass the engines'
-    flags (a mismatch degrades placement, never correctness)."""
+    flags (a mismatch degrades placement, never correctness).
+
+    Elastic knobs (PERF.md §27).  ``engine_capacity``: routed jobs one
+    engine accepts before placements queue (0 = unbounded — the PR 13
+    behavior); ``max_pending``: the bounded router-side pending queue;
+    ``per_tenant``: in-flight cap per submit-doc ``tenant`` (0 = off);
+    ``shed_policy``: what a full pending queue does to a new submit —
+    ``reject`` it typed (default), shed the ``oldest`` pending job to
+    admit it, or ``queue`` unboundedly (the legacy escape hatch; the
+    overload-semantics rule in CONTRIBUTING says don't).  Health
+    ladder: ``degrade_after`` consecutive strained scrapes mark an
+    engine degraded (places last), ``quarantine_after`` mark it
+    quarantined (never places; the autoscaler drains + replaces),
+    ``recover_after`` clean scrapes walk degraded back to healthy, and
+    ``quarantine_replays`` checkpoint-bearing job failures quarantine
+    the engine outright.  ``poll_jitter``: per-engine fraction of
+    ``poll_s`` each engine's scrape tick is deterministically offset
+    by, so N engines never stampede one tick."""
 
     def __init__(self, *, place: str = "affinity",
                  replay_budget: int = 1, poll_s: float = 2.0,
                  poll_misses: int = 3, defaults=None,
-                 control_timeout: float = 120.0) -> None:
+                 control_timeout: float = 120.0,
+                 engine_capacity: int = 0, max_pending: int = 256,
+                 per_tenant: int = 0, shed_policy: str = "reject",
+                 degrade_after: int = 1, quarantine_after: int = 3,
+                 recover_after: int = 2, quarantine_replays: int = 2,
+                 poll_jitter: float = 0.25) -> None:
         if place not in ("affinity", "round-robin"):
             raise ValueError(
                 f"place must be affinity|round-robin, got {place!r}"
+            )
+        if shed_policy not in ("reject", "queue", "oldest"):
+            raise ValueError(
+                f"shed_policy must be reject|queue|oldest, got "
+                f"{shed_policy!r}"
             )
         self._place = place
         self._replay_budget = int(replay_budget)
@@ -406,12 +559,30 @@ class FleetRouter:
         self._poll_misses = int(poll_misses)
         self._defaults = defaults
         self._control_timeout = float(control_timeout)
+        self._engine_capacity = int(engine_capacity)
+        self._max_pending = int(max_pending)
+        self._per_tenant = int(per_tenant)
+        self._shed_policy = shed_policy
+        self._degrade_after = max(1, int(degrade_after))
+        self._quarantine_after = max(1, int(quarantine_after))
+        self._recover_after = max(1, int(recover_after))
+        self._quarantine_replays = max(1, int(quarantine_replays))
+        self._poll_jitter = max(0.0, float(poll_jitter))
         self._links: List[EngineLink] = []
         self._jobs: Dict[str, RoutedJob] = {}
+        #: admission-queued jobs (FIFO), bounded by ``max_pending``
+        #: unless ``shed_policy='queue'``; mutated under ``_lock``.
+        self._pending: List[RoutedJob] = []
+        #: unsettled jobs per explicit tenant (the ``per_tenant``
+        #: in-flight cap's ledger); mutated under ``_lock``.
+        self._tenant_counts: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._ids = itertools.count()
         self._rr = itertools.count()
         self._closed = False
+        #: the attached Autoscaler (None = fixed pool); set once by
+        #: ``Autoscaler.bind`` before any scaling runs.
+        self.autoscaler = None
         #: fleet counters report as since-THIS-router deltas (the
         #: Engine.stats() convention): the registry is process-wide,
         #: and an embedder running several routers (tests, benches)
@@ -419,7 +590,9 @@ class FleetRouter:
         self._counters0 = {
             name: int(telemetry.counter(f"fleet.{name}").value)
             for name in ("engine_deaths", "jobs_replayed",
-                         "migrations")
+                         "migrations", "jobs_rejected", "jobs_shed",
+                         "jobs_queued", "scrape_retries",
+                         "engines_quarantined", "engines_detached")
         }
         self._poll_stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
@@ -456,14 +629,54 @@ class FleetRouter:
             index=index, on_event=self._on_job_event,
             on_death=self._on_death,
         )
+        link.next_poll = time.monotonic() + self._jitter_of(link)
         with self._lock:
             self._links.append(link)
         self._scrape(link)
+        # Fresh capacity: admission-queued jobs can place now.
+        self._schedule_pump()
         return link
+
+    def detach(self, engine_id: str, *, shutdown: bool = True,
+               timeout: float = 30.0) -> None:
+        """Remove one engine from the pool — the autoscaler's reap
+        half (PERF.md §27).  The engine must be EMPTY (drained, or
+        dead): detaching with jobs still routed raises loudly — drain
+        first.  ``shutdown`` sends the engine its shutdown op and
+        reaps a spawned process."""
+        link = self._resolve(engine_id)
+        with self._lock:
+            if link.routed:
+                raise FleetError(
+                    f"engine {engine_id!r} still runs "
+                    f"{len(link.routed)} job(s) — drain it before "
+                    "detaching"
+                )
+            self._links.remove(link)
+        link._closing = True
+        if shutdown and link.alive:
+            try:
+                link.request({"op": "shutdown"}, timeout=timeout)
+            except FleetError:
+                pass
+        link.close()
+        if shutdown and link.proc is not None:
+            try:
+                link.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                link.proc.kill()
+                link.proc.wait()
+        telemetry.counter("fleet.engines_detached").add(1)
 
     def engines(self) -> List[EngineLink]:
         with self._lock:
             return list(self._links)
+
+    def pending_depth(self) -> int:
+        """Admission-queued jobs right now (the autoscaler's queue-
+        depth signal)."""
+        with self._lock:
+            return len(self._pending)
 
     def _resolve(self, engine_id: str) -> EngineLink:
         with self._lock:
@@ -526,12 +739,12 @@ class FleetRouter:
         return toks
 
     def _load_score(self, link: EngineLink) -> tuple:
-        s = link.scrape
         return (
+            # Circuit half-open: a degraded engine places only when
+            # every healthy one loses the tie (PERF.md §27).
+            0 if link.health == "healthy" else 1,
             len(link.routed),
-            s.get("jobs_runnable", s.get("jobs_active", 0))
-            + s.get("jobs_staged", 0) + s.get("jobs_building", 0)
-            + s.get("jobs_queued", 0),
+            scraped_load(link.scrape),
             link.index,
         )
 
@@ -539,11 +752,36 @@ class FleetRouter:
               exclude: Sequence[EngineLink] = ()) -> EngineLink:
         with self._lock:
             live = [
-                l for l in self._links if l.alive and not l.draining
+                l for l in self._links
+                if l.alive and not l.draining
+                and l.health != "quarantined"
             ]
-        pool = [l for l in live if l not in exclude] or live
-        if not pool:
+            any_alive = any(l.alive for l in self._links)
+        if not live:
+            if any_alive:
+                # Every alive engine is quarantined or draining:
+                # capacity is being replaced (the autoscaler's
+                # replacement-first discipline), so this is OVERLOAD,
+                # not absence — queue bounded / reject typed, never
+                # an untyped hard failure mid-degradation.
+                raise _NoCapacity(
+                    "every live engine is quarantined or draining "
+                    "(replacement capacity is on the way)"
+                )
             raise FleetError("no live engine to place the job on")
+        pool = [l for l in live if l not in exclude] or live
+        if self._engine_capacity > 0:
+            with self._lock:
+                fits = [
+                    l for l in pool
+                    if len(l.routed) < self._engine_capacity
+                ]
+            if not fits:
+                raise _NoCapacity(
+                    "every live engine is at engine_capacity "
+                    f"({self._engine_capacity})"
+                )
+            pool = fits
         if self._place == "round-robin":
             return pool[next(self._rr) % len(pool)]
         matches = [
@@ -559,37 +797,179 @@ class FleetRouter:
         forward downstream.  The document passes through UNCHANGED to
         the placed engine (clients keep their serve contract), except
         the router strips and holds a migrate-in ``checkpoint`` as the
-        job's replay origin and re-injects it on dispatch."""
+        job's replay origin and re-injects it on dispatch.
+
+        Admission control (PERF.md §27): a submit that cannot place
+        (every engine at ``engine_capacity``) queues on the bounded
+        pending list and the ack carries ``"queued": true``; a FULL
+        pending list rejects typed (:class:`FleetOverloaded`) — or, under
+        ``shed_policy='oldest'``, sheds the oldest pending job (deadline
+        carriers first) to admit this one.  A tenant over its
+        ``per_tenant`` in-flight cap rejects typed without queueing."""
         if self._closed:
             raise FleetError("router is shut down")
         jid = doc.get("id") or f"fleet-{next(self._ids)}"
         kind = "crack" if (
             "digests" in doc or "digest_list" in doc
         ) else "candidates"
+        ck = doc.get("checkpoint")
+        if ck is not None:
+            # Capture-time validation (PERF.md §27): a malformed
+            # migrate-in checkpoint fails the SUBMIT typed, not the
+            # eventual crash-replay resubmit.
+            validate_checkpoint_doc(ck)
         sdoc = {k: v for k, v in doc.items()
                 if k not in ("checkpoint", "replay_mute")}
         sdoc["id"] = jid
         sdoc["op"] = "submit"
         job = RoutedJob(jid, kind, sdoc, self._doc_token(sdoc), emit)
-        job.checkpoint = doc.get("checkpoint")
+        job.checkpoint = ck
         job.n_forwarded = int(doc.get("replay_mute", 0))
+        tenant = doc.get("tenant")
+        job.tenant = str(tenant) if tenant is not None else None
+        if doc.get("deadline_s") is not None:
+            job.deadline = time.monotonic() + float(doc["deadline_s"])
         with self._lock:
             prev = self._jobs.get(jid)
             if prev is not None and prev.unsettled:
                 raise FleetError(f"job id {jid!r} is still active")
+            if (
+                self._per_tenant > 0 and job.tenant is not None
+                and self._tenant_counts.get(job.tenant, 0)
+                >= self._per_tenant
+            ):
+                telemetry.counter("fleet.jobs_rejected").add(1)
+                raise FleetOverloaded(
+                    f"tenant {job.tenant!r} has "
+                    f"{self._tenant_counts[job.tenant]} jobs in "
+                    f"flight (per_tenant cap {self._per_tenant})",
+                    self._retry_after_locked(),
+                )
             self._jobs[jid] = job
+            if job.tenant is not None:
+                self._tenant_counts[job.tenant] = \
+                    self._tenant_counts.get(job.tenant, 0) + 1
         try:
             ack = dict(self._dispatch(job))
-        except FleetError:
-            # Never admitted anywhere: drop the table entry so the
-            # client can retry under the same id.
-            with self._lock:
-                if self._jobs.get(jid) is job:
-                    del self._jobs[jid]
+        except _NoCapacity:
+            ack = self._enqueue_pending(job)
+        except (FleetError, faults_mod.FaultError):
+            # Never admitted anywhere (engine rejection, or an injected
+            # router.place fault): drop the table entry so the client
+            # can retry under the same id.
+            self._forget(job)
             raise
         ack["engine"] = job.link.engine_id if job.link else None
         telemetry.counter("fleet.jobs_routed").add(1)
         return ack
+
+    def _forget(self, job: RoutedJob) -> None:
+        """Unregister a job that was never admitted anywhere (rejected
+        or failed before placement) so the client can retry its id."""
+        with self._lock:
+            if self._jobs.get(job.id) is job:
+                del self._jobs[job.id]
+            self._tenant_release_locked(job)
+
+    def _tenant_release_locked(self, job: RoutedJob) -> None:
+        """Release ``job``'s per-tenant in-flight slot (caller holds
+        ``_lock``) — the ONE decrement both the never-admitted and the
+        terminal-settle paths share."""
+        if job.tenant is not None and job.tenant in \
+                self._tenant_counts:
+            self._tenant_counts[job.tenant] -= 1
+            if self._tenant_counts[job.tenant] <= 0:
+                del self._tenant_counts[job.tenant]
+
+    def _retry_after(self) -> float:
+        """The overload rejection's backoff estimate: one poll cadence
+        scaled by how deep the backlog stands per live engine — coarse,
+        monotone in load, and cheap (no scrape)."""
+        with self._lock:
+            return self._retry_after_locked()
+
+    def _enqueue_pending(self, job: RoutedJob, *,
+                         forget_on_reject: bool = True) -> dict:
+        """Queue one admitted-but-unplaceable job on the bounded
+        pending list; returns the synthesized ``accepted`` ack.  A full
+        list applies ``shed_policy`` (PERF.md §27): ``oldest`` evicts a
+        pending job (deadline carriers first) to admit the newcomer,
+        ``reject`` refuses the newcomer typed, ``queue`` grows
+        unboundedly (the legacy escape hatch).  ``forget_on_reject``:
+        a rejected fresh SUBMIT drops its table entry (the id stays
+        retryable); a rejected RESUME must keep the job — it is
+        already admitted, paused, and holding its checkpoint."""
+        victim: Optional[RoutedJob] = None
+        overloaded: Optional[FleetOverloaded] = None
+        with self._lock:
+            if (
+                len(self._pending) >= self._max_pending
+                and self._shed_policy == "oldest"
+            ):
+                victim = self._shed_victim_locked()
+            if (
+                len(self._pending) >= self._max_pending
+                and self._shed_policy != "queue"
+            ):
+                overloaded = FleetOverloaded(
+                    f"router pending queue is full ({self._max_pending}"
+                    " jobs; every engine at capacity)",
+                    self._retry_after_locked(),
+                )
+            else:
+                self._pending.append(job)
+        if victim is not None:
+            self._shed(victim, "pending queue full: oldest-policy "
+                               "eviction for a newer arrival")
+        if overloaded is not None:
+            if forget_on_reject:
+                self._forget(job)
+            telemetry.counter("fleet.jobs_rejected").add(1)
+            raise overloaded
+        telemetry.counter("fleet.jobs_queued").add(1)
+        return {"id": job.id, "event": "accepted", "kind": job.kind,
+                "queued": True}
+
+    def _retry_after_locked(self) -> float:
+        depth = len(self._pending)
+        alive = sum(
+            1 for l in self._links
+            if l.alive and not l.draining
+            and l.health != "quarantined"
+        )
+        base = self._poll_s if self._poll_s > 0 else 1.0
+        return round(max(0.5, base) * (1.0 + depth / max(1, alive)), 3)
+
+    def _shed_victim_locked(self) -> Optional[RoutedJob]:
+        """Pick (and remove) the pending job to shed: deadline
+        carriers first — soonest deadline — then the oldest arrival
+        (PERF.md §27: a job that declared a deadline already agreed
+        staleness is failure; shedding it costs the least)."""
+        if not self._pending:
+            return None
+        deadline_jobs = [
+            j for j in self._pending if j.deadline is not None
+        ]
+        if deadline_jobs:
+            victim = min(deadline_jobs, key=lambda j: j.deadline)
+            self._pending.remove(victim)
+            return victim
+        return self._pending.pop(0)
+
+    def _shed(self, job: RoutedJob, reason: str) -> None:
+        """Fail one shed job downstream with the typed overload event
+        (checkpoint attached when the router holds one — a shed
+        migrate-in loses no progress)."""
+        telemetry.counter("fleet.jobs_shed").add(1)
+        ev = {
+            "id": job.id, "event": "failed", "error": "overloaded",
+            "reason": reason,
+            "retry_after_s": self._retry_after(),
+        }
+        if job.checkpoint is not None:
+            ev["checkpoint"] = job.checkpoint
+        self._forward(job, ev)
+        self._settle(job, "failed")
 
     def pause(self, jid: str) -> None:
         job = self._job(jid)
@@ -600,11 +980,32 @@ class FleetRouter:
     def resume(self, jid: str) -> dict:
         """Re-place a paused job from its router-held checkpoint;
         returns the ``accepted`` event (``resumed`` flagged) to
-        forward downstream."""
+        forward downstream.  Under admission control a resume with no
+        free capacity queues like a submit would."""
         job = self._job(jid)
-        if job.state != "paused":
+        with self._lock:
+            # ONE atomic read of the admission state: a state check
+            # outside this lock could interleave with the pump
+            # completing a queued resume's dispatch (queued→routed)
+            # and let a retry double-dispatch the running id.
+            queued = job in self._pending or job.claimed
+            paused = job.state == "paused"
+        if queued:
+            # Already admission-queued by an earlier resume (or being
+            # dispatched by the pump right now): the retry is
+            # idempotent — never a second pending entry or a second
+            # dispatch of a running id.
+            return {"id": jid, "event": "accepted", "kind": job.kind,
+                    "queued": True, "resumed": True}
+        if not paused:
             raise FleetError(f"job {jid!r} is {job.state}, not paused")
-        ack = dict(self._dispatch(job))
+        try:
+            ack = dict(self._dispatch(job))
+        except _NoCapacity:
+            # An overloaded-too reject must NOT forget an already-
+            # admitted job: it stays paused, checkpoint intact, and
+            # the client retries the resume after retry_after_s.
+            ack = self._enqueue_pending(job, forget_on_reject=False)
         ack["resumed"] = True
         return ack
 
@@ -613,9 +1014,18 @@ class FleetRouter:
         if job.state == "routed" and job.link is not None:
             job.link.send({"op": "cancel", "id": jid})
             return
-        if job.state == "paused":
-            # Nothing runs engine-side: settle here and tell the
-            # client ourselves.
+        with self._lock:
+            # Claim-by-removal: once this cancel takes the job OFF the
+            # pending list, the pump can never pop it; conversely a
+            # job the pump already claimed is dispatch-in-flight and
+            # must be cancelled engine-side once it binds (retry).
+            claimed = job.claimed
+            queued = job in self._pending and not claimed
+            if queued:
+                self._pending.remove(job)
+        if (job.state == "paused" and not claimed) or queued:
+            # Nothing runs engine-side (paused, or still admission-
+            # queued): settle here and tell the client ourselves.
             self._forward(job, {"id": jid, "event": "cancelled"})
             self._settle(job, "cancelled")
             return
@@ -703,6 +1113,7 @@ class FleetRouter:
                 "endpoint": link.endpoint,
                 "alive": link.alive,
                 "draining": link.draining,
+                "health": link.health,
                 "jobs_routed": len(link.routed),
                 "resident_groups": sorted(
                     self._resident_tokens(link)
@@ -713,21 +1124,32 @@ class FleetRouter:
             unsettled = sum(
                 1 for j in self._jobs.values() if j.unsettled
             )
+            pending = len(self._pending)
+        fleet = {
+            "place": self._place,
+            "engines": members,
+            "engines_alive": sum(1 for m in members if m["alive"]),
+            "jobs_tracked": unsettled,
+            # The admission surface (PERF.md §27): queued depth and
+            # the bounds the overload semantics enforce.
+            "jobs_pending": pending,
+            "max_pending": self._max_pending,
+            "engine_capacity": self._engine_capacity,
+            "shed_policy": self._shed_policy,
+            **{
+                name: int(
+                    telemetry.counter(f"fleet.{name}").value
+                ) - base
+                for name, base in self._counters0.items()
+            },
+        }
+        scaler = self.autoscaler
+        if scaler is not None:
+            fleet["autoscale"] = scaler.describe()
         return {
             "event": "stats",
             **agg,
-            "fleet": {
-                "place": self._place,
-                "engines": members,
-                "engines_alive": sum(1 for m in members if m["alive"]),
-                "jobs_tracked": unsettled,
-                **{
-                    name: int(
-                        telemetry.counter(f"fleet.{name}").value
-                    ) - base
-                    for name, base in self._counters0.items()
-                },
-            },
+            "fleet": fleet,
         }
 
     def metrics(self) -> dict:
@@ -776,6 +1198,8 @@ class FleetRouter:
         shutdown op (and reaps spawned processes); attach-mode callers
         pass False to leave the engines serving."""
         self._closed = True
+        if self.autoscaler is not None:
+            self.autoscaler.close()
         self._poll_stop.set()
         self._requeue.put(None)
         if self._poll_thread is not None:
@@ -823,6 +1247,12 @@ class FleetRouter:
         and the link reader must already resolve them to this job — a
         bind-after-ack would drop the first fetch's hits on the
         floor."""
+        # The placement seam (PERF.md §27): an injected fault fails
+        # THIS placement exactly like an engine rejection — submit
+        # reports it typed to the client; a requeue-time fault fails
+        # the job with its checkpoint attached (the quarantine token).
+        if faults_mod.ACTIVE is not None:
+            faults_mod.ACTIVE.fire("router.place")
         target = job.target
         job.target = None
         link = (
@@ -841,6 +1271,31 @@ class FleetRouter:
             doc["replay_mute"] = job.n_forwarded
         prev_state = job.state
         with self._lock:
+            if job.link is not None:
+                # Two dispatchers raced (e.g. concurrent resumes of
+                # one id): the first bound; a second binding would
+                # orphan the running placement and double-run the
+                # sweep.  Every legitimate dispatch path starts from
+                # link=None (fresh submit, pause, requeue, pump).
+                raise FleetError(
+                    f"job {job.id!r} is already bound to engine "
+                    f"{job.link.engine_id}"
+                )
+            if (
+                self._engine_capacity > 0 and target is None
+                and job.id not in link.routed
+                and len(link.routed) >= self._engine_capacity
+            ):
+                # Close the check-then-act window: _pick's capacity
+                # test ran under an earlier lock acquisition, and a
+                # concurrent dispatch may have bound here since —
+                # re-verify at bind time so the cap cannot overshoot
+                # (explicit-target migrates stay operator-privileged).
+                raise _NoCapacity(
+                    f"engine {link.engine_id} reached "
+                    f"engine_capacity ({self._engine_capacity}) "
+                    "before this placement bound"
+                )
             job.link = link
             job.state = "routed"
             job.acked = False
@@ -860,12 +1315,16 @@ class FleetRouter:
         return ack
 
     def _settle(self, job: RoutedJob, state: str) -> None:
+        freed = False
         with self._lock:
             job.state = state
             if job.link is not None:
                 job.link.routed.discard(job.id)
                 job.link = None
+                freed = True
             job.migrating = False
+            if job in self._pending:
+                self._pending.remove(job)
             if state != "paused":
                 # Terminal: release the heavy references — the full
                 # submit document (a service-scale router must not
@@ -875,7 +1334,11 @@ class FleetRouter:
                 # its outbound buffer).
                 job.doc = {"id": job.id}
                 job.emit = None
+                self._tenant_release_locked(job)
         job.settled.set()
+        if freed:
+            # An engine slot opened: admission-queued jobs can place.
+            self._schedule_pump()
 
     def _forward(self, job: RoutedJob, ev: dict) -> None:
         emit = job.emit
@@ -900,19 +1363,84 @@ class FleetRouter:
             job.link = None
         self._requeue.put((job, (old,), None))
 
+    def _schedule_pump(self) -> None:
+        """Ask the requeue worker to drain the pending queue — called
+        from reader/event threads, which must never dispatch
+        themselves (the GT003 handoff discipline)."""
+        if self._closed:
+            return
+        self._requeue.put(("pump",))
+
+    def _pump_pending(self) -> None:
+        """Dispatch admission-queued jobs while capacity lasts
+        (requeue-worker only).  Jobs whose ``deadline_s`` lapsed while
+        queued shed typed first — under overload the freed slot must
+        not go to work nobody is waiting for."""
+        while True:
+            now = time.monotonic()
+            with self._lock:
+                expired = [
+                    j for j in self._pending
+                    if j.deadline is not None and j.deadline <= now
+                ]
+                for j in expired:
+                    self._pending.remove(j)
+            for j in expired:
+                self._shed(j, "deadline_s lapsed while queued")
+            with self._lock:
+                job = self._pending.pop(0) if self._pending else None
+                if job is not None:
+                    # Claim: a concurrent cancel/resume must not treat
+                    # the popped job as settled-able or re-admittable
+                    # while its dispatch is in flight.
+                    job.claimed = True
+            if job is None:
+                return
+            try:
+                self._dispatch(job)
+            except _NoCapacity:
+                # Still no room: back to the FRONT (it is the oldest)
+                # until the next capacity-freed pump.
+                with self._lock:
+                    job.claimed = False
+                    self._pending.insert(0, job)
+                return
+            except (FleetError, faults_mod.FaultError) as exc:
+                with self._lock:
+                    job.claimed = False
+                self._fail_unplaceable(job, exc)
+            else:
+                with self._lock:
+                    job.claimed = False
+                if job.requeue_counter:
+                    telemetry.counter(job.requeue_counter).add(1)
+                    job.requeue_counter = None
+
     def _requeue_worker(self) -> None:
         while True:
             item = self._requeue.get()
             if item is None:
                 return
+            if item == ("pump",):
+                self._pump_pending()
+                continue
             job, exclude, counter = item
+            if counter:
+                job.requeue_counter = counter
             try:
                 self._dispatch(job, exclude)
-            except FleetError as exc:
+            except _NoCapacity:
+                # A crash-replay/migrate job was already admitted once:
+                # it queues AHEAD of new arrivals and re-places as
+                # capacity frees.
+                with self._lock:
+                    self._pending.insert(0, job)
+            except (FleetError, faults_mod.FaultError) as exc:
                 self._fail_unplaceable(job, exc)
             else:
-                if counter:
-                    telemetry.counter(counter).add(1)
+                if job.requeue_counter:
+                    telemetry.counter(job.requeue_counter).add(1)
+                    job.requeue_counter = None
 
     def _fail_unplaceable(self, job: RoutedJob,
                           exc: Exception) -> None:
@@ -941,7 +1469,23 @@ class FleetRouter:
             self._forward(job, ev)
             self._settle(job, "done")
         elif event == "paused":
-            job.checkpoint = ev.get("checkpoint")
+            ck = ev.get("checkpoint")
+            if ck is not None:
+                # Capture-time validation (PERF.md §27): a malformed
+                # checkpoint fails the pause/drain TYPED here, not the
+                # eventual crash-replay resubmit.
+                try:
+                    validate_checkpoint_doc(ck)
+                except ValueError as exc:
+                    self._forward(job, {
+                        "id": job.id, "event": "failed",
+                        "error": f"{type(exc).__name__}: {exc} "
+                                 "(checkpoint captured on pause "
+                                 "failed validation)",
+                    })
+                    self._settle(job, "failed")
+                    return
+            job.checkpoint = ck
             if job.migrating:
                 self._remigrate(job, link)
                 return
@@ -951,6 +1495,7 @@ class FleetRouter:
                 job.link = None
             self._forward(job, ev)
             job.settled.set()
+            self._schedule_pump()
         elif event == "cancelled":
             if job.migrating and job.kind == "candidates":
                 # Restart-style migration: the cancel was ours.
@@ -961,6 +1506,34 @@ class FleetRouter:
             self._settle(job, "cancelled")
         elif event == "failed":
             ck = ev.get("checkpoint")
+            if ck is not None:
+                try:
+                    validate_checkpoint_doc(ck)
+                except ValueError as exc:
+                    # A quarantine token this build cannot resume is no
+                    # replay origin: surface the failure typed instead
+                    # of resubmitting a doc that would explode later.
+                    ev = dict(ev)
+                    ev["checkpoint_invalid"] = \
+                        f"{type(exc).__name__}: {exc}"
+                    ck = None
+                else:
+                    # Engine-side quarantine (the §23 ladder exhausted
+                    # on this engine) is the repeated-crash-replay
+                    # strain signal: enough of them circuit-break the
+                    # engine (PERF.md §27).
+                    with self._lock:
+                        link.replay_fails += 1
+                        trip = (
+                            link.replay_fails
+                            >= self._quarantine_replays
+                        )
+                    if trip:
+                        self._quarantine_link(
+                            link,
+                            f"{link.replay_fails} checkpoint-bearing "
+                            "job failures",
+                        )
             if ck is not None and job.replays < self._replay_budget:
                 # Quarantine resubmission (PERF.md §23→§25): the
                 # failed event's checkpoint IS the migrate token.
@@ -1023,7 +1596,8 @@ class FleetRouter:
 
     # -- health --------------------------------------------------------
 
-    def _scrape(self, link: EngineLink) -> dict:
+    def _scrape(self, link: EngineLink, *,
+                observe: bool = False) -> dict:
         # The stats op answers from a session thread (counter reads,
         # no device work) on the link's DEDICATED health connection —
         # blocking ops on the main op stream (a pause parking at a
@@ -1031,35 +1605,170 @@ class FleetRouter:
         # dead.  The short cadence-scaled timeout bounds how long the
         # watchdog takes to declare a wedged engine (poll_misses ×
         # this).
-        ev = link.health_request(
-            {"op": "stats"},
-            timeout=max(2.0 * self._poll_s, 2.0),
-        )
+        timeout = max(2.0 * self._poll_s, 2.0)
+        with telemetry.stopwatch(
+            "fleet.scrape_s", edges=(0.01, 0.05, 0.25, 1.0, 5.0)
+        ) as sw:
+            ev = link.health_request({"op": "stats"}, timeout=timeout)
         if ev.get("event") == "error":
             raise FleetError(
                 f"engine {link.engine_id}: {ev.get('error')}"
             )
         link.scrape = ev
         link.misses = 0
+        if observe:
+            # Latency budget (PERF.md §27): a reply slower than half
+            # the scrape timeout is a strain signal even when it
+            # arrives — a struggling engine degrades before it wedges.
+            # ONLY the poll loop's cadenced scrapes feed the ladder:
+            # client-driven stats scrapes would otherwise make
+            # quarantine timing a function of how often clients poll
+            # (fast polls could both rush strikes and mask strain by
+            # resetting them between ticks).
+            self._ladder_observe(link, ev, sw.elapsed_s > 0.5 * timeout)
         return ev
 
+    # -- the health ladder (PERF.md §27) -------------------------------
+
+    def _ladder_observe(self, link: EngineLink, ev: dict,
+                        slow: bool) -> None:
+        """One successful scrape's ladder input: strain = a slow reply
+        OR rising recovery-ladder deltas (``group_demotions``/
+        ``job_restarts`` climbing between scrapes — the engine's §23
+        ladder is working, which means its device is failing)."""
+        cur = {
+            k: int(ev.get(k, 0))
+            for k in ("group_demotions", "job_restarts")
+        }
+        with self._lock:
+            prev = link.ladder_prev
+            link.ladder_prev = cur
+        # The FIRST scrape is the baseline: attaching to an engine
+        # with recovery history must not instantly degrade it.
+        rising = bool(prev) and any(
+            cur[k] > prev.get(k, 0) for k in cur
+        )
+        if slow or rising:
+            self._ladder_strike(link)
+        else:
+            self._ladder_clean(link)
+
+    def _ladder_strike(self, link: EngineLink) -> None:
+        quarantine = False
+        with self._lock:
+            link.strikes += 1
+            link.clean = 0
+            if link.health != "quarantined":
+                if (
+                    link.strikes >= self._quarantine_after
+                    and self.autoscaler is not None
+                ):
+                    quarantine = True
+                elif link.health == "healthy" and \
+                        link.strikes >= self._degrade_after:
+                    link.health = "degraded"
+        if quarantine:
+            self._quarantine_link(
+                link, f"{link.strikes} consecutive strained scrapes"
+            )
+
+    def _ladder_clean(self, link: EngineLink) -> None:
+        with self._lock:
+            link.strikes = 0
+            # A clean POLL tick also closes the repeated-crash-replay
+            # window: ``quarantine_replays`` means failures bunched
+            # within one health window, not accumulated over an
+            # engine's whole lifetime (a long-lived engine with one
+            # recovered transient per week must never circuit-break).
+            link.replay_fails = 0
+            if link.health == "degraded":
+                link.clean += 1
+                if link.clean >= self._recover_after:
+                    link.health = "healthy"
+                    link.clean = 0
+
+    def _quarantine_link(self, link: EngineLink, reason: str) -> None:
+        """Circuit-break one engine: no further placements land on it;
+        the autoscaler drains + replaces it (its routed jobs migrate
+        off with their checkpoints — nothing is lost).  One-way: a
+        quarantined engine never un-quarantines (replacement is the
+        recovery, mirroring the §23 job quarantine).  Only reachable
+        when an autoscaler is attached — a fixed pool has no replacer,
+        so its ladder tops out at ``degraded`` (place-last) and the
+        poll watchdog stays the kill path for truly wedged engines:
+        permanently losing live capacity would be strictly worse than
+        degraded placements."""
+        if self.autoscaler is None:
+            return
+        with self._lock:
+            if link.health == "quarantined":
+                return
+            link.health = "quarantined"
+        telemetry.counter("fleet.engines_quarantined").add(1)
+        print(
+            f"a5gen: fleet: engine {link.engine_id} QUARANTINED "
+            f"({reason}); placements stop — the autoscaler drains "
+            "and replaces it",
+            file=sys.stderr,
+        )
+
+    def _jitter_of(self, link: EngineLink) -> float:
+        """Deterministic per-engine scrape offset: a stable hash
+        fraction of ``poll_s × poll_jitter``, so N engines spread over
+        the scrape tick instead of stampeding it (PERF.md §27)."""
+        if self._poll_s <= 0:
+            return 0.0
+        frac = (
+            zlib.crc32(link.engine_id.encode("utf-8")) % 997
+        ) / 997.0
+        return self._poll_s * self._poll_jitter * frac
+
     def _poll_loop(self) -> None:
-        while not self._poll_stop.wait(self._poll_s):
+        while True:
+            now = time.monotonic()
+            due = []
+            wait = self._poll_s
             for link in self.engines():
                 if not link.alive:
                     continue
+                if link.next_poll <= now:
+                    due.append(link)
+                    link.next_poll = (
+                        now + self._poll_s + self._jitter_of(link)
+                    )
+                else:
+                    wait = min(wait, link.next_poll - now)
+            for link in due:
                 if link.proc is not None and link.proc.poll() is not None:
                     link.kill_socket()  # reaped: reader EOF replays
                     continue
                 try:
-                    self._scrape(link)
+                    self._scrape(link, observe=True)
                 except FleetError:
-                    link.misses += 1
-                    if link.misses >= self._poll_misses:
-                        # Wedged engine (socket up, serve loop gone):
-                        # the watchdog declares it dead the same way a
-                        # torn socket would.
-                        link.kill_socket()
+                    # One immediate in-poll retry before the failure
+                    # counts (PERF.md §27): a dropped health connection
+                    # or one slow reply must not walk a healthy engine
+                    # toward the watchdog.
+                    telemetry.counter("fleet.scrape_retries").add(1)
+                    try:
+                        self._scrape(link, observe=True)
+                    except FleetError:
+                        link.misses += 1
+                        self._ladder_strike(link)
+                        if link.misses >= self._poll_misses:
+                            # Wedged engine (socket up, serve loop
+                            # gone): the watchdog declares it dead the
+                            # same way a torn socket would.
+                            link.kill_socket()
+            with self._lock:
+                backlog = bool(self._pending)
+            if backlog:
+                # Belt-and-braces: capacity can free without a settle
+                # this router observes (quarantine recovery, operator
+                # action engine-side) — the tick re-pumps.
+                self._schedule_pump()
+            if self._poll_stop.wait(max(0.05, min(wait, self._poll_s))):
+                return
 
 
 # ---------------------------------------------------------------------------
@@ -1070,6 +1779,7 @@ class FleetRouter:
 def spawn_engines(n: int, directory: str, *,
                   engine_args: Sequence[str] = (),
                   engine_id_prefix: str = "eng",
+                  start_index: int = 0,
                   env: Optional[dict] = None,
                   stderr=subprocess.DEVNULL
                   ) -> List[Tuple[str, str, subprocess.Popen]]:
@@ -1078,10 +1788,13 @@ def spawn_engines(n: int, directory: str, *,
     (geometry flags, and — the fleet artifact store — one
     ``--schema-cache`` directory).  Returns ``(socket_path, engine_id,
     proc)`` triples; callers attach them to a :class:`FleetRouter`
-    (which retries until each engine's post-jax-import bind lands)."""
+    (which retries until each engine's post-jax-import bind lands).
+    ``start_index`` offsets the id/socket numbering — the autoscaler
+    spawns incrementally and must never reuse a reaped engine's
+    socket path (PERF.md §27)."""
     os.makedirs(directory, exist_ok=True)
     out = []
-    for i in range(int(n)):
+    for i in range(int(start_index), int(start_index) + int(n)):
         sock = os.path.join(directory, f"{engine_id_prefix}{i}.sock")
         eid = f"{engine_id_prefix}{i}"
         cmd = [
@@ -1182,10 +1895,15 @@ class _RouterSession:
             return True
         if op == "submit":
             ack = self._router.submit(doc, emit=self._emit)
-            self._emit({
+            out = {
                 "id": ack.get("id", jid), "event": "accepted",
                 "kind": ack.get("kind"), "engine": ack.get("engine"),
-            })
+            }
+            if ack.get("queued"):
+                # Admission-queued (PERF.md §27): accepted, not yet
+                # placed — the client's events flow once it dispatches.
+                out["queued"] = True
+            self._emit(out)
             return True
         if op == "pause":
             self._router.pause(jid)
@@ -1225,17 +1943,36 @@ class _RouterSession:
                 line = line.strip()
                 if not line:
                     continue
+                doc = None
                 try:
                     doc = json.loads(line)
                     keep_going = self._handle(doc)
                 except OSError:
                     return False  # this session's client is gone
-                except Exception as exc:  # noqa: BLE001 — protocol
+                except FleetOverloaded as exc:
+                    # The typed overload rejection (PERF.md §27):
+                    # machine-parseable error + retry_after_s, so
+                    # clients back off instead of hammering.
                     try:
-                        self._emit({
-                            "event": "error",
-                            "error": f"{type(exc).__name__}: {exc}",
-                        })
+                        self._emit(exc.event(
+                            doc.get("id") if isinstance(doc, dict)
+                            else None
+                        ))
+                    except OSError:
+                        return False
+                    continue
+                except Exception as exc:  # noqa: BLE001 — protocol
+                    err = {
+                        "event": "error",
+                        "error": f"{type(exc).__name__}: {exc}",
+                    }
+                    # Id-carrying like the engine session's errors —
+                    # clients correlate failures to the op that caused
+                    # them (CONTRIBUTING: router-passthrough-safe).
+                    if isinstance(doc, dict) and doc.get("id") is not None:
+                        err["id"] = doc["id"]
+                    try:
+                        self._emit(err)
                     except OSError:
                         return False
                     continue

@@ -263,6 +263,39 @@ def histogram(name: str,
     return REGISTRY.histogram(name, edges)
 
 
+class Stopwatch:
+    """Context manager timing one section into a registry histogram —
+    the sanctioned home for elapsed-time arithmetic (GL013 bans the
+    ``t0 = monotonic(); acc += monotonic() - t0`` idiom in ``runtime/``
+    outside this module).  ``elapsed_s`` is readable after exit, so
+    callers can apply thresholds (the fleet's slow-scrape strain
+    signal, PERF.md §27) without re-deriving the arithmetic; recording
+    honors the ``A5GEN_TELEMETRY`` hatch, the reading does not."""
+
+    __slots__ = ("elapsed_s", "_hist", "_t0")
+
+    def __init__(self, hist: Optional[Histogram]) -> None:
+        self.elapsed_s = 0.0
+        self._hist = hist
+        self._t0 = 0.0
+
+    def __enter__(self) -> "Stopwatch":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc: object) -> None:
+        self.elapsed_s = time.monotonic() - self._t0
+        if self._hist is not None and enabled():
+            self._hist.observe(self.elapsed_s)
+
+
+def stopwatch(name: str,
+              edges: Sequence[float] = DEFAULT_TIME_EDGES
+              ) -> Stopwatch:
+    """Time a ``with`` block into ``histogram(name, edges)``."""
+    return Stopwatch(REGISTRY.histogram(name, edges))
+
+
 def snapshot() -> Dict[str, dict]:
     snap = REGISTRY.snapshot()
     if _ENGINE_ID is not None:

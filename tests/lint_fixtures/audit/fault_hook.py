@@ -80,6 +80,27 @@ def broken_drive_bare_hook(call, make_bufs, total, advance, depth, faults):
     return done
 
 
+def clean_router_dispatch_hooked(pick, request, job, faults):
+    """The fleet placement seam's sanctioned shape (PERF.md §27): the
+    guard sits immediately around the fire at dispatch entry."""
+    if faults.ACTIVE is not None:
+        faults.ACTIVE.fire("router.place")
+    link = pick(job.token)
+    return request(link, job.doc)
+
+
+def broken_spawn_bare_hook(spawner, attach, faults):
+    """The finding, fleet-shaped: a bare fire inside the spawn try —
+    rule matching would run on every scale-up arrival."""
+    try:
+        faults.ACTIVE.fire("engine.spawn")  # no guard!
+        endpoint, eid, proc = spawner()
+        attach(endpoint, eid, proc)
+    except Exception:
+        return False
+    return True
+
+
 def broken_drive_wrong_guard(call, make_bufs, total, advance, depth,
                              faults, debug):
     """A guard that is not the ACTIVE-is-not-None test does not count:

@@ -1,0 +1,30 @@
+# Broken twin of gt003_autoscale_ok: a naive elastic control loop
+# that blocks on its own spawn-ack queue.  The loop thread both
+# produces (the put after a spawn) and is the ONLY producer of
+# _spawned — when _need_capacity() is False the get() can never be
+# satisfied by anyone else: a wait-for self-cycle (GT003), the same
+# shape as the fleet requeue-worker deadlock, one layer up.
+import queue
+import threading
+
+
+class Elastic:
+    def __init__(self):
+        self._spawned = queue.Queue()
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            if self._need_capacity():
+                self._spawned.put(self._spawn_one())
+            sock = self._spawned.get()  # only THIS thread ever puts
+            self._register(sock)
+
+    def _need_capacity(self):
+        return False
+
+    def _spawn_one(self):
+        return "sock"
+
+    def _register(self, sock):
+        pass

@@ -1,0 +1,45 @@
+# Clean twin: the queue discipline runtime/autoscale.py actually
+# ships (PERF.md §27).  The control loop paces on an Event wait (a
+# timeout wait, not an unbounded self-produced get), spawns
+# SYNCHRONOUSLY inside its own tick, and the only queue — operator
+# scale requests — is produced by CALLER entries and merely drained
+# (non-blocking) by the loop: no wait the loop itself must satisfy.
+import queue
+import threading
+
+
+class Elastic:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._requests = queue.Queue()
+        self._stop = threading.Event()
+        self._pool = []
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def request_scale(self, n):
+        # Caller-side producer: the loop only drains, never waits.
+        self._requests.put(n)
+
+    def _loop(self):
+        while not self._stop.wait(1.0):
+            self._tick()
+
+    def _tick(self):
+        with self._lock:
+            while True:
+                try:
+                    n = self._requests.get_nowait()
+                except queue.Empty:
+                    break
+                self._apply(n)
+            if self._need_capacity():
+                self._pool.append(self._spawn_one())
+
+    def _apply(self, n):
+        pass
+
+    def _need_capacity(self):
+        return False
+
+    def _spawn_one(self):
+        return "sock"

@@ -1,0 +1,1104 @@
+"""Elastic fleet (PERF.md §27): autoscaling, admission control,
+backpressure, and the health ladder.
+
+Fast tier runs STUB engines (fake links with scripted request/scrape
+replies — no jax, no sockets) so the control-plane contracts are
+deterministic and cheap: typed overload rejection with
+``retry_after_s``, shed policies (reject / oldest / queue) with
+deadline-carrying jobs first, per-tenant in-flight caps, bounded
+router memory under sustained overload, pending dispatch as capacity
+frees, the healthy→degraded→quarantined ladder with placement
+exclusion, capture-time checkpoint validation, autoscaler hysteresis +
+cooldown, and the three §27 fault seams (``router.place``,
+``link.send``, ``engine.spawn``).
+
+The REAL multi-process contracts are slow-marked: the forced
+scale-up/scale-down smoke and the elastic chaos soak (seeded engine
+kills during autoscale churn, byte-exact per-tenant parity vs solo,
+bounded queue growth).
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.runtime import faults, telemetry
+from hashcat_a5_table_generator_tpu.runtime.autoscale import (
+    AutoscaleConfig,
+    Autoscaler,
+)
+from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+    CheckpointCorrupt,
+    CheckpointState,
+    SweepCursor,
+    state_to_doc,
+)
+from hashcat_a5_table_generator_tpu.runtime.fleet import (
+    EngineLink,
+    FleetError,
+    FleetOverloaded,
+    FleetRouter,
+    spawn_engines,
+)
+from tests.test_fleet import (
+    BIG_WORDS,
+    WORDS,
+    _Collector,
+    cfg,
+    event_hits,
+    job_doc,
+    planted_digests,
+    solo_hits,
+)
+
+
+def wait_for(cond, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {what}"
+        time.sleep(0.01)
+
+
+class FakeLink:
+    """A scripted engine link: accepts every request instantly,
+    answers health scrapes from ``stats_reply`` — the router's full
+    admission/ladder surface with zero device work."""
+
+    def __init__(self, engine_id, index):
+        self.engine_id = engine_id
+        self.endpoint = f"fake://{engine_id}"
+        self.index = index
+        self.alive = True
+        self.draining = False
+        self.health = "healthy"
+        self.strikes = 0
+        self.clean = 0
+        self.replay_fails = 0
+        self.ladder_prev = {}
+        self.next_poll = 0.0
+        self.misses = 0
+        self.scrape = {}
+        self.routed = set()
+        self.requests = []
+        self.sent = []
+        self.stats_reply = {"event": "stats"}
+        self.proc = None
+        self._closing = False
+
+    def request(self, doc, timeout=None):
+        self.requests.append(doc)
+        return {"id": doc.get("id"), "event": "accepted",
+                "kind": "crack"}
+
+    def send(self, doc):
+        self.sent.append(doc)
+
+    def health_request(self, doc, timeout=None):
+        return dict(self.stats_reply)
+
+    def kill_socket(self):
+        self.alive = False
+
+    def close(self):
+        self.alive = False
+
+
+def make_router(n_links=1, **kw):
+    kw.setdefault("poll_s", 0)
+    router = FleetRouter(**kw)
+    links = [FakeLink(f"e{i}", i) for i in range(n_links)]
+    router._links = links
+    return router, links
+
+
+def collector():
+    events = []
+    return events, events.append
+
+
+# ---------------------------------------------------------------------------
+# Admission control + backpressure
+# ---------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def test_capacity_gates_then_queues_with_queued_ack(self):
+        router, (link,) = make_router(engine_capacity=1)
+        try:
+            ack1 = router.submit({"id": "j1", "digest_list": []})
+            assert ack1["engine"] == "e0" and "queued" not in ack1
+            ack2 = router.submit({"id": "j2", "digest_list": []})
+            assert ack2["queued"] is True and ack2["engine"] is None
+            assert router.pending_depth() == 1
+            assert link.routed == {"j1"}
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_overload_rejects_typed_with_retry_after(self):
+        router, (link,) = make_router(
+            engine_capacity=1, max_pending=1
+        )
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            router.submit({"id": "j2", "digest_list": []})
+            with pytest.raises(FleetOverloaded) as exc:
+                router.submit({"id": "j3", "digest_list": []})
+            assert exc.value.retry_after_s > 0
+            ev = exc.value.event("j3")
+            assert ev["error"] == "overloaded"
+            assert ev["retry_after_s"] == exc.value.retry_after_s
+            assert ev["id"] == "j3"
+            # The rejected id is retryable: no stale table entry.
+            assert "j3" not in router._jobs
+            assert router.stats()["fleet"]["jobs_rejected"] == 1
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_pending_dispatches_as_capacity_frees(self):
+        router, (link,) = make_router(engine_capacity=1)
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            events, emit = collector()
+            router.submit({"id": "j2", "digest_list": []}, emit=emit)
+            assert router.pending_depth() == 1
+            # j1 finishes engine-side: the freed slot pumps j2 out.
+            router._on_job_event(link, {"id": "j1", "event": "done"})
+            wait_for(lambda: "j2" in link.routed, what="j2 placed")
+            assert router.pending_depth() == 0
+            assert router.job("j2").state == "routed"
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_shed_policy_oldest_evicts_to_admit(self):
+        router, (link,) = make_router(
+            engine_capacity=1, max_pending=1, shed_policy="oldest"
+        )
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            events, emit = collector()
+            router.submit({"id": "old", "digest_list": []}, emit=emit)
+            ack = router.submit({"id": "new", "digest_list": []})
+            assert ack["queued"] is True
+            # The old pending job was shed typed, overload-shaped.
+            (failed,) = [e for e in events
+                         if e.get("event") == "failed"]
+            assert failed["error"] == "overloaded"
+            assert failed["retry_after_s"] > 0
+            assert router.job("old").state == "failed"
+            assert [j.id for j in router._pending] == ["new"]
+            assert router.stats()["fleet"]["jobs_shed"] == 1
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_deadline_carriers_shed_first(self):
+        router, (link,) = make_router(
+            engine_capacity=1, max_pending=2, shed_policy="oldest"
+        )
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            events, emit = collector()
+            # Older job WITHOUT a deadline, newer one WITH: the
+            # deadline carrier is the victim despite being newer.
+            router.submit({"id": "nodl", "digest_list": []})
+            router.submit({"id": "dl", "digest_list": [],
+                           "deadline_s": 60.0}, emit=emit)
+            router.submit({"id": "spill", "digest_list": []})
+            assert router.job("dl").state == "failed"
+            assert any(e.get("error") == "overloaded" for e in events)
+            assert [j.id for j in router._pending] == ["nodl", "spill"]
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_expired_deadline_sheds_at_pump(self):
+        router, (link,) = make_router(engine_capacity=1)
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            events, emit = collector()
+            router.submit({"id": "dl", "digest_list": [],
+                           "deadline_s": 0.01}, emit=emit)
+            time.sleep(0.05)
+            router._pump_pending()
+            assert router.job("dl").state == "failed"
+            (failed,) = [e for e in events
+                         if e.get("event") == "failed"]
+            assert failed["error"] == "overloaded"
+            assert "deadline" in failed["reason"]
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_queue_policy_is_the_unbounded_escape_hatch(self):
+        router, (link,) = make_router(
+            engine_capacity=1, max_pending=1, shed_policy="queue"
+        )
+        try:
+            router.submit({"id": "j0", "digest_list": []})
+            for i in range(5):
+                ack = router.submit(
+                    {"id": f"q{i}", "digest_list": []}
+                )
+                assert ack["queued"] is True
+            assert router.pending_depth() == 5
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_per_tenant_cap_rejects_typed(self):
+        router, (link,) = make_router(per_tenant=1)
+        try:
+            router.submit({"id": "t1", "digest_list": [],
+                           "tenant": "alice"})
+            with pytest.raises(FleetOverloaded) as exc:
+                router.submit({"id": "t2", "digest_list": [],
+                               "tenant": "alice"})
+            assert "alice" in str(exc.value)
+            # Other tenants (and tenant-less docs) are unaffected.
+            router.submit({"id": "t3", "digest_list": [],
+                           "tenant": "bob"})
+            router.submit({"id": "t4", "digest_list": []})
+            # A settled job releases the slot.
+            router._on_job_event(link, {"id": "t1", "event": "done"})
+            router.submit({"id": "t5", "digest_list": [],
+                           "tenant": "alice"})
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_router_memory_bounded_under_sustained_overload(self):
+        """The §27 acceptance pin: hammering an overloaded router
+        grows NEITHER the pending queue past max_pending NOR the job
+        table — rejected ids leave no residue."""
+        router, (link,) = make_router(
+            engine_capacity=1, max_pending=4
+        )
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            rejected = 0
+            for i in range(100):
+                try:
+                    router.submit({"id": f"burst{i}",
+                                   "digest_list": []})
+                except FleetOverloaded:
+                    rejected += 1
+            assert rejected == 96
+            assert router.pending_depth() == 4
+            # Table: 1 routed + 4 pending — no rejected residue.
+            assert len(router._jobs) == 5
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_resume_under_overload_keeps_paused_job(self):
+        """A rejected RESUME must not destroy the admitted job: it
+        stays paused with its checkpoint (the replay origin) intact,
+        and the retry succeeds once capacity frees."""
+        router, (link,) = make_router(engine_capacity=1, max_pending=0)
+        try:
+            ckdoc = state_to_doc(CheckpointState(
+                fingerprint="fp", cursor=SweepCursor(0, 4),
+                n_emitted=3, n_hits=0, hits=[], wall_s=0.1,
+            ))
+            router.submit({"id": "p1", "digest_list": []})
+            router._on_job_event(link, {
+                "id": "p1", "event": "paused", "checkpoint": ckdoc,
+            })
+            assert router.job("p1").state == "paused"
+            router.submit({"id": "run", "digest_list": []})
+            with pytest.raises(FleetOverloaded):
+                router.resume("p1")
+            job = router.job("p1")  # still known — id NOT forgotten
+            assert job.state == "paused"
+            assert job.checkpoint == ckdoc
+            router._on_job_event(link, {"id": "run", "event": "done"})
+            ack = router.resume("p1")
+            assert ack["resumed"] is True
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_resume_retry_while_queued_is_idempotent(self):
+        """A client retrying a queued resume (the pattern
+        retry_after_s invites) must never double-admit: one pending
+        entry, one eventual dispatch."""
+        router, (link,) = make_router(engine_capacity=1, max_pending=4)
+        try:
+            ckdoc = state_to_doc(CheckpointState(
+                fingerprint="fp", cursor=SweepCursor(0, 4),
+                n_emitted=3, n_hits=0, hits=[], wall_s=0.1,
+            ))
+            router.submit({"id": "p1", "digest_list": []})
+            router._on_job_event(link, {
+                "id": "p1", "event": "paused", "checkpoint": ckdoc,
+            })
+            router.submit({"id": "run", "digest_list": []})
+            ack1 = router.resume("p1")
+            ack2 = router.resume("p1")
+            assert ack1["queued"] is True and ack2["queued"] is True
+            with router._lock:
+                pending_ids = [j.id for j in router._pending]
+            assert pending_ids.count("p1") == 1
+            router._on_job_event(link, {"id": "run", "event": "done"})
+            wait_for(lambda: "p1" in link.routed, what="p1 placed")
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_dispatch_refuses_already_bound_job(self):
+        """Two dispatchers racing one id (concurrent resumes) must not
+        double-bind: the second bind fails typed, the first placement
+        keeps running."""
+        router, (link,) = make_router()
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            job = router.job("j1")
+            assert job.link is link
+            with pytest.raises(FleetError) as exc:
+                router._dispatch(job)
+            assert "already bound" in str(exc.value)
+            assert job.link is link  # the running placement survives
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_bind_time_capacity_recheck_closes_toctou(self):
+        """Two concurrent submits can both pass _pick's capacity test;
+        the bind under the lock must re-verify so the cap never
+        overshoots — simulated by pinning _pick to a full engine."""
+        router, (link,) = make_router(engine_capacity=1)
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            router._pick = lambda token, exclude=(): link  # the race
+            ack = router.submit({"id": "j2", "digest_list": []})
+            assert ack["queued"] is True
+            assert link.routed == {"j1"}  # never overshot
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_cancel_of_pending_job_settles_inline(self):
+        router, (link,) = make_router(engine_capacity=1)
+        try:
+            router.submit({"id": "j1", "digest_list": []})
+            events, emit = collector()
+            router.submit({"id": "q", "digest_list": []}, emit=emit)
+            router.cancel("q")
+            assert router.job("q").state == "cancelled"
+            assert router.pending_depth() == 0
+            assert any(e.get("event") == "cancelled" for e in events)
+        finally:
+            router.close(shutdown_engines=False)
+
+
+# ---------------------------------------------------------------------------
+# Health ladder + circuit breaking
+# ---------------------------------------------------------------------------
+
+
+class TestHealthLadder:
+    def _router2(self, **kw):
+        kw.setdefault("degrade_after", 1)
+        kw.setdefault("quarantine_after", 3)
+        kw.setdefault("recover_after", 2)
+        return make_router(n_links=2, **kw)
+
+    def test_rising_demotions_degrade_then_recover(self):
+        router, (a, b) = self._router2()
+        try:
+            a.stats_reply = {"event": "stats", "group_demotions": 0,
+                             "job_restarts": 0}
+            router._scrape(a, observe=True)  # baseline
+            assert a.health == "healthy"
+            a.stats_reply["group_demotions"] = 1
+            router._scrape(a, observe=True)  # rising delta = strain
+            assert a.health == "degraded"
+            # Degraded engines place last: a fresh submit avoids it.
+            router.submit({"id": "j1", "digest_list": []})
+            assert router.job("j1").link is b
+            # Two clean scrapes walk it back to healthy.
+            router._scrape(a, observe=True)
+            assert a.health == "degraded"
+            router._scrape(a, observe=True)
+            assert a.health == "healthy"
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_sustained_strain_quarantines_and_excludes(self):
+        router, (a, b) = self._router2(quarantine_after=2)
+        make_scaler(router, min_engines=1, max_engines=4)
+        try:
+            a.stats_reply = {"event": "stats", "group_demotions": 0}
+            router._scrape(a, observe=True)
+            for i in (1, 2):
+                a.stats_reply["group_demotions"] = i
+                router._scrape(a, observe=True)
+            assert a.health == "quarantined"
+            assert router.stats()["fleet"]["engines_quarantined"] == 1
+            # No placements land on it, ever (one-way circuit).
+            for i in range(4):
+                router.submit({"id": f"q{i}", "digest_list": []})
+                assert router.job(f"q{i}").link is b
+            # A quarantined-only pool is OVERLOAD (replacement is on
+            # the way), not absence: submits queue bounded + typed
+            # instead of failing with an untyped 'no live engine'.
+            b.alive = False
+            ack = router.submit({"id": "during", "digest_list": []})
+            assert ack["queued"] is True
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_fixed_pool_never_quarantines_tops_out_degraded(self):
+        """Without an autoscaler there is no replacer: the ladder must
+        stop at degraded (place-last) — permanently bricking live
+        capacity would be worse, and the poll watchdog still kills
+        truly wedged engines."""
+        router, (a, b) = self._router2(quarantine_after=2)
+        try:
+            a.stats_reply = {"event": "stats", "group_demotions": 0}
+            router._scrape(a, observe=True)
+            for i in (1, 2, 3, 4):
+                a.stats_reply["group_demotions"] = i
+                router._scrape(a, observe=True)
+            assert a.health == "degraded"  # never quarantined
+            # Still placeable as the last resort.
+            b.alive = False
+            router.submit({"id": "last", "digest_list": []})
+            assert router.job("last").link is a
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_repeated_crash_replays_quarantine(self):
+        router, (a, b) = self._router2(
+            quarantine_replays=2, replay_budget=5
+        )
+        make_scaler(router, min_engines=1, max_engines=4)
+        try:
+            ckdoc = state_to_doc(CheckpointState(
+                fingerprint="fp", cursor=SweepCursor(0, 10),
+                n_emitted=5, n_hits=0, hits=[], wall_s=0.1,
+            ))
+            for i in range(2):
+                router.submit({"id": f"r{i}", "digest_list": []})
+                job = router.job(f"r{i}")
+                wait_for(lambda: job.link is not None,
+                         what="placed")
+                link = job.link
+                link_events = {"id": job.id, "event": "failed",
+                               "error": "boom", "checkpoint": ckdoc}
+                router._on_job_event(link, link_events)
+                wait_for(lambda: job.link is not link or not
+                         job.unsettled, what="replayed")
+            assert a.replay_fails + b.replay_fails >= 2
+            assert "quarantined" in (a.health, b.health)
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_replay_fails_decay_on_clean_poll_tick(self):
+        """quarantine_replays means failures bunched within one health
+        window: a clean observed scrape resets the count, so an
+        engine with one recovered transient per week never
+        circuit-breaks."""
+        router, (a, b) = self._router2(quarantine_replays=2,
+                                       replay_budget=5)
+        make_scaler(router, min_engines=1, max_engines=4)
+        try:
+            ckdoc = state_to_doc(CheckpointState(
+                fingerprint="fp", cursor=SweepCursor(0, 2),
+                n_emitted=1, n_hits=0, hits=[], wall_s=0.1,
+            ))
+            router.submit({"id": "r0", "digest_list": []})
+            link = router.job("r0").link
+            router._on_job_event(link, {
+                "id": "r0", "event": "failed", "error": "boom",
+                "checkpoint": ckdoc,
+            })
+            assert link.replay_fails == 1
+            link.stats_reply = {"event": "stats"}
+            router._scrape(link, observe=True)  # clean poll tick
+            assert link.replay_fails == 0
+            assert link.health != "quarantined"
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_client_stats_scrapes_do_not_feed_ladder(self):
+        """Quarantine timing belongs to the POLL cadence: a client
+        hammering the stats op must neither rush strikes nor mask
+        strain by resetting them between poll ticks."""
+        router, (a, b) = self._router2()
+        try:
+            a.stats_reply = {"event": "stats", "group_demotions": 0}
+            router._scrape(a, observe=True)  # poll baseline
+            a.stats_reply["group_demotions"] = 5
+            for _ in range(4):
+                router.stats()  # client-driven scrapes: no ladder
+            assert a.health == "healthy"
+            router._scrape(a, observe=True)  # the poll tick sees it
+            assert a.health == "degraded"
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_failed_scrape_strikes_ladder(self):
+        router, (a, b) = self._router2(quarantine_after=1)
+        make_scaler(router, min_engines=1, max_engines=4)
+        try:
+
+            def boom(doc, timeout=None):
+                # The real EngineLink wraps transport errors typed.
+                raise FleetError("scrape torn")
+
+            a.health_request = boom
+            with pytest.raises(FleetError):
+                router._scrape(a)
+            # The poll loop counts the strike after its in-poll retry;
+            # simulate its failure path directly.
+            router._ladder_strike(a)
+            assert a.health == "quarantined"
+        finally:
+            router.close(shutdown_engines=False)
+
+
+# ---------------------------------------------------------------------------
+# Capture-time checkpoint validation
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointCapture:
+    def test_malformed_migrate_in_fails_submit_typed(self):
+        router, _ = make_router()
+        try:
+            with pytest.raises(CheckpointCorrupt) as exc:
+                router.submit({"id": "m1", "digest_list": [],
+                               "checkpoint": {"fingerprint": "fp"}})
+            assert "missing required field" in str(exc.value)
+            assert "m1" not in router._jobs  # id retryable
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_wrong_wire_major_fails_submit_typed(self):
+        from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+            CheckpointWireIncompatible,
+        )
+
+        router, _ = make_router()
+        try:
+            doc = state_to_doc(CheckpointState(
+                fingerprint="fp", cursor=SweepCursor(0, 1),
+                n_emitted=1, n_hits=0, hits=[], wall_s=0.0,
+            ))
+            doc["wire_version"] = "9.0"
+            with pytest.raises(CheckpointWireIncompatible):
+                router.submit({"id": "m2", "digest_list": [],
+                               "checkpoint": doc})
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_malformed_pause_checkpoint_fails_typed_at_capture(self):
+        router, (link,) = make_router()
+        try:
+            events, emit = collector()
+            router.submit({"id": "p1", "digest_list": []}, emit=emit)
+            router._on_job_event(link, {
+                "id": "p1", "event": "paused",
+                "checkpoint": {"fingerprint": "fp"},  # malformed
+            })
+            assert router.job("p1").state == "failed"
+            (failed,) = [e for e in events
+                         if e.get("event") == "failed"]
+            assert "CheckpointCorrupt" in failed["error"]
+            assert "pause" in failed["error"]
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_malformed_quarantine_token_not_replayed(self):
+        router, (link,) = make_router(replay_budget=3)
+        try:
+            events, emit = collector()
+            router.submit({"id": "f1", "digest_list": []}, emit=emit)
+            router._on_job_event(link, {
+                "id": "f1", "event": "failed", "error": "boom",
+                "checkpoint": {"cursor": {}},  # malformed
+            })
+            # No requeue: the failure surfaced typed instead.
+            assert router.job("f1").state == "failed"
+            (failed,) = [e for e in events
+                         if e.get("event") == "failed"]
+            assert "checkpoint_invalid" in failed
+            assert router.job("f1").replays == 0
+        finally:
+            router.close(shutdown_engines=False)
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: hysteresis, cooldown, quarantine replacement, reap
+# ---------------------------------------------------------------------------
+
+
+class StubRouter:
+    """The autoscaler-facing router surface, scripted."""
+
+    def __init__(self, links=(), pending=0):
+        self.links = list(links)
+        self.pending = pending
+        self.autoscaler = None
+        self.drained = []
+        self.detached = []
+        self.attached = []
+
+    def pending_depth(self):
+        return self.pending
+
+    def engines(self):
+        return list(self.links)
+
+    def _resolve(self, eid):
+        for link in self.links:
+            if link.engine_id == eid:
+                return link
+        raise FleetError(f"unknown engine {eid!r}")
+
+    def drain(self, eid):
+        link = self._resolve(eid)
+        link.draining = True
+        self.drained.append(eid)
+        return {"event": "draining", "engine": eid}
+
+    def detach(self, eid, *, shutdown=True, timeout=30.0):
+        link = self._resolve(eid)
+        if link.routed:
+            raise FleetError("still routed")
+        self.links.remove(link)
+        self.detached.append(eid)
+
+    def attach(self, endpoint, engine_id, *, proc=None, timeout=180.0):
+        link = FakeLink(engine_id, len(self.links))
+        self.links.append(link)
+        self.attached.append(engine_id)
+        return link
+
+
+def make_scaler(router, **cfg_kw):
+    cfg_kw.setdefault("interval_s", 0)  # manual ticks
+    cfg_kw.setdefault("cooldown_s", 1000.0)
+    n = [0]
+
+    def spawner():
+        n[0] += 1
+        return (f"fake://spawn{n[0]}", f"spawn{n[0]}", None)
+
+    scaler = Autoscaler(router, spawner, AutoscaleConfig(**cfg_kw))
+    return scaler
+
+
+class TestAutoscaler:
+    def test_scale_up_needs_sustained_window(self):
+        link = FakeLink("e0", 0)
+        link.routed = {"a", "b", "c"}
+        router = StubRouter([link], pending=2)
+        scaler = make_scaler(router, min_engines=1, max_engines=3,
+                             scale_up_at=2.0, up_window=2)
+        scaler.tick()  # streak 1: no action yet (hysteresis)
+        assert router.attached == []
+        scaler.tick()  # streak 2: spawn
+        assert router.attached == ["spawn1"]
+        # Cooldown: sustained pressure cannot spawn again yet.
+        scaler.tick()
+        scaler.tick()
+        assert router.attached == ["spawn1"]
+        assert scaler.describe()["cooling_down"] is True
+
+    def test_dead_band_resets_streaks(self):
+        link = FakeLink("e0", 0)
+        link.routed = {"a", "b", "c"}
+        router = StubRouter([link])
+        scaler = make_scaler(router, min_engines=1, max_engines=3,
+                             scale_up_at=2.0, scale_down_at=0.5,
+                             up_window=2)
+        scaler.tick()  # over threshold: streak 1
+        link.routed = {"a"}  # per = 1.0: dead band
+        scaler.tick()
+        assert scaler.describe()["up_streak"] == 0
+        link.routed = {"a", "b", "c"}
+        scaler.tick()  # streak restarts at 1: still no spawn
+        assert router.attached == []
+
+    def test_scale_down_drains_idlest_then_reaps(self):
+        a, b = FakeLink("e0", 0), FakeLink("e1", 1)
+        a.routed = {"j"}
+        router = StubRouter([a, b])
+        scaler = make_scaler(router, min_engines=1, max_engines=2,
+                             scale_down_at=0.6, down_window=2,
+                             cooldown_s=0.0)
+        scaler.tick()  # per = 0.5: streak 1
+        assert router.drained == []
+        scaler.tick()  # streak 2: drain the idle NEWEST engine
+        assert router.drained == ["e1"]
+        assert scaler.describe()["reaping"] == ["e1"]
+        # Reap lands once the drained engine is empty.
+        scaler.tick()
+        assert router.detached == ["e1"]
+        assert scaler.describe()["reaping"] == []
+
+    def test_min_floor_respawns_immediately(self):
+        router = StubRouter([])
+        scaler = make_scaler(router, min_engines=1, max_engines=2,
+                             cooldown_s=0.0)
+        scaler.tick()  # below min: no window needed
+        assert router.attached == ["spawn1"]
+
+    def test_quarantined_engine_drained_and_replaced(self):
+        a, b = FakeLink("e0", 0), FakeLink("e1", 1)
+        a.health = "quarantined"
+        router = StubRouter([a, b])
+        scaler = make_scaler(router, min_engines=2, max_engines=3,
+                             cooldown_s=0.0)
+        scaler.tick()
+        # Quarantine pass drains the broken engine; the min floor
+        # respawns the lost capacity.
+        assert router.drained == ["e0"]
+        assert router.attached == ["spawn1"]
+        # Once empty it reaps.
+        scaler.tick()
+        assert "e0" in router.detached
+
+    def test_last_capacity_quarantine_spawns_before_drain(self):
+        """Draining the LAST placeable engine would strand its
+        migrating jobs on 'no live engine': the replacement spawns
+        first, the drain waits for the next tick."""
+        a = FakeLink("e0", 0)
+        a.health = "quarantined"
+        a.routed = {"j"}
+        router = StubRouter([a])
+        scaler = make_scaler(router, min_engines=1, max_engines=2,
+                             cooldown_s=0.0)
+        scaler.tick()
+        assert router.attached == ["spawn1"]
+        assert router.drained == []
+        scaler.tick()  # somewhere to migrate now exists: drain
+        assert router.drained == ["e0"]
+
+    def test_spawn_fault_backs_off_and_retries(self):
+        router = StubRouter([])
+        scaler = make_scaler(router, min_engines=1, max_engines=2,
+                             cooldown_s=0.0)
+        before = int(
+            telemetry.counter("fleet.spawn_failures").value
+        )
+        with faults.armed("engine.spawn:nth=1"):
+            scaler.tick()  # injected spawn failure
+            assert router.attached == []
+            assert int(
+                telemetry.counter("fleet.spawn_failures").value
+            ) == before + 1
+            scaler.tick()  # cooldown 0: the retry succeeds
+            assert router.attached == ["spawn1"]
+
+    def test_failed_attach_reaps_the_spawned_process(self):
+        """A spawned-but-unattachable engine must not leak: the
+        scale-up failure path terminates the process it started."""
+
+        class FakeProc:
+            def __init__(self):
+                self.terminated = False
+                self.waited = False
+
+            def terminate(self):
+                self.terminated = True
+
+            def wait(self, timeout=None):
+                self.waited = True
+
+        proc = FakeProc()
+        router = StubRouter([])
+
+        def bad_attach(endpoint, engine_id, *, proc=None, timeout=180.0):
+            raise FleetError("engine never listened")
+
+        router.attach = bad_attach
+        scaler = Autoscaler(
+            router,
+            lambda: ("fake://x", "x", proc),
+            AutoscaleConfig(min_engines=1, max_engines=2,
+                            cooldown_s=0.0, interval_s=0),
+        )
+        scaler.tick()  # min floor tries to spawn; attach fails
+        assert proc.terminated and proc.waited
+        assert router.attached == []
+
+    def test_max_engines_is_a_ceiling(self):
+        link = FakeLink("e0", 0)
+        link.routed = {"a", "b", "c", "d"}
+        router = StubRouter([link])
+        scaler = make_scaler(router, min_engines=1, max_engines=1,
+                             scale_up_at=2.0, up_window=1,
+                             cooldown_s=0.0)
+        scaler.tick()
+        scaler.tick()
+        assert router.attached == []
+
+    def test_config_validates(self):
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_engines=2, max_engines=1)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(scale_up_at=1.0, scale_down_at=1.0)
+        with pytest.raises(ValueError):
+            AutoscaleConfig(min_engines=0)
+
+
+# ---------------------------------------------------------------------------
+# Fleet fault seams (PERF.md §27 satellites)
+# ---------------------------------------------------------------------------
+
+
+class TestFleetFaultSeams:
+    def test_router_place_fault_fails_submit_typed_and_retryable(self):
+        router, (link,) = make_router()
+        try:
+            with faults.armed("router.place:nth=1"):
+                with pytest.raises(faults.FaultInjected):
+                    router.submit({"id": "f1", "digest_list": []})
+                # Typed-and-bounded: no residue, the id retries fine.
+                assert "f1" not in router._jobs
+                ack = router.submit({"id": "f1", "digest_list": []})
+                assert ack["engine"] == "e0"
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_router_place_fault_on_requeue_quarantines_job(self):
+        """A place fault during crash-replay fails the job WITH its
+        checkpoint attached (the §23 quarantine-token discipline) —
+        never silently, never crashing the requeue worker."""
+        router, (link,) = make_router(replay_budget=1)
+        try:
+            events, emit = collector()
+            router.submit({"id": "r1", "digest_list": []}, emit=emit)
+            ckdoc = state_to_doc(CheckpointState(
+                fingerprint="fp", cursor=SweepCursor(1, 5),
+                n_emitted=9, n_hits=0, hits=[], wall_s=0.2,
+            ))
+            with faults.armed("router.place:nth=1"):
+                router._on_job_event(link, {
+                    "id": "r1", "event": "failed", "error": "boom",
+                    "checkpoint": ckdoc,
+                })
+                wait_for(lambda: router.job("r1").state == "failed",
+                         what="quarantined")
+            (failed,) = [e for e in events
+                         if e.get("event") == "failed"]
+            assert failed["checkpoint"] == ckdoc
+            # The worker survives: later submits still place.
+            router.submit({"id": "after", "digest_list": []})
+            assert router.job("after").link is link
+        finally:
+            router.close(shutdown_engines=False)
+
+    def test_link_send_fault_fails_op_typed(self):
+        import socket as socket_mod
+
+        a, b = socket_mod.socketpair(socket_mod.AF_UNIX)
+        link = EngineLink(a, "pair://", "e0")
+        try:
+            with faults.armed("link.send:nth=1"):
+                with pytest.raises(FleetError) as exc:
+                    link.request({"op": "stats"}, timeout=5.0)
+                assert "send failed" in str(exc.value)
+        finally:
+            link.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# Stats surface
+# ---------------------------------------------------------------------------
+
+
+class TestElasticStats:
+    def test_fleet_section_carries_elastic_signals(self):
+        router, (link,) = make_router(
+            engine_capacity=2, max_pending=8, shed_policy="oldest"
+        )
+        try:
+            scaler = make_scaler(
+                StubRouter(), min_engines=1, max_engines=4
+            )
+            router.autoscaler = scaler
+            fleet = router.stats()["fleet"]
+            assert fleet["jobs_pending"] == 0
+            assert fleet["max_pending"] == 8
+            assert fleet["engine_capacity"] == 2
+            assert fleet["shed_policy"] == "oldest"
+            assert fleet["engines"][0]["health"] == "healthy"
+            assert fleet["autoscale"]["min"] == 1
+            assert fleet["autoscale"]["max"] == 4
+            for key in ("jobs_rejected", "jobs_shed",
+                        "scrape_retries", "engines_quarantined",
+                        "engines_detached"):
+                assert fleet[key] == 0
+        finally:
+            router.close(shutdown_engines=False)
+
+
+# ---------------------------------------------------------------------------
+# Spawned multi-process elastic tier (slow): forced scale smoke + the
+# chaos soak
+# ---------------------------------------------------------------------------
+
+
+def _spawn_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("A5GEN_FAULTS", None)
+    return env
+
+
+def _elastic_fleet(tmp_path, *, n0=1, engine_capacity=2, max_pending=32,
+                   **cfg_kw):
+    eng_dir = str(tmp_path / "engines")
+    eng_args = ["--lanes", "64", "--blocks", "16", "--superstep", "1",
+                "--schema-cache", str(tmp_path / "cache")]
+    env = _spawn_env()
+    router = FleetRouter(poll_s=0.5, defaults=cfg(),
+                         engine_capacity=engine_capacity,
+                         max_pending=max_pending)
+    specs = spawn_engines(n0, eng_dir, engine_args=eng_args, env=env)
+    for sock_path, eid, proc in specs:
+        router.attach(sock_path, eid, proc=proc, timeout=300)
+    counter = [n0]
+
+    def spawner():
+        idx = counter[0]
+        counter[0] += 1
+        (spec,) = spawn_engines(1, eng_dir, engine_args=eng_args,
+                                start_index=idx, env=env)
+        return spec
+
+    cfg_kw.setdefault("interval_s", 0)
+    scaler = Autoscaler(router, spawner, AutoscaleConfig(**cfg_kw))
+    return router, scaler
+
+
+@pytest.mark.slow
+class TestElasticSpawned:
+    def test_forced_scale_up_then_down_with_parity(self, tmp_path):
+        """The CI elastic smoke: a 1-engine fleet under a 3-tenant
+        burst (capacity 1) must scale up to its max of 2, finish every
+        tenant byte-identically to solo, then drain + reap back to the
+        min — spawn and reap both through the REAL process path."""
+        router, scaler = _elastic_fleet(
+            tmp_path, n0=1, engine_capacity=1,
+            min_engines=1, max_engines=2,
+            scale_up_at=1.5, scale_down_at=0.5,
+            up_window=1, down_window=1, cooldown_s=0.0,
+        )
+        try:
+            jobs = {}
+            for i in range(3):
+                digs = planted_digests(BIG_WORDS, (i, -1),
+                                       decoys=30 + i)
+                col = _Collector()
+                jobs[f"j{i}"] = (digs, col)
+                router.submit(job_doc(f"j{i}", BIG_WORDS, digs),
+                              emit=col)
+            assert router.pending_depth() == 2
+            scaler.tick()  # backlog 3 over 1 engine: spawn
+            wait_for(lambda: len(router.engines()) == 2,
+                     timeout=300, what="scale-up")
+            assert router.stats()["fleet"]["autoscale"]["scale_ups"] \
+                == 1
+            deadline = time.monotonic() + 600
+            for jid in jobs:
+                assert router.wait(
+                    jid, timeout=max(1.0, deadline - time.monotonic())
+                ), jid
+                assert router.job(jid).state == "done", jid
+            for jid, (digs, col) in jobs.items():
+                _res, want = solo_hits(BIG_WORDS, digs)
+                assert event_hits(col.events) == want, jid
+            # Idle now: the scaler drains + reaps back to min.
+            scaler.tick()  # down streak 1 -> drain (down_window=1)
+            wait_for(
+                lambda: (scaler.tick() or
+                         len(router.engines()) == 1),
+                timeout=120, what="scale-down reap",
+            )
+            fleet = router.stats()["fleet"]
+            assert fleet["autoscale"]["scale_downs"] == 1
+            assert fleet["engines_detached"] == 1
+            # The reaped engine's process actually exited.
+            assert all(
+                l.proc is None or l.proc.poll() is None
+                for l in router.engines()
+            )
+        finally:
+            router.close(shutdown_engines=True)
+
+    def test_elastic_chaos_soak_seeded_kills_byte_parity(self,
+                                                         tmp_path):
+        """The §27 top-tier contract: M churning tenants while a
+        seeded schedule SIGKILLs engines and the autoscaler scales
+        through it — every tenant finishes with byte-exact hit parity
+        vs solo, the pending queue stays bounded, and the fleet ends
+        with capacity again."""
+        soak_words = WORDS * 40
+        router, scaler = _elastic_fleet(
+            tmp_path, n0=2, engine_capacity=2, max_pending=32,
+            min_engines=1, max_engines=3,
+            scale_up_at=1.5, scale_down_at=0.25,
+            up_window=1, down_window=8, cooldown_s=1.0,
+        )
+        max_seen_pending = [0]
+        stop_sampling = threading.Event()
+
+        def sample():
+            while not stop_sampling.wait(0.05):
+                max_seen_pending[0] = max(
+                    max_seen_pending[0], router.pending_depth()
+                )
+
+        threading.Thread(target=sample, daemon=True).start()
+        ticker_stop = threading.Event()
+
+        def ticker():
+            while not ticker_stop.wait(0.5):
+                scaler.tick()
+
+        threading.Thread(target=ticker, daemon=True).start()
+        try:
+            jobs = {}
+            for i in range(4):
+                digs = planted_digests(soak_words, (i, 5 + i, -1),
+                                       decoys=40 + i)
+                col = _Collector()
+                jobs[f"t{i}"] = (digs, col)
+                router.submit(job_doc(f"t{i}", soak_words, digs),
+                              emit=col)
+            # Seeded kill schedule: SIGKILL the engine carrying t0
+            # once it streams, then (if more than one engine lives)
+            # the one carrying t2.
+            assert jobs["t0"][1].first_hit.wait(300)
+            victim = router.job("t0").link
+            if victim is not None and victim.proc is not None:
+                os.kill(victim.proc.pid, signal.SIGKILL)
+            assert jobs["t2"][1].first_hit.wait(300)
+            live = [l for l in router.engines()
+                    if l.alive and l.proc is not None]
+            second = router.job("t2").link
+            if second is not None and second.proc is not None \
+                    and len(live) > 1 and second.alive:
+                os.kill(second.proc.pid, signal.SIGKILL)
+            for jid, (digs, col) in jobs.items():
+                assert router.wait(jid, timeout=900), jid
+                assert router.job(jid).state == "done", (
+                    jid, router.job(jid).state, col.events[-2:]
+                )
+            for jid, (digs, col) in jobs.items():
+                res, want = solo_hits(soak_words, digs)
+                assert event_hits(col.events) == want, jid
+                (done,) = [e for e in col.events
+                           if e.get("event") == "done"]
+                assert done["n_hits"] == res.n_hits
+            fleet = router.stats()["fleet"]
+            assert fleet["engine_deaths"] >= 1
+            assert fleet["jobs_replayed"] >= 1
+            # Bounded-queue pin: the soak never outgrew max_pending.
+            assert max_seen_pending[0] <= 32
+            assert router.pending_depth() == 0
+            # The fleet self-healed: at least one live engine serves.
+            assert any(l.alive for l in router.engines())
+        finally:
+            ticker_stop.set()
+            stop_sampling.set()
+            router.close(shutdown_engines=True)

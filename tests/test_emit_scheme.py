@@ -362,7 +362,15 @@ class TestHierarchicalPlacement:
                              algo=algo, scalar_units=False)
 
     @pytest.mark.parametrize("mode,algo", [
-        ("default", "md5"), ("default", "ntlm"), ("suball", "md5"),
+        ("default", "md5"),
+        # The NTLM arm's utf16-doubled widths make its interpret-mode
+        # Pallas parity super-linear (~54 s alone — the tier-1 budget's
+        # single worst entry); the md5 arms keep the window/terminator
+        # coverage in the default tier, the NTLM utf16 fold is pinned
+        # by the (fast) gw16/terminator tests above, and CI's slow
+        # steps still run this arm.
+        pytest.param("default", "ntlm", marks=pytest.mark.slow),
+        ("suball", "md5"),
     ])
     def test_window_fuzz_long_words(self, mode, algo):
         # Seeded fuzz at 2-hash-block-like widths: long words × mixed

@@ -252,9 +252,11 @@ class TestStatsPlacementSignals:
 # ---------------------------------------------------------------------------
 
 
-def _stub_link(engine_id, index, resident=(), load=0):
+def _stub_link(engine_id, index, resident=(), load=0,
+               health="healthy"):
     return types.SimpleNamespace(
         engine_id=engine_id, index=index, alive=True, draining=False,
+        health=health,
         scrape={"resident_groups": list(resident),
                 "jobs_runnable": load},
         routed=set(), misses=0,

@@ -325,6 +325,14 @@ class TestFaultHooks:
         from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
         from tools.graftaudit.faults import audit_fault_hooks
 
+        from hashcat_a5_table_generator_tpu.runtime.autoscale import (
+            Autoscaler,
+        )
+        from hashcat_a5_table_generator_tpu.runtime.fleet import (
+            EngineLink,
+            FleetRouter,
+        )
+
         for fn, name in (
             (Sweep._drive_superstep, "Sweep._drive_superstep"),
             (Sweep._dispatch_launch, "Sweep._dispatch_launch"),
@@ -333,8 +341,28 @@ class TestFaultHooks:
             (Engine._build_slot, "Engine._build_slot"),
             (ChunkCompiler._timed, "ChunkCompiler._timed"),
             (save_checkpoint, "save_checkpoint"),
+            (FleetRouter._dispatch, "FleetRouter._dispatch"),
+            (EngineLink.send, "EngineLink.send"),
+            (EngineLink.health_request, "EngineLink.health_request"),
+            (Autoscaler._scale_up, "Autoscaler._scale_up"),
         ):
             assert audit_fault_hooks(fn, name) == [], name
+
+    def test_router_shaped_fixture_variants(self):
+        """The §27 fleet seams' shapes, as fixtures: a guarded hook at
+        a dispatch entry (clean) and a bare hook inside a spawn try
+        (broken) — the audit must distinguish them exactly as it does
+        the drive-loop shapes."""
+        from tools.graftaudit.faults import audit_fault_hooks
+
+        mod = _fixture("fault_hook")
+        assert audit_fault_hooks(
+            mod.clean_router_dispatch_hooked, "fixture.fh"
+        ) == []
+        findings = audit_fault_hooks(
+            mod.broken_spawn_bare_hook, "fixture.fh"
+        )
+        assert [f.check for f in findings] == ["fault-hook"]
 
     def test_production_pump_is_clean_for_pack_round(self):
         """The pump's fault-supervision restructure (PERF.md §23) must

@@ -162,7 +162,25 @@ def test_repo_runtime_is_clean():
     # pass would certify nothing).
     threaded = {m.name for m in models if m.entries}
     assert {"Engine", "FleetRouter", "EngineLink",
-            "ChunkCompiler"} <= threaded
+            "ChunkCompiler", "Autoscaler"} <= threaded
+
+
+def test_autoscaler_queue_discipline_fixture_pair():
+    """The §27 autoscaler's GT003 story, as fixtures: a control loop
+    blocking on its own spawn-ack queue is a wait-for self-cycle
+    (flagged), while the shipped discipline — Event-paced ticks,
+    synchronous spawn, caller-produced request queue drained
+    non-blocking — analyzes clean."""
+    findings, _ = analyze_paths(
+        [str(FIXTURE_DIR / "gt003_autoscale_flag.py")], select=["GT003"]
+    )
+    assert len(findings) == 1
+    assert "_loop" in findings[0].message
+    assert "_spawned" in findings[0].message
+    findings, _ = analyze_paths(
+        [str(FIXTURE_DIR / "gt003_autoscale_ok.py")]
+    )
+    assert not findings, "\n".join(f.render() for f in findings)
 
 
 def test_allowlist_is_live_and_shrink_only():

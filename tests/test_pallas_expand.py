@@ -867,8 +867,13 @@ class TestMultiBlock:
         self._parity(AttackSpec(mode="default", algo="sha1"),
                      [b"assassin-sassafras-aa"])
 
+    @pytest.mark.slow  # 80-round interpret cost: ~31 s even sampled —
+    # the per-lane padding-block select is algo-generic and stays
+    # default-covered by the md5/suball/general samples below; SHA-1
+    # single-block parity stays fast (test_other_algos_match_xla).
     def test_sha1_two_blocks_sampled(self, monkeypatch):
-        # Default-run sample: SHA-1 through the 2-block tail at 146 ranks.
+        # Sample of the slow full run: SHA-1 through the 2-block tail
+        # at 146 ranks.
         import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
 
         monkeypatch.setattr(pe, "_G", 2)

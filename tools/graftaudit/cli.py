@@ -380,7 +380,14 @@ def run_audit(
         from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
             save_checkpoint,
         )
+        from hashcat_a5_table_generator_tpu.runtime.autoscale import (
+            Autoscaler,
+        )
         from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+        from hashcat_a5_table_generator_tpu.runtime.fleet import (
+            EngineLink,
+            FleetRouter,
+        )
         from hashcat_a5_table_generator_tpu.runtime.fuse import FusedGroup
         from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep
 
@@ -394,6 +401,15 @@ def run_audit(
             (Engine._build_slot, "runtime.Engine._build_slot"),
             (ChunkCompiler._timed, "ops.packing.ChunkCompiler._timed"),
             (save_checkpoint, "runtime.checkpoint.save_checkpoint"),
+            # The fleet seams (PERF.md §27): placement, the link's
+            # outbound writes (op stream + health stream), and the
+            # autoscaler's spawn.
+            (FleetRouter._dispatch, "runtime.FleetRouter._dispatch"),
+            (EngineLink.send, "runtime.fleet.EngineLink.send"),
+            (EngineLink.health_request,
+             "runtime.fleet.EngineLink.health_request"),
+            (Autoscaler._scale_up,
+             "runtime.autoscale.Autoscaler._scale_up"),
         ):
             findings.extend(audit_fault_hooks(fn, name))
 
