@@ -23,7 +23,7 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from dataclasses import asdict, dataclass, field
+from dataclasses import asdict, dataclass, field, fields
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -140,6 +140,14 @@ class CheckpointState:
     #: from the cursor.
     stream: Optional[Dict] = None
     version: int = FORMAT_VERSION
+    #: forward-compatibility carry (the replicated-ledger handoff
+    #: guarantee, ROADMAP item 4): unknown fields of a minor-newer
+    #: wire document, preserved verbatim so a
+    #: ``state_from_doc -> state_to_doc`` round trip through THIS
+    #: build — a pause/migrate hop through an older router — never
+    #: strips what a newer engine wrote.  Majors still reject
+    #: (:func:`check_wire_version`).
+    extra: Dict = field(default_factory=dict)
 
 
 def sweep_fingerprint(
@@ -206,9 +214,14 @@ def state_to_doc(state: CheckpointState) -> Dict:
     pause/migrate handoff (a paused job IS its checkpoint; ranks
     stringify because variant spaces exceed JSON's safe ints)."""
     doc = asdict(state)
+    extra = doc.pop("extra")
     doc["wire_version"] = WIRE_VERSION
     doc["cursor"] = {"word": state.cursor.word, "rank": str(state.cursor.rank)}
     doc["hits"] = [[w, str(r)] for w, r in state.hits]
+    # Re-append the unknown fields a minor-newer doc carried; known
+    # keys never lose to a stale carry (setdefault, not overwrite).
+    for k, v in extra.items():
+        doc.setdefault(k, v)
     return doc
 
 
@@ -284,6 +297,7 @@ def state_from_doc(doc: Dict) -> CheckpointState:
     the wire-version major IS validated — see
     :func:`check_wire_version`)."""
     check_wire_version(doc)
+    known = {f.name for f in fields(CheckpointState)} | {"wire_version"}
     return CheckpointState(
         fingerprint=doc["fingerprint"],
         cursor=SweepCursor(
@@ -295,6 +309,7 @@ def state_from_doc(doc: Dict) -> CheckpointState:
         fallback_done=int(doc.get("fallback_done", 0)),
         wall_s=float(doc["wall_s"]),
         stream=doc.get("stream"),
+        extra={k: v for k, v in doc.items() if k not in known},
     )
 
 

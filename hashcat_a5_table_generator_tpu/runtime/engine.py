@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from . import telemetry
 from . import faults as faults_mod
+from . import protocol
 from .checkpoint import CheckpointState, state_from_doc, state_to_doc
 from .sinks import CandidateWriter, HitRecord
 
@@ -1421,40 +1422,34 @@ class _JsonlSession:
         """The settling event for ``job``'s current terminal state."""
         if job.state == "done":
             res = job.result_value
-            done = {
-                "id": job.id, "event": "done",
-                "n_hits": res.n_hits, "n_emitted": res.n_emitted,
-                "wall_s": res.wall_s, "resumed": res.resumed,
-            }
-            if job.ttfc_s is not None:
-                done["ttfc_s"] = job.ttfc_s
-            if res.schema_cache:
-                done["schema_cache"] = res.schema_cache
-            if job.span_summary:
-                done["spans"] = job.span_summary
-            self._emit(done)
+            self._emit(protocol.ev_done(
+                job.id,
+                n_hits=res.n_hits, n_emitted=res.n_emitted,
+                wall_s=res.wall_s, resumed=res.resumed,
+                ttfc_s=job.ttfc_s,
+                schema_cache=res.schema_cache,
+                spans=job.span_summary,
+            ))
         elif job.state == "paused":
-            paused = {
-                "id": job.id, "event": "paused",
-                "checkpoint": state_to_doc(job.checkpoint),
-            }
-            if job.span_summary:
-                paused["spans"] = job.span_summary
-            self._emit(paused)
+            self._emit(protocol.ev_paused(
+                job.id, state_to_doc(job.checkpoint),
+                spans=job.span_summary,
+            ))
         elif job.state == "cancelled":
-            self._emit({"id": job.id, "event": "cancelled"})
+            self._emit(protocol.ev_cancelled(job.id))
         else:
-            failed = {
-                "id": job.id, "event": "failed",
-                "error": f"{type(job.error).__name__}: {job.error}",
-            }
             # Quarantine (PERF.md §23): a failed job's last checkpoint
             # rides the event so the client can resubmit it to another
             # engine ("checkpoint" on a fresh submit) instead of losing
             # the sweep's progress.
-            if job.checkpoint is not None:
-                failed["checkpoint"] = state_to_doc(job.checkpoint)
-            self._emit(failed)
+            self._emit(protocol.ev_failed(
+                job.id,
+                f"{type(job.error).__name__}: {job.error}",
+                checkpoint=(
+                    state_to_doc(job.checkpoint)
+                    if job.checkpoint is not None else None
+                ),
+            ))
 
     def _pump_job(self, job: EngineJob) -> None:
         """Per-job event pump (own thread): stream hits as they land,
@@ -1466,13 +1461,13 @@ class _JsonlSession:
         client_gone = False
         try:
             for rec in job.iter_hits():
-                self._emit({
-                    "id": job.id, "event": "hit",
-                    "digest": rec.digest_hex,
-                    "plain_hex": rec.candidate.hex(),
-                    "word_index": rec.word_index,
-                    "rank": str(rec.variant_rank),
-                })
+                self._emit(protocol.ev_hit(
+                    job.id,
+                    digest=rec.digest_hex,
+                    plain_hex=rec.candidate.hex(),
+                    word_index=rec.word_index,
+                    rank=str(rec.variant_rank),
+                ))
         except (OSError, ValueError):
             client_gone = True
             for _rec in job.iter_hits():
@@ -1497,13 +1492,13 @@ class _JsonlSession:
         # notices.
         if faults_mod.ACTIVE is not None:
             faults_mod.ACTIVE.fire("serve.client")
-        op = doc.get("op", "submit")
+        op = protocol.doc_op(doc)
         jid = doc.get("id")
         if op == "shutdown":
-            self._emit({"event": "bye"})
+            self._emit(protocol.ev_bye())
             return False
         if op == "stats":
-            self._emit({"event": "stats", **self._engine.stats()})
+            self._emit(protocol.ev_stats(self._engine.stats()))
             return True
         if op == "metrics":
             # The observability surface of a RUNNING engine (PERF.md
@@ -1511,11 +1506,9 @@ class _JsonlSession:
             # its Prometheus text exposition — a scrape adapter needs
             # only this op.
             snap = telemetry.snapshot()
-            self._emit({
-                "event": "metrics",
-                "metrics": snap,
-                "prometheus": telemetry.to_prometheus(snap),
-            })
+            self._emit(protocol.ev_metrics(
+                snap, telemetry.to_prometheus(snap)
+            ))
             return True
         if op == "submit":
             kw = _job_from_doc(doc, self._engine.defaults,
@@ -1530,8 +1523,7 @@ class _JsonlSession:
                 raise
             self._jobs[job.id] = job
             self._pumped.add(job.id)
-            self._emit({"id": job.id, "event": "accepted",
-                        "kind": job.kind})
+            self._emit(protocol.ev_accepted(job.id, job.kind))
             threading.Thread(
                 target=self._pump_job, args=(job,),
                 name=f"a5-serve-pump-{job.id}", daemon=True,
@@ -1552,8 +1544,9 @@ class _JsonlSession:
             new = self._engine.resume(job)
             self._jobs[new.id] = new
             self._pumped.add(new.id)
-            self._emit({"id": new.id, "event": "accepted",
-                        "kind": new.kind, "resumed": True})
+            self._emit(protocol.ev_accepted(
+                new.id, new.kind, resumed=True
+            ))
             threading.Thread(
                 target=self._pump_job, args=(new,),
                 name=f"a5-serve-pump-{new.id}", daemon=True,
@@ -1593,17 +1586,15 @@ class _JsonlSession:
                 doc = json.loads(line)
                 keep_going = self._handle(doc)
             except Exception as exc:  # noqa: BLE001 — protocol-scoped
-                err = {
-                    "event": "error",
-                    "error": f"{type(exc).__name__}: {exc}",
-                }
                 # Carry the failing op's job id when it named one: a
                 # routing layer (PERF.md §25) demuxes events by id, so
                 # an id-less error cannot be correlated to the op that
                 # caused it.
-                if isinstance(doc, dict) and doc.get("id") is not None:
-                    err["id"] = doc["id"]
-                self._emit(err)
+                self._emit(protocol.ev_error(
+                    f"{type(exc).__name__}: {exc}",
+                    jid=doc.get("id") if isinstance(doc, dict)
+                    else None,
+                ))
                 continue
             if not keep_going:
                 return True

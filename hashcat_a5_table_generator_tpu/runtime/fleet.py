@@ -95,9 +95,11 @@ import sys
 import threading
 import time
 import zlib
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, \
+    TextIO, Tuple
 
 from . import faults as faults_mod
+from . import protocol
 from . import telemetry
 from .checkpoint import validate_checkpoint_doc
 from .fuse import static_affinity_token
@@ -125,14 +127,9 @@ class FleetOverloaded(FleetError):
         self.retry_after_s = float(retry_after_s)
 
     def event(self, jid: "Optional[str]" = None) -> dict:
-        ev = {
-            "event": "error", "error": "overloaded",
-            "reason": self.reason,
-            "retry_after_s": self.retry_after_s,
-        }
-        if jid is not None:
-            ev["id"] = jid
-        return ev
+        return protocol.ev_error_overloaded(
+            self.reason, self.retry_after_s, jid=jid
+        )
 
 
 #: The health ladder's states (PERF.md §27), in degradation order.
@@ -175,7 +172,8 @@ class EngineLink:
     connection, so one in-flight request per link (serialized by
     ``_ctl_lock``) correlates exactly."""
 
-    def __init__(self, sock, endpoint: str, engine_id: str, *,
+    def __init__(self, sock: socket.socket, endpoint: str,
+                 engine_id: str, *,
                  proc: "Optional[subprocess.Popen]" = None,
                  index: int = 0,
                  on_event: Optional[Callable] = None,
@@ -244,7 +242,7 @@ class EngineLink:
 
     @classmethod
     def connect(cls, endpoint: str, engine_id: Optional[str] = None,
-                *, timeout: float = 180.0, **kw) -> "EngineLink":
+                *, timeout: float = 180.0, **kw: Any) -> "EngineLink":
         """Connect to an engine's unix socket, retrying until it is
         listening (a freshly spawned engine binds only after its jax
         import)."""
@@ -307,16 +305,18 @@ class EngineLink:
                     f"engine {self.engine_id}: send failed ({exc})"
                 ) from exc
             except queue.Empty:
-                if doc.get("op") in ("stats", "metrics", "shutdown"):
+                if protocol.doc_op(doc) in (
+                    "stats", "metrics", "shutdown"
+                ):
                     with self._skip_lock:
                         self._skip_replies += 1
                 raise FleetError(
                     f"engine {self.engine_id}: no reply to "
-                    f"{doc.get('op', 'submit')!r} in {timeout:g}s"
+                    f"{protocol.doc_op(doc)!r} in {timeout:g}s"
                 ) from None
             finally:
                 self._waiter = None
-        if ev.get("event") == "error":
+        if protocol.doc_event(ev) == "error":
             raise FleetError(
                 f"engine {self.engine_id}: {ev.get('error')}"
             )
@@ -405,7 +405,7 @@ class EngineLink:
                 waiter = self._waiter
                 if jid is not None and not (
                     waiter is not None
-                    and ev.get("event") in ("accepted", "error")
+                    and protocol.doc_event(ev) in ("accepted", "error")
                     and jid == waiter[0]
                 ):
                     if self._on_event is not None:
@@ -429,10 +429,9 @@ class EngineLink:
             self.alive = False
             waiter = self._waiter
             if waiter is not None:
-                waiter[1].put({
-                    "event": "error",
-                    "error": "engine connection lost",
-                })
+                waiter[1].put(
+                    protocol.ev_error("engine connection lost")
+                )
             if not self._closing and self._on_death is not None:
                 self._on_death(self)
 
@@ -537,7 +536,7 @@ class FleetRouter:
 
     def __init__(self, *, place: str = "affinity",
                  replay_budget: int = 1, poll_s: float = 2.0,
-                 poll_misses: int = 3, defaults=None,
+                 poll_misses: int = 3, defaults: Optional[Any] = None,
                  control_timeout: float = 120.0,
                  engine_capacity: int = 0, max_pending: int = 256,
                  per_tenant: int = 0, shed_policy: str = "reject",
@@ -656,7 +655,7 @@ class FleetRouter:
         link._closing = True
         if shutdown and link.alive:
             try:
-                link.request({"op": "shutdown"}, timeout=timeout)
+                link.request(protocol.op_shutdown(), timeout=timeout)
             except FleetError:
                 pass
         link.close()
@@ -704,7 +703,7 @@ class FleetRouter:
                 break
         d = self._defaults
 
-        def field(key, attr, fallback):
+        def field(key: str, attr: str, fallback: Any) -> Any:
             if key in cfg:
                 return cfg[key]
             if key in scraped:
@@ -821,7 +820,7 @@ class FleetRouter:
         sdoc = {k: v for k, v in doc.items()
                 if k not in ("checkpoint", "replay_mute")}
         sdoc["id"] = jid
-        sdoc["op"] = "submit"
+        protocol.op_submit(sdoc)
         job = RoutedJob(jid, kind, sdoc, self._doc_token(sdoc), emit)
         job.checkpoint = ck
         job.n_forwarded = int(doc.get("replay_mute", 0))
@@ -927,8 +926,7 @@ class FleetRouter:
             telemetry.counter("fleet.jobs_rejected").add(1)
             raise overloaded
         telemetry.counter("fleet.jobs_queued").add(1)
-        return {"id": job.id, "event": "accepted", "kind": job.kind,
-                "queued": True}
+        return protocol.ev_accepted(job.id, job.kind, queued=True)
 
     def _retry_after_locked(self) -> float:
         depth = len(self._pending)
@@ -961,21 +959,19 @@ class FleetRouter:
         (checkpoint attached when the router holds one — a shed
         migrate-in loses no progress)."""
         telemetry.counter("fleet.jobs_shed").add(1)
-        ev = {
-            "id": job.id, "event": "failed", "error": "overloaded",
-            "reason": reason,
-            "retry_after_s": self._retry_after(),
-        }
-        if job.checkpoint is not None:
-            ev["checkpoint"] = job.checkpoint
-        self._forward(job, ev)
+        self._forward(job, protocol.ev_failed(
+            job.id, "overloaded",
+            reason=reason,
+            retry_after_s=self._retry_after(),
+            checkpoint=job.checkpoint,
+        ))
         self._settle(job, "failed")
 
     def pause(self, jid: str) -> None:
         job = self._job(jid)
         if job.state != "routed" or job.link is None:
             raise FleetError(f"job {jid!r} is {job.state}, not running")
-        job.link.send({"op": "pause", "id": jid})
+        job.link.send(protocol.op_pause(jid))
 
     def resume(self, jid: str) -> dict:
         """Re-place a paused job from its router-held checkpoint;
@@ -995,8 +991,9 @@ class FleetRouter:
             # dispatched by the pump right now): the retry is
             # idempotent — never a second pending entry or a second
             # dispatch of a running id.
-            return {"id": jid, "event": "accepted", "kind": job.kind,
-                    "queued": True, "resumed": True}
+            return protocol.ev_accepted(
+                jid, job.kind, queued=True, resumed=True
+            )
         if not paused:
             raise FleetError(f"job {jid!r} is {job.state}, not paused")
         try:
@@ -1012,7 +1009,7 @@ class FleetRouter:
     def cancel(self, jid: str) -> None:
         job = self._job(jid)
         if job.state == "routed" and job.link is not None:
-            job.link.send({"op": "cancel", "id": jid})
+            job.link.send(protocol.op_cancel(jid))
             return
         with self._lock:
             # Claim-by-removal: once this cancel takes the job OFF the
@@ -1026,7 +1023,7 @@ class FleetRouter:
         if (job.state == "paused" and not claimed) or queued:
             # Nothing runs engine-side (paused, or still admission-
             # queued): settle here and tell the client ourselves.
-            self._forward(job, {"id": jid, "event": "cancelled"})
+            self._forward(job, protocol.ev_cancelled(jid))
             self._settle(job, "cancelled")
             return
         raise FleetError(f"job {jid!r} is {job.state}")
@@ -1045,19 +1042,20 @@ class FleetRouter:
         if engine_id is not None:
             self._resolve(engine_id)  # fail loudly before pausing
             if engine_id == job.link.engine_id:
-                return {"id": jid, "event": "migrating",
-                        "from": engine_id, "to": engine_id,
-                        "noop": True}
+                return protocol.ev_migrating(
+                    jid, frm=engine_id, to=engine_id, noop=True
+                )
         job.target = engine_id
         job.migrating = True
         telemetry.counter("fleet.migrations").add(1)
         if job.kind == "crack":
-            job.link.send({"op": "pause", "id": jid})
+            job.link.send(protocol.op_pause(jid))
         else:
-            job.link.send({"op": "cancel", "id": jid})
-        return {"id": jid, "event": "migrating",
-                "from": job.link.engine_id,
-                "to": engine_id or "(placement)"}
+            job.link.send(protocol.op_cancel(jid))
+        return protocol.ev_migrating(
+            jid, frm=job.link.engine_id,
+            to=engine_id or "(placement)",
+        )
 
     def drain(self, engine_id: str) -> dict:
         """Empty one engine for shutdown: no new placements land on
@@ -1073,8 +1071,7 @@ class FleetRouter:
             ]
         for jid in jids:
             self.migrate(jid)
-        return {"event": "draining", "engine": engine_id,
-                "jobs": len(jids)}
+        return protocol.ev_draining(engine_id, len(jids))
 
     def stats(self) -> dict:
         """The fleet's merged ``stats`` event: per-engine scrapes
@@ -1146,11 +1143,7 @@ class FleetRouter:
         scaler = self.autoscaler
         if scaler is not None:
             fleet["autoscale"] = scaler.describe()
-        return {
-            "event": "stats",
-            **agg,
-            "fleet": fleet,
-        }
+        return protocol.ev_stats(agg, fleet=fleet)
 
     def metrics(self) -> dict:
         """Merged registry scrape: every live engine's snapshot (each
@@ -1162,18 +1155,16 @@ class FleetRouter:
             if not link.alive:
                 continue
             try:
-                ev = link.request({"op": "metrics"},
+                ev = link.request(protocol.op_metrics(),
                                   timeout=self._control_timeout)
             except FleetError:
                 continue
             snaps.append(ev.get("metrics") or {})
         snaps.append(telemetry.snapshot())
         merged = telemetry.merge(snaps)
-        return {
-            "event": "metrics",
-            "metrics": merged,
-            "prometheus": telemetry.to_prometheus(merged),
-        }
+        return protocol.ev_metrics(
+            merged, telemetry.to_prometheus(merged)
+        )
 
     def passthrough(self, doc: dict) -> None:
         """Forward an op the router does not interpret to the engine
@@ -1209,7 +1200,8 @@ class FleetRouter:
             link._closing = True
             if shutdown_engines and link.alive:
                 try:
-                    link.request({"op": "shutdown"}, timeout=timeout)
+                    link.request(protocol.op_shutdown(),
+                                 timeout=timeout)
                 except FleetError:
                     pass
             link.close()
@@ -1223,12 +1215,12 @@ class FleetRouter:
     def __enter__(self) -> "FleetRouter":
         return self
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, *exc: object) -> None:
         self.close()
 
     # -- internals -----------------------------------------------------
 
-    def _job(self, jid) -> RoutedJob:
+    def _job(self, jid: str) -> RoutedJob:
         with self._lock:
             job = self._jobs.get(jid)
         if job is None:
@@ -1444,14 +1436,12 @@ class FleetRouter:
 
     def _fail_unplaceable(self, job: RoutedJob,
                           exc: Exception) -> None:
-        ev = {"id": job.id, "event": "failed",
-              "error": f"FleetError: {exc}"}
-        if job.checkpoint is not None:
-            ev["checkpoint"] = job.checkpoint
         # Forward BEFORE settling (here and in the event plane): a
         # caller woken by ``wait()`` must find the terminal event
         # already delivered.
-        self._forward(job, ev)
+        self._forward(job, protocol.ev_failed(
+            job.id, f"FleetError: {exc}", checkpoint=job.checkpoint,
+        ))
         self._settle(job, "failed")
 
     # -- engine event plane (link reader threads) ----------------------
@@ -1461,7 +1451,7 @@ class FleetRouter:
             job = self._jobs.get(ev.get("id"))
         if job is None or job.link is not link:
             return  # stale event from an engine the job left
-        event = ev.get("event")
+        event = protocol.doc_event(ev)
         if event == "hit":
             job.n_forwarded += 1
             self._forward(job, ev)
@@ -1477,12 +1467,12 @@ class FleetRouter:
                 try:
                     validate_checkpoint_doc(ck)
                 except ValueError as exc:
-                    self._forward(job, {
-                        "id": job.id, "event": "failed",
-                        "error": f"{type(exc).__name__}: {exc} "
-                                 "(checkpoint captured on pause "
-                                 "failed validation)",
-                    })
+                    self._forward(job, protocol.ev_failed(
+                        job.id,
+                        f"{type(exc).__name__}: {exc} "
+                        "(checkpoint captured on pause "
+                        "failed validation)",
+                    ))
                     self._settle(job, "failed")
                     return
             job.checkpoint = ck
@@ -1609,8 +1599,9 @@ class FleetRouter:
         with telemetry.stopwatch(
             "fleet.scrape_s", edges=(0.01, 0.05, 0.25, 1.0, 5.0)
         ) as sw:
-            ev = link.health_request({"op": "stats"}, timeout=timeout)
-        if ev.get("event") == "error":
+            ev = link.health_request(protocol.op_stats(),
+                                     timeout=timeout)
+        if protocol.doc_event(ev) == "error":
             raise FleetError(
                 f"engine {link.engine_id}: {ev.get('error')}"
             )
@@ -1781,7 +1772,7 @@ def spawn_engines(n: int, directory: str, *,
                   engine_id_prefix: str = "eng",
                   start_index: int = 0,
                   env: Optional[dict] = None,
-                  stderr=subprocess.DEVNULL
+                  stderr: Any = subprocess.DEVNULL
                   ) -> List[Tuple[str, str, subprocess.Popen]]:
     """Spawn ``n`` local ``a5gen serve`` engine processes, each on its
     own unix socket under ``directory``, all sharing ``engine_args``
@@ -1836,7 +1827,8 @@ class _RouterSession:
     #: this is dropped (see ``_emit``).
     OUT_DEPTH = 4096
 
-    def __init__(self, router: FleetRouter, fin, fout) -> None:
+    def __init__(self, router: FleetRouter, fin: TextIO,
+                 fout: TextIO) -> None:
         self._router = router
         self._fin = fin
         self._fout = fout
@@ -1882,10 +1874,10 @@ class _RouterSession:
             ) from None
 
     def _handle(self, doc: dict) -> bool:
-        op = doc.get("op", "submit")
+        op = protocol.doc_op(doc)
         jid = doc.get("id")
         if op == "shutdown":
-            self._emit({"event": "bye"})
+            self._emit(protocol.ev_bye())
             return False
         if op == "stats":
             self._emit(self._router.stats())
@@ -1895,24 +1887,22 @@ class _RouterSession:
             return True
         if op == "submit":
             ack = self._router.submit(doc, emit=self._emit)
-            out = {
-                "id": ack.get("id", jid), "event": "accepted",
-                "kind": ack.get("kind"), "engine": ack.get("engine"),
-            }
-            if ack.get("queued"):
-                # Admission-queued (PERF.md §27): accepted, not yet
-                # placed — the client's events flow once it dispatches.
-                out["queued"] = True
-            self._emit(out)
+            # Admission-queued (PERF.md §27): accepted, not yet
+            # placed — the client's events flow once it dispatches.
+            self._emit(protocol.ev_accepted(
+                ack.get("id", jid), ack.get("kind"),
+                engine=ack.get("engine"),
+                queued=bool(ack.get("queued")),
+            ))
             return True
         if op == "pause":
             self._router.pause(jid)
         elif op == "resume":
             ack = self._router.resume(jid)
-            self._emit({
-                "id": jid, "event": "accepted",
-                "kind": ack.get("kind"), "resumed": True,
-            })
+            self._emit(protocol.ev_accepted(
+                jid, ack.get("kind"),
+                queued=bool(ack.get("queued")), resumed=True,
+            ))
         elif op == "cancel":
             self._router.cancel(jid)
         elif op == "migrate":
@@ -1962,15 +1952,14 @@ class _RouterSession:
                         return False
                     continue
                 except Exception as exc:  # noqa: BLE001 — protocol
-                    err = {
-                        "event": "error",
-                        "error": f"{type(exc).__name__}: {exc}",
-                    }
                     # Id-carrying like the engine session's errors —
                     # clients correlate failures to the op that caused
                     # them (CONTRIBUTING: router-passthrough-safe).
-                    if isinstance(doc, dict) and doc.get("id") is not None:
-                        err["id"] = doc["id"]
+                    err = protocol.ev_error(
+                        f"{type(exc).__name__}: {exc}",
+                        jid=(doc.get("id")
+                             if isinstance(doc, dict) else None),
+                    )
                     try:
                         self._emit(err)
                     except OSError:
@@ -1987,7 +1976,8 @@ class _RouterSession:
             self._dead = True
 
 
-def serve_fleet_stdio(router: FleetRouter, fin, fout) -> None:
+def serve_fleet_stdio(router: FleetRouter, fin: TextIO,
+                      fout: TextIO) -> None:
     """Serve one JSONL command stream against the router."""
     _RouterSession(router, fin, fout).run()
 
@@ -2016,7 +2006,7 @@ def serve_fleet_socket(router: FleetRouter, path: str, *,
             except socket.timeout:
                 continue
 
-            def _session(conn=conn) -> None:
+            def _session(conn: socket.socket = conn) -> None:
                 with conn:
                     fin = conn.makefile("r", encoding="utf-8")
                     fout = conn.makefile("w", encoding="utf-8")

@@ -4,7 +4,7 @@
 #   scripts/lint.sh            # run everything available
 #   scripts/lint.sh --require-all   # fail if ruff/mypy are missing (CI)
 #
-# Five layers, any failure fails the script:
+# Six layers, any failure fails the script:
 #   1. ruff      — pyflakes + pycodestyle errors ([tool.ruff] in pyproject)
 #   2. mypy      — typed public API, strict on leaf modules ([tool.mypy])
 #   3. graftlint — repo-specific JAX/Pallas AST rules (tools/graftlint),
@@ -21,11 +21,18 @@
 #                  (tools/graftrace): unguarded shared writes,
 #                  lock-order cycles, queue wait-for cycles, router
 #                  passthrough — PERF.md §26.
+#   6. graftwire — wire-protocol contract audit over the serve/fleet
+#                  JSONL plane (tools/graftwire): emitted/dispatched
+#                  docs vs the runtime/protocol.py registry, the
+#                  router↔engine handler matrix, required-field and
+#                  dead-read checks, envelope-key sprawl, and drift vs
+#                  the committed PROTOCOL.json pin — PERF.md §25/§27.
 #
 # ruff and mypy are OPTIONAL locally (the TPU dev containers bake only the
 # jax toolchain; nothing may be pip-installed there) and mandatory in CI
-# via --require-all. graftlint and graftrace are stdlib-only and always
-# run; graftaudit needs jax (always present — the core dependency).
+# via --require-all. graftlint, graftrace and graftwire are stdlib-only
+# and always run; graftaudit needs jax (always present — the core
+# dependency).
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -73,6 +80,12 @@ fi
 echo "== graftrace =="
 if ! python -m tools.graftrace; then
     echo "lint.sh: graftrace FAILED" >&2
+    fail=1
+fi
+
+echo "== graftwire =="
+if ! python -m tools.graftwire; then
+    echo "lint.sh: graftwire FAILED" >&2
     fail=1
 fi
 

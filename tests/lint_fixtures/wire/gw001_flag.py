@@ -1,0 +1,39 @@
+"""GW001 fixture: emitted/dispatched op-event not in the registry.
+
+Embeds a miniature registry (this file is its own registry source, the
+fixture pattern graftwire's registry detection supports) and then
+emits an event the registry never declared, dispatches an undeclared
+op, and calls a constructor with no registry entry.
+"""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+}
+
+WIRE_EVENTS = {
+    "done": {"required": ["id"], "optional": [],
+             "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+def ev_vanished(jid):
+    return {"id": jid, "event": "vanished"}  # GW001: undeclared event
+
+
+class _Session:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "frobnicate":  # GW001: undeclared op dispatched
+            return None
+        return None
+
+    def emit_ack(self, jid):
+        self._send({"id": jid, "event": "acked"})  # GW001
+
+    def _send(self, ev):
+        raise NotImplementedError

@@ -1,0 +1,39 @@
+"""GW001 clean twin: every emitted/dispatched name is declared."""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+    "frobnicate": {"required": ["id"], "optional": [],
+                   "handlers": ["engine"]},
+}
+
+WIRE_EVENTS = {
+    "done": {"required": ["id"], "optional": [],
+             "emitters": ["engine"], "route": "dispatch"},
+    "vanished": {"required": ["id"], "optional": [],
+                 "emitters": ["engine"], "route": "passthrough"},
+    "acked": {"required": ["id"], "optional": [],
+              "emitters": ["engine"], "route": "passthrough"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+def ev_vanished(jid):
+    return {"id": jid, "event": "vanished"}
+
+
+class _Session:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "frobnicate":
+            return None
+        return None
+
+    def emit_ack(self, jid):
+        self._send({"id": jid, "event": "acked"})
+
+    def _send(self, ev):
+        raise NotImplementedError

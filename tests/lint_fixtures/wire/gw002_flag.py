@@ -1,0 +1,41 @@
+"""GW002 fixture: declared op/event with no handler at its role.
+
+The registry declares op ``frob`` with the engine role as a handler,
+but ``_JsonlSession._handle`` never decides it; event ``pulse`` routes
+as ``dispatch``, but ``_on_job_event`` never decides it either.
+"""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+    "frob": {"required": ["id"], "optional": [],
+             "handlers": ["engine"]},  # GW002: engine never handles it
+}
+
+WIRE_EVENTS = {
+    "done": {"required": ["id"], "optional": [],
+             "emitters": ["engine"], "route": "dispatch"},
+    "pulse": {"required": ["id"], "optional": [],
+              "emitters": ["engine"],
+              "route": "dispatch"},  # GW002: chain never decides it
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+class _JsonlSession:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "submit":
+            return True
+        return True
+
+
+class _Router:
+    def _on_job_event(self, link, ev):
+        event = ev.get("event")
+        if event == "done":
+            return None
+        return None

@@ -1,0 +1,37 @@
+"""GW002 clean twin: every declared handler obligation is met."""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+    "frob": {"required": ["id"], "optional": [],
+             "handlers": ["engine"]},
+}
+
+WIRE_EVENTS = {
+    "done": {"required": ["id"], "optional": [],
+             "emitters": ["engine"], "route": "dispatch"},
+    "pulse": {"required": ["id"], "optional": [],
+              "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+class _JsonlSession:
+    def _handle(self, doc):
+        op = doc.get("op", "submit")
+        if op == "submit":
+            return True
+        if op == "frob":
+            return True
+        return True
+
+
+class _Router:
+    def _on_job_event(self, link, ev):
+        event = ev.get("event")
+        if event in ("done", "pulse"):
+            return None
+        return None

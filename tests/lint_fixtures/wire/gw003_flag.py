@@ -1,0 +1,29 @@
+"""GW003 fixture: inline wire doc missing a declared-required field.
+
+A ``failed`` without ``error`` and a ``hit`` without ``id`` — the two
+shapes the check exists to catch before a client hangs on them.
+"""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+}
+
+WIRE_EVENTS = {
+    "failed": {"required": ["id", "error"], "optional": ["reason"],
+               "emitters": ["engine"], "route": "dispatch"},
+    "hit": {"required": ["id", "digest"], "optional": [],
+            "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+def fail(jid):
+    return {"id": jid, "event": "failed"}  # GW003: no "error"
+
+
+def hit(digest):
+    return {"event": "hit", "digest": digest}  # GW003: no "id"

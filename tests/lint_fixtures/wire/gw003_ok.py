@@ -1,0 +1,30 @@
+"""GW003 clean twin: every required field present (or the doc is
+``**``-spread open, which the AST cannot enumerate and must skip)."""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+}
+
+WIRE_EVENTS = {
+    "failed": {"required": ["id", "error"], "optional": ["reason"],
+               "emitters": ["engine"], "route": "dispatch"},
+    "hit": {"required": ["id", "digest"], "optional": [],
+            "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+def fail(jid, exc):
+    return {"id": jid, "event": "failed", "error": str(exc)}
+
+
+def hit(jid, digest):
+    return {"id": jid, "event": "hit", "digest": digest}
+
+
+def forwarded(base):
+    return {"event": "failed", **base}  # open doc: fields unknowable

@@ -1,0 +1,31 @@
+"""GW004 fixture: handler reads a field no declared sender can set.
+
+``_handle`` dispatches only ``submit`` yet reads ``ghost`` — a field
+no declared op carries, so the read sees its default forever.
+"""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id", "payload"],
+               "handlers": ["engine"], "default": True},
+}
+
+WIRE_EVENTS = {
+    "done": {"required": ["id"], "optional": [],
+             "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+def doc_op(doc):
+    return doc.get("op", "submit")
+
+
+class _Session:
+    def _handle(self, doc):
+        op = doc_op(doc)
+        if op == "submit":
+            return doc.get("ghost")  # GW004: nobody sets "ghost"
+        return None

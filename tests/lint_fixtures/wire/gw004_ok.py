@@ -1,0 +1,27 @@
+"""GW004 clean twin: every handler read is a declared field."""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id", "payload"],
+               "handlers": ["engine"], "default": True},
+}
+
+WIRE_EVENTS = {
+    "done": {"required": ["id"], "optional": [],
+             "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}
+
+
+def doc_op(doc):
+    return doc.get("op", "submit")
+
+
+class _Session:
+    def _handle(self, doc):
+        op = doc_op(doc)
+        if op == "submit":
+            return doc.get("payload")
+        return None

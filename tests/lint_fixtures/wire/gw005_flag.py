@@ -1,0 +1,24 @@
+"""GW005 fixture: raw envelope-key literals outside the registry.
+
+No miniature registry here — this file is NOT a registry source, so
+every raw ``"op"``/``"event"`` KEY use below is sprawl: a dict key, a
+``.get`` read, a subscript write, and a containment test.  Op/event
+VALUE strings (``op == "submit"``) stay legal — graftrace GT004
+extracts exactly those.
+"""
+
+
+def submit(sdoc, send):
+    sdoc["op"] = "submit"            # GW005: subscript key
+    send(sdoc)
+
+
+def dispatch(doc):
+    op = doc.get("op", "submit")     # GW005: .get read
+    if "event" in doc:               # GW005: containment test
+        return None
+    return op
+
+
+def ack(jid):
+    return {"id": jid, "event": "accepted"}  # GW005: dict key

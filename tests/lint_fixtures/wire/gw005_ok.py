@@ -1,0 +1,22 @@
+"""GW005 clean twin: emissions via constructors, reads via helpers.
+
+The value strings in the dispatch chain are legal — GW005 bans only
+the envelope KEY literals.
+"""
+
+import json
+
+
+def submit(sdoc, send, protocol):
+    send(protocol.op_submit(sdoc))
+
+
+def dispatch(doc, protocol):
+    op = protocol.doc_op(doc)
+    if op == "submit":
+        return "submitting"
+    return json.dumps({"id": doc["id"]})
+
+
+def ack(jid, protocol):
+    return protocol.ev_accepted(jid, "crack")

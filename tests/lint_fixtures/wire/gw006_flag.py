@@ -1,0 +1,25 @@
+"""GW006 fixture: live registry drifted from the committed pin.
+
+Paired with ``gw006_pin.json``, which pins neither the ``probe`` op
+this registry adds nor the ``retry_after_s`` field on ``failed`` —
+drift in the addition direction.  (``gw006_ok.py`` matches the pin
+exactly.)  Driven with ``--protocol-json`` / ``pin_path`` so the
+repo's real PROTOCOL.json never leaks into the fixture.
+"""
+
+PROTOCOL_VERSION = "1.1"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+    "probe": {"required": ["id"], "optional": [],
+              "handlers": ["engine"]},  # GW006: not in the pin
+}
+
+WIRE_EVENTS = {
+    "failed": {"required": ["id", "error"],
+               "optional": ["retry_after_s"],  # GW006: not pinned
+               "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}

@@ -1,0 +1,15 @@
+"""GW006 clean twin: the registry matches ``gw006_pin.json``."""
+
+PROTOCOL_VERSION = "1.0"
+
+WIRE_OPS = {
+    "submit": {"required": [], "optional": ["id"],
+               "handlers": ["engine"], "default": True},
+}
+
+WIRE_EVENTS = {
+    "failed": {"required": ["id", "error"], "optional": [],
+               "emitters": ["engine"], "route": "dispatch"},
+}
+
+CHECKPOINT_WIRE = {"version": "1.0", "required": ["fingerprint"]}

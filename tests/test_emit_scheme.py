@@ -362,13 +362,13 @@ class TestHierarchicalPlacement:
                              algo=algo, scalar_units=False)
 
     @pytest.mark.parametrize("mode,algo", [
-        ("default", "md5"),
         # The NTLM arm's utf16-doubled widths make its interpret-mode
-        # Pallas parity super-linear (~54 s alone — the tier-1 budget's
-        # single worst entry); the md5 arms keep the window/terminator
-        # coverage in the default tier, the NTLM utf16 fold is pinned
-        # by the (fast) gw16/terminator tests above, and CI's slow
-        # steps still run this arm.
+        # Pallas parity super-linear (~54 s alone), and the default-md5
+        # arm costs another ~27 s; the suball-md5 arm keeps the
+        # window/terminator coverage in the default tier, the NTLM
+        # utf16 fold is pinned by the (fast) gw16/terminator tests
+        # above, and CI's slow steps still run both marked arms.
+        pytest.param("default", "md5", marks=pytest.mark.slow),
         pytest.param("default", "ntlm", marks=pytest.mark.slow),
         ("suball", "md5"),
     ])

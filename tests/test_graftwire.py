@@ -1,0 +1,511 @@
+"""graftwire (PERF.md §25–§27): the wire-protocol contract audit.
+
+Static half: every GW check must both FLAG its broken fixture and stay
+quiet on the clean twin (``tests/lint_fixtures/wire/``), the shipped
+serve/fleet tier must analyze clean (the lint.sh layer-6 gate as a
+test, asserted NON-vacuous via the extraction counters), and the
+committed ``PROTOCOL.json`` pin must match the live registry (with the
+``--update-protocol`` bump rule unit-tested).
+
+Dynamic half: the ``runtime/protocol.py`` constructors must be
+emission-identical to the historical inline dicts — ``json.dumps`` key
+order IS the wire bytes the fleet parity suites pin — and the
+checkpoint wire doc must round-trip unknown minor-newer fields
+(``state_from_doc -> state_to_doc``), the replicated-ledger handoff
+guarantee ROADMAP item 4 depends on.
+
+Everything here is fast-tier: AST analysis plus pure-dict assertions,
+no engines, no JAX compilation.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from hashcat_a5_table_generator_tpu.runtime import protocol  # noqa: E402
+from hashcat_a5_table_generator_tpu.runtime.checkpoint import (  # noqa: E402
+    CheckpointState,
+    CheckpointWireIncompatible,
+    SweepCursor,
+    state_from_doc,
+    state_to_doc,
+    validate_checkpoint_doc,
+)
+from tools.graftwire import (  # noqa: E402
+    ALL_CHECKS,
+    analyze_paths,
+    analyze_sources,
+)
+from tools.graftwire.allowlist import ALLOWLIST  # noqa: E402
+from tools.graftwire.cli import DEFAULT_PATHS  # noqa: E402
+from tools.graftwire.registry import (  # noqa: E402
+    PinChange,
+    check_bump,
+    load_repo_registry,
+    registry_to_pin,
+)
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent / "lint_fixtures" \
+    / "wire"
+CODES = sorted(ALL_CHECKS)
+RUNTIME_PATHS = [str(REPO_ROOT / p) for p in DEFAULT_PATHS]
+GW006_PIN = str(FIXTURE_DIR / "gw006_pin.json")
+
+
+def _fixture_kwargs(code):
+    """GW006 diffs against its OWN fixture pin, never the repo's."""
+    if code == "GW006":
+        return {"pin_path": GW006_PIN}
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_check_flags_its_hazard(code):
+    path = FIXTURE_DIR / f"{code.lower()}_flag.py"
+    findings, _model = analyze_paths(
+        [str(path)], select=[code], **_fixture_kwargs(code)
+    )
+    assert findings, f"{code} did not flag its broken fixture"
+    assert all(f.code == code for f in findings)
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_check_passes_the_clean_twin(code):
+    path = FIXTURE_DIR / f"{code.lower()}_ok.py"
+    findings, _model = analyze_paths(
+        [str(path)], select=[code], **_fixture_kwargs(code)
+    )
+    assert not findings, (
+        f"{code} false-positived on its clean twin: "
+        + "; ".join(f.render() for f in findings)
+    )
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_fixture_pair_exists(code):
+    for kind in ("flag", "ok"):
+        assert (FIXTURE_DIR / f"{code.lower()}_{kind}.py").is_file()
+
+
+def test_gw003_open_doc_is_skipped():
+    """A ``**``-spread doc carries fields the AST cannot enumerate —
+    it must not false-positive GW003 (the router's forwarded events
+    are exactly this shape)."""
+    src = (
+        'WIRE_OPS = {}\n'
+        'WIRE_EVENTS = {"failed": {"required": ["id", "error"],\n'
+        '               "optional": [], "emitters": ["engine"],\n'
+        '               "route": "dispatch"}}\n'
+        'def fwd(base):\n'
+        '    return {"event": "failed", **base}\n'
+    )
+    findings, _ = analyze_sources(
+        [(src, "virt/open.py")], select=["GW003"]
+    )
+    assert not findings
+
+
+def test_gw005_value_strings_stay_legal():
+    """GW005 bans envelope KEY literals only: a dispatch chain's op
+    VALUE strings (what graftrace GT004 extracts) must not trip it."""
+    src = (
+        "def dispatch(op):\n"
+        "    if op == 'submit':\n"
+        "        return 1\n"
+        "    if op in ('pause', 'resume'):\n"
+        "        return 2\n"
+        "    return 0\n"
+    )
+    findings, _ = analyze_sources(
+        [(src, "virt/values.py")], select=["GW005"]
+    )
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# The repo-clean gate (non-vacuous)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_runtime_is_clean():
+    """The gate scripts/lint.sh layer 6 enforces, as a test: the
+    serve/fleet tier must analyze clean against the live registry and
+    the committed PROTOCOL.json."""
+    findings, model = analyze_paths(RUNTIME_PATHS)
+    assert not findings, "\n".join(f.render() for f in findings)
+    # Non-vacuity: the extraction actually saw the protocol surfaces.
+    assert model.registry is not None
+    assert model.registry.path.endswith("protocol.py")
+    assert len(model.registry.ops) >= 9
+    assert len(model.registry.events) >= 12
+    assert model.n_docs >= 30, "emission extraction went blind"
+    assert model.n_dispatches >= 20, "dispatch extraction went blind"
+    assert model.n_reads >= 20, "handler-read extraction went blind"
+    owners = {
+        d.owner
+        for fs in model.surfaces
+        for d in fs.dispatches
+    }
+    assert "_JsonlSession._handle" in owners
+    assert "_RouterSession._handle" in owners
+    assert any(o.endswith("._on_job_event") for o in owners)
+    assert model.pin is not None, "PROTOCOL.json not loaded"
+    assert model.changes == []
+
+
+def test_registry_extraction_matches_import():
+    """The AST-extracted registry IS the imported module's (the
+    pure-literal contract): drift between the two would mean graftwire
+    audits a phantom protocol."""
+    reg = load_repo_registry()
+    assert reg.version == protocol.PROTOCOL_VERSION
+    assert reg.ops == protocol.WIRE_OPS
+    assert reg.events == protocol.WIRE_EVENTS
+    assert reg.checkpoint == protocol.CHECKPOINT_WIRE
+
+
+def test_protocol_pin_matches_live_registry():
+    pin = json.loads((REPO_ROOT / "PROTOCOL.json").read_text())
+    assert pin == registry_to_pin(load_repo_registry())
+
+
+def test_allowlist_is_live_and_shrink_only():
+    """Every grandfather entry must still match a real finding: once
+    the pattern is fixed, the entry MUST be deleted (shrink-only)."""
+    findings, _ = analyze_paths(RUNTIME_PATHS, use_allowlist=False)
+    for (suffix, key), why in ALLOWLIST.items():
+        assert why.strip(), f"allowlist entry {key} needs a reason"
+        assert any(
+            f.path.replace("\\", "/").endswith(suffix) and f.key == key
+            for f in findings
+        ), (
+            f"allowlist entry ({suffix}, {key}) matches no finding — "
+            "the pattern was fixed; delete the entry"
+        )
+
+
+# ---------------------------------------------------------------------------
+# The bump rule (--update-protocol)
+# ---------------------------------------------------------------------------
+
+
+def _add(detail="op 'probe' added"):
+    return PinChange("addition", "op", "probe", detail)
+
+
+def _rm(detail="op 'probe' removed"):
+    return PinChange("removal", "op", "probe", detail)
+
+
+def _meta(detail="note changed"):
+    return PinChange("metadata", "op", "submit", detail)
+
+
+def test_bump_rule():
+    # additions need a minor (or major) bump
+    assert check_bump("1.0", "1.0", [_add()]) is not None
+    assert check_bump("1.0", "1.1", [_add()]) is None
+    assert check_bump("1.0", "2.0", [_add()]) is None
+    # removals/renames need a MAJOR bump — a minor does not satisfy
+    assert check_bump("1.0", "1.1", [_rm()]) is not None
+    assert check_bump("1.0", "2.0", [_rm()]) is None
+    assert check_bump("1.0", "2.0", [_rm(), _add()]) is None
+    # metadata-only re-pins need no bump but cannot move backwards
+    assert check_bump("1.1", "1.1", [_meta()]) is None
+    assert check_bump("1.1", "1.0", [_meta()]) is not None
+    # unparseable versions are refused loudly
+    with pytest.raises(ValueError):
+        check_bump("banana", "1.0", [])
+
+
+# ---------------------------------------------------------------------------
+# Constructor byte parity (key order IS the wire bytes)
+# ---------------------------------------------------------------------------
+
+
+def test_constructor_byte_parity():
+    """Each constructor must serialize byte-identically to the
+    historical inline dict it replaced — the fleet parity suites pin
+    whole JSONL streams on exactly these shapes."""
+    d = json.dumps
+    assert d(protocol.ev_accepted("j1", "crack")) == \
+        '{"id": "j1", "event": "accepted", "kind": "crack"}'
+    assert d(protocol.ev_accepted("j1", "crack", resumed=True)) == \
+        '{"id": "j1", "event": "accepted", "kind": "crack", ' \
+        '"resumed": true}'
+    # router ack: engine rides even when None (admission-queued)
+    assert d(protocol.ev_accepted("j1", "crack", engine=None,
+                                  queued=True)) == \
+        '{"id": "j1", "event": "accepted", "kind": "crack", ' \
+        '"engine": null, "queued": true}'
+    assert d(protocol.ev_hit("j1", digest="ab", plain_hex="cd",
+                             word_index=3, rank="9")) == \
+        '{"id": "j1", "event": "hit", "digest": "ab", ' \
+        '"plain_hex": "cd", "word_index": 3, "rank": "9"}'
+    assert d(protocol.ev_done("j1", n_hits=1, n_emitted=2,
+                              wall_s=0.5, resumed=False)) == \
+        '{"id": "j1", "event": "done", "n_hits": 1, ' \
+        '"n_emitted": 2, "wall_s": 0.5, "resumed": false}'
+    assert d(protocol.ev_done("j1", n_hits=1, n_emitted=2, wall_s=0.5,
+                              resumed=True, ttfc_s=0.1,
+                              schema_cache={"hits": 1},
+                              spans=[1])) == \
+        '{"id": "j1", "event": "done", "n_hits": 1, ' \
+        '"n_emitted": 2, "wall_s": 0.5, "resumed": true, ' \
+        '"ttfc_s": 0.1, "schema_cache": {"hits": 1}, "spans": [1]}'
+    assert d(protocol.ev_paused("j1", {"c": 1})) == \
+        '{"id": "j1", "event": "paused", "checkpoint": {"c": 1}}'
+    assert d(protocol.ev_cancelled("j1")) == \
+        '{"id": "j1", "event": "cancelled"}'
+    assert d(protocol.ev_failed("j1", "boom")) == \
+        '{"id": "j1", "event": "failed", "error": "boom"}'
+    assert d(protocol.ev_failed("j1", "overloaded", reason="queue",
+                                retry_after_s=1.5,
+                                checkpoint={"c": 1})) == \
+        '{"id": "j1", "event": "failed", "error": "overloaded", ' \
+        '"reason": "queue", "retry_after_s": 1.5, ' \
+        '"checkpoint": {"c": 1}}'
+    assert d(protocol.ev_migrating("j1", frm="a", to="b")) == \
+        '{"id": "j1", "event": "migrating", "from": "a", "to": "b"}'
+    assert d(protocol.ev_migrating("j1", frm="a", to="a",
+                                   noop=True)) == \
+        '{"id": "j1", "event": "migrating", "from": "a", ' \
+        '"to": "a", "noop": true}'
+    assert d(protocol.ev_draining("e0", 2)) == \
+        '{"event": "draining", "engine": "e0", "jobs": 2}'
+    assert d(protocol.ev_stats({"jobs": 3})) == \
+        '{"event": "stats", "jobs": 3}'
+    assert d(protocol.ev_stats({"jobs": 3}, fleet={"engines": 1})) == \
+        '{"event": "stats", "jobs": 3, "fleet": {"engines": 1}}'
+    assert d(protocol.ev_metrics({"m": 1}, "# HELP\n")) == \
+        '{"event": "metrics", "metrics": {"m": 1}, ' \
+        '"prometheus": "# HELP\\n"}'
+    assert d(protocol.ev_error("boom")) == \
+        '{"event": "error", "error": "boom"}'
+    assert d(protocol.ev_error("boom", jid="j1")) == \
+        '{"event": "error", "error": "boom", "id": "j1"}'
+    assert d(protocol.ev_error_overloaded("queue full", 2.0,
+                                          jid="j1")) == \
+        '{"event": "error", "error": "overloaded", ' \
+        '"reason": "queue full", "retry_after_s": 2.0, "id": "j1"}'
+    assert d(protocol.ev_bye()) == '{"event": "bye"}'
+    assert d(protocol.op_pause("j1")) == '{"op": "pause", "id": "j1"}'
+    assert d(protocol.op_cancel("j1")) == \
+        '{"op": "cancel", "id": "j1"}'
+    assert d(protocol.op_stats()) == '{"op": "stats"}'
+    assert d(protocol.op_metrics()) == '{"op": "metrics"}'
+    assert d(protocol.op_shutdown()) == '{"op": "shutdown"}'
+    # op_submit stamps in place, preserving the client's key order
+    sdoc = {"id": "j1", "words": ["a"]}
+    out = protocol.op_submit(sdoc)
+    assert out is sdoc
+    assert d(out) == '{"id": "j1", "words": ["a"], "op": "submit"}'
+
+
+def test_validate_doc():
+    protocol.validate_doc(protocol.ev_failed("j1", "boom"))
+    protocol.validate_doc(protocol.op_pause("j1"))
+    protocol.validate_doc({"words": ["a"]})  # default op: submit
+    # stats is an open doc: arbitrary scrape fields are the schema
+    protocol.validate_doc({"event": "stats", "whatever": 1})
+    with pytest.raises(ValueError, match="undeclared event"):
+        protocol.validate_doc({"event": "vanished"})
+    with pytest.raises(ValueError, match="undeclared op"):
+        protocol.validate_doc({"op": "frobnicate"})
+    with pytest.raises(ValueError, match="missing required"):
+        protocol.validate_doc({"event": "failed", "id": "j1"})
+    with pytest.raises(ValueError, match="missing required"):
+        protocol.validate_doc({"op": "pause"})
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint wire doc: forward compatibility (satellite of item 4)
+# ---------------------------------------------------------------------------
+
+
+def _state():
+    return CheckpointState(
+        fingerprint="f" * 64,
+        cursor=SweepCursor(word=3, rank=10**20),
+        n_emitted=5,
+        n_hits=1,
+        hits=[(2, 7)],
+        wall_s=1.5,
+    )
+
+
+def test_checkpoint_doc_round_trip_is_stable():
+    doc = state_to_doc(_state())
+    assert "extra" not in doc  # empty carry adds no wire bytes
+    state2 = state_from_doc(doc)
+    assert state2.extra == {}
+    assert state_to_doc(state2) == doc
+
+
+def test_minor_newer_checkpoint_fields_survive_round_trip():
+    """The replicated-ledger handoff guarantee: a minor-newer doc's
+    unknown fields ride ``state_from_doc -> state_to_doc`` verbatim —
+    an older router hop must not strip what a newer engine wrote."""
+    doc = state_to_doc(_state())
+    doc["wire_version"] = "1.7"
+    doc["lineage"] = {"engine": "e9", "hop": 2}
+    doc["salt_policy"] = "v2"
+    state = state_from_doc(doc)
+    assert state.extra == {
+        "lineage": {"engine": "e9", "hop": 2}, "salt_policy": "v2",
+    }
+    out = state_to_doc(state)
+    assert out["lineage"] == {"engine": "e9", "hop": 2}
+    assert out["salt_policy"] == "v2"
+    # this build re-stamps ITS wire version (same major: still legal
+    # for the next 1.x reader) and never loses known fields to the
+    # carry
+    assert out["wire_version"] == "1.0"
+    assert out["fingerprint"] == "f" * 64
+    validate_checkpoint_doc(out)
+    # majors still reject: forward-compat is minor-only
+    doc["wire_version"] = "2.0"
+    with pytest.raises(CheckpointWireIncompatible):
+        state_from_doc(doc)
+
+
+def test_validate_checkpoint_doc_on_constructor_path():
+    """The capture-time validator accepts exactly what the paused/
+    failed constructors carry (the checkpoint is the quarantine
+    token), and still rejects the malformed shapes."""
+    ck = state_to_doc(_state())
+    ev = protocol.ev_paused("j1", ck)
+    assert validate_checkpoint_doc(ev["checkpoint"]) is ck
+    ev = protocol.ev_failed("j1", "boom", checkpoint=ck)
+    assert validate_checkpoint_doc(ev["checkpoint"]) is ck
+    bad = dict(ck)
+    del bad["cursor"]
+    with pytest.raises(Exception, match="missing required"):
+        validate_checkpoint_doc(bad)
+    with pytest.raises(Exception, match="JSON object"):
+        validate_checkpoint_doc("not-a-doc")
+
+
+def test_checkpoint_wire_mirror_stays_synced():
+    """protocol.CHECKPOINT_WIRE mirrors checkpoint.py's constants
+    (also asserted at import time — this pins the message)."""
+    from hashcat_a5_table_generator_tpu.runtime.checkpoint import (
+        _WIRE_REQUIRED,
+        WIRE_VERSION,
+    )
+
+    assert protocol.CHECKPOINT_WIRE["version"] == WIRE_VERSION
+    assert protocol.CHECKPOINT_WIRE["required"] == list(_WIRE_REQUIRED)
+
+
+# ---------------------------------------------------------------------------
+# Router resume ack regression (the sweep's real find)
+# ---------------------------------------------------------------------------
+
+
+def test_router_resume_ack_carries_queued_flag():
+    """The graftwire sweep's asymmetry fix: a resume that lands in the
+    admission queue must say so — the router's resume ack now carries
+    the ``queued`` flag exactly like the submit ack (and stays
+    byte-identical when the job dispatched immediately)."""
+    ack_direct = protocol.ev_accepted("j1", "crack", queued=False,
+                                      resumed=True)
+    assert json.dumps(ack_direct) == \
+        '{"id": "j1", "event": "accepted", "kind": "crack", ' \
+        '"resumed": true}'
+    ack_queued = protocol.ev_accepted("j1", "crack", queued=True,
+                                      resumed=True)
+    assert json.dumps(ack_queued) == \
+        '{"id": "j1", "event": "accepted", "kind": "crack", ' \
+        '"queued": true, "resumed": true}'
+    # the live call site passes the router ack's queued bit through
+    import inspect
+
+    from hashcat_a5_table_generator_tpu.runtime import fleet
+
+    src = inspect.getsource(fleet._RouterSession._handle)
+    assert 'queued=bool(ack.get("queued")), resumed=True' in src
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_artifacts(tmp_path):
+    """0 clean / 1 findings / 2 usage error through the real CLI, plus
+    the --report/--metrics-json artifact shapes CI uploads."""
+    report = tmp_path / "wire.md"
+    metrics = tmp_path / "metrics.json"
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.graftwire",
+         *DEFAULT_PATHS,
+         "--report", str(report), "--metrics-json", str(metrics)],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    md = report.read_text()
+    assert "wire-protocol contract" in md
+    assert "| `submit` (default) |" in md
+    assert "in sync" in md
+    payload = json.loads(metrics.read_text())["graftwire"]
+    assert payload["findings"] == 0
+    assert payload["ops"] >= 9 and payload["events"] >= 12
+    assert payload["emissions"] >= 30
+    flag = subprocess.run(
+        [sys.executable, "-m", "tools.graftwire", "--select", "GW005",
+         str(FIXTURE_DIR / "gw005_flag.py")],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert flag.returncode == 1
+    assert "GW005" in flag.stdout
+    drift = subprocess.run(
+        [sys.executable, "-m", "tools.graftwire", "--select", "GW006",
+         "--protocol-json", GW006_PIN,
+         str(FIXTURE_DIR / "gw006_flag.py")],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert drift.returncode == 1
+    assert "GW006" in drift.stdout
+    usage = subprocess.run(
+        [sys.executable, "-m", "tools.graftwire", "--select", "GW999"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert usage.returncode == 2
+
+
+def test_readme_wire_section_is_fresh(tmp_path):
+    """The committed README section matches the live registry (the CI
+    staleness gate as a test), and a doctored section actually fails —
+    the check is not vacuous."""
+    fresh = subprocess.run(
+        [sys.executable, "-m", "tools.graftwire",
+         "--select", "GW006", "--check-readme", "README.md"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert fresh.returncode == 0, fresh.stdout + fresh.stderr
+    stale_md = tmp_path / "README.md"
+    stale_md.write_text(
+        (REPO_ROOT / "README.md").read_text().replace(
+            "| `submit` (default) |", "| `submit-old` |"
+        )
+    )
+    stale = subprocess.run(
+        [sys.executable, "-m", "tools.graftwire",
+         "--select", "GW006", "--check-readme", str(stale_md)],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert stale.returncode == 1
+    assert "stale" in stale.stderr

@@ -834,6 +834,9 @@ class TestMultiBlock:
         self._parity(AttackSpec(mode="default", algo="md5"),
                      [b"go", b"assassin-sassafras-aa"])
 
+    @pytest.mark.slow  # ~55 s interpret cost on the tier-1 host: the
+    # per-lane padding-block select stays default-covered by
+    # test_suball_two_blocks (G=4, 4 blocks); CI's slow steps run this
     def test_md5_mixed_block_counts_sampled(self, monkeypatch):
         # The default-run sample of the mixed-block contract: same
         # per-lane padding-block select, interpret-sized space (146
@@ -869,7 +872,7 @@ class TestMultiBlock:
 
     @pytest.mark.slow  # 80-round interpret cost: ~31 s even sampled —
     # the per-lane padding-block select is algo-generic and stays
-    # default-covered by the md5/suball/general samples below; SHA-1
+    # default-covered by the suball sample below; SHA-1
     # single-block parity stays fast (test_other_algos_match_xla).
     def test_sha1_two_blocks_sampled(self, monkeypatch):
         # Sample of the slow full run: SHA-1 through the 2-block tail
@@ -892,6 +895,10 @@ class TestMultiBlock:
         self._parity(AttackSpec(mode="suball", algo="md5"),
                      [b"assassin-sassafras-aa"], num_blocks=4)
 
+    @pytest.mark.slow  # ~80 s interpret cost on the tier-1 host — the
+    # suite's single worst entry; the general kernel keeps fast
+    # single-block parity (test_state_and_emit_match_xla) and the
+    # multi-block tail stays default-covered by test_suball_two_blocks
     def test_general_kernel_two_blocks(self, monkeypatch):
         # K=2 table: the general (non-scalar) kernel through the shared
         # multi-block tail. The word's unmatched '-' tail pushes out_width
@@ -907,7 +914,14 @@ class TestMultiBlock:
                      [b"assassin" + b"-" * 41], sub=sub, num_blocks=2)
 
 
-@pytest.mark.parametrize("algo", ["sha1", "ntlm", "md4"])
+@pytest.mark.parametrize("algo", [
+    "sha1",
+    # The NTLM arm's utf16-doubled widths cost ~17 s interpret-mode;
+    # its MD4 compression stays default-covered by the md4 arm and the
+    # utf16 fold by the suball NTLM parity + emit-scheme gw16 tests.
+    pytest.param("ntlm", marks=pytest.mark.slow),
+    "md4",
+])
 def test_other_algos_match_xla(algo):
     """SHA-1 (BE schedule + 5 state words), NTLM (UTF-16LE expansion +
     MD4), and raw MD4 through the fused kernel vs the XLA pair."""
@@ -1021,6 +1035,9 @@ class TestWindowedKernel:
                                num_blocks=16, require_tpu=False) == 2
 
 
+@pytest.mark.slow  # ~17 s interpret cost: the G-never-changes-
+# semantics contract is also exercised by every monkeypatched-_G
+# sample above; CI's slow steps run the explicit A/B
 def test_grid_height_override_parity(monkeypatch):
     """_G (blocks per grid step) is probe-tunable (A5GEN_PALLAS_G):
     G=16 must produce the identical emit/state stream as the default

@@ -1,0 +1,204 @@
+"""graftwire — wire-protocol contract static analysis.
+
+The protocol tier of the repo's static stack (PERF.md §25–§27):
+graftlint checks single-file AST hazards, graftaudit checks what XLA
+compiles, graftrace checks what the threads do, and graftwire checks
+what goes OVER THE WIRE — every emitted JSONL doc and every dispatch
+site in the serve/fleet tier, audited against the single declared
+registry in ``runtime/protocol.py`` and the committed ``PROTOCOL.json``
+pin.
+
+Checks:
+
+* **GW001** — emitted or dispatched op/event not in the declared
+  registry
+* **GW002** — declared op with no handler at its receiver role, or a
+  ``dispatch`` event the router's event chain never decides (the
+  router↔engine compatibility matrix generalizing GT004)
+* **GW003** — inline wire doc missing a declared-required field
+* **GW004** — handler reads a field no declared sender can set
+* **GW005** — raw ``"op"``/``"event"`` envelope-key literal outside
+  ``runtime/protocol.py`` (shrink-only grandfather list)
+* **GW006** — drift between the live registry and the committed
+  ``PROTOCOL.json`` pin (re-pin via ``--update-protocol``, which
+  enforces the PROTOCOL_VERSION bump rule)
+
+Typed public API::
+
+    from tools.graftwire import analyze_paths
+
+    findings, model = analyze_paths(
+        ["hashcat_a5_table_generator_tpu/runtime"])
+
+Run as ``python -m tools.graftwire`` (see ``scripts/lint.sh`` layer 6).
+Stdlib-only: the registry is extracted via AST, never imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graftlint import iter_python_files
+
+from . import allowlist
+from .checks import check_handler_matrix, check_key_sprawl, \
+    check_pin_drift, check_required_fields, check_undeclared, \
+    check_unset_reads
+from .extract import FileSurfaces, extract_surfaces
+from .findings import Finding
+from .registry import PIN_REL, PinChange, Registry, REPO_ROOT, \
+    diff_pin, extract_registry, is_registry_source, load_pin, \
+    load_repo_registry
+
+__all__ = [
+    "ALL_CHECKS",
+    "Finding",
+    "Registry",
+    "WireModel",
+    "analyze_sources",
+    "analyze_paths",
+]
+
+#: code -> one-line summary (the ``--list-checks`` table).
+ALL_CHECKS: Dict[str, str] = {
+    "GW001": "emitted/dispatched op or event not in the declared "
+             "registry",
+    "GW002": "declared op/event with no handler at its receiver role "
+             "(router-engine matrix)",
+    "GW003": "inline wire doc missing a declared-required field",
+    "GW004": "handler reads a field no declared sender can set",
+    "GW005": "raw \"op\"/\"event\" envelope-key literal outside "
+             "runtime/protocol.py",
+    "GW006": "live registry drifted from the committed PROTOCOL.json "
+             "pin",
+}
+
+#: The committed pin the repo-default analysis diffs against.
+DEFAULT_PIN_PATH = str(REPO_ROOT / PIN_REL)
+
+
+@dataclass
+class WireModel:
+    """Everything one analysis extracted (feeds the report)."""
+
+    registry: Optional[Registry]
+    surfaces: List[FileSurfaces] = field(default_factory=list)
+    pin: Optional[Dict[str, object]] = None
+    pin_path: str = ""
+    changes: List[PinChange] = field(default_factory=list)
+
+    @property
+    def n_docs(self) -> int:
+        return sum(len(fs.docs) for fs in self.surfaces)
+
+    @property
+    def n_dispatches(self) -> int:
+        return sum(len(fs.dispatches) for fs in self.surfaces)
+
+    @property
+    def n_reads(self) -> int:
+        return sum(len(fs.reads) for fs in self.surfaces)
+
+
+def _selected(select: Optional[Iterable[str]]) -> List[str]:
+    if select is None:
+        return list(ALL_CHECKS)
+    codes = [c for c in select]
+    unknown = [c for c in codes if c not in ALL_CHECKS]
+    if unknown:
+        raise ValueError(
+            f"unknown check code(s): {', '.join(unknown)}"
+        )
+    return codes
+
+
+def analyze_sources(
+    items: Sequence[Tuple[str, str]],
+    *,
+    select: Optional[Iterable[str]] = None,
+    use_allowlist: bool = True,
+    registry: Optional[Registry] = None,
+    pin: Optional[Dict[str, object]] = None,
+    pin_path: Optional[str] = None,
+) -> Tuple[List[Finding], WireModel]:
+    """Analyze ``(source, path)`` pairs as one program.
+
+    The registry comes from (first match wins) the ``registry``
+    argument, a scanned file that declares ``WIRE_OPS`` (basename
+    ``protocol.py`` preferred — fixtures embed miniature registries),
+    or the shipped ``runtime/protocol.py``.  ``pin``/``pin_path``
+    feed GW006; with neither, the repo's committed ``PROTOCOL.json``
+    is used when present.  Returns ``(findings, model)``; raises
+    ``SyntaxError`` on an unparseable file and ``ValueError`` on an
+    unknown check code or an impure registry literal."""
+    codes = _selected(select)
+    surfaces: List[FileSurfaces] = []
+    scanned_registries: List[Registry] = []
+    for source, path in items:
+        tree = ast.parse(source, filename=path)
+        source_file = is_registry_source(tree)
+        if source_file:
+            reg = extract_registry(tree, path)
+            if reg is not None:
+                scanned_registries.append(reg)
+        surfaces.append(
+            extract_surfaces(tree, path, registry_source=source_file)
+        )
+    if registry is None and scanned_registries:
+        preferred = [r for r in scanned_registries
+                     if os.path.basename(r.path) == "protocol.py"]
+        registry = (preferred or scanned_registries)[0]
+    if registry is None:
+        registry = load_repo_registry()
+
+    if pin_path is None:
+        pin_path = DEFAULT_PIN_PATH
+    if pin is None and os.path.exists(pin_path):
+        pin = load_pin(pin_path)
+    rel_pin = os.path.basename(pin_path)
+
+    findings: List[Finding] = []
+    if "GW001" in codes:
+        findings.extend(check_undeclared(surfaces, registry))
+    if "GW002" in codes:
+        findings.extend(check_handler_matrix(surfaces, registry))
+    if "GW003" in codes:
+        findings.extend(check_required_fields(surfaces, registry))
+    if "GW004" in codes:
+        findings.extend(check_unset_reads(surfaces, registry))
+    if "GW005" in codes:
+        findings.extend(check_key_sprawl(surfaces))
+    if "GW006" in codes:
+        findings.extend(check_pin_drift(registry, pin, rel_pin))
+    if use_allowlist:
+        findings, _grandfathered = allowlist.split(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    model = WireModel(
+        registry=registry, surfaces=surfaces,
+        pin=pin, pin_path=pin_path,
+        changes=diff_pin(pin, registry) if pin is not None else [],
+    )
+    return findings, model
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    select: Optional[Iterable[str]] = None,
+    use_allowlist: bool = True,
+    registry: Optional[Registry] = None,
+    pin: Optional[Dict[str, object]] = None,
+    pin_path: Optional[str] = None,
+) -> Tuple[List[Finding], WireModel]:
+    """Analyze every ``.py`` file under ``paths`` as one program."""
+    items: List[Tuple[str, str]] = []
+    for file_path in iter_python_files(paths):
+        with open(file_path, "r", encoding="utf-8") as fh:
+            items.append((fh.read(), file_path))
+    return analyze_sources(
+        items, select=select, use_allowlist=use_allowlist,
+        registry=registry, pin=pin, pin_path=pin_path,
+    )

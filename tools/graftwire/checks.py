@@ -1,0 +1,250 @@
+"""GW001–GW006: the wire-contract checks.
+
+Each check consumes the extracted surfaces (:mod:`.extract`) and the
+declared registry (:mod:`.registry`) and yields typed findings — no
+printing, no imports of the analyzed package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set
+
+from .extract import FileSurfaces
+from .findings import Finding
+from .registry import PinChange, Registry, diff_pin
+
+#: Role -> the session class whose ``_handle`` must decide each op
+#: (the graftrace GT004 constants, generalized into a matrix).
+ROLE_CLASSES = {"engine": "_JsonlSession", "router": "_RouterSession"}
+
+#: The router method whose chain must decide every ``dispatch`` event.
+EVENT_HANDLER = "_on_job_event"
+
+#: Envelope keys never listed in per-doc field specs.
+_ENVELOPE = {"op", "event"}
+
+
+def _all_fields(reg: Registry, kind: str, names: Set[str]) -> Set[str]:
+    """Union of declared fields over ``names`` (+ the envelope)."""
+    out: Set[str] = set(_ENVELOPE)
+    family = reg.ops if kind != "event" else reg.events
+    for name in names:
+        spec = family.get(name)
+        if spec is None:
+            continue
+        out.update(spec.get("required", ()))
+        out.update(spec.get("optional", ()))
+    return out
+
+
+def check_undeclared(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GW001: an emitted or dispatched op/event the registry never
+    declared — the doc would fail ``protocol.validate_doc`` at
+    runtime, and no replicated router could route it."""
+    for fs in surfaces:
+        for doc in fs.docs:
+            if doc.name is None:
+                continue
+            family = reg.ops if doc.kind == "op" else reg.events
+            if doc.name not in family:
+                via = ("constructor call" if doc.via == "constructor"
+                       else "inline doc")
+                yield Finding(
+                    fs.path, doc.line, doc.col, "GW001",
+                    f"emitted {doc.kind} {doc.name!r} ({via}) is not "
+                    "declared in the wire registry "
+                    "(runtime/protocol.py WIRE_OPS/WIRE_EVENTS)",
+                    key=f"{doc.kind}:{doc.name}",
+                )
+        for site in fs.dispatches:
+            family = reg.ops if site.kind == "op" else reg.events
+            if site.name not in family:
+                yield Finding(
+                    fs.path, site.line, site.col, "GW001",
+                    f"dispatched {site.kind} {site.name!r} "
+                    f"({site.owner}) is not declared in the wire "
+                    "registry (runtime/protocol.py)",
+                    key=f"{site.kind}:{site.name}",
+                )
+
+
+def check_handler_matrix(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GW002: a declared op with no handler at its receiver role, or a
+    declared ``dispatch`` event the router's event chain never decides
+    (the router<->engine compatibility matrix generalizing GT004).
+    Role checks run only when the role's session class is in the
+    analyzed file set (partial scans skip, like GT004)."""
+    op_tables: Dict[str, Set[str]] = {}
+    class_sites: Dict[str, Any] = {}
+    passthrough: Set[str] = set()
+    event_chain: Set[str] = set()
+    have_event_handler = False
+    for fs in surfaces:
+        passthrough |= fs.passthrough_ops
+        if EVENT_HANDLER in fs.handler_funcs:
+            have_event_handler = True
+        for site in fs.dispatches:
+            cls = site.owner.split(".")[0] if "." in site.owner else ""
+            if site.kind == "op" and site.func == "_handle" and cls:
+                op_tables.setdefault(cls, set()).add(site.name)
+            if site.kind == "event" and site.func == EVENT_HANDLER:
+                event_chain.add(site.name)
+        for cls, line in fs.classes.items():
+            class_sites.setdefault(cls, (fs.path, line))
+    for role, cls in sorted(ROLE_CLASSES.items()):
+        if cls not in class_sites:
+            continue  # partial file set: this role is not on screen
+        handled = op_tables.get(cls, set())
+        if role == "router":
+            handled = handled | passthrough
+        path, line = class_sites[cls]
+        for name in sorted(reg.ops):
+            spec = reg.ops[name]
+            if role not in spec.get("handlers", ()):
+                continue
+            if name not in handled:
+                yield Finding(
+                    path, line, 0, "GW002",
+                    f"declared op {name!r} names {role!r} as a handler "
+                    f"but {cls}._handle never decides it "
+                    "(fix the handler or the registry's handlers list)",
+                    key=f"op:{name}:{role}",
+                )
+    if have_event_handler:
+        path, line = ("", 1)
+        for fs in surfaces:
+            if EVENT_HANDLER in fs.handler_funcs:
+                path, line = fs.path, 1
+                break
+        for name in sorted(reg.events):
+            spec = reg.events[name]
+            if spec.get("route") != "dispatch":
+                continue
+            if name not in event_chain:
+                yield Finding(
+                    path, line, 0, "GW002",
+                    f"declared event {name!r} routes as 'dispatch' but "
+                    f"{EVENT_HANDLER} never decides it (handle it, or "
+                    "declare its route as passthrough/control/"
+                    "synthesized in the registry)",
+                    key=f"event:{name}",
+                )
+
+
+def check_required_fields(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GW003: an inline wire doc missing a field its op/event declares
+    required (a ``failed`` without ``error``, a ``hit`` without
+    ``id``).  Constructor calls are exempt by construction — their
+    signatures make required fields unskippable — and ``open`` docs
+    (``**``-spread or computed keys) carry fields the AST cannot
+    enumerate."""
+    for fs in surfaces:
+        for doc in fs.docs:
+            if doc.via != "literal" or doc.name is None or doc.open:
+                continue
+            family = reg.ops if doc.kind == "op" else reg.events
+            spec = family.get(doc.name)
+            if spec is None or spec.get("open"):
+                continue
+            missing = [
+                f for f in spec.get("required", ())
+                if f not in doc.fields
+            ]
+            if missing:
+                yield Finding(
+                    fs.path, doc.line, doc.col, "GW003",
+                    f"{doc.kind} {doc.name!r} doc is missing required "
+                    f"field(s): {', '.join(missing)}",
+                    key=f"{doc.kind}:{doc.name}",
+                )
+
+
+def check_unset_reads(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GW004: a handler reads a field no sender can set — the field is
+    not declared (required or optional) for any op/event the handler
+    dispatches.  The read would see its default forever; either the
+    registry is missing a field or the handler is reading a ghost."""
+    op_tables: Dict[str, Set[str]] = {}
+    event_tables: Dict[str, Set[str]] = {}
+    for fs in surfaces:
+        for site in fs.dispatches:
+            table = (op_tables if site.kind == "op" else event_tables)
+            table.setdefault(site.owner, set()).add(site.name)
+    for fs in surfaces:
+        for read in fs.reads:
+            if read.context == "submit":
+                legal = _all_fields(reg, "op", {"submit"})
+            elif read.context == "op":
+                names = op_tables.get(read.owner) or set(reg.ops)
+                legal = _all_fields(reg, "op", names)
+            else:
+                names = (event_tables.get(read.owner)
+                         or set(reg.events))
+                legal = _all_fields(reg, "event", names)
+            if read.field not in legal:
+                yield Finding(
+                    fs.path, read.line, read.col, "GW004",
+                    f"handler {read.owner or read.context} reads "
+                    f"field {read.field!r} that no declared "
+                    f"{'op' if read.context != 'event' else 'event'} "
+                    "it dispatches can carry (declare the field in "
+                    "runtime/protocol.py or drop the read)",
+                    key=f"{read.context}:{read.field}",
+                )
+
+
+def check_key_sprawl(
+    surfaces: Sequence[FileSurfaces],
+) -> Iterator[Finding]:
+    """GW005: a raw ``"op"``/``"event"`` envelope-key literal outside
+    the registry module (the GL012 sprawl discipline).  Emissions go
+    through the ``protocol`` constructors, dispatch reads through
+    ``doc_op``/``doc_event`` — op/event VALUE strings stay legal (the
+    dispatch tables graftrace GT004 extracts spell them)."""
+    for fs in surfaces:
+        for kl in fs.key_literals:
+            yield Finding(
+                fs.path, kl.line, kl.col, "GW005",
+                f"raw envelope key {kl.key!r} ({kl.detail}) outside "
+                "runtime/protocol.py — emit via a protocol "
+                "constructor, read via protocol.doc_op/doc_event",
+                key=f"key:{kl.key}",
+            )
+
+
+def check_pin_drift(
+    reg: Registry,
+    pin: Optional[Dict[str, Any]],
+    pin_path: str,
+) -> Iterator[Finding]:
+    """GW006: drift between the live registry and the committed
+    PROTOCOL.json pin — either direction fails (the KERNEL_BUDGETS
+    discipline).  Deliberate changes re-pin via ``python -m
+    tools.graftwire --update-protocol``, which also enforces the
+    version bump rule."""
+    where = reg.path or pin_path
+    if pin is None:
+        yield Finding(
+            where, 1, 0, "GW006",
+            f"no protocol pin at {pin_path} — bootstrap it with "
+            "python -m tools.graftwire --update-protocol",
+            key="pin:missing",
+        )
+        return
+    changes: List[PinChange] = diff_pin(pin, reg)
+    for ch in changes:
+        yield Finding(
+            where, 1, 0, "GW006",
+            f"registry drifted from {pin_path}: {ch.detail} "
+            "(deliberate? re-pin via --update-protocol, which "
+            "enforces the PROTOCOL_VERSION bump rule)",
+            key=f"pin:{ch.kind}:{ch.name}",
+        )

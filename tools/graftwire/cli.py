@@ -1,0 +1,246 @@
+"""graftwire command line: ``python -m tools.graftwire [paths...]``.
+
+Exit codes: 0 clean, 1 findings (or a stale README section), 2
+usage/parse error — the contract ``scripts/lint.sh`` and CI key on
+(same as graftlint/graftaudit/graftrace).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import ALL_CHECKS, DEFAULT_PIN_PATH, analyze_paths
+from .registry import check_bump, diff_pin, write_pin
+from .report import drift_table, extract_readme_section, metrics, \
+    render_section, replace_readme_section, to_markdown
+
+#: What ``python -m tools.graftwire`` scans with no arguments: the
+#: serve/fleet tier that speaks the protocol.  tools/ and tests/ stay
+#: out — graftwire's own extraction strings and the suites' hand-rolled
+#: docs are not wire emissions.
+DEFAULT_PATHS = (
+    "hashcat_a5_table_generator_tpu/runtime",
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftwire",
+        description=(
+            "Wire-protocol contract audit for the serve/fleet tier "
+            "(emitted docs and dispatch sites vs the declared "
+            "runtime/protocol.py registry and the PROTOCOL.json pin)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=list(DEFAULT_PATHS),
+        help="files or directories to analyze "
+             f"(default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--select",
+        metavar="CODES",
+        help="comma-separated check codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-checks",
+        action="store_true",
+        help="print the check table and exit",
+    )
+    parser.add_argument(
+        "--protocol-json",
+        metavar="PATH",
+        default=DEFAULT_PIN_PATH,
+        help="the committed protocol pin GW006 diffs against "
+             "(default: PROTOCOL.json at the repo root)",
+    )
+    parser.add_argument(
+        "--update-protocol",
+        action="store_true",
+        help="re-pin PROTOCOL.json from the live registry (enforces "
+             "the PROTOCOL_VERSION bump rule: additions need a minor "
+             "bump, removals/renames a major), then analyze",
+    )
+    parser.add_argument(
+        "--report",
+        metavar="PATH",
+        help="write the wire-protocol markdown report to PATH "
+             "('-' for stdout)",
+    )
+    parser.add_argument(
+        "--check-readme",
+        metavar="PATH",
+        help="fail (exit 1) when PATH's marker-delimited wire-protocol "
+             "section is stale vs the live registry",
+    )
+    parser.add_argument(
+        "--update-readme",
+        metavar="PATH",
+        help="rewrite PATH's marker-delimited wire-protocol section "
+             "from the live registry",
+    )
+    parser.add_argument(
+        "--summary",
+        metavar="PATH",
+        help="append the protocol report + drift table + finding "
+             "counts to PATH (CI: pass \"$GITHUB_STEP_SUMMARY\")",
+    )
+    parser.add_argument(
+        "--metrics-json",
+        metavar="PATH",
+        help="write run metrics (ops/events/emission/dispatch/finding "
+             "counts) as JSON to PATH; CI uploads it as a job artifact",
+    )
+    parser.add_argument(
+        "--no-allowlist",
+        action="store_true",
+        help="surface grandfathered findings (the shrink-only list in "
+             "tools/graftwire/allowlist.py)",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_checks:
+        for code, summary in ALL_CHECKS.items():
+            print(f"{code}  {summary}")
+        return 0
+    select: Optional[List[str]] = None
+    if args.select:
+        select = [c.strip() for c in args.select.split(",") if c.strip()]
+    t0 = time.monotonic()
+    try:
+        findings, model = analyze_paths(
+            args.paths,
+            select=select,
+            use_allowlist=not args.no_allowlist,
+            pin_path=args.protocol_json,
+        )
+    except ValueError as exc:
+        print(f"graftwire: error: {exc}", file=sys.stderr)
+        return 2
+    except SyntaxError as exc:
+        print(f"graftwire: parse error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.update_protocol:
+        reg = model.registry
+        if reg is None:
+            print("graftwire: error: no registry to pin",
+                  file=sys.stderr)
+            return 2
+        if model.pin is not None:
+            changes = diff_pin(model.pin, reg)
+            err = check_bump(
+                str(model.pin.get("protocol_version", "0.0")),
+                reg.version, changes,
+            )
+            if err is not None:
+                print(f"graftwire: --update-protocol refused: {err}",
+                      file=sys.stderr)
+                return 2
+        write_pin(args.protocol_json, reg)
+        print(f"graftwire: pinned protocol {reg.version} -> "
+              f"{args.protocol_json}")
+        # the fresh pin supersedes the pre-update drift findings
+        try:
+            findings, model = analyze_paths(
+                args.paths,
+                select=select,
+                use_allowlist=not args.no_allowlist,
+                pin_path=args.protocol_json,
+            )
+        except (ValueError, SyntaxError) as exc:
+            print(f"graftwire: error: {exc}", file=sys.stderr)
+            return 2
+    elapsed = time.monotonic() - t0
+
+    readme_stale = False
+    if args.update_readme or args.check_readme:
+        reg = model.registry
+        if reg is None:
+            print("graftwire: error: no registry for the README "
+                  "section", file=sys.stderr)
+            return 2
+        section = render_section(reg)
+        readme_path = args.update_readme or args.check_readme
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        if args.update_readme:
+            try:
+                updated = replace_readme_section(text, section)
+            except ValueError as exc:
+                print(f"graftwire: error: {exc}", file=sys.stderr)
+                return 2
+            with open(readme_path, "w", encoding="utf-8") as fh:
+                fh.write(updated)
+            print(f"graftwire: wrote wire-protocol section -> "
+                  f"{readme_path}")
+        else:
+            current = extract_readme_section(text)
+            if current is None or current.strip() != section.strip():
+                readme_stale = True
+                print(
+                    f"graftwire: {readme_path} wire-protocol section "
+                    "is stale — refresh with python -m tools.graftwire "
+                    f"--update-readme {readme_path}",
+                    file=sys.stderr,
+                )
+
+    report_md = to_markdown(model.registry, model.changes)
+    if args.report == "-":
+        print(report_md, end="")
+    elif args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            fh.write(report_md)
+    if args.summary:
+        with open(args.summary, "a", encoding="utf-8") as fh:
+            fh.write(report_md)
+            fh.write(drift_table(model.changes))
+            fh.write(
+                f"\n**graftwire**: {len(findings)} finding(s) over "
+                f"{model.n_docs} emissions / {model.n_dispatches} "
+                f"dispatch sites in {elapsed:.2f}s\n"
+            )
+            for f in findings:
+                fh.write(f"- `{f.render()}`\n")
+    if args.metrics_json:
+        counts: Dict[str, float] = {
+            "findings": len(findings), "elapsed_s": elapsed,
+            "emissions": model.n_docs,
+            "dispatch_sites": model.n_dispatches,
+            "handler_reads": model.n_reads,
+            "pin_changes": len(model.changes),
+        }
+        for code in ALL_CHECKS:
+            counts[f"findings_{code.lower()}"] = sum(
+                1 for f in findings if f.code == code
+            )
+        payload = metrics(model.registry, counts)
+        with open(args.metrics_json, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    try:
+        for finding in findings:
+            print(finding.render())
+    except BrokenPipeError:  # piped into head; keep the exit contract
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    if findings or readme_stale:
+        n = len(findings) + (1 if readme_stale else 0)
+        print(f"graftwire: {n} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

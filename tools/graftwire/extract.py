@@ -1,0 +1,396 @@
+"""AST extraction of the wire surfaces graftwire audits.
+
+Four surfaces, extracted per file with no imports (bare-checkout CI):
+
+* **Wire docs** — every dict literal carrying an ``"op"``/``"event"``
+  key (the pre-migration emission shape, and any future straggler) and
+  every ``protocol.op_*``/``protocol.ev_*`` constructor call (the
+  migrated shape).  GW001/GW003 audit these against the registry.
+* **Dispatch sites** — string constants compared against a dispatch
+  variable (one assigned from ``protocol.doc_op``/``doc_event`` or a
+  raw ``.get("op"/"event")``), or compared directly against such a
+  call.  GW001 checks the names; GW002 diffs the per-class tables
+  against the registry's handler matrix.
+* **Handler reads** — fields a declared handler method reads off its
+  doc parameter (``doc.get("x")``, ``doc["x"]``, ``"x" in doc``).
+  GW004 checks each against the fields some sender can set.
+* **Key literals** — raw ``"op"``/``"event"`` STRING KEYS outside the
+  registry module: dict keys, ``.get`` first arguments, subscripts,
+  containment tests.  GW005 bans these (the GL012 sprawl discipline).
+  Op/event VALUE strings (``op == "submit"``) stay legal: graftrace
+  GT004 extracts exactly those, and a dispatch table has to spell the
+  names somewhere.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+#: The two envelope keys (mirrors ``protocol.K_OP``/``K_EVENT``; kept
+#: literal here so graftwire never imports the runtime).
+ENVELOPE_KEYS = ("op", "event")
+
+#: Dispatch-read helpers in the registry module: calling one makes the
+#: assigned variable a dispatch variable of the given family.
+DOC_READERS = {"doc_op": "op", "doc_event": "event"}
+
+#: Constructor-name pattern and the constructors whose suffix is not
+#: the doc name verbatim.
+_CONSTRUCTOR_RE = re.compile(r"^(op|ev)_([a-z0-9_]+)$")
+CONSTRUCTOR_ALIASES = {("event", "error_overloaded"): "error"}
+
+#: Handler methods whose doc-parameter reads GW004 audits, mapped to
+#: (field context, which argument is the doc).  ``last`` skips
+#: ``self``/``link``-style leading params; ``first`` is for
+#: module-level parsers like ``_job_from_doc(doc, ...)``.
+HANDLER_METHODS: Dict[str, Tuple[str, str]] = {
+    "_handle": ("op", "last"),
+    "_on_job_event": ("event", "last"),
+    "_job_from_doc": ("submit", "first"),
+}
+
+
+@dataclass(frozen=True)
+class WireDoc:
+    """One extracted emission (dict literal or constructor call)."""
+
+    path: str
+    line: int
+    col: int
+    kind: str                       # "op" | "event"
+    name: Optional[str]             # None when the value is dynamic
+    fields: Tuple[str, ...]         # constant string keys present
+    open: bool                      # **-spread or non-constant key
+    via: str                        # "literal" | "constructor"
+
+
+@dataclass(frozen=True)
+class DispatchSite:
+    """One name compared at a dispatch surface."""
+
+    path: str
+    line: int
+    col: int
+    kind: str                       # "op" | "event"
+    name: str
+    owner: str                      # enclosing Class.method (or func)
+    func: str                       # bare function name
+
+
+@dataclass(frozen=True)
+class FieldRead:
+    """One field a handler reads off its doc parameter."""
+
+    path: str
+    line: int
+    col: int
+    context: str                    # "op" | "event" | "submit"
+    owner: str
+    field: str
+
+
+@dataclass(frozen=True)
+class KeyLiteral:
+    """One raw envelope-key literal (GW005 material)."""
+
+    path: str
+    line: int
+    col: int
+    key: str                        # "op" | "event"
+    detail: str                     # where it appeared
+
+
+@dataclass
+class FileSurfaces:
+    """Everything extracted from one file."""
+
+    path: str
+    docs: List[WireDoc] = field(default_factory=list)
+    dispatches: List[DispatchSite] = field(default_factory=list)
+    reads: List[FieldRead] = field(default_factory=list)
+    key_literals: List[KeyLiteral] = field(default_factory=list)
+    passthrough_ops: Set[str] = field(default_factory=set)
+    classes: Dict[str, int] = field(default_factory=dict)
+    handler_funcs: Set[str] = field(default_factory=set)
+
+
+def _const_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _call_name(node: ast.expr) -> Optional[str]:
+    """The trailing name of ``f(...)`` / ``mod.f(...)``, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _envelope_get(node: ast.expr) -> Optional[str]:
+    """Family of a raw ``X.get("op"/"event", ...)`` call, else None."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "get"
+        and node.args
+    ):
+        key = _const_str(node.args[0])
+        if key in ENVELOPE_KEYS:
+            return key
+    return None
+
+
+def _dispatch_family(node: ast.expr) -> Optional[str]:
+    """Family when ``node`` reads the envelope: a ``doc_op``/
+    ``doc_event`` call or a raw ``.get("op"/"event")``."""
+    name = _call_name(node)
+    if name in DOC_READERS:
+        return DOC_READERS[name]
+    return _envelope_get(node)
+
+
+def _compared_strings(node: ast.Compare) -> List[Tuple[str, ast.expr]]:
+    """Every string constant on either side of a comparison."""
+    out: List[Tuple[str, ast.expr]] = []
+    for side in (node.left, *node.comparators):
+        s = _const_str(side)
+        if s is not None:
+            out.append((s, side))
+        elif isinstance(side, (ast.Tuple, ast.List, ast.Set)):
+            for elt in side.elts:
+                s = _const_str(elt)
+                if s is not None:
+                    out.append((s, elt))
+    return out
+
+
+def _doc_param(fn: ast.FunctionDef, which: str) -> Optional[str]:
+    args = [a.arg for a in fn.args.args]
+    if args and args[0] in ("self", "cls"):
+        args = args[1:]
+    if not args:
+        return None
+    return args[0] if which == "first" else args[-1]
+
+
+class _Extractor(ast.NodeVisitor):
+    def __init__(self, path: str, *, registry_source: bool) -> None:
+        self.out = FileSurfaces(path)
+        self._path = path
+        self._registry_source = registry_source
+        self._class_stack: List[str] = []
+        self._func_stack: List[str] = []
+        #: dispatch vars of the INNERMOST function: name -> family
+        self._dispatch_vars: List[Dict[str, str]] = []
+        #: doc params of enclosing handler functions: name -> context
+        self._doc_params: List[Dict[str, str]] = []
+
+    # -- scope tracking --------------------------------------------------
+
+    def _owner(self) -> str:
+        cls = self._class_stack[-1] if self._class_stack else ""
+        fn = self._func_stack[-1] if self._func_stack else ""
+        return f"{cls}.{fn}" if cls else fn
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.out.classes.setdefault(node.name, node.lineno)
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _visit_function(self, node: ast.FunctionDef) -> None:
+        self._func_stack.append(node.name)
+        self._dispatch_vars.append({})
+        params: Dict[str, str] = {}
+        spec = HANDLER_METHODS.get(node.name)
+        if spec is not None:
+            context, which = spec
+            param = _doc_param(node, which)
+            if param is not None:
+                params = {param: context}
+                self.out.handler_funcs.add(node.name)
+        self._doc_params.append(params)
+        self.generic_visit(node)
+        self._doc_params.pop()
+        self._dispatch_vars.pop()
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function  # type: ignore[assignment]
+
+    def _doc_context(self, node: ast.expr) -> Optional[str]:
+        """Handler context when ``node`` is a doc parameter Name."""
+        if not isinstance(node, ast.Name):
+            return None
+        for params in reversed(self._doc_params):
+            if node.id in params:
+                return params[node.id]
+        return None
+
+    # -- wire docs -------------------------------------------------------
+
+    def visit_Dict(self, node: ast.Dict) -> None:
+        fields: List[str] = []
+        kind: Optional[str] = None
+        name: Optional[str] = None
+        is_open = False
+        for key, value in zip(node.keys, node.values):
+            if key is None:          # **spread
+                is_open = True
+                continue
+            k = _const_str(key)
+            if k is None:
+                is_open = True       # computed key: unknowable field
+                continue
+            fields.append(k)
+            if k in ENVELOPE_KEYS and kind is None:
+                kind = k
+                name = _const_str(value)
+        if kind is not None:
+            self.out.docs.append(WireDoc(
+                self._path, node.lineno, node.col_offset,
+                kind, name, tuple(fields), is_open, "literal",
+            ))
+            if not self._registry_source:
+                self.out.key_literals.append(KeyLiteral(
+                    self._path, node.lineno, node.col_offset, kind,
+                    "dict key in an inline wire doc",
+                ))
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        fn_name = _call_name(node)
+        m = _CONSTRUCTOR_RE.match(fn_name or "")
+        if m is not None:
+            prefix, suffix = m.group(1), m.group(2)
+            kind = "op" if prefix == "op" else "event"
+            doc_name = CONSTRUCTOR_ALIASES.get((kind, suffix), suffix)
+            self.out.docs.append(WireDoc(
+                self._path, node.lineno, node.col_offset,
+                kind, doc_name, (), False, "constructor",
+            ))
+        key = _envelope_get(node)
+        if key is not None and not self._registry_source:
+            self.out.key_literals.append(KeyLiteral(
+                self._path, node.lineno, node.col_offset, key,
+                f".get({key!r}) read",
+            ))
+        # handler read: `doc.get("field", ...)` on a doc parameter
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and node.args
+        ):
+            context = self._doc_context(node.func.value)
+            f = _const_str(node.args[0])
+            if context is not None and f is not None:
+                self.out.reads.append(FieldRead(
+                    self._path, node.lineno, node.col_offset,
+                    context, self._owner(), f,
+                ))
+        self.generic_visit(node)
+
+    # -- dispatch --------------------------------------------------------
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # module-level passthrough declaration (the GT004 anchor)
+        if not self._func_stack and not self._class_stack:
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "ROUTER_PASSTHROUGH_OPS"
+                ):
+                    value = node.value
+                    try:
+                        ops = ast.literal_eval(
+                            value.args[0]
+                            if isinstance(value, ast.Call) and value.args
+                            else value
+                        )
+                        self.out.passthrough_ops |= {
+                            o for o in ops if isinstance(o, str)
+                        }
+                    except (ValueError, TypeError):
+                        pass
+        family = _dispatch_family(node.value)
+        if family is not None and self._dispatch_vars:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._dispatch_vars[-1][t.id] = family
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        family: Optional[str] = None
+        for side in (node.left, *node.comparators):
+            if isinstance(side, ast.Name) and self._dispatch_vars:
+                for scope in reversed(self._dispatch_vars):
+                    if side.id in scope:
+                        family = scope[side.id]
+                        break
+            if family is None:
+                family = _dispatch_family(side)
+            if family is not None:
+                break
+        if family is not None:
+            fn = self._func_stack[-1] if self._func_stack else ""
+            for name, site in _compared_strings(node):
+                self.out.dispatches.append(DispatchSite(
+                    self._path, site.lineno, site.col_offset,
+                    family, name, self._owner(), fn,
+                ))
+        # containment test on a raw envelope key: `"op" in doc`
+        if (
+            not self._registry_source
+            and any(isinstance(o, (ast.In, ast.NotIn)) for o in node.ops)
+        ):
+            key = _const_str(node.left)
+            if key in ENVELOPE_KEYS:
+                self.out.key_literals.append(KeyLiteral(
+                    self._path, node.lineno, node.col_offset, key,
+                    f"{key!r} in <doc> containment test",
+                ))
+        # handler read via containment: `"x" in doc`
+        if len(node.ops) == 1 and isinstance(node.ops[0], ast.In):
+            context = self._doc_context(node.comparators[0])
+            f = _const_str(node.left)
+            if context is not None and f is not None:
+                self.out.reads.append(FieldRead(
+                    self._path, node.lineno, node.col_offset,
+                    context, self._owner(), f,
+                ))
+        self.generic_visit(node)
+
+    # -- handler reads & subscripts --------------------------------------
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        key = _const_str(node.slice)
+        if key is not None:
+            if key in ENVELOPE_KEYS and not self._registry_source:
+                self.out.key_literals.append(KeyLiteral(
+                    self._path, node.lineno, node.col_offset, key,
+                    f"[{key!r}] subscript",
+                ))
+            context = self._doc_context(node.value)
+            if context is not None:
+                self.out.reads.append(FieldRead(
+                    self._path, node.lineno, node.col_offset,
+                    context, self._owner(), key,
+                ))
+        self.generic_visit(node)
+
+def extract_surfaces(
+    tree: ast.Module, path: str, *, registry_source: bool
+) -> FileSurfaces:
+    """Extract every audited surface from one parsed module."""
+    ex = _Extractor(path, registry_source=registry_source)
+    ex.visit(tree)
+    return ex.out

@@ -1,0 +1,283 @@
+"""The declared wire registry, extracted — never imported.
+
+graftwire reads ``runtime/protocol.py`` the same way graftaudit reads
+kernels and graftrace reads thread entry points: via AST.  The registry
+literals (``PROTOCOL_VERSION``, ``WIRE_OPS``, ``WIRE_EVENTS``,
+``CHECKPOINT_WIRE``) are pure by contract, so ``ast.literal_eval``
+recovers exactly what the runtime declares without executing (or even
+being able to import) the package — the CI job runs on a bare checkout
+with no JAX.
+
+The same module owns the PROTOCOL.json pin discipline (the
+KERNEL_BUDGETS pattern): :func:`diff_pin` classifies every change as an
+addition or a removal/rename, and :func:`check_bump` enforces the
+version rule — additions need a minor ``PROTOCOL_VERSION`` bump,
+removals/renames a major one.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Module-level names that make a scanned file a registry source.
+REGISTRY_NAMES = (
+    "PROTOCOL_VERSION", "WIRE_OPS", "WIRE_EVENTS", "CHECKPOINT_WIRE",
+)
+
+#: Where the shipped registry and its pin live, relative to the repo
+#: root (``tools/graftwire/registry.py`` -> two parents up).
+REPO_ROOT = Path(__file__).resolve().parents[2]
+REGISTRY_REL = "hashcat_a5_table_generator_tpu/runtime/protocol.py"
+PIN_REL = "PROTOCOL.json"
+
+
+@dataclass
+class Registry:
+    """The extracted wire contract (pure data, JSON-serializable)."""
+
+    version: str
+    ops: Dict[str, Dict[str, Any]]
+    events: Dict[str, Dict[str, Any]]
+    checkpoint: Dict[str, Any] = field(default_factory=dict)
+    path: str = ""
+
+    def fields_of(self, kind: str, name: str) -> Optional[Tuple[str, ...]]:
+        """required+optional of one op/event; None when undeclared."""
+        spec = (self.ops if kind == "op" else self.events).get(name)
+        if spec is None:
+            return None
+        return tuple(spec.get("required", ())) + tuple(
+            spec.get("optional", ())
+        )
+
+
+def is_registry_source(tree: ast.Module) -> bool:
+    """Whether a module declares the registry (defines ``WIRE_OPS``)."""
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        if any(
+            isinstance(t, ast.Name) and t.id == "WIRE_OPS"
+            for t in targets
+        ):
+            return True
+    return False
+
+
+def extract_registry(tree: ast.Module, path: str) -> Optional[Registry]:
+    """Literal-eval the registry assignments out of one module.
+
+    Returns None when the module declares no complete registry; raises
+    :class:`ValueError` when it declares one that is not a pure
+    literal (the module contract graftwire exists to keep honest)."""
+    found: Dict[str, Any] = {}
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in REGISTRY_NAMES:
+                try:
+                    found[t.id] = ast.literal_eval(value)
+                except (ValueError, TypeError) as exc:
+                    raise ValueError(
+                        f"{path}: registry literal {t.id} is not pure "
+                        f"(ast.literal_eval failed: {exc})"
+                    ) from None
+    if "WIRE_OPS" not in found or "WIRE_EVENTS" not in found:
+        return None
+    return Registry(
+        version=str(found.get("PROTOCOL_VERSION", "0.0")),
+        ops=found["WIRE_OPS"],
+        events=found["WIRE_EVENTS"],
+        checkpoint=found.get("CHECKPOINT_WIRE", {}),
+        path=path,
+    )
+
+
+def load_repo_registry() -> Registry:
+    """Parse the shipped ``runtime/protocol.py`` (AST only)."""
+    path = REPO_ROOT / REGISTRY_REL
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    reg = extract_registry(tree, str(path))
+    if reg is None:
+        raise ValueError(f"{path}: no wire registry declared")
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# The PROTOCOL.json pin
+# ---------------------------------------------------------------------------
+
+
+def registry_to_pin(reg: Registry) -> Dict[str, Any]:
+    """The JSON document ``--update-protocol`` writes and GW006 diffs."""
+    return {
+        "protocol_version": reg.version,
+        "ops": reg.ops,
+        "events": reg.events,
+        "checkpoint": reg.checkpoint,
+    }
+
+
+def load_pin(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        pin = json.load(fh)
+    if not isinstance(pin, dict):
+        raise ValueError(f"{path}: pin must be a JSON object")
+    return pin
+
+
+@dataclass(frozen=True)
+class PinChange:
+    """One classified difference between the pin and the live registry.
+
+    ``severity`` drives the bump rule: ``addition`` (new op/event/
+    field) needs a minor bump, ``removal`` (dropped or renamed — a
+    rename IS a removal plus an addition) a major one, ``metadata``
+    (note/route/handlers wording) any re-pin."""
+
+    severity: str  # "addition" | "removal" | "metadata"
+    kind: str      # "op" | "event" | "checkpoint" | "version"
+    name: str
+    detail: str
+
+
+def _diff_family(
+    kind: str,
+    pinned: Dict[str, Any],
+    live: Dict[str, Any],
+) -> List[PinChange]:
+    changes: List[PinChange] = []
+    for name in sorted(set(pinned) - set(live)):
+        changes.append(PinChange("removal", kind, name,
+                                 f"{kind} {name!r} removed"))
+    for name in sorted(set(live) - set(pinned)):
+        changes.append(PinChange("addition", kind, name,
+                                 f"{kind} {name!r} added"))
+    for name in sorted(set(pinned) & set(live)):
+        old, new = pinned[name], live[name]
+        for fset in ("required", "optional"):
+            o = list(old.get(fset, ()))
+            n = list(new.get(fset, ()))
+            for f in [x for x in o if x not in n]:
+                changes.append(PinChange(
+                    "removal", kind, name,
+                    f"{kind} {name!r} {fset} field {f!r} removed"))
+            for f in [x for x in n if x not in o]:
+                changes.append(PinChange(
+                    "addition", kind, name,
+                    f"{kind} {name!r} {fset} field {f!r} added"))
+        meta_keys = (set(old) | set(new)) - {"required", "optional"}
+        for mk in sorted(meta_keys):
+            if old.get(mk) != new.get(mk):
+                changes.append(PinChange(
+                    "metadata", kind, name,
+                    f"{kind} {name!r} {mk} changed: "
+                    f"{old.get(mk)!r} -> {new.get(mk)!r}"))
+    return changes
+
+
+def diff_pin(pin: Dict[str, Any], reg: Registry) -> List[PinChange]:
+    """Every difference between the committed pin and the live
+    registry, classified for the bump rule.  Empty means in sync."""
+    changes: List[PinChange] = []
+    live = registry_to_pin(reg)
+    changes.extend(_diff_family("op", pin.get("ops", {}), live["ops"]))
+    changes.extend(
+        _diff_family("event", pin.get("events", {}), live["events"]))
+    old_ck, new_ck = pin.get("checkpoint", {}), live["checkpoint"]
+    if old_ck != new_ck:
+        o = list(old_ck.get("required", ()))
+        n = list(new_ck.get("required", ()))
+        removed = [f for f in o if f not in n]
+        added = [f for f in n if f not in o]
+        for f in removed:
+            changes.append(PinChange(
+                "removal", "checkpoint", f,
+                f"checkpoint required field {f!r} removed"))
+        for f in added:
+            changes.append(PinChange(
+                "addition", "checkpoint", f,
+                f"checkpoint required field {f!r} added"))
+        if old_ck.get("version") != new_ck.get("version"):
+            changes.append(PinChange(
+                "removal" if removed else "metadata", "checkpoint",
+                "version",
+                f"checkpoint wire version changed: "
+                f"{old_ck.get('version')!r} -> "
+                f"{new_ck.get('version')!r}"))
+        elif not removed and not added and old_ck != new_ck:
+            changes.append(PinChange(
+                "metadata", "checkpoint", "note",
+                "checkpoint metadata changed"))
+    old_v = str(pin.get("protocol_version", "0.0"))
+    if old_v != reg.version:
+        changes.append(PinChange(
+            "metadata", "version", "protocol_version",
+            f"PROTOCOL_VERSION {old_v!r} -> {reg.version!r}"))
+    return changes
+
+
+def _parse_version(v: str) -> Tuple[int, int]:
+    parts = v.split(".")
+    try:
+        return int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+    except (ValueError, IndexError):
+        raise ValueError(
+            f"unparseable PROTOCOL_VERSION {v!r} (want MAJOR.MINOR)"
+        ) from None
+
+
+def check_bump(
+    old_version: str,
+    new_version: str,
+    changes: List[PinChange],
+) -> Optional[str]:
+    """The ``--update-protocol`` version rule; None when satisfied.
+
+    * any ``removal`` change -> the major must increase;
+    * else any ``addition``  -> the minor (or major) must increase;
+    * metadata-only          -> any version >= the pinned one."""
+    old = _parse_version(old_version)
+    new = _parse_version(new_version)
+    severities = {c.severity for c in changes
+                  if c.kind != "version"}
+    if "removal" in severities:
+        if new[0] <= old[0]:
+            return (
+                f"removals/renames need a MAJOR PROTOCOL_VERSION bump "
+                f"(pinned {old_version}, live {new_version})"
+            )
+        return None
+    if "addition" in severities:
+        if new > old:
+            return None
+        return (
+            f"additions need a MINOR PROTOCOL_VERSION bump "
+            f"(pinned {old_version}, live {new_version})"
+        )
+    if new < old:
+        return (
+            f"PROTOCOL_VERSION cannot move backwards "
+            f"(pinned {old_version}, live {new_version})"
+        )
+    return None
+
+
+def write_pin(path: str, reg: Registry) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(registry_to_pin(reg), fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,140 @@
+"""The wire-protocol report: registry tables, pin drift, metrics.
+
+``--report`` renders the protocol section (also embedded in README
+between the markers below and kept fresh by ``--check-readme`` in CI);
+``--summary`` appends it plus the drift table to the CI job summary;
+``--metrics-json`` emits the counters CI uploads as an artifact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from .registry import PinChange, Registry
+
+#: README markers delimiting the rendered section (the region
+#: ``--update-readme`` rewrites and ``--check-readme`` verifies).
+BEGIN_MARK = "<!-- graftwire:wire-protocol:begin -->"
+END_MARK = "<!-- graftwire:wire-protocol:end -->"
+
+
+def _csv(values: Any) -> str:
+    vals = [str(v) for v in (values or ())]
+    return ", ".join(f"`{v}`" for v in vals) if vals else "—"
+
+
+def protocol_tables(reg: Registry) -> str:
+    """The op/event/checkpoint tables for one registry."""
+    lines: List[str] = []
+    lines.append(f"Protocol version **{reg.version}** — declared in "
+                 "`runtime/protocol.py`, pinned in `PROTOCOL.json` "
+                 "(changes re-pin via `python -m tools.graftwire "
+                 "--update-protocol`: additions bump the minor, "
+                 "removals/renames the major).")
+    lines.append("")
+    lines.append("| op | required | optional | handlers |")
+    lines.append("|----|----------|----------|----------|")
+    for name in sorted(reg.ops):
+        spec = reg.ops[name]
+        op_cell = f"`{name}`"
+        if spec.get("default"):
+            op_cell += " (default)"
+        lines.append(
+            f"| {op_cell} | {_csv(spec.get('required'))} "
+            f"| {_csv(spec.get('optional'))} "
+            f"| {_csv(spec.get('handlers'))} |"
+        )
+    lines.append("")
+    lines.append("| event | required | optional | emitters | route |")
+    lines.append("|-------|----------|----------|----------|-------|")
+    for name in sorted(reg.events):
+        spec = reg.events[name]
+        ev_cell = f"`{name}`"
+        if spec.get("open"):
+            ev_cell += " (open)"
+        lines.append(
+            f"| {ev_cell} | {_csv(spec.get('required'))} "
+            f"| {_csv(spec.get('optional'))} "
+            f"| {_csv(spec.get('emitters'))} "
+            f"| {spec.get('route', '—')} |"
+        )
+    ck = reg.checkpoint
+    if ck:
+        lines.append("")
+        lines.append(
+            f"Checkpoint wire doc v{ck.get('version', '?')}: required "
+            f"{_csv(ck.get('required'))}; minor-newer docs round-trip "
+            "unknown extra fields verbatim."
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_section(reg: Registry) -> str:
+    """The marker-delimited README region (heading included)."""
+    return (
+        f"{BEGIN_MARK}\n"
+        "### Wire protocol\n\n"
+        f"{protocol_tables(reg)}"
+        f"{END_MARK}\n"
+    )
+
+
+def drift_table(changes: Sequence[PinChange]) -> str:
+    """The pin-drift table CI publishes to the job summary."""
+    if not changes:
+        return ("\n**PROTOCOL.json**: in sync with the live "
+                "registry.\n")
+    lines = ["", "**PROTOCOL.json drift** (GW006):", "",
+             "| severity | change |", "|----------|--------|"]
+    for ch in changes:
+        lines.append(f"| {ch.severity} | {ch.detail} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def to_markdown(
+    reg: Optional[Registry],
+    changes: Sequence[PinChange] = (),
+) -> str:
+    """The full ``--report`` document."""
+    if reg is None:
+        return "# graftwire\n\nNo wire registry in the analyzed set.\n"
+    return (
+        "# graftwire — wire-protocol contract\n\n"
+        + protocol_tables(reg)
+        + drift_table(changes)
+    )
+
+
+def extract_readme_section(text: str) -> Optional[str]:
+    """The marker-delimited region of a README, markers included."""
+    start = text.find(BEGIN_MARK)
+    end = text.find(END_MARK)
+    if start < 0 or end < 0 or end < start:
+        return None
+    return text[start:end + len(END_MARK)] + "\n"
+
+
+def replace_readme_section(text: str, section: str) -> str:
+    """README text with the marker region replaced by ``section``."""
+    start = text.find(BEGIN_MARK)
+    end = text.find(END_MARK)
+    if start < 0 or end < 0 or end < start:
+        raise ValueError(
+            f"README has no {BEGIN_MARK} .. {END_MARK} region"
+        )
+    return text[:start] + section.rstrip("\n") + text[end + len(END_MARK):]
+
+
+def metrics(
+    reg: Optional[Registry],
+    counts: Dict[str, float],
+) -> Dict[str, Any]:
+    """The ``graftwire-metrics.json`` payload."""
+    payload: Dict[str, Any] = dict(counts)
+    if reg is not None:
+        payload["protocol_version"] = reg.version
+        payload["ops"] = len(reg.ops)
+        payload["events"] = len(reg.events)
+    return {"graftwire": payload}
