@@ -285,6 +285,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default 4 — the underfilled-N the §22 "
                          "acceptance criterion is stated at; must "
                          "divide --blocks)")
+    ap.add_argument("--pack-churn", action="store_true",
+                    help="measure dynamic re-fuse under tenant churn "
+                         "(PERF.md §28): waves of N compatible jobs "
+                         "submitted to a packed resident Engine with a "
+                         "mid-flight cancel of half each wave, re-fuse "
+                         "ENABLED vs DISABLED arms — per-arm serve "
+                         "wall, post-departure fill decay (min) and "
+                         "post-re-fuse recovered fill, refuse count, "
+                         "survivor parity vs solo runs — one JSON "
+                         "line. Geometry rules follow --pack-ab")
+    ap.add_argument("--churn-waves", type=int, default=2,
+                    help="--pack-churn: submit/cancel waves per arm "
+                         "(default 2)")
+    ap.add_argument("--refuse-below", type=float, default=0.8,
+                    help="--pack-churn: fill threshold for the "
+                         "re-fuse arm (default 0.8 — half the tenants "
+                         "cancelling always crosses it)")
     ap.add_argument("--pair-ab", action="store_true",
                     help="measure the pair-lane tier (K=2 candidates "
                          "per hash lane, PERF.md §24) against K=1 on "
@@ -1520,6 +1537,15 @@ def run_pack_ab(args: argparse.Namespace) -> None:
                 "span_fairness_maxmin": fairness,
                 "packed_dispatches": stats["packed_dispatches"],
                 "fill_ratio": stats["packed_fill"],
+                # Per-pump fill instruments (PERF.md §28): the
+                # aggregate above dilutes post-departure decay across
+                # every dispatch since engine start; these carry the
+                # LAST observed per-dispatch fill and the running
+                # minimum, so churn (and the re-fuse response) is
+                # visible in the JSON.
+                "fill_last": stats["packed_fill_last"],
+                "fill_min": stats["packed_fill_min"],
+                "refuse_total": stats["refuse_total"],
                 "supersteps_served": stats["supersteps_served"],
             }
         finally:
@@ -1558,6 +1584,179 @@ def run_pack_ab(args: argparse.Namespace) -> None:
         "wall_ratio": rr["wall_s"] / max(packed["wall_s"], 1e-9),
         "fill_ratio": packed["fill_ratio"],
         "warm_ttfc_batch_s": packed["warm_ttfc_batch_mean_s"],
+    }
+    print(json.dumps(record))
+    sys.stdout.flush()
+
+
+# ----------------------------------------------------------- pack churn A/B --
+
+
+def run_pack_churn(args: argparse.Namespace) -> None:
+    """A/B dynamic re-fuse (PERF.md §28) under tenant churn: per arm,
+    ``--churn-waves`` waves of N compatible jobs are submitted to a
+    warm packed resident Engine, half of each wave is CANCELLED after
+    two serve rounds (the departure the §28 trigger watches), and the
+    wave drains.  The re-fuse arm (``refuse_below=--refuse-below``)
+    retraces survivors into tighter groups; the control arm
+    (``refuse_below=0``) keeps dispatching the thinned group with
+    masked lanes.  Reports per-arm serve wall, the post-departure fill
+    minimum, the post-re-fuse recovered fill peak, and the refuse
+    count; parity-asserts every SURVIVOR's emitted count against its
+    own solo run.  One JSON line."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+    from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+    from hashcat_a5_table_generator_tpu.runtime.sweep import (
+        Sweep,
+        SweepConfig,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    if lanes % nb:
+        raise SystemExit("--pack-churn needs blocks dividing lanes")
+    n_jobs = max(2, int(args.pack_jobs))
+    if nb % n_jobs:
+        raise SystemExit(
+            f"--pack-churn needs --blocks ({nb}) divisible by "
+            f"--pack-jobs ({n_jobs}) so every job owns an equal segment"
+        )
+    waves = max(1, int(args.churn_waves))
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout(args.table).to_substitution_map()
+    words = synth_wordlist(args.words)
+    host_digest = HOST_DIGEST[spec.algo]
+    job_digests = [
+        [host_digest(b"churn-decoy-%d-%d" % (j, i)) for i in range(256)]
+        for j in range(n_jobs)
+    ]
+    base_cfg = SweepConfig(lanes=lanes, num_blocks=nb, superstep=4)
+    # Half of each wave departs; parity only makes sense for the jobs
+    # that run to completion.
+    cancelled = set(range(0, n_jobs, 2)) if n_jobs > 2 else {0}
+    survivors = [j for j in range(n_jobs) if j not in cancelled]
+
+    solo = {}
+    for j in survivors:
+        res = Sweep(spec, sub_map, words, job_digests[j],
+                    config=base_cfg).run_crack(resume=False)
+        solo[j] = res.n_emitted
+
+    def arm(refuse: bool) -> dict:
+        engine = Engine(base_cfg, auto=False, pack=True,
+                        refuse_below=(args.refuse_below if refuse
+                                      else 0))
+        try:
+            def submit_wave():
+                return [
+                    engine.submit(spec, sub_map, words, job_digests[j])
+                    for j in range(n_jobs)
+                ]
+
+            # Warm: compile both the full-width and (on the re-fuse
+            # arm) the survivor-width packed programs outside the
+            # measured window, so the walls compare dispatch behavior,
+            # not compile.
+            warm = submit_wave()
+            engine._admit()
+            for _ in range(2):
+                engine._serve_round()
+            for j in cancelled:
+                warm[j].cancel()
+            engine.run_until_idle()
+
+            wall = 0.0
+            fill_min = None
+            post_refuse_peak = None
+            emitted = {j: [] for j in survivors}
+            for _wave in range(waves):
+                handles = submit_wave()
+                engine._admit()  # builds + fuse, outside the wall
+                t0 = time.perf_counter()
+                for _ in range(2):
+                    engine._serve_round()
+                for j in cancelled:
+                    handles[j].cancel()
+                # Drain the wave, sampling the per-pump fill so the
+                # post-departure decay AND the post-re-fuse recovery
+                # both land in the record.
+                while True:
+                    engine._serve_round()
+                    engine._admit(wait=False)  # collect refuse builds
+                    st = engine.stats()
+                    if st["packed_fill_last"]:
+                        f = st["packed_fill_last"]
+                        if fill_min is None or f < fill_min:
+                            fill_min = f
+                        if st["refuse_total"] and st["fused_groups"]:
+                            post_refuse_peak = max(
+                                post_refuse_peak or 0.0, f
+                            )
+                    if not st["jobs_active"]:
+                        break
+                wall += time.perf_counter() - t0
+                for j in survivors:
+                    emitted[j].append(handles[j].result(timeout=5)
+                                      .n_emitted)
+            stats = engine.stats()
+            for j in survivors:
+                for wave_idx, n in enumerate(emitted[j]):
+                    if n != solo[j]:
+                        raise SystemExit(
+                            f"--pack-churn {'re-fuse' if refuse else 'control'} "
+                            f"arm diverged from solo: job {j} wave "
+                            f"{wave_idx} emitted {n} vs {solo[j]} — "
+                            "refusing to report timings for "
+                            "non-identical work"
+                        )
+            return {
+                "wall_s": wall,
+                "waves": waves,
+                "jobs_per_wave": n_jobs,
+                "cancelled_per_wave": len(cancelled),
+                "fill_min": fill_min,
+                "post_refuse_fill_peak": post_refuse_peak,
+                "refuse_total": stats["refuse_total"],
+                "packed_dispatches": stats["packed_dispatches"],
+                "fill_aggregate": stats["packed_fill"],
+                "supersteps_served": stats["supersteps_served"],
+            }
+        finally:
+            engine.close()
+
+    refused = arm(True)
+    control = arm(False)
+    if refused["refuse_total"] == 0:
+        raise SystemExit(
+            "--pack-churn re-fuse arm never retraced — half the "
+            "tenants cancelling was expected to cross the threshold"
+        )
+    record = {
+        "metric": "pack_churn_ab",
+        "unit": "seconds (wall) + fill ratios",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "jobs": n_jobs,
+        "refuse_below": args.refuse_below,
+        "refuse": refused,
+        "control": control,
+        # §28 acceptance instruments: the control arm's fill minimum
+        # shows the decay churn costs without re-fuse; the re-fuse
+        # arm's recovered peak must sit back above the threshold, and
+        # the serve-wall ratio shows what the retrace bought.
+        "wall_ratio": control["wall_s"] / max(refused["wall_s"], 1e-9),
+        "fill_recovered": refused["post_refuse_fill_peak"],
     }
     print(json.dumps(record))
     sys.stdout.flush()
@@ -2668,8 +2867,8 @@ def main() -> None:
             2048
             if (args.superstep_ab or args.stride_ab or args.pipeline_ab
                 or args.stream_ab or args.serve_ab or args.telemetry_ab
-                or args.pack_ab or args.pair_ab or args.fleet_ab
-                or args.elastic_ab)
+                or args.pack_ab or args.pack_churn or args.pair_ab
+                or args.fleet_ab or args.elastic_ab)
             else (1 << 22)
         )
     if args.words is None:
@@ -2681,9 +2880,13 @@ def main() -> None:
         # is a fraction of one superstep's lane capacity at the §4c
         # geometry — the regime cross-job packing amortizes (PERF.md
         # §22).
+        # --pack-churn needs jobs LONG enough that work remains after
+        # the mid-flight cancels (several supersteps per tenant), so
+        # its default is larger than --pack-ab's underfilled 24.
         args.words = (
             1000 if (args.serve_ab or args.fleet_ab or args.elastic_ab)
-            else 24 if args.pack_ab else 50000
+            else 24 if args.pack_ab
+            else 2000 if args.pack_churn else 50000
         )
     if args.fleet_ab or args.elastic_ab:
         # Routed-vs-direct serve A/B (PERF.md §25), with the elastic
@@ -2698,6 +2901,10 @@ def main() -> None:
         # Cross-job packing A/B (PERF.md §22); runs on the pinned (or
         # default) platform in-process.
         run_pack_ab(args)
+    elif args.pack_churn:
+        # Dynamic re-fuse churn A/B (PERF.md §28); runs on the pinned
+        # (or default) platform in-process.
+        run_pack_churn(args)
     elif args.telemetry_ab:
         # Telemetry-overhead A/B (PERF.md §21); runs on the pinned (or
         # default) platform in-process.

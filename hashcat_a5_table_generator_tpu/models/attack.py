@@ -244,6 +244,24 @@ def unpack_bits(bits: np.ndarray, num_lanes: int) -> np.ndarray:
     return np.unpackbits(bytes_, bitorder="little")[:num_lanes].astype(bool)
 
 
+def scalar_units_host_tables(plan: Plan, ct: CompiledTable
+                             ) -> Dict[str, np.ndarray]:
+    """``pallas_expand.scalar_units_fields`` as HOST arrays under their
+    plan-dict names (``su_*``) — the one naming map, shared by
+    :func:`scalar_units_arrays` (which device-puts them) and the
+    cross-job fuse layer (which signatures and concatenates them
+    host-side, PERF.md §28).  All fields are batch-leading and carry
+    value WORDS inline (never table indices), so compatible tenants'
+    rows concatenate like the plan arrays with no base shifting.
+    Empty when the plan doesn't qualify."""
+    from ..ops.pallas_expand import scalar_units_fields
+
+    fields = scalar_units_fields(plan, ct)
+    if not fields:
+        return {}
+    return {f"su_{k}": np.asarray(v) for k, v in fields.items()}
+
+
 def scalar_units_arrays(plan: Plan, ct: CompiledTable) -> Dict[str, jnp.ndarray]:
     """Device copies of ``pallas_expand.scalar_units_fields``, namespaced
     for the plan dict (``su_*``).  Callers merge them into
@@ -251,12 +269,10 @@ def scalar_units_arrays(plan: Plan, ct: CompiledTable) -> Dict[str, jnp.ndarray]
     the wrappers then replace their per-launch [NB, M, L] precompute with
     word-row gathers (PERF.md §12).  Empty when the plan doesn't qualify
     — the plan dict's pytree structure stays stable per sweep."""
-    from ..ops.pallas_expand import scalar_units_fields
-
-    fields = scalar_units_fields(plan, ct)
-    if not fields:
-        return {}
-    return {f"su_{k}": jnp.asarray(v) for k, v in fields.items()}
+    return {
+        k: jnp.asarray(v)
+        for k, v in scalar_units_host_tables(plan, ct).items()
+    }
 
 
 def piece_host_tables(pieces) -> Dict[str, np.ndarray]:

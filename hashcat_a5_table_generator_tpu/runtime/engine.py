@@ -74,6 +74,22 @@ class JobFailed(Exception):
 _HITS_END = object()
 
 
+class _CtlEvent:
+    """A control notification riding a job's async queue between hits
+    (today: ``refused`` — the job's fused group was re-fused tighter
+    after tenant departure, PERF.md §28).  Carries the event KIND plus
+    the constructor kwargs; the serve pump builds the wire doc with the
+    typed ``runtime.protocol`` constructor at the emit site (graftwire
+    GW001), and the Python API's ``iter_hits`` filters these out — its
+    contract stays hits-only."""
+
+    __slots__ = ("kind", "fields")
+
+    def __init__(self, kind: str, fields: dict) -> None:
+        self.kind = kind
+        self.fields = fields
+
+
 class EngineJob:
     """One tenant sweep's handle: state, async hits, result, and the
     pause/resume/cancel controls.
@@ -117,6 +133,16 @@ class EngineJob:
         this iterator concurrently, or raise the depth).  Ends when the
         job settles; a paused job's stream ends too (the resumed job
         gets a fresh handle and re-plays checkpointed hits into it)."""
+        for item in self._iter_records():
+            if isinstance(item, _CtlEvent):
+                continue
+            yield item
+
+    def _iter_records(self):
+        """``iter_hits`` plus the interleaved :class:`_CtlEvent`
+        control notifications, in stream order — the serve front-end's
+        pump consumes this to forward engine-side events (``refused``)
+        to the wire; the Python API filters them out."""
         while True:
             try:
                 item = self._hits.get(timeout=0.2)
@@ -197,6 +223,16 @@ class EngineJob:
             except queue.Full:
                 continue
 
+    def _push_ctl(self, kind: str, **fields) -> None:
+        # Best-effort, never blocking: a control notification is
+        # informational (stream correctness never depends on it), so a
+        # full queue DROPS it rather than stalling the serve thread
+        # outside the documented hit backpressure.
+        try:
+            self._hits.put_nowait(_CtlEvent(kind, fields))
+        except queue.Full:
+            pass
+
     def _settle(self, state: str) -> None:
         self.state = state
         self._settled.set()
@@ -267,7 +303,8 @@ class Engine:
                  auto: bool = True, pack: Optional[bool] = None,
                  admission_worker: bool = True,
                  faults: "Optional[object]" = None,
-                 job_retries: int = 1) -> None:
+                 job_retries: int = 1,
+                 refuse_below: "Optional[float]" = None) -> None:
         from ..ops.packing import schema_cache_stats
         from .sweep import SweepConfig, step_cache_stats
 
@@ -328,6 +365,21 @@ class Engine:
         #: from the caller thread).
         self._staging: Dict[str, dict] = {}
         self._cancel_all = False  # close(cancel=True) raced activations
+        #: dynamic re-fuse (PERF.md §28): the fill threshold below
+        #: which a fused group that LOST tenants is re-fused into a
+        #: tighter group.  None = the A5GEN_REFUSE env hatch decides
+        #: (0.5 by default); 0/0.0 disables re-fuse for this engine.
+        self._refuse_below = refuse_below
+        #: survivors detached from a thinned group, their re-fuse build
+        #: in flight on the admission worker (under ``_lock``; counted
+        #: in ``jobs_active`` — they are load, just not runnable yet).
+        self._refusing: List[_Slot] = []
+        #: packed-fill instruments (under ``_lock``): the last observed
+        #: per-pump fill and the running minimum since engine start —
+        #: the post-departure decay surface ``--pack-ab`` reads (the
+        #: old fuse-time-only sampling hid masked-lane decay).
+        self._fill_last: Optional[float] = None
+        self._fill_min: Optional[float] = None
         self._step0 = step_cache_stats()
         self._schema0 = schema_cache_stats()
         self._packed0 = self._packed_counters()
@@ -347,6 +399,16 @@ class Engine:
 
         return pack_enabled()
 
+    def _refuse_threshold(self) -> "Optional[float]":
+        """The resolved re-fuse fill threshold (PERF.md §28): an
+        explicit ``Engine(refuse_below=)`` wins (0/0.0 = disabled);
+        otherwise the A5GEN_REFUSE env hatch decides."""
+        if self._refuse_below is not None:
+            return float(self._refuse_below) or None
+        from .env import refuse_threshold
+
+        return refuse_threshold()
+
     @staticmethod
     def _packed_counters() -> Dict[str, int]:
         return {
@@ -358,7 +420,7 @@ class Engine:
     def _ladder_counters() -> Dict[str, int]:
         return {
             k: int(telemetry.counter(f"engine.{k}").value)
-            for k in ("group_demotions", "job_restarts")
+            for k in ("group_demotions", "job_restarts", "refuse_total")
         }
 
     # -- tenant surface ------------------------------------------------
@@ -440,7 +502,13 @@ class Engine:
         with self._lock:
             counts = dict(self._counts)
             groups = dict(self._groups)
-            active = len(self._active)
+            # Re-fusing survivors (PERF.md §28) are still this engine's
+            # load — a router must not see a dip while a rebuild is in
+            # flight — so both activity signals count them.
+            active = len(self._active) + len(self._refusing)
+            refusing = len(self._refusing)
+            fill_last = self._fill_last
+            fill_min = self._fill_min
             fused = len(self._fused)
             building = self._building
             staged = sum(
@@ -503,6 +571,19 @@ class Engine:
                 / packed["lanes_total"]
                 if packed.get("lanes_total") else 0.0
             ),
+            # Dynamic re-fuse (PERF.md §28): retraces since engine
+            # start, survivors mid-rebuild, and the per-pump fill
+            # instruments — last observed and the running minimum —
+            # which (unlike the aggregate above) expose POST-departure
+            # masked-lane decay the moment it happens.
+            "refuse_total": ladder.get("refuse_total", 0),
+            "jobs_refusing": refusing,
+            "packed_fill_last": (
+                fill_last if fill_last is not None else 0.0
+            ),
+            "packed_fill_min": (
+                fill_min if fill_min is not None else 0.0
+            ),
         }
 
     def close(self, *, cancel: bool = False,
@@ -515,7 +596,10 @@ class Engine:
                 # slot not yet in any list is caught by _cancel_all at
                 # its activation.
                 self._cancel_all = True
-                slots = list(self._active)
+                # Re-fusing survivors cancel like active slots: they
+                # reactivate when their rebuild lands and the flag then
+                # retires them at their first round.
+                slots = list(self._active) + list(self._refusing)
                 building = list(self._in_build)
                 # Staged-ready slots (built, parked for their burst
                 # peers) must cancel too: they activate when their
@@ -787,6 +871,39 @@ class Engine:
                 "groups": [], "solo": [], "failed": [(list(_slots),
                                                       _exc)],
             })
+        if item[0] == "refuse":
+            with self._lock:
+                self._building -= 1
+            return self._finish_refuse(item[1])
+        if item[0] == "refuse_death":
+            # Same restart-once recovery as "fuse_death"; a second
+            # death degrades every survivor to a SOLO rebuild from its
+            # carried checkpoint (in _worker_refuse) — a re-fuse must
+            # never fail a job.
+            _entries, _exc = item[1], item[2]
+            telemetry.counter("faults.worker_restarts").add(1)
+            if self._admit_ex is not None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._admit_ex.shutdown(wait=False)
+                # graftrace: owner=collector -- exactly one thread
+                # collects builds (the serve thread in auto mode, the
+                # embedder in manual mode), so the executor restart is
+                # single-writer by construction (PERF.md S23/S26).
+                self._admit_ex = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="a5-engine-admit"
+                )
+                self._admit_ex.submit(self._worker_refuse, _entries,
+                                      True)
+                return
+            with self._lock:  # pragma: no cover - sync mode never queues
+                self._building -= 1
+            return self._finish_refuse({
+                "groups": [],
+                "solo": [s for s, _st in _entries],
+                "failed": [],
+                "states": {id(s): st for s, st in _entries},
+            })
         return self._finish_build(*item[1:])
 
     def _collect_builds(self) -> None:
@@ -902,10 +1019,54 @@ class Engine:
         self._built.put(("fuse", res))
         self._wake.set()
 
+    def _queue_refuse(self, entries: "List[tuple]") -> None:
+        """Off-thread re-fuse build (PERF.md §28): the survivors'
+        ``pack_candidate`` probes and the tighter group's plan
+        concatenation + device upload run on the admission worker —
+        the ONE retrace a re-fuse pays stays off the serve thread (the
+        §22/§24 discipline), which keeps multiplexing every other
+        tenant meanwhile.  ``entries`` pairs each detached slot with
+        the checkpoint captured at its detach boundary; sync-admission
+        mode builds inline."""
+        if self._admit_ex is None:
+            slots = [s for s, _st in entries]
+            states = {id(s): st for s, st in entries}
+            return self._finish_refuse(
+                self._prepare_fuse(slots, states=states)
+            )
+        with self._lock:
+            self._building += 1
+        telemetry.counter("engine.fuse_builds_offthread").add(1)
+        self._admit_ex.submit(self._worker_refuse, entries)
+
+    def _worker_refuse(self, entries: "List[tuple]",
+                       retried: bool = False) -> None:
+        slots = [s for s, _st in entries]
+        states = {id(s): st for s, st in entries}
+        try:
+            res = self._prepare_fuse(slots, states=states)
+        except (KeyboardInterrupt, SystemExit):  # pragma: no cover
+            raise
+        except BaseException as exc:  # noqa: BLE001 — worker death
+            if isinstance(exc, Exception) or retried:
+                # A re-fuse must never fail a job (packing is an
+                # optimization): a batch-scoped error — or a second
+                # worker death — degrades every survivor to a SOLO
+                # rebuild from its carried checkpoint.
+                res = {"groups": [], "solo": list(slots), "failed": [],
+                       "states": states}
+            else:
+                self._built.put(("refuse_death", entries, exc))
+                self._wake.set()
+                return
+        self._built.put(("refuse", res))
+        self._wake.set()
+
     def _fuse_and_activate(self, slots: List["_Slot"]) -> None:
         self._finish_fuse(self._prepare_fuse(slots))
 
-    def _prepare_fuse(self, slots: List["_Slot"]) -> dict:
+    def _prepare_fuse(self, slots: List["_Slot"],
+                      states: "Optional[dict]" = None) -> dict:
         """Fuse a released staging batch (the heavy, thread-safe half —
         the slots are not yet active, so no other thread touches their
         sweeps): slots whose full packed keys match (and that are
@@ -916,14 +1077,26 @@ class Engine:
         every failure here is contained: an eligibility-probe error
         demotes the job to solo dispatch, and a group-build error
         (schema I/O, device memory on the packed upload) fails ONLY the
-        batch it was fusing — never the serve thread."""
+        batch it was fusing — never the serve thread.
+
+        ``states`` (a re-fuse build, PERF.md §28) overrides each slot's
+        admission-time resume state with the checkpoint captured at its
+        detach boundary — cursors are in rank-stride units, so they
+        carry over into the tighter group unchanged; the dict rides the
+        result so the collector rebuilds each machine from the SAME
+        state the probe aligned on."""
         from .fuse import build_fused_group, pack_candidate
 
-        out = {"groups": [], "solo": [], "failed": []}
+        out = {"groups": [], "solo": [], "failed": [],
+               "states": dict(states) if states else {}}
         buckets: Dict[tuple, List[tuple]] = {}
         for slot in slots:
+            resume = (
+                states.get(id(slot)) if states is not None
+                else slot.job._resume_state
+            )
             try:
-                cand = pack_candidate(slot.sweep, slot.job._resume_state)
+                cand = pack_candidate(slot.sweep, resume)
             except Exception:  # noqa: BLE001 — probe error = solo path
                 cand = None
             if cand is None:
@@ -967,6 +1140,64 @@ class Engine:
                 self._settle_counts(slot.job, "failed")
         for slot in res["solo"]:
             self._activate(slot)
+
+    def _finish_refuse(self, res: dict) -> None:
+        """Activation-on-completion for a re-fuse build (collector
+        thread).  Survivors whose packed keys still match ride the new
+        tighter group; the rest rebuild SOLO from their carried
+        checkpoints — a re-fuse must never fail a job, so failed
+        batches degrade to solo rebuilds too, and only a machine-
+        rebuild error quarantines that one member."""
+        states = res.get("states", {})
+        solo = list(res["solo"])
+        for slots, _exc in res["failed"]:
+            solo.extend(slots)
+        for group, slots in res["groups"]:
+            fused_any = False
+            for slot in slots:
+                try:
+                    self._machine_from_state(slot,
+                                             states.get(id(slot)))
+                except Exception as exc:  # noqa: BLE001 — member-scoped
+                    # Park the member's segment in the NEW group (it
+                    # was built expecting this sweep), then quarantine
+                    # just this member.
+                    group.leave(slot.sweep)
+                    self._unrefuse(slot)
+                    self._quarantine(slot, exc)
+                    continue
+                group.register(slot.sweep)
+                self._reactivate(slot)
+                fused_any = True
+            if fused_any:
+                with self._lock:
+                    self._fused.append(group)
+        for slot in solo:
+            try:
+                self._machine_from_state(slot, states.get(id(slot)))
+            except Exception as exc:  # noqa: BLE001 — member-scoped
+                self._unrefuse(slot)
+                self._quarantine(slot, exc)
+                continue
+            self._reactivate(slot)
+
+    def _reactivate(self, slot: "_Slot") -> None:
+        """Return a re-fused survivor to the scheduler.  The slot never
+        left the group/resident accounting (only ``_active``), so no
+        counters move; a cancel/close that raced the rebuild retires it
+        at its first round, before any machine tick."""
+        if self._cancel_all:
+            slot.job.cancel()
+        with self._lock:
+            if slot in self._refusing:
+                self._refusing.remove(slot)
+            self._active.append(slot)
+            self._active.sort(key=lambda s: (s.group, s.seq))
+
+    def _unrefuse(self, slot: "_Slot") -> None:
+        with self._lock:
+            if slot in self._refusing:
+                self._refusing.remove(slot)
 
     def _activate(self, slot: "_Slot") -> None:
         if self._cancel_all:
@@ -1080,10 +1311,90 @@ class Engine:
                 group.pump()
             except Exception as exc:  # noqa: BLE001 — group-scoped
                 self._demote_group(group, exc)
+            else:
+                self._note_fill(group)
             if group.done:
                 with self._lock:
                     if group in self._fused:
                         self._fused.remove(group)
+
+    def _note_fill(self, group) -> None:
+        """Post-pump fill instrumentation + the dynamic re-fuse trigger
+        (PERF.md §28).  The gauges record on EVERY pump — not just at
+        fuse time — so the ``--pack-ab`` fill report sees post-
+        departure masked-lane decay; ``packed_fill_min`` carries the
+        engine-tracked running minimum (``Gauge.set`` overwrites, so
+        ``agg="min"`` only merges across engines).  The trigger: a
+        group that lost tenants to DEPARTURE (cancel/pause — a member
+        draining its range naturally is not churn, and retracing every
+        group's tail would be a spurious rebuild) whose last dispatch
+        fill dropped below the threshold re-fuses its survivors into a
+        tighter group (one
+        retrace; checkpoint cursors are in rank-stride units and carry
+        over unchanged); a lone survivor rebuilds solo through the
+        same path."""
+        fill = group.last_fill
+        if fill is None:
+            return
+        with self._lock:
+            self._fill_last = fill
+            if self._fill_min is None or fill < self._fill_min:
+                self._fill_min = fill
+            fill_min = self._fill_min
+        telemetry.gauge("engine.packed_fill_last").set(fill)
+        telemetry.gauge("engine.packed_fill_min",
+                        agg="min").set(fill_min)
+        thr = self._refuse_threshold()
+        if (
+            thr is not None
+            and fill < thr
+            and group.departures > 0
+            and group.active_members > 0
+            and group._work_remains()
+        ):
+            self._start_refuse(group, fill)
+
+    def _start_refuse(self, group, fill: float) -> None:
+        """Detach a thinned group's survivors at their last consumed
+        boundaries (serve thread; each machine's close runs the packed
+        drive's park finallys) and hand them to the admission worker
+        to re-fuse into a tighter group.  Survivors sit in
+        ``_refusing`` (not ``_active``) while the build runs — they
+        keep their group/resident counts, so reactivation moves no
+        counters.  Members with a pending pause/cancel stay behind:
+        the round honors their request against the OLD group as
+        usual."""
+        members = [
+            slot for slot in self._round_slots()
+            if getattr(slot.sweep, "_packed_source", None) is group
+            and not slot.job._cancel_req.is_set()
+            and not slot.job._pause_req.is_set()
+        ]
+        if not members:
+            return
+        telemetry.counter("engine.refuse_total").add(1)
+        entries = []
+        for slot in members:
+            sweep = slot.sweep
+            # ttfc is a fact about the job's FIRST machine — capture
+            # it before the rebuild resets the sweep's instrument (the
+            # _rebuild_machine discipline, PERF.md §21/§23).
+            if slot.job.ttfc_s is None and sweep._ttfc[0] is not None:
+                slot.job.ttfc_s = sweep._ttfc[0] - sweep._run_t0
+            slot.machine.close()
+            src = getattr(sweep, "_packed_source", None)
+            if src is not None:
+                src.leave(sweep)
+                sweep._packed_source = None
+            entries.append((slot, self._checkpoint_of(slot)))
+        with self._lock:
+            for slot, _state in entries:
+                if slot in self._active:
+                    self._active.remove(slot)
+                self._refusing.append(slot)
+        for slot, _state in entries:
+            slot.job._push_ctl("refused", jobs=len(entries), fill=fill)
+        self._queue_refuse(entries)
 
     def _demote_group(self, group, exc: BaseException) -> None:
         """The degradation ladder's packed rung (PERF.md §23): a fused
@@ -1137,7 +1448,18 @@ class Engine:
         if src is not None:
             src.leave(sweep)
             sweep._packed_source = None
-        state = self._checkpoint_of(slot)
+        self._machine_from_state(slot, self._checkpoint_of(slot))
+
+    def _machine_from_state(self, slot: _Slot,
+                            state: "Optional[CheckpointState]") -> None:
+        """Fresh machine on the slot's sweep from ``state`` — the
+        shared tail of demotion, transient restart, and re-fuse
+        rebuilds.  Replayed checkpointed hits are muted on the job's
+        async queue (the tenant already received them on this handle)
+        while still rebuilding the recorder's ordered result list."""
+        sweep = slot.sweep
+        if state is None:
+            state = self._checkpoint_of(slot)
         if slot.job.kind == "crack":
             recorder = _JobRecorder(slot.job, mute=len(state.hits))
             slot.machine = sweep.crack_machine(
@@ -1145,7 +1467,8 @@ class Engine:
             )
         else:
             slot.machine = sweep.candidates_machine(
-                slot.job._submit_args["writer"], resume=False, state=state
+                slot.job._submit_args["writer"], resume=False,
+                state=state
             )
 
     def _recover_job(self, slot: _Slot, exc: BaseException) -> None:
@@ -1211,7 +1534,16 @@ class Engine:
             state = CheckpointState(fingerprint=slot.sweep.fingerprint)
         return copy.deepcopy(state)
 
+    def _note_departure(self, slot: _Slot) -> None:
+        # A tenant ACTION removed this member from its fused group —
+        # the churn signal the re-fuse trigger requires (a member
+        # finishing naturally never counts).
+        src = getattr(slot.sweep, "_packed_source", None)
+        if src is not None:
+            src.departures += 1
+
     def _park(self, slot: _Slot) -> None:
+        self._note_departure(slot)
         slot.machine.close()  # runs the sweep's cleanup finallys
         self._drop(slot)
         slot.job.checkpoint = self._checkpoint_of(slot)
@@ -1219,6 +1551,7 @@ class Engine:
         self._settle_counts(slot.job, "paused")
 
     def _retire(self, slot: _Slot, state: str) -> None:
+        self._note_departure(slot)
         slot.machine.close()
         self._drop(slot)
         self._settle_counts(slot.job, state)
@@ -1460,7 +1793,16 @@ class _JsonlSession:
         a reconnecting session (PERF.md §23)."""
         client_gone = False
         try:
-            for rec in job.iter_hits():
+            for rec in job._iter_records():
+                if isinstance(rec, _CtlEvent):
+                    # Engine-side control notifications forwarded in
+                    # stream order; the typed constructor at the emit
+                    # site keeps graftwire's registry authoritative.
+                    if rec.kind == "refused":
+                        self._emit(protocol.ev_refused(
+                            job.id, **rec.fields
+                        ))
+                    continue
                 self._emit(protocol.ev_hit(
                     job.id,
                     digest=rec.digest_hex,

@@ -134,6 +134,40 @@ def pair_enabled() -> bool:
     )
 
 
+def refuse_threshold() -> "Optional[float]":
+    """Dynamic re-fuse fill threshold (PERF.md §28): when a fused
+    group's per-round fill drops below this ratio after a tenant
+    departs, the engine re-fuses the survivors into a tighter group.
+    ``A5GEN_REFUSE`` holds the ratio (0 < r <= 1); ``off``/``0``/``no``
+    disables re-fuse; empty/unset keeps the default (0.5).
+    ``Engine(refuse_below=)`` overrides this per engine; an unparseable
+    value warns once and keeps the default — a typo must not silently
+    stop (or start) retracing groups."""
+    val = read_env("A5GEN_REFUSE")
+    if val in (None, ""):
+        return 0.5
+    if val.lower() in ("off", "0", "no"):
+        return None
+    try:
+        r = float(val)
+        if not 0.0 < r <= 1.0:
+            raise ValueError
+    except ValueError:
+        name_val = ("A5GEN_REFUSE", val)
+        if name_val not in _WARNED:
+            _WARNED.add(name_val)
+            import sys
+
+            print(
+                f"a5gen: warning: unrecognized A5GEN_REFUSE={val!r} "
+                "(want a fill ratio in (0, 1], or off|0|no); keeping "
+                "the default (0.5)",
+                file=sys.stderr,
+            )
+        return 0.5
+    return r
+
+
 def schema_cache_dir() -> "Optional[str]":
     """On-disk PieceSchema cache directory (``A5GEN_SCHEMA_CACHE``;
     empty/unset = no persistent cache).  ``SweepConfig.schema_cache`` /

@@ -1093,8 +1093,12 @@ class FleetRouter:
                 # the jobs that crash-replayed onto the survivors
                 # (its detail row below still shows the final state).
                 for k, v in s.items():
+                    # Ratios (fill instruments) are per-engine facts —
+                    # summing them across members is meaningless; the
+                    # detail rows below carry them instead.
                     if isinstance(v, bool) or k in (
-                        "packed_fill", "config_defaults"
+                        "packed_fill", "packed_fill_last",
+                        "packed_fill_min", "config_defaults"
                     ):
                         continue
                     if isinstance(v, (int, float)):
@@ -1116,6 +1120,11 @@ class FleetRouter:
                     self._resident_tokens(link)
                 ),
                 "packed_fill": s.get("packed_fill", 0.0),
+                # Post-departure fill decay + re-fuse activity
+                # (PERF.md §28): the router's view of how well each
+                # member keeps its fused groups tight under churn.
+                "packed_fill_min": s.get("packed_fill_min", 0.0),
+                "refuse_total": s.get("refuse_total", 0),
             })
         with self._lock:
             unsettled = sum(

@@ -36,12 +36,23 @@ when they agree on the full static trace config (spec, geometry,
 superstep shape, out_width, windowed decision, plan-array trailing
 shapes) and each is solo-superstep-eligible with a stride-aligned
 cursor.  Streaming jobs, closed (cascade-closure) plans, and candidates
-jobs always keep the per-job path.  The packed program itself uses the
-generic XLA expansion tiers (no per-plan piece schema / scalar-units
-statics — those are per-wordlist trace structure no two tenants share);
-the emission scheme never changes WHAT is emitted (PERF.md §17's
-parity contract), only per-lane throughput, and for the underfilled
-small jobs packing targets, dispatch amortization dominates.
+jobs always keep the per-job path.  The packed program keeps the SAME
+kernel tier the members' solo sweeps would use — per-slot piece schema
+(``pp_*``) AND the fused Pallas kernels' scalar-unit statics (``su_*``,
+PERF.md §28) are batch-leading host tables that concatenate row-wise,
+so a compatible group compiles to ONE fused kernel launch instead of
+dropping to the XLA expansion tier; the emission scheme never changes
+WHAT is emitted (PERF.md §17's parity contract), only per-lane
+throughput.
+
+Dynamic re-fuse (PERF.md §28): a departed tenant's segment parks as
+masked lanes, so packed fill decays monotonically under churn.  The
+group reports its per-round fill (``last_fill``); when it drops below
+the engine's re-fuse threshold (``A5GEN_REFUSE``), the engine detaches
+the survivors at their fetched boundaries and re-fuses them into a
+tighter group off the serve thread — one retrace (a new ``n_seg`` is a
+new step-cache key), checkpoint cursors carry over unchanged because
+all cursor math already walks in rank-stride units.
 
 ``A5GEN_PACK=off`` (or ``Engine(pack=False)``) restores the PR 8
 per-job dispatch path wholesale.
@@ -152,18 +163,22 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         return None
     b0 = total_blocks if w >= plan.batch else int(cum[w]) + rank // stride
     windowed = bool(getattr(plan, "windowed", False))
-    # The per-slot piece schema (PERF.md §17) and the radix-2 decode
-    # collapse are plan-derived trace statics: compatible tenants must
-    # agree on them (the common case — same dictionary shape × same
-    # table family yields identical schema structure), and their data
-    # tables are batch-leading, so the packed program keeps the SAME
-    # emission tier solo runs use.  The remaining solo-only tiers (the
-    # fused Pallas kernels' per-plan scalar-unit statics) fall back to
-    # the XLA tier under packing — emission scheme and kernel tier
-    # never change WHAT is emitted (the §17 parity contract).
+    # The per-slot piece schema (PERF.md §17), the radix-2 decode
+    # collapse, and the fused Pallas kernel verdicts (PERF.md §28) are
+    # plan-derived trace statics: compatible tenants must agree on them
+    # (the common case — same dictionary shape × same table family
+    # yields identical schema structure), and their data tables — the
+    # ``pp_*`` piece tables AND the ``su_*`` scalar-unit fields — are
+    # batch-leading, so the packed program keeps the SAME kernel tier
+    # solo runs use, fused Pallas included.  Emission scheme and kernel
+    # tier never change WHAT is emitted (the §17 parity contract).
     from ..models.attack import piece_host_tables, plan_array_keys
     from ..ops.packing import piece_schema_for
-    from ..ops.pallas_expand import k_opts_for
+    from ..ops.pallas_expand import (
+        k_opts_for,
+        opts_for,
+        scalar_units_for,
+    )
     from .sweep import _pieces_static
 
     pieces = piece_schema_for(
@@ -199,6 +214,24 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
             steps,
             ((1 << 31) - 1) // max(1, cfg.lanes * n_devices * pair_k),
         ))
+    # Packed Pallas fast path (PERF.md §28): the fused expand→hash
+    # kernel's verdicts, probed exactly as the solo sweep probes them
+    # (Sweep._superstep_static).  Both join the compatibility key —
+    # members agree on the kernel tier (and its option count) or they
+    # never fuse — and the su_* statics join the signature tree so the
+    # per-segment schema indirection concatenates like the plan rows.
+    # Eligibility at the PACKED value width is witnessed: the packed
+    # tables zero-pad narrow members' value rows to the widest member's
+    # width, and that member's own gate passed at exactly that width
+    # with every other gate input (out_width, windowed, trailing
+    # shapes, k) pinned equal by this key.
+    fused_opts = opts_for(
+        sweep.spec, plan, sweep.ct,
+        block_stride=stride, num_blocks=cfg.num_blocks,
+    )
+    scalar_units = (
+        scalar_units_for(plan) if fused_opts is not None else False
+    )
     # Trailing-shape signature of the plan + piece arrays: equal
     # signatures concatenate row-wise with no padding, so the packed
     # arrays are byte-wise each job's solo arrays stacked.  Host-array
@@ -206,6 +239,10 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
     # plan through device buffers.
     tree = {k: getattr(plan, k) for k in plan_array_keys(plan)}
     tree.update(piece_host_tables(pieces))
+    if fused_opts is not None and scalar_units:
+        from ..models.attack import scalar_units_host_tables
+
+        tree.update(scalar_units_host_tables(plan, sweep.ct))
     sig = tuple(
         (k, tuple(v.shape[1:]), str(v.dtype))
         for k, v in sorted(tree.items())
@@ -214,7 +251,7 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         sweep.spec, cfg.lanes, cfg.num_blocks, stride, steps,
         int(cfg.superstep_hit_cap), plan.out_width, windowed, n_devices,
         sweep._pipeline_depth(), sig, _pieces_static(pieces), radix2,
-        pair_k,
+        pair_k, fused_opts, scalar_units,
         # Fault-supervision knobs (PERF.md §23): the group runs ONE
         # retry policy and ONE fetch watchdog for every member, so
         # jobs that disagree on them must not fuse — a fail-fast
@@ -239,6 +276,8 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
         "n_devices": n_devices,
         "pieces": pieces,
         "radix2": radix2,
+        "fused_opts": fused_opts,
+        "scalar_units": scalar_units,
         "key": key,
     }
 
@@ -302,6 +341,14 @@ def _packed_plan_tree(members: Sequence[dict]):
             k: np.asarray(v)
             for k, v in piece_host_tables(m["pieces"]).items()
         })
+        # The fused Pallas kernel's scalar-unit statics (``su_*``,
+        # PERF.md §28) concatenate the same way — batch-leading rows
+        # whose value fields pack the value WORDS inline (never table
+        # indices), so no base shifting applies to them below.
+        if m["fused_opts"] is not None and m["scalar_units"]:
+            from ..models.attack import scalar_units_host_tables
+
+            tree.update(scalar_units_host_tables(plan, m["sweep"].ct))
         trees.append(tree)
     vb = [np.asarray(m["sweep"].ct.val_bytes) for m in members]
     vl = [np.asarray(m["sweep"].ct.val_len) for m in members]
@@ -419,6 +466,17 @@ class FusedGroup:
         self.depth = sweep0._pipeline_depth()
         self._inflight: deque = deque()
         self.dispatches = 0
+        #: the last consumed round's fill ratio (occupied variant lanes
+        #: over the dispatch's lane geometry) — the engine's re-fuse
+        #: trigger and the post-departure fill instrument (PERF.md §28)
+        #: read this instead of re-deriving it from the counters.
+        self.last_fill: Optional[float] = None
+        #: members that left by tenant action (cancel/pause — the
+        #: engine bumps this at retire/park).  The re-fuse trigger
+        #: requires a DEPARTURE: a member draining its range naturally
+        #: also thins the group, but retracing a natural tail would
+        #: charge every group a spurious rebuild at its end.
+        self.departures = 0
 
         plan_tree, table_tree = _packed_plan_tree(members)
         dig_tree = _packed_digest_arrays(members)
@@ -432,12 +490,18 @@ class FusedGroup:
             total_blocks=int(blk_base[-1]), windowed=windowed,
             n_seg=self.n_seg, pieces=m0["pieces"], radix2=m0["radix2"],
             pair_k=m0["pair_k"],
+            # The fused Pallas verdicts (PERF.md §28) — part of the
+            # compatibility key, so every member agreed at fuse time;
+            # the packed plan tree carries the concatenated su_* rows
+            # the kernel's scalar-unit prelude gathers per block.
+            fused_expand_opts=m0["fused_opts"],
+            fused_scalar_units=m0["scalar_units"],
         )
         skey = ("packed-superstep", spec, self.n_seg, self._n_devices,
                 cfg.lanes, cfg.num_blocks, m0["plan"].out_width,
                 self.stride, self.steps, self._hit_cap, windowed,
                 _pieces_static(m0["pieces"]), m0["radix2"],
-                m0["pair_k"])
+                m0["pair_k"], m0["fused_opts"], m0["scalar_units"])
         if self._n_devices == 1:
             self._p = {k: jnp.asarray(v) for k, v in plan_tree.items()}
             self._t = {k: jnp.asarray(v) for k, v in table_tree.items()}
@@ -509,6 +573,14 @@ class FusedGroup:
     def done(self) -> bool:
         """Every member has left (finished, paused, cancelled, failed)."""
         return not any(self._active)
+
+    @property
+    def active_members(self) -> int:
+        """Members still attached (segment not parked).  The engine's
+        re-fuse trigger compares this against ``n_seg`` to tell churn
+        fill loss (departed tenants' parked segments) from natural
+        tail under-occupancy, which no re-fuse can recover."""
+        return int(sum(self._active))
 
     def register(self, sweep) -> None:
         """Bind a member sweep to its segment (the engine sets
@@ -662,12 +734,14 @@ class FusedGroup:
         # packed_fill) record even under A5GEN_TELEMETRY=off — the PR 9
         # off-hatch contract: the hatch changes observability, never
         # results (same convention as the step_cache.* counters).
-        telemetry.counter("engine.packed_dispatches").add(1)
-        telemetry.counter("engine.packed_lanes_occupied").add(occupied)
-        telemetry.counter("engine.packed_lanes_total").add(
+        total = (
             self.steps * self._lanes * self._n_devices
             * max(1, self.pair_k)
         )
+        self.last_fill = occupied / max(1, total)
+        telemetry.counter("engine.packed_dispatches").add(1)
+        telemetry.counter("engine.packed_lanes_occupied").add(occupied)
+        telemetry.counter("engine.packed_lanes_total").add(total)
         return True
 
     # -- host bookkeeping ----------------------------------------------

@@ -64,6 +64,7 @@ __all__ = [
     "ev_paused",
     "ev_cancelled",
     "ev_failed",
+    "ev_refused",
     "ev_migrating",
     "ev_draining",
     "ev_stats",
@@ -78,7 +79,7 @@ __all__ = [
 #: checkpoint doc's ``wire_version``: field/op/event ADDITIONS bump the
 #: minor (old readers ignore unknown fields), removals/renames bump the
 #: major.  ``--update-protocol`` refuses a re-pin that violates this.
-PROTOCOL_VERSION = "1.0"
+PROTOCOL_VERSION = "1.1"
 
 #: The two envelope keys.  Outside this module they are banned as raw
 #: string literals (graftwire GW005, the GL012 sprawl discipline) —
@@ -242,6 +243,21 @@ WIRE_EVENTS: Dict[str, Dict[str, Any]] = {
             "origin); checkpoint_invalid replaces it when capture-time "
             "validation rejected the doc; error=overloaded sheds "
             "carry reason + retry_after_s"
+        ),
+    },
+    "refused": {
+        "required": ["id"],
+        "optional": ["jobs", "fill"],
+        "emitters": ["engine"],
+        "route": "passthrough",
+        "note": (
+            "dynamic re-fuse notification (PERF.md 28): the job's "
+            "fused group dropped below the fill threshold after a "
+            "tenant departed and its survivors were re-fused into a "
+            "tighter group; jobs = survivor count, fill = the "
+            "triggering fill ratio.  Informational — streams, "
+            "checkpoints and results are unchanged — so the router's "
+            "fallback forwards it verbatim"
         ),
     },
     "migrating": {
@@ -484,6 +500,24 @@ def ev_failed(
         ev["retry_after_s"] = retry_after_s
     if checkpoint is not None:
         ev["checkpoint"] = checkpoint
+    return ev
+
+
+def ev_refused(
+    jid: Any,
+    *,
+    jobs: Optional[int] = None,
+    fill: Optional[float] = None,
+) -> Dict[str, Any]:
+    """The dynamic re-fuse notification (PERF.md §28): this job's
+    fused group fell below the fill threshold and its survivors were
+    re-fused into a tighter group.  Informational — the job's stream,
+    checkpoints and results are unchanged."""
+    ev: Dict[str, Any] = {"id": jid, K_EVENT: "refused"}
+    if jobs is not None:
+        ev["jobs"] = jobs
+    if fill is not None:
+        ev["fill"] = fill
     return ev
 
 

@@ -101,14 +101,16 @@ class Counter:
 
 class Gauge:
     """Point-in-time value with a declared merge aggregation
-    (``max``/``sum``/``last``) — snapshots carry the policy so
+    (``max``/``min``/``sum``/``last``) — snapshots carry the policy so
     :func:`merge` needs no out-of-band table."""
 
     __slots__ = ("name", "agg", "_value", "_lock")
 
     def __init__(self, name: str, agg: str = "last") -> None:
-        if agg not in ("max", "sum", "last"):
-            raise ValueError(f"gauge agg must be max|sum|last, got {agg!r}")
+        if agg not in ("max", "min", "sum", "last"):
+            raise ValueError(
+                f"gauge agg must be max|min|sum|last, got {agg!r}"
+            )
         self.name = name
         self.agg = agg
         self._value = 0.0
@@ -424,6 +426,8 @@ def merge(snapshots: Iterable[Dict[str, dict]]) -> Dict[str, dict]:
                     cur["value"] += entry["value"]
                 elif agg == "max":
                     cur["value"] = max(cur["value"], entry["value"])
+                elif agg == "min":
+                    cur["value"] = min(cur["value"], entry["value"])
                 else:
                     cur["value"] = entry["value"]
     return out

@@ -121,6 +121,9 @@ class TestBucketedSweep:
         assert res.n_emitted == len(want)
         assert res.words_done == len(WORDS)
 
+    @pytest.mark.slow  # ~11 s on the tier-1 host; global-position
+    # mapping keeps default coverage via the bucketed sweep parity
+    # arms above.
     def test_crack_hits_report_global_dictionary_positions(self):
         spec = AttackSpec(mode="default", algo="md5")
         # Plant one hit in the 16-bucket and one in the 128-bucket.

@@ -395,6 +395,9 @@ class TestDeviceBackend:
         assert packed.stdout == strided.stdout == auto.stdout
         assert strided.stdout
 
+    @pytest.mark.slow  # ~10 s on the tier-1 host (jax profiler
+    # start/stop dominates); the CLI device-backend plumbing keeps
+    # default coverage via the other TestDeviceBackend arms.
     def test_profile_writes_trace(self, workdir, tmp_path):
         # --profile DIR: a device sweep leaves a jax.profiler trace on disk
         # (plugins/profile/<ts>/*.trace.json.gz or *.xplane.pb, backend-

@@ -209,8 +209,14 @@ class TestPallasFuzzParity:
     """The fused kernels, fuzzed per (mode, algo) — interpret mode."""
 
     @pytest.mark.parametrize("mode,algo", [
-        ("default", "md5"), ("default", "ntlm"),
-        ("reverse", "sha1"), ("reverse", "md5"),
+        ("default", "md5"),
+        # The (default, ntlm) and (reverse, sha1) arms cost ~7 s and
+        # ~10 s interpret-mode on the tier-1 host; ntlm keeps a default
+        # arm via (suball-reverse, ntlm) + the multiword-split test,
+        # sha1 via (suball-reverse, sha1).
+        pytest.param("default", "ntlm", marks=pytest.mark.slow),
+        pytest.param("reverse", "sha1", marks=pytest.mark.slow),
+        ("reverse", "md5"),
         ("suball", "md4"), ("suball", "md5"),
         ("suball-reverse", "ntlm"), ("suball-reverse", "sha1"),
     ])
@@ -247,6 +253,9 @@ class TestPallasFuzzParity:
             spec, plan, ct, schema, parr, t, b, algo=algo,
         ) > 0
 
+    @pytest.mark.slow  # ~10 s interpret cost on the tier-1 host; the
+    # multi-u32 piece × utf16 boundary fold keeps default coverage via
+    # the suball NTLM parity test in test_pallas_expand.
     def test_ntlm_multiword_split_pieces(self):
         # 3-byte values on longer words force multi-u32 pieces whose
         # UTF-16LE expansion crosses word boundaries — the split-piece
@@ -264,6 +273,9 @@ class TestPallasFuzzParity:
             scalar_units=False,
         ) > 0
 
+    @pytest.mark.slow  # ~8 s interpret cost on the tier-1 host; the
+    # windowed decode keeps default coverage via the windowed parity
+    # tests in test_pallas_expand and the windowed pack parity arm.
     def test_windowed_scalar_parity(self):
         spec = AttackSpec(mode="default", algo="md5", min_substitute=1,
                           max_substitute=2)
@@ -274,6 +286,9 @@ class TestPallasFuzzParity:
         assert_pallas_parity(spec, plan, ct, schema, parr, t, b,
                              algo="md5")
 
+    @pytest.mark.slow  # ~12 s interpret cost on the tier-1 host
+    # (runs both kernel tiers back to back); each tier keeps its own
+    # default arm via the scalar/general suball parity tests above.
     def test_windowed_suball_parity_both_tiers(self):
         # The suball windowed piece kernels: the scalar tier packs the
         # DP walk's chosen bits through the per-block bitpos ref; the
@@ -453,6 +468,9 @@ class TestGates:
         assert emit_scheme() == "perslot"
         assert "A5GEN_EMIT" in capsys.readouterr().err
 
+    @pytest.mark.slow  # ~7 s interpret cost on the tier-1 host; the
+    # bucket-word tail chunking keeps default coverage via the bucketed
+    # sweep parity tests in test_bucketed.
     def test_matchless_bucket_word_chunks_its_tail(self):
         # A 16-byte word with no matches must not veto the schema: its
         # tail splits into <=4-byte literal chunk groups instead of one

@@ -85,6 +85,9 @@ class TestSoloParity:
         assert got.n_emitted == want.n_emitted
         assert got.routing == want.routing
 
+    @pytest.mark.slow  # ~9 s on the tier-1 host; streaming solo parity
+    # keeps default coverage via test_streaming's stream-parity arms
+    # and the packed streaming survivors in test_pack.
     def test_streaming_crack_parity(self):
         spec = AttackSpec(mode="default", algo="md5")
         _planted, digests = planted_digests(spec, LEET, WORDS, (0, -1))

@@ -160,6 +160,9 @@ class TestDefaultMode:
         words = [b"pass", b"hi", b"", b"a", "λόγος".encode(), b"Pa,s"]
         assert_parity(sub_map, words, 0, 15)
 
+    @pytest.mark.slow  # ~9 s on the tier-1 host; block splitting keeps
+    # default coverage via the multi-block suball parity test in
+    # test_pallas_expand and the strided CLI arm.
     def test_block_splitting_matches_whole_run(self):
         sub_map = {b"a": [b"1", b"2", b"3"], b"b": [b"x", b"y"], b"c": [b"q"]}
         words = [b"abcabc", b"cab"]
@@ -425,6 +428,9 @@ class TestWindowedEnumeration:
             )
         assert got == want
 
+    @pytest.mark.slow  # ~13 s on the tier-1 host; windowed hit decode
+    # keeps default coverage via the windowed parity tests in
+    # test_pallas_expand and the windowed pack arm.
     def test_windowed_crack_hits_decode(self):
         # decode_variant + lane_cursor must invert the windowed ranks: a
         # planted digest's hit candidate must reconstruct exactly.

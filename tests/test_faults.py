@@ -796,3 +796,189 @@ class TestCrashRecoverySoak:
         assert run1_hits == want[: len(run1_hits)]
         assert done["n_hits"] == baseline.n_hits
         assert done["n_emitted"] == baseline.n_emitted
+
+
+@pytest.mark.slow
+class TestRefuseCrashSoak:
+    def test_sigkill_after_refuse_cursor_carries_over(self, tmp_path,
+                                                      spec):
+        """Churn + crash (PERF.md §28): four packed tenants, two cancel
+        mid-flight, the engine re-fuses the survivors (the client sees
+        the ``refused`` event), and THEN the serve process is
+        SIGKILLed.  The survivors' on-disk checkpoints — cursors in
+        rank-stride units, written at every boundary across the
+        re-fuse — resume on a fresh engine to the uninterrupted byte
+        stream, with run 1's delivered hits a prefix of it: the cursor
+        is interchangeable between the original group, the re-fused
+        group, and a solo resume."""
+        sock = str(tmp_path / "churn.sock")
+        n = 4
+        words, digests, cks = [], [], []
+        for i in range(n):
+            rot = (LONG_WORDS[i:] + LONG_WORDS[:i]) * 2
+            d = planted_digests(spec, rot)
+            d += [hashlib.md5(b"tenant-%d" % i).digest()]
+            words.append(rot)
+            digests.append(d)
+            cks.append(str(tmp_path / ("t%d.ck.json" % i)))
+        docs = [
+            {
+                "op": "submit", "id": "t%d" % i,
+                "table_map": {
+                    k.decode(): [v.decode() for v in vals]
+                    for k, vals in LEET.items()
+                },
+                "words": [w.decode() for w in words[i]],
+                "digest_list": [d.hex() for d in digests[i]],
+                "config": {
+                    "checkpoint_path": cks[i],
+                    "checkpoint_every_s": 0.0,
+                },
+            }
+            for i in range(n)
+        ]
+        serve_argv = ["serve", "--socket", sock, "--lanes", "64",
+                      "--blocks", "16", "--superstep", "1"]
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["A5GEN_REFUSE"] = "0.9"
+
+        p1 = subprocess.Popen(
+            [sys.executable, "-c", _SERVE_DRIVER, *serve_argv],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        run1 = {d["id"]: [] for d in docs}
+        refused = None
+        try:
+            c1 = _connect(sock, timeout=120.0)
+            c1.settimeout(120.0)
+            f1 = c1.makefile("rw")
+            # One flush for the burst: the admission-build window IS
+            # the packing window, so all four fuse into one group.
+            for doc in docs:
+                f1.write(json.dumps(doc) + "\n")
+            f1.flush()
+            accepted = set()
+            while len(accepted) < n:
+                ev = json.loads(f1.readline())
+                assert ev["event"] == "accepted"
+                accepted.add(ev["id"])
+            # Cancel only after the FIRST hit: a cancel that lands
+            # while the burst is still building is honored pre-
+            # admission and the departing pair never joins the group
+            # at all (no departure, nothing to re-fuse).
+            cancelled = False
+            for line in f1:
+                ev = json.loads(line)
+                if ev.get("event") == "hit":
+                    run1[ev["id"]].append(
+                        (ev["word_index"], int(ev["rank"]),
+                         ev["plain_hex"], ev["digest"])
+                    )
+                    if not cancelled:
+                        cancelled = True
+                        f1.write(
+                            json.dumps({"op": "cancel", "id": "t0"})
+                            + "\n"
+                        )
+                        f1.write(
+                            json.dumps({"op": "cancel", "id": "t1"})
+                            + "\n"
+                        )
+                        f1.flush()
+                elif ev.get("event") == "refused":
+                    refused = ev
+                    break
+                elif ev.get("event") == "done":
+                    pytest.fail(
+                        "a survivor drained before the re-fuse landed"
+                    )
+            assert refused is not None and refused["id"] in ("t2", "t3")
+            assert 0.0 < refused["fill"] < 0.9
+            # Let the re-fused group cross a few boundaries (the
+            # checkpoint writes at EVERY boundary) before pulling the
+            # plug; bounded so fast hosts don't drain the survivors.
+            extra = 0
+            c1.settimeout(1.0)
+            try:
+                while extra < 4:
+                    line = f1.readline()
+                    if not line:
+                        break
+                    ev = json.loads(line)
+                    extra += 1
+                    if ev.get("event") == "hit":
+                        run1[ev["id"]].append(
+                            (ev["word_index"], int(ev["rank"]),
+                             ev["plain_hex"], ev["digest"])
+                        )
+                    elif ev.get("event") == "done":
+                        break
+            except (socket.timeout, TimeoutError):
+                pass
+            p1.kill()  # SIGKILL — no shutdown hooks, no final flush
+            assert p1.wait(timeout=60) == -9
+            c1.close()
+        finally:
+            if p1.poll() is None:
+                p1.kill()
+                p1.wait()
+
+        want = {
+            jid: [
+                (h.word_index, h.variant_rank, h.candidate.hex(),
+                 h.digest_hex)
+                for h in Sweep(
+                    spec, LEET, words[i], digests[i], config=cfg()
+                ).run_crack().hits
+            ]
+            for i, jid in ((2, "t2"), (3, "t3"))
+        }
+        for jid in ("t2", "t3"):
+            assert run1[jid] == want[jid][: len(run1[jid])]
+
+        probe = Sweep(spec, LEET, words[2], digests[2], config=cfg())
+        state = load_checkpoint(cks[2], probe.fingerprint)
+        assert state is not None
+        assert 0 < state.cursor.word <= len(words[2])
+
+        p2 = subprocess.Popen(
+            [sys.executable, "-c", _SERVE_DRIVER, *serve_argv],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        run2 = []
+        try:
+            c2 = _connect(sock, timeout=120.0)
+            c2.settimeout(120.0)
+            f2 = c2.makefile("rw")
+            resub = dict(docs[2])
+            resub["checkpoint"] = state_to_doc(state)
+            f2.write(json.dumps(resub) + "\n")
+            f2.flush()
+            assert json.loads(f2.readline())["event"] == "accepted"
+            done = None
+            for line in f2:
+                ev = json.loads(line)
+                if ev.get("event") == "hit":
+                    run2.append(
+                        (ev["word_index"], int(ev["rank"]),
+                         ev["plain_hex"], ev["digest"])
+                    )
+                elif ev.get("event") == "done":
+                    done = ev
+                    break
+            assert done is not None and done["resumed"]
+            f2.write(json.dumps({"op": "shutdown"}) + "\n")
+            f2.flush()
+            p2.wait(timeout=60)
+        finally:
+            if p2.poll() is None:
+                p2.kill()
+                p2.wait()
+
+        # Byte parity: checkpoint replay + the resumed sweep reproduce
+        # the uninterrupted survivor stream exactly, through a cursor
+        # that crossed a re-fuse boundary in run 1.
+        assert run2 == want["t2"]

@@ -348,6 +348,9 @@ def fleet2(tmp_path):
 
 
 class TestFleetInProcess:
+    @pytest.mark.slow  # ~10 s on the tier-1 host; runs in CI via the
+    # slow fleet soak step (-k filter includes "churn"); fleet routing
+    # keeps default coverage via the other in-process fleet arms.
     def test_churn_mix_byte_parity(self, fleet2):
         """The §25 fast-tier contract: 2 engines × 4 churning tenants
         (plain / pause→resume / migrate / cancel) through the router —

@@ -84,6 +84,9 @@ class TestPackedParity:
             assert full_hits(h.result(timeout=0)) == full_hits(w)
         eng2.close()
 
+    @pytest.mark.slow  # ~15 s on the tier-1 host; the windowed ×
+    # streaming packed mix keeps default coverage via the homogeneous
+    # packed-parity arms above and TestRefuse's streaming survivors.
     def test_heterogeneous_batch_windowed_and_streaming(self):
         """A mixed burst: two packable tenants, one WINDOWED job (its
         enumeration scheme is different static trace structure) and one
@@ -371,6 +374,206 @@ class TestAdmissionWorker:
             assert full_hits(h.result(timeout=0)) == full_hits(w)
         eng.close()
 
+#: Long-tenant churn fixtures cached per geometry: the re-fuse tests
+#: need work REMAINING after the mid-flight departures, and they share
+#: the solo baseline sweeps to keep the tier-1 budget flat.
+_CHURN_CACHE: dict = {}
+
+
+def _churn_fixture(spec, c, n=4, reps=4):
+    key = (spec.mode, c.lanes, c.num_blocks, c.superstep, n, reps)
+    if key not in _CHURN_CACHE:
+        jobs = []
+        for i in range(n):
+            rot = WORDS[i % len(WORDS):] + WORDS[:i % len(WORDS)]
+            words = rot * reps
+            _p, digests = planted_digests(
+                spec, LEET, words, (0, -1), decoys=4
+            )
+            digests += [hashlib.md5(b"tenant-%d" % i).digest()]
+            jobs.append((words, digests))
+        _CHURN_CACHE[key] = (jobs, _solo(spec, jobs, c))
+    return _CHURN_CACHE[key]
+
+
+def _drive_until_idle(eng, max_rounds=400):
+    for _ in range(max_rounds):
+        eng._serve_round()
+        eng._admit(wait=True)  # collects off-thread re-fuse builds too
+        if not eng.stats()["jobs_active"]:
+            return
+    raise AssertionError("engine did not drain")
+
+
+class TestRefuse:
+    def test_refuse_retraces_survivors_byte_exact(self):
+        """Two of four fused tenants cancel mid-flight; the thinned
+        group's fill drops below the threshold and the engine re-fuses
+        the survivors into a tighter group (PERF.md §28) — their hit
+        streams stay byte-exact vs solo, the retrace is counted, and
+        the per-pump fill instruments record the post-departure
+        decay."""
+        spec = AttackSpec(mode="default", algo="md5")
+        c = cfg(superstep=1)
+        jobs, want = _churn_fixture(spec, c)
+        eng = Engine(c, auto=False, refuse_below=0.9)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng._admit()
+        assert eng.stats()["fused_groups"] == 1
+        for _ in range(2):
+            eng._serve_round()
+        handles[0].cancel()
+        handles[1].cancel()
+        _drive_until_idle(eng)
+        st = eng.stats()
+        got = [handles[i].result(timeout=5) for i in (2, 3)]
+        eng.close()
+        assert st["refuse_total"] >= 1
+        assert 0.0 < st["packed_fill_min"] < 1.0
+        assert st["packed_fill_last"] > 0.0
+        assert handles[0].state == handles[1].state == "cancelled"
+        for g, w in zip(got, (want[2], want[3])):
+            assert full_hits(g) == full_hits(w)
+            assert g.n_emitted == w.n_emitted
+
+    def test_refuse_disabled_keeps_thinned_group(self):
+        """refuse_below=0 pins the pre-§28 behavior: the thinned group
+        keeps dispatching with masked lanes (no retrace) and the
+        survivors still drain byte-exact."""
+        spec = AttackSpec(mode="default", algo="md5")
+        c = cfg(superstep=1)
+        jobs, want = _churn_fixture(spec, c)
+        eng = Engine(c, auto=False, refuse_below=0)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng._admit()
+        for _ in range(2):
+            eng._serve_round()
+        handles[0].cancel()
+        handles[1].cancel()
+        _drive_until_idle(eng)
+        st = eng.stats()
+        got = [handles[i].result(timeout=5) for i in (2, 3)]
+        eng.close()
+        assert st["refuse_total"] == 0
+        # The fill instruments still record the decay — the §28
+        # observability fix is independent of the re-fuse response.
+        assert 0.0 < st["packed_fill_min"] < 1.0
+        for g, w in zip(got, (want[2], want[3])):
+            assert full_hits(g) == full_hits(w)
+
+    def test_refuse_checkpoint_carry_over(self):
+        """Cursor interchangeability across a re-fuse: a survivor
+        pauses AFTER riding the retraced group; its checkpoint resumes
+        on a second engine to the same stream — rank-stride cursors
+        carry over through the re-fuse unchanged."""
+        spec = AttackSpec(mode="default", algo="md5")
+        c = cfg(superstep=1)
+        jobs, want = _churn_fixture(spec, c)
+        eng = Engine(c, auto=False, refuse_below=0.9)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng._admit()
+        for _ in range(2):
+            eng._serve_round()
+        handles[0].cancel()
+        handles[1].cancel()
+        landed = False
+        for _ in range(400):
+            eng._serve_round()
+            eng._admit(wait=True)
+            st = eng.stats()
+            if st["refuse_total"] and not st["jobs_refusing"]:
+                landed = True
+                break
+        assert landed, "re-fuse never landed while work remained"
+        eng._serve_round()  # at least one round on the NEW group
+        handles[2].request_pause()
+        eng.run_until_idle()
+        assert handles[2].state == "paused"
+        ck = handles[2].checkpoint
+        assert ck is not None
+        got3 = handles[3].result(timeout=5)
+        assert full_hits(got3) == full_hits(want[3])
+        eng.close()
+        eng2 = Engine(c, auto=False)
+        resumed = eng2.submit(spec, LEET, jobs[2][0], jobs[2][1],
+                              resume_state=ck)
+        eng2.run_until_idle()
+        got2 = resumed.result(timeout=5)
+        eng2.close()
+        assert full_hits(got2) == full_hits(want[2])
+        assert got2.n_emitted == want[2].n_emitted
+
+    def test_refuse_threshold_env_parsing(self, monkeypatch):
+        """The A5GEN_REFUSE hatch (GL012: read via runtime.env):
+        unset = 0.5, off-spellings disable, a ratio in (0, 1] is
+        honored, and garbage warns + keeps the default."""
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            refuse_threshold,
+        )
+
+        monkeypatch.delenv("A5GEN_REFUSE", raising=False)
+        assert refuse_threshold() == 0.5
+        for off in ("off", "0", "no"):
+            monkeypatch.setenv("A5GEN_REFUSE", off)
+            assert refuse_threshold() is None
+        monkeypatch.setenv("A5GEN_REFUSE", "0.8")
+        assert refuse_threshold() == 0.8
+        for bad in ("1.5", "-1", "nonsense"):
+            monkeypatch.setenv("A5GEN_REFUSE", bad)
+            assert refuse_threshold() == 0.5
+
+
+class TestPackedPallasFastPath:
+    def test_packed_group_rides_fused_kernel(self, monkeypatch):
+        """The §28 tentpole: a packed group of compatible jobs compiles
+        to the FUSED Pallas kernel tier (PERF.md §11), not the XLA
+        fallback — the per-segment scalar-unit tables ride the
+        concatenated batch rows.  Fake a TPU so the gates open, force
+        interpret-mode pallas, spy the kernel wrapper, and parity-check
+        both tenants against solo runs through the same tier."""
+        import hashcat_a5_table_generator_tpu.ops.pallas_expand as pe
+        from hashcat_a5_table_generator_tpu.runtime import SweepConfig
+
+        monkeypatch.setattr(pe, "_on_tpu", lambda: True)
+        monkeypatch.delenv("A5GEN_PALLAS", raising=False)
+        monkeypatch.setenv("A5GEN_PALLAS_INTERPRET", "1")
+
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2, picks=(0, -1))
+        c = SweepConfig(lanes=1024, num_blocks=8, superstep=1)
+        # The solo plan must be kernel-eligible at this geometry, or
+        # the packed assertion below would test nothing.
+        probe = Sweep(spec, LEET, jobs[0][0], jobs[0][1], config=c)
+        assert pe.opts_for(
+            spec, probe.plan, probe.ct,
+            block_stride=c.resolve_block_stride(),
+            num_blocks=c.num_blocks,
+        ) is not None
+        want = _solo(spec, jobs, c)
+
+        calls = []
+        real = pe.fused_expand_md5
+
+        def spy(*a, **kw):
+            calls.append(kw)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(pe, "fused_expand_md5", spy)
+        eng = Engine(c, auto=False)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        stats = eng.stats()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        assert stats["packed_dispatches"] > 0  # the pair fused...
+        # ...and the packed program traced THROUGH the fused kernel
+        # (an XLA-tier fallback would leave the spy untouched).
+        assert calls
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert g.superstep.get("packed") == 2
+
+
 REPO = pathlib.Path(__file__).resolve().parent.parent
 
 
@@ -402,3 +605,39 @@ def test_bench_pack_ab_record_shape():
     for arm in ("packed", "round_robin"):
         assert rec[arm]["wall_s"] > 0
         assert rec[arm]["admit_wall_s"] > 0
+    # The §28 post-departure fill instruments ride the same record.
+    for arm in ("packed", "round_robin"):
+        assert 0.0 <= rec[arm]["fill_min"] <= 1.0
+        assert rec[arm]["refuse_total"] >= 0
+
+
+@pytest.mark.slow
+def test_bench_pack_churn_record_shape():
+    """The §28 measurement instrument: one JSON line, both churn arms,
+    the wall-ratio/fill-recovery numbers the acceptance criteria read,
+    with survivors parity-asserted against solo runs inside the bench
+    itself (it exits nonzero on divergence OR when the re-fuse arm
+    never retraced).  Slow-marked: subprocess bench."""
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"), "--pack-churn",
+         "--platform", "cpu", "--lanes", "256", "--blocks", "16",
+         "--words", "600", "--pack-jobs", "4", "--churn-waves", "2"],
+        capture_output=True, timeout=540, cwd=str(REPO),
+    )
+    assert r.returncode == 0, r.stderr.decode()[-2000:]
+    lines = [ln for ln in r.stdout.decode().splitlines() if ln.strip()]
+    assert len(lines) == 1, lines
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "pack_churn_ab"
+    assert rec["jobs"] == 4
+    assert rec["refuse"]["refuse_total"] > 0
+    assert rec["control"]["refuse_total"] == 0
+    # The control arm keeps the thinned group: its fill never
+    # recovers; the re-fuse arm's peak sits back above the trigger.
+    assert 0.0 < rec["refuse"]["fill_min"] < 1.0
+    assert rec["fill_recovered"] > rec["refuse_below"]
+    for arm in ("refuse", "control"):
+        assert rec[arm]["wall_s"] > 0
+        assert rec[arm]["packed_dispatches"] > 0
+        assert rec[arm]["supersteps_served"] > 0
+    assert rec["wall_ratio"] > 0

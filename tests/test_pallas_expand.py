@@ -119,7 +119,13 @@ def _run_both(spec, plan, ct, *, num_blocks=8, algo="md5", **fused_kw):
     )
 
 
-@pytest.mark.parametrize("mode", ["default", "reverse"])
+@pytest.mark.parametrize("mode", [
+    # The default-mode arm costs ~20 s interpret-mode on the tier-1
+    # host; the reverse arm drives the identical single-block kernel
+    # path and keeps the family's fast default coverage.
+    pytest.param("default", marks=pytest.mark.slow),
+    "reverse",
+])
 def test_state_and_emit_match_xla(mode):
     spec = AttackSpec(mode=mode, algo="md5")
     ct, plan = _arrays(spec)
@@ -129,6 +135,9 @@ def test_state_and_emit_match_xla(mode):
         assert emit_x.any()  # the comparison must not be vacuous
 
 
+@pytest.mark.slow  # ~17 s interpret cost on the tier-1 host; the
+# in-tile window mask keeps default coverage via the windowed parity
+# tests below and the emit-scheme window fuzz arm.
 def test_count_window_respected():
     # max_substitute > WINDOWED_MAX_SUBST keeps the plan on FULL
     # enumeration (the windowed decode has its own parity tests below),
@@ -491,6 +500,8 @@ class TestScalarUnits:
         for k in full:
             np.testing.assert_array_equal(full[k], tiny[k])
 
+    @pytest.mark.slow  # ~7 s interpret cost on the tier-1 host; the
+    # scalar-unit join keeps default coverage via test_match_parity.
     def test_fuzz_parity(self):
         # Randomized K=1 tables (multichar keys, empty/multibyte values,
         # binary bytes) through whichever tier the gate picks — the bit
@@ -915,7 +926,11 @@ class TestMultiBlock:
 
 
 @pytest.mark.parametrize("algo", [
-    "sha1",
+    # The SHA-1 arm's 80-round schedule costs ~27 s interpret-mode on
+    # the tier-1 host; its BE schedule keeps fast default coverage via
+    # the scalar/general sha1 emit-scheme arms, and the fused-kernel ×
+    # non-md5 contract stays default-covered by the md4 arm.
+    pytest.param("sha1", marks=pytest.mark.slow),
     # The NTLM arm's utf16-doubled widths cost ~17 s interpret-mode;
     # its MD4 compression stays default-covered by the md4 arm and the
     # utf16 fold by the suball NTLM parity + emit-scheme gw16 tests.

@@ -99,6 +99,9 @@ class TestSuperstepParity:
         assert {h.candidate for h in on.hits} == {fb_cand, dev_cand}
         assert on.superstep["supersteps"] >= 1
 
+    @pytest.mark.slow  # ~7 s on the tier-1 host; multi-device equality
+    # keeps default coverage via the sharded parity arms in
+    # test_sharding.
     def test_multi_device_equals_per_launch(self):
         spec = AttackSpec(mode="default", algo="md5")
         oracle = oracle_lines(spec, LEET, WORDS)
@@ -160,6 +163,9 @@ class TestOverflowReplay:
         assert on.n_hits == off.n_hits == 40
         assert on.n_emitted == off.n_emitted
 
+    @pytest.mark.slow  # ~8 s on the tier-1 host; the exact-cap edge
+    # keeps default coverage via test_overflow_replays_exactly, which
+    # drives the same replay bookkeeping past the cap.
     def test_cap_exactly_reached_needs_no_replay(self):
         spec = AttackSpec(mode="default", algo="md5")
         oracle = oracle_lines(spec, LEET, [b"password"])
