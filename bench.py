@@ -110,6 +110,67 @@ def attach_tpu_evidence(record: dict) -> dict:
     return record
 
 
+#: Geometry provenance of this process's launch geometry (PERF.md §29):
+#: "explicit" (user flags), "default" (bench built-ins), "profile"
+#: (autotune profile filled the gaps), or "autotune" (the tune matrix
+#: itself).  Set once by main() from the parsed flags; the orchestrator
+#: forwards it to workers via --geometry-source.
+GEOMETRY_SOURCE = "explicit"
+
+
+def stamp_geometry(record: dict, source: "str | None" = None) -> dict:
+    """Stamp geometry provenance into an emitted record: every bench
+    record carries ``geometry_source`` and the resolved geometry tuple,
+    so no recorded number is ever ambiguous about the geometry that
+    produced it (PERF.md §29).  Idempotent — existing stamps win."""
+    record.setdefault("geometry_source", source or GEOMETRY_SOURCE)
+    if "geometry" not in record:
+        geom = {
+            k: record[k]
+            for k in ("lanes", "blocks")
+            if record.get(k) is not None
+        }
+        if geom:
+            record["geometry"] = geom
+    return record
+
+
+def compare_last_tpu(value: "float | None" = None) -> None:
+    """--compare-last-tpu: human verdict lines (stderr) against the
+    committed last-good on-chip record and the 1e10/chip north star,
+    instead of manual JSON diffing."""
+    last = load_tpu_last()
+    if last is None:
+        print("# compare: no BENCH_TPU_LAST.json on disk", file=sys.stderr)
+    else:
+        lv = float(last.get("value", 0.0))
+        print(
+            f"# compare: last TPU record {lv:.3e} hashes/s on "
+            f"{last.get('device_kind', '?')} "
+            f"({last.get('timestamp', '?')}) = {lv / NORTH_STAR:.2%} of "
+            "the 1e10/chip target",
+            file=sys.stderr,
+        )
+    if value is None:
+        return
+    print(
+        f"# compare: this run {value:.3e} hashes/s = "
+        f"{value / NORTH_STAR:.2%} of the 1e10/chip target",
+        file=sys.stderr,
+    )
+    if last is not None and float(last.get("value", 0.0)) > 0:
+        ratio = value / float(last["value"])
+        verdict = (
+            "AHEAD of" if ratio > 1.0 else
+            "LEVEL with" if ratio == 1.0 else "BEHIND"
+        )
+        print(
+            f"# compare: verdict — {verdict} the last TPU record "
+            f"({ratio:.2f}x)",
+            file=sys.stderr,
+        )
+
+
 def metric_name(algo: str) -> str:
     return f"{algo}_candidate_hashes_per_sec_per_chip"
 
@@ -159,8 +220,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "--serve-ab defaults to 1000 — its contract is "
                          "N equal SMALL jobs, the compile-dominant "
                          "regime the service mode amortizes)")
-    ap.add_argument("--seconds", type=float, default=10.0,
-                    help="timed-window length")
+    ap.add_argument("--seconds", type=float, default=None,
+                    help="timed-window length (default 10; --autotune "
+                         "defaults to 2 — it is PER ARM there)")
     ap.add_argument("--batches", type=int, default=8,
                     help="distinct pre-cut batches to cycle")
     ap.add_argument("--algo", default="md5", help="hash algorithm")
@@ -328,6 +390,41 @@ def build_parser() -> argparse.ArgumentParser:
                          "(tools/graftaudit/counter — the same counter "
                          "that pins KERNEL_BUDGETS.json), winner in one "
                          "JSON line (PERF.md §7a lever 2 / §17)")
+    ap.add_argument("--autotune", action="store_true",
+                    help="run the geometry autotune matrix "
+                         "(runtime/tune.py) as the bench mode: one JSON "
+                         "record per completed arm, per-arm stream "
+                         "parity asserted, the winner persisted as this "
+                         "device kind's profile (PERF.md §29). Under "
+                         "the orchestrator the matrix is retry-aware "
+                         "inside --init-retry-budget: a killed or "
+                         "flaked attempt resumes from the last "
+                         "completed arm via --tune-state. The smoke "
+                         "matrix runs on cpu, the full matrix on "
+                         "accelerators; --seconds is the per-arm "
+                         "window (default 2 in this mode)")
+    ap.add_argument("--tune-state", default=None,
+                    help="--autotune: partial-matrix resume file "
+                         "(JSON, rewritten atomically after each "
+                         "completed arm). The orchestrator defaults it "
+                         "to a per-run temp path so retries skip "
+                         "finished arms; pass a stable path to resume "
+                         "across bench invocations (delete the file to "
+                         "re-measure from scratch)")
+    ap.add_argument("--tune-profile-dir", default=None,
+                    help="--autotune: write the winning profile here "
+                         "instead of the A5GEN_TUNE_PROFILE default "
+                         "directory")
+    ap.add_argument("--compare-last-tpu", action="store_true",
+                    help="print a verdict (stderr) against the "
+                         "committed BENCH_TPU_LAST.json record and the "
+                         "1e10/chip target. Standalone (no other mode "
+                         "flags) it just reports the stored record; "
+                         "combined with a measuring run, the verdict "
+                         "also compares this run's emitted value")
+    ap.add_argument("--geometry-source", default=None,
+                    choices=("explicit", "default", "profile"),
+                    help=argparse.SUPPRESS)  # orchestrator->worker seam
     return ap
 
 
@@ -534,7 +631,7 @@ def run_superstep_ab(args: argparse.Namespace) -> None:
             / max(superstep["host_s_per_step"], 1e-12)
         ),
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -654,7 +751,7 @@ def run_pipeline_ab(args: argparse.Namespace) -> None:
             / max(pipelined["dead_s_per_step"], 1e-12)
         ),
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -787,7 +884,7 @@ def run_stream_ab(args: argparse.Namespace) -> None:
         ),
         "chunk_bytes_max": st.get("chunk_bytes_max", 0),
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -933,7 +1030,7 @@ def run_telemetry_ab(args: argparse.Namespace) -> None:
         ) - 1.0,
         "bar": 0.01,
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -1141,7 +1238,7 @@ def run_serve_ab(args: argparse.Namespace) -> None:
             / max(engine["programs_compiled"], 1)
         ),
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -1404,7 +1501,7 @@ def run_fleet_ab(args: argparse.Namespace,
             routed["wall_s"] / max(direct["wall_s"], 1e-9) - 1.0
         ),
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -1585,7 +1682,7 @@ def run_pack_ab(args: argparse.Namespace) -> None:
         "fill_ratio": packed["fill_ratio"],
         "warm_ttfc_batch_s": packed["warm_ttfc_batch_mean_s"],
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -1758,7 +1855,7 @@ def run_pack_churn(args: argparse.Namespace) -> None:
         "wall_ratio": control["wall_s"] / max(refused["wall_s"], 1e-9),
         "fill_recovered": refused["post_refuse_fill_peak"],
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -1968,7 +2065,7 @@ def run_pair_ab(args: argparse.Namespace) -> None:
             else None
         ),
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
@@ -2145,11 +2242,96 @@ def run_stride_ab(args: argparse.Namespace) -> None:
         # budgets — cross-reference for reviewers.
         "budget_file": "KERNEL_BUDGETS.json",
     }
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
 
 
 # ----------------------------------------------------------------- worker --
+
+
+# --------------------------------------------------------------- autotune --
+
+
+def run_autotune_worker(args: argparse.Namespace, dev) -> None:
+    """--autotune measurement body (device already initialized): sweep
+    the runtime's tune matrix on the live backend, emitting one JSON
+    record per completed arm — the orchestrator's last-record parsing
+    then lands the newest finished arm even when the attempt is killed
+    mid-matrix, and the --tune-state file lets the retry resume from
+    exactly there — then a final winner record.  The winning geometry
+    is persisted as this device kind's profile (PERF.md §29) unless
+    A5GEN_TUNE_PROFILE=off and no --tune-profile-dir overrides it."""
+    from hashcat_a5_table_generator_tpu.runtime.env import (
+        tune_profile_setting,
+    )
+    from hashcat_a5_table_generator_tpu.runtime.tune import (
+        TuneProfileCorrupt,
+        run_autotune,
+    )
+
+    # The full matrix is an accelerator-window workload; CPU (the CI
+    # smoke job and the orchestrator's fallback) gets the 2x2.
+    smoke = dev.platform == "cpu"
+    write = (
+        args.tune_profile_dir is not None
+        or tune_profile_setting() is not None
+    )
+
+    def on_arm(rec: dict) -> None:
+        line = {
+            "metric": "autotune_arm",
+            "value": rec["hashes_per_s"],
+            "unit": "hashes/sec",
+            "vs_baseline": rec["hashes_per_s"] / NORTH_STAR,
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "arm": rec["arm"],
+            "geometry": dict(rec["geometry"]),
+            "emitted_per_sweep": rec["emitted_per_sweep"],
+            "sweeps": rec["sweeps"],
+            "partial_matrix": True,  # a final winner record follows
+        }
+        if rec.get("resumed"):
+            line["resumed"] = True
+        print(json.dumps(stamp_geometry(line, source="autotune")))
+        sys.stdout.flush()
+        print(f"# [tune:{rec['arm']}] {rec['hashes_per_s']:.3e} hashes/s"
+              f"{' (resumed)' if rec.get('resumed') else ''}",
+              file=sys.stderr)
+
+    try:
+        res = run_autotune(
+            seconds=args.seconds,
+            smoke=smoke,
+            state_path=args.tune_state,
+            on_arm=on_arm,
+            write=write,
+            directory=args.tune_profile_dir,
+        )
+    except (TuneProfileCorrupt, RuntimeError, ValueError) as e:
+        print(json.dumps(stamp_geometry(
+            error_record(args.algo, f"autotune: {e}"), source="autotune",
+        )))
+        sys.stdout.flush()
+        raise SystemExit(1)
+    record = {
+        "metric": "autotune_matrix",
+        "value": res["hashes_per_s"],
+        "unit": "hashes/sec",
+        "vs_baseline": res["hashes_per_s"] / NORTH_STAR,
+        "platform": dev.platform,
+        "device_kind": res["device_kind"],
+        "arm": res["winner"],
+        "arms_measured": len(res["arms"]),
+        "geometry": dict(res["geometry"]),
+        "emitted_per_sweep": res["emitted_per_sweep"],
+        "profile_path": res["profile_path"],
+        "smoke": smoke,
+    }
+    print(json.dumps(stamp_geometry(record, source="autotune")))
+    sys.stdout.flush()
+    if not args.worker and args.compare_last_tpu:
+        compare_last_tpu(record["value"])
 
 
 def run_worker(args: argparse.Namespace) -> None:
@@ -2183,9 +2365,9 @@ def run_worker(args: argparse.Namespace) -> None:
         if not args.worker:
             # Direct (--platform) invocation: no orchestrator above us to
             # emit the record, so keep the one-JSON-line contract here.
-            print(json.dumps(
+            print(json.dumps(stamp_geometry(
                 error_record(args.algo, "accelerator init timeout")
-            ))
+            )))
             sys.stdout.flush()
         sys.stderr.flush()
         os._exit(2)
@@ -2213,6 +2395,29 @@ def run_worker(args: argparse.Namespace) -> None:
 
     dev = jax.devices()[0]
     print(f"# device: {dev.platform} ({dev.device_kind})", file=sys.stderr)
+
+    if args.autotune:
+        run_autotune_worker(args, dev)
+        return
+
+    # The kernel bench honors the autotune profile exactly like the
+    # production sweep (PERF.md §29): geometry the user left to the
+    # defaults is filled from this device kind's profile when one
+    # exists (the device kind is only known here, past init).
+    if (args.geometry_source or "explicit") != "explicit":
+        from hashcat_a5_table_generator_tpu.runtime.tune import load_profile
+
+        geom = (load_profile(dev.device_kind) or {}).get("geometry") or {}
+        if geom.get("lanes"):
+            args.lanes = int(geom["lanes"])
+            if args.blocks is None and geom.get("num_blocks"):
+                args.blocks = int(geom["num_blocks"])
+            args.geometry_source = "profile"
+            print(
+                f"# geometry from autotune profile ({dev.device_kind}): "
+                f"{args.lanes} lanes x {args.blocks or 'auto'} blocks",
+                file=sys.stderr,
+            )
 
     spec = AttackSpec(mode=args.mode, algo=args.algo)
     sub_map = get_layout(args.table).to_substitution_map()
@@ -2489,6 +2694,7 @@ def run_worker(args: argparse.Namespace) -> None:
             "per_launch_s": results[winner].get("per_launch_s", 0.0),
             "arm": winner,
         }
+        stamp_geometry(record, source=args.geometry_source)
         if results[winner].get("kernel"):
             record["kernel"] = results[winner]["kernel"]
         if args.mode != "default" or args.table != "qwerty-cyrillic":
@@ -2525,8 +2731,11 @@ def run_worker(args: argparse.Namespace) -> None:
     record = winner_record(results, partial_arms=False)
     if record is None:
         raise SystemExit("all arms failed")
-    print(json.dumps(record))
+    print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
+    if not args.worker and args.compare_last_tpu:
+        # Verdict BEFORE the save refreshes the record it compares to.
+        compare_last_tpu(record["value"])
     if not args.worker and dev.platform != "cpu":
         # Direct (--platform) accelerator run, no orchestrator above us:
         # persist the last-good on-chip record here.
@@ -2629,8 +2838,19 @@ def _attempt(argv: list[str], env: dict, init_grace: float, run_grace: float,
 def run_orchestrator(args: argparse.Namespace) -> None:
     me = os.path.abspath(__file__)
 
+    if args.autotune and not args.tune_state:
+        # The partial-matrix resume seam (PERF.md §29): every retry
+        # attempt — init flake or a mid-matrix kill — is a fresh
+        # subprocess that picks up from the last completed arm.
+        import tempfile
+
+        args.tune_state = os.path.join(
+            tempfile.gettempdir(), f"a5gen-tune-state-{os.getpid()}.json"
+        )
+
     def worker_args(init_timeout: float, platform: str | None = None,
-                    arm: str | None = None, **overrides):
+                    arm: str | None = None,
+                    geometry_source: str | None = None, **overrides):
         vals = {
             "lanes": args.lanes, "blocks": args.blocks, "words": args.words,
             "seconds": args.seconds, "batches": args.batches,
@@ -2651,12 +2871,21 @@ def run_orchestrator(args: argparse.Namespace) -> None:
             out += ["--platform", platform]
         if args.profile_dir:
             out += ["--profile-dir", args.profile_dir]
+        src = geometry_source or args.geometry_source
+        if src:
+            out += ["--geometry-source", src]
+        if args.autotune:
+            out += ["--autotune"]
+            if args.tune_state:
+                out += ["--tune-state", args.tune_state]
+            if args.tune_profile_dir:
+                out += ["--tune-profile-dir", args.tune_profile_dir]
         return out
 
     # CPU fallback gets host-sized shapes: the full accelerator geometry
     # (2^22 lanes × 32768 blocks) takes minutes per launch on a host core.
     cpu_args = worker_args(
-        60, platform="cpu",
+        60, platform="cpu", geometry_source="explicit",
         lanes=min(args.lanes, 2048),
         blocks=32 if args.blocks is None else min(args.blocks, 32),
         words=min(args.words, 4000),
@@ -2746,17 +2975,26 @@ def run_orchestrator(args: argparse.Namespace) -> None:
             record["init_wall_s"] = round(
                 float(telemetry.counter("bench.init_wall_s").value), 1
             )
+        if args.compare_last_tpu:
+            # Verdict BEFORE the save refreshes the record it compares
+            # to (stderr; the JSON record line stays the only stdout).
+            compare_last_tpu(record.get("value"))
         if record.get("platform") and record["platform"] != "cpu":
             # A live accelerator measurement: refresh the committed
-            # last-good record.
-            save_tpu_last(record)
+            # last-good record — unless it is an autotune-matrix or
+            # partial record, whose metric is a different contract
+            # (full-sweep rate / one arm) than the committed
+            # kernel-arm number.
+            if not record.get("partial_matrix") \
+                    and record.get("metric") != "autotune_matrix":
+                save_tpu_last(record)
         else:
             # CPU fallback carried the number: embed the last on-chip
             # measurement so the artifact keeps TPU evidence.
             attach_tpu_evidence(record)
         if failures:
             record["failed_attempts"] = failures
-        print(json.dumps(record))
+        print(json.dumps(stamp_geometry(record)))
 
     def complete_arms(record):
         """A kill mid-pallas-arm leaves a partial_arms record (xla only).
@@ -2796,6 +3034,35 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         merged["arms_completed_by_retry"] = True
         return merged
 
+    def complete_matrix(record):
+        """--autotune: a kill mid-matrix lands the newest finished arm
+        (partial_matrix).  While budget remains, retry — the worker
+        resumes from the --tune-state file, skipping every completed
+        arm — so the full matrix lands unattended inside the same
+        retry budget (PERF.md §29)."""
+        while record.get("partial_matrix"):
+            remaining = total_deadline - time.monotonic()
+            if remaining - cpu_tail < 120:
+                record["matrix_incomplete"] = True
+                break
+            print("# orchestrator: resuming autotune matrix from "
+                  f"{args.tune_state}", file=sys.stderr)
+            rec2 = try_one(
+                "accelerator-tune-resume",
+                worker_args(args.init_timeout),
+                min(args.init_timeout + 30, remaining - cpu_tail),
+                total_deadline - time.monotonic() - cpu_tail,
+            )
+            if rec2 is None:
+                record["matrix_incomplete"] = True
+                break
+            record = rec2
+        return record
+
+    def complete(record):
+        return (complete_matrix(record) if args.autotune
+                else complete_arms(record))
+
     failures = []
     attempts = [0]  # total subprocess attempts (emitted per record)
     init_wait = [0.0]  # cumulative wall burnt on attempts that never init'd
@@ -2823,7 +3090,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
                       init_grace,
                       total_deadline - time.monotonic() - cpu_tail)
         if rec is not None:
-            emit(complete_arms(rec))
+            emit(complete(rec))
             return
         # Explicit tpu plugin: if axon is wedged but a local libtpu chip
         # exists this comes up fast; if neither exists it errors fast —
@@ -2834,7 +3101,7 @@ def run_orchestrator(args: argparse.Namespace) -> None:
                 rec = try_one("tpu", worker_args(45, platform="tpu"), 75,
                               total_deadline - time.monotonic() - cpu_tail)
                 if rec is not None:
-                    emit(complete_arms(rec))
+                    emit(complete(rec))
                     return
         # Tunnel down: back off briefly, then retry a fresh subprocess.
         sleep_s = min(backoff,
@@ -2851,26 +3118,43 @@ def run_orchestrator(args: argparse.Namespace) -> None:
         emit(rec)
         return
 
-    print(json.dumps(attach_tpu_evidence(error_record(
+    print(json.dumps(stamp_geometry(attach_tpu_evidence(error_record(
         args.algo, "all platform attempts failed", failed_attempts=failures,
-    ))))
+    )))))
     sys.exit(2)
 
 
 def main() -> None:
+    global GEOMETRY_SOURCE
+
     args = build_parser().parse_args()
+    ab_mode = (args.superstep_ab or args.stride_ab or args.pipeline_ab
+               or args.stream_ab or args.serve_ab or args.telemetry_ab
+               or args.pack_ab or args.pack_churn or args.pair_ab
+               or args.fleet_ab or args.elastic_ab)
+    if args.compare_last_tpu and not (
+        ab_mode or args.autotune or args.worker or args.platform
+    ):
+        # Standalone verdict: report the committed record vs the north
+        # star and exit — no measurement.
+        compare_last_tpu()
+        return
+    if args.geometry_source is None:
+        # Unset-vs-explicit is the geometry-provenance seam (PERF.md
+        # §29): workers fill "default" geometry from the device kind's
+        # autotune profile once init reveals the device.
+        args.geometry_source = (
+            "explicit" if args.lanes is not None else "default"
+        )
+    GEOMETRY_SOURCE = args.geometry_source
+    if args.seconds is None:
+        # --autotune's window is PER ARM; the matrix has dozens.
+        args.seconds = 2.0 if args.autotune else 10.0
     if args.lanes is None:
         # Unset vs explicit matters: the focused A/B modes target small
         # geometries, the kernel bench the big accelerator launch; an
         # explicit --lanes is honored by all.
-        args.lanes = (
-            2048
-            if (args.superstep_ab or args.stride_ab or args.pipeline_ab
-                or args.stream_ab or args.serve_ab or args.telemetry_ab
-                or args.pack_ab or args.pack_churn or args.pair_ab
-                or args.fleet_ab or args.elastic_ab)
-            else (1 << 22)
-        )
+        args.lanes = 2048 if ab_mode else (1 << 22)
     if args.words is None:
         # --serve-ab's contract is N equal SMALL jobs (compile-dominant
         # — the regime the resident engine amortizes); --pack-ab's is N

@@ -136,10 +136,13 @@ def build_parser() -> argparse.ArgumentParser:
                     help="periodic JSON progress lines on stderr")
     ap.add_argument("--lanes", type=int, default=None,
                     help="variant lanes per device per launch (default: "
-                         "2^22 on accelerators — big launches amortize "
-                         "dispatch, PERF.md §4 — and 2^17 on CPU)")
+                         "this device kind's autotune profile when one "
+                         "exists — `a5gen tune`, PERF.md §29 — else 2^22 "
+                         "on accelerators and 2^17 on CPU; "
+                         "A5GEN_TUNE_PROFILE=off pins the built-ins)")
     ap.add_argument("--blocks", type=int, default=None,
-                    help="device block slots per launch (default: auto — "
+                    help="device block slots per launch (default: the "
+                         "autotune profile when one exists, else auto — "
                          "on accelerators the sweep picks the measured best "
                          "stride for the engaged kernel, 512/256 fused vs "
                          "128 XLA; 1024 on CPU)")
@@ -240,6 +243,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="multi-host sweep: total participating processes")
     ap.add_argument("--process-id", type=int, default=None, metavar="I",
                     help="multi-host sweep: this process's rank in [0, N)")
+    ap.add_argument("--giant-job", action="store_true",
+                    help="pod-sharded giant-job mode (crack only, "
+                         "PERF.md §29): instead of striping the "
+                         "DICTIONARY across hosts, every process sweeps "
+                         "the SAME full wordlist and the superstep block "
+                         "lattice is striped across ALL the pod's chips — "
+                         "one oversized keyspace job, checkpointable and "
+                         "resumable as ONE job whose (word, rank) cursor "
+                         "is interchangeable with a single-device sweep's. "
+                         "Requires --coordinator and the superstep "
+                         "executor; combine with --pod-hits local for the "
+                         "elastic variant")
     ap.add_argument("--pod-hits", choices=("gathered", "local"),
                     default="gathered",
                     help="multi-host hit reporting: 'gathered' (default) "
@@ -722,6 +737,28 @@ def _print_stream(res) -> None:
     )
 
 
+def _print_geometry(res) -> None:
+    """Resolved-geometry provenance (stderr, PERF.md §29): printed when
+    the launch-time resolution seam filled the geometry (profile or
+    built-in defaults), so no reported rate is ambiguous about which
+    geometry produced it.  Silent for explicit flags — the caller
+    already knows what they asked for."""
+    src = getattr(res, "geometry_source", "explicit")
+    g = getattr(res, "geometry", None) or {}
+    if src == "explicit" or not g:
+        return
+    origin = (
+        f"autotune profile ({g.get('device_kind')})" if src == "profile"
+        else "built-in defaults"
+    )
+    print(
+        f"{PROG}: geometry: lanes={g.get('lanes')} "
+        f"blocks={g.get('num_blocks')} superstep={g.get('superstep')} "
+        f"pair={g.get('pair')} — from {origin}",
+        file=sys.stderr,
+    )
+
+
 def _run_with_retries(make_attempt, retries: int, *, default_resume: bool,
                       label: str, retry_notice: str = ""):
     """Elastic recovery (SURVEY.md §5): candidate generation is pure and
@@ -875,31 +912,26 @@ def _run_device(args, sub_map, packed) -> int:
             )
             args.retries = 0
     bucketed = isinstance(packed, dict)
-    if nprocs > 1:
+    if nprocs > 1 and not args.giant_job:
         # Each process sweeps (and reports progress over) only its own
         # dictionary stripe.
         from .parallel.multihost import stripe_n_words
 
         n_words = stripe_n_words(packed, nprocs, pid)
     else:
+        # Single process — or the giant-job mode, where every process
+        # sweeps the FULL wordlist (the block lattice is what's striped).
         n_words = (
             sum(p.batch for p in packed.values()) if bucketed else packed.batch
         )
     progress = ProgressReporter(n_words) if args.progress else None
-    if args.lanes is None or args.blocks is None:
-        # Backend-sized launch geometry: accelerators want big launches
-        # (dispatch/fetch amortization, PERF.md §4); the CPU backend peaks
-        # far smaller (PERF.md §2).  Accelerator block count stays None =
-        # auto: the Sweep resolves it per plan once fused-kernel
-        # eligibility is known (stride 512 / 256 when the kernel takes the
-        # launch, else 128 — the measured per-arm bests, PERF.md §9b).
-        import jax
-
-        on_cpu = jax.default_backend() == "cpu"
-        if args.lanes is None:
-            args.lanes = (1 << 17) if on_cpu else (1 << 22)
-        if args.blocks is None and on_cpu:
-            args.blocks = 1024
+    # Launch geometry left unset resolves at launch time inside the
+    # Sweep (PERF.md §29): explicit flag > this device kind's autotune
+    # profile (`a5gen tune`; A5GEN_TUNE_PROFILE=off disables) > the
+    # built-in backend-sized defaults (2^22 lanes on accelerators /
+    # 2^17 on CPU; accelerator block count auto per plan).  Passing
+    # lanes=None through is the "no explicit flag" spelling the
+    # resolution seam keys on.
     cfg_kw = {}
     if args.fetch_chunk is not None:
         cfg_kw["fetch_chunk"] = args.fetch_chunk
@@ -945,19 +977,26 @@ def _run_device(args, sub_map, packed) -> int:
             if nprocs > 1:
                 from .parallel.multihost import (
                     PeerLossError,
+                    run_crack_giant,
                     run_crack_multihost,
                 )
 
                 # Gathered: the combined hit stream is identical on every
                 # process; process 0 is the conventional reporter.  Local
                 # (elastic): every host streams its own stripe's hits.
+                # --giant-job swaps the word-striped pod sweep for the
+                # block-striped ONE-job mode (PERF.md §29).
                 gather = args.pod_hits == "gathered"
                 recorder = (
                     HitRecorder(sys.stdout.buffer)
                     if (pid == 0 or not gather) else None
                 )
+                runner = (
+                    run_crack_giant if args.giant_job
+                    else run_crack_multihost
+                )
                 try:
-                    res = run_crack_multihost(
+                    res = runner(
                         spec, sub_map, packed, digests, cfg,
                         recorder=recorder, resume=not args.no_resume,
                         gather=gather,
@@ -986,6 +1025,7 @@ def _run_device(args, sub_map, packed) -> int:
                     file=sys.stderr,
                 )
             _print_routing(res)
+            _print_geometry(res)
             _print_superstep(res)
             _print_stream(res)
             _write_metrics_json(
@@ -1124,13 +1164,30 @@ def _run_serve(argv: Sequence[str]) -> int:
     )
 
     if args.lanes is None or args.blocks is None:
-        import jax
+        # Engine defaults must be CONCRETE (affinity tokens and
+        # config_defaults hash them), so serve resolves the geometry
+        # eagerly at startup instead of deferring to the per-sweep
+        # launch seam: explicit flag > autotune profile > built-ins
+        # (PERF.md §29; the lanes/blocks knobs only — per-job
+        # superstep/pair semantics stay with the job docs).
+        from .runtime.tune import current_device_kind, resolve_config
 
-        on_cpu = jax.default_backend() == "cpu"
+        kind = current_device_kind()
+        # lanes=None engages the seam even when --lanes was given; the
+        # per-knob merge below keeps any explicit flag.
+        resolved, source = resolve_config(
+            SweepConfig(lanes=None, num_blocks=args.blocks), kind
+        )
         if args.lanes is None:
-            args.lanes = (1 << 17) if on_cpu else (1 << 22)
-        if args.blocks is None and on_cpu:
-            args.blocks = 1024
+            args.lanes = resolved.lanes
+        if args.blocks is None:
+            args.blocks = resolved.num_blocks
+        if source == "profile":
+            print(
+                f"{PROG}: geometry defaults from autotune profile "
+                f"({kind}): lanes={args.lanes} blocks={args.blocks}",
+                file=sys.stderr,
+            )
     defaults = SweepConfig(
         lanes=args.lanes,
         num_blocks=args.blocks,
@@ -1405,6 +1462,99 @@ def _run_fleet(argv: Sequence[str]) -> int:
     return 0
 
 
+def _build_tune_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog=f"{PROG} tune",
+        description=(
+            "Geometry autotuner (PERF.md §29): sweep lanes x stride "
+            "(block batch) x superstep depth x pair x emit arm over the "
+            "production crack contract on the live backend, assert "
+            "per-arm stream parity, and write the winner as this device "
+            "kind's profile (~/.cache/a5gen/tune/<device_kind>.json; "
+            "A5GEN_TUNE_PROFILE overrides the directory or disables "
+            "loading). Sweeps with no explicit --lanes then load the "
+            "profile by default."
+        ),
+    )
+    ap.add_argument("--words", type=int, default=512, metavar="N",
+                    help="synthetic tune-contract dictionary size "
+                         "(deterministic; default 512)")
+    ap.add_argument("--seconds", type=float, default=1.0, metavar="S",
+                    help="timed wall per arm after the warm-up sweep "
+                         "(default 1.0)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the CI 2x2 matrix (lanes x stride only) — "
+                         "finishes in seconds on CPU")
+    ap.add_argument("--state", metavar="FILE",
+                    help="partial-matrix resume state: each completed "
+                         "arm's record is appended atomically, and a "
+                         "rerun skips straight past completed arms "
+                         "(the bench orchestrator's retry seam)")
+    ap.add_argument("--profile-dir", metavar="DIR", default=None,
+                    help="write the profile under DIR instead of the "
+                         "A5GEN_TUNE_PROFILE / ~/.cache default")
+    ap.add_argument("--no-write", action="store_true",
+                    help="measure and report only; do not persist a "
+                         "profile")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full result document as JSON on "
+                         "stdout (arm records included) instead of the "
+                         "summary table")
+    return ap
+
+
+def _run_tune(argv: Sequence[str]) -> int:
+    """``a5gen tune``: run the autotune matrix and persist the winner."""
+    import json as _json
+
+    args = _build_tune_parser().parse_args(argv)
+    from .runtime.tune import TuneProfileCorrupt, run_autotune
+
+    def on_arm(rec) -> None:
+        note = " (resumed)" if rec.get("resumed") else ""
+        print(
+            f"{PROG}: tune: {rec['arm']}: "
+            f"{rec['hashes_per_s']:.3e} hashes/s "
+            f"({rec['sweeps']} sweeps x {rec['emitted_per_sweep']} "
+            f"candidates){note}",
+            file=sys.stderr,
+        )
+
+    try:
+        result = run_autotune(
+            words=args.words,
+            seconds=args.seconds,
+            smoke=args.smoke,
+            state_path=args.state,
+            on_arm=on_arm,
+            write=not args.no_write,
+            directory=args.profile_dir,
+        )
+    except (TuneProfileCorrupt, RuntimeError, ValueError) as exc:
+        print(f"{PROG}: tune failed: {exc}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(result, indent=2, sort_keys=True))
+    else:
+        g = result["geometry"]
+        print(
+            f"{PROG}: tune winner on {result['device_kind']}: "
+            f"{result['winner']} — lanes={g['lanes']} "
+            f"blocks={g['num_blocks']} stride={g.get('stride')} "
+            f"superstep={g.get('superstep')} pair={g.get('pair')} "
+            f"at {result['hashes_per_s']:.3e} hashes/s",
+            file=sys.stderr,
+        )
+        if result.get("profile_path"):
+            print(
+                f"{PROG}: profile written: {result['profile_path']} "
+                "(loaded by default for sweeps with no explicit "
+                "--lanes; A5GEN_TUNE_PROFILE=off disables)",
+                file=sys.stderr,
+            )
+    return 0
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     # jax-free import: the typed corrupt-checkpoint error gets its
     # remediation hint here (PERF.md §23).
@@ -1421,6 +1571,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # Fleet mode (PERF.md §25): router + engine pool — jax-free in
         # the router process; the engines are where device work runs.
         return _run_fleet(list(argv[1:]))
+    if argv and argv[0] == "tune":
+        # Geometry autotuner (PERF.md §29): sweep the arm matrix on the
+        # live backend and persist the winner as this device kind's
+        # profile, which the runtime then loads by default.
+        return _run_tune(list(argv[1:]))
     ap = build_parser()
     args = ap.parse_args(argv)
     if args.list_layouts:
@@ -1448,6 +1603,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             "--retries in candidates mode requires --checkpoint (a retry "
             "without one would re-emit the whole candidate stream)"
         )
+    if args.giant_job and args.digests is None:
+        # Candidates mode streams the full keyspace from each process —
+        # a block stripe has no merge discipline there (PERF.md §29).
+        ap.error("--giant-job is crack mode only (requires --digests)")
     if args.backend == "device" and args.bug_compat:
         # The Q3 reverse-offset bug (main.go:249-257) is reproduced only by
         # the oracle engines; the device plans emit corrected bytes. Honor
@@ -1476,6 +1635,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             (args.coordinator is not None, "--coordinator"),
             (args.num_processes is not None, "--num-processes"),
             (args.process_id is not None, "--process-id"),
+            (args.giant_job, "--giant-job"),
             (args.retries, "--retries"),
         ):
             if flag:

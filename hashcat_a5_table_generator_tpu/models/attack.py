@@ -617,7 +617,9 @@ def make_superstep_body(
 
     ``step_advance``: global blocks consumed per scan step —
     ``num_blocks`` on one device, ``num_blocks * n_devices`` under the
-    sharded executor (every device advances past the whole launch).
+    sharded executor (every device advances past the whole launch), and
+    ``num_blocks * total_stripes`` under the pod giant-job mode, where
+    the lattice spans every process's devices (PERF.md §29).
     ``total_blocks``: blocks in the sweep; the tail superstep's
     out-of-range blocks cut zero-count (fully masked) blocks, so no tail
     special-casing exists anywhere.  When the ``ss`` tree carries the

@@ -220,6 +220,7 @@ def make_sharded_superstep_step(
     lanes_per_device: int,
     axis_name: str = "data",
     num_blocks: int,
+    step_advance: "int | None" = None,
     **kwargs,
 ):
     """The superstep executor, shard_map'd over a 1-D mesh.
@@ -229,7 +230,10 @@ def make_sharded_superstep_step(
     stripe: device ``d`` of ``D`` starts at ``b0 + d * num_blocks`` and
     every scan step advances all devices by ``D * num_blocks`` — exactly
     the contiguous per-launch ranges ``make_device_blocks`` cuts, so the
-    sharded superstep sweeps the identical (word, rank) stream.
+    sharded superstep sweeps the identical (word, rank) stream.  An
+    explicit ``step_advance`` overrides that default when this mesh's
+    stripes are a subset of a larger lattice (the pod giant-job mode
+    passes ``num_blocks * total_stripes``; PERF.md §29).
 
     Input pytrees: ``plan``/``table``/``digests``/``ss`` replicated;
     ``b0`` an int32 [D] of per-device start block indices, sharded;
@@ -256,9 +260,14 @@ def make_sharded_superstep_step(
     from ..models.attack import _buffer_donation
 
     n_devices = int(np.prod(mesh.devices.shape))
+    # step_advance default: this mesh's stripes tile the keyspace alone.
+    # The pod giant-job mode widens it to num_blocks * total_stripes so
+    # every process's mesh advances past ALL pod stripes (PERF.md §29).
+    if step_advance is None:
+        step_advance = num_blocks * n_devices
     body = make_superstep_body(
         spec, num_lanes=lanes_per_device, num_blocks=num_blocks,
-        step_advance=num_blocks * n_devices, **kwargs,
+        step_advance=step_advance, **kwargs,
     )
 
     def local_step(plan, table, digests, ss, b0, bufs):

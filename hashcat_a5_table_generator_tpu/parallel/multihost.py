@@ -656,6 +656,87 @@ def run_crack_multihost(
     )
 
 
+def run_crack_giant(
+    spec,
+    sub_map: Dict[bytes, List[bytes]],
+    packed: PackedWords,
+    digests: Sequence[bytes],
+    config=None,
+    *,
+    recorder=None,
+    resume: bool = True,
+    gather: bool = True,
+):
+    """ONE oversized keyspace job split across the pod's chips — the
+    giant-job twin of :func:`run_crack_multihost` (PERF.md §29).
+
+    Where multihost mode stripes the WORDLIST (each host plans and
+    sweeps its own word slice), giant mode hands every process the SAME
+    full wordlist and splits the superstep BLOCK lattice instead:
+    ``SweepConfig.pod=(pid, nprocs)`` makes global device ``p*D + d``
+    own blocks ``b0 + (p*D + d) * num_blocks`` of every superstep, all
+    stripes advancing in lockstep, so the union of the shards' hit
+    streams is exactly the single-device stream.  The cursor stays the
+    global linear (word, rank) cursor — a shard checkpoint (written at
+    ``PATH.p<pid>``) resumes under the single-device path and vice
+    versa: the giant job is ONE resumable job.  Requires the superstep
+    executor (an ineligible plan raises rather than duplicating work
+    through the per-launch path).
+
+    ``gather=True`` (default): processes exchange hit records (each hit
+    is found by exactly ONE stripe, so the gather is a disjoint union)
+    and every process returns the same combined SweepResult; the
+    recorder — typically only on process 0 — receives the combined
+    (word, rank)-sorted stream.  ``words_done``/``routing``/``geometry``
+    describe the FULL dictionary identically on every shard, so they
+    merge by max/passthrough, not sum.
+
+    ``gather=False`` (elastic): each process streams its own stripe's
+    hits to its recorder and returns its host-local result — no
+    collective runs at all, so a dead peer cannot block survivors; only
+    the dead shard's stripe needs a relaunch, resuming from its own
+    checkpoint.
+    """
+    import jax
+
+    from ..runtime.sweep import Sweep, SweepConfig, SweepResult
+
+    pid, nprocs = jax.process_index(), jax.process_count()
+    cfg = _host_config(config, pid)
+    cfg = replace(cfg if cfg is not None else SweepConfig(),
+                  pod=(pid, nprocs))
+    if isinstance(packed, dict):
+        from ..runtime.bucketed import BucketedSweep
+
+        sweep = BucketedSweep(spec, sub_map, packed, digests, config=cfg)
+    else:
+        sweep = Sweep(spec, sub_map, packed, digests, config=cfg)
+    if not gather:
+        return sweep.run_crack(recorder, resume=resume)
+    res = sweep.run_crack(resume=resume)
+    all_hits = gather_hits(res.hits)
+    if recorder is not None:
+        for h in all_hits:
+            recorder.emit(h)
+    return SweepResult(
+        n_emitted=allgather_sum(res.n_emitted),
+        n_hits=len(all_hits),
+        hits=all_hits,
+        # Every shard sweeps the same dictionary to the same boundary —
+        # max (not sum) keeps the global count a global count.
+        words_done=int(allgather_max(float(res.words_done))),
+        resumed=allgather_sum(int(res.resumed)) > 0,
+        wall_s=allgather_max(res.wall_s),
+        # Routing counts describe planning the FULL dictionary and are
+        # identical on every shard; summing would multiply them by P.
+        routing=dict(res.routing),
+        superstep=_reduce_superstep(res.superstep),
+        stream=dict(res.stream),  # host-local (see run_crack_multihost)
+        geometry=dict(res.geometry),
+        geometry_source=res.geometry_source,
+    )
+
+
 def run_candidates_multihost(
     spec,
     sub_map: Dict[bytes, List[bytes]],

@@ -78,6 +78,14 @@ class _BucketProgress:
         if inner_set is not None:
             inner_set(self._routing)
 
+    def set_geometry(self, geometry: dict, source: str) -> None:
+        # Buckets share one SweepConfig, so every bucket resolves the
+        # same geometry; last write wins harmlessly.  Guarded like
+        # set_routing for pre-geometry custom reporters.
+        inner_set = getattr(self.inner, "set_geometry", None)
+        if inner_set is not None:
+            inner_set(geometry, source)
+
     def seed_emitted(self, emitted: int) -> None:
         self.inner.seed_emitted(self.emit_base + emitted)
 
@@ -194,6 +202,17 @@ class BucketedSweep:
             stream["steady_overlap_ratio"] = (
                 over / (wall - first) if wall - first > 0 else 0.0
             )
+        # Buckets share one SweepConfig, so every bucket resolves the
+        # same geometry (PERF.md §29); the first result's stamp stands
+        # for the whole run.
+        geometry = next(
+            (dict(r.geometry) for r in results if r.geometry), {}
+        )
+        geometry_source = next(
+            (r.geometry_source for r in results
+             if r.geometry_source != "explicit"),
+            results[0].geometry_source if results else "explicit",
+        )
         return SweepResult(
             n_emitted=sum(r.n_emitted for r in results),
             n_hits=sum(r.n_hits for r in results),
@@ -205,6 +224,8 @@ class BucketedSweep:
             superstep=superstep,
             stream=stream,
             schema_cache=schema_cache,
+            geometry=geometry,
+            geometry_source=geometry_source,
         )
 
     def run_crack(self, recorder=None, *, resume: bool = True) -> SweepResult:

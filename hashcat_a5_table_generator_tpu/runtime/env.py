@@ -168,6 +168,19 @@ def refuse_threshold() -> "Optional[float]":
     return r
 
 
+def tune_profile_setting() -> "Optional[str]":
+    """Autotuned-geometry profile loading (``A5GEN_TUNE_PROFILE``,
+    PERF.md §29): ``off``/``0``/``no`` disables profile loading (the
+    escape hatch — built-in defaults only); empty/unset enables it at
+    the default directory (``~/.cache/a5gen/tune``); any other value is
+    a directory override (the test/CI spelling).  Returns ``None`` for
+    disabled, else the directory string (possibly empty = default)."""
+    val = env_str("A5GEN_TUNE_PROFILE")
+    if val.lower() in ("off", "0", "no"):
+        return None
+    return val
+
+
 def schema_cache_dir() -> "Optional[str]":
     """On-disk PieceSchema cache directory (``A5GEN_SCHEMA_CACHE``;
     empty/unset = no persistent cache).  ``SweepConfig.schema_cache`` /

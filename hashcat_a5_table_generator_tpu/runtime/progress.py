@@ -36,6 +36,7 @@ class ProgressReporter:
         self._last_t = self._t0
         self._routing: "dict | None" = None
         self._stream: "dict | None" = None
+        self._geometry: "dict | None" = None
 
     def set_routing(self, routing: dict) -> None:
         """Attach the sweep's word-routing counts (device_clean /
@@ -49,6 +50,14 @@ class ProgressReporter:
         marker — updated per chunk, seeded immediately on a resumed
         streaming sweep); included in every progress line once known."""
         self._stream = dict(stream)
+
+    def set_geometry(self, geometry: dict, source: str) -> None:
+        """Attach the resolved launch geometry and its provenance
+        (PERF.md §29: ``explicit``/``profile``/``default`` — stamped by
+        the Sweep's launch-time resolution seam, constant over the run);
+        included in every progress line once known, so no throughput
+        number in a log is ever ambiguous about its geometry."""
+        self._geometry = dict(geometry, source=source)
 
     def seed_emitted(self, emitted: int) -> None:
         """Base the first rate window on a resumed sweep's prior count, so
@@ -86,6 +95,8 @@ class ProgressReporter:
             body["routing"] = self._routing
         if self._stream is not None:
             body["stream"] = self._stream
+        if self._geometry is not None:
+            body["geometry"] = self._geometry
         # Registry-derived enrichment (PERF.md §21; keys in README):
         # pipeline dead-time share, chunk-ring occupancy, cache hit
         # rates — silent when A5GEN_TELEMETRY=off or nothing recorded.

@@ -37,7 +37,16 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+from typing import (
+    Any,
+    Callable,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 import numpy as np
 
@@ -186,7 +195,13 @@ class SweepConfig:
     emitted — the checkpoint fingerprint deliberately excludes them, so a
     checkpoint taken at one geometry/device count resumes at any other)."""
 
-    lanes: int = 1 << 17  # variant lanes per device per launch
+    lanes: Optional[int] = 1 << 17  # variant lanes per device per launch.
+    #   None = resolve at launch (PERF.md §29): the sweep fills lanes —
+    #   and every other None geometry knob — from the device kind's
+    #   autotune profile (runtime/tune.py, explicit flag > profile >
+    #   built-in defaults); the CLI/bench pass None when the user gave
+    #   no flag.  An explicit lanes value (every test and library
+    #   construction) pins the whole config: no profile is consulted.
     num_blocks: Optional[int] = 1024  # static per-device block count (jit
     #   stability). None = auto: resolved by the Sweep once plan/table
     #   eligibility is known — lanes/512 (lanes/256 for suball) when the
@@ -298,6 +313,26 @@ class SweepConfig:
     #   §23): a runtime/faults.py spec string or FaultPlan, installed
     #   process-wide at Sweep construction.  None = A5GEN_FAULTS decides
     #   (unset = nothing armed, the production no-op).
+    pod: "Optional[Tuple[int, int]]" = None  # pod-sharded giant-job mode
+    #   (PERF.md §29): ``(process_index, process_count)`` splits ONE
+    #   keyspace job across a pod via per-device block-cursor stripes —
+    #   with P processes of D devices each, global device ``p*D + d``
+    #   owns blocks ``b0 + (p*D + d) * num_blocks`` of every superstep
+    #   and all stripes advance ``steps * num_blocks * P * D`` per
+    #   dispatch, so the union of the shards' streams is exactly the
+    #   single-device stream.  Every process sweeps the SAME wordlist
+    #   (unlike the per-host word stripes of run_crack_multihost); the
+    #   cursor stays the global linear (word, rank) cursor, so shard
+    #   checkpoints and single-device checkpoints are interchangeable.
+    #   Requires the superstep executor (the striping seam); the
+    #   per-launch fallback path would silently duplicate work, so an
+    #   ineligible plan raises instead.  None = no pod striping.
+    geometry_source: str = "explicit"  # provenance of the launch
+    #   geometry (PERF.md §29): "explicit" (caller-pinned values),
+    #   "profile" (filled from the device kind's autotune profile), or
+    #   "default" (built-in defaults).  Stamped by the launch-time
+    #   resolution seam; metadata only — never trace-key or
+    #   fingerprint material.
 
     def resolve_block_stride(self) -> Optional[int]:
         """Lanes-per-block of the fixed-stride layout; None = packed.
@@ -306,6 +341,12 @@ class SweepConfig:
         non-divisible geometry raises instead of silently degrading to
         packed; auto mode quietly falls back (the layouts are
         stream-identical, only throughput differs)."""
+        if self.lanes is None:
+            raise ValueError(
+                "lanes=None (autotune profile / built-in defaults) is "
+                "resolved by the Sweep at launch; resolve_block_stride "
+                "needs a concrete lane count"
+            )
         if self.num_blocks is None:
             raise ValueError(
                 "num_blocks=None (auto) is resolved by the Sweep once plan "
@@ -355,6 +396,15 @@ class SweepResult:
     #: observes it — per-job attribution is ``Engine.stats()``'s
     #: process totals, not this field.
     schema_cache: Dict[str, int] = field(default_factory=dict)
+    #: resolved launch geometry provenance (PERF.md §29): the concrete
+    #: values this run actually launched with (lanes / num_blocks /
+    #: superstep / pair / device_kind) — no throughput number is ever
+    #: ambiguous about its geometry again.  Empty when no launch ran
+    #: (zero-word sweeps).
+    geometry: Dict[str, Any] = field(default_factory=dict)
+    #: where that geometry came from: "explicit" (caller-pinned),
+    #: "profile" (autotune profile), or "default" (built-ins).
+    geometry_source: str = "explicit"
 
 
 class _FallbackPrefetcher:
@@ -474,6 +524,9 @@ class Sweep:
         #: step programs live in the process-level _STEP_CACHE.
         self._step_cache: Dict = {}
         self._mesh = None
+        #: device kind of the live backend, resolved at first launch
+        #: (geometry-provenance material, PERF.md §29).
+        self._device_kind: Optional[str] = None
         self._ttfc: List[Optional[float]] = [None]
         self._run_t0 = 0.0
         #: the live machine's CheckpointState (PERF.md §20): set when a
@@ -549,6 +602,26 @@ class Sweep:
         set_routing = getattr(self.config.progress, "set_routing", None)
         if set_routing is not None:
             set_routing(self.routing)
+        # Pod-sharded giant-job mode (PERF.md §29): validate the shard
+        # coordinates, and route the host-side oracle-fallback words to
+        # shard 0 ONLY — fallback expansion is whole-word host work that
+        # must not be duplicated P times.  The routing counts above stay
+        # global (every shard reports the same totals); shard p>0 simply
+        # has nothing to flush, and its checkpoint's fallback_done=0
+        # means a single-device resume of that checkpoint emits the
+        # fallback words itself — no lost work across the interchange.
+        if self.config.pod is not None:
+            pidx, pcnt = (int(x) for x in self.config.pod)
+            if pcnt < 1 or not 0 <= pidx < pcnt:
+                raise ValueError(
+                    f"SweepConfig.pod must be (index, count) with "
+                    f"0 <= index < count, got {self.config.pod!r}"
+                )
+            from dataclasses import replace as _replace
+
+            self.config = _replace(self.config, pod=(pidx, pcnt))
+            if pidx != 0:
+                self.fallback_rows = []
 
     # ------------------------------------------------------------------
     # Streaming ingestion (PERF.md §19)
@@ -803,6 +876,26 @@ class Sweep:
             raise ValueError(f"SweepConfig.devices must be >= 1, got {n}")
         return n
 
+    def _geometry_provenance(self) -> "Dict[str, Any]":
+        """Resolved-geometry stamp for SweepResult/progress/bench records
+        (PERF.md §29): with ``geometry_source`` it makes every reported
+        number unambiguous about which geometry produced it.  Metadata
+        only — never trace-key or fingerprint material."""
+        cfg = self.config
+        try:
+            stride = cfg.resolve_block_stride()
+        except ValueError:
+            stride = None  # pre-resolution (lanes/num_blocks still None)
+        return {
+            "lanes": cfg.lanes,
+            "num_blocks": cfg.num_blocks,
+            "block_stride": stride,
+            "superstep": cfg.superstep,
+            "pair": cfg.pair,
+            "device_kind": self._device_kind,
+            "pod": list(cfg.pod) if cfg.pod is not None else None,
+        }
+
     def _get_step(self, key: tuple, build: Callable):
         """Shared compiled-program cache: jitted steps keyed by their
         static trace config, so streaming chunks — and repeat sweeps in
@@ -884,12 +977,38 @@ class Sweep:
         # orchestrator's init-retry budget, the engine's job restart.
         if faults.ACTIVE is not None:
             faults.ACTIVE.fire("device.init")
-        if self.config.num_blocks is None:
-            from dataclasses import replace
+        from dataclasses import replace
 
+        if self._device_kind is None:
+            import jax
+
+            self._device_kind = str(jax.devices()[0].device_kind)
+        if self.config.lanes is None:
+            # The geometry-resolution seam (PERF.md §29): explicit flag
+            # > autotune profile > built-in defaults.  Runs here — not
+            # in __init__ — because the profile is keyed by device
+            # kind, and nothing before the first launch touches jax.
+            from .tune import resolve_config
+
+            resolved, source = resolve_config(
+                self.config, self._device_kind
+            )
+            self.config = replace(resolved, geometry_source=source)
+        if self.config.num_blocks is None:
             self.config = replace(
                 self.config, num_blocks=self._auto_num_blocks(kind, plan)
             )
+        if self.config.progress is not None:
+            # Provenance into the progress JSON stream (guarded like the
+            # set_routing call site for pre-geometry custom reporters).
+            set_geometry = getattr(
+                self.config.progress, "set_geometry", None
+            )
+            if set_geometry is not None:
+                set_geometry(
+                    self._geometry_provenance(),
+                    self.config.geometry_source,
+                )
         spec, cfg = self.spec, self.config
         n_devices = self._resolve_devices()
         stride = cfg.resolve_block_stride()
@@ -1102,19 +1221,31 @@ class Sweep:
         if idx is None:
             return None
         cum, _totals, total_blocks = idx
+        # Pod-sharded giant-job striping (PERF.md §29): with P pod
+        # processes of D local devices, global device stripe
+        # ``p*D + d`` starts at ``b0 + (p*D + d) * num_blocks`` and
+        # EVERY stripe advances ``steps * num_blocks * P * D`` per
+        # superstep — the sharded executor's per-device striping with
+        # the pod as the outer axis, so the union of the shards'
+        # streams is exactly the single-device stream and boundary
+        # cursors stay global.  All shards must compute the identical
+        # ``steps`` cap, hence total_stripes (not n_devices) below.
+        pod_index, pod_procs = cfg.pod or (0, 1)
+        total_stripes = n_devices * pod_procs
+        stripe_off = pod_index * n_devices
         # The superstep's device accumulator is int32: cap steps so a
         # worst case of every lane emitting cannot reach 2^31 per fetch.
         steps = max(1, min(
             steps,
             ((1 << 31) - 1)
-            // max(1, cfg.lanes * n_devices * (pair_k or 1)),
+            // max(1, cfg.lanes * total_stripes * (pair_k or 1)),
         ))
         # The tail superstep's device cursor overshoots the sweep end by
         # up to one full superstep (those blocks cut zero-count); the
         # overshot indices must themselves stay int32, or `b < total`
         # comparisons wrap negative and resurrect word-0 blocks.
         if (
-            total_blocks + (steps + 1) * cfg.num_blocks * n_devices
+            total_blocks + (steps + 1) * cfg.num_blocks * total_stripes
             >= (1 << 31)
         ):
             return None
@@ -1136,6 +1267,14 @@ class Sweep:
                 common["windowed"], step_ctx["fused_opts"],
                 step_ctx["scalar_units"], step_ctx["radix2"],
                 _pieces_static(step_ctx["pieces"]), pair_k)
+        if cfg.pod is not None:
+            # The per-step advance is baked into the traced body, so
+            # pod-striped programs must never share a cache entry with
+            # solo ones (and solo keys stay byte-identical to pre-pod).
+            skey = skey + (("pod", stripe_off, total_stripes),)
+            common = dict(
+                common, step_advance=cfg.num_blocks * total_stripes
+            )
         if mesh is not None:
             skey = skey + (tuple(int(d.id) for d in mesh.devices.flat),)
         p, t, darrs = step_ctx["arrays"]
@@ -1148,9 +1287,10 @@ class Sweep:
             ))
             ss = superstep_arrays(plan, rank_stride, idx=idx)
             make_bufs = lambda: superstep_buffers(hit_cap)  # noqa: E731
+            solo_off = stripe_off * cfg.num_blocks
 
             def call(b: int, bufs):
-                return step(p, t, darrs, ss, np.int32(b), bufs)
+                return step(p, t, darrs, ss, np.int32(b + solo_off), bufs)
         else:
             from ..parallel.mesh import (
                 make_sharded_superstep_step,
@@ -1181,7 +1321,8 @@ class Sweep:
 
             def call(b: int, bufs):
                 b0_dev = shard_leading(mesh, np.asarray(
-                    [b + d * nb for d in range(n_devices)], np.int32
+                    [b + (stripe_off + d) * nb for d in range(n_devices)],
+                    np.int32,
                 ))
                 return step(p, t, darrs, ss, b0_dev, bufs)
 
@@ -1199,7 +1340,14 @@ class Sweep:
             "cum": cum,
             "total_blocks": total_blocks,
             "hit_cap": hit_cap,
-            "advance": steps * cfg.num_blocks * n_devices,
+            "advance": steps * cfg.num_blocks * total_stripes,
+            # Pod giant-job stripe layout (None = no pod striping):
+            # overflow replay must re-run only THIS shard's stripes of
+            # the superstep's global [b_lo, b_hi) range.
+            "stripe": (
+                None if cfg.pod is None
+                else (stripe_off, n_devices, total_stripes, cfg.num_blocks)
+            ),
         }
 
     def _make_superstep(self, plan, cursor: SweepCursor, n_devices: int,
@@ -1370,13 +1518,19 @@ class Sweep:
                     # Graceful degradation: the capped device buffer
                     # dropped entries — replay this superstep exactly
                     # through the per-launch path (its hit processing is
-                    # the accounting; the scan's counts stand).
+                    # the accounting; the scan's counts stand).  Under
+                    # pod striping only THIS shard's stripe sub-ranges
+                    # replay — re-running a peer's blocks would emit
+                    # duplicate hits.
                     stats["replays"] += 1
                     replayed = True
-                    self._replay_superstep(
-                        sb0, end_b, ss, launch, n_devices, mesh,
-                        process_launch_hits, plan=plan,
-                    )
+                    for r_lo, r_hi in self._pod_replay_ranges(
+                        sb0, end_b, ss
+                    ):
+                        self._replay_superstep(
+                            r_lo, r_hi, ss, launch, n_devices, mesh,
+                            process_launch_hits, plan=plan,
+                        )
                 else:
                     hw = np.asarray(out["hit_word"])
                     hr = np.asarray(out["hit_rank"])
@@ -1432,6 +1586,32 @@ class Sweep:
                 )
             yield
         return stats
+
+    def _pod_replay_ranges(
+        self, b_lo: int, b_hi: int, ss
+    ) -> "Iterator[Tuple[int, int]]":
+        """The block sub-ranges THIS process owns inside one superstep's
+        global ``[b_lo, b_hi)`` range.  Without pod striping that is the
+        whole range; under ``SweepConfig.pod`` each scan step ``s``
+        grants this shard the contiguous slice
+        ``[b_lo + s*span + off*nb, + n_local*nb)`` where ``span =
+        total_stripes * nb`` — its local devices' stripes — clipped to
+        the sweep end (overshot stripes cut zero-count blocks on
+        device, and must replay nothing on the host)."""
+        stripe = ss.get("stripe")
+        if stripe is None:
+            yield (b_lo, b_hi)
+            return
+        off, n_local, total_stripes, nb = stripe
+        span = total_stripes * nb
+        total_blocks = ss["total_blocks"]
+        base = b_lo
+        while base < b_hi:
+            lo = base + off * nb
+            hi = min(lo + n_local * nb, total_blocks)
+            if lo < hi:
+                yield (lo, hi)
+            base += span
 
     def _replay_superstep(
         self, b_lo: int, b_hi: int, ss, launch: Callable, n_devices: int,
@@ -1892,6 +2072,8 @@ class Sweep:
             routing=dict(self.routing),
             superstep=superstep_stats,
             stream=stream_stats,
+            geometry=self._geometry_provenance(),
+            geometry_source=self.config.geometry_source,
             schema_cache=_stats_delta(sc0, schema_cache_stats()),
         )
 
@@ -1957,6 +2139,12 @@ class Sweep:
 
         if self._packed_source is not None and row_base == 0 \
                 and self._stream is None:
+            if cfg.pod is not None:
+                raise RuntimeError(
+                    "pod giant-job mode cannot ride a cross-job packed "
+                    "dispatch (the FusedGroup owns the block cursors); "
+                    "run giant jobs solo"
+                )
             # Cross-job packed dispatch (PERF.md §22): the engine's
             # FusedGroup owns dispatch and the one-per-round fetch; this
             # machine consumes its own split share through the SAME
@@ -1970,6 +2158,18 @@ class Sweep:
         sstep = self._make_superstep(
             plan, local_cursor, n_devices, mesh, step_ctx
         )
+        if sstep is None and cfg.pod is not None:
+            # The striping seam IS the superstep executor's block
+            # lattice; the per-launch fallback would sweep every shard
+            # over the whole keyspace (P× duplicate work and duplicate
+            # hit streams).  Fail loudly instead.
+            raise RuntimeError(
+                "pod giant-job mode requires the superstep executor "
+                "(fixed-stride layout, int32-safe block index, "
+                "stride-aligned resume cursor); this plan/geometry/"
+                "cursor is ineligible — adjust the geometry or drop "
+                "--giant-job"
+            )
         if sstep is not None:
             return (yield from self._drive_superstep(
                 sstep, state, launch, n_devices, mesh,
@@ -2364,6 +2564,14 @@ class Sweep:
         from ..ops.packing import schema_cache_stats
 
         cfg = self.config
+        if cfg.pod is not None:
+            # Candidates mode streams EVERY candidate to one writer; a
+            # pod stripe would emit an interleaved subset with no merge
+            # discipline.  Giant-job striping is a crack-mode contract.
+            raise RuntimeError(
+                "pod giant-job mode is crack-only; candidates mode "
+                "streams the full keyspace from one process"
+            )
         state, resumed = self._load_state(resume, state)
         self.active_state = state
         sc0 = schema_cache_stats()
@@ -2424,6 +2632,8 @@ class Sweep:
             wall_s=state.wall_s,
             routing=dict(self.routing),
             stream=stream_stats,
+            geometry=self._geometry_provenance(),
+            geometry_source=self.config.geometry_source,
             schema_cache=_stats_delta(sc0, schema_cache_stats()),
         )
 

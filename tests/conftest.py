@@ -32,6 +32,12 @@ import pytest
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_a5")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
 
+# Hermeticity (PERF.md §29): a developer's ~/.cache/a5gen autotune
+# profile must never change test results — geometry left to the runtime
+# resolves to built-in defaults here.  Tests exercising profile loading
+# point A5GEN_TUNE_PROFILE at their own tmp directory via monkeypatch.
+os.environ["A5GEN_TUNE_PROFILE"] = "off"
+
 REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 UPSTREAM_REFERENCE = pathlib.Path("/root/reference")
 

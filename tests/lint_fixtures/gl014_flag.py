@@ -1,0 +1,19 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL014 must flag: hardcoded geometry literals in ``runtime/``.
+
+Every binding form counts — a bare assignment (shift spelling
+included), a geometry keyword in a call, and a function default —
+because each one pins a geometry the autotune profile can never
+override and the ``geometry_source`` stamp never reports (PERF.md
+§29)."""
+
+
+def build(make_config):
+    lanes = 1 << 20  # assignment: GL014
+    stride = 128  # assignment: GL014
+    cfg = make_config(num_blocks=1024)  # call keyword: GL014
+    return lanes, stride, cfg
+
+
+def drive(step, superstep=8):  # function default: GL014
+    return step(superstep)

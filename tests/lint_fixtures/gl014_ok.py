@@ -1,0 +1,19 @@
+# graftlint-virtual-path: hashcat_a5_table_generator_tpu/runtime/_fixture.py
+"""GL014 stays quiet on the idiom: geometry flows through the
+resolution seam — knobs left ``None`` for the Sweep to fill from the
+device kind's profile, values read back off a config, and derived
+geometry computed from those resolved values (PERF.md §29).  Non-
+geometry integer literals are out of scope."""
+
+
+def build(make_config, resolve_config, kind):
+    cfg = make_config(lanes=None, num_blocks=None)  # resolve at launch
+    resolved, source = resolve_config(cfg, kind)
+    lanes = resolved.lanes  # read-back, not a literal
+    stride = lanes // max(resolved.num_blocks, 1)  # derived
+    fetch_chunk = 16  # not a geometry knob
+    return resolved, source, stride, fetch_chunk
+
+
+def drive(step, superstep=None):  # None default: resolved downstream
+    return step(superstep)

@@ -1,0 +1,296 @@
+"""Pod-sharded giant-job mode (PERF.md §29): ONE oversized keyspace job
+split across a pod via per-device block-cursor stripes.
+
+Stream contract: the union of the shards' hit streams is byte-exact the
+single-device stream (each hit found by exactly ONE stripe), every
+shard sweeps the FULL dictionary, and the checkpoint cursor stays the
+GLOBAL linear (word, rank) cursor — a shard checkpoint resumes under
+the single-device path and vice versa.  Most tests run the stripes
+in-process (``SweepConfig.pod`` is plain config); the 2-process
+``run_crack_giant`` surface runs behind the ``pod_collectives`` guard.
+"""
+
+import hashlib
+import io
+import json
+import os
+import pathlib
+import socket
+import subprocess
+import sys
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.runtime import (
+    CandidateWriter,
+    HitRecorder,
+    load_checkpoint,
+)
+from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep, SweepConfig
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a", b"assess",
+         b"oboe", b"xyzzy", b"sass", b"passes"]
+
+
+def oracle_lines(spec):
+    out = []
+    for w in WORDS:
+        out.extend(iter_candidates(w, LEET, spec.min_substitute,
+                                   spec.max_substitute))
+    return out
+
+
+def planted_digests(spec, picks=(0, 2, 5)):
+    oracle = oracle_lines(spec)
+    planted = sorted({oracle[len(oracle) * i // 7] for i in picks})
+    digests = [hashlib.md5(c).digest() for c in planted]
+    digests += [hashlib.md5(b"decoy%d" % i).digest() for i in range(20)]
+    return planted, digests
+
+
+def cfg(pod=None, **kw):
+    # devices=1 pins one local device per shard: total stripes ==
+    # pod process count, matching one-chip-per-process pods.
+    kw.setdefault("lanes", 64)
+    kw.setdefault("num_blocks", 16)
+    kw.setdefault("superstep", 1)
+    kw.setdefault("devices", 1)
+    return SweepConfig(pod=pod, **kw)
+
+
+def hit_tuples(res):
+    return sorted(
+        (h.word_index, h.variant_rank, h.candidate) for h in res.hits
+    )
+
+
+class TestStripeParity:
+    # 3-way striping is the slow-tier arm (~7 s: one solo + three shard
+    # sweeps); the 2-way arm keeps the parity contract in the default
+    # tier.
+    @pytest.mark.parametrize(
+        "nprocs",
+        [2, pytest.param(3, marks=pytest.mark.slow)],
+    )
+    def test_stripe_union_is_byte_exact_solo_stream(self, nprocs):
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, digests = planted_digests(spec)
+        solo = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        shards = [
+            Sweep(spec, LEET, WORDS, digests,
+                  config=cfg(pod=(p, nprocs))).run_crack()
+            for p in range(nprocs)
+        ]
+        # Disjoint union: every hit found by exactly one stripe.
+        union = [t for s in shards for t in hit_tuples(s)]
+        assert len(union) == len(set(union))
+        assert sorted(union) == hit_tuples(solo)
+        assert {t[2] for t in union} == set(planted)
+        assert sum(s.n_emitted for s in shards) == solo.n_emitted
+        # Every shard sweeps the FULL dictionary (words_done merges by
+        # max across shards, never sum).
+        for s in shards:
+            assert s.words_done == solo.words_done == len(WORDS)
+
+    def test_geometry_stamp_records_stripe(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        _, digests = planted_digests(spec)
+        res = Sweep(spec, LEET, WORDS, digests,
+                    config=cfg(pod=(1, 2))).run_crack()
+        assert res.geometry["pod"] == [1, 2]
+        solo = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        assert solo.geometry["pod"] is None
+
+
+class TestPodGuards:
+    def test_candidates_mode_raises(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        sweep = Sweep(spec, LEET, WORDS, [], config=cfg(pod=(0, 2)))
+        with pytest.raises(RuntimeError, match="crack-only"):
+            sweep.run_candidates(CandidateWriter(io.BytesIO()))
+
+    def test_per_launch_path_raises(self):
+        """superstep=0 pins the per-launch pipeline; the striping seam
+        IS the superstep block lattice, so pod mode must fail loudly
+        instead of sweeping every shard over the whole keyspace."""
+        spec = AttackSpec(mode="default", algo="md5")
+        _, digests = planted_digests(spec)
+        sweep = Sweep(spec, LEET, WORDS, digests,
+                      config=cfg(pod=(0, 2), superstep=0))
+        with pytest.raises(RuntimeError, match="superstep executor"):
+            sweep.run_crack()
+
+    def test_bad_pod_tuple_raises(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        with pytest.raises(ValueError, match="pod"):
+            Sweep(spec, LEET, WORDS, [], config=cfg(pod=(2, 2)))
+
+
+class TestGiantJobResume:
+    def test_mid_stripe_resume_is_byte_exact(self, tmp_path):
+        """A shard killed mid-job resumes from its boundary checkpoint
+        and finishes with the identical stripe stream."""
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, digests = planted_digests(spec, picks=(0, 1, 2, 4, 6))
+        pod = (1, 2)
+        want = Sweep(spec, LEET, WORDS, digests,
+                     config=cfg(pod=pod)).run_crack()
+        assert want.n_hits >= 2, "need >=2 stripe hits to interrupt"
+
+        path = str(tmp_path / "shard1.json")
+        ckpt_cfg = cfg(pod=pod, checkpoint_path=path,
+                       checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        # Blow up on the SECOND stripe hit: at least one superstep
+        # boundary (and its every_s=0 checkpoint) has passed by then.
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=ckpt_cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+
+        second = Sweep(spec, LEET, WORDS, digests, config=ckpt_cfg)
+        got = second.run_crack()
+        assert got.resumed
+        assert hit_tuples(got) == hit_tuples(want)
+        assert got.n_emitted == want.n_emitted
+        assert got.words_done == want.words_done
+
+    @pytest.mark.slow  # ~4 s on the tier-1 host; the mid-stripe resume
+    # test above keeps the giant-job checkpoint family's default arm
+    def test_cursor_interchanges_with_single_device_path(self, tmp_path):
+        """The giant job is ONE job: a shard's mid-job checkpoint is a
+        plain global (word, rank) cursor, so the single-device sweep
+        resumes it — and from that boundary emits exactly the solo
+        stream's tail (a superset of the one stripe's tail)."""
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, digests = planted_digests(spec, picks=(0, 1, 2, 4, 6))
+        path = str(tmp_path / "shard0.json")
+        pod_cfg = cfg(pod=(0, 2), checkpoint_path=path,
+                      checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        # Second-hit boom: guarantees a boundary checkpoint exists.
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=pod_cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+
+        # Resume the SAME checkpoint file on the solo path (pod=None,
+        # same fingerprint — geometry/devices are excluded from it).
+        solo_cfg = cfg(checkpoint_path=path, checkpoint_every_s=0.0)
+        got = Sweep(spec, LEET, WORDS, digests, config=solo_cfg).run_crack()
+        assert got.resumed
+        assert got.words_done == len(WORDS)
+        full = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        # The resumed tail is a subset of the full solo stream, and
+        # nothing before the checkpointed cursor is re-emitted.
+        assert set(hit_tuples(got)) <= set(hit_tuples(full))
+        assert got.n_emitted <= full.n_emitted
+
+
+_GIANT_CHILD = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.pop("XLA_FLAGS", None)  # one local device per process
+import jax
+jax.config.update("jax_platforms", "cpu")
+import json
+pid = int(sys.argv[1])
+port = sys.argv[2]
+outdir = sys.argv[3]
+
+from hashcat_a5_table_generator_tpu.parallel import multihost
+multihost.initialize(f"127.0.0.1:{port}", 2, pid)
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.ops.packing import pack_words
+from hashcat_a5_table_generator_tpu.parallel.multihost import run_crack_giant
+from hashcat_a5_table_generator_tpu.runtime.sweep import SweepConfig
+
+LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a", b"assess",
+         b"oboe", b"xyzzy", b"sass", b"passes"]
+digests = [bytes.fromhex(h) for h in json.loads(sys.argv[4])]
+
+spec = AttackSpec(mode="default", algo="md5")
+res = run_crack_giant(
+    spec, LEET, pack_words(WORDS), digests,
+    config=SweepConfig(lanes=64, num_blocks=16, superstep=1),
+)
+with open(os.path.join(outdir, f"out{pid}.json"), "w") as fh:
+    json.dump({
+        "n_emitted": res.n_emitted,
+        "n_hits": res.n_hits,
+        "words_done": res.words_done,
+        "geometry_pod": res.geometry.get("pod"),
+        "hits": [
+            [h.word_index, h.variant_rank, h.candidate.hex()]
+            for h in res.hits
+        ],
+    }, fh)
+"""
+
+
+def test_two_process_giant_job_matches_single(tmp_path, pod_collectives):
+    """run_crack_giant over a real 2-process pod: both processes return
+    the same combined result, byte-exact vs the single-device sweep,
+    with words_done covering the FULL dictionary (not a wordlist
+    stripe — the giant job splits blocks, not words)."""
+    spec = AttackSpec(mode="default", algo="md5")
+    planted, digests = planted_digests(spec)
+    want = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+    want_hits = [[h.word_index, h.variant_rank, h.candidate.hex()]
+                 for h in sorted(want.hits,
+                                 key=lambda h: (h.word_index,
+                                                h.variant_rank))]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "child.py"
+    script.write_text(_GIANT_CHILD)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(p), str(port),
+             str(tmp_path), json.dumps([d.hex() for d in digests])],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for p in range(2)
+    ]
+    outs = [p.communicate(timeout=300) for p in procs]
+    for p, (out, err) in zip(procs, outs):
+        assert p.returncode == 0, err.decode()[-3000:]
+
+    results = [json.load(open(tmp_path / f"out{p}.json"))
+               for p in range(2)]
+    assert results[0] == results[1]
+    assert results[0]["hits"] == want_hits
+    assert results[0]["n_emitted"] == want.n_emitted
+    assert results[0]["words_done"] == len(WORDS)
+    assert results[0]["geometry_pod"] in ([0, 2], [1, 2])

@@ -1,0 +1,323 @@
+"""Autotuned launch geometry (PERF.md §29): profile round-trip and
+precedence, corrupt-profile fallback, the matrix driver's per-arm
+parity + partial-matrix resume, and the Sweep's launch-time resolution
+seam (explicit flag > loaded profile > built-in defaults) with its
+``geometry_source`` provenance stamp.
+
+The suite-wide ``A5GEN_TUNE_PROFILE=off`` (conftest) keeps every other
+test hermetic; tests here point the env var at their own tmp dir."""
+
+import hashlib
+import json
+
+import pytest
+
+from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+from hashcat_a5_table_generator_tpu.oracle.engines import iter_candidates
+from hashcat_a5_table_generator_tpu.runtime.sweep import Sweep, SweepConfig
+from hashcat_a5_table_generator_tpu.runtime.tune import (
+    TUNE_SCHEMA_VERSION,
+    TuneProfileCorrupt,
+    builtin_geometry,
+    default_matrix,
+    device_slug,
+    load_profile,
+    profile_path,
+    read_profile,
+    resolve_config,
+    run_autotune,
+    tune_wordlist,
+    write_profile,
+)
+
+LEET = {b"a": [b"4", b"@"], b"o": [b"0"], b"s": [b"$", b"5"], b"e": [b"3"]}
+WORDS = [b"password", b"sesame", b"octopus", b"zzz", b"a"]
+
+#: A tiny 2-arm matrix: one warm + one timed sweep per arm at
+#: ``seconds=0.0`` keeps the whole matrix inside a couple of seconds on
+#: the CPU backend (tier-1 budget).
+TINY_MATRIX = [
+    {"name": "lanes256-stride64", "lanes": 256, "num_blocks": 4,
+     "stride": 64, "superstep": None, "pair": "auto", "emit": None},
+    {"name": "lanes512-stride64", "lanes": 512, "num_blocks": 8,
+     "stride": 64, "superstep": None, "pair": "auto", "emit": None},
+]
+
+
+def tiny_autotune(tmp_path, **kw):
+    kw.setdefault("words", 64)
+    kw.setdefault("seconds", 0.0)
+    kw.setdefault("matrix", [dict(a) for a in TINY_MATRIX])
+    kw.setdefault("directory", str(tmp_path / "profiles"))
+    return run_autotune(**kw)
+
+
+class TestProfileRoundTrip:
+    def test_write_then_read_preserves_geometry(self, tmp_path):
+        d = str(tmp_path)
+        geometry = {"lanes": 1 << 17, "num_blocks": 256, "superstep": 8,
+                    "pair": None, "packed_blocks": None}
+        path = write_profile("TPU v5 lite", geometry,
+                            bench={"hashes_per_s": 1.0}, directory=d)
+        assert path == profile_path("TPU v5 lite", d)
+        doc = read_profile(path)
+        assert doc["version"] == TUNE_SCHEMA_VERSION
+        assert doc["device_kind"] == "TPU v5 lite"
+        for k, v in geometry.items():
+            assert doc["geometry"][k] == v
+        assert load_profile("TPU v5 lite", d) == doc
+
+    def test_device_slug_is_filesystem_safe(self):
+        assert device_slug("TPU v4") == "tpu-v4"
+        assert device_slug("cpu") == "cpu"
+        assert "/" not in device_slug("weird/kind (x)")
+
+    def test_atomic_write_leaves_no_temp_droppings(self, tmp_path):
+        write_profile("cpu", {"lanes": 1024}, directory=str(tmp_path))
+        names = {p.name for p in tmp_path.iterdir()}
+        assert names == {"cpu.json"}
+
+
+class TestPrecedence:
+    """Per-knob: explicit (non-None) > profile > built-in defaults."""
+
+    def test_explicit_lanes_never_consults_profile(self, tmp_path):
+        d = str(tmp_path)
+        write_profile("cpu", {"lanes": 2048, "num_blocks": 4}, directory=d)
+        cfg = SweepConfig(lanes=1 << 12, num_blocks=8)
+        resolved, source = resolve_config(cfg, "cpu", directory=d)
+        assert source == "explicit"
+        assert resolved is cfg
+
+    def test_profile_fills_unset_knobs(self, tmp_path):
+        d = str(tmp_path)
+        write_profile("cpu", {"lanes": 2048, "num_blocks": 4,
+                              "superstep": 4}, directory=d)
+        resolved, source = resolve_config(
+            SweepConfig(lanes=None, num_blocks=None), "cpu", directory=d
+        )
+        assert source == "profile"
+        assert (resolved.lanes, resolved.num_blocks, resolved.superstep) \
+            == (2048, 4, 4)
+
+    def test_explicit_knob_composes_with_profile(self, tmp_path):
+        d = str(tmp_path)
+        write_profile("cpu", {"lanes": 2048, "num_blocks": 4}, directory=d)
+        resolved, source = resolve_config(
+            SweepConfig(lanes=None, num_blocks=16), "cpu", directory=d
+        )
+        assert source == "profile"
+        assert resolved.lanes == 2048
+        assert resolved.num_blocks == 16  # explicit per-knob value wins
+
+    def test_no_profile_falls_back_to_builtins(self, tmp_path):
+        resolved, source = resolve_config(
+            SweepConfig(lanes=None, num_blocks=None), "cpu",
+            directory=str(tmp_path / "empty"),
+        )
+        assert source == "default"
+        builtin = builtin_geometry("cpu")
+        assert resolved.lanes == builtin["lanes"]
+        assert resolved.num_blocks == builtin["num_blocks"]
+
+    def test_builtin_geometry_per_backend_class(self):
+        assert builtin_geometry("cpu")["lanes"] == 1 << 17
+        assert builtin_geometry("TPU v4")["lanes"] == 1 << 22
+        assert builtin_geometry("TPU v4")["num_blocks"] is None
+
+
+class TestCorruptProfiles:
+    def _resolve(self, d):
+        return resolve_config(SweepConfig(lanes=None), "cpu", directory=d)
+
+    def test_torn_json_warns_once_and_falls_back(self, tmp_path, capsys):
+        d = str(tmp_path)
+        path = profile_path("cpu", d)
+        with open(path, "w") as fh:
+            fh.write('{"version": "1.0", "geometry": {"lan')  # torn
+        with pytest.raises(TuneProfileCorrupt):
+            read_profile(path)
+        resolved, source = self._resolve(d)
+        assert source == "default"
+        assert resolved.lanes == builtin_geometry("cpu")["lanes"]
+        # Loading again must not warn again (once per path+reason).
+        self._resolve(d)
+        err = capsys.readouterr().err
+        assert err.count("ignoring tune profile") == 1
+
+    def test_unknown_major_rejected(self, tmp_path):
+        d = str(tmp_path)
+        path = profile_path("cpu", d)
+        with open(path, "w") as fh:
+            json.dump({"version": "99.0",
+                       "geometry": {"lanes": 64}}, fh)
+        with pytest.raises(TuneProfileCorrupt, match="schema major"):
+            read_profile(path)
+        assert load_profile("cpu", d) is None
+
+    def test_unknown_minor_is_additive(self, tmp_path):
+        d = str(tmp_path)
+        path = profile_path("cpu", d)
+        with open(path, "w") as fh:
+            json.dump({"version": "1.9", "future_field": True,
+                       "geometry": {"lanes": 4096}}, fh)
+        resolved, source = self._resolve(d)
+        assert source == "profile"
+        assert resolved.lanes == 4096
+
+    def test_malformed_geometry_rejected(self, tmp_path):
+        d = str(tmp_path)
+        path = profile_path("cpu", d)
+        with open(path, "w") as fh:
+            json.dump({"version": "1.0",
+                       "geometry": {"lanes": "huge"}}, fh)
+        assert load_profile("cpu", d) is None
+        assert self._resolve(d)[1] == "default"
+
+    def test_disabled_via_env(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", "off")
+        assert profile_path("cpu") is None
+        assert load_profile("cpu") is None
+        with pytest.raises(ValueError, match="disabled"):
+            write_profile("cpu", {"lanes": 64})
+
+    def test_env_overrides_directory(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", str(tmp_path))
+        write_profile("cpu", {"lanes": 512, "num_blocks": 8})
+        assert (tmp_path / "cpu.json").is_file()
+        resolved, source = resolve_config(SweepConfig(lanes=None), "cpu")
+        assert (source, resolved.lanes) == ("profile", 512)
+
+
+class TestAutotuneMatrix:
+    def test_smoke_matrix_measures_and_writes_profile(self, tmp_path):
+        seen = []
+        res = tiny_autotune(tmp_path, on_arm=seen.append)
+        assert [r["arm"] for r in seen] == [a["name"] for a in TINY_MATRIX]
+        # Per-arm parity: geometry never changes WHAT is emitted.
+        assert len({r["emitted_per_sweep"] for r in seen}) == 1
+        assert res["winner"] in {a["name"] for a in TINY_MATRIX}
+        assert res["hashes_per_s"] == max(r["hashes_per_s"] for r in seen)
+        # The profile round-trips through the resolution seam, and the
+        # loaded-by-default geometry is the measured winner (>= every
+        # other arm, so >= the built-in default arm when present).
+        doc = read_profile(res["profile_path"])
+        resolved, source = resolve_config(
+            SweepConfig(lanes=None), res["device_kind"],
+            directory=str(tmp_path / "profiles"),
+        )
+        assert source == "profile"
+        assert resolved.lanes == res["geometry"]["lanes"] \
+            == doc["geometry"]["lanes"]
+
+    def test_parity_failure_raises(self, tmp_path):
+        bad = [dict(TINY_MATRIX[0]), dict(TINY_MATRIX[1])]
+        state = {"completed": {bad[0]["name"]: {
+            "arm": bad[0]["name"], "geometry": dict(bad[0]),
+            "emitted_per_sweep": 1, "hits_per_sweep": 0, "sweeps": 1,
+            "seconds": 0.0, "hashes_per_s": 1.0,
+        }}}
+        sp = tmp_path / "state.json"
+        sp.write_text(json.dumps(state))
+        with pytest.raises(RuntimeError, match="parity"):
+            tiny_autotune(tmp_path, matrix=bad, state_path=str(sp))
+
+    def test_partial_matrix_resume_skips_completed_arms(self, tmp_path):
+        sp = str(tmp_path / "state.json")
+        first = tiny_autotune(tmp_path, matrix=[dict(TINY_MATRIX[0])],
+                              state_path=sp, write=False)
+        assert first["winner"] == TINY_MATRIX[0]["name"]
+        seen = []
+        second = tiny_autotune(tmp_path, state_path=sp, write=False,
+                               on_arm=seen.append)
+        resumed = {r["arm"]: r.get("resumed", False) for r in seen}
+        assert resumed[TINY_MATRIX[0]["name"]] is True
+        assert resumed[TINY_MATRIX[1]["name"]] is False
+        assert len(second["arms"]) == 2
+        # Third run: the state file now covers the full matrix.
+        third = tiny_autotune(tmp_path, state_path=sp, write=False)
+        assert all(r.get("resumed") for r in third["arms"])
+
+    def test_corrupt_state_file_raises_typed(self, tmp_path):
+        sp = tmp_path / "state.json"
+        sp.write_text("{not json")
+        with pytest.raises(TuneProfileCorrupt, match="tune state"):
+            tiny_autotune(tmp_path, state_path=str(sp))
+
+    def test_default_matrix_smoke_is_tiny_and_full_is_bounded(self):
+        smoke = default_matrix(smoke=True)
+        full = default_matrix()
+        assert 1 < len(smoke) <= 4
+        assert len(smoke) < len(full) <= 64
+        names = [a["name"] for a in full]
+        assert len(set(names)) == len(names)
+        for arm in smoke + full:
+            assert arm["lanes"] % arm["stride"] == 0
+            assert arm["num_blocks"] == arm["lanes"] // arm["stride"]
+
+    def test_tune_wordlist_is_deterministic(self):
+        assert tune_wordlist(16) == tune_wordlist(16)
+        assert len(tune_wordlist(16)) == 16
+
+
+class TestSweepResolutionSeam:
+    """The runtime surface: a Sweep constructed with ``lanes=None``
+    resolves geometry at launch time and stamps the provenance into
+    the result; explicit constructions never consult a profile."""
+
+    def _crack(self, cfg):
+        spec = AttackSpec(mode="default", algo="md5")
+        cand = next(iter(iter_candidates(WORDS[0], LEET, 0, 15)))
+        digests = [hashlib.md5(cand).digest()]
+        return Sweep(spec, LEET, WORDS, digests, config=cfg).run_crack()
+
+    def test_explicit_geometry_stamped_explicit(self):
+        res = self._crack(SweepConfig(lanes=64, num_blocks=16))
+        assert res.geometry_source == "explicit"
+        assert res.geometry["lanes"] == 64
+        assert res.geometry["num_blocks"] == 16
+        assert res.geometry["device_kind"] == "cpu"
+
+    def test_profile_geometry_loaded_by_default(self, monkeypatch,
+                                                tmp_path):
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", str(tmp_path))
+        write_profile("cpu", {"lanes": 128, "num_blocks": 4})
+        explicit = self._crack(SweepConfig(lanes=64, num_blocks=16))
+        res = self._crack(SweepConfig(lanes=None, num_blocks=None))
+        assert res.geometry_source == "profile"
+        assert res.geometry["lanes"] == 128
+        assert res.geometry["num_blocks"] == 4
+        # Geometry never changes WHAT is emitted.
+        assert res.n_emitted == explicit.n_emitted
+        assert [h.candidate for h in res.hits] \
+            == [h.candidate for h in explicit.hits]
+
+    def test_corrupt_profile_falls_back_to_defaults(self, monkeypatch,
+                                                    tmp_path):
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", str(tmp_path))
+        (tmp_path / "cpu.json").write_text("{torn")
+        # Small words list: built-in cpu default lanes (2^17) is one
+        # launch over this wordlist — cheap.
+        res = self._crack(SweepConfig(lanes=None))
+        assert res.geometry_source == "default"
+        assert res.geometry["lanes"] == builtin_geometry("cpu")["lanes"]
+
+    def test_progress_lines_carry_geometry(self, monkeypatch, tmp_path,
+                                           capsys):
+        import io
+
+        from hashcat_a5_table_generator_tpu.runtime.progress import (
+            ProgressReporter,
+        )
+
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", str(tmp_path))
+        write_profile("cpu", {"lanes": 128, "num_blocks": 4})
+        buf = io.StringIO()
+        progress = ProgressReporter(len(WORDS), every_s=0.0, stream=buf)
+        self._crack(SweepConfig(lanes=None, progress=progress))
+        lines = [json.loads(line) for line in
+                 buf.getvalue().strip().splitlines()]
+        assert lines
+        geom = lines[-1]["progress"]["geometry"]
+        assert geom["source"] == "profile"
+        assert geom["lanes"] == 128

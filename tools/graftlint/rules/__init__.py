@@ -13,6 +13,7 @@ from .base import Rule
 from .docs import OpDocstringContract
 from .dtype import FloatLiteralInKernel, UnmaskedWideInt
 from .envvars import EnvVarSprawl
+from .geometry import HardcodedGeometry
 from .hygiene import MutableDefaultArg, Nondeterminism, StdoutPrint
 from .jit import JitMissingStaticArgnames
 from .timing import TimingAccumulation
@@ -37,6 +38,7 @@ ALL_RULES: List[Rule] = [
     HostSyncInLoopBody(),
     EnvVarSprawl(),
     TimingAccumulation(),
+    HardcodedGeometry(),
 ]
 
 RULES_BY_CODE: Dict[str, Rule] = {rule.code: rule for rule in ALL_RULES}

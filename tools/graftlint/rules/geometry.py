@@ -1,0 +1,121 @@
+"""Geometry hygiene: the autotune seam owns geometry numbers in ``runtime/``.
+
+PERF.md §29 made launch geometry a resolved artifact — explicit flag >
+per-device-kind autotune profile > ``tune.builtin_geometry`` — so a
+throughput number is never ambiguous about where its geometry came
+from.  A hardcoded ``lanes = 1 << 20`` (or ``num_blocks=1024`` keyword)
+in a runtime module bypasses that seam: it silently pins a geometry the
+profile can never override and the provenance stamp never reports.
+
+The rule flags geometry-named bindings to integer literals (including
+``1 << n`` / literal products) in ``runtime/`` — assignments, call
+keywords, and function defaults alike.  ``tune.py`` IS the seam
+(``builtin_geometry`` lives there), and ``sweep.py`` keeps its
+grandfathered ``SweepConfig`` dataclass defaults (the library-caller
+contract predating the autotuner); the list is shrink-only.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..context import PACKAGE, FileContext
+from ..findings import Finding
+from .base import Rule
+
+#: Binding names that denote launch geometry (SweepConfig knobs and
+#: their local-variable spellings).
+_GEOMETRY_NAMES = frozenset(
+    {"lanes", "num_blocks", "blocks", "block_stride", "stride",
+     "superstep"}
+)
+
+#: The geometry-resolution seam itself — builtin_geometry and the arm
+#: matrix are the ONE sanctioned home for geometry numbers.
+_SEAM_SUFFIX = "/runtime/tune.py"
+
+#: Pre-§29 geometry literals kept for the library-caller contract
+#: (``SweepConfig``'s dataclass defaults).  Shrink-only: new runtime
+#: modules get no pass, and entries leave as the defaults migrate to
+#: ``tune.builtin_geometry``.
+_GRANDFATHERED = (
+    f"{PACKAGE}/runtime/sweep.py",
+)
+
+
+def _is_int_literal(node: ast.AST) -> bool:
+    """An int constant, or arithmetic over int constants (``1 << 17``,
+    ``4 * 1024``) — the spellings geometry numbers are written in."""
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, int) and not isinstance(
+            node.value, bool
+        )
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.LShift, ast.Mult, ast.FloorDiv, ast.Add)
+    ):
+        return _is_int_literal(node.left) and _is_int_literal(node.right)
+    return False
+
+
+class HardcodedGeometry(Rule):
+    code = "GL014"
+    name = "hardcoded-geometry"
+    summary = (
+        "geometry literal (lanes/num_blocks/stride/superstep) in "
+        "runtime/ outside the autotune resolution seam"
+    )
+    rationale = (
+        "Launch geometry resolves explicit flag > autotune profile > "
+        "tune.builtin_geometry (PERF.md §29); a literal bound to a "
+        "geometry name in runtime/ pins a value the profile can never "
+        "override and the geometry_source stamp never reports. Leave "
+        "the knob None and let the Sweep resolve it, or add the number "
+        "to tune.builtin_geometry / the tune matrix."
+    )
+
+    def applies(self, ctx: FileContext) -> bool:
+        path = ctx.posix_path
+        if f"{PACKAGE}/runtime/" not in path:
+            return False
+        if path.endswith(_SEAM_SUFFIX):
+            return False
+        return not any(path.endswith(g) for g in _GRANDFATHERED)
+
+    def _bindings(self, node: ast.AST):
+        """(name, value, lineno, col) pairs this node binds."""
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    yield t.id, node.value, node.lineno, node.col_offset
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                yield (node.target.id, node.value, node.lineno,
+                       node.col_offset)
+        elif isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    yield (kw.arg, kw.value, kw.value.lineno,
+                           kw.value.col_offset)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = node.args
+            pos = a.posonlyargs + a.args
+            for arg, default in zip(pos[len(pos) - len(a.defaults):],
+                                    a.defaults):
+                yield arg.arg, default, default.lineno, default.col_offset
+            for arg, default in zip(a.kwonlyargs, a.kw_defaults):
+                if default is not None:
+                    yield (arg.arg, default, default.lineno,
+                           default.col_offset)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            for name, value, lineno, col in self._bindings(node):
+                if name in _GEOMETRY_NAMES and _is_int_literal(value):
+                    yield self.finding(
+                        ctx, lineno, col,
+                        f"hardcoded geometry literal for '{name}'; "
+                        "geometry resolves explicit > profile > "
+                        "builtin (runtime/tune.py) — leave it None or "
+                        "move the number into the resolution seam",
+                    )
