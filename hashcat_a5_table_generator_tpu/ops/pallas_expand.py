@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..audit import audited_entry
-from ..runtime.env import env_is, read_env
+from ..runtime.env import env_is, env_warn_once, read_env
 from .hashes import (
     _MD4_G,
     _MD4_H,
@@ -73,12 +73,10 @@ def _grid_height_from_env() -> int:
         if g <= 0:
             raise ValueError("must be positive")
     except ValueError:
-        import sys
-
-        print(
-            f"a5gen: warning: invalid A5GEN_PALLAS_G={raw!r} "
+        env_warn_once(
+            "A5GEN_PALLAS_G", raw,
+            f"invalid A5GEN_PALLAS_G={raw!r} "
             "(want a positive integer); using 8",
-            file=sys.stderr,
         )
         return 8
     return g
@@ -188,13 +186,11 @@ def enabled_by_env() -> bool:
         return True
     if val in ("off", "0", "xla", "none", "1"):
         return False
-    import sys
-
-    print(
-        f"a5gen: warning: unrecognized A5GEN_PALLAS={val!r} "
+    env_warn_once(
+        "A5GEN_PALLAS", val,
+        f"unrecognized A5GEN_PALLAS={val!r} "
         "(want expand|off|0|xla|none|1); keeping the default "
         "(fused kernel on for eligible TPU configs)",
-        file=sys.stderr,
     )
     return True
 
@@ -267,12 +263,11 @@ def opts_for(spec, plan, ct, *, block_stride, num_blocks) -> "int | None":
     if env_is("A5GEN_PALLAS", "expand") and not _on_tpu():
         # An EXPLICIT opt-in deserves a diagnostic when it can't be
         # honored; the default-on (env unset) case falls back silently.
-        import sys
-
-        print(
-            "a5gen: warning: A5GEN_PALLAS=expand but no TPU device; "
+        # Once per process, not per launch — opts_for runs per job.
+        env_warn_once(
+            "A5GEN_PALLAS", "expand",
+            "A5GEN_PALLAS=expand but no TPU device; "
             "using the XLA expand+hash path",
-            file=sys.stderr,
         )
         return None
     return opts_for_config(

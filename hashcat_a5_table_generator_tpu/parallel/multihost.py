@@ -39,7 +39,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops.packing import PackedWords
-from ..runtime.env import read_env
+from ..runtime.env import env_warn_once, read_env
 
 __all__ = [
     "PeerLossError",
@@ -359,12 +359,10 @@ def _dcn_timeout() -> float:
     try:
         return float(raw)
     except ValueError:
-        import sys
-
-        print(
-            f"a5gen: warning: invalid A5GEN_DCN_TIMEOUT={raw!r} "
+        env_warn_once(
+            "A5GEN_DCN_TIMEOUT", raw,
+            f"invalid A5GEN_DCN_TIMEOUT={raw!r} "
             f"(want seconds); using {_DEFAULT_DCN_TIMEOUT:.0f}",
-            file=sys.stderr,
         )
         return _DEFAULT_DCN_TIMEOUT
 

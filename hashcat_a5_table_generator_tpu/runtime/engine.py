@@ -52,13 +52,32 @@ import queue
 import threading
 import time
 from dataclasses import replace
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import (
+    TYPE_CHECKING,
+    Callable,
+    Dict,
+    Generator,
+    IO,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
 
 from . import telemetry
 from . import faults as faults_mod
 from . import protocol
 from .checkpoint import CheckpointState, state_from_doc, state_to_doc
 from .sinks import CandidateWriter, HitRecord
+
+if TYPE_CHECKING:
+    import socket as _socket
+
+    from ..models.attack import AttackSpec
+    from .fuse import FusedGroup
+    from .sweep import Sweep, SweepConfig, SweepResult
 
 
 class JobCancelled(Exception):
@@ -108,7 +127,7 @@ class EngineJob:
         #: CheckpointState, set when the job parks (and on done, for
         #: inspection).
         self.checkpoint: Optional[CheckpointState] = None
-        self.result_value = None
+        self.result_value: "Optional[SweepResult]" = None
         self.error: Optional[BaseException] = None
         #: time-to-first-fetch relative to the machine's start (None
         #: until known) — the warm-vs-cold instrument --serve-ab reads.
@@ -125,7 +144,7 @@ class EngineJob:
 
     # -- tenant surface ------------------------------------------------
 
-    def iter_hits(self):
+    def iter_hits(self) -> "Iterator[HitRecord]":
         """Yield this job's :class:`HitRecord` s as they are fetched
         (bounded queue — a slow consumer backpressures the engine:
         while this job's queue is full, NO tenant advances, so crack
@@ -138,7 +157,7 @@ class EngineJob:
                 continue
             yield item
 
-    def _iter_records(self):
+    def _iter_records(self) -> "Iterator[Union[HitRecord, _CtlEvent]]":
         """``iter_hits`` plus the interleaved :class:`_CtlEvent`
         control notifications, in stream order — the serve front-end's
         pump consumes this to forward engine-side events (``refused``)
@@ -160,7 +179,9 @@ class EngineJob:
         """Wait until the job settles (done/paused/cancelled/failed)."""
         return self._settled.wait(timeout)
 
-    def result(self, timeout: Optional[float] = None):
+    def result(
+        self, timeout: Optional[float] = None
+    ) -> "Optional[SweepResult]":
         """Block for the job's :class:`SweepResult`.  Raises
         :class:`JobCancelled` / :class:`JobFailed` accordingly, and
         ``TimeoutError`` if the job has not settled in time (a PAUSED
@@ -223,7 +244,7 @@ class EngineJob:
             except queue.Full:
                 continue
 
-    def _push_ctl(self, kind: str, **fields) -> None:
+    def _push_ctl(self, kind: str, **fields: object) -> None:
         # Best-effort, never blocking: a control notification is
         # informational (stream correctness never depends on it), so a
         # full queue DROPS it rather than stalling the serve thread
@@ -272,8 +293,9 @@ class _Slot:
     group (static-trace-config) key, and its affinity token (the
     fleet router's placement signal, ``runtime.fuse.affinity_token``)."""
 
-    def __init__(self, job: EngineJob, sweep, machine, group: str,
-                 seq: int, token: str = "") -> None:
+    def __init__(self, job: EngineJob, sweep: "Sweep",
+                 machine: "Generator[None, None, SweepResult]",
+                 group: str, seq: int, token: str = "") -> None:
         self.job = job
         self.sweep = sweep
         self.machine = machine
@@ -299,7 +321,8 @@ class Engine:
     yourself, which is also how the tests make pause/cancel timing
     deterministic."""
 
-    def __init__(self, defaults=None, *, hit_queue_depth: int = 4096,
+    def __init__(self, defaults: "Optional[SweepConfig]" = None, *,
+                 hit_queue_depth: int = 4096,
                  auto: bool = True, pack: Optional[bool] = None,
                  admission_worker: bool = True,
                  faults: "Optional[object]" = None,
@@ -427,12 +450,12 @@ class Engine:
 
     def submit(
         self,
-        spec,
+        spec: "AttackSpec",
         sub_map: Dict[bytes, List[bytes]],
-        words,
+        words: Sequence[bytes],
         digests: Sequence[bytes] = (),
         *,
-        config=None,
+        config: "Optional[SweepConfig]" = None,
         kind: str = "crack",
         writer: Optional[CandidateWriter] = None,
         resume_state: Optional[CheckpointState] = None,
@@ -653,7 +676,7 @@ class Engine:
     def __enter__(self) -> "Engine":
         return self
 
-    def __exit__(self, *exc) -> None:
+    def __exit__(self, *exc: object) -> None:
         self.close(cancel=exc[0] is not None)
 
     # -- scheduler (serve thread) --------------------------------------
@@ -808,13 +831,17 @@ class Engine:
         cfg = a["config"] if a["config"] is not None else self.defaults
         return f"{job.kind}|{self._group_key(a['spec'], cfg)}"
 
-    def _try_build(self, job: EngineJob):
+    def _try_build(
+        self, job: EngineJob
+    ) -> "Tuple[EngineJob, Optional[_Slot], Optional[BaseException]]":
         try:
             return job, self._build_slot(job), None
         except Exception as exc:  # noqa: BLE001 — job-scoped failure
             return job, None, exc
 
-    def _safe_build(self, job: EngineJob):
+    def _safe_build(
+        self, job: EngineJob
+    ) -> "Tuple[EngineJob, Optional[_Slot], Optional[BaseException]]":
         """``_try_build`` with a worker-death net (PERF.md §23): a
         ``BaseException`` escaping the job-scoped ``except Exception``
         (the fault layer's ``WorkerDeath``, a dying thread) must not
@@ -1245,7 +1272,7 @@ class Engine:
         return _Slot(job, sweep, machine, self._group_key(a["spec"], cfg),
                      next(self._ids), affinity_token(a["spec"], cfg))
 
-    def _group_key(self, spec, cfg) -> str:
+    def _group_key(self, spec: "AttackSpec", cfg: "SweepConfig") -> str:
         """Static-trace-config grouping key: jobs agreeing here trace
         the same program shapes (the step cache's own keys add the
         plan-derived statics; this is the scheduler-visible prefix)."""
@@ -1318,7 +1345,7 @@ class Engine:
                     if group in self._fused:
                         self._fused.remove(group)
 
-    def _note_fill(self, group) -> None:
+    def _note_fill(self, group: "FusedGroup") -> None:
         """Post-pump fill instrumentation + the dynamic re-fuse trigger
         (PERF.md §28).  The gauges record on EVERY pump — not just at
         fuse time — so the ``--pack-ab`` fill report sees post-
@@ -1354,7 +1381,7 @@ class Engine:
         ):
             self._start_refuse(group, fill)
 
-    def _start_refuse(self, group, fill: float) -> None:
+    def _start_refuse(self, group: "FusedGroup", fill: float) -> None:
         """Detach a thinned group's survivors at their last consumed
         boundaries (serve thread; each machine's close runs the packed
         drive's park finallys) and hand them to the admission worker
@@ -1396,7 +1423,9 @@ class Engine:
             slot.job._push_ctl("refused", jobs=len(entries), fill=fill)
         self._queue_refuse(entries)
 
-    def _demote_group(self, group, exc: BaseException) -> None:
+    def _demote_group(
+        self, group: "FusedGroup", exc: BaseException
+    ) -> None:
         """The degradation ladder's packed rung (PERF.md §23): a fused
         group whose pump failed parks every member's segment and
         rebuilds each member as a SOLO machine from its own last
@@ -1556,7 +1585,7 @@ class Engine:
         self._drop(slot)
         self._settle_counts(slot.job, state)
 
-    def _finish(self, slot: _Slot, result) -> None:
+    def _finish(self, slot: _Slot, result: "SweepResult") -> None:
         self._drop(slot)
         job = slot.job
         job.result_value = result
@@ -1624,7 +1653,9 @@ _JOB_CONFIG_FIELDS = {
 }
 
 
-def _job_from_doc(doc: dict, defaults, max_word_bytes: int):
+def _job_from_doc(
+    doc: dict, defaults: "SweepConfig", max_word_bytes: int
+) -> dict:
     """Parse one submit document into ``Engine.submit`` arguments."""
     from ..models.attack import AttackSpec
     from ..tables.parser import load_tables
@@ -1714,7 +1745,8 @@ class _JsonlSession:
     settling event on THIS session — the original session's pump is
     gone with its socket."""
 
-    def __init__(self, engine: Engine, fin, fout, *,
+    def __init__(self, engine: Engine, fin: "IO[str]",
+                 fout: "IO[str]", *,
                  max_word_bytes: int = 64 * 1024,
                  jobs: "Optional[Dict[str, EngineJob]]" = None) -> None:
         self._engine = engine
@@ -1942,7 +1974,7 @@ class _JsonlSession:
                 return True
 
 
-def serve_stdio(engine: Engine, fin, fout, *,
+def serve_stdio(engine: Engine, fin: "IO[str]", fout: "IO[str]", *,
                 max_word_bytes: int = 64 * 1024) -> None:
     """Serve one JSONL command stream (``a5gen serve`` over stdin)."""
     _JsonlSession(engine, fin, fout,
@@ -1974,7 +2006,7 @@ def serve_socket(engine: Engine, path: str, *,
     #: one registry for every connection — reconnection = adoption.
     shared_jobs: Dict[str, EngineJob] = {}
 
-    def _watchdog(conn, session: "_JsonlSession",
+    def _watchdog(conn: "_socket.socket", session: "_JsonlSession",
                   done: threading.Event) -> None:
         interval = max(0.05, float(client_timeout) / 4.0)
         while not done.wait(interval):
@@ -2006,7 +2038,7 @@ def serve_socket(engine: Engine, path: str, *,
             except socket.timeout:
                 continue
 
-            def _session(conn=conn) -> None:
+            def _session(conn: "_socket.socket" = conn) -> None:
                 with conn:
                     fin = conn.makefile("r", encoding="utf-8")
                     fout = conn.makefile("w", encoding="utf-8")

@@ -57,6 +57,23 @@ def env_is(name: str, literal: str) -> bool:
 _WARNED: set = set()
 
 
+def env_warn_once(name: str, value: str, message: str) -> None:
+    """One knob diagnostic per (name, spelling) process-wide.
+
+    THE warn-once seam for every knob accessor — in this module and at
+    the bespoke-vocabulary call sites that keep their own parsing
+    (``A5GEN_PALLAS`` in ``ops/pallas_expand.py``,
+    ``A5GEN_DCN_TIMEOUT`` in ``parallel/multihost.py``).  Accessors are
+    called from per-word planning loops and per-superstep drive loops;
+    one typo must produce one diagnostic, not one per iteration."""
+    if (name, value) in _WARNED:
+        return
+    _WARNED.add((name, value))
+    import sys
+
+    print(f"a5gen: warning: {message}", file=sys.stderr)
+
+
 def env_opt_out(name: str, default_desc: str) -> bool:
     """Shared parse for the on-by-default escape hatches
     (``A5GEN_SUPERSTEP``, ``A5GEN_CASCADE_CLOSE``, ``A5GEN_PIPELINE``):
@@ -68,16 +85,11 @@ def env_opt_out(name: str, default_desc: str) -> bool:
     if val.lower() in ("off", "0", "no"):
         return True
     if val.lower() not in ("", "auto", "on", "1"):
-        if (name, val) not in _WARNED:
-            _WARNED.add((name, val))
-            import sys
-
-            print(
-                f"a5gen: warning: unrecognized {name}={val!r} "
-                f"(want off|0|no or on|1|auto); keeping the default "
-                f"({default_desc})",
-                file=sys.stderr,
-            )
+        env_warn_once(
+            name, val,
+            f"unrecognized {name}={val!r} (want off|0|no or "
+            f"on|1|auto); keeping the default ({default_desc})",
+        )
     return False
 
 
@@ -153,17 +165,11 @@ def refuse_threshold() -> "Optional[float]":
         if not 0.0 < r <= 1.0:
             raise ValueError
     except ValueError:
-        name_val = ("A5GEN_REFUSE", val)
-        if name_val not in _WARNED:
-            _WARNED.add(name_val)
-            import sys
-
-            print(
-                f"a5gen: warning: unrecognized A5GEN_REFUSE={val!r} "
-                "(want a fill ratio in (0, 1], or off|0|no); keeping "
-                "the default (0.5)",
-                file=sys.stderr,
-            )
+        env_warn_once(
+            "A5GEN_REFUSE", val,
+            f"unrecognized A5GEN_REFUSE={val!r} (want a fill ratio "
+            "in (0, 1], or off|0|no); keeping the default (0.5)",
+        )
         return 0.5
     return r
 
@@ -202,17 +208,12 @@ def schema_cache_max_mb() -> "Optional[float]":
         if mb <= 0:
             raise ValueError
     except ValueError:
-        name_val = ("A5GEN_SCHEMA_CACHE_MAX_MB", val)
-        if name_val not in _WARNED:
-            _WARNED.add(name_val)
-            import sys
-
-            print(
-                f"a5gen: warning: unrecognized "
-                f"A5GEN_SCHEMA_CACHE_MAX_MB={val!r} (want a positive "
-                "number of megabytes); keeping the cache unbounded",
-                file=sys.stderr,
-            )
+        env_warn_once(
+            "A5GEN_SCHEMA_CACHE_MAX_MB", val,
+            f"unrecognized A5GEN_SCHEMA_CACHE_MAX_MB={val!r} (want a "
+            "positive number of megabytes); keeping the cache "
+            "unbounded",
+        )
         return None
     return mb
 
@@ -239,11 +240,9 @@ def emit_scheme() -> str:
         return "perslot"
     if val == "bytescan":
         return "bytescan"
-    import sys
-
-    print(
-        f"a5gen: warning: unrecognized A5GEN_EMIT={val!r} "
-        "(want perslot|bytescan); keeping the default (perslot)",
-        file=sys.stderr,
+    env_warn_once(
+        "A5GEN_EMIT", val,
+        f"unrecognized A5GEN_EMIT={val!r} (want perslot|bytescan); "
+        "keeping the default (perslot)",
     )
     return "perslot"

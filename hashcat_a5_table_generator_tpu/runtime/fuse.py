@@ -122,6 +122,12 @@ def pack_candidate(sweep, resume_state=None) -> "Optional[dict]":
     cfg = sweep.config
     if sweep._stream is not None or sweep.plan is None:
         return None
+    if cfg.pod is not None:
+        # Pod-striped giant jobs advance the block lattice per stripe;
+        # the fused group's shared step has no stripe advance, so even
+        # equal-pod tenants would replay each other's stripes — refuse
+        # packing outright (graftknob GK003 pins this guard).
+        return None
     plan = sweep.plan
     if getattr(plan, "close_next", None) is not None:
         # Closed plans carry their own per-plan value tables; merging

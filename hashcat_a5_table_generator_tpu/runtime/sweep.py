@@ -41,6 +41,7 @@ from typing import (
     Any,
     Callable,
     Dict,
+    Generator,
     Iterator,
     List,
     Optional,
@@ -1958,7 +1959,7 @@ class Sweep:
         *,
         resume: bool = True,
         state: "Optional[CheckpointState]" = None,
-    ) -> "Iterator[None]":
+    ) -> "Generator[None, None, SweepResult]":
         """The crack sweep as an explicitly resumable state machine
         (PERF.md §20): a generator yielding at every consumed fetch
         boundary (superstep or chunk drain), with its
@@ -2557,7 +2558,7 @@ class Sweep:
         *,
         resume: bool = True,
         state: "Optional[CheckpointState]" = None,
-    ) -> "Iterator[None]":
+    ) -> "Generator[None, None, SweepResult]":
         """Candidates mode in the machine protocol (PERF.md §20): the
         crack machine's twin — yields at every consumed launch batch,
         returns the :class:`SweepResult`; see :meth:`crack_machine`."""

@@ -35,7 +35,21 @@ import json
 import os
 import time
 from dataclasses import replace
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import (
+    TYPE_CHECKING,
+    Any,
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+if TYPE_CHECKING:
+    from ..models.attack import AttackSpec
+    from ..ops.packing import PackedWords
+    from .sweep import SweepConfig
 
 from .checkpoint import atomic_write_text
 from .env import tune_profile_setting
@@ -221,8 +235,10 @@ def builtin_geometry(device_kind: str) -> Dict[str, Any]:
     }
 
 
-def resolve_config(cfg, device_kind: str, *,
-                   directory: Optional[str] = None):
+def resolve_config(
+    cfg: "SweepConfig", device_kind: str, *,
+    directory: Optional[str] = None,
+) -> "Tuple[SweepConfig, str]":
     """Resolve a ``SweepConfig`` whose geometry was left to the runtime
     (``lanes=None`` — the CLI/bench spelling for "no explicit flag").
 
@@ -333,7 +349,9 @@ def default_matrix(
     return arms
 
 
-def _arm_config(arm: Dict[str, Any], base_kw: Dict[str, Any]):
+def _arm_config(
+    arm: Dict[str, Any], base_kw: Dict[str, Any]
+) -> "SweepConfig":
     from .sweep import SweepConfig
 
     return SweepConfig(
@@ -348,10 +366,10 @@ def _arm_config(arm: Dict[str, Any], base_kw: Dict[str, Any]):
 
 
 def measure_arm(
-    spec,
-    sub_map,
-    packed,
-    digests,
+    spec: "AttackSpec",
+    sub_map: Dict[bytes, List[bytes]],
+    packed: "PackedWords",
+    digests: Sequence[bytes],
     arm: Dict[str, Any],
     *,
     seconds: float = 1.0,
@@ -448,7 +466,7 @@ def run_autotune(
     write: bool = True,
     directory: Optional[str] = None,
     device_kind: Optional[str] = None,
-    spec=None,
+    spec: "Optional[AttackSpec]" = None,
     base_kw: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     """Sweep the arm matrix over the production crack contract and

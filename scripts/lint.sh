@@ -4,7 +4,7 @@
 #   scripts/lint.sh            # run everything available
 #   scripts/lint.sh --require-all   # fail if ruff/mypy are missing (CI)
 #
-# Six layers, any failure fails the script:
+# Seven layers, any failure fails the script:
 #   1. ruff      — pyflakes + pycodestyle errors ([tool.ruff] in pyproject)
 #   2. mypy      — typed public API, strict on leaf modules ([tool.mypy])
 #   3. graftlint — repo-specific JAX/Pallas AST rules (tools/graftlint),
@@ -27,12 +27,19 @@
 #                  router↔engine handler matrix, required-field and
 #                  dead-read checks, envelope-key sprawl, and drift vs
 #                  the committed PROTOCOL.json pin — PERF.md §25/§27.
+#   7. graftknob — configuration-knob contract audit (tools/graftknob):
+#                  every env/cli/config/serve-doc/tune-profile surface
+#                  vs the runtime/knobs.py registry, declared roles
+#                  traced to the step-cache / pack / affinity /
+#                  fingerprint key sites, default drift, README
+#                  staleness, and drift vs the committed KNOBS.json
+#                  pin — PERF.md §30.
 #
 # ruff and mypy are OPTIONAL locally (the TPU dev containers bake only the
 # jax toolchain; nothing may be pip-installed there) and mandatory in CI
-# via --require-all. graftlint, graftrace and graftwire are stdlib-only
-# and always run; graftaudit needs jax (always present — the core
-# dependency).
+# via --require-all. graftlint, graftrace, graftwire and graftknob are
+# stdlib-only and always run; graftaudit needs jax (always present —
+# the core dependency).
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -86,6 +93,12 @@ fi
 echo "== graftwire =="
 if ! python -m tools.graftwire; then
     echo "lint.sh: graftwire FAILED" >&2
+    fail=1
+fi
+
+echo "== graftknob =="
+if ! python -m tools.graftknob --check-readme README.md; then
+    echo "lint.sh: graftknob FAILED" >&2
     fail=1
 fi
 

@@ -1,0 +1,10 @@
+"""GK001 broken fixture: an undeclared env read (A5GEN_GAMMA) and a
+dead declaration (nothing here spells A5GEN_BETA)."""
+
+
+def alpha_enabled(read_env):
+    return read_env("A5GEN_ALPHA") == "1"
+
+
+def gamma_enabled(read_env):
+    return read_env("A5GEN_GAMMA") == "1"

@@ -1,0 +1,15 @@
+"""Miniature registry for the GK001 fixture pair: two declared env
+knobs — the fixtures differ in which of them the surface file reads."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "alpha": {
+        "layers": {"env": {"surface": "A5GEN_ALPHA", "default": None}},
+        "roles": ["host-only"],
+    },
+    "beta": {
+        "layers": {"env": {"surface": "A5GEN_BETA", "default": None}},
+        "roles": ["host-only"],
+    },
+}

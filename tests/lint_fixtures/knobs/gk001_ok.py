@@ -1,0 +1,9 @@
+"""GK001 clean twin: every read is declared, every declaration read."""
+
+
+def alpha_enabled(read_env):
+    return read_env("A5GEN_ALPHA") == "1"
+
+
+def beta_enabled(read_env):
+    return read_env("A5GEN_BETA") == "1"

@@ -1,0 +1,8 @@
+"""GK002 broken fixture: the skey tuple never spells 'stride' — two
+jobs differing only on stride would share one compiled program."""
+
+
+class Sweep:
+    def _make_launch(self, plan):
+        skey = (self.lanes, self.num_blocks, plan.kind)
+        return skey

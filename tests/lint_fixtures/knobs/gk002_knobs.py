@@ -1,0 +1,12 @@
+"""Miniature registry for the GK002 fixture pair: one trace-role knob
+whose token must appear in the step-cache key."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "stride": {
+        "layers": {"config": {"surface": "stride", "default": 128}},
+        "roles": ["trace"],
+        "keys": {"trace": "stride"},
+    },
+}

@@ -1,0 +1,7 @@
+"""GK002 clean twin: the trace-role token rides the skey tuple."""
+
+
+class Sweep:
+    def _make_launch(self, plan):
+        skey = (self.lanes, self.num_blocks, self.stride, plan.kind)
+        return skey

@@ -1,0 +1,11 @@
+"""GK003 broken fixture: 'pod' is in neither the compatibility key nor
+any return-None guard — pod-striped jobs could fuse with solo ones
+(the PR 12 bug class)."""
+
+
+def pack_candidate(sweep, resume_state=None):
+    cfg = sweep.config
+    if cfg.stream_chunk_words is not None:
+        return None
+    key = (cfg.lanes, cfg.num_blocks)
+    return {"key": key}

@@ -1,0 +1,12 @@
+"""Miniature registry for the GK003 fixture pair: one fuse-compat-role
+knob that must reach pack_candidate's key tuple or its guards."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "pod": {
+        "layers": {"config": {"surface": "pod", "default": None}},
+        "roles": ["fuse-compat"],
+        "keys": {"fuse-compat": "pod"},
+    },
+}

@@ -1,0 +1,12 @@
+"""GK003 clean twin: the knob gates eligibility (a return-None guard
+counts exactly like key membership)."""
+
+
+def pack_candidate(sweep, resume_state=None):
+    cfg = sweep.config
+    if cfg.stream_chunk_words is not None:
+        return None
+    if cfg.pod is not None:
+        return None
+    key = (cfg.lanes, cfg.num_blocks)
+    return {"key": key}

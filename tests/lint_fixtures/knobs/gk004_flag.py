@@ -1,0 +1,15 @@
+"""GK004 broken fixture: 'devices' never reaches the
+static_affinity_token call, and 'mode' is not a sweep_fingerprint
+parameter."""
+
+
+def static_affinity_token(**fields):
+    return "|".join(f"{k}={v}" for k, v in sorted(fields.items()))
+
+
+def affinity_token(spec, cfg):
+    return static_affinity_token(lanes=cfg.lanes, blocks=cfg.num_blocks)
+
+
+def sweep_fingerprint(algo, words, sub_map):
+    return hash((algo, tuple(words), sub_map))

@@ -1,0 +1,17 @@
+"""Miniature registry for the GK004 fixture pair: one affinity-role
+knob and one fingerprint-role knob."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "devices": {
+        "layers": {"config": {"surface": "devices", "default": 1}},
+        "roles": ["affinity"],
+        "keys": {"affinity": "devices"},
+    },
+    "mode": {
+        "layers": {"config": {"surface": "mode", "default": "default"}},
+        "roles": ["fingerprint"],
+        "keys": {"fingerprint": "mode"},
+    },
+}

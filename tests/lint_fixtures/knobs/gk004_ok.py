@@ -1,0 +1,16 @@
+"""GK004 clean twin: the affinity token routes 'devices' and the
+fingerprint takes 'mode'."""
+
+
+def static_affinity_token(**fields):
+    return "|".join(f"{k}={v}" for k, v in sorted(fields.items()))
+
+
+def affinity_token(spec, cfg):
+    return static_affinity_token(
+        lanes=cfg.lanes, blocks=cfg.num_blocks, devices=cfg.devices
+    )
+
+
+def sweep_fingerprint(mode, algo, words, sub_map):
+    return hash((mode, algo, tuple(words), sub_map))

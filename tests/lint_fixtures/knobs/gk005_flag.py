@@ -1,0 +1,11 @@
+"""GK005 broken fixture: the dataclass default AND the argparse
+default drifted from the declared 131072."""
+
+
+class SweepConfig:
+    lanes: int = 65536
+
+
+def build_parser(parser):
+    parser.add_argument("--lanes", type=int, default=256)
+    return parser

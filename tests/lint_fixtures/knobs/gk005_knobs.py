@@ -1,0 +1,14 @@
+"""Miniature registry for the GK005 fixture pair: one knob with a
+declared default at both the config and the cli layer."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "lanes": {
+        "layers": {
+            "config": {"surface": "lanes", "default": 131072},
+            "cli": {"surface": "--lanes", "default": 131072},
+        },
+        "roles": ["host-only"],
+    },
+}

@@ -1,0 +1,11 @@
+"""GK005 clean twin: both code defaults fold to the declared value
+(1 << 17 folds to 131072)."""
+
+
+class SweepConfig:
+    lanes: int = 1 << 17
+
+
+def build_parser(parser):
+    parser.add_argument("--lanes", type=int, default=131072)
+    return parser

@@ -1,0 +1,15 @@
+"""GK006 broken fixture: a knob was added since gk006_pin.json was
+written (any drift flags; --update-knobs is the re-pin door)."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "alpha": {
+        "layers": {"env": {"surface": "A5GEN_ALPHA", "default": None}},
+        "roles": ["host-only"],
+    },
+    "beta": {
+        "layers": {"env": {"surface": "A5GEN_BETA", "default": None}},
+        "roles": ["host-only"],
+    },
+}

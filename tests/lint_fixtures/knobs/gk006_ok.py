@@ -1,0 +1,10 @@
+"""GK006 clean twin: the registry matches gk006_pin.json exactly."""
+
+KNOBS_VERSION = "1.0"
+
+KNOBS = {
+    "alpha": {
+        "layers": {"env": {"surface": "A5GEN_ALPHA", "default": None}},
+        "roles": ["host-only"],
+    },
+}

@@ -103,7 +103,7 @@ def test_parser_has_stride_ab_and_init_retry_budget():
         import bench
     finally:
         sys.path.pop(0)
-    args = bench.build_parser().parse_args([])
+    args = bench._build_bench_parser().parse_args([])
     assert args.stride_ab is False
     assert args.init_retry_budget == 240.0
 
@@ -116,9 +116,9 @@ def test_parser_has_pipeline_ab():
         import bench
     finally:
         sys.path.pop(0)
-    args = bench.build_parser().parse_args([])
+    args = bench._build_bench_parser().parse_args([])
     assert args.pipeline_ab is False
-    assert bench.build_parser().parse_args(["--pipeline-ab"]).pipeline_ab
+    assert bench._build_bench_parser().parse_args(["--pipeline-ab"]).pipeline_ab
 
 
 import pytest  # noqa: E402

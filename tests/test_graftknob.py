@@ -1,0 +1,301 @@
+"""graftknob (PERF.md §30): the configuration-knob contract audit.
+
+Every GK check must both FLAG its broken fixture and stay quiet on the
+clean twin (``tests/lint_fixtures/knobs/``), the shipped package must
+analyze clean (the lint.sh layer-7 gate as a test, asserted NON-vacuous
+via the extraction floors), the AST-extracted registry must equal the
+imported ``runtime/knobs.py`` module's (the pure-literal contract), and
+the committed ``KNOBS.json`` pin must match the live registry (with the
+``--update-knobs`` bump rule unit-tested).
+
+GK001–GK005 fixtures come in (surface file, registry companion) pairs:
+a file that declares ``KNOBS`` is a registry SOURCE and is skipped for
+surface extraction, so the miniature registry rides in its own
+``gk00N_knobs.py`` alongside the flag/ok twin.  GK006 is registry-vs-
+pin drift, so its fixtures ARE registries, diffed against the fixture's
+own ``gk006_pin.json`` — never the repo's.
+
+Everything here is fast-tier: AST analysis plus a few sub-second CLI
+subprocesses, no engines, no JAX compilation.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+from hashcat_a5_table_generator_tpu.runtime import knobs  # noqa: E402
+from tools.graftknob import (  # noqa: E402
+    ALL_CHECKS,
+    REPO_FLOORS,
+    analyze_paths,
+    repo_floor_errors,
+)
+from tools.graftknob.allowlist import ALLOWLIST  # noqa: E402
+from tools.graftknob.cli import DEFAULT_PATHS  # noqa: E402
+from tools.graftknob.registry import (  # noqa: E402
+    PinChange,
+    check_bump,
+    load_repo_registry,
+    registry_to_pin,
+)
+
+FIXTURE_DIR = pathlib.Path(__file__).resolve().parent / "lint_fixtures" \
+    / "knobs"
+CODES = sorted(ALL_CHECKS)
+RUNTIME_PATHS = [str(REPO_ROOT / p) for p in DEFAULT_PATHS]
+GK006_PIN = str(FIXTURE_DIR / "gk006_pin.json")
+
+
+def _fixture_paths(code, kind):
+    """GK001–GK005 analyze (surface, registry-companion) pairs; GK006's
+    fixtures ARE registries, diffed against the fixture pin."""
+    main = FIXTURE_DIR / f"{code.lower()}_{kind}.py"
+    if code == "GK006":
+        return [str(main)]
+    return [str(main), str(FIXTURE_DIR / f"{code.lower()}_knobs.py")]
+
+
+def _fixture_kwargs(code):
+    if code == "GK006":
+        return {"pin_path": GK006_PIN}
+    return {}
+
+
+# ---------------------------------------------------------------------------
+# Fixture corpus
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_check_flags_its_hazard(code):
+    findings, _model = analyze_paths(
+        _fixture_paths(code, "flag"), select=[code],
+        **_fixture_kwargs(code)
+    )
+    assert findings, f"{code} did not flag its broken fixture"
+    assert all(f.code == code for f in findings)
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_check_passes_the_clean_twin(code):
+    findings, _model = analyze_paths(
+        _fixture_paths(code, "ok"), select=[code],
+        **_fixture_kwargs(code)
+    )
+    assert not findings, (
+        f"{code} false-positived on its clean twin: "
+        + "; ".join(f.render() for f in findings)
+    )
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_fixture_pair_exists(code):
+    for kind in ("flag", "ok"):
+        assert (FIXTURE_DIR / f"{code.lower()}_{kind}.py").is_file()
+    if code != "GK006":
+        assert (FIXTURE_DIR / f"{code.lower()}_knobs.py").is_file()
+
+
+def test_gk001_both_directions():
+    """The GK001 fixture is bidirectional by construction: the flag
+    twin reads an undeclared env var AND leaves a declared knob dead —
+    both findings must surface (one check, two failure modes)."""
+    findings, _ = analyze_paths(
+        _fixture_paths("GK001", "flag"), select=["GK001"]
+    )
+    keys = {f.key for f in findings}
+    assert "env:A5GEN_GAMMA" in keys, "undeclared-read arm went blind"
+    assert any(k.startswith("dead:") for k in keys), \
+        "dead-declaration arm went blind"
+
+
+def test_gk005_flags_both_surfaces():
+    """Default drift is checked per surface: the flag twin drifts the
+    dataclass AND the argparse default, and each gets its own keyed
+    finding (fixing one must not mask the other)."""
+    findings, _ = analyze_paths(
+        _fixture_paths("GK005", "flag"), select=["GK005"]
+    )
+    keys = {f.key for f in findings}
+    assert "default:config:lanes" in keys
+    assert "default:cli:lanes" in keys
+
+
+# ---------------------------------------------------------------------------
+# The repo-clean gate (non-vacuous)
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_is_clean():
+    """The gate scripts/lint.sh layer 7 enforces, as a test: the
+    package + bench.py must analyze clean against the live registry
+    and the committed KNOBS.json."""
+    findings, model = analyze_paths(RUNTIME_PATHS)
+    assert not findings, "\n".join(f.render() for f in findings)
+    # Non-vacuity: the extraction actually saw the knob surfaces and
+    # every role's key site.
+    assert model.registry is not None
+    assert model.registry.path.endswith("knobs.py")
+    assert repo_floor_errors(model) == []
+    assert len(model.registry.knobs) >= REPO_FLOORS["knobs"]
+    assert model.n_env_reads >= REPO_FLOORS["env_reads"]
+    assert model.n_cli_flags >= REPO_FLOORS["cli_flags"]
+    assert model.n_config_fields >= REPO_FLOORS["config_fields"]
+    assert model.n_trace_sites >= REPO_FLOORS["trace_sites"]
+    assert model.n_fuse_key_sites >= 1, "pack_candidate key went blind"
+    assert model.n_fuse_guards >= REPO_FLOORS["fuse_guards"]
+    assert model.n_affinity_sites >= 1, "affinity_token went blind"
+    assert model.n_fingerprint_sites >= 1, \
+        "sweep_fingerprint went blind"
+    assert model.n_serve_fields >= REPO_FLOORS["serve_fields"]
+    assert model.n_profile_knobs >= REPO_FLOORS["profile_knobs"]
+    assert model.builders_found >= REPO_FLOORS["builders"]
+    assert model.pin is not None, "KNOBS.json not loaded"
+    assert model.changes == []
+
+
+def test_registry_extraction_matches_import():
+    """The AST-extracted registry IS the imported module's (the
+    pure-literal contract): drift between the two would mean graftknob
+    audits a phantom knob surface."""
+    reg = load_repo_registry()
+    assert reg.version == knobs.KNOBS_VERSION
+    assert reg.knobs == knobs.KNOBS
+
+
+def test_knobs_pin_matches_live_registry():
+    pin = json.loads((REPO_ROOT / "KNOBS.json").read_text())
+    assert pin == registry_to_pin(load_repo_registry())
+
+
+def test_allowlist_is_live_and_shrink_only():
+    """Every grandfather entry must still match a real finding: once
+    the pattern is fixed, the entry MUST be deleted (shrink-only).
+    The list is empty today — this keeps it honest if it ever grows."""
+    findings, _ = analyze_paths(RUNTIME_PATHS, use_allowlist=False)
+    for (suffix, key), why in ALLOWLIST.items():
+        assert why.strip(), f"allowlist entry {key} needs a reason"
+        assert any(
+            f.path.replace("\\", "/").endswith(suffix) and f.key == key
+            for f in findings
+        ), (
+            f"allowlist entry ({suffix}, {key}) matches no finding — "
+            "the pattern was fixed; delete the entry"
+        )
+
+
+# ---------------------------------------------------------------------------
+# The bump rule (--update-knobs)
+# ---------------------------------------------------------------------------
+
+
+def _add(detail="knob 'probe' added"):
+    return PinChange("addition", "knob", "probe", detail)
+
+
+def _rm(detail="knob 'probe' removed"):
+    return PinChange("removal", "knob", "probe", detail)
+
+
+def _meta(detail="note changed"):
+    return PinChange("metadata", "knob", "lanes", detail)
+
+
+def test_bump_rule():
+    # additions need a minor (or major) bump
+    assert check_bump("1.0", "1.0", [_add()]) is not None
+    assert check_bump("1.0", "1.1", [_add()]) is None
+    assert check_bump("1.0", "2.0", [_add()]) is None
+    # removals/renames need a MAJOR bump — a minor does not satisfy
+    assert check_bump("1.0", "1.1", [_rm()]) is not None
+    assert check_bump("1.0", "2.0", [_rm()]) is None
+    assert check_bump("1.0", "2.0", [_rm(), _add()]) is None
+    # metadata-only re-pins need no bump but cannot move backwards
+    assert check_bump("1.1", "1.1", [_meta()]) is None
+    assert check_bump("1.1", "1.0", [_meta()]) is not None
+    # the version-stamp pseudo-change never drives the rule
+    v = PinChange("metadata", "version", "knobs_version", "1.0 -> 1.1")
+    assert check_bump("1.0", "1.1", [v]) is None
+    # unparseable versions are refused loudly
+    with pytest.raises(ValueError):
+        check_bump("banana", "1.0", [])
+
+
+# ---------------------------------------------------------------------------
+# CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_codes_and_artifacts(tmp_path):
+    """0 clean / 1 findings / 2 usage error through the real CLI, plus
+    the --report/--metrics-json artifact shapes CI uploads."""
+    report = tmp_path / "knobs.md"
+    metrics = tmp_path / "metrics.json"
+    clean = subprocess.run(
+        [sys.executable, "-m", "tools.graftknob",
+         *DEFAULT_PATHS,
+         "--report", str(report), "--metrics-json", str(metrics)],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+    md = report.read_text()
+    assert "configuration-knob contract" in md
+    assert "| knob | surfaces | default | roles | note |" in md
+    payload = json.loads(metrics.read_text())["graftknob"]
+    assert payload["findings"] == 0
+    assert payload["knobs"] >= REPO_FLOORS["knobs"]
+    assert payload["trace_sites"] >= REPO_FLOORS["trace_sites"]
+    assert payload["pin_changes"] == 0
+    usage = subprocess.run(
+        [sys.executable, "-m", "tools.graftknob", "--select", "GK999"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert usage.returncode == 2
+
+
+@pytest.mark.parametrize("code", CODES)
+def test_cli_flags_every_fixture(code):
+    """Each doctored fixture exits 1 through the real CLI with its
+    code in stdout — the acceptance contract, not just the API."""
+    cmd = [sys.executable, "-m", "tools.graftknob",
+           "--select", code, *_fixture_paths(code, "flag")]
+    if code == "GK006":
+        cmd += ["--knobs-json", GK006_PIN]
+    proc = subprocess.run(
+        cmd, cwd=str(REPO_ROOT), capture_output=True, text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert code in proc.stdout
+
+
+def test_readme_knob_section_is_fresh(tmp_path):
+    """The committed README section matches the live registry (the CI
+    staleness gate as a test), and a doctored section actually fails —
+    the check is not vacuous."""
+    fresh = subprocess.run(
+        [sys.executable, "-m", "tools.graftknob",
+         "--select", "GK006", "--check-readme", "README.md"],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert fresh.returncode == 0, fresh.stdout + fresh.stderr
+    stale_md = tmp_path / "README.md"
+    stale_md.write_text(
+        (REPO_ROOT / "README.md").read_text().replace(
+            "| `A5GEN_REFUSE` |", "| `A5GEN_REFUZE` |"
+        )
+    )
+    stale = subprocess.run(
+        [sys.executable, "-m", "tools.graftknob",
+         "--select", "GK006", "--check-readme", str(stale_md)],
+        cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=120,
+    )
+    assert stale.returncode == 1
+    assert "stale" in stale.stderr

@@ -641,3 +641,43 @@ def test_bench_pack_churn_record_shape():
         assert rec[arm]["packed_dispatches"] > 0
         assert rec[arm]["supersteps_served"] > 0
     assert rec["wall_ratio"] > 0
+
+
+class TestPodNeverFuses:
+    """The graftknob GK003 find, regression-pinned: a pod-striped giant
+    job advances the block lattice per stripe, and the fused group's
+    shared step has no stripe advance — even equal-pod tenants would
+    replay each other's stripes.  ``pack_candidate`` refuses pod
+    sweeps outright; a pod job through the pack-enabled engine rides
+    the solo dispatch path byte-identically."""
+
+    def test_pack_candidate_refuses_pod_sweeps(self):
+        from hashcat_a5_table_generator_tpu.runtime.fuse import (
+            pack_candidate,
+        )
+
+        spec = AttackSpec(mode="default", algo="md5")
+        ((words, digests),) = _jobs(spec, 1)
+        solo = Sweep(spec, LEET, words, digests,
+                     config=cfg(superstep=2))
+        assert pack_candidate(solo) is not None
+        pod = Sweep(spec, LEET, words, digests,
+                    config=cfg(superstep=2, pod=(0, 2)))
+        assert pack_candidate(pod) is None
+
+    def test_pod_jobs_demote_to_solo_byte_exact(self):
+        spec = AttackSpec(mode="default", algo="md5")
+        jobs = _jobs(spec, 2)
+        c = cfg(superstep=2, pod=(0, 2))
+        want = _solo(spec, jobs, c)
+        eng = Engine(c, auto=False, pack=True)
+        handles = [eng.submit(spec, LEET, w, d) for w, d in jobs]
+        eng.run_until_idle()
+        stats = eng.stats()
+        got = [h.result(timeout=0) for h in handles]
+        eng.close()
+        assert stats["packed_dispatches"] == 0
+        for g, w in zip(got, want):
+            assert full_hits(g) == full_hits(w)
+            assert g.n_emitted == w.n_emitted
+            assert g.superstep.get("packed") is None

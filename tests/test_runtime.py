@@ -561,3 +561,182 @@ class TestAutoNumBlocks:
     def test_resolve_block_stride_rejects_unresolved_auto(self):
         with pytest.raises(ValueError, match="auto"):
             SweepConfig(lanes=256, num_blocks=None).resolve_block_stride()
+
+
+class TestEnvAccessors:
+    """Every ``runtime/env.py`` accessor: the documented off spelling
+    takes effect, and a typo spelling warns ONCE per process (the
+    ``env_warn_once`` convention) while keeping the default — a typo
+    must never silently change behavior OR spam per-word loops."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_warn_state(self, monkeypatch):
+        from hashcat_a5_table_generator_tpu.runtime import env as env_mod
+
+        monkeypatch.setattr(env_mod, "_WARNED", set())
+
+    def test_read_env_rejects_foreign_names(self):
+        from hashcat_a5_table_generator_tpu.runtime.env import read_env
+
+        with pytest.raises(ValueError, match="A5GEN"):
+            read_env("PATH")
+
+    def test_read_env_grandfathers_a5_native(self, monkeypatch):
+        from hashcat_a5_table_generator_tpu.runtime.env import read_env
+
+        monkeypatch.setenv("A5_NATIVE", "1")
+        assert read_env("A5_NATIVE") == "1"
+
+    def test_env_warn_once_dedupes_by_name_and_value(self, capsys):
+        from hashcat_a5_table_generator_tpu.runtime.env import env_warn_once
+
+        env_warn_once("A5GEN_X", "a", "first spelling")
+        env_warn_once("A5GEN_X", "a", "first spelling")
+        env_warn_once("A5GEN_X", "b", "second spelling")
+        err = capsys.readouterr().err
+        assert err.count("first spelling") == 1
+        assert err.count("second spelling") == 1
+
+    GATES = [
+        ("pipeline_enabled", "A5GEN_PIPELINE"),
+        ("stream_enabled", "A5GEN_STREAM"),
+        ("telemetry_enabled", "A5GEN_TELEMETRY"),
+        ("pack_enabled", "A5GEN_PACK"),
+        ("pair_enabled", "A5GEN_PAIR"),
+    ]
+
+    @pytest.mark.parametrize("accessor,var", GATES)
+    def test_opt_out_gate_off_spellings(self, accessor, var, monkeypatch):
+        from hashcat_a5_table_generator_tpu.runtime import env as env_mod
+
+        fn = getattr(env_mod, accessor)
+        monkeypatch.delenv(var, raising=False)
+        assert fn() is True
+        for spelling in ("off", "0", "no", "OFF"):
+            monkeypatch.setenv(var, spelling)
+            assert fn() is False
+
+    @pytest.mark.parametrize("accessor,var", GATES)
+    def test_opt_out_gate_typo_warns_once_keeps_default(
+        self, accessor, var, monkeypatch, capsys
+    ):
+        from hashcat_a5_table_generator_tpu.runtime import env as env_mod
+
+        fn = getattr(env_mod, accessor)
+        monkeypatch.setenv(var, "offf")
+        assert fn() is True
+        assert fn() is True
+        err = capsys.readouterr().err
+        assert err.count(f"unrecognized {var}='offf'") == 1
+
+    def test_refuse_threshold_arms(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            refuse_threshold,
+        )
+
+        monkeypatch.delenv("A5GEN_REFUSE", raising=False)
+        assert refuse_threshold() == 0.5
+        monkeypatch.setenv("A5GEN_REFUSE", "off")
+        assert refuse_threshold() is None
+        monkeypatch.setenv("A5GEN_REFUSE", "0.25")
+        assert refuse_threshold() == 0.25
+        monkeypatch.setenv("A5GEN_REFUSE", "1.5")  # out of (0, 1]
+        assert refuse_threshold() == 0.5
+        assert refuse_threshold() == 0.5
+        err = capsys.readouterr().err
+        assert err.count("unrecognized A5GEN_REFUSE='1.5'") == 1
+
+    def test_tune_profile_setting_arms(self, monkeypatch):
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            tune_profile_setting,
+        )
+
+        monkeypatch.delenv("A5GEN_TUNE_PROFILE", raising=False)
+        assert tune_profile_setting() == ""
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", "off")
+        assert tune_profile_setting() is None
+        monkeypatch.setenv("A5GEN_TUNE_PROFILE", "/tmp/profiles")
+        assert tune_profile_setting() == "/tmp/profiles"
+
+    def test_schema_cache_dir_arms(self, monkeypatch):
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            schema_cache_dir,
+        )
+
+        monkeypatch.delenv("A5GEN_SCHEMA_CACHE", raising=False)
+        assert schema_cache_dir() is None
+        monkeypatch.setenv("A5GEN_SCHEMA_CACHE", "")
+        assert schema_cache_dir() is None
+        monkeypatch.setenv("A5GEN_SCHEMA_CACHE", "/tmp/sc")
+        assert schema_cache_dir() == "/tmp/sc"
+
+    def test_schema_cache_max_mb_arms(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.runtime.env import (
+            schema_cache_max_mb,
+        )
+
+        monkeypatch.delenv("A5GEN_SCHEMA_CACHE_MAX_MB", raising=False)
+        assert schema_cache_max_mb() is None
+        monkeypatch.setenv("A5GEN_SCHEMA_CACHE_MAX_MB", "64")
+        assert schema_cache_max_mb() == 64.0
+        monkeypatch.setenv("A5GEN_SCHEMA_CACHE_MAX_MB", "-3")
+        assert schema_cache_max_mb() is None
+        assert schema_cache_max_mb() is None
+        err = capsys.readouterr().err
+        assert err.count("unrecognized A5GEN_SCHEMA_CACHE_MAX_MB='-3'") == 1
+
+    def test_faults_spec_arms(self, monkeypatch):
+        from hashcat_a5_table_generator_tpu.runtime.env import faults_spec
+
+        monkeypatch.delenv("A5GEN_FAULTS", raising=False)
+        assert faults_spec() is None
+        monkeypatch.setenv("A5GEN_FAULTS", "")
+        assert faults_spec() is None
+        monkeypatch.setenv("A5GEN_FAULTS", "superstep.dispatch:nth=2")
+        assert faults_spec() == "superstep.dispatch:nth=2"
+
+    def test_emit_scheme_arms_and_warns_once(self, monkeypatch, capsys):
+        # Regression: emit_scheme used to print its typo warning on
+        # EVERY call — and it is called per plan build.
+        from hashcat_a5_table_generator_tpu.runtime.env import emit_scheme
+
+        monkeypatch.delenv("A5GEN_EMIT", raising=False)
+        assert emit_scheme() == "perslot"
+        monkeypatch.setenv("A5GEN_EMIT", "bytescan")
+        assert emit_scheme() == "bytescan"
+        monkeypatch.setenv("A5GEN_EMIT", "byteskan")
+        assert emit_scheme() == "perslot"
+        assert emit_scheme() == "perslot"
+        err = capsys.readouterr().err
+        assert err.count("unrecognized A5GEN_EMIT='byteskan'") == 1
+
+    def test_pallas_gate_typo_warns_once(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            enabled_by_env,
+        )
+
+        monkeypatch.setenv("A5GEN_PALLAS", "offf")
+        assert enabled_by_env() is True
+        assert enabled_by_env() is True
+        err = capsys.readouterr().err
+        assert err.count("unrecognized A5GEN_PALLAS='offf'") == 1
+
+    def test_pallas_grid_height_typo_warns_once(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.ops.pallas_expand import (
+            _grid_height_from_env,
+        )
+
+        monkeypatch.setenv("A5GEN_PALLAS_G", "eight")
+        assert _grid_height_from_env() == 8
+        assert _grid_height_from_env() == 8
+        err = capsys.readouterr().err
+        assert err.count("invalid A5GEN_PALLAS_G='eight'") == 1
+
+    def test_dcn_timeout_typo_warns_once(self, monkeypatch, capsys):
+        from hashcat_a5_table_generator_tpu.parallel import multihost
+
+        monkeypatch.setenv("A5GEN_DCN_TIMEOUT", "soon")
+        assert multihost._dcn_timeout() == multihost._DEFAULT_DCN_TIMEOUT
+        assert multihost._dcn_timeout() == multihost._DEFAULT_DCN_TIMEOUT
+        err = capsys.readouterr().err
+        assert err.count("invalid A5GEN_DCN_TIMEOUT='soon'") == 1

@@ -1,0 +1,297 @@
+"""graftknob — configuration-knob contract static analysis.
+
+The knob tier of the repo's static stack (PERF.md §25–§30): graftlint
+checks single-file AST hazards, graftaudit checks what XLA compiles,
+graftrace checks what the threads do, graftwire checks what goes over
+the wire, and graftknob checks what CONFIGURATION can change — every
+env var, CLI flag, ``SweepConfig`` field, serve-doc config field, and
+tune-profile knob, audited against the single declared registry in
+``runtime/knobs.py`` and the committed ``KNOBS.json`` pin, with each
+declared ROLE mechanically traced to the cache key that must honor it.
+
+Checks:
+
+* **GK001** — knob surface read in the scanned tree but undeclared,
+  or declared but dead
+* **GK002** — ``trace``-role knob missing from the step-cache key
+  (silent cross-job compiled-program reuse)
+* **GK003** — ``fuse-compat``-role knob absent from
+  ``pack_candidate``'s compatibility key and guards (jobs with
+  conflicting policies could fuse — the PR 12 bug class, mechanized)
+* **GK004** — ``affinity``-role knob missing from ``affinity_token``'s
+  scheduler-visible prefix, or ``fingerprint``-role knob missing from
+  ``sweep_fingerprint``
+* **GK005** — default-value drift: registry vs ``SweepConfig``
+  dataclass vs ``argparse`` declarations
+* **GK006** — drift between the live registry and the committed
+  ``KNOBS.json`` pin (re-pin via ``--update-knobs``, which enforces
+  the KNOBS_VERSION bump rule)
+
+Typed public API::
+
+    from tools.graftknob import analyze_paths
+
+    findings, model = analyze_paths(
+        ["hashcat_a5_table_generator_tpu", "bench.py"])
+
+Run as ``python -m tools.graftknob`` (see ``scripts/lint.sh``
+layer 7).  Stdlib-only: the registry is extracted via AST, never
+imported.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from tools.graftlint import iter_python_files
+
+from . import allowlist
+from .checks import check_declared, check_default_drift, \
+    check_fuse_keys, check_pin_drift, check_schedule_keys, \
+    check_trace_keys
+from .extract import FileSurfaces, extract_surfaces
+from .findings import Finding
+from .registry import PIN_REL, PinChange, Registry, REPO_ROOT, \
+    diff_pin, extract_registry, is_registry_source, load_pin, \
+    load_repo_registry
+
+__all__ = [
+    "ALL_CHECKS",
+    "Finding",
+    "KnobModel",
+    "Registry",
+    "analyze_sources",
+    "analyze_paths",
+    "repo_floor_errors",
+]
+
+#: code -> one-line summary (the ``--list-checks`` table).
+ALL_CHECKS: Dict[str, str] = {
+    "GK001": "knob surface read but undeclared, or declared but dead",
+    "GK002": "trace-role knob missing from the step-cache key",
+    "GK003": "fuse-compat-role knob absent from pack_candidate's "
+             "key/guards",
+    "GK004": "affinity-role knob missing from affinity_token, or "
+             "fingerprint-role knob missing from sweep_fingerprint",
+    "GK005": "default drift: registry vs SweepConfig vs argparse",
+    "GK006": "live registry drifted from the committed KNOBS.json pin",
+}
+
+#: The committed pin the repo-default analysis diffs against.
+DEFAULT_PIN_PATH = str(REPO_ROOT / PIN_REL)
+
+
+@dataclass
+class KnobModel:
+    """Everything one analysis extracted (feeds the report)."""
+
+    registry: Optional[Registry]
+    surfaces: List[FileSurfaces] = field(default_factory=list)
+    pin: Optional[Dict[str, object]] = None
+    pin_path: str = ""
+    changes: List[PinChange] = field(default_factory=list)
+
+    @property
+    def n_env_reads(self) -> int:
+        return sum(len(fs.env_reads) for fs in self.surfaces)
+
+    @property
+    def n_cli_flags(self) -> int:
+        return sum(len(fs.cli_flags) for fs in self.surfaces)
+
+    @property
+    def n_config_fields(self) -> int:
+        return sum(len(fs.config_fields) for fs in self.surfaces)
+
+    @property
+    def n_trace_sites(self) -> int:
+        return sum(len(fs.trace_sites) for fs in self.surfaces)
+
+    @property
+    def n_fuse_key_sites(self) -> int:
+        return sum(len(fs.fuse_key_sites) for fs in self.surfaces)
+
+    @property
+    def n_fuse_guards(self) -> int:
+        return sum(len(fs.fuse_guard_sites) for fs in self.surfaces)
+
+    @property
+    def n_affinity_sites(self) -> int:
+        return sum(len(fs.affinity_sites) for fs in self.surfaces)
+
+    @property
+    def n_fingerprint_sites(self) -> int:
+        return sum(len(fs.fingerprint_sites) for fs in self.surfaces)
+
+    @property
+    def n_serve_fields(self) -> int:
+        return sum(len(fs.serve_fields) for fs in self.surfaces)
+
+    @property
+    def n_profile_knobs(self) -> int:
+        return sum(len(fs.profile_knobs) for fs in self.surfaces)
+
+    @property
+    def n_step_env_knobs(self) -> int:
+        return sum(len(fs.step_env_knobs) for fs in self.surfaces)
+
+    @property
+    def builders_found(self) -> int:
+        found = set()
+        for fs in self.surfaces:
+            found |= fs.builders_found
+        return len(found)
+
+
+#: Extraction floors the repo-default run must clear (the non-vacuity
+#: gate: a rename that silently disarms a key-site check trips these
+#: before it can pretend the tree is clean).  Fixture runs pass
+#: explicit paths and are exempt.
+REPO_FLOORS: Dict[str, int] = {
+    "knobs": 40,
+    "env_reads": 15,
+    "cli_flags": 40,
+    "config_fields": 15,
+    "trace_sites": 2,
+    "step_env_knobs": 3,
+    "fuse_key_sites": 1,
+    "fuse_guards": 3,
+    "affinity_sites": 1,
+    "fingerprint_sites": 1,
+    "serve_fields": 10,
+    "profile_knobs": 4,
+    "builders": 4,
+}
+
+
+def repo_floor_errors(model: KnobModel) -> List[str]:
+    """Floor violations of one repo-default analysis (empty = armed)."""
+    reg = model.registry
+    actual: Dict[str, int] = {
+        "knobs": len(reg.knobs) if reg is not None else 0,
+        "env_reads": model.n_env_reads,
+        "cli_flags": model.n_cli_flags,
+        "config_fields": model.n_config_fields,
+        "trace_sites": model.n_trace_sites,
+        "step_env_knobs": model.n_step_env_knobs,
+        "fuse_key_sites": model.n_fuse_key_sites,
+        "fuse_guards": model.n_fuse_guards,
+        "affinity_sites": model.n_affinity_sites,
+        "fingerprint_sites": model.n_fingerprint_sites,
+        "serve_fields": model.n_serve_fields,
+        "profile_knobs": model.n_profile_knobs,
+        "builders": model.builders_found,
+    }
+    errors: List[str] = []
+    for name, floor in REPO_FLOORS.items():
+        if actual[name] < floor:
+            errors.append(
+                f"extraction floor not met: {name}={actual[name]} "
+                f"< {floor} (a rename disarmed the check? fix the "
+                "anchor names in tools/graftknob/extract.py)"
+            )
+    return errors
+
+
+def _selected(select: Optional[Iterable[str]]) -> List[str]:
+    if select is None:
+        return list(ALL_CHECKS)
+    codes = [c for c in select]
+    unknown = [c for c in codes if c not in ALL_CHECKS]
+    if unknown:
+        raise ValueError(
+            f"unknown check code(s): {', '.join(unknown)}"
+        )
+    return codes
+
+
+def analyze_sources(
+    items: Sequence[Tuple[str, str]],
+    *,
+    select: Optional[Iterable[str]] = None,
+    use_allowlist: bool = True,
+    registry: Optional[Registry] = None,
+    pin: Optional[Dict[str, object]] = None,
+    pin_path: Optional[str] = None,
+) -> Tuple[List[Finding], KnobModel]:
+    """Analyze ``(source, path)`` pairs as one program.
+
+    The registry comes from (first match wins) the ``registry``
+    argument, a scanned file that declares ``KNOBS`` (basename
+    ``knobs.py`` preferred — fixtures embed miniature registries), or
+    the shipped ``runtime/knobs.py``.  ``pin``/``pin_path`` feed
+    GK006; with neither, the repo's committed ``KNOBS.json`` is used
+    when present.  Returns ``(findings, model)``; raises
+    ``SyntaxError`` on an unparseable file and ``ValueError`` on an
+    unknown check code or an impure/invalid registry literal."""
+    codes = _selected(select)
+    surfaces: List[FileSurfaces] = []
+    scanned_registries: List[Registry] = []
+    for source, path in items:
+        tree = ast.parse(source, filename=path)
+        source_file = is_registry_source(tree)
+        if source_file:
+            reg = extract_registry(tree, path)
+            if reg is not None:
+                scanned_registries.append(reg)
+        surfaces.append(
+            extract_surfaces(tree, path, registry_source=source_file)
+        )
+    if registry is None and scanned_registries:
+        preferred = [r for r in scanned_registries
+                     if os.path.basename(r.path) == "knobs.py"]
+        registry = (preferred or scanned_registries)[0]
+    if registry is None:
+        registry = load_repo_registry()
+
+    if pin_path is None:
+        pin_path = DEFAULT_PIN_PATH
+    if pin is None and os.path.exists(pin_path):
+        pin = load_pin(pin_path)
+    rel_pin = os.path.basename(pin_path)
+
+    findings: List[Finding] = []
+    if "GK001" in codes:
+        findings.extend(check_declared(surfaces, registry))
+    if "GK002" in codes:
+        findings.extend(check_trace_keys(surfaces, registry))
+    if "GK003" in codes:
+        findings.extend(check_fuse_keys(surfaces, registry))
+    if "GK004" in codes:
+        findings.extend(check_schedule_keys(surfaces, registry))
+    if "GK005" in codes:
+        findings.extend(check_default_drift(surfaces, registry))
+    if "GK006" in codes:
+        findings.extend(check_pin_drift(registry, pin, rel_pin))
+    if use_allowlist:
+        findings, _grandfathered = allowlist.split(findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    model = KnobModel(
+        registry=registry, surfaces=surfaces,
+        pin=pin, pin_path=pin_path,
+        changes=diff_pin(pin, registry) if pin is not None else [],
+    )
+    return findings, model
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    *,
+    select: Optional[Iterable[str]] = None,
+    use_allowlist: bool = True,
+    registry: Optional[Registry] = None,
+    pin: Optional[Dict[str, object]] = None,
+    pin_path: Optional[str] = None,
+) -> Tuple[List[Finding], KnobModel]:
+    """Analyze every ``.py`` file under ``paths`` as one program."""
+    items: List[Tuple[str, str]] = []
+    for file_path in iter_python_files(paths):
+        with open(file_path, "r", encoding="utf-8") as fh:
+            items.append((fh.read(), file_path))
+    return analyze_sources(
+        items, select=select, use_allowlist=use_allowlist,
+        registry=registry, pin=pin, pin_path=pin_path,
+    )

@@ -1,0 +1,323 @@
+"""GK001–GK006: the knob-contract checks.
+
+Each check consumes the extracted surfaces (:mod:`.extract`) and the
+declared registry (:mod:`.registry`) and yields typed findings — no
+printing, no imports of the analyzed package.
+
+Key-site checks (GK002–GK004) run only when their anchor is in the
+analyzed file set (fixtures embed miniature anchors; partial scans
+skip, like graftrace GT004) — the CLI's repo-default gate separately
+asserts that the shipped tree DID surface every anchor, so a rename
+cannot silently disarm them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from .extract import (
+    AFFINITY_CALL, AFFINITY_FUNC, CONFIG_CLASS, FINGERPRINT_FUNC,
+    FUSE_FUNC, FileSurfaces, PROFILE_NAME, SERVE_FIELDS_NAME,
+    STEP_ENV_NAME, TRACE_FUNCS, UNFOLDABLE,
+)
+from .findings import Finding
+from .registry import PinChange, Registry, diff_pin
+
+#: Layers whose dead-surface direction needs a per-layer anchor in the
+#: scanned set before it can run (partial scans skip).
+_REGISTRY_WHERE = "runtime/knobs.py"
+
+
+def _fmt(value: Any) -> str:
+    return repr(value)
+
+
+def check_declared(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GK001: a knob surface read in the scanned tree but never
+    declared — or declared but dead (nothing reads it).  Undeclared
+    knobs dodge every role check; dead declarations rot the registry
+    the way stale docs rot a README."""
+    env_map = reg.surfaces_of("env")
+    cli_map = reg.surfaces_of("cli")
+    config_map = reg.surfaces_of("config")
+    serve_map = reg.surfaces_of("serve-doc")
+    profile_map = reg.surfaces_of("tune-profile")
+
+    seen_env: Set[str] = set()
+    seen_cli: Set[str] = set()
+    seen_config: Set[str] = set()
+    seen_serve: Set[str] = set()
+    seen_profile: Set[str] = set()
+    any_env = any_cli = any_config = any_serve = any_profile = False
+
+    for fs in surfaces:
+        for er in fs.env_reads:
+            any_env = True
+            seen_env.add(er.name)
+            if er.name not in env_map:
+                yield Finding(
+                    fs.path, er.line, er.col, "GK001",
+                    f"env knob {er.name!r} is read here but not "
+                    "declared in runtime/knobs.py (declare it, role "
+                    "it, then re-pin via --update-knobs)",
+                    key=f"env:{er.name}",
+                )
+        for cf in fs.config_fields:
+            any_config = True
+            seen_config.add(cf.name)
+            if cf.name not in config_map:
+                yield Finding(
+                    fs.path, cf.line, cf.col, "GK001",
+                    f"{CONFIG_CLASS} field {cf.name!r} is not declared "
+                    "as a config-layer knob in runtime/knobs.py",
+                    key=f"config:{cf.name}",
+                )
+        for fl in fs.cli_flags:
+            any_cli = True
+            seen_cli.update(fl.flags)
+            if not any(f in cli_map for f in fl.flags):
+                yield Finding(
+                    fs.path, fl.line, fl.col, "GK001",
+                    f"CLI flag {fl.flags[0]!r} ({fl.builder}) is not "
+                    "declared as a cli-layer knob in runtime/knobs.py",
+                    key=f"cli:{fl.flags[0]}",
+                )
+        for sf in fs.serve_fields:
+            any_serve = True
+            seen_serve.add(sf.name)
+            if sf.name not in serve_map:
+                yield Finding(
+                    fs.path, sf.line, sf.col, "GK001",
+                    f"{SERVE_FIELDS_NAME} field {sf.name!r} is not "
+                    "declared as a serve-doc-layer knob in "
+                    "runtime/knobs.py",
+                    key=f"serve-doc:{sf.name}",
+                )
+        for pk in fs.profile_knobs:
+            any_profile = True
+            seen_profile.add(pk.name)
+            if pk.name not in profile_map:
+                yield Finding(
+                    fs.path, pk.line, pk.col, "GK001",
+                    f"{PROFILE_NAME} entry {pk.name!r} is not declared "
+                    "as a tune-profile-layer knob in runtime/knobs.py",
+                    key=f"tune-profile:{pk.name}",
+                )
+
+    dead_legs: List[Tuple[bool, Dict[str, str], Set[str], str]] = [
+        (any_env, env_map, seen_env, "env"),
+        (any_cli, cli_map, seen_cli, "cli"),
+        (any_config, config_map, seen_config, "config"),
+        (any_serve, serve_map, seen_serve, "serve-doc"),
+        (any_profile, profile_map, seen_profile, "tune-profile"),
+    ]
+    for anchored, decl_map, seen, layer in dead_legs:
+        if not anchored:
+            continue  # partial file set: this layer is not on screen
+        for surface in sorted(set(decl_map) - seen):
+            knob = decl_map[surface]
+            if reg.knobs[knob].get("scope") == "tests":
+                continue  # documented test-suite knobs never read here
+            yield Finding(
+                reg.path or _REGISTRY_WHERE, 1, 0, "GK001",
+                f"knob {knob!r} declares {layer} surface {surface!r} "
+                "but nothing in the scanned tree spells it (dead "
+                "declaration — drop the layer or fix the reader)",
+                key=f"dead:{layer}:{surface}",
+            )
+
+
+def _union_tokens(sites: Sequence[Any]) -> Set[str]:
+    out: Set[str] = set()
+    for site in sites:
+        out |= site.tokens
+    return out
+
+
+def _first_site(
+    surfaces: Sequence[FileSurfaces], attr: str
+) -> Optional[Tuple[str, int]]:
+    for fs in surfaces:
+        sites = getattr(fs, attr)
+        if sites:
+            return fs.path, sites[0].line
+    return None
+
+
+def check_trace_keys(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GK002: a ``trace``-role knob whose token never appears in the
+    step-cache key (the ``skey`` tuples of ``_make_launch`` /
+    ``_superstep_static``, or the ``_STEP_ENV_KNOBS`` suffix) — two
+    jobs differing only on that knob would silently reuse one
+    compiled program."""
+    trace_sites = [s for fs in surfaces for s in fs.trace_sites]
+    step_env = {er.name for fs in surfaces
+                for er in fs.step_env_knobs}
+    if not trace_sites and not step_env:
+        return  # partial file set: no step-cache key on screen
+    tokens = _union_tokens(trace_sites) | step_env
+    where = _first_site(surfaces, "trace_sites")
+    path, line = where if where else (reg.path, 1)
+    for knob in reg.role_knobs("trace"):
+        token = reg.role_token(knob, "trace")
+        if token not in tokens:
+            yield Finding(
+                path, line, 0, "GK002",
+                f"trace-role knob {knob!r}: token {token!r} is in "
+                f"neither {'/'.join(TRACE_FUNCS)}'s skey nor "
+                f"{STEP_ENV_NAME} — cross-job compiled-program reuse "
+                "would ignore it (add it to the key, or fix the "
+                "registry's keys.trace token)",
+                key=f"trace:{knob}",
+            )
+
+
+def check_fuse_keys(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GK003: a ``fuse-compat``-role knob absent from
+    ``pack_candidate``'s compatibility key AND from its eligibility
+    guards — jobs with conflicting policies could fuse into one packed
+    group (the PR 12 bug class, mechanized)."""
+    key_sites = [s for fs in surfaces for s in fs.fuse_key_sites]
+    guard_sites = [s for fs in surfaces for s in fs.fuse_guard_sites]
+    if not key_sites and not guard_sites:
+        return  # partial file set: pack_candidate not on screen
+    tokens = _union_tokens(key_sites) | _union_tokens(guard_sites)
+    where = _first_site(surfaces, "fuse_key_sites")
+    path, line = where if where else (reg.path, 1)
+    for knob in reg.role_knobs("fuse-compat"):
+        token = reg.role_token(knob, "fuse-compat")
+        if token not in tokens:
+            yield Finding(
+                path, line, 0, "GK003",
+                f"fuse-compat-role knob {knob!r}: token {token!r} is "
+                f"in neither {FUSE_FUNC}'s key tuple nor its "
+                "return-None guards — jobs disagreeing on it could "
+                "fuse (add it to the key, gate eligibility, or fix "
+                "the registry's keys.fuse-compat token)",
+                key=f"fuse:{knob}",
+            )
+
+
+def check_schedule_keys(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GK004: an ``affinity``-role knob missing from
+    ``affinity_token``'s scheduler-visible prefix (the router would
+    place jobs where nothing can be reused), or a ``fingerprint``-role
+    knob missing from ``sweep_fingerprint``'s parameters (checkpoints
+    could resume across semantically different sweeps)."""
+    affinity_sites = [s for fs in surfaces for s in fs.affinity_sites]
+    if affinity_sites:
+        tokens = _union_tokens(affinity_sites)
+        where = _first_site(surfaces, "affinity_sites")
+        path, line = where if where else (reg.path, 1)
+        for knob in reg.role_knobs("affinity"):
+            token = reg.role_token(knob, "affinity")
+            if token not in tokens:
+                yield Finding(
+                    path, line, 0, "GK004",
+                    f"affinity-role knob {knob!r}: token {token!r} "
+                    f"never reaches the {AFFINITY_CALL} call in "
+                    f"{AFFINITY_FUNC} — the router would place "
+                    "compatible jobs apart (route it, or fix the "
+                    "registry's keys.affinity token)",
+                    key=f"affinity:{knob}",
+                )
+    fp_sites = [s for fs in surfaces for s in fs.fingerprint_sites]
+    if fp_sites:
+        tokens = _union_tokens(fp_sites)
+        where = _first_site(surfaces, "fingerprint_sites")
+        path, line = where if where else (reg.path, 1)
+        for knob in reg.role_knobs("fingerprint"):
+            token = reg.role_token(knob, "fingerprint")
+            if token not in tokens:
+                yield Finding(
+                    path, line, 0, "GK004",
+                    f"fingerprint-role knob {knob!r}: {token!r} is "
+                    f"not a parameter of {FINGERPRINT_FUNC} — resume "
+                    "identity would ignore it (thread it through, or "
+                    "fix the registry's keys.fingerprint token)",
+                    key=f"fingerprint:{knob}",
+                )
+
+
+def check_default_drift(
+    surfaces: Sequence[FileSurfaces], reg: Registry
+) -> Iterator[Finding]:
+    """GK005: the declared default drifted from the code — the
+    ``SweepConfig`` dataclass default or an ``add_argument`` default
+    disagrees with the registry row.  (The README row cannot drift: it
+    is rendered FROM the registry and staleness-gated by
+    ``--check-readme``.)"""
+    config_map = reg.surfaces_of("config")
+    cli_map = reg.surfaces_of("cli")
+    for fs in surfaces:
+        for cf in fs.config_fields:
+            knob = config_map.get(cf.name)
+            if knob is None:
+                continue  # GK001's problem
+            declared, value = reg.declared_default(knob, "config")
+            if not declared:
+                continue
+            if cf.default == UNFOLDABLE or value != cf.default:
+                yield Finding(
+                    fs.path, cf.line, cf.col, "GK005",
+                    f"config default drift for knob {knob!r}: "
+                    f"{CONFIG_CLASS}.{cf.name} defaults to "
+                    f"{_fmt(cf.default)} but runtime/knobs.py declares "
+                    f"{_fmt(value)}",
+                    key=f"default:config:{knob}",
+                )
+        for fl in fs.cli_flags:
+            knob = next(
+                (cli_map[f] for f in fl.flags if f in cli_map), None)
+            if knob is None:
+                continue  # GK001's problem
+            declared, value = reg.declared_default(knob, "cli")
+            if not declared:
+                continue
+            if fl.default == UNFOLDABLE or value != fl.default:
+                yield Finding(
+                    fs.path, fl.line, fl.col, "GK005",
+                    f"cli default drift for knob {knob!r}: "
+                    f"{fl.flags[0]} ({fl.builder}) defaults to "
+                    f"{_fmt(fl.default)} but runtime/knobs.py declares "
+                    f"{_fmt(value)}",
+                    key=f"default:cli:{knob}",
+                )
+
+
+def check_pin_drift(
+    reg: Registry,
+    pin: Optional[Dict[str, Any]],
+    pin_path: str,
+) -> Iterator[Finding]:
+    """GK006: drift between the live registry and the committed
+    KNOBS.json pin — either direction fails (the PROTOCOL.json
+    discipline).  Deliberate changes re-pin via ``python -m
+    tools.graftknob --update-knobs``, which also enforces the version
+    bump rule."""
+    where = reg.path or pin_path
+    if pin is None:
+        yield Finding(
+            where, 1, 0, "GK006",
+            f"no knob pin at {pin_path} — bootstrap it with "
+            "python -m tools.graftknob --update-knobs",
+            key="pin:missing",
+        )
+        return
+    changes: List[PinChange] = diff_pin(pin, reg)
+    for ch in changes:
+        yield Finding(
+            where, 1, 0, "GK006",
+            f"registry drifted from {pin_path}: {ch.detail} "
+            "(deliberate? re-pin via --update-knobs, which enforces "
+            "the KNOBS_VERSION bump rule)",
+            key=f"pin:{ch.kind}:{ch.name}",
+        )

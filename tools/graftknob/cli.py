@@ -1,0 +1,272 @@
+"""graftknob command line: ``python -m tools.graftknob [paths...]``.
+
+Exit codes: 0 clean, 1 findings (or a stale README section), 2
+usage/parse error or an unmet extraction floor — the contract
+``scripts/lint.sh`` and CI key on (same as the other graft tiers).
+
+The repo-default run (no explicit paths) additionally asserts the
+extraction floors in :data:`tools.graftknob.REPO_FLOORS`: the gate is
+non-vacuous BY CONSTRUCTION — if a refactor renames ``pack_candidate``
+or ``skey`` out from under the extractor, the floors trip (exit 2)
+instead of the checks silently passing over nothing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import ALL_CHECKS, DEFAULT_PIN_PATH, analyze_paths, \
+    repo_floor_errors
+from .registry import check_bump, diff_pin, write_pin
+from .report import drift_table, extract_readme_section, metrics, \
+    render_section, replace_readme_section, to_markdown
+
+#: What ``python -m tools.graftknob`` scans with no arguments: the
+#: whole package (env reads live in ops/, native/, parallel/ too)
+#: plus bench.py.  tools/ and tests/ stay out — the tiers' own
+#: extraction strings and the suites' monkeypatched env vars are not
+#: knob reads.
+DEFAULT_PATHS = (
+    "hashcat_a5_table_generator_tpu",
+    "bench.py",
+)
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="graftknob",
+        description=(
+            "Configuration-knob contract audit (env/cli/config/"
+            "serve-doc/tune-profile surfaces and the trace/fuse/"
+            "affinity/fingerprint key sites vs the declared "
+            "runtime/knobs.py registry and the KNOBS.json pin)."
+        ),
+    )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=list(DEFAULT_PATHS),
+        help="files or directories to analyze "
+             f"(default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--select",
+        metavar="CODES",
+        help="comma-separated check codes to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-checks",
+        action="store_true",
+        help="print the check table and exit",
+    )
+    parser.add_argument(
+        "--knobs-json",
+        metavar="PATH",
+        default=DEFAULT_PIN_PATH,
+        help="the committed knob pin GK006 diffs against "
+             "(default: KNOBS.json at the repo root)",
+    )
+    parser.add_argument(
+        "--update-knobs",
+        action="store_true",
+        help="re-pin KNOBS.json from the live registry (enforces the "
+             "KNOBS_VERSION bump rule: additions need a minor bump, "
+             "removals/renames a major), then analyze",
+    )
+    parser.add_argument(
+        "--report",
+        metavar="PATH",
+        help="write the knob markdown report to PATH ('-' for stdout)",
+    )
+    parser.add_argument(
+        "--check-readme",
+        metavar="PATH",
+        help="fail (exit 1) when PATH's marker-delimited knob section "
+             "is stale vs the live registry",
+    )
+    parser.add_argument(
+        "--update-readme",
+        metavar="PATH",
+        help="rewrite PATH's marker-delimited knob section from the "
+             "live registry",
+    )
+    parser.add_argument(
+        "--summary",
+        metavar="PATH",
+        help="append the knob report + drift table + finding counts "
+             "to PATH (CI: pass \"$GITHUB_STEP_SUMMARY\")",
+    )
+    parser.add_argument(
+        "--metrics-json",
+        metavar="PATH",
+        help="write run metrics (knob/surface/key-site/finding "
+             "counts) as JSON to PATH; CI uploads it as a job "
+             "artifact",
+    )
+    parser.add_argument(
+        "--no-allowlist",
+        action="store_true",
+        help="surface grandfathered findings (the shrink-only list in "
+             "tools/graftknob/allowlist.py)",
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _build_parser().parse_args(argv)
+    if args.list_checks:
+        for code, summary in ALL_CHECKS.items():
+            print(f"{code}  {summary}")
+        return 0
+    select: Optional[List[str]] = None
+    if args.select:
+        select = [c.strip() for c in args.select.split(",") if c.strip()]
+    repo_gate = args.paths == list(DEFAULT_PATHS)
+    t0 = time.monotonic()
+    try:
+        findings, model = analyze_paths(
+            args.paths,
+            select=select,
+            use_allowlist=not args.no_allowlist,
+            pin_path=args.knobs_json,
+        )
+    except ValueError as exc:
+        print(f"graftknob: error: {exc}", file=sys.stderr)
+        return 2
+    except SyntaxError as exc:
+        print(f"graftknob: parse error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.update_knobs:
+        reg = model.registry
+        if reg is None:
+            print("graftknob: error: no registry to pin",
+                  file=sys.stderr)
+            return 2
+        if model.pin is not None:
+            changes = diff_pin(model.pin, reg)
+            err = check_bump(
+                str(model.pin.get("knobs_version", "0.0")),
+                reg.version, changes,
+            )
+            if err is not None:
+                print(f"graftknob: --update-knobs refused: {err}",
+                      file=sys.stderr)
+                return 2
+        write_pin(args.knobs_json, reg)
+        print(f"graftknob: pinned knobs {reg.version} -> "
+              f"{args.knobs_json}")
+        # the fresh pin supersedes the pre-update drift findings
+        try:
+            findings, model = analyze_paths(
+                args.paths,
+                select=select,
+                use_allowlist=not args.no_allowlist,
+                pin_path=args.knobs_json,
+            )
+        except (ValueError, SyntaxError) as exc:
+            print(f"graftknob: error: {exc}", file=sys.stderr)
+            return 2
+    elapsed = time.monotonic() - t0
+
+    if repo_gate:
+        floor_errors = repo_floor_errors(model)
+        if floor_errors:
+            for err in floor_errors:
+                print(f"graftknob: error: {err}", file=sys.stderr)
+            return 2
+
+    readme_stale = False
+    if args.update_readme or args.check_readme:
+        reg = model.registry
+        if reg is None:
+            print("graftknob: error: no registry for the README "
+                  "section", file=sys.stderr)
+            return 2
+        section = render_section(reg)
+        readme_path = args.update_readme or args.check_readme
+        with open(readme_path, "r", encoding="utf-8") as fh:
+            text = fh.read()
+        if args.update_readme:
+            try:
+                updated = replace_readme_section(text, section)
+            except ValueError as exc:
+                print(f"graftknob: error: {exc}", file=sys.stderr)
+                return 2
+            with open(readme_path, "w", encoding="utf-8") as fh:
+                fh.write(updated)
+            print(f"graftknob: wrote knob section -> {readme_path}")
+        else:
+            current = extract_readme_section(text)
+            if current is None or current.strip() != section.strip():
+                readme_stale = True
+                print(
+                    f"graftknob: {readme_path} knob section is stale "
+                    "— refresh with python -m tools.graftknob "
+                    f"--update-readme {readme_path}",
+                    file=sys.stderr,
+                )
+
+    report_md = to_markdown(model.registry, model.changes)
+    if args.report == "-":
+        print(report_md, end="")
+    elif args.report:
+        with open(args.report, "w", encoding="utf-8") as fh:
+            fh.write(report_md)
+    if args.summary:
+        with open(args.summary, "a", encoding="utf-8") as fh:
+            fh.write(report_md)
+            fh.write(drift_table(model.changes))
+            fh.write(
+                f"\n**graftknob**: {len(findings)} finding(s) over "
+                f"{model.n_env_reads} env reads / "
+                f"{model.n_cli_flags} cli flags / "
+                f"{model.n_config_fields} config fields in "
+                f"{elapsed:.2f}s\n"
+            )
+            for f in findings:
+                fh.write(f"- `{f.render()}`\n")
+    if args.metrics_json:
+        counts: Dict[str, float] = {
+            "findings": len(findings), "elapsed_s": elapsed,
+            "env_reads": model.n_env_reads,
+            "cli_flags": model.n_cli_flags,
+            "config_fields": model.n_config_fields,
+            "serve_fields": model.n_serve_fields,
+            "profile_knobs": model.n_profile_knobs,
+            "trace_sites": model.n_trace_sites,
+            "fuse_key_sites": model.n_fuse_key_sites,
+            "fuse_guards": model.n_fuse_guards,
+            "affinity_sites": model.n_affinity_sites,
+            "fingerprint_sites": model.n_fingerprint_sites,
+            "pin_changes": len(model.changes),
+        }
+        for code in ALL_CHECKS:
+            counts[f"findings_{code.lower()}"] = sum(
+                1 for f in findings if f.code == code
+            )
+        payload = metrics(model.registry, counts)
+        with open(args.metrics_json, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    try:
+        for finding in findings:
+            print(finding.render())
+    except BrokenPipeError:  # piped into head; keep the exit contract
+        devnull = os.open(os.devnull, os.O_WRONLY)
+        os.dup2(devnull, sys.stdout.fileno())
+    if findings or readme_stale:
+        n = len(findings) + (1 if readme_stale else 0)
+        print(f"graftknob: {n} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,437 @@
+"""AST extraction of the knob surfaces graftknob audits.
+
+Five layer surfaces plus four key sites, extracted per file with no
+imports (bare-checkout CI):
+
+* **Env reads** — every string constant that spells an env knob name
+  (``A5GEN_*`` / the grandfathered ``A5_NATIVE``): accessor first
+  arguments, ``_STEP_ENV_KNOBS``-style tuples, ``os.environ``
+  subscripts.  GK001 audits these against the registry's env layer.
+* **Config fields** — ``SweepConfig``'s annotated fields with
+  const-folded defaults (``1 << 17`` folds to ``131072``).  GK001 +
+  GK005.
+* **CLI flags** — every ``add_argument`` call inside the four parser
+  builder functions, with argparse's effective default normalized
+  (``store_true`` without ``default=`` -> ``False``; absent ->
+  ``None``).  GK001 + GK005.
+* **Serve-doc fields** — the keys of ``_JOB_CONFIG_FIELDS`` (the
+  submit-doc ``config`` sub-object; doc-level spec fields are
+  graftwire's domain).  GK001.
+* **Tune-profile knobs** — the ``PROFILE_KNOBS`` tuple.  GK001.
+
+Key sites (the tokens GK002–GK004 trace declared roles to):
+
+* **Trace keys** — every assignment to ``skey`` inside
+  ``Sweep._make_launch`` / ``Sweep._superstep_static``, plus the
+  ``_STEP_ENV_KNOBS`` env suffix ``Sweep._get_step`` appends.
+* **Fuse key** — ``pack_candidate``'s ``key`` tuple PLUS the tokens of
+  every early ``return None`` guard there (a knob may satisfy
+  fuse-compat either by joining the key or by gating eligibility).
+* **Affinity call** — the ``static_affinity_token(...)`` call inside
+  ``affinity_token``: keyword names and value tokens.
+* **Fingerprint params** — ``sweep_fingerprint``'s parameter names.
+
+Tokens of an expression are every ``Name`` id, ``Attribute`` attr, and
+string-constant value appearing anywhere inside it — deliberately
+coarse: the contract is "the key spells this token somewhere", which
+survives refactors of HOW the value reaches the tuple while still
+failing loudly when it stops being spelled at all.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Set, Tuple
+
+#: Env-knob name pattern (mirrors ``env.read_env``'s naming contract;
+#: kept literal here so graftknob never imports the runtime).
+ENV_NAME_RE = re.compile(r"^(?:A5GEN_[A-Z0-9_]+|A5_NATIVE)$")
+
+#: Functions whose ``skey`` assignments form the step-cache key.
+TRACE_FUNCS = ("_make_launch", "_superstep_static")
+#: The env suffix ``_get_step`` appends to every step-cache key.
+STEP_ENV_NAME = "_STEP_ENV_KNOBS"
+#: The packed-dispatch admission function and its key variable.
+FUSE_FUNC = "pack_candidate"
+FUSE_KEY_NAME = "key"
+TRACE_KEY_NAME = "skey"
+#: The scheduler-prefix seam: ``affinity_token`` must route every
+#: affinity-role knob into this call.
+AFFINITY_FUNC = "affinity_token"
+AFFINITY_CALL = "static_affinity_token"
+#: The resume-identity function whose params GK004 checks.
+FINGERPRINT_FUNC = "sweep_fingerprint"
+#: The config dataclass and the serve-doc/profile literal anchors.
+CONFIG_CLASS = "SweepConfig"
+SERVE_FIELDS_NAME = "_JOB_CONFIG_FIELDS"
+PROFILE_NAME = "PROFILE_KNOBS"
+#: The four argparse builder functions whose flags ARE the cli layer.
+PARSER_BUILDERS = (
+    "build_parser", "_build_serve_parser", "_build_fleet_parser",
+    "_build_tune_parser",
+)
+
+#: Sentinel for a default the const-folder cannot evaluate.
+UNFOLDABLE = "<unfoldable>"
+
+
+@dataclass(frozen=True)
+class EnvRead:
+    """One spelled env-knob name."""
+
+    path: str
+    line: int
+    col: int
+    name: str
+
+
+@dataclass(frozen=True)
+class ConfigField:
+    """One annotated ``SweepConfig`` field."""
+
+    path: str
+    line: int
+    col: int
+    name: str
+    default: Any                    # folded literal or UNFOLDABLE
+
+
+@dataclass(frozen=True)
+class CliFlag:
+    """One ``add_argument`` call inside a parser builder."""
+
+    path: str
+    line: int
+    col: int
+    flags: Tuple[str, ...]
+    default: Any                    # argparse-effective, folded
+    builder: str
+
+
+@dataclass(frozen=True)
+class SurfaceName:
+    """One serve-doc field or tune-profile knob name."""
+
+    path: str
+    line: int
+    col: int
+    name: str
+
+
+@dataclass(frozen=True)
+class KeySite:
+    """One key expression (tokens collected, coarse)."""
+
+    path: str
+    line: int
+    col: int
+    func: str
+    tokens: FrozenSet[str]
+
+
+@dataclass
+class FileSurfaces:
+    """Everything extracted from one file."""
+
+    path: str
+    env_reads: List[EnvRead] = field(default_factory=list)
+    config_fields: List[ConfigField] = field(default_factory=list)
+    cli_flags: List[CliFlag] = field(default_factory=list)
+    serve_fields: List[SurfaceName] = field(default_factory=list)
+    profile_knobs: List[SurfaceName] = field(default_factory=list)
+    trace_sites: List[KeySite] = field(default_factory=list)
+    step_env_knobs: List[EnvRead] = field(default_factory=list)
+    fuse_key_sites: List[KeySite] = field(default_factory=list)
+    fuse_guard_sites: List[KeySite] = field(default_factory=list)
+    affinity_sites: List[KeySite] = field(default_factory=list)
+    fingerprint_sites: List[KeySite] = field(default_factory=list)
+    builders_found: Set[str] = field(default_factory=set)
+    has_config_class: bool = False
+    has_serve_fields: bool = False
+    has_profile_knobs: bool = False
+
+
+def _const_str(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _call_name(node: ast.expr) -> Optional[str]:
+    """The trailing name of ``f(...)`` / ``mod.f(...)``, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def fold_const(node: Optional[ast.expr]) -> Any:
+    """Const-fold a default expression; :data:`UNFOLDABLE` otherwise.
+
+    Handles the shapes the repo actually writes: plain constants,
+    unary minus, ``1 << 17`` / ``64 * 1024`` arithmetic, and literal
+    tuples/lists of foldable elements."""
+    if node is None:
+        return UNFOLDABLE
+    if isinstance(node, ast.Constant):
+        return node.value
+    if (isinstance(node, ast.UnaryOp)
+            and isinstance(node.op, ast.USub)):
+        v = fold_const(node.operand)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            return -v
+        return UNFOLDABLE
+    if isinstance(node, ast.BinOp):
+        left, right = fold_const(node.left), fold_const(node.right)
+        ok = all(
+            isinstance(v, (int, float)) and not isinstance(v, bool)
+            for v in (left, right)
+        )
+        if not ok:
+            return UNFOLDABLE
+        if isinstance(node.op, ast.Add):
+            return left + right
+        if isinstance(node.op, ast.Sub):
+            return left - right
+        if isinstance(node.op, ast.Mult):
+            return left * right
+        if (isinstance(node.op, ast.LShift)
+                and isinstance(left, int) and isinstance(right, int)):
+            return left << right
+        return UNFOLDABLE
+    if isinstance(node, (ast.Tuple, ast.List)):
+        elts = [fold_const(e) for e in node.elts]
+        if UNFOLDABLE in elts:
+            return UNFOLDABLE
+        return list(elts) if isinstance(node, ast.List) else tuple(elts)
+    return UNFOLDABLE
+
+
+def expr_tokens(node: ast.expr) -> FrozenSet[str]:
+    """Every Name id, Attribute attr, and str constant inside."""
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+        elif (isinstance(sub, ast.Constant)
+                and isinstance(sub.value, str)):
+            out.add(sub.value)
+    return frozenset(out)
+
+
+def _is_return_none(stmt: ast.stmt) -> bool:
+    return isinstance(stmt, ast.Return) and (
+        stmt.value is None
+        or (isinstance(stmt.value, ast.Constant)
+            and stmt.value.value is None)
+    )
+
+
+def _assign_names(node: ast.stmt) -> List[ast.Name]:
+    if isinstance(node, ast.Assign):
+        return [t for t in node.targets if isinstance(t, ast.Name)]
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        if isinstance(node.target, ast.Name):
+            return [node.target]
+    return []
+
+
+def _assign_value(node: ast.stmt) -> Optional[ast.expr]:
+    if isinstance(node, (ast.Assign, ast.AugAssign)):
+        return node.value
+    if isinstance(node, ast.AnnAssign):
+        return node.value
+    return None
+
+
+class _Extractor(ast.NodeVisitor):
+    def __init__(self, path: str) -> None:
+        self.out = FileSurfaces(path)
+        self._path = path
+        self._func_stack: List[str] = []
+
+    # -- env names (any string constant spelling one) -------------------
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if (isinstance(node.value, str)
+                and ENV_NAME_RE.fullmatch(node.value)):
+            self.out.env_reads.append(EnvRead(
+                self._path, node.lineno, node.col_offset, node.value,
+            ))
+
+    # -- config fields ---------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        if node.name == CONFIG_CLASS:
+            self.out.has_config_class = True
+            for stmt in node.body:
+                if (isinstance(stmt, ast.AnnAssign)
+                        and isinstance(stmt.target, ast.Name)):
+                    self.out.config_fields.append(ConfigField(
+                        self._path, stmt.lineno, stmt.col_offset,
+                        stmt.target.id, fold_const(stmt.value),
+                    ))
+        self.generic_visit(node)
+
+    # -- functions: builders, key sites, fingerprint ---------------------
+
+    def _visit_function(self, node: ast.FunctionDef) -> None:
+        if node.name == FINGERPRINT_FUNC:
+            args = node.args
+            params = [a.arg for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs)
+            )]
+            self.out.fingerprint_sites.append(KeySite(
+                self._path, node.lineno, node.col_offset,
+                node.name, frozenset(params),
+            ))
+        if node.name in PARSER_BUILDERS:
+            self.out.builders_found.add(node.name)
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and _call_name(sub) == "add_argument"):
+                    self._add_argument(sub, node.name)
+        if node.name == FUSE_FUNC:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.If) and any(
+                    _is_return_none(s) for s in sub.body
+                ):
+                    self.out.fuse_guard_sites.append(KeySite(
+                        self._path, sub.lineno, sub.col_offset,
+                        node.name, expr_tokens(sub.test),
+                    ))
+        self._func_stack.append(node.name)
+        self.generic_visit(node)
+        self._func_stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function  # type: ignore[assignment]
+
+    def _add_argument(self, node: ast.Call, builder: str) -> None:
+        flags = tuple(
+            s for s in (_const_str(a) for a in node.args)
+            if s is not None
+        )
+        if not flags:
+            return
+        default: Any = None
+        action: Optional[str] = None
+        has_default = False
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default = fold_const(kw.value)
+                has_default = True
+            elif kw.arg == "action":
+                action = _const_str(kw.value)
+        if not has_default:
+            if action == "store_true":
+                default = False
+            elif action == "store_false":
+                default = True
+        self.out.cli_flags.append(CliFlag(
+            self._path, node.lineno, node.col_offset,
+            flags, default, builder,
+        ))
+
+    # -- key sites & literal anchors -------------------------------------
+
+    def _handle_assign(self, node: ast.stmt) -> None:
+        names = _assign_names(node)
+        value = _assign_value(node)
+        if value is None or not names:
+            return
+        in_trace = any(f in TRACE_FUNCS for f in self._func_stack)
+        in_fuse = FUSE_FUNC in self._func_stack
+        for t in names:
+            if t.id == TRACE_KEY_NAME and in_trace:
+                fn = next(f for f in reversed(self._func_stack)
+                          if f in TRACE_FUNCS)
+                self.out.trace_sites.append(KeySite(
+                    self._path, node.lineno, node.col_offset,
+                    fn, expr_tokens(value),
+                ))
+            if t.id == FUSE_KEY_NAME and in_fuse:
+                self.out.fuse_key_sites.append(KeySite(
+                    self._path, node.lineno, node.col_offset,
+                    FUSE_FUNC, expr_tokens(value),
+                ))
+            if t.id == STEP_ENV_NAME and not self._func_stack:
+                folded = fold_const(value)
+                if isinstance(folded, (tuple, list)):
+                    for name in folded:
+                        if isinstance(name, str):
+                            self.out.step_env_knobs.append(EnvRead(
+                                self._path, node.lineno,
+                                node.col_offset, name,
+                            ))
+            if t.id == SERVE_FIELDS_NAME and not self._func_stack:
+                if isinstance(value, ast.Dict):
+                    self.out.has_serve_fields = True
+                    for key in value.keys:
+                        k = _const_str(key) if key is not None else None
+                        if k is not None:
+                            self.out.serve_fields.append(SurfaceName(
+                                self._path, key.lineno,
+                                key.col_offset, k,
+                            ))
+            if t.id == PROFILE_NAME and not self._func_stack:
+                folded = fold_const(value)
+                if isinstance(folded, (tuple, list)):
+                    self.out.has_profile_knobs = True
+                    for name in folded:
+                        if isinstance(name, str):
+                            self.out.profile_knobs.append(SurfaceName(
+                                self._path, node.lineno,
+                                node.col_offset, name,
+                            ))
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._handle_assign(node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._handle_assign(node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._handle_assign(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if (_call_name(node) == AFFINITY_CALL
+                and AFFINITY_FUNC in self._func_stack):
+            tokens: Set[str] = set()
+            for kw in node.keywords:
+                if kw.arg is not None:
+                    tokens.add(kw.arg)
+                tokens |= expr_tokens(kw.value)
+            for a in node.args:
+                tokens |= expr_tokens(a)
+            self.out.affinity_sites.append(KeySite(
+                self._path, node.lineno, node.col_offset,
+                AFFINITY_FUNC, frozenset(tokens),
+            ))
+        self.generic_visit(node)
+
+
+def extract_surfaces(
+    tree: ast.Module, path: str, *, registry_source: bool
+) -> FileSurfaces:
+    """Extract every audited surface from one parsed module.
+
+    The registry module itself is skipped (its surface SPELLINGS are
+    declarations, not reads)."""
+    if registry_source:
+        return FileSurfaces(path)
+    ex = _Extractor(path)
+    ex.visit(tree)
+    return ex.out

@@ -1,0 +1,34 @@
+"""Finding type — graftknob's typed output surface.
+
+Same contract as graftlint/graftaudit/graftrace/graftwire's:
+everything the CLI prints and the tests assert on is a
+:class:`Finding`; checks produce them and never print, so one check
+implementation drives the CLI, the fixtures, and CI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One knob-contract violation at a source location.
+
+    ``path`` is the path the file was analyzed AS (fixture tests feed
+    snippets under virtual paths); ``line``/``col`` are 1-based line
+    and 0-based column, matching ``ast`` node coordinates.  ``key`` is
+    the stable allowlist key (``env:<NAME>`` / ``cli:<flag>`` /
+    ``trace:<knob>`` / ``pin:<kind>:<name>`` …) — the grandfather list
+    matches on it, never on line numbers."""
+
+    path: str
+    line: int
+    col: int
+    code: str
+    message: str
+    key: str = ""
+
+    def render(self) -> str:
+        """``path:line:col: CODE message`` — the CLI output line."""
+        return f"{self.path}:{self.line}:{self.col}: {self.code} {self.message}"

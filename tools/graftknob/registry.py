@@ -1,0 +1,330 @@
+"""The declared knob registry, extracted — never imported.
+
+graftknob reads ``runtime/knobs.py`` the same way graftwire reads
+``runtime/protocol.py``: via AST.  The registry literals
+(``KNOBS_VERSION``, ``KNOBS``) are pure by contract, so
+``ast.literal_eval`` recovers exactly what the runtime declares
+without executing (or even being able to import) the package — the CI
+job runs on a bare checkout with no JAX.
+
+The same module owns the KNOBS.json pin discipline (the PROTOCOL.json
+pattern): :func:`diff_pin` classifies every change as an addition, a
+removal/rename, or metadata, and :func:`check_bump` enforces the
+version rule — additions need a minor ``KNOBS_VERSION`` bump,
+removals/renames a major one.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+#: Module-level names that make a scanned file a registry source.
+REGISTRY_NAMES = ("KNOBS_VERSION", "KNOBS")
+
+#: The five knob layers and six roles (mirrors ``runtime/knobs.py``;
+#: kept literal here so graftknob never imports the runtime — the
+#: registry's own LAYERS/ROLES tuples are validated against these).
+LAYERS = ("env", "cli", "config", "serve-doc", "tune-profile")
+ROLES = ("trace", "fuse-compat", "affinity", "fingerprint",
+         "stream-semantics", "host-only")
+
+#: Where the shipped registry and its pin live, relative to the repo
+#: root (``tools/graftknob/registry.py`` -> two parents up).
+REPO_ROOT = Path(__file__).resolve().parents[2]
+REGISTRY_REL = "hashcat_a5_table_generator_tpu/runtime/knobs.py"
+PIN_REL = "KNOBS.json"
+
+
+@dataclass
+class Registry:
+    """The extracted knob contract (pure data, JSON-serializable)."""
+
+    version: str
+    knobs: Dict[str, Dict[str, Any]]
+    path: str = ""
+
+    def surfaces_of(self, layer: str) -> Dict[str, str]:
+        """``surface spelling -> knob name`` for one layer."""
+        out: Dict[str, str] = {}
+        for name, spec in self.knobs.items():
+            ldecl = spec.get("layers", {}).get(layer)
+            if ldecl is None:
+                continue
+            surface = ldecl.get("surface", name)
+            spellings = (
+                surface if isinstance(surface, (list, tuple))
+                else [surface]
+            )
+            for s in spellings:
+                out[str(s)] = name
+        return out
+
+    def declared_default(
+        self, name: str, layer: str
+    ) -> Tuple[bool, Any]:
+        """``(declared?, value)`` of one knob's default at one layer."""
+        ldecl = self.knobs.get(name, {}).get("layers", {}).get(layer)
+        if ldecl is None or "default" not in ldecl:
+            return False, None
+        return True, ldecl["default"]
+
+    def role_token(self, name: str, role: str) -> str:
+        """The key-site token witnessing ``name`` for ``role``."""
+        spec = self.knobs.get(name, {})
+        return str(spec.get("keys", {}).get(role, name))
+
+    def role_knobs(self, role: str) -> List[str]:
+        """Knob names carrying ``role``, registry order."""
+        return [n for n, spec in self.knobs.items()
+                if role in spec.get("roles", ())]
+
+
+def _validate(reg: Registry) -> None:
+    for name, spec in reg.knobs.items():
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"{reg.path}: knob {name!r} entry is not a dict")
+        layers = spec.get("layers", {})
+        if not isinstance(layers, dict) or not layers:
+            raise ValueError(
+                f"{reg.path}: knob {name!r} declares no layers")
+        for layer in layers:
+            if layer not in LAYERS:
+                raise ValueError(
+                    f"{reg.path}: knob {name!r} has unknown layer "
+                    f"{layer!r} (want one of {', '.join(LAYERS)})")
+        roles = spec.get("roles", ())
+        if not roles:
+            raise ValueError(
+                f"{reg.path}: knob {name!r} declares no roles")
+        for role in roles:
+            if role not in ROLES:
+                raise ValueError(
+                    f"{reg.path}: knob {name!r} has unknown role "
+                    f"{role!r} (want one of {', '.join(ROLES)})")
+
+
+def is_registry_source(tree: ast.Module) -> bool:
+    """Whether a module declares the registry (defines ``KNOBS``)."""
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        if any(
+            isinstance(t, ast.Name) and t.id == "KNOBS"
+            for t in targets
+        ):
+            return True
+    return False
+
+
+def extract_registry(tree: ast.Module, path: str) -> Optional[Registry]:
+    """Literal-eval the registry assignments out of one module.
+
+    Returns None when the module declares no registry; raises
+    :class:`ValueError` when it declares one that is not a pure
+    literal or violates the layer/role vocabulary (the module contract
+    graftknob exists to keep honest)."""
+    found: Dict[str, Any] = {}
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in REGISTRY_NAMES:
+                try:
+                    found[t.id] = ast.literal_eval(value)
+                except (ValueError, TypeError) as exc:
+                    raise ValueError(
+                        f"{path}: registry literal {t.id} is not pure "
+                        f"(ast.literal_eval failed: {exc})"
+                    ) from None
+    if "KNOBS" not in found:
+        return None
+    reg = Registry(
+        version=str(found.get("KNOBS_VERSION", "0.0")),
+        knobs=found["KNOBS"],
+        path=path,
+    )
+    _validate(reg)
+    return reg
+
+
+def load_repo_registry() -> Registry:
+    """Parse the shipped ``runtime/knobs.py`` (AST only)."""
+    path = REPO_ROOT / REGISTRY_REL
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    reg = extract_registry(tree, str(path))
+    if reg is None:
+        raise ValueError(f"{path}: no knob registry declared")
+    return reg
+
+
+# ---------------------------------------------------------------------------
+# The KNOBS.json pin
+# ---------------------------------------------------------------------------
+
+
+def registry_to_pin(reg: Registry) -> Dict[str, Any]:
+    """The JSON document ``--update-knobs`` writes and GK006 diffs."""
+    return {
+        "knobs_version": reg.version,
+        "knobs": reg.knobs,
+    }
+
+
+def load_pin(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        pin = json.load(fh)
+    if not isinstance(pin, dict):
+        raise ValueError(f"{path}: pin must be a JSON object")
+    return pin
+
+
+@dataclass(frozen=True)
+class PinChange:
+    """One classified difference between the pin and the live registry.
+
+    ``severity`` drives the bump rule: ``addition`` (new knob, layer,
+    or role) needs a minor bump, ``removal`` (dropped or renamed — a
+    rename IS a removal plus an addition) a major one, ``metadata``
+    (defaults, key tokens, precedence, notes, scope) any re-pin."""
+
+    severity: str  # "addition" | "removal" | "metadata"
+    kind: str      # "knob" | "layer" | "role" | "version"
+    name: str
+    detail: str
+
+
+def _diff_layers(
+    name: str,
+    pinned: Dict[str, Any],
+    live: Dict[str, Any],
+) -> List[PinChange]:
+    changes: List[PinChange] = []
+    for layer in sorted(set(pinned) - set(live)):
+        changes.append(PinChange(
+            "removal", "layer", f"{name}:{layer}",
+            f"knob {name!r} layer {layer!r} removed"))
+    for layer in sorted(set(live) - set(pinned)):
+        changes.append(PinChange(
+            "addition", "layer", f"{name}:{layer}",
+            f"knob {name!r} layer {layer!r} added"))
+    for layer in sorted(set(pinned) & set(live)):
+        old, new = pinned[layer], live[layer]
+        if old.get("surface") != new.get("surface"):
+            changes.append(PinChange(
+                "removal", "layer", f"{name}:{layer}",
+                f"knob {name!r} {layer} surface renamed: "
+                f"{old.get('surface')!r} -> {new.get('surface')!r}"))
+        if old.get("default") != new.get("default") or (
+            ("default" in old) != ("default" in new)
+        ):
+            changes.append(PinChange(
+                "metadata", "layer", f"{name}:{layer}",
+                f"knob {name!r} {layer} default changed: "
+                f"{old.get('default')!r} -> {new.get('default')!r}"))
+    return changes
+
+
+def diff_pin(pin: Dict[str, Any], reg: Registry) -> List[PinChange]:
+    """Every difference between the committed pin and the live
+    registry, classified for the bump rule.  Empty means in sync."""
+    changes: List[PinChange] = []
+    pinned: Dict[str, Any] = pin.get("knobs", {})
+    live = reg.knobs
+    for name in sorted(set(pinned) - set(live)):
+        changes.append(PinChange(
+            "removal", "knob", name, f"knob {name!r} removed"))
+    for name in sorted(set(live) - set(pinned)):
+        changes.append(PinChange(
+            "addition", "knob", name, f"knob {name!r} added"))
+    for name in sorted(set(pinned) & set(live)):
+        old, new = pinned[name], live[name]
+        old_roles = list(old.get("roles", ()))
+        new_roles = list(new.get("roles", ()))
+        for r in [x for x in old_roles if x not in new_roles]:
+            changes.append(PinChange(
+                "removal", "role", f"{name}:{r}",
+                f"knob {name!r} role {r!r} removed"))
+        for r in [x for x in new_roles if x not in old_roles]:
+            changes.append(PinChange(
+                "addition", "role", f"{name}:{r}",
+                f"knob {name!r} role {r!r} added"))
+        changes.extend(_diff_layers(
+            name, old.get("layers", {}), new.get("layers", {})))
+        for mk in ("keys", "precedence", "note", "scope"):
+            if old.get(mk) != new.get(mk):
+                changes.append(PinChange(
+                    "metadata", "knob", name,
+                    f"knob {name!r} {mk} changed: "
+                    f"{old.get(mk)!r} -> {new.get(mk)!r}"))
+    old_v = str(pin.get("knobs_version", "0.0"))
+    if old_v != reg.version:
+        changes.append(PinChange(
+            "metadata", "version", "knobs_version",
+            f"KNOBS_VERSION {old_v!r} -> {reg.version!r}"))
+    return changes
+
+
+def _parse_version(v: str) -> Tuple[int, int]:
+    parts = v.split(".")
+    try:
+        return int(parts[0]), int(parts[1]) if len(parts) > 1 else 0
+    except (ValueError, IndexError):
+        raise ValueError(
+            f"unparseable KNOBS_VERSION {v!r} (want MAJOR.MINOR)"
+        ) from None
+
+
+def check_bump(
+    old_version: str,
+    new_version: str,
+    changes: List[PinChange],
+) -> Optional[str]:
+    """The ``--update-knobs`` version rule; None when satisfied.
+
+    * any ``removal`` change -> the major must increase;
+    * else any ``addition``  -> the minor (or major) must increase;
+    * metadata-only          -> any version >= the pinned one."""
+    old = _parse_version(old_version)
+    new = _parse_version(new_version)
+    severities = {c.severity for c in changes
+                  if c.kind != "version"}
+    if "removal" in severities:
+        if new[0] <= old[0]:
+            return (
+                f"removals/renames need a MAJOR KNOBS_VERSION bump "
+                f"(pinned {old_version}, live {new_version})"
+            )
+        return None
+    if "addition" in severities:
+        if new > old:
+            return None
+        return (
+            f"additions need a MINOR KNOBS_VERSION bump "
+            f"(pinned {old_version}, live {new_version})"
+        )
+    if new < old:
+        return (
+            f"KNOBS_VERSION cannot move backwards "
+            f"(pinned {old_version}, live {new_version})"
+        )
+    return None
+
+
+def write_pin(path: str, reg: Registry) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(registry_to_pin(reg), fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,146 @@
+"""The knob report: registry table, pin drift, metrics.
+
+``--report`` renders the knob section (also embedded in README between
+the markers below and kept fresh by ``--check-readme`` in CI);
+``--summary`` appends it plus the drift table to the CI job summary;
+``--metrics-json`` emits the counters CI uploads as an artifact.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from .registry import PinChange, Registry
+
+#: README markers delimiting the rendered section (the region
+#: ``--update-readme`` rewrites and ``--check-readme`` verifies).
+BEGIN_MARK = "<!-- graftknob:knobs:begin -->"
+END_MARK = "<!-- graftknob:knobs:end -->"
+
+
+def _surface_cell(spec: Dict[str, Any]) -> str:
+    parts: List[str] = []
+    for layer in ("env", "cli", "config", "serve-doc", "tune-profile"):
+        ldecl = spec.get("layers", {}).get(layer)
+        if ldecl is None:
+            continue
+        surface = ldecl.get("surface", "")
+        spellings = (
+            surface if isinstance(surface, (list, tuple))
+            else [surface]
+        )
+        joined = " ".join(f"`{s}`" for s in spellings)
+        parts.append(f"{layer} {joined}")
+    return "; ".join(parts) if parts else "—"
+
+
+def _default_cell(spec: Dict[str, Any]) -> str:
+    for layer in ("config", "cli", "env"):
+        ldecl = spec.get("layers", {}).get(layer)
+        if ldecl is not None and "default" in ldecl:
+            return f"`{ldecl['default']!r}`"
+    return "—"
+
+
+def _roles_cell(spec: Dict[str, Any]) -> str:
+    roles = spec.get("roles", ())
+    return ", ".join(f"`{r}`" for r in roles) if roles else "—"
+
+
+def knob_table(reg: Registry) -> str:
+    """The one table of every declared knob."""
+    lines: List[str] = []
+    lines.append(
+        f"Knob registry **{reg.version}** — declared in "
+        "`runtime/knobs.py`, pinned in `KNOBS.json` (changes re-pin "
+        "via `python -m tools.graftknob --update-knobs`: additions "
+        "bump the minor, removals/renames the major).  Roles are "
+        "mechanically enforced: `trace` knobs must join the step-cache "
+        "key, `fuse-compat` knobs the `pack_candidate` compatibility "
+        "key, `affinity` knobs the scheduler token, `fingerprint` "
+        "knobs the resume identity."
+    )
+    lines.append("")
+    lines.append("| knob | surfaces | default | roles | note |")
+    lines.append("|------|----------|---------|-------|------|")
+    for name in sorted(reg.knobs):
+        spec = reg.knobs[name]
+        cell = f"`{name}`"
+        if spec.get("scope") == "tests":
+            cell += " (tests)"
+        lines.append(
+            f"| {cell} | {_surface_cell(spec)} "
+            f"| {_default_cell(spec)} | {_roles_cell(spec)} "
+            f"| {spec.get('note', '—')} |"
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_section(reg: Registry) -> str:
+    """The marker-delimited README region (heading included)."""
+    return (
+        f"{BEGIN_MARK}\n"
+        "### Configuration knobs\n\n"
+        f"{knob_table(reg)}"
+        f"{END_MARK}\n"
+    )
+
+
+def drift_table(changes: Sequence[PinChange]) -> str:
+    """The pin-drift table CI publishes to the job summary."""
+    if not changes:
+        return ("\n**KNOBS.json**: in sync with the live "
+                "registry.\n")
+    lines = ["", "**KNOBS.json drift** (GK006):", "",
+             "| severity | change |", "|----------|--------|"]
+    for ch in changes:
+        lines.append(f"| {ch.severity} | {ch.detail} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def to_markdown(
+    reg: Optional[Registry],
+    changes: Sequence[PinChange] = (),
+) -> str:
+    """The full ``--report`` document."""
+    if reg is None:
+        return "# graftknob\n\nNo knob registry in the analyzed set.\n"
+    return (
+        "# graftknob — configuration-knob contract\n\n"
+        + knob_table(reg)
+        + drift_table(changes)
+    )
+
+
+def extract_readme_section(text: str) -> Optional[str]:
+    """The marker-delimited region of a README, markers included."""
+    start = text.find(BEGIN_MARK)
+    end = text.find(END_MARK)
+    if start < 0 or end < 0 or end < start:
+        return None
+    return text[start:end + len(END_MARK)] + "\n"
+
+
+def replace_readme_section(text: str, section: str) -> str:
+    """README text with the marker region replaced by ``section``."""
+    start = text.find(BEGIN_MARK)
+    end = text.find(END_MARK)
+    if start < 0 or end < 0 or end < start:
+        raise ValueError(
+            f"README has no {BEGIN_MARK} .. {END_MARK} region"
+        )
+    return text[:start] + section.rstrip("\n") + text[end + len(END_MARK):]
+
+
+def metrics(
+    reg: Optional[Registry],
+    counts: Dict[str, float],
+) -> Dict[str, Any]:
+    """The ``graftknob-metrics.json`` payload."""
+    payload: Dict[str, Any] = dict(counts)
+    if reg is not None:
+        payload["knobs_version"] = reg.version
+        payload["knobs"] = len(reg.knobs)
+    return {"graftknob": payload}
