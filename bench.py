@@ -68,7 +68,8 @@ def save_tpu_last(record: dict) -> None:
     entry = {
         k: record[k]
         for k in ("metric", "value", "unit", "lanes", "blocks", "arm",
-                  "kernel", "platform", "device_kind", "mode", "table")
+                  "kernel", "platform", "device_kind", "mode", "table",
+                  "partial_matrix")
         if k in record
     }
     entry["timestamp"] = time.strftime(
@@ -140,8 +141,20 @@ def compare_last_tpu(value: "float | None" = None) -> None:
     committed last-good on-chip record and the 1e10/chip north star,
     instead of manual JSON diffing."""
     last = load_tpu_last()
+    if last is not None and last.get("partial_matrix"):
+        # A partial autotune matrix is a checkpoint, not a measurement
+        # of the best geometry — comparing against it inflates every
+        # later run's verdict.  Skip it and say so.
+        print(
+            "# compare: last TPU record is a PARTIAL autotune matrix "
+            f"({last.get('timestamp', '?')}) — skipped as baseline; "
+            "rerun --autotune to completion for a comparable record",
+            file=sys.stderr,
+        )
+        last = None
     if last is None:
-        print("# compare: no BENCH_TPU_LAST.json on disk", file=sys.stderr)
+        print("# compare: no usable BENCH_TPU_LAST.json on disk",
+              file=sys.stderr)
     else:
         lv = float(last.get("value", 0.0))
         print(
@@ -367,6 +380,32 @@ def _build_bench_parser() -> argparse.ArgumentParser:
                     help="--pack-churn: fill threshold for the "
                          "re-fuse arm (default 0.8 — half the tenants "
                          "cancelling always crosses it)")
+    ap.add_argument("--split-ab", action="store_true",
+                    help="measure giant-job striping (PERF.md §31): ONE "
+                         "oversized crack job scattered across "
+                         "--split-engines spawned engines as disjoint "
+                         "rank-stride shard ranges (merged back into "
+                         "one ordered client stream) vs the identical "
+                         "job on one engine — merged-stream parity "
+                         "asserted tuple-for-tuple in-bench, per-arm "
+                         "wall, speedup, and the router merge "
+                         "overhead share — one JSON line. Spawns "
+                         "engine subprocesses; no jax in this process")
+    ap.add_argument("--split-engines", type=int, default=2,
+                    help="--split-ab: engines the split arm scatters "
+                         "over (default 2 — the N the §31 acceptance "
+                         "criterion is stated at)")
+    ap.add_argument("--churn-cross", action="store_true",
+                    help="measure cross-group vs within-group re-fuse "
+                         "(PERF.md §31): two fused groups on one "
+                         "packed Engine each lose one of two members "
+                         "mid-flight; the cross scope merges the lone "
+                         "survivors into one full group, the within "
+                         "scope leaves them solo at the post-"
+                         "departure fill floor — per-arm fill "
+                         "recovery + refuse_cross counters, survivor "
+                         "parity vs solo runs — one JSON line. "
+                         "Geometry rules follow --pack-churn")
     ap.add_argument("--pair-ab", action="store_true",
                     help="measure the pair-lane tier (K=2 candidates "
                          "per hash lane, PERF.md §24) against K=1 on "
@@ -1508,6 +1547,186 @@ def run_fleet_ab(args: argparse.Namespace,
     sys.stdout.flush()
 
 
+def run_split_ab(args: argparse.Namespace) -> None:
+    """A/B giant-job striping (PERF.md §31) on the fleet contract: ONE
+    oversized crack job submitted to a :class:`FleetRouter` backed by
+    ``--split-engines`` spawned engines with striping ON — the router
+    scatters it as disjoint rank-stride shard ranges and k-way-merges
+    the per-shard hit streams back into one (word,rank)-ordered client
+    stream — vs the IDENTICAL job on one engine with striping OFF.
+    Both arms warm with one untimed identical job so the measured
+    window is sweep throughput, not compile.  Parity-asserts the
+    merged hit stream against the solo arm's tuple-for-tuple (content
+    AND order — the merge's whole contract) plus the done totals, and
+    reports per-arm wall, the speedup, and the router-side merge
+    overhead as a share of the split arm's wall (the §31 acceptance
+    instruments).  Runs NO jax in this process — both arms' device
+    work happens in the engine subprocesses."""
+    import hashlib as _hashlib
+    import os
+    import shutil
+    import tempfile
+
+    import hashcat_a5_table_generator_tpu.runtime.fleet as fleet_mod
+    from hashcat_a5_table_generator_tpu.oracle.engines import (
+        iter_candidates,
+    )
+    from hashcat_a5_table_generator_tpu.runtime.fleet import (
+        FleetRouter,
+        spawn_engines,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    n_engines = max(2, int(args.split_engines))
+    words = synth_wordlist(args.words)
+    sub_map = get_layout(args.table).to_substitution_map()
+    # Plant real hits scattered through the keyspace (the host oracle
+    # enumerates reference order) so the merge path actually carries a
+    # stream to order, plus decoys for membership pressure.
+    planted = set()
+    for w in words[:: max(1, len(words) // 37)]:
+        cands = list(iter_candidates(w, sub_map, 0, 15))
+        planted.add(cands[len(cands) // 2])
+    digests = sorted(
+        _hashlib.new(args.algo, c).digest() for c in planted
+    ) + [
+        _hashlib.new(args.algo, b"split-decoy-%d" % i).digest()
+        for i in range(512)
+    ]
+    job_fields = {
+        "words": [w.decode() for w in words],
+        "table_map": {
+            k.decode(): [v.decode() for v in vals]
+            for k, vals in sub_map.items()
+        },
+        "algo": args.algo,
+        "mode": args.mode,
+        "digest_list": [d.hex() for d in digests],
+        "config": {"lanes": lanes, "blocks": nb},
+    }
+    env = dict(os.environ)
+    if args.platform:
+        env["JAX_PLATFORMS"] = args.platform
+
+    def arm(tag: str, n: int, split: str) -> dict:
+        d = tempfile.mkdtemp(prefix=f"a5-split-ab-{tag}-")
+        router = FleetRouter(poll_s=1.0, split=split)
+        merge_s = [0.0]
+        orig_round = fleet_mod._SplitMerge._merge_round
+
+        def timed_round(self, i, ev, _orig=orig_round):
+            t0 = time.perf_counter()
+            _orig(self, i, ev)
+            merge_s[0] += time.perf_counter() - t0
+
+        fleet_mod._SplitMerge._merge_round = timed_round
+        try:
+            specs = spawn_engines(
+                n, d,
+                engine_args=["--lanes", str(lanes), "--blocks", str(nb),
+                             "--schema-cache", os.path.join(d, "cache")],
+                engine_id_prefix=tag, env=env,
+            )
+            for sock_path, eid, proc in specs:
+                router.attach(sock_path, eid, proc=proc, timeout=300)
+            events: dict = {}
+
+            def run_one(j):
+                events[j] = []
+                router.submit({**job_fields, "op": "submit", "id": j},
+                              emit=events[j].append)
+                if not router.wait(j, timeout=900):
+                    raise SystemExit(
+                        f"--split-ab {tag} arm: job {j} never settled"
+                    )
+                done = [e for e in events[j] if e.get("event") == "done"]
+                if not done:
+                    raise SystemExit(
+                        f"--split-ab {tag} arm: job {j} settled "
+                        f"{router.job(j).state} — {events[j][-3:]}"
+                    )
+                return done[0]
+
+            run_one("warm0")  # untimed: the compiles land here
+            merge_s[0] = 0.0
+            t0 = time.perf_counter()
+            done = run_one("big0")
+            wall = time.perf_counter() - t0
+            hits = [
+                (e["word_index"], int(e["rank"]), e["plain_hex"],
+                 e["digest"])
+                for e in events["big0"] if e.get("event") == "hit"
+            ]
+            fleet = router.stats()["fleet"]
+            return {
+                "wall_s": wall,
+                "engines": n,
+                "n_emitted": done["n_emitted"],
+                "n_hits": done["n_hits"],
+                "hits": hits,
+                "jobs_split": fleet["jobs_split"],
+                "shard_done_events": sum(
+                    1 for e in events["big0"]
+                    if e.get("event") == "shard_done"
+                ),
+                "merge_s": merge_s[0],
+            }
+        finally:
+            fleet_mod._SplitMerge._merge_round = orig_round
+            router.close(shutdown_engines=True)
+            shutil.rmtree(d, ignore_errors=True)
+
+    solo = arm("solo", 1, "off")
+    split = arm("split", n_engines, "on")
+    if split["jobs_split"] != 2:  # warm job + measured job both scatter
+        raise SystemExit(
+            "--split-ab: the split arm never scattered "
+            f"(jobs_split={split['jobs_split']}) — nothing to measure"
+        )
+    if (
+        split["hits"] != solo["hits"]
+        or split["n_hits"] != solo["n_hits"]
+        or split["n_emitted"] != solo["n_emitted"]
+        or not solo["hits"]
+    ):
+        raise SystemExit(
+            "--split-ab arms diverged: merged stream "
+            f"{len(split['hits'])} hits (emitted {split['n_emitted']}) "
+            f"vs solo {len(solo['hits'])} (emitted {solo['n_emitted']}) "
+            "— refusing to report timings for a non-identical stream"
+        )
+    for a in (solo, split):
+        a["hits"] = len(a.pop("hits"))  # parity held; drop the bulk
+    record = {
+        "metric": "split_ab",
+        "unit": "seconds (wall) + speedup",
+        "platform": args.platform or "default",
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "planted_hits": len(planted),
+        # The striping win is host-parallelism-gated: N engine
+        # processes on < N usable cores timeshare the sweep compute
+        # and the wall ratio honestly reads ~1.0.  Recorded so a
+        # speedup number is never compared across hosts blind.
+        "host_cpus": len(os.sched_getaffinity(0)),
+        "solo": solo,
+        "split": split,
+        # §31 acceptance instruments: fleet-level speedup on ONE job
+        # (the striping headroom), and the router's merge cost as a
+        # share of the split wall (the merge must stay bookkeeping,
+        # not a second pipeline stage).
+        "speedup": solo["wall_s"] / max(split["wall_s"], 1e-9),
+        "merge_overhead_share": (
+            split["merge_s"] / max(split["wall_s"], 1e-9)
+        ),
+    }
+    print(json.dumps(stamp_geometry(record)))
+    sys.stdout.flush()
+
+
 def run_pack_ab(args: argparse.Namespace) -> None:
     """A/B the cross-job packed dispatch (PERF.md §22) against the PR 8
     per-job round-robin on the production crack contract: the same N
@@ -1857,6 +2076,164 @@ def run_pack_churn(args: argparse.Namespace) -> None:
         # the serve-wall ratio shows what the retrace bought.
         "wall_ratio": control["wall_s"] / max(refused["wall_s"], 1e-9),
         "fill_recovered": refused["post_refuse_fill_peak"],
+    }
+    print(json.dumps(stamp_geometry(record)))
+    sys.stdout.flush()
+
+
+def run_churn_cross(args: argparse.Namespace) -> None:
+    """A/B cross-group vs within-group dynamic re-fuse (PERF.md §31)
+    under two-group churn: per arm, TWO sequential admission batches
+    of two compatible jobs each form two fused groups on one packed
+    resident Engine; after two serve rounds one member of EACH group
+    cancels, leaving both groups thin at ~half fill with one survivor
+    apiece — exactly the regime within-group re-fuse cannot fix (a
+    lone survivor rebuilds SOLO, so packed fill never recovers) and
+    the cross scope exists for: the survivors' ``pack_candidate``
+    keys match, so the cross harvest merges them into one full group.
+    Reports per-arm post-departure fill minimum, post-re-fuse
+    recovered fill, and the refuse/refuse_cross counters;
+    parity-asserts every survivor's emitted count against its own
+    solo run.  One JSON line."""
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from hashcat_a5_table_generator_tpu.models.attack import AttackSpec
+    from hashcat_a5_table_generator_tpu.runtime.engine import Engine
+    from hashcat_a5_table_generator_tpu.runtime.sweep import (
+        Sweep,
+        SweepConfig,
+    )
+    from hashcat_a5_table_generator_tpu.tables.layouts import get_layout
+    from hashcat_a5_table_generator_tpu.utils.digests import HOST_DIGEST
+
+    dev = jax.devices()[0]
+    lanes = args.lanes
+    nb = args.blocks if args.blocks is not None else 32
+    if lanes % nb or nb % 2:
+        raise SystemExit(
+            "--churn-cross needs blocks dividing lanes and an even "
+            "block count (two jobs per group)"
+        )
+    n_groups, per_group = 2, 2
+    n_jobs = n_groups * per_group
+    spec = AttackSpec(mode=args.mode, algo=args.algo)
+    sub_map = get_layout(args.table).to_substitution_map()
+    words = synth_wordlist(args.words)
+    host_digest = HOST_DIGEST[spec.algo]
+    job_digests = [
+        [host_digest(b"cross-decoy-%d-%d" % (j, i)) for i in range(256)]
+        for j in range(n_jobs)
+    ]
+    base_cfg = SweepConfig(lanes=lanes, num_blocks=nb, superstep=4)
+    # One member of each group departs; its groupmate survives.
+    cancelled = {0, per_group}
+    survivors = [j for j in range(n_jobs) if j not in cancelled]
+
+    solo = {}
+    for j in survivors:
+        res = Sweep(spec, sub_map, words, job_digests[j],
+                    config=base_cfg).run_crack(resume=False)
+        solo[j] = res.n_emitted
+
+    def arm(scope: str) -> dict:
+        engine = Engine(base_cfg, auto=False, pack=True,
+                        refuse_below=args.refuse_below,
+                        refuse_scope=scope)
+        try:
+            def run_pass(measured: bool) -> dict:
+                handles = []
+                for g in range(n_groups):
+                    handles += [
+                        engine.submit(spec, sub_map, words,
+                                      job_digests[g * per_group + j])
+                        for j in range(per_group)
+                    ]
+                    engine._admit()  # one staged batch = one group
+                # Counters are engine-lifetime: gate this pass's
+                # post-refuse peak on refuses fired DURING it, or the
+                # warm pass's refuse would count the pre-cancel
+                # full-fill dispatches as "recovered".
+                refuse0 = engine.stats()["refuse_total"]
+                t0 = time.perf_counter()
+                for _ in range(2):
+                    engine._serve_round()
+                for j in cancelled:
+                    handles[j].cancel()
+                fill_min = None
+                post_refuse_peak = None
+                while True:
+                    engine._serve_round()
+                    engine._admit(wait=False)  # collect refuse builds
+                    st = engine.stats()
+                    if st["packed_fill_last"]:
+                        f = st["packed_fill_last"]
+                        if fill_min is None or f < fill_min:
+                            fill_min = f
+                        if st["refuse_total"] > refuse0:
+                            post_refuse_peak = max(
+                                post_refuse_peak or 0.0, f
+                            )
+                    if not st["jobs_active"]:
+                        break
+                wall = time.perf_counter() - t0
+                for j in survivors:
+                    n = handles[j].result(timeout=5).n_emitted
+                    if measured and n != solo[j]:
+                        raise SystemExit(
+                            f"--churn-cross {scope} arm diverged from "
+                            f"solo: job {j} emitted {n} vs {solo[j]} — "
+                            "refusing to report fills for "
+                            "non-identical work"
+                        )
+                return {
+                    "wall_s": wall,
+                    "fill_min": fill_min,
+                    "post_refuse_fill_peak": post_refuse_peak,
+                }
+            run_pass(measured=False)  # warm: every program compiles
+            out = run_pass(measured=True)
+            stats = engine.stats()
+            out["refuse_total"] = stats["refuse_total"]
+            out["refuse_cross"] = stats["refuse_cross"]
+            return out
+        finally:
+            engine.close()
+
+    cross = arm("cross")
+    within = arm("within")
+    if cross["refuse_cross"] < 1:
+        raise SystemExit(
+            "--churn-cross: the cross arm never harvested across "
+            f"groups ({cross}) — two thin sibling groups were expected "
+            "to merge"
+        )
+    if within["refuse_cross"] != 0 or within["refuse_total"] == 0:
+        raise SystemExit(
+            f"--churn-cross: the within arm misbehaved ({within}) — "
+            "it must retrace (lone survivors rebuild solo) without "
+            "ever crossing groups"
+        )
+    record = {
+        "metric": "churn_cross_ab",
+        "unit": "fill ratios",
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "lanes": lanes,
+        "blocks": nb,
+        "words": args.words,
+        "groups": n_groups,
+        "jobs_per_group": per_group,
+        "refuse_below": args.refuse_below,
+        "cross": cross,
+        "within": within,
+        # §31 acceptance instruments: the cross harvest merges the two
+        # lone survivors back to a full-width packed program; the
+        # within scope leaves them solo at the post-departure floor.
+        "fill_recovered_cross": cross["post_refuse_fill_peak"],
+        "fill_recovered_within": within["post_refuse_fill_peak"],
     }
     print(json.dumps(stamp_geometry(record)))
     sys.stdout.flush()
@@ -3134,7 +3511,8 @@ def main() -> None:
     ab_mode = (args.superstep_ab or args.stride_ab or args.pipeline_ab
                or args.stream_ab or args.serve_ab or args.telemetry_ab
                or args.pack_ab or args.pack_churn or args.pair_ab
-               or args.fleet_ab or args.elastic_ab)
+               or args.fleet_ab or args.elastic_ab or args.split_ab
+               or args.churn_cross)
     if args.compare_last_tpu and not (
         ab_mode or args.autotune or args.worker or args.platform
     ):
@@ -3170,16 +3548,28 @@ def main() -> None:
         # --pack-churn needs jobs LONG enough that work remains after
         # the mid-flight cancels (several supersteps per tenant), so
         # its default is larger than --pack-ab's underfilled 24.
+        # --split-ab's contract is ONE OVERSIZED job (the striping
+        # regime — per-shard sweep work must dwarf scatter + merge);
+        # --churn-cross reuses --pack-churn's long-tenant sizing.
         args.words = (
             1000 if (args.serve_ab or args.fleet_ab or args.elastic_ab)
             else 24 if args.pack_ab
-            else 2000 if args.pack_churn else 50000
+            else 2000 if (args.pack_churn or args.churn_cross)
+            else 20000 if args.split_ab else 50000
         )
     if args.fleet_ab or args.elastic_ab:
         # Routed-vs-direct serve A/B (PERF.md §25), with the elastic
         # tier armed on the routed arm under --elastic-ab (PERF.md
         # §27); spawns engine subprocesses — no jax in this process.
         run_fleet_ab(args, elastic=args.elastic_ab)
+    elif args.split_ab:
+        # Giant-job striping A/B (PERF.md §31); spawns engine
+        # subprocesses — no jax in this process.
+        run_split_ab(args)
+    elif args.churn_cross:
+        # Cross-group vs within-group re-fuse A/B (PERF.md §31); runs
+        # on the pinned (or default) platform in-process.
+        run_churn_cross(args)
     elif args.pair_ab:
         # Pair-lane tier A/B (PERF.md §24); runs on the pinned (or
         # default) platform in-process.

@@ -1256,6 +1256,21 @@ def _build_fleet_parser() -> argparse.ArgumentParser:
                     help="checkpoint-bearing engine failures "
                          "(quarantine) are resubmitted to another "
                          "engine up to N times per job")
+    # Giant-job striping (PERF.md §31).
+    ap.add_argument("--split", choices=("auto", "on", "off"),
+                    default=None,
+                    help="giant-job striping: scatter one oversized "
+                         "crack job across every free engine as "
+                         "disjoint rank-stride shard ranges and merge "
+                         "the hit streams back into one (word,rank)-"
+                         "ordered client stream (auto: only jobs with "
+                         "at least --split-threshold words; on: any "
+                         "crack job when 2+ engines are free; off: "
+                         "never; default: $A5GEN_SPLIT or auto)")
+    ap.add_argument("--split-threshold", type=int, default=4096,
+                    metavar="N",
+                    help="auto split mode: minimum wordlist size (in "
+                         "words) before a submit is scattered")
     # Elastic tier (PERF.md §27): autoscaling + admission control.
     ap.add_argument("--autoscale", metavar="MIN:MAX", default=None,
                     help="enable the autoscaler (spawn mode only): "
@@ -1391,7 +1406,9 @@ def _run_fleet(argv: Sequence[str]) -> int:
                          engine_capacity=args.engine_capacity,
                          max_pending=args.max_pending,
                          per_tenant=args.per_tenant,
-                         shed_policy=args.shed_policy)
+                         shed_policy=args.shed_policy,
+                         split=args.split,
+                         split_threshold=args.split_threshold)
     spawned = False
     scaler = None
     try:

@@ -327,7 +327,8 @@ class Engine:
                  admission_worker: bool = True,
                  faults: "Optional[object]" = None,
                  job_retries: int = 1,
-                 refuse_below: "Optional[float]" = None) -> None:
+                 refuse_below: "Optional[float]" = None,
+                 refuse_scope: "Optional[str]" = None) -> None:
         from ..ops.packing import schema_cache_stats
         from .sweep import SweepConfig, step_cache_stats
 
@@ -393,6 +394,17 @@ class Engine:
         #: tighter group.  None = the A5GEN_REFUSE env hatch decides
         #: (0.5 by default); 0/0.0 disables re-fuse for this engine.
         self._refuse_below = refuse_below
+        #: re-fuse merge scope (PERF.md §31): "cross" (default) lets a
+        #: thin-group retrace harvest survivors from OTHER thin
+        #: compatible groups too (the pack_candidate key proves safety
+        #: in _prepare_fuse's bucketing); "within" pins the pre-§31
+        #: one-group-only behavior.  None = A5GEN_REFUSE's within[:thr]
+        #: spelling decides.
+        if refuse_scope not in (None, "within", "cross"):
+            raise ValueError(
+                "refuse_scope must be None, 'within' or 'cross'"
+            )
+        self._refuse_scope_cfg = refuse_scope
         #: survivors detached from a thinned group, their re-fuse build
         #: in flight on the admission worker (under ``_lock``; counted
         #: in ``jobs_active`` — they are load, just not runnable yet).
@@ -432,6 +444,17 @@ class Engine:
 
         return refuse_threshold()
 
+    def _refuse_scope(self) -> str:
+        """The resolved re-fuse merge scope (PERF.md §31): an explicit
+        ``Engine(refuse_scope=)`` wins; otherwise A5GEN_REFUSE's
+        ``within[:thr]`` spelling pins the within-group-only control
+        and anything else means cross-group merging."""
+        if self._refuse_scope_cfg is not None:
+            return self._refuse_scope_cfg
+        from .env import refuse_scope
+
+        return refuse_scope()
+
     @staticmethod
     def _packed_counters() -> Dict[str, int]:
         return {
@@ -443,7 +466,8 @@ class Engine:
     def _ladder_counters() -> Dict[str, int]:
         return {
             k: int(telemetry.counter(f"engine.{k}").value)
-            for k in ("group_demotions", "job_restarts", "refuse_total")
+            for k in ("group_demotions", "job_restarts", "refuse_total",
+                      "refuse_cross")
         }
 
     # -- tenant surface ------------------------------------------------
@@ -600,6 +624,9 @@ class Engine:
             # which (unlike the aggregate above) expose POST-departure
             # masked-lane decay the moment it happens.
             "refuse_total": ladder.get("refuse_total", 0),
+            # Cross-group merges (PERF.md §31): retraces that harvested
+            # survivors from MORE than one thin group in one batch.
+            "refuse_cross": ladder.get("refuse_cross", 0),
             "jobs_refusing": refusing,
             "packed_fill_last": (
                 fill_last if fill_last is not None else 0.0
@@ -1333,13 +1360,21 @@ class Engine:
         untouched."""
         with self._lock:
             groups = list(self._fused)
+        pumped = []
         for group in groups:
             try:
                 group.pump()
             except Exception as exc:  # noqa: BLE001 — group-scoped
                 self._demote_group(group, exc)
             else:
-                self._note_fill(group)
+                pumped.append(group)
+        # Fill notes run AFTER every group pumped: the cross-scope
+        # re-fuse harvest (PERF.md §31) reads the SIBLING groups' fills,
+        # and a trigger firing mid-round would see a cohabitant's stale
+        # pre-departure fill and skip a thin group it should merge.
+        for group in pumped:
+            self._note_fill(group)
+        for group in groups:
             if group.done:
                 with self._lock:
                     if group in self._fused:
@@ -1390,16 +1425,41 @@ class Engine:
         keep their group/resident counts, so reactivation moves no
         counters.  Members with a pending pause/cancel stay behind:
         the round honors their request against the OLD group as
-        usual."""
+        usual.
+
+        Cross-group scope (PERF.md §31): when the resolved scope is
+        "cross", the batch also harvests survivors from OTHER thin
+        post-churn groups (each gated by its own departure/fill
+        trigger, so a healthy or naturally-tailing cohabitant group is
+        never retraced).  Safety comes for free downstream:
+        ``_prepare_fuse`` buckets the combined batch by its
+        ``pack_candidate`` static key, so only provably-compatible
+        survivors merge and the rest rebuild within their own
+        buckets."""
+        sources = [group]
+        thr = self._refuse_threshold()
+        if self._refuse_scope() == "cross" and thr is not None:
+            with self._lock:
+                others = [g for g in self._fused if g is not group]
+            sources += [
+                g for g in others
+                if g.departures > 0
+                and g.active_members > 0
+                and g.last_fill is not None
+                and g.last_fill < thr
+                and g._work_remains()
+            ]
         members = [
             slot for slot in self._round_slots()
-            if getattr(slot.sweep, "_packed_source", None) is group
+            if getattr(slot.sweep, "_packed_source", None) in sources
             and not slot.job._cancel_req.is_set()
             and not slot.job._pause_req.is_set()
         ]
         if not members:
             return
         telemetry.counter("engine.refuse_total").add(1)
+        if len(sources) > 1:
+            telemetry.counter("engine.refuse_cross").add(1)
         entries = []
         for slot in members:
             sweep = slot.sweep
@@ -1624,7 +1684,8 @@ class Engine:
 # candidates job, which then needs "output": path); "algo", "mode"
 # ("default"/"reverse"/"suball"/"suball-reverse"), "table_min"/"table_max";
 # "config": SweepConfig subset {lanes, blocks, superstep, devices,
-# fetch_chunk, stream_chunk_words, schema_cache, schema_cache_max_mb};
+# fetch_chunk, stream_chunk_words, schema_cache, schema_cache_max_mb,
+# pod: [index, count] — one rank-stride stripe of a split giant job};
 # "checkpoint": a previously returned pause checkpoint (migrate-in);
 # "replay_mute": N — withhold the leading N hit emissions from event
 # delivery (the fleet router's exactly-once redelivery discipline; the
@@ -1650,6 +1711,12 @@ _JOB_CONFIG_FIELDS = {
     "retry_attempts": "retry_attempts",
     "retry_backoff_s": "retry_backoff_s",
     "fetch_timeout_s": "fetch_timeout_s",
+    # Pod giant-job striping over the wire (PERF.md §31): the fleet
+    # router's split scatter drives the SweepConfig.pod cursor
+    # arithmetic per shard — "pod": [index, count] scans only that
+    # rank-stride stripe of the superstep block lattice, and the
+    # shards' hit-stream union is exactly the solo stream.
+    "pod": "pod",
 }
 
 
@@ -1702,6 +1769,11 @@ def _job_from_doc(
     unknown = set(overrides) - set(_JOB_CONFIG_FIELDS)
     if unknown:
         raise ValueError(f"unknown config field(s): {sorted(unknown)}")
+    if overrides.get("pod") is not None:
+        # JSON has no tuples; SweepConfig.pod wants (index, count).
+        overrides = dict(overrides, pod=tuple(
+            int(x) for x in overrides["pod"]
+        ))
     if overrides:
         cfg = replace(cfg, **{
             _JOB_CONFIG_FIELDS[k]: v for k, v in overrides.items()

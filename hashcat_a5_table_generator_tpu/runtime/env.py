@@ -151,15 +151,22 @@ def refuse_threshold() -> "Optional[float]":
     group's per-round fill drops below this ratio after a tenant
     departs, the engine re-fuses the survivors into a tighter group.
     ``A5GEN_REFUSE`` holds the ratio (0 < r <= 1); ``off``/``0``/``no``
-    disables re-fuse; empty/unset keeps the default (0.5).
+    disables re-fuse; empty/unset keeps the default (0.5); the
+    ``within``/``within:<ratio>`` spellings keep re-fuse on but pin
+    the within-group-only merge scope (see :func:`refuse_scope`).
     ``Engine(refuse_below=)`` overrides this per engine; an unparseable
     value warns once and keeps the default — a typo must not silently
     stop (or start) retracing groups."""
     val = read_env("A5GEN_REFUSE")
     if val in (None, ""):
         return 0.5
-    if val.lower() in ("off", "0", "no"):
+    low = val.lower()
+    if low in ("off", "0", "no"):
         return None
+    if low == "within":
+        return 0.5
+    if low.startswith("within:"):
+        val = val.split(":", 1)[1]
     try:
         r = float(val)
         if not 0.0 < r <= 1.0:
@@ -168,10 +175,52 @@ def refuse_threshold() -> "Optional[float]":
         env_warn_once(
             "A5GEN_REFUSE", val,
             f"unrecognized A5GEN_REFUSE={val!r} (want a fill ratio "
-            "in (0, 1], or off|0|no); keeping the default (0.5)",
+            "in (0, 1], within[:ratio], or off|0|no); keeping the "
+            "default (0.5)",
         )
         return 0.5
     return r
+
+
+def refuse_scope() -> str:
+    """Re-fuse merge scope (PERF.md §31): ``cross`` (the default —
+    thin post-churn survivors merge ACROSS compatible fused groups on
+    the engine; the ``pack_candidate`` static key proves safety) or
+    ``within`` (each thin group re-fuses only its own survivors — the
+    PR 18 behavior and the churn bench's control arm).  Spelled inside
+    ``A5GEN_REFUSE`` (``within`` / ``within:<ratio>``) so one knob
+    owns the whole re-fuse surface; ``Engine(refuse_scope=)``
+    overrides per engine."""
+    val = env_str("A5GEN_REFUSE").lower()
+    if val == "within" or val.startswith("within:"):
+        return "within"
+    return "cross"
+
+
+def split_setting() -> str:
+    """Fleet giant-job splitting (``A5GEN_SPLIT``, PERF.md §31):
+    ``auto`` (empty/unset default — the router scatters an oversized
+    crack job across engines when its word count crosses the split
+    threshold and >= 2 engines can take a stripe), ``on``/``1`` (split
+    every eligible crack job regardless of size), ``off``/``0``/``no``
+    (never auto-split; the explicit ``split`` op still works).  The
+    router's ``--split`` flag overrides this per process; an
+    unrecognized value warns once and keeps ``auto`` — a typo must not
+    silently change placement."""
+    val = env_str("A5GEN_SPLIT")
+    low = val.lower()
+    if low in ("", "auto"):
+        return "auto"
+    if low in ("on", "1"):
+        return "on"
+    if low in ("off", "0", "no"):
+        return "off"
+    env_warn_once(
+        "A5GEN_SPLIT", val,
+        f"unrecognized A5GEN_SPLIT={val!r} (want auto|on|off); "
+        "keeping the default (auto)",
+    )
+    return "auto"
 
 
 def tune_profile_setting() -> "Optional[str]":

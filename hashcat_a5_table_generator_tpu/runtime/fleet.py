@@ -52,6 +52,22 @@ The router holds no device state and runs no jax: it is JSON, sockets
 and tables, so one router fronts many engine processes without
 competing for the accelerator.
 
+Giant-job striping (PERF.md §31, ROADMAP item 4): one OVERSIZED job
+can also split ACROSS engines.  The router rewrites the submit
+document N ways with disjoint ``config.pod = [i, N]`` rank-stride
+stripes — the same cursor arithmetic ``SweepConfig.pod`` already
+generalizes in-process — and dispatches each stripe to a different
+engine; a k-way merge (:class:`_SplitMerge`) releases the per-shard
+(word,rank)-ordered hit streams back to the client as ONE globally
+(word,rank)-ordered, exactly-once stream.  Every shard rides the
+existing checkpoint wire format, so a shard's checkpoint stays
+interchangeable with a solo resume, and a dead engine's stripe
+reassigns through the ordinary crash-replay path (checkpoint +
+``replay_mute``), never replaying a hit into the client.  ``split``
+picks the mode (``auto`` scatters oversized fresh submits;
+``on``/``off`` force it) and the explicit ``split`` op scatters a
+RUNNING job mid-flight (pause → checkpoint → N shard resubmits).
+
 The elastic half (PERF.md §27) makes the fleet overload-safe and
 self-managing:
 
@@ -95,6 +111,7 @@ import sys
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, \
     TextIO, Tuple
 
@@ -490,10 +507,273 @@ class RoutedJob:
         #: a ghost sweep under a table entry the dispatcher deletes).
         self.acked = False
         self.settled = threading.Event()
+        #: split scatter (PERF.md §31): the k-way merge when this job
+        #: IS scattered across engines (its hits arrive through the
+        #: shards; ``link`` stays None).
+        self.split: "Optional[_SplitMerge]" = None
+        #: ``(index, count)`` when this job IS one scattered stripe of
+        #: ``parent`` — its events route into the parent's merge, and
+        #: crash-replay of its range counts as a reassignment.
+        self.shard: Optional[Tuple[int, int]] = None
+        self.parent: "Optional[RoutedJob]" = None
+        #: the explicit ``split`` op's park handshake: set while the
+        #: op waits for the running job's pause→checkpoint round trip
+        #: (the paused event signals it instead of reaching the
+        #: client).
+        self.splitting: Optional[threading.Event] = None
 
     @property
     def unsettled(self) -> bool:
         return self.state in ("queued", "routed", "paused")
+
+
+# ---------------------------------------------------------------------------
+# Split-job hit-stream merging (PERF.md §31)
+# ---------------------------------------------------------------------------
+
+
+class _SplitMerge:
+    """Router-held merge state of ONE split job: N shards stream
+    (word,rank)-ordered hits off disjoint rank-stride pod stripes;
+    this k-way merge releases them downstream as one globally
+    (word,rank)-ordered, exactly-once client stream.
+
+    Release discipline: the global minimum across the shard buffers
+    releases only while no LIVE shard with an empty buffer could still
+    produce an earlier key — each shard's stream is (word,rank)-
+    monotone (the pod lattice walks blocks in global order), so its
+    last seen key (``_marks``) is a safe lower bound on everything it
+    will produce next.  A shard that ended stops gating.  Nothing
+    releases before :meth:`arm` — a scatter that fails mid-way must
+    leave the client stream untouched for the solo fallback — and
+    every release happens under the merge lock so the client sees one
+    serialized ordered stream.
+
+    Exactly-once across reassignment comes free from the §20/§26
+    crash-replay discipline: a dead shard resubmits from its last
+    router-held checkpoint with ``replay_mute`` = hits it already fed
+    THIS merge, so the replacement engine withholds exactly the
+    deterministic prefix the buffers already hold."""
+
+    def __init__(self, router: "FleetRouter", job: RoutedJob,
+                 n: int) -> None:
+        self.router = router
+        self.job = job
+        self.n = n
+        self.shards: List[RoutedJob] = []
+        self._bufs: List[deque] = [deque() for _ in range(n)]
+        #: last merge key seen per shard (None = nothing yet).
+        self._marks: List[Optional[Tuple[int, int]]] = [None] * n
+        #: terminal state per shard (None = still streaming).
+        self._ended: List[Optional[str]] = [None] * n
+        #: the done event per shard (the parent's totals source).
+        self._stats: List[Optional[dict]] = [None] * n
+        self._armed = False
+        self._finished = False
+        self._failure: Optional[dict] = None
+        self._lock = threading.Lock()
+        ck = job.checkpoint or {}
+        #: the scattered checkpoint's emitted counter: every shard
+        #: resumes from the SAME doc, so each shard's done counters
+        #: include this prefix once — the parent's total subtracts the
+        #: duplicate n-1 copies.
+        self._ck_emitted = int(ck.get("n_emitted", 0) or 0)
+        self._resumed = job.checkpoint is not None
+
+    def shard_emit(self, i: int) -> Callable:
+        """The shard's ``RoutedJob.emit``: the router's ordinary event
+        plane forwards shard events here instead of to a client."""
+        def emit(ev: dict, _i: int = i) -> None:
+            self.on_event(_i, ev)
+        return emit
+
+    def arm(self) -> None:
+        """Open the client valve — called once, after every shard
+        dispatched.  Hits that streamed during the scatter drain now;
+        terminals that landed early finish now."""
+        with self._lock:
+            self._armed = True
+            self._release_locked(self._drain_locked())
+        self._maybe_finish()
+
+    # -- event plane (shard emit callbacks, reader threads) ------------
+
+    def on_event(self, i: int, ev: dict) -> None:
+        event = protocol.doc_event(ev)
+        if event == "hit":
+            self._merge_round(i, ev)
+            return
+        if event == "done":
+            shard = self.shards[i]
+            engine = shard.link.engine_id if shard.link else None
+            with self._lock:
+                self._ended[i] = "done"
+                self._stats[i] = ev
+                self._release_locked(self._drain_locked())
+                armed = self._armed
+            if armed:
+                self.router._forward(self.job, protocol.ev_shard_done(
+                    self.job.id, shard=i, shards=self.n,
+                    engine=engine, n_hits=ev.get("n_hits"),
+                ))
+        elif event == "failed":
+            first = False
+            with self._lock:
+                self._ended[i] = "failed"
+                if self._failure is None:
+                    self._failure = ev
+                    first = True
+                armed = self._armed
+            if first and armed:
+                # One stripe is unrecoverable (replay budget spent):
+                # the whole job fails — stop the siblings burning
+                # device time on ranges nobody will merge.
+                self._cancel_live(exclude=i)
+        elif event == "cancelled":
+            with self._lock:
+                self._ended[i] = "cancelled"
+        else:
+            # Informational per-job events (refused, ...) pass through
+            # re-labeled with the parent id.
+            with self._lock:
+                armed = self._armed
+            if armed:
+                ev2 = dict(ev)
+                ev2["id"] = self.job.id
+                self.router._forward(self.job, ev2)
+            return
+        self._maybe_finish()
+
+    def _merge_round(self, i: int, ev: dict) -> None:
+        """One shard hit through the merge (``audit_merge_loop`` pins
+        this shape): the ONE unconditional host decode — the rank
+        string parses exactly once, here, never per-shard in the drain
+        bookkeeping — then lock-held bounded buffering: the shard's
+        buffer takes the hit and the drain pops every releasable head
+        before the lock drops, so a stalled sibling bounds the buffer
+        at its stripe lag, never at the whole keyspace."""
+        key = (ev["word_index"], int(ev["rank"]))
+        with self._lock:
+            self._bufs[i].append((key, ev))
+            self._marks[i] = key
+            self._release_locked(self._drain_locked())
+
+    def _drain_locked(self) -> List[dict]:
+        """Pop every releasable buffered hit, in global key order
+        (caller holds ``_lock``)."""
+        out: List[dict] = []
+        if not self._armed:
+            return out
+        while True:
+            best: Optional[Tuple[int, int]] = None
+            src = -1
+            for k in range(self.n):
+                if self._bufs[k] and (
+                    best is None or self._bufs[k][0][0] < best
+                ):
+                    best = self._bufs[k][0][0]
+                    src = k
+            if best is None:
+                return out
+            blocked = any(
+                self._ended[k] is None and not self._bufs[k]
+                and (self._marks[k] is None or self._marks[k] < best)
+                for k in range(self.n)
+            )
+            if blocked:
+                return out
+            out.append(self._bufs[src].popleft()[1])
+
+    def _release_locked(self, evs: List[dict]) -> None:
+        """Forward merged hits downstream as the PARENT's hits (caller
+        holds ``_lock`` — releases serialize).  Rebuilt through the
+        typed constructor so key order matches a solo engine's stream
+        byte for byte."""
+        job = self.job
+        for ev in evs:
+            job.n_forwarded += 1
+            self.router._forward(job, protocol.ev_hit(
+                job.id,
+                digest=ev["digest"],
+                plain_hex=ev["plain_hex"],
+                word_index=ev["word_index"],
+                rank=ev["rank"],
+            ))
+
+    # -- completion ----------------------------------------------------
+
+    def _maybe_finish(self) -> None:
+        with self._lock:
+            if (
+                self._finished or not self._armed
+                or any(e is None for e in self._ended)
+            ):
+                return
+            self._finished = True
+            ended = list(self._ended)
+            stats = [s for s in self._stats if s is not None]
+            if all(e == "done" for e in ended):
+                # All stripes drained: nothing gates — flush.
+                self._release_locked(self._drain_locked())
+        job = self.job
+        job.split = None
+        if all(e == "done" for e in ended):
+            n_emitted = sum(
+                int(s.get("n_emitted", 0)) for s in stats
+            ) - (self.n - 1) * self._ck_emitted
+            wall = max(
+                (float(s.get("wall_s", 0.0)) for s in stats),
+                default=0.0,
+            )
+            self.router._forward(job, protocol.ev_done(
+                job.id, n_hits=job.n_forwarded,
+                n_emitted=n_emitted, wall_s=wall,
+                resumed=self._resumed,
+            ))
+            self.router._settle(job, "done")
+        elif self._failure is not None:
+            ev = dict(self._failure)
+            ev["id"] = job.id
+            self.router._forward(job, ev)
+            self.router._settle(job, "failed")
+        else:
+            self.router._forward(job, protocol.ev_cancelled(job.id))
+            self.router._settle(job, "cancelled")
+
+    # -- control -------------------------------------------------------
+
+    def cancel(self) -> None:
+        """Client cancel of the split parent: cancel every live
+        shard; the merge finishes ``cancelled`` once they all park."""
+        self._cancel_live(exclude=None)
+        self._maybe_finish()
+
+    def _cancel_live(self, exclude: Optional[int]) -> None:
+        router = self.router
+        for j, s in enumerate(self.shards):
+            if j == exclude:
+                continue
+            with self._lock:
+                if self._ended[j] is not None:
+                    continue
+            link = s.link
+            if s.state == "routed" and link is not None:
+                try:
+                    link.send(protocol.op_cancel(s.id))
+                except (OSError, FleetError,
+                        faults_mod.FaultError):
+                    pass  # dying link: crash-replay owns the shard
+            else:
+                # Reassignment parked it on the pending queue (or it
+                # sits paused): settle it router-side.
+                with router._lock:
+                    pending = s in router._pending and not s.claimed
+                    if pending:
+                        router._pending.remove(s)
+                if pending or (s.state == "paused" and not s.claimed):
+                    router._settle(s, "cancelled")
+                    with self._lock:
+                        self._ended[j] = "cancelled"
 
 
 # ---------------------------------------------------------------------------
@@ -542,7 +822,9 @@ class FleetRouter:
                  per_tenant: int = 0, shed_policy: str = "reject",
                  degrade_after: int = 1, quarantine_after: int = 3,
                  recover_after: int = 2, quarantine_replays: int = 2,
-                 poll_jitter: float = 0.25) -> None:
+                 poll_jitter: float = 0.25,
+                 split: Optional[str] = None,
+                 split_threshold: int = 4096) -> None:
         if place not in ("affinity", "round-robin"):
             raise ValueError(
                 f"place must be affinity|round-robin, got {place!r}"
@@ -551,6 +833,10 @@ class FleetRouter:
             raise ValueError(
                 f"shed_policy must be reject|queue|oldest, got "
                 f"{shed_policy!r}"
+            )
+        if split not in (None, "auto", "on", "off"):
+            raise ValueError(
+                f"split must be auto|on|off, got {split!r}"
             )
         self._place = place
         self._replay_budget = int(replay_budget)
@@ -567,6 +853,12 @@ class FleetRouter:
         self._recover_after = max(1, int(recover_after))
         self._quarantine_replays = max(1, int(quarantine_replays))
         self._poll_jitter = max(0.0, float(poll_jitter))
+        #: giant-job striping (PERF.md §31): None = the A5GEN_SPLIT
+        #: env hatch decides (``auto`` by default); the threshold is
+        #: the ``auto`` mode's oversized floor in WORDS (a word expands
+        #: to ≥1 lattice blocks, so it lower-bounds the block count).
+        self._split = split
+        self._split_threshold = int(split_threshold)
         self._links: List[EngineLink] = []
         self._jobs: Dict[str, RoutedJob] = {}
         #: admission-queued jobs (FIFO), bounded by ``max_pending``
@@ -591,7 +883,8 @@ class FleetRouter:
             for name in ("engine_deaths", "jobs_replayed",
                          "migrations", "jobs_rejected", "jobs_shed",
                          "jobs_queued", "scrape_retries",
-                         "engines_quarantined", "engines_detached")
+                         "engines_quarantined", "engines_detached",
+                         "jobs_split", "shards_reassigned")
         }
         self._poll_stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
@@ -849,7 +1142,15 @@ class FleetRouter:
                 self._tenant_counts[job.tenant] = \
                     self._tenant_counts.get(job.tenant, 0) + 1
         try:
-            ack = dict(self._dispatch(job))
+            ack = None
+            n_split = self._auto_split_width(job, doc)
+            if n_split >= 2:
+                # Oversized: scatter across engines (PERF.md §31).  A
+                # part-failed scatter unwinds to None and the job
+                # falls through to the ordinary solo dispatch.
+                ack = self._split_scatter(job, n_split, strict=False)
+            if ack is None:
+                ack = dict(self._dispatch(job))
         except _NoCapacity:
             ack = self._enqueue_pending(job)
         except (FleetError, faults_mod.FaultError):
@@ -969,6 +1270,16 @@ class FleetRouter:
 
     def pause(self, jid: str) -> None:
         job = self._job(jid)
+        if job.split is not None:
+            raise FleetError(
+                f"job {jid!r} is split across engines — it has no "
+                "single pause point; cancel it or let it finish"
+            )
+        if job.shard is not None:
+            raise FleetError(
+                f"job {jid!r} is a split shard — operate on its "
+                f"parent {job.parent.id!r}"
+            )
         if job.state != "routed" or job.link is None:
             raise FleetError(f"job {jid!r} is {job.state}, not running")
         job.link.send(protocol.op_pause(jid))
@@ -979,6 +1290,11 @@ class FleetRouter:
         forward downstream.  Under admission control a resume with no
         free capacity queues like a submit would."""
         job = self._job(jid)
+        if job.shard is not None:
+            raise FleetError(
+                f"job {jid!r} is a split shard — the router owns its "
+                "lifecycle"
+            )
         with self._lock:
             # ONE atomic read of the admission state: a state check
             # outside this lock could interleave with the pump
@@ -1006,8 +1322,188 @@ class FleetRouter:
         ack["resumed"] = True
         return ack
 
+    # -- giant-job striping (PERF.md §31) ------------------------------
+
+    def _placeable_width(self) -> int:
+        """Engines a scatter could stripe across right now."""
+        with self._lock:
+            return sum(
+                1 for l in self._links
+                if l.alive and not l.draining
+                and l.health != "quarantined"
+            )
+
+    def _auto_split_width(self, job: RoutedJob, doc: dict) -> int:
+        """How many stripes a fresh submit should scatter across (0 =
+        keep it solo).  Gates: the resolved split mode (ctor >
+        A5GEN_SPLIT > ``auto``); crack jobs only (candidates output is
+        engine-local); an explicit client ``config.pod`` wins (the
+        client already striped it); ``superstep: 0`` has no block
+        lattice to stripe; ``auto`` requires an oversized inline
+        wordlist (``split_threshold`` words) so fleet-of-small-jobs
+        traffic never pays scatter overhead; and at least two
+        placeable engines must exist."""
+        mode = self._split
+        if mode is None:
+            from .env import split_setting
+
+            mode = split_setting()
+        if mode == "off" or job.kind != "crack":
+            return 0
+        cfg = job.doc.get("config") or {}
+        if cfg.get("pod") is not None:
+            return 0
+        superstep = cfg.get("superstep")
+        if superstep is None:
+            superstep = getattr(self._defaults, "superstep", None)
+        if superstep == 0:
+            return 0
+        words = doc.get("words")
+        if not isinstance(words, list):
+            return 0
+        if mode != "on" and len(words) < self._split_threshold:
+            return 0
+        n = self._placeable_width()
+        return n if n >= 2 else 0
+
+    def _split_scatter(self, job: RoutedJob, n: int, *,
+                       strict: bool) -> Optional[dict]:
+        """Scatter one admitted crack job across ``n`` engines as
+        disjoint ``config.pod = [i, n]`` rank-stride stripes, each a
+        full resubmittable job doc riding the job's checkpoint (pod
+        cursors are GLOBAL, so every shard resumes from the SAME doc
+        and walks only its stripe) with already-forwarded hits muted.
+        On success the merge arms and the parent streams through it.
+        On any placement failure the scatter unwinds completely —
+        nothing reached the client — and either returns None
+        (``strict=False``: submit falls back to solo dispatch) or
+        raises typed (``strict=True``: the explicit op's job stays
+        paused, checkpoint intact)."""
+        merge = _SplitMerge(self, job, n)
+        shards: List[RoutedJob] = []
+        for i in range(n):
+            sdoc = dict(job.doc)
+            cfg = dict(sdoc.get("config") or {})
+            cfg["pod"] = [i, n]
+            sdoc["config"] = cfg
+            sdoc["id"] = f"{job.id}::s{i}"
+            protocol.op_submit(sdoc)
+            shard = RoutedJob(sdoc["id"], "crack", sdoc, job.token,
+                              merge.shard_emit(i))
+            shard.shard = (i, n)
+            shard.parent = job
+            shard.checkpoint = job.checkpoint
+            # Double duty, both correct: the mute each dispatch sends
+            # (the checkpoint prefix is already client-forwarded) AND
+            # the shard's forwarded counter (replayed hits never
+            # re-enter the merge).
+            shard.n_forwarded = job.n_forwarded
+            shards.append(shard)
+        merge.shards = shards
+        with self._lock:
+            for shard in shards:
+                self._jobs[shard.id] = shard
+            job.split = merge
+            job.state = "routed"
+        used: List[EngineLink] = []
+        try:
+            for shard in shards:
+                # Affinity would co-locate equal-token stripes: spread
+                # them instead — distinct engines are the whole win.
+                self._dispatch(shard, tuple(used))
+                if shard.link is not None and shard.link not in used:
+                    used.append(shard.link)
+        except (FleetError, faults_mod.FaultError) as exc:
+            self._split_undo(job, shards)
+            if strict:
+                raise FleetError(
+                    f"split of {job.id!r} failed mid-scatter: {exc} "
+                    "(the job is intact — resume it solo or retry)"
+                ) from exc
+            return None
+        merge.arm()
+        telemetry.counter("fleet.jobs_split").add(1)
+        return protocol.ev_accepted(job.id, job.kind, shards=n)
+
+    def _split_undo(self, job: RoutedJob,
+                    shards: List[RoutedJob]) -> None:
+        """Unwind a part-placed scatter: the merge never armed, so no
+        hit reached the client — cancel the placed stripes (their
+        buffered hits die with the merge) and drop the unplaced shard
+        entries; the job returns to its pre-scatter admission state."""
+        for shard in shards:
+            link = shard.link
+            if link is not None:
+                try:
+                    link.send(protocol.op_cancel(shard.id))
+                except (OSError, FleetError, faults_mod.FaultError):
+                    pass  # dying link: its death path settles the id
+        with self._lock:
+            job.split = None
+            job.state = "paused" if job.checkpoint is not None \
+                else "queued"
+            for shard in shards:
+                if shard.link is None:
+                    if self._jobs.get(shard.id) is shard:
+                        del self._jobs[shard.id]
+                    shard.state = "cancelled"
+                    shard.settled.set()
+
+    def split(self, jid: str, shards: Optional[int] = None) -> dict:
+        """The explicit ``split`` op (PERF.md §31): scatter one
+        admitted crack job across engines mid-flight.  A RUNNING job
+        parks first (pause → checkpoint over the wire — the same §20
+        discipline migrate rides; the paused event signals the park
+        instead of reaching the client), then the checkpoint scatters
+        as N disjoint pod stripes with already-forwarded hits muted; a
+        PAUSED job scatters directly.  Returns the ``accepted`` ack
+        with ``shards`` set."""
+        job = self._job(jid)
+        if job.kind != "crack":
+            raise FleetError(
+                f"job {jid!r} is {job.kind} — only crack jobs split "
+                "(candidates output is engine-local)"
+            )
+        if job.split is not None or job.shard is not None:
+            raise FleetError(f"job {jid!r} is already split")
+        if (job.doc.get("config") or {}).get("pod") is not None:
+            raise FleetError(
+                f"job {jid!r} already carries a client pod stripe"
+            )
+        n_live = self._placeable_width()
+        n = int(shards) if shards is not None else n_live
+        n = min(n, max(n_live, 1))
+        if n < 2:
+            raise FleetError(
+                "split needs at least 2 placeable engines (have "
+                f"{n_live})"
+            )
+        if job.state == "routed" and job.link is not None:
+            parked = threading.Event()
+            job.splitting = parked
+            job.link.send(protocol.op_pause(jid))
+            if not parked.wait(self._control_timeout):
+                job.splitting = None
+                raise FleetError(
+                    f"job {jid!r} did not park for split within "
+                    f"{self._control_timeout:g}s"
+                )
+        if job.state != "paused":
+            raise FleetError(
+                f"job {jid!r} is {job.state}, not splittable"
+            )
+        return self._split_scatter(job, n, strict=True)
+
     def cancel(self, jid: str) -> None:
         job = self._job(jid)
+        if job.split is not None:
+            job.split.cancel()
+            return
+        if job.shard is not None:
+            raise FleetError(
+                f"job {jid!r} is a split shard — cancel its parent "
+                f"{job.parent.id!r}"
+            )
         if job.state == "routed" and job.link is not None:
             job.link.send(protocol.op_cancel(jid))
             return
@@ -1037,6 +1533,12 @@ class FleetRouter:
         their output is engine-local).  Asynchronous: returns an ack;
         the job continues streaming on its same client session."""
         job = self._job(jid)
+        if job.split is not None:
+            raise FleetError(
+                f"job {jid!r} is split across engines — its stripes "
+                "rebalance individually (drain moves them; cancel "
+                "the parent to stop them)"
+            )
         if job.state != "routed" or job.link is None:
             raise FleetError(f"job {jid!r} is {job.state}, not running")
         if engine_id is not None:
@@ -1362,6 +1864,10 @@ class FleetRouter:
         with self._lock:
             old.routed.discard(job.id)
             job.link = None
+        # A migrating split stripe is a range reassignment too (the
+        # drain rebalancer rides this path): same parent-side event
+        # and counter as the crash path, same mute discipline.
+        self._note_reassign(job, old)
         self._requeue.put((job, (old,), None))
 
     def _schedule_pump(self) -> None:
@@ -1485,6 +1991,18 @@ class FleetRouter:
                     self._settle(job, "failed")
                     return
             job.checkpoint = ck
+            parked = job.splitting
+            if parked is not None:
+                # The explicit split op's park (PERF.md §31): the
+                # pause was ours — hand the checkpointed job back to
+                # the waiting scatter instead of the client.
+                job.splitting = None
+                with self._lock:
+                    job.state = "paused"
+                    link.routed.discard(job.id)
+                    job.link = None
+                parked.set()
+                return
             if job.migrating:
                 self._remigrate(job, link)
                 return
@@ -1541,6 +2059,7 @@ class FleetRouter:
                 with self._lock:
                     link.routed.discard(job.id)
                     job.link = None
+                self._note_reassign(job, link)
                 self._requeue.put((job, (link,),
                                    "fleet.jobs_replayed"))
                 return
@@ -1591,7 +2110,22 @@ class FleetRouter:
                 continue
             if job.kind == "candidates":
                 job.checkpoint = None  # restart: output truncates
+            self._note_reassign(job, link)
             self._requeue.put((job, (), "fleet.jobs_replayed"))
+
+    def _note_reassign(self, job: RoutedJob,
+                       link: EngineLink) -> None:
+        """A split shard's stripe is moving engines (PERF.md §31):
+        count it and tell the parent's client — the stripe resumes
+        from its last router-held checkpoint with ``acked`` already-
+        merged hits muted, so the merged stream never replays."""
+        if job.shard is None or job.parent is None:
+            return
+        telemetry.counter("fleet.shards_reassigned").add(1)
+        self._forward(job.parent, protocol.ev_range_reassign(
+            job.parent.id, shard=job.shard[0], shards=job.shard[1],
+            frm=link.engine_id, acked=job.n_forwarded,
+        ))
 
     # -- health --------------------------------------------------------
 
@@ -1902,6 +2436,7 @@ class _RouterSession:
                 ack.get("id", jid), ack.get("kind"),
                 engine=ack.get("engine"),
                 queued=bool(ack.get("queued")),
+                shards=ack.get("shards"),
             ))
             return True
         if op == "pause":
@@ -1914,6 +2449,11 @@ class _RouterSession:
             ))
         elif op == "cancel":
             self._router.cancel(jid)
+        elif op == "split":
+            ack = self._router.split(jid, doc.get("shards"))
+            self._emit(protocol.ev_accepted(
+                jid, ack.get("kind"), shards=ack.get("shards"),
+            ))
         elif op == "migrate":
             self._emit(self._router.migrate(jid, doc.get("engine")))
         elif op == "drain":

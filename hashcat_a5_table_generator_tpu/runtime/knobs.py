@@ -77,7 +77,7 @@ __all__ = ["KNOBS_VERSION", "LAYERS", "ROLES", "KNOBS"]
 #: Registry version (MAJOR.MINOR): knob/role/surface ADDITIONS bump the
 #: minor, removals/renames the major, metadata (defaults, precedence,
 #: notes) any re-pin.  ``--update-knobs`` refuses violations.
-KNOBS_VERSION = "1.0"
+KNOBS_VERSION = "1.1"
 
 #: The five places a knob can surface.
 LAYERS = ("env", "cli", "config", "serve-doc", "tune-profile")
@@ -220,11 +220,13 @@ KNOBS: Dict[str, Dict[str, Any]] = {
         "layers": {
             "config": {"surface": "pod", "default": None},
             "cli": {"surface": "--giant-job", "default": False},
+            "serve-doc": {"surface": "pod"},
         },
         "roles": ["trace", "fuse-compat"],
         "keys": {"trace": "pod", "fuse-compat": "pod"},
         "precedence": "config (CLI --giant-job derives it from the "
-                      "pod runtime)",
+                      "pod runtime; the fleet router's split scatter "
+                      "drives it per shard through the serve doc)",
         "note": "giant-job block striping (stripe, n_stripes); "
                 "pod-striped jobs refuse packed dispatch",
     },
@@ -561,7 +563,9 @@ KNOBS: Dict[str, Dict[str, Any]] = {
         "roles": ["host-only"],
         "precedence": "Engine(refuse_below=) > env > builtin (0.5)",
         "note": "packed-group re-fuse fill threshold; off disables "
-                "re-fuse",
+                "re-fuse; within[:ratio] keeps re-fuse on but pins "
+                "the within-group-only merge scope (the cross-group "
+                "control arm)",
     },
     "tune_profile": {
         "layers": {
@@ -786,6 +790,28 @@ KNOBS: Dict[str, Dict[str, Any]] = {
         "roles": ["host-only"],
         "precedence": "cli > builtin",
         "note": "router health/stats scrape cadence",
+    },
+    "split": {
+        "layers": {
+            "env": {"surface": "A5GEN_SPLIT", "default": None},
+            "cli": {"surface": "--split", "default": None},
+        },
+        "roles": ["host-only"],
+        "precedence": "cli > env > builtin (auto)",
+        "note": "fleet giant-job splitting (auto|on|off): scatter one "
+                "oversized crack job across engines as disjoint pod "
+                "stripes; host-side routing only — the merged stream "
+                "is byte-identical to solo",
+    },
+    "split_threshold": {
+        "layers": {
+            "cli": {"surface": "--split-threshold",
+                    "default": 4096},
+        },
+        "roles": ["host-only"],
+        "precedence": "cli > builtin",
+        "note": "word count at which split=auto scatters a crack job "
+                "(split=on ignores it; split=off never scatters)",
     },
     "replay_budget": {
         "layers": {"cli": {"surface": "--replay-budget",

@@ -55,6 +55,7 @@ __all__ = [
     "op_submit",
     "op_pause",
     "op_cancel",
+    "op_split",
     "op_stats",
     "op_metrics",
     "op_shutdown",
@@ -65,6 +66,8 @@ __all__ = [
     "ev_cancelled",
     "ev_failed",
     "ev_refused",
+    "ev_shard_done",
+    "ev_range_reassign",
     "ev_migrating",
     "ev_draining",
     "ev_stats",
@@ -79,7 +82,7 @@ __all__ = [
 #: checkpoint doc's ``wire_version``: field/op/event ADDITIONS bump the
 #: minor (old readers ignore unknown fields), removals/renames bump the
 #: major.  ``--update-protocol`` refuses a re-pin that violates this.
-PROTOCOL_VERSION = "1.1"
+PROTOCOL_VERSION = "1.2"
 
 #: The two envelope keys.  Outside this module they are banned as raw
 #: string literals (graftwire GW005, the GL012 sprawl discipline) —
@@ -150,6 +153,19 @@ WIRE_OPS: Dict[str, Dict[str, Any]] = {
             "every routed job off it (the autoscaler's reap half)"
         ),
     },
+    "split": {
+        "required": ["id"],
+        "optional": ["shards"],
+        "handlers": ["router"],
+        "note": (
+            "router-only: scatter one running crack job's superstep "
+            "block lattice across shards engines as disjoint "
+            "rank-stride pod ranges (pause -> checkpoint -> N shard "
+            "resubmits); the merged client stream stays (word,rank)-"
+            "ordered and exactly-once, and each shard checkpoint "
+            "stays interchangeable with a solo resume"
+        ),
+    },
     "stats": {
         "required": [],
         "optional": [],
@@ -185,7 +201,7 @@ WIRE_OPS: Dict[str, Dict[str, Any]] = {
 WIRE_EVENTS: Dict[str, Dict[str, Any]] = {
     "accepted": {
         "required": ["id", "kind"],
-        "optional": ["engine", "queued", "resumed"],
+        "optional": ["engine", "queued", "resumed", "shards"],
         "emitters": ["engine", "router"],
         "route": "control",
         "note": (
@@ -193,7 +209,9 @@ WIRE_EVENTS: Dict[str, Dict[str, Any]] = {
             "plane; the router synthesizes its own client-facing ack "
             "with the engine/queued additions (which engine the job "
             "placed on — null while admission-queued — and whether it "
-            "waits in the pending queue)"
+            "waits in the pending queue); shards appends only on a "
+            "split scatter's ack (how many rank-stride shard ranges "
+            "the job fanned out over)"
         ),
     },
     "hit": {
@@ -258,6 +276,32 @@ WIRE_EVENTS: Dict[str, Dict[str, Any]] = {
             "triggering fill ratio.  Informational — streams, "
             "checkpoints and results are unchanged — so the router's "
             "fallback forwards it verbatim"
+        ),
+    },
+    "shard_done": {
+        "required": ["id", "shard", "shards"],
+        "optional": ["engine", "n_hits"],
+        "emitters": ["router"],
+        "route": "synthesized",
+        "note": (
+            "router-synthesized split-job progress: shard (0-based "
+            "stripe index) of shards finished its disjoint block "
+            "range on engine with n_hits forwarded into the merge; "
+            "the engine only ever sees ordinary pod-striped crack "
+            "jobs, so it never emits this"
+        ),
+    },
+    "range_reassign": {
+        "required": ["id", "shard", "shards"],
+        "optional": ["from", "to", "acked"],
+        "emitters": ["router"],
+        "route": "synthesized",
+        "note": (
+            "router-synthesized split-job recovery: shard's block "
+            "range moved engines (from -> to) after a death or "
+            "rebalance, resuming from its last acked checkpoint "
+            "boundary with acked hits muted — never replayed into "
+            "the client"
         ),
     },
     "migrating": {
@@ -385,6 +429,16 @@ def op_cancel(jid: str) -> Dict[str, Any]:
     return {K_OP: "cancel", "id": jid}
 
 
+def op_split(jid: str, *, shards: Optional[int] = None
+             ) -> Dict[str, Any]:
+    """The router-only split op: scatter one running crack job across
+    ``shards`` engines (placement-chosen when omitted)."""
+    doc: Dict[str, Any] = {K_OP: "split", "id": jid}
+    if shards is not None:
+        doc["shards"] = shards
+    return doc
+
+
 def op_stats() -> Dict[str, Any]:
     return {K_OP: "stats"}
 
@@ -410,10 +464,12 @@ def ev_accepted(
     engine: Any = _UNSET,
     queued: bool = False,
     resumed: bool = False,
+    shards: Optional[int] = None,
 ) -> Dict[str, Any]:
     """The admission ack.  ``engine`` is router-only (pass even when
     None — a queued job's ack carries ``engine: null``); ``queued`` /
-    ``resumed`` append only when set, matching the historical docs."""
+    ``resumed`` append only when set, matching the historical docs;
+    ``shards`` appends only on a split scatter's ack (PERF.md §31)."""
     ev: Dict[str, Any] = {"id": jid, K_EVENT: "accepted", "kind": kind}
     if engine is not _UNSET:
         ev["engine"] = engine
@@ -421,6 +477,8 @@ def ev_accepted(
         ev["queued"] = True
     if resumed:
         ev["resumed"] = True
+    if shards is not None:
+        ev["shards"] = int(shards)
     return ev
 
 
@@ -518,6 +576,53 @@ def ev_refused(
         ev["jobs"] = jobs
     if fill is not None:
         ev["fill"] = fill
+    return ev
+
+
+def ev_shard_done(
+    jid: Any,
+    *,
+    shard: int,
+    shards: int,
+    engine: Optional[str] = None,
+    n_hits: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Router-synthesized split-job progress: one shard's disjoint
+    block range finished; the merged client stream keeps flowing from
+    the other shards."""
+    ev: Dict[str, Any] = {
+        "id": jid, K_EVENT: "shard_done",
+        "shard": shard, "shards": shards,
+    }
+    if engine is not None:
+        ev["engine"] = engine
+    if n_hits is not None:
+        ev["n_hits"] = n_hits
+    return ev
+
+
+def ev_range_reassign(
+    jid: Any,
+    *,
+    shard: int,
+    shards: int,
+    frm: Optional[str] = None,
+    to: Optional[str] = None,
+    acked: Optional[int] = None,
+) -> Dict[str, Any]:
+    """Router-synthesized split-job recovery: one shard's block range
+    moved engines, resuming from its last acked checkpoint boundary
+    with ``acked`` already-forwarded hits muted."""
+    ev: Dict[str, Any] = {
+        "id": jid, K_EVENT: "range_reassign",
+        "shard": shard, "shards": shards,
+    }
+    if frm is not None:
+        ev["from"] = frm
+    if to is not None:
+        ev["to"] = to
+    if acked is not None:
+        ev["acked"] = acked
     return ev
 
 

@@ -1,0 +1,99 @@
+"""Split-merge discipline fixtures: the router's k-way shard-hit merge
+(PERF.md §31).
+
+``CleanMerge`` is the sanctioned shape — the production
+``_SplitMerge`` reduced to its audited skeleton: one unconditional
+wire decode per merge round (the rank string parses once, at ingress),
+a lock-held append into the shard's buffer, and a drain whose per-
+shard bookkeeping compares already-parsed keys and pops every
+releasable head before the lock drops.  The ``Broken*`` variants
+commit the three merge-loop sins: re-decoding the event inside the
+per-shard drain scan (per-shard parse work once per hit), a second
+unconditional decode at ingress (per-hit work duplicated across the
+whole merged stream), and appending into a buffer nothing in the
+class ever pops (unbounded hoarding — one stalled shard holds every
+sibling's hits for the rest of the job).
+
+AST-only fixtures: the audit reads source, nothing here ever runs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+
+class CleanMerge:
+    def __init__(self, n):
+        self.n = n
+        self._bufs = [deque() for _ in range(n)]
+        self._marks = [None] * n
+        self._out = []
+
+    def _merge_round(self, i, ev):
+        key = (ev["word_index"], int(ev["rank"]))
+        with self._lock:
+            self._bufs[i].append((key, ev))
+            self._marks[i] = key
+            self._drain()
+
+    def _drain(self):
+        while True:
+            best, src = None, -1
+            for k in range(self.n):
+                if self._bufs[k] and (
+                    best is None or self._bufs[k][0][0] < best
+                ):
+                    best, src = self._bufs[k][0][0], k
+            if best is None:
+                return
+            self._out.append(self._bufs[src].popleft()[1])
+
+    def _flush(self):
+        self._out.clear()
+
+
+class BrokenPerShardDecode(CleanMerge):
+    """The per-shard-parse regression: the drain scan re-decodes the
+    buffered events' rank strings once per shard per hit instead of
+    comparing the parsed keys stored at ingress."""
+
+    def _merge_round(self, i, ev):
+        key = (ev["word_index"], int(ev["rank"]))
+        with self._lock:
+            self._bufs[i].append((key, ev))
+            self._marks[i] = key
+            best, src = None, -1
+            for k in range(self.n):
+                if self._bufs[k]:
+                    head = int(self._bufs[k][0][1]["rank"])
+                    if best is None or head < best:
+                        best, src = head, k
+            if src >= 0:
+                self._out.append(self._bufs[src].popleft()[1])
+
+
+class BrokenDoubleDecode(CleanMerge):
+    """A second unconditional decode of the same wire event — per-hit
+    work duplicated across the whole merged stream."""
+
+    def _merge_round(self, i, ev):
+        key = (int(ev["word_index"]), int(ev["rank"]))
+        with self._lock:
+            self._bufs[i].append((key, ev))
+            self._marks[i] = key
+            self._drain()
+
+
+class BrokenHoard:
+    """Append-only buffering: nothing in the class ever pops or clears
+    ``_hoard`` — one stalled sibling makes the buffer grow with the
+    whole merged stream."""
+
+    def __init__(self, n):
+        self.n = n
+        self._hoard = deque()
+
+    def _merge_round(self, i, ev):
+        key = (ev["word_index"], int(ev["rank"]))
+        with self._lock:
+            self._hoard.append((key, ev))

@@ -121,6 +121,64 @@ def test_parser_has_pipeline_ab():
     assert bench._build_bench_parser().parse_args(["--pipeline-ab"]).pipeline_ab
 
 
+def test_parser_has_split_ab_and_churn_cross():
+    """The §31 fleet-striping arms ride the same parser contract as the
+    other A/B flags (default-off; --split-engines sizes the fleet)."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    args = bench._build_bench_parser().parse_args([])
+    assert args.split_ab is False
+    assert args.churn_cross is False
+    assert args.split_engines == 2
+    args = bench._build_bench_parser().parse_args(
+        ["--split-ab", "--split-engines", "3"]
+    )
+    assert args.split_ab and args.split_engines == 3
+
+
+def test_compare_last_tpu_skips_partial_matrix(tmp_path, monkeypatch,
+                                               capsys):
+    """A partial autotune matrix is a checkpoint, not a best-geometry
+    measurement: --compare-last-tpu must refuse it as a baseline (and
+    say so) instead of rendering an inflated verdict against it."""
+    sys.path.insert(0, str(REPO))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    path = tmp_path / "BENCH_TPU_LAST.json"
+    monkeypatch.setattr(bench, "TPU_LAST_PATH", str(path))
+    partial = {
+        "metric": "md5_candidate_hashes_per_sec_per_chip",
+        "value": 9.9e9, "unit": "hashes/sec", "platform": "tpu",
+        "device_kind": "TPU v5 lite", "partial_matrix": True,
+        "timestamp": "2026-01-01T00:00:00Z",
+    }
+    path.write_text(json.dumps(partial))
+    bench.compare_last_tpu(1.0e8)
+    err = capsys.readouterr().err
+    assert "PARTIAL autotune matrix" in err
+    assert "skipped as baseline" in err
+    # No verdict line against the rejected record — the baseline slot
+    # reads as empty.
+    assert "verdict" not in err
+    assert "no usable BENCH_TPU_LAST.json" in err
+
+    # A completed record still compares (and the saver whitelists the
+    # partial_matrix flag through, so a later partial save is visible).
+    del partial["partial_matrix"]
+    path.write_text(json.dumps(partial))
+    bench.compare_last_tpu(1.0e8)
+    err = capsys.readouterr().err
+    assert "verdict" in err and "BEHIND" in err
+    bench.save_tpu_last({**partial, "partial_matrix": True})
+    assert json.loads(path.read_text())["partial_matrix"] is True
+
+
 import pytest  # noqa: E402
 
 

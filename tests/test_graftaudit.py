@@ -590,6 +590,60 @@ class TestPackRound:
 
 
 # ---------------------------------------------------------------------------
+# Split-merge round discipline (PERF.md §31)
+# ---------------------------------------------------------------------------
+
+
+class TestMergeLoop:
+    def test_clean_merge_passes(self):
+        from tools.graftaudit.transfers import audit_merge_loop
+
+        mod = _fixture("merge_loop")
+        assert audit_merge_loop(mod.CleanMerge, "fixture.merge") == []
+
+    def test_pershard_decode_flagged(self):
+        # The per-shard-parse regression: the drain scan re-decodes the
+        # wire event once per shard per hit.
+        from tools.graftaudit.transfers import audit_merge_loop
+
+        mod = _fixture("merge_loop")
+        findings = audit_merge_loop(
+            mod.BrokenPerShardDecode, "fixture.merge"
+        )
+        assert any(
+            "decode inside a for loop" in f.message for f in findings
+        )
+        assert all(f.check == "merge-loop" for f in findings)
+
+    def test_double_decode_flagged(self):
+        from tools.graftaudit.transfers import audit_merge_loop
+
+        mod = _fixture("merge_loop")
+        findings = audit_merge_loop(
+            mod.BrokenDoubleDecode, "fixture.merge"
+        )
+        assert any("unconditional" in f.message for f in findings)
+
+    def test_unbounded_buffer_flagged(self):
+        from tools.graftaudit.transfers import audit_merge_loop
+
+        mod = _fixture("merge_loop")
+        findings = audit_merge_loop(mod.BrokenHoard, "fixture.merge")
+        assert any("_hoard" in f.message for f in findings)
+        assert any("unbounded" in f.message for f in findings)
+
+    def test_production_merge_round_is_clean(self):
+        from hashcat_a5_table_generator_tpu.runtime.fleet import (
+            _SplitMerge,
+        )
+        from tools.graftaudit.transfers import audit_merge_loop
+
+        assert audit_merge_loop(
+            _SplitMerge, "runtime.fleet._SplitMerge._merge_round"
+        ) == []
+
+
+# ---------------------------------------------------------------------------
 # Telemetry placement (PERF.md §21): off the hot path
 # ---------------------------------------------------------------------------
 

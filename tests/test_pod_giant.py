@@ -170,6 +170,108 @@ class TestGiantJobResume:
         assert got.n_emitted == want.n_emitted
         assert got.words_done == want.words_done
 
+    def test_split_to_solo_resume_is_byte_exact(self, tmp_path):
+        """Split→solo round-trip (PERF.md §31): a stripe's mid-job
+        checkpoint resumes on the SOLO path byte-exactly — the replayed
+        prefix is the stripe's checkpointed hits, and the tail is the
+        full solo stream from the global cursor on (every stripe's
+        share, not just the checkpointing shard's).  This is the
+        boundary the fleet router reassigns a dead shard's range from:
+        nothing before the acked cursor replays, nothing after it is
+        missed."""
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, digests = planted_digests(spec, picks=(0, 1, 2, 4, 6))
+        path = str(tmp_path / "shard0.json")
+        pod_cfg = cfg(pod=(0, 2), checkpoint_path=path,
+                      checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        # Second-hit boom: guarantees a boundary checkpoint exists.
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=pod_cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+        boundary = (partial.cursor.word, partial.cursor.rank)
+
+        solo_cfg = cfg(checkpoint_path=path, checkpoint_every_s=0.0)
+        got = Sweep(spec, LEET, WORDS, digests,
+                    config=solo_cfg).run_crack()
+        assert got.resumed
+        full = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        want = sorted(
+            set(partial.hits)
+            | {(h.word_index, h.variant_rank) for h in full.hits
+               if (h.word_index, h.variant_rank) >= boundary}
+        )
+        assert [(h.word_index, h.variant_rank)
+                for h in sorted(got.hits,
+                                key=lambda h: (h.word_index,
+                                               h.variant_rank))] == want
+
+    def test_solo_to_split_resume_is_byte_exact(self, tmp_path):
+        """Solo→split round-trip (PERF.md §31): a SOLO mid-job
+        checkpoint seeds a full set of pod stripes — exactly the fleet
+        router's split scatter, which parks a running solo job and
+        hands its checkpoint to every shard.  Each shard replays the
+        checkpointed prefix; the stripes' tails are disjoint and their
+        union restores the full solo stream byte-exactly."""
+        import shutil
+
+        spec = AttackSpec(mode="default", algo="md5")
+        planted, digests = planted_digests(spec, picks=(0, 1, 2, 4, 6))
+        path = str(tmp_path / "solo.json")
+        solo_cfg = cfg(checkpoint_path=path, checkpoint_every_s=0.0)
+
+        class Boom(Exception):
+            pass
+
+        class ExplodingRecorder(HitRecorder):
+            def emit(self, record):
+                super().emit(record)
+                if len(self.hits) == 2:
+                    raise Boom()
+
+        first = Sweep(spec, LEET, WORDS, digests, config=solo_cfg)
+        with pytest.raises(Boom):
+            first.run_crack(ExplodingRecorder())
+        partial = load_checkpoint(path, first.fingerprint)
+        assert partial is not None
+        prefix = set(partial.hits)
+
+        shards = []
+        for p in range(2):
+            # Each shard resumes its own COPY: a resumed sweep keeps
+            # writing to its checkpoint path, exactly like the router
+            # handing the parked parent's checkpoint to every shard.
+            sp = str(tmp_path / f"seed{p}.json")
+            shutil.copy(path, sp)
+            res = Sweep(spec, LEET, WORDS, digests,
+                        config=cfg(pod=(p, 2), checkpoint_path=sp,
+                                   checkpoint_every_s=0.0)).run_crack()
+            assert res.resumed
+            shards.append(res)
+        full = Sweep(spec, LEET, WORDS, digests, config=cfg()).run_crack()
+        tails = [
+            [(h.word_index, h.variant_rank) for h in s.hits
+             if (h.word_index, h.variant_rank) not in prefix]
+            for s in shards
+        ]
+        # Disjoint stripe tails; prefix ∪ tails == the full solo stream.
+        assert not set(tails[0]) & set(tails[1])
+        assert sorted(prefix | set(tails[0]) | set(tails[1])) == sorted(
+            (h.word_index, h.variant_rank) for h in full.hits
+        )
+        assert {t[2] for t in hit_tuples(full)} == set(planted)
+
     @pytest.mark.slow  # ~4 s on the tier-1 host; the mid-stripe resume
     # test above keeps the giant-job checkpoint family's default arm
     def test_cursor_interchanges_with_single_device_path(self, tmp_path):

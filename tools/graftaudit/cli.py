@@ -293,6 +293,7 @@ def run_audit(
             audit_chunk_ring,
             audit_drive_loop,
             audit_host_transfers,
+            audit_merge_loop,
             audit_pack_round,
             audit_serve_loop,
         )
@@ -339,6 +340,20 @@ def run_audit(
                 audit_pack_round(
                     FusedGroup.pump,
                     "runtime.fuse.FusedGroup.pump",
+                )
+            )
+            # The split merge (PERF.md §31): the router's k-way shard
+            # merge runs once per hit on the reader threads — one wire
+            # decode per round, parse-free drain bookkeeping, bounded
+            # buffers.
+            from hashcat_a5_table_generator_tpu.runtime.fleet import (
+                _SplitMerge,
+            )
+
+            findings.extend(
+                audit_merge_loop(
+                    _SplitMerge,
+                    "runtime.fleet._SplitMerge._merge_round",
                 )
             )
 

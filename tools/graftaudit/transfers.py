@@ -697,6 +697,200 @@ def audit_pack_round(fn, entry: str) -> List[AuditFinding]:
     return findings
 
 
+def _self_attr_of(node: ast.AST) -> "str | None":
+    """The ``self`` attribute a call lands on, looked through
+    subscripts: ``self._bufs[i].append`` → ``"_bufs"``."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+#: Calls that drain a buffer — the release half of the merge's
+#: bounded-buffering contract.
+_DRAIN_ATTRS = frozenset({"popleft", "pop", "clear"})
+
+
+def _class_node(cls) -> ast.ClassDef:
+    """The ``ClassDef`` for ``cls``, tolerating classes from
+    dynamically-loaded modules (the fixture loader) where
+    ``inspect.getsource`` can't resolve a class (no ``sys.modules``
+    entry) — a method's code object still knows the file."""
+    try:
+        tree = ast.parse(textwrap.dedent(inspect.getsource(cls)))
+    except (OSError, TypeError, SyntaxError):
+        path = None
+        for val in cls.__dict__.values():
+            code = getattr(val, "__code__", None)
+            if code is not None and code.co_filename:
+                path = code.co_filename
+                break
+        if path is None:
+            raise OSError(f"no source file for {cls!r}") from None
+        with open(path, "r", encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls.__name__:
+            return node
+    raise OSError(f"no class body for {cls!r} in its source")
+
+
+def audit_merge_loop(cls, entry: str) -> List[AuditFinding]:
+    """Statically audit the split router's shard-merge round
+    (``runtime.fleet._SplitMerge``, PERF.md §31) — the per-hit path
+    that folds N shard streams into one ordered client stream.
+
+    The merge sits on the router's reader threads, once per hit, for
+    every split job at once — so its own discipline is what keeps
+    giant-job striping from moving the bottleneck into the router:
+
+    * exactly ONE unconditional decode (``int()``/``float()``/...) of
+      the wire event per merge round — the hit's rank string parses
+      once, at ingress; a second decode is per-hit work duplicated
+      across the whole merged stream;
+    * NO decode inside a ``for`` loop — the k-way drain bookkeeping
+      compares already-parsed keys; a parse hidden in the per-shard
+      scan re-decodes once per shard per hit (the merge spelling of
+      the per-member-fetch regression, PERF.md §22);
+    * every buffer the round ``.append``s to must drain — the same
+      self attribute must ``.popleft``/``.pop``/``.clear`` somewhere
+      in the class.  An append-only buffer is unbounded: one stalled
+      shard would hoard every sibling's hits for the rest of the job
+      instead of bounding the buffer at the stripe lag.
+
+    Takes the merge CLASS (the drain discipline is class-wide: the
+    round appends, the shared drain helper pops) and audits its
+    ``_merge_round`` method.
+    """
+    try:
+        cdef = _class_node(cls)
+    except (OSError, TypeError, SyntaxError) as exc:
+        return [
+            AuditFinding(
+                "config", entry,
+                f"merge round source unavailable for audit: {exc}",
+            )
+        ]
+    fdef = next(
+        (
+            n for n in ast.walk(cdef)
+            if isinstance(n, ast.FunctionDef) and n.name == "_merge_round"
+        ),
+        None,
+    )
+    if fdef is None:
+        return [
+            AuditFinding("config", entry,
+                         "merge class has no _merge_round to audit")
+        ]
+    findings: List[AuditFinding] = []
+
+    decodes: List[Tuple[ast.Call, bool, bool]] = []
+    appended: Set[str] = set()
+
+    def scan(node, conditional: bool, in_for: bool) -> None:
+        for sub in ast.walk(node):
+            if _is_fetch_call(sub):
+                decodes.append((sub, conditional, in_for))
+            elif (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("append", "appendleft")
+            ):
+                name = _self_attr_of(sub.func.value)
+                if name is not None:
+                    appended.add(name)
+
+    def walk(stmts, conditional: bool, in_for: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                scan(stmt.iter, conditional, in_for)
+                walk(stmt.body, conditional, True)
+                walk(stmt.orelse, conditional, in_for)
+            elif isinstance(stmt, ast.While):
+                scan(stmt.test, conditional, in_for)
+                walk(stmt.body, conditional, in_for)
+                walk(stmt.orelse, conditional, in_for)
+            elif isinstance(stmt, ast.If):
+                scan(stmt.test, conditional, in_for)
+                walk(stmt.body, True, in_for)
+                walk(stmt.orelse, True, in_for)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    scan(item.context_expr, conditional, in_for)
+                walk(stmt.body, conditional, in_for)
+            elif isinstance(stmt, ast.Try):
+                walk(stmt.body, conditional, in_for)
+                for h in stmt.handlers:
+                    walk(h.body, True, in_for)
+                walk(stmt.orelse, True, in_for)
+                walk(stmt.finalbody, conditional, in_for)
+            else:
+                scan(stmt, conditional, in_for)
+
+    walk(fdef.body, False, False)
+
+    if any(in_for for _n, _c, in_for in decodes):
+        findings.append(
+            AuditFinding(
+                "merge-loop", entry,
+                "wire decode inside a for loop of the merge round — the "
+                "per-shard drain bookkeeping must compare already-parsed "
+                "keys, not re-decode the event once per shard per hit "
+                "(PERF.md §31)",
+            )
+        )
+    n_uncond = sum(
+        1 for _n, conditional, _l in decodes if not conditional
+    )
+    if n_uncond != 1:
+        findings.append(
+            AuditFinding(
+                "merge-loop", entry,
+                f"{n_uncond} unconditional wire decode(s) per merge "
+                "round (want exactly one — the hit's rank parses once, "
+                "at ingress; every extra decode is per-hit work on the "
+                "router's reader threads, PERF.md §31)",
+            )
+        )
+    # The drain half may live anywhere in the class — including a base
+    # (the fixture variants subclass the clean skeleton); scan the MRO.
+    drained: Set[str] = set()
+    for base in getattr(cls, "__mro__", (cls,)):
+        if base is object:
+            continue
+        try:
+            node = cdef if base is cls else _class_node(base)
+        except (OSError, TypeError, SyntaxError):
+            continue
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _DRAIN_ATTRS
+            ):
+                name = _self_attr_of(sub.func.value)
+                if name is not None:
+                    drained.add(name)
+    for name in sorted(appended - drained):
+        findings.append(
+            AuditFinding(
+                "merge-loop", entry,
+                f"merge round appends to self.{name} but nothing in the "
+                "class ever pops/clears it — an append-only buffer is "
+                "unbounded hit hoarding: one stalled shard holds every "
+                "sibling's hits for the rest of the job instead of "
+                "bounding the buffer at the stripe lag (PERF.md §31)",
+            )
+        )
+    return findings
+
+
 #: Call names that move data between host and device — none of them
 #: belong in the chunk ring's consume loop (the worker thread owns every
 #: transfer; a synchronous one in the drive barriers the sweep behind
